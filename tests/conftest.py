@@ -1,0 +1,18 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh BEFORE jax import.
+
+The driver benches on one real TPU chip; tests validate multi-chip sharding on
+host CPU devices (ref test strategy: SURVEY.md §4 level 2 — hermetic in-process
+cluster tests, testkit.CreateMockStore analog).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,124 @@
+"""Columnar core tests (ref: pkg/util/chunk tests, pkg/util/codec tests)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.types import (
+    FieldType,
+    TypeKind,
+    bigint_type,
+    date_type,
+    decimal_type,
+    double_type,
+    string_type,
+)
+from tidb_tpu.utils import codec
+from tidb_tpu.utils.chunk import Chunk, Column, Dictionary, bucket_size, decode_chunk, encode_chunk
+
+
+def test_column_roundtrip_int():
+    col = Column.from_values([1, None, -5, 2**40], bigint_type())
+    assert col.to_list() == [1, None, -5, 2**40]
+    assert col.null_count == 1
+
+
+def test_column_roundtrip_string_dict():
+    d = Dictionary()
+    col = Column.from_values(["a", "b", None, "a"], string_type(), d)
+    assert col.to_list() == ["a", "b", None, "a"]
+    assert len(d) == 2
+    assert col.data[0] == col.data[3]
+
+
+def test_column_decimal_scaling():
+    col = Column.from_values([1.23, None, "4.56"], decimal_type(10, 2))
+    from decimal import Decimal
+
+    assert col.to_list() == [Decimal("1.23"), None, Decimal("4.56")]
+    assert col.data[0] == 123
+
+
+def test_column_date():
+    import datetime
+
+    col = Column.from_values(["1994-01-01", datetime.date(1970, 1, 2), None], date_type())
+    assert col.to_list()[0] == datetime.date(1994, 1, 1)
+    assert col.data[1] == 1
+
+
+def test_chunk_concat_take_pad():
+    a = Chunk([Column.from_values([1, 2], bigint_type()), Column.from_values([1.0, 2.0], double_type())])
+    b = Chunk([Column.from_values([3], bigint_type()), Column.from_values([3.0], double_type())])
+    c = Chunk.concat([a, b])
+    assert c.rows() == [(1, 1.0), (2, 2.0), (3, 3.0)]
+    assert c.take(np.array([2, 0])).rows() == [(3, 3.0), (1, 1.0)]
+    padded = c.columns[0].pad_to(8)
+    assert len(padded) == 8 and padded.null_count == 5
+
+
+def test_wire_codec_roundtrip():
+    ch = Chunk(
+        [
+            Column.from_values([1, None, 3], bigint_type()),
+            Column.from_values([1.5, 2.5, None], double_type()),
+            Column.from_values(["x", None, "yz"], string_type()),
+        ]
+    )
+    out = decode_chunk(encode_chunk(ch))
+    assert out.rows() == ch.rows()
+
+
+def test_bucket_size():
+    assert bucket_size(1) == 1024
+    assert bucket_size(1024) == 1024
+    assert bucket_size(1025) == 2048
+
+
+def test_dictionary_compact_order_preserving():
+    d = Dictionary()
+    col = Column.from_values(["c", "a", "b"], string_type(), d)
+    assert not d.sorted
+    remap = d.compact()
+    col.data = remap[col.data]
+    assert col.to_list() == ["c", "a", "b"]
+    assert d.sorted
+    # codes are now rank-ordered
+    assert col.data.tolist() == [2, 0, 1]
+
+
+# -- memcomparable codec ----------------------------------------------------
+
+
+def test_codec_int_order():
+    vals = [-(2**62), -100, -1, 0, 1, 5, 2**40, 2**62]
+    encs = [codec.encode_int_raw(v) for v in vals]
+    assert encs == sorted(encs)
+    assert [codec.decode_int_raw(e) for e in encs] == vals
+
+
+def test_codec_bytes_order_and_prefix_freedom():
+    vals = [b"", b"a", b"aa", b"aaaaaaaa", b"aaaaaaaaa", b"ab", b"b" * 20]
+    encs = [codec.encode_bytes_raw(v) for v in vals]
+    assert encs == sorted(encs)
+    for v, e in zip(vals, encs):
+        got, off = codec.decode_bytes_raw(e)
+        assert got == v and off == len(e)
+
+
+def test_codec_float_order():
+    vals = [float("-inf"), -1e300, -1.5, -0.0, 0.0, 1e-300, 2.5, 1e300, float("inf")]
+    encs = [codec.encode_key_float(v) for v in vals]
+    assert encs == sorted(encs)
+    for v, e in zip(vals, encs):
+        got, _ = codec.decode_key_one(e)
+        assert got == v or (v == 0 and got == 0)
+
+
+def test_codec_flagged_tuple_roundtrip():
+    buf = codec.encode_key_nil() + codec.encode_key_int(-7) + codec.encode_key_bytes(b"hello") + codec.encode_key_float(2.5)
+    v0, off = codec.decode_key_one(buf)
+    v1, off = codec.decode_key_one(buf, off)
+    v2, off = codec.decode_key_one(buf, off)
+    v3, off = codec.decode_key_one(buf, off)
+    assert (v0, v1, v2, v3) == (None, -7, b"hello", 2.5)
+    assert off == len(buf)

@@ -1,0 +1,190 @@
+"""KV/MVCC/2PC tests (ref: unistore mvcc tests, pkg/store/driver/txn tests)."""
+
+import pytest
+
+from tidb_tpu.kv import KeyRange
+from tidb_tpu.kv.kv import KeyLockedError, WriteConflictError
+from tidb_tpu.kv.memstore import MemStore, Mutation, OP_PUT
+from tidb_tpu.kv import tablecodec, rowcodec
+from tidb_tpu.types import bigint_type, double_type, string_type
+
+import numpy as np
+
+
+def test_tso_monotonic():
+    s = MemStore()
+    ts = [s.current_ts() for _ in range(100)]
+    assert ts == sorted(ts) and len(set(ts)) == 100
+
+
+def test_txn_put_get_commit():
+    s = MemStore()
+    t1 = s.begin()
+    t1.put(b"k1", b"v1")
+    assert t1.get(b"k1") == b"v1"  # own writes visible
+    t1.commit()
+
+    t2 = s.begin()
+    assert t2.get(b"k1") == b"v1"
+    t2.delete(b"k1")
+    assert t2.get(b"k1") is None
+    t2.commit()
+    assert s.begin().get(b"k1") is None
+
+
+def test_snapshot_isolation():
+    s = MemStore()
+    t1 = s.begin()
+    t1.put(b"a", b"1")
+    t1.commit()
+    reader = s.begin()  # snapshot here
+    t2 = s.begin()
+    t2.put(b"a", b"2")
+    t2.commit()
+    assert reader.get(b"a") == b"1"
+    assert s.begin().get(b"a") == b"2"
+
+
+def test_write_conflict():
+    s = MemStore()
+    t1 = s.begin()
+    t2 = s.begin()
+    t1.put(b"x", b"1")
+    t2.put(b"x", b"2")
+    t1.commit()
+    with pytest.raises(WriteConflictError):
+        t2.commit()
+
+
+def test_lock_resolution_after_rollback():
+    s = MemStore(lock_ttl_ms=0)  # abandoned locks expire immediately
+    t1 = s.begin()
+    t1.put(b"y", b"1")
+    s.prewrite(t1.membuf.mutations(), b"y", t1.start_ts)  # prewrite, never commit
+    # another reader resolves the abandoned lock via primary status
+    t2 = s.begin()
+    assert t2.get(b"y") is None
+
+
+def test_resolve_lock_commits_secondaries():
+    s = MemStore()
+    t1 = s.begin()
+    t1.put(b"p", b"1")
+    t1.put(b"s", b"2")
+    muts = t1.membuf.mutations()
+    s.prewrite(muts, b"p", t1.start_ts)
+    commit_ts = s.tso.ts()
+    s.commit([b"p"], t1.start_ts, commit_ts)  # primary committed, crash before secondary
+    t2 = s.begin()
+    assert t2.get(b"s") == b"2"  # resolved from primary
+
+
+def test_scan_with_membuf_overlay():
+    s = MemStore()
+    t = s.begin()
+    for i in range(5):
+        t.put(b"k%d" % i, b"v%d" % i)
+    t.commit()
+    t2 = s.begin()
+    t2.delete(b"k1")
+    t2.put(b"k9", b"v9")
+    got = t2.scan(KeyRange(b"k0", b"kz"))
+    assert [k for k, _ in got] == [b"k0", b"k2", b"k3", b"k4", b"k9"]
+
+
+def test_region_split_and_pd_ranges():
+    s = MemStore(region_split_keys=10)
+    t = s.begin()
+    for i in range(50):
+        t.put(tablecodec.record_key(1, i), b"row%d" % i)
+    t.commit()
+    assert len(s.regions()) > 1
+    tasks = s.pd.regions_in_ranges([tablecodec.record_range(1)])
+    # all 50 rows covered exactly once
+    total = 0
+    snap = s.get_snapshot(s.current_ts())
+    for region, ranges in tasks:
+        for r in ranges:
+            total += len(snap.scan(r))
+    assert total == 50
+
+
+def test_gc_prunes_versions():
+    s = MemStore()
+    for i in range(3):
+        t = s.begin()
+        t.put(b"g", b"v%d" % i)
+        t.commit()
+    safe = s.current_ts()
+    assert s.gc(safe) == 2
+    assert s.begin().get(b"g") == b"v2"
+
+
+def test_rowcodec_bulk_roundtrip():
+    schema = rowcodec.RowSchema([bigint_type(), double_type(), string_type(), bigint_type()])
+    rows = [
+        [1, 2.5, b"hello", None],
+        [None, -1.25, None, 7],
+        [3, None, b"", 9],
+    ]
+    bufs = [rowcodec.encode_row(schema, r) for r in rows]
+    for r, b in zip(rows, bufs):
+        assert rowcodec.decode_row(schema, b) == r
+    buf = b"".join(bufs)
+    starts = np.array([0, len(bufs[0]), len(bufs[0]) + len(bufs[1])], dtype=np.int64)
+    ends = np.array([len(bufs[0]), len(bufs[0]) + len(bufs[1]), len(buf)], dtype=np.int64)
+    datas, valids = rowcodec.decode_fixed_bulk(schema, buf, starts, [0, 1, 3])
+    assert datas[0].tolist() == [1, 0, 3] and valids[0].tolist() == [True, False, True]
+    assert datas[1].tolist() == [2.5, -1.25, 0.0] and valids[1].tolist() == [True, True, False]
+    assert datas[2].tolist() == [0, 7, 9] and valids[2].tolist() == [False, True, True]
+    svals, svalid = rowcodec.decode_strings_bulk(schema, buf, starts, 2)
+    assert svals == [b"hello", None, b""] and svalid.tolist() == [True, False, True]
+
+
+def test_commit_after_rollback_visible_in_scan():
+    # regression: a rollback record must not hide a later commit from scans
+    s = MemStore()
+    t1 = s.begin()
+    t1.put(b"rk", b"1")
+    s.prewrite(t1.membuf.mutations(), b"rk", t1.start_ts)
+    s.rollback([b"rk"], t1.start_ts)
+    t2 = s.begin()
+    t2.put(b"rk", b"2")
+    t2.commit()
+    got = s.begin().scan(KeyRange(b"rk", b"rl"))
+    assert got == [(b"rk", b"2")]
+
+
+def test_prewrite_conflict_seen_through_rollback():
+    # regression: rollback tombstones must not mask newer committed writes
+    s = MemStore()
+    tb = s.begin()  # early start_ts
+    ta = s.begin()
+    ta.put(b"ck", b"A")
+    ta.commit()
+    s.rollback([b"ck"], tb.start_ts)  # unrelated old-txn rollback on same key
+    tc_start = tb.start_ts  # older than ta's commit
+    from tidb_tpu.kv.memstore import Mutation, OP_PUT
+
+    with pytest.raises(WriteConflictError):
+        s.prewrite([Mutation(OP_PUT, b"ck", b"C")], b"ck", tc_start)
+
+
+def test_uint_two_complement_roundtrip():
+    from tidb_tpu.types import FieldType, TypeKind
+    from tidb_tpu.utils.chunk import Column
+
+    ut = FieldType(TypeKind.UINT)
+    col = Column.from_values([0, 1, 2**63, 2**64 - 1, None], ut)
+    assert col.to_list() == [0, 1, 2**63, 2**64 - 1, None]
+
+
+def test_record_key_roundtrip_and_order():
+    k1 = tablecodec.record_key(5, -10)
+    k2 = tablecodec.record_key(5, 3)
+    k3 = tablecodec.record_key(6, 0)
+    assert k1 < k2 < k3
+    assert tablecodec.decode_record_key(k2) == (5, 3)
+    rr = tablecodec.record_range(5)
+    assert rr.start <= k1 < rr.end and rr.start <= k2 < rr.end
+    assert not (rr.start <= k3 < rr.end)

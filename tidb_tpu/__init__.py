@@ -1,0 +1,35 @@
+"""tidb_tpu — a TPU-native distributed SQL framework.
+
+A from-scratch rebuild of the capability surface of TiDB (reference:
+/root/reference, MySQL-compatible HTAP SQL layer in Go) designed TPU-first:
+
+- Columnar ``Chunk``/``Column`` batches (Arrow-style, fixed-width + dictionary
+  encoded strings) map 1:1 onto device arrays (ref: pkg/util/chunk).
+- Pushed-down coprocessor DAG fragments (TableScan/Selection/HashAgg/StreamAgg/
+  TopN/Limit — ref: pkg/store/mockstore/unistore/cophandler/closure_exec.go)
+  execute as jitted XLA kernels over padded static-shape column batches.
+- MPP exchange (Hash/Broadcast/PassThrough — ref: pkg/planner/core/fragment.go,
+  unistore cophandler/mpp_exec.go) maps onto ``jax.lax`` collectives
+  (all_to_all / all_gather / psum) over a ``jax.sharding.Mesh``.
+- A Volcano SQL engine (parser → planner → executor) sits on top, with the
+  planner's engine-isolation hook (ref: pkg/planner/core/planbuilder.go
+  filterPathByIsolationRead) routing eligible plans to the ``tpu`` engine.
+
+Quick start::
+
+    import tidb_tpu
+    db = tidb_tpu.open()            # embedded store, in-process
+    db.execute("CREATE TABLE t (a BIGINT, b DOUBLE)")
+    db.execute("INSERT INTO t VALUES (1, 2.5), (2, 3.5)")
+    rows = db.query("SELECT a, SUM(b) FROM t GROUP BY a")
+"""
+
+__version__ = "0.1.0"
+
+__all__ = ["open", "__version__"]
+
+
+def open(*args, **kwargs):  # noqa: A001  (deliberate: db handle factory)
+    from tidb_tpu.session.session import open_db
+
+    return open_db(*args, **kwargs)

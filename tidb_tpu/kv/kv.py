@@ -1,0 +1,128 @@
+"""KV abstraction layer (ref: pkg/kv/kv.go)."""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Optional, Protocol, Sequence
+
+
+class StoreType(enum.Enum):
+    """Which engine executes a pushed-down fragment (ref: kv.go:353
+    StoreType{TiKV, TiFlash, TiDB}). HOST is the CPU reference engine
+    (unistore-cophandler analog), TPU is the XLA engine (TiFlash analog),
+    ROOT means "execute in the SQL layer" (TiDB memtables)."""
+
+    HOST = "host"
+    TPU = "tpu"
+    ROOT = "root"
+
+
+class RequestType(enum.IntEnum):
+    DAG = 103  # mirrors kv.ReqTypeDAG
+    ANALYZE = 104
+    CHECKSUM = 105
+
+
+@dataclass(frozen=True)
+class KeyRange:
+    """Half-open [start, end)."""
+
+    start: bytes
+    end: bytes
+
+    def intersect(self, other: "KeyRange") -> Optional["KeyRange"]:
+        s = max(self.start, other.start)
+        e = min(self.end, other.end)
+        return KeyRange(s, e) if s < e else None
+
+
+@dataclass
+class Request:
+    """A pushdown request (ref: kv.Request kv.go:533)."""
+
+    tp: RequestType
+    data: Any  # dagpb.DAGRequest (tidb_tpu.copr.dagpb)
+    ranges: list[KeyRange]
+    store_type: StoreType = StoreType.HOST
+    start_ts: int = 0
+    concurrency: int = 8
+    keep_order: bool = False
+    desc: bool = False
+    paging: bool = True
+    # partition pushdown: list of (physical_table_id, ranges) like
+    # kv.Request.PartitionIDAndRanges (kv.go:544)
+    partition_ranges: list[tuple[int, list[KeyRange]]] = field(default_factory=list)
+
+
+class Response(Protocol):
+    """Streaming response (ref: kv.Response kv.go:648). Yields
+    copr.CopResult items; exhausted when the iterator ends."""
+
+    def __iter__(self) -> Iterator[Any]: ...
+
+    def close(self) -> None: ...
+
+
+class Client(Protocol):
+    """ref: kv.Client kv.go:316."""
+
+    def send(self, req: Request) -> Response: ...
+
+
+class Storage(Protocol):
+    """ref: kv.Storage. Concrete impl: tidb_tpu.kv.memstore.MemStore."""
+
+    def get_client(self) -> Client: ...
+
+    def current_ts(self) -> int: ...
+
+    def get_snapshot(self, ts: int): ...
+
+    def begin(self): ...
+
+
+class TimestampOracle:
+    """TSO: (physical_ms << 18) | logical, globally unique and monotonic
+    (ref: PD TSO; pkg/store/mockstore/unistore/pd.go)."""
+
+    _PHYSICAL_SHIFT = 18
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._last = 0
+
+    def ts(self) -> int:
+        with self._lock:
+            phys = int(time.time() * 1000) << self._PHYSICAL_SHIFT
+            if phys <= self._last:
+                self._last += 1
+            else:
+                self._last = phys
+            return self._last
+
+    @staticmethod
+    def physical_ms(ts: int) -> int:
+        return ts >> TimestampOracle._PHYSICAL_SHIFT
+
+
+class KVError(Exception):
+    pass
+
+
+class WriteConflictError(KVError):
+    def __init__(self, key: bytes, conflict_ts: int, start_ts: int):
+        super().__init__(f"write conflict on {key!r}: commit_ts {conflict_ts} > start_ts {start_ts}")
+        self.key, self.conflict_ts, self.start_ts = key, conflict_ts, start_ts
+
+
+class KeyLockedError(KVError):
+    def __init__(self, key: bytes, lock):
+        super().__init__(f"key {key!r} locked by txn {lock.start_ts}")
+        self.key, self.lock = key, lock
+
+
+class TxnAbortedError(KVError):
+    pass

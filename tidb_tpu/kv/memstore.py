@@ -1,0 +1,451 @@
+"""Embedded MVCC store — the in-process engine host (unistore analog).
+
+Reference parity: pkg/store/mockstore/unistore/tikv/mvcc.go (MVCCStore,
+Prewrite :768, Commit :1240), region.go (region management), pd.go (mock PD).
+Badger-LSM is replaced by an in-memory hash map + lazily-sorted key index:
+bulk loads append O(1) per key and the sorted view rebuilds once per scan
+epoch, which matches the analytics-heavy profile of the TPU engine.
+
+Percolator semantics (server side):
+- ``prewrite``: lock check → write-conflict check → stage lock+value.
+- ``commit``: move staged value into the write column at commit_ts.
+- ``rollback`` / ``resolve_locks`` / ``check_txn_status``: crash recovery.
+
+Regions: half-open key ranges with a data_version bumped on every committed
+write batch — the TPU engine's columnar cache keys off (region_id,
+data_version) to reuse device-resident columns across queries (TiFlash's
+delta/stable analog, rebuilt rather than merged).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from dataclasses import dataclass, field
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from tidb_tpu.kv.kv import (
+    KeyLockedError,
+    KeyRange,
+    StoreType,
+    TimestampOracle,
+    TxnAbortedError,
+    WriteConflictError,
+)
+from tidb_tpu.kv import tablecodec
+
+OP_PUT = "P"
+OP_DEL = "D"
+
+
+@dataclass(frozen=True)
+class Write:
+    """One committed version. Chains in MemStore._writes are strictly
+    ascending by commit_ts — every append site must preserve this, it is what
+    prewrite's conflict check, Snapshot._visible and gc() rely on. Rollback
+    tombstones live out-of-band in MemStore._rollbacks."""
+
+    commit_ts: int
+    start_ts: int
+    op: str
+    value: bytes = b""
+
+
+@dataclass
+class Lock:
+    primary: bytes
+    start_ts: int
+    op: str
+    value: bytes
+    ttl_ms: int = 3000
+    created_ms: float = 0.0  # wall-clock at prewrite; TTL expiry base
+
+    def expired(self) -> bool:
+        import time
+
+        return (time.time() * 1000 - self.created_ms) >= self.ttl_ms
+
+
+@dataclass
+class Mutation:
+    op: str  # OP_PUT / OP_DEL
+    key: bytes
+    value: bytes = b""
+
+
+@dataclass
+class Region:
+    """ref: unistore/tikv/region.go; metadata served by the embedded PD."""
+
+    region_id: int
+    start: bytes
+    end: bytes  # b"" == +inf
+    data_version: int = 0
+    max_commit_ts: int = 0
+    key_count: int = 0
+
+    def contains(self, key: bytes) -> bool:
+        return self.start <= key and (self.end == b"" or key < self.end)
+
+    def range(self) -> KeyRange:
+        return KeyRange(self.start, self.end if self.end else b"\xff" * 32)
+
+
+class PlacementDriver:
+    """Embedded PD: region metadata + id allocation (ref: unistore/pd.go).
+    Region→node placement for MPP lives in tidb_tpu.parallel."""
+
+    def __init__(self, store: "MemStore"):
+        self._store = store
+
+    def regions_in_ranges(self, ranges: Sequence[KeyRange]) -> list[tuple[Region, list[KeyRange]]]:
+        """Split key ranges by region boundary (ref: copr/coprocessor.go:334
+        buildCopTasks / region_cache.SplitKeyRangesByBuckets)."""
+        out: list[tuple[Region, list[KeyRange]]] = []
+        for region in self._store.regions():
+            rr = region.range()
+            pieces = [p for kr in ranges if (p := kr.intersect(rr)) is not None]
+            if pieces:
+                out.append((region, pieces))
+        return out
+
+
+class BulkRows:
+    """Zero-loop handoff of a record scan: concatenated row values + offsets,
+    ready for rowcodec.decode_fixed_bulk."""
+
+    __slots__ = ("handles", "starts", "ends", "buf")
+
+    def __init__(self, handles: np.ndarray, starts: np.ndarray, ends: np.ndarray, buf: bytes):
+        self.handles, self.starts, self.ends, self.buf = handles, starts, ends, buf
+
+    def __len__(self) -> int:
+        return len(self.handles)
+
+
+class Snapshot:
+    """Consistent read view at read_ts (ref: kv.Snapshot; unistore mvcc
+    reader)."""
+
+    def __init__(self, store: "MemStore", read_ts: int):
+        self._store = store
+        self.read_ts = read_ts
+
+    def _visible(self, writes: list[Write]) -> Optional[Write]:
+        # writes ascend by commit_ts; walk from the end
+        for w in reversed(writes):
+            if w.commit_ts <= self.read_ts:
+                return w
+        return None
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._store._mu:
+            self._store._check_lock(key, self.read_ts)
+            writes = self._store._writes.get(key)
+            if not writes:
+                return None
+            w = self._visible(writes)
+            if w is None or w.op == OP_DEL:
+                return None
+            return w.value
+
+    def scan(self, kr: KeyRange, limit: int = 2**63, reverse: bool = False) -> list[tuple[bytes, bytes]]:
+        """Eager scan — materializes under the store lock, never holds it
+        across caller iterations."""
+        out: list[tuple[bytes, bytes]] = []
+        with self._store._mu:
+            keys = self._store._sorted_slice(kr)
+            if reverse:
+                keys = keys[::-1]
+            for k in keys:
+                self._store._check_lock(k, self.read_ts)
+                w = self._visible(self._store._writes[k])
+                if w is not None and w.op == OP_PUT:
+                    out.append((k, w.value))
+                    if len(out) >= limit:
+                        break
+        return out
+
+    def scan_record_rows(self, kr: KeyRange) -> BulkRows:
+        """Scan record keys in [kr) and pack visible row values contiguously
+        — the hot path feeding the columnar cache."""
+        handles: list[int] = []
+        chunks: list[bytes] = []
+        starts: list[int] = []
+        ends: list[int] = []
+        off = 0
+        with self._store._mu:
+            keys = self._store._sorted_slice(kr)
+            writes_map = self._store._writes
+            locks = self._store._locks
+            read_ts = self.read_ts
+            for k in keys:
+                if locks and k in locks:
+                    self._store._check_lock(k, read_ts)
+                w = self._visible(writes_map[k])
+                if w is None or w.op != OP_PUT:
+                    continue
+                if not tablecodec.is_record_key(k):
+                    continue
+                handles.append(tablecodec.decode_record_key(k)[1])
+                chunks.append(w.value)
+                starts.append(off)
+                off += len(w.value)
+                ends.append(off)
+        return BulkRows(
+            np.asarray(handles, dtype=np.int64),
+            np.asarray(starts, dtype=np.int64),
+            np.asarray(ends, dtype=np.int64),
+            b"".join(chunks),
+        )
+
+
+class MemStore:
+    """The storage node. One process can host several (multi-"node" tests)."""
+
+    def __init__(self, region_split_keys: int = 500_000, lock_ttl_ms: int = 3000):
+        self.lock_ttl_ms = lock_ttl_ms
+        self._mu = threading.RLock()
+        self._writes: dict[bytes, list[Write]] = {}
+        # key → start_ts set of rolled-back txns (out-of-band so write chains
+        # stay strictly ascending by commit_ts)
+        self._rollbacks: dict[bytes, set[int]] = {}
+        self._locks: dict[bytes, Lock] = {}
+        self._sorted: list[bytes] | None = []
+        self.tso = TimestampOracle()
+        self._region_split_keys = region_split_keys
+        self._regions: list[Region] = [Region(region_id=1, start=b"", end=b"")]
+        self._next_region_id = 2
+        self.pd = PlacementDriver(self)
+        self._client = None  # installed by copr.CopClient wiring
+
+    # -- kv.Storage surface ------------------------------------------------
+    def current_ts(self) -> int:
+        return self.tso.ts()
+
+    def get_snapshot(self, ts: int) -> Snapshot:
+        return Snapshot(self, ts)
+
+    def begin(self):
+        from tidb_tpu.kv.txn import Txn
+
+        return Txn(self)
+
+    def get_client(self):
+        if self._client is None:
+            from tidb_tpu.copr.client import CopClient
+
+            self._client = CopClient(self)
+        return self._client
+
+    # -- sorted key index --------------------------------------------------
+    def _ensure_sorted(self) -> list[bytes]:
+        if self._sorted is None:
+            self._sorted = sorted(self._writes.keys())
+        return self._sorted
+
+    def _sorted_slice(self, kr: KeyRange) -> list[bytes]:
+        keys = self._ensure_sorted()
+        lo = bisect.bisect_left(keys, kr.start)
+        hi = bisect.bisect_left(keys, kr.end)
+        return keys[lo:hi]
+
+    # -- region management -------------------------------------------------
+    def regions(self) -> list[Region]:
+        with self._mu:
+            return list(self._regions)
+
+    def region_for_key(self, key: bytes) -> Region:
+        with self._mu:
+            for r in self._regions:
+                if r.contains(key):
+                    return r
+            raise KeyError(f"no region for {key!r}")
+
+    def split_region(self, split_key: bytes) -> None:
+        """Manual split (ref: failpoint-forced splits in tests)."""
+        with self._mu:
+            for i, r in enumerate(self._regions):
+                if r.contains(split_key) and split_key > r.start:
+                    new = Region(
+                        region_id=self._next_region_id,
+                        start=split_key,
+                        end=r.end,
+                        data_version=r.data_version,
+                        max_commit_ts=r.max_commit_ts,
+                    )
+                    self._next_region_id += 1
+                    r.end = split_key
+                    self._regions.insert(i + 1, new)
+                    self._recount_region(r)
+                    self._recount_region(new)
+                    return
+
+    def _recount_region(self, r: Region) -> None:
+        r.key_count = len(self._sorted_slice(r.range()))
+
+    def _maybe_auto_split(self, r: Region) -> None:
+        if r.key_count <= self._region_split_keys:
+            return
+        keys = self._sorted_slice(r.range())
+        if len(keys) < 2:
+            return
+        self.split_region(keys[len(keys) // 2])
+
+    # -- percolator (server side; ref: mvcc.go:768 Prewrite, :1240 Commit) --
+    def _check_lock(self, key: bytes, read_ts: int) -> None:
+        lock = self._locks.get(key)
+        if lock is not None and lock.start_ts <= read_ts:
+            raise KeyLockedError(key, lock)
+
+    def prewrite(self, mutations: Sequence[Mutation], primary: bytes, start_ts: int) -> None:
+        with self._mu:
+            for m in mutations:
+                lock = self._locks.get(m.key)
+                if lock is not None and lock.start_ts != start_ts:
+                    raise KeyLockedError(m.key, lock)
+                writes = self._writes.get(m.key)
+                if writes and writes[-1].commit_ts > start_ts:
+                    raise WriteConflictError(m.key, writes[-1].commit_ts, start_ts)
+                if start_ts in self._rollbacks.get(m.key, ()):
+                    raise TxnAbortedError(f"txn {start_ts} already rolled back at {m.key!r}")
+            import time
+
+            now_ms = time.time() * 1000
+            for m in mutations:
+                self._locks[m.key] = Lock(
+                    primary=primary,
+                    start_ts=start_ts,
+                    op=m.op,
+                    value=m.value,
+                    ttl_ms=self.lock_ttl_ms,
+                    created_ms=now_ms,
+                )
+
+    def commit(self, keys: Sequence[bytes], start_ts: int, commit_ts: int) -> None:
+        with self._mu:
+            touched: set[int] = set()
+            for k in keys:
+                lock = self._locks.get(k)
+                if lock is None or lock.start_ts != start_ts:
+                    # idempotent re-commit or lost lock
+                    if any(w.start_ts == start_ts for w in self._writes.get(k, [])):
+                        continue  # already committed
+                    raise TxnAbortedError(f"commit of {k!r}@{start_ts}: lock not found")
+                del self._locks[k]
+                chain = self._writes.setdefault(k, [])
+                is_new = not chain
+                chain.append(Write(commit_ts, start_ts, OP_PUT if lock.op == OP_PUT else OP_DEL, lock.value))
+                if is_new and self._sorted is not None:
+                    # cheap append keeps sortedness only if appending at tail
+                    if self._sorted and self._sorted[-1] < k:
+                        self._sorted.append(k)
+                    else:
+                        self._sorted = None
+                region = self.region_for_key(k)
+                region.max_commit_ts = max(region.max_commit_ts, commit_ts)
+                if is_new:
+                    region.key_count += 1
+                touched.add(id(region))
+            for r in self._regions:
+                if id(r) in touched:
+                    r.data_version += 1
+                    self._maybe_auto_split(r)
+
+    def rollback(self, keys: Sequence[bytes], start_ts: int) -> None:
+        with self._mu:
+            for k in keys:
+                lock = self._locks.get(k)
+                if lock is not None and lock.start_ts == start_ts:
+                    del self._locks[k]
+                self._rollbacks.setdefault(k, set()).add(start_ts)
+
+    def check_txn_status(self, primary: bytes, start_ts: int) -> tuple[str, int]:
+        """→ ("committed", commit_ts) | ("rolled_back", 0) | ("locked", 0).
+        (ref: unistore CheckTxnStatus; TTL expiry handled by caller policy)"""
+        with self._mu:
+            lock = self._locks.get(primary)
+            if lock is not None and lock.start_ts == start_ts:
+                if lock.expired():
+                    # dead txn: roll back its primary so the decision is durable
+                    del self._locks[primary]
+                    self._rollbacks.setdefault(primary, set()).add(start_ts)
+                    return "rolled_back", 0
+                return "locked", 0
+            for w in self._writes.get(primary, []):
+                if w.start_ts == start_ts:
+                    return "committed", w.commit_ts
+            return "rolled_back", 0  # no lock, no write → treat as rolled back
+
+    def resolve_lock(self, key: bytes, lock: Lock) -> None:
+        """Resolve one stuck lock by consulting its primary."""
+        status, commit_ts = self.check_txn_status(lock.primary, lock.start_ts)
+        if status == "committed":
+            self.commit([key], lock.start_ts, commit_ts)
+        elif status == "rolled_back":
+            self.rollback([key], lock.start_ts)
+        # "locked": primary still alive → caller backs off and retries
+
+    # -- GC (ref: pkg/store/gcworker) ---------------------------------------
+    def gc(self, safe_ts: int) -> int:
+        """Drop versions no snapshot at ts ≥ safe_ts can see. Returns number
+        of pruned version records."""
+        pruned = 0
+        with self._mu:
+            dead_keys = []
+            for k, writes in self._writes.items():
+                # find newest write with commit_ts <= safe_ts; keep it (unless DEL), drop older
+                keep_from = 0
+                for i in range(len(writes) - 1, -1, -1):
+                    if writes[i].commit_ts <= safe_ts:
+                        keep_from = i
+                        if writes[i].op == OP_DEL:
+                            keep_from = i + 1
+                        break
+                if keep_from > 0:
+                    pruned += keep_from
+                    del writes[:keep_from]
+                if not writes:
+                    dead_keys.append(k)
+            for k in dead_keys:
+                del self._writes[k]
+            # rollback tombstones older than the GC horizon can never matter
+            # to a future prewrite (its start_ts would conflict anyway)
+            for k in list(self._rollbacks):
+                self._rollbacks[k] = {ts for ts in self._rollbacks[k] if ts > safe_ts}
+                if not self._rollbacks[k]:
+                    del self._rollbacks[k]
+            if dead_keys:
+                self._sorted = None
+                for r in self._regions:
+                    self._recount_region(r)
+        return pruned
+
+    # -- raw ops (catalog/meta convenience; single-key autocommit) ----------
+    def raw_put(self, key: bytes, value: bytes) -> None:
+        with self._mu:  # ts drawn under the lock keeps chains ascending
+            ts = self.tso.ts()
+            chain = self._writes.setdefault(key, [])
+            if not chain and self._sorted is not None:
+                if self._sorted and self._sorted[-1] < key:
+                    self._sorted.append(key)
+                else:
+                    self._sorted = None
+            chain.append(Write(ts, ts, OP_PUT, value))
+            r = self.region_for_key(key)
+            r.max_commit_ts = max(r.max_commit_ts, ts)
+            r.data_version += 1
+
+    def raw_get(self, key: bytes) -> Optional[bytes]:
+        return Snapshot(self, self.tso.ts()).get(key)
+
+    def raw_delete(self, key: bytes) -> None:
+        with self._mu:
+            ts = self.tso.ts()
+            self._writes.setdefault(key, []).append(Write(ts, ts, OP_DEL))
+            self.region_for_key(key).data_version += 1
+
+    def raw_scan(self, kr: KeyRange, limit: int = 2**63) -> list[tuple[bytes, bytes]]:
+        return Snapshot(self, self.tso.ts()).scan(kr, limit)

@@ -1,0 +1,151 @@
+"""Client-side transaction: membuffer + two-phase commit driver.
+
+Reference parity: pkg/session/txn.go (LazyTxn membuffer with per-statement
+staging), tikv/client-go 2PC (prewrite primary-first → TSO commit_ts → commit
+primary → commit secondaries), pkg/store/driver/txn. Single-process build
+commits synchronously; the secondary-commit fan-out is where a multi-node
+deployment parallelizes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from tidb_tpu.kv.kv import KeyLockedError, KeyRange, TxnAbortedError, WriteConflictError
+from tidb_tpu.kv.memstore import MemStore, Mutation, OP_DEL, OP_PUT, Snapshot
+
+
+class MemBuffer:
+    """Uncommitted writes with statement staging (ref: LazyTxn staging,
+    session/txn.go:128 flushStmtBuf)."""
+
+    def __init__(self):
+        self._buf: dict[bytes, tuple[str, bytes]] = {}
+        self._stages: list[dict[bytes, tuple[str, bytes] | None]] = []
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._record(key)
+        self._buf[key] = (OP_PUT, value)
+
+    def delete(self, key: bytes) -> None:
+        self._record(key)
+        self._buf[key] = (OP_DEL, b"")
+
+    def get(self, key: bytes):
+        ent = self._buf.get(key)
+        if ent is None:
+            return None
+        return None if ent[0] == OP_DEL else ent[1]
+
+    def contains(self, key: bytes) -> bool:
+        return key in self._buf
+
+    def is_deleted(self, key: bytes) -> bool:
+        ent = self._buf.get(key)
+        return ent is not None and ent[0] == OP_DEL
+
+    def _record(self, key: bytes) -> None:
+        if self._stages:
+            st = self._stages[-1]
+            if key not in st:
+                st[key] = self._buf.get(key)
+
+    # statement staging: begin at stmt start, rollback on stmt error
+    def stage(self) -> None:
+        self._stages.append({})
+
+    def release_stage(self) -> None:
+        self._stages.pop()
+
+    def rollback_stage(self) -> None:
+        for key, old in self._stages.pop().items():
+            if old is None:
+                self._buf.pop(key, None)
+            else:
+                self._buf[key] = old
+
+    def mutations(self) -> list[Mutation]:
+        return [Mutation(op, k, v) for k, (op, v) in sorted(self._buf.items())]
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+
+class Txn:
+    """One transaction. Reads go to a start_ts snapshot overlaid with the
+    membuffer; commit runs percolator 2PC against the store."""
+
+    def __init__(self, store: MemStore, start_ts: Optional[int] = None):
+        self.store = store
+        self.start_ts = start_ts if start_ts is not None else store.tso.ts()
+        self.snapshot = Snapshot(store, self.start_ts)
+        self.membuf = MemBuffer()
+        self.commit_ts: Optional[int] = None
+        self._done = False
+
+    # -- reads -------------------------------------------------------------
+    def get(self, key: bytes) -> Optional[bytes]:
+        if self.membuf.contains(key):
+            return self.membuf.get(key)
+        return self._retry_locked(lambda: self.snapshot.get(key))
+
+    def scan(self, kr: KeyRange, limit: int = 2**63) -> list[tuple[bytes, bytes]]:
+        base = dict(self._retry_locked(lambda: self.snapshot.scan(kr)))
+        for k, (op, v) in self.membuf._buf.items():
+            if kr.start <= k < kr.end:
+                if op == OP_DEL:
+                    base.pop(k, None)
+                else:
+                    base[k] = v
+        return sorted(base.items())[:limit]
+
+    def _retry_locked(self, fn, max_retries: int = 16):
+        import time
+
+        for i in range(max_retries):
+            try:
+                return fn()
+            except KeyLockedError as e:
+                self.store.resolve_lock(e.key, e.lock)
+                if i > 0:
+                    time.sleep(min(0.001 * (1 << i), 0.1))  # backoff while lock holder lives
+        raise TxnAbortedError("lock resolution did not converge")
+
+    # -- writes ------------------------------------------------------------
+    def put(self, key: bytes, value: bytes) -> None:
+        self.membuf.put(key, value)
+
+    def delete(self, key: bytes) -> None:
+        self.membuf.delete(key)
+
+    # -- 2PC ---------------------------------------------------------------
+    def commit(self) -> int:
+        assert not self._done, "txn already finished"
+        self._done = True
+        muts = self.membuf.mutations()
+        if not muts:
+            self.commit_ts = self.start_ts
+            return self.commit_ts
+        primary = muts[0].key
+        try:
+            self.store.prewrite(muts, primary, self.start_ts)
+        except KeyLockedError as e:
+            self.store.resolve_lock(e.key, e.lock)
+            # single retry after resolution; else surface the conflict
+            self.store.prewrite(muts, primary, self.start_ts)
+        self.commit_ts = self.store.tso.ts()
+        # commit primary first — the txn is durably decided once this returns
+        self.store.commit([primary], self.start_ts, self.commit_ts)
+        secondaries = [m.key for m in muts if m.key != primary]
+        if secondaries:
+            self.store.commit(secondaries, self.start_ts, self.commit_ts)
+        return self.commit_ts
+
+    def rollback(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        keys = [m.key for m in self.membuf.mutations()]
+        if keys:
+            self.store.rollback(keys, self.start_ts)

@@ -1,0 +1,96 @@
+"""Datum: one scalar value crossing the host boundary (constants, point rows).
+
+Reference parity: pkg/types/datum.go. Heavily simplified: on the device there
+are no datums at all — only columns; Datum exists for literals in plans, keys
+in point lookups, and row assembly in the write path.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from dataclasses import dataclass
+from typing import Any
+
+from tidb_tpu.types.field_type import FieldType, TypeKind
+
+_EPOCH_DATE = _dt.date(1970, 1, 1)
+_EPOCH_DT = _dt.datetime(1970, 1, 1)
+
+
+class _Null:
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "NULL"
+
+    def __bool__(self):
+        return False
+
+
+NULL = _Null()
+
+
+@dataclass(frozen=True)
+class Datum:
+    """A typed scalar. ``value`` holds the *logical* Python value
+    (int/float/str/bytes/date/datetime/None)."""
+
+    value: Any
+    ftype: FieldType
+
+    @property
+    def is_null(self) -> bool:
+        return self.value is None
+
+    def physical(self) -> Any:
+        """Encode to the device representation (int64/float64) — strings are
+        NOT encodable without a dictionary and raise."""
+        v = self.value
+        if v is None:
+            return 0
+        k = self.ftype.kind
+        if k == TypeKind.UINT:
+            v = int(v)
+            return v - (1 << 64) if v >= (1 << 63) else v  # two's complement
+        if k == TypeKind.INT:
+            return int(v)
+        if k == TypeKind.FLOAT:
+            return float(v)
+        if k == TypeKind.DECIMAL:
+            return int(round(float(v) * (10 ** self.ftype.scale)))
+        if k == TypeKind.DATE:
+            if isinstance(v, _dt.date):
+                return (v - _EPOCH_DATE).days
+            return int(v)
+        if k == TypeKind.DATETIME:
+            if isinstance(v, _dt.datetime):
+                return int((v - _EPOCH_DT).total_seconds() * 1_000_000)
+            return int(v)
+        if k == TypeKind.DURATION:
+            return int(v)
+        raise TypeError(f"no physical scalar for {self.ftype}")
+
+
+def date_to_days(v: "str | _dt.date") -> int:
+    if isinstance(v, str):
+        v = _dt.date.fromisoformat(v)
+    return (v - _EPOCH_DATE).days
+
+
+def days_to_date(days: int) -> _dt.date:
+    return _EPOCH_DATE + _dt.timedelta(days=int(days))
+
+
+def datetime_to_micros(v: "str | _dt.datetime") -> int:
+    if isinstance(v, str):
+        v = _dt.datetime.fromisoformat(v)
+    return int((v - _EPOCH_DT).total_seconds() * 1_000_000)
+
+
+def micros_to_datetime(us: int) -> _dt.datetime:
+    return _EPOCH_DT + _dt.timedelta(microseconds=int(us))

@@ -1,0 +1,1 @@
+"""Host-side utilities: columnar batches, codecs, memory tracking, misc."""
