@@ -1,0 +1,345 @@
+"""Coprocessor engine tests: host (numpy) vs TPU (XLA) agreement on the full
+DAG operator set, over a multi-region store (ref: unistore cophandler tests +
+the testkit mock-store strategy, SURVEY §4.2)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.copr import dagpb
+from tidb_tpu.copr.client import CopClient
+from tidb_tpu.expression import col, const, func
+from tidb_tpu.expression.expr import AggDesc
+from tidb_tpu.kv import tablecodec
+from tidb_tpu.kv.kv import KeyRange, Request, RequestType, StoreType
+from tidb_tpu.kv.memstore import MemStore
+from tidb_tpu.kv.rowcodec import RowSchema, encode_row
+from tidb_tpu.types import bigint_type, date_type, decimal_type, double_type, string_type
+from tidb_tpu.types.datum import date_to_days
+
+TABLE_ID = 77
+
+# storage schema: (a BIGINT, b DOUBLE, c VARCHAR, d DATE, e DECIMAL(10,2))
+SCHEMA_FTS = [bigint_type(), double_type(), string_type(), date_type(), decimal_type(10, 2)]
+
+
+@pytest.fixture(scope="module")
+def store():
+    s = MemStore(region_split_keys=400)
+    schema = RowSchema(SCHEMA_FTS)
+    rng = np.random.default_rng(7)
+    t = s.begin()
+    flags = [b"A", b"N", b"R"]
+    for h in range(2000):
+        a = int(rng.integers(0, 50))
+        b = float(rng.random() * 100)
+        c = flags[h % 3] if h % 11 else None
+        d = date_to_days("1994-01-01") + (h % 900)
+        e = int(rng.integers(0, 10000))  # scaled decimal
+        t.put(tablecodec.record_key(TABLE_ID, h), encode_row(schema, [a, b, c, d, e]))
+    t.commit()
+    return s
+
+
+def scan_exec():
+    return dagpb.ExecutorPB(
+        dagpb.TABLE_SCAN,
+        table_id=TABLE_ID,
+        columns=[
+            dagpb.ColumnInfoPB(0, SCHEMA_FTS[0]),
+            dagpb.ColumnInfoPB(1, SCHEMA_FTS[1]),
+            dagpb.ColumnInfoPB(2, SCHEMA_FTS[2]),
+            dagpb.ColumnInfoPB(3, SCHEMA_FTS[3]),
+            dagpb.ColumnInfoPB(4, SCHEMA_FTS[4]),
+        ],
+        storage_schema=SCHEMA_FTS,
+    )
+
+
+def run_engines(store, dag, keep_order=True):
+    """Execute on both engines, return (host_rows, tpu_rows)."""
+    client = CopClient(store)
+    out = {}
+    for st in (StoreType.HOST, StoreType.TPU):
+        req = Request(
+            tp=RequestType.DAG,
+            data=dag,
+            ranges=[tablecodec.record_range(TABLE_ID)],
+            store_type=st,
+            start_ts=store.current_ts(),
+            keep_order=keep_order,
+        )
+        rows = []
+        for res in client.send(req):
+            rows.extend(res.chunk.rows())
+        out[st] = rows
+    return out[StoreType.HOST], out[StoreType.TPU]
+
+
+def norm(rows):
+    def k(r):
+        return tuple((x is None, x) for x in r)
+
+    return sorted(rows, key=lambda r: tuple(str(x) for x in r))
+
+
+def test_full_scan_both_engines(store):
+    dag = dagpb.DAGRequest([scan_exec()], output_offsets=[0, 1, 2])
+    host, tpu = run_engines(store, dag)
+    assert len(host) == 2000
+    assert norm(host) == norm(tpu)
+
+
+def test_selection_numeric_and_string(store):
+    bt, st_, dt = bigint_type(), string_type(), date_type()
+    conds = [
+        func("ge", col(0, bt), const(10)).to_pb(),
+        func("eq", col(2, st_), const("A")).to_pb(),
+        func("lt", col(3, dt), const(date_to_days("1995-06-01"), date_type())).to_pb(),
+    ]
+    dag = dagpb.DAGRequest(
+        [scan_exec(), dagpb.ExecutorPB(dagpb.SELECTION, conditions=conds)], output_offsets=[0, 2, 3]
+    )
+    host, tpu = run_engines(store, dag)
+    assert host, "selection should match some rows"
+    assert norm(host) == norm(tpu)
+    for r in host:
+        assert r[0] >= 10 and r[1] == "A"
+
+
+def test_string_range_predicate_rank_rewrite(store):
+    st_ = string_type()
+    conds = [func("le", col(2, st_), const("N")).to_pb()]  # A, N qualify; R not; NULL not
+    dag = dagpb.DAGRequest(
+        [scan_exec(), dagpb.ExecutorPB(dagpb.SELECTION, conditions=conds)], output_offsets=[2]
+    )
+    host, tpu = run_engines(store, dag)
+    assert set(r[0] for r in host) == {"A", "N"}
+    assert norm(host) == norm(tpu)
+
+
+def test_hash_agg_complete(store):
+    bt = bigint_type()
+    agg = dagpb.ExecutorPB(
+        dagpb.AGGREGATION,
+        group_by=[col(2, string_type()).to_pb()],
+        aggs=[
+            AggDesc("count", None).to_pb(),
+            AggDesc("sum", col(1, double_type())).to_pb(),
+            AggDesc("avg", col(1, double_type())).to_pb(),
+            AggDesc("min", col(0, bt)).to_pb(),
+            AggDesc("max", col(0, bt)).to_pb(),
+        ],
+        agg_mode=dagpb.AGG_COMPLETE,
+    )
+    dag = dagpb.DAGRequest([scan_exec(), agg])
+    host, tpu = run_engines(store, dag)
+    host_by_key = {r[-1]: r for r in host}
+    tpu_by_key = {r[-1]: r for r in tpu}
+    # engines process different region groupings; keys must agree after merge?
+    # each region emits its own groups — compare per (region keep_order) rows
+    assert set(host_by_key) == set(tpu_by_key)
+    for k in host_by_key:
+        h, t = host_by_key[k], tpu_by_key[k]
+        assert h[0] == t[0]  # count
+        assert h[3] == t[3] and h[4] == t[4]  # min/max
+        assert abs(h[1] - t[1]) < 1e-6 and abs(h[2] - t[2]) < 1e-6
+
+
+def test_agg_partial_two_phase(store):
+    """Partial agg per region + host-side merge == complete agg over all."""
+    from tidb_tpu.copr.host_engine import finalize_agg
+    from tidb_tpu.utils.chunk import Chunk, Column
+
+    bt = bigint_type()
+    aggs = [AggDesc("count", None), AggDesc("avg", col(1, double_type()))]
+    agg = dagpb.ExecutorPB(
+        dagpb.AGGREGATION,
+        group_by=[col(0, bt).to_pb()],
+        aggs=[a.to_pb() for a in aggs],
+        agg_mode=dagpb.AGG_PARTIAL,
+    )
+    dag = dagpb.DAGRequest([scan_exec(), agg])
+    host, tpu = run_engines(store, dag)
+    # partial schema: [count, avg.count, avg.sum, group_key]
+    def merge(rows):
+        acc = {}
+        for cnt, acnt, asum, key in rows:
+            c0, a0, s0 = acc.get(key, (0, 0, 0.0))
+            acc[key] = (c0 + cnt, a0 + acnt, s0 + asum)
+        return {k: (c, s / max(a, 1)) for k, (c, a, s) in acc.items()}
+
+    mh, mt = merge(host), merge(tpu)
+    assert set(mh) == set(mt)
+    for k in mh:
+        assert mh[k][0] == mt[k][0] and abs(mh[k][1] - mt[k][1]) < 1e-9
+
+
+def test_scalar_agg_empty_result(store):
+    bt = bigint_type()
+    conds = [func("lt", col(0, bt), const(-5)).to_pb()]  # matches nothing
+    agg = dagpb.ExecutorPB(
+        dagpb.AGGREGATION,
+        group_by=[],
+        aggs=[AggDesc("count", None).to_pb(), AggDesc("sum", col(0, bt)).to_pb()],
+        agg_mode=dagpb.AGG_COMPLETE,
+    )
+    dag = dagpb.DAGRequest([scan_exec(), dagpb.ExecutorPB(dagpb.SELECTION, conditions=conds), agg])
+    host, tpu = run_engines(store, dag)
+    # per-region scalar agg: COUNT=0, SUM=NULL
+    assert all(r == (0, None) for r in host)
+    assert norm(host) == norm(tpu)
+
+
+def test_topn_with_nulls(store):
+    st_ = string_type()
+    topn = dagpb.ExecutorPB(
+        dagpb.TOPN,
+        order_by=[[col(2, st_).to_pb(), False], [col(0, bigint_type()).to_pb(), True]],
+        limit=7,
+    )
+    dag = dagpb.DAGRequest([scan_exec(), topn], output_offsets=[2, 0])
+    host, tpu = run_engines(store, dag)
+    assert norm(host) == norm(tpu)
+    # per region: NULLs first (ASC)
+    assert host[0][0] is None
+
+
+def test_limit(store):
+    dag = dagpb.DAGRequest(
+        [scan_exec(), dagpb.ExecutorPB(dagpb.LIMIT, limit=5)], output_offsets=[0]
+    )
+    host, tpu = run_engines(store, dag)
+    # 5 per region
+    nregions = len(store.regions())
+    assert len(host) == len(tpu)
+    assert len(host) <= 5 * nregions
+
+
+def test_projection(store):
+    bt, db = bigint_type(), double_type()
+    proj = dagpb.ExecutorPB(
+        dagpb.PROJECTION,
+        exprs=[
+            func("mul", col(0, bt), const(2)).to_pb(),
+            func("plus", col(1, db), const(0.5)).to_pb(),
+            func("year", col(3, date_type())).to_pb(),
+        ],
+    )
+    dag = dagpb.DAGRequest([scan_exec(), proj])
+    host, tpu = run_engines(store, dag)
+    assert norm(host) == norm(tpu)
+    assert all(r[0] % 2 == 0 and 1994 <= r[2] <= 1997 for r in host)
+
+
+def test_decimal_agg(store):
+    dec = decimal_type(10, 2)
+    agg = dagpb.ExecutorPB(
+        dagpb.AGGREGATION,
+        group_by=[],
+        aggs=[AggDesc("sum", col(4, dec)).to_pb(), AggDesc("avg", col(4, dec)).to_pb()],
+        agg_mode=dagpb.AGG_COMPLETE,
+    )
+    dag = dagpb.DAGRequest([scan_exec(), agg])
+    host, tpu = run_engines(store, dag)
+    assert norm(host) == norm(tpu)
+
+
+def test_range_pruned_scan(store):
+    """Point/handle ranges restrict rows (region tasks see partial ranges)."""
+    client = CopClient(store)
+    dag = dagpb.DAGRequest([scan_exec()], output_offsets=[0])
+    for st in (StoreType.HOST, StoreType.TPU):
+        req = Request(
+            tp=RequestType.DAG,
+            data=dag,
+            ranges=[
+                tablecodec.handle_range(TABLE_ID, 10, 19),
+                tablecodec.handle_range(TABLE_ID, 500, 504),
+            ],
+            store_type=st,
+            start_ts=store.current_ts(),
+        )
+        total = sum(len(r.chunk) for r in client.send(req))
+        assert total == 15, f"{st}: expected 15 rows"
+
+
+def test_agg_overflow_retry_with_downstream_topn(store, monkeypatch):
+    """Group overflow must trigger the cap-doubling retry even when agg is
+    not the last executor (regression: silent group drop)."""
+    from tidb_tpu.copr import tpu_engine
+
+    monkeypatch.setattr(tpu_engine, "_DEFAULT_AGG_CAP", 4)
+    bt = bigint_type()
+    agg = dagpb.ExecutorPB(
+        dagpb.AGGREGATION,
+        group_by=[col(0, bt).to_pb()],  # ~50 groups > cap 4
+        aggs=[AggDesc("count", None).to_pb()],
+        agg_mode=dagpb.AGG_COMPLETE,
+    )
+    topn = dagpb.ExecutorPB(dagpb.TOPN, order_by=[[col(1, bt).to_pb(), False]], limit=100)
+    dag = dagpb.DAGRequest([scan_exec(), agg, topn])
+    host, tpu = run_engines(store, dag)
+    assert norm(host) == norm(tpu)
+    assert len(set(r[1] for r in tpu)) == 50
+
+
+def test_desc_scan_falls_back(store):
+    """desc scans take the host path from the TPU entry point (order)."""
+    dag = dagpb.DAGRequest(
+        [
+            dagpb.ExecutorPB(
+                dagpb.TABLE_SCAN,
+                table_id=TABLE_ID,
+                columns=[dagpb.ColumnInfoPB(0, SCHEMA_FTS[0]), dagpb.ColumnInfoPB(-1, bigint_type(False), is_handle=True)],
+                storage_schema=SCHEMA_FTS,
+                desc=True,
+            ),
+            dagpb.ExecutorPB(dagpb.LIMIT, limit=3),
+        ],
+        output_offsets=[1],
+    )
+    host, tpu = run_engines(store, dag)
+    assert host == tpu  # ordered comparison: both must give highest handles first per region
+
+
+def test_desc_sort_int64_min(store):
+    """regression: ORDER BY DESC must not wrap INT64_MIN via negation."""
+    import numpy as np
+    from tidb_tpu.copr.host_engine import sort_perm
+    from tidb_tpu.utils.chunk import Chunk, Column
+
+    c = Column(np.array([5, -(2**63)], dtype=np.int64), np.ones(2, bool), bigint_type())
+    chunk = Chunk([c])
+    perm = sort_perm(chunk, [[col(0, bigint_type()).to_pb(), True]])
+    assert c.data[perm[0]] == 5
+
+
+def test_mvcc_visibility_through_engines(store):
+    """An update after the read_ts must be invisible to both engines."""
+    read_ts = store.current_ts()
+    t = store.begin()
+    schema = RowSchema(SCHEMA_FTS)
+    t.put(tablecodec.record_key(TABLE_ID, 0), encode_row(schema, [999999, 0.0, b"Z", 0, 0]))
+    t.commit()
+    client = CopClient(store)
+    dag = dagpb.DAGRequest([scan_exec()], output_offsets=[0])
+    for st in (StoreType.HOST, StoreType.TPU):
+        req = Request(
+            tp=RequestType.DAG,
+            data=dag,
+            ranges=[tablecodec.handle_range(TABLE_ID, 0, 0)],
+            store_type=st,
+            start_ts=read_ts,
+        )
+        rows = [r for res in client.send(req) for r in res.chunk.rows()]
+        assert rows and rows[0][0] != 999999, f"{st} leaked a future write"
+    # and a fresh read sees it
+    req = Request(
+        tp=RequestType.DAG,
+        data=dag,
+        ranges=[tablecodec.handle_range(TABLE_ID, 0, 0)],
+        store_type=StoreType.TPU,
+        start_ts=store.current_ts(),
+    )
+    rows = [r for res in client.send(req) for r in res.chunk.rows()]
+    assert rows[0][0] == 999999

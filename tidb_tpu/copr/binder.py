@@ -1,0 +1,172 @@
+"""Device binder: legalize a DAGRequest for TPU execution.
+
+Strings never travel to the device as bytes — only as dictionary codes. The
+binder rewrites every string-touching expression into integer form against
+the region-shared dictionaries (ref: the role TiFlash's collation-aware
+compiled predicates play; pushdown legality: infer_pushdown.go:266):
+
+- ``eq/ne/in`` on a string column vs constants → compare codes (absent
+  constant → code -1, which matches nothing);
+- ``lt/le/gt/ge`` → rank-compare, after forcing the dictionary sorted
+  (codes become order-preserving; le/gt use bisect_right semantics);
+- ORDER BY / MIN / MAX on a string column → force-sort the dictionary;
+- anything else string-valued (LIKE, LENGTH, ...) → ``UnsupportedForDevice``
+  (the planner's legality table should have kept these off the TPU path).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+from tidb_tpu.copr import dagpb
+from tidb_tpu.copr.colcache import ColumnCache
+from tidb_tpu.expression.registry import REGISTRY
+from tidb_tpu.types import TypeKind
+from tidb_tpu.types.field_type import bigint_type
+
+
+class UnsupportedForDevice(Exception):
+    pass
+
+
+_CMP_REWRITE = {"lt": ("lt", "left"), "le": ("lt", "right"), "gt": ("ge", "right"), "ge": ("ge", "left")}
+_INT_FT = [int(TypeKind.INT), 20, 0, 1, "bin"]
+
+
+class Binder:
+    def __init__(self, cache: ColumnCache, table_id: int, scan_cols: list[dagpb.ColumnInfoPB]):
+        self.cache = cache
+        self.table_id = table_id
+        # scan output offset → (storage slot, ftype)
+        self.scan_cols = scan_cols
+
+    def _dict_for_offset(self, offset: int):
+        c = self.scan_cols[offset]
+        return self.cache.dictionary(self.table_id, c.column_id)
+
+    def bind_dag(self, dag: dagpb.DAGRequest) -> dagpb.DAGRequest:
+        out = copy.deepcopy(dag)
+        scan_seen = False
+        for ex in out.executors:
+            if ex.tp == dagpb.TABLE_SCAN:
+                scan_seen = True
+                continue
+            if not scan_seen:
+                raise UnsupportedForDevice("DAG must start with a scan")
+            if ex.tp == dagpb.SELECTION:
+                ex.conditions = [self.bind_expr(c) for c in ex.conditions]
+            elif ex.tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG):
+                ex.group_by = [self.bind_expr(g, allow_string_ref=True) for g in ex.group_by]
+                for a in ex.aggs:
+                    if a.get("distinct"):
+                        raise UnsupportedForDevice("distinct agg on device")
+                    if a["arg"] is not None:
+                        allow = a["name"] in ("first_row", "count")
+                        if a["name"] in ("min", "max") and self._is_string(a["arg"]):
+                            self._force_sorted(a["arg"])
+                            allow = True
+                        a["arg"] = self.bind_expr(a["arg"], allow_string_ref=allow or a["name"] in ("min", "max"))
+            elif ex.tp == dagpb.TOPN:
+                new_order = []
+                for item in ex.order_by:
+                    pb, desc = item
+                    if self._is_string(pb):
+                        self._force_sorted(pb)
+                    new_order.append([self.bind_expr(pb, allow_string_ref=True), desc])
+                ex.order_by = new_order
+            elif ex.tp == dagpb.PROJECTION:
+                ex.exprs = [self.bind_expr(e, allow_string_ref=True) for e in ex.exprs]
+            elif ex.tp == dagpb.LIMIT:
+                pass
+            else:
+                raise UnsupportedForDevice(f"executor {ex.tp} on device")
+        return out
+
+    # -- expression rewriting ----------------------------------------------
+    def _is_string(self, pb: dict) -> bool:
+        return pb["tp"] == "col" and pb["ft"][0] == int(TypeKind.STRING)
+
+    def _force_sorted(self, col_pb: dict):
+        slot = self.scan_cols[col_pb["idx"]].column_id
+        self.cache.ensure_sorted_dict(self.table_id, slot)
+
+    def bind_expr(self, pb: dict, allow_string_ref: bool = False) -> dict:
+        tp = pb["tp"]
+        if tp == "col":
+            if pb["ft"][0] == int(TypeKind.STRING) and not allow_string_ref:
+                raise UnsupportedForDevice("raw string column in device expression")
+            return pb
+        if tp == "const":
+            if pb["ft"][0] == int(TypeKind.STRING):
+                raise UnsupportedForDevice("unbound string constant on device")
+            return pb
+        # func
+        sig = pb["sig"]
+        spec = REGISTRY.get(sig)
+        if spec is None or "tpu" not in spec.engines:
+            raise UnsupportedForDevice(f"builtin {sig} not device-legal")
+        kids = pb["children"]
+        str_kids = [k for k in kids if k["tp"] != "func" and k["ft"][0] == int(TypeKind.STRING)]
+        if str_kids:
+            if sig in ("eq", "ne", "in"):
+                return self._bind_code_compare(pb)
+            if sig in _CMP_REWRITE:
+                return self._bind_rank_compare(pb)
+            if sig in ("isnull", "ifnull", "coalesce", "if", "case_when"):
+                pass  # operate on codes + validity; fall through
+            else:
+                raise UnsupportedForDevice(f"{sig} over strings on device")
+        return {**pb, "children": [self.bind_expr(k, allow_string_ref=True) for k in kids]}
+
+    def _col_and_consts(self, pb: dict):
+        kids = pb["children"]
+        col = next((k for k in kids if k["tp"] == "col"), None)
+        if col is None or any(k["tp"] == "func" for k in kids):
+            raise UnsupportedForDevice("string comparison must be col-vs-const on device")
+        return col, [k for k in kids if k is not col]
+
+    def _bind_code_compare(self, pb: dict) -> dict:
+        col, consts = self._col_and_consts(pb)
+        dic = self._dict_for_offset(col["idx"])
+        new_kids = []
+        for k in pb["children"]:
+            if k is col:
+                new_kids.append({**col, "ft": _INT_FT})
+            else:
+                v = k["val"]
+                if v is None:
+                    new_kids.append({**k, "ft": _INT_FT})
+                    continue
+                code = dic.try_encode(v.encode("utf-8", "surrogateescape") if isinstance(v, str) else v)
+                new_kids.append({"tp": "const", "val": int(code), "ft": _INT_FT})
+        return {**pb, "children": new_kids}
+
+    def _bind_rank_compare(self, pb: dict) -> dict:
+        col, consts = self._col_and_consts(pb)
+        if len(consts) != 1 or consts[0]["tp"] != "const":
+            raise UnsupportedForDevice("string range compare must be col-vs-one-const")
+        if pb["children"][0] is not col:
+            # const OP col → flip operator
+            flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
+            pb = {**pb, "sig": flip[pb["sig"]], "children": [pb["children"][1], pb["children"][0]]}
+            col, consts = pb["children"][0], [pb["children"][1]]
+        slot = self.scan_cols[col["idx"]].column_id
+        dic = self.cache.ensure_sorted_dict(self.table_id, slot)
+        v = consts[0]["val"]
+        if v is None:
+            # comparison with NULL is NULL → planner folds this; encode as
+            # never-true with NULL validity via (col != col)... keep simple:
+            raise UnsupportedForDevice("range compare with NULL constant")
+        vb = v.encode("utf-8", "surrogateescape") if isinstance(v, str) else v
+        import bisect
+
+        vals = dic.values_array()
+        new_sig, side = _CMP_REWRITE[pb["sig"]]
+        rank = bisect.bisect_left(vals, vb) if side == "left" else bisect.bisect_right(vals, vb)
+        return {
+            "tp": "func",
+            "sig": new_sig,
+            "children": [{**col, "ft": _INT_FT}, {"tp": "const", "val": int(rank), "ft": _INT_FT}],
+            "ft": pb["ft"],
+        }

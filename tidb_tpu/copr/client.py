@@ -1,0 +1,128 @@
+"""Coprocessor client: region split → worker fan-out → streamed results.
+
+Reference parity: pkg/store/copr/coprocessor.go (buildCopTasks :334 splits
+ranges by region; copIterator :684 runs a worker pool with keep-order
+channels; :87 CopClient.Send). Concurrency here is a thread pool — numpy and
+XLA release the GIL in their hot paths, so region tasks overlap for real.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Callable, Iterator, Optional
+
+from tidb_tpu.copr import dagpb
+from tidb_tpu.kv.kv import KeyRange, Request, RequestType, StoreType
+from tidb_tpu.kv.memstore import MemStore, Region
+from tidb_tpu.utils.chunk import Chunk
+
+# engine registry: StoreType → DAG executor over one region
+# (ref: kvstore.Register in cmd/tidb-server/main.go:399-409)
+_ENGINES: dict[StoreType, Callable] = {}
+
+
+def register_engine(st: StoreType, fn: Callable) -> None:
+    _ENGINES[st] = fn
+
+
+def _engines():
+    if not _ENGINES:
+        from tidb_tpu.copr import host_engine, tpu_engine
+
+        register_engine(StoreType.HOST, host_engine.execute_dag)
+        register_engine(StoreType.TPU, tpu_engine.execute_dag)
+    return _ENGINES
+
+
+@dataclass
+class CopTask:
+    region: Region
+    ranges: list[KeyRange]
+    task_id: int
+
+
+@dataclass
+class CopResult:
+    chunk: Chunk
+    task_id: int
+    region_id: int
+
+
+class CopResponse:
+    """Streaming response (kv.Response). Iterates CopResults; with
+    keep_order the stream follows region order, else completion order."""
+
+    def __init__(self, it: Iterator[CopResult], pool: Optional[ThreadPoolExecutor]):
+        self._it = it
+        self._pool = pool
+        self._closed = False
+
+    def __iter__(self):
+        return self._it
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            if self._pool is not None:
+                self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+class CopClient:
+    """kv.Client for the embedded store (both engines)."""
+
+    def __init__(self, store: MemStore):
+        self.store = store
+
+    def send(self, req: Request) -> CopResponse:
+        assert req.tp == RequestType.DAG
+        dag: dagpb.DAGRequest = req.data
+        engine = _engines()[req.store_type]
+        read_ts = req.start_ts or self.store.current_ts()
+
+        tasks: list[CopTask] = []
+        for region, ranges in self.store.pd.regions_in_ranges(req.ranges):
+            tasks.append(CopTask(region, ranges, len(tasks)))
+        if req.desc:
+            tasks.reverse()
+
+        if not tasks:
+            return CopResponse(iter(()), None)
+
+        concurrency = max(1, min(req.concurrency, len(tasks)))
+
+        def run(task: CopTask) -> CopResult:
+            chunk = engine(self.store, dag, task.region, task.ranges, read_ts)
+            return CopResult(chunk, task.task_id, task.region.region_id)
+
+        if concurrency == 1 or len(tasks) == 1:
+            def gen_serial():
+                for t in tasks:
+                    yield run(t)
+
+            return CopResponse(gen_serial(), None)
+
+        pool = ThreadPoolExecutor(max_workers=concurrency, thread_name_prefix="cop")
+        futures = [pool.submit(run, t) for t in tasks]
+
+        if req.keep_order:
+            def gen_ordered():
+                try:
+                    for f in futures:
+                        yield f.result()
+                finally:
+                    pool.shutdown(wait=False)
+
+            return CopResponse(gen_ordered(), pool)
+
+        from concurrent.futures import as_completed
+
+        def gen_unordered():
+            try:
+                for f in as_completed(futures):
+                    yield f.result()
+            finally:
+                pool.shutdown(wait=False)
+
+        return CopResponse(gen_unordered(), pool)

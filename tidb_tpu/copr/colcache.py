@@ -1,0 +1,185 @@
+"""Region column cache — MVCC rows materialized as device-ready columns.
+
+Reference parity: TiFlash's delta/stable columnar replica, collapsed to a
+rebuild-on-write-epoch cache. Keyed by (region_id, table_id); an entry is
+valid while the region's data_version is unchanged and the read_ts is at or
+past the entry's build snapshot (any such snapshot observes identical data).
+
+String columns dictionary-encode against a per-(table, column) dictionary
+shared across regions, so group-by/join codes are globally consistent; a
+dictionary can be rank-compacted (sorted) on demand to legalize device-side
+ordering predicates, which remaps codes in every cached region of that column.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from tidb_tpu.kv import KeyRange, tablecodec
+from tidb_tpu.kv.memstore import MemStore, Region
+from tidb_tpu.kv.rowcodec import RowSchema, decode_fixed_bulk, decode_strings_bulk
+from tidb_tpu.types import FieldType, TypeKind
+from tidb_tpu.utils.chunk import Dictionary
+
+
+@dataclass
+class RegionColumns:
+    """One region's decoded rows for one table: sorted-by-handle columns."""
+
+    handles: np.ndarray  # int64, ascending
+    n: int
+    # storage-slot → (data, validity)
+    cols: dict[int, tuple[np.ndarray, np.ndarray]] = field(default_factory=dict)
+    data_version: int = -1
+    built_ts: int = 0
+    # True iff built_ts covered every commit in the region at build time —
+    # only then does the entry equal the region head for this data_version
+    complete: bool = True
+    # raw row buffer retained to decode further columns lazily
+    _buf: bytes = b""
+    _starts: np.ndarray | None = None
+
+
+class ColumnCache:
+    """Per-store singleton (both engines share it; the TPU engine layers a
+    device-array cache keyed by the same (region, version) identity)."""
+
+    def __init__(self, store: MemStore):
+        # weak: the cache registry keys off the store; a strong ref here
+        # would keep the store alive through the WeakKeyDictionary value
+        self._store_ref = __import__("weakref").ref(store)
+        self._mu = threading.Lock()
+        self._entries: dict[tuple[int, int], RegionColumns] = {}
+        self._dicts: dict[tuple[int, int], Dictionary] = {}
+        # bumped whenever a dictionary is compacted: device caches must drop
+        self.epoch = 0
+
+    # -- dictionaries ------------------------------------------------------
+    def dictionary(self, table_id: int, slot: int) -> Dictionary:
+        with self._mu:
+            return self._dicts.setdefault((table_id, slot), Dictionary())
+
+    def ensure_sorted_dict(self, table_id: int, slot: int) -> Dictionary:
+        """Rank-compact a dictionary so codes become order-preserving;
+        remaps codes in all cached regions of this column."""
+        with self._mu:
+            dic = self._dicts.setdefault((table_id, slot), Dictionary())
+            if dic.sorted:
+                return dic
+            remap = dic.compact()
+            for (rid, tid), entry in self._entries.items():
+                if tid == table_id and slot in entry.cols:
+                    data, valid = entry.cols[slot]
+                    entry.cols[slot] = (remap[data], valid)
+            self.epoch += 1
+            return dic
+
+    # -- entry build/reuse -------------------------------------------------
+    def get(
+        self,
+        region: Region,
+        table_id: int,
+        schema: RowSchema,
+        slots: Sequence[int],
+        read_ts: int,
+    ) -> RegionColumns:
+        """Columns for the given storage slots of one region, reusing cached
+        decodes when the region's write epoch is unchanged."""
+        key = (region.region_id, table_id)
+        with self._mu:
+            entry = self._entries.get(key)
+            reusable = (
+                entry is not None
+                and entry.data_version == region.data_version
+                and read_ts >= entry.built_ts
+            )
+        if not reusable:
+            entry = self._build(region, table_id, read_ts)
+            if entry.complete:
+                with self._mu:
+                    self._entries[key] = entry
+            # stale-snapshot builds (read_ts behind the region head) are
+            # returned uncached: caching them would alias the head state
+        missing = [s for s in slots if s not in entry.cols]
+        if missing:
+            self._decode_slots(entry, table_id, schema, missing)
+        return entry
+
+    @property
+    def store(self) -> MemStore:
+        s = self._store_ref()
+        assert s is not None, "store was garbage-collected"
+        return s
+
+    def _build(self, region: Region, table_id: int, read_ts: int) -> RegionColumns:
+        kr = region.range().intersect(tablecodec.record_range(table_id))
+        # capture version/coverage BEFORE the scan: a concurrent commit after
+        # this point bumps data_version and invalidates the entry
+        data_version = region.data_version
+        complete = read_ts >= region.max_commit_ts
+        snap = self.store.get_snapshot(read_ts)
+        if kr is None:
+            return RegionColumns(
+                np.empty(0, np.int64), 0, data_version=data_version, built_ts=read_ts, complete=complete
+            )
+        bulk = snap.scan_record_rows(kr)
+        return RegionColumns(
+            bulk.handles,
+            len(bulk),
+            data_version=data_version,
+            built_ts=read_ts,
+            complete=complete,
+            _buf=bulk.buf,
+            _starts=bulk.starts,
+        )
+
+    def _decode_slots(self, entry: RegionColumns, table_id: int, schema: RowSchema, slots: Sequence[int]) -> None:
+        if entry.n == 0:
+            for s in slots:
+                ft = schema.ftypes[s]
+                dt = np.int32 if ft.kind == TypeKind.STRING else (np.float64 if ft.kind == TypeKind.FLOAT else np.int64)
+                entry.cols[s] = (np.empty(0, dt), np.empty(0, bool))
+            return
+        fixed = [s for s in slots if schema.ftypes[s].kind not in (TypeKind.STRING, TypeKind.JSON)]
+        if fixed:
+            datas, valids = decode_fixed_bulk(schema, entry._buf, entry._starts, fixed)
+            for s, d, v in zip(fixed, datas, valids):
+                entry.cols[s] = (d, v)
+        for s in slots:
+            if s in entry.cols:
+                continue
+            raw, valid = decode_strings_bulk(schema, entry._buf, entry._starts, s)
+            dic = self.dictionary(table_id, s)
+            with self._mu:
+                data = np.fromiter(
+                    (0 if r is None else dic.encode(r) for r in raw), dtype=np.int32, count=len(raw)
+                )
+            entry.cols[s] = (data, valid)
+
+    def invalidate_table(self, table_id: int) -> None:
+        """DDL (drop/truncate) drops cached columns."""
+        with self._mu:
+            for key in [k for k in self._entries if k[1] == table_id]:
+                del self._entries[key]
+            for key in [k for k in self._dicts if k[0] == table_id]:
+                del self._dicts[key]
+            self.epoch += 1
+
+
+import weakref
+
+_CACHES: "weakref.WeakKeyDictionary[MemStore, ColumnCache]" = weakref.WeakKeyDictionary()
+_CACHES_MU = threading.Lock()
+
+
+def cache_for(store: MemStore) -> ColumnCache:
+    with _CACHES_MU:
+        c = _CACHES.get(store)
+        if c is None:
+            c = ColumnCache(store)
+            _CACHES[store] = c
+        return c

@@ -1,0 +1,276 @@
+"""Host coprocessor engine — numpy reference implementation.
+
+Reference parity: unistore's fused closure executor
+(pkg/store/mockstore/unistore/cophandler/closure_exec.go:165
+buildClosureExecutor; dispatch :72-149). Executes a DAGRequest over one
+region's columns entirely in numpy. It is (a) the correctness oracle the TPU
+engine is tested against, and (b) the fallback engine for expressions the
+device can't run (LIKE, arbitrary string ops — ref: pushdown legality,
+infer_pushdown.go).
+
+Aggregation here (and on the TPU) is sort-based grouping: lexsort the group
+keys, find segment boundaries, reduce per segment — the same algorithm the
+device kernel uses, so partial-result semantics match bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from tidb_tpu.copr import dagpb
+from tidb_tpu.copr.colcache import RegionColumns, cache_for
+from tidb_tpu.expression.expr import (
+    AggDesc,
+    EvalBatch,
+    eval_to_column,
+    expr_from_pb,
+)
+from tidb_tpu.kv import KeyRange, tablecodec
+from tidb_tpu.kv.memstore import MemStore, Region
+from tidb_tpu.kv.rowcodec import RowSchema
+from tidb_tpu.types import FieldType, TypeKind
+from tidb_tpu.types.field_type import bigint_type
+from tidb_tpu.utils.chunk import Chunk, Column, Dictionary
+
+
+@dataclass
+class ExecOutput:
+    """Intermediate batch between chained executors."""
+
+    chunk: Chunk
+
+    @property
+    def batch(self) -> EvalBatch:
+        return EvalBatch.from_chunk(self.chunk)
+
+
+def _scan(store: MemStore, region: Region, ex: dagpb.ExecutorPB, ranges: list[KeyRange], read_ts: int) -> Chunk:
+    schema = RowSchema(ex.storage_schema)
+    slots = [c.column_id for c in ex.columns if not c.is_handle]
+    cache = cache_for(store)
+    entry = cache.get(region, ex.table_id, schema, slots, read_ts)
+    # restrict to requested handle ranges (handles ascend in the entry)
+    if entry.n:
+        mask = np.zeros(entry.n, dtype=bool)
+        for kr in ranges:
+            lo, hi = tablecodec.range_to_handles(kr, ex.table_id)
+            i = np.searchsorted(entry.handles, lo, side="left")
+            j = np.searchsorted(entry.handles, hi, side="left")
+            mask[i:j] = True
+        idx = np.nonzero(mask)[0]
+    else:
+        idx = np.empty(0, dtype=np.int64)
+    cols = []
+    for c in ex.columns:
+        if c.is_handle:
+            cols.append(Column(entry.handles[idx], np.ones(len(idx), bool), bigint_type(nullable=False)))
+        else:
+            data, valid = entry.cols[c.column_id]
+            dic = cache.dictionary(ex.table_id, c.column_id) if c.ftype.kind == TypeKind.STRING else None
+            cols.append(Column(data[idx], valid[idx], c.ftype, dic))
+    if ex.desc:
+        cols = [Column(c.data[::-1], c.validity[::-1], c.ftype, c.dictionary) for c in cols]
+    return Chunk(cols)
+
+
+def _selection(chunk: Chunk, conditions: list[dict]) -> Chunk:
+    if not len(chunk):
+        return chunk
+    batch = EvalBatch.from_chunk(chunk)
+    keep = np.ones(len(chunk), dtype=bool)
+    for pb in conditions:
+        c = eval_to_column(expr_from_pb(pb), batch, np)
+        keep &= (c.data != 0) & c.validity  # NULL predicate == not selected
+    idx = np.nonzero(keep)[0]
+    return chunk.take(idx)
+
+
+def _group_sort(chunk: Chunk, key_cols: list[Column]) -> tuple[np.ndarray, np.ndarray, int]:
+    """Lexsort rows by group keys → (perm, segment_ids_sorted, n_groups)."""
+    n = len(chunk)
+    if not key_cols:
+        return np.arange(n), np.zeros(n, dtype=np.int64), 1
+    lanes = []
+    for c in key_cols:
+        lanes.append(c.data)
+        lanes.append(~c.validity)  # NULLs form their own (single) group
+    perm = np.lexsort(tuple(reversed(lanes)))  # first key = primary
+    boundary = np.zeros(n, dtype=bool)
+    if n:
+        boundary[0] = True
+        for c in key_cols:
+            ds, vs = c.data[perm], c.validity[perm]
+            boundary[1:] |= ds[1:] != ds[:-1]
+            boundary[1:] |= vs[1:] != vs[:-1]
+    seg = np.cumsum(boundary) - 1
+    ngroups = int(seg[-1]) + 1 if n else 0
+    return perm, seg, ngroups
+
+
+def _segment_reduce(op: str, data: np.ndarray, valid: np.ndarray, seg: np.ndarray, ngroups: int):
+    """→ (result, valid_count) per group."""
+    w = valid.astype(np.int64)
+    cnt = np.bincount(seg, weights=w, minlength=ngroups).astype(np.int64)
+    if op == "count":
+        return cnt, cnt
+    if op == "sum":
+        if data.dtype == np.float64:
+            s = np.bincount(seg, weights=np.where(valid, data, 0.0), minlength=ngroups)
+        else:
+            s = np.zeros(ngroups, dtype=np.int64)
+            np.add.at(s, seg, np.where(valid, data, 0))
+        return s, cnt
+    if op in ("min", "max"):
+        if data.dtype == np.float64:
+            sentinel = np.inf if op == "min" else -np.inf
+        else:
+            sentinel = np.iinfo(np.int64).max if op == "min" else np.iinfo(np.int64).min
+        d = np.where(valid, data, sentinel)
+        out = np.full(ngroups, sentinel, dtype=data.dtype)
+        (np.minimum if op == "min" else np.maximum).at(out, seg, d)
+        return out, cnt
+    if op == "first_row":
+        first_idx = np.zeros(ngroups, dtype=np.int64)
+        seen = np.zeros(ngroups, dtype=bool)
+        # rows are already grouped contiguously: boundary rows are the firsts
+        b = np.ones(len(seg), dtype=bool)
+        b[1:] = seg[1:] != seg[:-1]
+        first_idx[seg[b]] = np.nonzero(b)[0]
+        return data[first_idx], valid[first_idx].astype(np.int64) * np.maximum(cnt, 1)
+    raise ValueError(op)
+
+
+def _aggregate(chunk: Chunk, ex: dagpb.ExecutorPB) -> Chunk:
+    batch = EvalBatch.from_chunk(chunk)
+    gcols = [eval_to_column(expr_from_pb(pb), batch, np) for pb in ex.group_by]
+    aggs = [AggDesc.from_pb(pb) for pb in ex.aggs]
+    n = len(chunk)
+    perm, seg, ngroups = _group_sort(chunk, gcols)
+    if n == 0 and not ex.group_by:
+        # scalar agg over empty input still yields one row
+        perm, seg, ngroups = np.arange(0), np.zeros(0, np.int64), 1
+
+    out_cols: list[Column] = []
+    for a in aggs:
+        if a.arg is not None:
+            ac = eval_to_column(a.arg, batch, np)
+            data, valid = ac.data[perm], ac.validity[perm]
+            adic = ac.dictionary
+            aft = ac.ftype
+        else:  # COUNT(*)
+            data = np.ones(n, dtype=np.int64)[perm] if n else np.zeros(0, np.int64)
+            valid = np.ones(len(data), dtype=bool)
+            adic, aft = None, bigint_type(nullable=False)
+        if a.distinct:
+            # dedupe (group, value) pairs before reducing
+            order = np.lexsort((data, ~valid, seg))
+            d2, v2, s2 = data[order], valid[order], seg[order]
+            keep = np.ones(len(d2), dtype=bool)
+            keep[1:] = (s2[1:] != s2[:-1]) | (d2[1:] != d2[:-1]) | (v2[1:] != v2[:-1])
+            data, valid, seg_a = d2[keep], v2[keep], s2[keep]
+        else:
+            seg_a = seg
+        for kind in a.partial_kinds:
+            if kind == "count":
+                res, cnt = _segment_reduce("count", data, valid, seg_a, ngroups)
+                out_cols.append(Column(res, np.ones(ngroups, bool), bigint_type(nullable=False)))
+            elif kind == "sum":
+                res, cnt = _segment_reduce("sum", data, valid, seg_a, ngroups)
+                sum_ft = AggDesc("sum", a.arg).ftype if a.arg is not None else bigint_type()
+                dtype = np.float64 if sum_ft.kind == TypeKind.FLOAT else np.int64
+                out_cols.append(Column(res.astype(dtype), cnt > 0, sum_ft))
+            elif kind in ("min", "max", "first_row"):
+                res, cnt = _segment_reduce(kind, data, valid, seg_a, ngroups)
+                sentinel_ok = cnt > 0 if kind != "first_row" else (cnt > 0)
+                out_cols.append(Column(res.astype(data.dtype), sentinel_ok, aft, adic))
+    for gc in gcols:
+        first, cnt = _segment_reduce("first_row", gc.data[perm], gc.validity[perm], seg, ngroups)
+        out_cols.append(Column(first.astype(gc.data.dtype), cnt > 0, gc.ftype, gc.dictionary))
+    result = Chunk(out_cols)
+    if ex.agg_mode in (dagpb.AGG_COMPLETE,):
+        result = finalize_agg(result, aggs, [g.ftype for g in gcols], [g.dictionary for g in gcols])
+    return result
+
+
+def finalize_agg(partial: Chunk, aggs: list[AggDesc], group_fts: list[FieldType], group_dicts: list) -> Chunk:
+    """Collapse partial state lanes → final agg values (ref: the final-mode
+    HashAgg the executor runs above the coprocessor)."""
+    cols = partial.columns
+    out: list[Column] = []
+    i = 0
+    for a in aggs:
+        if a.name == "avg":
+            cnt, s = cols[i], cols[i + 1]
+            i += 2
+            ft = a.ftype
+            denom = np.maximum(cnt.data, 1)
+            if ft.kind == TypeKind.DECIMAL:
+                # sum lane has arg scale; result scale = arg_scale+4
+                num = s.data.astype(np.int64) * (10**4)
+                q = np.sign(num) * ((np.abs(num) + denom // 2) // denom)
+                out.append(Column(q, cnt.data > 0, ft))
+            else:
+                out.append(Column(s.data / denom, cnt.data > 0, ft))
+        else:
+            c = cols[i]
+            i += 1
+            out.append(Column(c.data, c.validity, a.ftype if a.name != "first_row" else c.ftype, c.dictionary))
+    out.extend(cols[i:])  # group-by key columns
+    return Chunk(out)
+
+
+def sort_perm(chunk: Chunk, order_by: list) -> np.ndarray:
+    """Row permutation for ORDER BY (MySQL NULL placement: first on ASC,
+    last on DESC). Priority tuple per key is (null_lane, data_lane)."""
+    batch = EvalBatch.from_chunk(chunk)
+    priority: list[np.ndarray] = []
+    for pb, desc in order_by:
+        c = eval_to_column(expr_from_pb(pb), batch, np)
+        data = c.data
+        if c.ftype.kind == TypeKind.STRING and c.dictionary is not None and not c.dictionary.sorted:
+            # unsorted dictionary: rank codes host-side
+            vals = c.dictionary.decode_many(data)
+            rank = {v: i for i, v in enumerate(sorted(set(vals)))}
+            data = np.array([rank[v] for v in vals], dtype=np.int64)
+        if desc:
+            priority.append((~c.validity).astype(np.int8))  # NULLs last
+            # ints: bitwise complement reverses order without INT64_MIN
+            # overflow; floats: negate
+            priority.append(-data if data.dtype == np.float64 else ~data)
+        else:
+            priority.append(c.validity.astype(np.int8))  # NULLs first
+            priority.append(data)
+    # np.lexsort: LAST key is primary → reverse the priority list
+    return np.lexsort(tuple(reversed(priority)))
+
+
+def _topn(chunk: Chunk, ex: dagpb.ExecutorPB) -> Chunk:
+    if len(chunk) == 0:
+        return chunk
+    perm = sort_perm(chunk, ex.order_by)
+    return chunk.take(perm[: ex.limit])
+
+
+def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int) -> Chunk:
+    assert dag.executors and dag.executors[0].tp == dagpb.TABLE_SCAN
+    chunk = _scan(store, region, dag.executors[0], ranges, read_ts)
+    for ex in dag.executors[1:]:
+        if ex.tp == dagpb.SELECTION:
+            chunk = _selection(chunk, ex.conditions)
+        elif ex.tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG):
+            chunk = _aggregate(chunk, ex)
+        elif ex.tp == dagpb.TOPN:
+            chunk = _topn(chunk, ex)
+        elif ex.tp == dagpb.LIMIT:
+            chunk = chunk.slice(0, min(ex.limit, len(chunk)))
+        elif ex.tp == dagpb.PROJECTION:
+            batch = EvalBatch.from_chunk(chunk)
+            chunk = Chunk([eval_to_column(expr_from_pb(pb), batch, np) for pb in ex.exprs])
+        else:
+            raise NotImplementedError(f"host engine: executor {ex.tp}")
+    if dag.output_offsets:
+        chunk = Chunk([chunk.columns[i] for i in dag.output_offsets])
+    return chunk

@@ -1,0 +1,213 @@
+"""TPU coprocessor engine: region columns → device cache → fused kernel.
+
+Reference parity: the TiFlash role (columnar accelerator engine behind the
+same coprocessor contract as TiKV). Per region task:
+
+1. get/reuse host columnar cache (colcache.ColumnCache);
+2. get/reuse *device-resident* padded arrays keyed by the same
+   (region, data_version) identity — steady-state queries touch HBM only;
+3. bind the DAG (string constants → dictionary codes; binder.py);
+4. fetch/compile the fused kernel (ops/dag_kernel.py) and run it;
+5. trim padded outputs by the kernel-reported count and re-attach string
+   dictionaries → chunk.
+
+Overflow protocol: if the kernel reports more groups than its static cap, we
+recompile with the next power-of-two cap and re-run (bounded doubling).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from tidb_tpu.copr import dagpb
+from tidb_tpu.copr.binder import Binder, UnsupportedForDevice
+from tidb_tpu.copr.colcache import cache_for
+from tidb_tpu.copr.host_engine import execute_dag as host_execute_dag
+from tidb_tpu.kv import KeyRange, tablecodec
+from tidb_tpu.kv.memstore import MemStore, Region
+from tidb_tpu.kv.rowcodec import RowSchema
+from tidb_tpu.ops.dag_kernel import MAX_RANGES, get_kernel
+from tidb_tpu.types import FieldType, TypeKind
+from tidb_tpu.types.field_type import bigint_type
+from tidb_tpu.utils.chunk import Chunk, Column, bucket_size
+
+_DEFAULT_AGG_CAP = 4096
+
+_dev_mu = threading.Lock()
+# (region_id, table_id, slot, data_version, dict_epoch, n_pad) → (data, valid) on device
+_device_cols: dict[tuple, tuple] = {}
+
+
+def _device_put_col(key, data: np.ndarray, valid: np.ndarray, n_pad: int, cacheable: bool = True):
+    import jax
+    import jax.numpy as jnp
+
+    if cacheable:
+        with _dev_mu:
+            hit = _device_cols.get(key)
+        if hit is not None:
+            return hit
+    pd = np.zeros(n_pad, dtype=data.dtype if data.dtype != np.int32 else np.int64)
+    pd[: len(data)] = data
+    pv = np.zeros(n_pad, dtype=bool)
+    pv[: len(valid)] = valid
+    out = (jax.device_put(jnp.asarray(pd)), jax.device_put(jnp.asarray(pv)))
+    if cacheable:
+        with _dev_mu:
+            # evict superseded epochs of the same column: each write bumps
+            # data_version, and stale device arrays would leak HBM forever
+            ident = key[:3]  # (region_id, table_id, slot)
+            for k in [k for k in _device_cols if k[:3] == ident and k != key]:
+                del _device_cols[k]
+            _device_cols[key] = out
+    return out
+
+
+def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int) -> Chunk:
+    import jax.numpy as jnp
+
+    scan = dag.executors[0]
+    if scan.desc:
+        # descending scans are order-sensitive row streams — the sorted-batch
+        # kernel has no cheap equivalent; delegate to the host engine
+        return host_execute_dag(store, dag, region, ranges, read_ts)
+    schema = RowSchema(scan.storage_schema)
+    slots = [c.column_id for c in scan.columns if not c.is_handle]
+    cache = cache_for(store)
+    entry = cache.get(region, scan.table_id, schema, slots, read_ts)
+    n_pad = bucket_size(max(entry.n, 1))
+
+    binder = Binder(cache, scan.table_id, scan.columns)
+    bound = binder.bind_dag(dag)
+
+    # device inputs (cached per region epoch; stale-snapshot entries bypass
+    # the device cache — they'd alias the head state of the same version)
+    epoch = cache.epoch
+    cacheable = entry.complete
+    hkey = (region.region_id, scan.table_id, -1, entry.data_version, epoch, n_pad)
+    handles_dev, _ = _device_put_col(hkey, entry.handles, np.ones(entry.n, bool), n_pad, cacheable)
+    cols_dev = []
+    for c in scan.columns:
+        if c.is_handle:
+            cols_dev.append(_device_put_col(hkey, entry.handles, np.ones(entry.n, bool), n_pad, cacheable))
+        else:
+            data, valid = entry.cols[c.column_id]
+            ckey = (region.region_id, scan.table_id, c.column_id, entry.data_version, epoch, n_pad)
+            cols_dev.append(_device_put_col(ckey, data, valid, n_pad, cacheable))
+
+    # ranges → padded static array; rows outside any range are masked out
+    rarr = np.zeros((MAX_RANGES, 2), dtype=np.int64)
+    use = ranges[:MAX_RANGES]
+    if len(ranges) > MAX_RANGES:
+        # merge overflow ranges into a single covering span (mask is a filter
+        # on top of region contents, so over-covering only loses pruning)
+        los, his = zip(*[tablecodec.range_to_handles(kr, scan.table_id) for kr in ranges])
+        rarr[0] = (min(los), max(his))
+    else:
+        for i, kr in enumerate(use):
+            rarr[i] = tablecodec.range_to_handles(kr, scan.table_id)
+
+    agg_cap = min(_DEFAULT_AGG_CAP, n_pad) if kernel_needs_agg(bound) else _DEFAULT_AGG_CAP
+    while True:
+        kernel = get_kernel(bound, n_pad, agg_cap)
+        outs, count, ngroups = kernel.fn(handles_dev, tuple(cols_dev), jnp.asarray(rarr), jnp.asarray(entry.n))
+        count = int(count)
+        if int(ngroups) > kernel.agg_cap:
+            if agg_cap >= n_pad:
+                # more groups than rows cannot happen; n_pad cap always fits
+                raise RuntimeError("aggregation group overflow beyond row count")
+            agg_cap = min(agg_cap * 4, n_pad)
+            continue
+        break
+
+    # assemble chunk: output schema comes from the *unbound* DAG (string
+    # columns keep their dictionaries)
+    out_fts = output_ftypes(dag)
+    offsets = dag.output_offsets or list(range(len(out_fts)))
+    cols = []
+    for (data, valid), off in zip(outs, offsets):
+        ft = out_fts[off]
+        d = np.asarray(data)[:count]
+        v = np.asarray(valid)[:count]
+        dic = None
+        if ft.kind == TypeKind.STRING:
+            slot = string_slot_for_output(dag, off)
+            dic = cache.dictionary(scan.table_id, slot) if slot is not None else None
+            d = d.astype(np.int32)
+        elif ft.kind == TypeKind.FLOAT:
+            d = d.astype(np.float64)
+        else:
+            d = d.astype(np.int64)
+        cols.append(Column(d, v.astype(bool), ft, dic))
+    return Chunk(cols)
+
+
+def kernel_needs_agg(dag: dagpb.DAGRequest) -> bool:
+    return any(ex.tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG) for ex in dag.executors)
+
+
+def output_ftypes(dag: dagpb.DAGRequest) -> list[FieldType]:
+    """Schema of the last executor's output (before output_offsets)."""
+    from tidb_tpu.expression.expr import expr_from_pb, AggDesc, _ft_from_pb
+
+    scan = dag.executors[0]
+    fts = [c.ftype for c in scan.columns]
+    for ex in dag.executors[1:]:
+        if ex.tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG):
+            out = []
+            for a_pb in ex.aggs:
+                a = AggDesc.from_pb(a_pb)
+                if ex.agg_mode == dagpb.AGG_COMPLETE:
+                    out.append(a.ftype)
+                else:
+                    for pk in a.partial_kinds:
+                        if pk == "count":
+                            out.append(bigint_type(nullable=False))
+                        elif pk == "sum":
+                            out.append(AggDesc("sum", a.arg).ftype)
+                        else:
+                            out.append(a.arg.ftype if a.arg is not None else bigint_type())
+            for g in ex.group_by:
+                out.append(expr_from_pb(g).ftype)
+            fts = out
+        elif ex.tp == dagpb.PROJECTION:
+            fts = [expr_from_pb(e).ftype for e in ex.exprs]
+    return fts
+
+
+def string_slot_for_output(dag: dagpb.DAGRequest, offset: int):
+    """Find the storage slot whose dictionary backs output column ``offset``
+    (only direct ColumnRef passthroughs keep dictionaries)."""
+    scan = dag.executors[0]
+    # walk the executor chain tracking provenance of each output offset
+    prov: list = list(range(len(scan.columns)))  # scan offset → scan offset
+    for ex in dag.executors[1:]:
+        if ex.tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG):
+            out = []
+            for a in ex.aggs:
+                n_lanes = len(AggFromPb(a).partial_kinds) if ex.agg_mode != dagpb.AGG_COMPLETE else 1
+                arg = a.get("arg")
+                src = None
+                if a["name"] in ("min", "max", "first_row") and arg is not None and arg.get("tp") == "col":
+                    src = prov[arg["idx"]] if arg["idx"] < len(prov) else None
+                out.extend([src] * n_lanes)
+            for g in ex.group_by:
+                out.append(prov[g["idx"]] if g.get("tp") == "col" and g["idx"] < len(prov) else None)
+            prov = out
+        elif ex.tp == dagpb.PROJECTION:
+            out = []
+            for e in ex.exprs:
+                out.append(prov[e["idx"]] if e.get("tp") == "col" and e["idx"] < len(prov) else None)
+            prov = out
+    src = prov[offset] if offset < len(prov) else None
+    if src is None:
+        return None
+    return scan.columns[src].column_id
+
+
+def AggFromPb(pb):
+    from tidb_tpu.expression.expr import AggDesc
+
+    return AggDesc.from_pb(pb)

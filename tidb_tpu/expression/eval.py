@@ -1,0 +1,717 @@
+"""Vectorized builtin implementations — backend-agnostic (numpy | jax.numpy).
+
+Every function is mask-carried three-valued logic: args and results are
+(data, validity) with validity possibly None (all valid) or a scalar bool.
+MySQL semantics implemented here (not IEEE/Python):
+- division by zero → NULL (both / and DIV and %)
+- NULL propagates through arithmetic/comparison
+- AND/OR use Kleene logic (FALSE AND NULL = FALSE, TRUE OR NULL = TRUE)
+- % takes the sign of the dividend (C fmod, not Python floor-mod)
+
+Ref: pkg/expression/builtin_arithmetic_vec.go, builtin_compare_vec.go,
+builtin_op_vec.go, builtin_time_vec.go (YEAR/MONTH/DAY via civil-from-days
+integer calendar math so temporal extraction stays on-device).
+"""
+
+from __future__ import annotations
+
+from tidb_tpu.types import TypeKind
+from tidb_tpu.types.field_type import FieldType, bool_type, double_type, bigint_type, decimal_type, string_type
+from tidb_tpu.expression.registry import (
+    ALL_ENGINES,
+    HOST_ONLY,
+    and_valid,
+    infer_bool,
+    infer_double,
+    infer_first,
+    infer_merge,
+    register,
+)
+
+
+# ---------------------------------------------------------------------------
+# numeric coercion helpers
+# ---------------------------------------------------------------------------
+
+
+def _coerce_pair(xp, ctx, i, j):
+    """Bring args i and j to a common physical representation per their
+    logical types (decimal rescale, int→float)."""
+    (da, va), (db, vb) = ctx.args[i], ctx.args[j]
+    ta, tb = ctx.arg_types[i], ctx.arg_types[j]
+    if ta.kind == TypeKind.DECIMAL or tb.kind == TypeKind.DECIMAL:
+        if ta.kind == TypeKind.FLOAT or tb.kind == TypeKind.FLOAT:
+            da = da / (10**ta.scale) if ta.kind == TypeKind.DECIMAL else da * 1.0
+            db = db / (10**tb.scale) if tb.kind == TypeKind.DECIMAL else db * 1.0
+        else:
+            sa = ta.scale if ta.kind == TypeKind.DECIMAL else 0
+            sb = tb.scale if tb.kind == TypeKind.DECIMAL else 0
+            s = max(sa, sb)
+            da = da * (10 ** (s - sa))
+            db = db * (10 ** (s - sb))
+    elif ta.kind == TypeKind.FLOAT or tb.kind == TypeKind.FLOAT:
+        da = da * 1.0
+        db = db * 1.0
+    return da, va, db, vb
+
+
+def _result_scale(ctx):
+    if ctx.ret_type.kind == TypeKind.DECIMAL:
+        return ctx.ret_type.scale
+    return None
+
+
+def infer_arith(args):
+    t = infer_merge(args)
+    return t
+
+
+def infer_div(args):
+    # MySQL: `/` over exact types yields decimal; we yield FLOAT unless both
+    # are DECIMAL (then scale+4 like MySQL's div_precision_increment)
+    a, b = args[0], args[1]
+    if a.kind == TypeKind.DECIMAL and b.kind in (TypeKind.DECIMAL, TypeKind.INT, TypeKind.UINT):
+        return decimal_type(a.length + 4, a.scale + 4)
+    return double_type()
+
+
+@register("plus", infer_arith)
+def _plus(xp, args, ctx):
+    da, va, db, vb = _coerce_pair(xp, ctx, 0, 1)
+    return da + db, and_valid(xp, va, vb)
+
+
+@register("minus", infer_arith)
+def _minus(xp, args, ctx):
+    da, va, db, vb = _coerce_pair(xp, ctx, 0, 1)
+    return da - db, and_valid(xp, va, vb)
+
+
+def infer_mul(args):
+    a, b = args[0], args[1]
+    if a.kind == TypeKind.DECIMAL and b.kind == TypeKind.DECIMAL:
+        return decimal_type(min(a.length + b.length, 65), a.scale + b.scale)
+    return infer_merge(args)
+
+
+@register("mul", infer_mul)
+def _mul(xp, args, ctx):
+    (da, va), (db, vb) = args
+    ta, tb = ctx.arg_types
+    if ta.kind == TypeKind.DECIMAL and tb.kind == TypeKind.DECIMAL:
+        # scales add; ret_type carries s1+s2 — raw int multiply is exact
+        return da * db, and_valid(xp, va, vb)
+    da, va, db, vb = _coerce_pair(xp, ctx, 0, 1)
+    return da * db, and_valid(xp, va, vb)
+
+
+@register("div", infer_div)
+def _div(xp, args, ctx):
+    (da, va), (db, vb) = args
+    ta, tb = ctx.arg_types
+    nz = db != 0
+    if ctx.ret_type.kind == TypeKind.DECIMAL:
+        # decimal/decimal: result scale = sa+4; numerator rescaled so the int
+        # division is exact to the target scale
+        sa = ta.scale
+        sb = tb.scale if tb.kind == TypeKind.DECIMAL else 0
+        num = da * (10 ** (4 + sb))
+        den = xp.where(nz, db, 1)
+        q = num // den
+        # round half away from zero on the truncated tail
+        r = num - q * den
+        q = q + xp.where(2 * xp.abs(r) >= xp.abs(den), xp.sign(num) * xp.sign(den), 0)
+        return q, and_valid(xp, va, vb, nz)
+    da = da / (10**ta.scale) if ta.kind == TypeKind.DECIMAL else da * 1.0
+    db = db / (10**tb.scale) if tb.kind == TypeKind.DECIMAL else db * 1.0
+    return xp.where(nz, da / xp.where(nz, db, 1.0), 0.0), and_valid(xp, va, vb, nz)
+
+
+@register("intdiv", lambda args: bigint_type())
+def _intdiv(xp, args, ctx):
+    da, va, db, vb = _coerce_pair(xp, ctx, 0, 1)
+    nz = db != 0
+    den = xp.where(nz, db, 1)
+    if ctx.arg_types[0].kind == TypeKind.FLOAT or ctx.arg_types[1].kind == TypeKind.FLOAT:
+        q = (da / den).astype("int64") if hasattr(da / den, "astype") else int(da / den)
+    else:
+        # MySQL DIV truncates toward zero
+        q = xp.sign(da) * xp.sign(den) * (xp.abs(da) // xp.abs(den))
+    return q, and_valid(xp, va, vb, nz)
+
+
+@register("mod", infer_arith)
+def _mod(xp, args, ctx):
+    da, va, db, vb = _coerce_pair(xp, ctx, 0, 1)
+    nz = db != 0
+    den = xp.where(nz, db, 1)
+    r = xp.fmod(da, den)  # sign of dividend, MySQL semantics
+    return r, and_valid(xp, va, vb, nz)
+
+
+@register("unaryminus", infer_first, arity=1)
+def _unaryminus(xp, args, ctx):
+    (d, v) = args[0]
+    return -d, v
+
+
+# ---------------------------------------------------------------------------
+# comparisons (binder guarantees numeric/physical-comparable inputs)
+# ---------------------------------------------------------------------------
+
+
+def _cmp(xp, ctx, op):
+    ta, tb = ctx.arg_types[0], ctx.arg_types[1]
+    if ta.kind == TypeKind.STRING or tb.kind == TypeKind.STRING:
+        da, va = ctx.args[0]
+        db, vb = ctx.args[1]
+        dict_a, dict_b = ctx.arg_dicts[0], ctx.arg_dicts[1]
+        if ta.kind == tb.kind == TypeKind.STRING and dict_a is dict_b and dict_a is not None and dict_a.sorted:
+            # same sorted dictionary: codes are order-preserving
+            res = op(da, db)
+            return res.astype("int64"), and_valid(xp, va, vb)
+        # host path: decode and compare bytes lexicographically
+        import numpy as np
+
+        sa, _ = _decode_strs(ctx, 0)
+        sb, _ = _decode_strs(ctx, 1)
+        out = np.zeros(max(len(sa), len(sb)), dtype=np.int64)
+        for i in range(len(out)):
+            x = sa[i if len(sa) > 1 else 0]
+            y = sb[i if len(sb) > 1 else 0]
+            if x is not None and y is not None:
+                out[i] = int(op(x, y))
+        return out, and_valid(xp, va, vb)
+    da, va, db, vb = _coerce_pair(xp, ctx, 0, 1)
+    res = op(da, db)
+    return res.astype("int64") if hasattr(res, "astype") else int(res), and_valid(xp, va, vb)
+
+
+@register("eq", infer_bool)
+def _eq(xp, args, ctx):
+    return _cmp(xp, ctx, lambda a, b: a == b)
+
+
+@register("ne", infer_bool)
+def _ne(xp, args, ctx):
+    return _cmp(xp, ctx, lambda a, b: a != b)
+
+
+@register("lt", infer_bool)
+def _lt(xp, args, ctx):
+    return _cmp(xp, ctx, lambda a, b: a < b)
+
+
+@register("le", infer_bool)
+def _le(xp, args, ctx):
+    return _cmp(xp, ctx, lambda a, b: a <= b)
+
+
+@register("gt", infer_bool)
+def _gt(xp, args, ctx):
+    return _cmp(xp, ctx, lambda a, b: a > b)
+
+
+@register("ge", infer_bool)
+def _ge(xp, args, ctx):
+    return _cmp(xp, ctx, lambda a, b: a >= b)
+
+
+@register("in", infer_bool, variadic=True)
+def _in(xp, args, ctx):
+    (d, v) = args[0]
+    hit = None
+    any_null = False
+    for (cd, cv) in args[1:]:
+        if cv is False:  # NULL literal in the IN list
+            any_null = True
+            continue
+        h = d == cd
+        hit = h if hit is None else (hit | h)
+    if hit is None:
+        hit = d == d  # empty list after nulls: all False
+        hit = hit & False
+    res = hit.astype("int64") if hasattr(hit, "astype") else int(hit)
+    validity = v
+    if any_null:
+        # x IN (..., NULL): FALSE becomes NULL
+        validity = and_valid(xp, v, hit)
+    return res, validity
+
+
+# ---------------------------------------------------------------------------
+# logic (Kleene)
+# ---------------------------------------------------------------------------
+
+
+def _truth(xp, d, v):
+    """(is_true, is_false, is_null) masks for a bool-ish (data, validity)."""
+    d = xp.asarray(d)  # constants arrive as python scalars
+    t = d != 0
+    if v is None:
+        return t, ~t, None
+    v = xp.asarray(v)
+    return t & v, (~t) & v, ~v
+
+
+@register("and", infer_bool)
+def _and(xp, args, ctx):
+    (da, va), (db, vb) = args
+    ta, fa, na = _truth(xp, da, va)
+    tb, fb, nb = _truth(xp, db, vb)
+    res = ta & tb
+    is_false = fa | fb
+    valid = is_false | (ta & tb)
+    return res.astype("int64"), valid if (na is not None or nb is not None) else None
+
+
+@register("or", infer_bool)
+def _or(xp, args, ctx):
+    (da, va), (db, vb) = args
+    ta, fa, na = _truth(xp, da, va)
+    tb, fb, nb = _truth(xp, db, vb)
+    res = ta | tb
+    is_true = res
+    valid = is_true | (fa & fb)
+    return res.astype("int64"), valid if (na is not None or nb is not None) else None
+
+
+@register("not", infer_bool, arity=1)
+def _not(xp, args, ctx):
+    (d, v) = args[0]
+    res = d == 0
+    return res.astype("int64"), v
+
+
+@register("xor", infer_bool)
+def _xor(xp, args, ctx):
+    (da, va), (db, vb) = args
+    res = (da != 0) ^ (db != 0)
+    return res.astype("int64"), and_valid(xp, va, vb)
+
+
+# ---------------------------------------------------------------------------
+# NULL handling
+# ---------------------------------------------------------------------------
+
+
+@register("isnull", infer_bool, arity=1)
+def _isnull(xp, args, ctx):
+    (d, v) = args[0]
+    if v is None:
+        z = d != d  # all False
+        return z.astype("int64"), None
+    if v is False:
+        return (d * 0 + 1).astype("int64") if hasattr(d, "astype") else 1, None
+    return (~v).astype("int64"), None
+
+
+@register("ifnull", infer_merge)
+def _ifnull(xp, args, ctx):
+    (da, va), (db, vb) = args
+    if va is None:
+        return da, None
+    return xp.where(va, da, db), (va | vb) if vb is not None else None
+
+
+@register("coalesce", infer_merge, variadic=True)
+def _coalesce(xp, args, ctx):
+    out_d, out_v = args[-1]
+    for (d, v) in reversed(args[:-1]):
+        if v is None:
+            # this arg is never NULL → everything below is dead
+            out_d, out_v = d, None
+        else:
+            out_d = xp.where(v, d, out_d)
+            # row is valid if this arg is valid OR anything below was
+            out_v = None if out_v is None else (v | out_v)
+    return out_d, out_v
+
+
+@register("if", lambda args: infer_merge(args[1:]), variadic=True, arity=3)
+def _if(xp, args, ctx):
+    (dc, vc), (da, va), (db, vb) = args
+    cond = (dc != 0) if vc is None else ((dc != 0) & vc)
+    data = xp.where(cond, da, db)
+    if va is None and vb is None:
+        return data, None
+    va_ = va if va is not None else cond | True
+    vb_ = vb if vb is not None else cond | True
+    return data, xp.where(cond, va_, vb_)
+
+
+@register("case_when", infer_merge, variadic=True)
+def _case_when(xp, args, ctx):
+    """args: cond1, val1, cond2, val2, ..., [else_val]."""
+    has_else = len(args) % 2 == 1
+    if has_else:
+        out_d, out_v = args[-1]
+        pairs = args[:-1]
+    else:
+        d0 = args[1][0]
+        out_d, out_v = d0 * 0, False
+        pairs = args
+    for i in range(len(pairs) - 2, -1, -2):
+        (dc, vc), (dv, vv) = pairs[i], pairs[i + 1]
+        dc = xp.asarray(dc)
+        cond = (dc != 0) if vc is None else ((dc != 0) & vc)
+        out_d = xp.where(cond, dv, out_d)
+        if vv is None and out_v is None:
+            continue  # both branches all-valid
+        out_v = xp.where(cond, True if vv is None else vv, True if out_v is None else out_v)
+    return out_d, out_v
+
+
+# ---------------------------------------------------------------------------
+# math
+# ---------------------------------------------------------------------------
+
+
+@register("abs", infer_first, arity=1)
+def _abs(xp, args, ctx):
+    (d, v) = args[0]
+    return xp.abs(d), v
+
+
+@register("ceil", lambda args: bigint_type(), arity=1)
+def _ceil(xp, args, ctx):
+    (d, v) = args[0]
+    t = ctx.arg_types[0]
+    if t.kind == TypeKind.DECIMAL:
+        f = 10**t.scale
+        return -((-d) // f), v
+    if t.kind == TypeKind.FLOAT:
+        return xp.ceil(d).astype("int64"), v
+    return d, v
+
+
+@register("floor", lambda args: bigint_type(), arity=1)
+def _floor(xp, args, ctx):
+    (d, v) = args[0]
+    t = ctx.arg_types[0]
+    if t.kind == TypeKind.DECIMAL:
+        return d // (10**t.scale), v
+    if t.kind == TypeKind.FLOAT:
+        return xp.floor(d).astype("int64"), v
+    return d, v
+
+
+@register("round", infer_first, variadic=True, arity=1)
+def _round(xp, args, ctx):
+    (d, v) = args[0]
+    t = ctx.arg_types[0]
+    nd = 0
+    if len(args) > 1:
+        nd = int(args[1][0])  # binder guarantees constant
+    if t.kind == TypeKind.DECIMAL:
+        drop = t.scale - nd
+        if drop <= 0:
+            return d, v
+        f = 10**drop
+        q = xp.sign(d) * ((xp.abs(d) + f // 2) // f) * f
+        return q, v
+    if t.kind == TypeKind.FLOAT:
+        f = 10.0**nd
+        return xp.where(d >= 0, xp.floor(d * f + 0.5), xp.ceil(d * f - 0.5)) / f, v
+    if nd >= 0:
+        return d, v
+    f = 10 ** (-nd)
+    return xp.sign(d) * ((xp.abs(d) + f // 2) // f) * f, v
+
+
+@register("sqrt", infer_double, arity=1)
+def _sqrt(xp, args, ctx):
+    (d, v) = args[0]
+    d = d * 1.0
+    ok = d >= 0
+    return xp.where(ok, xp.sqrt(xp.where(ok, d, 0.0)), 0.0), and_valid(xp, v, ok)
+
+
+@register("pow", infer_double)
+def _pow(xp, args, ctx):
+    (da, va), (db, vb) = args
+    return xp.power(da * 1.0, db * 1.0), and_valid(xp, va, vb)
+
+
+@register("exp", infer_double, arity=1)
+def _exp(xp, args, ctx):
+    (d, v) = args[0]
+    return xp.exp(d * 1.0), v
+
+
+def _log_impl(xp, d, v, base_log):
+    d = d * 1.0
+    ok = d > 0
+    return base_log(xp.where(ok, d, 1.0)), and_valid(xp, v, ok)
+
+
+@register("ln", infer_double, arity=1)
+def _ln(xp, args, ctx):
+    (d, v) = args[0]
+    return _log_impl(xp, d, v, xp.log)
+
+
+@register("log2", infer_double, arity=1)
+def _log2(xp, args, ctx):
+    (d, v) = args[0]
+    return _log_impl(xp, d, v, xp.log2)
+
+
+@register("log10", infer_double, arity=1)
+def _log10(xp, args, ctx):
+    (d, v) = args[0]
+    return _log_impl(xp, d, v, xp.log10)
+
+
+@register("sign", lambda args: bigint_type(), arity=1)
+def _sign(xp, args, ctx):
+    (d, v) = args[0]
+    return xp.sign(d).astype("int64"), v
+
+
+# ---------------------------------------------------------------------------
+# casts (ret_type on the ScalarFunc carries the target)
+# ---------------------------------------------------------------------------
+
+
+@register("cast_int", lambda args: bigint_type(), arity=1)
+def _cast_int(xp, args, ctx):
+    (d, v) = args[0]
+    t = ctx.arg_types[0]
+    if t.kind == TypeKind.DECIMAL:
+        f = 10**t.scale
+        return xp.sign(d) * ((xp.abs(d) + f // 2) // f), v
+    if t.kind == TypeKind.FLOAT:
+        return xp.where(d >= 0, xp.floor(d + 0.5), xp.ceil(d - 0.5)).astype("int64"), v
+    return d, v
+
+
+@register("cast_float", infer_double, arity=1)
+def _cast_float(xp, args, ctx):
+    (d, v) = args[0]
+    t = ctx.arg_types[0]
+    if t.kind == TypeKind.DECIMAL:
+        return d / (10**t.scale), v
+    return d * 1.0, v
+
+
+@register("cast_decimal", lambda args: args[0], arity=1)
+def _cast_decimal(xp, args, ctx):
+    (d, v) = args[0]
+    t = ctx.arg_types[0]
+    target = ctx.ret_type
+    if t.kind == TypeKind.DECIMAL:
+        diff = target.scale - t.scale
+        if diff >= 0:
+            return d * (10**diff), v
+        f = 10 ** (-diff)
+        return xp.sign(d) * ((xp.abs(d) + f // 2) // f), v
+    if t.kind == TypeKind.FLOAT:
+        scaled = d * (10.0**target.scale)
+        return xp.where(scaled >= 0, xp.floor(scaled + 0.5), xp.ceil(scaled - 0.5)).astype("int64"), v
+    return d * (10**target.scale), v
+
+
+# ---------------------------------------------------------------------------
+# temporal extraction — civil-from-days (pure integer math, device-legal)
+# ---------------------------------------------------------------------------
+
+
+def _civil_from_days(xp, days):
+    z = days + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + xp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def _days_arg(xp, ctx, i):
+    (d, v) = ctx.args[i]
+    if ctx.arg_types[i].kind == TypeKind.DATETIME:
+        d = d // 86_400_000_000  # micros → days
+    return d, v
+
+
+@register("year", lambda args: bigint_type(), arity=1)
+def _year(xp, args, ctx):
+    d, v = _days_arg(xp, ctx, 0)
+    y, _, _ = _civil_from_days(xp, d)
+    return y, v
+
+
+@register("month", lambda args: bigint_type(), arity=1)
+def _month(xp, args, ctx):
+    d, v = _days_arg(xp, ctx, 0)
+    _, m, _ = _civil_from_days(xp, d)
+    return m, v
+
+
+@register("dayofmonth", lambda args: bigint_type(), arity=1)
+def _dayofmonth(xp, args, ctx):
+    d, v = _days_arg(xp, ctx, 0)
+    _, _, dd = _civil_from_days(xp, d)
+    return dd, v
+
+
+@register("dayofweek", lambda args: bigint_type(), arity=1)
+def _dayofweek(xp, args, ctx):
+    d, v = _days_arg(xp, ctx, 0)
+    # 1970-01-01 is a Thursday; MySQL DAYOFWEEK: 1=Sunday
+    return ((d + 4) % 7) + 1, v
+
+
+@register("hour", lambda args: bigint_type(), arity=1)
+def _hour(xp, args, ctx):
+    (d, v) = args[0]
+    return (d // 3_600_000_000) % 24, v
+
+
+@register("minute", lambda args: bigint_type(), arity=1)
+def _minute(xp, args, ctx):
+    (d, v) = args[0]
+    return (d // 60_000_000) % 60, v
+
+
+@register("second", lambda args: bigint_type(), arity=1)
+def _second(xp, args, ctx):
+    (d, v) = args[0]
+    return (d // 1_000_000) % 60, v
+
+
+@register("date_add_days", infer_first)
+def _date_add_days(xp, args, ctx):
+    (da, va), (db, vb) = args
+    if ctx.arg_types[0].kind == TypeKind.DATETIME:
+        return da + db * 86_400_000_000, and_valid(xp, va, vb)
+    return da + db, and_valid(xp, va, vb)
+
+
+# ---------------------------------------------------------------------------
+# strings (host engine only; device string ops happen on dictionary codes and
+# are produced by the binder, never through these entry points)
+# ---------------------------------------------------------------------------
+
+
+def _decode_strs(ctx, i):
+    (d, v) = ctx.args[i]
+    dic = ctx.arg_dicts[i]
+    import numpy as np
+
+    n = len(d) if hasattr(d, "__len__") else ctx.n
+    out = []
+    for k in range(n):
+        if v is not None and v is not True and not (v if isinstance(v, bool) else v[k]):
+            out.append(None)
+        else:
+            out.append(dic.decode(int(d if not hasattr(d, "__len__") else d[k])))
+    return out, v
+
+
+def _encode_strs(ctx, strs):
+    import numpy as np
+
+    dic = ctx.ret_dict
+    data = np.zeros(len(strs), dtype=np.int32)
+    valid = np.ones(len(strs), dtype=bool)
+    for i, s in enumerate(strs):
+        if s is None:
+            valid[i] = False
+        else:
+            data[i] = dic.encode(s)
+    return data, valid
+
+
+@register("length", lambda args: bigint_type(), engines=HOST_ONLY, arity=1)
+def _length(xp, args, ctx):
+    strs, v = _decode_strs(ctx, 0)
+    import numpy as np
+
+    return np.array([0 if s is None else len(s) for s in strs], dtype=np.int64), v
+
+
+@register("lower", lambda args: string_type(), engines=HOST_ONLY, arity=1)
+def _lower(xp, args, ctx):
+    strs, _ = _decode_strs(ctx, 0)
+    return _encode_strs(ctx, [None if s is None else s.lower() for s in strs])
+
+
+@register("upper", lambda args: string_type(), engines=HOST_ONLY, arity=1)
+def _upper(xp, args, ctx):
+    strs, _ = _decode_strs(ctx, 0)
+    return _encode_strs(ctx, [None if s is None else s.upper() for s in strs])
+
+
+@register("concat", lambda args: string_type(), engines=HOST_ONLY, variadic=True)
+def _concat(xp, args, ctx):
+    cols = [_decode_strs(ctx, i)[0] for i in range(len(args))]
+    out = []
+    for parts in zip(*cols):
+        out.append(None if any(p is None for p in parts) else b"".join(parts))
+    return _encode_strs(ctx, out)
+
+
+@register("substring", lambda args: string_type(), engines=HOST_ONLY, variadic=True, arity=3)
+def _substring(xp, args, ctx):
+    strs, _ = _decode_strs(ctx, 0)
+    pos = int(args[1][0])
+    ln = int(args[2][0]) if len(args) > 2 else None
+    out = []
+    for s in strs:
+        if s is None:
+            out.append(None)
+            continue
+        # MySQL 1-based; negative counts from the end
+        start = pos - 1 if pos > 0 else len(s) + pos
+        if start < 0 or pos == 0:
+            out.append(b"" if pos == 0 else s[max(0, start) :])
+            if pos == 0:
+                continue
+            out[-1] = out[-1] if ln is None else out[-1][:ln]
+            continue
+        out.append(s[start:] if ln is None else s[start : start + ln])
+    return _encode_strs(ctx, out)
+
+
+def like_to_regex(pat: str) -> str:
+    """SQL LIKE → regex: % = .*, _ = ., backslash escapes the next char."""
+    import re
+
+    out = []
+    i = 0
+    while i < len(pat):
+        ch = pat[i]
+        if ch == "\\" and i + 1 < len(pat):
+            out.append(re.escape(pat[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return "^" + "".join(out) + "$"
+
+
+@register("like", infer_bool, engines=HOST_ONLY)
+def _like(xp, args, ctx):
+    import re
+
+    import numpy as np
+
+    strs, v = _decode_strs(ctx, 0)
+    pat_code = int(args[1][0])
+    pat = ctx.arg_dicts[1].decode(pat_code).decode("utf-8", "replace")
+    rx = re.compile(like_to_regex(pat), re.DOTALL | re.IGNORECASE if ctx.arg_types[0].collation == "ci" else re.DOTALL)
+    out = np.zeros(len(strs), dtype=np.int64)
+    for i, s in enumerate(strs):
+        if s is not None and rx.match(s.decode("utf-8", "replace")):
+            out[i] = 1
+    return out, v

@@ -56,6 +56,31 @@ def handle_range(table_id: int, lo: int | None, hi: int | None) -> KeyRange:
     return KeyRange(start, end)
 
 
+def range_to_handles(kr: KeyRange, table_id: int) -> tuple[int, int]:
+    """Project a key range onto handle space → [lo, hi) over int64 handles,
+    saturating at the int64 bounds (a row at handle INT64_MAX is not
+    addressable by a half-open int64 range — the autoid allocator never
+    hands it out, matching the reference's IntHandle edge)."""
+    p = record_prefix(table_id)
+    i64_max = 2**63 - 1
+
+    def project(k: bytes) -> int:
+        # smallest handle whose record key is >= k, saturated
+        if k <= p:
+            return -(2**63)
+        if not k.startswith(p):
+            return i64_max  # k is past this table's record space
+        body = k[len(p) :]
+        if len(body) >= 8:
+            h = codec.decode_int_raw(body[:8])
+            if len(body) > 8:  # key extends past the handle → next handle up
+                h = min(h + 1, i64_max)
+            return h
+        return codec.decode_int_raw(body + b"\x00" * (8 - len(body)))
+
+    return project(kr.start), project(kr.end)
+
+
 def index_key(table_id: int, index_id: int, encoded_values: bytes, handle: int | None = None) -> bytes:
     """Non-unique indexes append the handle to make keys unique; unique
     indexes omit it (handle lives in the value)."""

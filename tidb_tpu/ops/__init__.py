@@ -1,0 +1,7 @@
+"""Device kernels: the XLA execution layer of the TPU coprocessor engine.
+
+The DAG operator set (TableScan/Selection/HashAgg/StreamAgg/TopN/Limit/
+Projection — ref: unistore cophandler closure_exec.go) compiles into a single
+fused jitted function per (DAG structure, padded batch shape): one XLA
+computation per region task, no host round-trips inside the pipeline.
+"""

@@ -1,0 +1,322 @@
+"""The fused DAG kernel: one jitted XLA computation per (DAG, batch shape).
+
+Reference parity: unistore's fused closure executor (closure_exec.go:165) —
+but where that is a row-at-a-time Go loop, this compiles the whole operator
+chain into a single XLA program over padded columnar batches:
+
+- Selection = vectorized predicate eval → row mask (no compaction: dynamic
+  shapes would defeat XLA; masked lanes ride along).
+- HashAgg = multi-lane stable sort by group keys (masked rows to the end) →
+  segment boundaries → ``jax.ops.segment_*`` reductions. Deterministic,
+  collision-free (sorts real keys, not hashes), MXU/VPU-friendly.
+- TopN = the same lexicographic sort with MySQL NULL placement, then a
+  static-width head slice.
+- All shapes static: inputs padded to power-of-two buckets, group outputs
+  capped at ``agg_cap`` (kernel reports true group count; the caller re-runs
+  with a bigger cap on overflow — the recompile-storm guard from SURVEY §7).
+
+Numeric policy: int64/float64 lanes (x64 enabled). TPU executes i64/f64 as
+emulated pairs — correct first; a bf16/int32 fast path is a later round's
+optimization once SQL-level tolerance plumbing exists.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+from tidb_tpu.copr import dagpb
+from tidb_tpu.expression.expr import AggDesc, EvalBatch, eval_expr, expr_from_pb
+from tidb_tpu.types import TypeKind
+from tidb_tpu.utils.chunk import bucket_size
+
+MAX_RANGES = 8
+_I64_MAX = np.iinfo(np.int64).max
+_I64_MIN = np.iinfo(np.int64).min
+
+
+@dataclass
+class CompiledKernel:
+    fn: Callable  # (handles, cols, ranges) -> outputs dict
+    kind: str  # "rows" | "agg"
+    out_n: int  # static output row capacity
+    agg_cap: int
+
+
+_COMPILE_CACHE: dict[tuple, CompiledKernel] = {}
+_CACHE_MU = threading.Lock()
+
+
+def _ensure_x64():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+
+def get_kernel(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
+    key = (dag.fingerprint(), n_pad, agg_cap)
+    with _CACHE_MU:
+        k = _COMPILE_CACHE.get(key)
+    if k is None:
+        k = _build(dag, n_pad, agg_cap)
+        with _CACHE_MU:
+            _COMPILE_CACHE[key] = k
+    return k
+
+
+def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
+    _ensure_x64()
+    import jax
+    import jax.numpy as jnp
+
+    executors = dag.executors
+    scan = executors[0]
+    # pre-parse expression trees (host-side, once per compile)
+    parsed: list[Any] = []
+    for ex in executors[1:]:
+        if ex.tp == dagpb.SELECTION:
+            parsed.append([expr_from_pb(c) for c in ex.conditions])
+        elif ex.tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG):
+            parsed.append(
+                (
+                    [expr_from_pb(g) for g in ex.group_by],
+                    [AggDesc.from_pb(a) for a in ex.aggs],
+                    ex.agg_mode,
+                )
+            )
+        elif ex.tp == dagpb.TOPN:
+            parsed.append(([(expr_from_pb(p), d) for p, d in ex.order_by], ex.limit))
+        elif ex.tp == dagpb.PROJECTION:
+            parsed.append([expr_from_pb(e) for e in ex.exprs])
+        else:
+            parsed.append(None)
+
+    agg_is_last = bool(executors[1:]) and executors[-1].tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG)
+    topn_like = [ex for ex in executors[1:] if ex.tp in (dagpb.TOPN, dagpb.LIMIT)]
+    out_n = n_pad
+    if agg_is_last:
+        out_n = agg_cap
+    elif topn_like:
+        out_n = min(n_pad, bucket_size(max(ex.limit for ex in topn_like)))
+
+    def _bcast(d, n):
+        d = jnp.asarray(d)
+        return jnp.broadcast_to(d, (n,)) if d.ndim == 0 else d
+
+    def _vmask(v, n):
+        if v is None:
+            return jnp.ones(n, dtype=bool)
+        if v is False:
+            return jnp.zeros(n, dtype=bool)
+        v = jnp.asarray(v)
+        return jnp.broadcast_to(v, (n,)) if v.ndim == 0 else v
+
+    def _lex_perm(lanes):
+        perm = jnp.argsort(lanes[-1], stable=True)
+        for lane in reversed(lanes[:-1]):
+            perm = perm[jnp.argsort(lane[perm], stable=True)]
+        return perm
+
+    def kernel(handles, cols, ranges, nvalid):
+        n = n_pad
+        # range mask: padded (MAX_RANGES, 2); empty slots have lo >= hi
+        mask = jnp.zeros(n, dtype=bool)
+        for r in range(MAX_RANGES):
+            lo, hi = ranges[r, 0], ranges[r, 1]
+            mask = mask | ((handles >= lo) & (handles < hi))
+        mask = mask & (jnp.arange(n) < nvalid)  # padding rows are never live
+        batch = EvalBatch([(d, v) for d, v in cols], [None] * len(cols), n)
+        kind = "rows"
+        count = None
+        ngroups = None
+
+        for ex, pre in zip(executors[1:], parsed):
+            if ex.tp == dagpb.SELECTION:
+                for cond in pre:
+                    d, v, _ = eval_expr(cond, batch, jnp)
+                    d = _bcast(d, n)
+                    keep = d != 0
+                    if v is not None:
+                        keep = keep & _vmask(v, n)
+                    mask = mask & keep
+            elif ex.tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG):
+                group_exprs, aggs, mode = pre
+                gvals = []
+                for g in group_exprs:
+                    d, v, _ = eval_expr(g, batch, jnp)
+                    d = _bcast(d, n)
+                    v = _vmask(v, n)
+                    gvals.append((jnp.where(v, d, 0), v))
+                if gvals:
+                    lanes = [~mask]
+                    for d, v in gvals:
+                        lanes.append(~v)  # NULL group lane
+                        lanes.append(d)
+                    perm = _lex_perm(lanes)
+                    sm = mask[perm]
+                    first = jnp.arange(n) == 0
+                    diff = jnp.zeros(n, dtype=bool)
+                    for d, v in gvals:
+                        ds, vs = d[perm], v[perm]
+                        diff = diff | jnp.concatenate([jnp.zeros(1, bool), ds[1:] != ds[:-1]])
+                        diff = diff | jnp.concatenate([jnp.zeros(1, bool), vs[1:] != vs[:-1]])
+                    boundary = sm & (first | diff)
+                    seg = jnp.clip(jnp.cumsum(boundary) - 1, 0, None)
+                    ngroups = boundary.sum()
+                else:
+                    perm = jnp.arange(n)
+                    sm = mask
+                    seg = jnp.zeros(n, dtype=jnp.int64)
+                    ngroups = jnp.asarray(1, dtype=jnp.int64)
+
+                pos = jnp.arange(n)
+                first_pos = jax.ops.segment_min(jnp.where(sm, pos, n), seg, num_segments=agg_cap)
+                first_pos_c = jnp.clip(first_pos, 0, n - 1)
+
+                out_data, out_valid = [], []
+                for a in aggs:
+                    if a.arg is not None:
+                        d, v, _ = eval_expr(a.arg, batch, jnp)
+                        d = _bcast(d, n)[perm]
+                        v = _vmask(v, n)[perm]
+                    else:
+                        d = jnp.ones(n, dtype=jnp.int64)
+                        v = jnp.ones(n, dtype=bool)
+                    w = sm & v
+                    cnt = jax.ops.segment_sum(w.astype(jnp.int64), seg, num_segments=agg_cap)
+                    for pk in a.partial_kinds:
+                        if pk == "count":
+                            out_data.append(cnt)
+                            out_valid.append(jnp.ones(agg_cap, dtype=bool))
+                        elif pk == "sum":
+                            if a.arg is not None and a.arg.ftype.kind == TypeKind.FLOAT:
+                                s = jax.ops.segment_sum(jnp.where(w, d * 1.0, 0.0), seg, num_segments=agg_cap)
+                            else:
+                                s = jax.ops.segment_sum(jnp.where(w, d, 0), seg, num_segments=agg_cap)
+                            out_data.append(s)
+                            out_valid.append(cnt > 0)
+                        elif pk in ("min", "max"):
+                            if d.dtype == jnp.float64:
+                                sentinel = jnp.inf if pk == "min" else -jnp.inf
+                            else:
+                                sentinel = _I64_MAX if pk == "min" else _I64_MIN
+                            sd = jnp.where(w, d, sentinel)
+                            red = jax.ops.segment_min if pk == "min" else jax.ops.segment_max
+                            out_data.append(red(sd, seg, num_segments=agg_cap))
+                            out_valid.append(cnt > 0)
+                        elif pk == "first_row":
+                            out_data.append(d[first_pos_c])
+                            out_valid.append(v[first_pos_c] & (first_pos < n))
+                if mode == dagpb.AGG_COMPLETE:
+                    out_data, out_valid = _finalize_device(jnp, aggs, out_data, out_valid)
+                # group key outputs
+                gslot = jnp.arange(agg_cap)
+                gvalid_slot = gslot < ngroups
+                for g, (gd, gv) in zip(group_exprs, gvals):
+                    gd_s, gv_s = gd[perm], gv[perm]
+                    out_data.append(gd_s[first_pos_c])
+                    out_valid.append(gv_s[first_pos_c] & gvalid_slot)
+                # rebuild batch in case more executors follow
+                batch = EvalBatch([(d, v) for d, v in zip(out_data, out_valid)], [None] * len(out_data), agg_cap)
+                mask = gslot < ngroups
+                n_cur = agg_cap
+                kind = "agg"
+            elif ex.tp == dagpb.TOPN:
+                order, limit = pre
+                cur_n = batch.n
+                lanes = [~mask]
+                for e, desc in order:
+                    d, v, _ = eval_expr(e, batch, jnp)
+                    d = _bcast(d, cur_n)
+                    v = _vmask(v, cur_n)
+                    if desc:
+                        lanes.append(~v)  # NULLs last
+                        dd = jnp.where(v, d, 0)
+                        # ints: bitwise complement (monotone-reversing, no
+                        # INT64_MIN overflow); floats: negate
+                        lanes.append(-dd if jnp.issubdtype(dd.dtype, jnp.floating) else ~dd)
+                    else:
+                        lanes.append(v)  # NULLs first
+                        lanes.append(jnp.where(v, d, 0))
+                perm = _lex_perm(lanes)
+                head_n = min(out_n, cur_n)
+                head = perm[:head_n]
+                batch = EvalBatch(
+                    [(_bcast(d, cur_n)[head], _vmask(v, cur_n)[head]) for d, v in batch.cols],
+                    batch.dicts,
+                    head_n,
+                )
+                count = jnp.minimum(limit, mask.sum())
+                mask = jnp.arange(head_n) < count
+                kind = "rows"
+            elif ex.tp == dagpb.LIMIT:
+                cur_n = batch.n
+                perm = jnp.argsort(~mask, stable=True)
+                head = perm[: min(out_n, cur_n)]
+                batch = EvalBatch(
+                    [(_bcast(d, cur_n)[head], _vmask(v, cur_n)[head]) for d, v in batch.cols],
+                    batch.dicts,
+                    len(head),
+                )
+                count = jnp.minimum(ex.limit, mask.sum())
+                mask = jnp.arange(len(head)) < count
+                kind = "rows"
+            elif ex.tp == dagpb.PROJECTION:
+                cur_n = batch.n
+                new_cols = []
+                for e in pre:
+                    d, v, _ = eval_expr(e, batch, jnp)
+                    new_cols.append((_bcast(d, cur_n), _vmask(v, cur_n)))
+                batch = EvalBatch(new_cols, [None] * len(new_cols), cur_n)
+
+        # final packaging; ngroups travels out so the caller can detect
+        # agg-cap overflow even when agg is not the last executor
+        og = ngroups if ngroups is not None else jnp.asarray(-1, dtype=jnp.int64)
+        offsets = dag.output_offsets or list(range(len(batch.cols)))
+        if kind == "agg":
+            outs = [(batch.cols[i][0], batch.cols[i][1]) for i in offsets]
+            return tuple(outs), ngroups, og
+        cur_n = batch.n
+        if count is None:
+            # compact selected rows to the front
+            perm = jnp.argsort(~mask, stable=True)
+            count = mask.sum()
+            outs = [
+                (_bcast(d, cur_n)[perm][:out_n], _vmask(v, cur_n)[perm][:out_n]) for d, v in batch.cols
+            ]
+            outs = [outs[i] for i in offsets]
+            return tuple(outs), jnp.minimum(count, out_n), og
+        outs = [(_bcast(d, cur_n), _vmask(v, cur_n)) for d, v in batch.cols]
+        outs = [outs[i] for i in offsets]
+        return tuple(outs), count, og
+
+    import jax
+
+    jitted = jax.jit(kernel)
+    return CompiledKernel(jitted, "agg" if agg_is_last else "rows", out_n, agg_cap)
+
+
+def _finalize_device(jnp, aggs, state_data, state_valid):
+    """Collapse partial lanes → final values, on device (complete mode)."""
+    out_d, out_v = [], []
+    i = 0
+    for a in aggs:
+        if a.name == "avg":
+            cnt, s = state_data[i], state_data[i + 1]
+            i += 2
+            denom = jnp.maximum(cnt, 1)
+            if a.ftype.kind == TypeKind.DECIMAL:
+                num = s * (10**4)
+                q = jnp.sign(num) * ((jnp.abs(num) + denom // 2) // denom)
+                out_d.append(q)
+            else:
+                out_d.append(s / denom)
+            out_v.append(cnt > 0)
+        else:
+            out_d.append(state_data[i])
+            out_v.append(state_valid[i])
+            i += 1
+    return out_d, out_v
