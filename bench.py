@@ -1,0 +1,122 @@
+"""Benchmark: TPC-H Q1/Q6-shaped aggregation pushdown, TPU engine vs the
+host (numpy/unistore-analog) reference engine.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where value
+is the TPU engine's Q1 scan+agg throughput (rows/sec/chip, end-to-end SQL
+path, warm device cache) and vs_baseline is the speedup over the host
+engine on identical data and plans (BASELINE.md configs 2 and 3).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", "2000000"))
+REPS = int(os.environ.get("BENCH_REPS", "5"))
+
+Q1 = """SELECT l_returnflag, l_linestatus,
+    SUM(l_quantity), SUM(l_extendedprice),
+    SUM(l_extendedprice * (1 - l_discount)),
+    SUM(l_extendedprice * (1 - l_discount) * (1 + l_tax)),
+    AVG(l_quantity), AVG(l_extendedprice), AVG(l_discount), COUNT(*)
+  FROM lineitem WHERE l_shipdate <= DATE '1998-09-02'
+  GROUP BY l_returnflag, l_linestatus ORDER BY l_returnflag, l_linestatus"""
+
+Q6 = """SELECT SUM(l_extendedprice * l_discount) FROM lineitem
+  WHERE l_shipdate >= DATE '1994-01-01' AND l_shipdate < DATE '1995-01-01'
+    AND l_discount BETWEEN 0.05 AND 0.07 AND l_quantity < 24"""
+
+
+def setup():
+    import numpy as np
+
+    import tidb_tpu
+    from tidb_tpu.executor.load import bulk_load
+
+    db = tidb_tpu.open(region_split_keys=1 << 62)  # single region per chip
+    db.execute(
+        """CREATE TABLE lineitem (
+        l_quantity DECIMAL(12,2), l_extendedprice DECIMAL(12,2),
+        l_discount DECIMAL(12,2), l_tax DECIMAL(12,2),
+        l_returnflag VARCHAR(1), l_linestatus VARCHAR(1), l_shipdate DATE)"""
+    )
+    rng = np.random.default_rng(0)
+    n = N_ROWS
+    cols = [
+        rng.integers(100, 5100, n),  # qty  (scaled 2)
+        rng.integers(100000, 9000000, n),  # extendedprice
+        rng.integers(0, 11, n),  # discount
+        rng.integers(0, 9, n),  # tax
+        np.array([b"A", b"N", b"R"], dtype=object)[rng.integers(0, 3, n)],
+        np.array([b"F", b"O"], dtype=object)[rng.integers(0, 2, n)],
+        8036 + rng.integers(0, 2525, n),  # 1992-01-01 .. ~1998-12
+    ]
+    t0 = time.time()
+    bulk_load(db, "lineitem", cols)
+    load_s = time.time() - t0
+    return db, load_s
+
+
+def timed(session, sql, reps):
+    session.query(sql)  # warm (compile + cache build)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        session.query(sql)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    db, load_s = setup()
+    s = db.session()
+
+    s.execute("SET tidb_isolation_read_engines = 'tpu'")
+    q1_tpu = timed(s, Q1, REPS)
+    q6_tpu = timed(s, Q6, REPS)
+    tpu_rows = s.query(Q1)
+
+    s.execute("SET tidb_isolation_read_engines = 'host'")
+    q1_host = timed(s, Q1, max(1, REPS // 2))
+    q6_host = timed(s, Q6, max(1, REPS // 2))
+    host_rows = s.query(Q1)
+
+    assert [r[:2] + tuple(str(x) for x in r[2:]) for r in tpu_rows] == [
+        r[:2] + tuple(str(x) for x in r[2:]) for r in host_rows
+    ], "engine results diverge"
+
+    value = N_ROWS / q1_tpu
+    vs = q1_host / q1_tpu
+    result = {
+        "metric": "tpch_q1_sf~1_rows_per_sec_per_chip",
+        "value": round(value),
+        "unit": "rows/s",
+        "vs_baseline": round(vs, 2),
+        "detail": {
+            "rows": N_ROWS,
+            "q1_tpu_ms": round(q1_tpu * 1e3, 1),
+            "q1_host_ms": round(q1_host * 1e3, 1),
+            "q6_tpu_ms": round(q6_tpu * 1e3, 1),
+            "q6_host_ms": round(q6_host * 1e3, 1),
+            "q6_speedup": round(q6_host / q6_tpu, 2),
+            "load_s": round(load_s, 1),
+            "platform": _platform(),
+        },
+    }
+    print(json.dumps(result))
+
+
+def _platform():
+    try:
+        import jax
+
+        return str(jax.devices()[0].platform)
+    except Exception as e:  # pragma: no cover
+        return f"unknown ({e})"
+
+
+if __name__ == "__main__":
+    main()

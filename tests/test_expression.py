@@ -170,6 +170,59 @@ def test_string_compare_and_like_host_only():
     assert eval_to_column(like, batch, np).to_list() == [0, 1, None]
 
 
+def test_string_in_cross_dictionary():
+    """regression: IN-list constants must re-encode against the column's
+    dictionary, not compare raw codes."""
+    st = string_type()
+    batch, _ = make_batch(s=(["a", "b", "c", None], st))
+    e = func("in", col(0, st), const("b"), const("zzz"))
+    assert eval_to_column(e, batch, np).to_list() == [0, 1, 0, None]
+
+
+def test_decimal_div_negative_rounding(xp):
+    from decimal import Decimal
+
+    dt = decimal_type(10, 1)
+    batch, _ = make_batch(a=([-1.0, 1.0, -10.0], dt), b=([3.0, 3.0, 3.0], dt))
+    out = run(func("div", col(0, dt), col(1, dt)), batch, xp)
+    assert out == [Decimal("-0.33333"), Decimal("0.33333"), Decimal("-3.33333")]
+
+
+def test_substring_negative_pos_past_length():
+    st = string_type()
+    batch, _ = make_batch(s=(["abc"], st))
+    assert eval_to_column(func("substring", col(0, st), const(-5), const(2)), batch, np).to_list() == [""]
+    assert eval_to_column(func("substring", col(0, st), const(-2), const(2)), batch, np).to_list() == ["bc"]
+    assert eval_to_column(func("substring", col(0, st), const(0), const(2)), batch, np).to_list() == [""]
+
+
+def test_group_by_computed_expr_with_nulls():
+    """regression: NULL group keys from computed expressions must coalesce
+    into one group on the host engine."""
+    from tidb_tpu.copr import dagpb
+    from tidb_tpu.copr.host_engine import _aggregate
+    from tidb_tpu.expression.expr import AggDesc
+    from tidb_tpu.utils.chunk import Chunk, Column
+
+    bt = bigint_type()
+    chunk = Chunk(
+        [
+            Column.from_values([None, None, 1], bt),
+            Column.from_values([5, 9, 1], bt),
+        ]
+    )
+    # group by a+b: rows 0,1 have NULL keys with different garbage lanes
+    ex = dagpb.ExecutorPB(
+        dagpb.AGGREGATION,
+        group_by=[func("plus", col(0, bt), col(1, bt)).to_pb()],
+        aggs=[AggDesc("count", None).to_pb()],
+        agg_mode=dagpb.AGG_COMPLETE,
+    )
+    out = _aggregate(chunk, ex)
+    # one NULL group (count 2) + one group for key 2 (count 1)
+    assert sorted(out.rows(), key=str) == [(1, 2), (2, None)]
+
+
 def test_string_funcs_host():
     st = string_type()
     batch, _ = make_batch(s=(["Hello", None], st))

@@ -1,0 +1,164 @@
+"""Parser tests (ref: pkg/parser parser_test.go patterns)."""
+
+import pytest
+
+from tidb_tpu.parser import ParseError, parse, parse_many
+from tidb_tpu.parser import ast
+
+
+def test_select_basic():
+    s = parse("SELECT a, b+1 AS c FROM t WHERE a > 5 ORDER BY b DESC LIMIT 10")
+    assert isinstance(s, ast.Select)
+    assert len(s.items) == 2 and s.items[1].alias == "c"
+    assert isinstance(s.from_, ast.TableRef) and s.from_.name == "t"
+    assert isinstance(s.where, ast.BinaryOp) and s.where.op == "gt"
+    assert s.order_by[0].desc and s.limit == 10
+
+
+def test_select_group_having():
+    s = parse("SELECT l_returnflag, SUM(l_quantity) FROM lineitem GROUP BY l_returnflag HAVING SUM(l_quantity) > 100")
+    assert len(s.group_by) == 1 and s.having is not None
+    agg = s.items[1].expr
+    assert isinstance(agg, ast.FuncCall) and agg.name == "sum"
+
+
+def test_tpch_q1_parses():
+    q1 = """
+    SELECT l_returnflag, l_linestatus,
+        SUM(l_quantity) AS sum_qty,
+        SUM(l_extendedprice) AS sum_base_price,
+        SUM(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+        SUM(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+        AVG(l_quantity) AS avg_qty, AVG(l_extendedprice) AS avg_price,
+        AVG(l_discount) AS avg_disc, COUNT(*) AS count_order
+    FROM lineitem
+    WHERE l_shipdate <= DATE '1998-09-02'
+    GROUP BY l_returnflag, l_linestatus
+    ORDER BY l_returnflag, l_linestatus
+    """
+    s = parse(q1)
+    assert len(s.items) == 10 and len(s.group_by) == 2 and len(s.order_by) == 2
+    assert s.items[9].expr.star
+
+
+def test_operator_precedence():
+    s = parse("SELECT 1 + 2 * 3 = 7 AND NOT 0")
+    e = s.items[0].expr
+    assert isinstance(e, ast.BinaryOp) and e.op == "and"
+    assert e.left.op == "eq"
+
+
+def test_in_between_like_is():
+    s = parse("SELECT * FROM t WHERE a IN (1,2) AND b BETWEEN 3 AND 4 AND c LIKE 'x%' AND d IS NOT NULL AND e NOT IN (5)")
+    w = s.where
+    found = set()
+
+    def walk(n):
+        if isinstance(n, ast.BinaryOp):
+            walk(n.left)
+            walk(n.right)
+        elif isinstance(n, ast.InList):
+            found.add("in" if not n.negated else "notin")
+        elif isinstance(n, ast.Between):
+            found.add("between")
+        elif isinstance(n, ast.Like):
+            found.add("like")
+        elif isinstance(n, ast.IsNull):
+            found.add("isnotnull" if n.negated else "isnull")
+
+    walk(w)
+    assert found == {"in", "notin", "between", "like", "isnotnull"}
+
+
+def test_joins():
+    s = parse("SELECT * FROM a JOIN b ON a.x = b.x LEFT JOIN c ON b.y = c.y, d")
+    j = s.from_
+    assert isinstance(j, ast.Join) and j.kind == "cross"
+    assert j.left.kind == "left"
+    assert j.left.left.kind == "inner"
+
+
+def test_insert_forms():
+    i = parse("INSERT INTO t (a, b) VALUES (1, 'x'), (2, 'y')")
+    assert i.columns == ["a", "b"] and len(i.values) == 2
+    i2 = parse("INSERT INTO t VALUES (1)")
+    assert i2.columns == [] and i2.values == [[ast.Literal(1)]]
+
+
+def test_update_delete():
+    u = parse("UPDATE t SET a = a + 1, b = 2 WHERE c = 3 LIMIT 5")
+    assert len(u.assignments) == 2 and u.limit == 5
+    d = parse("DELETE FROM t WHERE a < 0")
+    assert d.where.op == "lt"
+
+
+def test_create_table():
+    c = parse(
+        """CREATE TABLE IF NOT EXISTS t (
+            id BIGINT NOT NULL AUTO_INCREMENT PRIMARY KEY,
+            name VARCHAR(64) DEFAULT 'x',
+            price DECIMAL(12,2),
+            ship DATE,
+            KEY idx_name (name),
+            UNIQUE KEY uq (price, ship)
+        ) ENGINE=InnoDB"""
+    )
+    assert c.if_not_exists and len(c.columns) == 4
+    assert c.columns[0].auto_increment and c.columns[0].primary_key
+    assert c.columns[1].default == ast.Literal("x")
+    assert c.indexes[0].columns == ["name"] and c.indexes[1].unique
+
+
+def test_ddl_misc():
+    assert isinstance(parse("DROP TABLE IF EXISTS a, b"), ast.DropTable)
+    assert parse("ALTER TABLE t ADD COLUMN x INT").action == "add_column"
+    assert parse("ALTER TABLE t DROP COLUMN x").action == "drop_column"
+    assert parse("ALTER TABLE t ADD INDEX i (a, b)").action == "add_index"
+    assert isinstance(parse("CREATE INDEX i ON t (a)"), ast.CreateIndex)
+    assert isinstance(parse("TRUNCATE TABLE t"), ast.TruncateTable)
+    assert isinstance(parse("CREATE DATABASE IF NOT EXISTS d"), ast.CreateDatabase)
+
+
+def test_misc_statements():
+    assert isinstance(parse("EXPLAIN SELECT 1"), ast.Explain)
+    assert parse("EXPLAIN ANALYZE SELECT 1").analyze
+    sv = parse("SET @@session.tidb_isolation_read_engines = 'tpu'")
+    assert sv.name == "tidb_isolation_read_engines" and sv.scope == "session"
+    assert parse("SET GLOBAL x = 1").scope == "global"
+    assert isinstance(parse("SHOW TABLES"), ast.Show)
+    assert isinstance(parse("BEGIN"), ast.Begin)
+    assert isinstance(parse("START TRANSACTION"), ast.Begin)
+    assert isinstance(parse("COMMIT"), ast.Commit)
+    assert isinstance(parse("USE test"), ast.UseDatabase)
+    assert isinstance(parse("ANALYZE TABLE t"), ast.AnalyzeTable)
+
+
+def test_case_cast_funcs():
+    s = parse("SELECT CASE WHEN a > 1 THEN 'hi' ELSE 'lo' END, CAST(a AS DOUBLE), COALESCE(a, 0) FROM t")
+    assert isinstance(s.items[0].expr, ast.CaseWhen)
+    assert isinstance(s.items[1].expr, ast.Cast)
+    assert s.items[2].expr.name == "coalesce"
+
+
+def test_typed_literals_and_quotes():
+    s = parse("SELECT DATE '1994-01-01', `weird col` FROM `my table`")
+    assert s.items[0].expr == ast.Literal("1994-01-01", hint="date")
+    assert s.items[1].expr.name == "weird col"
+
+
+def test_subqueries():
+    s = parse("SELECT * FROM (SELECT a FROM t) sub WHERE a IN (SELECT b FROM u)")
+    assert isinstance(s.from_, ast.SubquerySource) and s.from_.alias == "sub"
+    inq = s.where
+    assert isinstance(inq, ast.InList) and isinstance(inq.items[0], ast.SubqueryExpr)
+
+
+def test_parse_many_and_errors():
+    stmts = parse_many("SELECT 1; SELECT 2;")
+    assert len(stmts) == 2
+    with pytest.raises(ParseError):
+        parse("SELECT FROM")
+    with pytest.raises(ParseError):
+        parse("FOO BAR")
+    with pytest.raises(ParseError):
+        parse("SELECT 1 extra garbage ,")

@@ -1,0 +1,232 @@
+"""End-to-end SQL tests (ref: testkit-driven suites, SURVEY §4.2 — full
+stack in one process on the embedded store)."""
+
+from decimal import Decimal
+
+import pytest
+
+import tidb_tpu
+
+
+@pytest.fixture()
+def db():
+    return tidb_tpu.open()
+
+
+@pytest.fixture()
+def tdb(db):
+    db.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, a BIGINT, b DOUBLE, c VARCHAR(32), d DATE)")
+    db.execute(
+        "INSERT INTO t VALUES (1, 10, 1.5, 'x', '2024-01-01'), (2, 20, 2.5, 'y', '2024-06-01'),"
+        " (3, 30, 3.5, 'x', '2023-01-01'), (4, NULL, NULL, NULL, NULL)"
+    )
+    return db
+
+
+def test_create_insert_select(tdb):
+    rows = tdb.query("SELECT id, a, c FROM t ORDER BY id")
+    assert rows == [(1, 10, "x"), (2, 20, "y"), (3, 30, "x"), (4, None, None)]
+
+
+def test_where_and_projection(tdb):
+    assert tdb.query("SELECT a*2 FROM t WHERE a > 10 ORDER BY a") == [(40,), (60,)]
+    assert tdb.query("SELECT id FROM t WHERE c = 'x' ORDER BY id") == [(1,), (3,)]
+    assert tdb.query("SELECT id FROM t WHERE d < '2024-01-01'") == [(3,)]
+
+
+def test_aggregation(tdb):
+    assert tdb.query("SELECT COUNT(*) FROM t") == [(4,)]
+    assert tdb.query("SELECT COUNT(a), SUM(a), MIN(a), MAX(a) FROM t") == [(3, 60, 10, 30)]
+    rows = tdb.query("SELECT c, COUNT(*), AVG(b) FROM t GROUP BY c ORDER BY c")
+    assert rows[0][0] is None and rows[0][1] == 1
+    assert ("x", 2, 2.5) in rows and ("y", 1, 2.5) in rows
+
+
+def test_agg_empty_table(db):
+    db.execute("CREATE TABLE e (a BIGINT)")
+    assert db.query("SELECT COUNT(*), SUM(a) FROM e") == [(0, None)]
+    assert db.query("SELECT COUNT(*) FROM e WHERE a > 5") == [(0,)]
+
+
+def test_having_and_alias(tdb):
+    rows = tdb.query("SELECT c, SUM(a) AS s FROM t GROUP BY c HAVING s > 10 ORDER BY s")
+    assert rows == [("y", 20), ("x", 40)]
+
+
+def test_order_limit_offset(tdb):
+    assert tdb.query("SELECT id FROM t ORDER BY a DESC LIMIT 2") == [(3,), (2,)]
+    assert tdb.query("SELECT id FROM t ORDER BY id LIMIT 2 OFFSET 1") == [(2,), (3,)]
+    # NULLs first on ASC
+    assert tdb.query("SELECT id FROM t ORDER BY a LIMIT 1") == [(4,)]
+
+
+def test_order_by_hidden_column(tdb):
+    assert tdb.query("SELECT id FROM t WHERE a IS NOT NULL ORDER BY b DESC") == [(3,), (2,), (1,)]
+
+
+def test_distinct(tdb):
+    assert sorted(tdb.query("SELECT DISTINCT c FROM t"), key=str) == sorted([(None,), ("x",), ("y",)], key=str)
+
+
+def test_point_get_and_update_delete(tdb):
+    assert tdb.query("SELECT a FROM t WHERE id = 2") == [(20,)]
+    assert tdb.execute("UPDATE t SET a = a + 1 WHERE id = 2").affected == 1
+    assert tdb.query("SELECT a FROM t WHERE id = 2") == [(21,)]
+    assert tdb.execute("DELETE FROM t WHERE id = 2").affected == 1
+    assert tdb.query("SELECT a FROM t WHERE id = 2") == []
+    assert tdb.query("SELECT COUNT(*) FROM t") == [(3,)]
+
+
+def test_duplicate_pk(tdb):
+    from tidb_tpu.executor.write import DupKeyError
+
+    with pytest.raises(DupKeyError):
+        tdb.execute("INSERT INTO t VALUES (1, 1, 1.0, 'dup', NULL)")
+    # INSERT IGNORE swallows
+    assert tdb.execute("INSERT IGNORE INTO t VALUES (1, 99, 1.0, 'dup', NULL)").affected == 0
+    # REPLACE overwrites
+    assert tdb.execute("REPLACE INTO t VALUES (1, 99, 1.0, 'rep', NULL)").affected == 1
+    assert tdb.query("SELECT a, c FROM t WHERE id = 1") == [(99, "rep")]
+
+
+def test_auto_increment(db):
+    db.execute("CREATE TABLE ai (id BIGINT PRIMARY KEY AUTO_INCREMENT, v BIGINT)")
+    db.execute("INSERT INTO ai (v) VALUES (10), (20)")
+    rows = db.query("SELECT id, v FROM ai ORDER BY id")
+    assert rows[0][1] == 10 and rows[1][1] == 20 and rows[1][0] > rows[0][0]
+
+
+def test_explicit_txn_union_scan(db):
+    db.execute("CREATE TABLE tx (id BIGINT PRIMARY KEY, v BIGINT)")
+    db.execute("INSERT INTO tx VALUES (1, 100)")
+    s = db.session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO tx VALUES (2, 200)")
+    s.execute("UPDATE tx SET v = 111 WHERE id = 1")
+    # own writes visible before commit (union scan), incl. under aggregation
+    assert s.query("SELECT v FROM tx ORDER BY id") == [(111,), (200,)]
+    assert s.query("SELECT SUM(v) FROM tx") == [(311,)]
+    # other sessions don't see it
+    assert db.query("SELECT COUNT(*) FROM tx") == [(1,)]
+    s.execute("COMMIT")
+    assert db.query("SELECT v FROM tx ORDER BY id") == [(111,), (200,)]
+
+
+def test_txn_rollback(db):
+    db.execute("CREATE TABLE r (id BIGINT PRIMARY KEY)")
+    s = db.session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO r VALUES (1)")
+    s.execute("ROLLBACK")
+    assert db.query("SELECT COUNT(*) FROM r") == [(0,)]
+
+
+def test_joins(db):
+    db.execute("CREATE TABLE c (id BIGINT PRIMARY KEY, name VARCHAR(20))")
+    db.execute("CREATE TABLE o (oid BIGINT PRIMARY KEY, cid BIGINT, amt DOUBLE)")
+    db.execute("INSERT INTO c VALUES (1, 'ann'), (2, 'bob'), (3, 'cat')")
+    db.execute("INSERT INTO o VALUES (10, 1, 5.0), (11, 1, 7.0), (12, 2, 9.0)")
+    rows = db.query(
+        "SELECT c.name, o.amt FROM c JOIN o ON c.id = o.cid ORDER BY o.oid"
+    )
+    assert rows == [("ann", 5.0), ("ann", 7.0), ("bob", 9.0)]
+    rows = db.query(
+        "SELECT c.name, SUM(o.amt) FROM c LEFT JOIN o ON c.id = o.cid GROUP BY c.name ORDER BY c.name"
+    )
+    assert rows == [("ann", 12.0), ("bob", 9.0), ("cat", None)]
+
+
+def test_subqueries(db):
+    db.execute("CREATE TABLE s1 (a BIGINT)")
+    db.execute("INSERT INTO s1 VALUES (1), (2), (3)")
+    assert db.query("SELECT a FROM s1 WHERE a IN (SELECT a FROM s1 WHERE a > 1) ORDER BY a") == [(2,), (3,)]
+    assert db.query("SELECT (SELECT MAX(a) FROM s1)") == [(3,)]
+    assert db.query("SELECT SUM(a) FROM (SELECT a FROM s1 WHERE a < 3) sub") == [(3,)]
+
+
+def test_ddl_alter(db):
+    db.execute("CREATE TABLE al (a BIGINT)")
+    db.execute("INSERT INTO al VALUES (1), (2)")
+    db.execute("ALTER TABLE al ADD COLUMN b BIGINT DEFAULT 7")
+    assert db.query("SELECT a, b FROM al ORDER BY a") == [(1, 7), (2, 7)]
+    db.execute("ALTER TABLE al DROP COLUMN a")
+    assert db.query("SELECT b FROM al") == [(7,), (7,)]
+    db.execute("DROP TABLE al")
+    from tidb_tpu.catalog import CatalogError
+
+    with pytest.raises(CatalogError):
+        db.query("SELECT * FROM al")
+
+
+def test_engine_isolation_switch(tdb):
+    s = tdb._ses()
+    s.execute("SET tidb_isolation_read_engines = 'host'")
+    host_rows = s.query("SELECT c, SUM(a) FROM t GROUP BY c ORDER BY c")
+    s.execute("SET tidb_isolation_read_engines = 'tpu,host'")
+    tpu_rows = s.query("SELECT c, SUM(a) FROM t GROUP BY c ORDER BY c")
+    assert host_rows == tpu_rows
+
+
+def test_explain_shows_engine_and_pushdown(tdb):
+    rows = tdb.query("EXPLAIN SELECT c, SUM(a) FROM t WHERE a > 5 GROUP BY c")
+    text = "\n".join(r[0] for r in rows)
+    assert "tpu" in text and "PartialAgg" in text and "Selection" in text
+    rows = tdb.query("EXPLAIN SELECT c FROM t WHERE c LIKE 'x%'")
+    text = "\n".join(r[0] for r in rows)
+    assert "host" in text  # LIKE is not device-legal
+
+
+def test_show_and_use(tdb):
+    assert ("t",) in tdb.query("SHOW TABLES")
+    assert ("test",) in tdb.query("SHOW DATABASES")
+    tdb.execute("CREATE DATABASE other")
+    tdb.execute("USE other")
+    assert tdb.query("SHOW TABLES") == []
+    tdb.execute("USE test")
+
+
+def test_decimal_end_to_end(db):
+    db.execute("CREATE TABLE dec (p DECIMAL(12,2), q DECIMAL(12,2))")
+    db.execute("INSERT INTO dec VALUES (10.50, 0.05), (20.25, 0.10)")
+    rows = db.query("SELECT SUM(p * (1 - q)) FROM dec")
+    assert rows == [(Decimal("28.2000"),)]
+
+
+def test_select_no_from(db):
+    assert db.query("SELECT 1 + 1, 'hi'") == [(2, "hi")]
+
+
+def test_tpch_q1_shape_end_to_end(db):
+    db.execute(
+        """CREATE TABLE lineitem (
+        l_quantity DECIMAL(12,2), l_extendedprice DECIMAL(12,2),
+        l_discount DECIMAL(12,2), l_tax DECIMAL(12,2),
+        l_returnflag VARCHAR(1), l_linestatus VARCHAR(1), l_shipdate DATE)"""
+    )
+    import random
+
+    random.seed(3)
+    vals = []
+    for i in range(500):
+        vals.append(
+            f"({random.randint(1,50)}, {random.uniform(100,1000):.2f}, 0.0{random.randint(0,9)},"
+            f" 0.0{random.randint(0,8)}, '{random.choice('ANR')}', '{random.choice('FO')}',"
+            f" '199{random.randint(2,7)}-0{random.randint(1,9)}-1{random.randint(0,9)}')"
+        )
+    db.execute("INSERT INTO lineitem VALUES " + ",".join(vals))
+    q1 = """SELECT l_returnflag, l_linestatus,
+        SUM(l_quantity) AS sum_qty,
+        SUM(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+        AVG(l_quantity) AS avg_qty, COUNT(*) AS count_order
+      FROM lineitem
+      WHERE l_shipdate <= DATE '1998-09-02' - INTERVAL 90 DAY
+      GROUP BY l_returnflag, l_linestatus
+      ORDER BY l_returnflag, l_linestatus"""
+    s = db._ses()
+    s.execute("SET tidb_isolation_read_engines = 'host'")
+    host = s.query(q1)
+    s.execute("SET tidb_isolation_read_engines = 'tpu,host'")
+    tpu = s.query(q1)
+    assert host == tpu and len(host) >= 4
+    total = sum(r[5] for r in host)
+    assert total == 500  # all rows qualify (dates < 1998)

@@ -1,0 +1,149 @@
+"""Schema objects (ref: pkg/meta/model TableInfo/ColumnInfo/IndexInfo)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from tidb_tpu.parser import ast
+from tidb_tpu.types import FieldType, TypeKind
+from tidb_tpu.types.field_type import (
+    FieldType,
+    bigint_type,
+    date_type,
+    datetime_type,
+    decimal_type,
+    double_type,
+    duration_type,
+    string_type,
+)
+from tidb_tpu.expression.expr import _ft_pb, _ft_from_pb
+
+
+def typedef_to_ftype(td: ast.TypeDef, not_null: bool = False) -> FieldType:
+    name = td.name
+    nullable = not not_null
+    if name in ("tinyint", "smallint", "mediumint", "int", "integer", "bigint", "bool", "boolean", "serial"):
+        ft = FieldType(TypeKind.UINT if td.unsigned else TypeKind.INT, length=td.length if td.length > 0 else 20, nullable=nullable)
+    elif name in ("double", "float", "real"):
+        ft = double_type(nullable)
+    elif name in ("decimal", "numeric"):
+        ft = decimal_type(td.length if td.length > 0 else 10, td.scale, nullable)
+    elif name in ("varchar", "char", "text", "tinytext", "mediumtext", "longtext", "blob", "varbinary", "binary", "enum"):
+        ft = string_type(td.length, nullable)
+    elif name == "date":
+        ft = date_type(nullable)
+    elif name in ("datetime", "timestamp"):
+        ft = datetime_type(nullable)
+    elif name == "time":
+        ft = duration_type(nullable)
+    elif name == "json":
+        ft = FieldType(TypeKind.JSON, nullable=nullable)
+    else:
+        raise ValueError(f"unsupported column type {name!r}")
+    return ft
+
+
+@dataclass
+class ColumnInfo:
+    id: int  # stable per-table column id
+    name: str
+    ftype: FieldType
+    offset: int  # current storage slot
+    default: Any = None  # logical python value
+    auto_increment: bool = False
+
+    def to_pb(self) -> dict:
+        d = self.default
+        if hasattr(d, "isoformat"):
+            d = d.isoformat()
+        return {
+            "id": self.id,
+            "name": self.name,
+            "ft": _ft_pb(self.ftype),
+            "offset": self.offset,
+            "default": d,
+            "auto_increment": self.auto_increment,
+        }
+
+    @staticmethod
+    def from_pb(pb: dict) -> "ColumnInfo":
+        return ColumnInfo(pb["id"], pb["name"], _ft_from_pb(pb["ft"]), pb["offset"], pb["default"], pb["auto_increment"])
+
+
+@dataclass
+class IndexInfo:
+    id: int
+    name: str
+    column_offsets: list[int]
+    unique: bool = False
+    primary: bool = False
+
+    def to_pb(self) -> dict:
+        return {"id": self.id, "name": self.name, "cols": self.column_offsets, "unique": self.unique, "primary": self.primary}
+
+    @staticmethod
+    def from_pb(pb: dict) -> "IndexInfo":
+        return IndexInfo(pb["id"], pb["name"], pb["cols"], pb["unique"], pb["primary"])
+
+
+@dataclass
+class TableInfo:
+    id: int
+    name: str
+    columns: list[ColumnInfo] = field(default_factory=list)
+    indexes: list[IndexInfo] = field(default_factory=list)
+    # int primary key stored AS the handle (ref: pk_is_handle in model.TableInfo)
+    pk_is_handle: bool = False
+    pk_offset: int = -1
+    next_column_id: int = 1
+    next_index_id: int = 1
+
+    def column(self, name: str) -> Optional[ColumnInfo]:
+        lname = name.lower()
+        for c in self.columns:
+            if c.name.lower() == lname:
+                return c
+        return None
+
+    @property
+    def storage_schema(self) -> list[FieldType]:
+        return [c.ftype for c in self.columns]
+
+    def to_pb(self) -> dict:
+        return {
+            "id": self.id,
+            "name": self.name,
+            "columns": [c.to_pb() for c in self.columns],
+            "indexes": [i.to_pb() for i in self.indexes],
+            "pk_is_handle": self.pk_is_handle,
+            "pk_offset": self.pk_offset,
+            "next_column_id": self.next_column_id,
+            "next_index_id": self.next_index_id,
+        }
+
+    @staticmethod
+    def from_pb(pb: dict) -> "TableInfo":
+        return TableInfo(
+            pb["id"],
+            pb["name"],
+            [ColumnInfo.from_pb(c) for c in pb["columns"]],
+            [IndexInfo.from_pb(i) for i in pb["indexes"]],
+            pb["pk_is_handle"],
+            pb["pk_offset"],
+            pb["next_column_id"],
+            pb["next_index_id"],
+        )
+
+
+@dataclass
+class DBInfo:
+    name: str
+    tables: dict[str, TableInfo] = field(default_factory=dict)
+
+    def to_pb(self) -> dict:
+        return {"name": self.name, "tables": {k: t.to_pb() for k, t in self.tables.items()}}
+
+    @staticmethod
+    def from_pb(pb: dict) -> "DBInfo":
+        return DBInfo(pb["name"], {k: TableInfo.from_pb(t) for k, t in pb["tables"].items()})

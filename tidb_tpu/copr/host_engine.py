@@ -94,15 +94,16 @@ def _group_sort(chunk: Chunk, key_cols: list[Column]) -> tuple[np.ndarray, np.nd
     if not key_cols:
         return np.arange(n), np.zeros(n, dtype=np.int64), 1
     lanes = []
-    for c in key_cols:
-        lanes.append(c.data)
+    masked = [np.where(c.validity, c.data, 0) for c in key_cols]  # NULL lanes
+    for c, md in zip(key_cols, masked):  # may hold garbage from computed exprs
+        lanes.append(md)
         lanes.append(~c.validity)  # NULLs form their own (single) group
     perm = np.lexsort(tuple(reversed(lanes)))  # first key = primary
     boundary = np.zeros(n, dtype=bool)
     if n:
         boundary[0] = True
-        for c in key_cols:
-            ds, vs = c.data[perm], c.validity[perm]
+        for c, md in zip(key_cols, masked):
+            ds, vs = md[perm], c.validity[perm]
             boundary[1:] |= ds[1:] != ds[:-1]
             boundary[1:] |= vs[1:] != vs[:-1]
     seg = np.cumsum(boundary) - 1
@@ -254,10 +255,10 @@ def _topn(chunk: Chunk, ex: dagpb.ExecutorPB) -> Chunk:
     return chunk.take(perm[: ex.limit])
 
 
-def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int) -> Chunk:
-    assert dag.executors and dag.executors[0].tp == dagpb.TABLE_SCAN
-    chunk = _scan(store, region, dag.executors[0], ranges, read_ts)
-    for ex in dag.executors[1:]:
+def run_operators(chunk: Chunk, executors: list, output_offsets: list[int]) -> Chunk:
+    """Apply post-scan DAG operators to a materialized chunk — shared by the
+    per-region host path and the union-scan (dirty-txn) path."""
+    for ex in executors:
         if ex.tp == dagpb.SELECTION:
             chunk = _selection(chunk, ex.conditions)
         elif ex.tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG):
@@ -271,6 +272,12 @@ def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: 
             chunk = Chunk([eval_to_column(expr_from_pb(pb), batch, np) for pb in ex.exprs])
         else:
             raise NotImplementedError(f"host engine: executor {ex.tp}")
-    if dag.output_offsets:
-        chunk = Chunk([chunk.columns[i] for i in dag.output_offsets])
+    if output_offsets:
+        chunk = Chunk([chunk.columns[i] for i in output_offsets])
     return chunk
+
+
+def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int) -> Chunk:
+    assert dag.executors and dag.executors[0].tp == dagpb.TABLE_SCAN
+    chunk = _scan(store, region, dag.executors[0], ranges, read_ts)
+    return run_operators(chunk, dag.executors[1:], dag.output_offsets)

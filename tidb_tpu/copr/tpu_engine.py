@@ -58,8 +58,8 @@ def _device_put_col(key, data: np.ndarray, valid: np.ndarray, n_pad: int, cachea
         with _dev_mu:
             # evict superseded epochs of the same column: each write bumps
             # data_version, and stale device arrays would leak HBM forever
-            ident = key[:3]  # (region_id, table_id, slot)
-            for k in [k for k in _device_cols if k[:3] == ident and k != key]:
+            ident = key[:4]  # (store_nonce, region_id, table_id, slot)
+            for k in [k for k in _device_cols if k[:4] == ident and k != key]:
                 del _device_cols[k]
             _device_cols[key] = out
     return out
@@ -86,7 +86,7 @@ def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: 
     # the device cache — they'd alias the head state of the same version)
     epoch = cache.epoch
     cacheable = entry.complete
-    hkey = (region.region_id, scan.table_id, -1, entry.data_version, epoch, n_pad)
+    hkey = (store.nonce, region.region_id, scan.table_id, -1, entry.data_version, epoch, n_pad)
     handles_dev, _ = _device_put_col(hkey, entry.handles, np.ones(entry.n, bool), n_pad, cacheable)
     cols_dev = []
     for c in scan.columns:
@@ -94,7 +94,7 @@ def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: 
             cols_dev.append(_device_put_col(hkey, entry.handles, np.ones(entry.n, bool), n_pad, cacheable))
         else:
             data, valid = entry.cols[c.column_id]
-            ckey = (region.region_id, scan.table_id, c.column_id, entry.data_version, epoch, n_pad)
+            ckey = (store.nonce, region.region_id, scan.table_id, c.column_id, entry.data_version, epoch, n_pad)
             cols_dev.append(_device_put_col(ckey, data, valid, n_pad, cacheable))
 
     # ranges → padded static array; rows outside any range are masked out

@@ -1,0 +1,506 @@
+"""Read-path executors (ref: pkg/executor table_reader.go, aggregate/,
+sortexec/, join/ — collapsed to chunk-materializing operators)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from tidb_tpu.copr import dagpb
+from tidb_tpu.copr.host_engine import _aggregate as host_aggregate  # complete-mode agg
+from tidb_tpu.copr.host_engine import _selection as host_selection
+from tidb_tpu.copr.host_engine import finalize_agg, sort_perm
+from tidb_tpu.expression.expr import AggDesc, ColumnRef, EvalBatch, eval_to_column
+from tidb_tpu.kv import tablecodec
+from tidb_tpu.kv.kv import Request, RequestType, StoreType
+from tidb_tpu.kv.rowcodec import RowSchema, decode_row
+from tidb_tpu.planner.plans import (
+    PhysDistinct,
+    PhysDual,
+    PhysFinalAgg,
+    PhysHashJoin,
+    PhysLimit,
+    PhysPointGet,
+    PhysProjection,
+    PhysSelection,
+    PhysSort,
+    PhysTableReader,
+)
+from tidb_tpu.types import TypeKind
+from tidb_tpu.types.field_type import bigint_type
+from tidb_tpu.utils.chunk import Chunk, Column, Dictionary
+
+
+class ExecError(Exception):
+    pass
+
+
+class Executor:
+    schema: list
+
+    def execute(self) -> Chunk:
+        raise NotImplementedError
+
+
+def build_executor(plan, session) -> Executor:
+    """ref: executorBuilder.build (builder.go:164)."""
+    if isinstance(plan, PhysTableReader):
+        return TableReaderExec(plan, session)
+    if isinstance(plan, PhysSelection):
+        return SelectionExec(plan, build_executor(plan.children[0], session))
+    if isinstance(plan, PhysProjection):
+        return ProjectionExec(plan, build_executor(plan.children[0], session))
+    if isinstance(plan, PhysFinalAgg):
+        return FinalAggExec(plan, build_executor(plan.children[0], session))
+    if isinstance(plan, PhysSort):
+        return SortExec(plan, build_executor(plan.children[0], session))
+    if isinstance(plan, PhysLimit):
+        return LimitExec(plan, build_executor(plan.children[0], session))
+    if isinstance(plan, PhysHashJoin):
+        return HashJoinExec(plan, build_executor(plan.children[0], session), build_executor(plan.children[1], session))
+    if isinstance(plan, PhysDistinct):
+        return DistinctExec(build_executor(plan.children[0], session))
+    if isinstance(plan, PhysDual):
+        return DualExec(plan)
+    if isinstance(plan, PhysPointGet):
+        return PointGetExec(plan, session)
+    raise ExecError(f"no executor for {type(plan).__name__}")
+
+
+def _empty_chunk(schema) -> Chunk:
+    cols = []
+    for oc in schema:
+        dt = {TypeKind.FLOAT: np.float64, TypeKind.STRING: np.int32}.get(oc.ftype.kind, np.int64)
+        cols.append(Column(np.empty(0, dt), np.empty(0, bool), oc.ftype))
+    return Chunk(cols)
+
+
+@dataclass
+class TableReaderExec(Executor):
+    plan: PhysTableReader
+    session: object
+
+    def __post_init__(self):
+        self.schema = self.plan.schema
+
+    def execute(self) -> Chunk:
+        p = self.plan
+        t = p.table
+        scan = dagpb.ExecutorPB(
+            dagpb.TABLE_SCAN,
+            table_id=t.id,
+            columns=[
+                dagpb.ColumnInfoPB(slot, t.columns[slot].ftype)
+                if slot >= 0
+                else dagpb.ColumnInfoPB(-1, bigint_type(nullable=False), is_handle=True)
+                for slot in p.scan_slots
+            ],
+            storage_schema=t.storage_schema,
+        )
+        executors = [scan]
+        if p.pushed_conditions:
+            executors.append(dagpb.ExecutorPB(dagpb.SELECTION, conditions=[c.to_pb() for c in p.pushed_conditions]))
+        if p.pushed_agg is not None:
+            executors.append(
+                dagpb.ExecutorPB(
+                    dagpb.AGGREGATION,
+                    group_by=[g.to_pb() for g in p.pushed_agg.group_by],
+                    aggs=[a.to_pb() for a in p.pushed_agg.aggs],
+                    agg_mode=dagpb.AGG_PARTIAL if p.pushed_agg_mode == "partial" else dagpb.AGG_COMPLETE,
+                )
+            )
+        if p.pushed_topn is not None:
+            by, limit = p.pushed_topn
+            executors.append(
+                dagpb.ExecutorPB(dagpb.TOPN, order_by=[[e.to_pb(), d] for e, d in by], limit=limit)
+            )
+        if p.pushed_limit is not None:
+            executors.append(dagpb.ExecutorPB(dagpb.LIMIT, limit=p.pushed_limit))
+        dag = dagpb.DAGRequest(executors=executors)
+        ranges = p.ranges if p.ranges is not None else [tablecodec.record_range(t.id)]
+        if not ranges:
+            return _empty_chunk(p.schema)
+        if self.session._txn_dirty():
+            # union-scan path (ref: UnionScanExec): scan through the txn's
+            # membuffer overlay and replay pushed operators host-side
+            return self._union_scan(dag, ranges)
+        req = Request(
+            tp=RequestType.DAG,
+            data=dag,
+            ranges=ranges,
+            store_type=p.store_type,
+            start_ts=self.session.read_ts(),
+            concurrency=int(self.session.vars.get("tidb_distsql_scan_concurrency", 8)),
+            keep_order=p.keep_order,
+        )
+        client = self.session.store.get_client()
+        chunks = [res.chunk for res in client.send(req) if len(res.chunk)]
+        if not chunks:
+            return _empty_chunk(p.schema)
+        # string columns may carry per-region-identical dictionaries (table-
+        # level, shared) — concat requires the same object, which holds here
+        return Chunk.concat(chunks) if len(chunks) > 1 else chunks[0]
+
+    def _union_scan(self, dag, ranges) -> Chunk:
+        from tidb_tpu.copr.host_engine import run_operators
+        from tidb_tpu.executor.write import _rows_to_chunk, _scan_visible_rows
+
+        t = self.plan.table
+        handles, rows = _scan_visible_rows(self.session, t)
+        # restrict by handle ranges
+        keep = []
+        bounds = [tablecodec.range_to_handles(kr, t.id) for kr in ranges]
+        for i, h in enumerate(handles):
+            if any(lo <= h < hi for lo, hi in bounds):
+                keep.append(i)
+        rows = [rows[i] for i in keep]
+        handles = [handles[i] for i in keep]
+        full = _rows_to_chunk(self.session, t, rows)
+        cols = []
+        for slot in self.plan.scan_slots:
+            if slot == -1:
+                cols.append(Column(np.asarray(handles, np.int64), np.ones(len(handles), bool), bigint_type(nullable=False)))
+            else:
+                cols.append(full.columns[slot])
+        chunk = Chunk(cols)
+        out = run_operators(chunk, dag.executors[1:], dag.output_offsets)
+        return out if len(out.columns) else _empty_chunk(self.plan.schema)
+
+
+@dataclass
+class SelectionExec(Executor):
+    plan: PhysSelection
+    child: Executor
+
+    def __post_init__(self):
+        self.schema = self.plan.schema
+
+    def execute(self) -> Chunk:
+        chunk = self.child.execute()
+        return host_selection(chunk, [c.to_pb() for c in self.plan.conditions])
+
+
+@dataclass
+class ProjectionExec(Executor):
+    plan: PhysProjection
+    child: Executor
+
+    def __post_init__(self):
+        self.schema = self.plan.schema
+
+    def execute(self) -> Chunk:
+        chunk = self.child.execute()
+        batch = EvalBatch.from_chunk(chunk)
+        if len(chunk) == 0:
+            return _empty_chunk(self.plan.schema)
+        return Chunk([eval_to_column(e, batch, np) for e in self.plan.exprs])
+
+
+@dataclass
+class FinalAggExec(Executor):
+    plan: PhysFinalAgg
+    child: Executor
+
+    def __post_init__(self):
+        self.schema = self.plan.schema
+
+    def execute(self) -> Chunk:
+        chunk = self.child.execute()
+        aggs = self.plan.aggs
+        ngroup = len(self.plan.group_by)
+        if not self.plan.partial_input:
+            ex = dagpb.ExecutorPB(
+                dagpb.AGGREGATION,
+                group_by=[g.to_pb() for g in self.plan.group_by],
+                aggs=[a.to_pb() for a in aggs],
+                agg_mode=dagpb.AGG_COMPLETE,
+            )
+            return host_aggregate(chunk, ex)
+        return merge_partials(chunk, aggs, ngroup)
+
+
+def merge_partials(chunk: Chunk, aggs: list[AggDesc], ngroup: int) -> Chunk:
+    """Merge per-region partial-state chunks into final values (ref: the
+    final-mode HashAgg above a partial cop agg, aggregate/agg_hash_executor)."""
+    ncols = chunk.num_cols
+    key_cols = chunk.columns[ncols - ngroup :] if ngroup else []
+    n = len(chunk)
+    # group rows by key columns
+    if ngroup and n:
+        lanes = []
+        for c in key_cols:
+            lanes.append(c.data)
+            lanes.append(~c.validity)
+        perm = np.lexsort(tuple(reversed(lanes)))
+        boundary = np.zeros(n, dtype=bool)
+        boundary[0] = True
+        for c in key_cols:
+            ds, vs = c.data[perm], c.validity[perm]
+            boundary[1:] |= ds[1:] != ds[:-1]
+            boundary[1:] |= vs[1:] != vs[:-1]
+        seg = np.cumsum(boundary) - 1
+        ngroups = int(seg[-1]) + 1
+    else:
+        perm = np.arange(n)
+        seg = np.zeros(n, dtype=np.int64)
+        ngroups = 1 if (n or not ngroup) else 0
+        boundary = np.zeros(n, dtype=bool)
+        if n:
+            boundary[0] = True
+
+    state_cols: list[Column] = []
+    i = 0
+    for a in aggs:
+        for pk in a.partial_kinds:
+            c = chunk.columns[i]
+            i += 1
+            data, valid = c.data[perm], c.validity[perm]
+            if pk in ("count",):
+                out = np.bincount(seg, weights=data, minlength=ngroups).astype(np.int64)
+                state_cols.append(Column(out, np.ones(ngroups, bool), c.ftype))
+            elif pk == "sum":
+                w = np.where(valid, data, 0)
+                if data.dtype == np.float64:
+                    out = np.bincount(seg, weights=w, minlength=ngroups)
+                else:
+                    out = np.zeros(ngroups, dtype=np.int64)
+                    np.add.at(out, seg, w)
+                anyv = np.zeros(ngroups, dtype=bool)
+                np.logical_or.at(anyv, seg, valid)
+                state_cols.append(Column(out.astype(data.dtype), anyv, c.ftype))
+            elif pk in ("min", "max"):
+                if data.dtype == np.float64:
+                    sentinel = np.inf if pk == "min" else -np.inf
+                else:
+                    sentinel = np.iinfo(np.int64).max if pk == "min" else np.iinfo(np.int64).min
+                d = np.where(valid, data, sentinel)
+                out = np.full(ngroups, sentinel, dtype=data.dtype)
+                (np.minimum if pk == "min" else np.maximum).at(out, seg, d)
+                anyv = np.zeros(ngroups, dtype=bool)
+                np.logical_or.at(anyv, seg, valid)
+                state_cols.append(Column(out, anyv, c.ftype, c.dictionary))
+            elif pk == "first_row":
+                first_idx = np.nonzero(boundary)[0] if n else np.empty(0, np.int64)
+                # first VALID row per group preferred
+                out = np.zeros(ngroups, dtype=data.dtype)
+                anyv = np.zeros(ngroups, dtype=bool)
+                # walk groups: take first valid value
+                order = np.lexsort((np.arange(n), ~valid, seg)) if n else np.empty(0, np.int64)
+                if n:
+                    b2 = np.ones(n, dtype=bool)
+                    b2[1:] = seg[order][1:] != seg[order][:-1]
+                    firsts = order[b2]
+                    out[seg[firsts]] = data[firsts]
+                    anyv[seg[firsts]] = valid[firsts]
+                state_cols.append(Column(out, anyv, c.ftype, c.dictionary))
+    # key outputs: value at first row of each group
+    out_keys: list[Column] = []
+    if ngroup and n:
+        firsts = np.nonzero(boundary)[0]
+        for c in key_cols:
+            out_keys.append(Column(c.data[perm][firsts], c.validity[perm][firsts], c.ftype, c.dictionary))
+    elif ngroup:
+        out_keys = [Column(np.empty(0, c.data.dtype), np.empty(0, bool), c.ftype, c.dictionary) for c in key_cols]
+    partial = Chunk(state_cols + out_keys)
+    if ngroups == 0 and ngroup == 0:
+        # scalar agg over empty input: synthesize the empty-partial row
+        pass
+    group_fts = [c.ftype for c in key_cols]
+    group_dicts = [c.dictionary for c in key_cols]
+    return finalize_agg(partial, aggs, group_fts, group_dicts)
+
+
+@dataclass
+class SortExec(Executor):
+    plan: PhysSort
+    child: Executor
+
+    def __post_init__(self):
+        self.schema = self.plan.schema
+
+    def execute(self) -> Chunk:
+        chunk = self.child.execute()
+        if len(chunk) == 0:
+            return chunk
+        perm = sort_perm(chunk, [[e.to_pb(), d] for e, d in self.plan.by])
+        return chunk.take(perm)
+
+
+@dataclass
+class LimitExec(Executor):
+    plan: PhysLimit
+    child: Executor
+
+    def __post_init__(self):
+        self.schema = self.plan.schema
+
+    def execute(self) -> Chunk:
+        chunk = self.child.execute()
+        return chunk.slice(min(self.plan.offset, len(chunk)), min(self.plan.offset + self.plan.limit, len(chunk)))
+
+
+@dataclass
+class DistinctExec(Executor):
+    child: Executor
+
+    def __post_init__(self):
+        self.schema = self.child.schema
+
+    def execute(self) -> Chunk:
+        chunk = self.child.execute()
+        n = len(chunk)
+        if n == 0:
+            return chunk
+        lanes = []
+        for c in chunk.columns:
+            key = c.data
+            if c.ftype.kind == TypeKind.STRING and c.dictionary is not None:
+                pass  # codes identify values within one dictionary
+            lanes.append(key)
+            lanes.append(~c.validity)
+        perm = np.lexsort(tuple(reversed(lanes)))
+        keep = np.ones(n, dtype=bool)
+        for c in chunk.columns:
+            ds, vs = c.data[perm], c.validity[perm]
+            if len(ds) > 1:
+                keep[1:] &= ~((ds[1:] == ds[:-1]) & (vs[1:] == vs[:-1]))
+        # keep[i] True where any column differs from previous
+        diff = np.zeros(n, dtype=bool)
+        diff[0] = True
+        for c in chunk.columns:
+            ds, vs = c.data[perm], c.validity[perm]
+            diff[1:] |= ds[1:] != ds[:-1]
+            diff[1:] |= vs[1:] != vs[:-1]
+        return chunk.take(np.sort(perm[diff]))
+
+
+@dataclass
+class HashJoinExec(Executor):
+    plan: PhysHashJoin
+    left: Executor
+    right: Executor
+
+    def __post_init__(self):
+        self.schema = self.plan.schema
+
+    def _key_array(self, chunk: Chunk, idx: int):
+        c = chunk.columns[idx]
+        if c.ftype.kind == TypeKind.STRING and c.dictionary is not None:
+            # cross-table joins: dictionaries differ → join on bytes
+            return np.array([None if not c.validity[i] else c.dictionary.decode(int(c.data[i])) for i in range(len(c))], dtype=object)
+        return c.data
+
+    def execute(self) -> Chunk:
+        p = self.plan
+        lc = self.left.execute()
+        rc = self.right.execute()
+        nleft = len(lc.columns)
+        if p.kind == "cross" and not p.eq_conds:
+            li = np.repeat(np.arange(len(lc)), len(rc))
+            ri = np.tile(np.arange(len(rc)), len(lc))
+            joined = Chunk(
+                [c.take(li) for c in lc.columns] + [c.take(ri) for c in rc.columns]
+            )
+            return self._apply_other(joined)
+        # build on right, probe left (ref: hash_join build/probe)
+        rkeys = [self._key_array(rc, r) for _, r in p.eq_conds]
+        rvalid = [rc.columns[r].validity for _, r in p.eq_conds]
+        table: dict = {}
+        for j in range(len(rc)):
+            if all(v[j] for v in rvalid):
+                k = tuple(ka[j] for ka in rkeys)
+                table.setdefault(k, []).append(j)
+        lkeys = [self._key_array(lc, l) for l, _ in p.eq_conds]
+        lvalid = [lc.columns[l].validity for l, _ in p.eq_conds]
+        li_list: list[int] = []
+        ri_list: list[int] = []
+        lmiss: list[int] = []
+        rmatched = np.zeros(len(rc), dtype=bool)
+        for i in range(len(lc)):
+            if all(v[i] for v in lvalid):
+                k = tuple(ka[i] for ka in lkeys)
+                hits = table.get(k)
+                if hits:
+                    for j in hits:
+                        li_list.append(i)
+                        ri_list.append(j)
+                        rmatched[j] = True
+                    continue
+            lmiss.append(i)
+        li = np.asarray(li_list, dtype=np.int64)
+        ri = np.asarray(ri_list, dtype=np.int64)
+        cols = [c.take(li) for c in lc.columns] + [c.take(ri) for c in rc.columns]
+        joined = Chunk(cols)
+        joined = self._apply_other(joined)
+        if p.kind == "left" and lmiss:
+            lm = np.asarray(lmiss, dtype=np.int64)
+            null_right = [
+                Column(np.zeros(len(lm), c.data.dtype), np.zeros(len(lm), bool), c.ftype, c.dictionary)
+                for c in rc.columns
+            ]
+            miss = Chunk([c.take(lm) for c in lc.columns] + null_right)
+            joined = Chunk.concat([joined, miss]) if len(joined) else miss
+        elif p.kind == "right":
+            rmiss = np.nonzero(~rmatched)[0]
+            if len(rmiss):
+                null_left = [
+                    Column(np.zeros(len(rmiss), c.data.dtype), np.zeros(len(rmiss), bool), c.ftype, c.dictionary)
+                    for c in lc.columns
+                ]
+                miss = Chunk(null_left + [c.take(rmiss) for c in rc.columns])
+                joined = Chunk.concat([joined, miss]) if len(joined) else miss
+        return joined
+
+    def _apply_other(self, joined: Chunk) -> Chunk:
+        if not self.plan.other_conds or len(joined) == 0:
+            return joined
+        return host_selection(joined, [c.to_pb() for c in self.plan.other_conds])
+
+
+@dataclass
+class DualExec(Executor):
+    plan: PhysDual
+
+    def __post_init__(self):
+        self.schema = self.plan.schema
+
+    def execute(self) -> Chunk:
+        # one dummy row so projections above evaluate constants once
+        c = Column(np.zeros(1, np.int64), np.ones(1, bool), bigint_type(nullable=False))
+        return Chunk([c])
+
+
+@dataclass
+class PointGetExec(Executor):
+    plan: PhysPointGet
+    session: object
+
+    def __post_init__(self):
+        self.schema = self.plan.schema
+
+    def execute(self) -> Chunk:
+        t = self.plan.table
+        txn = self.session.txn_for_read()
+        raw = txn.get(tablecodec.record_key(t.id, self.plan.handle))
+        slots = getattr(self.plan, "scan_slots", list(range(len(t.columns))))
+        if raw is None:
+            return _empty_chunk(self.plan.schema)
+        vals = decode_row(RowSchema(t.storage_schema), raw)
+        cols = []
+        from tidb_tpu.copr.colcache import cache_for
+
+        cache = cache_for(self.session.store)
+        for pos, slot in enumerate(slots):
+            ci = t.columns[slot]
+            v = vals[slot]
+            if ci.ftype.kind == TypeKind.STRING:
+                dic = cache.dictionary(t.id, slot)
+                data = np.array([0 if v is None else dic.encode(v)], dtype=np.int32)
+                cols.append(Column(data, np.array([v is not None]), ci.ftype, dic))
+            else:
+                dt = np.float64 if ci.ftype.kind == TypeKind.FLOAT else np.int64
+                data = np.array([0 if v is None else v], dtype=dt)
+                cols.append(Column(data, np.array([v is not None]), ci.ftype))
+        return Chunk(cols)

@@ -1,0 +1,63 @@
+"""Bulk columnar loader — the IMPORT INTO / lightning analog.
+
+Reference parity: pkg/lightning local backend + IMPORT INTO (disttask) —
+bypasses per-statement SQL overhead and writes encoded rows straight through
+a transaction in batches. Used by bench/bootstrap; the SQL surface for it
+(IMPORT INTO) can layer on later.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from tidb_tpu.executor.write import index_entry, to_physical
+from tidb_tpu.kv import tablecodec
+from tidb_tpu.kv.rowcodec import RowSchema, encode_row
+from tidb_tpu.session.session import DB
+from tidb_tpu.types import TypeKind
+
+
+def bulk_load(db: DB, table_name: str, columns: Sequence[Sequence], db_name: str = "test", batch: int = 200_000) -> int:
+    """Load columnar data (one sequence per table column, logical values).
+    Handles come from the int PK column when pk_is_handle, else autoid."""
+    t = db.catalog.table(db_name, table_name)
+    ncols = len(t.columns)
+    assert len(columns) == ncols, f"expected {ncols} columns"
+    n = len(columns[0])
+    schema = RowSchema(t.storage_schema)
+
+    phys_cols = []
+    for c, vals in zip(t.columns, columns):
+        k = c.ftype.kind
+        if isinstance(vals, np.ndarray) and k in (TypeKind.INT, TypeKind.UINT, TypeKind.DECIMAL, TypeKind.DATE, TypeKind.DATETIME, TypeKind.DURATION):
+            phys_cols.append(vals.astype(np.int64))
+        elif isinstance(vals, np.ndarray) and k == TypeKind.FLOAT:
+            phys_cols.append(vals.astype(np.float64))
+        else:
+            phys_cols.append([to_physical(v, c.ftype) for v in vals])
+
+    loaded = 0
+    i = 0
+    while i < n:
+        j = min(i + batch, n)
+        txn = db.store.begin()
+        if t.pk_is_handle:
+            handles = phys_cols[t.pk_offset][i:j]
+        else:
+            base = db.catalog.alloc_autoid(t.id, j - i)
+            handles = range(base, base + (j - i))
+        for r, h in zip(range(i, j), handles):
+            vals = [phys_cols[c][r] for c in range(ncols)]
+            txn.put(tablecodec.record_key(t.id, int(h)), encode_row(schema, vals))
+            for idx in t.indexes:
+                ik, iv = index_entry(t, idx, vals, int(h))
+                txn.put(ik, iv)
+        txn.commit()
+        loaded += j - i
+        i = j
+    if t.pk_is_handle:
+        mx = int(np.max(np.asarray(phys_cols[t.pk_offset]))) if n else 0
+        db.catalog.rebase_autoid(t.id, mx + 1)
+    return loaded

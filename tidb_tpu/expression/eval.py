@@ -112,15 +112,15 @@ def _div(xp, args, ctx):
     nz = db != 0
     if ctx.ret_type.kind == TypeKind.DECIMAL:
         # decimal/decimal: result scale = sa+4; numerator rescaled so the int
-        # division is exact to the target scale
-        sa = ta.scale
+        # division is exact to the target scale. Truncate toward zero, then
+        # round half away from zero (floor-div would over-round negatives).
         sb = tb.scale if tb.kind == TypeKind.DECIMAL else 0
         num = da * (10 ** (4 + sb))
         den = xp.where(nz, db, 1)
-        q = num // den
-        # round half away from zero on the truncated tail
-        r = num - q * den
-        q = q + xp.where(2 * xp.abs(r) >= xp.abs(den), xp.sign(num) * xp.sign(den), 0)
+        absq = xp.abs(num) // xp.abs(den)
+        rem = xp.abs(num) - absq * xp.abs(den)
+        absq = absq + (2 * rem >= xp.abs(den))
+        q = xp.sign(num) * xp.sign(den) * absq
         return q, and_valid(xp, va, vb, nz)
     da = da / (10**ta.scale) if ta.kind == TypeKind.DECIMAL else da * 1.0
     db = db / (10**tb.scale) if tb.kind == TypeKind.DECIMAL else db * 1.0
@@ -160,7 +160,7 @@ def _unaryminus(xp, args, ctx):
 # ---------------------------------------------------------------------------
 
 
-def _cmp(xp, ctx, op):
+def _cmp(xp, ctx, op, sig=None):
     ta, tb = ctx.arg_types[0], ctx.arg_types[1]
     if ta.kind == TypeKind.STRING or tb.kind == TypeKind.STRING:
         da, va = ctx.args[0]
@@ -170,7 +170,13 @@ def _cmp(xp, ctx, op):
             # same sorted dictionary: codes are order-preserving
             res = op(da, db)
             return res.astype("int64"), and_valid(xp, va, vb)
-        # host path: decode and compare bytes lexicographically
+        # col-vs-constant fast path: bind the constant into the column's
+        # dictionary once and compare codes/ranks vectorized (the host
+        # analog of binder._bind_code_compare / _bind_rank_compare)
+        fast = _cmp_const_fast(xp, ctx, sig)
+        if fast is not None:
+            return fast
+        # general path: decode and compare bytes lexicographically
         import numpy as np
 
         sa, _ = _decode_strs(ctx, 0)
@@ -187,45 +193,93 @@ def _cmp(xp, ctx, op):
     return res.astype("int64") if hasattr(res, "astype") else int(res), and_valid(xp, va, vb)
 
 
+def _cmp_const_fast(xp, ctx, sig):
+    """String col vs string constant → vectorized code/rank comparison.
+    Returns None when the shape doesn't fit (col-vs-col, no dictionary)."""
+    import numpy as np
+
+    for ci, ki in ((0, 1), (1, 0)):
+        dcol, vcol = ctx.args[ci]
+        dconst, vconst = ctx.args[ki]
+        if not (hasattr(dcol, "ndim") and getattr(dcol, "ndim", 0) == 1):
+            continue
+        if hasattr(dconst, "ndim") and getattr(dconst, "ndim", 0) == 1:
+            continue
+        col_dict = ctx.arg_dicts[ci]
+        const_dict = ctx.arg_dicts[ki]
+        if col_dict is None or const_dict is None or ctx.arg_types[ci].kind != TypeKind.STRING:
+            return None
+        val = const_dict.decode(int(dconst))
+        # flip operator when the constant is on the left
+        s = sig if ci == 0 else {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq", "ne": "ne"}[sig]
+        if s in ("eq", "ne"):
+            code = col_dict.try_encode(val)
+            res = (dcol == code) if s == "eq" else (dcol != code)
+            return res.astype("int64"), and_valid(xp, vcol, vconst)
+        if not col_dict.sorted:
+            return None  # ordering needs order-preserving codes
+        import bisect
+
+        vals = col_dict.values_array()
+        if s == "lt":
+            res = dcol < bisect.bisect_left(vals, val)
+        elif s == "le":
+            res = dcol < bisect.bisect_right(vals, val)
+        elif s == "gt":
+            res = dcol >= bisect.bisect_right(vals, val)
+        else:  # ge
+            res = dcol >= bisect.bisect_left(vals, val)
+        return res.astype("int64"), and_valid(xp, vcol, vconst)
+    return None
+
+
 @register("eq", infer_bool)
 def _eq(xp, args, ctx):
-    return _cmp(xp, ctx, lambda a, b: a == b)
+    return _cmp(xp, ctx, lambda a, b: a == b, "eq")
 
 
 @register("ne", infer_bool)
 def _ne(xp, args, ctx):
-    return _cmp(xp, ctx, lambda a, b: a != b)
+    return _cmp(xp, ctx, lambda a, b: a != b, "ne")
 
 
 @register("lt", infer_bool)
 def _lt(xp, args, ctx):
-    return _cmp(xp, ctx, lambda a, b: a < b)
+    return _cmp(xp, ctx, lambda a, b: a < b, "lt")
 
 
 @register("le", infer_bool)
 def _le(xp, args, ctx):
-    return _cmp(xp, ctx, lambda a, b: a <= b)
+    return _cmp(xp, ctx, lambda a, b: a <= b, "le")
 
 
 @register("gt", infer_bool)
 def _gt(xp, args, ctx):
-    return _cmp(xp, ctx, lambda a, b: a > b)
+    return _cmp(xp, ctx, lambda a, b: a > b, "gt")
 
 
 @register("ge", infer_bool)
 def _ge(xp, args, ctx):
-    return _cmp(xp, ctx, lambda a, b: a >= b)
+    return _cmp(xp, ctx, lambda a, b: a >= b, "ge")
 
 
 @register("in", infer_bool, variadic=True)
 def _in(xp, args, ctx):
     (d, v) = args[0]
+    is_string = ctx.arg_types[0].kind == TypeKind.STRING
+    col_dict = ctx.arg_dicts[0] if is_string else None
     hit = None
     any_null = False
-    for (cd, cv) in args[1:]:
+    for i, (cd, cv) in enumerate(args[1:], start=1):
         if cv is False:  # NULL literal in the IN list
             any_null = True
             continue
+        if is_string:
+            # constants carry their own dictionaries — re-encode against the
+            # column's dictionary so code comparison is meaningful
+            const_dict = ctx.arg_dicts[i]
+            if const_dict is not col_dict and const_dict is not None:
+                cd = col_dict.try_encode(const_dict.decode(int(cd))) if col_dict is not None else -1
         h = d == cd
         hit = h if hit is None else (hit | h)
     if hit is None:
@@ -666,13 +720,14 @@ def _substring(xp, args, ctx):
         if s is None:
             out.append(None)
             continue
-        # MySQL 1-based; negative counts from the end
+        # MySQL 1-based; negative pos counts from the end; pos 0, negative
+        # length, or |pos| beyond the string → empty
+        if pos == 0 or (ln is not None and ln <= 0):
+            out.append(b"")
+            continue
         start = pos - 1 if pos > 0 else len(s) + pos
-        if start < 0 or pos == 0:
-            out.append(b"" if pos == 0 else s[max(0, start) :])
-            if pos == 0:
-                continue
-            out[-1] = out[-1] if ln is None else out[-1][:ln]
+        if start < 0:
+            out.append(b"")
             continue
         out.append(s[start:] if ln is None else s[start : start + ln])
     return _encode_strs(ctx, out)

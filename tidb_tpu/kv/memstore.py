@@ -206,7 +206,12 @@ class MemStore:
     """The storage node. One process can host several (multi-"node" tests)."""
 
     def __init__(self, region_split_keys: int = 500_000, lock_ttl_ms: int = 3000):
+        import uuid
+
         self.lock_ttl_ms = lock_ttl_ms
+        # distinguishes this store in process-global caches (device arrays):
+        # region/table ids restart per store and would otherwise collide
+        self.nonce = uuid.uuid4().hex
         self._mu = threading.RLock()
         self._writes: dict[bytes, list[Write]] = {}
         # key → start_ts set of rolled-back txns (out-of-band so write chains

@@ -1,0 +1,22 @@
+"""Device mesh construction (ref: the role PD topology + store lists play —
+which compute nodes exist and how fragments land on them)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "dp"):
+    """1-D mesh over available devices. SQL fragments parallelize along one
+    data axis; intra-device parallelism is XLA's job (VPU/MXU), so unlike an
+    LLM stack there is no tp/pp split — dp + collectives covers the MPP
+    model (hash/broadcast/passthrough exchanges ride ICI)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    import numpy as np
+
+    return Mesh(np.array(devs), (axis,))

@@ -1,0 +1,161 @@
+"""MPP fragments as shard_map programs: the distributed query step.
+
+The canonical two-fragment MPP plan (ref: fragment.go + mpp_exec.go):
+
+  Fragment 1 (per shard): Scan → Selection → PartialAgg
+  ── Hash exchange on group keys (all_to_all) ──
+  Fragment 2 (per shard): merge partials for owned key range
+  ── PassThrough exchange (all_gather) ──
+  root: finalize
+
+Everything below runs inside ONE jitted shard_map over mesh axis ``dp`` —
+fragment boundaries become collectives, not gRPC streams. Group capacities
+are static (padded); hash-bucket capacity equals the per-shard group cap, so
+the exchange can never overflow.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+import numpy as np
+
+
+@dataclass
+class DistAggSpec:
+    """A distributed group-by/aggregate over sharded columns.
+
+    ``n_keys`` leading input columns are the group keys (int lanes);
+    ``sums``: indices of value columns to SUM; COUNT(*) always included.
+    ``group_cap``: static max distinct groups per shard (and per exchange
+    bucket)."""
+
+    n_keys: int
+    sums: Sequence[int]
+    group_cap: int = 256
+
+
+def _segment_partial(jnp, keys, vals, mask, cap):
+    """Sort-based grouped partial agg on one shard (same algorithm as
+    ops/dag_kernel.py — key-exact, no hash collisions)."""
+    n = keys[0].shape[0]
+    lanes = [~mask] + list(keys)
+    perm = jnp.argsort(lanes[-1], stable=True)
+    for lane in reversed(lanes[:-1]):
+        perm = perm[jnp.argsort(lane[perm], stable=True)]
+    sm = mask[perm]
+    first = jnp.arange(n) == 0
+    diff = jnp.zeros(n, dtype=bool)
+    for k in keys:
+        ks = k[perm]
+        diff = diff | jnp.concatenate([jnp.zeros(1, bool), ks[1:] != ks[:-1]])
+    boundary = sm & (first | diff)
+    seg = jnp.clip(jnp.cumsum(boundary) - 1, 0, None)
+    import jax
+
+    cnt = jax.ops.segment_sum(sm.astype(jnp.int64), seg, num_segments=cap)
+    out_keys = []
+    pos = jnp.arange(n)
+    first_pos = jnp.clip(jax.ops.segment_min(jnp.where(sm, pos, n), seg, num_segments=cap), 0, n - 1)
+    for k in keys:
+        out_keys.append(k[perm][first_pos])
+    out_sums = []
+    for v in vals:
+        vs = v[perm]
+        out_sums.append(jax.ops.segment_sum(jnp.where(sm, vs, 0), seg, num_segments=cap))
+    return out_keys, out_sums, cnt  # slot i valid iff cnt[i] > 0
+
+
+def build_dist_agg(mesh, spec: DistAggSpec, selection: Callable | None = None):
+    """→ jitted fn(*sharded_cols) executing the two-fragment MPP agg.
+
+    Input: one array per column, sharded along dp (global length =
+    ndev * local_n). Output (replicated): (keys..., sums..., count) arrays of
+    length ndev * group_cap; slots with count==0 are padding.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    shard_map = jax.shard_map
+
+    ndev = mesh.devices.size
+    cap = spec.group_cap
+
+    def step(*cols):
+        keys = list(cols[: spec.n_keys])
+        vals = [cols[i] for i in spec.sums]
+        mask = jnp.ones(cols[0].shape[0], dtype=bool)
+        if selection is not None:
+            mask = selection(*cols)
+
+        # fragment 1: local partial agg
+        pkeys, psums, pcnt = _segment_partial(jnp, keys, vals, mask, cap)
+
+        # hash exchange: route group slots to owner = hash(keys) % ndev
+        h = pkeys[0]
+        for k in pkeys[1:]:
+            h = h * jnp.int64(1000003) + k
+        owner = jnp.abs(h) % ndev
+        owner = jnp.where(pcnt > 0, owner, ndev - 1)  # park empty slots anywhere
+        # bucket: rank within destination, capacity cap per destination
+        order = jnp.argsort(owner, stable=True)
+        sorted_owner = owner[order]
+        rank = jnp.arange(cap) - jnp.searchsorted(sorted_owner, sorted_owner, side="left")
+
+        def bucketize(x, fill):
+            buf = jnp.full((ndev * cap,), fill, dtype=x.dtype)
+            idx = sorted_owner * cap + rank
+            return buf.at[idx].set(x[order])
+
+        bkeys = [bucketize(k, 0) for k in pkeys]
+        bsums = [bucketize(s, 0) for s in psums]
+        bcnt = bucketize(pcnt, 0)
+        # all_to_all: (ndev, cap, ...) split axis 0, concat received on axis 0
+        def exchange(buf):
+            return jax.lax.all_to_all(buf.reshape(ndev, cap), "dp", split_axis=0, concat_axis=0, tiled=False).reshape(
+                ndev * cap
+            )
+
+        rkeys = [exchange(k) for k in bkeys]
+        rsums = [exchange(s) for s in bsums]
+        rcnt = exchange(bcnt)
+
+        # fragment 2: merge received partials for the owned key range
+        rmask = rcnt > 0
+        mkeys, msums_and_cnt, _ = _segment_partial(jnp, rkeys, rsums + [rcnt], rmask, cap)
+        msums = msums_and_cnt[:-1]
+        mcnt = msums_and_cnt[-1]
+
+        # pass-through exchange to root (replicated result via all_gather)
+        gkeys = [jax.lax.all_gather(k, "dp").reshape(ndev * cap) for k in mkeys]
+        gsums = [jax.lax.all_gather(s, "dp").reshape(ndev * cap) for s in msums]
+        gcnt = jax.lax.all_gather(mcnt, "dp").reshape(ndev * cap)
+        total = jax.lax.psum(mask.sum(), "dp")  # scanned-row count (sanity/stats)
+        return (*gkeys, *gsums, gcnt, total)
+
+    def make(n_inputs):
+        return shard_map(
+            step,
+            mesh=mesh,
+            in_specs=tuple(P("dp") for _ in range(n_inputs)),
+            out_specs=(P(None),) * (spec.n_keys + len(spec.sums) + 1) + (P(),),
+            check_vma=False,
+        )
+
+    def run(*cols):
+        fn = make(len(cols))
+        return jax.jit(fn)(*cols)
+
+    return run
+
+
+def finalize_dist_agg(outs, n_keys: int, n_sums: int):
+    """Host-side trim: drop padding slots, return numpy arrays."""
+    cnt = np.asarray(outs[n_keys + n_sums])
+    live = cnt > 0
+    keys = [np.asarray(outs[i])[live] for i in range(n_keys)]
+    sums = [np.asarray(outs[n_keys + i])[live] for i in range(n_sums)]
+    return keys, sums, cnt[live], int(np.asarray(outs[-1]))

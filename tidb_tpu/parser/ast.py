@@ -1,0 +1,310 @@
+"""AST nodes (ref: pkg/parser/ast — trimmed to the supported surface)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+class Node:
+    pass
+
+
+# -- expressions ------------------------------------------------------------
+
+
+@dataclass
+class Literal(Node):
+    value: Any  # int | float | str | bytes | None | bool
+    # hints: "date"/"time"/"decimal" for typed literals (DATE '1994-01-01')
+    hint: str = ""
+
+
+@dataclass
+class ColumnName(Node):
+    name: str
+    table: str = ""
+    db: str = ""
+
+    def __str__(self):
+        parts = [p for p in (self.db, self.table, self.name) if p]
+        return ".".join(parts)
+
+
+@dataclass
+class BinaryOp(Node):
+    op: str  # or/xor/and/eq/ne/lt/le/gt/ge/plus/minus/mul/div/intdiv/mod
+    left: Node
+    right: Node
+
+
+@dataclass
+class UnaryOp(Node):
+    op: str  # not/unaryminus/unaryplus
+    operand: Node
+
+
+@dataclass
+class IsNull(Node):
+    operand: Node
+    negated: bool = False
+
+
+@dataclass
+class InList(Node):
+    operand: Node
+    items: list[Node]
+    negated: bool = False
+
+
+@dataclass
+class Between(Node):
+    operand: Node
+    low: Node
+    high: Node
+    negated: bool = False
+
+
+@dataclass
+class Like(Node):
+    operand: Node
+    pattern: Node
+    negated: bool = False
+
+
+@dataclass
+class FuncCall(Node):
+    name: str  # lowercased
+    args: list[Node] = field(default_factory=list)
+    distinct: bool = False
+    star: bool = False  # COUNT(*)
+
+
+@dataclass
+class CaseWhen(Node):
+    operand: Optional[Node]  # CASE x WHEN ... vs CASE WHEN ...
+    branches: list[tuple[Node, Node]] = field(default_factory=list)
+    else_value: Optional[Node] = None
+
+
+@dataclass
+class Cast(Node):
+    operand: Node
+    target: "TypeDef"
+
+
+@dataclass
+class Wildcard(Node):  # t.* or *
+    table: str = ""
+
+
+@dataclass
+class SubqueryExpr(Node):
+    select: "Select"
+    # modifier: "" (scalar) | "exists" | "in" | "any" | "all"
+    modifier: str = ""
+
+
+# -- type definitions (DDL) -------------------------------------------------
+
+
+@dataclass
+class TypeDef(Node):
+    name: str  # bigint/int/double/varchar/decimal/date/datetime/...
+    length: int = -1
+    scale: int = 0
+    unsigned: bool = False
+
+
+# -- statements -------------------------------------------------------------
+
+
+@dataclass
+class SelectItem(Node):
+    expr: Node
+    alias: str = ""
+
+
+@dataclass
+class TableRef(Node):
+    name: str
+    db: str = ""
+    alias: str = ""
+
+
+@dataclass
+class Join(Node):
+    left: Node  # TableRef | Join | SubquerySource
+    right: Node
+    kind: str = "inner"  # inner/left/right/cross
+    on: Optional[Node] = None
+
+
+@dataclass
+class SubquerySource(Node):
+    select: "Select"
+    alias: str = ""
+
+
+@dataclass
+class OrderItem(Node):
+    expr: Node
+    desc: bool = False
+
+
+@dataclass
+class Select(Node):
+    items: list[SelectItem]
+    from_: Optional[Node] = None  # TableRef | Join | SubquerySource
+    where: Optional[Node] = None
+    group_by: list[Node] = field(default_factory=list)
+    having: Optional[Node] = None
+    order_by: list[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: int = 0
+    distinct: bool = False
+
+
+@dataclass
+class Insert(Node):
+    table: TableRef
+    columns: list[str] = field(default_factory=list)
+    values: list[list[Node]] = field(default_factory=list)
+    select: Optional[Select] = None
+    replace: bool = False
+    ignore: bool = False
+    on_dup_update: list[tuple[str, Node]] = field(default_factory=list)
+
+
+@dataclass
+class Update(Node):
+    table: TableRef
+    assignments: list[tuple[ColumnName, Node]] = field(default_factory=list)
+    where: Optional[Node] = None
+    order_by: list[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+
+
+@dataclass
+class Delete(Node):
+    table: TableRef
+    where: Optional[Node] = None
+    order_by: list[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+
+
+@dataclass
+class ColumnDef(Node):
+    name: str
+    type: TypeDef
+    not_null: bool = False
+    default: Optional[Node] = None
+    primary_key: bool = False
+    unique: bool = False
+    auto_increment: bool = False
+
+
+@dataclass
+class IndexDef(Node):
+    name: str
+    columns: list[str]
+    unique: bool = False
+    primary: bool = False
+
+
+@dataclass
+class CreateTable(Node):
+    table: TableRef
+    columns: list[ColumnDef] = field(default_factory=list)
+    indexes: list[IndexDef] = field(default_factory=list)
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTable(Node):
+    tables: list[TableRef]
+    if_exists: bool = False
+
+
+@dataclass
+class TruncateTable(Node):
+    table: TableRef
+
+
+@dataclass
+class AlterTable(Node):
+    table: TableRef
+    # one action per statement (reference supports lists; keep one)
+    action: str = ""  # add_column/drop_column/add_index/drop_index/rename
+    column: Optional[ColumnDef] = None
+    index: Optional[IndexDef] = None
+    name: str = ""  # drop target or rename target
+
+
+@dataclass
+class CreateIndex(Node):
+    index: IndexDef
+    table: TableRef
+
+
+@dataclass
+class DropIndex(Node):
+    name: str
+    table: TableRef
+
+
+@dataclass
+class CreateDatabase(Node):
+    name: str
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropDatabase(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class UseDatabase(Node):
+    name: str
+
+
+@dataclass
+class Explain(Node):
+    stmt: Node
+    analyze: bool = False
+
+
+@dataclass
+class SetVariable(Node):
+    name: str
+    value: Node
+    scope: str = "session"  # session | global
+
+
+@dataclass
+class Show(Node):
+    kind: str  # tables/databases/create_table/variables/columns
+    target: str = ""
+    like: Optional[str] = None
+
+
+@dataclass
+class Begin(Node):
+    pass
+
+
+@dataclass
+class Commit(Node):
+    pass
+
+
+@dataclass
+class Rollback(Node):
+    pass
+
+
+@dataclass
+class AnalyzeTable(Node):
+    tables: list[TableRef] = field(default_factory=list)

@@ -1,0 +1,845 @@
+"""Recursive-descent SQL parser (ref: pkg/parser/parser.y, hand-rolled).
+
+Precedence (low→high), mirroring MySQL:
+OR/|| → XOR → AND/&& → NOT → comparison (=, <>, <, <=, >, >=, IS, IN,
+BETWEEN, LIKE) → | → & → << >> → + - → * / DIV MOD % → unary -+!~ → primary.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tidb_tpu.parser import ast
+from tidb_tpu.parser.lexer import Token, tokenize
+
+
+class ParseError(Exception):
+    def __init__(self, msg: str, tok: Token):
+        super().__init__(f"{msg} near {tok.value!r} (offset {tok.pos})")
+        self.tok = tok
+
+
+RESERVED = frozenset(
+    """SELECT INSERT UPDATE DELETE REPLACE FROM WHERE GROUP HAVING ORDER LIMIT
+    OFFSET BY AND OR XOR NOT AS ON JOIN LEFT RIGHT INNER CROSS OUTER UNION SET
+    INTO VALUES CREATE DROP ALTER TABLE INDEX DATABASE USE SHOW EXPLAIN BETWEEN
+    LIKE IN IS NULL CASE WHEN THEN ELSE END CAST DISTINCT ASC DESC PRIMARY KEY
+    UNIQUE DEFAULT EXISTS COMMIT ROLLBACK BEGIN TRUNCATE ANALYZE""".split()
+)
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.toks = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers ------------------------------------------------------
+    def peek(self, ahead: int = 0) -> Token:
+        return self.toks[min(self.i + ahead, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind != "eof":
+            self.i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == "ident" and t.value.upper() in kws
+
+    def eat_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.eat_kw(kw):
+            raise ParseError(f"expected {kw}", self.peek())
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind == "op" and t.value in ops
+
+    def eat_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.eat_op(op):
+            raise ParseError(f"expected {op!r}", self.peek())
+
+    def ident(self) -> str:
+        t = self.peek()
+        if t.kind in ("ident", "qident"):
+            self.next()
+            return t.value
+        raise ParseError("expected identifier", t)
+
+    # -- entry --------------------------------------------------------------
+    def parse_statement(self) -> ast.Node:
+        t = self.peek()
+        if t.kind != "ident":
+            raise ParseError("expected statement", t)
+        kw = t.value.upper()
+        fn = {
+            "SELECT": self.parse_select,
+            "INSERT": self.parse_insert,
+            "REPLACE": self.parse_insert,
+            "UPDATE": self.parse_update,
+            "DELETE": self.parse_delete,
+            "CREATE": self.parse_create,
+            "DROP": self.parse_drop,
+            "ALTER": self.parse_alter,
+            "TRUNCATE": self.parse_truncate,
+            "EXPLAIN": self.parse_explain,
+            "DESC": self.parse_explain,
+            "SET": self.parse_set,
+            "SHOW": self.parse_show,
+            "USE": self.parse_use,
+            "BEGIN": self.parse_begin,
+            "START": self.parse_begin,
+            "COMMIT": lambda: (self.next(), ast.Commit())[1],
+            "ROLLBACK": lambda: (self.next(), ast.Rollback())[1],
+            "ANALYZE": self.parse_analyze,
+        }.get(kw)
+        if fn is None:
+            raise ParseError("unsupported statement", t)
+        return fn()
+
+    # -- SELECT --------------------------------------------------------------
+    def parse_select(self) -> ast.Select:
+        self.expect_kw("SELECT")
+        distinct = self.eat_kw("DISTINCT")
+        self.eat_kw("ALL")
+        items = [self.parse_select_item()]
+        while self.eat_op(","):
+            items.append(self.parse_select_item())
+        sel = ast.Select(items=items, distinct=distinct)
+        if self.eat_kw("FROM"):
+            sel.from_ = self.parse_table_refs()
+        if self.eat_kw("WHERE"):
+            sel.where = self.parse_expr()
+        if self.at_kw("GROUP"):
+            self.next()
+            self.expect_kw("BY")
+            sel.group_by.append(self.parse_expr())
+            while self.eat_op(","):
+                sel.group_by.append(self.parse_expr())
+        if self.eat_kw("HAVING"):
+            sel.having = self.parse_expr()
+        if self.at_kw("ORDER"):
+            self.next()
+            self.expect_kw("BY")
+            sel.order_by = self.parse_order_items()
+        if self.eat_kw("LIMIT"):
+            a = int(self.next().value)
+            if self.eat_op(","):
+                sel.offset = a
+                sel.limit = int(self.next().value)
+            else:
+                sel.limit = a
+                if self.eat_kw("OFFSET"):
+                    sel.offset = int(self.next().value)
+        return sel
+
+    def parse_select_item(self) -> ast.SelectItem:
+        if self.at_op("*"):
+            self.next()
+            return ast.SelectItem(ast.Wildcard())
+        # t.* lookahead
+        if self.peek().kind in ("ident", "qident") and self.peek(1).kind == "op" and self.peek(1).value == "." and self.peek(2).value == "*":
+            tbl = self.ident()
+            self.next()
+            self.next()
+            return ast.SelectItem(ast.Wildcard(table=tbl))
+        e = self.parse_expr()
+        alias = ""
+        if self.eat_kw("AS"):
+            alias = self.ident()
+        elif self.peek().kind in ("ident", "qident") and not self.at_kw(
+            "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "UNION", "INTO", "JOIN", "ON",
+            "LEFT", "RIGHT", "INNER", "CROSS", "AS", "SET",
+        ):
+            alias = self.ident()
+        return ast.SelectItem(e, alias)
+
+    def parse_order_items(self) -> list[ast.OrderItem]:
+        out = [self._order_item()]
+        while self.eat_op(","):
+            out.append(self._order_item())
+        return out
+
+    def _order_item(self) -> ast.OrderItem:
+        e = self.parse_expr()
+        desc = False
+        if self.eat_kw("DESC"):
+            desc = True
+        else:
+            self.eat_kw("ASC")
+        return ast.OrderItem(e, desc)
+
+    def parse_table_refs(self) -> ast.Node:
+        left = self.parse_table_factor()
+        while True:
+            if self.eat_op(","):
+                right = self.parse_table_factor()
+                left = ast.Join(left, right, kind="cross")
+            elif self.at_kw("JOIN", "INNER", "LEFT", "RIGHT", "CROSS"):
+                kind = "inner"
+                if self.eat_kw("LEFT"):
+                    kind = "left"
+                    self.eat_kw("OUTER")
+                elif self.eat_kw("RIGHT"):
+                    kind = "right"
+                    self.eat_kw("OUTER")
+                elif self.eat_kw("CROSS"):
+                    kind = "cross"
+                else:
+                    self.eat_kw("INNER")
+                self.expect_kw("JOIN")
+                right = self.parse_table_factor()
+                on = None
+                if self.eat_kw("ON"):
+                    on = self.parse_expr()
+                left = ast.Join(left, right, kind=kind, on=on)
+            else:
+                return left
+
+    def parse_table_factor(self) -> ast.Node:
+        if self.at_op("("):
+            # subquery or parenthesized join
+            if self.peek(1).kind == "ident" and self.peek(1).value.upper() == "SELECT":
+                self.next()
+                sel = self.parse_select()
+                self.expect_op(")")
+                alias = ""
+                self.eat_kw("AS")
+                if self.peek().kind in ("ident", "qident"):
+                    alias = self.ident()
+                return ast.SubquerySource(sel, alias)
+            self.next()
+            inner = self.parse_table_refs()
+            self.expect_op(")")
+            return inner
+        name = self.ident()
+        db = ""
+        if self.eat_op("."):
+            db, name = name, self.ident()
+        alias = ""
+        if self.eat_kw("AS"):
+            alias = self.ident()
+        elif self.peek().kind in ("ident", "qident") and not self.at_kw(
+            "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "JOIN", "ON", "LEFT", "RIGHT",
+            "INNER", "CROSS", "SET", "UNION",
+        ):
+            alias = self.ident()
+        return ast.TableRef(name, db=db, alias=alias)
+
+    # -- expressions ---------------------------------------------------------
+    def parse_expr(self) -> ast.Node:
+        return self._or_expr()
+
+    def _or_expr(self) -> ast.Node:
+        left = self._xor_expr()
+        while self.at_kw("OR") or self.at_op("||"):
+            self.next()
+            left = ast.BinaryOp("or", left, self._xor_expr())
+        return left
+
+    def _xor_expr(self) -> ast.Node:
+        left = self._and_expr()
+        while self.at_kw("XOR"):
+            self.next()
+            left = ast.BinaryOp("xor", left, self._and_expr())
+        return left
+
+    def _and_expr(self) -> ast.Node:
+        left = self._not_expr()
+        while self.at_kw("AND") or self.at_op("&&"):
+            self.next()
+            left = ast.BinaryOp("and", left, self._not_expr())
+        return left
+
+    def _not_expr(self) -> ast.Node:
+        if self.at_kw("NOT") or self.at_op("!"):
+            self.next()
+            return ast.UnaryOp("not", self._not_expr())
+        return self._comparison()
+
+    _CMP = {"=": "eq", "<=>": "nulleq", "<>": "ne", "!=": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
+
+    def _comparison(self) -> ast.Node:
+        left = self._bitor()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in self._CMP:
+                self.next()
+                left = ast.BinaryOp(self._CMP[t.value], left, self._bitor())
+                continue
+            if self.at_kw("IS"):
+                self.next()
+                neg = self.eat_kw("NOT")
+                self.expect_kw("NULL")
+                left = ast.IsNull(left, negated=neg)
+                continue
+            neg = False
+            save = self.i
+            if self.at_kw("NOT"):
+                self.next()
+                neg = True
+            if self.at_kw("IN"):
+                self.next()
+                self.expect_op("(")
+                if self.at_kw("SELECT"):
+                    sel = self.parse_select()
+                    self.expect_op(")")
+                    left = ast.InList(left, [ast.SubqueryExpr(sel, "in")], negated=neg)
+                else:
+                    items = [self.parse_expr()]
+                    while self.eat_op(","):
+                        items.append(self.parse_expr())
+                    self.expect_op(")")
+                    left = ast.InList(left, items, negated=neg)
+                continue
+            if self.at_kw("BETWEEN"):
+                self.next()
+                lo = self._bitor()
+                self.expect_kw("AND")
+                hi = self._bitor()
+                left = ast.Between(left, lo, hi, negated=neg)
+                continue
+            if self.at_kw("LIKE"):
+                self.next()
+                left = ast.Like(left, self._bitor(), negated=neg)
+                continue
+            if neg:
+                self.i = save
+            return left
+
+    def _bitor(self) -> ast.Node:
+        left = self._bitand()
+        while self.at_op("|"):
+            self.next()
+            left = ast.BinaryOp("bitor", left, self._bitand())
+        return left
+
+    def _bitand(self) -> ast.Node:
+        left = self._shift()
+        while self.at_op("&"):
+            self.next()
+            left = ast.BinaryOp("bitand", left, self._shift())
+        return left
+
+    def _shift(self) -> ast.Node:
+        left = self._additive()
+        while self.at_op("<<", ">>"):
+            op = "shl" if self.next().value == "<<" else "shr"
+            left = ast.BinaryOp(op, left, self._additive())
+        return left
+
+    def _additive(self) -> ast.Node:
+        left = self._multiplicative()
+        while self.at_op("+", "-"):
+            op = "plus" if self.next().value == "+" else "minus"
+            left = ast.BinaryOp(op, left, self._multiplicative())
+        return left
+
+    def _multiplicative(self) -> ast.Node:
+        left = self._unary()
+        while True:
+            if self.at_op("*"):
+                self.next()
+                left = ast.BinaryOp("mul", left, self._unary())
+            elif self.at_op("/"):
+                self.next()
+                left = ast.BinaryOp("div", left, self._unary())
+            elif self.at_op("%") or self.at_kw("MOD"):
+                self.next()
+                left = ast.BinaryOp("mod", left, self._unary())
+            elif self.at_kw("DIV"):
+                self.next()
+                left = ast.BinaryOp("intdiv", left, self._unary())
+            else:
+                return left
+
+    def _unary(self) -> ast.Node:
+        if self.at_op("-"):
+            self.next()
+            return ast.UnaryOp("unaryminus", self._unary())
+        if self.at_op("+"):
+            self.next()
+            return self._unary()
+        if self.at_op("~"):
+            self.next()
+            return ast.UnaryOp("bitneg", self._unary())
+        return self._primary()
+
+    def _primary(self) -> ast.Node:
+        t = self.peek()
+        if t.kind == "int":
+            self.next()
+            return ast.Literal(int(t.value))
+        if t.kind == "float":
+            self.next()
+            return ast.Literal(t.value, hint="decimal")
+        if t.kind == "str":
+            self.next()
+            return ast.Literal(t.value)
+        if self.at_op("("):
+            self.next()
+            if self.at_kw("SELECT"):
+                sel = self.parse_select()
+                self.expect_op(")")
+                return ast.SubqueryExpr(sel)
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "qident":
+            return self._column_or_call()
+        if t.kind != "ident":
+            raise ParseError("expected expression", t)
+        kw = t.value.upper()
+        if kw == "NULL":
+            self.next()
+            return ast.Literal(None)
+        if kw == "TRUE":
+            self.next()
+            return ast.Literal(True)
+        if kw == "FALSE":
+            self.next()
+            return ast.Literal(False)
+        if kw in ("DATE", "TIMESTAMP", "TIME") and self.peek(1).kind == "str":
+            self.next()
+            lit = self.next()
+            return ast.Literal(lit.value, hint=kw.lower())
+        if kw == "CASE":
+            return self._case()
+        if kw == "CAST":
+            self.next()
+            self.expect_op("(")
+            e = self.parse_expr()
+            self.expect_kw("AS")
+            td = self.parse_typedef()
+            self.expect_op(")")
+            return ast.Cast(e, td)
+        if kw == "EXISTS" and self.peek(1).value == "(":
+            self.next()
+            self.next()
+            sel = self.parse_select()
+            self.expect_op(")")
+            return ast.SubqueryExpr(sel, "exists")
+        if kw == "INTERVAL":
+            # INTERVAL n DAY — folded into date arithmetic by the planner
+            self.next()
+            n = self.parse_expr()
+            unit = self.ident().lower()
+            return ast.FuncCall("interval", [n, ast.Literal(unit)])
+        return self._column_or_call()
+
+    def _column_or_call(self) -> ast.Node:
+        t = self.peek()
+        if t.kind == "ident" and t.value.upper() in RESERVED:
+            raise ParseError("expected expression", t)
+        name = self.ident()
+        if self.at_op("("):
+            self.next()
+            fc = ast.FuncCall(name.lower())
+            if self.at_op("*"):
+                self.next()
+                fc.star = True
+            elif not self.at_op(")"):
+                fc.distinct = self.eat_kw("DISTINCT")
+                fc.args.append(self.parse_expr())
+                while self.eat_op(","):
+                    fc.args.append(self.parse_expr())
+            self.expect_op(")")
+            return fc
+        table = db = ""
+        if self.eat_op("."):
+            table, name = name, self.ident()
+            if self.eat_op("."):
+                db, table, name = table, name, self.ident()
+        return ast.ColumnName(name, table=table, db=db)
+
+    def _case(self) -> ast.CaseWhen:
+        self.expect_kw("CASE")
+        operand = None
+        if not self.at_kw("WHEN"):
+            operand = self.parse_expr()
+        branches = []
+        while self.eat_kw("WHEN"):
+            cond = self.parse_expr()
+            self.expect_kw("THEN")
+            branches.append((cond, self.parse_expr()))
+        else_v = self.parse_expr() if self.eat_kw("ELSE") else None
+        self.expect_kw("END")
+        return ast.CaseWhen(operand, branches, else_v)
+
+    # -- DML ------------------------------------------------------------------
+    def parse_insert(self) -> ast.Insert:
+        replace = self.eat_kw("REPLACE")
+        if not replace:
+            self.expect_kw("INSERT")
+        ignore = self.eat_kw("IGNORE")
+        self.eat_kw("INTO")
+        tbl = self._table_ref_simple()
+        ins = ast.Insert(tbl, replace=replace, ignore=ignore)
+        if self.at_op("("):
+            self.next()
+            ins.columns.append(self.ident())
+            while self.eat_op(","):
+                ins.columns.append(self.ident())
+            self.expect_op(")")
+        if self.at_kw("VALUES", "VALUE"):
+            self.next()
+            while True:
+                self.expect_op("(")
+                row = [] if self.at_op(")") else [self.parse_expr()]
+                while self.eat_op(","):
+                    row.append(self.parse_expr())
+                self.expect_op(")")
+                ins.values.append(row)
+                if not self.eat_op(","):
+                    break
+        elif self.at_kw("SELECT"):
+            ins.select = self.parse_select()
+        if self.at_kw("ON"):
+            self.next()
+            self.expect_kw("DUPLICATE")
+            self.expect_kw("KEY")
+            self.expect_kw("UPDATE")
+            while True:
+                cname = self.ident()
+                self.expect_op("=")
+                ins.on_dup_update.append((cname, self.parse_expr()))
+                if not self.eat_op(","):
+                    break
+        return ins
+
+    def parse_update(self) -> ast.Update:
+        self.expect_kw("UPDATE")
+        tbl = self._table_ref_simple(allow_alias=True)
+        self.expect_kw("SET")
+        upd = ast.Update(tbl)
+        while True:
+            colname = self._column_or_call()
+            if not isinstance(colname, ast.ColumnName):
+                raise ParseError("expected column in SET", self.peek())
+            self.expect_op("=")
+            upd.assignments.append((colname, self.parse_expr()))
+            if not self.eat_op(","):
+                break
+        if self.eat_kw("WHERE"):
+            upd.where = self.parse_expr()
+        if self.at_kw("ORDER"):
+            self.next()
+            self.expect_kw("BY")
+            upd.order_by = self.parse_order_items()
+        if self.eat_kw("LIMIT"):
+            upd.limit = int(self.next().value)
+        return upd
+
+    def parse_delete(self) -> ast.Delete:
+        self.expect_kw("DELETE")
+        self.expect_kw("FROM")
+        tbl = self._table_ref_simple(allow_alias=True)
+        d = ast.Delete(tbl)
+        if self.eat_kw("WHERE"):
+            d.where = self.parse_expr()
+        if self.at_kw("ORDER"):
+            self.next()
+            self.expect_kw("BY")
+            d.order_by = self.parse_order_items()
+        if self.eat_kw("LIMIT"):
+            d.limit = int(self.next().value)
+        return d
+
+    def _table_ref_simple(self, allow_alias: bool = False) -> ast.TableRef:
+        name = self.ident()
+        db = ""
+        if self.eat_op("."):
+            db, name = name, self.ident()
+        alias = ""
+        if allow_alias:
+            if self.eat_kw("AS"):
+                alias = self.ident()
+            elif self.peek().kind in ("ident", "qident") and not self.at_kw("SET", "WHERE", "ORDER", "LIMIT"):
+                alias = self.ident()
+        return ast.TableRef(name, db=db, alias=alias)
+
+    # -- DDL ------------------------------------------------------------------
+    def parse_typedef(self) -> ast.TypeDef:
+        name = self.ident().lower()
+        if name == "double" and self.at_kw("PRECISION"):
+            self.next()
+        td = ast.TypeDef(name)
+        if self.at_op("("):
+            self.next()
+            td.length = int(self.next().value)
+            if self.eat_op(","):
+                td.scale = int(self.next().value)
+            self.expect_op(")")
+        if self.eat_kw("UNSIGNED"):
+            td.unsigned = True
+        self.eat_kw("SIGNED")
+        # charset/collate noise
+        if self.eat_kw("CHARACTER"):
+            self.expect_kw("SET")
+            self.ident()
+        if self.eat_kw("COLLATE"):
+            self.ident()
+        return td
+
+    def parse_create(self) -> ast.Node:
+        self.expect_kw("CREATE")
+        if self.at_kw("DATABASE", "SCHEMA"):
+            self.next()
+            ine = self._if_not_exists()
+            return ast.CreateDatabase(self.ident(), if_not_exists=ine)
+        if self.at_kw("UNIQUE", "INDEX"):
+            unique = self.eat_kw("UNIQUE")
+            self.expect_kw("INDEX")
+            iname = self.ident()
+            self.expect_kw("ON")
+            tbl = self._table_ref_simple()
+            self.expect_op("(")
+            cols = [self.ident()]
+            while self.eat_op(","):
+                cols.append(self.ident())
+            self.expect_op(")")
+            return ast.CreateIndex(ast.IndexDef(iname, cols, unique=unique), tbl)
+        self.expect_kw("TABLE")
+        ine = self._if_not_exists()
+        tbl = self._table_ref_simple()
+        ct = ast.CreateTable(tbl, if_not_exists=ine)
+        self.expect_op("(")
+        while True:
+            if self.at_kw("PRIMARY"):
+                self.next()
+                self.expect_kw("KEY")
+                self.expect_op("(")
+                cols = [self.ident()]
+                while self.eat_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+                ct.indexes.append(ast.IndexDef("primary", cols, unique=True, primary=True))
+            elif self.at_kw("UNIQUE", "INDEX", "KEY"):
+                unique = self.eat_kw("UNIQUE")
+                if not self.eat_kw("INDEX"):
+                    self.eat_kw("KEY")
+                iname = self.ident() if self.peek().kind in ("ident", "qident") and not self.at_op("(") else ""
+                self.expect_op("(")
+                cols = [self.ident()]
+                while self.eat_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+                ct.indexes.append(ast.IndexDef(iname or f"idx_{len(ct.indexes)}", cols, unique=unique))
+            else:
+                cname = self.ident()
+                td = self.parse_typedef()
+                cd = ast.ColumnDef(cname, td)
+                while True:
+                    if self.eat_kw("NOT"):
+                        self.expect_kw("NULL")
+                        cd.not_null = True
+                    elif self.eat_kw("NULL"):
+                        pass
+                    elif self.eat_kw("DEFAULT"):
+                        cd.default = self._primary() if not self.at_op("-") else self.parse_expr()
+                    elif self.at_kw("PRIMARY"):
+                        self.next()
+                        self.expect_kw("KEY")
+                        cd.primary_key = True
+                    elif self.eat_kw("UNIQUE"):
+                        self.eat_kw("KEY")
+                        cd.unique = True
+                    elif self.eat_kw("AUTO_INCREMENT"):
+                        cd.auto_increment = True
+                    elif self.eat_kw("COMMENT"):
+                        self.next()
+                    else:
+                        break
+                ct.columns.append(cd)
+            if not self.eat_op(","):
+                break
+        self.expect_op(")")
+        # table options: swallow ident=value pairs
+        while self.peek().kind == "ident" and not self.at_op(";"):
+            self.next()
+            if self.eat_op("="):
+                self.next()
+        return ct
+
+    def _if_not_exists(self) -> bool:
+        if self.at_kw("IF"):
+            self.next()
+            self.expect_kw("NOT")
+            self.expect_kw("EXISTS")
+            return True
+        return False
+
+    def parse_drop(self) -> ast.Node:
+        self.expect_kw("DROP")
+        if self.at_kw("DATABASE", "SCHEMA"):
+            self.next()
+            ie = self._if_exists()
+            return ast.DropDatabase(self.ident(), if_exists=ie)
+        if self.at_kw("INDEX"):
+            self.next()
+            name = self.ident()
+            self.expect_kw("ON")
+            return ast.DropIndex(name, self._table_ref_simple())
+        self.expect_kw("TABLE")
+        ie = self._if_exists()
+        tables = [self._table_ref_simple()]
+        while self.eat_op(","):
+            tables.append(self._table_ref_simple())
+        return ast.DropTable(tables, if_exists=ie)
+
+    def _if_exists(self) -> bool:
+        if self.at_kw("IF"):
+            self.next()
+            self.expect_kw("EXISTS")
+            return True
+        return False
+
+    def parse_alter(self) -> ast.AlterTable:
+        self.expect_kw("ALTER")
+        self.expect_kw("TABLE")
+        tbl = self._table_ref_simple()
+        at = ast.AlterTable(tbl)
+        if self.eat_kw("ADD"):
+            if self.at_kw("INDEX", "KEY", "UNIQUE"):
+                unique = self.eat_kw("UNIQUE")
+                if not self.eat_kw("INDEX"):
+                    self.eat_kw("KEY")
+                iname = self.ident()
+                self.expect_op("(")
+                cols = [self.ident()]
+                while self.eat_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+                at.action, at.index = "add_index", ast.IndexDef(iname, cols, unique=unique)
+            else:
+                self.eat_kw("COLUMN")
+                cname = self.ident()
+                td = self.parse_typedef()
+                cd = ast.ColumnDef(cname, td)
+                if self.eat_kw("NOT"):
+                    self.expect_kw("NULL")
+                    cd.not_null = True
+                if self.eat_kw("DEFAULT"):
+                    cd.default = self.parse_expr()
+                at.action, at.column = "add_column", cd
+        elif self.eat_kw("DROP"):
+            if self.at_kw("INDEX", "KEY"):
+                self.next()
+                at.action, at.name = "drop_index", self.ident()
+            else:
+                self.eat_kw("COLUMN")
+                at.action, at.name = "drop_column", self.ident()
+        elif self.eat_kw("RENAME"):
+            self.eat_kw("TO")
+            at.action, at.name = "rename", self.ident()
+        else:
+            raise ParseError("unsupported ALTER action", self.peek())
+        return at
+
+    def parse_truncate(self) -> ast.TruncateTable:
+        self.expect_kw("TRUNCATE")
+        self.eat_kw("TABLE")
+        return ast.TruncateTable(self._table_ref_simple())
+
+    # -- misc -----------------------------------------------------------------
+    def parse_explain(self) -> ast.Explain:
+        self.next()  # EXPLAIN/DESC
+        analyze = self.eat_kw("ANALYZE")
+        return ast.Explain(self.parse_statement(), analyze=analyze)
+
+    def parse_set(self) -> ast.SetVariable:
+        self.expect_kw("SET")
+        scope = "session"
+        if self.eat_kw("GLOBAL"):
+            scope = "global"
+        elif self.eat_kw("SESSION"):
+            pass
+        if self.at_op("@"):
+            self.next()
+            if self.at_op("@"):
+                self.next()
+                # @@global.x / @@session.x
+                name = self.ident()
+                if name.lower() in ("global", "session") and self.eat_op("."):
+                    scope = name.lower()
+                    name = self.ident()
+            else:
+                name = "@" + self.ident()
+        else:
+            name = self.ident()
+        if not self.eat_op("="):
+            self.expect_op(":=")
+        val = self.parse_expr()
+        return ast.SetVariable(name.lower(), val, scope=scope)
+
+    def parse_show(self) -> ast.Show:
+        self.expect_kw("SHOW")
+        if self.eat_kw("TABLES"):
+            like = None
+            if self.eat_kw("LIKE"):
+                like = self.next().value
+            return ast.Show("tables", like=like)
+        if self.eat_kw("DATABASES"):
+            return ast.Show("databases")
+        if self.eat_kw("VARIABLES"):
+            like = None
+            if self.eat_kw("LIKE"):
+                like = self.next().value
+            return ast.Show("variables", like=like)
+        if self.eat_kw("CREATE"):
+            self.expect_kw("TABLE")
+            return ast.Show("create_table", target=self.ident())
+        if self.eat_kw("COLUMNS") or self.eat_kw("FIELDS"):
+            self.expect_kw("FROM")
+            return ast.Show("columns", target=self.ident())
+        raise ParseError("unsupported SHOW", self.peek())
+
+    def parse_use(self) -> ast.UseDatabase:
+        self.expect_kw("USE")
+        return ast.UseDatabase(self.ident())
+
+    def parse_begin(self) -> ast.Begin:
+        if self.eat_kw("START"):
+            self.expect_kw("TRANSACTION")
+        else:
+            self.expect_kw("BEGIN")
+        return ast.Begin()
+
+    def parse_analyze(self) -> ast.AnalyzeTable:
+        self.expect_kw("ANALYZE")
+        self.expect_kw("TABLE")
+        tables = [self._table_ref_simple()]
+        while self.eat_op(","):
+            tables.append(self._table_ref_simple())
+        return ast.AnalyzeTable(tables)
+
+
+def parse(sql: str) -> ast.Node:
+    p = Parser(sql)
+    stmt = p.parse_statement()
+    p.eat_op(";")
+    if p.peek().kind != "eof":
+        raise ParseError("trailing input", p.peek())
+    return stmt
+
+
+def parse_many(sql: str) -> list[ast.Node]:
+    p = Parser(sql)
+    out = []
+    while p.peek().kind != "eof":
+        out.append(p.parse_statement())
+        while p.eat_op(";"):
+            pass
+    return out

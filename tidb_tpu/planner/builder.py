@@ -1,0 +1,702 @@
+"""AST → logical plan with name resolution, type coercion and constant
+folding (ref: pkg/planner/core/logical_plan_builder.go + expression
+rewriter)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from decimal import Decimal
+from typing import Callable, Optional
+
+import numpy as np
+
+from tidb_tpu.catalog import Catalog
+from tidb_tpu.expression.expr import (
+    AggDesc,
+    AGG_FUNCS,
+    ColumnRef,
+    Constant,
+    EvalBatch,
+    Expression,
+    ScalarFunc,
+    eval_to_column,
+    func,
+)
+from tidb_tpu.parser import ast
+from tidb_tpu.planner.plans import (
+    LogicalAggregation,
+    LogicalDistinct,
+    LogicalDual,
+    LogicalJoin,
+    LogicalLimit,
+    LogicalPlan,
+    LogicalProjection,
+    LogicalScan,
+    LogicalSelection,
+    LogicalSort,
+    OutCol,
+    PlanError,
+)
+from tidb_tpu.types import FieldType, TypeKind
+from tidb_tpu.types.field_type import bigint_type, bool_type, decimal_type, double_type, string_type
+from tidb_tpu.types.datum import date_to_days, datetime_to_micros
+
+# parser func name → registry sig aliases
+_FN_ALIAS = {
+    "power": "pow",
+    "log": "ln",
+    "char_length": "length",
+    "character_length": "length",
+    "substr": "substring",
+    "mid": "substring",
+    "day": "dayofmonth",
+    "lcase": "lower",
+    "ucase": "upper",
+    "ceiling": "ceil",
+}
+
+
+@dataclass
+class BuildCtx:
+    """Name-resolution scope."""
+
+    schema: list  # list[OutCol]
+    # aggregation context: when set, agg funcalls resolve into it
+    agg_list: Optional[list[AggDesc]] = None
+    agg_base: Optional[list] = None  # schema under the agg (for agg args)
+    # alias → expression over current schema (SELECT aliases in HAVING/ORDER)
+    aliases: Optional[dict[str, Expression]] = None
+
+
+class Builder:
+    def __init__(self, catalog: Catalog, current_db: str, subquery_runner: Optional[Callable] = None):
+        self.catalog = catalog
+        self.db = current_db
+        self.subquery_runner = subquery_runner
+
+    # -- statements ---------------------------------------------------------
+    def build_select(self, sel: ast.Select) -> LogicalPlan:
+        if sel.from_ is None:
+            plan: LogicalPlan = LogicalDual()
+        else:
+            plan = self._build_from(sel.from_)
+
+        if sel.where is not None:
+            conds = self._split_conj(self.resolve(sel.where, BuildCtx(plan.schema)))
+            plan = LogicalSelection(conditions=conds, children=[plan])
+
+        # aggregation detection
+        has_agg = bool(sel.group_by) or any(
+            _contains_agg(it.expr) for it in sel.items
+        ) or (sel.having is not None and _contains_agg(sel.having))
+
+        aliases: dict[str, Expression] = {}
+        hidden = 0
+        if has_agg:
+            base_schema = plan.schema
+            aggs: list[AggDesc] = []
+            group_exprs = [self.resolve(g, BuildCtx(base_schema)) for g in sel.group_by]
+            agg_ctx = BuildCtx(schema=[], agg_list=aggs, agg_base=base_schema)
+
+            # first pass: group-key expressions resolve positionally
+            def agg_schema():
+                cols = []
+                for i, a in enumerate(aggs):
+                    cols.append(OutCol(f"agg#{i}", a.ftype))
+                for i, g in enumerate(group_exprs):
+                    name = sel.group_by[i].name if isinstance(sel.group_by[i], ast.ColumnName) else f"gb#{i}"
+                    src = _source_outcol(g, base_schema)
+                    cols.append(OutCol(name, g.ftype, table=src.table if src else "", slot=src.slot if src else -1))
+                return cols
+
+            proj_exprs: list[Expression] = []
+            names: list[str] = []
+            for it in sel.items:
+                if isinstance(it.expr, ast.Wildcard):
+                    raise PlanError("SELECT * with GROUP BY is not supported")
+                e = self._resolve_in_agg(it.expr, base_schema, aggs, group_exprs, sel.group_by)
+                proj_exprs.append(e)
+                nm = it.alias or _display_name(it.expr)
+                names.append(nm)
+                if it.alias:
+                    aliases[it.alias.lower()] = e
+            agg = LogicalAggregation(group_by=group_exprs, aggs=aggs, children=[plan])
+            plan = agg
+            having_conds: list[Expression] = []
+            if sel.having is not None:
+                h = self._resolve_in_agg(sel.having, base_schema, aggs, group_exprs, sel.group_by, aliases)
+                having_conds = self._split_conj(h)
+            # agg list is final now: patch deferred group-key refs everywhere
+            agg.schema = agg_schema()
+            proj_exprs = [_patch_group_refs(e, len(aggs)) for e in proj_exprs]
+            having_conds = [_patch_group_refs(e, len(aggs)) for e in having_conds]
+            for a in aliases:
+                aliases[a] = _patch_group_refs(aliases[a], len(aggs))
+            if having_conds:
+                plan = LogicalSelection(conditions=having_conds, children=[plan])
+            proj = LogicalProjection(exprs=proj_exprs, children=[plan])
+            proj.schema = []
+            for i in range(len(proj_exprs)):
+                src = _source_outcol(proj_exprs[i], plan.schema)
+                proj.schema.append(
+                    OutCol(
+                        names[i],
+                        proj_exprs[i].ftype,
+                        table=src.table if src else "",
+                        slot=src.slot if src else -1,
+                    )
+                )
+            plan = proj
+        else:
+            # plain projection
+            proj_exprs, names, srcs = [], [], []
+            for it in sel.items:
+                if isinstance(it.expr, ast.Wildcard):
+                    for i, oc in enumerate(plan.schema):
+                        if it.expr.table and oc.table.lower() != it.expr.table.lower():
+                            continue
+                        proj_exprs.append(ColumnRef(i, oc.ftype, oc.name))
+                        names.append(oc.name)
+                        srcs.append(oc)
+                    continue
+                e = self.resolve(it.expr, BuildCtx(plan.schema))
+                proj_exprs.append(e)
+                names.append(it.alias or _display_name(it.expr))
+                srcs.append(_source_outcol(e, plan.schema))
+                if it.alias:
+                    aliases[it.alias.lower()] = e
+            if not proj_exprs:
+                raise PlanError("empty select list")
+            proj = LogicalProjection(exprs=proj_exprs, children=[plan])
+            proj.schema = [
+                OutCol(
+                    names[i],
+                    proj_exprs[i].ftype,
+                    table=srcs[i].table if srcs[i] else "",
+                    slot=srcs[i].slot if srcs[i] else -1,
+                )
+                for i in range(len(proj_exprs))
+            ]
+            # ORDER BY may reference non-projected columns → hidden extras
+            if sel.order_by and sel.from_ is not None:
+                base = plan.schema
+                for oi in sel.order_by:
+                    if self._order_needs_hidden(oi.expr, proj.schema, aliases):
+                        e = self.resolve(oi.expr, BuildCtx(base))
+                        src = _source_outcol(e, base)
+                        # name the hidden column after its source so ORDER BY
+                        # resolution finds it (duplicates with visible items
+                        # are impossible — those wouldn't need a hidden col)
+                        hname = src.name if src else (oi.expr.name if isinstance(oi.expr, ast.ColumnName) else f"__hidden#{hidden}")
+                        proj.exprs.append(e)
+                        proj.schema.append(
+                            OutCol(
+                                hname,
+                                e.ftype,
+                                table=src.table if src else "",
+                                slot=src.slot if src else -1,
+                            )
+                        )
+                        hidden += 1
+            plan = proj
+
+        if sel.distinct:
+            plan = LogicalDistinct(children=[plan])
+
+        if sel.order_by:
+            by = []
+            for oi in sel.order_by:
+                e = self._resolve_order(oi.expr, plan.schema, aliases)
+                by.append((e, oi.desc))
+            plan = LogicalSort(by=by, children=[plan])
+
+        if sel.limit is not None:
+            plan = LogicalLimit(limit=sel.limit, offset=sel.offset, children=[plan])
+
+        if hidden:
+            # trim hidden sort columns with a final projection
+            vis = len(plan.schema) - hidden
+            tp = LogicalProjection(
+                exprs=[ColumnRef(i, plan.schema[i].ftype, plan.schema[i].name) for i in range(vis)],
+                children=[plan],
+            )
+            tp.schema = plan.schema[:vis]
+            plan = tp
+        return plan
+
+    # -- FROM ---------------------------------------------------------------
+    def _build_from(self, node: ast.Node) -> LogicalPlan:
+        if isinstance(node, ast.TableRef):
+            db = node.db or self.db
+            t = self.catalog.table(db, node.name)
+            alias = node.alias or node.name
+            scan = LogicalScan(db=db, table=t, alias=alias)
+            scan.schema = [
+                OutCol(c.name, c.ftype, table=alias, slot=c.offset) for c in t.columns
+            ]
+            return scan
+        if isinstance(node, ast.SubquerySource):
+            sub = self.build_select(node.select)
+            alias = node.alias or "subquery"
+            for oc in sub.schema:
+                oc.table = alias
+            return sub
+        if isinstance(node, ast.Join):
+            left = self._build_from(node.left)
+            right = self._build_from(node.right)
+            schema = [OutCol(c.name, c.ftype, c.table, c.slot) for c in left.schema] + [
+                OutCol(c.name, c.ftype, c.table, c.slot) for c in right.schema
+            ]
+            join = LogicalJoin(kind=node.kind, schema=schema, children=[left, right])
+            if node.on is not None:
+                conds = self._split_conj(self.resolve(node.on, BuildCtx(schema)))
+                nleft = len(left.schema)
+                for c in conds:
+                    pair = _as_equi_pair(c, nleft)
+                    if pair is not None:
+                        join.eq_conds.append(pair)
+                    else:
+                        join.other_conds.append(c)
+            return join
+        raise PlanError(f"unsupported FROM clause {type(node).__name__}")
+
+    # -- expression resolution ----------------------------------------------
+    def resolve(self, node: ast.Node, ctx: BuildCtx) -> Expression:
+        e = self._resolve(node, ctx)
+        return _fold(e)
+
+    def _resolve(self, node: ast.Node, ctx: BuildCtx) -> Expression:
+        if isinstance(node, ast.Literal):
+            return _literal(node)
+        if isinstance(node, ast.ColumnName):
+            return self._resolve_column(node, ctx)
+        if isinstance(node, ast.BinaryOp):
+            # date ± INTERVAL n unit (ref: MySQL date arithmetic)
+            if node.op in ("plus", "minus"):
+                for side, other in ((node.right, node.left), (node.left, node.right)):
+                    if isinstance(side, ast.FuncCall) and side.name == "interval":
+                        if side is node.left and node.op == "minus":
+                            raise PlanError("INTERVAL - date is invalid")
+                        n = self._resolve(side.args[0], ctx)
+                        unit = side.args[1].value
+                        base = self._resolve(other, ctx)
+                        if unit != "day":
+                            raise PlanError(f"unsupported INTERVAL unit {unit}")
+                        delta = n if node.op == "plus" else func("unaryminus", n)
+                        return func("date_add_days", base, delta)
+            left = self._resolve(node.left, ctx)
+            right = self._resolve(node.right, ctx)
+            return self._binary(node.op, left, right)
+        if isinstance(node, ast.UnaryOp):
+            if node.op == "not":
+                return func("not", self._resolve(node.operand, ctx))
+            if node.op == "unaryminus":
+                return func("unaryminus", self._resolve(node.operand, ctx))
+            raise PlanError(f"unsupported unary op {node.op}")
+        if isinstance(node, ast.IsNull):
+            e = func("isnull", self._resolve(node.operand, ctx))
+            return func("not", e) if node.negated else e
+        if isinstance(node, ast.InList):
+            if len(node.items) == 1 and isinstance(node.items[0], ast.SubqueryExpr):
+                vals = self._run_subquery(node.items[0].select, expect_cols=1)
+                items = [_const_like(v[0]) for v in vals]
+                if not items:
+                    return Constant(0 if not node.negated else 1, bool_type())
+            else:
+                items = [self._resolve(x, ctx) for x in node.items]
+            operand = self._resolve(node.operand, ctx)
+            items = [self._coerce_to(operand.ftype, it) for it in items]
+            e = func("in", operand, *items)
+            return func("not", e) if node.negated else e
+        if isinstance(node, ast.Between):
+            operand = self._resolve(node.operand, ctx)
+            lo = self._coerce_to(operand.ftype, self._resolve(node.low, ctx))
+            hi = self._coerce_to(operand.ftype, self._resolve(node.high, ctx))
+            e = func("and", self._binary("ge", operand, lo), self._binary("le", operand, hi))
+            return func("not", e) if node.negated else e
+        if isinstance(node, ast.Like):
+            e = func("like", self._resolve(node.operand, ctx), self._resolve(node.pattern, ctx))
+            return func("not", e) if node.negated else e
+        if isinstance(node, ast.FuncCall):
+            return self._func_call(node, ctx)
+        if isinstance(node, ast.CaseWhen):
+            args: list[Expression] = []
+            for cond, val in node.branches:
+                c = self._resolve(cond, ctx)
+                if node.operand is not None:
+                    c = self._binary("eq", self._resolve(node.operand, ctx), c)
+                args.append(c)
+                args.append(self._resolve(val, ctx))
+            if node.else_value is not None:
+                args.append(self._resolve(node.else_value, ctx))
+            return func("case_when", *args)
+        if isinstance(node, ast.Cast):
+            e = self._resolve(node.operand, ctx)
+            tname = node.target.name
+            if tname in ("signed", "int", "integer", "bigint", "unsigned"):
+                return func("cast_int", e)
+            if tname in ("double", "float", "real"):
+                return func("cast_float", e)
+            if tname in ("decimal", "numeric"):
+                ft = decimal_type(node.target.length if node.target.length > 0 else 10, node.target.scale)
+                return func("cast_decimal", e, ret=ft)
+            raise PlanError(f"unsupported CAST target {tname}")
+        if isinstance(node, ast.SubqueryExpr):
+            if node.modifier == "exists":
+                vals = self._run_subquery(node.select, limit=1)
+                return Constant(1 if vals else 0, bool_type())
+            vals = self._run_subquery(node.select, expect_cols=1, limit=2)
+            if len(vals) > 1:
+                raise PlanError("scalar subquery returned more than one row")
+            return _const_like(vals[0][0]) if vals else Constant(None, FieldType(TypeKind.NULLTYPE))
+        raise PlanError(f"unsupported expression {type(node).__name__}")
+
+    def _resolve_column(self, node: ast.ColumnName, ctx: BuildCtx) -> Expression:
+        name = node.name.lower()
+        tbl = node.table.lower()
+        matches = [
+            i
+            for i, oc in enumerate(ctx.schema)
+            if oc.name.lower() == name and (not tbl or oc.table.lower() == tbl)
+        ]
+        if not matches and ctx.aliases and not tbl and name in ctx.aliases:
+            return ctx.aliases[name]
+        if not matches:
+            raise PlanError(f"Unknown column '{node}'")
+        if len(matches) > 1:
+            raise PlanError(f"Column '{node}' is ambiguous")
+        oc = ctx.schema[matches[0]]
+        return ColumnRef(matches[0], oc.ftype, oc.name)
+
+    def _func_call(self, node: ast.FuncCall, ctx: BuildCtx) -> Expression:
+        name = _FN_ALIAS.get(node.name, node.name)
+        if name in AGG_FUNCS or (name == "count" and node.star):
+            # agg calls are intercepted by _resolve_in_agg's rewrite pass;
+            # reaching here means an agg in a pure scalar context
+            raise PlanError(f"aggregate {name}() used outside aggregation context")
+        if name == "interval":
+            raise PlanError("INTERVAL outside date arithmetic")
+        if name in ("now", "current_timestamp"):
+            import datetime
+
+            return Constant(datetime.datetime.now(), FieldType(TypeKind.DATETIME, nullable=False))
+        if name in ("curdate", "current_date"):
+            import datetime
+
+            return Constant(datetime.date.today(), FieldType(TypeKind.DATE, nullable=False))
+        if name == "nullif":
+            a = self._resolve(node.args[0], ctx)
+            b = self._resolve(node.args[1], ctx)
+            return func("case_when", self._binary("eq", a, b), Constant(None, FieldType(TypeKind.NULLTYPE)), a)
+        args = [self._resolve(a, ctx) for a in node.args]
+        try:
+            return func(name, *args)
+        except KeyError:
+            raise PlanError(f"unknown function {node.name}()")
+
+    def _binary(self, op: str, left: Expression, right: Expression) -> Expression:
+        if op in ("eq", "ne", "lt", "le", "gt", "ge"):
+            left, right = self._coerce_cmp(left, right)
+        return func(op, left, right)
+
+    def _coerce_cmp(self, a: Expression, b: Expression):
+        """Temporal-vs-string-constant coercion (MySQL implicit casts)."""
+        for x, y in ((a, b), (b, a)):
+            if x.ftype.is_temporal and isinstance(y, Constant) and y.ftype.kind == TypeKind.STRING:
+                conv = self._coerce_to(x.ftype, y)
+                if x is a:
+                    return a, conv
+                return conv, b
+        return a, b
+
+    def _coerce_to(self, ft: FieldType, e: Expression) -> Expression:
+        if not isinstance(e, Constant) or e.value is None:
+            return e
+        v = e.value
+        if ft.kind == TypeKind.DATE and isinstance(v, (str, bytes)):
+            s = v.decode() if isinstance(v, bytes) else v
+            return Constant(date_to_days(s), ft.not_null())
+        if ft.kind == TypeKind.DATETIME and isinstance(v, (str, bytes)):
+            s = v.decode() if isinstance(v, bytes) else v
+            try:
+                return Constant(datetime_to_micros(s), ft.not_null())
+            except ValueError:
+                return Constant(datetime_to_micros(s + " 00:00:00"), ft.not_null())
+        return e
+
+    # -- agg resolution -------------------------------------------------------
+    def _resolve_in_agg(self, node, base_schema, aggs, group_exprs, group_asts, aliases=None):
+        """Resolve an expression in SELECT/HAVING of an aggregated query:
+        agg calls → refs into the agg output; group-by exprs → group key refs;
+        bare columns → implicit first_row (MySQL non-strict)."""
+        agg_schema_len = lambda: len(aggs)  # noqa: E731
+
+        def walk(n):
+            # whole-expression matches a group-by item? (deferred index: agg
+            # count isn't final yet — ColumnRef(-1-gi) is patched afterwards)
+            for gi, gast in enumerate(group_asts):
+                if _ast_eq(n, gast):
+                    e = group_exprs[gi]
+                    return ColumnRef(-1 - gi, e.ftype, f"gb#{gi}")
+            if isinstance(n, ast.FuncCall):
+                name = _FN_ALIAS.get(n.name, n.name)
+                if name in AGG_FUNCS or n.star:
+                    if n.star:
+                        desc = AggDesc("count", None)
+                    else:
+                        arg = self.resolve(n.args[0], BuildCtx(base_schema))
+                        desc = AggDesc(name, arg, distinct=n.distinct)
+                    for i, existing in enumerate(aggs):
+                        if repr(existing) == repr(desc):
+                            return ColumnRef(i, existing.ftype, f"agg#{i}")
+                    aggs.append(desc)
+                    return ColumnRef(len(aggs) - 1, desc.ftype, f"agg#{len(aggs) - 1}")
+                return ast.FuncCall(n.name, [walk(a) for a in n.args], n.distinct, n.star)
+            if isinstance(n, ast.BinaryOp):
+                return ast.BinaryOp(n.op, walk(n.left), walk(n.right))
+            if isinstance(n, ast.UnaryOp):
+                return ast.UnaryOp(n.op, walk(n.operand))
+            if isinstance(n, ast.ColumnName):
+                # group key column? (matched above); SELECT alias (HAVING/
+                # ORDER BY)? else implicit first_row (MySQL non-strict)
+                if not n.table and aliases and n.name.lower() in aliases:
+                    return aliases[n.name.lower()]
+                arg = self.resolve(n, BuildCtx(base_schema))
+                desc = AggDesc("first_row", arg)
+                for i, existing in enumerate(aggs):
+                    if repr(existing) == repr(desc):
+                        return ColumnRef(i, existing.ftype, f"agg#{i}")
+                aggs.append(desc)
+                return ColumnRef(len(aggs) - 1, desc.ftype, f"agg#{len(aggs) - 1}")
+            if isinstance(n, (ast.Literal, Expression)):
+                return n
+            if isinstance(n, ast.CaseWhen):
+                return ast.CaseWhen(
+                    walk(n.operand) if n.operand else None,
+                    [(walk(c), walk(v)) for c, v in n.branches],
+                    walk(n.else_value) if n.else_value else None,
+                )
+            if isinstance(n, ast.IsNull):
+                return ast.IsNull(walk(n.operand), n.negated)
+            if isinstance(n, ast.InList):
+                return ast.InList(walk(n.operand), [walk(x) for x in n.items], n.negated)
+            if isinstance(n, ast.Between):
+                return ast.Between(walk(n.operand), walk(n.low), walk(n.high), n.negated)
+            if isinstance(n, ast.Cast):
+                return ast.Cast(walk(n.operand), n.target)
+            return n
+
+        rewritten = walk(node)
+        # now resolve the rewritten tree against the agg output schema;
+        # embedded Expression nodes pass through untouched
+        agg_out = []
+        for i, a in enumerate(aggs):
+            agg_out.append(OutCol(f"agg#{i}", a.ftype))
+        for gi, g in enumerate(group_exprs):
+            agg_out.append(OutCol(f"gb#{gi}", g.ftype))
+        # NOTE: group-key refs stay negative (deferred) — the caller patches
+        # them once the agg list stops growing (after all items + HAVING)
+        return self._resolve_mixed(rewritten, BuildCtx(agg_out, aliases=aliases))
+
+    def _resolve_mixed(self, node, ctx: BuildCtx) -> Expression:
+        if isinstance(node, Expression):
+            return node
+        if isinstance(node, ast.BinaryOp):
+            return self._binary(node.op, self._resolve_mixed(node.left, ctx), self._resolve_mixed(node.right, ctx))
+        if isinstance(node, ast.UnaryOp):
+            op = "not" if node.op == "not" else node.op
+            return func(op if op != "unaryplus" else "plus", self._resolve_mixed(node.operand, ctx))
+        if isinstance(node, ast.FuncCall):
+            name = _FN_ALIAS.get(node.name, node.name)
+            args = [self._resolve_mixed(a, ctx) for a in node.args]
+            return func(name, *args)
+        if isinstance(node, ast.CaseWhen):
+            args = []
+            for c, v in node.branches:
+                cc = self._resolve_mixed(c, ctx)
+                if node.operand is not None:
+                    cc = self._binary("eq", self._resolve_mixed(node.operand, ctx), cc)
+                args.append(cc)
+                args.append(self._resolve_mixed(v, ctx))
+            if node.else_value is not None:
+                args.append(self._resolve_mixed(node.else_value, ctx))
+            return func("case_when", *args)
+        if isinstance(node, ast.IsNull):
+            e = func("isnull", self._resolve_mixed(node.operand, ctx))
+            return func("not", e) if node.negated else e
+        if isinstance(node, ast.InList):
+            e = func("in", self._resolve_mixed(node.operand, ctx), *[self._resolve_mixed(x, ctx) for x in node.items])
+            return func("not", e) if node.negated else e
+        if isinstance(node, ast.Between):
+            operand = self._resolve_mixed(node.operand, ctx)
+            e = func(
+                "and",
+                self._binary("ge", operand, self._resolve_mixed(node.low, ctx)),
+                self._binary("le", operand, self._resolve_mixed(node.high, ctx)),
+            )
+            return func("not", e) if node.negated else e
+        if isinstance(node, ast.Cast):
+            return self._resolve(node, ctx)
+        return _fold(self._resolve(node, ctx))
+
+    def _order_needs_hidden(self, node, proj_schema, aliases) -> bool:
+        if isinstance(node, ast.Literal):
+            return False
+        if isinstance(node, ast.ColumnName):
+            name = node.name.lower()
+            if not node.table and aliases and name in aliases:
+                return False
+            for oc in proj_schema:
+                if oc.name.lower() == name and (not node.table or oc.table.lower() == node.table.lower()):
+                    return False
+            return True
+        return True  # complex order expr → compute as hidden column
+
+    def _resolve_order(self, node, schema, aliases) -> Expression:
+        if isinstance(node, ast.Literal) and isinstance(node.value, int):
+            idx = node.value - 1  # ORDER BY ordinal
+            if not (0 <= idx < len(schema)):
+                raise PlanError(f"ORDER BY position {node.value} out of range")
+            return ColumnRef(idx, schema[idx].ftype, schema[idx].name)
+        return self.resolve(node, BuildCtx(schema, aliases=aliases))
+
+    def _split_conj(self, e: Expression) -> list[Expression]:
+        if isinstance(e, ScalarFunc) and e.sig == "and":
+            return self._split_conj(e.args[0]) + self._split_conj(e.args[1])
+        return [e]
+
+    def _run_subquery(self, sel: ast.Select, expect_cols: Optional[int] = None, limit: Optional[int] = None):
+        if self.subquery_runner is None:
+            raise PlanError("subqueries not supported in this context")
+        rows = self.subquery_runner(sel)
+        if expect_cols is not None and rows and len(rows[0]) != expect_cols:
+            raise PlanError("Operand should contain 1 column(s)")
+        if limit is not None:
+            rows = rows[:limit]
+        return rows
+
+
+def _patch_group_refs(e: Expression, n_aggs: int) -> Expression:
+    """Rewrite deferred group-key refs (negative indices) now that the agg
+    lane count is final: ColumnRef(-1-gi) → ColumnRef(n_aggs+gi)."""
+    if isinstance(e, ColumnRef) and e.index < 0:
+        gi = -1 - e.index
+        return ColumnRef(n_aggs + gi, e.ftype, e.name)
+    if isinstance(e, ScalarFunc):
+        return ScalarFunc(e.sig, [_patch_group_refs(a, n_aggs) for a in e.args], e.ftype)
+    return e
+
+
+# -- helpers ----------------------------------------------------------------
+
+
+def _literal(node: ast.Literal) -> Constant:
+    v = node.value
+    if node.hint == "date":
+        return Constant(date_to_days(v), FieldType(TypeKind.DATE, nullable=False))
+    if node.hint in ("timestamp", "time"):
+        return Constant(datetime_to_micros(v), FieldType(TypeKind.DATETIME, nullable=False))
+    if node.hint == "decimal":
+        d = Decimal(v)
+        exp = d.as_tuple().exponent
+        scale = -exp if exp < 0 else 0
+        return Constant(d, decimal_type(max(len(d.as_tuple().digits), scale + 1), scale, nullable=False))
+    if v is None:
+        return Constant(None, FieldType(TypeKind.NULLTYPE))
+    if isinstance(v, bool):
+        return Constant(int(v), bool_type().not_null())
+    if isinstance(v, int):
+        return Constant(v, bigint_type(nullable=False))
+    if isinstance(v, float):
+        return Constant(v, double_type(nullable=False))
+    return Constant(v, string_type(nullable=False))
+
+
+def _const_like(v) -> Constant:
+    if v is None:
+        return Constant(None, FieldType(TypeKind.NULLTYPE))
+    if isinstance(v, bool):
+        return Constant(int(v), bool_type().not_null())
+    if isinstance(v, int):
+        return Constant(v, bigint_type(nullable=False))
+    if isinstance(v, float):
+        return Constant(v, double_type(nullable=False))
+    if isinstance(v, Decimal):
+        exp = -v.as_tuple().exponent
+        return Constant(v, decimal_type(38, max(exp, 0), nullable=False))
+    import datetime
+
+    if isinstance(v, datetime.datetime):
+        return Constant(datetime_to_micros(v), FieldType(TypeKind.DATETIME, nullable=False))
+    if isinstance(v, datetime.date):
+        return Constant(date_to_days(v), FieldType(TypeKind.DATE, nullable=False))
+    return Constant(v, string_type(nullable=False))
+
+
+def _contains_agg(node) -> bool:
+    if isinstance(node, ast.FuncCall):
+        if _FN_ALIAS.get(node.name, node.name) in AGG_FUNCS or node.star:
+            return True
+        return any(_contains_agg(a) for a in node.args)
+    for attr in ("left", "right", "operand", "low", "high", "pattern", "else_value"):
+        v = getattr(node, attr, None)
+        if v is not None and isinstance(v, ast.Node) and _contains_agg(v):
+            return True
+    if isinstance(node, ast.CaseWhen):
+        return any(_contains_agg(c) or _contains_agg(v) for c, v in node.branches)
+    if isinstance(node, ast.InList):
+        return any(_contains_agg(x) for x in node.items)
+    return False
+
+
+def _ast_eq(a, b) -> bool:
+    return type(a) is type(b) and a == b
+
+
+def _display_name(node) -> str:
+    if isinstance(node, ast.ColumnName):
+        return node.name
+    if isinstance(node, ast.FuncCall):
+        inner = "*" if node.star else ", ".join(_display_name(a) for a in node.args)
+        return f"{node.name}({inner})"
+    if isinstance(node, ast.Literal):
+        return str(node.value)
+    if isinstance(node, ast.BinaryOp):
+        return f"{_display_name(node.left)} {node.op} {_display_name(node.right)}"
+    return type(node).__name__.lower()
+
+
+def _source_outcol(e: Expression, schema) -> Optional[OutCol]:
+    if isinstance(e, ColumnRef) and e.index < len(schema):
+        return schema[e.index]
+    return None
+
+
+def _slot_of(e: Expression, schema) -> int:
+    oc = _source_outcol(e, schema)
+    return oc.slot if oc else -1
+
+
+def _as_equi_pair(cond: Expression, nleft: int):
+    if isinstance(cond, ScalarFunc) and cond.sig == "eq":
+        a, b = cond.args
+        if isinstance(a, ColumnRef) and isinstance(b, ColumnRef):
+            if a.index < nleft <= b.index:
+                return (a.index, b.index - nleft)
+            if b.index < nleft <= a.index:
+                return (b.index, a.index - nleft)
+    return None
+
+
+def _fold(e: Expression) -> Expression:
+    """Constant folding: all-constant scalar funcs evaluate at build time."""
+    if isinstance(e, ScalarFunc):
+        e = ScalarFunc(e.sig, [_fold(a) for a in e.args], e.ftype)
+        if e.sig != "like" and all(isinstance(a, Constant) for a in e.args):
+            batch = EvalBatch([], [], 1)
+            try:
+                col = eval_to_column(e, batch, np)
+            except Exception:
+                return e
+            return Constant(col.logical_value(0), e.ftype)
+    return e

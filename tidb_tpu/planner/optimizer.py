@@ -1,0 +1,406 @@
+"""Logical optimization + physical planning.
+
+Reference parity: pkg/planner/core/optimizer.go — the rule list at :84 runs
+column pruning, predicate pushdown, agg/topN/limit pushdown in that spirit;
+physicalOptimize (:1125) is replaced by deterministic pushdown-greedy
+construction (cost-based search is a later round once statistics exist).
+The engine-isolation hook (planbuilder.go:1357 filterPathByIsolationRead)
+lives in ``_pick_engine``: a fragment goes to the TPU engine iff the session
+allows it and every pushed expression is device-legal.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+from tidb_tpu.expression.expr import AggDesc, ColumnRef, Constant, Expression, ScalarFunc, can_push_down
+from tidb_tpu.kv import tablecodec
+from tidb_tpu.kv.kv import KeyRange, StoreType
+from tidb_tpu.planner.plans import (
+    LogicalAggregation,
+    LogicalDistinct,
+    LogicalDual,
+    LogicalJoin,
+    LogicalLimit,
+    LogicalPlan,
+    LogicalProjection,
+    LogicalScan,
+    LogicalSelection,
+    LogicalSort,
+    OutCol,
+    PhysDual,
+    PhysDistinct,
+    PhysFinalAgg,
+    PhysHashJoin,
+    PhysLimit,
+    PhysPointGet,
+    PhysProjection,
+    PhysSelection,
+    PhysSort,
+    PhysTableReader,
+    PhysicalPlan,
+    PlanError,
+)
+from tidb_tpu.types import TypeKind
+
+
+def optimize(plan: LogicalPlan, engines: list[str]) -> PhysicalPlan:
+    """engines: allowed read engines in preference order (session var
+    tidb_isolation_read_engines analog)."""
+    plan, _ = _prune(plan, None)
+    plan = _push_selections(plan)
+    fast = _try_point_get(plan)
+    if fast is not None:
+        return fast
+    return _physical(plan, engines)
+
+
+# ---------------------------------------------------------------------------
+# column pruning (ref: rule_column_pruning.go)
+# ---------------------------------------------------------------------------
+
+
+def _remap_expr(e: Expression, mapping: dict[int, int]) -> Expression:
+    if isinstance(e, ColumnRef):
+        return ColumnRef(mapping[e.index], e.ftype, e.name)
+    if isinstance(e, ScalarFunc):
+        return ScalarFunc(e.sig, [_remap_expr(a, mapping) for a in e.args], e.ftype)
+    return e
+
+
+def _expr_cols(e: Expression, out: set[int]) -> None:
+    if isinstance(e, ColumnRef):
+        out.add(e.index)
+    for c in e.children():
+        _expr_cols(c, out)
+
+
+def _prune(plan: LogicalPlan, needed: Optional[set[int]]):
+    """Bottom-up pruning. Returns (plan, mapping old_idx→new_idx for the
+    node's output schema)."""
+    if isinstance(plan, LogicalScan):
+        if needed is None:
+            return plan, {i: i for i in range(len(plan.schema))}
+        keep = sorted(needed)
+        mapping = {old: new for new, old in enumerate(keep)}
+        plan.schema = [plan.schema[i] for i in keep]
+        return plan, mapping
+    if isinstance(plan, LogicalDual):
+        return plan, {}
+    if isinstance(plan, LogicalProjection):
+        if needed is None:
+            keep = list(range(len(plan.exprs)))
+        else:
+            keep = sorted(needed)
+        child_needed: set[int] = set()
+        for i in keep:
+            _expr_cols(plan.exprs[i], child_needed)
+        child, cmap = _prune(plan.children[0], child_needed)
+        plan.children = [child]
+        plan.exprs = [_remap_expr(plan.exprs[i], cmap) for i in keep]
+        plan.schema = [plan.schema[i] for i in keep]
+        return plan, {old: new for new, old in enumerate(keep)}
+    if isinstance(plan, LogicalSelection):
+        child_needed = None if needed is None else set(needed)
+        if child_needed is not None:
+            for c in plan.conditions:
+                _expr_cols(c, child_needed)
+        child, cmap = _prune(plan.children[0], child_needed)
+        plan.children = [child]
+        plan.conditions = [_remap_expr(c, cmap) for c in plan.conditions]
+        return plan, cmap
+    if isinstance(plan, LogicalAggregation):
+        child_needed: set[int] = set()
+        for g in plan.group_by:
+            _expr_cols(g, child_needed)
+        for a in plan.aggs:
+            if a.arg is not None:
+                _expr_cols(a.arg, child_needed)
+        child, cmap = _prune(plan.children[0], child_needed)
+        plan.children = [child]
+        plan.group_by = [_remap_expr(g, cmap) for g in plan.group_by]
+        plan.aggs = [
+            AggDesc(a.name, _remap_expr(a.arg, cmap) if a.arg is not None else None, a.distinct) for a in plan.aggs
+        ]
+        return plan, {i: i for i in range(len(plan.schema))}
+    if isinstance(plan, (LogicalSort, LogicalLimit, LogicalDistinct)):
+        child_needed = None if needed is None else set(needed)
+        if isinstance(plan, LogicalSort) and child_needed is not None:
+            for e, _ in plan.by:
+                _expr_cols(e, child_needed)
+        child, cmap = _prune(plan.children[0], child_needed)
+        plan.children = [child]
+        if isinstance(plan, LogicalSort):
+            plan.by = [(_remap_expr(e, cmap), d) for e, d in plan.by]
+        return plan, cmap
+    if isinstance(plan, LogicalJoin):
+        nleft = len(plan.children[0].schema)
+        ln: set[int] = set()
+        rn: set[int] = set()
+        if needed is None:
+            ln = set(range(nleft))
+            rn = set(range(len(plan.children[1].schema)))
+        else:
+            for i in needed:
+                (ln if i < nleft else rn).add(i if i < nleft else i - nleft)
+        for l, r in plan.eq_conds:
+            ln.add(l)
+            rn.add(r)
+        for c in plan.other_conds:
+            s: set[int] = set()
+            _expr_cols(c, s)
+            for i in s:
+                (ln if i < nleft else rn).add(i if i < nleft else i - nleft)
+        lchild, lmap = _prune(plan.children[0], ln)
+        rchild, rmap = _prune(plan.children[1], rn)
+        plan.children = [lchild, rchild]
+        new_nleft = len(lchild.schema)
+        full_map = {}
+        for old, new in lmap.items():
+            full_map[old] = new
+        for old, new in rmap.items():
+            full_map[old + nleft] = new + new_nleft
+        plan.eq_conds = [(lmap[l], rmap[r]) for l, r in plan.eq_conds]
+        plan.other_conds = [_remap_expr(c, full_map) for c in plan.other_conds]
+        plan.schema = [plan.schema[i] for i in sorted(full_map)]
+        return plan, {old: new for new, old in enumerate(sorted(full_map))}
+    raise PlanError(f"prune: unhandled node {type(plan).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# predicate pushdown (ref: rule_predicate_push_down.go)
+# ---------------------------------------------------------------------------
+
+
+def _push_selections(plan: LogicalPlan) -> LogicalPlan:
+    for i, c in enumerate(getattr(plan, "children", [])):
+        plan.children[i] = _push_selections(c)
+    if isinstance(plan, LogicalSelection) and isinstance(plan.children[0], LogicalJoin):
+        join = plan.children[0]
+        nleft = len(join.children[0].schema)
+        keep: list[Expression] = []
+        for cond in plan.conditions:
+            s: set[int] = set()
+            _expr_cols(cond, s)
+            if join.kind in ("inner", "cross") and s and max(s) < nleft:
+                join.children[0] = LogicalSelection(conditions=[cond], children=[join.children[0]])
+            elif join.kind in ("inner", "cross") and s and min(s) >= nleft:
+                remapped = _remap_expr(cond, {i: i - nleft for i in s})
+                join.children[1] = LogicalSelection(conditions=[remapped], children=[join.children[1]])
+            else:
+                keep.append(cond)
+        # merge adjacent selections on the same side
+        for side in (0, 1):
+            ch = join.children[side]
+            if isinstance(ch, LogicalSelection) and isinstance(ch.children[0], LogicalSelection):
+                inner = ch.children[0]
+                inner.conditions = ch.conditions + inner.conditions
+                join.children[side] = inner
+        if not keep:
+            return join
+        plan.conditions = keep
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# point-get fast path (ref: point_get_plan.go:957 TryFastPlan)
+# ---------------------------------------------------------------------------
+
+
+def _try_point_get(plan: LogicalPlan):
+    proj = plan
+    if not isinstance(proj, LogicalProjection):
+        return None
+    sel = proj.children[0]
+    if not (isinstance(sel, LogicalSelection) and isinstance(sel.children[0], LogicalScan)):
+        return None
+    scan = sel.children[0]
+    if not scan.table.pk_is_handle or len(sel.conditions) != 1:
+        return None
+    cond = sel.conditions[0]
+    if not (isinstance(cond, ScalarFunc) and cond.sig == "eq"):
+        return None
+    a, b = cond.args
+    colref, const = (a, b) if isinstance(a, ColumnRef) else (b, a)
+    if not (isinstance(colref, ColumnRef) and isinstance(const, Constant)) or const.value is None:
+        return None
+    if scan.schema[colref.index].slot != scan.table.pk_offset:
+        return None
+    if not all(isinstance(e, ColumnRef) for e in proj.exprs):
+        return None
+    pg = PhysPointGet(db=scan.db, table=scan.table, handle=int(const.value), schema=proj.schema)
+    pg.scan_slots = [scan.schema[e.index].slot for e in proj.exprs]  # type: ignore[attr-defined]
+    return pg
+
+
+# ---------------------------------------------------------------------------
+# physical planning
+# ---------------------------------------------------------------------------
+
+
+def _pick_engine(engines: list[str], exprs: list[Expression]) -> StoreType:
+    for name in engines:
+        if name == "tpu" and all(can_push_down(e, "tpu") for e in exprs):
+            return StoreType.TPU
+        if name == "host" and all(can_push_down(e, "host") for e in exprs):
+            return StoreType.HOST
+    # nothing fits wholly; host engine accepts the most
+    return StoreType.HOST
+
+
+def _derive_ranges(scan: LogicalScan, conds: list[Expression]) -> Optional[list[KeyRange]]:
+    """Handle-range derivation for pk_is_handle predicates (util/ranger lite).
+    Conservative: intersects simple top-level comparisons on the pk column."""
+    t = scan.table
+    if not t.pk_is_handle:
+        return None
+    pk_positions = [i for i, oc in enumerate(scan.schema) if oc.slot == t.pk_offset]
+    if not pk_positions:
+        return None
+    pk_idx = pk_positions[0]
+    lo, hi = -(2**63), 2**63 - 2  # hi inclusive
+    found = False
+    for c in conds:
+        if not (isinstance(c, ScalarFunc) and c.sig in ("eq", "lt", "le", "gt", "ge")):
+            continue
+        a, b = c.args
+        sig = c.sig
+        if isinstance(b, ColumnRef) and isinstance(a, Constant):
+            a, b = b, a
+            sig = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}[sig]
+        if not (isinstance(a, ColumnRef) and a.index == pk_idx and isinstance(b, Constant)):
+            continue
+        if b.value is None or a.ftype.kind not in (TypeKind.INT, TypeKind.UINT):
+            continue
+        v = int(b.value)
+        found = True
+        if sig == "eq":
+            lo, hi = max(lo, v), min(hi, v)
+        elif sig == "lt":
+            hi = min(hi, v - 1)
+        elif sig == "le":
+            hi = min(hi, v)
+        elif sig == "gt":
+            lo = max(lo, v + 1)
+        elif sig == "ge":
+            lo = max(lo, v)
+    if not found:
+        return None
+    if lo > hi:
+        return []
+    return [tablecodec.handle_range(t.id, lo, hi)]
+
+
+def _physical(plan: LogicalPlan, engines: list[str]) -> PhysicalPlan:
+    if isinstance(plan, LogicalDual):
+        return PhysDual(schema=plan.schema)
+    if isinstance(plan, LogicalScan):
+        reader = PhysTableReader(
+            db=plan.db,
+            table=plan.table,
+            store_type=_pick_engine(engines, []),
+            scan_slots=[oc.slot for oc in plan.schema],
+            ranges=plan.ranges,
+            schema=plan.schema,
+        )
+        return reader
+    if isinstance(plan, LogicalSelection):
+        child = _physical(plan.children[0], engines)
+        if isinstance(child, PhysTableReader) and child.pushed_agg is None and child.pushed_topn is None and child.pushed_limit is None:
+            st = _pick_engine(engines, plan.conditions)
+            pushable = [c for c in plan.conditions if can_push_down(c, st.value)]
+            host_side = [c for c in plan.conditions if not can_push_down(c, st.value)]
+            child.store_type = st
+            child.pushed_conditions.extend(pushable)
+            if isinstance(plan.children[0], LogicalScan):
+                r = _derive_ranges(plan.children[0], pushable)
+                if r is not None:
+                    child.ranges = r
+            if host_side:
+                # host-only residue forces the host engine for correctness of
+                # the whole fragment ordering? No — residue evaluates above
+                # the reader, engine-independent.
+                return PhysSelection(conditions=host_side, children=[child])
+            return child
+        return PhysSelection(conditions=plan.conditions, children=[child])
+    if isinstance(plan, LogicalAggregation):
+        child = _physical(plan.children[0], engines)
+        exprs: list[Expression] = list(plan.group_by) + [a.arg for a in plan.aggs if a.arg is not None]
+        can_push = (
+            isinstance(child, PhysTableReader)
+            and child.pushed_agg is None
+            and child.pushed_topn is None
+            and child.pushed_limit is None
+            and not any(a.distinct for a in plan.aggs)
+        )
+        if can_push:
+            st = _pick_engine(engines, list(child.pushed_conditions) + exprs)
+            if all(can_push_down(e, st.value) for e in exprs) and all(
+                can_push_down(c, st.value) for c in child.pushed_conditions
+            ):
+                child.store_type = st
+                child.pushed_agg = plan
+                child.pushed_agg_mode = "partial"
+                # reader output schema = partial lanes + keys
+                child.schema = _partial_schema(plan)
+                final = PhysFinalAgg(
+                    group_by=plan.group_by, aggs=plan.aggs, partial_input=True, schema=plan.schema, children=[child]
+                )
+                return final
+        return PhysFinalAgg(group_by=plan.group_by, aggs=plan.aggs, partial_input=False, schema=plan.schema, children=[child])
+    if isinstance(plan, LogicalSort):
+        child = _physical(plan.children[0], engines)
+        return PhysSort(by=plan.by, children=[child])
+    if isinstance(plan, LogicalLimit):
+        child = _physical(plan.children[0], engines)
+        total = plan.limit + plan.offset
+        # topN pushdown: Limit(Sort(reader)) → reader TopN + root merge sort
+        if isinstance(child, PhysSort) and isinstance(child.children[0], PhysTableReader):
+            reader = child.children[0]
+            if reader.pushed_agg is None and reader.pushed_topn is None and reader.pushed_limit is None:
+                st = _pick_engine(engines, list(reader.pushed_conditions) + [e for e, _ in child.by])
+                if all(can_push_down(e, st.value) for e, _ in child.by) and all(
+                    can_push_down(c, st.value) for c in reader.pushed_conditions
+                ):
+                    reader.store_type = st
+                    reader.pushed_topn = (child.by, total)
+        elif isinstance(child, PhysTableReader) and child.pushed_agg is None and child.pushed_topn is None:
+            child.pushed_limit = total
+        return PhysLimit(limit=plan.limit, offset=plan.offset, children=[child])
+    if isinstance(plan, LogicalProjection):
+        child = _physical(plan.children[0], engines)
+        return PhysProjection(exprs=plan.exprs, schema=plan.schema, children=[child])
+    if isinstance(plan, LogicalDistinct):
+        child = _physical(plan.children[0], engines)
+        return PhysDistinct(children=[child])
+    if isinstance(plan, LogicalJoin):
+        left = _physical(plan.children[0], engines)
+        right = _physical(plan.children[1], engines)
+        return PhysHashJoin(
+            kind=plan.kind,
+            eq_conds=plan.eq_conds,
+            other_conds=plan.other_conds,
+            schema=plan.schema,
+            children=[left, right],
+        )
+    raise PlanError(f"physical: unhandled node {type(plan).__name__}")
+
+
+def _partial_schema(agg: LogicalAggregation) -> list:
+    from tidb_tpu.types.field_type import bigint_type
+
+    out = []
+    for i, a in enumerate(agg.aggs):
+        for pk in a.partial_kinds:
+            if pk == "count":
+                out.append(OutCol(f"p{i}_count", bigint_type(nullable=False)))
+            elif pk == "sum":
+                out.append(OutCol(f"p{i}_sum", AggDesc("sum", a.arg).ftype))
+            else:
+                ft = a.arg.ftype if a.arg is not None else bigint_type()
+                out.append(OutCol(f"p{i}_{pk}", ft))
+    for gi, g in enumerate(agg.group_by):
+        src = agg.children[0].schema[g.index] if isinstance(g, ColumnRef) else None
+        out.append(OutCol(f"gb#{gi}", g.ftype, slot=src.slot if src else -1, table=src.table if src else ""))
+    return out

@@ -1,0 +1,275 @@
+"""Logical and physical plan nodes (ref: pkg/planner/core logical/physical
+operators, trimmed)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tidb_tpu.catalog.schema import TableInfo
+from tidb_tpu.expression.expr import AggDesc, Expression
+from tidb_tpu.kv.kv import KeyRange, StoreType
+from tidb_tpu.types import FieldType
+
+
+class PlanError(Exception):
+    pass
+
+
+@dataclass
+class OutCol:
+    """One output column of a plan node."""
+
+    name: str
+    ftype: FieldType
+    table: str = ""  # qualifier (alias) for resolution
+    # storage slot when this is a direct table column (dictionary lookup)
+    slot: int = -1
+
+
+Schema = list  # list[OutCol]
+
+
+class LogicalPlan:
+    children: list["LogicalPlan"]
+    schema: Schema
+
+    def child(self) -> "LogicalPlan":
+        return self.children[0]
+
+
+@dataclass
+class LogicalScan(LogicalPlan):
+    db: str
+    table: TableInfo
+    alias: str
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+    # filled by predicate pushdown / range derivation
+    ranges: Optional[list[KeyRange]] = None
+
+
+@dataclass
+class LogicalDual(LogicalPlan):
+    """SELECT with no FROM — one row, zero columns."""
+
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+
+@dataclass
+class LogicalSelection(LogicalPlan):
+    conditions: list[Expression]
+    children: list = field(default_factory=list)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+
+@dataclass
+class LogicalProjection(LogicalPlan):
+    exprs: list[Expression]
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+
+@dataclass
+class LogicalAggregation(LogicalPlan):
+    group_by: list[Expression]
+    aggs: list[AggDesc]
+    schema: Schema = field(default_factory=list)  # [aggs..., group keys...]
+    children: list = field(default_factory=list)
+
+
+@dataclass
+class LogicalSort(LogicalPlan):
+    by: list[tuple[Expression, bool]]  # (expr, desc)
+    children: list = field(default_factory=list)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+
+@dataclass
+class LogicalLimit(LogicalPlan):
+    limit: int
+    offset: int = 0
+    children: list = field(default_factory=list)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+
+@dataclass
+class LogicalJoin(LogicalPlan):
+    kind: str  # inner/left/right/cross
+    # equi-join keys resolved to (left_idx, right_idx) pairs + other conds
+    eq_conds: list[tuple[int, int]] = field(default_factory=list)
+    other_conds: list[Expression] = field(default_factory=list)
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+
+@dataclass
+class LogicalDistinct(LogicalPlan):
+    children: list = field(default_factory=list)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+
+# ---------------------------------------------------------------------------
+# physical plans
+# ---------------------------------------------------------------------------
+
+
+class PhysicalPlan:
+    children: list["PhysicalPlan"]
+    schema: Schema
+
+
+@dataclass
+class PhysTableReader(PhysicalPlan):
+    """The pushed-down fragment: executed by an engine via the cop client
+    (ref: PhysicalTableReader + ConstructDAGReq)."""
+
+    db: str
+    table: TableInfo
+    store_type: StoreType
+    # pushed operators, in DAG order after the implicit scan
+    pushed_conditions: list[Expression] = field(default_factory=list)
+    pushed_agg: Optional[LogicalAggregation] = None
+    pushed_agg_mode: str = "partial"
+    pushed_topn: Optional[tuple[list, int]] = None  # (order_by, limit+offset)
+    pushed_limit: Optional[int] = None
+    scan_slots: list[int] = field(default_factory=list)  # storage slots scanned
+    ranges: Optional[list[KeyRange]] = None
+    keep_order: bool = False
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+
+@dataclass
+class PhysSelection(PhysicalPlan):
+    conditions: list[Expression]
+    children: list = field(default_factory=list)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+
+@dataclass
+class PhysProjection(PhysicalPlan):
+    exprs: list[Expression]
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+
+@dataclass
+class PhysFinalAgg(PhysicalPlan):
+    """Merges partial-agg chunks from the reader (or performs the whole agg
+    when nothing was pushed)."""
+
+    group_by: list[Expression]
+    aggs: list[AggDesc]
+    partial_input: bool  # True: child emits partial state lanes
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+
+@dataclass
+class PhysSort(PhysicalPlan):
+    by: list[tuple[Expression, bool]]
+    children: list = field(default_factory=list)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+
+@dataclass
+class PhysLimit(PhysicalPlan):
+    limit: int
+    offset: int = 0
+    children: list = field(default_factory=list)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+
+@dataclass
+class PhysHashJoin(PhysicalPlan):
+    kind: str
+    eq_conds: list[tuple[int, int]]
+    other_conds: list[Expression]
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+
+@dataclass
+class PhysDistinct(PhysicalPlan):
+    children: list = field(default_factory=list)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+
+@dataclass
+class PhysDual(PhysicalPlan):
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+
+@dataclass
+class PhysPointGet(PhysicalPlan):
+    """Fast path: PK point lookup bypassing the coprocessor entirely
+    (ref: core/point_get_plan.go:957 TryFastPlan)."""
+
+    db: str
+    table: TableInfo
+    handle: int
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+
+def explain_plan(p, indent: int = 0) -> str:
+    """EXPLAIN output (ref: the reference's indented explain format)."""
+    pad = "  " * indent
+    name = type(p).__name__
+    extra = ""
+    if isinstance(p, PhysTableReader):
+        ops = ["Scan"]
+        if p.pushed_conditions:
+            ops.append(f"Selection({', '.join(map(repr, p.pushed_conditions))})")
+        if p.pushed_agg is not None:
+            ops.append(f"{'Partial' if p.pushed_agg_mode == 'partial' else ''}Agg({', '.join(map(repr, p.pushed_agg.aggs))})")
+        if p.pushed_topn is not None:
+            ops.append(f"TopN({p.pushed_topn[1]})")
+        if p.pushed_limit is not None:
+            ops.append(f"Limit({p.pushed_limit})")
+        extra = f"[{p.store_type.value}] {p.table.name}: " + " -> ".join(ops)
+    elif isinstance(p, PhysFinalAgg):
+        extra = ", ".join(map(repr, p.aggs)) + (" (merge partial)" if p.partial_input else "")
+    elif isinstance(p, PhysSelection):
+        extra = ", ".join(map(repr, p.conditions))
+    elif isinstance(p, PhysProjection):
+        extra = ", ".join(map(repr, p.exprs))
+    elif isinstance(p, PhysSort):
+        extra = ", ".join(f"{e!r}{' desc' if d else ''}" for e, d in p.by)
+    elif isinstance(p, PhysLimit):
+        extra = f"limit={p.limit} offset={p.offset}"
+    elif isinstance(p, PhysHashJoin):
+        extra = f"{p.kind} on {p.eq_conds}"
+    elif isinstance(p, PhysPointGet):
+        extra = f"{p.table.name} handle={p.handle}"
+    lines = [f"{pad}{name} {extra}".rstrip()]
+    for c in getattr(p, "children", []):
+        lines.append(explain_plan(c, indent + 1))
+    return "\n".join(lines)

@@ -1,0 +1,338 @@
+"""Session: the statement state machine.
+
+ref call path being mirrored: clientConn.Run → session.ExecuteStmt →
+Compiler.Compile (planner.Optimize) → ExecStmt.Exec → executor tree
+(SURVEY §3.2). Reads inside a dirty explicit transaction take the union-scan
+path: the reader scans through the txn membuffer and replays the pushed
+operators host-side (ref: UnionScanExec merging membuffer over snapshot).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from tidb_tpu.catalog import Catalog, CatalogError
+from tidb_tpu.kv.memstore import MemStore
+from tidb_tpu.kv.txn import Txn
+from tidb_tpu.parser import ast, parse
+from tidb_tpu.planner.builder import Builder
+from tidb_tpu.planner.optimizer import optimize
+from tidb_tpu.planner.plans import PlanError, explain_plan
+from tidb_tpu.utils.chunk import Chunk
+
+DEFAULT_SYSVARS = {
+    # engine isolation (ref: vardef tidb_isolation_read_engines :631);
+    # preference order matters: first legal engine wins
+    "tidb_isolation_read_engines": "tpu,host",
+    "tidb_distsql_scan_concurrency": 8,  # ref: tidb_vars.go:302 (default 15)
+    "autocommit": 1,
+    "tidb_current_ts": 0,
+    "sql_mode": "",
+    "max_execution_time": 0,
+}
+
+
+@dataclass
+class Result:
+    columns: list[str] = field(default_factory=list)
+    rows: list[tuple] = field(default_factory=list)
+    affected: int = 0
+    last_insert_id: int = 0
+
+    def scalar(self):
+        return self.rows[0][0] if self.rows else None
+
+
+class SessionError(Exception):
+    pass
+
+
+class Session:
+    def __init__(self, db: "DB"):
+        self._db = db
+        self.store: MemStore = db.store
+        self.catalog: Catalog = db.catalog
+        self.vars: dict[str, Any] = dict(DEFAULT_SYSVARS)
+        self.current_db = "test"
+        self._txn: Optional[Txn] = None
+        self._explicit = False
+
+    # -- txn lifecycle (ref: LazyTxn) ---------------------------------------
+    def txn(self) -> Txn:
+        if self._txn is None:
+            self._txn = self.store.begin()
+        return self._txn
+
+    def txn_for_read(self) -> Txn:
+        return self.txn()
+
+    def read_ts(self) -> int:
+        if self._txn is not None:
+            return self._txn.start_ts
+        return self.store.current_ts()
+
+    def _txn_dirty(self) -> bool:
+        return self._txn is not None and len(self._txn.membuf) > 0
+
+    def begin(self) -> None:
+        self._finish_txn(commit=True)
+        self._explicit = True
+        self._txn = self.store.begin()
+
+    def commit(self) -> None:
+        self._finish_txn(commit=True)
+        self._explicit = False
+
+    def rollback(self) -> None:
+        self._finish_txn(commit=False)
+        self._explicit = False
+
+    def _finish_txn(self, commit: bool) -> None:
+        if self._txn is not None:
+            t, self._txn = self._txn, None
+            if commit:
+                t.commit()
+            else:
+                t.rollback()
+
+    # -- entry points --------------------------------------------------------
+    def execute(self, sql: str) -> Result:
+        stmt = parse(sql)
+        try:
+            res = self._execute_stmt(stmt)
+            if not self._explicit and self._txn is not None:
+                self._finish_txn(commit=True)
+            return res
+        except Exception:
+            if not self._explicit and self._txn is not None:
+                # autocommit statement failed → roll back its staged writes
+                self._finish_txn(commit=False)
+            elif self._explicit and self._txn is not None:
+                # statement-level atomicity inside explicit txn is handled by
+                # membuffer staging in _execute_stmt for DML
+                pass
+            raise
+
+    def query(self, sql: str) -> list[tuple]:
+        return self.execute(sql).rows
+
+    # -- dispatch ------------------------------------------------------------
+    def _execute_stmt(self, stmt: ast.Node) -> Result:
+        if isinstance(stmt, ast.Select):
+            return self._select(stmt)
+        if isinstance(stmt, ast.Insert):
+            from tidb_tpu.executor import write
+
+            return self._dml(lambda: write.execute_insert(self, stmt))
+        if isinstance(stmt, ast.Update):
+            from tidb_tpu.executor import write
+
+            return self._dml(lambda: write.execute_update(self, stmt))
+        if isinstance(stmt, ast.Delete):
+            from tidb_tpu.executor import write
+
+            return self._dml(lambda: write.execute_delete(self, stmt))
+        if isinstance(stmt, ast.CreateTable):
+            self.catalog.create_table(stmt.table.db or self.current_db, stmt)
+            return Result()
+        if isinstance(stmt, ast.DropTable):
+            for tr in stmt.tables:
+                self.catalog.drop_table(tr.db or self.current_db, tr.name, if_exists=stmt.if_exists)
+            return Result()
+        if isinstance(stmt, ast.TruncateTable):
+            self.catalog.truncate_table(stmt.table.db or self.current_db, stmt.table.name)
+            return Result()
+        if isinstance(stmt, ast.AlterTable):
+            self.catalog.alter_table(stmt.table.db or self.current_db, stmt)
+            return Result()
+        if isinstance(stmt, ast.CreateIndex):
+            alter = ast.AlterTable(stmt.table, action="add_index", index=stmt.index)
+            self.catalog.alter_table(stmt.table.db or self.current_db, alter)
+            return Result()
+        if isinstance(stmt, ast.DropIndex):
+            alter = ast.AlterTable(stmt.table, action="drop_index", name=stmt.name)
+            self.catalog.alter_table(stmt.table.db or self.current_db, alter)
+            return Result()
+        if isinstance(stmt, ast.CreateDatabase):
+            self.catalog.create_database(stmt.name, stmt.if_not_exists)
+            return Result()
+        if isinstance(stmt, ast.DropDatabase):
+            self.catalog.drop_database(stmt.name, stmt.if_exists)
+            return Result()
+        if isinstance(stmt, ast.UseDatabase):
+            self.catalog.db(stmt.name)  # raises if unknown
+            self.current_db = stmt.name.lower()
+            return Result()
+        if isinstance(stmt, ast.SetVariable):
+            return self._set_var(stmt)
+        if isinstance(stmt, ast.Show):
+            return self._show(stmt)
+        if isinstance(stmt, ast.Begin):
+            self.begin()
+            return Result()
+        if isinstance(stmt, ast.Commit):
+            self.commit()
+            return Result()
+        if isinstance(stmt, ast.Rollback):
+            self.rollback()
+            return Result()
+        if isinstance(stmt, ast.Explain):
+            return self._explain(stmt)
+        if isinstance(stmt, ast.AnalyzeTable):
+            return self._analyze(stmt)
+        raise SessionError(f"unsupported statement {type(stmt).__name__}")
+
+    def _dml(self, fn) -> Result:
+        txn = self.txn()
+        txn.membuf.stage()
+        try:
+            affected = fn()
+        except Exception:
+            txn.membuf.rollback_stage()
+            raise
+        txn.membuf.release_stage()
+        return Result(affected=affected)
+
+    # -- SELECT ---------------------------------------------------------------
+    def _select(self, stmt: ast.Select) -> Result:
+        plan = self._plan_select(stmt)
+        from tidb_tpu.executor import build_executor
+
+        ex = build_executor(plan, self)
+        chunk = ex.execute()
+        names = [oc.name for oc in plan.schema]
+        return Result(columns=names, rows=chunk.rows())
+
+    def _plan_select(self, stmt: ast.Select):
+        builder = Builder(self.catalog, self.current_db, subquery_runner=self._subquery_runner)
+        logical = builder.build_select(stmt)
+        engines = [e.strip() for e in str(self.vars["tidb_isolation_read_engines"]).split(",") if e.strip()]
+        return optimize(logical, engines)
+
+    def _run_select_ast(self, stmt: ast.Select) -> list[tuple]:
+        return self._select(stmt).rows
+
+    def _subquery_runner(self, sel: ast.Select) -> list[tuple]:
+        return self._run_select_ast(sel)
+
+    # -- misc -----------------------------------------------------------------
+    def _set_var(self, stmt: ast.SetVariable) -> Result:
+        builder = Builder(self.catalog, self.current_db)
+        from tidb_tpu.planner.builder import BuildCtx
+
+        e = builder.resolve(stmt.value, BuildCtx([]))
+        from tidb_tpu.expression.expr import Constant
+
+        if not isinstance(e, Constant):
+            raise SessionError("SET value must be constant")
+        v = e.value
+        if isinstance(v, bytes):
+            v = v.decode()
+        if stmt.scope == "global":
+            self._db.global_vars[stmt.name] = v
+        self.vars[stmt.name] = v
+        return Result()
+
+    def _show(self, stmt: ast.Show) -> Result:
+        if stmt.kind == "tables":
+            rows = [(t,) for t in self.catalog.tables(self.current_db)]
+            if stmt.like:
+                import re
+
+                from tidb_tpu.expression.eval import like_to_regex
+
+                rx = re.compile(like_to_regex(stmt.like))
+                rows = [r for r in rows if rx.match(r[0])]
+            return Result(columns=[f"Tables_in_{self.current_db}"], rows=rows)
+        if stmt.kind == "databases":
+            return Result(columns=["Database"], rows=[(d,) for d in self.catalog.databases()])
+        if stmt.kind == "variables":
+            rows = sorted((k, str(v)) for k, v in self.vars.items())
+            if stmt.like:
+                import re
+
+                from tidb_tpu.expression.eval import like_to_regex
+
+                rx = re.compile(like_to_regex(stmt.like))
+                rows = [r for r in rows if rx.match(r[0])]
+            return Result(columns=["Variable_name", "Value"], rows=rows)
+        if stmt.kind == "columns":
+            t = self.catalog.table(self.current_db, stmt.target)
+            rows = [
+                (c.name, str(c.ftype), "YES" if c.ftype.nullable else "NO", str(c.default or ""))
+                for c in t.columns
+            ]
+            return Result(columns=["Field", "Type", "Null", "Default"], rows=rows)
+        if stmt.kind == "create_table":
+            t = self.catalog.table(self.current_db, stmt.target)
+            cols = ",\n  ".join(f"`{c.name}` {c.ftype}" for c in t.columns)
+            return Result(columns=["Table", "Create Table"], rows=[(t.name, f"CREATE TABLE `{t.name}` (\n  {cols}\n)")])
+        raise SessionError(f"unsupported SHOW {stmt.kind}")
+
+    def _explain(self, stmt: ast.Explain) -> Result:
+        inner = stmt.stmt
+        if not isinstance(inner, ast.Select):
+            raise SessionError("EXPLAIN supports SELECT only")
+        plan = self._plan_select(inner)
+        if stmt.analyze:
+            from tidb_tpu.executor import build_executor
+            import time
+
+            t0 = time.time()
+            build_executor(plan, self).execute()
+            dt = (time.time() - t0) * 1000
+            text = explain_plan(plan) + f"\n-- actual time: {dt:.1f} ms"
+        else:
+            text = explain_plan(plan)
+        return Result(columns=["plan"], rows=[(line,) for line in text.split("\n")])
+
+    def _analyze(self, stmt: ast.AnalyzeTable) -> Result:
+        # round 1: ANALYZE compacts string dictionaries (order-preserving
+        # codes legalize device-side string ordering); histogram/CM-sketch
+        # statistics are a later round (ref: pkg/statistics)
+        from tidb_tpu.copr.colcache import cache_for
+
+        cache = cache_for(self.store)
+        for tr in stmt.tables:
+            t = self.catalog.table(tr.db or self.current_db, tr.name)
+            for c in t.columns:
+                from tidb_tpu.types import TypeKind
+
+                if c.ftype.kind == TypeKind.STRING:
+                    cache.ensure_sorted_dict(t.id, c.offset)
+        return Result()
+
+
+class DB:
+    """Embedded database handle (testkit.CreateMockStore analog)."""
+
+    def __init__(self, region_split_keys: int = 500_000):
+        self.store = MemStore(region_split_keys=region_split_keys)
+        self.catalog = Catalog(self.store)
+        self.global_vars: dict[str, Any] = {}
+        self._mu = threading.Lock()
+
+    def session(self) -> Session:
+        s = Session(self)
+        s.vars.update(self.global_vars)
+        return s
+
+    # convenience single-session surface
+    _default: Optional[Session] = None
+
+    def _ses(self) -> Session:
+        if self._default is None:
+            self._default = self.session()
+        return self._default
+
+    def execute(self, sql: str) -> Result:
+        return self._ses().execute(sql)
+
+    def query(self, sql: str) -> list[tuple]:
+        return self._ses().query(sql)
+
+
+def open_db(region_split_keys: int = 500_000) -> DB:
+    return DB(region_split_keys=region_split_keys)

@@ -66,10 +66,17 @@ class Datum:
         if k == TypeKind.DATE:
             if isinstance(v, _dt.date):
                 return (v - _EPOCH_DATE).days
+            if isinstance(v, str):  # wire form (ISO) from serialized plans
+                return date_to_days(v)
             return int(v)
         if k == TypeKind.DATETIME:
             if isinstance(v, _dt.datetime):
                 return int((v - _EPOCH_DT).total_seconds() * 1_000_000)
+            if isinstance(v, str):
+                try:
+                    return datetime_to_micros(v)
+                except ValueError:
+                    return datetime_to_micros(v + " 00:00:00")
             return int(v)
         if k == TypeKind.DURATION:
             return int(v)

@@ -192,7 +192,8 @@ class Column:
                 return iv
             from decimal import Decimal
 
-            return Decimal(iv) / (10**s)
+            # scaleb keeps the declared scale (5.00, not 5) like MySQL
+            return Decimal(iv).scaleb(-s)
         if k == TypeKind.DATE:
             return days_to_date(int(v))
         if k == TypeKind.DATETIME:
