@@ -51,6 +51,14 @@ class Binder:
         for ex in out.executors:
             if ex.tp == dagpb.TABLE_SCAN:
                 scan_seen = True
+                # capture value domains: string codes live in [0, len(dict));
+                # enables the kernel's dense no-sort group-by fast path
+                ex.domains = [
+                    len(self.cache.dictionary(self.table_id, c.column_id))
+                    if c.ftype.kind == TypeKind.STRING
+                    else -1
+                    for c in ex.columns
+                ]
                 continue
             if not scan_seen:
                 raise UnsupportedForDevice("DAG must start with a scan")

@@ -63,6 +63,9 @@ class ExecutorPB:
     # full storage-slot schema of the table (rowcodec is schema-versioned,
     # not self-describing — decode needs every slot's type)
     storage_schema: list[FieldType] = field(default_factory=list)
+    # per-scan-output value-domain size (dictionary length for string codes;
+    # -1 unknown). Set by the device binder; enables dense no-sort group-by.
+    domains: list[int] = field(default_factory=list)
     # selection: conditions (ExprPB dicts), implicitly AND-ed
     conditions: list[dict] = field(default_factory=list)
     # aggregation
@@ -91,6 +94,7 @@ class ExecutorPB:
                 columns=[c.to_pb() for c in self.columns],
                 desc=self.desc,
                 storage_schema=[_ft_pb(ft) for ft in self.storage_schema],
+                domains=list(self.domains),
             )
         elif self.tp == SELECTION:
             d.update(conditions=self.conditions)
@@ -112,6 +116,7 @@ class ExecutorPB:
             e.columns = [ColumnInfoPB.from_pb(c) for c in pb["columns"]]
             e.desc = pb.get("desc", False)
             e.storage_schema = [_ft_from_pb(f) for f in pb.get("storage_schema", [])]
+            e.domains = pb.get("domains", [])
         elif e.tp == SELECTION:
             e.conditions = pb["conditions"]
         elif e.tp in (AGGREGATION, STREAM_AGG):

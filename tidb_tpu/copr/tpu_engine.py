@@ -33,6 +33,10 @@ from tidb_tpu.types import FieldType, TypeKind
 from tidb_tpu.types.field_type import bigint_type
 from tidb_tpu.utils.chunk import Chunk, Column, bucket_size
 
+from tidb_tpu.ops.dag_kernel import _ensure_x64
+
+_ensure_x64()  # BEFORE any device_put: int64/float64 lanes must not truncate
+
 _DEFAULT_AGG_CAP = 4096
 
 _dev_mu = threading.Lock()

@@ -144,13 +144,46 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                     mask = mask & keep
             elif ex.tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG):
                 group_exprs, aggs, mode = pre
+                # dense fast path: every group key is a scan column with a
+                # known small domain (dictionary codes) → bucket index is
+                # pure arithmetic, no O(n log n) sort. One extra bucket per
+                # key holds its NULLs.
+                dense_doms = None
+                if group_exprs:
+                    doms = []
+                    for g in group_exprs:
+                        from tidb_tpu.expression.expr import ColumnRef as _CR
+
+                        if isinstance(g, _CR) and g.index < len(scan.domains) and scan.domains[g.index] > 0:
+                            doms.append(scan.domains[g.index])
+                        else:
+                            doms = None
+                            break
+                    if doms:
+                        b_total = 1
+                        for dm in doms:
+                            b_total *= dm + 1
+                        if b_total <= agg_cap:
+                            dense_doms = doms
+
                 gvals = []
                 for g in group_exprs:
                     d, v, _ = eval_expr(g, batch, jnp)
                     d = _bcast(d, n)
                     v = _vmask(v, n)
                     gvals.append((jnp.where(v, d, 0), v))
-                if gvals:
+
+                if dense_doms is not None:
+                    perm = None  # identity — no row reorder at all
+                    sm = mask
+                    seg = jnp.zeros(n, dtype=jnp.int64)
+                    stride = 1
+                    for (d, v), dom in zip(reversed(gvals), reversed(dense_doms)):
+                        adj = jnp.where(v, d, dom)  # NULLs → extra bucket
+                        seg = seg + adj * stride
+                        stride *= dom + 1
+                    ngroups = None  # derived from occupancy after reduction
+                elif gvals:
                     lanes = [~mask]
                     for d, v in gvals:
                         lanes.append(~v)  # NULL group lane
@@ -167,10 +200,13 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                     seg = jnp.clip(jnp.cumsum(boundary) - 1, 0, None)
                     ngroups = boundary.sum()
                 else:
-                    perm = jnp.arange(n)
+                    perm = None
                     sm = mask
                     seg = jnp.zeros(n, dtype=jnp.int64)
                     ngroups = jnp.asarray(1, dtype=jnp.int64)
+
+                def _p(x):
+                    return x if perm is None else x[perm]
 
                 pos = jnp.arange(n)
                 first_pos = jax.ops.segment_min(jnp.where(sm, pos, n), seg, num_segments=agg_cap)
@@ -180,8 +216,8 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                 for a in aggs:
                     if a.arg is not None:
                         d, v, _ = eval_expr(a.arg, batch, jnp)
-                        d = _bcast(d, n)[perm]
-                        v = _vmask(v, n)[perm]
+                        d = _p(_bcast(d, n))
+                        v = _p(_vmask(v, n))
                     else:
                         d = jnp.ones(n, dtype=jnp.int64)
                         v = jnp.ones(n, dtype=bool)
@@ -213,16 +249,23 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                 if mode == dagpb.AGG_COMPLETE:
                     out_data, out_valid = _finalize_device(jnp, aggs, out_data, out_valid)
                 # group key outputs
+                for g, (gd, gv) in zip(group_exprs, gvals):
+                    out_data.append(_p(gd)[first_pos_c])
+                    out_valid.append(_p(gv)[first_pos_c] & (first_pos < n))
+                if dense_doms is not None:
+                    # compact live buckets to the front (tiny sort over caps)
+                    occupancy = jax.ops.segment_sum(sm.astype(jnp.int64), seg, num_segments=agg_cap)
+                    live = occupancy > 0
+                    order = jnp.argsort(~live, stable=True)
+                    out_data = [o[order] for o in out_data]
+                    out_valid = [o[order] for o in out_valid]
+                    ngroups = live.sum()
                 gslot = jnp.arange(agg_cap)
                 gvalid_slot = gslot < ngroups
-                for g, (gd, gv) in zip(group_exprs, gvals):
-                    gd_s, gv_s = gd[perm], gv[perm]
-                    out_data.append(gd_s[first_pos_c])
-                    out_valid.append(gv_s[first_pos_c] & gvalid_slot)
+                out_valid = [ov & gvalid_slot for ov in out_valid]
                 # rebuild batch in case more executors follow
                 batch = EvalBatch([(d, v) for d, v in zip(out_data, out_valid)], [None] * len(out_data), agg_cap)
-                mask = gslot < ngroups
-                n_cur = agg_cap
+                mask = gvalid_slot
                 kind = "agg"
             elif ex.tp == dagpb.TOPN:
                 order, limit = pre
