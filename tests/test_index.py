@@ -1,0 +1,160 @@
+"""Index access path: ranger derivation, IndexReader/IndexLookUp executors,
+plan selection (ref: util/ranger tests + executor index reader tests)."""
+
+import numpy as np
+import pytest
+
+import tidb_tpu
+
+
+@pytest.fixture()
+def db():
+    d = tidb_tpu.open()
+    d.execute(
+        "CREATE TABLE emp (id BIGINT PRIMARY KEY, dept VARCHAR(16), salary BIGINT, "
+        "score DOUBLE, KEY idx_dept (dept), KEY idx_sal (salary, score))"
+    )
+    rows = []
+    depts = ["eng", "sales", "hr", "ops"]
+    for i in range(200):
+        rows.append(f"({i}, '{depts[i % 4]}', {1000 + i % 50}, {i / 10.0})")
+    d.execute("INSERT INTO emp VALUES " + ", ".join(rows))
+    return d
+
+
+def plan_text(db, sql):
+    return "\n".join(r[0] for r in db.query("EXPLAIN " + sql))
+
+
+def test_eq_condition_uses_index(db):
+    text = plan_text(db, "SELECT id, dept FROM emp WHERE dept = 'eng'")
+    assert "IndexScan(idx_dept" in text
+    rows = db.query("SELECT id, dept FROM emp WHERE dept = 'eng' ORDER BY id")
+    assert len(rows) == 50
+    assert all(r[1] == "eng" for r in rows)
+    assert rows[0][0] == 0 and rows[1][0] == 4
+
+
+def test_index_lookup_fetches_non_index_columns(db):
+    text = plan_text(db, "SELECT salary FROM emp WHERE dept = 'hr'")
+    assert "TableRowIDScan" in text
+    rows = db.query("SELECT SUM(salary) FROM emp WHERE dept = 'hr'")
+    ref = sum(1000 + i % 50 for i in range(200) if i % 4 == 2)
+    assert rows[0][0] == ref
+
+
+def test_covering_index_reader(db):
+    # salary+score are both in idx_sal; id is the handle → covering
+    text = plan_text(db, "SELECT salary, score, id FROM emp WHERE salary = 1010")
+    assert "IndexScan(idx_sal" in text and "TableRowIDScan" not in text
+    rows = db.query("SELECT salary, score, id FROM emp WHERE salary = 1010 ORDER BY id")
+    expect = [(1010, i / 10.0, i) for i in range(200) if 1000 + i % 50 == 1010]
+    assert [(r[0], r[1], r[2]) for r in rows] == expect
+
+
+def test_eq_plus_range_on_second_column(db):
+    rows = db.query("SELECT id FROM emp WHERE salary = 1010 AND score > 5.0 ORDER BY id")
+    expect = [i for i in range(200) if 1000 + i % 50 == 1010 and i / 10.0 > 5.0]
+    assert [r[0] for r in rows] == expect
+
+
+def test_in_list_fans_out_point_ranges(db):
+    text = plan_text(db, "SELECT id FROM emp WHERE dept IN ('eng', 'hr')")
+    assert "IndexScan(idx_dept" in text
+    rows = db.query("SELECT COUNT(*) FROM emp WHERE dept IN ('eng', 'hr')")
+    assert rows[0][0] == 100
+
+
+def test_residual_conditions_applied(db):
+    rows = db.query("SELECT id FROM emp WHERE dept = 'eng' AND salary > 1040 ORDER BY id")
+    expect = [i for i in range(200) if i % 4 == 0 and 1000 + i % 50 > 1040]
+    assert [r[0] for r in rows] == expect
+
+
+def test_no_index_for_unindexed_column(db):
+    text = plan_text(db, "SELECT id FROM emp WHERE score = 5.0")
+    assert "IndexScan" not in text
+
+
+def test_pk_point_beats_secondary_index(db):
+    text = plan_text(db, "SELECT id, dept FROM emp WHERE id = 5 AND dept = 'sales'")
+    assert "IndexScan" not in text  # point-get or table range, not index
+
+
+def test_index_inside_dirty_txn_union_scan(db):
+    s = db.session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO emp VALUES (1000, 'eng', 2000, 1.5)")
+    rows = s.query("SELECT id FROM emp WHERE dept = 'eng' AND salary = 2000")
+    assert [r[0] for r in rows] == [1000]
+    s.execute("ROLLBACK")
+    rows = db.query("SELECT id FROM emp WHERE dept = 'eng' AND salary = 2000")
+    assert rows == []
+
+
+def test_index_after_update_and_delete(db):
+    db.execute("UPDATE emp SET dept = 'legal' WHERE id = 0")
+    db.execute("DELETE FROM emp WHERE id = 4")
+    rows = db.query("SELECT id FROM emp WHERE dept = 'eng' ORDER BY id LIMIT 3")
+    assert [r[0] for r in rows] == [8, 12, 16]
+    assert db.query("SELECT id FROM emp WHERE dept = 'legal'") == [(0,)]
+
+
+def test_create_index_backfills_existing_rows():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE t (a BIGINT PRIMARY KEY, b BIGINT)")
+    d.execute("INSERT INTO t VALUES (1, 10), (2, 20), (3, 10)")
+    d.execute("CREATE INDEX idx_b ON t (b)")
+    text = plan_text(d, "SELECT a FROM t WHERE b = 10")
+    assert "IndexScan(idx_b" in text
+    assert d.query("SELECT a FROM t WHERE b = 10 ORDER BY a") == [(1,), (3,)]
+
+
+def test_unique_index_point(db):
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE u (a BIGINT PRIMARY KEY, b VARCHAR(8), UNIQUE KEY ub (b))")
+    d.execute("INSERT INTO u VALUES (1, 'x'), (2, 'y')")
+    assert d.query("SELECT a FROM u WHERE b = 'y'") == [(2,)]
+    assert d.query("SELECT a FROM u WHERE b = 'z'") == []
+
+
+def test_decimal_index_bounds():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE p (a BIGINT PRIMARY KEY, d DECIMAL(8,2), KEY kd (d))")
+    d.execute("INSERT INTO p VALUES (1, 1.25), (2, 1.30), (3, 2.75)")
+    assert d.query("SELECT a FROM p WHERE d = 1.30") == [(2,)]
+    # non-representable point (scale 3 constant on scale-2 column)
+    assert d.query("SELECT a FROM p WHERE d = 1.305") == []
+    rows = d.query("SELECT a FROM p WHERE d IN (1.25, 2.75) ORDER BY a")
+    assert rows == [(1,), (3,)]
+
+
+def test_in_fanout_cap_falls_back_to_table_scan():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE f (id BIGINT PRIMARY KEY, a BIGINT, b BIGINT, KEY kab (a, b))")
+    rows = ", ".join(f"({i}, {i % 20}, {i % 17})" for i in range(400))
+    d.execute("INSERT INTO f VALUES " + rows)
+    a_vals = ", ".join(str(v) for v in range(17))
+    b_vals = ", ".join(str(v) for v in range(16))
+    sql = f"SELECT COUNT(*) FROM f WHERE a IN ({a_vals}) AND b IN ({b_vals})"
+    text = "\n".join(r[0] for r in d.query("EXPLAIN " + sql))
+    assert "IndexScan" not in text  # 17*16 = 272 > 256 point cap
+    expect = sum(1 for i in range(400) if i % 20 < 17 and i % 17 < 16)
+    assert d.query(sql)[0][0] == expect
+
+
+def test_unsigned_point_beyond_int64():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE ub (id BIGINT PRIMARY KEY, a BIGINT, u BIGINT UNSIGNED, KEY kau (a, u))")
+    big = 2**63 + 5
+    d.execute(f"INSERT INTO ub VALUES (1, 1, {big}), (2, 1, 7)")
+    assert d.query(f"SELECT id FROM ub WHERE a = 1 AND u = {big}") == [(1,)]
+    assert d.query("SELECT id FROM ub WHERE a = 1 AND u = 7") == [(2,)]
+
+
+def test_negative_and_boundary_handles():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE n (a BIGINT PRIMARY KEY, b BIGINT, KEY kb (b))")
+    d.execute("INSERT INTO n VALUES (-5, -100), (0, 0), (5, 100)")
+    assert d.query("SELECT a FROM n WHERE b = -100") == [(-5,)]
+    assert d.query("SELECT a FROM n WHERE b >= 0 AND b <= 100 ORDER BY a") == [(0,), (5,)]

@@ -19,6 +19,7 @@ from tidb_tpu.expression.expr import _ft_pb, _ft_from_pb  # shared FieldType wir
 
 # executor types (ref: tipb.ExecType)
 TABLE_SCAN = "table_scan"
+INDEX_SCAN = "index_scan"
 SELECTION = "selection"
 AGGREGATION = "aggregation"  # hash agg
 STREAM_AGG = "stream_agg"
@@ -56,10 +57,15 @@ class ColumnInfoPB:
 @dataclass
 class ExecutorPB:
     tp: str
-    # table_scan
+    # table_scan / index_scan
     table_id: int = 0
     columns: list[ColumnInfoPB] = field(default_factory=list)
     desc: bool = False
+    # index_scan: which index, and the storage offsets of its key columns in
+    # key order (drives flagged-datum decode; ref: tipb.IndexScan)
+    index_id: int = 0
+    index_col_offsets: list[int] = field(default_factory=list)
+    unique: bool = False
     # full storage-slot schema of the table (rowcodec is schema-versioned,
     # not self-describing — decode needs every slot's type)
     storage_schema: list[FieldType] = field(default_factory=list)
@@ -96,6 +102,16 @@ class ExecutorPB:
                 storage_schema=[_ft_pb(ft) for ft in self.storage_schema],
                 domains=list(self.domains),
             )
+        elif self.tp == INDEX_SCAN:
+            d.update(
+                table_id=self.table_id,
+                index_id=self.index_id,
+                index_col_offsets=list(self.index_col_offsets),
+                unique=self.unique,
+                columns=[c.to_pb() for c in self.columns],
+                desc=self.desc,
+                storage_schema=[_ft_pb(ft) for ft in self.storage_schema],
+            )
         elif self.tp == SELECTION:
             d.update(conditions=self.conditions)
         elif self.tp in (AGGREGATION, STREAM_AGG):
@@ -117,6 +133,14 @@ class ExecutorPB:
             e.desc = pb.get("desc", False)
             e.storage_schema = [_ft_from_pb(f) for f in pb.get("storage_schema", [])]
             e.domains = pb.get("domains", [])
+        elif e.tp == INDEX_SCAN:
+            e.table_id = pb["table_id"]
+            e.index_id = pb["index_id"]
+            e.index_col_offsets = pb["index_col_offsets"]
+            e.unique = pb.get("unique", False)
+            e.columns = [ColumnInfoPB.from_pb(c) for c in pb["columns"]]
+            e.desc = pb.get("desc", False)
+            e.storage_schema = [_ft_from_pb(f) for f in pb.get("storage_schema", [])]
         elif e.tp == SELECTION:
             e.conditions = pb["conditions"]
         elif e.tp in (AGGREGATION, STREAM_AGG):

@@ -76,6 +76,57 @@ def _scan(store: MemStore, region: Region, ex: dagpb.ExecutorPB, ranges: list[Ke
     return Chunk(cols)
 
 
+def _index_scan(store: MemStore, region: Region, ex: dagpb.ExecutorPB, ranges: list[KeyRange], read_ts: int) -> Chunk:
+    """Scan index entries, decoding flagged datums from keys (ref: unistore
+    cophandler index scan; tablecodec index layout). Output columns are a
+    subset of the index's key columns plus the handle pseudo-column; rows come
+    back in index-key order (keep_order semantics)."""
+    from tidb_tpu.utils import codec as ucodec
+
+    snap = store.get_snapshot(read_ts)
+    prefix = tablecodec.index_prefix(ex.table_id, ex.index_id)
+    plen = len(prefix)
+    fts = [ex.storage_schema[off] for off in ex.index_col_offsets]
+    per_col: list[list] = [[] for _ in ex.index_col_offsets]
+    handles: list[int] = []
+    for kr in ranges:
+        rr = kr.intersect(region.range())
+        if rr is None:
+            continue
+        for k, v in snap.scan(rr):
+            off = plen
+            for ci in range(len(fts)):
+                val, off = ucodec.decode_key_one(k, off)
+                per_col[ci].append(val)
+            if off + 8 <= len(k):  # non-unique: handle suffix in key
+                handles.append(ucodec.decode_int_raw(k, off))
+            else:  # unique: handle in value
+                handles.append(ucodec.decode_int_raw(v))
+    n = len(handles)
+    by_offset = {off: i for i, off in enumerate(ex.index_col_offsets)}
+    cols = []
+    cache = cache_for(store)
+    for c in ex.columns:
+        if c.is_handle:
+            cols.append(Column(np.asarray(handles, np.int64), np.ones(n, bool), bigint_type(nullable=False)))
+            continue
+        vals = per_col[by_offset[c.column_id]]
+        valid = np.array([v is not None for v in vals], dtype=bool) if n else np.empty(0, bool)
+        if c.ftype.kind == TypeKind.STRING:
+            dic = cache.dictionary(ex.table_id, c.column_id)
+            data = np.array([0 if v is None else dic.encode(v) for v in vals], dtype=np.int32) if n else np.empty(0, np.int32)
+            cols.append(Column(data, valid, c.ftype, dic))
+        elif c.ftype.kind == TypeKind.FLOAT:
+            data = np.array([0.0 if v is None else float(v) for v in vals], dtype=np.float64) if n else np.empty(0, np.float64)
+            cols.append(Column(data, valid, c.ftype))
+        else:
+            data = np.array([0 if v is None else int(v) for v in vals], dtype=np.int64) if n else np.empty(0, np.int64)
+            cols.append(Column(data, valid, c.ftype))
+    if ex.desc:
+        cols = [Column(c.data[::-1], c.validity[::-1], c.ftype, c.dictionary) for c in cols]
+    return Chunk(cols)
+
+
 def _selection(chunk: Chunk, conditions: list[dict]) -> Chunk:
     if not len(chunk):
         return chunk
@@ -278,6 +329,9 @@ def run_operators(chunk: Chunk, executors: list, output_offsets: list[int]) -> C
 
 
 def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int) -> Chunk:
-    assert dag.executors and dag.executors[0].tp == dagpb.TABLE_SCAN
-    chunk = _scan(store, region, dag.executors[0], ranges, read_ts)
+    assert dag.executors and dag.executors[0].tp in (dagpb.TABLE_SCAN, dagpb.INDEX_SCAN)
+    if dag.executors[0].tp == dagpb.INDEX_SCAN:
+        chunk = _index_scan(store, region, dag.executors[0], ranges, read_ts)
+    else:
+        chunk = _scan(store, region, dag.executors[0], ranges, read_ts)
     return run_operators(chunk, dag.executors[1:], dag.output_offsets)

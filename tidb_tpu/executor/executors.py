@@ -21,6 +21,8 @@ from tidb_tpu.planner.plans import (
     PhysDual,
     PhysFinalAgg,
     PhysHashJoin,
+    PhysIndexLookUp,
+    PhysIndexReader,
     PhysLimit,
     PhysPointGet,
     PhysProjection,
@@ -66,6 +68,10 @@ def build_executor(plan, session) -> Executor:
         return DualExec(plan)
     if isinstance(plan, PhysPointGet):
         return PointGetExec(plan, session)
+    if isinstance(plan, PhysIndexReader):
+        return IndexReaderExec(plan, session)
+    if isinstance(plan, PhysIndexLookUp):
+        return IndexLookUpExec(plan, session)
     raise ExecError(f"no executor for {type(plan).__name__}")
 
 
@@ -167,6 +173,138 @@ class TableReaderExec(Executor):
         chunk = Chunk(cols)
         out = run_operators(chunk, dag.executors[1:], dag.output_offsets)
         return out if len(out.columns) else _empty_chunk(self.plan.schema)
+
+
+def _union_scan_fallback(session, table, scan_slots, conditions, schema) -> Chunk:
+    """Dirty-txn path shared by the index executors: index contents may lag
+    the membuffer, so read through a membuffer-merged table scan instead
+    (ref: UnionScanExec wrapping IndexReader/IndexLookUp)."""
+    reader = PhysTableReader(
+        db="",
+        table=table,
+        store_type=StoreType.HOST,
+        pushed_conditions=list(conditions),
+        scan_slots=list(scan_slots),
+        schema=schema,
+    )
+    return TableReaderExec(reader, session).execute()
+
+
+def _coalesce_handle_ranges(table_id: int, handles: np.ndarray) -> list:
+    """Sorted handles → minimal list of contiguous [lo, hi] key ranges."""
+    if len(handles) == 0:
+        return []
+    hs = np.unique(handles)  # sorts
+    breaks = np.nonzero(np.diff(hs) != 1)[0]
+    starts = np.concatenate(([0], breaks + 1))
+    ends = np.concatenate((breaks, [len(hs) - 1]))
+    return [tablecodec.handle_range(table_id, int(hs[s]), int(hs[e])) for s, e in zip(starts, ends)]
+
+
+@dataclass
+class IndexReaderExec(Executor):
+    """Covering-index read (ref: IndexReaderExecutor, distsql.go)."""
+
+    plan: PhysIndexReader
+    session: object
+
+    def __post_init__(self):
+        self.schema = self.plan.schema
+
+    def execute(self) -> Chunk:
+        p = self.plan
+        if self.session._txn_dirty():
+            return _union_scan_fallback(
+                self.session, p.table, [oc.slot for oc in p.schema], p.all_conditions, p.schema
+            )
+        if not p.ranges:
+            return _empty_chunk(p.schema)
+        t = p.table
+        cols = []
+        for pos, slot in enumerate(p.output_slots):
+            if slot == -1:
+                cols.append(dagpb.ColumnInfoPB(-1, bigint_type(nullable=False), is_handle=True))
+            else:
+                cols.append(dagpb.ColumnInfoPB(slot, t.columns[slot].ftype))
+        scan = dagpb.ExecutorPB(
+            dagpb.INDEX_SCAN,
+            table_id=t.id,
+            index_id=p.index.id,
+            index_col_offsets=list(p.index.column_offsets),
+            unique=p.index.unique,
+            columns=cols,
+            storage_schema=t.storage_schema,
+        )
+        executors = [scan]
+        if p.pushed_conditions:
+            executors.append(dagpb.ExecutorPB(dagpb.SELECTION, conditions=[c.to_pb() for c in p.pushed_conditions]))
+        req = Request(
+            tp=RequestType.DAG,
+            data=dagpb.DAGRequest(executors=executors),
+            ranges=p.ranges,
+            store_type=StoreType.HOST,
+            start_ts=self.session.read_ts(),
+            concurrency=int(self.session.vars.get("tidb_distsql_scan_concurrency", 8)),
+            keep_order=True,
+        )
+        chunks = [res.chunk for res in self.session.store.get_client().send(req) if len(res.chunk)]
+        if not chunks:
+            return _empty_chunk(p.schema)
+        return Chunk.concat(chunks) if len(chunks) > 1 else chunks[0]
+
+
+@dataclass
+class IndexLookUpExec(Executor):
+    """Index scan → handle collection → batched table row fetch
+    (ref: IndexLookUpExecutor's index worker + table worker pipeline)."""
+
+    plan: PhysIndexLookUp
+    session: object
+
+    def __post_init__(self):
+        self.schema = self.plan.schema
+
+    def execute(self) -> Chunk:
+        p = self.plan
+        if self.session._txn_dirty():
+            return _union_scan_fallback(self.session, p.table, p.scan_slots, p.all_conditions, p.schema)
+        if not p.ranges:
+            return _empty_chunk(p.schema)
+        t = p.table
+        # phase 1: index side — handles only
+        scan = dagpb.ExecutorPB(
+            dagpb.INDEX_SCAN,
+            table_id=t.id,
+            index_id=p.index.id,
+            index_col_offsets=list(p.index.column_offsets),
+            unique=p.index.unique,
+            columns=[dagpb.ColumnInfoPB(-1, bigint_type(nullable=False), is_handle=True)],
+            storage_schema=t.storage_schema,
+        )
+        req = Request(
+            tp=RequestType.DAG,
+            data=dagpb.DAGRequest(executors=[scan]),
+            ranges=p.ranges,
+            store_type=StoreType.HOST,
+            start_ts=self.session.read_ts(),
+            concurrency=int(self.session.vars.get("tidb_distsql_scan_concurrency", 8)),
+        )
+        handle_chunks = [res.chunk for res in self.session.store.get_client().send(req) if len(res.chunk)]
+        if not handle_chunks:
+            return _empty_chunk(p.schema)
+        handles = np.concatenate([c.columns[0].data for c in handle_chunks])
+        # phase 2: table side — fetch rows by coalesced handle ranges with
+        # residual filters pushed (ref: buildTableReaderForIndexJoin)
+        reader = PhysTableReader(
+            db=p.db,
+            table=t,
+            store_type=StoreType.HOST,
+            pushed_conditions=list(p.residual_conditions),
+            scan_slots=list(p.scan_slots),
+            ranges=_coalesce_handle_ranges(t.id, handles),
+            schema=p.schema,
+        )
+        return TableReaderExec(reader, self.session).execute()
 
 
 @dataclass

@@ -17,6 +17,7 @@ from typing import Optional
 from tidb_tpu.expression.expr import AggDesc, ColumnRef, Constant, Expression, ScalarFunc, can_push_down
 from tidb_tpu.kv import tablecodec
 from tidb_tpu.kv.kv import KeyRange, StoreType
+from tidb_tpu.planner import ranger
 from tidb_tpu.planner.plans import (
     LogicalAggregation,
     LogicalDistinct,
@@ -33,6 +34,8 @@ from tidb_tpu.planner.plans import (
     PhysDistinct,
     PhysFinalAgg,
     PhysHashJoin,
+    PhysIndexLookUp,
+    PhysIndexReader,
     PhysLimit,
     PhysPointGet,
     PhysProjection,
@@ -235,6 +238,64 @@ def _try_point_get(plan: LogicalPlan):
 
 
 # ---------------------------------------------------------------------------
+# access-path selection (ref: planbuilder getPossibleAccessPaths +
+# find_best_task skyline pruning, heuristics-only until statistics land)
+# ---------------------------------------------------------------------------
+
+
+def _choose_index_path(scan: LogicalScan, conds: list[Expression]):
+    """Pick an index path when some index has point (eq/IN) conditions on its
+    leading column(s) — without statistics this is the only case where an
+    index is reliably cheaper than a columnar full scan. PK handle ranges are
+    handled by _derive_ranges on the table-reader path."""
+    t = scan.table
+    best = None  # (eq_prefix_len, unique, has_range, IndexAccess)
+    for idx in t.indexes:
+        acc = ranger.detach_index_conditions(conds, scan.schema, t, idx)
+        if acc is None or acc.eq_prefix_len == 0:
+            continue
+        key = (acc.eq_prefix_len, idx.unique, acc.has_range)
+        if best is None or key > best[0]:
+            best = (key, acc)
+    if best is None:
+        return None
+    # PK point conditions beat any secondary index (handled downstream)
+    if t.pk_is_handle:
+        hr = ranger.derive_handle_ranges(conds, scan.schema, t)
+        if hr is not None and hr[1] == 1:
+            return None
+    acc = best[1]
+    covering = all(
+        oc.slot in acc.index.column_offsets or (t.pk_is_handle and oc.slot == t.pk_offset)
+        for oc in scan.schema
+    )
+    if covering:
+        output_slots = [
+            -1 if (t.pk_is_handle and oc.slot == t.pk_offset) else oc.slot for oc in scan.schema
+        ]
+        return PhysIndexReader(
+            db=scan.db,
+            table=t,
+            index=acc.index,
+            ranges=acc.ranges,
+            output_slots=output_slots,
+            pushed_conditions=list(acc.residual),
+            all_conditions=list(conds),
+            schema=scan.schema,
+        )
+    return PhysIndexLookUp(
+        db=scan.db,
+        table=t,
+        index=acc.index,
+        ranges=acc.ranges,
+        scan_slots=[oc.slot for oc in scan.schema],
+        residual_conditions=list(acc.residual),
+        all_conditions=list(conds),
+        schema=scan.schema,
+    )
+
+
+# ---------------------------------------------------------------------------
 # physical planning
 # ---------------------------------------------------------------------------
 
@@ -306,6 +367,10 @@ def _physical(plan: LogicalPlan, engines: list[str]) -> PhysicalPlan:
         )
         return reader
     if isinstance(plan, LogicalSelection):
+        if isinstance(plan.children[0], LogicalScan):
+            ipath = _choose_index_path(plan.children[0], plan.conditions)
+            if ipath is not None:
+                return ipath
         child = _physical(plan.children[0], engines)
         if isinstance(child, PhysTableReader) and child.pushed_agg is None and child.pushed_topn is None and child.pushed_limit is None:
             st = _pick_engine(engines, plan.conditions)

@@ -154,6 +154,46 @@ class PhysTableReader(PhysicalPlan):
 
 
 @dataclass
+class PhysIndexReader(PhysicalPlan):
+    """Covering-index scan: every needed column lives in the index key (or is
+    the handle), so no table lookup happens (ref: PhysicalIndexReader).
+    Index scans are served by the host engine only — the TPU engine, like
+    TiFlash, serves columnar table fragments (planbuilder engine isolation)."""
+
+    db: str
+    table: TableInfo
+    index: object  # IndexInfo
+    ranges: list[KeyRange] = field(default_factory=list)
+    # outputs, in scan-schema order: storage slot per column (-1 == handle)
+    output_slots: list[int] = field(default_factory=list)
+    # residual filters; ColumnRefs index into the output schema
+    pushed_conditions: list[Expression] = field(default_factory=list)
+    # union-scan fallback (dirty txn): the original conditions over the same
+    # schema, replayed host-side over a membuffer-merged table scan
+    all_conditions: list[Expression] = field(default_factory=list)
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+
+@dataclass
+class PhysIndexLookUp(PhysicalPlan):
+    """Two-phase read: index scan yields handles, table side fetches rows and
+    applies residual filters (ref: PhysicalIndexLookUpReader / IndexLookUp
+    double worker pipeline, executor/distsql.go:439)."""
+
+    db: str
+    table: TableInfo
+    index: object  # IndexInfo
+    ranges: list[KeyRange] = field(default_factory=list)
+    scan_slots: list[int] = field(default_factory=list)  # table-side outputs
+    # residual filters over the table-side scan schema
+    residual_conditions: list[Expression] = field(default_factory=list)
+    all_conditions: list[Expression] = field(default_factory=list)
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+
+@dataclass
 class PhysSelection(PhysicalPlan):
     conditions: list[Expression]
     children: list = field(default_factory=list)
@@ -269,6 +309,12 @@ def explain_plan(p, indent: int = 0) -> str:
         extra = f"{p.kind} on {p.eq_conds}"
     elif isinstance(p, PhysPointGet):
         extra = f"{p.table.name} handle={p.handle}"
+    elif isinstance(p, PhysIndexReader):
+        conds = f" -> Selection({', '.join(map(repr, p.pushed_conditions))})" if p.pushed_conditions else ""
+        extra = f"[host] {p.table.name}: IndexScan({p.index.name}, {len(p.ranges)} ranges){conds}"
+    elif isinstance(p, PhysIndexLookUp):
+        conds = f" -> Selection({', '.join(map(repr, p.residual_conditions))})" if p.residual_conditions else ""
+        extra = f"[host] {p.table.name}: IndexScan({p.index.name}, {len(p.ranges)} ranges) -> TableRowIDScan{conds}"
     lines = [f"{pad}{name} {extra}".rstrip()]
     for c in getattr(p, "children", []):
         lines.append(explain_plan(c, indent + 1))
