@@ -152,6 +152,23 @@ def test_unsigned_point_beyond_int64():
     assert d.query("SELECT id FROM ub WHERE a = 1 AND u = 7") == [(2,)]
 
 
+def test_out_of_domain_range_bounds_match_nothing():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE o (a BIGINT PRIMARY KEY, b BIGINT, c BIGINT, KEY kbc (b, c))")
+    d.execute("INSERT INTO o VALUES (1, 1, 10), (2, 1, 20)")
+    assert d.query("SELECT a FROM o WHERE b = 1 AND c > 9223372036854775807") == []
+    assert d.query("SELECT a FROM o WHERE b = 1 AND c < -9223372036854775808") == []
+    assert d.query("SELECT a FROM o WHERE b = 1 AND c >= -9223372036854775808 ORDER BY a") == [(1,), (2,)]
+
+
+def test_upper_bound_range_excludes_nulls():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE z (id BIGINT PRIMARY KEY, a BIGINT, b BIGINT, KEY kab (a, b))")
+    d.execute("INSERT INTO z VALUES (1, 1, 50), (2, 1, NULL)")
+    assert d.query("SELECT id FROM z WHERE a = 1 AND b <= 100") == [(1,)]
+    assert d.query("SELECT id FROM z WHERE a = 1 AND b >= 0") == [(1,)]
+
+
 def test_negative_and_boundary_handles():
     d = tidb_tpu.open()
     d.execute("CREATE TABLE n (a BIGINT PRIMARY KEY, b BIGINT, KEY kb (b))")

@@ -1,0 +1,207 @@
+"""Pessimistic transactions, deadlock detection, GC
+(ref: tests/realtikvtest/pessimistictest, unistore detector tests,
+store/gcworker)."""
+
+import threading
+import time
+
+import pytest
+
+import tidb_tpu
+from tidb_tpu.kv.kv import DeadlockError, LockWaitTimeoutError, WriteConflictError
+
+
+@pytest.fixture()
+def db():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE acct (id BIGINT PRIMARY KEY, bal BIGINT)")
+    d.execute("INSERT INTO acct VALUES (1, 100), (2, 200)")
+    return d
+
+
+def test_optimistic_conflict_aborts_second_committer(db):
+    s1, s2 = db.session(), db.session()
+    s1.execute("BEGIN OPTIMISTIC")
+    s2.execute("BEGIN OPTIMISTIC")
+    s1.execute("UPDATE acct SET bal = bal + 10 WHERE id = 1")
+    s2.execute("UPDATE acct SET bal = bal + 5 WHERE id = 1")
+    s1.execute("COMMIT")
+    with pytest.raises(WriteConflictError):
+        s2.execute("COMMIT")
+    assert db.query("SELECT bal FROM acct WHERE id = 1") == [(110,)]
+
+
+def test_pessimistic_serializes_increments(db):
+    """The classic lost-update: both add to the same balance; pessimistic
+    locks + current read make the increments compose."""
+    s1, s2 = db.session(), db.session()
+    s1.execute("BEGIN PESSIMISTIC")
+    s1.execute("UPDATE acct SET bal = bal + 10 WHERE id = 1")  # locks row 1
+
+    errs = []
+    done = threading.Event()
+
+    def second():
+        try:
+            s2.execute("BEGIN PESSIMISTIC")
+            s2.execute("UPDATE acct SET bal = bal + 5 WHERE id = 1")  # blocks
+            s2.execute("COMMIT")
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+        finally:
+            done.set()
+
+    th = threading.Thread(target=second)
+    th.start()
+    time.sleep(0.1)  # let s2 reach the lock wait
+    assert not done.is_set(), "s2 should be blocked on s1's lock"
+    s1.execute("COMMIT")
+    th.join(timeout=5)
+    assert done.is_set() and not errs, errs
+    assert db.query("SELECT bal FROM acct WHERE id = 1") == [(115,)]
+
+
+def test_lock_wait_timeout(db):
+    s1, s2 = db.session(), db.session()
+    s2.execute("SET innodb_lock_wait_timeout = 0.15")
+    s1.execute("BEGIN PESSIMISTIC")
+    s1.execute("UPDATE acct SET bal = 0 WHERE id = 1")
+    s2.execute("BEGIN PESSIMISTIC")
+    with pytest.raises(LockWaitTimeoutError):
+        s2.execute("UPDATE acct SET bal = 1 WHERE id = 1")
+    s1.execute("ROLLBACK")
+    # after release s2 can proceed (statement error did not kill the txn)
+    s2.execute("UPDATE acct SET bal = 1 WHERE id = 1")
+    s2.execute("COMMIT")
+    assert db.query("SELECT bal FROM acct WHERE id = 1") == [(1,)]
+
+
+def test_deadlock_detected(db):
+    s1, s2 = db.session(), db.session()
+    s1.execute("BEGIN PESSIMISTIC")
+    s2.execute("BEGIN PESSIMISTIC")
+    s1.execute("UPDATE acct SET bal = bal + 1 WHERE id = 1")  # s1 holds row 1
+    s2.execute("UPDATE acct SET bal = bal + 1 WHERE id = 2")  # s2 holds row 2
+
+    res = {}
+
+    def s1_waits():
+        try:
+            s1.execute("UPDATE acct SET bal = bal + 1 WHERE id = 2")  # waits on s2
+            res["s1"] = "ok"
+        except Exception as e:
+            res["s1"] = e
+
+    th = threading.Thread(target=s1_waits)
+    th.start()
+    time.sleep(0.05)
+    with pytest.raises(DeadlockError):
+        s2.execute("UPDATE acct SET bal = bal + 1 WHERE id = 1")  # closes the cycle
+    s2.execute("ROLLBACK")  # victim releases its locks
+    th.join(timeout=5)
+    assert res.get("s1") == "ok", res
+    s1.execute("COMMIT")
+    assert db.query("SELECT bal, id FROM acct ORDER BY id") == [(101, 1), (201, 2)]
+
+
+def test_select_for_update_locks_rows(db):
+    s1, s2 = db.session(), db.session()
+    s2.execute("SET innodb_lock_wait_timeout = 0.15")
+    s1.execute("BEGIN PESSIMISTIC")
+    assert s1.query("SELECT bal FROM acct WHERE id = 1 FOR UPDATE") == [(100,)]
+    s2.execute("BEGIN PESSIMISTIC")
+    with pytest.raises(LockWaitTimeoutError):
+        s2.execute("UPDATE acct SET bal = 0 WHERE id = 1")
+    # unlocked row still writable
+    s2.execute("UPDATE acct SET bal = 0 WHERE id = 2")
+    s2.execute("COMMIT")
+    s1.execute("COMMIT")
+    assert db.query("SELECT bal FROM acct WHERE id = 2") == [(0,)]
+
+
+def test_pessimistic_locks_invisible_to_readers(db):
+    s1, s2 = db.session(), db.session()
+    s1.execute("BEGIN PESSIMISTIC")
+    s1.query("SELECT bal FROM acct WHERE id = 1 FOR UPDATE")
+    # plain read does not block on the pessimistic (lock-only) lock
+    assert s2.query("SELECT bal FROM acct WHERE id = 1") == [(100,)]
+    s1.execute("ROLLBACK")
+
+
+def test_current_read_sees_committed_update(db):
+    s1, s2 = db.session(), db.session()
+    s1.execute("BEGIN PESSIMISTIC")
+    s1.query("SELECT 1")  # pin start_ts before s2's commit
+    s2.execute("UPDATE acct SET bal = 500 WHERE id = 1")  # autocommit
+    # snapshot read still sees the old value...
+    assert s1.query("SELECT bal FROM acct WHERE id = 1") == [(100,)]
+    # ...but UPDATE computes from the current (locked) value
+    s1.execute("UPDATE acct SET bal = bal + 1 WHERE id = 1")
+    s1.execute("COMMIT")
+    assert db.query("SELECT bal FROM acct WHERE id = 1") == [(501,)]
+
+
+def test_for_update_is_current_read(db):
+    s1 = db.session()
+    s1.execute("BEGIN PESSIMISTIC")
+    s1.query("SELECT 1")  # pin start_ts
+    db.execute("UPDATE acct SET bal = 500 WHERE id = 1")  # other session commits
+    assert s1.query("SELECT bal FROM acct WHERE id = 1") == [(100,)]  # snapshot
+    assert s1.query("SELECT bal FROM acct WHERE id = 1 FOR UPDATE") == [(500,)]
+    s1.execute("ROLLBACK")
+
+
+def test_pessimistic_insert_sees_committed_duplicate(db):
+    import tidb_tpu.executor.write as w
+
+    s1 = db.session()
+    s1.execute("BEGIN PESSIMISTIC")
+    s1.query("SELECT 1")  # pin start_ts
+    db.execute("INSERT INTO acct VALUES (5, 100)")  # commits after s1 began
+    with pytest.raises(w.DupKeyError):
+        s1.execute("INSERT INTO acct VALUES (5, 999)")
+    s1.execute("ROLLBACK")
+    assert db.query("SELECT bal FROM acct WHERE id = 5") == [(100,)]
+
+
+def test_failed_multi_key_lock_releases_partial_locks(db):
+    s1, s2, s3 = db.session(), db.session(), db.session()
+    s2.execute("SET innodb_lock_wait_timeout = 0.1")
+    s3.execute("SET innodb_lock_wait_timeout = 0.5")
+    s1.execute("BEGIN PESSIMISTIC")
+    s1.execute("UPDATE acct SET bal = 0 WHERE id = 2")  # s1 holds row 2
+    s2.execute("BEGIN PESSIMISTIC")
+    with pytest.raises(LockWaitTimeoutError):
+        s2.execute("UPDATE acct SET bal = 1 WHERE id IN (1, 2)")  # locks 1, times out on 2
+    s2.execute("ROLLBACK")
+    # row 1's lock from s2's failed statement must be gone
+    s3.execute("BEGIN PESSIMISTIC")
+    s3.execute("UPDATE acct SET bal = 7 WHERE id = 1")
+    s3.execute("COMMIT")
+    s1.execute("ROLLBACK")
+    assert db.query("SELECT bal FROM acct WHERE id = 1") == [(7,)]
+
+
+def test_gc_prunes_old_versions(db):
+    for i in range(20):
+        db.execute(f"UPDATE acct SET bal = {i} WHERE id = 1")
+    store = db.store
+    key_versions_before = max(len(w) for w in store._writes.values())
+    assert key_versions_before > 10
+    pruned = db.run_gc(safe_point=store.current_ts())
+    assert pruned > 0
+    assert db.query("SELECT bal FROM acct WHERE id = 1") == [(19,)]
+    # deleted rows vanish entirely after GC
+    db.execute("DELETE FROM acct WHERE id = 2")
+    db.run_gc(safe_point=store.current_ts())
+    assert db.query("SELECT COUNT(*) FROM acct") == [(1,)]
+
+
+def test_gc_worker_thread(db):
+    from tidb_tpu.kv.gcworker import GCWorker
+
+    w = GCWorker(db.store, life_ms=0, interval_s=0.02)
+    w.start()
+    time.sleep(0.1)
+    w.stop()
+    assert w.runs >= 1

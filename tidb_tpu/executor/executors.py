@@ -621,7 +621,14 @@ class PointGetExec(Executor):
     def execute(self) -> Chunk:
         t = self.plan.table
         txn = self.session.txn_for_read()
-        raw = txn.get(tablecodec.record_key(t.id, self.plan.handle))
+        rk = tablecodec.record_key(t.id, self.plan.handle)
+        if txn.membuf.contains(rk):
+            raw = txn.membuf.get(rk)
+        else:
+            # honors current-read overrides (FOR UPDATE at for_update_ts)
+            from tidb_tpu.kv.memstore import Snapshot
+
+            raw = txn._retry_locked(lambda: Snapshot(self.session.store, self.session.read_ts()).get(rk))
         slots = getattr(self.plan, "scan_slots", list(range(len(t.columns))))
         if raw is None:
             return _empty_chunk(self.plan.schema)

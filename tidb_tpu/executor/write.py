@@ -91,12 +91,27 @@ def index_entry(t: TableInfo, idx: IndexInfo, vals: list, handle: int) -> tuple[
     return tablecodec.index_key(t.id, idx.id, bytes(enc), handle), b"0"
 
 
+def _txn_read(session, key: bytes):
+    """Read through the membuffer; in an explicit pessimistic txn the base
+    snapshot is for_update_ts (current read), else start_ts. Constraint
+    checks must see rows committed after txn start once the key is locked."""
+    txn = session.txn()
+    if txn.membuf.contains(key):
+        return txn.membuf.get(key)
+    if session._explicit and txn.pessimistic:
+        from tidb_tpu.kv.memstore import Snapshot
+
+        return Snapshot(session.store, txn.for_update_ts).get(key)
+    return txn.get(key)
+
+
 def _write_row(session, t: TableInfo, vals: list, handle: int, on_dup: Optional[str] = None) -> int:
     """Stage one row + its index entries; returns rows affected."""
     txn = session.txn()
     schema = RowSchema(t.storage_schema)
     rk = tablecodec.record_key(t.id, handle)
-    existing = txn.get(rk)
+    session.lock_for_write([rk])  # pessimistic stmt-time lock (no-op otherwise)
+    existing = _txn_read(session, rk)
     if existing is not None:
         if on_dup == "replace":
             _delete_row(session, t, decode_row(schema, existing), handle)
@@ -111,11 +126,11 @@ def _write_row(session, t: TableInfo, vals: list, handle: int, on_dup: Optional[
         ik, _ = index_entry(t, idx, vals, handle)
         if any(vals[o] is None for o in idx.column_offsets):
             continue  # NULL never conflicts
-        hit = txn.get(ik)
+        hit = _txn_read(session, ik)
         if hit is not None:
             if on_dup == "replace":
                 old_handle = codec.decode_int_raw(hit)
-                old_raw = txn.get(tablecodec.record_key(t.id, old_handle))
+                old_raw = _txn_read(session, tablecodec.record_key(t.id, old_handle))
                 if old_raw is not None:
                     _delete_row(session, t, decode_row(schema, old_raw), old_handle)
             elif on_dup == "ignore":
@@ -131,6 +146,7 @@ def _write_row(session, t: TableInfo, vals: list, handle: int, on_dup: Optional[
 
 def _delete_row(session, t: TableInfo, vals: list, handle: int) -> None:
     txn = session.txn()
+    session.lock_for_write([tablecodec.record_key(t.id, handle)])
     txn.delete(tablecodec.record_key(t.id, handle))
     for idx in t.indexes:
         ik, _ = index_entry(t, idx, vals, handle)
@@ -210,11 +226,13 @@ def execute_insert(session, stmt: ast.Insert) -> int:
 
 
 def _scan_visible_rows(session, t: TableInfo):
-    """All rows visible to the txn (membuffer overlaid) → (handles, rows)."""
+    """All rows visible to the txn (membuffer overlaid) → (handles, rows).
+    The base snapshot follows session.read_ts() so FOR UPDATE current reads
+    apply inside dirty transactions too."""
     txn = session.txn()
     schema = RowSchema(t.storage_schema)
     handles, rows = [], []
-    for k, v in txn.scan(tablecodec.record_range(t.id)):
+    for k, v in txn.scan(tablecodec.record_range(t.id), read_ts=session.read_ts()):
         handles.append(tablecodec.decode_record_key(k)[1])
         rows.append(decode_row(schema, v))
     return handles, rows
@@ -261,6 +279,45 @@ def _where_mask(session, t: TableInfo, chunk: Chunk, where, db: str, alias: str)
     return (col.data != 0) & col.validity
 
 
+def _pessimistic_current_read(session, t: TableInfo, handles, rows, chunk, idxs, where, db, alias):
+    """Lock the matched rows, then re-read them at for_update_ts and re-apply
+    the WHERE filter — the "current read" that makes pessimistic UPDATE/DELETE
+    see the latest committed values instead of the start_ts snapshot
+    (ref: sessiontxn/isolation pessimistic provider's for-update read).
+    Returns (idxs, rows, chunk), possibly updated in place."""
+    txn = session._txn
+    if not (session._explicit and txn is not None and txn.pessimistic) or len(idxs) == 0:
+        return idxs, rows, chunk
+    from tidb_tpu.kv.memstore import Snapshot
+
+    keys = [tablecodec.record_key(t.id, handles[int(i)]) for i in idxs]
+    session.lock_for_write(keys)
+    snap = Snapshot(session.store, txn.for_update_ts)
+    schema = RowSchema(t.storage_schema)
+    changed = False
+    live = []
+    for i in idxs:
+        rk = tablecodec.record_key(t.id, handles[int(i)])
+        if txn.membuf.contains(rk):
+            raw = txn.membuf.get(rk)
+        else:
+            raw = snap.get(rk)
+        if raw is None:  # deleted underneath us after the lock
+            changed = True
+            continue
+        fresh = decode_row(schema, raw)
+        if fresh != rows[int(i)]:
+            rows[int(i)] = fresh
+            changed = True
+        live.append(i)
+    idxs = np.asarray(live, dtype=np.int64)
+    if changed:
+        chunk = _rows_to_chunk(session, t, rows)
+        mask = _where_mask(session, t, chunk, where, db, alias)
+        idxs = np.asarray([i for i in idxs if mask[int(i)]], dtype=np.int64)
+    return idxs, rows, chunk
+
+
 def execute_update(session, stmt: ast.Update) -> int:
     db = stmt.table.db or session.current_db
     t = session.catalog.table(db, stmt.table.name)
@@ -281,6 +338,7 @@ def execute_update(session, stmt: ast.Update) -> int:
         idxs = idxs[sort_perm(sub, by)]
     if stmt.limit is not None:
         idxs = idxs[: stmt.limit]
+    idxs, rows, chunk = _pessimistic_current_read(session, t, handles, rows, chunk, idxs, stmt.where, db, alias)
 
     # evaluate assignment expressions over the full chunk (row values)
     builder = Builder(session.catalog, db, subquery_runner=session._subquery_runner)
@@ -335,6 +393,7 @@ def execute_delete(session, stmt: ast.Delete) -> int:
         idxs = idxs[sort_perm(sub, by)]
     if stmt.limit is not None:
         idxs = idxs[: stmt.limit]
+    idxs, rows, chunk = _pessimistic_current_read(session, t, handles, rows, chunk, idxs, stmt.where, db, alias)
     for i in idxs:
-        _delete_row(session, t, rows[i], handles[i])
+        _delete_row(session, t, rows[int(i)], handles[int(i)])
     return int(len(idxs))

@@ -126,3 +126,18 @@ class KeyLockedError(KVError):
 
 class TxnAbortedError(KVError):
     pass
+
+
+class DeadlockError(KVError):
+    """Raised to the waiter whose lock request closes a wait-for cycle
+    (ref: unistore/tikv/detector.go, kvproto Deadlock)."""
+
+    def __init__(self, waiter_ts: int, holder_ts: int, key: bytes):
+        super().__init__(f"deadlock: txn {waiter_ts} waiting for txn {holder_ts} on {key!r}")
+        self.waiter_ts, self.holder_ts, self.key = waiter_ts, holder_ts, key
+
+
+class LockWaitTimeoutError(KVError):
+    def __init__(self, key: bytes):
+        super().__init__(f"lock wait timeout on {key!r}")
+        self.key = key

@@ -29,15 +29,18 @@ import numpy as np
 from tidb_tpu.kv.kv import (
     KeyLockedError,
     KeyRange,
+    LockWaitTimeoutError,
     StoreType,
     TimestampOracle,
     TxnAbortedError,
     WriteConflictError,
 )
+from tidb_tpu.kv.detector import DeadlockDetector
 from tidb_tpu.kv import tablecodec
 
 OP_PUT = "P"
 OP_DEL = "D"
+OP_PESSIMISTIC_LOCK = "L"  # lock-only; carries no data, invisible to readers
 
 
 @dataclass(frozen=True)
@@ -225,6 +228,7 @@ class MemStore:
         self._next_region_id = 2
         self.pd = PlacementDriver(self)
         self._client = None  # installed by copr.CopClient wiring
+        self.detector = DeadlockDetector()
 
     # -- kv.Storage surface ------------------------------------------------
     def current_ts(self) -> int:
@@ -302,7 +306,8 @@ class MemStore:
     # -- percolator (server side; ref: mvcc.go:768 Prewrite, :1240 Commit) --
     def _check_lock(self, key: bytes, read_ts: int) -> None:
         lock = self._locks.get(key)
-        if lock is not None and lock.start_ts <= read_ts:
+        if lock is not None and lock.start_ts <= read_ts and lock.op != OP_PESSIMISTIC_LOCK:
+            # pessimistic (lock-only) locks carry no data → readers pass
             raise KeyLockedError(key, lock)
 
     def prewrite(self, mutations: Sequence[Mutation], primary: bytes, start_ts: int) -> None:
@@ -311,6 +316,10 @@ class MemStore:
                 lock = self._locks.get(m.key)
                 if lock is not None and lock.start_ts != start_ts:
                     raise KeyLockedError(m.key, lock)
+                if lock is not None and lock.op == OP_PESSIMISTIC_LOCK:
+                    # upgrading our own pessimistic lock: the conflict window
+                    # was already checked against for_update_ts at lock time
+                    continue
                 writes = self._writes.get(m.key)
                 if writes and writes[-1].commit_ts > start_ts:
                     raise WriteConflictError(m.key, writes[-1].commit_ts, start_ts)
@@ -328,6 +337,74 @@ class MemStore:
                     ttl_ms=self.lock_ttl_ms,
                     created_ms=now_ms,
                 )
+
+    def acquire_pessimistic_lock(
+        self,
+        keys: Sequence[bytes],
+        primary: bytes,
+        start_ts: int,
+        for_update_ts: int,
+        wait_timeout_ms: int = 3000,
+    ) -> None:
+        """Statement-time lock acquisition (ref: unistore mvcc.go
+        PessimisticLock). Blocks (polling) on foreign locks until timeout;
+        wait edges feed the deadlock detector, whose victim is the requester
+        that closes a cycle. Write-conflict check runs against for_update_ts,
+        not start_ts — that is what lets pessimistic txns proceed where
+        optimistic ones must restart."""
+        import time
+
+        deadline = time.time() * 1000 + wait_timeout_ms
+        placed: list[bytes] = []  # locks created by THIS call, for unwind
+        try:
+            for key in keys:
+                while True:
+                    with self._mu:
+                        lock = self._locks.get(key)
+                        if lock is None or lock.start_ts == start_ts:
+                            writes = self._writes.get(key)
+                            if writes and writes[-1].commit_ts > for_update_ts:
+                                raise WriteConflictError(key, writes[-1].commit_ts, start_ts)
+                            if start_ts in self._rollbacks.get(key, ()):
+                                raise TxnAbortedError(f"txn {start_ts} already rolled back at {key!r}")
+                            if lock is None:  # keep prewrite-upgraded locks as-is
+                                self._locks[key] = Lock(
+                                    primary=primary,
+                                    start_ts=start_ts,
+                                    op=OP_PESSIMISTIC_LOCK,
+                                    value=b"",
+                                    ttl_ms=self.lock_ttl_ms,
+                                    created_ms=time.time() * 1000,
+                                )
+                                placed.append(key)
+                            self.detector.unregister(start_ts)
+                            break
+                        holder = lock.start_ts
+                        expired = lock.expired()
+                    # outside the store lock: deadlock check, resolution, backoff
+                    self.detector.register(start_ts, holder, key)
+                    if expired:
+                        self.resolve_lock(key, lock)
+                        continue
+                    if time.time() * 1000 >= deadline:
+                        self.detector.unregister(start_ts)
+                        raise LockWaitTimeoutError(key)
+                    time.sleep(0.002)
+        except Exception:
+            # a failed statement must not leave locks the caller doesn't
+            # know about (it only records keys on full success)
+            self.pessimistic_rollback(placed, start_ts)
+            raise
+
+    def pessimistic_rollback(self, keys: Sequence[bytes], start_ts: int) -> None:
+        """Release lock-only locks without leaving rollback tombstones (the
+        txn may still commit other keys)."""
+        with self._mu:
+            for k in keys:
+                lock = self._locks.get(k)
+                if lock is not None and lock.start_ts == start_ts and lock.op == OP_PESSIMISTIC_LOCK:
+                    del self._locks[k]
+        self.detector.clean_up(start_ts)
 
     def commit(self, keys: Sequence[bytes], start_ts: int, commit_ts: int) -> None:
         with self._mu:

@@ -74,15 +74,48 @@ class MemBuffer:
 
 class Txn:
     """One transaction. Reads go to a start_ts snapshot overlaid with the
-    membuffer; commit runs percolator 2PC against the store."""
+    membuffer; commit runs percolator 2PC against the store. In pessimistic
+    mode, lock_keys acquires statement-time locks (ref: client-go
+    LockKeys + sessiontxn/isolation pessimistic provider)."""
 
-    def __init__(self, store: MemStore, start_ts: Optional[int] = None):
+    def __init__(self, store: MemStore, start_ts: Optional[int] = None, pessimistic: bool = False):
         self.store = store
         self.start_ts = start_ts if start_ts is not None else store.tso.ts()
         self.snapshot = Snapshot(store, self.start_ts)
         self.membuf = MemBuffer()
         self.commit_ts: Optional[int] = None
         self._done = False
+        self.pessimistic = pessimistic
+        self.for_update_ts = self.start_ts
+        self._locked_keys: set[bytes] = set()
+        self._pess_primary: Optional[bytes] = None
+
+    # -- pessimistic locking ------------------------------------------------
+    def lock_keys(self, keys, wait_timeout_ms: int = 3000) -> None:
+        """Acquire pessimistic locks at a fresh for_update_ts. No-op for
+        optimistic txns (commit-time conflict detection covers them)."""
+        if not self.pessimistic or not keys:
+            return
+        new = [k for k in keys if k not in self._locked_keys]
+        if not new:
+            return
+        if self._pess_primary is None:
+            self._pess_primary = new[0]
+        # a conflicting commit can land while we wait on its lock; refresh
+        # for_update_ts and retry (ref: pessimistic lock retry in
+        # session/txn pessimistic mode — the statement, not the txn, restarts)
+        last: Exception | None = None
+        for _ in range(8):
+            self.for_update_ts = self.store.tso.ts()
+            try:
+                self.store.acquire_pessimistic_lock(
+                    new, self._pess_primary, self.start_ts, self.for_update_ts, wait_timeout_ms
+                )
+                self._locked_keys.update(new)
+                return
+            except WriteConflictError as e:
+                last = e
+        raise last  # type: ignore[misc]
 
     # -- reads -------------------------------------------------------------
     def get(self, key: bytes) -> Optional[bytes]:
@@ -90,8 +123,9 @@ class Txn:
             return self.membuf.get(key)
         return self._retry_locked(lambda: self.snapshot.get(key))
 
-    def scan(self, kr: KeyRange, limit: int = 2**63) -> list[tuple[bytes, bytes]]:
-        base = dict(self._retry_locked(lambda: self.snapshot.scan(kr)))
+    def scan(self, kr: KeyRange, limit: int = 2**63, read_ts: Optional[int] = None) -> list[tuple[bytes, bytes]]:
+        snap = self.snapshot if read_ts is None else Snapshot(self.store, read_ts)
+        base = dict(self._retry_locked(lambda: snap.scan(kr)))
         for k, (op, v) in self.membuf._buf.items():
             if kr.start <= k < kr.end:
                 if op == OP_DEL:
@@ -125,9 +159,17 @@ class Txn:
         self._done = True
         muts = self.membuf.mutations()
         if not muts:
+            if self._locked_keys:
+                self.store.pessimistic_rollback(list(self._locked_keys), self.start_ts)
             self.commit_ts = self.start_ts
             return self.commit_ts
+        written = {m.key for m in muts}
+        leftover = [k for k in self._locked_keys if k not in written]
+        if leftover:  # locked but never written (e.g. FOR UPDATE only)
+            self.store.pessimistic_rollback(leftover, self.start_ts)
         primary = muts[0].key
+        if self.pessimistic and self._pess_primary is not None and self._pess_primary in written:
+            primary = self._pess_primary  # keep lock primary stable across upgrade
         try:
             self.store.prewrite(muts, primary, self.start_ts)
         except KeyLockedError as e:
@@ -140,12 +182,16 @@ class Txn:
         secondaries = [m.key for m in muts if m.key != primary]
         if secondaries:
             self.store.commit(secondaries, self.start_ts, self.commit_ts)
+        self.store.detector.clean_up(self.start_ts)
         return self.commit_ts
 
     def rollback(self) -> None:
         if self._done:
             return
         self._done = True
+        if self._locked_keys:
+            self.store.pessimistic_rollback(list(self._locked_keys), self.start_ts)
         keys = [m.key for m in self.membuf.mutations()]
         if keys:
             self.store.rollback(keys, self.start_ts)
+        self.store.detector.clean_up(self.start_ts)

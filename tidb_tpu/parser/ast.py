@@ -163,6 +163,7 @@ class Select(Node):
     limit: Optional[int] = None
     offset: int = 0
     distinct: bool = False
+    for_update: bool = False
 
 
 @dataclass
@@ -292,7 +293,7 @@ class Show(Node):
 
 @dataclass
 class Begin(Node):
-    pass
+    mode: str = ""  # "" (session default) | pessimistic | optimistic
 
 
 @dataclass

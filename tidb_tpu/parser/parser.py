@@ -143,6 +143,9 @@ class Parser:
                 sel.limit = a
                 if self.eat_kw("OFFSET"):
                     sel.offset = int(self.next().value)
+        if self.eat_kw("FOR"):
+            self.expect_kw("UPDATE")
+            sel.for_update = True
         return sel
 
     def parse_select_item(self) -> ast.SelectItem:
@@ -815,7 +818,12 @@ class Parser:
             self.expect_kw("TRANSACTION")
         else:
             self.expect_kw("BEGIN")
-        return ast.Begin()
+        mode = ""
+        if self.eat_kw("PESSIMISTIC"):
+            mode = "pessimistic"
+        elif self.eat_kw("OPTIMISTIC"):
+            mode = "optimistic"
+        return ast.Begin(mode=mode)
 
     def parse_analyze(self) -> ast.AnalyzeTable:
         self.expect_kw("ANALYZE")

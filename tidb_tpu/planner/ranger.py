@@ -203,11 +203,22 @@ def _extract_col_conds(conds: list[Expression], col_idx: int, ftype) -> tuple[Co
             tighten_lo(v, sig == "ge")
         else:
             tighten_hi(v, sig == "le")
-    # normalize: eq points filtered by lo/hi
+    # normalize: clamp to the int64 key domain (out-of-domain bounds must
+    # not wrap in encode_int_raw), then filter eq points by lo/hi
+    i64_min, i64_max = -(2**63), 2**63 - 1
+    if int_backed:
+        if b.lo is not None:
+            if b.lo > i64_max:
+                b.empty = True
+            b.lo = max(b.lo, i64_min)
+        if b.hi is not None:
+            if b.hi < i64_min:
+                b.empty = True
+            b.hi = min(b.hi, i64_max)
     if b.eq is not None:
         if int_backed:
-            lo = b.lo if b.lo is not None else -(2**63)
-            hi = b.hi if b.hi is not None else 2**63 - 1
+            lo = b.lo if b.lo is not None else i64_min
+            hi = b.hi if b.hi is not None else i64_max
             b.eq = [p for p in b.eq if lo <= p <= hi]
         if not b.eq:
             b.empty = True
@@ -270,6 +281,9 @@ def detach_index_conditions(
             has_range = True
             used_all.extend(used)
             int_backed = ftype.kind in _INT_KINDS
+            # comparisons never match NULL: skip NIL-flagged entries (flag
+            # 0x00 sorts before every typed datum) when there is no low bound
+            lo_key_suffix = bytes([codec.NIL_FLAG + 1])
             if bound.lo is not None:
                 if int_backed:
                     lo_key_suffix = _encode_datum(bound.lo, ftype)

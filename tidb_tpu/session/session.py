@@ -31,6 +31,10 @@ DEFAULT_SYSVARS = {
     "tidb_current_ts": 0,
     "sql_mode": "",
     "max_execution_time": 0,
+    # ref: vardef TiDBTxnMode (pessimistic is the reference default)
+    "tidb_txn_mode": "pessimistic",
+    "innodb_lock_wait_timeout": 3,  # seconds (shortened for embedded use)
+    "tidb_gc_life_time": 600,  # seconds (ref: 10m default)
 }
 
 
@@ -58,6 +62,8 @@ class Session:
         self.current_db = "test"
         self._txn: Optional[Txn] = None
         self._explicit = False
+        # current-read override: FOR UPDATE reads at for_update_ts
+        self._read_ts_override: Optional[int] = None
 
     # -- txn lifecycle (ref: LazyTxn) ---------------------------------------
     def txn(self) -> Txn:
@@ -69,6 +75,8 @@ class Session:
         return self.txn()
 
     def read_ts(self) -> int:
+        if self._read_ts_override is not None:
+            return self._read_ts_override
         if self._txn is not None:
             return self._txn.start_ts
         return self.store.current_ts()
@@ -76,10 +84,22 @@ class Session:
     def _txn_dirty(self) -> bool:
         return self._txn is not None and len(self._txn.membuf) > 0
 
-    def begin(self) -> None:
+    def begin(self, mode: str = "") -> None:
         self._finish_txn(commit=True)
         self._explicit = True
-        self._txn = self.store.begin()
+        mode = mode or str(self.vars.get("tidb_txn_mode", "pessimistic"))
+        from tidb_tpu.kv.txn import Txn
+
+        self._txn = Txn(self.store, pessimistic=(mode == "pessimistic"))
+
+    def lock_for_write(self, keys: list[bytes]) -> None:
+        """Statement-time pessimistic locking for DML/FOR UPDATE keys
+        (ref: executor lockRows → client-go LockKeys). Autocommit single
+        statements skip it: 2PC conflict detection already covers them."""
+        if not self._explicit or self._txn is None or not self._txn.pessimistic:
+            return
+        wait_ms = int(float(self.vars.get("innodb_lock_wait_timeout", 3)) * 1000)
+        self._txn.lock_keys(keys, wait_timeout_ms=wait_ms)
 
     def commit(self) -> None:
         self._finish_txn(commit=True)
@@ -170,7 +190,7 @@ class Session:
         if isinstance(stmt, ast.Show):
             return self._show(stmt)
         if isinstance(stmt, ast.Begin):
-            self.begin()
+            self.begin(stmt.mode)
             return Result()
         if isinstance(stmt, ast.Commit):
             self.commit()
@@ -197,13 +217,58 @@ class Session:
 
     # -- SELECT ---------------------------------------------------------------
     def _select(self, stmt: ast.Select) -> Result:
-        plan = self._plan_select(stmt)
-        from tidb_tpu.executor import build_executor
+        if stmt.for_update:
+            self._lock_select_rows(stmt)
+            if self._explicit and self._txn is not None and self._txn.pessimistic:
+                # locking read returns latest committed values (current read)
+                self._read_ts_override = self._txn.for_update_ts
+        try:
+            plan = self._plan_select(stmt)
+            from tidb_tpu.executor import build_executor
 
-        ex = build_executor(plan, self)
-        chunk = ex.execute()
+            ex = build_executor(plan, self)
+            chunk = ex.execute()
+        finally:
+            self._read_ts_override = None
         names = [oc.name for oc in plan.schema]
         return Result(columns=names, rows=chunk.rows())
+
+    def _lock_select_rows(self, stmt: ast.Select) -> None:
+        """SELECT ... FOR UPDATE: pessimistically lock the matched rows'
+        record keys (ref: SelectLockExec, executor/executor.go). Single-table
+        FROM only; other shapes execute without locking (round-1 divergence)."""
+        if not (self._explicit and self._txn is not None and self._txn.pessimistic):
+            return
+        if not isinstance(stmt.from_, ast.TableRef):
+            return
+        from tidb_tpu.executor.executors import TableReaderExec
+        from tidb_tpu.kv import tablecodec
+        from tidb_tpu.kv.kv import StoreType
+        from tidb_tpu.planner.plans import OutCol, PhysTableReader
+        from tidb_tpu.types.field_type import bigint_type
+
+        db_name = stmt.from_.db or self.current_db
+        t = self.catalog.table(db_name, stmt.from_.name)
+        alias = stmt.from_.alias or stmt.from_.name
+        schema = [OutCol(c.name, c.ftype, table=alias, slot=c.offset) for c in t.columns]
+        conds = []
+        if stmt.where is not None:
+            builder = Builder(self.catalog, self.current_db, subquery_runner=self._subquery_runner)
+            from tidb_tpu.planner.builder import BuildCtx
+
+            conds = builder._split_conj(builder.resolve(stmt.where, BuildCtx(schema)))
+        reader = PhysTableReader(
+            db=db_name,
+            table=t,
+            store_type=StoreType.HOST,
+            pushed_conditions=conds,
+            scan_slots=[c.offset for c in t.columns] + [-1],
+            schema=schema + [OutCol("_handle", bigint_type(nullable=False))],
+        )
+        chunk = TableReaderExec(reader, self).execute()
+        handles = chunk.columns[-1].data
+        keys = [tablecodec.record_key(t.id, int(h)) for h in handles]
+        self.lock_for_write(keys)
 
     def _plan_select(self, stmt: ast.Select):
         builder = Builder(self.catalog, self.current_db, subquery_runner=self._subquery_runner)
@@ -313,6 +378,16 @@ class DB:
         self.catalog = Catalog(self.store)
         self.global_vars: dict[str, Any] = {}
         self._mu = threading.Lock()
+        from tidb_tpu.kv.gcworker import GCWorker
+
+        self.gc_worker = GCWorker(self.store)
+
+    def run_gc(self, safe_point: Optional[int] = None) -> int:
+        """One synchronous MVCC GC cycle (tests / admin). Honors the
+        tidb_gc_life_time global (seconds)."""
+        life_s = float(self.global_vars.get("tidb_gc_life_time", DEFAULT_SYSVARS["tidb_gc_life_time"]))
+        self.gc_worker.life_ms = int(life_s * 1000)
+        return self.gc_worker.run_once(safe_point)
 
     def session(self) -> Session:
         s = Session(self)
