@@ -1,0 +1,114 @@
+"""UNION / INTERSECT / EXCEPT end-to-end (ref: set-operation coverage in
+tests/integrationtest executor suites)."""
+
+from decimal import Decimal
+
+import pytest
+
+import tidb_tpu
+
+
+@pytest.fixture()
+def db():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE s1 (a BIGINT, b VARCHAR(16))")
+    d.execute("CREATE TABLE s2 (a BIGINT, b VARCHAR(16))")
+    d.execute("INSERT INTO s1 VALUES (1,'x'), (2,'y'), (2,'y'), (3,'z'), (NULL,NULL)")
+    d.execute("INSERT INTO s2 VALUES (2,'y'), (3,'z'), (4,'w'), (NULL,NULL)")
+    return d
+
+
+def test_union_distinct(db):
+    rows = db.query("SELECT a, b FROM s1 UNION SELECT a, b FROM s2 ORDER BY a")
+    assert rows == [(None, None), (1, "x"), (2, "y"), (3, "z"), (4, "w")]
+
+
+def test_union_all(db):
+    rows = db.query("SELECT a FROM s1 UNION ALL SELECT a FROM s2 ORDER BY a")
+    assert rows == [(None,), (None,), (1,), (2,), (2,), (2,), (3,), (3,), (4,)]
+
+
+def test_intersect(db):
+    # NULLs compare equal in set operations (MySQL semantics)
+    rows = db.query("SELECT a, b FROM s1 INTERSECT SELECT a, b FROM s2 ORDER BY a")
+    assert rows == [(None, None), (2, "y"), (3, "z")]
+
+
+def test_except(db):
+    rows = db.query("SELECT a, b FROM s1 EXCEPT SELECT a, b FROM s2 ORDER BY a")
+    assert rows == [(1, "x")]
+
+
+def test_intersect_binds_tighter_than_union(db):
+    # s1 UNION ALL (s1 INTERSECT s2)
+    rows = db.query(
+        "SELECT a FROM s1 UNION ALL SELECT a FROM s1 INTERSECT SELECT a FROM s2 ORDER BY a"
+    )
+    assert rows == [(None,), (None,), (1,), (2,), (2,), (2,), (3,), (3,)]
+
+
+def test_union_limit_applies_to_compound(db):
+    rows = db.query("SELECT a FROM s1 UNION SELECT a FROM s2 ORDER BY a DESC LIMIT 2")
+    assert rows == [(4,), (3,)]
+
+
+def test_parenthesized_operands_keep_local_limit(db):
+    rows = db.query(
+        "(SELECT a FROM s1 WHERE a IS NOT NULL ORDER BY a LIMIT 1)"
+        " UNION (SELECT a FROM s2 WHERE a IS NOT NULL ORDER BY a LIMIT 1) ORDER BY a"
+    )
+    assert rows == [(1,), (2,)]
+
+
+def test_union_type_unification(db):
+    rows = db.query("SELECT 1 UNION SELECT 2.5 ORDER BY 1")
+    assert rows == [(Decimal("1.0"),), (Decimal("2.5"),)]
+
+
+def test_union_in_subquery_source(db):
+    rows = db.query(
+        "SELECT COUNT(*), SUM(a) FROM (SELECT a FROM s1 UNION SELECT a FROM s2) u"
+    )
+    assert rows == [(5, 10)]
+
+
+def test_union_in_in_subquery(db):
+    rows = db.query(
+        "SELECT a FROM s1 WHERE a IN (SELECT a FROM s2 EXCEPT SELECT 2) ORDER BY a"
+    )
+    assert rows == [(3,)]
+
+
+def test_nonfinal_order_without_parens_rejected(db):
+    with pytest.raises(Exception):
+        db.query("SELECT a FROM s1 ORDER BY a UNION SELECT a FROM s2")
+
+
+def test_explicit_parens_not_reassociated(db):
+    # (1 UNION 2) INTERSECT 3 must stay grouped — not become 1 UNION (2 ∩ 3)
+    assert db.query("(SELECT 1 UNION SELECT 2) INTERSECT SELECT 3") == []
+    assert db.query("(SELECT 2 UNION SELECT 3) INTERSECT SELECT 3") == [(3,)]
+
+
+def test_decimal_scale_unification(db):
+    db.execute("CREATE TABLE d1 (v DECIMAL(10,1))")
+    db.execute("CREATE TABLE d2 (v DECIMAL(10,2))")
+    db.execute("INSERT INTO d1 VALUES (1.5)")
+    db.execute("INSERT INTO d2 VALUES (2.25)")
+    rows = db.query("SELECT v FROM d1 UNION ALL SELECT v FROM d2 ORDER BY v")
+    assert rows == [(Decimal("1.50"),), (Decimal("2.25"),)]
+
+
+def test_nested_paren_join_still_parses(db):
+    db.execute("CREATE TABLE j1 (a BIGINT)")
+    db.execute("CREATE TABLE j2 (a BIGINT)")
+    db.execute("CREATE TABLE j3 (a BIGINT)")
+    for t in ("j1", "j2", "j3"):
+        db.execute(f"INSERT INTO {t} VALUES (1)")
+    rows = db.query("SELECT j1.a FROM ((j1 JOIN j2 ON j1.a=j2.a) JOIN j3 ON j1.a=j3.a)")
+    assert rows == [(1,)]
+
+
+def test_double_paren_select_operand(db):
+    assert db.query("((SELECT 1)) UNION SELECT 2 ORDER BY 1") == [(1,), (2,)]
+    assert db.query("SELECT * FROM ((SELECT 1 AS x)) q") == [(1,)]

@@ -27,6 +27,7 @@ from tidb_tpu.planner.plans import (
     PhysPointGet,
     PhysProjection,
     PhysSelection,
+    PhysSetOp,
     PhysSort,
     PhysTableReader,
 )
@@ -64,6 +65,8 @@ def build_executor(plan, session) -> Executor:
         return HashJoinExec(plan, build_executor(plan.children[0], session), build_executor(plan.children[1], session))
     if isinstance(plan, PhysDistinct):
         return DistinctExec(build_executor(plan.children[0], session))
+    if isinstance(plan, PhysSetOp):
+        return SetOpExec(plan, [build_executor(c, session) for c in plan.children])
     if isinstance(plan, PhysDual):
         return DualExec(plan)
     if isinstance(plan, PhysPointGet):
@@ -512,6 +515,75 @@ class DistinctExec(Executor):
             diff[1:] |= ds[1:] != ds[:-1]
             diff[1:] |= vs[1:] != vs[:-1]
         return chunk.take(np.sort(perm[diff]))
+
+
+@dataclass
+class SetOpExec(Executor):
+    """UNION / INTERSECT / EXCEPT with multiset (ALL) or set semantics
+    (ref: UnionExec + set-operation rewrites). Row identity uses logical
+    values, so NULLs compare equal as MySQL set ops require."""
+
+    plan: PhysSetOp
+    childs: list
+
+    def __post_init__(self):
+        self.schema = self.plan.schema
+
+    def execute(self) -> Chunk:
+        from collections import Counter
+
+        l, r = (c.execute() for c in self.childs)
+        op, all_ = self.plan.op, self.plan.all
+        if op == "union" and all_ and self._concat_ok(l, r):
+            return Chunk.concat([l, r])
+        lrows, rrows = l.rows(), r.rows()
+        if op == "union":
+            rows = lrows + rrows
+            if not all_:
+                rows = list(dict.fromkeys(rows))
+        elif op == "intersect":
+            rc = Counter(rrows)
+            rows = []
+            if all_:
+                for t in lrows:
+                    if rc[t] > 0:
+                        rows.append(t)
+                        rc[t] -= 1
+            else:
+                seen: set = set()
+                for t in lrows:
+                    if rc[t] > 0 and t not in seen:
+                        rows.append(t)
+                        seen.add(t)
+        else:  # except
+            rc = Counter(rrows)
+            rows = []
+            if all_:
+                for t in lrows:
+                    if rc[t] > 0:
+                        rc[t] -= 1
+                    else:
+                        rows.append(t)
+            else:
+                seen = set()
+                for t in lrows:
+                    if rc[t] == 0 and t not in seen:
+                        rows.append(t)
+                        seen.add(t)
+        cols = [
+            Column.from_values([row[i] for row in rows], oc.ftype)
+            for i, oc in enumerate(self.schema)
+        ]
+        return Chunk(cols)
+
+    @staticmethod
+    def _concat_ok(l: Chunk, r: Chunk) -> bool:
+        """Physical concat is sound unless string lanes use different
+        dictionaries (codes would collide)."""
+        for lc, rc in zip(l.columns, r.columns):
+            if lc.ftype.kind == TypeKind.STRING and lc.dictionary is not rc.dictionary:
+                return False
+        return True
 
 
 @dataclass

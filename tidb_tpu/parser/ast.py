@@ -167,6 +167,22 @@ class Select(Node):
 
 
 @dataclass
+class SetOp(Node):
+    """UNION / INTERSECT / EXCEPT chain (ref: ast.SetOprStmt).
+
+    ``order_by``/``limit`` apply to the whole compound result (MySQL: a
+    trailing ORDER BY binds to the union, not the last operand)."""
+
+    left: Node  # Select | SetOp
+    right: Node  # Select | SetOp
+    op: str  # "union" | "intersect" | "except"
+    all: bool = False
+    order_by: list[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: int = 0
+
+
+@dataclass
 class Insert(Node):
     table: TableRef
     columns: list[str] = field(default_factory=list)

@@ -81,11 +81,13 @@ class Parser:
     # -- entry --------------------------------------------------------------
     def parse_statement(self) -> ast.Node:
         t = self.peek()
+        if t.kind == "op" and t.value == "(":
+            return self.parse_select_stmt()
         if t.kind != "ident":
             raise ParseError("expected statement", t)
         kw = t.value.upper()
         fn = {
-            "SELECT": self.parse_select,
+            "SELECT": self.parse_select_stmt,
             "INSERT": self.parse_insert,
             "REPLACE": self.parse_insert,
             "UPDATE": self.parse_update,
@@ -110,6 +112,83 @@ class Parser:
         return fn()
 
     # -- SELECT --------------------------------------------------------------
+    def parse_select_stmt(self) -> ast.Node:
+        """SELECT optionally chained with UNION/INTERSECT/EXCEPT (ref:
+        ast.SetOprStmt; INTERSECT binds tighter per MySQL 8). A trailing
+        ORDER BY/LIMIT binds to the whole compound."""
+        node, paren = self._setop_operand()
+        # whether the top node came from explicit parentheses (an explicitly
+        # grouped SetOp must not be re-associated by INTERSECT precedence)
+        node_paren = paren
+        last, last_paren = node, paren
+        while self.at_kw("UNION", "EXCEPT", "INTERSECT"):
+            if (
+                not last_paren
+                and isinstance(last, ast.Select)
+                and (last.order_by or last.limit is not None)
+            ):
+                raise ParseError(
+                    "ORDER BY/LIMIT in a non-final set operand needs parentheses", self.peek()
+                )
+            op = self.next().value.lower()
+            all_ = self.eat_kw("ALL")
+            if not all_:
+                self.eat_kw("DISTINCT")
+            last, last_paren = self._setop_operand()
+            if (
+                op == "intersect"
+                and isinstance(node, ast.SetOp)
+                and node.op != "intersect"
+                and not node_paren
+            ):
+                node.right = ast.SetOp(node.right, last, op, all=all_)
+            else:
+                node = ast.SetOp(node, last, op, all=all_)
+                node_paren = False
+        if not isinstance(node, ast.SetOp):
+            return node
+        if not last_paren and isinstance(last, ast.Select):
+            # parse_select consumed the trailing ORDER BY/LIMIT — it belongs
+            # to the compound statement
+            node.order_by, last.order_by = last.order_by, []
+            node.limit, node.offset, last.limit, last.offset = last.limit, last.offset, None, 0
+        if self.at_kw("ORDER"):
+            self.next()
+            self.expect_kw("BY")
+            node.order_by = self.parse_order_items()
+        self._parse_limit(node)
+        return node
+
+    def _parse_limit(self, node) -> None:
+        """LIMIT n | LIMIT off, n | LIMIT n OFFSET off — sets node.limit/offset."""
+        if not self.eat_kw("LIMIT"):
+            return
+        a = int(self.next().value)
+        if self.eat_op(","):
+            node.offset = a
+            node.limit = int(self.next().value)
+        else:
+            node.limit = a
+            if self.eat_kw("OFFSET"):
+                node.offset = int(self.next().value)
+
+    def _paren_select_ahead(self) -> bool:
+        """True when the upcoming '('... run of parens wraps a SELECT (as
+        opposed to a parenthesized join or scalar expression)."""
+        j = 0
+        while self.peek(j).kind == "op" and self.peek(j).value == "(":
+            j += 1
+        t = self.peek(j)
+        return j > 0 and t.kind == "ident" and t.value.upper() == "SELECT"
+
+    def _setop_operand(self) -> tuple:
+        if self._paren_select_ahead():
+            self.next()
+            inner = self.parse_select_stmt()
+            self.expect_op(")")
+            return inner, True
+        return self.parse_select(), False
+
     def parse_select(self) -> ast.Select:
         self.expect_kw("SELECT")
         distinct = self.eat_kw("DISTINCT")
@@ -134,15 +213,7 @@ class Parser:
             self.next()
             self.expect_kw("BY")
             sel.order_by = self.parse_order_items()
-        if self.eat_kw("LIMIT"):
-            a = int(self.next().value)
-            if self.eat_op(","):
-                sel.offset = a
-                sel.limit = int(self.next().value)
-            else:
-                sel.limit = a
-                if self.eat_kw("OFFSET"):
-                    sel.offset = int(self.next().value)
+        self._parse_limit(sel)
         if self.eat_kw("FOR"):
             self.expect_kw("UPDATE")
             sel.for_update = True
@@ -163,7 +234,7 @@ class Parser:
         if self.eat_kw("AS"):
             alias = self.ident()
         elif self.peek().kind in ("ident", "qident") and not self.at_kw(
-            "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "UNION", "INTO", "JOIN", "ON",
+            "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "UNION", "INTERSECT", "EXCEPT", "INTO", "JOIN", "ON",
             "LEFT", "RIGHT", "INNER", "CROSS", "AS", "SET",
         ):
             alias = self.ident()
@@ -214,9 +285,9 @@ class Parser:
     def parse_table_factor(self) -> ast.Node:
         if self.at_op("("):
             # subquery or parenthesized join
-            if self.peek(1).kind == "ident" and self.peek(1).value.upper() == "SELECT":
+            if self._paren_select_ahead():
                 self.next()
-                sel = self.parse_select()
+                sel = self.parse_select_stmt()
                 self.expect_op(")")
                 alias = ""
                 self.eat_kw("AS")
@@ -236,7 +307,7 @@ class Parser:
             alias = self.ident()
         elif self.peek().kind in ("ident", "qident") and not self.at_kw(
             "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "JOIN", "ON", "LEFT", "RIGHT",
-            "INNER", "CROSS", "SET", "UNION",
+            "INNER", "CROSS", "SET", "UNION", "INTERSECT", "EXCEPT",
         ):
             alias = self.ident()
         return ast.TableRef(name, db=db, alias=alias)
@@ -297,7 +368,7 @@ class Parser:
                 self.next()
                 self.expect_op("(")
                 if self.at_kw("SELECT"):
-                    sel = self.parse_select()
+                    sel = self.parse_select_stmt()
                     self.expect_op(")")
                     left = ast.InList(left, [ast.SubqueryExpr(sel, "in")], negated=neg)
                 else:
@@ -394,7 +465,7 @@ class Parser:
         if self.at_op("("):
             self.next()
             if self.at_kw("SELECT"):
-                sel = self.parse_select()
+                sel = self.parse_select_stmt()
                 self.expect_op(")")
                 return ast.SubqueryExpr(sel)
             e = self.parse_expr()
@@ -431,7 +502,7 @@ class Parser:
         if kw == "EXISTS" and self.peek(1).value == "(":
             self.next()
             self.next()
-            sel = self.parse_select()
+            sel = self.parse_select_stmt()
             self.expect_op(")")
             return ast.SubqueryExpr(sel, "exists")
         if kw == "INTERVAL":
@@ -508,7 +579,7 @@ class Parser:
                 if not self.eat_op(","):
                     break
         elif self.at_kw("SELECT"):
-            ins.select = self.parse_select()
+            ins.select = self.parse_select_stmt()
         if self.at_kw("ON"):
             self.next()
             self.expect_kw("DUPLICATE")

@@ -4,7 +4,7 @@ rewriter)."""
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from decimal import Decimal
 from typing import Callable, Optional
 
@@ -33,6 +33,7 @@ from tidb_tpu.planner.plans import (
     LogicalProjection,
     LogicalScan,
     LogicalSelection,
+    LogicalSetOp,
     LogicalSort,
     OutCol,
     PlanError,
@@ -56,6 +57,29 @@ _FN_ALIAS = {
 }
 
 
+def _common_type(l: FieldType, r: FieldType) -> FieldType:
+    """Result type of a set-operation column pair (ref: unionJoinFieldType,
+    expression/util.go aggFieldType): numeric promotion, else exact kind."""
+    nullable = l.nullable or r.nullable
+    if l.kind == TypeKind.NULLTYPE:
+        return replace(r, nullable=True)
+    if r.kind == TypeKind.NULLTYPE:
+        return replace(l, nullable=True)
+    if l.kind == r.kind:
+        if l.kind == TypeKind.DECIMAL and l.scale != r.scale:
+            return replace(decimal_type(18, max(l.scale, r.scale)), nullable=nullable)
+        return replace(l, nullable=nullable)
+    numeric = {TypeKind.INT, TypeKind.UINT, TypeKind.FLOAT, TypeKind.DECIMAL}
+    if l.kind in numeric and r.kind in numeric:
+        if TypeKind.FLOAT in (l.kind, r.kind):
+            return replace(double_type(), nullable=nullable)
+        if TypeKind.DECIMAL in (l.kind, r.kind):
+            d = l if l.kind == TypeKind.DECIMAL else r
+            return replace(decimal_type(18, d.scale), nullable=nullable)
+        return replace(bigint_type(), nullable=nullable)
+    raise PlanError(f"incompatible set-operand column types {l.kind.name} vs {r.kind.name}")
+
+
 @dataclass
 class BuildCtx:
     """Name-resolution scope."""
@@ -75,6 +99,71 @@ class Builder:
         self.subquery_runner = subquery_runner
 
     # -- statements ---------------------------------------------------------
+    def build_query(self, node) -> LogicalPlan:
+        """SELECT or a UNION/INTERSECT/EXCEPT compound (ref: buildSetOpr in
+        logical_plan_builder.go)."""
+        if isinstance(node, ast.Select):
+            return self.build_select(node)
+        if isinstance(node, ast.SetOp):
+            return self._build_setop(node)
+        raise PlanError(f"unsupported query {type(node).__name__}")
+
+    def _build_setop(self, node: ast.SetOp) -> LogicalPlan:
+        left = self.build_query(node.left)
+        right = self.build_query(node.right)
+        if len(left.schema) != len(right.schema):
+            raise PlanError("set operands have a different number of columns")
+        # unify column types: numeric promotion, else exact-kind match
+        target: list[FieldType] = []
+        for lc, rc in zip(left.schema, right.schema):
+            target.append(_common_type(lc.ftype, rc.ftype))
+        left = self._cast_to(left, target)
+        right = self._cast_to(right, target)
+        schema = [
+            OutCol(left.schema[i].name, target[i]) for i in range(len(target))
+        ]
+        plan: LogicalPlan = LogicalSetOp(
+            op=node.op, all=node.all, schema=schema, children=[left, right]
+        )
+        if node.order_by:
+            by = []
+            for oi in node.order_by:
+                by.append((self._resolve_order(oi.expr, plan.schema, {}), oi.desc))
+            plan = LogicalSort(by=by, children=[plan])
+        if node.limit is not None:
+            plan = LogicalLimit(limit=node.limit, offset=node.offset, children=[plan])
+        return plan
+
+    def _cast_to(self, plan: LogicalPlan, target: list[FieldType]) -> LogicalPlan:
+        """Wrap ``plan`` in a projection casting each column to the target
+        kind where it differs."""
+        exprs: list[Expression] = []
+        changed = False
+        for i, (oc, ft) in enumerate(zip(plan.schema, target)):
+            e: Expression = ColumnRef(i, oc.ftype, oc.name)
+            scale_diff = ft.kind == TypeKind.DECIMAL and oc.ftype.scale != ft.scale
+            if oc.ftype.kind != ft.kind or scale_diff:
+                changed = True
+                if ft.kind == TypeKind.FLOAT:
+                    e = func("cast_float", e)
+                elif ft.kind == TypeKind.DECIMAL:
+                    e = func("cast_decimal", e, ret=ft)
+                elif ft.kind in (TypeKind.INT, TypeKind.UINT):
+                    e = func("cast_int", e)
+                else:
+                    raise PlanError(
+                        f"cannot unify set-operand column types {oc.ftype.kind} vs {ft.kind}"
+                    )
+            exprs.append(e)
+        if not changed:
+            return plan
+        proj = LogicalProjection(exprs=exprs, children=[plan])
+        proj.schema = [
+            OutCol(plan.schema[i].name, exprs[i].ftype, plan.schema[i].table, plan.schema[i].slot)
+            for i in range(len(exprs))
+        ]
+        return proj
+
     def build_select(self, sel: ast.Select) -> LogicalPlan:
         if sel.from_ is None:
             plan: LogicalPlan = LogicalDual()
@@ -236,7 +325,7 @@ class Builder:
             ]
             return scan
         if isinstance(node, ast.SubquerySource):
-            sub = self.build_select(node.select)
+            sub = self.build_query(node.select)
             alias = node.alias or "subquery"
             for oc in sub.schema:
                 oc.table = alias

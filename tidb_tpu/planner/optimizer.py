@@ -28,6 +28,7 @@ from tidb_tpu.planner.plans import (
     LogicalProjection,
     LogicalScan,
     LogicalSelection,
+    LogicalSetOp,
     LogicalSort,
     OutCol,
     PhysDual,
@@ -40,6 +41,7 @@ from tidb_tpu.planner.plans import (
     PhysPointGet,
     PhysProjection,
     PhysSelection,
+    PhysSetOp,
     PhysSort,
     PhysTableReader,
     PhysicalPlan,
@@ -137,6 +139,11 @@ def _prune(plan: LogicalPlan, needed: Optional[set[int]]):
         if isinstance(plan, LogicalSort):
             plan.by = [(_remap_expr(e, cmap), d) for e, d in plan.by]
         return plan, cmap
+    if isinstance(plan, LogicalSetOp):
+        # row identity spans every column — children keep their full schemas
+        for i, c in enumerate(plan.children):
+            plan.children[i], _ = _prune(c, set(range(len(c.schema))))
+        return plan, {i: i for i in range(len(plan.schema))}
     if isinstance(plan, LogicalJoin):
         nleft = len(plan.children[0].schema)
         ln: set[int] = set()
@@ -439,6 +446,13 @@ def _physical(plan: LogicalPlan, engines: list[str]) -> PhysicalPlan:
     if isinstance(plan, LogicalDistinct):
         child = _physical(plan.children[0], engines)
         return PhysDistinct(children=[child])
+    if isinstance(plan, LogicalSetOp):
+        return PhysSetOp(
+            op=plan.op,
+            all=plan.all,
+            schema=plan.schema,
+            children=[_physical(c, engines) for c in plan.children],
+        )
     if isinstance(plan, LogicalJoin):
         left = _physical(plan.children[0], engines)
         right = _physical(plan.children[1], engines)

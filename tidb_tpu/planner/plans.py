@@ -114,6 +114,17 @@ class LogicalJoin(LogicalPlan):
 
 
 @dataclass
+class LogicalSetOp(LogicalPlan):
+    """UNION / INTERSECT / EXCEPT (ref: LogicalUnionAll + set-op builders in
+    logical_plan_builder.go). Children already project to a unified schema."""
+
+    op: str  # union | intersect | except
+    all: bool = False
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+
+@dataclass
 class LogicalDistinct(LogicalPlan):
     children: list = field(default_factory=list)
 
@@ -262,6 +273,14 @@ class PhysDistinct(PhysicalPlan):
 
 
 @dataclass
+class PhysSetOp(PhysicalPlan):
+    op: str
+    all: bool = False
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+
+@dataclass
 class PhysDual(PhysicalPlan):
     schema: Schema = field(default_factory=list)
     children: list = field(default_factory=list)
@@ -307,6 +326,8 @@ def explain_plan(p, indent: int = 0) -> str:
         extra = f"limit={p.limit} offset={p.offset}"
     elif isinstance(p, PhysHashJoin):
         extra = f"{p.kind} on {p.eq_conds}"
+    elif isinstance(p, PhysSetOp):
+        extra = f"{p.op}{' all' if p.all else ''}"
     elif isinstance(p, PhysPointGet):
         extra = f"{p.table.name} handle={p.handle}"
     elif isinstance(p, PhysIndexReader):

@@ -140,7 +140,7 @@ class Session:
 
     # -- dispatch ------------------------------------------------------------
     def _execute_stmt(self, stmt: ast.Node) -> Result:
-        if isinstance(stmt, ast.Select):
+        if isinstance(stmt, (ast.Select, ast.SetOp)):
             return self._select(stmt)
         if isinstance(stmt, ast.Insert):
             from tidb_tpu.executor import write
@@ -216,8 +216,8 @@ class Session:
         return Result(affected=affected)
 
     # -- SELECT ---------------------------------------------------------------
-    def _select(self, stmt: ast.Select) -> Result:
-        if stmt.for_update:
+    def _select(self, stmt) -> Result:
+        if getattr(stmt, "for_update", False):
             self._lock_select_rows(stmt)
             if self._explicit and self._txn is not None and self._txn.pessimistic:
                 # locking read returns latest committed values (current read)
@@ -270,16 +270,16 @@ class Session:
         keys = [tablecodec.record_key(t.id, int(h)) for h in handles]
         self.lock_for_write(keys)
 
-    def _plan_select(self, stmt: ast.Select):
+    def _plan_select(self, stmt):
         builder = Builder(self.catalog, self.current_db, subquery_runner=self._subquery_runner)
-        logical = builder.build_select(stmt)
+        logical = builder.build_query(stmt)
         engines = [e.strip() for e in str(self.vars["tidb_isolation_read_engines"]).split(",") if e.strip()]
         return optimize(logical, engines)
 
-    def _run_select_ast(self, stmt: ast.Select) -> list[tuple]:
+    def _run_select_ast(self, stmt) -> list[tuple]:
         return self._select(stmt).rows
 
-    def _subquery_runner(self, sel: ast.Select) -> list[tuple]:
+    def _subquery_runner(self, sel) -> list[tuple]:
         return self._run_select_ast(sel)
 
     # -- misc -----------------------------------------------------------------
@@ -338,7 +338,7 @@ class Session:
 
     def _explain(self, stmt: ast.Explain) -> Result:
         inner = stmt.stmt
-        if not isinstance(inner, ast.Select):
+        if not isinstance(inner, (ast.Select, ast.SetOp)):
             raise SessionError("EXPLAIN supports SELECT only")
         plan = self._plan_select(inner)
         if stmt.analyze:
