@@ -1,0 +1,108 @@
+"""Window function tests (ref: pkg/executor window executor +
+tests/integrationtest window coverage)."""
+
+import pytest
+
+import tidb_tpu
+
+
+@pytest.fixture()
+def db():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE w (g VARCHAR(8), v BIGINT, x DOUBLE)")
+    d.execute(
+        "INSERT INTO w VALUES ('a',1,1.0),('a',2,2.0),('a',2,3.0),('a',5,4.0),"
+        "('b',10,5.0),('b',20,6.0),(NULL,NULL,7.0)"
+    )
+    return d
+
+
+def test_row_number(db):
+    rows = db.query("SELECT g, v, ROW_NUMBER() OVER (PARTITION BY g ORDER BY v) FROM w ORDER BY g, v")
+    assert rows == [
+        (None, None, 1), ("a", 1, 1), ("a", 2, 2), ("a", 2, 3), ("a", 5, 4),
+        ("b", 10, 1), ("b", 20, 2),
+    ]
+
+
+def test_rank_dense_rank(db):
+    rows = db.query(
+        "SELECT v, RANK() OVER (PARTITION BY g ORDER BY v),"
+        " DENSE_RANK() OVER (PARTITION BY g ORDER BY v) FROM w WHERE g='a' ORDER BY v"
+    )
+    assert rows == [(1, 1, 1), (2, 2, 2), (2, 2, 2), (5, 4, 3)]
+
+
+def test_cumulative_sum_peers_share_frame(db):
+    rows = db.query("SELECT v, SUM(v) OVER (PARTITION BY g ORDER BY v) FROM w WHERE g='a' ORDER BY v")
+    assert rows == [(1, 1), (2, 5), (2, 5), (5, 10)]
+
+
+def test_rows_frame_cuts_at_current_row(db):
+    rows = db.query(
+        "SELECT v, SUM(v) OVER (PARTITION BY g ORDER BY v"
+        " ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) FROM w WHERE g='a' ORDER BY v"
+    )
+    assert rows == [(1, 1), (2, 3), (2, 5), (5, 10)]
+
+
+def test_whole_partition_agg(db):
+    rows = db.query("SELECT g, SUM(v) OVER (PARTITION BY g) FROM w WHERE g IS NOT NULL ORDER BY g")
+    assert rows == [("a", 10), ("a", 10), ("a", 10), ("a", 10), ("b", 30), ("b", 30)]
+
+
+def test_empty_over(db):
+    rows = db.query("SELECT v, COUNT(*) OVER (), SUM(v) OVER () FROM w ORDER BY v LIMIT 1")
+    assert rows == [(None, 7, 40)]
+
+
+def test_lead_lag_with_default(db):
+    rows = db.query(
+        "SELECT v, LEAD(v) OVER (PARTITION BY g ORDER BY v),"
+        " LAG(v, 1, -1) OVER (PARTITION BY g ORDER BY v) FROM w WHERE g='b' ORDER BY v"
+    )
+    assert rows == [(10, 20, -1), (20, None, 10)]
+
+
+def test_first_last_value(db):
+    rows = db.query(
+        "SELECT v, FIRST_VALUE(v) OVER (PARTITION BY g ORDER BY v),"
+        " LAST_VALUE(v) OVER (PARTITION BY g ORDER BY v) FROM w WHERE g='a' ORDER BY v"
+    )
+    # default RANGE frame: LAST_VALUE reaches the end of the peer group
+    assert rows == [(1, 1, 1), (2, 1, 2), (2, 1, 2), (5, 1, 5)]
+
+
+def test_ntile(db):
+    rows = db.query("SELECT v, NTILE(2) OVER (ORDER BY v) FROM w WHERE v IS NOT NULL ORDER BY v")
+    assert [r[1] for r in rows] == [1, 1, 1, 2, 2, 2]
+
+
+def test_avg_window_null_group(db):
+    rows = db.query("SELECT g, AVG(v) OVER (PARTITION BY g) FROM w ORDER BY g LIMIT 1")
+    assert rows == [(None, None)]
+
+
+def test_window_expr_arith(db):
+    rows = db.query("SELECT v, ROW_NUMBER() OVER (ORDER BY v) * 10 AS r FROM w WHERE g='b' ORDER BY v")
+    assert rows == [(10, 10), (20, 20)]
+
+
+def test_window_in_order_by(db):
+    rows = db.query("SELECT v FROM w WHERE v IS NOT NULL ORDER BY ROW_NUMBER() OVER (ORDER BY v DESC)")
+    assert [r[0] for r in rows] == [20, 10, 5, 2, 2, 1]
+
+
+def test_window_with_group_by_rejected(db):
+    with pytest.raises(Exception):
+        db.query("SELECT g, SUM(v), ROW_NUMBER() OVER () FROM w GROUP BY g")
+
+
+def test_window_func_without_over_rejected(db):
+    with pytest.raises(Exception):
+        db.query("SELECT ROW_NUMBER() FROM w")
+
+
+def test_min_max_string_window(db):
+    rows = db.query("SELECT MIN(g) OVER (), MAX(g) OVER () FROM w LIMIT 1")
+    assert rows == [("a", "b")]

@@ -30,6 +30,7 @@ from tidb_tpu.planner.plans import (
     PhysSetOp,
     PhysSort,
     PhysTableReader,
+    PhysWindow,
 )
 from tidb_tpu.types import TypeKind
 from tidb_tpu.types.field_type import bigint_type
@@ -67,6 +68,8 @@ def build_executor(plan, session) -> Executor:
         return DistinctExec(build_executor(plan.children[0], session))
     if isinstance(plan, PhysSetOp):
         return SetOpExec(plan, [build_executor(c, session) for c in plan.children])
+    if isinstance(plan, PhysWindow):
+        return WindowExec(plan, build_executor(plan.children[0], session))
     if isinstance(plan, PhysDual):
         return DualExec(plan)
     if isinstance(plan, PhysPointGet):
@@ -515,6 +518,203 @@ class DistinctExec(Executor):
             diff[1:] |= ds[1:] != ds[:-1]
             diff[1:] |= vs[1:] != vs[:-1]
         return chunk.take(np.sort(perm[diff]))
+
+
+@dataclass
+class WindowExec(Executor):
+    """Window functions (ref: pkg/executor WindowExec + pipelined window
+    workers, collapsed to a sorted-partition sweep). Supported frames: whole
+    partition, RANGE UNBOUNDED..CURRENT (peers share the frame — the MySQL
+    default with ORDER BY) and ROWS UNBOUNDED..CURRENT."""
+
+    plan: PhysWindow
+    child: Executor
+
+    def __post_init__(self):
+        self.schema = self.plan.schema
+
+    def execute(self) -> Chunk:
+        p = self.plan
+        chunk = self.child.execute()
+        n = len(chunk)
+        if n == 0:
+            return Chunk(
+                list(chunk.columns)
+                + [
+                    Column(np.empty(0, _np_dtype(f.ftype)), np.empty(0, bool), f.ftype)
+                    for f in p.funcs
+                ]
+            )
+        keys = [[e.to_pb(), False] for e in p.partition_by] + [
+            [e.to_pb(), d] for e, d in p.order_by
+        ]
+        perm = sort_perm(chunk, keys) if keys else np.arange(n)
+        batch = EvalBatch.from_chunk(chunk)
+        part_start = np.zeros(n, dtype=bool)
+        part_start[0] = True
+        for e in p.partition_by:
+            c = eval_to_column(e, batch, np)
+            d, v = c.data[perm], c.validity[perm]
+            part_start[1:] |= (d[1:] != d[:-1]) | (v[1:] != v[:-1])
+        peer_start = part_start.copy()
+        if not p.whole_partition and not p.rows_frame:
+            for e, _ in p.order_by:
+                c = eval_to_column(e, batch, np)
+                d, v = c.data[perm], c.validity[perm]
+                peer_start[1:] |= (d[1:] != d[:-1]) | (v[1:] != v[:-1])
+        pbounds = np.flatnonzero(part_start).tolist() + [n]
+        out_cols = []
+        for f in p.funcs:
+            argcols = [eval_to_column(a, batch, np) for a in f.args]
+            sdata, svalid = self._compute(f, argcols, perm, pbounds, peer_start)
+            data = np.empty(n, dtype=sdata.dtype)
+            valid = np.empty(n, dtype=bool)
+            data[perm] = sdata
+            valid[perm] = svalid
+            dic = (
+                argcols[0].dictionary
+                if argcols and argcols[0].ftype.kind == TypeKind.STRING
+                else None
+            )
+            out_cols.append(Column(data, valid, f.ftype, dic))
+        return Chunk(list(chunk.columns) + out_cols)
+
+    def _compute(self, f, argcols, perm, pbounds, peer_start):
+        """Returns (data, validity) arrays in sorted-row order."""
+        p = self.plan
+        n = len(perm)
+        dt = _np_dtype(f.ftype)
+        out = np.zeros(n, dtype=dt)
+        valid = np.ones(n, dtype=bool)
+        av = argcols[0].data[perm] if argcols else None
+        vv = argcols[0].validity[perm] if argcols else None
+        mm_rank = mm_codes = None  # lazily-built MIN/MAX comparison lanes
+        for s, e in zip(pbounds, pbounds[1:]):
+            m = e - s
+            ps = peer_start[s:e]
+            starts = np.flatnonzero(ps)
+            ends = np.r_[starts[1:], m]
+            sizes = ends - starts
+            # frame end (exclusive) per row under the supported frames
+            if p.whole_partition:
+                fe = np.full(m, m, dtype=np.int64)
+            elif p.rows_frame:
+                fe = np.arange(1, m + 1, dtype=np.int64)
+            else:  # RANGE ..CURRENT: peers share the frame
+                fe = np.repeat(ends, sizes)
+            name = f.name
+            if name == "row_number":
+                out[s:e] = np.arange(1, m + 1)
+            elif name == "rank":
+                out[s:e] = np.repeat(starts + 1, sizes)
+            elif name == "dense_rank":
+                out[s:e] = np.repeat(np.arange(1, len(starts) + 1), sizes)
+            elif name == "percent_rank":
+                r = np.repeat(starts, sizes).astype(np.float64)
+                out[s:e] = r / (m - 1) if m > 1 else 0.0
+            elif name == "cume_dist":
+                out[s:e] = np.repeat(ends, sizes) / float(m)
+            elif name == "ntile":
+                k = int(av[s])
+                q, rem = divmod(m, k)
+                bsizes = np.array([q + 1] * rem + [q] * (k - rem), dtype=np.int64)
+                out[s:e] = np.repeat(np.arange(1, k + 1), bsizes)[:m]
+            elif name in ("lead", "lag"):
+                # offset/default are plan-time constants (builder enforces)
+                off = int(argcols[1].data[0]) if len(argcols) > 1 else 1
+                shift = -off if name == "lead" else off
+                src = np.arange(m) - shift
+                ok = (src >= 0) & (src < m)
+                idx = np.clip(src, 0, m - 1)
+                out[s:e] = np.where(ok, av[s:e][idx], 0)
+                valid[s:e] = np.where(ok, vv[s:e][idx], False)
+                if len(argcols) > 2:  # explicit default
+                    dcol = argcols[2]
+                    dvalid = bool(dcol.validity[0])
+                    if argcols[0].ftype.kind == TypeKind.STRING and dvalid:
+                        # re-encode into the argument's dictionary — the
+                        # constant's private dictionary codes don't transfer
+                        dv = argcols[0].dictionary.encode(dcol.logical_value(0))
+                    else:
+                        dv = dcol.data[0]
+                    out[s:e] = np.where(ok, out[s:e], dv)
+                    valid[s:e] = np.where(ok, valid[s:e], dvalid)
+            elif name == "first_value":
+                out[s:e] = av[s]
+                valid[s:e] = vv[s]
+            elif name == "last_value":
+                out[s:e] = av[s:e][fe - 1]
+                valid[s:e] = vv[s:e][fe - 1]
+            elif name in ("count", "sum", "avg", "min", "max"):
+                if name == "count" and not argcols:
+                    out[s:e] = fe
+                    continue
+                pvv = vv[s:e]
+                cnt = np.cumsum(pvv.astype(np.int64))[fe - 1]
+                if name == "count":
+                    out[s:e] = cnt
+                    continue
+                pav = av[s:e]
+                if name in ("min", "max"):
+                    if mm_rank is None:
+                        mm_rank, mm_codes = _cmp_lanes(argcols[0], av)
+                    rank = mm_rank[s:e]
+                    if rank.dtype == np.float64:
+                        fill = np.inf if name == "min" else -np.inf
+                    else:
+                        fill = np.iinfo(np.int64).max if name == "min" else np.iinfo(np.int64).min
+                    lane = np.where(pvv, rank, fill)
+                    acc = (np.minimum if name == "min" else np.maximum).accumulate(lane)
+                    best = acc[fe - 1]
+                    if mm_codes is not None:
+                        # all-NULL frames carry the sentinel — mask before the
+                        # rank→code fancy index, not after
+                        best = np.where(cnt > 0, best, 0)
+                        res = mm_codes[best]
+                    else:
+                        res = best
+                    out[s:e] = np.where(cnt > 0, res.astype(dt, copy=False), 0)
+                    valid[s:e] = cnt > 0
+                    continue
+                filled = np.where(pvv, pav, 0)
+                cum = np.cumsum(
+                    filled.astype(np.float64 if dt == np.float64 else np.int64)
+                )[fe - 1]
+                if name == "sum":
+                    out[s:e] = np.where(cnt > 0, cum.astype(dt, copy=False), 0)
+                    valid[s:e] = cnt > 0
+                else:  # avg
+                    safe = np.maximum(cnt, 1)
+                    if f.ftype.kind == TypeKind.DECIMAL:
+                        scale_up = 10 ** (f.ftype.scale - argcols[0].ftype.scale)
+                        out[s:e] = np.where(
+                            cnt > 0, np.round(cum * scale_up / safe).astype(np.int64), 0
+                        )
+                    else:
+                        out[s:e] = np.where(cnt > 0, cum / safe, 0.0)
+                    valid[s:e] = cnt > 0
+            else:
+                raise ExecError(f"unsupported window function {name}")
+        return out, valid
+
+
+def _np_dtype(ftype):
+    return {TypeKind.FLOAT: np.float64, TypeKind.STRING: np.int32}.get(ftype.kind, np.int64)
+
+
+def _cmp_lanes(col, data):
+    """(comparison lane, rank→code lookup) for cumulative MIN/MAX: plain
+    lanes compare directly; unsorted-dictionary strings compare by value
+    rank, mapped back to codes afterwards."""
+    if col.ftype.kind == TypeKind.STRING and col.dictionary is not None and not col.dictionary.sorted:
+        vals = col.dictionary.decode_many(data)
+        order = {v: i for i, v in enumerate(sorted(set(vals)))}
+        rank = np.fromiter((order[v] for v in vals), dtype=np.int64, count=len(vals))
+        code_for_rank = np.zeros(len(order), dtype=np.int64)
+        for v, c in zip(vals, data):
+            code_for_rank[order[v]] = c
+        return rank, code_for_rank
+    return data.astype(np.int64, copy=False) if data.dtype != np.float64 else data, None
 
 
 @dataclass

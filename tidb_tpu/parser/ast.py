@@ -78,6 +78,23 @@ class FuncCall(Node):
     args: list[Node] = field(default_factory=list)
     distinct: bool = False
     star: bool = False  # COUNT(*)
+    over: Optional["WindowSpec"] = None  # window call when set
+
+
+@dataclass
+class WindowSpec(Node):
+    """OVER (PARTITION BY ... ORDER BY ... [frame]) (ref: ast.WindowSpec)."""
+
+    partition_by: list[Node] = field(default_factory=list)
+    order_by: list["OrderItem"] = field(default_factory=list)
+    # frames: whole-partition (no ORDER BY, or UNBOUNDED..UNBOUNDED),
+    # RANGE UNBOUNDED..CURRENT (default with ORDER BY; peers share the
+    # frame), or ROWS UNBOUNDED..CURRENT (exact cut at the current row)
+    whole_partition: bool = False
+    rows_frame: bool = False
+
+    def key(self) -> str:
+        return repr((self.partition_by, self.order_by, self.whole_partition, self.rows_frame))
 
 
 @dataclass

@@ -146,6 +146,19 @@ class Parser:
                 node = ast.SetOp(node, last, op, all=all_)
                 node_paren = False
         if not isinstance(node, ast.SetOp):
+            if paren and (self.at_kw("ORDER") or self.at_kw("LIMIT")):
+                # (SELECT ... LIMIT 10) ORDER BY/LIMIT — the outer clauses
+                # apply to the derived result, after the inner ones
+                outer = ast.Select(
+                    items=[ast.SelectItem(ast.Wildcard())],
+                    from_=ast.SubquerySource(node, "__paren__"),
+                )
+                if self.at_kw("ORDER"):
+                    self.next()
+                    self.expect_kw("BY")
+                    outer.order_by = self.parse_order_items()
+                self._parse_limit(outer)
+                return outer
             return node
         if not last_paren and isinstance(last, ast.Select):
             # parse_select consumed the trailing ORDER BY/LIMIT — it belongs
@@ -530,6 +543,9 @@ class Parser:
                 while self.eat_op(","):
                     fc.args.append(self.parse_expr())
             self.expect_op(")")
+            if self.at_kw("OVER"):
+                self.next()
+                fc.over = self._window_spec()
             return fc
         table = db = ""
         if self.eat_op("."):
@@ -537,6 +553,40 @@ class Parser:
             if self.eat_op("."):
                 db, table, name = table, name, self.ident()
         return ast.ColumnName(name, table=table, db=db)
+
+    def _window_spec(self) -> ast.WindowSpec:
+        self.expect_op("(")
+        spec = ast.WindowSpec()
+        if self.at_kw("PARTITION"):
+            self.next()
+            self.expect_kw("BY")
+            spec.partition_by.append(self.parse_expr())
+            while self.eat_op(","):
+                spec.partition_by.append(self.parse_expr())
+        if self.at_kw("ORDER"):
+            self.next()
+            self.expect_kw("BY")
+            spec.order_by = self.parse_order_items()
+        if self.at_kw("ROWS", "RANGE", "GROUPS"):
+            # explicit frames: only the canonical spellings of the implicit
+            # frames are executable (ref: executor window frames, subset)
+            unit = self.next().value.upper()
+            ok = False
+            if self.eat_kw("BETWEEN") and self.eat_kw("UNBOUNDED"):
+                self.expect_kw("PRECEDING")
+                self.expect_kw("AND")
+                if self.eat_kw("CURRENT"):
+                    self.expect_kw("ROW")
+                    spec.rows_frame = unit == "ROWS"
+                    ok = True
+                elif self.eat_kw("UNBOUNDED"):
+                    self.expect_kw("FOLLOWING")
+                    spec.whole_partition = True
+                    ok = True
+            if not ok:
+                raise ParseError("unsupported window frame", self.peek())
+        self.expect_op(")")
+        return spec
 
     def _case(self) -> ast.CaseWhen:
         self.expect_kw("CASE")

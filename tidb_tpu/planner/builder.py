@@ -97,6 +97,8 @@ class Builder:
         self.catalog = catalog
         self.db = current_db
         self.subquery_runner = subquery_runner
+        # ast window-call node id → ColumnRef into a LogicalWindow's output
+        self._win_map: dict[int, Expression] = {}
 
     # -- statements ---------------------------------------------------------
     def build_query(self, node) -> LogicalPlan:
@@ -179,6 +181,23 @@ class Builder:
             _contains_agg(it.expr) for it in sel.items
         ) or (sel.having is not None and _contains_agg(sel.having))
 
+        # window functions (ref: buildWindowFunctions): one LogicalWindow per
+        # distinct OVER spec, each appending result columns to the schema
+        win_calls: list = []
+        for it in sel.items:
+            if not isinstance(it.expr, ast.Wildcard):
+                _collect_windows(it.expr, win_calls)
+        for oi in sel.order_by:
+            _collect_windows(oi.expr, win_calls)
+        # SELECT * must expand to the pre-window schema only
+        wild_n = len(plan.schema)
+        if win_calls:
+            if has_agg:
+                raise PlanError(
+                    "window functions combined with GROUP BY/aggregates are not supported yet"
+                )
+            plan = self._build_windows(plan, win_calls)
+
         aliases: dict[str, Expression] = {}
         hidden = 0
         if has_agg:
@@ -241,7 +260,7 @@ class Builder:
             proj_exprs, names, srcs = [], [], []
             for it in sel.items:
                 if isinstance(it.expr, ast.Wildcard):
-                    for i, oc in enumerate(plan.schema):
+                    for i, oc in enumerate(plan.schema[:wild_n]):
                         if it.expr.table and oc.table.lower() != it.expr.table.lower():
                             continue
                         proj_exprs.append(ColumnRef(i, oc.ftype, oc.name))
@@ -288,6 +307,14 @@ class Builder:
                         )
                         hidden += 1
             plan = proj
+            if self._win_map:
+                # ORDER BY resolves over the projection's schema — retarget
+                # window refs (pre-projection space) onto the projected column
+                for key, ref in list(self._win_map.items()):
+                    for j, pe in enumerate(proj.exprs):
+                        if isinstance(pe, ColumnRef) and pe.index == ref.index:
+                            self._win_map[key] = ColumnRef(j, ref.ftype, ref.name)
+                            break
 
         if sel.distinct:
             plan = LogicalDistinct(children=[plan])
@@ -311,6 +338,51 @@ class Builder:
             )
             tp.schema = plan.schema[:vis]
             plan = tp
+        return plan
+
+    def _build_windows(self, plan: LogicalPlan, win_calls: list) -> LogicalPlan:
+        from tidb_tpu.planner.plans import LogicalWindow, WindowFuncDesc
+
+        groups: dict[str, list] = {}
+        seen: set[int] = set()
+        for fc in win_calls:
+            if id(fc) in seen:
+                continue
+            seen.add(id(fc))
+            groups.setdefault(fc.over.key(), []).append(fc)
+        for calls in groups.values():
+            spec = calls[0].over
+            ctx = BuildCtx(plan.schema)
+            part = [self.resolve(e, ctx) for e in spec.partition_by]
+            order = [(self.resolve(oi.expr, ctx), oi.desc) for oi in spec.order_by]
+            base_n = len(plan.schema)
+            funcs: list[WindowFuncDesc] = []
+            for fc in calls:
+                if fc.distinct:
+                    raise PlanError("DISTINCT in a window function is not supported")
+                name = _FN_ALIAS.get(fc.name, fc.name)
+                args = [] if (name == "count" and fc.star) else [self.resolve(a, ctx) for a in fc.args]
+                if name in ("lead", "lag"):
+                    for extra in args[1:]:  # offset and default
+                        if not isinstance(extra, Constant):
+                            raise PlanError(f"{name}() offset/default must be constant")
+                if name == "ntile" and not (args and isinstance(args[0], Constant)):
+                    raise PlanError("ntile() bucket count must be constant")
+                funcs.append(WindowFuncDesc(name, args, _window_ftype(name, args, order)))
+            win = LogicalWindow(
+                funcs=funcs,
+                partition_by=part,
+                order_by=order,
+                whole_partition=spec.whole_partition or not spec.order_by,
+                rows_frame=spec.rows_frame,
+                children=[plan],
+            )
+            win.schema = list(plan.schema) + [
+                OutCol(f"win#{base_n + i}", f.ftype) for i, f in enumerate(funcs)
+            ]
+            for i, fc in enumerate(calls):
+                self._win_map[id(fc)] = ColumnRef(base_n + i, funcs[i].ftype, _display_name(fc))
+            plan = win
         return plan
 
     # -- FROM ---------------------------------------------------------------
@@ -407,6 +479,8 @@ class Builder:
             e = func("like", self._resolve(node.operand, ctx), self._resolve(node.pattern, ctx))
             return func("not", e) if node.negated else e
         if isinstance(node, ast.FuncCall):
+            if self._win_map and id(node) in self._win_map:
+                return self._win_map[id(node)]
             return self._func_call(node, ctx)
         if isinstance(node, ast.CaseWhen):
             args: list[Expression] = []
@@ -459,6 +533,10 @@ class Builder:
 
     def _func_call(self, node: ast.FuncCall, ctx: BuildCtx) -> Expression:
         name = _FN_ALIAS.get(node.name, node.name)
+        if node.over is not None:
+            raise PlanError(f"window function {name}() is not allowed in this clause")
+        if name in PURE_WINDOW_FUNCS:
+            raise PlanError(f"{name}() requires an OVER clause")
         if name in AGG_FUNCS or (name == "count" and node.star):
             # agg calls are intercepted by _resolve_in_agg's rewrite pass;
             # reaching here means an agg in a pure scalar context
@@ -724,7 +802,7 @@ def _const_like(v) -> Constant:
 
 def _contains_agg(node) -> bool:
     if isinstance(node, ast.FuncCall):
-        if _FN_ALIAS.get(node.name, node.name) in AGG_FUNCS or node.star:
+        if node.over is None and (_FN_ALIAS.get(node.name, node.name) in AGG_FUNCS or node.star):
             return True
         return any(_contains_agg(a) for a in node.args)
     for attr in ("left", "right", "operand", "low", "high", "pattern", "else_value"):
@@ -736,6 +814,60 @@ def _contains_agg(node) -> bool:
     if isinstance(node, ast.InList):
         return any(_contains_agg(x) for x in node.items)
     return False
+
+
+def _collect_windows(node, out: list) -> None:
+    """Collect FuncCall nodes with an OVER clause, outermost first."""
+    if not isinstance(node, ast.Node):
+        return
+    if isinstance(node, ast.FuncCall):
+        if node.over is not None:
+            out.append(node)
+        for a in node.args:
+            _collect_windows(a, out)
+        return
+    for attr in ("left", "right", "operand", "low", "high", "pattern", "else_value", "expr"):
+        v = getattr(node, attr, None)
+        if isinstance(v, ast.Node):
+            _collect_windows(v, out)
+    if isinstance(node, ast.CaseWhen):
+        for c, v in node.branches:
+            _collect_windows(c, out)
+            _collect_windows(v, out)
+    if isinstance(node, ast.InList):
+        for x in node.items:
+            _collect_windows(x, out)
+
+
+# window functions beyond the aggregate set (ref: ast.WindowFuncs)
+PURE_WINDOW_FUNCS = {
+    "row_number",
+    "rank",
+    "dense_rank",
+    "percent_rank",
+    "cume_dist",
+    "ntile",
+    "lead",
+    "lag",
+    "first_value",
+    "last_value",
+}
+
+
+def _window_ftype(name: str, args: list, win_order: list) -> FieldType:
+    if name in ("row_number", "rank", "dense_rank", "ntile"):
+        return bigint_type(nullable=False)
+    if name in ("percent_rank", "cume_dist"):
+        return replace(double_type(), nullable=False)
+    if name in ("lead", "lag", "first_value", "last_value"):
+        if not args:
+            raise PlanError(f"{name}() needs an argument")
+        return replace(args[0].ftype, nullable=True)
+    if name == "count":
+        return bigint_type(nullable=False)
+    if name in ("sum", "avg", "min", "max"):
+        return AggDesc(name, args[0]).ftype
+    raise PlanError(f"unsupported window function {name}()")
 
 
 def _ast_eq(a, b) -> bool:

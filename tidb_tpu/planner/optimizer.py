@@ -30,6 +30,7 @@ from tidb_tpu.planner.plans import (
     LogicalSelection,
     LogicalSetOp,
     LogicalSort,
+    LogicalWindow,
     OutCol,
     PhysDual,
     PhysDistinct,
@@ -43,6 +44,7 @@ from tidb_tpu.planner.plans import (
     PhysSelection,
     PhysSetOp,
     PhysSort,
+    PhysWindow,
     PhysTableReader,
     PhysicalPlan,
     PlanError,
@@ -143,6 +145,12 @@ def _prune(plan: LogicalPlan, needed: Optional[set[int]]):
         # row identity spans every column — children keep their full schemas
         for i, c in enumerate(plan.children):
             plan.children[i], _ = _prune(c, set(range(len(c.schema))))
+        return plan, {i: i for i in range(len(plan.schema))}
+    if isinstance(plan, LogicalWindow):
+        # appended columns index past the child schema — keep the child whole
+        plan.children[0], _ = _prune(
+            plan.children[0], set(range(len(plan.children[0].schema)))
+        )
         return plan, {i: i for i in range(len(plan.schema))}
     if isinstance(plan, LogicalJoin):
         nleft = len(plan.children[0].schema)
@@ -446,6 +454,16 @@ def _physical(plan: LogicalPlan, engines: list[str]) -> PhysicalPlan:
     if isinstance(plan, LogicalDistinct):
         child = _physical(plan.children[0], engines)
         return PhysDistinct(children=[child])
+    if isinstance(plan, LogicalWindow):
+        return PhysWindow(
+            funcs=plan.funcs,
+            partition_by=plan.partition_by,
+            order_by=plan.order_by,
+            whole_partition=plan.whole_partition,
+            rows_frame=plan.rows_frame,
+            schema=plan.schema,
+            children=[_physical(plan.children[0], engines)],
+        )
     if isinstance(plan, LogicalSetOp):
         return PhysSetOp(
             op=plan.op,

@@ -114,6 +114,32 @@ class LogicalJoin(LogicalPlan):
 
 
 @dataclass
+class WindowFuncDesc:
+    """One window call (ref: aggregation.WindowFuncDesc)."""
+
+    name: str
+    args: list  # resolved Expressions
+    ftype: FieldType
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(map(repr, self.args))})"
+
+
+@dataclass
+class LogicalWindow(LogicalPlan):
+    """Window functions over one OVER spec; appends one output column per
+    func to the child schema (ref: LogicalWindow, rule_window builders)."""
+
+    funcs: list[WindowFuncDesc]
+    partition_by: list  # Expressions
+    order_by: list  # (Expression, desc) pairs
+    whole_partition: bool = False
+    rows_frame: bool = False
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+
+@dataclass
 class LogicalSetOp(LogicalPlan):
     """UNION / INTERSECT / EXCEPT (ref: LogicalUnionAll + set-op builders in
     logical_plan_builder.go). Children already project to a unified schema."""
@@ -273,6 +299,17 @@ class PhysDistinct(PhysicalPlan):
 
 
 @dataclass
+class PhysWindow(PhysicalPlan):
+    funcs: list[WindowFuncDesc]
+    partition_by: list
+    order_by: list
+    whole_partition: bool = False
+    rows_frame: bool = False
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+
+@dataclass
 class PhysSetOp(PhysicalPlan):
     op: str
     all: bool = False
@@ -328,6 +365,9 @@ def explain_plan(p, indent: int = 0) -> str:
         extra = f"{p.kind} on {p.eq_conds}"
     elif isinstance(p, PhysSetOp):
         extra = f"{p.op}{' all' if p.all else ''}"
+    elif isinstance(p, PhysWindow):
+        over = f"partition by {p.partition_by}" if p.partition_by else "()"
+        extra = f"{', '.join(map(repr, p.funcs))} over {over}"
     elif isinstance(p, PhysPointGet):
         extra = f"{p.table.name} handle={p.handle}"
     elif isinstance(p, PhysIndexReader):

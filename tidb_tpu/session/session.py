@@ -53,6 +53,14 @@ class SessionError(Exception):
     pass
 
 
+def _setop_has_for_update(node) -> bool:
+    if isinstance(node, ast.Select):
+        return node.for_update
+    if isinstance(node, ast.SetOp):
+        return _setop_has_for_update(node.left) or _setop_has_for_update(node.right)
+    return False
+
+
 class Session:
     def __init__(self, db: "DB"):
         self._db = db
@@ -217,6 +225,8 @@ class Session:
 
     # -- SELECT ---------------------------------------------------------------
     def _select(self, stmt) -> Result:
+        if isinstance(stmt, ast.SetOp) and _setop_has_for_update(stmt):
+            raise SessionError("FOR UPDATE is not supported inside set operations")
         if getattr(stmt, "for_update", False):
             self._lock_select_rows(stmt)
             if self._explicit and self._txn is not None and self._txn.pessimistic:
