@@ -1,0 +1,106 @@
+"""Correlated and uncorrelated subquery tests (ref: decorrelation rules →
+semi/anti joins, rule_decorrelate.go; eager constant-fold path for
+uncorrelated subqueries)."""
+
+import pytest
+
+import tidb_tpu
+
+
+@pytest.fixture()
+def db():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE c (id BIGINT, name VARCHAR(16))")
+    d.execute("CREATE TABLE o (cid BIGINT, amt BIGINT)")
+    d.execute("INSERT INTO c VALUES (1,'ann'),(2,'bob'),(3,'cat')")
+    d.execute("INSERT INTO o VALUES (1,100),(1,50),(3,70)")
+    return d
+
+
+def test_correlated_exists(db):
+    rows = db.query("SELECT name FROM c WHERE EXISTS (SELECT 1 FROM o WHERE o.cid = c.id) ORDER BY name")
+    assert rows == [("ann",), ("cat",)]
+
+
+def test_correlated_not_exists(db):
+    rows = db.query("SELECT name FROM c WHERE NOT EXISTS (SELECT 1 FROM o WHERE o.cid = c.id) ORDER BY name")
+    assert rows == [("bob",)]
+
+
+def test_correlated_exists_with_local_filter(db):
+    rows = db.query(
+        "SELECT name FROM c WHERE EXISTS (SELECT 1 FROM o WHERE o.cid = c.id AND o.amt > 80) ORDER BY name"
+    )
+    assert rows == [("ann",)]
+
+
+def test_correlated_in(db):
+    rows = db.query(
+        "SELECT name FROM c WHERE id IN (SELECT cid FROM o WHERE o.amt > 60 AND o.cid = c.id) ORDER BY name"
+    )
+    assert rows == [("ann",), ("cat",)]
+
+
+def test_correlated_not_in(db):
+    rows = db.query(
+        "SELECT name FROM c WHERE id NOT IN (SELECT cid FROM o WHERE o.cid = c.id AND o.amt > 80) ORDER BY name"
+    )
+    assert rows == [("bob",), ("cat",)]
+
+
+def test_not_in_null_poisoning(db):
+    db.execute("INSERT INTO o VALUES (NULL, 5)")
+    assert db.query("SELECT name FROM c WHERE id NOT IN (SELECT cid FROM o)") == []
+    rows = db.query(
+        "SELECT name FROM c WHERE id NOT IN (SELECT cid FROM o WHERE cid IS NOT NULL) ORDER BY name"
+    )
+    assert rows == [("bob",)]
+
+
+def test_uncorrelated_scalar_subquery(db):
+    rows = db.query("SELECT name FROM c WHERE id = (SELECT MAX(cid) FROM o)")
+    assert rows == [("cat",)]
+
+
+def test_nonequality_correlation_rejected(db):
+    with pytest.raises(Exception, match="correlat"):
+        db.query("SELECT name FROM c WHERE EXISTS (SELECT 1 FROM o WHERE o.amt < c.id)")
+
+
+def test_null_in_correlation_column_does_not_poison(db):
+    db.execute("INSERT INTO o VALUES (NULL, 7)")
+    rows = db.query(
+        "SELECT name FROM c WHERE id NOT IN (SELECT amt FROM o WHERE o.cid = c.id) ORDER BY name"
+    )
+    # the NULL correlation key matches no outer row — it must not empty the result
+    assert rows == [("ann",), ("bob",), ("cat",)]
+
+
+def test_null_in_in_column_poisons_group_only(db):
+    db.execute("CREATE TABLE o2 (cid BIGINT, amt BIGINT)")
+    db.execute("INSERT INTO o2 VALUES (1, NULL)")
+    rows = db.query(
+        "SELECT name FROM c WHERE id NOT IN (SELECT amt FROM o2 WHERE o2.cid = c.id) ORDER BY name"
+    )
+    # ann's group contains a NULL (UNKNOWN); bob/cat have empty groups (TRUE)
+    assert rows == [("bob",), ("cat",)]
+
+
+def test_exists_over_ungrouped_aggregate_always_true(db):
+    rows = db.query(
+        "SELECT name FROM c WHERE EXISTS (SELECT MAX(amt) FROM o WHERE o.cid = c.id) ORDER BY name"
+    )
+    assert rows == [("ann",), ("bob",), ("cat",)]
+    assert db.query(
+        "SELECT name FROM c WHERE NOT EXISTS (SELECT MAX(amt) FROM o WHERE o.cid = c.id)"
+    ) == []
+
+
+def test_typo_in_subquery_keeps_original_error(db):
+    with pytest.raises(Exception, match="typo"):
+        db.query("SELECT name FROM c WHERE EXISTS (SELECT 1 FROM o WHERE o.cid = c.id AND o.typo > 3)")
+
+
+def test_semi_join_explain_shape(db):
+    lines = [r[0] for r in db.query("EXPLAIN SELECT name FROM c WHERE EXISTS (SELECT 1 FROM o WHERE o.cid = c.id)")]
+    assert any("semi" in l for l in lines)

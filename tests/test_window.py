@@ -106,3 +106,30 @@ def test_window_func_without_over_rejected(db):
 def test_min_max_string_window(db):
     rows = db.query("SELECT MIN(g) OVER (), MAX(g) OVER () FROM w LIMIT 1")
     assert rows == [("a", "b")]
+
+
+def test_rank_ignores_explicit_frame(db):
+    rows = db.query(
+        "SELECT v, RANK() OVER (ORDER BY v ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW)"
+        " FROM w WHERE g='a' ORDER BY v"
+    )
+    assert rows == [(1, 1), (2, 2), (2, 2), (5, 4)]
+
+
+def test_min_string_with_leading_null_frame(db):
+    db.execute("CREATE TABLE s (id BIGINT, t VARCHAR(8))")
+    db.execute("INSERT INTO s VALUES (1,NULL),(2,'z'),(3,'a')")
+    rows = db.query("SELECT MIN(t) OVER (ORDER BY id) FROM s ORDER BY 1")
+    assert rows == [(None,), ("a",), ("z",)]
+
+
+def test_lag_string_default(db):
+    rows = db.query(
+        "SELECT v, LAG(g, 1, 'none') OVER (ORDER BY v) FROM w WHERE g='b' ORDER BY v"
+    )
+    assert rows == [(10, "none"), (20, "b")]
+
+
+def test_ntile_zero_rejected(db):
+    with pytest.raises(Exception, match="positive"):
+        db.query("SELECT NTILE(0) OVER (ORDER BY v) FROM w")

@@ -556,12 +556,13 @@ class WindowExec(Executor):
             c = eval_to_column(e, batch, np)
             d, v = c.data[perm], c.validity[perm]
             part_start[1:] |= (d[1:] != d[:-1]) | (v[1:] != v[:-1])
+        # order-key peer groups: ranking functions always use these, whatever
+        # the frame says (MySQL ignores frames for ranking)
         peer_start = part_start.copy()
-        if not p.whole_partition and not p.rows_frame:
-            for e, _ in p.order_by:
-                c = eval_to_column(e, batch, np)
-                d, v = c.data[perm], c.validity[perm]
-                peer_start[1:] |= (d[1:] != d[:-1]) | (v[1:] != v[:-1])
+        for e, _ in p.order_by:
+            c = eval_to_column(e, batch, np)
+            d, v = c.data[perm], c.validity[perm]
+            peer_start[1:] |= (d[1:] != d[:-1]) | (v[1:] != v[:-1])
         pbounds = np.flatnonzero(part_start).tolist() + [n]
         out_cols = []
         for f in p.funcs:
@@ -807,6 +808,8 @@ class HashJoinExec(Executor):
         lc = self.left.execute()
         rc = self.right.execute()
         nleft = len(lc.columns)
+        if p.kind in ("semi", "anti"):
+            return self._semi_anti(lc, rc)
         if p.kind == "cross" and not p.eq_conds:
             li = np.repeat(np.arange(len(lc)), len(rc))
             ri = np.tile(np.arange(len(rc)), len(lc))
@@ -862,6 +865,71 @@ class HashJoinExec(Executor):
                 miss = Chunk(null_left + [c.take(rmiss) for c in rc.columns])
                 joined = Chunk.concat([joined, miss]) if len(joined) else miss
         return joined
+
+    def _semi_anti(self, lc: Chunk, rc: Chunk) -> Chunk:
+        """[NOT] EXISTS / [NOT] IN rewrites (ref: semi-join executors). The
+        output is the matching (semi) or non-matching (anti) LEFT rows."""
+        p = self.plan
+        if p.kind == "anti" and p.null_aware:
+            return self._null_aware_anti(lc, rc)
+        rkeys = [self._key_array(rc, r) for _, r in p.eq_conds]
+        rvalid = [rc.columns[r].validity for _, r in p.eq_conds]
+        table: set = set()
+        for j in range(len(rc)):
+            if all(v[j] for v in rvalid):
+                table.add(tuple(ka[j] for ka in rkeys))
+        lkeys = [self._key_array(lc, l) for l, _ in p.eq_conds]
+        lvalid = [lc.columns[l].validity for l, _ in p.eq_conds]
+        keep: list[int] = []
+        for i in range(len(lc)):
+            key_valid = all(v[i] for v in lvalid)
+            matched = key_valid and tuple(ka[i] for ka in lkeys) in table
+            if (p.kind == "semi") == matched:
+                keep.append(i)
+        return Chunk([c.take(np.asarray(keep, dtype=np.int64)) for c in lc.columns])
+
+    def _null_aware_anti(self, lc: Chunk, rc: Chunk) -> Chunk:
+        """NOT IN semantics per correlation group (ref: null-aware anti join,
+        hash_join null-aware variants). By construction (builder rewrite) the
+        FIRST eq pair is the IN operand; the rest are correlation keys.
+
+        For each left row with correlation group G (right rows whose
+        correlation keys match): NOT IN is TRUE iff G is empty, or (operand
+        is non-NULL, no NULL among G's IN-column values, and operand ∉ G).
+        """
+        p = self.plan
+        (in_l, in_r), corr = p.eq_conds[0], p.eq_conds[1:]
+        rin = self._key_array(rc, in_r)
+        rin_valid = rc.columns[in_r].validity
+        rcorr = [self._key_array(rc, r) for _, r in corr]
+        rcorr_valid = [rc.columns[r].validity for _, r in corr]
+        groups: dict = {}  # corr key → [set of in-values, has_null]
+        for j in range(len(rc)):
+            if not all(v[j] for v in rcorr_valid):
+                continue  # NULL correlation key never matches any left row
+            g = groups.setdefault(tuple(ka[j] for ka in rcorr), [set(), False])
+            if rin_valid[j]:
+                g[0].add(rin[j])
+            else:
+                g[1] = True
+        lin = self._key_array(lc, in_l)
+        lin_valid = lc.columns[in_l].validity
+        lcorr = [self._key_array(lc, l) for l, _ in corr]
+        lcorr_valid = [lc.columns[l].validity for l, _ in corr]
+        keep: list[int] = []
+        for i in range(len(lc)):
+            if all(v[i] for v in lcorr_valid):
+                g = groups.get(tuple(ka[i] for ka in lcorr))
+            else:
+                g = None  # NULL correlation key → empty group
+            if g is None:
+                keep.append(i)  # NOT IN (empty) is TRUE even for NULL operand
+                continue
+            vals, has_null = g
+            if not lin_valid[i] or has_null or lin[i] in vals:
+                continue  # NULL operand / NULL in list / match → not TRUE
+            keep.append(i)
+        return Chunk([c.take(np.asarray(keep, dtype=np.int64)) for c in lc.columns])
 
     def _apply_other(self, joined: Chunk) -> Chunk:
         if not self.plan.other_conds or len(joined) == 0:

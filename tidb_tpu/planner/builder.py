@@ -173,8 +173,18 @@ class Builder:
             plan = self._build_from(sel.from_)
 
         if sel.where is not None:
-            conds = self._split_conj(self.resolve(sel.where, BuildCtx(plan.schema)))
-            plan = LogicalSelection(conditions=conds, children=[plan])
+            residual: list[ast.Node] = []
+            for cj in _split_ast_conj(sel.where):
+                joined = self._try_subquery_join(plan, cj)
+                if joined is not None:
+                    plan = joined
+                else:
+                    residual.append(cj)
+            conds: list[Expression] = []
+            for cj in residual:
+                conds.extend(self._split_conj(self.resolve(cj, BuildCtx(plan.schema))))
+            if conds:
+                plan = LogicalSelection(conditions=conds, children=[plan])
 
         # aggregation detection
         has_agg = bool(sel.group_by) or any(
@@ -340,6 +350,141 @@ class Builder:
             plan = tp
         return plan
 
+    # -- correlated subqueries → semi/anti join (ref: decorrelation rules,
+    # core/rule/rule_decorrelate.go; only equality correlation is supported,
+    # the common EXISTS/IN shape) --------------------------------------------
+    def _try_subquery_join(self, plan: LogicalPlan, cj: ast.Node) -> Optional[LogicalPlan]:
+        """If ``cj`` is a correlated [NOT] EXISTS / [NOT] IN-subquery
+        predicate, rewrite it into a semi/anti join against ``plan`` and
+        return the join; otherwise return None (the eager uncorrelated path
+        in _resolve handles it)."""
+        negated = False
+        node = cj
+        if isinstance(node, ast.UnaryOp) and node.op == "not":
+            negated, node = True, node.operand
+        operand_ast = None
+        null_aware = False
+        if isinstance(node, ast.SubqueryExpr) and node.modifier == "exists":
+            inner = node.select
+        elif (
+            isinstance(node, ast.InList)
+            and len(node.items) == 1
+            and isinstance(node.items[0], ast.SubqueryExpr)
+        ):
+            inner = node.items[0].select
+            operand_ast = node.operand
+            negated = negated != node.negated
+            null_aware = negated
+        else:
+            return None
+        if not isinstance(inner, ast.Select):
+            return None  # set-op subqueries stay on the eager path
+        if not self._is_correlated(inner, plan.schema):
+            return None
+        if inner.limit is not None or inner.order_by:
+            raise PlanError("correlated subquery with ORDER BY/LIMIT is not supported")
+        inner_has_agg = bool(inner.group_by) or any(
+            not isinstance(it.expr, ast.Wildcard) and _contains_agg(it.expr) for it in inner.items
+        )
+        if inner_has_agg:
+            if operand_ast is None and not inner.group_by:
+                # EXISTS over an ungrouped aggregate: exactly one row always
+                # exists, whatever the correlation filters keep
+                if not negated:
+                    return plan
+                false_sel = LogicalSelection(
+                    conditions=[Constant(0, bool_type())], children=[plan]
+                )
+                return false_sel
+            raise PlanError("unsupported correlated subquery with aggregation")
+        # split the inner WHERE into correlation equalities vs local filters
+        inner_from = self._build_from(inner.from_) if inner.from_ is not None else LogicalDual()
+        inner_schema = inner_from.schema
+        corr: list[tuple[ast.Node, ast.Node]] = []  # (outer side, inner side)
+        keep: list[ast.Node] = []
+        for c in _split_ast_conj(inner.where) if inner.where is not None else []:
+            pair = self._corr_eq_pair(c, inner_schema, plan.schema)
+            if pair is not None:
+                corr.append(pair)
+            else:
+                keep.append(c)
+        if not corr and operand_ast is None:
+            raise PlanError("unsupported correlated subquery (no equality correlation)")
+        inner.where = _and_join_ast(keep)
+        base_items = len(inner.items)
+        for _, inner_side in corr:
+            inner.items.append(ast.SelectItem(inner_side))
+        try:
+            inner_plan = self.build_select(inner)
+        except PlanError as err:
+            if "Unknown column" in str(err) and _unknown_col_in_schema(str(err), plan.schema):
+                raise PlanError(
+                    "unsupported correlated subquery: correlation must be a plain equality"
+                )
+            raise  # a genuine unknown column — keep the original message
+        n_extra = len(corr)
+        eq_conds: list[tuple[int, int]] = []
+        if operand_ast is not None:
+            op_e = self.resolve(operand_ast, BuildCtx(plan.schema))
+            if not isinstance(op_e, ColumnRef):
+                raise PlanError("IN-subquery operand must be a column for correlated rewrite")
+            if base_items != 1:
+                raise PlanError("IN subquery must select exactly one column")
+            eq_conds.append((op_e.index, 0))
+        first_extra = len(inner_plan.schema) - n_extra
+        for i, (outer_side, _) in enumerate(corr):
+            oe = self.resolve(outer_side, BuildCtx(plan.schema))
+            if not isinstance(oe, ColumnRef):
+                raise PlanError("correlated comparison must reference a plain outer column")
+            eq_conds.append((oe.index, first_extra + i))
+        return LogicalJoin(
+            kind="anti" if negated else "semi",
+            eq_conds=eq_conds,
+            null_aware=null_aware,
+            schema=[OutCol(c.name, c.ftype, c.table, c.slot) for c in plan.schema],
+            children=[plan, inner_plan],
+        )
+
+    def _is_correlated(self, inner: ast.Select, outer_schema) -> bool:
+        """True when the subquery fails to resolve alone but its unknown
+        columns exist in the outer scope. The probe's nested subqueries
+        resolve against empty results so nothing executes twice."""
+        probe = Builder(self.catalog, self.db, subquery_runner=lambda _sel: [])
+        try:
+            probe.build_select(inner)
+            return False
+        except PlanError as err:
+            if "Unknown column" not in str(err):
+                raise
+            if _unknown_col_in_schema(str(err), outer_schema):
+                return True
+            raise
+
+    def _corr_eq_pair(self, c: ast.Node, inner_schema, outer_schema):
+        """(outer_ast, inner_ast) when ``c`` is `inner_col = outer_col` (either
+        orientation), else None."""
+        if not (isinstance(c, ast.BinaryOp) and c.op == "eq"):
+            return None
+
+        def scope(x: ast.Node) -> str:
+            try:
+                self.resolve(x, BuildCtx(inner_schema))
+                return "inner"
+            except PlanError:
+                pass
+            try:
+                self.resolve(x, BuildCtx(outer_schema))
+                return "outer"
+            except PlanError:
+                return "none"
+
+        ls, rs = scope(c.left), scope(c.right)
+        if ls == "inner" and rs == "outer":
+            return (c.right, c.left)
+        if ls == "outer" and rs == "inner":
+            return (c.left, c.right)
+        return None
+
     def _build_windows(self, plan: LogicalPlan, win_calls: list) -> LogicalPlan:
         from tidb_tpu.planner.plans import LogicalWindow, WindowFuncDesc
 
@@ -366,8 +511,11 @@ class Builder:
                     for extra in args[1:]:  # offset and default
                         if not isinstance(extra, Constant):
                             raise PlanError(f"{name}() offset/default must be constant")
-                if name == "ntile" and not (args and isinstance(args[0], Constant)):
-                    raise PlanError("ntile() bucket count must be constant")
+                if name == "ntile":
+                    if not (args and isinstance(args[0], Constant)):
+                        raise PlanError("ntile() bucket count must be constant")
+                    if int(args[0].value or 0) < 1:
+                        raise PlanError("ntile() bucket count must be positive")
                 funcs.append(WindowFuncDesc(name, args, _window_ftype(name, args, order)))
             win = LogicalWindow(
                 funcs=funcs,
@@ -814,6 +962,32 @@ def _contains_agg(node) -> bool:
     if isinstance(node, ast.InList):
         return any(_contains_agg(x) for x in node.items)
     return False
+
+
+def _unknown_col_in_schema(err_msg: str, schema) -> bool:
+    """Does the column named in an 'Unknown column' PlanError exist in
+    ``schema``? (used to distinguish correlation from typos)"""
+    name = err_msg.split("'")[1] if "'" in err_msg else ""
+    col = name.split(".")[-1].lower()
+    tbl = name.split(".")[0].lower() if "." in name else ""
+    return any(
+        oc.name.lower() == col and (not tbl or oc.table.lower() == tbl) for oc in schema
+    )
+
+
+def _split_ast_conj(node: ast.Node) -> list:
+    if isinstance(node, ast.BinaryOp) and node.op == "and":
+        return _split_ast_conj(node.left) + _split_ast_conj(node.right)
+    return [node]
+
+
+def _and_join_ast(conds: list):
+    if not conds:
+        return None
+    e = conds[0]
+    for c in conds[1:]:
+        e = ast.BinaryOp("and", e, c)
+    return e
 
 
 def _collect_windows(node, out: list) -> None:

@@ -152,6 +152,19 @@ def _prune(plan: LogicalPlan, needed: Optional[set[int]]):
             plan.children[0], set(range(len(plan.children[0].schema)))
         )
         return plan, {i: i for i in range(len(plan.schema))}
+    if isinstance(plan, LogicalJoin) and plan.kind in ("semi", "anti"):
+        # output schema is the LEFT side only; right contributes join keys
+        ln = set(needed) if needed is not None else set(range(len(plan.children[0].schema)))
+        rn: set[int] = set()
+        for l, r in plan.eq_conds:
+            ln.add(l)
+            rn.add(r)
+        lchild, lmap = _prune(plan.children[0], ln)
+        rchild, rmap = _prune(plan.children[1], rn)
+        plan.children = [lchild, rchild]
+        plan.eq_conds = [(lmap[l], rmap[r]) for l, r in plan.eq_conds]
+        plan.schema = [plan.schema[i] for i in sorted(lmap)]
+        return plan, {old: new for new, old in enumerate(sorted(lmap))}
     if isinstance(plan, LogicalJoin):
         nleft = len(plan.children[0].schema)
         ln: set[int] = set()
@@ -478,6 +491,7 @@ def _physical(plan: LogicalPlan, engines: list[str]) -> PhysicalPlan:
             kind=plan.kind,
             eq_conds=plan.eq_conds,
             other_conds=plan.other_conds,
+            null_aware=plan.null_aware,
             schema=plan.schema,
             children=[left, right],
         )

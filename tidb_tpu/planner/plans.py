@@ -105,10 +105,12 @@ class LogicalLimit(LogicalPlan):
 
 @dataclass
 class LogicalJoin(LogicalPlan):
-    kind: str  # inner/left/right/cross
+    kind: str  # inner/left/right/cross/semi/anti
     # equi-join keys resolved to (left_idx, right_idx) pairs + other conds
     eq_conds: list[tuple[int, int]] = field(default_factory=list)
     other_conds: list[Expression] = field(default_factory=list)
+    # NOT IN: a NULL on either side of the key poisons the anti-match
+    null_aware: bool = False
     schema: Schema = field(default_factory=list)
     children: list = field(default_factory=list)
 
@@ -285,6 +287,7 @@ class PhysHashJoin(PhysicalPlan):
     kind: str
     eq_conds: list[tuple[int, int]]
     other_conds: list[Expression]
+    null_aware: bool = False
     schema: Schema = field(default_factory=list)
     children: list = field(default_factory=list)
 
