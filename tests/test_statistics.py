@@ -1,0 +1,110 @@
+"""Statistics subsystem tests (ref: pkg/statistics + cardinality tests:
+histogram accuracy, TopN, selectivity, cost-based access path, auto-analyze)."""
+
+import numpy as np
+import pytest
+
+import tidb_tpu
+from tidb_tpu.statistics.histogram import build_topn_and_histogram
+from tidb_tpu.statistics.sketch import CMSketch, FMSketch
+
+
+def test_histogram_range_estimates():
+    vals = np.sort(np.arange(10_000, dtype=np.int64) % 100)
+    topn, hist = build_topn_and_histogram(vals, n_top=0, n_buckets=32)
+    # uniform 0..99, 100 of each value
+    est = hist.est_range(None, 50, False, False)  # v < 50
+    assert abs(est - 5000) / 5000 < 0.1
+    est = hist.est_range(20, 30, True, True)
+    assert abs(est - 1100) / 1100 < 0.3
+
+
+def test_topn_absorbs_heavy_hitters():
+    vals = np.sort(np.r_[np.zeros(5000, dtype=np.int64), np.arange(1, 1001, dtype=np.int64)])
+    topn, hist = build_topn_and_histogram(vals)
+    assert topn.count_of(0) == 5000
+    assert hist.total <= 1001
+
+
+def test_cmsketch_counts():
+    cm = CMSketch()
+    vals = np.repeat(np.arange(50, dtype=np.int64), 40)
+    cm.insert_many(vals)
+    assert cm.query(7) >= 40  # CM overestimates, never under
+    assert cm.query(7) < 80
+
+
+def test_fmsketch_ndv():
+    fm = FMSketch(max_size=128)
+    fm.insert_many(np.arange(10_000, dtype=np.int64))
+    assert 3000 < fm.ndv() < 30_000  # order of magnitude
+
+
+@pytest.fixture()
+def adb():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, a BIGINT, b VARCHAR(16))")
+    rows = ",".join(f"({i},{i % 100},'v{i % 7}')" for i in range(2000))
+    d.execute(f"INSERT INTO t VALUES {rows}")
+    d.execute("CREATE INDEX ia ON t (a)")
+    d.execute("ANALYZE TABLE t")
+    return d
+
+
+def test_analyze_populates_stats(adb):
+    t = adb.catalog.table("test", "t")
+    st = adb.stats.get(t.id)
+    assert st is not None and st.row_count == 2000
+    assert st.cols[1].ndv == 100
+    assert st.cols[2].ndv == 7
+    assert st.idxs[1].ndv == 100
+
+
+def test_cost_based_index_choice(adb):
+    # selective eq → index lookup; wide range → columnar full scan
+    lines = "\n".join(r[0] for r in adb.query("EXPLAIN SELECT * FROM t WHERE a = 3"))
+    assert "IndexLookUp" in lines
+    lines = "\n".join(r[0] for r in adb.query("EXPLAIN SELECT * FROM t WHERE a < 95"))
+    assert "TableReader" in lines and "IndexLookUp" not in lines
+
+
+def test_plans_agree_with_and_without_index(adb):
+    with_idx = adb.query("SELECT COUNT(*) FROM t WHERE a = 3")
+    assert with_idx == [(20,)]
+
+
+def test_show_stats(adb):
+    rows = adb.query("SHOW STATS_HISTOGRAMS")
+    assert any(r[1] == "a" and r[3] == 100 for r in rows)
+    assert len(adb.query("SHOW STATS_TOPN")) > 0
+    assert len(adb.query("SHOW STATS_BUCKETS")) > 0
+
+
+def test_auto_analyze(adb):
+    t = adb.catalog.table("test", "t")
+    assert not adb.stats.needs_analyze(t.id)
+    rows = ",".join(f"({i},1,'x')" for i in range(2000, 3200))
+    adb.execute(f"INSERT INTO t VALUES {rows}")
+    assert adb.stats.needs_analyze(t.id)
+    assert adb.run_auto_analyze() == ["test.t"]
+    assert adb.stats.get(t.id).row_count == 3200
+    assert not adb.stats.needs_analyze(t.id)
+
+
+def test_string_stats_selectivity(adb):
+    # b has 7 distinct values; eq on one should pick ~1/7
+    from tidb_tpu.planner.plans import OutCol
+    from tidb_tpu.statistics.selectivity import estimate_selectivity
+    from tidb_tpu.expression import col, func
+    from tidb_tpu.expression.expr import Constant
+    from tidb_tpu.types import string_type
+
+    t = adb.catalog.table("test", "t")
+    st = adb.stats.get(t.id)
+    schema = [OutCol(c.name, c.ftype, slot=c.offset) for c in t.columns]
+    e = func("eq", col(2, string_type(16)), Constant("v3", string_type(16)))
+    sel = estimate_selectivity([e], schema, st)
+    assert abs(sel - 1 / 7) < 0.05
+    # absent value → zero selectivity
+    e = func("eq", col(2, string_type(16)), Constant("nope", string_type(16)))
+    assert estimate_selectivity([e], schema, st) == 0.0

@@ -101,6 +101,35 @@ def test_typo_in_subquery_keeps_original_error(db):
         db.query("SELECT name FROM c WHERE EXISTS (SELECT 1 FROM o WHERE o.cid = c.id AND o.typo > 3)")
 
 
+def test_nested_correlated_exists(db):
+    db.execute("CREATE TABLE o2 (cid BIGINT)")
+    db.execute("INSERT INTO o2 VALUES (3)")
+    rows = db.query(
+        "SELECT name FROM c WHERE EXISTS (SELECT 1 FROM o WHERE o.cid = c.id"
+        " AND EXISTS (SELECT 1 FROM o2 WHERE o2.cid = o.cid))"
+    )
+    assert rows == [("cat",)]
+
+
+def test_agg_shortcut_still_validates_columns(db):
+    with pytest.raises(Exception, match="typo"):
+        db.query(
+            "SELECT name FROM c WHERE EXISTS (SELECT MAX(amt) FROM o WHERE o.cid = c.id AND o.typo > 3)"
+        )
+
+
+def test_rollback_does_not_count_stats_mods(db):
+    t = db.catalog.table("test", "c")
+    s = db.session()
+    base = db.stats._mod_counts.get(t.id, 0)
+    s.execute("BEGIN")
+    s.execute("INSERT INTO c VALUES (9,'x')")
+    s.execute("ROLLBACK")
+    assert db.stats._mod_counts.get(t.id, 0) == base
+    s.execute("INSERT INTO c VALUES (9,'x')")
+    assert db.stats._mod_counts.get(t.id, 0) == base + 1
+
+
 def test_semi_join_explain_shape(db):
     lines = [r[0] for r in db.query("EXPLAIN SELECT name FROM c WHERE EXISTS (SELECT 1 FROM o WHERE o.cid = c.id)")]
     assert any("semi" in l for l in lines)

@@ -928,6 +928,12 @@ class Parser:
         if self.eat_kw("COLUMNS") or self.eat_kw("FIELDS"):
             self.expect_kw("FROM")
             return ast.Show("columns", target=self.ident())
+        if self.eat_kw("STATS_HISTOGRAMS"):
+            return ast.Show("stats_histograms")
+        if self.eat_kw("STATS_TOPN"):
+            return ast.Show("stats_topn")
+        if self.eat_kw("STATS_BUCKETS"):
+            return ast.Show("stats_buckets")
         raise ParseError("unsupported SHOW", self.peek())
 
     def parse_use(self) -> ast.UseDatabase:

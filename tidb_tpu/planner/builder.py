@@ -383,31 +383,43 @@ class Builder:
             return None
         if inner.limit is not None or inner.order_by:
             raise PlanError("correlated subquery with ORDER BY/LIMIT is not supported")
+        # rewrite a private copy — probe builds must never see a mutated AST
+        import copy as _copy
+
+        inner = _copy.deepcopy(inner)
+        # split the inner WHERE into correlation equalities vs local filters;
+        # a probe builder resolves without executing nested subqueries
+        probe = Builder(self.catalog, self.db, subquery_runner=lambda _sel: [])
+        inner_from = probe._build_from(inner.from_) if inner.from_ is not None else LogicalDual()
+        inner_schema = inner_from.schema
+        corr: list[tuple[ast.Node, ast.Node]] = []  # (outer side, inner side)
+        keep: list[ast.Node] = []
+        for c in _split_ast_conj(inner.where) if inner.where is not None else []:
+            pair = self._corr_eq_pair(c, inner_schema, plan.schema, probe)
+            if pair is not None:
+                corr.append(pair)
+            else:
+                keep.append(c)
         inner_has_agg = bool(inner.group_by) or any(
             not isinstance(it.expr, ast.Wildcard) and _contains_agg(it.expr) for it in inner.items
         )
         if inner_has_agg:
             if operand_ast is None and not inner.group_by:
                 # EXISTS over an ungrouped aggregate: exactly one row always
-                # exists, whatever the correlation filters keep
+                # exists — but the stripped body must still be valid SQL
+                inner.where = _and_join_ast(keep)
+                try:
+                    probe.build_select(inner)
+                except PlanError as err:
+                    if "Unknown column" in str(err) and _unknown_col_in_schema(str(err), plan.schema):
+                        raise PlanError(
+                            "unsupported correlated subquery: correlation must be a plain equality"
+                        )
+                    raise
                 if not negated:
                     return plan
-                false_sel = LogicalSelection(
-                    conditions=[Constant(0, bool_type())], children=[plan]
-                )
-                return false_sel
+                return LogicalSelection(conditions=[Constant(0, bool_type())], children=[plan])
             raise PlanError("unsupported correlated subquery with aggregation")
-        # split the inner WHERE into correlation equalities vs local filters
-        inner_from = self._build_from(inner.from_) if inner.from_ is not None else LogicalDual()
-        inner_schema = inner_from.schema
-        corr: list[tuple[ast.Node, ast.Node]] = []  # (outer side, inner side)
-        keep: list[ast.Node] = []
-        for c in _split_ast_conj(inner.where) if inner.where is not None else []:
-            pair = self._corr_eq_pair(c, inner_schema, plan.schema)
-            if pair is not None:
-                corr.append(pair)
-            else:
-                keep.append(c)
         if not corr and operand_ast is None:
             raise PlanError("unsupported correlated subquery (no equality correlation)")
         inner.where = _and_join_ast(keep)
@@ -460,20 +472,20 @@ class Builder:
                 return True
             raise
 
-    def _corr_eq_pair(self, c: ast.Node, inner_schema, outer_schema):
+    def _corr_eq_pair(self, c: ast.Node, inner_schema, outer_schema, probe: "Builder"):
         """(outer_ast, inner_ast) when ``c`` is `inner_col = outer_col` (either
-        orientation), else None."""
+        orientation), else None. ``probe`` resolves without executing."""
         if not (isinstance(c, ast.BinaryOp) and c.op == "eq"):
             return None
 
         def scope(x: ast.Node) -> str:
             try:
-                self.resolve(x, BuildCtx(inner_schema))
+                probe.resolve(x, BuildCtx(inner_schema))
                 return "inner"
             except PlanError:
                 pass
             try:
-                self.resolve(x, BuildCtx(outer_schema))
+                probe.resolve(x, BuildCtx(outer_schema))
                 return "outer"
             except PlanError:
                 return "none"

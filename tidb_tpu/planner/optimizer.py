@@ -52,15 +52,16 @@ from tidb_tpu.planner.plans import (
 from tidb_tpu.types import TypeKind
 
 
-def optimize(plan: LogicalPlan, engines: list[str]) -> PhysicalPlan:
+def optimize(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan:
     """engines: allowed read engines in preference order (session var
-    tidb_isolation_read_engines analog)."""
+    tidb_isolation_read_engines analog). ``stats``: StatsHandle feeding the
+    cost-based access-path choice (pseudo-stats heuristics when absent)."""
     plan, _ = _prune(plan, None)
     plan = _push_selections(plan)
     fast = _try_point_get(plan)
     if fast is not None:
         return fast
-    return _physical(plan, engines)
+    return _physical(plan, engines, stats)
 
 
 # ---------------------------------------------------------------------------
@@ -267,24 +268,55 @@ def _try_point_get(plan: LogicalPlan):
 
 # ---------------------------------------------------------------------------
 # access-path selection (ref: planbuilder getPossibleAccessPaths +
-# find_best_task skyline pruning, heuristics-only until statistics land)
+# find_best_task; cost-based when ANALYZE stats exist, skyline heuristics
+# otherwise)
 # ---------------------------------------------------------------------------
 
+# relative per-row cost factors (ref: plan_cost_ver2 coefficients, rescaled
+# for a columnar device engine: sequential scans are cheap, random handle
+# lookups are not)
+_COST_TABLE_ROW = 1.0
+_COST_IDX_ROW = 1.5
+_COST_LOOKUP_ROW = 6.0
+_COST_SETUP = 40.0
 
-def _choose_index_path(scan: LogicalScan, conds: list[Expression]):
-    """Pick an index path when some index has point (eq/IN) conditions on its
-    leading column(s) — without statistics this is the only case where an
-    index is reliably cheaper than a columnar full scan. PK handle ranges are
-    handled by _derive_ranges on the table-reader path."""
+
+def _choose_index_path(scan: LogicalScan, conds: list[Expression], stats=None):
+    """Access-path choice. With statistics: estimate rows per candidate index
+    from histograms and compare costs against the columnar full scan (ref:
+    find_best_task + cardinality.Selectivity). Without: an index wins only on
+    point (eq/IN) leading-column conditions — the one reliably-cheaper case.
+    PK handle ranges are handled by _derive_ranges on the table-reader path."""
     t = scan.table
-    best = None  # (eq_prefix_len, unique, has_range, IndexAccess)
-    for idx in t.indexes:
-        acc = ranger.detach_index_conditions(conds, scan.schema, t, idx)
-        if acc is None or acc.eq_prefix_len == 0:
-            continue
-        key = (acc.eq_prefix_len, idx.unique, acc.has_range)
-        if best is None or key > best[0]:
-            best = (key, acc)
+    tstats = stats.get(t.id) if stats is not None else None
+    best = None
+    if tstats is not None and tstats.row_count > 0:
+        from tidb_tpu.statistics.selectivity import estimate_selectivity
+
+        total = tstats.row_count
+        # full columnar scan baseline: sequential, device-friendly
+        best_cost = float(total) * _COST_TABLE_ROW
+        for idx in t.indexes:
+            acc = ranger.detach_index_conditions(conds, scan.schema, t, idx)
+            if acc is None or not acc.used:
+                continue
+            rows = total * estimate_selectivity(acc.used, scan.schema, tstats)
+            covering = all(
+                oc.slot in idx.column_offsets or (t.pk_is_handle and oc.slot == t.pk_offset)
+                for oc in scan.schema
+            )
+            cost = _COST_SETUP + rows * (_COST_IDX_ROW if covering else _COST_LOOKUP_ROW)
+            if cost < best_cost:
+                best_cost = cost
+                best = ((), acc)
+    else:
+        for idx in t.indexes:
+            acc = ranger.detach_index_conditions(conds, scan.schema, t, idx)
+            if acc is None or acc.eq_prefix_len == 0:
+                continue
+            key = (acc.eq_prefix_len, idx.unique, acc.has_range)
+            if best is None or key > best[0]:
+                best = (key, acc)
     if best is None:
         return None
     # PK point conditions beat any secondary index (handled downstream)
@@ -381,7 +413,7 @@ def _derive_ranges(scan: LogicalScan, conds: list[Expression]) -> Optional[list[
     return [tablecodec.handle_range(t.id, lo, hi)]
 
 
-def _physical(plan: LogicalPlan, engines: list[str]) -> PhysicalPlan:
+def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan:
     if isinstance(plan, LogicalDual):
         return PhysDual(schema=plan.schema)
     if isinstance(plan, LogicalScan):
@@ -396,10 +428,10 @@ def _physical(plan: LogicalPlan, engines: list[str]) -> PhysicalPlan:
         return reader
     if isinstance(plan, LogicalSelection):
         if isinstance(plan.children[0], LogicalScan):
-            ipath = _choose_index_path(plan.children[0], plan.conditions)
+            ipath = _choose_index_path(plan.children[0], plan.conditions, stats)
             if ipath is not None:
                 return ipath
-        child = _physical(plan.children[0], engines)
+        child = _physical(plan.children[0], engines, stats)
         if isinstance(child, PhysTableReader) and child.pushed_agg is None and child.pushed_topn is None and child.pushed_limit is None:
             st = _pick_engine(engines, plan.conditions)
             pushable = [c for c in plan.conditions if can_push_down(c, st.value)]
@@ -418,7 +450,7 @@ def _physical(plan: LogicalPlan, engines: list[str]) -> PhysicalPlan:
             return child
         return PhysSelection(conditions=plan.conditions, children=[child])
     if isinstance(plan, LogicalAggregation):
-        child = _physical(plan.children[0], engines)
+        child = _physical(plan.children[0], engines, stats)
         exprs: list[Expression] = list(plan.group_by) + [a.arg for a in plan.aggs if a.arg is not None]
         can_push = (
             isinstance(child, PhysTableReader)
@@ -443,10 +475,10 @@ def _physical(plan: LogicalPlan, engines: list[str]) -> PhysicalPlan:
                 return final
         return PhysFinalAgg(group_by=plan.group_by, aggs=plan.aggs, partial_input=False, schema=plan.schema, children=[child])
     if isinstance(plan, LogicalSort):
-        child = _physical(plan.children[0], engines)
+        child = _physical(plan.children[0], engines, stats)
         return PhysSort(by=plan.by, children=[child])
     if isinstance(plan, LogicalLimit):
-        child = _physical(plan.children[0], engines)
+        child = _physical(plan.children[0], engines, stats)
         total = plan.limit + plan.offset
         # topN pushdown: Limit(Sort(reader)) → reader TopN + root merge sort
         if isinstance(child, PhysSort) and isinstance(child.children[0], PhysTableReader):
@@ -462,10 +494,10 @@ def _physical(plan: LogicalPlan, engines: list[str]) -> PhysicalPlan:
             child.pushed_limit = total
         return PhysLimit(limit=plan.limit, offset=plan.offset, children=[child])
     if isinstance(plan, LogicalProjection):
-        child = _physical(plan.children[0], engines)
+        child = _physical(plan.children[0], engines, stats)
         return PhysProjection(exprs=plan.exprs, schema=plan.schema, children=[child])
     if isinstance(plan, LogicalDistinct):
-        child = _physical(plan.children[0], engines)
+        child = _physical(plan.children[0], engines, stats)
         return PhysDistinct(children=[child])
     if isinstance(plan, LogicalWindow):
         return PhysWindow(
@@ -475,18 +507,18 @@ def _physical(plan: LogicalPlan, engines: list[str]) -> PhysicalPlan:
             whole_partition=plan.whole_partition,
             rows_frame=plan.rows_frame,
             schema=plan.schema,
-            children=[_physical(plan.children[0], engines)],
+            children=[_physical(plan.children[0], engines, stats)],
         )
     if isinstance(plan, LogicalSetOp):
         return PhysSetOp(
             op=plan.op,
             all=plan.all,
             schema=plan.schema,
-            children=[_physical(c, engines) for c in plan.children],
+            children=[_physical(c, engines, stats) for c in plan.children],
         )
     if isinstance(plan, LogicalJoin):
-        left = _physical(plan.children[0], engines)
-        right = _physical(plan.children[1], engines)
+        left = _physical(plan.children[0], engines, stats)
+        right = _physical(plan.children[1], engines, stats)
         return PhysHashJoin(
             kind=plan.kind,
             eq_conds=plan.eq_conds,

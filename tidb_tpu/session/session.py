@@ -72,6 +72,8 @@ class Session:
         self._explicit = False
         # current-read override: FOR UPDATE reads at for_update_ts
         self._read_ts_override: Optional[int] = None
+        # table_id → row mods staged by the open txn (flushed at commit)
+        self._pending_mods: dict[int, int] = {}
 
     # -- txn lifecycle (ref: LazyTxn) ---------------------------------------
     def txn(self) -> Txn:
@@ -122,8 +124,13 @@ class Session:
             t, self._txn = self._txn, None
             if commit:
                 t.commit()
+                # stats deltas flush at commit, not per statement (ref:
+                # stats delta dumping) — rolled-back mods never count
+                for tid, n in self._pending_mods.items():
+                    self._db.stats.note_mods(tid, n)
             else:
                 t.rollback()
+        self._pending_mods.clear()
 
     # -- entry points --------------------------------------------------------
     def execute(self, sql: str) -> Result:
@@ -150,18 +157,19 @@ class Session:
     def _execute_stmt(self, stmt: ast.Node) -> Result:
         if isinstance(stmt, (ast.Select, ast.SetOp)):
             return self._select(stmt)
-        if isinstance(stmt, ast.Insert):
+        if isinstance(stmt, (ast.Insert, ast.Update, ast.Delete)):
             from tidb_tpu.executor import write
 
-            return self._dml(lambda: write.execute_insert(self, stmt))
-        if isinstance(stmt, ast.Update):
-            from tidb_tpu.executor import write
-
-            return self._dml(lambda: write.execute_update(self, stmt))
-        if isinstance(stmt, ast.Delete):
-            from tidb_tpu.executor import write
-
-            return self._dml(lambda: write.execute_delete(self, stmt))
+            fn = {
+                ast.Insert: write.execute_insert,
+                ast.Update: write.execute_update,
+                ast.Delete: write.execute_delete,
+            }[type(stmt)]
+            t = self.catalog.table(stmt.table.db or self.current_db, stmt.table.name)
+            res = self._dml(lambda: fn(self, stmt))
+            # stats modify counter feeds auto-analyze (ref: stats delta dump)
+            self.note_table_mods(t.id, res.affected)
+            return res
         if isinstance(stmt, ast.CreateTable):
             self.catalog.create_table(stmt.table.db or self.current_db, stmt)
             return Result()
@@ -284,7 +292,7 @@ class Session:
         builder = Builder(self.catalog, self.current_db, subquery_runner=self._subquery_runner)
         logical = builder.build_query(stmt)
         engines = [e.strip() for e in str(self.vars["tidb_isolation_read_engines"]).split(",") if e.strip()]
-        return optimize(logical, engines)
+        return optimize(logical, engines, stats=self._db.stats)
 
     def _run_select_ast(self, stmt) -> list[tuple]:
         return self._select(stmt).rows
@@ -311,6 +319,8 @@ class Session:
         return Result()
 
     def _show(self, stmt: ast.Show) -> Result:
+        if stmt.kind in ("stats_histograms", "stats_topn", "stats_buckets"):
+            return self._show_stats(stmt.kind)
         if stmt.kind == "tables":
             rows = [(t,) for t in self.catalog.tables(self.current_db)]
             if stmt.like:
@@ -346,6 +356,40 @@ class Session:
             return Result(columns=["Table", "Create Table"], rows=[(t.name, f"CREATE TABLE `{t.name}` (\n  {cols}\n)")])
         raise SessionError(f"unsupported SHOW {stmt.kind}")
 
+    def _show_stats(self, kind: str) -> Result:
+        """SHOW STATS_HISTOGRAMS / STATS_TOPN / STATS_BUCKETS (ref: the
+        mysql.stats_* inspection statements)."""
+        rows: list[tuple] = []
+        for tname in self.catalog.tables(self.current_db):
+            t = self.catalog.table(self.current_db, tname)
+            st = self._db.stats.get(t.id)
+            if st is None:
+                continue
+            for c in t.columns:
+                cs = st.cols.get(c.offset)
+                if cs is None:
+                    continue
+                if kind == "stats_histograms":
+                    rows.append((tname, c.name, st.row_count, cs.ndv, cs.null_count, cs.hist.num_buckets))
+                elif kind == "stats_topn":
+                    for v, cnt in zip(cs.topn.values, cs.topn.counts):
+                        if cs.is_string and cs.dictionary is not None:
+                            v = cs.dictionary.decode(int(v)).decode("utf-8", "replace")
+                        rows.append((tname, c.name, v, int(cnt)))
+                else:
+                    for b in range(cs.hist.num_buckets):
+                        lo, hi = cs.hist.lowers[b], cs.hist.uppers[b]
+                        if cs.is_string and cs.dictionary is not None:
+                            lo = cs.dictionary.decode(int(lo)).decode("utf-8", "replace")
+                            hi = cs.dictionary.decode(int(hi)).decode("utf-8", "replace")
+                        rows.append((tname, c.name, b, int(cs.hist.cum_counts[b]), int(cs.hist.repeats[b]), lo, hi))
+        cols = {
+            "stats_histograms": ["Table", "Column", "Row_count", "Distinct_count", "Null_count", "Buckets"],
+            "stats_topn": ["Table", "Column", "Value", "Count"],
+            "stats_buckets": ["Table", "Column", "Bucket", "Cum_count", "Repeats", "Lower", "Upper"],
+        }[kind]
+        return Result(columns=cols, rows=rows)
+
     def _explain(self, stmt: ast.Explain) -> Result:
         inner = stmt.stmt
         if not isinstance(inner, (ast.Select, ast.SetOp)):
@@ -364,20 +408,21 @@ class Session:
         return Result(columns=["plan"], rows=[(line,) for line in text.split("\n")])
 
     def _analyze(self, stmt: ast.AnalyzeTable) -> Result:
-        # round 1: ANALYZE compacts string dictionaries (order-preserving
-        # codes legalize device-side string ordering); histogram/CM-sketch
-        # statistics are a later round (ref: pkg/statistics)
-        from tidb_tpu.copr.colcache import cache_for
+        """ANALYZE TABLE: build histograms/TopN/CM-FM sketches per column and
+        NDV per index; results land in the DB's stats cache and drive the
+        cost-based access-path choice (ref: ANALYZE executors +
+        statistics/handle)."""
+        from tidb_tpu.statistics import analyze_table
 
-        cache = cache_for(self.store)
         for tr in stmt.tables:
-            t = self.catalog.table(tr.db or self.current_db, tr.name)
-            for c in t.columns:
-                from tidb_tpu.types import TypeKind
-
-                if c.ftype.kind == TypeKind.STRING:
-                    cache.ensure_sorted_dict(t.id, c.offset)
+            db_name = tr.db or self.current_db
+            t = self.catalog.table(db_name, tr.name)
+            self._db.stats.put(analyze_table(self, db_name, t))
         return Result()
+
+    def note_table_mods(self, table_id: int, n: int) -> None:
+        if n:
+            self._pending_mods[table_id] = self._pending_mods.get(table_id, 0) + n
 
 
 class DB:
@@ -389,8 +434,27 @@ class DB:
         self.global_vars: dict[str, Any] = {}
         self._mu = threading.Lock()
         from tidb_tpu.kv.gcworker import GCWorker
+        from tidb_tpu.statistics import StatsHandle
 
         self.gc_worker = GCWorker(self.store)
+        self.stats = StatsHandle()
+
+    def run_auto_analyze(self) -> list[str]:
+        """One auto-analyze sweep (ref: autoanalyze.go:296 — tables whose
+        modify ratio crossed tidb_auto_analyze_ratio get re-analyzed).
+        Returns the names of analyzed tables."""
+        from tidb_tpu.statistics import analyze_table
+
+        s = self.session()
+        analyzed: list[str] = []
+        stale = set(self.stats.stale_tables())
+        for db_name in self.catalog.databases():
+            for tname in self.catalog.tables(db_name):
+                t = self.catalog.table(db_name, tname)
+                if t.id in stale:
+                    self.stats.put(analyze_table(s, db_name, t))
+                    analyzed.append(f"{db_name}.{tname}")
+        return analyzed
 
     def run_gc(self, safe_point: Optional[int] = None) -> int:
         """One synchronous MVCC GC cycle (tests / admin). Honors the

@@ -1,0 +1,95 @@
+"""Stats containers + in-memory stats cache (ref: statistics.Table,
+handle.Handle — the cache/loader; SURVEY §2.4)."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tidb_tpu.statistics.histogram import Histogram, TopN
+from tidb_tpu.statistics.sketch import CMSketch, FMSketch
+
+
+@dataclass
+class ColumnStats:
+    offset: int  # storage slot
+    null_count: int
+    ndv: int
+    topn: TopN
+    hist: Histogram
+    cm: CMSketch
+    fm: FMSketch
+    # string columns estimate over sorted-dictionary codes
+    is_string: bool = False
+    dictionary: object = None  # the sorted Dictionary codes refer to
+
+    def est_eq(self, v, total_rows: int) -> float:
+        c = self.topn.count_of(v)
+        if c is not None:
+            return float(c)
+        h = self.hist.est_eq(v)
+        if h > 0:
+            return h
+        if self.ndv > 0:
+            return max(total_rows / self.ndv, 1.0)
+        return 0.0
+
+
+@dataclass
+class IndexStats:
+    index_id: int
+    ndv: int  # distinct full-tuple count
+
+
+@dataclass
+class TableStats:
+    table_id: int
+    version: int  # commit ts the snapshot was read at
+    row_count: int
+    cols: dict[int, ColumnStats] = field(default_factory=dict)
+    idxs: dict[int, IndexStats] = field(default_factory=dict)
+
+
+class StatsHandle:
+    """Per-DB stats cache + modification counters driving auto-analyze
+    (ref: handle.Handle + autoanalyze.go)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._tables: dict[int, TableStats] = {}
+        self._mod_counts: dict[int, int] = {}
+        self.auto_analyze_ratio = 0.5  # ref: tidb_auto_analyze_ratio default
+
+    def get(self, table_id: int) -> Optional[TableStats]:
+        with self._mu:
+            return self._tables.get(table_id)
+
+    def put(self, stats: TableStats) -> None:
+        with self._mu:
+            self._tables[stats.table_id] = stats
+            self._mod_counts[stats.table_id] = 0
+
+    def drop(self, table_id: int) -> None:
+        with self._mu:
+            self._tables.pop(table_id, None)
+            self._mod_counts.pop(table_id, None)
+
+    def note_mods(self, table_id: int, n: int) -> None:
+        """DML bumps the modify counter (ref: stats delta dumping)."""
+        with self._mu:
+            self._mod_counts[table_id] = self._mod_counts.get(table_id, 0) + n
+
+    def needs_analyze(self, table_id: int) -> bool:
+        with self._mu:
+            st = self._tables.get(table_id)
+            mods = self._mod_counts.get(table_id, 0)
+        if st is None:
+            return mods > 0
+        base = max(st.row_count, 1)
+        return mods / base >= self.auto_analyze_ratio
+
+    def stale_tables(self) -> list[int]:
+        with self._mu:
+            ids = set(self._mod_counts) | set(self._tables)
+        return [tid for tid in ids if self.needs_analyze(tid)]
