@@ -78,6 +78,10 @@ def build_executor(plan, session) -> Executor:
         return IndexReaderExec(plan, session)
     if isinstance(plan, PhysIndexLookUp):
         return IndexLookUpExec(plan, session)
+    from tidb_tpu.parallel.gather import MPPGatherExec, PhysMPPGather
+
+    if isinstance(plan, PhysMPPGather):
+        return MPPGatherExec(plan, session)
     raise ExecError(f"no executor for {type(plan).__name__}")
 
 

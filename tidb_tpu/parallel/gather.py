@@ -1,0 +1,478 @@
+"""MPP gather: plan rewrite + host-side coordinator executing a join+agg
+query as ONE jitted shard_map program over the device mesh.
+
+ref: MPPGather (mpp_gather.go:69) + localMppCoordinator
+(local_mpp_coordinator.go) + fragment cutting (fragment.go:48). Redesigned:
+fragments do not travel as gRPC DAGs to per-node engines — the whole
+fragment tree compiles into collectives (all_to_all / all_gather) on the
+mesh's ``dp`` axis (SURVEY §7.7).
+
+Supported shape (the TPC-H star-join core): FinalAgg ← inner equi-join of
+two table readers where the build side is unique on the join key; aggs
+count/sum/avg; any tpu-legal selection/key/arg expressions. Anything else
+stays on the host Volcano path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from tidb_tpu.expression.expr import AggDesc, ColumnRef, EvalBatch, Expression, can_push_down, eval_expr, expr_from_pb
+from tidb_tpu.planner.plans import (
+    OutCol,
+    PhysFinalAgg,
+    PhysHashJoin,
+    PhysTableReader,
+    PhysicalPlan,
+    Schema,
+)
+from tidb_tpu.types import TypeKind
+
+
+@dataclass
+class PhysMPPGather(PhysicalPlan):
+    """Root of an MPP task tree (ref: PhysicalTableReader with mpp task root
+    + MPPGather executor)."""
+
+    agg: PhysFinalAgg  # group_by/aggs definitions (logical content)
+    left: PhysTableReader
+    right: Optional[PhysTableReader]  # None → single-table MPP agg
+    join_eq: list  # [(left schema pos, right schema pos)]
+    exchange: str = "hash"  # join exchange type: hash | broadcast
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+    @property
+    def fragments(self) -> list[str]:
+        if self.right is None:
+            return [
+                f"Fragment#1 [mpp] {self.left.table.name}: Scan -> Selection -> PartialAgg -> HashExchange",
+                "Fragment#2 [mpp] MergeAgg -> PassThrough(gather)",
+            ]
+        if self.exchange == "broadcast":
+            # probe side stays put; only the build side moves
+            return [
+                f"Fragment#1 [mpp] {self.right.table.name}: Scan -> Selection -> BroadcastExchange",
+                f"Fragment#2 [mpp] {self.left.table.name}: Scan -> Selection -> Join -> PartialAgg -> HashExchange",
+                "Fragment#3 [mpp] MergeAgg -> PassThrough(gather)",
+            ]
+        return [
+            f"Fragment#1 [mpp] {self.left.table.name}: Scan -> Selection -> HashExchange",
+            f"Fragment#2 [mpp] {self.right.table.name}: Scan -> Selection -> HashExchange",
+            "Fragment#3 [mpp] Join -> PartialAgg -> HashExchange",
+            "Fragment#4 [mpp] MergeAgg -> PassThrough(gather)",
+        ]
+
+
+def _right_side_unique(reader: PhysTableReader, key_slots: list[int]) -> bool:
+    t = reader.table
+    if t.pk_is_handle and key_slots == [t.pk_offset]:
+        return True
+    for idx in t.indexes:
+        if (idx.unique or idx.primary) and sorted(idx.column_offsets) == sorted(key_slots):
+            return True
+    return False
+
+
+def _reader_mpp_ok(reader: PhysTableReader) -> bool:
+    return (
+        isinstance(reader, PhysTableReader)
+        and reader.pushed_agg is None
+        and reader.pushed_topn is None
+        and reader.pushed_limit is None
+        and all(can_push_down(c, "tpu") for c in reader.pushed_conditions)
+    )
+
+
+def _agg_mpp_ok(agg: PhysFinalAgg) -> bool:
+    for a in agg.aggs:
+        if a.name not in ("count", "sum", "avg") or a.distinct:
+            return False
+        if a.arg is not None and not can_push_down(a.arg, "tpu"):
+            return False
+    for g in agg.group_by:
+        if not can_push_down(g, "tpu"):
+            return False
+        if g.ftype.kind == TypeKind.STRING and not isinstance(g, ColumnRef):
+            return False  # string group keys must map to a table dictionary
+    return True
+
+
+BROADCAST_THRESHOLD = 100_000  # ref: broadcast-join row threshold spirit
+
+
+def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None) -> PhysicalPlan:
+    """Rewrite eligible FinalAgg-over-join subtrees into PhysMPPGather
+    (ref: the planner preferring mpp task type under tidb_allow_mpp)."""
+    if not int(vars.get("tidb_allow_mpp", 1)):
+        return plan
+    enforce = int(vars.get("tidb_enforce_mpp", 0))
+
+    def walk(p: PhysicalPlan) -> PhysicalPlan:
+        for i, c in enumerate(getattr(p, "children", [])):
+            p.children[i] = walk(c)
+        if not (isinstance(p, PhysFinalAgg) and _agg_mpp_ok(p)):
+            return p
+        child = p.children[0]
+        if (
+            not p.partial_input
+            and isinstance(child, PhysHashJoin)
+            and child.kind == "inner"
+            and child.eq_conds
+            and not child.other_conds
+            and len(child.children) == 2
+            and _reader_mpp_ok(child.children[0])
+            and _reader_mpp_ok(child.children[1])
+        ):
+            lreader, rreader = child.children
+            nleft = len(lreader.schema)
+            key_slots = [rreader.schema[r].slot for _, r in child.eq_conds]
+            key_types = [rreader.schema[r].ftype for _, r in child.eq_conds]
+            if any(ft.kind == TypeKind.STRING for ft in key_types):
+                return p  # per-table dictionaries: string join keys differ
+            if not _right_side_unique(rreader, key_slots):
+                return p
+            # broadcast when the build side is small; shuffle (hash) when the
+            # stats say it is big
+            exchange = "broadcast"
+            if stats is not None:
+                st = stats.get(rreader.table.id)
+                if st is not None and st.row_count > BROADCAST_THRESHOLD:
+                    exchange = "hash"
+            return PhysMPPGather(
+                agg=p,
+                left=lreader,
+                right=rreader,
+                join_eq=list(child.eq_conds),
+                exchange=exchange,
+                schema=p.schema,
+            )
+        if (
+            enforce
+            and p.partial_input
+            and isinstance(child, PhysTableReader)
+            and child.pushed_agg is not None
+            and child.pushed_topn is None
+            and child.pushed_limit is None
+            and all(can_push_down(c, "tpu") for c in child.pushed_conditions)
+        ):
+            # single-table MPP agg (exercised mainly by multi-device runs)
+            agg = PhysFinalAgg(
+                group_by=child.pushed_agg.group_by,
+                aggs=child.pushed_agg.aggs,
+                partial_input=False,
+                schema=p.schema,
+                children=[],
+            )
+            scan_schema = _scan_schema(child)
+            reader = PhysTableReader(
+                db=child.db,
+                table=child.table,
+                store_type=child.store_type,
+                pushed_conditions=list(child.pushed_conditions),
+                scan_slots=[s for s in child.scan_slots],
+                schema=scan_schema,
+            )
+            return PhysMPPGather(agg=agg, left=reader, right=None, join_eq=[], schema=p.schema)
+        return p
+
+    return walk(plan)
+
+
+def _scan_schema(reader: PhysTableReader) -> Schema:
+    t = reader.table
+    out = []
+    for slot in reader.scan_slots:
+        c = t.columns[slot]
+        out.append(OutCol(c.name, c.ftype, table=t.name, slot=slot))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# coordinator / executor
+# ---------------------------------------------------------------------------
+
+
+class MPPGatherExec:
+    """Materialize shard inputs, jit the fragment pipeline over the mesh,
+    merge the replicated partials into the final agg chunk."""
+
+    def __init__(self, plan: PhysMPPGather, session):
+        self.plan = plan
+        self.session = session
+        self.schema = plan.schema
+
+    # -- input materialization ------------------------------------------------
+    def _reader_arrays(self, reader: PhysTableReader):
+        """Full-table columns as (data, validity) pairs + dictionaries,
+        via the host read path (MVCC-consistent at the session read ts)."""
+        from tidb_tpu.executor.executors import TableReaderExec
+        from tidb_tpu.kv.kv import StoreType
+
+        bare = PhysTableReader(
+            db=reader.db,
+            table=reader.table,
+            store_type=StoreType.HOST,
+            scan_slots=list(reader.scan_slots),
+            schema=reader.schema,
+        )
+        chunk = TableReaderExec(bare, self.session).execute()
+        return chunk
+
+    def _bind_conditions(self, reader: PhysTableReader) -> list[Expression]:
+        """String constants → dictionary codes (device legalization)."""
+        from tidb_tpu.copr import dagpb
+        from tidb_tpu.copr.binder import Binder
+        from tidb_tpu.copr.colcache import cache_for
+
+        if not reader.pushed_conditions:
+            return []
+        cache = cache_for(self.session.store)
+        scan_cols = [
+            dagpb.ColumnInfoPB(oc.slot, oc.ftype) for oc in reader.schema
+        ]
+        binder = Binder(cache, reader.table.id, scan_cols)
+        return [expr_from_pb(binder.bind_expr(c.to_pb())) for c in reader.pushed_conditions]
+
+    def execute(self):
+        import jax.numpy as jnp
+
+        from tidb_tpu.parallel import make_mesh
+        from tidb_tpu.parallel.mpp import (
+            DistAggSpec,
+            DistJoinSpec,
+            build_dist_join_agg,
+        )
+
+        p = self.plan
+        mesh = make_mesh()
+        ndev = mesh.devices.size
+        lchunk = self._reader_arrays(p.left)
+        lconds = self._bind_conditions(p.left)
+        if p.right is not None:
+            rchunk = self._reader_arrays(p.right)
+            rconds = self._bind_conditions(p.right)
+        else:
+            rchunk, rconds = None, []
+        agg = p.agg
+
+        def pad_side(chunk):
+            n = len(chunk)
+            per = max((n + ndev - 1) // ndev, 8)
+            tot = per * ndev
+            arrays = []
+            for c in chunk.columns:
+                d = np.zeros(tot, dtype=c.data.dtype)
+                d[:n] = c.data
+                v = np.zeros(tot, dtype=bool)
+                v[:n] = c.validity
+                arrays.append(np.where(v, d, 0))
+                arrays.append(v)
+            live = np.zeros(tot, dtype=bool)
+            live[:n] = True
+            arrays.append(live)
+            return arrays, n
+
+        larrays, n_l = pad_side(lchunk)
+        if rchunk is not None:
+            rarrays, n_r = pad_side(rchunk)
+        else:
+            rarrays, n_r = [], 0
+        ncols_l = len(lchunk.columns)
+        ncols_r = len(rchunk.columns) if rchunk is not None else 0
+
+        def side_selection(conds, ncols):
+            def fn(*cols):
+                pairs = [(cols[2 * i], cols[2 * i + 1]) for i in range(ncols)]
+                live = cols[2 * ncols]
+                batch = EvalBatch(pairs, [None] * ncols, pairs[0][0].shape[0])
+                m = live
+                for cond in conds:
+                    d, v, _ = eval_expr(cond, batch, jnp)
+                    keep = jnp.broadcast_to(d != 0, m.shape)
+                    if v is not None:
+                        keep = keep & jnp.broadcast_to(v, m.shape)
+                    m = m & keep
+                return m
+
+            return fn
+
+        # join keys index into the interleaved lane layout
+        left_keys = [2 * l for l, _ in p.join_eq]
+        right_keys = [2 * r for _, r in p.join_eq]
+
+        lsel = side_selection(lconds, ncols_l)
+        # join keys must be non-NULL to match (inner-join semantics)
+        base_lsel = lsel
+
+        def lsel_with_keys(*cols):
+            m = base_lsel(*cols)
+            for l, _ in p.join_eq:
+                m = m & cols[2 * l + 1]
+            return m
+
+        rsel = None
+        if p.right is not None:
+            rsel0 = side_selection(rconds, ncols_r)
+
+            def rsel(*cols):
+                m = rsel0(*cols)
+                for _, r in p.join_eq:
+                    m = m & cols[2 * r + 1]
+                return m
+
+        # agg input mapping over the joined lane layout
+        n_left_lanes = 2 * ncols_l + 1
+        joined_pairs_n = ncols_l + ncols_r
+
+        def agg_inputs(joined):
+            # joined = left lanes (incl live) + gathered right lanes
+            pairs = [(joined[2 * i], joined[2 * i + 1]) for i in range(ncols_l)]
+            off = n_left_lanes
+            for i in range(ncols_r):
+                pairs.append((joined[off + 2 * i], joined[off + 2 * i + 1]))
+            batch = EvalBatch(pairs, [None] * len(pairs), pairs[0][0].shape[0])
+            out = []
+            for g in agg.group_by:
+                d, v, _ = eval_expr(g, batch, jnp)
+                n = pairs[0][0].shape[0]
+                d = jnp.broadcast_to(d, (n,)).astype(jnp.int64)
+                v = jnp.broadcast_to(v if v is not None else True, (n,))
+                out.append(jnp.where(v, d, 0))
+                out.append(v.astype(jnp.int64))
+            for a in agg.aggs:
+                if a.arg is None:
+                    continue
+                d, v, _ = eval_expr(a.arg, batch, jnp)
+                n = pairs[0][0].shape[0]
+                d = jnp.broadcast_to(d, (n,))
+                v = jnp.broadcast_to(v if v is not None else True, (n,))
+                out.append(jnp.where(v, d, 0))
+                out.append(v.astype(jnp.int64))
+            return out
+
+        n_group_lanes = 2 * len(agg.group_by)
+        sums_idx = list(range(n_group_lanes, n_group_lanes + 2 * sum(1 for a in agg.aggs if a.arg is not None)))
+        group_cap = self._initial_group_cap(len(lchunk))
+        per_shard = (max(n_l, 1) + ndev - 1) // ndev
+        row_cap = max(2 * per_shard, 64)
+        while True:
+            spec = DistAggSpec(n_keys=n_group_lanes, sums=sums_idx, group_cap=group_cap)
+            join_spec = None
+            if p.right is not None:
+                join_spec = DistJoinSpec(
+                    left_keys=left_keys,
+                    right_keys=right_keys,
+                    exchange=p.exchange,
+                    row_cap=row_cap,
+                )
+            fn = build_dist_join_agg(
+                mesh,
+                join_spec,
+                spec,
+                n_left=n_left_lanes,
+                n_right=(2 * ncols_r + 1) if p.right is not None else 0,
+                left_selection=lsel_with_keys if p.right is not None else lsel,
+                right_selection=rsel,
+                agg_inputs=agg_inputs,
+            )
+            outs = fn(*[jnp.asarray(a) for a in larrays + rarrays])
+            dropped = int(np.asarray(outs[-2]))
+            group_overflow = int(np.asarray(outs[-1]))
+            if dropped == 0 and group_overflow == 0:
+                break
+            # grow-on-overflow, like coprocessor paging
+            if dropped:
+                row_cap *= 4
+            if group_overflow:
+                group_cap *= 4
+        return self._merge(outs[:-2], agg)
+
+    def _initial_group_cap(self, n_left_rows: int) -> int:
+        """Static per-shard group capacity: NDV-product estimate with a
+        ×2 margin when ANALYZE stats exist, else a conservative bound on the
+        probe row count. Undersizing is safe — overflow is detected and the
+        coordinator retries bigger."""
+        stats = self.session._db.stats
+        est = 1
+        have = False
+        for gi, g in enumerate(self.plan.agg.group_by):
+            if not isinstance(g, ColumnRef):
+                est *= 64
+                continue
+            src = self._group_source(gi)
+            ndv = None
+            if src is not None and stats is not None:
+                st = stats.get(src[0])
+                cs = st.cols.get(src[1]) if st is not None else None
+                if cs is not None:
+                    ndv, have = cs.ndv, True
+            est *= ndv if ndv else 64
+        if have:
+            return max(_pow2(min(2 * est, 1 << 16)), 64)
+        return max(_pow2(min(n_left_rows + 1, 1 << 16)), 256)
+
+    def _merge(self, outs, agg: PhysFinalAgg):
+        """Replicated (group lanes…, sum lanes…, count) → final agg chunk via
+        the shared partial-merge path."""
+        from tidb_tpu.executor.executors import merge_partials
+        from tidb_tpu.utils.chunk import Chunk, Column
+        from tidb_tpu.types.field_type import bigint_type
+
+        n_groups_lanes = 2 * len(agg.group_by)
+        n_val_lanes = 2 * sum(1 for a in agg.aggs if a.arg is not None)
+        arrs = [np.asarray(o) for o in outs]
+        cnt = arrs[n_groups_lanes + n_val_lanes]
+        live = cnt > 0
+        # assemble the partial chunk in _partial_schema layout
+        cols = []
+        vi = 0
+        for a in agg.aggs:
+            if a.arg is None:  # count(*)
+                cols.append(Column(cnt[live].astype(np.int64), np.ones(live.sum(), bool), bigint_type(nullable=False)))
+                continue
+            vdata = arrs[n_groups_lanes + 2 * vi][live]
+            vcount = arrs[n_groups_lanes + 2 * vi + 1][live]
+            vi += 1
+            for pk in a.partial_kinds:
+                if pk == "count":
+                    cols.append(Column(vcount.astype(np.int64), np.ones(live.sum(), bool), bigint_type(nullable=False)))
+                else:  # sum lane
+                    ft = AggDesc("sum", a.arg).ftype
+                    dt = np.float64 if ft.kind == TypeKind.FLOAT else np.int64
+                    cols.append(Column(vdata.astype(dt), vcount > 0, ft))
+        from tidb_tpu.copr.colcache import cache_for
+
+        cache = cache_for(self.session.store)
+        for gi, g in enumerate(agg.group_by):
+            kdata = arrs[2 * gi][live]
+            kvalid = arrs[2 * gi + 1][live].astype(bool)
+            dic = None
+            if g.ftype.kind == TypeKind.STRING and isinstance(g, ColumnRef):
+                src = self._group_source(gi)
+                if src is not None:
+                    dic = cache.dictionary(*src)
+            dt = np.float64 if g.ftype.kind == TypeKind.FLOAT else (np.int32 if g.ftype.kind == TypeKind.STRING else np.int64)
+            cols.append(Column(kdata.astype(dt), kvalid, g.ftype, dic))
+        chunk = Chunk(cols)
+        return merge_partials(chunk, agg.aggs, len(agg.group_by))
+
+    def _group_source(self, gi: int):
+        """(table_id, slot) whose dictionary a string group key uses."""
+        g = self.plan.agg.group_by[gi]
+        nleft = len(self.plan.left.schema)
+        if g.index < nleft:
+            return (self.plan.left.table.id, self.plan.left.schema[g.index].slot)
+        if self.plan.right is not None:
+            return (self.plan.right.table.id, self.plan.right.schema[g.index - nleft].slot)
+        return None
+
+
+def _pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b

@@ -16,6 +16,12 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "dp"):
 
     devs = jax.devices()
     if n_devices is not None:
+        if n_devices > len(devs):
+            raise RuntimeError(
+                f"mesh wants {n_devices} devices, only {len(devs)} available "
+                "(set XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                "JAX_PLATFORMS=cpu for a virtual mesh)"
+            )
         devs = devs[:n_devices]
     import numpy as np
 

@@ -39,7 +39,10 @@ class DistAggSpec:
 
 def _segment_partial(jnp, keys, vals, mask, cap):
     """Sort-based grouped partial agg on one shard (same algorithm as
-    ops/dag_kernel.py — key-exact, no hash collisions)."""
+    ops/dag_kernel.py — key-exact, no hash collisions). Returns
+    (keys, sums, counts, overflow): ``overflow`` counts distinct groups
+    beyond ``cap`` — results are invalid unless it is zero, so callers
+    surface it and retry with a bigger cap."""
     n = keys[0].shape[0]
     lanes = [~mask] + list(keys)
     perm = jnp.argsort(lanes[-1], stable=True)
@@ -52,6 +55,7 @@ def _segment_partial(jnp, keys, vals, mask, cap):
         ks = k[perm]
         diff = diff | jnp.concatenate([jnp.zeros(1, bool), ks[1:] != ks[:-1]])
     boundary = sm & (first | diff)
+    overflow = jnp.maximum(boundary.sum() - cap, 0)
     seg = jnp.clip(jnp.cumsum(boundary) - 1, 0, None)
     import jax
 
@@ -65,7 +69,7 @@ def _segment_partial(jnp, keys, vals, mask, cap):
     for v in vals:
         vs = v[perm]
         out_sums.append(jax.ops.segment_sum(jnp.where(sm, vs, 0), seg, num_segments=cap))
-    return out_keys, out_sums, cnt  # slot i valid iff cnt[i] > 0
+    return out_keys, out_sums, cnt, overflow  # slot i valid iff cnt[i] > 0
 
 
 def build_dist_agg(mesh, spec: DistAggSpec, selection: Callable | None = None):
@@ -92,7 +96,7 @@ def build_dist_agg(mesh, spec: DistAggSpec, selection: Callable | None = None):
             mask = selection(*cols)
 
         # fragment 1: local partial agg
-        pkeys, psums, pcnt = _segment_partial(jnp, keys, vals, mask, cap)
+        pkeys, psums, pcnt, _of = _segment_partial(jnp, keys, vals, mask, cap)
 
         # hash exchange: route group slots to owner = hash(keys) % ndev
         h = pkeys[0]
@@ -125,7 +129,7 @@ def build_dist_agg(mesh, spec: DistAggSpec, selection: Callable | None = None):
 
         # fragment 2: merge received partials for the owned key range
         rmask = rcnt > 0
-        mkeys, msums_and_cnt, _ = _segment_partial(jnp, rkeys, rsums + [rcnt], rmask, cap)
+        mkeys, msums_and_cnt, _, _of2 = _segment_partial(jnp, rkeys, rsums + [rcnt], rmask, cap)
         msums = msums_and_cnt[:-1]
         mcnt = msums_and_cnt[-1]
 
@@ -150,6 +154,197 @@ def build_dist_agg(mesh, spec: DistAggSpec, selection: Callable | None = None):
         return jax.jit(fn)(*cols)
 
     return run
+
+
+@dataclass
+class DistJoinSpec:
+    """A distributed equi-join between two sharded tables (ref: the MPP
+    shuffle/broadcast hash join, mpp_exec.go join + exchange senders).
+
+    ``left_keys``/``right_keys``: column indices of the join keys (int
+    lanes). The right (build) side must be unique on its key — the
+    dimension-table shape every TPC-H-style star join has; the planner
+    falls back to the host join otherwise.
+    ``exchange``: "hash" (both sides shuffled by key owner — all_to_all) or
+    "broadcast" (right side replicated — all_gather).
+    ``row_cap``: static per-destination receive capacity for hash exchange
+    (overflow is reported, never silently dropped on the result path)."""
+
+    left_keys: Sequence[int]
+    right_keys: Sequence[int]
+    exchange: str = "hash"  # hash | broadcast
+    row_cap: int = 4096
+
+
+def _combine_keys(jnp, keys):
+    """Mix multiple int64 key lanes into one ordering/bucketing lane.
+    Components are verified exactly after matching, so a (cosmically rare)
+    mix collision can only cost a missed adjacency, never a false match."""
+    h = keys[0].astype(jnp.int64)
+    for k in keys[1:]:
+        # 0x9E3779B97F4A7C15 as signed int64 (two's complement)
+        h = h * jnp.int64(-7046029254386353131) + k.astype(jnp.int64)
+    return h
+
+
+def _route_rows(jax, jnp, arrays, valid, owner, ndev, cap):
+    """Hash-exchange rows to owner shards (all_to_all with static per-dest
+    capacity). Returns (received arrays, received valid, locally dropped)."""
+    n = valid.shape[0]
+    # stable sort by destination; invalid rows park past every real dest
+    order = jnp.argsort(jnp.where(valid, owner, ndev), stable=True)
+    so = jnp.where(valid, owner, ndev)[order]
+    sv = valid[order]
+    rank = jnp.arange(n) - jnp.searchsorted(so, so, side="left")
+    keep = sv & (rank < cap)
+    dropped = (sv & (rank >= cap)).sum()
+    # non-kept rows scatter to a sacrificial slot past the buffer
+    idx = jnp.where(keep, so * cap + jnp.clip(rank, 0, cap - 1), ndev * cap)
+
+    def exchange(buf):
+        return jax.lax.all_to_all(
+            buf.reshape(ndev, cap), "dp", split_axis=0, concat_axis=0, tiled=False
+        ).reshape(ndev * cap)
+
+    out_arrays = []
+    for x in arrays:
+        buf = jnp.zeros((ndev * cap + 1,), dtype=x.dtype)
+        buf = buf.at[idx].set(x[order])
+        out_arrays.append(exchange(buf[: ndev * cap]))
+    vbuf = jnp.zeros((ndev * cap + 1,), dtype=bool)
+    vbuf = vbuf.at[idx].set(keep)
+    out_valid = exchange(vbuf[: ndev * cap])
+    return out_arrays, out_valid, dropped
+
+
+def _local_unique_join(jax, jnp, lkey, lkeys, lvalid, rkey, rkeys, rcols, rvalid):
+    """Per-shard probe of a unique-key build side: for each left row find its
+    right match (≤1 by uniqueness). Returns (gathered right cols, match)."""
+    cap = rkey.shape[0]
+    rperm = jnp.argsort(jnp.where(rvalid, rkey, jnp.int64(2**62)), stable=True)
+    rk_s = jnp.where(rvalid, rkey, jnp.int64(2**62))[rperm]
+    idx = jnp.clip(jnp.searchsorted(rk_s, lkey), 0, cap - 1)
+    match = (rk_s[idx] == lkey) & lvalid
+    match &= rvalid[rperm][idx]
+    # exact component verification (mix collisions can't fabricate a match)
+    for lcomp, rcomp in zip(lkeys, rkeys):
+        match &= rcomp[rperm][idx] == lcomp
+    gathered = [rc[rperm][idx] for rc in rcols]
+    return gathered, match
+
+
+def build_dist_join_agg(
+    mesh,
+    join: DistJoinSpec | None,
+    agg: DistAggSpec,
+    *,
+    n_left: int,
+    n_right: int = 0,
+    left_selection: Callable | None = None,
+    right_selection: Callable | None = None,
+    agg_inputs: Callable | None = None,
+):
+    """The canonical MPP pipeline in ONE jitted shard_map (ref: §3.3 —
+    fragments: scan→sel→[exchange]→join→partial agg→hash exchange→merge→
+    gather; fragment boundaries are collectives on the ``dp`` axis).
+
+    Inputs: ``n_left`` sharded left columns then ``n_right`` sharded right
+    columns. ``agg_inputs(joined_cols) -> cols`` maps the joined row
+    (left cols + gathered right cols) to the agg input layout
+    (``agg.n_keys`` keys first, then value columns; defaults to identity).
+    Returns replicated (keys..., sums..., count, total, dropped).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    ndev = mesh.devices.size
+    cap = agg.group_cap
+
+    def step(*cols):
+        lcols = list(cols[:n_left])
+        rcols = list(cols[n_left : n_left + n_right])
+        lvalid = jnp.ones(lcols[0].shape[0], dtype=bool)
+        if left_selection is not None:
+            lvalid = left_selection(*lcols)
+        if join is None:
+            # no-join pipeline: scan → selection → two-phase agg
+            joined, mask = lcols, lvalid
+            dropped = jnp.int64(0)
+            return _agg_tail(joined, mask, dropped)
+        rvalid = jnp.ones(rcols[0].shape[0], dtype=bool)
+        if right_selection is not None:
+            rvalid = right_selection(*rcols)
+        lkeys = [lcols[i] for i in join.left_keys]
+        rkeys = [rcols[i] for i in join.right_keys]
+        lkey = _combine_keys(jnp, lkeys)
+        rkey = _combine_keys(jnp, rkeys)
+        dropped = jnp.int64(0)
+        if join.exchange == "hash":
+            lowner = jnp.abs(lkey) % ndev
+            rowner = jnp.abs(rkey) % ndev
+            lcols2, lvalid, d1 = _route_rows(jax, jnp, lcols, lvalid, lowner, ndev, join.row_cap)
+            rcols2, rvalid, d2 = _route_rows(jax, jnp, rcols, rvalid, rowner, ndev, join.row_cap)
+            dropped = d1 + d2
+            lcols, rcols = lcols2, rcols2
+            lkeys = [lcols[i] for i in join.left_keys]
+            rkeys = [rcols[i] for i in join.right_keys]
+            lkey = _combine_keys(jnp, lkeys)
+            rkey = _combine_keys(jnp, rkeys)
+        else:  # broadcast: replicate the build side on every shard
+            rcols = [jax.lax.all_gather(c, "dp").reshape(-1) for c in rcols]
+            rvalid = jax.lax.all_gather(rvalid, "dp").reshape(-1)
+            rkeys = [rcols[i] for i in join.right_keys]
+            rkey = _combine_keys(jnp, rkeys)
+        gathered, match = _local_unique_join(jax, jnp, lkey, lkeys, lvalid, rkey, rkeys, rcols, rvalid)
+        joined = lcols + gathered
+        return _agg_tail(joined, match, dropped)
+
+    def _agg_tail(joined, mask, dropped):
+        import jax
+        import jax.numpy as jnp
+
+        acols = agg_inputs(joined) if agg_inputs is not None else joined
+        keys = list(acols[: agg.n_keys])
+        vals = [acols[i] for i in agg.sums]
+        pkeys, psums, pcnt, of1 = _segment_partial(jnp, keys, vals, mask, cap)
+        h = _combine_keys(jnp, pkeys)
+        owner = jnp.where(pcnt > 0, jnp.abs(h) % ndev, ndev - 1)
+        order = jnp.argsort(owner, stable=True)
+        so = owner[order]
+        rank = jnp.arange(cap) - jnp.searchsorted(so, so, side="left")
+        # one dest owning more than ``cap`` group slots overflows the bucket
+        of_slots = ((pcnt[order] > 0) & (rank >= cap)).sum()
+
+        def bucketize(x):
+            buf = jnp.zeros((ndev * cap,), dtype=x.dtype)
+            return buf.at[so * cap + rank].set(x[order])
+
+        def exchange(buf):
+            return jax.lax.all_to_all(
+                buf.reshape(ndev, cap), "dp", split_axis=0, concat_axis=0, tiled=False
+            ).reshape(ndev * cap)
+
+        rxkeys = [exchange(bucketize(k)) for k in pkeys]
+        rxsums = [exchange(bucketize(s)) for s in psums]
+        rxcnt = exchange(bucketize(pcnt))
+        mkeys, msums_cnt, _, of3 = _segment_partial(jnp, rxkeys, rxsums + [rxcnt], rxcnt > 0, cap)
+        gkeys = [jax.lax.all_gather(k, "dp").reshape(ndev * cap) for k in mkeys]
+        gsums = [jax.lax.all_gather(s, "dp").reshape(ndev * cap) for s in msums_cnt[:-1]]
+        gcnt = jax.lax.all_gather(msums_cnt[-1], "dp").reshape(ndev * cap)
+        total = jax.lax.psum(mask.sum(), "dp")
+        gdropped = jax.lax.psum(dropped, "dp")
+        goverflow = jax.lax.psum(of1 + of_slots + of3, "dp")
+        return (*gkeys, *gsums, gcnt, total, gdropped, goverflow)
+
+    fn = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=tuple(P("dp") for _ in range(n_left + n_right)),
+        out_specs=(P(None),) * (agg.n_keys + len(agg.sums) + 1) + (P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
 
 
 def finalize_dist_agg(outs, n_keys: int, n_sums: int):

@@ -379,6 +379,16 @@ def explain_plan(p, indent: int = 0) -> str:
     elif isinstance(p, PhysIndexLookUp):
         conds = f" -> Selection({', '.join(map(repr, p.residual_conditions))})" if p.residual_conditions else ""
         extra = f"[host] {p.table.name}: IndexScan({p.index.name}, {len(p.ranges)} ranges) -> TableRowIDScan{conds}"
+    from tidb_tpu.parallel.gather import PhysMPPGather
+
+    if isinstance(p, PhysMPPGather):
+        extra = f"{len(p.fragments)} fragments, {p.exchange} join exchange" if p.right is not None else f"{len(p.fragments)} fragments"
+        lines = [f"{pad}{name} {extra}"]
+        for fr in p.fragments:
+            lines.append(f"{pad}  {fr}")
+        for r in [p.left] + ([p.right] if p.right is not None else []):
+            lines.append(explain_plan(r, indent + 1))
+        return "\n".join(lines)
     lines = [f"{pad}{name} {extra}".rstrip()]
     for c in getattr(p, "children", []):
         lines.append(explain_plan(c, indent + 1))

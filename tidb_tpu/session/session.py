@@ -35,6 +35,9 @@ DEFAULT_SYSVARS = {
     "tidb_txn_mode": "pessimistic",
     "innodb_lock_wait_timeout": 3,  # seconds (shortened for embedded use)
     "tidb_gc_life_time": 600,  # seconds (ref: 10m default)
+    # MPP gating (ref: tidb_vars.go:399 tidb_allow_mpp, :415 tidb_enforce_mpp)
+    "tidb_allow_mpp": 1,
+    "tidb_enforce_mpp": 0,
 }
 
 
@@ -292,7 +295,10 @@ class Session:
         builder = Builder(self.catalog, self.current_db, subquery_runner=self._subquery_runner)
         logical = builder.build_query(stmt)
         engines = [e.strip() for e in str(self.vars["tidb_isolation_read_engines"]).split(",") if e.strip()]
-        return optimize(logical, engines, stats=self._db.stats)
+        plan = optimize(logical, engines, stats=self._db.stats)
+        from tidb_tpu.parallel.gather import try_mpp_rewrite
+
+        return try_mpp_rewrite(plan, self.vars, stats=self._db.stats)
 
     def _run_select_ast(self, stmt) -> list[tuple]:
         return self._select(stmt).rows
