@@ -184,3 +184,27 @@ def test_enforce_mpp_single_table(sqldb):
     s.execute("SET tidb_allow_mpp = 0")
     host = s.execute(q).rows
     assert mpp == host
+
+
+def test_sql_mpp_scalar_aggregate(sqldb):
+    """Scalar (no GROUP BY) aggregates over an MPP join must match the host
+    path — the pipeline routes them through a synthetic constant group key."""
+    q = "SELECT COUNT(*), SUM(qty) FROM fact JOIN dim ON fact.cid = dim.id"
+    s = sqldb.session()
+    lines = "\n".join(r[0] for r in s.execute("EXPLAIN " + q).rows)
+    assert "PhysMPPGather" in lines
+    mpp = s.execute(q).rows
+    s.execute("SET tidb_allow_mpp = 0")
+    host = s.execute(q).rows
+    assert mpp == host
+
+
+def test_sql_mpp_scalar_aggregate_single_table(sqldb):
+    s = sqldb.session()
+    s.execute("SET tidb_enforce_mpp = 1")
+    q = "SELECT COUNT(*), SUM(qty), AVG(qty) FROM fact WHERE qty > 2"
+    mpp = s.execute(q).rows
+    s.execute("SET tidb_enforce_mpp = 0")
+    s.execute("SET tidb_allow_mpp = 0")
+    host = s.execute(q).rows
+    assert mpp == host

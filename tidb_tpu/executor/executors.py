@@ -24,6 +24,7 @@ from tidb_tpu.planner.plans import (
     PhysIndexLookUp,
     PhysIndexReader,
     PhysLimit,
+    PhysMemSource,
     PhysPointGet,
     PhysProjection,
     PhysSelection,
@@ -72,6 +73,8 @@ def build_executor(plan, session) -> Executor:
         return WindowExec(plan, build_executor(plan.children[0], session))
     if isinstance(plan, PhysDual):
         return DualExec(plan)
+    if isinstance(plan, PhysMemSource):
+        return MemSourceExec(plan)
     if isinstance(plan, PhysPointGet):
         return PointGetExec(plan, session)
     if isinstance(plan, PhysIndexReader):
@@ -952,6 +955,25 @@ class DualExec(Executor):
         # one dummy row so projections above evaluate constants once
         c = Column(np.zeros(1, np.int64), np.ones(1, bool), bigint_type(nullable=False))
         return Chunk([c])
+
+
+@dataclass
+class MemSourceExec(Executor):
+    """Materialized in-memory rowset (recursive-CTE results, memtables)."""
+
+    plan: object  # PhysMemSource
+
+    def __post_init__(self):
+        self.schema = self.plan.schema
+
+    def execute(self) -> Chunk:
+        rows = self.plan.rows
+        return Chunk(
+            [
+                Column.from_values([r[i] for r in rows], oc.ftype)
+                for i, oc in enumerate(self.plan.schema)
+            ]
+        )
 
 
 @dataclass

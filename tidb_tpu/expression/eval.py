@@ -681,6 +681,42 @@ def _encode_strs(ctx, strs):
     return data, valid
 
 
+@register("cast_string", lambda args: string_type(), engines=HOST_ONLY, arity=1)
+def _cast_string(xp, args, ctx):
+    """CAST(x AS CHAR) — MySQL-style value formatting."""
+    import numpy as np
+
+    from tidb_tpu.types.datum import days_to_date, micros_to_datetime
+
+    t = ctx.arg_types[0]
+    if t.kind == TypeKind.STRING:
+        strs, _ = _decode_strs(ctx, 0)
+        return _encode_strs(ctx, strs)
+    (d, v) = args[0]
+    n = len(d) if hasattr(d, "__len__") else ctx.n
+    out = []
+    for k in range(n):
+        if v is not None and v is not True and not (v if isinstance(v, bool) else v[k]):
+            out.append(None)
+            continue
+        x = d if not hasattr(d, "__len__") else d[k]
+        if t.kind == TypeKind.DECIMAL and t.scale > 0:
+            iv = int(x)
+            sign = "-" if iv < 0 else ""
+            iv = abs(iv)
+            s = f"{sign}{iv // 10**t.scale}.{iv % 10**t.scale:0{t.scale}d}"
+        elif t.kind == TypeKind.FLOAT:
+            s = repr(float(x))
+        elif t.kind == TypeKind.DATE:
+            s = str(days_to_date(int(x)))
+        elif t.kind == TypeKind.DATETIME:
+            s = str(micros_to_datetime(int(x)))
+        else:
+            s = str(int(x))
+        out.append(s.encode() if isinstance(s, str) else s)
+    return _encode_strs(ctx, out)
+
+
 @register("length", lambda args: bigint_type(), engines=HOST_ONLY, arity=1)
 def _length(xp, args, ctx):
     strs, v = _decode_strs(ctx, 0)

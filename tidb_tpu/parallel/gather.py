@@ -336,6 +336,12 @@ class MPPGatherExec:
                 pairs.append((joined[off + 2 * i], joined[off + 2 * i + 1]))
             batch = EvalBatch(pairs, [None] * len(pairs), pairs[0][0].shape[0])
             out = []
+            if not agg.group_by:
+                # scalar aggregate: one synthetic constant group key so the
+                # segment/exchange machinery sees exactly one group
+                n = pairs[0][0].shape[0]
+                out.append(jnp.zeros(n, jnp.int64))
+                out.append(jnp.ones(n, jnp.int64))
             for g in agg.group_by:
                 d, v, _ = eval_expr(g, batch, jnp)
                 n = pairs[0][0].shape[0]
@@ -354,7 +360,7 @@ class MPPGatherExec:
                 out.append(v.astype(jnp.int64))
             return out
 
-        n_group_lanes = 2 * len(agg.group_by)
+        n_group_lanes = 2 * len(agg.group_by) if agg.group_by else 2
         sums_idx = list(range(n_group_lanes, n_group_lanes + 2 * sum(1 for a in agg.aggs if a.arg is not None)))
         group_cap = self._initial_group_cap(len(lchunk))
         per_shard = (max(n_l, 1) + ndev - 1) // ndev
@@ -396,6 +402,8 @@ class MPPGatherExec:
         ×2 margin when ANALYZE stats exist, else a conservative bound on the
         probe row count. Undersizing is safe — overflow is detected and the
         coordinator retries bigger."""
+        if not self.plan.agg.group_by:
+            return 8  # scalar aggregate: one synthetic group
         stats = self.session._db.stats
         est = 1
         have = False
@@ -422,7 +430,7 @@ class MPPGatherExec:
         from tidb_tpu.utils.chunk import Chunk, Column
         from tidb_tpu.types.field_type import bigint_type
 
-        n_groups_lanes = 2 * len(agg.group_by)
+        n_groups_lanes = 2 * len(agg.group_by) if agg.group_by else 2
         n_val_lanes = 2 * sum(1 for a in agg.aggs if a.arg is not None)
         arrs = [np.asarray(o) for o in outs]
         cnt = arrs[n_groups_lanes + n_val_lanes]

@@ -73,85 +73,33 @@ def _segment_partial(jnp, keys, vals, mask, cap):
 
 
 def build_dist_agg(mesh, spec: DistAggSpec, selection: Callable | None = None):
-    """→ jitted fn(*sharded_cols) executing the two-fragment MPP agg.
+    """→ fn(*sharded_cols) executing the two-fragment MPP agg (the no-join
+    specialization of :func:`build_dist_join_agg`).
 
     Input: one array per column, sharded along dp (global length =
-    ndev * local_n). Output (replicated): (keys..., sums..., count) arrays of
-    length ndev * group_cap; slots with count==0 are padding.
+    ndev * local_n). Output (replicated): (keys..., sums..., count, total)
+    arrays of length ndev * group_cap; slots with count==0 are padding.
+    Group-cap overflow is never silent: the runner retries with a larger cap
+    until the result is exact (coprocessor grow-on-demand paging spirit).
     """
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import PartitionSpec as P
+    from dataclasses import replace
 
-    shard_map = jax.shard_map
-
-    ndev = mesh.devices.size
-    cap = spec.group_cap
-
-    def step(*cols):
-        keys = list(cols[: spec.n_keys])
-        vals = [cols[i] for i in spec.sums]
-        mask = jnp.ones(cols[0].shape[0], dtype=bool)
-        if selection is not None:
-            mask = selection(*cols)
-
-        # fragment 1: local partial agg
-        pkeys, psums, pcnt, _of = _segment_partial(jnp, keys, vals, mask, cap)
-
-        # hash exchange: route group slots to owner = hash(keys) % ndev
-        h = pkeys[0]
-        for k in pkeys[1:]:
-            h = h * jnp.int64(1000003) + k
-        owner = jnp.abs(h) % ndev
-        owner = jnp.where(pcnt > 0, owner, ndev - 1)  # park empty slots anywhere
-        # bucket: rank within destination, capacity cap per destination
-        order = jnp.argsort(owner, stable=True)
-        sorted_owner = owner[order]
-        rank = jnp.arange(cap) - jnp.searchsorted(sorted_owner, sorted_owner, side="left")
-
-        def bucketize(x, fill):
-            buf = jnp.full((ndev * cap,), fill, dtype=x.dtype)
-            idx = sorted_owner * cap + rank
-            return buf.at[idx].set(x[order])
-
-        bkeys = [bucketize(k, 0) for k in pkeys]
-        bsums = [bucketize(s, 0) for s in psums]
-        bcnt = bucketize(pcnt, 0)
-        # all_to_all: (ndev, cap, ...) split axis 0, concat received on axis 0
-        def exchange(buf):
-            return jax.lax.all_to_all(buf.reshape(ndev, cap), "dp", split_axis=0, concat_axis=0, tiled=False).reshape(
-                ndev * cap
-            )
-
-        rkeys = [exchange(k) for k in bkeys]
-        rsums = [exchange(s) for s in bsums]
-        rcnt = exchange(bcnt)
-
-        # fragment 2: merge received partials for the owned key range
-        rmask = rcnt > 0
-        mkeys, msums_and_cnt, _, _of2 = _segment_partial(jnp, rkeys, rsums + [rcnt], rmask, cap)
-        msums = msums_and_cnt[:-1]
-        mcnt = msums_and_cnt[-1]
-
-        # pass-through exchange to root (replicated result via all_gather)
-        gkeys = [jax.lax.all_gather(k, "dp").reshape(ndev * cap) for k in mkeys]
-        gsums = [jax.lax.all_gather(s, "dp").reshape(ndev * cap) for s in msums]
-        gcnt = jax.lax.all_gather(mcnt, "dp").reshape(ndev * cap)
-        total = jax.lax.psum(mask.sum(), "dp")  # scanned-row count (sanity/stats)
-        return (*gkeys, *gsums, gcnt, total)
-
-    def make(n_inputs):
-        return shard_map(
-            step,
-            mesh=mesh,
-            in_specs=tuple(P("dp") for _ in range(n_inputs)),
-            out_specs=(P(None),) * (spec.n_keys + len(spec.sums) + 1) + (P(),),
-            check_vma=False,
-        )
+    import numpy as np
 
     def run(*cols):
-        fn = make(len(cols))
-        return jax.jit(fn)(*cols)
+        cap = spec.group_cap
+        while True:
+            fn = build_dist_join_agg(
+                mesh,
+                None,
+                replace(spec, group_cap=cap),
+                n_left=len(cols),
+                left_selection=selection,
+            )
+            outs = fn(*cols)
+            if int(np.asarray(outs[-1])) == 0:  # overflow lane
+                return outs[:-2]  # drop (dropped, overflow) — both zero
+            cap *= 4
 
     return run
 

@@ -161,6 +161,30 @@ class Join(Node):
 class SubquerySource(Node):
     select: "Select"
     alias: str = ""
+    # CTE column renames: WITH c(a, b) AS (...) — applied over the built
+    # subquery's schema by the planner
+    col_aliases: list[str] = field(default_factory=list)
+
+
+@dataclass
+class ValuesSource(Node):
+    """A materialized in-memory rowset used as a table source (the planner's
+    landing pad for recursive-CTE fixpoints and memtable feeds)."""
+
+    rows: list  # list[tuple] of logical Python values
+    names: list[str]
+    ftypes: list  # list[FieldType]
+    alias: str = ""
+
+
+@dataclass
+class CTEDef(Node):
+    """One WITH-list entry (ref: ast.CommonTableExpression)."""
+
+    name: str
+    columns: list[str]
+    query: Node  # Select | SetOp
+    recursive: bool = False
 
 
 @dataclass
@@ -181,6 +205,8 @@ class Select(Node):
     offset: int = 0
     distinct: bool = False
     for_update: bool = False
+    # WITH clause attached to this query block (ref: SelectStmt.With)
+    ctes: list["CTEDef"] = field(default_factory=list)
 
 
 @dataclass
@@ -197,6 +223,7 @@ class SetOp(Node):
     order_by: list[OrderItem] = field(default_factory=list)
     limit: Optional[int] = None
     offset: int = 0
+    ctes: list["CTEDef"] = field(default_factory=list)
 
 
 @dataclass

@@ -88,6 +88,7 @@ class Parser:
         kw = t.value.upper()
         fn = {
             "SELECT": self.parse_select_stmt,
+            "WITH": self.parse_select_stmt,
             "INSERT": self.parse_insert,
             "REPLACE": self.parse_insert,
             "UPDATE": self.parse_update,
@@ -116,6 +117,8 @@ class Parser:
         """SELECT optionally chained with UNION/INTERSECT/EXCEPT (ref:
         ast.SetOprStmt; INTERSECT binds tighter per MySQL 8). A trailing
         ORDER BY/LIMIT binds to the whole compound."""
+        if self.at_kw("WITH"):
+            return self.parse_with()
         node, paren = self._setop_operand()
         # whether the top node came from explicit parentheses (an explicitly
         # grouped SetOp must not be re-associated by INTERSECT precedence)
@@ -186,13 +189,39 @@ class Parser:
                 node.offset = int(self.next().value)
 
     def _paren_select_ahead(self) -> bool:
-        """True when the upcoming '('... run of parens wraps a SELECT (as
+        """True when the upcoming '('... run of parens wraps a SELECT/WITH (as
         opposed to a parenthesized join or scalar expression)."""
         j = 0
         while self.peek(j).kind == "op" and self.peek(j).value == "(":
             j += 1
         t = self.peek(j)
-        return j > 0 and t.kind == "ident" and t.value.upper() == "SELECT"
+        return j > 0 and t.kind == "ident" and t.value.upper() in ("SELECT", "WITH")
+
+    def parse_with(self) -> ast.Node:
+        """WITH [RECURSIVE] name [(col, ...)] AS (query), ... SELECT ...
+        (ref: parser.y WithClause → ast.CommonTableExpression list)."""
+        self.expect_kw("WITH")
+        recursive = self.eat_kw("RECURSIVE")
+        ctes: list[ast.CTEDef] = []
+        while True:
+            name = self.ident()
+            cols: list[str] = []
+            if self.at_op("("):
+                self.next()
+                cols.append(self.ident())
+                while self.eat_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+            self.expect_kw("AS")
+            self.expect_op("(")
+            q = self.parse_select_stmt()
+            self.expect_op(")")
+            ctes.append(ast.CTEDef(name.lower(), [c.lower() for c in cols], q, recursive))
+            if not self.eat_op(","):
+                break
+        stmt = self.parse_select_stmt()
+        stmt.ctes = ctes + list(getattr(stmt, "ctes", []))
+        return stmt
 
     def _setop_operand(self) -> tuple:
         if self._paren_select_ahead():
@@ -380,7 +409,7 @@ class Parser:
             if self.at_kw("IN"):
                 self.next()
                 self.expect_op("(")
-                if self.at_kw("SELECT"):
+                if self.at_kw("SELECT", "WITH"):
                     sel = self.parse_select_stmt()
                     self.expect_op(")")
                     left = ast.InList(left, [ast.SubqueryExpr(sel, "in")], negated=neg)
@@ -477,7 +506,7 @@ class Parser:
             return ast.Literal(t.value)
         if self.at_op("("):
             self.next()
-            if self.at_kw("SELECT"):
+            if self.at_kw("SELECT", "WITH"):
                 sel = self.parse_select_stmt()
                 self.expect_op(")")
                 return ast.SubqueryExpr(sel)
@@ -628,7 +657,7 @@ class Parser:
                 ins.values.append(row)
                 if not self.eat_op(","):
                     break
-        elif self.at_kw("SELECT"):
+        elif self.at_kw("SELECT", "WITH"):
             ins.select = self.parse_select_stmt()
         if self.at_kw("ON"):
             self.next()

@@ -80,6 +80,21 @@ def _common_type(l: FieldType, r: FieldType) -> FieldType:
     raise PlanError(f"incompatible set-operand column types {l.kind.name} vs {r.kind.name}")
 
 
+def _cast_expr(e: Expression, target: ast.TypeDef) -> Expression:
+    """CAST target mapping (shared by the plain and mixed resolvers)."""
+    tname = target.name
+    if tname in ("signed", "int", "integer", "bigint", "unsigned"):
+        return func("cast_int", e)
+    if tname in ("double", "float", "real"):
+        return func("cast_float", e)
+    if tname in ("decimal", "numeric"):
+        ft = decimal_type(target.length if target.length > 0 else 10, target.scale)
+        return func("cast_decimal", e, ret=ft)
+    if tname in ("char", "varchar", "binary", "nchar"):
+        return func("cast_string", e)
+    raise PlanError(f"unsupported CAST target {tname}")
+
+
 @dataclass
 class BuildCtx:
     """Name-resolution scope."""
@@ -559,9 +574,25 @@ class Builder:
         if isinstance(node, ast.SubquerySource):
             sub = self.build_query(node.select)
             alias = node.alias or "subquery"
+            if node.col_aliases:
+                if len(node.col_aliases) != len(sub.schema):
+                    raise PlanError(
+                        f"derived table '{alias}' has {len(node.col_aliases)} column "
+                        f"aliases for {len(sub.schema)} columns"
+                    )
+                for oc, nm in zip(sub.schema, node.col_aliases):
+                    oc.name = nm
             for oc in sub.schema:
                 oc.table = alias
             return sub
+        if isinstance(node, ast.ValuesSource):
+            from tidb_tpu.planner.plans import LogicalMemSource
+
+            alias = node.alias or "values"
+            schema = [
+                OutCol(nm, ft, table=alias) for nm, ft in zip(node.names, node.ftypes)
+            ]
+            return LogicalMemSource(rows=node.rows, schema=schema)
         if isinstance(node, ast.Join):
             left = self._build_from(node.left)
             right = self._build_from(node.right)
@@ -654,16 +685,7 @@ class Builder:
                 args.append(self._resolve(node.else_value, ctx))
             return func("case_when", *args)
         if isinstance(node, ast.Cast):
-            e = self._resolve(node.operand, ctx)
-            tname = node.target.name
-            if tname in ("signed", "int", "integer", "bigint", "unsigned"):
-                return func("cast_int", e)
-            if tname in ("double", "float", "real"):
-                return func("cast_float", e)
-            if tname in ("decimal", "numeric"):
-                ft = decimal_type(node.target.length if node.target.length > 0 else 10, node.target.scale)
-                return func("cast_decimal", e, ret=ft)
-            raise PlanError(f"unsupported CAST target {tname}")
+            return _cast_expr(self._resolve(node.operand, ctx), node.target)
         if isinstance(node, ast.SubqueryExpr):
             if node.modifier == "exists":
                 vals = self._run_subquery(node.select, limit=1)
@@ -863,7 +885,7 @@ class Builder:
             )
             return func("not", e) if node.negated else e
         if isinstance(node, ast.Cast):
-            return self._resolve(node, ctx)
+            return _cast_expr(self._resolve_mixed(node.operand, ctx), node.target)
         return _fold(self._resolve(node, ctx))
 
     def _order_needs_hidden(self, node, proj_schema, aliases) -> bool:

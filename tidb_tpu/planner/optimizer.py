@@ -24,6 +24,7 @@ from tidb_tpu.planner.plans import (
     LogicalDual,
     LogicalJoin,
     LogicalLimit,
+    LogicalMemSource,
     LogicalPlan,
     LogicalProjection,
     LogicalScan,
@@ -39,6 +40,7 @@ from tidb_tpu.planner.plans import (
     PhysIndexLookUp,
     PhysIndexReader,
     PhysLimit,
+    PhysMemSource,
     PhysPointGet,
     PhysProjection,
     PhysSelection,
@@ -96,6 +98,14 @@ def _prune(plan: LogicalPlan, needed: Optional[set[int]]):
         return plan, mapping
     if isinstance(plan, LogicalDual):
         return plan, {}
+    if isinstance(plan, LogicalMemSource):
+        if needed is None:
+            return plan, {i: i for i in range(len(plan.schema))}
+        keep = sorted(needed)
+        mapping = {old: new for new, old in enumerate(keep)}
+        plan.schema = [plan.schema[i] for i in keep]
+        plan.rows = [tuple(r[i] for i in keep) for r in plan.rows]
+        return plan, mapping
     if isinstance(plan, LogicalProjection):
         if needed is None:
             keep = list(range(len(plan.exprs)))
@@ -416,6 +426,8 @@ def _derive_ranges(scan: LogicalScan, conds: list[Expression]) -> Optional[list[
 def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan:
     if isinstance(plan, LogicalDual):
         return PhysDual(schema=plan.schema)
+    if isinstance(plan, LogicalMemSource):
+        return PhysMemSource(rows=plan.rows, schema=plan.schema)
     if isinstance(plan, LogicalScan):
         reader = PhysTableReader(
             db=plan.db,

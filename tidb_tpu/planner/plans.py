@@ -58,6 +58,16 @@ class LogicalDual(LogicalPlan):
 
 
 @dataclass
+class LogicalMemSource(LogicalPlan):
+    """In-memory rowset source: recursive-CTE fixpoints, information_schema
+    memtables (ref: infoschema memtable retrievers + CTE storage)."""
+
+    rows: list  # list[tuple] of logical Python values
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+
+@dataclass
 class LogicalSelection(LogicalPlan):
     conditions: list[Expression]
     children: list = field(default_factory=list)
@@ -327,6 +337,13 @@ class PhysDual(PhysicalPlan):
 
 
 @dataclass
+class PhysMemSource(PhysicalPlan):
+    rows: list
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+
+@dataclass
 class PhysPointGet(PhysicalPlan):
     """Fast path: PK point lookup bypassing the coprocessor entirely
     (ref: core/point_get_plan.go:957 TryFastPlan)."""
@@ -373,6 +390,8 @@ def explain_plan(p, indent: int = 0) -> str:
         extra = f"{', '.join(map(repr, p.funcs))} over {over}"
     elif isinstance(p, PhysPointGet):
         extra = f"{p.table.name} handle={p.handle}"
+    elif isinstance(p, PhysMemSource):
+        extra = f"{len(p.rows)} rows"
     elif isinstance(p, PhysIndexReader):
         conds = f" -> Selection({', '.join(map(repr, p.pushed_conditions))})" if p.pushed_conditions else ""
         extra = f"[host] {p.table.name}: IndexScan({p.index.name}, {len(p.ranges)} ranges){conds}"

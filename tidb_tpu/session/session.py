@@ -236,6 +236,10 @@ class Session:
 
     # -- SELECT ---------------------------------------------------------------
     def _select(self, stmt) -> Result:
+        if getattr(stmt, "ctes", None):
+            from tidb_tpu.planner.cte import expand_ctes
+
+            stmt = expand_ctes(stmt, self._cte_runner)
         if isinstance(stmt, ast.SetOp) and _setop_has_for_update(stmt):
             raise SessionError("FOR UPDATE is not supported inside set operations")
         if getattr(stmt, "for_update", False):
@@ -292,6 +296,9 @@ class Session:
         self.lock_for_write(keys)
 
     def _plan_select(self, stmt):
+        from tidb_tpu.planner.cte import expand_ctes
+
+        stmt = expand_ctes(stmt, self._cte_runner)
         builder = Builder(self.catalog, self.current_db, subquery_runner=self._subquery_runner)
         logical = builder.build_query(stmt)
         engines = [e.strip() for e in str(self.vars["tidb_isolation_read_engines"]).split(",") if e.strip()]
@@ -305,6 +312,15 @@ class Session:
 
     def _subquery_runner(self, sel) -> list[tuple]:
         return self._run_select_ast(sel)
+
+    def _cte_runner(self, sel):
+        """Plan+run one CTE part; returns (rows, schema) for the fixpoint
+        driver (ref: cte.go seed/recursive part execution)."""
+        plan = self._plan_select(sel)
+        from tidb_tpu.executor import build_executor
+
+        chunk = build_executor(plan, self).execute()
+        return chunk.rows(), plan.schema
 
     # -- misc -----------------------------------------------------------------
     def _set_var(self, stmt: ast.SetVariable) -> Result:
