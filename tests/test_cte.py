@@ -152,3 +152,39 @@ def test_cast_date_to_char(db):
     db.execute("CREATE TABLE dt (d DATE)")
     db.execute("INSERT INTO dt VALUES ('2020-03-01')")
     assert db.query("SELECT CAST(d AS CHAR) FROM dt") == [("2020-03-01",)]
+
+
+def test_count_star_over_cte_and_derived(db):
+    db.execute("CREATE TABLE z (a INT, s VARCHAR(10))")
+    db.execute("INSERT INTO z VALUES (1,'abcdef'),(2,'xy')")
+    assert db.query("WITH c AS (SELECT a FROM z) SELECT COUNT(*) FROM c") == [(2,)]
+    assert db.query("SELECT COUNT(*) FROM (SELECT 1 AS one FROM z) q") == [(2,)]
+    assert db.query(
+        "WITH RECURSIVE seq(n) AS (SELECT 1 UNION ALL SELECT n+1 FROM seq WHERE n < 5)"
+        " SELECT COUNT(*) FROM seq"
+    ) == [(5,)]
+
+
+def test_cte_duplicate_name_rejected(db):
+    import pytest
+
+    with pytest.raises(Exception, match="Duplicate query name"):
+        db.query("WITH c AS (SELECT 1 AS x), c AS (SELECT 2 AS x) SELECT x FROM c")
+
+
+def test_recursive_cte_arity_mismatch_rejected(db):
+    import pytest
+
+    with pytest.raises(Exception, match="returns 2 columns"):
+        db.query(
+            "WITH RECURSIVE c(n) AS (SELECT 1 UNION ALL SELECT n, n FROM c WHERE n < 2)"
+            " SELECT n FROM c"
+        )
+
+
+def test_cast_char_truncation(db):
+    db.execute("CREATE TABLE zz (a INT, s VARCHAR(10))")
+    db.execute("INSERT INTO zz VALUES (1,'abcdef')")
+    assert db.query("SELECT CAST(s AS CHAR(2)) FROM zz") == [("ab",)]
+    assert db.query("SELECT CAST(s AS CHAR) FROM zz") == [("abcdef",)]
+    assert db.query("SELECT CAST(a AS CHAR) FROM zz") == [("1",)]

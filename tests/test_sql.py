@@ -230,3 +230,14 @@ def test_tpch_q1_shape_end_to_end(db):
     assert host == tpu and len(host) >= 4
     total = sum(r[5] for r in host)
     assert total == 500  # all rows qualify (dates < 1998)
+
+
+def test_explain_analyze_runtime_stats(tdb):
+    r = tdb.execute("EXPLAIN ANALYZE SELECT c, SUM(a) FROM t WHERE a > 5 GROUP BY c ORDER BY c")
+    text = "\n".join(row[0] for row in r.rows)
+    assert "actRows:" in text and "time:" in text and "loops:1" in text
+    # the agg output has 2 non-null groups + the NULL group row is filtered by a>5
+    assert "PhysTableReader" in text
+    # plain EXPLAIN carries no execution info
+    r2 = tdb.execute("EXPLAIN SELECT * FROM t")
+    assert "actRows" not in "\n".join(row[0] for row in r2.rows)

@@ -50,7 +50,18 @@ class Executor:
 
 
 def build_executor(plan, session) -> Executor:
-    """ref: executorBuilder.build (builder.go:164)."""
+    """ref: executorBuilder.build (builder.go:164). When the session carries a
+    RuntimeStatsColl (EXPLAIN ANALYZE), every built node is instrumented."""
+    e = _build_executor(plan, session)
+    coll = getattr(session, "runtime_stats", None)
+    if coll is not None:
+        from tidb_tpu.utils.execdetails import instrument
+
+        instrument(e, plan, coll)
+    return e
+
+
+def _build_executor(plan, session) -> Executor:
     if isinstance(plan, PhysTableReader):
         return TableReaderExec(plan, session)
     if isinstance(plan, PhysSelection):

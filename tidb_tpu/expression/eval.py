@@ -688,10 +688,15 @@ def _cast_string(xp, args, ctx):
 
     from tidb_tpu.types.datum import days_to_date, micros_to_datetime
 
+    maxlen = ctx.ret_type.length  # CHAR(n) truncates; -1 = unbounded
+
+    def _trunc(b):
+        return b[:maxlen] if maxlen >= 0 and b is not None else b
+
     t = ctx.arg_types[0]
     if t.kind == TypeKind.STRING:
         strs, _ = _decode_strs(ctx, 0)
-        return _encode_strs(ctx, strs)
+        return _encode_strs(ctx, [_trunc(s) for s in strs])
     (d, v) = args[0]
     n = len(d) if hasattr(d, "__len__") else ctx.n
     out = []
@@ -713,7 +718,7 @@ def _cast_string(xp, args, ctx):
             s = str(micros_to_datetime(int(x)))
         else:
             s = str(int(x))
-        out.append(s.encode() if isinstance(s, str) else s)
+        out.append(_trunc(s.encode() if isinstance(s, str) else s))
     return _encode_strs(ctx, out)
 
 

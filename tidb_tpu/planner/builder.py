@@ -91,7 +91,8 @@ def _cast_expr(e: Expression, target: ast.TypeDef) -> Expression:
         ft = decimal_type(target.length if target.length > 0 else 10, target.scale)
         return func("cast_decimal", e, ret=ft)
     if tname in ("char", "varchar", "binary", "nchar"):
-        return func("cast_string", e)
+        # ret_type.length carries CHAR(n)'s truncation length to the eval
+        return func("cast_string", e, ret=string_type(length=target.length))
     raise PlanError(f"unsupported CAST target {tname}")
 
 

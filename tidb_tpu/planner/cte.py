@@ -42,6 +42,11 @@ def expand_ctes(stmt: ast.Node, runner: Runner) -> ast.Node:
 def _expand(node: ast.Node, runner: Runner) -> None:
     if isinstance(node, (ast.Select, ast.SetOp)) and node.ctes:
         ctes, node.ctes = node.ctes, []
+        names_seen = set()
+        for cte in ctes:
+            if cte.name.lower() in names_seen:
+                raise PlanError(f"Duplicate query name '{cte.name}' in WITH clause")
+            names_seen.add(cte.name.lower())
         bindings: list[tuple[str, tuple]] = []
         for cte in ctes:
             # earlier CTEs in the same WITH list are visible to later bodies
@@ -233,7 +238,12 @@ def _materialize_recursive(cte: ast.CTEDef, runner: Runner) -> tuple:
             _substitute(op2, cte.name, ("values", delta, names, ftypes))
             # the recursive operand may still be correlated/nested — one plain
             # query per iteration with the previous delta as a memsource
-            r, _ = runner(op2)
+            r, rschema = runner(op2)
+            if len(rschema) != len(names):
+                raise PlanError(
+                    f"The recursive part of CTE '{cte.name}' returns "
+                    f"{len(rschema)} columns, expected {len(names)}"
+                )
             produced.extend(r)
         if distinct:
             fresh = []

@@ -93,6 +93,11 @@ def _prune(plan: LogicalPlan, needed: Optional[set[int]]):
         if needed is None:
             return plan, {i: i for i in range(len(plan.schema))}
         keep = sorted(needed)
+        if not keep and plan.schema:
+            # COUNT(*) / constant projections need no columns, but a
+            # zero-column source loses the row count — keep one column
+            # (ref: rule_column_pruning.go PruneColumns keeps one)
+            keep = [0]
         mapping = {old: new for new, old in enumerate(keep)}
         plan.schema = [plan.schema[i] for i in keep]
         return plan, mapping
@@ -102,6 +107,8 @@ def _prune(plan: LogicalPlan, needed: Optional[set[int]]):
         if needed is None:
             return plan, {i: i for i in range(len(plan.schema))}
         keep = sorted(needed)
+        if not keep and plan.schema:
+            keep = [0]  # see LogicalScan: never prune to zero columns
         mapping = {old: new for new, old in enumerate(keep)}
         plan.schema = [plan.schema[i] for i in keep]
         plan.rows = [tuple(r[i] for i in keep) for r in plan.rows]
@@ -111,6 +118,8 @@ def _prune(plan: LogicalPlan, needed: Optional[set[int]]):
             keep = list(range(len(plan.exprs)))
         else:
             keep = sorted(needed)
+            if not keep and plan.exprs:
+                keep = [0]  # see LogicalScan: never prune to zero columns
         child_needed: set[int] = set()
         for i in keep:
             _expr_cols(plan.exprs[i], child_needed)

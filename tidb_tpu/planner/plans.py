@@ -355,9 +355,18 @@ class PhysPointGet(PhysicalPlan):
     children: list = field(default_factory=list)
 
 
-def explain_plan(p, indent: int = 0) -> str:
-    """EXPLAIN output (ref: the reference's indented explain format)."""
+def explain_plan(p, indent: int = 0, stats=None) -> str:
+    """EXPLAIN output (ref: the reference's indented explain format). With
+    ``stats`` (a RuntimeStatsColl), appends per-node execution info the way
+    EXPLAIN ANALYZE's `execution info` column does."""
     pad = "  " * indent
+
+    def _info(node) -> str:
+        if stats is None:
+            return ""
+        r = stats.render(node)
+        return f"  | {r}" if r else ""
+
     name = type(p).__name__
     extra = ""
     if isinstance(p, PhysTableReader):
@@ -402,13 +411,13 @@ def explain_plan(p, indent: int = 0) -> str:
 
     if isinstance(p, PhysMPPGather):
         extra = f"{len(p.fragments)} fragments, {p.exchange} join exchange" if p.right is not None else f"{len(p.fragments)} fragments"
-        lines = [f"{pad}{name} {extra}"]
+        lines = [f"{pad}{name} {extra}{_info(p)}"]
         for fr in p.fragments:
             lines.append(f"{pad}  {fr}")
         for r in [p.left] + ([p.right] if p.right is not None else []):
-            lines.append(explain_plan(r, indent + 1))
+            lines.append(explain_plan(r, indent + 1, stats))
         return "\n".join(lines)
-    lines = [f"{pad}{name} {extra}".rstrip()]
+    lines = [f"{pad}{name} {extra}".rstrip() + _info(p)]
     for c in getattr(p, "children", []):
-        lines.append(explain_plan(c, indent + 1))
+        lines.append(explain_plan(c, indent + 1, stats))
     return "\n".join(lines)

@@ -77,6 +77,8 @@ class Session:
         self._read_ts_override: Optional[int] = None
         # table_id → row mods staged by the open txn (flushed at commit)
         self._pending_mods: dict[int, int] = {}
+        # EXPLAIN ANALYZE per-operator stats (ref: util/execdetails)
+        self.runtime_stats = None
 
     # -- txn lifecycle (ref: LazyTxn) ---------------------------------------
     def txn(self) -> Txn:
@@ -419,12 +421,14 @@ class Session:
         plan = self._plan_select(inner)
         if stmt.analyze:
             from tidb_tpu.executor import build_executor
-            import time
+            from tidb_tpu.utils.execdetails import RuntimeStatsColl
 
-            t0 = time.time()
-            build_executor(plan, self).execute()
-            dt = (time.time() - t0) * 1000
-            text = explain_plan(plan) + f"\n-- actual time: {dt:.1f} ms"
+            self.runtime_stats = RuntimeStatsColl()
+            try:
+                build_executor(plan, self).execute()
+            finally:
+                coll, self.runtime_stats = self.runtime_stats, None
+            text = explain_plan(plan, stats=coll)
         else:
             text = explain_plan(plan)
         return Result(columns=["plan"], rows=[(line,) for line in text.split("\n")])

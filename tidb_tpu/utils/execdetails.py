@@ -1,0 +1,61 @@
+"""Per-operator runtime execution statistics for EXPLAIN ANALYZE.
+
+ref: pkg/util/execdetails (RuntimeStatsColl attached to each executor; the
+reference records loops/rows/time per plan-node id and renders them in the
+`execution info` column of EXPLAIN ANALYZE). Here executors materialize one
+chunk per execute() call, so stats are inclusive wall time + produced rows,
+keyed by plan-node object identity.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class OpStats:
+    rows: int = 0
+    time_ms: float = 0.0
+    loops: int = 0
+
+    def render(self) -> str:
+        return f"actRows:{self.rows}, loops:{self.loops}, time:{self.time_ms:.2f}ms"
+
+
+@dataclass
+class RuntimeStatsColl:
+    """Collects OpStats keyed by id(plan_node)."""
+
+    stats: dict = field(default_factory=dict)
+
+    def get(self, plan) -> OpStats:
+        s = self.stats.get(id(plan))
+        if s is None:
+            s = self.stats[id(plan)] = OpStats()
+        return s
+
+    def record(self, plan, rows: int, dt_ms: float) -> None:
+        s = self.get(plan)
+        s.rows += rows
+        s.time_ms += dt_ms
+        s.loops += 1
+
+    def render(self, plan) -> str:
+        s = self.stats.get(id(plan))
+        return s.render() if s is not None else ""
+
+
+def instrument(executor, plan, coll: RuntimeStatsColl):
+    """Wrap executor.execute to record inclusive wall time + output rows."""
+    inner = executor.execute
+
+    def timed():
+        t0 = time.perf_counter()
+        chunk = inner()
+        dt = (time.perf_counter() - t0) * 1000.0
+        coll.record(plan, len(chunk) if chunk is not None else 0, dt)
+        return chunk
+
+    executor.execute = timed
+    return executor
