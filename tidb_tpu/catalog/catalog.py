@@ -229,19 +229,42 @@ class Catalog:
         txn.commit()
         cache_for(self.store).invalidate_table(t.id)
 
+    @property
+    def ddl(self):
+        """The owner DDL worker (ref: pkg/ddl; owner election is trivial in
+        one process — see catalog/ddl.py)."""
+        with self._mu:
+            if getattr(self, "_ddl", None) is None:
+                from tidb_tpu.catalog.ddl import DDLWorker
+
+                self._ddl = DDLWorker(self)
+            return self._ddl
+
     def alter_table(self, db: str, stmt: ast.AlterTable) -> None:
-        """Synchronous schema change; add/drop column rewrites rows (round-1
-        divergence from the online DDL state machine, see module docstring)."""
+        """ADD/DROP INDEX run as online async DDL jobs through the F1 state
+        machine (catalog/ddl.py). Layout-changing ALTERs (add/drop column)
+        rewrite the table's rows in one transaction — a documented divergence
+        from per-column online states."""
+        if stmt.action == "add_index":
+            t = self.table(db, stmt.table.name)
+            for c in stmt.index.columns:
+                self._col_offset(t, c)  # validate before enqueueing
+            job = self.ddl.submit(
+                "add_index",
+                db,
+                t.id,
+                {"name": stmt.index.name.lower(), "columns": [c.lower() for c in stmt.index.columns], "unique": stmt.index.unique},
+            )
+            self.ddl.run_job(job)
+            return
+        if stmt.action == "drop_index":
+            t = self.table(db, stmt.table.name)
+            job = self.ddl.submit("drop_index", db, t.id, {"name": stmt.name.lower()})
+            self.ddl.run_job(job)
+            return
         with self._mu:
             t = self.table(db, stmt.table.name)
-            if stmt.action == "add_index":
-                offs = [self._col_offset(t, c) for c in stmt.index.columns]
-                t.indexes.append(IndexInfo(t.next_index_id, stmt.index.name.lower(), offs, unique=stmt.index.unique))
-                t.next_index_id += 1
-                self._backfill_index(t, t.indexes[-1])
-            elif stmt.action == "drop_index":
-                t.indexes = [i for i in t.indexes if i.name != stmt.name.lower()]
-            elif stmt.action == "add_column":
+            if stmt.action == "add_column":
                 cd = stmt.column
                 ft = typedef_to_ftype(cd.type, cd.not_null)
                 default = _fold_default(cd.default, ft) if cd.default is not None else None
@@ -290,20 +313,6 @@ class Catalog:
             txn.put(k, encode_row(new_schema, fn(decode_row(old_schema, v))))
         txn.commit()
         cache_for(self.store).invalidate_table(t.id)
-
-    def _backfill_index(self, t: TableInfo, idx: IndexInfo) -> None:
-        """Write index entries for existing rows (txn backfill; ref:
-        ddl/backfilling.go path a)."""
-        from tidb_tpu.executor.write import index_entry  # late import, cycle
-
-        schema = RowSchema(t.storage_schema)
-        txn = self.store.begin()
-        for k, v in txn.scan(tablecodec.record_range(t.id)):
-            handle = tablecodec.decode_record_key(k)[1]
-            vals = decode_row(schema, v)
-            ik, iv = index_entry(t, idx, vals, handle)
-            txn.put(ik, iv)
-        txn.commit()
 
 
 def _fold_default(node: ast.Node, ft) -> object:

@@ -78,13 +78,16 @@ class IndexInfo:
     column_offsets: list[int]
     unique: bool = False
     primary: bool = False
+    # online-DDL schema state (ref: F1 states in ddl/job_worker.go:773):
+    # delete_only → write_only → write_reorg → public
+    state: str = "public"
 
     def to_pb(self) -> dict:
-        return {"id": self.id, "name": self.name, "cols": self.column_offsets, "unique": self.unique, "primary": self.primary}
+        return {"id": self.id, "name": self.name, "cols": self.column_offsets, "unique": self.unique, "primary": self.primary, "state": self.state}
 
     @staticmethod
     def from_pb(pb: dict) -> "IndexInfo":
-        return IndexInfo(pb["id"], pb["name"], pb["cols"], pb["unique"], pb["primary"])
+        return IndexInfo(pb["id"], pb["name"], pb["cols"], pb["unique"], pb["primary"], pb.get("state", "public"))
 
 
 @dataclass

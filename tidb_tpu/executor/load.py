@@ -52,6 +52,8 @@ def bulk_load(db: DB, table_name: str, columns: Sequence[Sequence], db_name: str
             vals = [phys_cols[c][r] for c in range(ncols)]
             txn.put(tablecodec.record_key(t.id, int(h)), encode_row(schema, vals))
             for idx in t.indexes:
+                if idx.state == "delete_only":
+                    continue  # writes don't maintain delete-only indexes
                 ik, iv = index_entry(t, idx, vals, int(h))
                 txn.put(ik, iv)
         txn.commit()

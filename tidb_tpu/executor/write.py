@@ -119,9 +119,10 @@ def _write_row(session, t: TableInfo, vals: list, handle: int, on_dup: Optional[
             return 0
         else:
             raise DupKeyError(f"PRIMARY ({handle})")
-    # unique index conflict checks
+    # unique index conflict checks (delete-only indexes don't take writes,
+    # so they can't conflict either — ref: F1 state semantics)
     for idx in t.indexes:
-        if not idx.unique:
+        if not idx.unique or idx.state == "delete_only":
             continue
         ik, _ = index_entry(t, idx, vals, handle)
         if any(vals[o] is None for o in idx.column_offsets):
@@ -139,6 +140,8 @@ def _write_row(session, t: TableInfo, vals: list, handle: int, on_dup: Optional[
                 raise DupKeyError(idx.name)
     txn.put(rk, encode_row(schema, vals))
     for idx in t.indexes:
+        if idx.state == "delete_only":
+            continue  # writes don't maintain delete-only indexes
         ik, iv = index_entry(t, idx, vals, handle)
         txn.put(ik, iv)
     return 1

@@ -691,7 +691,12 @@ def _cast_string(xp, args, ctx):
     maxlen = ctx.ret_type.length  # CHAR(n) truncates; -1 = unbounded
 
     def _trunc(b):
-        return b[:maxlen] if maxlen >= 0 and b is not None else b
+        if maxlen < 0 or b is None:
+            return b
+        if isinstance(b, bytes):
+            # CHAR(n) counts characters, not bytes — never split a codepoint
+            return b.decode("utf-8", "surrogateescape")[:maxlen].encode("utf-8", "surrogateescape")
+        return b[:maxlen]
 
     t = ctx.arg_types[0]
     if t.kind == TypeKind.STRING:

@@ -72,6 +72,8 @@ def _right_side_unique(reader: PhysTableReader, key_slots: list[int]) -> bool:
     if t.pk_is_handle and key_slots == [t.pk_offset]:
         return True
     for idx in t.indexes:
+        if idx.state != "public":
+            continue  # a mid-DDL unique index hasn't proven uniqueness yet
         if (idx.unique or idx.primary) and sorted(idx.column_offsets) == sorted(key_slots):
             return True
     return False

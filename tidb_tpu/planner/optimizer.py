@@ -316,6 +316,8 @@ def _choose_index_path(scan: LogicalScan, conds: list[Expression], stats=None):
         # full columnar scan baseline: sequential, device-friendly
         best_cost = float(total) * _COST_TABLE_ROW
         for idx in t.indexes:
+            if idx.state != "public":
+                continue  # in-flight online-DDL indexes are not readable
             acc = ranger.detach_index_conditions(conds, scan.schema, t, idx)
             if acc is None or not acc.used:
                 continue
@@ -330,6 +332,8 @@ def _choose_index_path(scan: LogicalScan, conds: list[Expression], stats=None):
                 best = ((), acc)
     else:
         for idx in t.indexes:
+            if idx.state != "public":
+                continue  # in-flight online-DDL indexes are not readable
             acc = ranger.detach_index_conditions(conds, scan.schema, t, idx)
             if acc is None or acc.eq_prefix_len == 0:
                 continue
