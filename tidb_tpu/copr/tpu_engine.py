@@ -116,15 +116,31 @@ def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: 
     agg_cap = min(_DEFAULT_AGG_CAP, n_pad) if kernel_needs_agg(bound) else _DEFAULT_AGG_CAP
     while True:
         kernel = get_kernel(bound, n_pad, agg_cap)
-        outs, count, ngroups = kernel.fn(handles_dev, tuple(cols_dev), jnp.asarray(rarr), jnp.asarray(entry.n))
-        count = int(count)
-        if int(ngroups) > kernel.agg_cap:
+        packed = kernel.fn(handles_dev, tuple(cols_dev), jnp.asarray(rarr), jnp.asarray(entry.n))
+        # ONE device→host transfer per task (two when float lanes exist):
+        # the packed buffer carries count, ngroups, and every (data, valid)
+        # lane (see dag_kernel._pack)
+        fbuf = None
+        if isinstance(packed, tuple):
+            buf = np.asarray(packed[0])
+            fbuf = np.asarray(packed[1])
+        else:
+            buf = np.asarray(packed)
+        count = int(buf[0, 0])
+        ngroups = int(buf[0, 1])
+        if ngroups > kernel.agg_cap:
             if agg_cap >= n_pad:
                 # more groups than rows cannot happen; n_pad cap always fits
                 raise RuntimeError("aggregation group overflow beyond row count")
             agg_cap = min(agg_cap * 4, n_pad)
             continue
         break
+
+    outs = []
+    for (which, idx), vidx in zip(kernel.lane_loc, kernel.valid_loc):
+        data = fbuf[idx] if which == "f" else buf[idx]
+        valid = buf[vidx].astype(bool)
+        outs.append((data, valid))
 
     # assemble chunk: output schema comes from the *unbound* DAG (string
     # columns keep their dictionaries)
