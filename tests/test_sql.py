@@ -241,3 +241,20 @@ def test_explain_analyze_runtime_stats(tdb):
     # plain EXPLAIN carries no execution info
     r2 = tdb.execute("EXPLAIN SELECT * FROM t")
     assert "actRows" not in "\n".join(row[0] for row in r2.rows)
+
+
+def test_order_by_aggregate(tdb):
+    # aggregate expressions in ORDER BY resolve against the aggregation and
+    # ride as hidden projection columns (trimmed after the sort)
+    tdb.execute("CREATE TABLE oba (g BIGINT, v BIGINT)")
+    tdb.execute("INSERT INTO oba VALUES (1,10),(1,20),(2,5),(2,NULL),(3,7),(3,8),(3,9)")
+    assert tdb.query("SELECT g, COUNT(v) FROM oba GROUP BY g ORDER BY COUNT(v) DESC, g") == [
+        (3, 3), (1, 2), (2, 1),
+    ]
+    assert tdb.query("SELECT g FROM oba GROUP BY g ORDER BY SUM(v) DESC") == [(1,), (3,), (2,)]
+    assert tdb.query("SELECT g, COUNT(*) AS c FROM oba GROUP BY g ORDER BY c, g") == [
+        (1, 2), (2, 2), (3, 3),
+    ]
+    assert tdb.query("SELECT g, SUM(v) FROM oba GROUP BY g ORDER BY SUM(v)+g ASC") == [
+        (2, 5), (3, 24), (1, 30),
+    ]

@@ -226,6 +226,8 @@ class Builder:
 
         aliases: dict[str, Expression] = {}
         hidden = 0
+        order_agg_map: dict[int, int] = {}  # order-item idx → hidden agg col
+        order_agg_base = 0
         if has_agg:
             base_schema = plan.schema
             aggs: list[AggDesc] = []
@@ -260,10 +262,21 @@ class Builder:
             if sel.having is not None:
                 h = self._resolve_in_agg(sel.having, base_schema, aggs, group_exprs, sel.group_by, aliases)
                 having_conds = self._split_conj(h)
+            # ORDER BY items containing aggregates resolve against the agg
+            # (may append new aggs, so this must precede finalization); they
+            # ride as hidden projection columns trimmed after the sort
+            order_agg_exprs: list[Expression] = []
+            if sel.order_by:
+                for i_o, oi in enumerate(sel.order_by):
+                    if _contains_agg(oi.expr):
+                        e_o = self._resolve_in_agg(oi.expr, base_schema, aggs, group_exprs, sel.group_by, aliases)
+                        order_agg_map[i_o] = len(order_agg_exprs)
+                        order_agg_exprs.append(e_o)
             # agg list is final now: patch deferred group-key refs everywhere
             agg.schema = agg_schema()
             proj_exprs = [_patch_group_refs(e, len(aggs)) for e in proj_exprs]
             having_conds = [_patch_group_refs(e, len(aggs)) for e in having_conds]
+            order_agg_exprs = [_patch_group_refs(e, len(aggs)) for e in order_agg_exprs]
             for a in aliases:
                 aliases[a] = _patch_group_refs(aliases[a], len(aggs))
             if having_conds:
@@ -280,6 +293,12 @@ class Builder:
                         slot=src.slot if src else -1,
                     )
                 )
+            if order_agg_exprs:
+                order_agg_base = len(proj.schema)
+                for k, e_o in enumerate(order_agg_exprs):
+                    proj.exprs.append(e_o)
+                    proj.schema.append(OutCol(f"__agg_order#{k}", e_o.ftype))
+                hidden += len(order_agg_exprs)
             plan = proj
         else:
             # plain projection
@@ -347,8 +366,12 @@ class Builder:
 
         if sel.order_by:
             by = []
-            for oi in sel.order_by:
-                e = self._resolve_order(oi.expr, plan.schema, aliases)
+            for i_o, oi in enumerate(sel.order_by):
+                if i_o in order_agg_map:
+                    idx = order_agg_base + order_agg_map[i_o]
+                    e: Expression = ColumnRef(idx, plan.schema[idx].ftype, plan.schema[idx].name)
+                else:
+                    e = self._resolve_order(oi.expr, plan.schema, aliases)
                 by.append((e, oi.desc))
             plan = LogicalSort(by=by, children=[plan])
 
