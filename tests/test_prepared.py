@@ -1,0 +1,129 @@
+"""Prepared statements, user variables, session plan cache, point-get fast
+path (ref: executor/prepared.go, core/plan_cache_lru.go:44,
+core/point_get_plan.go:957 TryFastPlan)."""
+
+import datetime
+
+import pytest
+
+import tidb_tpu
+
+
+@pytest.fixture()
+def db():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, a BIGINT, s VARCHAR(20), d DATE)")
+    d.execute("INSERT INTO t VALUES (1, 10, 'x', '2024-01-05'), (2, 20, 'y', '2024-02-06'), (7, NULL, NULL, NULL)")
+    return d
+
+
+def test_point_get(db):
+    s = db.session()
+    assert s.query("SELECT * FROM t WHERE id = 2") == [(2, 20, "y", datetime.date(2024, 2, 6))]
+    assert s.query("SELECT a, s FROM t WHERE id = 7") == [(None, None)]
+    assert s.query("SELECT a FROM t WHERE id = 99") == []
+    assert s.query("SELECT id AS k FROM t WHERE 1 = id") == [(1,)]
+    # EXPLAIN surfaces the fast plan
+    (line,) = db.query("EXPLAIN SELECT * FROM t WHERE id = 2")[0]
+    assert line.startswith("Point_Get")
+
+
+def test_point_get_reads_txn_membuffer(db):
+    s = db.session()
+    s.execute("BEGIN")
+    s.execute("UPDATE t SET a = 99 WHERE id = 1")
+    assert s.query("SELECT a FROM t WHERE id = 1") == [(99,)]
+    s.execute("DELETE FROM t WHERE id = 2")
+    assert s.query("SELECT a FROM t WHERE id = 2") == []
+    s.execute("ROLLBACK")
+    assert s.query("SELECT a FROM t WHERE id = 1") == [(10,)]
+
+
+def test_point_get_not_applicable_shapes(db):
+    s = db.session()
+    # non-PK equality, ranges, aggregates: all take the planner path
+    assert s.query("SELECT id FROM t WHERE a = 20") == [(2,)]
+    assert s.query("SELECT COUNT(*) FROM t WHERE id = 1") == [(1,)]
+    assert s.query("SELECT id FROM t WHERE id > 1 ORDER BY id") == [(2,), (7,)]
+
+
+def test_user_variables(db):
+    s = db.session()
+    s.execute("SET @x = 5")
+    assert s.query("SELECT @x + 1") == [(6,)]
+    s.execute("SET @name = 'y'")
+    assert s.query("SELECT id FROM t WHERE s = @name") == [(2,)]
+    # unset vars read as NULL
+    assert s.query("SELECT @missing IS NULL") == [(1,)]
+    # system variables
+    assert s.query("SELECT @@autocommit") == [(1,)]
+
+
+def test_prepare_execute_deallocate(db):
+    s = db.session()
+    s.execute("PREPARE p1 FROM 'SELECT a FROM t WHERE a > ? ORDER BY a'")
+    s.execute("SET @lo = 5")
+    assert s.execute("EXECUTE p1 USING @lo").rows == [(10,), (20,)]
+    s.execute("SET @lo = 15")
+    assert s.execute("EXECUTE p1 USING @lo").rows == [(20,)]
+    # arity mismatch
+    with pytest.raises(Exception):
+        s.execute("EXECUTE p1")
+    s.execute("DEALLOCATE PREPARE p1")
+    with pytest.raises(Exception):
+        s.execute("EXECUTE p1 USING @lo")
+    # PREPARE FROM @var
+    s.execute("SET @q = 'SELECT COUNT(*) FROM t'")
+    s.execute("PREPARE p2 FROM @q")
+    assert s.execute("EXECUTE p2").rows == [(3,)]
+
+
+def test_prepare_programmatic(db):
+    s = db.session()
+    nm = s.prepare("SELECT id FROM t WHERE id = ?")
+    assert s.execute_prepared(nm, [7]).rows == [(7,)]
+    assert s.execute_prepared(nm, [1]).rows == [(1,)]
+
+
+def test_plan_cache_hit_and_invalidation(db):
+    s = db.session()
+    q = "SELECT COUNT(*) FROM t WHERE a > 5"
+    s.query(q)
+    assert s.vars["last_plan_from_cache"] == 0
+    s.query(q)
+    assert s.vars["last_plan_from_cache"] == 1
+    # DDL bumps schema version → miss, then warm again
+    db.execute("CREATE TABLE t_inval (x BIGINT)")
+    s.query(q)
+    assert s.vars["last_plan_from_cache"] == 0
+    s.query(q)
+    assert s.vars["last_plan_from_cache"] == 1
+    # data changes do not invalidate, and results stay fresh
+    db.execute("INSERT INTO t VALUES (9, 100, NULL, NULL)")
+    assert s.query(q) == [(3,)]
+    assert s.vars["last_plan_from_cache"] == 1
+    # engine switch takes a different cache slot
+    s.execute("SET tidb_isolation_read_engines = 'host'")
+    s.query(q)
+    assert s.vars["last_plan_from_cache"] == 0
+
+
+def test_plan_cache_skips_variable_reads(db):
+    s = db.session()
+    s.execute("SET @lo = 5")
+    q = "SELECT COUNT(*) FROM t WHERE a > @lo"
+    assert s.query(q) == [(2,)]
+    s.execute("SET @lo = 15")
+    # a cached plan would have baked @lo=5; variable reads are uncacheable
+    assert s.query(q) == [(1,)]
+
+
+def test_plan_cache_lru_eviction(db):
+    s = db.session()
+    s.vars["tidb_prepared_plan_cache_size"] = 2
+    qs = ["SELECT 1 FROM t", "SELECT 2 FROM t", "SELECT 3 FROM t"]
+    for q in qs:
+        s.query(q)
+    assert len(s._plan_cache) == 2
+    s.query(qs[0])
+    assert s.vars["last_plan_from_cache"] == 0  # evicted earlier

@@ -21,6 +21,22 @@ class Literal(Node):
 
 
 @dataclass
+class ParamMarker(Node):
+    """``?`` placeholder in a prepared statement (ref: ast.ParamMarkerExpr)."""
+
+    idx: int
+
+
+@dataclass
+class UserVar(Node):
+    """``@name`` user variable or ``@@name`` system variable reference."""
+
+    name: str
+    sys: bool = False
+    scope: str = "session"
+
+
+@dataclass
 class ColumnName(Node):
     name: str
     table: str = ""
@@ -345,6 +361,30 @@ class SetVariable(Node):
 
 
 @dataclass
+class Prepare(Node):
+    """PREPARE name FROM 'text' | @var (ref: ast.PrepareStmt)."""
+
+    name: str
+    text: Optional[str] = None
+    from_var: Optional[str] = None
+
+
+@dataclass
+class ExecutePrepared(Node):
+    """EXECUTE name [USING @a, @b] (ref: ast.ExecuteStmt)."""
+
+    name: str
+    using: list[str] = field(default_factory=list)
+
+
+@dataclass
+class Deallocate(Node):
+    """DEALLOCATE PREPARE name (ref: ast.DeallocateStmt)."""
+
+    name: str
+
+
+@dataclass
 class Show(Node):
     kind: str  # tables/databases/create_table/variables/columns
     target: str = ""
@@ -369,3 +409,22 @@ class Rollback(Node):
 @dataclass
 class AnalyzeTable(Node):
     tables: list[TableRef] = field(default_factory=list)
+
+
+def bind_params(node, values):
+    """Return a copy of the AST with each ParamMarker replaced by a Literal
+    of the corresponding value (EXECUTE ... USING binding)."""
+    import dataclasses
+
+    def conv(v):
+        if isinstance(v, ParamMarker):
+            return Literal(values[v.idx])
+        if isinstance(v, Node) and dataclasses.is_dataclass(v):
+            return type(v)(**{f.name: conv(getattr(v, f.name)) for f in dataclasses.fields(v)})
+        if isinstance(v, list):
+            return [conv(x) for x in v]
+        if isinstance(v, tuple):
+            return tuple(conv(x) for x in v)
+        return v
+
+    return conv(node)

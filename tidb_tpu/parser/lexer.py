@@ -26,7 +26,7 @@ class Token:
 _OPS = [
     "<=>", "<<", ">>", "<=", ">=", "<>", "!=", ":=", "||", "&&",
     "(", ")", ",", ".", ";", "+", "-", "*", "/", "%", "=", "<", ">",
-    "!", "~", "^", "&", "|", "@",
+    "!", "~", "^", "&", "|", "@", "?",
 ]
 
 

@@ -32,6 +32,7 @@ class Parser:
     def __init__(self, sql: str):
         self.toks = tokenize(sql)
         self.i = 0
+        self.param_count = 0  # `?` markers seen so far (prepared statements)
 
     # -- token helpers ------------------------------------------------------
     def peek(self, ahead: int = 0) -> Token:
@@ -107,6 +108,9 @@ class Parser:
             "COMMIT": lambda: (self.next(), ast.Commit())[1],
             "ROLLBACK": lambda: (self.next(), ast.Rollback())[1],
             "ANALYZE": self.parse_analyze,
+            "PREPARE": self.parse_prepare,
+            "EXECUTE": self.parse_execute_stmt,
+            "DEALLOCATE": self.parse_deallocate,
         }.get(kw)
         if fn is None:
             raise ParseError("unsupported statement", t)
@@ -495,6 +499,22 @@ class Parser:
 
     def _primary(self) -> ast.Node:
         t = self.peek()
+        if t.kind == "op" and t.value == "?":
+            self.next()
+            m = ast.ParamMarker(self.param_count)
+            self.param_count += 1
+            return m
+        if t.kind == "op" and t.value == "@":
+            self.next()
+            if self.at_op("@"):
+                self.next()
+                scope = "session"
+                name = self.ident()
+                if name.lower() in ("global", "session") and self.eat_op("."):
+                    scope = name.lower()
+                    name = self.ident()
+                return ast.UserVar(name.lower(), sys=True, scope=scope)
+            return ast.UserVar(self.ident().lower())
         if t.kind == "int":
             self.next()
             return ast.Literal(int(t.value))
@@ -937,6 +957,37 @@ class Parser:
         val = self.parse_expr()
         return ast.SetVariable(name.lower(), val, scope=scope)
 
+    def parse_prepare(self) -> ast.Prepare:
+        self.expect_kw("PREPARE")
+        name = self.ident().lower()
+        self.expect_kw("FROM")
+        t = self.peek()
+        if t.kind == "str":
+            self.next()
+            text = t.value.decode() if isinstance(t.value, bytes) else t.value
+            return ast.Prepare(name, text=text)
+        if self.at_op("@"):
+            self.next()
+            return ast.Prepare(name, from_var=self.ident().lower())
+        raise ParseError("expected string literal or @var after FROM", t)
+
+    def parse_execute_stmt(self) -> ast.ExecutePrepared:
+        self.expect_kw("EXECUTE")
+        name = self.ident().lower()
+        using: list[str] = []
+        if self.eat_kw("USING"):
+            while True:
+                self.expect_op("@")
+                using.append(self.ident().lower())
+                if not self.eat_op(","):
+                    break
+        return ast.ExecutePrepared(name, using)
+
+    def parse_deallocate(self) -> ast.Deallocate:
+        self.expect_kw("DEALLOCATE")
+        self.expect_kw("PREPARE")
+        return ast.Deallocate(self.ident().lower())
+
     def parse_show(self) -> ast.Show:
         self.expect_kw("SHOW")
         if self.eat_kw("TABLES"):
@@ -991,12 +1042,18 @@ class Parser:
 
 
 def parse(sql: str) -> ast.Node:
+    return parse_with_params(sql)[0]
+
+
+def parse_with_params(sql: str) -> tuple[ast.Node, int]:
+    """Parse one statement; also report how many ``?`` markers it contains
+    (prepared-statement surface, ref: ast.ParamMarkerExpr counting)."""
     p = Parser(sql)
     stmt = p.parse_statement()
     p.eat_op(";")
     if p.peek().kind != "eof":
         raise ParseError("trailing input", p.peek())
-    return stmt
+    return stmt, p.param_count
 
 
 def parse_many(sql: str) -> list[ast.Node]:

@@ -109,10 +109,24 @@ class BuildCtx:
 
 
 class Builder:
-    def __init__(self, catalog: Catalog, current_db: str, subquery_runner: Optional[Callable] = None):
+    def __init__(
+        self,
+        catalog: Catalog,
+        current_db: str,
+        subquery_runner: Optional[Callable] = None,
+        user_vars: Optional[dict] = None,
+        sys_vars: Optional[dict] = None,
+        global_vars: Optional[dict] = None,
+    ):
         self.catalog = catalog
         self.db = current_db
         self.subquery_runner = subquery_runner
+        self.user_vars = user_vars
+        self.sys_vars = sys_vars
+        self.global_vars = global_vars if global_vars is not None else sys_vars
+        # set when the built plan bakes in plan-time state (subquery results,
+        # variable reads) and must not enter the plan cache
+        self.uncacheable = False
         # ast window-call node id → ColumnRef into a LogicalWindow's output
         self._win_map: dict[int, Expression] = {}
 
@@ -644,6 +658,21 @@ class Builder:
     def _resolve(self, node: ast.Node, ctx: BuildCtx) -> Expression:
         if isinstance(node, ast.Literal):
             return _literal(node)
+        if isinstance(node, ast.ParamMarker):
+            raise PlanError("parameter marker outside PREPARE/EXECUTE")
+        if isinstance(node, ast.UserVar):
+            # user/system variable reads fold to constants at plan time →
+            # such plans must not be cached (ref: plan-cache skips them)
+            self.uncacheable = True
+            if node.sys:
+                src = self.sys_vars if node.scope != "global" else self.global_vars
+                if src is None or node.name not in src:
+                    raise PlanError(f"unknown system variable '{node.name}'")
+                return _literal(ast.Literal(src[node.name]))
+            val = (self.user_vars or {}).get(node.name)
+            if isinstance(val, str):
+                val = val.encode()
+            return _literal(ast.Literal(val))
         if isinstance(node, ast.ColumnName):
             return self._resolve_column(node, ctx)
         if isinstance(node, ast.BinaryOp):
@@ -941,6 +970,7 @@ class Builder:
     def _run_subquery(self, sel: ast.Select, expect_cols: Optional[int] = None, limit: Optional[int] = None):
         if self.subquery_runner is None:
             raise PlanError("subqueries not supported in this context")
+        self.uncacheable = True  # plan bakes in subquery results as of now
         rows = self.subquery_runner(sel)
         if expect_cols is not None and rows and len(rows[0]) != expect_cols:
             raise PlanError("Operand should contain 1 column(s)")

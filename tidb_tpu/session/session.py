@@ -10,6 +10,7 @@ operators host-side (ref: UnionScanExec merging membuffer over snapshot).
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -38,7 +39,22 @@ DEFAULT_SYSVARS = {
     # MPP gating (ref: tidb_vars.go:399 tidb_allow_mpp, :415 tidb_enforce_mpp)
     "tidb_allow_mpp": 1,
     "tidb_enforce_mpp": 0,
+    # session plan cache capacity (ref: tidb_prepared_plan_cache_size)
+    "tidb_prepared_plan_cache_size": 100,
+    # 1 when the previous statement's plan came from the plan cache
+    # (ref: last_plan_from_cache status var)
+    "last_plan_from_cache": 0,
 }
+
+
+@dataclass
+class PreparedStmt:
+    """PREPARE'd statement: parsed AST + ``?`` count (ref: PlanCacheStmt)."""
+
+    name: str
+    text: str
+    stmt: Any
+    n_params: int
 
 
 @dataclass
@@ -79,6 +95,12 @@ class Session:
         self._pending_mods: dict[int, int] = {}
         # EXPLAIN ANALYZE per-operator stats (ref: util/execdetails)
         self.runtime_stats = None
+        # user variables (@x) and prepared statements (session-scoped)
+        self.user_vars: dict[str, Any] = {}
+        self.prepared: dict[str, PreparedStmt] = {}
+        # session LRU plan cache (ref: core/plan_cache_lru.go:44); key
+        # includes schema/stats versions so DDL and ANALYZE invalidate it
+        self._plan_cache: OrderedDict[tuple, Any] = OrderedDict()
 
     # -- txn lifecycle (ref: LazyTxn) ---------------------------------------
     def txn(self) -> Txn:
@@ -141,7 +163,7 @@ class Session:
     def execute(self, sql: str) -> Result:
         stmt = parse(sql)
         try:
-            res = self._execute_stmt(stmt)
+            res = self._execute_stmt(stmt, sql_text=sql)
             if not self._explicit and self._txn is not None:
                 self._finish_txn(commit=True)
             return res
@@ -159,9 +181,9 @@ class Session:
         return self.execute(sql).rows
 
     # -- dispatch ------------------------------------------------------------
-    def _execute_stmt(self, stmt: ast.Node) -> Result:
+    def _execute_stmt(self, stmt: ast.Node, sql_text: Optional[str] = None) -> Result:
         if isinstance(stmt, (ast.Select, ast.SetOp)):
-            return self._select(stmt)
+            return self._select(stmt, cache_key=sql_text)
         if isinstance(stmt, (ast.Insert, ast.Update, ast.Delete)):
             from tidb_tpu.executor import write
 
@@ -223,7 +245,59 @@ class Session:
             return self._explain(stmt)
         if isinstance(stmt, ast.AnalyzeTable):
             return self._analyze(stmt)
+        if isinstance(stmt, ast.Prepare):
+            return self._prepare(stmt)
+        if isinstance(stmt, ast.ExecutePrepared):
+            return self._execute_prepared(stmt)
+        if isinstance(stmt, ast.Deallocate):
+            if stmt.name not in self.prepared:
+                raise SessionError(f"unknown prepared statement '{stmt.name}'")
+            del self.prepared[stmt.name]
+            return Result()
         raise SessionError(f"unsupported statement {type(stmt).__name__}")
+
+    # -- prepared statements (ref: executor/prepared.go) ---------------------
+    def _prepare(self, stmt: ast.Prepare) -> Result:
+        from tidb_tpu.parser import parse_with_params
+
+        text = stmt.text
+        if text is None:
+            v = self.user_vars.get(stmt.from_var)
+            if v is None:
+                raise SessionError(f"user variable @{stmt.from_var} is not set")
+            text = v.decode() if isinstance(v, bytes) else str(v)
+        inner, n_params = parse_with_params(text)
+        if isinstance(inner, (ast.Prepare, ast.ExecutePrepared, ast.Deallocate)):
+            raise SessionError("cannot prepare a PREPARE/EXECUTE statement")
+        self.prepared[stmt.name] = PreparedStmt(stmt.name, text, inner, n_params)
+        return Result()
+
+    def prepare(self, sql: str, name: str = "__lib") -> str:
+        """Programmatic prepare; returns the statement name."""
+        self._prepare(ast.Prepare(name, text=sql))
+        return name
+
+    def execute_prepared(self, name: str, params: Optional[list] = None) -> Result:
+        ps = self.prepared.get(name)
+        if ps is None:
+            raise SessionError(f"unknown prepared statement '{name}'")
+        params = params or []
+        if len(params) != ps.n_params:
+            raise SessionError(
+                f"prepared statement '{name}' expects {ps.n_params} parameters, got {len(params)}"
+            )
+        bound = ast.bind_params(ps.stmt, params) if ps.n_params else ps.stmt
+        # plans bake constants into scan ranges, so the cache key includes
+        # the bound parameter values (the reference instead rebuilds ranges
+        # inside a value-agnostic cached plan — a later-round refinement)
+        key = ("__prep__", ps.text, tuple(repr(p) for p in params))
+        return self._execute_stmt(bound, sql_text=key)
+
+    def _execute_prepared(self, stmt: ast.ExecutePrepared) -> Result:
+        vals = []
+        for vn in stmt.using:
+            vals.append(self.user_vars.get(vn))
+        return self.execute_prepared(stmt.name, vals)
 
     def _dml(self, fn) -> Result:
         txn = self.txn()
@@ -237,10 +311,20 @@ class Session:
         return Result(affected=affected)
 
     # -- SELECT ---------------------------------------------------------------
-    def _select(self, stmt) -> Result:
+    def _select(self, stmt, cache_key=None) -> Result:
+        # point-get fast path first (ref: TryFastPlan, point_get_plan.go:957)
+        from tidb_tpu.planner.pointget import detect_point_get, run_point_get
+
+        pg = detect_point_get(self.catalog, self.current_db, stmt)
+        if pg is not None:
+            self.vars["last_plan_from_cache"] = 0
+            return Result(columns=pg.out_names, rows=run_point_get(self, pg))
         if getattr(stmt, "ctes", None):
             from tidb_tpu.planner.cte import expand_ctes
 
+            # CTE expansion can materialize data (recursive fixpoints) into
+            # the AST — such plans must never be cached
+            cache_key = None
             stmt = expand_ctes(stmt, self._cte_runner)
         if isinstance(stmt, ast.SetOp) and _setop_has_for_update(stmt):
             raise SessionError("FOR UPDATE is not supported inside set operations")
@@ -250,7 +334,7 @@ class Session:
                 # locking read returns latest committed values (current read)
                 self._read_ts_override = self._txn.for_update_ts
         try:
-            plan = self._plan_select(stmt)
+            plan = self._plan_select(stmt, cache_key=cache_key)
             from tidb_tpu.executor import build_executor
 
             ex = build_executor(plan, self)
@@ -297,17 +381,57 @@ class Session:
         keys = [tablecodec.record_key(t.id, int(h)) for h in handles]
         self.lock_for_write(keys)
 
-    def _plan_select(self, stmt):
+    def _plan_cache_key(self, cache_key):
+        return (
+            cache_key,
+            self.current_db,
+            str(self.vars["tidb_isolation_read_engines"]),
+            self.catalog.schema_version,
+            self._db.stats.version,
+            self.vars.get("tidb_allow_mpp"),
+            self.vars.get("tidb_enforce_mpp"),
+        )
+
+    def _plan_select(self, stmt, cache_key=None):
+        # session LRU plan cache (ref: core/plan_cache_lru.go); FOR UPDATE
+        # and WITH queries never cache (txn-state/plan-time-dependent)
+        key = None
+        if (
+            cache_key is not None
+            and not getattr(stmt, "for_update", False)
+            and not getattr(stmt, "ctes", None)
+        ):
+            key = self._plan_cache_key(cache_key)
+            hit = self._plan_cache.get(key)
+            if hit is not None:
+                self._plan_cache.move_to_end(key)
+                self.vars["last_plan_from_cache"] = 1
+                return hit
+        self.vars["last_plan_from_cache"] = 0
+
         from tidb_tpu.planner.cte import expand_ctes
 
         stmt = expand_ctes(stmt, self._cte_runner)
-        builder = Builder(self.catalog, self.current_db, subquery_runner=self._subquery_runner)
+        builder = Builder(
+            self.catalog,
+            self.current_db,
+            subquery_runner=self._subquery_runner,
+            user_vars=self.user_vars,
+            sys_vars=self.vars,
+            global_vars=self._db.global_vars,
+        )
         logical = builder.build_query(stmt)
         engines = [e.strip() for e in str(self.vars["tidb_isolation_read_engines"]).split(",") if e.strip()]
         plan = optimize(logical, engines, stats=self._db.stats)
         from tidb_tpu.parallel.gather import try_mpp_rewrite
 
-        return try_mpp_rewrite(plan, self.vars, stats=self._db.stats)
+        plan = try_mpp_rewrite(plan, self.vars, stats=self._db.stats)
+        if key is not None and not builder.uncacheable:
+            self._plan_cache[key] = plan
+            cap = int(self.vars.get("tidb_prepared_plan_cache_size", 100))
+            while len(self._plan_cache) > cap:
+                self._plan_cache.popitem(last=False)
+        return plan
 
     def _run_select_ast(self, stmt) -> list[tuple]:
         return self._select(stmt).rows
@@ -337,6 +461,9 @@ class Session:
         v = e.value
         if isinstance(v, bytes):
             v = v.decode()
+        if stmt.name.startswith("@"):
+            self.user_vars[stmt.name[1:]] = v
+            return Result()
         if stmt.scope == "global":
             self._db.global_vars[stmt.name] = v
         self.vars[stmt.name] = v
@@ -418,6 +545,12 @@ class Session:
         inner = stmt.stmt
         if not isinstance(inner, (ast.Select, ast.SetOp)):
             raise SessionError("EXPLAIN supports SELECT only")
+        from tidb_tpu.planner.pointget import detect_point_get
+
+        pg = detect_point_get(self.catalog, self.current_db, inner)
+        if pg is not None and not stmt.analyze:
+            line = f"Point_Get  table:{pg.table.name}, handle:{pg.handle}"
+            return Result(columns=["plan"], rows=[(line,)])
         plan = self._plan_select(inner)
         if stmt.analyze:
             from tidb_tpu.executor import build_executor

@@ -60,6 +60,9 @@ class StatsHandle:
         self._tables: dict[int, TableStats] = {}
         self._mod_counts: dict[int, int] = {}
         self.auto_analyze_ratio = 0.5  # ref: tidb_auto_analyze_ratio default
+        # bumped on every stats change; plan caches key on it so ANALYZE
+        # invalidates cached access-path choices
+        self.version = 0
 
     def get(self, table_id: int) -> Optional[TableStats]:
         with self._mu:
@@ -69,11 +72,13 @@ class StatsHandle:
         with self._mu:
             self._tables[stats.table_id] = stats
             self._mod_counts[stats.table_id] = 0
+            self.version += 1
 
     def drop(self, table_id: int) -> None:
         with self._mu:
             self._tables.pop(table_id, None)
             self._mod_counts.pop(table_id, None)
+            self.version += 1
 
     def note_mods(self, table_id: int, n: int) -> None:
         """DML bumps the modify counter (ref: stats delta dumping)."""
