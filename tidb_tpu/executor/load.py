@@ -38,6 +38,29 @@ def bulk_load(db: DB, table_name: str, columns: Sequence[Sequence], db_name: str
         else:
             phys_cols.append([to_physical(v, c.ftype) for v in vals])
 
+    # native fast path (C++ encode + SST-style ingest; ref: lightning local
+    # backend): row+key encoding and the per-key 2PC loop collapse into one
+    # C call + one bulk store insert. Indexed tables keep the txn path so
+    # index entries stay transactional with their rows.
+    from tidb_tpu.native import lib as native_lib
+
+    if native_lib() is not None and not any(idx.state != "delete_only" for idx in t.indexes):
+        from tidb_tpu.native.bulk import encode_rows, split_encoded
+
+        if t.pk_is_handle:
+            all_handles = np.ascontiguousarray(np.asarray(phys_cols[t.pk_offset], dtype=np.int64))
+        else:
+            base = db.catalog.alloc_autoid(t.id, n)
+            all_handles = np.arange(base, base + n, dtype=np.int64)
+        enc = encode_rows(t, phys_cols, all_handles)
+        if enc is not None:
+            keys_buf, rows_buf, row_starts = enc
+            pairs = list(split_encoded(keys_buf, rows_buf, row_starts))
+            db.store.ingest([k for k, _ in pairs], [v for _, v in pairs])
+            if t.pk_is_handle and n:
+                db.catalog.rebase_autoid(t.id, int(all_handles.max()) + 1)
+            return n
+
     loaded = 0
     i = 0
     while i < n:

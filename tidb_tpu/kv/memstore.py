@@ -436,6 +436,51 @@ class MemStore:
                     r.data_version += 1
                     self._maybe_auto_split(r)
 
+    def ingest(self, keys: Sequence[bytes], values: Sequence[bytes]) -> int:
+        """Bulk ingest of pre-encoded committed rows at one fresh commit ts —
+        the local-SST-ingest path (ref: lightning local backend + unistore's
+        IngestSST): bypasses prewrite/commit per key. Refuses when any
+        ingested key holds a lock (writers would race the ingest)."""
+        with self._mu:
+            start_ts = self.tso.ts()
+            commit_ts = self.tso.ts()
+            if self._locks:
+                for k in keys:
+                    if k in self._locks:
+                        raise KeyLockedError(k, self._locks[k])
+            writes = self._writes
+            lo: bytes | None = None
+            hi: bytes | None = None
+            for k, v in zip(keys, values):
+                chain = writes.get(k)
+                if chain is None:
+                    writes[k] = [Write(commit_ts, start_ts, OP_PUT, v)]
+                else:
+                    chain.append(Write(commit_ts, start_ts, OP_PUT, v))
+                if lo is None or k < lo:
+                    lo = k
+                if hi is None or k > hi:
+                    hi = k
+            if lo is None:
+                return commit_ts
+            # region bookkeeping in one sweep over the regions the ingested
+            # span touches (per-key region lookup is the slow path the txn
+            # commit pays); untouched regions keep their data_version so
+            # their columnar/device caches stay warm
+            self._sorted = None
+            touched = [
+                r
+                for r in self._regions
+                if (not r.end or lo < r.end) and (not r.start or hi >= r.start)
+            ]
+            for r in touched:
+                self._recount_region(r)
+                r.max_commit_ts = max(r.max_commit_ts, commit_ts)
+                r.data_version += 1
+            for r in touched:
+                self._maybe_auto_split(r)
+            return commit_ts
+
     def rollback(self, keys: Sequence[bytes], start_ts: int) -> None:
         with self._mu:
             for k in keys:

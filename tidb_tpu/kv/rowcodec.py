@@ -119,6 +119,17 @@ def decode_fixed_bulk(
     ``buf`` is the concatenation of row values; ``starts[i]`` is the byte
     offset of row i. Returns ([data...], [validity...]) per requested col.
     """
+    from tidb_tpu.native.bulk import decode_fixed as native_decode_fixed
+
+    nat = native_decode_fixed(buf, starts, schema, cols) if len(starts) else None
+    if nat is not None:
+        datas, valids = [], []
+        for (data, valid), c in zip(nat, cols):
+            if schema.ftypes[c].kind == TypeKind.FLOAT:
+                data = data.view("<f8")
+            datas.append(data)
+            valids.append(valid)
+        return datas, valids
     arr = np.frombuffer(buf, dtype=np.uint8)
     n = len(starts)
     datas, valids = [], []
