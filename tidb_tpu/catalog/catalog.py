@@ -17,7 +17,15 @@ import json
 import threading
 from typing import Callable, Optional
 
-from tidb_tpu.catalog.schema import ColumnInfo, DBInfo, IndexInfo, TableInfo, typedef_to_ftype
+from tidb_tpu.catalog.schema import (
+    ColumnInfo,
+    DBInfo,
+    IndexInfo,
+    PartitionDef,
+    PartitionInfo,
+    TableInfo,
+    typedef_to_ftype,
+)
 from tidb_tpu.kv import KeyRange, tablecodec
 from tidb_tpu.kv.memstore import MemStore
 from tidb_tpu.kv.rowcodec import RowSchema, decode_row, encode_row
@@ -186,9 +194,33 @@ class Catalog:
                 else:
                     t.indexes.insert(0, IndexInfo(t.next_index_id, "primary", offs, unique=True, primary=True))
                     t.next_index_id += 1
+            if stmt.partition_by is not None:
+                t.partition = self._build_partition_info(t, stmt.partition_by)
             dbi.tables[tname] = t
             self._persist()
             return t
+
+    def _build_partition_info(self, t: TableInfo, pby: ast.PartitionByDef) -> PartitionInfo:
+        """Each partition is a physical table id (ref: model.PartitionInfo;
+        indexes are local — unique keys are enforced per partition)."""
+        off = self._col_offset(t, pby.column)
+        if t.columns[off].ftype.kind not in (TypeKind.INT, TypeKind.UINT, TypeKind.DATE, TypeKind.DATETIME):
+            raise CatalogError("partition column must be integer-kind")
+        if pby.type == "hash":
+            defs = [PartitionDef(self._next_table_id(), f"p{i}") for i in range(pby.num)]
+            return PartitionInfo("hash", off, defs)
+        defs = []
+        prev: int | None = None
+        for name, lt in pby.defs:
+            if any(d.name == name for d in defs):
+                raise CatalogError(f"duplicate partition name {name!r}")
+            if prev is not None and lt is not None and lt <= prev:
+                raise CatalogError("RANGE partition bounds must be strictly increasing")
+            if defs and defs[-1].less_than is None:
+                raise CatalogError("MAXVALUE partition must be last")
+            defs.append(PartitionDef(self._next_table_id(), name, lt))
+            prev = lt if lt is not None else prev
+        return PartitionInfo("range", off, defs)
 
     @staticmethod
     def _col_offset(t: TableInfo, name: str) -> int:
@@ -216,18 +248,25 @@ class Catalog:
             t = self.table(db, name)
             self._drop_table_data(t)
             t.id = self._next_table_id()
+            if t.partition is not None:
+                for d in t.partition.defs:
+                    d.id = self._next_table_id()
             self._persist()
             return t
 
     def _drop_table_data(self, t: TableInfo) -> None:
         from tidb_tpu.copr.colcache import cache_for
 
-        kr = KeyRange(tablecodec.table_prefix(t.id), tablecodec.table_prefix(t.id + 1))
-        txn = self.store.begin()
-        for k, _ in txn.scan(kr):
-            txn.delete(k)
-        txn.commit()
-        cache_for(self.store).invalidate_table(t.id)
+        for view in t.partition_views():
+            kr = KeyRange(tablecodec.table_prefix(view.id), tablecodec.table_prefix(view.id + 1))
+            txn = self.store.begin()
+            for k, _ in txn.scan(kr):
+                txn.delete(k)
+            txn.commit()
+            cache_for(self.store).invalidate_table(view.id)
+        if t.partition is not None:
+            # shared (logical-id) dictionaries go with the table
+            cache_for(self.store).invalidate_table(t.id)
 
     @property
     def ddl(self):
@@ -294,12 +333,43 @@ class Catalog:
                     t.pk_is_handle, t.pk_offset = False, -1
                 elif t.pk_offset > off:
                     t.pk_offset -= 1
+                if t.partition is not None:
+                    if t.partition.col_offset == off:
+                        raise CatalogError("cannot drop the partitioning column")
+                    if t.partition.col_offset > off:
+                        t.partition.col_offset -= 1
                 self._rewrite_rows(t, old_schema, lambda vals: vals[:off] + vals[off + 1 :])
             elif stmt.action == "rename":
                 dbi = self.db(db)
                 del dbi.tables[t.name]
                 t.name = stmt.name.lower()
                 dbi.tables[t.name] = t
+            elif stmt.action == "add_partition":
+                p = t.partition
+                if p is None or p.type != "range":
+                    raise CatalogError("ADD PARTITION requires a RANGE-partitioned table")
+                if any(d.name == stmt.name for d in p.defs):
+                    raise CatalogError(f"duplicate partition name {stmt.name!r}")
+                last = p.defs[-1]
+                if last.less_than is None:
+                    raise CatalogError("cannot add after a MAXVALUE partition")
+                if stmt.less_than is not None and stmt.less_than <= last.less_than:
+                    raise CatalogError("new partition bound must exceed the last bound")
+                p.defs.append(PartitionDef(self._next_table_id(), stmt.name, stmt.less_than))
+            elif stmt.action in ("drop_partition", "truncate_partition"):
+                p = t.partition
+                if p is None:
+                    raise CatalogError("table is not partitioned")
+                d = next((d for d in p.defs if d.name == stmt.name.lower()), None)
+                if d is None:
+                    raise CatalogError(f"unknown partition {stmt.name!r}")
+                if stmt.action == "drop_partition" and len(p.defs) == 1:
+                    raise CatalogError("cannot drop the only partition")
+                self._drop_table_data(t.partition_view(d.id))
+                if stmt.action == "drop_partition":
+                    p.defs.remove(d)
+                else:
+                    d.id = self._next_table_id()
             else:
                 raise CatalogError(f"unsupported ALTER action {stmt.action!r}")
             self._persist()
@@ -308,11 +378,12 @@ class Catalog:
         from tidb_tpu.copr.colcache import cache_for
 
         new_schema = RowSchema(t.storage_schema)
-        txn = self.store.begin()
-        for k, v in txn.scan(tablecodec.record_range(t.id)):
-            txn.put(k, encode_row(new_schema, fn(decode_row(old_schema, v))))
-        txn.commit()
-        cache_for(self.store).invalidate_table(t.id)
+        for view in t.partition_views():
+            txn = self.store.begin()
+            for k, v in txn.scan(tablecodec.record_range(view.id)):
+                txn.put(k, encode_row(new_schema, fn(decode_row(old_schema, v))))
+            txn.commit()
+            cache_for(self.store).invalidate_table(view.id)
 
 
 def _fold_default(node: ast.Node, ft) -> object:

@@ -91,6 +91,41 @@ class IndexInfo:
 
 
 @dataclass
+class PartitionDef:
+    """One partition: its own physical table id (ref: model.PartitionDefinition
+    — partitions are physical tables sharing one schema)."""
+
+    id: int  # physical table id (record/index keys use this)
+    name: str
+    less_than: Optional[int] = None  # RANGE bound; None = MAXVALUE
+
+    def to_pb(self) -> dict:
+        return {"id": self.id, "name": self.name, "less_than": self.less_than}
+
+    @staticmethod
+    def from_pb(pb: dict) -> "PartitionDef":
+        return PartitionDef(pb["id"], pb["name"], pb["less_than"])
+
+
+@dataclass
+class PartitionInfo:
+    """RANGE / HASH partitioning over one integer-kind column
+    (ref: model.PartitionInfo; expressions beyond a bare column are a later
+    round — the reference's most common shapes are RANGE(col) and HASH(col))."""
+
+    type: str  # "range" | "hash"
+    col_offset: int
+    defs: list[PartitionDef] = field(default_factory=list)
+
+    def to_pb(self) -> dict:
+        return {"type": self.type, "col": self.col_offset, "defs": [d.to_pb() for d in self.defs]}
+
+    @staticmethod
+    def from_pb(pb: dict) -> "PartitionInfo":
+        return PartitionInfo(pb["type"], pb["col"], [PartitionDef.from_pb(d) for d in pb["defs"]])
+
+
+@dataclass
 class TableInfo:
     id: int
     name: str
@@ -101,6 +136,7 @@ class TableInfo:
     pk_offset: int = -1
     next_column_id: int = 1
     next_index_id: int = 1
+    partition: Optional[PartitionInfo] = None
 
     def column(self, name: str) -> Optional[ColumnInfo]:
         lname = name.lower()
@@ -113,6 +149,40 @@ class TableInfo:
     def storage_schema(self) -> list[FieldType]:
         return [c.ftype for c in self.columns]
 
+    # -- partition helpers ---------------------------------------------------
+    def partition_views(self) -> list["TableInfo"]:
+        """One TableInfo clone per partition, with id = the partition's
+        physical id (columns/indexes shared). Non-partitioned → [self]."""
+        if self.partition is None:
+            return [self]
+        import dataclasses
+
+        return [dataclasses.replace(self, id=d.id, partition=None) for d in self.partition.defs]
+
+    def partition_view(self, pid: int) -> "TableInfo":
+        import dataclasses
+
+        return dataclasses.replace(self, id=pid, partition=None)
+
+    def partition_id_for(self, vals: list) -> int:
+        """Route a row to its partition's physical id. NULL routes to the
+        first partition (MySQL RANGE semantics)."""
+        assert self.partition is not None
+        p = self.partition
+        v = vals[p.col_offset]
+        if p.type == "hash":
+            if v is None:
+                return p.defs[0].id
+            return p.defs[int(v) % len(p.defs)].id
+        if v is None:
+            return p.defs[0].id
+        for d in p.defs:
+            if d.less_than is None or int(v) < d.less_than:
+                return d.id
+        from tidb_tpu.catalog.catalog import CatalogError
+
+        raise CatalogError(f"Table has no partition for value {v}")
+
     def to_pb(self) -> dict:
         return {
             "id": self.id,
@@ -123,6 +193,7 @@ class TableInfo:
             "pk_offset": self.pk_offset,
             "next_column_id": self.next_column_id,
             "next_index_id": self.next_index_id,
+            "partition": self.partition.to_pb() if self.partition else None,
         }
 
     @staticmethod
@@ -136,6 +207,7 @@ class TableInfo:
             pb["pk_offset"],
             pb["next_column_id"],
             pb["next_index_id"],
+            PartitionInfo.from_pb(pb["partition"]) if pb.get("partition") else None,
         )
 
 

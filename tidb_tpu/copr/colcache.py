@@ -55,24 +55,35 @@ class ColumnCache:
         self._mu = threading.Lock()
         self._entries: dict[tuple[int, int], RegionColumns] = {}
         self._dicts: dict[tuple[int, int], Dictionary] = {}
+        self._alias: dict[int, int] = {}  # partition physical id → logical id
         # bumped whenever a dictionary is compacted: device caches must drop
         self.epoch = 0
 
     # -- dictionaries ------------------------------------------------------
+    def set_table_alias(self, physical_id: int, logical_id: int) -> None:
+        """Partition physical ids share the logical table's dictionaries, so
+        string columns concat across partitions (same Dictionary object)."""
+        with self._mu:
+            self._alias[physical_id] = logical_id
+
+    def _resolve(self, table_id: int) -> int:
+        return self._alias.get(table_id, table_id)
+
     def dictionary(self, table_id: int, slot: int) -> Dictionary:
         with self._mu:
-            return self._dicts.setdefault((table_id, slot), Dictionary())
+            return self._dicts.setdefault((self._resolve(table_id), slot), Dictionary())
 
     def ensure_sorted_dict(self, table_id: int, slot: int) -> Dictionary:
         """Rank-compact a dictionary so codes become order-preserving;
         remaps codes in all cached regions of this column."""
         with self._mu:
-            dic = self._dicts.setdefault((table_id, slot), Dictionary())
+            logical = self._resolve(table_id)
+            dic = self._dicts.setdefault((logical, slot), Dictionary())
             if dic.sorted:
                 return dic
             remap = dic.compact()
             for (rid, tid), entry in self._entries.items():
-                if tid == table_id and slot in entry.cols:
+                if self._resolve(tid) == logical and slot in entry.cols:
                     data, valid = entry.cols[slot]
                     entry.cols[slot] = (remap[data], valid)
             self.epoch += 1

@@ -117,7 +117,42 @@ class TableReaderExec(Executor):
 
     def execute(self) -> Chunk:
         p = self.plan
+        if p.table.partition is not None:
+            # one request per partition (each is its own physical table —
+            # ref: kv.Request.PartitionIDAndRanges); chunks concat like
+            # multi-region partials
+            from tidb_tpu.copr.colcache import cache_for
+
+            cache = cache_for(self.session.store)
+            views = p.partitions if p.partitions is not None else p.table.partition_views()
+            chunks = []
+            for view in views:
+                cache.set_table_alias(view.id, p.table.id)
+                ch = self._execute_one(view, self._translate_ranges(view))
+                if len(ch):
+                    chunks.append(ch)
+            if not chunks:
+                return _empty_chunk(p.schema)
+            return Chunk.concat(chunks) if len(chunks) > 1 else chunks[0]
         t = p.table
+        ranges = p.ranges if p.ranges is not None else [tablecodec.record_range(t.id)]
+        return self._execute_one(t, ranges)
+
+    def _translate_ranges(self, view) -> list:
+        """Planner ranges are handle ranges in logical-table key space —
+        re-encode them for the partition's physical id."""
+        p = self.plan
+        if p.ranges is None:
+            return [tablecodec.record_range(view.id)]
+        out = []
+        for kr in p.ranges:
+            lo, hi = tablecodec.range_to_handles(kr, p.table.id)
+            if lo < hi:
+                out.append(tablecodec.handle_range(view.id, lo, hi - 1))
+        return out
+
+    def _execute_one(self, t, ranges) -> Chunk:
+        p = self.plan
         scan = dagpb.ExecutorPB(
             dagpb.TABLE_SCAN,
             table_id=t.id,
@@ -149,13 +184,12 @@ class TableReaderExec(Executor):
         if p.pushed_limit is not None:
             executors.append(dagpb.ExecutorPB(dagpb.LIMIT, limit=p.pushed_limit))
         dag = dagpb.DAGRequest(executors=executors)
-        ranges = p.ranges if p.ranges is not None else [tablecodec.record_range(t.id)]
         if not ranges:
             return _empty_chunk(p.schema)
         if self.session._txn_dirty():
             # union-scan path (ref: UnionScanExec): scan through the txn's
             # membuffer overlay and replay pushed operators host-side
-            return self._union_scan(dag, ranges)
+            return self._union_scan(dag, ranges, t)
         req = Request(
             tp=RequestType.DAG,
             data=dag,
@@ -173,12 +207,13 @@ class TableReaderExec(Executor):
         # level, shared) — concat requires the same object, which holds here
         return Chunk.concat(chunks) if len(chunks) > 1 else chunks[0]
 
-    def _union_scan(self, dag, ranges) -> Chunk:
+    def _union_scan(self, dag, ranges, t=None) -> Chunk:
         from tidb_tpu.copr.host_engine import run_operators
         from tidb_tpu.executor.write import _rows_to_chunk, _scan_visible_rows
 
-        t = self.plan.table
-        handles, rows = _scan_visible_rows(self.session, t)
+        if t is None:
+            t = self.plan.table
+        handles, rows, _ = _scan_visible_rows(self.session, t)
         # restrict by handle ranges
         keep = []
         bounds = [tablecodec.range_to_handles(kr, t.id) for kr in ranges]

@@ -44,6 +44,9 @@ def bulk_load(db: DB, table_name: str, columns: Sequence[Sequence], db_name: str
     # index entries stay transactional with their rows.
     from tidb_tpu.native import lib as native_lib
 
+    if t.partition is not None:
+        return _bulk_load_partitioned(db, t, phys_cols, n, schema)
+
     if native_lib() is not None and not any(idx.state != "delete_only" for idx in t.indexes):
         from tidb_tpu.native.bulk import encode_rows, split_encoded
 
@@ -86,3 +89,70 @@ def bulk_load(db: DB, table_name: str, columns: Sequence[Sequence], db_name: str
         mx = int(np.max(np.asarray(phys_cols[t.pk_offset]))) if n else 0
         db.catalog.rebase_autoid(t.id, mx + 1)
     return loaded
+
+
+def _bulk_load_partitioned(db: DB, t, phys_cols, n: int, schema: RowSchema) -> int:
+    """Partition-routed load: rows group by partition id, then each group
+    loads through the native ingest (or txn fallback) under its partition's
+    physical table id."""
+    p = t.partition
+    raw = phys_cols[p.col_offset]
+    if isinstance(raw, np.ndarray):
+        pcol = raw.astype(np.int64, copy=False)
+        null_mask = np.zeros(n, dtype=bool)
+    else:
+        null_mask = np.fromiter((v is None for v in raw), dtype=bool, count=n)
+        pcol = np.fromiter((0 if v is None else int(v) for v in raw), dtype=np.int64, count=n)
+    if p.type == "hash":
+        pidx = pcol % len(p.defs)
+    else:
+        bounds = np.array(
+            [d.less_than if d.less_than is not None else 2**62 for d in p.defs], dtype=np.int64
+        )
+        pidx = np.searchsorted(bounds, pcol, side="right")
+        if int(pidx.max(initial=0)) >= len(p.defs):
+            bad = int(pcol[pidx >= len(p.defs)][0])
+            from tidb_tpu.catalog.catalog import CatalogError
+
+            raise CatalogError(f"Table has no partition for value {bad}")
+    pidx = np.where(null_mask, 0, pidx)  # NULL routes to the first partition
+
+    if t.pk_is_handle:
+        handles = np.ascontiguousarray(np.asarray(phys_cols[t.pk_offset], dtype=np.int64))
+    else:
+        base = db.catalog.alloc_autoid(t.id, n)
+        handles = np.arange(base, base + n, dtype=np.int64)
+
+    from tidb_tpu.executor.write import index_entry
+    from tidb_tpu.native import lib as native_lib
+    from tidb_tpu.native.bulk import encode_rows, split_encoded
+
+    has_index = any(idx.state != "delete_only" for idx in t.indexes)
+    for k, d in enumerate(p.defs):
+        sel = np.nonzero(pidx == k)[0]
+        if len(sel) == 0:
+            continue
+        view = t.partition_view(d.id)
+        sub_cols = [
+            c[sel] if isinstance(c, np.ndarray) else [c[int(i)] for i in sel] for c in phys_cols
+        ]
+        sub_handles = handles[sel]
+        if native_lib() is not None and not has_index:
+            enc = encode_rows(view, sub_cols, sub_handles)
+            if enc is not None:
+                pairs = list(split_encoded(*enc))
+                db.store.ingest([kk for kk, _ in pairs], [v for _, v in pairs])
+                continue
+        txn = db.store.begin()
+        for j, h in enumerate(sub_handles):
+            vals = [sub_cols[c][j] for c in range(len(t.columns))]
+            txn.put(tablecodec.record_key(view.id, int(h)), encode_row(schema, vals))
+            for idx in t.indexes:
+                if idx.state == "delete_only":
+                    continue
+                ik, iv = index_entry(view, idx, vals, int(h))
+                txn.put(ik, iv)
+        txn.commit()
+    if t.pk_is_handle and n:
+        db.catalog.rebase_autoid(t.id, int(handles.max()) + 1)
+    return n

@@ -224,21 +224,28 @@ def execute_insert(session, stmt: ast.Insert) -> int:
                 session.catalog.rebase_autoid(t.id, handle + 1)
         else:
             handle = session.catalog.alloc_autoid(t.id)
-        affected += _write_row(session, t, full, handle, on_dup)
+        # partitioned tables: route the row to its partition's physical id
+        # (ref: table/tables partitionedTable.AddRecord locating the
+        # partition before the write)
+        wt = t.partition_view(t.partition_id_for(full)) if t.partition is not None else t
+        affected += _write_row(session, wt, full, handle, on_dup)
     return affected
 
 
 def _scan_visible_rows(session, t: TableInfo):
-    """All rows visible to the txn (membuffer overlaid) → (handles, rows).
-    The base snapshot follows session.read_ts() so FOR UPDATE current reads
-    apply inside dirty transactions too."""
+    """All rows visible to the txn (membuffer overlaid) → (handles, rows,
+    row_tables). The base snapshot follows session.read_ts() so FOR UPDATE
+    current reads apply inside dirty transactions too. ``row_tables[i]`` is
+    the physical table (partition view) each row lives in."""
     txn = session.txn()
     schema = RowSchema(t.storage_schema)
-    handles, rows = [], []
-    for k, v in txn.scan(tablecodec.record_range(t.id), read_ts=session.read_ts()):
-        handles.append(tablecodec.decode_record_key(k)[1])
-        rows.append(decode_row(schema, v))
-    return handles, rows
+    handles, rows, row_tables = [], [], []
+    for view in t.partition_views():
+        for k, v in txn.scan(tablecodec.record_range(view.id), read_ts=session.read_ts()):
+            handles.append(tablecodec.decode_record_key(k)[1])
+            rows.append(decode_row(schema, v))
+            row_tables.append(view)
+    return handles, rows, row_tables
 
 
 def _rows_to_chunk(session, t: TableInfo, rows: list[list]) -> Chunk:
@@ -282,7 +289,7 @@ def _where_mask(session, t: TableInfo, chunk: Chunk, where, db: str, alias: str)
     return (col.data != 0) & col.validity
 
 
-def _pessimistic_current_read(session, t: TableInfo, handles, rows, chunk, idxs, where, db, alias):
+def _pessimistic_current_read(session, t: TableInfo, handles, rows, chunk, idxs, where, db, alias, row_tables=None):
     """Lock the matched rows, then re-read them at for_update_ts and re-apply
     the WHERE filter — the "current read" that makes pessimistic UPDATE/DELETE
     see the latest committed values instead of the start_ts snapshot
@@ -293,14 +300,17 @@ def _pessimistic_current_read(session, t: TableInfo, handles, rows, chunk, idxs,
         return idxs, rows, chunk
     from tidb_tpu.kv.memstore import Snapshot
 
-    keys = [tablecodec.record_key(t.id, handles[int(i)]) for i in idxs]
+    def _tid(i) -> int:
+        return row_tables[int(i)].id if row_tables is not None else t.id
+
+    keys = [tablecodec.record_key(_tid(i), handles[int(i)]) for i in idxs]
     session.lock_for_write(keys)
     snap = Snapshot(session.store, txn.for_update_ts)
     schema = RowSchema(t.storage_schema)
     changed = False
     live = []
     for i in idxs:
-        rk = tablecodec.record_key(t.id, handles[int(i)])
+        rk = tablecodec.record_key(_tid(i), handles[int(i)])
         if txn.membuf.contains(rk):
             raw = txn.membuf.get(rk)
         else:
@@ -325,7 +335,7 @@ def execute_update(session, stmt: ast.Update) -> int:
     db = stmt.table.db or session.current_db
     t = session.catalog.table(db, stmt.table.name)
     alias = stmt.table.alias or stmt.table.name
-    handles, rows = _scan_visible_rows(session, t)
+    handles, rows, row_tables = _scan_visible_rows(session, t)
     if not rows:
         return 0
     chunk = _rows_to_chunk(session, t, rows)
@@ -341,7 +351,9 @@ def execute_update(session, stmt: ast.Update) -> int:
         idxs = idxs[sort_perm(sub, by)]
     if stmt.limit is not None:
         idxs = idxs[: stmt.limit]
-    idxs, rows, chunk = _pessimistic_current_read(session, t, handles, rows, chunk, idxs, stmt.where, db, alias)
+    idxs, rows, chunk = _pessimistic_current_read(
+        session, t, handles, rows, chunk, idxs, stmt.where, db, alias, row_tables
+    )
 
     # evaluate assignment expressions over the full chunk (row values)
     builder = Builder(session.catalog, db, subquery_runner=session._subquery_runner)
@@ -370,8 +382,10 @@ def execute_update(session, stmt: ast.Update) -> int:
         new_handle = handle
         if t.pk_is_handle and new_vals[t.pk_offset] != old_vals[t.pk_offset]:
             new_handle = int(new_vals[t.pk_offset])
-        _delete_row(session, t, old_vals, handle)
-        _write_row(session, t, new_vals, new_handle)
+        old_t = row_tables[i]
+        new_t = t.partition_view(t.partition_id_for(new_vals)) if t.partition is not None else t
+        _delete_row(session, old_t, old_vals, handle)
+        _write_row(session, new_t, new_vals, new_handle)
         affected += 1
     return affected
 
@@ -380,7 +394,7 @@ def execute_delete(session, stmt: ast.Delete) -> int:
     db = stmt.table.db or session.current_db
     t = session.catalog.table(db, stmt.table.name)
     alias = stmt.table.alias or stmt.table.name
-    handles, rows = _scan_visible_rows(session, t)
+    handles, rows, row_tables = _scan_visible_rows(session, t)
     if not rows:
         return 0
     chunk = _rows_to_chunk(session, t, rows)
@@ -396,7 +410,9 @@ def execute_delete(session, stmt: ast.Delete) -> int:
         idxs = idxs[sort_perm(sub, by)]
     if stmt.limit is not None:
         idxs = idxs[: stmt.limit]
-    idxs, rows, chunk = _pessimistic_current_read(session, t, handles, rows, chunk, idxs, stmt.where, db, alias)
+    idxs, rows, chunk = _pessimistic_current_read(
+        session, t, handles, rows, chunk, idxs, stmt.where, db, alias, row_tables
+    )
     for i in idxs:
-        _delete_row(session, t, rows[int(i)], handles[int(i)])
+        _delete_row(session, row_tables[int(i)], rows[int(i)], handles[int(i)])
     return int(len(idxs))

@@ -85,6 +85,7 @@ def _reader_mpp_ok(reader: PhysTableReader) -> bool:
         and reader.pushed_agg is None
         and reader.pushed_topn is None
         and reader.pushed_limit is None
+        and reader.table.partition is None  # partitioned MPP: later round
         and all(can_push_down(c, "tpu") for c in reader.pushed_conditions)
     )
 
@@ -159,6 +160,7 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None) -> PhysicalPlan:
             and child.pushed_agg is not None
             and child.pushed_topn is None
             and child.pushed_limit is None
+            and child.table.partition is None  # partitioned MPP: later round
             and all(can_push_down(c, "tpu") for c in child.pushed_conditions)
         ):
             # single-table MPP agg (exercised mainly by multi-device runs)

@@ -290,11 +290,22 @@ class IndexDef(Node):
 
 
 @dataclass
+class PartitionByDef(Node):
+    """PARTITION BY RANGE (col) (...) | HASH (col) PARTITIONS n."""
+
+    type: str  # "range" | "hash"
+    column: str
+    defs: list[tuple[str, Optional[int]]] = field(default_factory=list)  # (name, less_than)
+    num: int = 0  # hash partition count
+
+
+@dataclass
 class CreateTable(Node):
     table: TableRef
     columns: list[ColumnDef] = field(default_factory=list)
     indexes: list[IndexDef] = field(default_factory=list)
     if_not_exists: bool = False
+    partition_by: Optional[PartitionByDef] = None
 
 
 @dataclass
@@ -312,10 +323,13 @@ class TruncateTable(Node):
 class AlterTable(Node):
     table: TableRef
     # one action per statement (reference supports lists; keep one)
-    action: str = ""  # add_column/drop_column/add_index/drop_index/rename
+    # actions: add_column/drop_column/add_index/drop_index/rename/
+    #          add_partition/drop_partition/truncate_partition
+    action: str = ""
     column: Optional[ColumnDef] = None
     index: Optional[IndexDef] = None
-    name: str = ""  # drop target or rename target
+    name: str = ""  # drop target, rename target, or partition name
+    less_than: Optional[int] = None  # add_partition bound (None = MAXVALUE)
 
 
 @dataclass

@@ -839,6 +839,29 @@ class Parser:
             if not self.eat_op(","):
                 break
         self.expect_op(")")
+        if self.at_kw("PARTITION"):
+            self.next()
+            self.expect_kw("BY")
+            if self.eat_kw("HASH"):
+                self.expect_op("(")
+                col = self.ident().lower()
+                self.expect_op(")")
+                self.expect_kw("PARTITIONS")
+                ntok = self.next()
+                if ntok.kind != "int" or int(ntok.value) < 1:
+                    raise ParseError("expected partition count", ntok)
+                ct.partition_by = ast.PartitionByDef("hash", col, num=int(ntok.value))
+            else:
+                self.expect_kw("RANGE")
+                self.expect_op("(")
+                col = self.ident().lower()
+                self.expect_op(")")
+                self.expect_op("(")
+                defs = [self._partition_def()]
+                while self.eat_op(","):
+                    defs.append(self._partition_def())
+                self.expect_op(")")
+                ct.partition_by = ast.PartitionByDef("range", col, defs=defs)
         # table options: swallow ident=value pairs
         while self.peek().kind == "ident" and not self.at_op(";"):
             self.next()
@@ -885,7 +908,13 @@ class Parser:
         tbl = self._table_ref_simple()
         at = ast.AlterTable(tbl)
         if self.eat_kw("ADD"):
-            if self.at_kw("INDEX", "KEY", "UNIQUE"):
+            if self.at_kw("PARTITION"):
+                self.next()
+                self.expect_op("(")
+                name, lt = self._partition_def()
+                self.expect_op(")")
+                at.action, at.name, at.less_than = "add_partition", name, lt
+            elif self.at_kw("INDEX", "KEY", "UNIQUE"):
                 unique = self.eat_kw("UNIQUE")
                 if not self.eat_kw("INDEX"):
                     self.eat_kw("KEY")
@@ -908,18 +937,44 @@ class Parser:
                     cd.default = self.parse_expr()
                 at.action, at.column = "add_column", cd
         elif self.eat_kw("DROP"):
-            if self.at_kw("INDEX", "KEY"):
+            if self.at_kw("PARTITION"):
+                self.next()
+                at.action, at.name = "drop_partition", self.ident()
+            elif self.at_kw("INDEX", "KEY"):
                 self.next()
                 at.action, at.name = "drop_index", self.ident()
             else:
                 self.eat_kw("COLUMN")
                 at.action, at.name = "drop_column", self.ident()
+        elif self.eat_kw("TRUNCATE"):
+            self.expect_kw("PARTITION")
+            at.action, at.name = "truncate_partition", self.ident()
         elif self.eat_kw("RENAME"):
             self.eat_kw("TO")
             at.action, at.name = "rename", self.ident()
         else:
             raise ParseError("unsupported ALTER action", self.peek())
         return at
+
+    def _partition_def(self) -> tuple[str, "int | None"]:
+        """PARTITION name VALUES LESS THAN (n) | MAXVALUE"""
+        self.expect_kw("PARTITION")
+        name = self.ident().lower()
+        self.expect_kw("VALUES")
+        self.expect_kw("LESS")
+        self.expect_kw("THAN")
+        if self.eat_kw("MAXVALUE"):
+            return name, None
+        self.expect_op("(")
+        if self.eat_kw("MAXVALUE"):
+            self.expect_op(")")
+            return name, None
+        neg = self.eat_op("-")
+        tok = self.next()
+        if tok.kind != "int":
+            raise ParseError("expected integer partition bound", tok)
+        self.expect_op(")")
+        return name, int(tok.value) * (-1 if neg else 1)
 
     def parse_truncate(self) -> ast.TruncateTable:
         self.expect_kw("TRUNCATE")

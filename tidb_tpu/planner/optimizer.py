@@ -307,6 +307,11 @@ def _choose_index_path(scan: LogicalScan, conds: list[Expression], stats=None):
     point (eq/IN) leading-column conditions — the one reliably-cheaper case.
     PK handle ranges are handled by _derive_ranges on the table-reader path."""
     t = scan.table
+    if t.partition is not None:
+        # partitioned tables read via pruned per-partition table scans;
+        # local-index access paths are a later round (ref: TiDB dynamic
+        # prune mode restricting plans similarly)
+        return None
     tstats = stats.get(t.id) if stats is not None else None
     best = None
     if tstats is not None and tstats.row_count > 0:
@@ -467,6 +472,12 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan
                 r = _derive_ranges(plan.children[0], pushable)
                 if r is not None:
                     child.ranges = r
+                if plan.children[0].table.partition is not None:
+                    from tidb_tpu.planner.partition import prune_partitions
+
+                    child.partitions = prune_partitions(
+                        child.table, plan.children[0].schema, plan.conditions
+                    )
             if host_side:
                 # host-only residue forces the host engine for correctness of
                 # the whole fragment ordering? No — residue evaluates above

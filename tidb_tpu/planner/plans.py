@@ -200,6 +200,9 @@ class PhysTableReader(PhysicalPlan):
     keep_order: bool = False
     schema: Schema = field(default_factory=list)
     children: list = field(default_factory=list)
+    # partitioned tables: pruned partition views to scan (None = all;
+    # ref: rule_partition_processor pruning + PartitionIDAndRanges)
+    partitions: Optional[list] = None
 
 
 @dataclass

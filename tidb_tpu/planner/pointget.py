@@ -69,6 +69,8 @@ def detect_point_get(catalog, current_db: str, stmt: ast.Node) -> Optional[Point
         return None
     if not t.pk_is_handle or t.pk_offset < 0:
         return None
+    if t.partition is not None:
+        return None  # partitioned point lookups take the planner path
     pk_name = t.columns[t.pk_offset].name.lower()
     alias = (stmt.from_.alias or stmt.from_.name).lower()
 
