@@ -1,0 +1,110 @@
+"""Memory tracker, OOM actions, disk spill, query kill
+(ref: util/memory/tracker.go:77, util/chunk/row_container.go, util/sqlkiller)."""
+
+import numpy as np
+import pytest
+
+import tidb_tpu
+from tidb_tpu.utils.chunk import Chunk, Column
+from tidb_tpu.utils.memory import QueryKilledError, QueryOOMError, Tracker, chunk_bytes
+from tidb_tpu.utils.rowcontainer import RowContainer
+from tidb_tpu.types.field_type import bigint_type, string_type
+
+
+def _chunk(n, base=0, dic=None):
+    data = np.arange(base, base + n, dtype=np.int64)
+    cols = [Column(data, np.ones(n, bool), bigint_type())]
+    if dic is not None:
+        codes = np.zeros(n, dtype=np.int32)
+        cols.append(Column(codes, np.ones(n, bool), string_type(10), dic))
+    return Chunk(cols)
+
+
+def test_tracker_quota_and_cancel():
+    root = Tracker("q", limit=1000)
+    child = root.child("op")
+    child.consume(800)
+    assert root.consumed == 800
+    with pytest.raises(QueryOOMError):
+        child.consume(300)
+    child.release(800)
+
+
+def test_tracker_spill_action_prevents_oom():
+    root = Tracker("q", limit=1000)
+    freed = []
+
+    def spill():
+        freed.append(900)
+        root.release(900)
+        return 900
+
+    root.register_spill(spill)
+    root.consume(950)
+    root.consume(100)  # trips quota → spill runs → under limit again
+    assert freed == [900]
+    assert root.consumed == 150
+
+
+def test_row_container_spill_roundtrip():
+    from tidb_tpu.utils.chunk import Dictionary
+
+    dic = Dictionary([b"alpha"])
+    t = Tracker("q", limit=-1)
+    rc = RowContainer(t, "test")
+    rc.add(_chunk(100, 0, dic))
+    rc.add(_chunk(50, 100, dic))
+    assert not rc.spilled
+    freed = rc.spill()
+    assert rc.spilled and freed > 0 and t.consumed == 0
+    rc.add(_chunk(25, 150, dic))  # post-spill adds go straight to disk
+    out = rc.to_chunk()
+    assert len(out) == 175
+    assert out.columns[0].data.tolist() == list(range(175))
+    assert out.columns[1].dictionary is dic  # identity preserved for concat
+    rc.close()
+
+
+def test_query_completes_under_tiny_quota_by_spilling():
+    db = tidb_tpu.open(region_split_keys=2000)  # several regions → many chunks
+    db.execute("CREATE TABLE big (id BIGINT PRIMARY KEY, v BIGINT)")
+    from tidb_tpu.executor.load import bulk_load
+
+    n = 20000
+    bulk_load(db, "big", [np.arange(n, dtype=np.int64), np.arange(n, dtype=np.int64) * 2])
+    s = db.session()
+    s.execute("SET tidb_isolation_read_engines = 'host'")
+    s.execute("SET tidb_mem_quota_query = 4096")  # 4KB — forces gather spill
+    assert s.query("SELECT COUNT(*), SUM(v) FROM big") == [(n, n * (n - 1))]
+    rows = s.query("SELECT v FROM big WHERE id >= 19995 ORDER BY id")
+    assert rows == [(2 * i,) for i in range(19995, 20000)]
+
+
+def test_kill_interrupts_query():
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE t (a BIGINT)")
+    db.execute("INSERT INTO t VALUES (1), (2)")
+    s = db.session()
+    s.execute("SET tidb_isolation_read_engines = 'host'")
+    s.kill()
+    with pytest.raises(QueryKilledError):
+        s.query("SELECT COUNT(*) FROM t")
+    # flag clears after delivery; next query runs
+    assert s.query("SELECT COUNT(*) FROM t") == [(2,)]
+
+
+def test_max_execution_time():
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE t (a BIGINT)")
+    db.execute("INSERT INTO t VALUES (1)")
+    s = db.session()
+    s.execute("SET tidb_isolation_read_engines = 'host'")
+    s.execute("SET max_execution_time = 0.000001")  # already expired
+    with pytest.raises(QueryKilledError):
+        s.query("SELECT COUNT(*) FROM t")
+    s.execute("SET max_execution_time = 0")
+    assert s.query("SELECT COUNT(*) FROM t") == [(1,)]
+
+
+def test_chunk_bytes():
+    assert chunk_bytes(_chunk(100)) == 100 * 8 + 100

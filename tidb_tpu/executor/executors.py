@@ -127,6 +127,7 @@ class TableReaderExec(Executor):
             views = p.partitions if p.partitions is not None else p.table.partition_views()
             chunks = []
             for view in views:
+                self.session.check_killed()
                 cache.set_table_alias(view.id, p.table.id)
                 ch = self._execute_one(view, self._translate_ranges(view))
                 if len(ch):
@@ -200,12 +201,24 @@ class TableReaderExec(Executor):
             keep_order=p.keep_order,
         )
         client = self.session.store.get_client()
-        chunks = [res.chunk for res in client.send(req) if len(res.chunk)]
-        if not chunks:
+        # gather through a spillable container accounted against the query's
+        # memory tracker (ref: copr worker results → memory.Tracker; spill =
+        # chunk_in_disk host-RAM offload), checking the kill flag per task
+        from tidb_tpu.utils.rowcontainer import RowContainer
+
+        rc = RowContainer(getattr(self.session, "mem_tracker", None), "cop-gather")
+        try:
+            for res in client.send(req):
+                self.session.check_killed()
+                rc.add(res.chunk)
+            out = rc.to_chunk()
+        finally:
+            rc.close()
+        if out is None:
             return _empty_chunk(p.schema)
         # string columns may carry per-region-identical dictionaries (table-
         # level, shared) — concat requires the same object, which holds here
-        return Chunk.concat(chunks) if len(chunks) > 1 else chunks[0]
+        return out
 
     def _union_scan(self, dag, ranges, t=None) -> Chunk:
         from tidb_tpu.copr.host_engine import run_operators

@@ -39,6 +39,11 @@ DEFAULT_SYSVARS = {
     # MPP gating (ref: tidb_vars.go:399 tidb_allow_mpp, :415 tidb_enforce_mpp)
     "tidb_allow_mpp": 1,
     "tidb_enforce_mpp": 0,
+    # per-query memory quota in bytes (ref: tidb_mem_quota_query, 1GB default)
+    "tidb_mem_quota_query": 1 << 30,
+    # CANCEL kills the query on quota excess after spill actions run
+    # (ref: tidb_mem_oom_action)
+    "tidb_mem_oom_action": "CANCEL",
     # session plan cache capacity (ref: tidb_prepared_plan_cache_size)
     "tidb_prepared_plan_cache_size": 100,
     # 1 when the previous statement's plan came from the plan cache
@@ -95,6 +100,11 @@ class Session:
         self._pending_mods: dict[int, int] = {}
         # EXPLAIN ANALYZE per-operator stats (ref: util/execdetails)
         self.runtime_stats = None
+        # per-statement memory tracker + kill flag (ref: memory.Tracker root
+        # at the session, sqlkiller checked at executor boundaries)
+        self.mem_tracker = None
+        self._killed = False
+        self._deadline: Optional[float] = None
         # user variables (@x) and prepared statements (session-scoped)
         self.user_vars: dict[str, Any] = {}
         self.prepared: dict[str, PreparedStmt] = {}
@@ -158,6 +168,22 @@ class Session:
             else:
                 t.rollback()
         self._pending_mods.clear()
+
+    def kill(self) -> None:
+        """Cross-thread query cancel (ref: util/sqlkiller)."""
+        self._killed = True
+
+    def check_killed(self) -> None:
+        """Called at executor boundaries (chunk/task granularity)."""
+        import time
+
+        from tidb_tpu.utils.memory import QueryKilledError
+
+        if self._killed:
+            self._killed = False
+            raise QueryKilledError("Query execution was interrupted")
+        if self._deadline is not None and time.monotonic() > self._deadline:
+            raise QueryKilledError("Query execution was interrupted, maximum statement execution time exceeded")
 
     # -- entry points --------------------------------------------------------
     def execute(self, sql: str) -> Result:
@@ -333,6 +359,13 @@ class Session:
             if self._explicit and self._txn is not None and self._txn.pessimistic:
                 # locking read returns latest committed values (current read)
                 self._read_ts_override = self._txn.for_update_ts
+        import time
+
+        from tidb_tpu.utils.memory import Tracker
+
+        self.mem_tracker = Tracker("query", int(self.vars.get("tidb_mem_quota_query", 1 << 30)))
+        met = float(self.vars.get("max_execution_time", 0) or 0)
+        self._deadline = (time.monotonic() + met / 1000.0) if met > 0 else None
         try:
             plan = self._plan_select(stmt, cache_key=cache_key)
             from tidb_tpu.executor import build_executor
@@ -341,6 +374,8 @@ class Session:
             chunk = ex.execute()
         finally:
             self._read_ts_override = None
+            self._deadline = None
+            self.mem_tracker = None
         names = [oc.name for oc in plan.schema]
         return Result(columns=names, rows=chunk.rows())
 
