@@ -254,6 +254,26 @@ class Catalog:
             self._persist()
             return t
 
+    def register_restored_table(self, db: str, old: TableInfo) -> TableInfo:
+        """RESTORE path: adopt a backed-up table's schema under fresh physical
+        ids (ref: BR rewriting table ids on restore)."""
+        import dataclasses
+
+        with self._mu:
+            dbi = self.db(db)
+            if old.name in dbi.tables:
+                raise CatalogError(f"Table {old.name!r} already exists")
+            t = dataclasses.replace(old, id=self._next_table_id())
+            if t.partition is not None:
+                t.partition = PartitionInfo(
+                    t.partition.type,
+                    t.partition.col_offset,
+                    [PartitionDef(self._next_table_id(), d.name, d.less_than) for d in t.partition.defs],
+                )
+            dbi.tables[t.name] = t
+            self._persist()
+            return t
+
     def _drop_table_data(self, t: TableInfo) -> None:
         from tidb_tpu.copr.colcache import cache_for
 

@@ -375,6 +375,33 @@ class SetVariable(Node):
 
 
 @dataclass
+class ImportInto(Node):
+    """IMPORT INTO t FROM 'file.csv' [WITH opt=val, ...] (ref:
+    disttask/importinto SQL surface)."""
+
+    table: TableRef
+    path: str
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
+class Backup(Node):
+    """BACKUP DATABASE db | TABLE t[, t2] TO 'dest' (ref: executor/brie.go)."""
+
+    dest: str
+    db: str = ""
+    tables: list[TableRef] = field(default_factory=list)
+
+
+@dataclass
+class Restore(Node):
+    """RESTORE DATABASE [db] FROM 'src' (ref: executor/brie.go)."""
+
+    src: str
+    db: str = ""
+
+
+@dataclass
 class Prepare(Node):
     """PREPARE name FROM 'text' | @var (ref: ast.PrepareStmt)."""
 

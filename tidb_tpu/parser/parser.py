@@ -111,6 +111,9 @@ class Parser:
             "PREPARE": self.parse_prepare,
             "EXECUTE": self.parse_execute_stmt,
             "DEALLOCATE": self.parse_deallocate,
+            "IMPORT": self.parse_import,
+            "BACKUP": self.parse_backup,
+            "RESTORE": self.parse_restore,
         }.get(kw)
         if fn is None:
             raise ParseError("unsupported statement", t)
@@ -1011,6 +1014,55 @@ class Parser:
             self.expect_op(":=")
         val = self.parse_expr()
         return ast.SetVariable(name.lower(), val, scope=scope)
+
+    def _string_lit(self) -> str:
+        t = self.next()
+        if t.kind != "str":
+            raise ParseError("expected string literal", t)
+        return t.value.decode() if isinstance(t.value, bytes) else t.value
+
+    def parse_import(self) -> ast.ImportInto:
+        self.expect_kw("IMPORT")
+        self.expect_kw("INTO")
+        tbl = self._table_ref_simple()
+        self.expect_kw("FROM")
+        path = self._string_lit()
+        opts: dict = {}
+        if self.eat_kw("WITH"):
+            while True:
+                name = self.ident().lower()
+                if self.eat_op("="):
+                    v = self.next()
+                    val = v.value.decode() if isinstance(v.value, bytes) else v.value
+                else:
+                    val = 1
+                opts[name] = val
+                if not self.eat_op(","):
+                    break
+        return ast.ImportInto(tbl, path, opts)
+
+    def parse_backup(self) -> ast.Backup:
+        self.expect_kw("BACKUP")
+        db = ""
+        tables: list = []
+        if self.eat_kw("DATABASE"):
+            db = self.ident().lower()
+        else:
+            self.expect_kw("TABLE")
+            tables = [self._table_ref_simple()]
+            while self.eat_op(","):
+                tables.append(self._table_ref_simple())
+        self.expect_kw("TO")
+        return ast.Backup(self._string_lit(), db=db, tables=tables)
+
+    def parse_restore(self) -> ast.Restore:
+        self.expect_kw("RESTORE")
+        self.expect_kw("DATABASE")
+        db = ""
+        if not self.at_kw("FROM"):
+            db = self.ident().lower()
+        self.expect_kw("FROM")
+        return ast.Restore(self._string_lit(), db=db)
 
     def parse_prepare(self) -> ast.Prepare:
         self.expect_kw("PREPARE")

@@ -271,6 +271,35 @@ class Session:
             return self._explain(stmt)
         if isinstance(stmt, ast.AnalyzeTable):
             return self._analyze(stmt)
+        if isinstance(stmt, ast.ImportInto):
+            from tidb_tpu.tools.importer import import_into
+
+            n = import_into(
+                self._db,
+                stmt.table.db or self.current_db,
+                stmt.table.name,
+                stmt.path,
+                skip_header=(bool(int(stmt.options["skip_header"])) if "skip_header" in stmt.options else None),
+                delimiter=str(stmt.options.get("delimiter", ",")),
+            )
+            t = self.catalog.table(stmt.table.db or self.current_db, stmt.table.name)
+            self._db.stats.note_mods(t.id, n)  # feeds auto-analyze directly
+            return Result(affected=n)
+        if isinstance(stmt, ast.Backup):
+            from tidb_tpu.tools.brie import backup_database
+
+            if stmt.tables:
+                db_name = stmt.tables[0].db or self.current_db
+                meta = backup_database(self._db, db_name, stmt.dest, [tr.name for tr in stmt.tables])
+            else:
+                meta = backup_database(self._db, stmt.db or self.current_db, stmt.dest)
+            rows = [(stmt.dest, name, tm["rows"]) for name, tm in meta["tables"].items()]
+            return Result(columns=["Destination", "Table", "Rows"], rows=rows)
+        if isinstance(stmt, ast.Restore):
+            from tidb_tpu.tools.brie import restore_database
+
+            out = restore_database(self._db, stmt.src, stmt.db or None)
+            return Result(columns=["Table", "Rows"], rows=sorted(out.items()))
         if isinstance(stmt, ast.Prepare):
             return self._prepare(stmt)
         if isinstance(stmt, ast.ExecutePrepared):

@@ -1,0 +1,54 @@
+"""IMPORT INTO — CSV bulk import (ref: pkg/lightning mydump parsing +
+disttask/importinto SQL surface). Parses CSV host-side, converts to physical
+columns, and loads through the native SST-style ingest (executor/load)."""
+
+from __future__ import annotations
+
+import csv as _csv
+
+from tidb_tpu.types import TypeKind
+
+
+def import_into(db, db_name: str, table_name: str, path: str, *, skip_header: bool | None = None, delimiter: str = ",") -> int:
+    """Load a CSV file into a table; returns rows imported. ``skip_header``
+    defaults to auto-detect (header row = any field that fails numeric
+    conversion for a numeric column but matches the column's name)."""
+    t = db.catalog.table(db_name, table_name)
+    ncols = len(t.columns)
+    with open(path, newline="") as f:
+        reader = _csv.reader(f, delimiter=delimiter)
+        rows = [r for r in reader if r]
+    if not rows:
+        return 0
+    if skip_header is None:
+        first = [x.strip().lower() for x in rows[0]]
+        skip_header = first == [c.name.lower() for c in t.columns]
+    if skip_header:
+        rows = rows[1:]
+
+    cols: list[list] = [[] for _ in range(ncols)]
+    for r in rows:
+        if len(r) != ncols:
+            raise ValueError(f"row has {len(r)} fields, table has {ncols} columns")
+        for c, field in enumerate(r):
+            ft = t.columns[c].ftype
+            if field == "\\N" or (field == "" and ft.kind not in (TypeKind.STRING,)):
+                cols[c].append(None)
+                continue
+            cols[c].append(_convert(field, ft))
+
+    from tidb_tpu.executor.load import bulk_load
+
+    return bulk_load(db, table_name, cols, db_name=db_name)
+
+
+def _convert(s: str, ft):
+    k = ft.kind
+    if k in (TypeKind.STRING,):
+        return s.encode()
+    if k == TypeKind.FLOAT:
+        return float(s)
+    if k in (TypeKind.INT, TypeKind.UINT):
+        return int(s)
+    # decimals / dates / datetimes: bulk_load's to_physical path parses them
+    return s
