@@ -1,0 +1,112 @@
+"""MySQL wire-protocol server: handshake, COM_QUERY text resultsets, NULLs,
+errors, USE/COM_INIT_DB, concurrent connections, processlist + KILL
+(ref: pkg/server conn.go dispatch + tests/globalkilltest)."""
+
+import threading
+import time
+
+import pytest
+
+import tidb_tpu
+from tidb_tpu.server import Client, Server
+from tidb_tpu.server.client import MySQLError
+
+
+@pytest.fixture()
+def srv():
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, s VARCHAR(20), f DOUBLE, d DATE)")
+    db.execute("INSERT INTO t VALUES (1, 'hello', 1.5, '2024-03-04'), (2, NULL, NULL, NULL)")
+    server = Server(db)
+    port = server.start()
+    yield server, port
+    server.close()
+
+
+def test_query_roundtrip(srv):
+    _, port = srv
+    c = Client(port=port)
+    assert c.ping()
+    rows = c.query("SELECT id, s, f, d FROM t ORDER BY id")
+    assert rows == [("1", "hello", "1.5", "2024-03-04"), ("2", None, None, None)]
+    assert c.columns == ["id", "s", "f", "d"]
+    assert c.query("INSERT INTO t VALUES (3, 'x', 0.25, '2020-01-01')") == 1
+    assert c.query("SELECT COUNT(*) FROM t") == [("3",)]
+    c.close()
+
+
+def test_error_and_use(srv):
+    _, port = srv
+    c = Client(port=port)
+    with pytest.raises(MySQLError):
+        c.query("SELECT * FROM nonexistent")
+    with pytest.raises(MySQLError):
+        c.use("nodb")
+    c.query("CREATE DATABASE other")
+    c.use("other")
+    c.query("CREATE TABLE o (a BIGINT)")
+    c.query("INSERT INTO o VALUES (7)")
+    assert c.query("SELECT a FROM o") == [("7",)]
+    c.close()
+
+
+def test_connect_with_db(srv):
+    _, port = srv
+    c = Client(port=port, db="test")
+    assert c.query("SELECT id FROM t WHERE id = 1") == [("1",)]
+    c.close()
+
+
+def test_concurrent_connections_and_txn_isolation(srv):
+    _, port = srv
+    c1 = Client(port=port)
+    c2 = Client(port=port)
+    c1.query("BEGIN")
+    c1.query("INSERT INTO t VALUES (10, 'staged', 0.0, NULL)")
+    assert c1.query("SELECT COUNT(*) FROM t") == [("3",)]
+    assert c2.query("SELECT COUNT(*) FROM t") == [("2",)]  # uncommitted invisible
+    c1.query("COMMIT")
+    assert c2.query("SELECT COUNT(*) FROM t") == [("3",)]
+    c1.close()
+    c2.close()
+
+
+def test_processlist_and_kill(srv):
+    server, port = srv
+    c1 = Client(port=port)
+    c2 = Client(port=port)
+    rows = c1.query("SHOW PROCESSLIST")
+    ids = {r[0] for r in rows}
+    assert len(rows) >= 2
+    # find c2's id: it is the one not running the SHOW
+    my_id = next(r[0] for r in rows if "PROCESSLIST" in (r[4] or ""))
+    other = next(i for i in ids if i != my_id)
+    assert c1.query(f"KILL QUERY {other}") == 0
+    # killed flag delivers on c2's next statement
+    with pytest.raises(MySQLError):
+        c2.query("SELECT COUNT(*) FROM t")
+    # and clears afterward
+    assert c2.query("SELECT COUNT(*) FROM t") == [("2",)]
+    c1.close()
+    c2.close()
+
+
+def test_many_threads(srv):
+    _, port = srv
+    errs = []
+
+    def worker(i):
+        try:
+            c = Client(port=port)
+            for _ in range(5):
+                assert c.query("SELECT COUNT(*) FROM t") == [("2",)]
+            c.close()
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errs

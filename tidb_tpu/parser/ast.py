@@ -448,6 +448,14 @@ class Rollback(Node):
 
 
 @dataclass
+class Kill(Node):
+    """KILL [QUERY|CONNECTION] conn_id (ref: ast.KillStmt)."""
+
+    conn_id: int
+    query_only: bool = True
+
+
+@dataclass
 class AnalyzeTable(Node):
     tables: list[TableRef] = field(default_factory=list)
 

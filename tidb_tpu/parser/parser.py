@@ -114,6 +114,7 @@ class Parser:
             "IMPORT": self.parse_import,
             "BACKUP": self.parse_backup,
             "RESTORE": self.parse_restore,
+            "KILL": self.parse_kill,
         }.get(kw)
         if fn is None:
             raise ParseError("unsupported statement", t)
@@ -1064,6 +1065,18 @@ class Parser:
         self.expect_kw("FROM")
         return ast.Restore(self._string_lit(), db=db)
 
+    def parse_kill(self) -> ast.Kill:
+        self.expect_kw("KILL")
+        query_only = True
+        if self.eat_kw("CONNECTION"):
+            query_only = False
+        else:
+            self.eat_kw("QUERY")
+        t = self.next()
+        if t.kind != "int":
+            raise ParseError("expected connection id", t)
+        return ast.Kill(int(t.value), query_only)
+
     def parse_prepare(self) -> ast.Prepare:
         self.expect_kw("PREPARE")
         name = self.ident().lower()
@@ -1104,6 +1117,10 @@ class Parser:
             return ast.Show("tables", like=like)
         if self.eat_kw("DATABASES"):
             return ast.Show("databases")
+        if self.eat_kw("PROCESSLIST"):
+            return ast.Show("processlist")
+        if self.eat_kw("FULL") and self.eat_kw("PROCESSLIST"):
+            return ast.Show("processlist")
         if self.eat_kw("VARIABLES"):
             like = None
             if self.eat_kw("LIKE"):

@@ -1,0 +1,7 @@
+"""MySQL wire-protocol server (ref: pkg/server — conn.go clientConn.Run,
+the text protocol subset: handshake v10, COM_QUERY/INIT_DB/PING/QUIT)."""
+
+from tidb_tpu.server.server import Server
+from tidb_tpu.server.client import Client
+
+__all__ = ["Server", "Client"]

@@ -1,0 +1,121 @@
+"""Minimal MySQL text-protocol client (the benchdb/test driver analog and
+the in-repo stand-in for mysql-client/pymysql in hermetic tests)."""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Optional
+
+from tidb_tpu.server import protocol as p
+
+
+class MySQLError(Exception):
+    def __init__(self, code: int, msg: str):
+        super().__init__(f"({code}) {msg}")
+        self.code = code
+
+
+class Client:
+    def __init__(self, host: str = "127.0.0.1", port: int = 4000, user: str = "root", password: str = "", db: str = ""):
+        self.sock = socket.create_connection((host, port), timeout=30)
+        self.io = p.PacketIO(self.sock)
+        self._handshake(user, password, db)
+
+    def _handshake(self, user: str, password: str, db: str) -> None:
+        greeting = self.io.read()
+        assert greeting[0] == 10, "unexpected protocol version"
+        caps = p.CLIENT_PROTOCOL_41 | p.CLIENT_SECURE_CONNECTION | p.CLIENT_PLUGIN_AUTH
+        if db:
+            caps |= p.CLIENT_CONNECT_WITH_DB
+        resp = (
+            struct.pack("<IIB", caps, 1 << 24, 33)
+            + b"\x00" * 23
+            + user.encode() + b"\x00"
+            + bytes([0])  # empty auth response (server trusts local)
+            + ((db.encode() + b"\x00") if db else b"")
+            + b"mysql_native_password\x00"
+        )
+        self.io.write(resp)
+        pkt = self.io.read()
+        if pkt[0] == 0xFF:
+            raise self._err(pkt)
+
+    def _err(self, pkt: bytes) -> MySQLError:
+        code = struct.unpack_from("<H", pkt, 1)[0]
+        off = 3
+        if pkt[off : off + 1] == b"#":
+            off += 6
+        return MySQLError(code, pkt[off:].decode("utf-8", "replace"))
+
+    def query(self, sql: str):
+        """→ list of tuples of str|None (text protocol), or affected count."""
+        self.io.reset_seq()
+        self.io.write(bytes([p.COM_QUERY]) + sql.encode("utf-8"))
+        pkt = self.io.read()
+        if pkt[0] == 0xFF:
+            raise self._err(pkt)
+        if pkt[0] == 0x00:  # OK
+            affected, off = p.read_lenc_int(pkt, 1)
+            return affected
+        ncols, _ = p.read_lenc_int(pkt, 0)
+        cols = []
+        for _ in range(ncols):
+            cols.append(self._parse_coldef(self.io.read()))
+        self._expect_eof()
+        rows = []
+        while True:
+            pkt = self.io.read()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            if pkt[0] == 0xFF:
+                raise self._err(pkt)
+            rows.append(self._parse_row(pkt, ncols))
+        self.columns = cols
+        return rows
+
+    def _parse_coldef(self, pkt: bytes) -> str:
+        off = 0
+        vals = []
+        for _ in range(6):  # catalog, schema, table, org_table, name, org_name
+            ln, off = p.read_lenc_int(pkt, off)
+            vals.append(pkt[off : off + ln])
+            off += ln
+        return vals[4].decode()
+
+    def _parse_row(self, pkt: bytes, ncols: int) -> tuple:
+        off = 0
+        out = []
+        for _ in range(ncols):
+            if pkt[off] == 0xFB:
+                out.append(None)
+                off += 1
+            else:
+                ln, off = p.read_lenc_int(pkt, off)
+                out.append(pkt[off : off + ln].decode("utf-8", "replace"))
+                off += ln
+        return tuple(out)
+
+    def _expect_eof(self) -> None:
+        pkt = self.io.read()
+        assert pkt[0] == 0xFE, "expected EOF packet"
+
+    def ping(self) -> bool:
+        self.io.reset_seq()
+        self.io.write(bytes([p.COM_PING]))
+        return self.io.read()[0] == 0x00
+
+    def use(self, db: str) -> None:
+        self.io.reset_seq()
+        self.io.write(bytes([p.COM_INIT_DB]) + db.encode())
+        pkt = self.io.read()
+        if pkt[0] == 0xFF:
+            raise self._err(pkt)
+
+    def close(self) -> None:
+        try:
+            self.io.reset_seq()
+            self.io.write(bytes([0x01]))  # COM_QUIT
+        except OSError:
+            pass
+        self.sock.close()

@@ -1,0 +1,168 @@
+"""MySQL client/server protocol encoding primitives (ref: pkg/server/packetio
++ the MySQL protocol text-resultset layout that conn.go writeResultSet emits).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+# capability flags (the subset we speak)
+CLIENT_LONG_PASSWORD = 0x1
+CLIENT_PROTOCOL_41 = 0x200
+CLIENT_CONNECT_WITH_DB = 0x8
+CLIENT_SECURE_CONNECTION = 0x8000
+CLIENT_PLUGIN_AUTH = 0x80000
+CLIENT_DEPRECATE_EOF = 0x1000000
+
+SERVER_CAPS = (
+    CLIENT_LONG_PASSWORD
+    | CLIENT_PROTOCOL_41
+    | CLIENT_CONNECT_WITH_DB
+    | CLIENT_SECURE_CONNECTION
+    | CLIENT_PLUGIN_AUTH
+)
+
+# command bytes
+COM_QUIT = 0x01
+COM_INIT_DB = 0x02
+COM_QUERY = 0x03
+COM_PING = 0x0E
+
+# column type codes (protocol::ColumnType)
+T_DOUBLE = 5
+T_LONGLONG = 8
+T_DATE = 10
+T_TIME = 11
+T_DATETIME = 12
+T_VAR_STRING = 253
+T_NEWDECIMAL = 246
+T_JSON = 245
+
+
+def lenc_int(n: int) -> bytes:
+    if n < 251:
+        return bytes([n])
+    if n < 1 << 16:
+        return b"\xfc" + struct.pack("<H", n)
+    if n < 1 << 24:
+        return b"\xfd" + struct.pack("<I", n)[:3]
+    return b"\xfe" + struct.pack("<Q", n)
+
+
+def lenc_str(b: bytes) -> bytes:
+    return lenc_int(len(b)) + b
+
+
+def read_lenc_int(buf: bytes, off: int) -> tuple[int, int]:
+    first = buf[off]
+    if first < 251:
+        return first, off + 1
+    if first == 0xFC:
+        return struct.unpack_from("<H", buf, off + 1)[0], off + 3
+    if first == 0xFD:
+        return struct.unpack("<I", buf[off + 1 : off + 4] + b"\x00")[0], off + 4
+    return struct.unpack_from("<Q", buf, off + 1)[0], off + 9
+
+
+class PacketIO:
+    """3-byte length + 1-byte sequence framing over a socket."""
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.seq = 0
+
+    def read(self) -> bytes:
+        hdr = self._recvn(4)
+        ln = hdr[0] | (hdr[1] << 8) | (hdr[2] << 16)
+        self.seq = (hdr[3] + 1) & 0xFF
+        return self._recvn(ln)
+
+    def write(self, payload: bytes) -> None:
+        out = bytearray()
+        off = 0
+        while True:
+            part = payload[off : off + 0xFFFFFF]
+            out += struct.pack("<I", len(part))[:3] + bytes([self.seq])
+            out += part
+            self.seq = (self.seq + 1) & 0xFF
+            off += len(part)
+            if off >= len(payload) and len(part) != 0xFFFFFF:
+                break
+        self.sock.sendall(bytes(out))
+
+    def reset_seq(self) -> None:
+        self.seq = 0
+
+    def _recvn(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            part = self.sock.recv(n - len(buf))
+            if not part:
+                raise ConnectionError("connection closed")
+            buf += part
+        return buf
+
+
+def ok_packet(affected: int = 0, last_insert_id: int = 0, status: int = 2, info: bytes = b"") -> bytes:
+    return b"\x00" + lenc_int(affected) + lenc_int(last_insert_id) + struct.pack("<HH", status, 0) + info
+
+
+def err_packet(code: int, msg: str, sqlstate: str = "HY000") -> bytes:
+    return b"\xff" + struct.pack("<H", code) + b"#" + sqlstate.encode() + msg.encode("utf-8")
+
+
+def eof_packet(status: int = 2) -> bytes:
+    return b"\xfe" + struct.pack("<HH", 0, status)
+
+
+def column_def(name: str, col_type: int, col_len: int = 255, decimals: int = 0, charset: int = 33) -> bytes:
+    """Column definition 41 (ref: writeColumnInfo)."""
+
+    def ls(s: bytes) -> bytes:
+        return lenc_str(s)
+
+    nm = name.encode("utf-8")
+    return (
+        ls(b"def") + ls(b"") + ls(b"") + ls(b"") + ls(nm) + ls(nm)
+        + b"\x0c" + struct.pack("<HIBHB", charset, col_len, col_type, 0, decimals) + b"\x00\x00"
+    )
+
+
+def type_for(ft) -> tuple[int, int, int]:
+    """FieldType → (protocol type, display length, decimals)."""
+    from tidb_tpu.types import TypeKind
+
+    k = ft.kind
+    if k in (TypeKind.INT, TypeKind.UINT):
+        return T_LONGLONG, 20, 0
+    if k == TypeKind.FLOAT:
+        return T_DOUBLE, 22, 31
+    if k == TypeKind.DECIMAL:
+        return T_NEWDECIMAL, ft.length + 2, ft.scale
+    if k == TypeKind.DATE:
+        return T_DATE, 10, 0
+    if k == TypeKind.DATETIME:
+        return T_DATETIME, 26, 0
+    if k == TypeKind.DURATION:
+        return T_TIME, 10, 0
+    if k == TypeKind.JSON:
+        return T_JSON, 1 << 16, 0
+    return T_VAR_STRING, max(ft.length, 0) or 255, 0
+
+
+def text_value(v) -> Optional[bytes]:
+    """Python value → text-protocol bytes (None = SQL NULL)."""
+    if v is None:
+        return None
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, bool):
+        return b"1" if v else b"0"
+    if isinstance(v, float):
+        return repr(v).encode()
+    if hasattr(v, "isoformat"):
+        if hasattr(v, "hour") and hasattr(v, "year"):
+            return v.isoformat(sep=" ").encode()
+        return v.isoformat().encode()
+    return str(v).encode("utf-8")

@@ -1,0 +1,226 @@
+"""The TCP server (ref: pkg/server/server.go accept loop + conn.go:1045
+clientConn.Run): one thread per connection, each owning a Session; a
+connection registry backs SHOW PROCESSLIST and cross-connection KILL
+(ref: util/globalconn + server.Kill)."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+from tidb_tpu.server import protocol as p
+
+
+class ClientConn:
+    def __init__(self, server: "Server", sock, conn_id: int):
+        self.server = server
+        self.sock = sock
+        self.conn_id = conn_id
+        self.session = server.db.session()
+        self.session.conn_id = conn_id
+        self.user = ""
+        self.current_sql: Optional[str] = None
+        self.connected_at = time.time()
+
+    # -- handshake (protocol v10) ------------------------------------------
+    def handshake(self, io: p.PacketIO) -> bool:
+        salt = b"01234567" + b"890123456789"  # fixed salt: auth is open (see auth note)
+        pkt = (
+            bytes([10])
+            + b"8.0.11-tidb-tpu\x00"
+            + struct.pack("<I", self.conn_id)
+            + salt[:8]
+            + b"\x00"
+            + struct.pack("<H", p.SERVER_CAPS & 0xFFFF)
+            + bytes([33])  # utf8_general_ci
+            + struct.pack("<H", 2)  # status: autocommit
+            + struct.pack("<H", (p.SERVER_CAPS >> 16) & 0xFFFF)
+            + bytes([21])
+            + b"\x00" * 10
+            + salt[8:] + b"\x00"
+            + b"mysql_native_password\x00"
+        )
+        io.write(pkt)
+        resp = io.read()
+        caps = struct.unpack_from("<I", resp, 0)[0]
+        off = 4 + 4 + 1 + 23
+        end = resp.index(b"\x00", off)
+        self.user = resp[off:end].decode()
+        off = end + 1
+        # auth response (skipped: embedded server trusts local connections,
+        # like the reference's skip-grant mode; real auth = privilege round)
+        if caps & p.CLIENT_SECURE_CONNECTION:
+            alen = resp[off]
+            off += 1 + alen
+        else:
+            off = resp.index(b"\x00", off) + 1
+        if caps & p.CLIENT_CONNECT_WITH_DB and off < len(resp):
+            end = resp.index(b"\x00", off)
+            dbname = resp[off:end].decode()
+            if dbname:
+                try:
+                    self.session.catalog.db(dbname)
+                    self.session.current_db = dbname.lower()
+                except Exception:
+                    io.write(p.err_packet(1049, f"Unknown database '{dbname}'", "42000"))
+                    return False
+        io.write(p.ok_packet())
+        return True
+
+    # -- command loop -------------------------------------------------------
+    def run(self) -> None:
+        io = p.PacketIO(self.sock)
+        try:
+            if not self.handshake(io):
+                return
+            while True:
+                io.reset_seq()
+                try:
+                    pkt = io.read()
+                except (ConnectionError, OSError):
+                    return
+                if not pkt:
+                    continue
+                cmd, data = pkt[0], pkt[1:]
+                if cmd == p.COM_QUIT:
+                    return
+                if cmd == p.COM_PING:
+                    io.write(p.ok_packet())
+                elif cmd == p.COM_INIT_DB:
+                    self._run_sql(io, f"USE `{data.decode()}`")
+                elif cmd == p.COM_QUERY:
+                    self._run_sql(io, data.decode("utf-8"))
+                else:
+                    io.write(p.err_packet(1047, f"Unknown command {cmd}", "08S01"))
+        finally:
+            self.server._deregister(self.conn_id)
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+    def _run_sql(self, io: p.PacketIO, sql: str) -> None:
+        self.current_sql = sql
+        try:
+            res = self.session.execute(sql)
+        except Exception as e:
+            io.write(p.err_packet(1105, str(e)))
+            return
+        finally:
+            self.current_sql = None
+        if not res.columns:
+            io.write(p.ok_packet(affected=res.affected, last_insert_id=res.last_insert_id))
+            return
+        out = [p.lenc_int(len(res.columns))]
+        ftypes = getattr(res, "ftypes", None)
+        for i, name in enumerate(res.columns):
+            if ftypes is not None and i < len(ftypes) and ftypes[i] is not None:
+                tc, ln, dec = p.type_for(ftypes[i])
+            else:
+                tc, ln, dec = p.T_VAR_STRING, 255, 0
+            out.append(p.column_def(str(name), tc, ln, dec))
+        out.append(p.eof_packet())
+        for row in res.rows:
+            rb = bytearray()
+            for v in row:
+                tv = p.text_value(v)
+                rb += b"\xfb" if tv is None else p.lenc_str(tv)
+            out.append(bytes(rb))
+        out.append(p.eof_packet())
+        for pkt in out:
+            io.write(pkt)
+
+
+class Server:
+    """server.NewServer + Run analog. ``Server(db).start()`` returns the
+    bound port; connections are thread-per-conn like the reference's
+    goroutine-per-conn."""
+
+    def __init__(self, db, host: str = "127.0.0.1", port: int = 0):
+        self.db = db
+        self.host = host
+        self.port = port
+        self._lsock: Optional[socket.socket] = None
+        self._conns: dict[int, ClientConn] = {}
+        self._next_id = 1
+        self._mu = threading.Lock()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._stopping = False
+        db.server = self  # processlist/kill hook for sessions
+
+    def start(self) -> int:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.host, self.port))
+        s.listen(64)
+        self.port = s.getsockname()[1]
+        self._lsock = s
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+        return self.port
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                sock, _ = self._lsock.accept()
+            except OSError:
+                return
+            with self._mu:
+                cid = self._next_id
+                self._next_id += 1
+                conn = ClientConn(self, sock, cid)
+                self._conns[cid] = conn
+            threading.Thread(target=conn.run, daemon=True).start()
+
+    def _deregister(self, conn_id: int) -> None:
+        with self._mu:
+            self._conns.pop(conn_id, None)
+
+    # -- processlist / kill (ref: SHOW PROCESSLIST + conn.Kill) -------------
+    def processlist(self) -> list[tuple]:
+        with self._mu:
+            conns = list(self._conns.values())
+        out = []
+        for c in conns:
+            sql = c.current_sql
+            out.append(
+                (
+                    c.conn_id,
+                    c.user or "root",
+                    c.session.current_db,
+                    "Query" if sql else "Sleep",
+                    (sql or "")[:100],
+                )
+            )
+        return out
+
+    def kill(self, conn_id: int, query_only: bool = True) -> bool:
+        with self._mu:
+            conn = self._conns.get(conn_id)
+        if conn is None:
+            return False
+        conn.session.kill()
+        if not query_only:
+            try:
+                conn.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        return True
+
+    def close(self) -> None:
+        self._stopping = True
+        if self._lsock is not None:
+            try:
+                self._lsock.close()
+            except OSError:
+                pass
+        with self._mu:
+            conns = list(self._conns.values())
+        for c in conns:
+            try:
+                c.sock.close()
+            except OSError:
+                pass

@@ -68,6 +68,8 @@ class Result:
     rows: list[tuple] = field(default_factory=list)
     affected: int = 0
     last_insert_id: int = 0
+    # column FieldTypes when known (wire protocol column definitions)
+    ftypes: Optional[list] = None
 
     def scalar(self):
         return self.rows[0][0] if self.rows else None
@@ -271,6 +273,11 @@ class Session:
             return self._explain(stmt)
         if isinstance(stmt, ast.AnalyzeTable):
             return self._analyze(stmt)
+        if isinstance(stmt, ast.Kill):
+            server = getattr(self._db, "server", None)
+            if server is None or not server.kill(stmt.conn_id, stmt.query_only):
+                raise SessionError(f"Unknown thread id: {stmt.conn_id}")
+            return Result()
         if isinstance(stmt, ast.ImportInto):
             from tidb_tpu.tools.importer import import_into
 
@@ -406,7 +413,7 @@ class Session:
             self._deadline = None
             self.mem_tracker = None
         names = [oc.name for oc in plan.schema]
-        return Result(columns=names, rows=chunk.rows())
+        return Result(columns=names, rows=chunk.rows(), ftypes=[oc.ftype for oc in plan.schema])
 
     def _lock_select_rows(self, stmt: ast.Select) -> None:
         """SELECT ... FOR UPDATE: pessimistically lock the matched rows'
@@ -536,6 +543,10 @@ class Session:
     def _show(self, stmt: ast.Show) -> Result:
         if stmt.kind in ("stats_histograms", "stats_topn", "stats_buckets"):
             return self._show_stats(stmt.kind)
+        if stmt.kind == "processlist":
+            server = getattr(self._db, "server", None)
+            rows = server.processlist() if server is not None else []
+            return Result(columns=["Id", "User", "db", "Command", "Info"], rows=rows)
         if stmt.kind == "tables":
             rows = [(t,) for t in self.catalog.tables(self.current_db)]
             if stmt.like:
