@@ -1,0 +1,128 @@
+"""Privileges + information_schema: grant tables, auth, enforcement at
+planner/DML/DDL boundaries, SHOW GRANTS, infoschema memtables
+(ref: pkg/privilege/privileges/cache.go, pkg/infoschema/tables.go)."""
+
+import pytest
+
+import tidb_tpu
+from tidb_tpu.privilege.privileges import PrivilegeError
+from tidb_tpu.server import Client, Server
+from tidb_tpu.server.client import MySQLError
+
+
+@pytest.fixture()
+def db():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+    d.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+    return d
+
+
+def test_information_schema(db):
+    s = db.session()
+    rows = s.query("SELECT table_schema, table_name FROM information_schema.tables WHERE table_schema = 'test'")
+    assert ("test", "t") in rows
+    cols = s.query(
+        "SELECT column_name, data_type, column_key FROM information_schema.columns "
+        "WHERE table_schema = 'test' AND table_name = 't' ORDER BY ordinal_position"
+    )
+    assert cols == [("id", "bigint", "PRI"), ("v", "bigint", "")]
+    assert ("def", "test", "utf8mb4", "utf8mb4_bin") in s.query("SELECT * FROM information_schema.schemata")
+    engines = dict((r[0], r[1]) for r in s.query("SELECT engine, support FROM information_schema.engines"))
+    assert engines["tpu"] == "DEFAULT"
+    # USE information_schema
+    s.execute("USE information_schema")
+    assert ("test", "t") in s.query("SELECT table_schema, table_name FROM tables")
+
+
+def test_information_schema_partitions(db):
+    db.execute(
+        "CREATE TABLE p (a BIGINT, b BIGINT) PARTITION BY RANGE (a) "
+        "(PARTITION p0 VALUES LESS THAN (10), PARTITION p1 VALUES LESS THAN MAXVALUE)"
+    )
+    s = db.session()
+    rows = s.query(
+        "SELECT partition_name, partition_method, partition_description FROM information_schema.partitions "
+        "WHERE table_name = 'p' ORDER BY partition_ordinal_position"
+    )
+    assert rows == [("p0", "RANGE", "10"), ("p1", "RANGE", "MAXVALUE")]
+
+
+def test_create_user_grant_enforcement(db):
+    db.execute("CREATE USER 'alice'@'%' IDENTIFIED BY 'pw1'")
+    s = db.session()
+    s.user, s.host = "alice", "127.0.0.1"
+    with pytest.raises(PrivilegeError):
+        s.query("SELECT * FROM t")
+    with pytest.raises(PrivilegeError):
+        s.execute("INSERT INTO t VALUES (3, 30)")
+    with pytest.raises(PrivilegeError):
+        s.execute("CREATE TABLE t2 (a BIGINT)")
+
+    db.execute("GRANT SELECT ON test.t TO 'alice'@'%'")
+    assert s.query("SELECT v FROM t WHERE id = 1") == [(10,)]
+    with pytest.raises(PrivilegeError):
+        s.execute("INSERT INTO t VALUES (3, 30)")
+
+    db.execute("GRANT INSERT, UPDATE ON test.* TO 'alice'@'%'")
+    s.execute("INSERT INTO t VALUES (3, 30)")
+    s.execute("UPDATE t SET v = 31 WHERE id = 3")
+    with pytest.raises(PrivilegeError):
+        s.execute("DELETE FROM t WHERE id = 3")
+
+    db.execute("REVOKE INSERT ON test.* FROM 'alice'@'%'")
+    with pytest.raises(PrivilegeError):
+        s.execute("INSERT INTO t VALUES (4, 40)")
+
+    # global grant
+    db.execute("GRANT ALL ON *.* TO 'alice'@'%'")
+    s.execute("DELETE FROM t WHERE id = 3")
+    s.execute("CREATE TABLE t2 (a BIGINT)")
+
+
+def test_show_grants_and_drop_user(db):
+    db.execute("CREATE USER 'bob'@'%'")
+    db.execute("GRANT SELECT ON test.* TO 'bob'@'%'")
+    rows = db.query("SHOW GRANTS FOR 'bob'@'%'")
+    text = "\n".join(r[0] for r in rows)
+    assert "GRANT USAGE ON *.*" in text and "GRANT SELECT ON test.*" in text
+    db.execute("DROP USER 'bob'@'%'")
+    assert db.query("SELECT 1 FROM mysql.user WHERE User = 'bob'") == []
+    with pytest.raises(Exception):
+        db.execute("DROP USER 'bob'@'%'")
+    db.execute("DROP USER IF EXISTS 'bob'@'%'")
+
+
+def test_duplicate_create_user(db):
+    db.execute("CREATE USER 'carol'@'%'")
+    with pytest.raises(Exception):
+        db.execute("CREATE USER 'carol'@'%'")
+    db.execute("CREATE USER IF NOT EXISTS 'carol'@'%'")
+
+
+def test_wire_auth(db):
+    db.execute("CREATE USER 'dave'@'%' IDENTIFIED BY 'secret'")
+    db.execute("GRANT SELECT ON test.* TO 'dave'@'%'")
+    server = Server(db)
+    port = server.start()
+    try:
+        # correct password
+        c = Client(port=port, user="dave", password="secret")
+        assert c.query("SELECT v FROM t WHERE id = 2") == [("20",)]
+        # privilege enforcement over the wire
+        with pytest.raises(MySQLError):
+            c.query("INSERT INTO t VALUES (9, 90)")
+        c.close()
+        # wrong password
+        with pytest.raises(MySQLError) as ei:
+            Client(port=port, user="dave", password="wrong")
+        assert ei.value.code == 1045
+        # unknown user
+        with pytest.raises(MySQLError):
+            Client(port=port, user="nobody", password="")
+        # root with empty password still fine
+        c = Client(port=port)
+        assert c.query("SELECT COUNT(*) FROM t") == [("2",)]
+        c.close()
+    finally:
+        server.close()

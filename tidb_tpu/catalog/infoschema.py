@@ -1,0 +1,140 @@
+"""information_schema memtables (ref: pkg/infoschema/tables.go +
+perfschema): virtual tables materialized from catalog/runtime state at query
+time, fed to the planner as memtable sources (the reference's
+createInfoSchemaTable → memtableRetriever path)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tidb_tpu.types.field_type import bigint_type, string_type
+
+_S = lambda n=64: string_type(n)  # noqa: E731
+_I = bigint_type
+
+
+def memtable_rows(db, session, name: str) -> Optional[tuple[list, list, list]]:
+    """→ (column names, ftypes, rows) for information_schema.<name>."""
+    fn = {
+        "schemata": _schemata,
+        "tables": _tables,
+        "columns": _columns,
+        "statistics": _statistics,
+        "partitions": _partitions,
+        "processlist": _processlist,
+        "session_variables": _variables,
+        "engines": _engines,
+    }.get(name)
+    if fn is None:
+        return None
+    return fn(db, session)
+
+
+def _schemata(db, session):
+    cols = ["CATALOG_NAME", "SCHEMA_NAME", "DEFAULT_CHARACTER_SET_NAME", "DEFAULT_COLLATION_NAME"]
+    rows = [("def", d, "utf8mb4", "utf8mb4_bin") for d in sorted(db.catalog.databases())]
+    rows.append(("def", "information_schema", "utf8mb4", "utf8mb4_bin"))
+    return cols, [_S()] * 4, rows
+
+
+def _iter_tables(db):
+    for dname in sorted(db.catalog.databases()):
+        for tname in sorted(db.catalog.tables(dname)):
+            yield dname, db.catalog.table(dname, tname)
+
+
+def _tables(db, session):
+    cols = ["TABLE_CATALOG", "TABLE_SCHEMA", "TABLE_NAME", "TABLE_TYPE", "ENGINE", "TABLE_ROWS", "TIDB_TABLE_ID", "CREATE_OPTIONS"]
+    fts = [_S(), _S(), _S(), _S(), _S(), _I(), _I(), _S()]
+    rows = []
+    for dname, t in _iter_tables(db):
+        st = db.stats.get(t.id)
+        nrows = st.row_count if st is not None else 0
+        opts = "partitioned" if t.partition is not None else ""
+        rows.append(("def", dname, t.name, "BASE TABLE", "tpu", nrows, t.id, opts))
+    return cols, fts, rows
+
+
+def _columns(db, session):
+    from tidb_tpu.tools.dumpling import _sql_type
+
+    cols = ["TABLE_SCHEMA", "TABLE_NAME", "COLUMN_NAME", "ORDINAL_POSITION", "COLUMN_DEFAULT", "IS_NULLABLE", "DATA_TYPE", "COLUMN_TYPE", "COLUMN_KEY"]
+    fts = [_S(), _S(), _S(), _I(), _S(), _S(3), _S(), _S(), _S(3)]
+    rows = []
+    for dname, t in _iter_tables(db):
+        for c in t.columns:
+            key = "PRI" if (t.pk_is_handle and c.offset == t.pk_offset) else ""
+            full = _sql_type(c.ftype)
+            rows.append(
+                (
+                    dname,
+                    t.name,
+                    c.name,
+                    c.offset + 1,
+                    None if c.default is None else str(c.default),
+                    "YES" if c.ftype.nullable else "NO",
+                    full.split("(")[0].lower(),
+                    full.lower(),
+                    key,
+                )
+            )
+    return cols, fts, rows
+
+
+def _statistics(db, session):
+    cols = ["TABLE_SCHEMA", "TABLE_NAME", "NON_UNIQUE", "INDEX_NAME", "SEQ_IN_INDEX", "COLUMN_NAME"]
+    fts = [_S(), _S(), _I(), _S(), _I(), _S()]
+    rows = []
+    for dname, t in _iter_tables(db):
+        if t.pk_is_handle:
+            rows.append((dname, t.name, 0, "PRIMARY", 1, t.columns[t.pk_offset].name))
+        for idx in t.indexes:
+            if idx.state != "public":
+                continue
+            for seq, off in enumerate(idx.column_offsets):
+                rows.append((dname, t.name, 0 if idx.unique else 1, idx.name, seq + 1, t.columns[off].name))
+    return cols, fts, rows
+
+
+def _partitions(db, session):
+    cols = ["TABLE_SCHEMA", "TABLE_NAME", "PARTITION_NAME", "PARTITION_ORDINAL_POSITION", "PARTITION_METHOD", "PARTITION_EXPRESSION", "PARTITION_DESCRIPTION", "TIDB_PARTITION_ID"]
+    fts = [_S(), _S(), _S(), _I(), _S(), _S(), _S(), _I()]
+    rows = []
+    for dname, t in _iter_tables(db):
+        if t.partition is None:
+            rows.append((dname, t.name, None, None, None, None, None, t.id))
+            continue
+        p = t.partition
+        col = t.columns[p.col_offset].name
+        for i, d in enumerate(p.defs):
+            desc = None
+            if p.type == "range":
+                desc = "MAXVALUE" if d.less_than is None else str(d.less_than)
+            rows.append((dname, t.name, d.name, i + 1, p.type.upper(), f"`{col}`", desc, d.id))
+    return cols, fts, rows
+
+
+def _processlist(db, session):
+    cols = ["ID", "USER", "HOST", "DB", "COMMAND", "TIME", "STATE", "INFO"]
+    fts = [_I(), _S(), _S(), _S(), _S(), _I(), _S(), _S(256)]
+    server = getattr(db, "server", None)
+    rows = []
+    if server is not None:
+        for cid, user, dbn, cmd, info in server.processlist():
+            rows.append((cid, user, "127.0.0.1", dbn, cmd, 0, "", info))
+    return cols, fts, rows
+
+
+def _variables(db, session):
+    cols = ["VARIABLE_NAME", "VARIABLE_VALUE"]
+    rows = sorted((k, str(v)) for k, v in session.vars.items())
+    return cols, [_S(), _S(256)], rows
+
+
+def _engines(db, session):
+    cols = ["ENGINE", "SUPPORT", "COMMENT"]
+    rows = [
+        ("tpu", "DEFAULT", "XLA columnar coprocessor engine over the TPU mesh"),
+        ("host", "YES", "NumPy reference coprocessor engine"),
+    ]
+    return cols, [_S(), _S(), _S(256)], rows

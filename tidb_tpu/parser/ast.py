@@ -448,6 +448,38 @@ class Rollback(Node):
 
 
 @dataclass
+class UserSpec(Node):
+    name: str
+    host: str = "%"
+    password: str = ""
+
+
+@dataclass
+class CreateUser(Node):
+    users: list[UserSpec] = field(default_factory=list)
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropUser(Node):
+    users: list[UserSpec] = field(default_factory=list)
+    if_exists: bool = False
+
+
+@dataclass
+class Grant(Node):
+    """GRANT privs ON level TO user (ref: ast.GrantStmt). REVOKE shares the
+    shape via ``revoke=True``."""
+
+    privs: list[str] = field(default_factory=list)  # lowercase; ["all"] = all
+    db: str = ""  # "" = *.* (global)
+    table: str = ""  # "" = db.* (db level)
+    user: str = ""
+    host: str = "%"
+    revoke: bool = False
+
+
+@dataclass
 class Kill(Node):
     """KILL [QUERY|CONNECTION] conn_id (ref: ast.KillStmt)."""
 

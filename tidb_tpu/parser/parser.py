@@ -115,6 +115,8 @@ class Parser:
             "BACKUP": self.parse_backup,
             "RESTORE": self.parse_restore,
             "KILL": self.parse_kill,
+            "GRANT": self.parse_grant,
+            "REVOKE": self.parse_grant,
         }.get(kw)
         if fn is None:
             raise ParseError("unsupported statement", t)
@@ -772,6 +774,8 @@ class Parser:
 
     def parse_create(self) -> ast.Node:
         self.expect_kw("CREATE")
+        if self.eat_kw("USER"):
+            return self.parse_create_user()
         if self.at_kw("DATABASE", "SCHEMA"):
             self.next()
             ine = self._if_not_exists()
@@ -883,6 +887,12 @@ class Parser:
 
     def parse_drop(self) -> ast.Node:
         self.expect_kw("DROP")
+        if self.eat_kw("USER"):
+            ie = self._if_exists()
+            users = [self._user_spec()]
+            while self.eat_op(","):
+                users.append(self._user_spec())
+            return ast.DropUser(users, ie)
         if self.at_kw("DATABASE", "SCHEMA"):
             self.next()
             ie = self._if_exists()
@@ -1065,6 +1075,72 @@ class Parser:
         self.expect_kw("FROM")
         return ast.Restore(self._string_lit(), db=db)
 
+    def _user_spec(self) -> ast.UserSpec:
+        t = self.peek()
+        if t.kind == "str":
+            self.next()
+            name = t.value.decode() if isinstance(t.value, bytes) else t.value
+        else:
+            name = self.ident()
+        host = "%"
+        if self.at_op("@"):
+            self.next()
+            h = self.peek()
+            if h.kind == "str":
+                self.next()
+                host = h.value.decode() if isinstance(h.value, bytes) else h.value
+            else:
+                host = self.ident()
+        spec = ast.UserSpec(name, host)
+        if self.eat_kw("IDENTIFIED"):
+            self.expect_kw("BY")
+            spec.password = self._string_lit()
+        return spec
+
+    def parse_create_user(self) -> ast.CreateUser:
+        # caller consumed CREATE USER
+        ine = self._if_not_exists()
+        users = [self._user_spec()]
+        while self.eat_op(","):
+            users.append(self._user_spec())
+        return ast.CreateUser(users, ine)
+
+    _PRIV_KWS = ("SELECT", "INSERT", "UPDATE", "DELETE", "CREATE", "DROP", "INDEX", "ALTER", "SUPER")
+
+    def parse_grant(self) -> ast.Grant:
+        revoke = bool(self.eat_kw("REVOKE"))
+        if not revoke:
+            self.expect_kw("GRANT")
+        privs: list[str] = []
+        if self.eat_kw("ALL"):
+            self.eat_kw("PRIVILEGES")
+            privs = ["all"]
+        else:
+            while True:
+                kw = self.next()
+                if kw.value.upper() not in self._PRIV_KWS:
+                    raise ParseError(f"unknown privilege {kw.value!r}", kw)
+                privs.append(kw.value.lower())
+                if not self.eat_op(","):
+                    break
+        self.expect_kw("ON")
+        db = table = ""
+        if self.eat_op("*"):
+            self.expect_op(".")
+            self.expect_op("*")
+        else:
+            first = self.ident()
+            if self.eat_op("."):
+                if self.eat_op("*"):
+                    db = first.lower()
+                else:
+                    db, table = first.lower(), self.ident().lower()
+            else:
+                table = first.lower()  # bare table → current db at exec
+        self.expect_kw("FROM" if revoke else "TO")
+        spec = self._user_spec()
+        return ast.Grant(privs, db, table, spec.name, spec.host, revoke)
+
     def parse_kill(self) -> ast.Kill:
         self.expect_kw("KILL")
         query_only = True
@@ -1119,6 +1195,12 @@ class Parser:
             return ast.Show("databases")
         if self.eat_kw("PROCESSLIST"):
             return ast.Show("processlist")
+        if self.eat_kw("GRANTS"):
+            target = ""
+            if self.eat_kw("FOR"):
+                spec = self._user_spec()
+                target = f"{spec.name}@{spec.host}"
+            return ast.Show("grants", target=target)
         if self.eat_kw("FULL") and self.eat_kw("PROCESSLIST"):
             return ast.Show("processlist")
         if self.eat_kw("VARIABLES"):

@@ -117,6 +117,8 @@ class Builder:
         user_vars: Optional[dict] = None,
         sys_vars: Optional[dict] = None,
         global_vars: Optional[dict] = None,
+        memtable_provider: Optional[Callable] = None,
+        scan_checker: Optional[Callable] = None,
     ):
         self.catalog = catalog
         self.db = current_db
@@ -124,6 +126,8 @@ class Builder:
         self.user_vars = user_vars
         self.sys_vars = sys_vars
         self.global_vars = global_vars if global_vars is not None else sys_vars
+        self.memtable_provider = memtable_provider
+        self.scan_checker = scan_checker  # privilege hook per scanned table
         # set when the built plan bakes in plan-time state (subquery results,
         # variable reads) and must not enter the plan cache
         self.uncacheable = False
@@ -602,7 +606,23 @@ class Builder:
     def _build_from(self, node: ast.Node) -> LogicalPlan:
         if isinstance(node, ast.TableRef):
             db = node.db or self.db
+            if db.lower() == "information_schema" and self.memtable_provider is not None:
+                mem = self.memtable_provider(node.name.lower())
+                if mem is None:
+                    raise PlanError(f"Unknown table 'information_schema.{node.name}'")
+                names, ftypes, rows = mem
+                self.uncacheable = True  # memtables snapshot runtime state
+                from tidb_tpu.planner.plans import LogicalMemSource
+
+                alias = node.alias or node.name
+                ms = LogicalMemSource(
+                    rows=rows,
+                    schema=[OutCol(nm, ft, table=alias) for nm, ft in zip(names, ftypes)],
+                )
+                return ms
             t = self.catalog.table(db, node.name)
+            if self.scan_checker is not None:
+                self.scan_checker(db, node.name)
             alias = node.alias or node.name
             scan = LogicalScan(db=db, table=t, alias=alias)
             scan.schema = [

@@ -1,0 +1,20 @@
+"""Privilege subsystem (ref: pkg/privilege — MySQLPrivilege cache over the
+mysql.* grant tables, privileges/cache.go:87)."""
+
+from tidb_tpu.privilege.privileges import (
+    ALL_PRIVS,
+    PrivChecker,
+    bootstrap_priv_tables,
+    encode_password,
+    native_auth_token,
+    verify_native_password,
+)
+
+__all__ = [
+    "ALL_PRIVS",
+    "PrivChecker",
+    "bootstrap_priv_tables",
+    "encode_password",
+    "native_auth_token",
+    "verify_native_password",
+]

@@ -25,6 +25,14 @@ class Client:
     def _handshake(self, user: str, password: str, db: str) -> None:
         greeting = self.io.read()
         assert greeting[0] == 10, "unexpected protocol version"
+        # salt = 8 bytes after ver+thread_id, then 12 more past the caps block
+        off = 1 + greeting.index(b"\x00", 1) + 4
+        salt1 = greeting[off : off + 8]
+        off2 = off + 8 + 1 + 2 + 1 + 2 + 2 + 1 + 10
+        salt2 = greeting[off2 : off2 + 12]
+        from tidb_tpu.privilege import native_auth_token
+
+        token = native_auth_token(password, salt1 + salt2)
         caps = p.CLIENT_PROTOCOL_41 | p.CLIENT_SECURE_CONNECTION | p.CLIENT_PLUGIN_AUTH
         if db:
             caps |= p.CLIENT_CONNECT_WITH_DB
@@ -32,7 +40,7 @@ class Client:
             struct.pack("<IIB", caps, 1 << 24, 33)
             + b"\x00" * 23
             + user.encode() + b"\x00"
-            + bytes([0])  # empty auth response (server trusts local)
+            + bytes([len(token)]) + token
             + ((db.encode() + b"\x00") if db else b"")
             + b"mysql_native_password\x00"
         )

@@ -27,7 +27,9 @@ class ClientConn:
 
     # -- handshake (protocol v10) ------------------------------------------
     def handshake(self, io: p.PacketIO) -> bool:
-        salt = b"01234567" + b"890123456789"  # fixed salt: auth is open (see auth note)
+        import os as _os
+
+        salt = _os.urandom(20)
         pkt = (
             bytes([10])
             + b"8.0.11-tidb-tpu\x00"
@@ -50,13 +52,24 @@ class ClientConn:
         end = resp.index(b"\x00", off)
         self.user = resp[off:end].decode()
         off = end + 1
-        # auth response (skipped: embedded server trusts local connections,
-        # like the reference's skip-grant mode; real auth = privilege round)
         if caps & p.CLIENT_SECURE_CONNECTION:
             alen = resp[off]
+            token = resp[off + 1 : off + 1 + alen]
             off += 1 + alen
         else:
-            off = resp.index(b"\x00", off) + 1
+            end = resp.index(b"\x00", off)
+            token = resp[off:end]
+            off = end + 1
+        # mysql_native_password verification against mysql.user
+        # (ref: privilege.ConnectionVerification)
+        checker = self.server.db.priv_checker
+        if not checker.auth(self.user, "127.0.0.1", token, salt):
+            io.write(
+                p.err_packet(1045, f"Access denied for user '{self.user}'@'127.0.0.1'", "28000")
+            )
+            return False
+        self.session.user = self.user
+        self.session.host = "127.0.0.1"
         if caps & p.CLIENT_CONNECT_WITH_DB and off < len(resp):
             end = resp.index(b"\x00", off)
             dbname = resp[off:end].decode()

@@ -94,6 +94,10 @@ class Session:
         self.catalog: Catalog = db.catalog
         self.vars: dict[str, Any] = dict(DEFAULT_SYSVARS)
         self.current_db = "test"
+        # identity for privilege checks (root@% bypasses, like the
+        # reference's embedded/bootstrap sessions before grant data exists)
+        self.user = "root"
+        self.host = "%"
         self._txn: Optional[Txn] = None
         self._explicit = False
         # current-read override: FOR UPDATE reads at for_update_ts
@@ -220,22 +224,28 @@ class Session:
                 ast.Update: write.execute_update,
                 ast.Delete: write.execute_delete,
             }[type(stmt)]
+            priv = {ast.Insert: "insert", ast.Update: "update", ast.Delete: "delete"}[type(stmt)]
+            self.require_priv(stmt.table.db or self.current_db, stmt.table.name, priv)
             t = self.catalog.table(stmt.table.db or self.current_db, stmt.table.name)
             res = self._dml(lambda: fn(self, stmt))
             # stats modify counter feeds auto-analyze (ref: stats delta dump)
             self.note_table_mods(t.id, res.affected)
             return res
         if isinstance(stmt, ast.CreateTable):
+            self.require_priv(stmt.table.db or self.current_db, stmt.table.name, "create")
             self.catalog.create_table(stmt.table.db or self.current_db, stmt)
             return Result()
         if isinstance(stmt, ast.DropTable):
             for tr in stmt.tables:
+                self.require_priv(tr.db or self.current_db, tr.name, "drop")
                 self.catalog.drop_table(tr.db or self.current_db, tr.name, if_exists=stmt.if_exists)
             return Result()
         if isinstance(stmt, ast.TruncateTable):
+            self.require_priv(stmt.table.db or self.current_db, stmt.table.name, "drop")
             self.catalog.truncate_table(stmt.table.db or self.current_db, stmt.table.name)
             return Result()
         if isinstance(stmt, ast.AlterTable):
+            self.require_priv(stmt.table.db or self.current_db, stmt.table.name, "alter")
             self.catalog.alter_table(stmt.table.db or self.current_db, stmt)
             return Result()
         if isinstance(stmt, ast.CreateIndex):
@@ -253,7 +263,8 @@ class Session:
             self.catalog.drop_database(stmt.name, stmt.if_exists)
             return Result()
         if isinstance(stmt, ast.UseDatabase):
-            self.catalog.db(stmt.name)  # raises if unknown
+            if stmt.name.lower() != "information_schema":
+                self.catalog.db(stmt.name)  # raises if unknown
             self.current_db = stmt.name.lower()
             return Result()
         if isinstance(stmt, ast.SetVariable):
@@ -273,6 +284,12 @@ class Session:
             return self._explain(stmt)
         if isinstance(stmt, ast.AnalyzeTable):
             return self._analyze(stmt)
+        if isinstance(stmt, ast.CreateUser):
+            return self._create_user(stmt)
+        if isinstance(stmt, ast.DropUser):
+            return self._drop_user(stmt)
+        if isinstance(stmt, ast.Grant):
+            return self._grant(stmt)
         if isinstance(stmt, ast.Kill):
             server = getattr(self._db, "server", None)
             if server is None or not server.kill(stmt.conn_id, stmt.query_only):
@@ -317,6 +334,98 @@ class Session:
             del self.prepared[stmt.name]
             return Result()
         raise SessionError(f"unsupported statement {type(stmt).__name__}")
+
+    # -- privileges (ref: executor/grant.go, revoke.go, simple.go users) -----
+    def require_priv(self, db: str, table: str, priv: str) -> None:
+        if self.user == "root":
+            return  # embedded/bootstrap superuser fast path
+        self._db.priv_checker.require(self.user, self.host, db, table, priv)
+
+    def _internal_root(self) -> "Session":
+        s = self._db.session()
+        s.user, s.host = "root", "%"
+        return s
+
+    def _create_user(self, stmt: ast.CreateUser) -> Result:
+        from tidb_tpu.privilege import ALL_PRIVS, encode_password
+
+        self.require_priv("mysql", "user", "insert")
+        self._db.ensure_priv_bootstrap()
+        s = self._internal_root()
+        for u in stmt.users:
+            exists = s.query(
+                f"SELECT 1 FROM mysql.user WHERE User = '{u.name}' AND Host = '{u.host}'"
+            )
+            if exists:
+                if stmt.if_not_exists:
+                    continue
+                raise SessionError(f"Operation CREATE USER failed for '{u.name}'@'{u.host}'")
+            ns = ", ".join(["'N'"] * len(ALL_PRIVS))
+            s.execute(
+                f"INSERT INTO mysql.user VALUES ('{u.host}', '{u.name}', '{encode_password(u.password)}', {ns})"
+            )
+        self._db.priv_version += 1
+        return Result()
+
+    def _drop_user(self, stmt: ast.DropUser) -> Result:
+        self.require_priv("mysql", "user", "delete")
+        self._db.ensure_priv_bootstrap()
+        s = self._internal_root()
+        for u in stmt.users:
+            n = s.execute(
+                f"DELETE FROM mysql.user WHERE User = '{u.name}' AND Host = '{u.host}'"
+            ).affected
+            if not n and not stmt.if_exists:
+                raise SessionError(f"Operation DROP USER failed for '{u.name}'@'{u.host}'")
+            s.execute(f"DELETE FROM mysql.db WHERE User = '{u.name}' AND Host = '{u.host}'")
+            s.execute(f"DELETE FROM mysql.tables_priv WHERE User = '{u.name}' AND Host = '{u.host}'")
+        self._db.priv_version += 1
+        return Result()
+
+    def _grant(self, stmt: ast.Grant) -> Result:
+        from tidb_tpu.privilege import ALL_PRIVS
+
+        self.require_priv("mysql", "user", "update")
+        self._db.ensure_priv_bootstrap()
+        privs = [p for p in ALL_PRIVS if p != "super"] if stmt.privs == ["all"] else stmt.privs
+        s = self._internal_root()
+        if not s.query(f"SELECT 1 FROM mysql.user WHERE User = '{stmt.user}' AND Host = '{stmt.host}'"):
+            raise SessionError(f"unknown user '{stmt.user}'@'{stmt.host}'")
+        val = "'N'" if stmt.revoke else "'Y'"
+        db = stmt.db or (self.current_db if stmt.table else "")
+        if not db and not stmt.table:
+            # global level → mysql.user flags
+            sets = ", ".join(f"{p.capitalize()}_priv = {val}" for p in privs)
+            s.execute(f"UPDATE mysql.user SET {sets} WHERE User = '{stmt.user}' AND Host = '{stmt.host}'")
+        elif not stmt.table:
+            # db level → mysql.db row upsert
+            if not s.query(f"SELECT 1 FROM mysql.db WHERE User = '{stmt.user}' AND Host = '{stmt.host}' AND DB = '{db}'"):
+                ns = ", ".join(["'N'"] * len(ALL_PRIVS))
+                s.execute(f"INSERT INTO mysql.db VALUES ('{stmt.host}', '{db}', '{stmt.user}', {ns})")
+            sets = ", ".join(f"{p.capitalize()}_priv = {val}" for p in privs)
+            s.execute(
+                f"UPDATE mysql.db SET {sets} WHERE User = '{stmt.user}' AND Host = '{stmt.host}' AND DB = '{db}'"
+            )
+        else:
+            # table level → mysql.tables_priv SET-string merge
+            cur = s.query(
+                f"SELECT Table_priv FROM mysql.tables_priv WHERE User = '{stmt.user}' AND Host = '{stmt.host}' AND DB = '{db}' AND Table_name = '{stmt.table}'"
+            )
+            have = set()
+            if cur:
+                have = {p.strip().lower() for p in (cur[0][0] or "").split(",") if p.strip()}
+            have = have - set(privs) if stmt.revoke else have | set(privs)
+            ps = ",".join(sorted(p.capitalize() for p in have))
+            if cur:
+                s.execute(
+                    f"UPDATE mysql.tables_priv SET Table_priv = '{ps}' WHERE User = '{stmt.user}' AND Host = '{stmt.host}' AND DB = '{db}' AND Table_name = '{stmt.table}'"
+                )
+            else:
+                s.execute(
+                    f"INSERT INTO mysql.tables_priv VALUES ('{stmt.host}', '{db}', '{stmt.user}', '{stmt.table}', '{ps}')"
+                )
+        self._db.priv_version += 1
+        return Result()
 
     # -- prepared statements (ref: executor/prepared.go) ---------------------
     def _prepare(self, stmt: ast.Prepare) -> Result:
@@ -379,6 +488,7 @@ class Session:
 
         pg = detect_point_get(self.catalog, self.current_db, stmt)
         if pg is not None:
+            self.require_priv(pg.db, pg.table.name, "select")
             self.vars["last_plan_from_cache"] = 0
             return Result(columns=pg.out_names, rows=run_point_get(self, pg))
         if getattr(stmt, "ctes", None):
@@ -490,6 +600,8 @@ class Session:
             user_vars=self.user_vars,
             sys_vars=self.vars,
             global_vars=self._db.global_vars,
+            memtable_provider=self._memtable_provider,
+            scan_checker=lambda db, tbl: self.require_priv(db, tbl, "select"),
         )
         logical = builder.build_query(stmt)
         engines = [e.strip() for e in str(self.vars["tidb_isolation_read_engines"]).split(",") if e.strip()]
@@ -509,6 +621,11 @@ class Session:
 
     def _subquery_runner(self, sel) -> list[tuple]:
         return self._run_select_ast(sel)
+
+    def _memtable_provider(self, name: str):
+        from tidb_tpu.catalog.infoschema import memtable_rows
+
+        return memtable_rows(self._db, self, name)
 
     def _cte_runner(self, sel):
         """Plan+run one CTE part; returns (rows, schema) for the fixpoint
@@ -543,6 +660,13 @@ class Session:
     def _show(self, stmt: ast.Show) -> Result:
         if stmt.kind in ("stats_histograms", "stats_topn", "stats_buckets"):
             return self._show_stats(stmt.kind)
+        if stmt.kind == "grants":
+            if stmt.target:
+                user, _, host = stmt.target.partition("@")
+            else:
+                user, host = self.user, self.host
+            rows = [(g,) for g in self._db.priv_checker.grants_for(user, host)]
+            return Result(columns=[f"Grants for {user}@{host}"], rows=rows)
         if stmt.kind == "processlist":
             server = getattr(self._db, "server", None)
             rows = server.processlist() if server is not None else []
@@ -672,6 +796,24 @@ class DB:
 
         self.gc_worker = GCWorker(self.store)
         self.stats = StatsHandle()
+        # privilege state: grant tables bootstrap lazily (first auth/grant);
+        # the cache keys on priv_version (ref: privilege reload notification)
+        self.priv_version = 0
+        self._priv_checker = None
+
+    def ensure_priv_bootstrap(self) -> None:
+        from tidb_tpu.privilege import bootstrap_priv_tables
+
+        bootstrap_priv_tables(self)
+
+    @property
+    def priv_checker(self):
+        if self._priv_checker is None:
+            from tidb_tpu.privilege import PrivChecker
+
+            self.ensure_priv_bootstrap()
+            self._priv_checker = PrivChecker(self)
+        return self._priv_checker
 
     def run_auto_analyze(self) -> list[str]:
         """One auto-analyze sweep (ref: autoanalyze.go:296 — tables whose
