@@ -1,0 +1,124 @@
+"""TTL + timer framework + stale reads (ref: pkg/ttl, pkg/timer,
+sessiontxn/staleread)."""
+
+import datetime
+import time
+
+import pytest
+
+import tidb_tpu
+from tidb_tpu.utils.timer import TimerRuntime
+
+
+@pytest.fixture()
+def db():
+    return tidb_tpu.open()
+
+
+def test_ttl_expires_rows(db):
+    db.execute("CREATE TABLE ev (id BIGINT PRIMARY KEY, created DATE) TTL = created + INTERVAL 30 DAY")
+    old = (datetime.date.today() - datetime.timedelta(days=60)).isoformat()
+    fresh = datetime.date.today().isoformat()
+    db.execute(f"INSERT INTO ev VALUES (1, '{old}'), (2, '{fresh}'), (3, NULL)")
+    out = db.run_ttl()
+    assert out == {"test.ev": 1}
+    assert db.query("SELECT id FROM ev ORDER BY id") == [(2,), (3,)]  # NULL never expires
+    # second sweep: nothing left to do
+    assert db.run_ttl() == {}
+
+
+def test_ttl_enable_toggle_and_alter(db):
+    db.execute("CREATE TABLE ev (id BIGINT PRIMARY KEY, created DATE) TTL = created + INTERVAL 1 DAY TTL_ENABLE = 'OFF'")
+    old = (datetime.date.today() - datetime.timedelta(days=10)).isoformat()
+    db.execute(f"INSERT INTO ev VALUES (1, '{old}')")
+    assert db.run_ttl() == {}  # disabled
+    db.execute("ALTER TABLE ev TTL_ENABLE = 'ON'")
+    assert db.run_ttl() == {"test.ev": 1}
+    # ALTER SET/REMOVE TTL
+    db.execute("CREATE TABLE ev2 (id BIGINT PRIMARY KEY, d DATETIME)")
+    db.execute("ALTER TABLE ev2 TTL = d + INTERVAL 1 WEEK")
+    t = db.catalog.table("test", "ev2")
+    assert t.ttl_days == 7 and t.ttl_col_offset == 1
+    db.execute("ALTER TABLE ev2 REMOVE TTL")
+    assert db.catalog.table("test", "ev2").ttl_col_offset == -1
+    # TTL column must be temporal
+    with pytest.raises(Exception):
+        db.execute("CREATE TABLE bad (id BIGINT) TTL = id + INTERVAL 1 DAY")
+
+
+def test_ttl_on_partitioned_table(db):
+    db.execute(
+        "CREATE TABLE pv (id BIGINT PRIMARY KEY, d DATE, g BIGINT) "
+        "PARTITION BY HASH (g) PARTITIONS 3 TTL = d + INTERVAL 5 DAY"
+    )
+    old = (datetime.date.today() - datetime.timedelta(days=9)).isoformat()
+    new = datetime.date.today().isoformat()
+    db.execute(f"INSERT INTO pv VALUES (1, '{old}', 0), (2, '{old}', 1), (3, '{new}', 2)")
+    assert db.run_ttl() == {"test.pv": 2}
+    assert db.query("SELECT id FROM pv") == [(3,)]
+
+
+def test_timer_runtime():
+    tr = TimerRuntime()
+    hits = []
+    tr.register("a", 0.0, lambda: hits.append("a"))
+    tr.register("boom", 0.0, lambda: 1 / 0)
+    ran = tr.tick(force=True)
+    assert set(ran) == {"a", "boom"}
+    assert hits == ["a"]
+    boom = next(t for t in tr.timers() if t.name == "boom")
+    assert "division" in boom.last_error
+    # interval gating
+    tr2 = TimerRuntime()
+    tr2.register("slow", 9999, lambda: hits.append("slow"))
+    t = tr2.timers()[0]
+    t.last_run = time.monotonic()
+    assert tr2.tick() == []
+
+
+def test_background_domain_loop(db):
+    db.execute("CREATE TABLE ev (id BIGINT PRIMARY KEY, created DATE) TTL = created + INTERVAL 1 DAY")
+    old = (datetime.date.today() - datetime.timedelta(days=5)).isoformat()
+    db.execute(f"INSERT INTO ev VALUES (1, '{old}')")
+    db.start_background(ttl_interval_s=0.0, analyze_interval_s=9999, gc_interval_s=9999)
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if db.query("SELECT COUNT(*) FROM ev") == [(0,)]:
+                break
+            time.sleep(0.1)
+        assert db.query("SELECT COUNT(*) FROM ev") == [(0,)]
+    finally:
+        db.stop_background()
+
+
+def test_stale_read_as_of(db):
+    db.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+    db.execute("INSERT INTO t VALUES (1, 10)")
+    time.sleep(0.05)
+    mark = datetime.datetime.fromtimestamp(time.time()).isoformat(sep=" ", timespec="milliseconds")
+    time.sleep(0.05)
+    db.execute("UPDATE t SET v = 99 WHERE id = 1")
+    db.execute("INSERT INTO t VALUES (2, 20)")
+    s = db.session()
+    assert s.query(f"SELECT v FROM t AS OF TIMESTAMP '{mark}' WHERE id = 1") == [(10,)]
+    assert s.query(f"SELECT COUNT(*) FROM t AS OF TIMESTAMP '{mark}'") == [(1,)]
+    assert s.query("SELECT v FROM t WHERE id = 1") == [(99,)]
+    # joins must agree on the timestamp
+    with pytest.raises(Exception):
+        s.query(f"SELECT * FROM t AS OF TIMESTAMP '{mark}' a, t b WHERE a.id = b.id AND b.v > 0")
+    # forbidden with FOR UPDATE
+    with pytest.raises(Exception):
+        s.query(f"SELECT * FROM t AS OF TIMESTAMP '{mark}' FOR UPDATE")
+
+
+def test_read_staleness_sysvar(db):
+    db.execute("CREATE TABLE t (id BIGINT PRIMARY KEY)")
+    db.execute("INSERT INTO t VALUES (1)")
+    s = db.session()
+    time.sleep(0.12)
+    db.execute("INSERT INTO t VALUES (2)")
+    s.execute("SET tidb_read_staleness = -0.1")
+    assert s.query("SELECT COUNT(*) FROM t") == [(1,)]
+    s.execute("SET tidb_read_staleness = 0")
+    assert s.query("SELECT COUNT(*) FROM t") == [(2,)]

@@ -196,9 +196,18 @@ class Catalog:
                     t.next_index_id += 1
             if stmt.partition_by is not None:
                 t.partition = self._build_partition_info(t, stmt.partition_by)
+            if stmt.ttl is not None:
+                self._set_ttl(t, stmt.ttl, stmt.ttl_enable)
             dbi.tables[tname] = t
             self._persist()
             return t
+
+    def _set_ttl(self, t: TableInfo, ttl: tuple, enable: bool) -> None:
+        col, days = ttl
+        off = self._col_offset(t, col)
+        if t.columns[off].ftype.kind not in (TypeKind.DATE, TypeKind.DATETIME):
+            raise CatalogError("TTL column must be DATE or DATETIME")
+        t.ttl_col_offset, t.ttl_days, t.ttl_enable = off, days, enable
 
     def _build_partition_info(self, t: TableInfo, pby: ast.PartitionByDef) -> PartitionInfo:
         """Each partition is a physical table id (ref: model.PartitionInfo;
@@ -364,6 +373,14 @@ class Catalog:
                 del dbi.tables[t.name]
                 t.name = stmt.name.lower()
                 dbi.tables[t.name] = t
+            elif stmt.action == "set_ttl":
+                self._set_ttl(t, stmt.ttl, True)
+            elif stmt.action == "remove_ttl":
+                t.ttl_col_offset, t.ttl_days, t.ttl_enable = -1, 0, True
+            elif stmt.action == "ttl_enable":
+                if t.ttl_col_offset < 0:
+                    raise CatalogError("table has no TTL")
+                t.ttl_enable = stmt.ttl_enable
             elif stmt.action == "add_partition":
                 p = t.partition
                 if p is None or p.type != "range":

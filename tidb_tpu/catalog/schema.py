@@ -137,6 +137,10 @@ class TableInfo:
     next_column_id: int = 1
     next_index_id: int = 1
     partition: Optional[PartitionInfo] = None
+    # TTL (ref: model.TTLInfo): rows where col < now - ttl_days expire
+    ttl_col_offset: int = -1
+    ttl_days: int = 0
+    ttl_enable: bool = True
 
     def column(self, name: str) -> Optional[ColumnInfo]:
         lname = name.lower()
@@ -194,6 +198,7 @@ class TableInfo:
             "next_column_id": self.next_column_id,
             "next_index_id": self.next_index_id,
             "partition": self.partition.to_pb() if self.partition else None,
+            "ttl": [self.ttl_col_offset, self.ttl_days, self.ttl_enable],
         }
 
     @staticmethod
@@ -208,6 +213,7 @@ class TableInfo:
             pb["next_column_id"],
             pb["next_index_id"],
             PartitionInfo.from_pb(pb["partition"]) if pb.get("partition") else None,
+            *(pb.get("ttl") or [-1, 0, True]),
         )
 
 

@@ -163,6 +163,7 @@ class TableRef(Node):
     name: str
     db: str = ""
     alias: str = ""
+    as_of: Optional[Node] = None  # stale read: AS OF TIMESTAMP expr
 
 
 @dataclass
@@ -306,6 +307,8 @@ class CreateTable(Node):
     indexes: list[IndexDef] = field(default_factory=list)
     if_not_exists: bool = False
     partition_by: Optional[PartitionByDef] = None
+    ttl: Optional[tuple[str, int]] = None  # (column, days)
+    ttl_enable: bool = True
 
 
 @dataclass
@@ -330,6 +333,8 @@ class AlterTable(Node):
     index: Optional[IndexDef] = None
     name: str = ""  # drop target, rename target, or partition name
     less_than: Optional[int] = None  # add_partition bound (None = MAXVALUE)
+    ttl: Optional[tuple[str, int]] = None  # set_ttl payload
+    ttl_enable: bool = True
 
 
 @dataclass

@@ -354,7 +354,14 @@ class Parser:
         db = ""
         if self.eat_op("."):
             db, name = name, self.ident()
+        as_of = None
         alias = ""
+        if self.at_kw("AS") and self.peek(1).value.upper() == "OF":
+            # stale read: t AS OF TIMESTAMP expr (ref: ast.TableName.AsOf)
+            self.next()
+            self.next()
+            self.expect_kw("TIMESTAMP")
+            as_of = self.parse_expr()
         if self.eat_kw("AS"):
             alias = self.ident()
         elif self.peek().kind in ("ident", "qident") and not self.at_kw(
@@ -362,7 +369,7 @@ class Parser:
             "INNER", "CROSS", "SET", "UNION", "INTERSECT", "EXCEPT",
         ):
             alias = self.ident()
-        return ast.TableRef(name, db=db, alias=alias)
+        return ast.TableRef(name, db=db, alias=alias, as_of=as_of)
 
     # -- expressions ---------------------------------------------------------
     def parse_expr(self) -> ast.Node:
@@ -870,12 +877,42 @@ class Parser:
                     defs.append(self._partition_def())
                 self.expect_op(")")
                 ct.partition_by = ast.PartitionByDef("range", col, defs=defs)
-        # table options: swallow ident=value pairs
+        # table options: TTL parsed, everything else swallowed
         while self.peek().kind == "ident" and not self.at_op(";"):
+            if self.at_kw("TTL"):
+                self.next()
+                self.expect_op("=")
+                ct.ttl = self._ttl_spec()
+                continue
+            if self.peek().value.upper() == "TTL_ENABLE":
+                self.next()
+                self.expect_op("=")
+                ct.ttl_enable = self._string_lit().upper() == "ON"
+                continue
             self.next()
             if self.eat_op("="):
                 self.next()
         return ct
+
+    def _ttl_spec(self) -> tuple[str, int]:
+        """`col` + INTERVAL n DAY"""
+        col = self.ident().lower()
+        self.expect_op("+")
+        self.expect_kw("INTERVAL")
+        t = self.next()
+        if t.kind != "int":
+            raise ParseError("expected TTL interval count", t)
+        unit = self.ident().lower()
+        days = int(t.value)
+        if unit in ("day", "days"):
+            pass
+        elif unit in ("week", "weeks"):
+            days *= 7
+        elif unit in ("month", "months"):
+            days *= 30
+        else:
+            raise ParseError(f"unsupported TTL unit {unit!r}", t)
+        return col, days
 
     def _if_not_exists(self) -> bool:
         if self.at_kw("IF"):
@@ -963,6 +1000,17 @@ class Parser:
         elif self.eat_kw("TRUNCATE"):
             self.expect_kw("PARTITION")
             at.action, at.name = "truncate_partition", self.ident()
+        elif self.at_kw("TTL"):
+            self.next()
+            self.expect_op("=")
+            at.action, at.ttl = "set_ttl", self._ttl_spec()
+        elif self.peek().value.upper() == "TTL_ENABLE":
+            self.next()
+            self.expect_op("=")
+            at.action, at.ttl_enable = "ttl_enable", self._string_lit().upper() == "ON"
+        elif self.eat_kw("REMOVE"):
+            self.expect_kw("TTL")
+            at.action = "remove_ttl"
         elif self.eat_kw("RENAME"):
             self.eat_kw("TO")
             at.action, at.name = "rename", self.ident()

@@ -57,6 +57,8 @@ def detect_point_get(catalog, current_db: str, stmt: ast.Node) -> Optional[Point
         return None
     if not isinstance(stmt.from_, ast.TableRef):
         return None
+    if stmt.from_.as_of is not None:
+        return None  # stale reads take the planner path
     if stmt.where is None:
         return None
     # WHERE must be exactly `pk = const` (or `const = pk`)

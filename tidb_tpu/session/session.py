@@ -39,6 +39,9 @@ DEFAULT_SYSVARS = {
     # MPP gating (ref: tidb_vars.go:399 tidb_allow_mpp, :415 tidb_enforce_mpp)
     "tidb_allow_mpp": 1,
     "tidb_enforce_mpp": 0,
+    # stale reads: negative seconds back for autocommit statements
+    # (ref: tidb_read_staleness)
+    "tidb_read_staleness": 0,
     # per-query memory quota in bytes (ref: tidb_mem_quota_query, 1GB default)
     "tidb_mem_quota_query": 1 << 30,
     # CANCEL kills the query on quota excess after spill actions run
@@ -132,6 +135,13 @@ class Session:
             return self._read_ts_override
         if self._txn is not None:
             return self._txn.start_ts
+        # tidb_read_staleness: negative seconds → bounded-staleness autocommit
+        # reads (ref: staleread/provider.go + tidb_read_staleness)
+        stale = float(self.vars.get("tidb_read_staleness", 0) or 0)
+        if stale:
+            import time
+
+            return max(0, int((time.time() + stale) * 1000)) << 18
         return self.store.current_ts()
 
     def _txn_dirty(self) -> bool:
@@ -500,6 +510,14 @@ class Session:
             stmt = expand_ctes(stmt, self._cte_runner)
         if isinstance(stmt, ast.SetOp) and _setop_has_for_update(stmt):
             raise SessionError("FOR UPDATE is not supported inside set operations")
+        as_of_ts = self._resolve_as_of(stmt)
+        if as_of_ts is not None:
+            if self._txn_dirty():
+                raise SessionError("AS OF TIMESTAMP inside a dirty transaction is not allowed")
+            if getattr(stmt, "for_update", False):
+                raise SessionError("AS OF TIMESTAMP can't be used with FOR UPDATE")
+            cache_key = None  # stale plans bake nothing, but reads must re-ts
+            self._read_ts_override = as_of_ts
         if getattr(stmt, "for_update", False):
             self._lock_select_rows(stmt)
             if self._explicit and self._txn is not None and self._txn.pessimistic:
@@ -524,6 +542,54 @@ class Session:
             self.mem_tracker = None
         names = [oc.name for oc in plan.schema]
         return Result(columns=names, rows=chunk.rows(), ftypes=[oc.ftype for oc in plan.schema])
+
+    def _resolve_as_of(self, stmt) -> Optional[int]:
+        """Collect AS OF TIMESTAMP from the statement's table refs → TSO ts
+        (ref: calculateTsExpr in staleread). All refs must agree."""
+        exprs: list = []
+        n_refs = [0]
+
+        def walk(node):
+            if isinstance(node, ast.TableRef):
+                n_refs[0] += 1
+                if node.as_of is not None:
+                    exprs.append(node.as_of)
+            elif isinstance(node, ast.Join):
+                walk(node.left)
+                walk(node.right)
+            elif isinstance(node, ast.SubquerySource):
+                walk(node.select)
+            elif isinstance(node, ast.SetOp):
+                walk(node.left)
+                walk(node.right)
+            elif isinstance(node, ast.Select):
+                if node.from_ is not None:
+                    walk(node.from_)
+
+        if isinstance(stmt, ast.SetOp):
+            walk(stmt)
+        elif getattr(stmt, "from_", None) is not None:
+            walk(stmt.from_)
+        if not exprs:
+            return None
+        if len({repr(e) for e in exprs}) > 1 or len(exprs) != n_refs[0]:
+            raise SessionError("can not set different time in the as of")
+        builder = Builder(self.catalog, self.current_db)
+        from tidb_tpu.expression.expr import Constant
+        from tidb_tpu.planner.builder import BuildCtx
+        from tidb_tpu.types.datum import datetime_to_micros
+
+        e = builder.resolve(exprs[0], BuildCtx([]))
+        if not isinstance(e, Constant):
+            raise SessionError("AS OF TIMESTAMP must be a constant expression")
+        v = e.value
+        if isinstance(v, bytes):
+            v = v.decode()
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            ms = int(float(v) * 1000)  # unix seconds
+        else:
+            ms = datetime_to_micros(str(v)) // 1000
+        return ms << 18
 
     def _lock_select_rows(self, stmt: ast.Select) -> None:
         """SELECT ... FOR UPDATE: pessimistically lock the matched rows'
@@ -831,6 +897,28 @@ class DB:
                     self.stats.put(analyze_table(s, db_name, t))
                     analyzed.append(f"{db_name}.{tname}")
         return analyzed
+
+    def run_ttl(self) -> dict:
+        """One TTL sweep (ref: ttlworker jobs)."""
+        from tidb_tpu.ttl import run_ttl_once
+
+        return run_ttl_once(self)
+
+    def start_background(self, ttl_interval_s: float = 60, analyze_interval_s: float = 60, gc_interval_s: float = 120) -> None:
+        """Start the Domain-style background loops (ref: domain.Start —
+        TTL, auto-analyze, GC workers on the timer framework)."""
+        from tidb_tpu.utils.timer import TimerRuntime
+
+        if getattr(self, "timers", None) is None:
+            self.timers = TimerRuntime()
+        self.timers.register("ttl", ttl_interval_s, self.run_ttl)
+        self.timers.register("auto_analyze", analyze_interval_s, self.run_auto_analyze)
+        self.timers.register("gc", gc_interval_s, self.run_gc)
+        self.timers.start()
+
+    def stop_background(self) -> None:
+        if getattr(self, "timers", None) is not None:
+            self.timers.stop()
 
     def run_gc(self, safe_point: Optional[int] = None) -> int:
         """One synchronous MVCC GC cycle (tests / admin). Honors the
