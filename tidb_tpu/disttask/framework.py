@@ -1,0 +1,290 @@
+"""Distributed task framework.
+
+Reference parity (pkg/disttask/framework):
+- task / subtask state machines persisted in system tables
+  (mysql.tidb_global_task, mysql.tidb_background_subtask — framework/storage)
+  so SQL can inspect them and pending work resumes after interruption;
+- a Scheduler that asks the task type's SchedulerExt to plan subtasks per
+  step and advances the task when all subtasks of a step finish
+  (scheduler/scheduler.go:61);
+- TaskExecutor worker threads ("nodes") claiming pending subtasks and
+  running the registered StepExecutor (taskexecutor/interface.go:70);
+- cancellation propagates to running subtasks; failed subtasks fail the
+  task and remaining subtasks are cancelled (proto/task.go transitions).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+
+class TaskState:
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEED = "succeed"
+    FAILED = "failed"
+    CANCELLING = "cancelling"
+    CANCELLED = "cancelled"
+
+
+class SubtaskState:
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEED = "succeed"
+    FAILED = "failed"
+    CANCELED = "canceled"
+
+
+@dataclass
+class Subtask:
+    id: int
+    task_id: int
+    step: int
+    state: str
+    exec_id: str
+    meta: dict
+    summary: dict
+
+
+@dataclass
+class Task:
+    id: int
+    type: str
+    state: str
+    step: int
+    concurrency: int
+    meta: dict
+    error: str = ""
+
+
+class SchedulerExt:
+    """Per-task-type planning hooks (ref: scheduler.Extension)."""
+
+    #: step numbers, in order; the task succeeds after the last one
+    steps: list[int] = [1]
+
+    def plan_subtasks(self, task: Task, step: int) -> list[dict]:
+        """→ subtask metas for this step."""
+        raise NotImplementedError
+
+    def on_done(self, task: Task, manager: "DistTaskManager") -> None:
+        """Called once when the task reaches succeed."""
+
+
+class StepExecutor:
+    """Runs one subtask (ref: execute.StepExecutor.RunSubtask)."""
+
+    def run_subtask(self, task: Task, subtask: Subtask, manager: "DistTaskManager") -> dict:
+        """→ summary dict persisted on the subtask."""
+        raise NotImplementedError
+
+
+_REGISTRY: dict[str, tuple[SchedulerExt, StepExecutor]] = {}
+
+
+def register_task_type(name: str, ext: SchedulerExt, executor: StepExecutor) -> None:
+    _REGISTRY[name] = (ext, executor)
+
+
+class DistTaskManager:
+    """Owner-side scheduler + executor pool in one process (the reference
+    splits these across nodes; the contracts are the same)."""
+
+    def __init__(self, db, n_workers: int = 4, node_prefix: str = "exec"):
+        self.db = db
+        self.n_workers = n_workers
+        self.node_prefix = node_prefix
+        self._mu = threading.Lock()
+        self._cancel_flags: dict[int, threading.Event] = {}
+        self._ensure_tables()
+
+    # -- storage (system tables; ref: framework/storage) --------------------
+    def _ensure_tables(self) -> None:
+        s = self._session()
+        s.execute("CREATE DATABASE IF NOT EXISTS mysql")
+        s.execute(
+            "CREATE TABLE IF NOT EXISTS mysql.tidb_global_task (id BIGINT PRIMARY KEY, "
+            "task_type VARCHAR(64), state VARCHAR(32), step BIGINT, concurrency BIGINT, "
+            "meta TEXT, error TEXT)"
+        )
+        s.execute(
+            "CREATE TABLE IF NOT EXISTS mysql.tidb_background_subtask (id BIGINT PRIMARY KEY, "
+            "task_id BIGINT, step BIGINT, state VARCHAR(32), exec_id VARCHAR(64), "
+            "meta TEXT, summary TEXT)"
+        )
+
+    def _session(self):
+        s = self.db.session()
+        s.user, s.host = "root", "%"
+        return s
+
+    def _q(self, sql: str):
+        return self._session().query(sql)
+
+    def _x(self, sql: str):
+        return self._session().execute(sql)
+
+    @staticmethod
+    def _esc(v: str) -> str:
+        return v.replace("\\", "\\\\").replace("'", "\\'")
+
+    def _next_id(self, table: str) -> int:
+        r = self._q(f"SELECT MAX(id) FROM mysql.{table}")
+        return (r[0][0] or 0) + 1
+
+    # -- task API ------------------------------------------------------------
+    def submit_task(self, task_type: str, meta: dict, concurrency: int = 4) -> int:
+        if task_type not in _REGISTRY:
+            raise ValueError(f"unknown task type {task_type!r}")
+        with self._mu:
+            tid = self._next_id("tidb_global_task")
+            self._x(
+                "INSERT INTO mysql.tidb_global_task VALUES "
+                f"({tid}, '{task_type}', '{TaskState.PENDING}', 0, {concurrency}, "
+                f"'{self._esc(json.dumps(meta))}', '')"
+            )
+        return tid
+
+    def get_task(self, task_id: int) -> Optional[Task]:
+        r = self._q(f"SELECT * FROM mysql.tidb_global_task WHERE id = {task_id}")
+        if not r:
+            return None
+        tid, tp, state, step, conc, meta, err = r[0]
+        return Task(tid, tp, state, step, conc, json.loads(meta), err or "")
+
+    def subtasks(self, task_id: int, step: Optional[int] = None) -> list[Subtask]:
+        cond = f"task_id = {task_id}" + (f" AND step = {step}" if step is not None else "")
+        out = []
+        for sid, tid, st, state, ex, meta, summary in self._q(
+            f"SELECT * FROM mysql.tidb_background_subtask WHERE {cond} ORDER BY id"
+        ):
+            out.append(Subtask(sid, tid, st, state, ex, json.loads(meta), json.loads(summary or "{}")))
+        return out
+
+    def cancel_task(self, task_id: int) -> None:
+        self._set_task_state(task_id, TaskState.CANCELLING)
+        with self._mu:
+            ev = self._cancel_flags.get(task_id)
+        if ev is not None:
+            ev.set()
+
+    def is_cancelling(self, task_id: int) -> bool:
+        with self._mu:
+            ev = self._cancel_flags.get(task_id)
+        return ev is not None and ev.is_set()
+
+    def _set_task_state(self, task_id: int, state: str, error: str = "") -> None:
+        self._x(
+            f"UPDATE mysql.tidb_global_task SET state = '{state}', error = '{self._esc(error)}' "
+            f"WHERE id = {task_id}"
+        )
+
+    def _set_subtask(self, sid: int, state: str, summary: Optional[dict] = None) -> None:
+        extra = f", summary = '{self._esc(json.dumps(summary))}'" if summary is not None else ""
+        self._x(
+            f"UPDATE mysql.tidb_background_subtask SET state = '{state}'{extra} WHERE id = {sid}"
+        )
+
+    # -- scheduler + executor (ref: scheduleLoop + taskExecutor pool) --------
+    def run_task(self, task_id: int) -> Task:
+        """Drive one task to a terminal state (synchronous scheduler loop;
+        the caller is the 'owner node')."""
+        task = self.get_task(task_id)
+        if task is None:
+            raise ValueError(f"unknown task {task_id}")
+        ext, _ = _REGISTRY[task.type]
+        cancel = threading.Event()
+        with self._mu:
+            self._cancel_flags[task_id] = cancel
+        try:
+            self._set_task_state(task_id, TaskState.RUNNING)
+            for step in ext.steps:
+                task = self.get_task(task_id)
+                existing = self.subtasks(task_id, step)
+                if not existing:
+                    metas = ext.plan_subtasks(task, step)
+                    with self._mu:
+                        base = self._next_id("tidb_background_subtask")
+                        for i, m in enumerate(metas):
+                            self._x(
+                                "INSERT INTO mysql.tidb_background_subtask VALUES "
+                                f"({base + i}, {task_id}, {step}, '{SubtaskState.PENDING}', '', "
+                                f"'{self._esc(json.dumps(m))}', '{{}}')"
+                            )
+                self._x(
+                    f"UPDATE mysql.tidb_global_task SET step = {step} WHERE id = {task_id}"
+                )
+                ok, err = self._run_step(task_id, step, cancel)
+                if not ok:
+                    if err and err != "cancelled":
+                        self._set_task_state(task_id, TaskState.FAILED, err)
+                    else:
+                        self._set_task_state(task_id, TaskState.CANCELLED, "cancelled by user")
+                    return self.get_task(task_id)
+            task = self.get_task(task_id)
+            ext.on_done(task, self)
+            self._set_task_state(task_id, TaskState.SUCCEED)
+            return self.get_task(task_id)
+        finally:
+            with self._mu:
+                self._cancel_flags.pop(task_id, None)
+
+    def _run_step(self, task_id: int, step: int, cancel: threading.Event) -> tuple[bool, str]:
+        task = self.get_task(task_id)
+        _, executor = _REGISTRY[task.type]
+        pending = [st for st in self.subtasks(task_id, step) if st.state == SubtaskState.PENDING]
+        qlock = threading.Lock()
+        errors: list[str] = []
+
+        def worker(node_id: int):
+            exec_id = f"{self.node_prefix}-{node_id}"
+            while not cancel.is_set():
+                with qlock:
+                    if not pending:
+                        return
+                    st = pending.pop(0)
+                self._x(
+                    f"UPDATE mysql.tidb_background_subtask SET state = '{SubtaskState.RUNNING}', "
+                    f"exec_id = '{exec_id}' WHERE id = {st.id}"
+                )
+                try:
+                    summary = executor.run_subtask(task, st, self)
+                    self._set_subtask(st.id, SubtaskState.SUCCEED, summary or {})
+                except Exception as e:
+                    self._set_subtask(st.id, SubtaskState.FAILED, {"error": str(e)})
+                    errors.append(str(e))
+                    cancel.set()  # fail fast; remaining subtasks cancel
+                    return
+
+        n = min(max(task.concurrency, 1), self.n_workers)
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            for st in self.subtasks(task_id, step):
+                if st.state == SubtaskState.PENDING:
+                    self._set_subtask(st.id, SubtaskState.CANCELED)
+            return False, errors[0]
+        if cancel.is_set():
+            for st in self.subtasks(task_id, step):
+                if st.state == SubtaskState.PENDING:
+                    self._set_subtask(st.id, SubtaskState.CANCELED)
+            return False, "cancelled"
+        return True, ""
+
+    def resume_pending(self) -> list[int]:
+        """Re-drive tasks left non-terminal (crash recovery — ref: disttask
+        resuming from system-table state after restart)."""
+        out = []
+        for (tid,) in self._q(
+            "SELECT id FROM mysql.tidb_global_task WHERE state = 'pending' OR state = 'running'"
+        ):
+            self.run_task(tid)
+            out.append(tid)
+        return out

@@ -39,6 +39,9 @@ DEFAULT_SYSVARS = {
     # MPP gating (ref: tidb_vars.go:399 tidb_allow_mpp, :415 tidb_enforce_mpp)
     "tidb_allow_mpp": 1,
     "tidb_enforce_mpp": 0,
+    # IMPORT INTO via the distributed task framework (ref:
+    # tidb_enable_dist_task; default off — direct load is faster in-process)
+    "tidb_enable_dist_task": 0,
     # stale reads: negative seconds back for autocommit statements
     # (ref: tidb_read_staleness)
     "tidb_read_staleness": 0,
@@ -306,8 +309,10 @@ class Session:
                 raise SessionError(f"Unknown thread id: {stmt.conn_id}")
             return Result()
         if isinstance(stmt, ast.ImportInto):
-            from tidb_tpu.tools.importer import import_into
+            from tidb_tpu.tools.importer import import_into, import_into_disttask
 
+            if int(self.vars.get("tidb_enable_dist_task", 0)):
+                import_into = import_into_disttask
             n = import_into(
                 self._db,
                 stmt.table.db or self.current_db,

@@ -11,21 +11,33 @@ from tidb_tpu.types import TypeKind
 
 def import_into(db, db_name: str, table_name: str, path: str, *, skip_header: bool | None = None, delimiter: str = ",") -> int:
     """Load a CSV file into a table; returns rows imported. ``skip_header``
-    defaults to auto-detect (header row = any field that fails numeric
-    conversion for a numeric column but matches the column's name)."""
+    defaults to auto-detect (header row matching the column names)."""
     t = db.catalog.table(db_name, table_name)
-    ncols = len(t.columns)
+    rows = parse_csv_rows(t, path, skip_header, delimiter)
+    if not rows:
+        return 0
+    return import_rows_slice(db, db_name, table_name, rows)
+
+
+def parse_csv_rows(t, path: str, skip_header: bool | None, delimiter: str) -> list[list]:
+    """CSV → typed row lists (shared by the direct and disttask paths)."""
     with open(path, newline="") as f:
         reader = _csv.reader(f, delimiter=delimiter)
         rows = [r for r in reader if r]
     if not rows:
-        return 0
+        return []
     if skip_header is None:
         first = [x.strip().lower() for x in rows[0]]
         skip_header = first == [c.name.lower() for c in t.columns]
     if skip_header:
         rows = rows[1:]
+    return rows
 
+
+def import_rows_slice(db, db_name: str, table_name: str, rows: list[list]) -> int:
+    """Convert + load one slice of parsed CSV rows."""
+    t = db.catalog.table(db_name, table_name)
+    ncols = len(t.columns)
     cols: list[list] = [[] for _ in range(ncols)]
     for r in rows:
         if len(r) != ncols:
@@ -34,12 +46,72 @@ def import_into(db, db_name: str, table_name: str, path: str, *, skip_header: bo
             ft = t.columns[c].ftype
             if field == "\\N" or (field == "" and ft.kind not in (TypeKind.STRING,)):
                 cols[c].append(None)
-                continue
-            cols[c].append(_convert(field, ft))
-
+            else:
+                cols[c].append(_convert(field, ft))
     from tidb_tpu.executor.load import bulk_load
 
     return bulk_load(db, table_name, cols, db_name=db_name)
+
+
+# -- disttask integration (ref: disttask/importinto: the IMPORT INTO SQL
+# surface plans row-range subtasks executed by the framework's workers) -----
+
+_SUBTASK_ROWS = 100_000
+
+
+class _ImportExt:
+    steps = [1]
+
+    def plan_subtasks(self, task, step):
+        from tidb_tpu.session.session import DB  # noqa: F401 (type only)
+
+        m = task.meta
+        db = _DB_BY_ID[m["db_ref"]]
+        t = db.catalog.table(m["db"], m["table"])
+        n = len(parse_csv_rows(t, m["path"], m.get("skip_header"), m.get("delimiter", ",")))
+        if n == 0:
+            return []
+        return [
+            {"start": i, "end": min(i + _SUBTASK_ROWS, n)} for i in range(0, n, _SUBTASK_ROWS)
+        ]
+
+    def on_done(self, task, manager):
+        pass
+
+
+class _ImportExec:
+    def run_subtask(self, task, subtask, manager):
+        m = task.meta
+        db = _DB_BY_ID[m["db_ref"]]
+        t = db.catalog.table(m["db"], m["table"])
+        rows = parse_csv_rows(t, m["path"], m.get("skip_header"), m.get("delimiter", ","))
+        sl = rows[subtask.meta["start"] : subtask.meta["end"]]
+        n = import_rows_slice(db, m["db"], m["table"], sl)
+        return {"rows": n}
+
+
+# process-local handle registry: task meta must be JSON, the DB object isn't
+_DB_BY_ID: dict = {}
+
+
+def import_into_disttask(db, db_name: str, table_name: str, path: str, *, skip_header=None, delimiter=",") -> int:
+    """IMPORT INTO through the distributed task framework; returns rows."""
+    from tidb_tpu.disttask import DistTaskManager, register_task_type
+
+    register_task_type("import_into", _ImportExt(), _ImportExec())
+    _DB_BY_ID[id(db)] = db
+    mgr = getattr(db, "_disttask_mgr", None)
+    if mgr is None:
+        mgr = DistTaskManager(db)
+        db._disttask_mgr = mgr
+    tid = mgr.submit_task(
+        "import_into",
+        {"db_ref": id(db), "db": db_name, "table": table_name, "path": path, "skip_header": skip_header, "delimiter": delimiter},
+    )
+    task = mgr.run_task(tid)
+    if task.state != "succeed":
+        raise RuntimeError(f"IMPORT INTO task {tid} {task.state}: {task.error}")
+    return sum(st.summary.get("rows", 0) for st in mgr.subtasks(tid))
 
 
 def _convert(s: str, ft):
