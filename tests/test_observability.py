@@ -1,0 +1,89 @@
+"""Observability: metrics registry, statement summary, slow query log,
+TRACE, HTTP status endpoints (ref: pkg/metrics, util/stmtsummary,
+executor/trace.go, http_status.go)."""
+
+import json
+import urllib.request
+
+import pytest
+
+import tidb_tpu
+from tidb_tpu.utils.metrics import REGISTRY, STMT_TOTAL
+from tidb_tpu.utils.stmtsummary import digest
+
+
+@pytest.fixture()
+def db():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+    d.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+    return d
+
+
+def test_digest_normalizes_literals():
+    a = digest("SELECT * FROM t WHERE id = 5")
+    b = digest("SELECT  *  from T where ID = 99")
+    c = digest("SELECT * FROM t WHERE id = 'x'")
+    assert a == b == c
+    assert a != digest("SELECT * FROM t WHERE v = 5")
+
+
+def test_statements_summary(db):
+    s = db.session()
+    for i in range(3):
+        s.query(f"SELECT v FROM t WHERE id = {i}")
+    s.query("SELECT COUNT(*) FROM t")
+    rows = s.query(
+        "SELECT digest_text, exec_count FROM information_schema.statements_summary "
+        "WHERE digest_text LIKE '%where id =%'"
+    )
+    assert any(cnt == 3 for _, cnt in rows), rows
+
+
+def test_slow_query_log(db):
+    s = db.session()
+    s.execute("SET tidb_slow_log_threshold = 0")  # everything is slow now
+    s.query("SELECT SUM(v) FROM t")
+    s.execute("SET tidb_slow_log_threshold = 300")
+    rows = s.query("SELECT query, result_rows FROM information_schema.slow_query")
+    assert any("SUM(v)" in q for q, _ in rows)
+
+
+def test_trace(db):
+    s = db.session()
+    res = s.execute("TRACE SELECT COUNT(*) FROM t")
+    ops = [r[0] for r in res.rows]
+    text = "\n".join(ops)
+    assert "select" in text and "plan" in text and "execute" in text
+    assert all(len(r) == 3 for r in res.rows)
+    # tracing turns itself off afterward
+    assert s.tracer is None
+    assert s.query("SELECT COUNT(*) FROM t") == [(2,)]
+
+
+def test_metrics_counters_and_render(db):
+    before = STMT_TOTAL.get(type="Select")
+    db.query("SELECT 1 FROM t")
+    assert STMT_TOTAL.get(type="Select") == before + 1
+    text = REGISTRY.render()
+    assert "tidb_tpu_executor_statement_total" in text
+    assert "tidb_tpu_server_handle_query_duration_seconds_bucket" in text
+    assert "tidb_tpu_copr_task_total" in text
+
+
+def test_http_status_server(db):
+    from tidb_tpu.server.status import StatusServer
+
+    st = StatusServer(db)
+    port = st.start()
+    try:
+        db.query("SELECT COUNT(*) FROM t")
+        body = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics").read().decode()
+        assert "tidb_tpu_executor_statement_total" in body
+        status = json.loads(urllib.request.urlopen(f"http://127.0.0.1:{port}/status").read())
+        assert status["version"].endswith("tidb-tpu")
+        schema = json.loads(urllib.request.urlopen(f"http://127.0.0.1:{port}/schema").read())
+        assert "t" in schema["test"]
+        assert urllib.request.urlopen(f"http://127.0.0.1:{port}/schema").status == 200
+    finally:
+        st.close()

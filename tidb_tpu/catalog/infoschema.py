@@ -24,6 +24,8 @@ def memtable_rows(db, session, name: str) -> Optional[tuple[list, list, list]]:
         "processlist": _processlist,
         "session_variables": _variables,
         "engines": _engines,
+        "statements_summary": _statements_summary,
+        "slow_query": _slow_query,
     }.get(name)
     if fn is None:
         return None
@@ -129,6 +131,26 @@ def _variables(db, session):
     cols = ["VARIABLE_NAME", "VARIABLE_VALUE"]
     rows = sorted((k, str(v)) for k, v in session.vars.items())
     return cols, [_S(), _S(256)], rows
+
+
+def _statements_summary(db, session):
+    from tidb_tpu.types.field_type import double_type
+
+    cols = ["DIGEST", "DIGEST_TEXT", "EXEC_COUNT", "SUM_LATENCY", "MAX_LATENCY", "AVG_LATENCY", "SUM_ROWS", "QUERY_SAMPLE_TEXT"]
+    fts = [_S(80), _S(256), _I(), double_type(), double_type(), double_type(), _I(), _S(256)]
+    rows = []
+    for st in db.stmt_summary.stats():
+        d, _, norm = st.digest.partition("|")
+        rows.append((d, norm, st.exec_count, st.sum_latency, st.max_latency, st.avg_latency, st.sum_rows, st.sample))
+    return cols, fts, rows
+
+
+def _slow_query(db, session):
+    from tidb_tpu.types.field_type import double_type
+
+    cols = ["TIME", "QUERY", "QUERY_TIME", "RESULT_ROWS", "USER"]
+    fts = [double_type(), _S(512), double_type(), _I(), _S()]
+    return cols, fts, [tuple(r) for r in db.stmt_summary.slow_queries()]
 
 
 def _engines(db, session):

@@ -153,7 +153,10 @@ class TableReaderExec(Executor):
         return out
 
     def _execute_one(self, t, ranges) -> Chunk:
+        from tidb_tpu.utils import metrics as _m
+
         p = self.plan
+        _m.COP_TASKS.inc(engine=p.store_type.value if hasattr(p.store_type, "value") else str(p.store_type))
         scan = dagpb.ExecutorPB(
             dagpb.TABLE_SCAN,
             table_id=t.id,

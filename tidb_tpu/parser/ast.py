@@ -485,6 +485,13 @@ class Grant(Node):
 
 
 @dataclass
+class Trace(Node):
+    """TRACE <stmt> (ref: ast.TraceStmt)."""
+
+    stmt: Node
+
+
+@dataclass
 class Kill(Node):
     """KILL [QUERY|CONNECTION] conn_id (ref: ast.KillStmt)."""
 

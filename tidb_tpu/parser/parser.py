@@ -117,6 +117,7 @@ class Parser:
             "KILL": self.parse_kill,
             "GRANT": self.parse_grant,
             "REVOKE": self.parse_grant,
+            "TRACE": lambda: (self.next(), ast.Trace(self.parse_statement()))[1],
         }.get(kw)
         if fn is None:
             raise ParseError("unsupported statement", t)

@@ -1,0 +1,60 @@
+"""HTTP status server (ref: pkg/server/http_status.go:213-260): /metrics
+(Prometheus text), /status (JSON health), /schema (catalog dump)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class StatusServer:
+    def __init__(self, db, host: str = "127.0.0.1", port: int = 0):
+        self.db = db
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    from tidb_tpu.utils.metrics import REGISTRY
+
+                    body = REGISTRY.render().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path == "/status":
+                    body = json.dumps(
+                        {"connections": len(getattr(outer.db, "server", None)._conns) if getattr(outer.db, "server", None) else 0,
+                         "version": "8.0.11-tidb-tpu", "git_hash": "tpu-native"}
+                    ).encode()
+                    ctype = "application/json"
+                elif self.path == "/schema":
+                    out = {}
+                    for d in outer.db.catalog.databases():
+                        out[d] = {t: outer.db.catalog.table(d, t).to_pb() for t in outer.db.catalog.tables(d)}
+                    body = json.dumps(out).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self.port
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -39,6 +39,8 @@ DEFAULT_SYSVARS = {
     # MPP gating (ref: tidb_vars.go:399 tidb_allow_mpp, :415 tidb_enforce_mpp)
     "tidb_allow_mpp": 1,
     "tidb_enforce_mpp": 0,
+    # slow query log threshold in ms (ref: tidb_slow_log_threshold)
+    "tidb_slow_log_threshold": 300,
     # IMPORT INTO via the distributed task framework (ref:
     # tidb_enable_dist_task; default off — direct load is faster in-process)
     "tidb_enable_dist_task": 0,
@@ -112,6 +114,8 @@ class Session:
         self._pending_mods: dict[int, int] = {}
         # EXPLAIN ANALYZE per-operator stats (ref: util/execdetails)
         self.runtime_stats = None
+        # TRACE statement span collector (None = tracing off)
+        self.tracer = None
         # per-statement memory tracker + kill flag (ref: memory.Tracker root
         # at the session, sqlkiller checked at executor boundaries)
         self.mem_tracker = None
@@ -204,15 +208,38 @@ class Session:
         if self._deadline is not None and time.monotonic() > self._deadline:
             raise QueryKilledError("Query execution was interrupted, maximum statement execution time exceeded")
 
+    # -- tracing (ref: util/tracing StartRegionEx call sites) ----------------
+    def span(self, name: str):
+        if self.tracer is not None:
+            return self.tracer.span(name)
+        import contextlib
+
+        return contextlib.nullcontext()
+
     # -- entry points --------------------------------------------------------
     def execute(self, sql: str) -> Result:
-        stmt = parse(sql)
+        import time as _time
+
+        from tidb_tpu.utils import metrics as _m
+
+        t0 = _time.perf_counter()
+        with self.span("parse"):
+            stmt = parse(sql)
+        stype = type(stmt).__name__
         try:
             res = self._execute_stmt(stmt, sql_text=sql)
             if not self._explicit and self._txn is not None:
                 self._finish_txn(commit=True)
+            dt = _time.perf_counter() - t0
+            _m.STMT_TOTAL.inc(type=stype)
+            _m.QUERY_DURATION.observe(dt)
+            self._db.stmt_summary.record(
+                sql, dt, len(res.rows) or res.affected, f"{self.user}@{self.host}",
+                float(self.vars.get("tidb_slow_log_threshold", 300)) / 1000.0,
+            )
             return res
         except Exception:
+            _m.STMT_TOTAL.inc(type=f"{stype}:error")
             if not self._explicit and self._txn is not None:
                 # autocommit statement failed → roll back its staged writes
                 self._finish_txn(commit=False)
@@ -297,6 +324,16 @@ class Session:
             return self._explain(stmt)
         if isinstance(stmt, ast.AnalyzeTable):
             return self._analyze(stmt)
+        if isinstance(stmt, ast.Trace):
+            from tidb_tpu.utils.tracing import Tracer
+
+            self.tracer = Tracer()
+            try:
+                with self.tracer.span(type(stmt.stmt).__name__.lower()):
+                    self._execute_stmt(stmt.stmt)
+            finally:
+                tracer, self.tracer = self.tracer, None
+            return Result(columns=["operation", "startTS", "duration"], rows=tracer.rows())
         if isinstance(stmt, ast.CreateUser):
             return self._create_user(stmt)
         if isinstance(stmt, ast.DropUser):
@@ -536,11 +573,13 @@ class Session:
         met = float(self.vars.get("max_execution_time", 0) or 0)
         self._deadline = (time.monotonic() + met / 1000.0) if met > 0 else None
         try:
-            plan = self._plan_select(stmt, cache_key=cache_key)
+            with self.span("plan"):
+                plan = self._plan_select(stmt, cache_key=cache_key)
             from tidb_tpu.executor import build_executor
 
-            ex = build_executor(plan, self)
-            chunk = ex.execute()
+            with self.span("execute"):
+                ex = build_executor(plan, self)
+                chunk = ex.execute()
         finally:
             self._read_ts_override = None
             self._deadline = None
@@ -867,6 +906,9 @@ class DB:
 
         self.gc_worker = GCWorker(self.store)
         self.stats = StatsHandle()
+        from tidb_tpu.utils.stmtsummary import StmtSummary
+
+        self.stmt_summary = StmtSummary()
         # privilege state: grant tables bootstrap lazily (first auth/grant);
         # the cache keys on priv_version (ref: privilege reload notification)
         self.priv_version = 0

@@ -1,0 +1,113 @@
+"""Prometheus-style metrics registry (ref: pkg/metrics — one registry,
+per-subsystem counters/histograms, served on the status port's /metrics;
+here rendered via ``render()`` and wired into the wire server)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+_DEFAULT_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30)
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, labels: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.labels = labels
+        self._mu = threading.Lock()
+        self._vals: dict[tuple, float] = {}
+
+    def inc(self, n: float = 1, **labels) -> None:
+        key = tuple(labels.get(k, "") for k in self.labels)
+        with self._mu:
+            self._vals[key] = self._vals.get(key, 0) + n
+
+    def get(self, **labels) -> float:
+        key = tuple(labels.get(k, "") for k in self.labels)
+        with self._mu:
+            return self._vals.get(key, 0)
+
+    def render(self) -> str:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._mu:
+            for key, v in sorted(self._vals.items()):
+                lbl = ",".join(f'{k}="{val}"' for k, val in zip(self.labels, key))
+                out.append(f"{self.name}{{{lbl}}} {v:g}" if lbl else f"{self.name} {v:g}")
+        return "\n".join(out)
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, buckets=_DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(buckets)
+        self._mu = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._n = 0
+
+    def observe(self, v: float) -> None:
+        with self._mu:
+            self._sum += v
+            self._n += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    def render(self) -> str:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._mu:
+            cum = 0
+            for b, c in zip(self.buckets, self._counts):
+                cum += c
+                out.append(f'{self.name}_bucket{{le="{b:g}"}} {cum}')
+            out.append(f'{self.name}_bucket{{le="+Inf"}} {self._n}')
+            out.append(f"{self.name}_sum {self._sum:g}")
+            out.append(f"{self.name}_count {self._n}")
+        return "\n".join(out)
+
+
+class Registry:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def counter(self, name: str, help_: str = "", labels: tuple[str, ...] = ()) -> Counter:
+        with self._mu:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Counter(name, help_, labels)
+                self._metrics[name] = m
+            return m  # type: ignore[return-value]
+
+    def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
+        with self._mu:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help_, buckets)
+                self._metrics[name] = m
+            return m  # type: ignore[return-value]
+
+    def render(self) -> str:
+        with self._mu:
+            ms = list(self._metrics.values())
+        return "\n".join(m.render() for m in ms) + "\n"
+
+
+# process-global registry (ref: metrics.go package-level collectors)
+REGISTRY = Registry()
+
+STMT_TOTAL = REGISTRY.counter(
+    "tidb_tpu_executor_statement_total", "Executed statements", ("type",)
+)
+QUERY_DURATION = REGISTRY.histogram(
+    "tidb_tpu_server_handle_query_duration_seconds", "Statement latency"
+)
+COP_TASKS = REGISTRY.counter("tidb_tpu_copr_task_total", "Coprocessor tasks", ("engine",))

@@ -1,0 +1,90 @@
+"""Statement summary + slow query log (ref: util/stmtsummary — per-digest
+aggregates surfaced via information_schema.statements_summary; and the slow
+query log surfaced via information_schema.slow_query)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+
+
+def digest(sql: str) -> str:
+    """Normalized SQL digest: literals → '?', whitespace folded, lowercased
+    keywords (ref: parser/digester.go)."""
+    import hashlib
+
+    from tidb_tpu.parser.lexer import tokenize
+
+    try:
+        toks = tokenize(sql)
+    except Exception:
+        return hashlib.sha256(sql.encode()).hexdigest()[:16] + "|" + sql[:64]
+    parts = []
+    for t in toks:
+        if t.kind in ("int", "float", "str"):
+            parts.append("?")
+        elif t.kind == "eof":
+            break
+        elif t.kind == "ident":
+            parts.append(t.value.lower())
+        else:
+            parts.append(str(t.value))
+    norm = " ".join(parts)
+    return hashlib.sha256(norm.encode()).hexdigest()[:16] + "|" + norm[:256]
+
+
+@dataclass
+class StmtStats:
+    digest: str
+    sample: str
+    exec_count: int = 0
+    sum_latency: float = 0.0
+    max_latency: float = 0.0
+    sum_rows: int = 0
+    last_seen: float = field(default_factory=time.time)
+
+    @property
+    def avg_latency(self) -> float:
+        return self.sum_latency / self.exec_count if self.exec_count else 0.0
+
+
+class StmtSummary:
+    def __init__(self, capacity: int = 200, slow_capacity: int = 512):
+        self._mu = threading.Lock()
+        self._stats: OrderedDict[str, StmtStats] = OrderedDict()
+        self.capacity = capacity
+        # slow log ring: (time, sql, latency_s, rows, user)
+        self._slow: deque = deque(maxlen=slow_capacity)
+
+    def record(self, sql: str, latency_s: float, rows: int, user: str, slow_threshold_s: float) -> None:
+        d = digest(sql)
+        with self._mu:
+            st = self._stats.get(d)
+            if st is None:
+                st = StmtStats(d, sql[:256])
+                self._stats[d] = st
+                while len(self._stats) > self.capacity:
+                    self._stats.popitem(last=False)
+            st.exec_count += 1
+            st.sum_latency += latency_s
+            st.max_latency = max(st.max_latency, latency_s)
+            st.sum_rows += rows
+            st.last_seen = time.time()
+            self._stats.move_to_end(d)
+            if latency_s >= slow_threshold_s:
+                self._slow.append((time.time(), sql[:512], latency_s, rows, user))
+
+    def stats(self) -> list[StmtStats]:
+        with self._mu:
+            return list(self._stats.values())
+
+    def slow_queries(self) -> list[tuple]:
+        with self._mu:
+            return list(self._slow)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._stats.clear()
+            self._slow.clear()

@@ -1,0 +1,43 @@
+"""Tracing (ref: pkg/util/tracing dual spans + the TRACE statement,
+executor/trace.go): a per-statement span collector; instrumentation sites
+open spans through Session.span() which no-ops when tracing is off."""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+
+@dataclass
+class Span:
+    name: str
+    start_s: float  # relative to trace start
+    duration_s: float
+    depth: int
+
+
+class Tracer:
+    def __init__(self):
+        self._t0 = time.perf_counter()
+        self._depth = 0
+        self.spans: list[Span] = []
+
+    @contextmanager
+    def span(self, name: str):
+        start = time.perf_counter()
+        idx = len(self.spans)
+        self.spans.insert(idx, Span(name, start - self._t0, 0.0, self._depth))
+        self._depth += 1
+        try:
+            yield
+        finally:
+            self._depth -= 1
+            self.spans[idx].duration_s = time.perf_counter() - start
+
+    def rows(self) -> list[tuple]:
+        out = []
+        for s in self.spans:
+            label = ("  " * s.depth) + ("└─" if s.depth else "") + s.name
+            out.append((label, f"{s.start_s * 1e3:.3f}ms", f"{s.duration_s * 1e3:.3f}ms"))
+        return out
