@@ -41,8 +41,9 @@ MAX_RANGES = 8
 _I64_MAX = np.iinfo(np.int64).max
 _I64_MIN = np.iinfo(np.int64).min
 # dense path does B*n work per agg lane; past this many buckets the
-# lex-sort path is cheaper
+# MXU pallas kernel (≤ _DENSE_MXU_MAX) or the lex-sort path takes over
 _DENSE_EQMASK_MAX = 32
+_DENSE_MXU_MAX = 512
 
 
 def _dense_b_total(doms) -> int:
@@ -50,6 +51,29 @@ def _dense_b_total(doms) -> int:
     for dm in doms:
         b *= dm + 1
     return b
+
+
+def _mxu_aggs_ok(aggs) -> bool:
+    """The pallas grouped-sum kernel covers COUNT/SUM lanes whose values are
+    provably < 2^45 (exact byte-limb accumulation): DECIMAL with bounded
+    precision and DATE days. Anything else takes the sort path."""
+    from tidb_tpu.types import TypeKind
+
+    for a in aggs:
+        for pk in a.partial_kinds:
+            if pk == "count":
+                continue
+            if pk != "sum":
+                return False  # min/max/first_row: no matmul form
+            ft = a.arg.ftype if a.arg is not None else None
+            if ft is None:
+                return False
+            if ft.kind == TypeKind.DECIMAL and 0 < ft.length <= 13:
+                continue
+            if ft.kind == TypeKind.DATE:
+                continue
+            return False
+    return True
 
 
 @dataclass
@@ -175,6 +199,7 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                 # pure arithmetic, no O(n log n) sort. One extra bucket per
                 # key holds its NULLs.
                 dense_doms = None
+                mxu_doms = None
                 if group_exprs:
                     doms = []
                     for g in group_exprs:
@@ -186,9 +211,18 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                             doms = None
                             break
                     # equality-mask reduce cost is B*n per agg lane; past
-                    # _DENSE_EQMASK_MAX buckets the lex-sort path wins
-                    if doms and _dense_b_total(doms) <= min(agg_cap, _DENSE_EQMASK_MAX):
-                        dense_doms = doms
+                    # _DENSE_EQMASK_MAX buckets the MXU pallas kernel takes
+                    # over (up to _DENSE_MXU_MAX) for COUNT/SUM shapes, and
+                    # the lex-sort path covers the rest
+                    if doms:
+                        bt = _dense_b_total(doms)
+                        if bt <= min(agg_cap, _DENSE_EQMASK_MAX):
+                            dense_doms = doms
+                        elif bt <= min(agg_cap, _DENSE_MXU_MAX) and _mxu_aggs_ok(aggs):
+                            from tidb_tpu.ops.pallas_groupby import MAX_ROWS
+
+                            if n_pad <= MAX_ROWS:
+                                mxu_doms = doms
 
                 gvals = []
                 for g in group_exprs:
@@ -289,6 +323,73 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
 
                     out_data = [_pad(o[order]) for o in out_data]
                     out_valid = [_pad(o[order]) for o in out_valid]
+                elif mxu_doms is not None:
+                    # MXU path: one-hot matmul grouped COUNT/SUM on the
+                    # systolic array, exact via byte-limb accumulation
+                    # (ops/pallas_groupby.py)
+                    from tidb_tpu.ops.pallas_groupby import grouped_sums
+
+                    B = _dense_b_total(mxu_doms)
+                    seg = jnp.zeros(n, dtype=jnp.int64)
+                    stride = 1
+                    strides = []
+                    for (d, v), dom in zip(reversed(gvals), reversed(mxu_doms)):
+                        adj = jnp.where(v, d, dom)  # NULLs → extra bucket
+                        seg = seg + adj * stride
+                        strides.append(stride)
+                        stride *= dom + 1
+                    strides = list(reversed(strides))  # align with gvals order
+                    seg = jnp.where(mask, seg, B)  # dead rows match nothing
+
+                    pairs = []
+                    lane_of_agg = []
+                    for a in aggs:
+                        if a.arg is not None:
+                            d, v, _ = eval_expr(a.arg, batch, jnp)
+                            d = _bcast(d, n).astype(jnp.int64)
+                            v = _vmask(v, n)
+                        else:
+                            d = jnp.zeros(n, dtype=jnp.int64)
+                            v = jnp.ones(n, dtype=bool)
+                        lane_of_agg.append(len(pairs))
+                        pairs.append((d, mask & v))
+                    occ_lane = len(pairs)
+                    pairs.append((jnp.zeros(n, dtype=jnp.int64), mask))  # occupancy
+
+                    interpret = jax.default_backend() != "tpu"
+                    counts, sums = grouped_sums(seg.astype(jnp.int32), pairs, B, n, interpret)
+
+                    out_data, out_valid = [], []
+                    for a, li in zip(aggs, lane_of_agg):
+                        cnt = counts[:, li]
+                        for pk in a.partial_kinds:
+                            if pk == "count":
+                                out_data.append(cnt)
+                                out_valid.append(jnp.ones(B, dtype=bool))
+                            else:  # sum (gated by _mxu_aggs_ok)
+                                out_data.append(sums[:, li])
+                                out_valid.append(cnt > 0)
+                    if mode == dagpb.AGG_COMPLETE:
+                        out_data, out_valid = _finalize_device(jnp, aggs, out_data, out_valid)
+                    # group keys decode arithmetically from the bucket index
+                    bidx = jnp.arange(B)
+                    occupied = counts[:, occ_lane] > 0
+                    for (g, (gd, gv)), dom, st in zip(zip(group_exprs, gvals), mxu_doms, strides):
+                        code = (bidx // st) % (dom + 1)
+                        kv = (code != dom) & occupied
+                        # invalid lanes must still carry in-range dict codes
+                        out_data.append(jnp.where(kv, code, 0).astype(jnp.int64))
+                        out_valid.append(kv)
+                    order = jnp.argsort(~occupied, stable=True)
+                    ngroups = occupied.sum()
+
+                    def _padm(x):
+                        if B >= agg_cap:
+                            return x[:agg_cap]
+                        return jnp.zeros(agg_cap, dtype=x.dtype).at[:B].set(x)
+
+                    out_data = [_padm(o[order]) for o in out_data]
+                    out_valid = [_padm(o[order]) for o in out_valid]
                 else:
                     lanes = [~mask]
                     for d, v in gvals:

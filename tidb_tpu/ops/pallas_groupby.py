@@ -1,0 +1,149 @@
+"""Pallas TPU kernel: exact grouped sums on the MXU.
+
+The DAG kernel's group-by paths are (a) equality-mask reduces for tiny key
+domains (cost B*n on the VPU) and (b) lex-sort + segmented scans. In between
+sits the sweet spot this kernel owns: a few hundred buckets, where a one-hot
+(B, blk) @ (blk, C) matmul per row block rides the 128x128 systolic array.
+
+Exactness: SQL aggregates cannot tolerate float rounding, and f32 matmul
+accumulation is only exact below 2^24. So values are pre-split into 24-bit
+pieces (XLA side), the kernel limb-decomposes each piece into 8-bit bytes,
+and the matmul accumulates byte-sums: per block each partial is at most
+blk * 255 = 261120 < 2^24 (exact in f32); the int32 accumulator then holds
+up to 2^31 / 261120 ≈ 8000 blocks ≈ 8M rows per call. Negative values are
+handled by biasing with 2^46 and subtracting count * bias afterwards.
+
+Layout per aggregate lane: 3 int32 columns [w, piece0, piece1] where
+w ∈ {0,1} is the validity weight (doubling as the COUNT lane and the bias
+corrector) and piece0/1 are the low/high 24 bits of value + 2^46.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+_BLK = 1024
+_BIAS = 1 << 46
+_MAX_ABS = 1 << 45  # callers must guarantee |value| < this
+MAX_BUCKETS = 512
+MAX_ROWS = 8_000_000  # int32 accumulator headroom (see module docstring)
+
+
+def _pad_to(x, m):
+    return ((x + m - 1) // m) * m
+
+
+@functools.lru_cache(maxsize=64)
+def _build_call(n_pad: int, B_pad: int, n_cols: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    n_blocks = n_pad // _BLK
+    C3 = n_cols * 3  # byte limbs per column
+
+    def kernel(seg_ref, cols_ref, acc_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        seg = seg_ref[:]  # (blk,) int32; dead rows carry >= B_pad (no match)
+        bidx = jax.lax.broadcasted_iota(jnp.int32, (B_pad, _BLK), 0)
+        onehot = (seg[None, :] == bidx).astype(jnp.float32)  # (B, blk)
+        cols = cols_ref[:]  # (blk, n_cols) int32, values in [0, 2^24)
+        limbs = jnp.concatenate(
+            [((cols >> (8 * k)) & 0xFF).astype(jnp.float32) for k in range(3)], axis=1
+        )  # (blk, C3)
+        part = jnp.dot(onehot, limbs, preferred_element_type=jnp.float32)
+        acc_ref[:] += part.astype(jnp.int32)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((_BLK,), lambda i: (i,)),
+            # lane dim = full array width (allowed without 128-padding)
+            pl.BlockSpec((_BLK, n_cols), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((B_pad, C3), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B_pad, C3), jnp.int32),
+        interpret=interpret,
+    )
+    return call
+
+
+def grouped_sums(seg, pairs, B: int, n_pad: int, interpret: bool = False):
+    """Exact grouped COUNT/SUM for every (value, weight) lane.
+
+    seg   : (n_pad,) int32 — bucket per row in [0, B); dead rows must carry
+            a value >= B.
+    pairs : list of (vals int64 (n_pad,), w bool (n_pad,)) — w gates each
+            row's contribution for that lane.
+    → (counts int64 (B, L), sums int64 (B, L)), traced (jit-safe).
+    """
+    import jax.numpy as jnp
+
+    assert n_pad % _BLK == 0, "n_pad must be a multiple of the row block"
+    L = len(pairs)
+    B_pad = max(_pad_to(B, 8), 8)
+    n_cols = 3 * L
+
+    # vectorized column build: (L, n) stacks stay contiguous (stacking
+    # (n, 1) slices along axis=1 pads every slice to a full tile — 26GB
+    # observed at 2M rows)
+    V = jnp.stack([v for v, _ in pairs])  # (L, n) int64
+    W = jnp.stack([w for _, w in pairs])  # (L, n) bool
+    VB = jnp.where(W, V + _BIAS, 0)
+    tri = jnp.stack(  # (L, 3, n) int32: [w, lo24, hi24] per lane
+        [
+            W.astype(jnp.int32),
+            (VB & 0xFFFFFF).astype(jnp.int32),
+            ((VB >> 24) & 0xFFFFFF).astype(jnp.int32),
+        ],
+        axis=1,
+    )
+    cols2d = jnp.transpose(tri.reshape(n_cols, n_pad))  # (n_pad, 3L)
+    seg1d = jnp.minimum(seg, B_pad * 2).astype(jnp.int32)
+
+    # the Mosaic lowering rejects kernels traced in x64 mode ("failed to
+    # legalize func.return"); the kernel is pure int32/f32, so trace it in a
+    # 32-bit scope — inputs/outputs are explicit-dtype arrays either way
+    import jax
+
+    with jax.enable_x64(False):
+        acc = _build_call(n_pad, B_pad, n_cols, interpret)(seg1d, cols2d)  # (B_pad, 9L)
+
+    def col(j):
+        # recombine the 3 byte-limb sums of column j → exact int64
+        return (
+            acc[:, j].astype(jnp.int64)
+            + (acc[:, n_cols + j].astype(jnp.int64) << 8)
+            + (acc[:, 2 * n_cols + j].astype(jnp.int64) << 16)
+        )
+
+    counts, sums = [], []
+    for k in range(L):
+        w_cnt = col(3 * k)
+        p0 = col(3 * k + 1)
+        p1 = col(3 * k + 2)
+        s = p0 + (p1 << 24) - w_cnt * _BIAS
+        counts.append(w_cnt[:B])
+        sums.append(s[:B])
+    return jnp.stack(counts, axis=1), jnp.stack(sums, axis=1)
+
+
+def np_reference(seg, pairs, B):
+    """NumPy oracle for tests."""
+    L = len(pairs)
+    counts = np.zeros((B, L), dtype=np.int64)
+    sums = np.zeros((B, L), dtype=np.int64)
+    for k, (vals, w) in enumerate(pairs):
+        for b in range(B):
+            m = (np.asarray(seg) == b) & np.asarray(w)
+            counts[b, k] = int(m.sum())
+            sums[b, k] = int(np.asarray(vals)[m].sum()) if m.any() else 0
+    return counts, sums
