@@ -1,0 +1,74 @@
+"""Resource groups + runaway queries (ref: pkg/resourcegroup,
+resourcemanager, runaway/checker.go)."""
+
+import time
+
+import pytest
+
+import tidb_tpu
+from tidb_tpu.utils.memory import QueryKilledError
+
+
+@pytest.fixture()
+def db():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE t (a BIGINT)")
+    d.execute("INSERT INTO t VALUES (1), (2), (3)")
+    return d
+
+
+def test_create_alter_drop_group(db):
+    db.execute("CREATE RESOURCE GROUP rg1 RU_PER_SEC = 1000")
+    rows = db.query("SELECT name, ru_per_sec FROM information_schema.resource_groups ORDER BY name")
+    assert ("rg1", 1000) in rows and ("default", 0) in rows
+    db.execute("ALTER RESOURCE GROUP rg1 RU_PER_SEC = 500 BURSTABLE")
+    g = db.resource_groups.get("rg1")
+    assert g.ru_per_sec == 500 and g.burstable
+    with pytest.raises(Exception):
+        db.execute("CREATE RESOURCE GROUP rg1 RU_PER_SEC = 1")
+    db.execute("CREATE RESOURCE GROUP IF NOT EXISTS rg1 RU_PER_SEC = 1")
+    db.execute("DROP RESOURCE GROUP rg1")
+    assert db.resource_groups.get("rg1") is None
+    with pytest.raises(Exception):
+        db.execute("DROP RESOURCE GROUP default")
+
+
+def test_set_resource_group_and_accounting(db):
+    db.execute("CREATE RESOURCE GROUP rg2 RU_PER_SEC = 1000000")
+    s = db.session()
+    s.execute("SET RESOURCE GROUP rg2")
+    assert s.vars["tidb_resource_group"] == "rg2"
+    s.query("SELECT * FROM t")
+    assert db.resource_groups.get("rg2").ru_consumed > 0
+    with pytest.raises(Exception):
+        s.execute("SET RESOURCE GROUP missing")
+
+
+def test_ru_throttling_waits(db):
+    # tiny budget: the second statement must wait for bucket refill
+    db.execute("CREATE RESOURCE GROUP slow RU_PER_SEC = 20")
+    s = db.session()
+    s.execute("SET RESOURCE GROUP slow")
+    s.query("SELECT * FROM t")  # drains the bucket (3 rows + base)
+    t0 = time.monotonic()
+    s.query("SELECT * FROM t")
+    assert time.monotonic() - t0 > 0.05  # had to wait for tokens
+
+
+def test_runaway_kill(db):
+    db.execute("CREATE RESOURCE GROUP rk RU_PER_SEC = 0 QUERY_LIMIT = (EXEC_ELAPSED = '1ms', ACTION = KILL)")
+    s = db.session()
+    s.execute("SET RESOURCE GROUP rk")
+    with pytest.raises(QueryKilledError):
+        s.query("SELECT COUNT(*) FROM t")
+    rows = db.query("SELECT resource_group_name, action FROM information_schema.runaway_watches")
+    assert ("rk", "KILL") in rows
+
+
+def test_runaway_cooldown_records_only(db):
+    db.execute("CREATE RESOURCE GROUP rc RU_PER_SEC = 0 QUERY_LIMIT = (EXEC_ELAPSED = '0.0001ms', ACTION = COOLDOWN)")
+    s = db.session()
+    s.execute("SET RESOURCE GROUP rc")
+    assert s.query("SELECT COUNT(*) FROM t") == [(3,)]  # not killed
+    rows = db.query("SELECT resource_group_name, action FROM information_schema.runaway_watches")
+    assert ("rc", "COOLDOWN") in rows

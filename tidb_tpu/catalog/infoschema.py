@@ -26,6 +26,8 @@ def memtable_rows(db, session, name: str) -> Optional[tuple[list, list, list]]:
         "engines": _engines,
         "statements_summary": _statements_summary,
         "slow_query": _slow_query,
+        "resource_groups": _resource_groups,
+        "runaway_watches": _runaway_watches,
     }.get(name)
     if fn is None:
         return None
@@ -151,6 +153,29 @@ def _slow_query(db, session):
     cols = ["TIME", "QUERY", "QUERY_TIME", "RESULT_ROWS", "USER"]
     fts = [double_type(), _S(512), double_type(), _I(), _S()]
     return cols, fts, [tuple(r) for r in db.stmt_summary.slow_queries()]
+
+
+def _resource_groups(db, session):
+    from tidb_tpu.types.field_type import double_type
+
+    cols = ["NAME", "RU_PER_SEC", "BURSTABLE", "QUERY_LIMIT", "RU_CONSUMED"]
+    fts = [_S(), _I(), _S(3), _S(128), double_type()]
+    rows = []
+    for g in db.resource_groups.list():
+        ql = ""
+        if g.exec_elapsed_s:
+            ql = f"EXEC_ELAPSED={g.exec_elapsed_s}s ACTION={g.action}"
+        rows.append((g.name, g.ru_per_sec, "YES" if g.burstable else "NO", ql, g.ru_consumed))
+    return cols, fts, rows
+
+
+def _runaway_watches(db, session):
+    from tidb_tpu.types.field_type import double_type
+
+    cols = ["TIME", "RESOURCE_GROUP_NAME", "ACTION", "SAMPLE_SQL"]
+    fts = [double_type(), _S(), _S(16), _S(256)]
+    rows = [(r.time, r.group, r.action, r.sql) for r in db.resource_groups.runaway_log]
+    return cols, fts, rows
 
 
 def _engines(db, session):

@@ -485,6 +485,25 @@ class Grant(Node):
 
 
 @dataclass
+class ResourceGroupStmt(Node):
+    """CREATE/ALTER/DROP RESOURCE GROUP (ref: ast.CreateResourceGroupStmt)."""
+
+    op: str  # create | alter | drop
+    name: str
+    ru_per_sec: int = 0
+    burstable: bool = False
+    exec_elapsed_s: float = 0.0
+    action: str = "KILL"
+    if_not_exists: bool = False
+    if_exists: bool = False
+
+
+@dataclass
+class SetResourceGroup(Node):
+    name: str
+
+
+@dataclass
 class Trace(Node):
     """TRACE <stmt> (ref: ast.TraceStmt)."""
 

@@ -784,6 +784,8 @@ class Parser:
         self.expect_kw("CREATE")
         if self.eat_kw("USER"):
             return self.parse_create_user()
+        if self.at_kw("RESOURCE"):
+            return self._resource_group("create")
         if self.at_kw("DATABASE", "SCHEMA"):
             self.next()
             ine = self._if_not_exists()
@@ -925,6 +927,8 @@ class Parser:
 
     def parse_drop(self) -> ast.Node:
         self.expect_kw("DROP")
+        if self.at_kw("RESOURCE"):
+            return self._resource_group("drop")
         if self.eat_kw("USER"):
             ie = self._if_exists()
             users = [self._user_spec()]
@@ -954,8 +958,10 @@ class Parser:
             return True
         return False
 
-    def parse_alter(self) -> ast.AlterTable:
+    def parse_alter(self):
         self.expect_kw("ALTER")
+        if self.at_kw("RESOURCE"):
+            return self._resource_group("alter")
         self.expect_kw("TABLE")
         tbl = self._table_ref_simple()
         at = ast.AlterTable(tbl)
@@ -1050,8 +1056,12 @@ class Parser:
         analyze = self.eat_kw("ANALYZE")
         return ast.Explain(self.parse_statement(), analyze=analyze)
 
-    def parse_set(self) -> ast.SetVariable:
+    def parse_set(self):
         self.expect_kw("SET")
+        if self.at_kw("RESOURCE"):
+            self.next()
+            self.expect_kw("GROUP")
+            return ast.SetResourceGroup(self.ident().lower())
         scope = "session"
         if self.eat_kw("GLOBAL"):
             scope = "global"
@@ -1190,6 +1200,44 @@ class Parser:
         spec = self._user_spec()
         return ast.Grant(privs, db, table, spec.name, spec.host, revoke)
 
+    def _resource_group(self, op: str) -> ast.ResourceGroupStmt:
+        self.expect_kw("RESOURCE")
+        self.expect_kw("GROUP")
+        st = ast.ResourceGroupStmt(op, "")
+        if op == "create":
+            st.if_not_exists = self._if_not_exists()
+        if op == "drop":
+            st.if_exists = self._if_exists()
+        st.name = self.ident().lower()
+        if op == "drop":
+            return st
+        while self.peek().kind == "ident" and not self.at_op(";"):
+            kw = self.ident().upper()
+            if kw == "RU_PER_SEC":
+                self.expect_op("=")
+                st.ru_per_sec = int(self.next().value)
+            elif kw == "BURSTABLE":
+                if self.eat_op("="):
+                    self.next()
+                st.burstable = True
+            elif kw == "QUERY_LIMIT":
+                self.expect_op("=")
+                self.expect_op("(")
+                while not self.eat_op(")"):
+                    opt = self.ident().upper()
+                    self.expect_op("=")
+                    if opt == "EXEC_ELAPSED":
+                        st.exec_elapsed_s = _parse_duration(self._string_lit())
+                    elif opt == "ACTION":
+                        st.action = self.ident().upper()
+                    else:
+                        raise ParseError(f"unknown QUERY_LIMIT option {opt!r}", self.peek())
+                    self.eat_op(",")
+            else:
+                raise ParseError(f"unknown resource group option {kw!r}", self.peek())
+            self.eat_op(",")
+        return st
+
     def parse_kill(self) -> ast.Kill:
         self.expect_kw("KILL")
         query_only = True
@@ -1294,6 +1342,15 @@ class Parser:
         while self.eat_op(","):
             tables.append(self._table_ref_simple())
         return ast.AnalyzeTable(tables)
+
+
+def _parse_duration(s: str) -> float:
+    """'1s' / '500ms' / '2m' → seconds."""
+    s = s.strip().lower()
+    for suffix, mult in (("ms", 1e-3), ("s", 1.0), ("m", 60.0), ("h", 3600.0)):
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * mult
+    return float(s)
 
 
 def parse(sql: str) -> ast.Node:

@@ -1,0 +1,110 @@
+"""Resource groups, RU accounting, runaway detection.
+
+Reference parity:
+- CREATE/ALTER/DROP RESOURCE GROUP with RU_PER_SEC and QUERY_LIMIT
+  (EXEC_ELAPSED, ACTION={DRYRUN,COOLDOWN,KILL}) — ddl/resource_group.go;
+- a token bucket per group: statements consume request units (reads: rows
+  scanned; the reference's RU model maps bytes/requests to RUs — here
+  1 RU ≈ 1 returned row + a per-statement base cost);
+- the runaway checker arms a per-statement deadline from QUERY_LIMIT and
+  applies the action when it fires (runaway/checker.go), recording the
+  event for information_schema.runaway_watches.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+_BASE_RU = 0.125  # per-statement floor (ref: request unit base cost)
+
+
+@dataclass
+class RunawayRecord:
+    time: float
+    group: str
+    action: str
+    sql: str
+
+
+@dataclass
+class ResourceGroup:
+    name: str
+    ru_per_sec: int = 0  # 0 = unlimited
+    burstable: bool = False
+    # runaway rule: exec elapsed threshold in seconds; 0 = none
+    exec_elapsed_s: float = 0.0
+    action: str = "KILL"  # DRYRUN | COOLDOWN | KILL
+    # token bucket state
+    tokens: float = field(default=0.0)
+    last_refill: float = field(default_factory=time.monotonic)
+    ru_consumed: float = 0.0
+
+    def _refill(self) -> None:
+        now = time.monotonic()
+        if self.ru_per_sec > 0:
+            cap = float(self.ru_per_sec)  # 1s burst capacity
+            self.tokens = min(cap, self.tokens + (now - self.last_refill) * self.ru_per_sec)
+        self.last_refill = now
+
+    def consume(self, ru: float, max_wait_s: float = 5.0) -> float:
+        """Take ``ru`` tokens, sleeping while the bucket is empty (flow
+        control). Returns seconds waited. Unlimited groups never wait."""
+        self.ru_consumed += ru
+        if self.ru_per_sec <= 0 or self.burstable:
+            return 0.0
+        waited = 0.0
+        while True:
+            self._refill()
+            if self.tokens >= ru or waited >= max_wait_s:
+                self.tokens -= ru
+                return waited
+            need = (ru - self.tokens) / self.ru_per_sec
+            step = min(need, 0.05)
+            time.sleep(step)
+            waited += step
+
+
+class ResourceGroupManager:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._groups: dict[str, ResourceGroup] = {"default": ResourceGroup("default")}
+        self.runaway_log: list[RunawayRecord] = []
+
+    def create(self, g: ResourceGroup, if_not_exists: bool = False) -> None:
+        with self._mu:
+            if g.name in self._groups:
+                if if_not_exists:
+                    return
+                raise ValueError(f"resource group {g.name!r} already exists")
+            self._groups[g.name] = g
+
+    def alter(self, g: ResourceGroup) -> None:
+        with self._mu:
+            if g.name not in self._groups:
+                raise ValueError(f"unknown resource group {g.name!r}")
+            old = self._groups[g.name]
+            g.ru_consumed = old.ru_consumed
+            self._groups[g.name] = g
+
+    def drop(self, name: str, if_exists: bool = False) -> None:
+        with self._mu:
+            if name == "default":
+                raise ValueError("cannot drop the default resource group")
+            if name not in self._groups and not if_exists:
+                raise ValueError(f"unknown resource group {name!r}")
+            self._groups.pop(name, None)
+
+    def get(self, name: str) -> Optional[ResourceGroup]:
+        with self._mu:
+            return self._groups.get(name)
+
+    def list(self) -> list[ResourceGroup]:
+        with self._mu:
+            return list(self._groups.values())
+
+    def record_runaway(self, group: str, action: str, sql: str) -> None:
+        with self._mu:
+            self.runaway_log.append(RunawayRecord(time.time(), group, action, sql))

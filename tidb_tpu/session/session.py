@@ -41,6 +41,8 @@ DEFAULT_SYSVARS = {
     "tidb_enforce_mpp": 0,
     # slow query log threshold in ms (ref: tidb_slow_log_threshold)
     "tidb_slow_log_threshold": 300,
+    # session resource group (ref: tidb_resource_control + resource groups)
+    "tidb_resource_group": "default",
     # IMPORT INTO via the distributed task framework (ref:
     # tidb_enable_dist_task; default off — direct load is faster in-process)
     "tidb_enable_dist_task": 0,
@@ -237,9 +239,19 @@ class Session:
                 sql, dt, len(res.rows) or res.affected, f"{self.user}@{self.host}",
                 float(self.vars.get("tidb_slow_log_threshold", 300)) / 1000.0,
             )
+            # resource-group accounting + runaway detection (ref:
+            # RunawayChecker at adapter.go:553; RU model per request)
+            g = self._db.resource_groups.get(str(self.vars.get("tidb_resource_group", "default")))
+            if g is not None:
+                g.consume(0.125 + (len(res.rows) or res.affected))
+                if g.exec_elapsed_s and dt > g.exec_elapsed_s:
+                    self._db.resource_groups.record_runaway(g.name, g.action, sql[:256])
             return res
         except Exception:
             _m.STMT_TOTAL.inc(type=f"{stype}:error")
+            g = self._db.resource_groups.get(str(self.vars.get("tidb_resource_group", "default")))
+            if g is not None and g.exec_elapsed_s and (_time.perf_counter() - t0) >= g.exec_elapsed_s:
+                self._db.resource_groups.record_runaway(g.name, g.action, sql[:256])
             if not self._explicit and self._txn is not None:
                 # autocommit statement failed → roll back its staged writes
                 self._finish_txn(commit=False)
@@ -324,6 +336,30 @@ class Session:
             return self._explain(stmt)
         if isinstance(stmt, ast.AnalyzeTable):
             return self._analyze(stmt)
+        if isinstance(stmt, ast.ResourceGroupStmt):
+            from tidb_tpu.resourcegroup import ResourceGroup
+
+            mgr = self._db.resource_groups
+            if stmt.op == "drop":
+                mgr.drop(stmt.name, stmt.if_exists)
+            else:
+                g = ResourceGroup(
+                    stmt.name,
+                    ru_per_sec=stmt.ru_per_sec,
+                    burstable=stmt.burstable,
+                    exec_elapsed_s=stmt.exec_elapsed_s,
+                    action=stmt.action,
+                )
+                if stmt.op == "create":
+                    mgr.create(g, stmt.if_not_exists)
+                else:
+                    mgr.alter(g)
+            return Result()
+        if isinstance(stmt, ast.SetResourceGroup):
+            if self._db.resource_groups.get(stmt.name) is None:
+                raise SessionError(f"unknown resource group {stmt.name!r}")
+            self.vars["tidb_resource_group"] = stmt.name
+            return Result()
         if isinstance(stmt, ast.Trace):
             from tidb_tpu.utils.tracing import Tracer
 
@@ -571,7 +607,13 @@ class Session:
 
         self.mem_tracker = Tracker("query", int(self.vars.get("tidb_mem_quota_query", 1 << 30)))
         met = float(self.vars.get("max_execution_time", 0) or 0)
-        self._deadline = (time.monotonic() + met / 1000.0) if met > 0 else None
+        limits = [met / 1000.0] if met > 0 else []
+        # runaway KILL rule arms the same statement deadline (ref: runaway
+        # checker registering a kill timer)
+        g = self._db.resource_groups.get(str(self.vars.get("tidb_resource_group", "default")))
+        if g is not None and g.exec_elapsed_s and g.action == "KILL":
+            limits.append(g.exec_elapsed_s)
+        self._deadline = (time.monotonic() + min(limits)) if limits else None
         try:
             with self.span("plan"):
                 plan = self._plan_select(stmt, cache_key=cache_key)
@@ -906,9 +948,11 @@ class DB:
 
         self.gc_worker = GCWorker(self.store)
         self.stats = StatsHandle()
+        from tidb_tpu.resourcegroup import ResourceGroupManager
         from tidb_tpu.utils.stmtsummary import StmtSummary
 
         self.stmt_summary = StmtSummary()
+        self.resource_groups = ResourceGroupManager()
         # privilege state: grant tables bootstrap lazily (first auth/grant);
         # the cache keys on priv_version (ref: privilege reload notification)
         self.priv_version = 0
