@@ -1,0 +1,79 @@
+"""Views + ADMIN statements (ref: ddl CreateView/BuildDataSourceFromView,
+executor/admin.go)."""
+
+import pytest
+
+import tidb_tpu
+
+
+@pytest.fixture()
+def db():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, g VARCHAR(10), v BIGINT)")
+    d.execute("INSERT INTO t VALUES (1, 'a', 10), (2, 'b', 20), (3, 'a', 30)")
+    return d
+
+
+def test_create_query_drop_view(db):
+    db.execute("CREATE VIEW va AS SELECT g, SUM(v) AS total FROM t GROUP BY g")
+    s = db.session()
+    assert s.query("SELECT * FROM va ORDER BY g") == [("a", 40), ("b", 20)]
+    assert s.query("SELECT total FROM va WHERE g = 'a'") == [(40,)]
+    # join a view with a table
+    assert s.query(
+        "SELECT t.id FROM t, va WHERE t.g = va.g AND va.total > 30 ORDER BY t.id"
+    ) == [(1,), (3,)]
+    # view reflects new data (not materialized)
+    db.execute("INSERT INTO t VALUES (4, 'b', 5)")
+    assert s.query("SELECT total FROM va WHERE g = 'b'") == [(25,)]
+    # shows up in catalogs
+    assert ("va",) in db.query("SHOW TABLES")
+    rows = db.query("SELECT table_name, table_type FROM information_schema.tables WHERE table_schema = 'test'")
+    assert ("va", "VIEW") in rows
+    db.execute("DROP VIEW va")
+    with pytest.raises(Exception):
+        s.query("SELECT * FROM va")
+
+
+def test_view_column_renames_and_replace(db):
+    db.execute("CREATE VIEW v2 (grp, cnt) AS SELECT g, COUNT(*) FROM t GROUP BY g")
+    s = db.session()
+    assert s.query("SELECT grp, cnt FROM v2 ORDER BY grp") == [("a", 2), ("b", 1)]
+    with pytest.raises(Exception):
+        db.execute("CREATE VIEW v2 AS SELECT 1 FROM t")
+    db.execute("CREATE OR REPLACE VIEW v2 AS SELECT id FROM t WHERE v > 15")
+    assert s.query("SELECT * FROM v2 ORDER BY id") == [(2,), (3,)]
+
+
+def test_view_of_view_and_depth_guard(db):
+    db.execute("CREATE VIEW v1 AS SELECT id, v FROM t WHERE v >= 20")
+    db.execute("CREATE VIEW v2 AS SELECT id FROM v1 WHERE v = 30")
+    assert db.query("SELECT * FROM v2") == [(3,)]
+
+
+def test_admin_check_table(db):
+    db.execute("CREATE INDEX ig ON t (g)")
+    db.execute("ADMIN CHECK TABLE t")  # consistent → no error
+    db.execute("ADMIN CHECK INDEX t ig")
+    # corrupt the index: delete one entry behind the executor's back
+    t = db.catalog.table("test", "t")
+    idx = next(i for i in t.indexes if i.name == "ig")
+    from tidb_tpu.executor.write import index_entry
+    from tidb_tpu.kv.rowcodec import RowSchema, decode_row
+    from tidb_tpu.kv import tablecodec
+
+    txn = db.store.begin()
+    schema = RowSchema(t.storage_schema)
+    k, v = txn.scan(tablecodec.record_range(t.id), limit=1)[0]
+    handle = tablecodec.decode_record_key(k)[1]
+    ik, _ = index_entry(t, idx, decode_row(schema, v), handle)
+    txn.delete(ik)
+    txn.commit()
+    with pytest.raises(Exception):
+        db.execute("ADMIN CHECK TABLE t")
+
+
+def test_admin_show_ddl_jobs(db):
+    db.execute("CREATE INDEX ix ON t (v)")
+    rows = db.query("ADMIN SHOW DDL JOBS")
+    assert rows and rows[0][1] == "add_index" and rows[0][2] == "done"

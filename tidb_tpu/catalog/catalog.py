@@ -263,6 +263,38 @@ class Catalog:
             self._persist()
             return t
 
+    # -- views (ref: ddl CreateView / model.ViewInfo) ------------------------
+    def create_view(self, db: str, stmt: ast.CreateView) -> None:
+        from tidb_tpu.catalog.schema import ViewInfo
+
+        with self._mu:
+            dbi = self.db(db)
+            name = stmt.table.name.lower()
+            if name in dbi.tables:
+                raise CatalogError(f"'{name}' is not a view (a table exists)")
+            if name in dbi.views and not stmt.or_replace:
+                raise CatalogError(f"View {name!r} already exists")
+            dbi.views[name] = ViewInfo(name, stmt.text, stmt.columns)
+            self._persist()
+
+    def drop_view(self, db: str, name: str, if_exists: bool = False) -> None:
+        with self._mu:
+            dbi = self.db(db)
+            if name.lower() not in dbi.views:
+                if if_exists:
+                    return
+                raise CatalogError(f"Unknown view '{name}'")
+            del dbi.views[name.lower()]
+            self._persist()
+
+    def view(self, db: str, name: str):
+        dbi = self._dbs.get(db.lower())
+        return dbi.views.get(name.lower()) if dbi else None
+
+    def views(self, db: str) -> list[str]:
+        dbi = self._dbs.get(db.lower())
+        return sorted(dbi.views.keys()) if dbi else []
+
     def register_restored_table(self, db: str, old: TableInfo) -> TableInfo:
         """RESTORE path: adopt a backed-up table's schema under fresh physical
         ids (ref: BR rewriting table ids on restore)."""

@@ -56,6 +56,9 @@ def _tables(db, session):
         nrows = st.row_count if st is not None else 0
         opts = "partitioned" if t.partition is not None else ""
         rows.append(("def", dname, t.name, "BASE TABLE", "tpu", nrows, t.id, opts))
+    for dname in sorted(db.catalog.databases()):
+        for vname in db.catalog.views(dname):
+            rows.append(("def", dname, vname, "VIEW", None, None, None, ""))
     return cols, fts, rows
 
 

@@ -218,13 +218,36 @@ class TableInfo:
 
 
 @dataclass
+class ViewInfo:
+    name: str
+    text: str  # the defining SELECT, as SQL
+    columns: list[str] = field(default_factory=list)  # optional renames
+
+    def to_pb(self) -> dict:
+        return {"name": self.name, "text": self.text, "columns": self.columns}
+
+    @staticmethod
+    def from_pb(pb: dict) -> "ViewInfo":
+        return ViewInfo(pb["name"], pb["text"], pb.get("columns", []))
+
+
+@dataclass
 class DBInfo:
     name: str
     tables: dict[str, TableInfo] = field(default_factory=dict)
+    views: dict[str, ViewInfo] = field(default_factory=dict)
 
     def to_pb(self) -> dict:
-        return {"name": self.name, "tables": {k: t.to_pb() for k, t in self.tables.items()}}
+        return {
+            "name": self.name,
+            "tables": {k: t.to_pb() for k, t in self.tables.items()},
+            "views": {k: v.to_pb() for k, v in self.views.items()},
+        }
 
     @staticmethod
     def from_pb(pb: dict) -> "DBInfo":
-        return DBInfo(pb["name"], {k: TableInfo.from_pb(t) for k, t in pb["tables"].items()})
+        return DBInfo(
+            pb["name"],
+            {k: TableInfo.from_pb(t) for k, t in pb["tables"].items()},
+            {k: ViewInfo.from_pb(v) for k, v in pb.get("views", {}).items()},
+        )

@@ -312,6 +312,23 @@ class CreateTable(Node):
 
 
 @dataclass
+class CreateView(Node):
+    """CREATE [OR REPLACE] VIEW v [(cols)] AS <select> — definition kept as
+    SQL text (ref: model.ViewInfo.SelectStmt)."""
+
+    table: TableRef
+    columns: list[str]
+    text: str
+    or_replace: bool = False
+
+
+@dataclass
+class DropView(Node):
+    tables: list[TableRef]
+    if_exists: bool = False
+
+
+@dataclass
 class DropTable(Node):
     tables: list[TableRef]
     if_exists: bool = False
@@ -508,6 +525,15 @@ class Trace(Node):
     """TRACE <stmt> (ref: ast.TraceStmt)."""
 
     stmt: Node
+
+
+@dataclass
+class Admin(Node):
+    """ADMIN CHECK TABLE / CHECK INDEX / SHOW DDL JOBS (ref: ast.AdminStmt)."""
+
+    kind: str  # check_table | check_index | show_ddl_jobs
+    table: Optional[TableRef] = None
+    index: str = ""
 
 
 @dataclass

@@ -30,6 +30,7 @@ RESERVED = frozenset(
 
 class Parser:
     def __init__(self, sql: str):
+        self.sql = sql
         self.toks = tokenize(sql)
         self.i = 0
         self.param_count = 0  # `?` markers seen so far (prepared statements)
@@ -118,6 +119,7 @@ class Parser:
             "GRANT": self.parse_grant,
             "REVOKE": self.parse_grant,
             "TRACE": lambda: (self.next(), ast.Trace(self.parse_statement()))[1],
+            "ADMIN": self.parse_admin,
         }.get(kw)
         if fn is None:
             raise ParseError("unsupported statement", t)
@@ -786,6 +788,26 @@ class Parser:
             return self.parse_create_user()
         if self.at_kw("RESOURCE"):
             return self._resource_group("create")
+        or_replace = False
+        if self.at_kw("OR"):
+            self.next()
+            self.expect_kw("REPLACE")
+            or_replace = True
+        if self.eat_kw("VIEW"):
+            tbl = self._table_ref_simple()
+            cols: list[str] = []
+            if self.eat_op("("):
+                cols.append(self.ident())
+                while self.eat_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+            self.expect_kw("AS")
+            start = self.peek().pos
+            self.parse_select_stmt()  # validate the definition now
+            text = self.sql[start:].rstrip().rstrip(";")
+            return ast.CreateView(tbl, [c.lower() for c in cols], text, or_replace)
+        if or_replace:
+            raise ParseError("OR REPLACE only applies to CREATE VIEW", self.peek())
         if self.at_kw("DATABASE", "SCHEMA"):
             self.next()
             ine = self._if_not_exists()
@@ -944,6 +966,12 @@ class Parser:
             name = self.ident()
             self.expect_kw("ON")
             return ast.DropIndex(name, self._table_ref_simple())
+        if self.eat_kw("VIEW"):
+            ie = self._if_exists()
+            tables = [self._table_ref_simple()]
+            while self.eat_op(","):
+                tables.append(self._table_ref_simple())
+            return ast.DropView(tables, ie)
         self.expect_kw("TABLE")
         ie = self._if_exists()
         tables = [self._table_ref_simple()]
@@ -1237,6 +1265,19 @@ class Parser:
                 raise ParseError(f"unknown resource group option {kw!r}", self.peek())
             self.eat_op(",")
         return st
+
+    def parse_admin(self) -> ast.Admin:
+        self.expect_kw("ADMIN")
+        if self.eat_kw("CHECK"):
+            if self.eat_kw("TABLE"):
+                return ast.Admin("check_table", self._table_ref_simple())
+            self.expect_kw("INDEX")
+            tbl = self._table_ref_simple()
+            return ast.Admin("check_index", tbl, self.ident().lower())
+        self.expect_kw("SHOW")
+        self.expect_kw("DDL")
+        self.expect_kw("JOBS")
+        return ast.Admin("show_ddl_jobs")
 
     def parse_kill(self) -> ast.Kill:
         self.expect_kw("KILL")

@@ -128,6 +128,7 @@ class Builder:
         self.global_vars = global_vars if global_vars is not None else sys_vars
         self.memtable_provider = memtable_provider
         self.scan_checker = scan_checker  # privilege hook per scanned table
+        self._view_depth = 0
         # set when the built plan bakes in plan-time state (subquery results,
         # variable reads) and must not enter the plan cache
         self.uncacheable = False
@@ -620,6 +621,29 @@ class Builder:
                     schema=[OutCol(nm, ft, table=alias) for nm, ft in zip(names, ftypes)],
                 )
                 return ms
+            view = self.catalog.view(db, node.name) if hasattr(self.catalog, "view") else None
+            if view is not None:
+                # expand the view definition as a derived table (ref:
+                # planbuilder BuildDataSourceFromView)
+                if self._view_depth >= 8:
+                    raise PlanError(f"view nesting too deep at '{node.name}'")
+                from tidb_tpu.parser import parse
+
+                self._view_depth += 1
+                try:
+                    sub = self.build_query(parse(view.text))
+                finally:
+                    self._view_depth -= 1
+                alias = node.alias or node.name
+                if view.columns:
+                    if len(view.columns) != len(sub.schema):
+                        raise PlanError(f"view '{node.name}' column count mismatch")
+                    for oc, nm in zip(sub.schema, view.columns):
+                        oc.name = nm
+                for oc in sub.schema:
+                    oc.table = alias
+                self.uncacheable = True  # definition text can change
+                return sub
             t = self.catalog.table(db, node.name)
             if self.scan_checker is not None:
                 self.scan_checker(db, node.name)

@@ -283,6 +283,15 @@ class Session:
             # stats modify counter feeds auto-analyze (ref: stats delta dump)
             self.note_table_mods(t.id, res.affected)
             return res
+        if isinstance(stmt, ast.CreateView):
+            self.require_priv(stmt.table.db or self.current_db, stmt.table.name, "create")
+            self.catalog.create_view(stmt.table.db or self.current_db, stmt)
+            return Result()
+        if isinstance(stmt, ast.DropView):
+            for tr in stmt.tables:
+                self.require_priv(tr.db or self.current_db, tr.name, "drop")
+                self.catalog.drop_view(tr.db or self.current_db, tr.name, stmt.if_exists)
+            return Result()
         if isinstance(stmt, ast.CreateTable):
             self.require_priv(stmt.table.db or self.current_db, stmt.table.name, "create")
             self.catalog.create_table(stmt.table.db or self.current_db, stmt)
@@ -336,6 +345,8 @@ class Session:
             return self._explain(stmt)
         if isinstance(stmt, ast.AnalyzeTable):
             return self._analyze(stmt)
+        if isinstance(stmt, ast.Admin):
+            return self._admin(stmt)
         if isinstance(stmt, ast.ResourceGroupStmt):
             from tidb_tpu.resourcegroup import ResourceGroup
 
@@ -422,6 +433,32 @@ class Session:
             del self.prepared[stmt.name]
             return Result()
         raise SessionError(f"unsupported statement {type(stmt).__name__}")
+
+    # -- ADMIN statements (ref: executor/admin.go) ---------------------------
+    def _admin(self, stmt: ast.Admin) -> Result:
+        from tidb_tpu.catalog.ddl import admin_check_index
+
+        if stmt.kind == "show_ddl_jobs":
+            rows = [
+                (j.id, j.tp, j.state, j.db, j.table_id)
+                for j in reversed(self.catalog.ddl.history())
+            ]
+            return Result(columns=["JOB_ID", "JOB_TYPE", "STATE", "DB_NAME", "TABLE_ID"], rows=rows)
+        t = self.catalog.table(stmt.table.db or self.current_db, stmt.table.name)
+        if stmt.kind == "check_index":
+            idx = next((i for i in t.indexes if i.name == stmt.index), None)
+            if idx is None:
+                raise SessionError(f"unknown index {stmt.index!r}")
+            for view in t.partition_views():
+                admin_check_index(self.store, view, idx)
+            return Result()
+        # check_table: every public index
+        for idx in t.indexes:
+            if idx.state != "public":
+                continue
+            for view in t.partition_views():
+                admin_check_index(self.store, view, idx)
+        return Result()
 
     # -- privileges (ref: executor/grant.go, revoke.go, simple.go users) -----
     def require_priv(self, db: str, table: str, priv: str) -> None:
@@ -824,7 +861,8 @@ class Session:
             rows = server.processlist() if server is not None else []
             return Result(columns=["Id", "User", "db", "Command", "Info"], rows=rows)
         if stmt.kind == "tables":
-            rows = [(t,) for t in self.catalog.tables(self.current_db)]
+            names = sorted(set(self.catalog.tables(self.current_db)) | set(self.catalog.views(self.current_db)))
+            rows = [(t,) for t in names]
             if stmt.like:
                 import re
 
