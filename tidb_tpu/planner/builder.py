@@ -129,6 +129,7 @@ class Builder:
         self.memtable_provider = memtable_provider
         self.scan_checker = scan_checker  # privilege hook per scanned table
         self._view_depth = 0
+        self.hints: list = []  # current query block's optimizer hints
         # set when the built plan bakes in plan-time state (subquery results,
         # variable reads) and must not enter the plan cache
         self.uncacheable = False
@@ -202,6 +203,14 @@ class Builder:
         return proj
 
     def build_select(self, sel: ast.Select) -> LogicalPlan:
+        prev_hints = self.hints
+        self.hints = getattr(sel, "hints", []) or prev_hints
+        try:
+            return self._build_select(sel)
+        finally:
+            self.hints = prev_hints
+
+    def _build_select(self, sel: ast.Select) -> LogicalPlan:
         if sel.from_ is None:
             plan: LogicalPlan = LogicalDual()
         else:
@@ -649,6 +658,13 @@ class Builder:
                 self.scan_checker(db, node.name)
             alias = node.alias or node.name
             scan = LogicalScan(db=db, table=t, alias=alias)
+            for hname, hargs in self.hints:
+                if hname in ("use_index", "ignore_index") and len(hargs) >= 2:
+                    if hargs[0].strip().lower() in (alias.lower(), node.name.lower()):
+                        if hname == "use_index":
+                            scan.use_index = hargs[1].strip().lower()
+                        else:
+                            scan.ignore_index = hargs[1].strip().lower()
             scan.schema = [
                 OutCol(c.name, c.ftype, table=alias, slot=c.offset) for c in t.columns
             ]

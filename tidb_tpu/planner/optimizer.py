@@ -307,6 +307,12 @@ def _choose_index_path(scan: LogicalScan, conds: list[Expression], stats=None):
     point (eq/IN) leading-column conditions — the one reliably-cheaper case.
     PK handle ranges are handled by _derive_ranges on the table-reader path."""
     t = scan.table
+    if scan.use_index is not None:
+        idx = next((i for i in t.indexes if i.name == scan.use_index and i.state == "public"), None)
+        if idx is not None:
+            forced = _index_path_for(scan, idx, conds)
+            if forced is not None:
+                return forced
     if t.partition is not None:
         # partitioned tables read via pruned per-partition table scans;
         # local-index access paths are a later round (ref: TiDB dynamic
@@ -321,8 +327,8 @@ def _choose_index_path(scan: LogicalScan, conds: list[Expression], stats=None):
         # full columnar scan baseline: sequential, device-friendly
         best_cost = float(total) * _COST_TABLE_ROW
         for idx in t.indexes:
-            if idx.state != "public":
-                continue  # in-flight online-DDL indexes are not readable
+            if idx.state != "public" or idx.name == scan.ignore_index:
+                continue  # in-flight online-DDL / hint-ignored indexes
             acc = ranger.detach_index_conditions(conds, scan.schema, t, idx)
             if acc is None or not acc.used:
                 continue
@@ -337,8 +343,8 @@ def _choose_index_path(scan: LogicalScan, conds: list[Expression], stats=None):
                 best = ((), acc)
     else:
         for idx in t.indexes:
-            if idx.state != "public":
-                continue  # in-flight online-DDL indexes are not readable
+            if idx.state != "public" or idx.name == scan.ignore_index:
+                continue  # in-flight online-DDL / hint-ignored indexes
             acc = ranger.detach_index_conditions(conds, scan.schema, t, idx)
             if acc is None or acc.eq_prefix_len == 0:
                 continue
@@ -352,7 +358,20 @@ def _choose_index_path(scan: LogicalScan, conds: list[Expression], stats=None):
         hr = ranger.derive_handle_ranges(conds, scan.schema, t)
         if hr is not None and hr[1] == 1:
             return None
-    acc = best[1]
+    return _build_index_access(scan, best[1], conds)
+
+
+def _index_path_for(scan: LogicalScan, idx, conds: list[Expression]):
+    """USE_INDEX hint: force an access path over ``idx`` when any range can
+    be derived from the conditions."""
+    acc = ranger.detach_index_conditions(conds, scan.schema, scan.table, idx)
+    if acc is None:
+        return None
+    return _build_index_access(scan, acc, conds)
+
+
+def _build_index_access(scan: LogicalScan, acc, conds: list[Expression]):
+    t = scan.table
     covering = all(
         oc.slot in acc.index.column_offsets or (t.pk_is_handle and oc.slot == t.pk_offset)
         for oc in scan.schema

@@ -47,6 +47,9 @@ class LogicalScan(LogicalPlan):
     children: list = field(default_factory=list)
     # filled by predicate pushdown / range derivation
     ranges: Optional[list[KeyRange]] = None
+    # optimizer hints targeting this table (ref: USE_INDEX/IGNORE_INDEX)
+    use_index: Optional[str] = None
+    ignore_index: Optional[str] = None
 
 
 @dataclass

@@ -123,6 +123,8 @@ class Session:
         self.mem_tracker = None
         self._killed = False
         self._deadline: Optional[float] = None
+        # session-scoped plan bindings (override globals; ref: bindinfo scope)
+        self.bindings: dict[str, tuple[str, str]] = {}
         # user variables (@x) and prepared statements (session-scoped)
         self.user_vars: dict[str, Any] = {}
         self.prepared: dict[str, PreparedStmt] = {}
@@ -228,6 +230,16 @@ class Session:
         with self.span("parse"):
             stmt = parse(sql)
         stype = type(stmt).__name__
+        # plan bindings: a bound statement with a matching digest replaces
+        # the incoming one (ref: bindinfo matching by normalized digest)
+        if isinstance(stmt, (ast.Select, ast.SetOp)) and (self.bindings or self._db.bindings):
+            from tidb_tpu.utils.stmtsummary import digest as _digest
+
+            d = _digest(sql)
+            bound = self.bindings.get(d) or self._db.bindings.get(d)
+            if bound is not None:
+                sql = bound[1]
+                stmt = parse(sql)
         try:
             res = self._execute_stmt(stmt, sql_text=sql)
             if not self._explicit and self._txn is not None:
@@ -345,6 +357,18 @@ class Session:
             return self._explain(stmt)
         if isinstance(stmt, ast.AnalyzeTable):
             return self._analyze(stmt)
+        if isinstance(stmt, ast.CreateBinding):
+            from tidb_tpu.utils.stmtsummary import digest as _digest
+
+            store = self._db.bindings if stmt.is_global else self.bindings
+            store[_digest(stmt.for_text)] = (stmt.for_text, stmt.using_text)
+            return Result()
+        if isinstance(stmt, ast.DropBinding):
+            from tidb_tpu.utils.stmtsummary import digest as _digest
+
+            store = self._db.bindings if stmt.is_global else self.bindings
+            store.pop(_digest(stmt.for_text), None)
+            return Result()
         if isinstance(stmt, ast.Admin):
             return self._admin(stmt)
         if isinstance(stmt, ast.ResourceGroupStmt):
@@ -644,6 +668,12 @@ class Session:
 
         self.mem_tracker = Tracker("query", int(self.vars.get("tidb_mem_quota_query", 1 << 30)))
         met = float(self.vars.get("max_execution_time", 0) or 0)
+        for hname, hargs in getattr(stmt, "hints", []) or []:
+            if hname == "max_execution_time" and hargs:
+                try:
+                    met = float(hargs[0])
+                except ValueError:
+                    pass
         limits = [met / 1000.0] if met > 0 else []
         # runaway KILL rule arms the same statement deadline (ref: runaway
         # checker registering a kill timer)
@@ -794,6 +824,17 @@ class Session:
         )
         logical = builder.build_query(stmt)
         engines = [e.strip() for e in str(self.vars["tidb_isolation_read_engines"]).split(",") if e.strip()]
+        # READ_FROM_STORAGE hint overrides engine isolation for the statement
+        # (ref: isolation-read + read_from_storage hint interplay)
+        for hname, hargs in getattr(stmt, "hints", []) or []:
+            if hname == "read_from_storage" and hargs:
+                hinted = []
+                for a in hargs:
+                    eng = a.split("[")[0].strip().lower()
+                    if eng in ("tpu", "host", "tikv", "tiflash") and eng not in hinted:
+                        hinted.append({"tikv": "host", "tiflash": "tpu"}.get(eng, eng))
+                if hinted:
+                    engines = hinted
         plan = optimize(logical, engines, stats=self._db.stats)
         from tidb_tpu.parallel.gather import try_mpp_rewrite
 
@@ -849,6 +890,12 @@ class Session:
     def _show(self, stmt: ast.Show) -> Result:
         if stmt.kind in ("stats_histograms", "stats_topn", "stats_buckets"):
             return self._show_stats(stmt.kind)
+        if stmt.kind == "bindings":
+            rows = []
+            for scope, store in (("session", self.bindings), ("global", self._db.bindings)):
+                for d, (for_text, using_text) in store.items():
+                    rows.append((for_text, using_text, scope))
+            return Result(columns=["Original_sql", "Bind_sql", "Scope"], rows=rows)
         if stmt.kind == "grants":
             if stmt.target:
                 user, _, host = stmt.target.partition("@")
@@ -991,6 +1038,9 @@ class DB:
 
         self.stmt_summary = StmtSummary()
         self.resource_groups = ResourceGroupManager()
+        # global SQL plan bindings: digest → (for_text, using_text)
+        # (ref: pkg/bindinfo binding_handle)
+        self.bindings: dict[str, tuple[str, str]] = {}
         # privilege state: grant tables bootstrap lazily (first auth/grant);
         # the cache keys on priv_version (ref: privilege reload notification)
         self.priv_version = 0
