@@ -1,0 +1,25 @@
+"""benchdb CLI + benchdaily JSON harness (ref: cmd/benchdb, util/benchdaily)."""
+
+import json
+
+import tidb_tpu
+from tidb_tpu.bench.benchdb import run_jobs
+from tidb_tpu.bench.benchdaily import run_all
+
+
+def test_benchdb_jobs():
+    db = tidb_tpu.open()
+    recs = run_jobs(db, "create,insert:500,update-random:20,select:20,query:5,analyze,delete:100,gc")
+    assert [r["job"].split(":")[0] for r in recs] == [
+        "create", "insert", "update-random", "select", "query", "analyze", "delete", "gc",
+    ]
+    assert all(r["seconds"] >= 0 for r in recs)
+    assert db.query("SELECT COUNT(*) FROM bench_db") == [(400,)]
+
+
+def test_benchdaily_json(tmp_path):
+    recs = run_all(["BenchmarkChunkCodec"])
+    assert len(recs) == 1 and recs[0]["ops_per_sec"] > 0 and recs[0]["date"]
+    p = tmp_path / "daily.json"
+    p.write_text(json.dumps(recs))
+    assert json.loads(p.read_text())[0]["name"] == "BenchmarkChunkCodec"

@@ -1,0 +1,2 @@
+"""Benchmark harnesses (ref: cmd/benchdb workload CLI + util/benchdaily
+JSON trend emitter)."""

@@ -1,0 +1,137 @@
+"""benchdaily: registered micro-benchmarks serialized to JSON for trend
+tracking (ref: pkg/util/benchdaily/bench_daily.go — the daily-regression
+harness CI feeds from).
+
+    python -m tidb_tpu.bench.benchdaily --out bench_daily.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import time
+from typing import Callable
+
+_BENCHES: dict[str, Callable[[], float]] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _BENCHES[name] = fn
+        return fn
+
+    return deco
+
+
+def _time_ops(fn, n: int) -> float:
+    t0 = time.perf_counter()
+    fn()
+    dt = time.perf_counter() - t0
+    return n / dt if dt > 0 else float("inf")
+
+
+@register("BenchmarkBulkLoad")
+def bench_bulk_load() -> float:
+    import numpy as np
+
+    import tidb_tpu
+    from tidb_tpu.executor.load import bulk_load
+
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE b (id BIGINT PRIMARY KEY, v BIGINT, s VARCHAR(16))")
+    n = 200_000
+    cols = [
+        np.arange(n, dtype=np.int64),
+        np.arange(n, dtype=np.int64) * 2,
+        [b"abcdefgh"] * n,
+    ]
+    return _time_ops(lambda: bulk_load(db, "b", cols), n)
+
+
+@register("BenchmarkPointGet")
+def bench_point_get() -> float:
+    import tidb_tpu
+
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE p (id BIGINT PRIMARY KEY, v BIGINT)")
+    db.execute("INSERT INTO p VALUES " + ",".join(f"({i},{i})" for i in range(1000)))
+    s = db.session()
+    n = 2000
+
+    def run():
+        for i in range(n):
+            s.query(f"SELECT v FROM p WHERE id = {i % 1000}")
+
+    return _time_ops(run, n)
+
+
+@register("BenchmarkHostAggQ1")
+def bench_host_agg() -> float:
+    import numpy as np
+
+    import tidb_tpu
+    from tidb_tpu.executor.load import bulk_load
+
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE a (id BIGINT PRIMARY KEY, g BIGINT, v BIGINT)")
+    n = 200_000
+    rng = np.random.default_rng(0)
+    bulk_load(db, "a", [np.arange(n, dtype=np.int64), rng.integers(0, 5, n), rng.integers(0, 1000, n)])
+    s = db.session()
+    s.execute("SET tidb_isolation_read_engines = 'host'")
+
+    def run():
+        for _ in range(5):
+            s.query("SELECT g, COUNT(*), SUM(v) FROM a GROUP BY g")
+
+    return _time_ops(run, 5 * n)
+
+
+@register("BenchmarkChunkCodec")
+def bench_chunk_codec() -> float:
+    import numpy as np
+
+    from tidb_tpu.types.field_type import bigint_type
+    from tidb_tpu.utils.chunk import Chunk, Column, decode_chunk, encode_chunk
+
+    n = 500_000
+    ch = Chunk([Column(np.arange(n, dtype=np.int64), np.ones(n, bool), bigint_type())] * 4)
+
+    def run():
+        for _ in range(10):
+            decode_chunk(encode_chunk(ch))
+
+    return _time_ops(run, 10 * n)
+
+
+def run_all(names=None) -> list[dict]:
+    out = []
+    for name, fn in _BENCHES.items():
+        if names and name not in names:
+            continue
+        ops = fn()
+        out.append(
+            {
+                "name": name,
+                "ops_per_sec": round(ops),
+                "date": datetime.date.today().isoformat(),
+            }
+        )
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="bench_daily.json")
+    ap.add_argument("--only", nargs="*", default=None)
+    args = ap.parse_args(argv)
+    records = run_all(args.only)
+    with open(args.out, "w") as f:
+        json.dump(records, f, indent=1)
+    for r in records:
+        print(f"{r['name']:<28} {r['ops_per_sec']:>12,} ops/s")
+
+
+if __name__ == "__main__":
+    main()
