@@ -1,0 +1,59 @@
+"""Case-insensitive collation + JSON type and functions
+(ref: util/collate general_ci, types/json + builtin_json)."""
+
+import pytest
+
+import tidb_tpu
+
+
+@pytest.fixture()
+def db():
+    return tidb_tpu.open()
+
+
+def test_ci_collation(db):
+    db.execute("CREATE TABLE c (s VARCHAR(20) COLLATE utf8mb4_general_ci, b VARCHAR(20))")
+    db.execute("INSERT INTO c VALUES ('Abc', 'Abc'), ('abc', 'abc'), ('ABD', 'ABD')")
+    s = db.session()
+    # ci column folds; bin column doesn't
+    assert s.query("SELECT COUNT(*) FROM c WHERE s = 'abc'") == [(2,)]
+    assert s.query("SELECT COUNT(*) FROM c WHERE b = 'abc'") == [(1,)]
+    assert s.query("SELECT b FROM c WHERE s = 'ABC' ORDER BY b") == [("Abc",), ("abc",)]
+    # ordering comparisons fold too
+    assert s.query("SELECT COUNT(*) FROM c WHERE s < 'ABD'") == [(2,)]
+    # engine parity (ci predicates stay host-side via pushdown legality)
+    s.execute("SET tidb_isolation_read_engines = 'tpu,host'")
+    assert s.query("SELECT COUNT(*) FROM c WHERE s = 'abc'") == [(2,)]
+
+
+def test_json_type_roundtrip(db):
+    db.execute("CREATE TABLE j (id BIGINT PRIMARY KEY, d JSON)")
+    db.execute("""INSERT INTO j VALUES (1, '{"a": 1, "b": [10, 20], "s": "x"}'), (2, NULL), (3, '[1, 2, 3]')""")
+    s = db.session()
+    assert s.query("SELECT d FROM j WHERE id = 2") == [(None,)]
+    # normalized storage
+    (doc,) = s.query("SELECT d FROM j WHERE id = 1")[0]
+    assert '"a": 1' in doc
+    # invalid JSON rejected
+    with pytest.raises(Exception):
+        db.execute("INSERT INTO j VALUES (9, '{broken')")
+    # type surfaces as JSON
+    rows = s.query("SELECT data_type FROM information_schema.columns WHERE table_name = 'j' AND column_name = 'd'")
+    assert rows == [("json",)]
+
+
+def test_json_functions(db):
+    db.execute("CREATE TABLE j (id BIGINT PRIMARY KEY, d JSON)")
+    db.execute("""INSERT INTO j VALUES (1, '{"a": 1, "b": [10, 20], "s": "x"}'), (2, '[5, 6]')""")
+    s = db.session()
+    assert s.query("SELECT JSON_EXTRACT(d, '$.a') FROM j WHERE id = 1") == [("1",)]
+    assert s.query("SELECT JSON_EXTRACT(d, '$.b[1]') FROM j WHERE id = 1") == [("20",)]
+    assert s.query("SELECT JSON_EXTRACT(d, '$.missing') FROM j WHERE id = 1") == [(None,)]
+    assert s.query("SELECT JSON_EXTRACT(d, '$[0]') FROM j WHERE id = 2") == [("5",)]
+    # -> and ->> operators
+    assert s.query("SELECT d -> '$.s' FROM j WHERE id = 1") == [('"x"',)]
+    assert s.query("SELECT d ->> '$.s' FROM j WHERE id = 1") == [("x",)]
+    assert s.query("SELECT JSON_TYPE(d) FROM j ORDER BY id") == [("OBJECT",), ("ARRAY",)]
+    assert s.query("SELECT JSON_VALID('{}'), JSON_VALID('nope')") == [(1, 0)]
+    # filter on a JSON path
+    assert s.query("SELECT id FROM j WHERE d ->> '$.a' = '1'") == [(1,)]

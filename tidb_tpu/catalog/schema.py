@@ -30,7 +30,10 @@ def typedef_to_ftype(td: ast.TypeDef, not_null: bool = False) -> FieldType:
     elif name in ("decimal", "numeric"):
         ft = decimal_type(td.length if td.length > 0 else 10, td.scale, nullable)
     elif name in ("varchar", "char", "text", "tinytext", "mediumtext", "longtext", "blob", "varbinary", "binary", "enum"):
-        ft = string_type(td.length, nullable)
+        # MySQL: *_ci collations compare case-insensitively (ref: util/collate
+        # general_ci — here folded-compare semantics, accent folding omitted)
+        coll = "ci" if td.collate.endswith(("_ci", "_ai_ci")) else "bin"
+        ft = string_type(td.length, nullable, collation=coll)
     elif name == "date":
         ft = date_type(nullable)
     elif name in ("datetime", "timestamp"):
@@ -38,7 +41,9 @@ def typedef_to_ftype(td: ast.TypeDef, not_null: bool = False) -> FieldType:
     elif name == "time":
         ft = duration_type(nullable)
     elif name == "json":
-        ft = FieldType(TypeKind.JSON, nullable=nullable)
+        # JSON stores as normalized text on the STRING path (dictionary
+        # codes on device); the flag drives display + json functions
+        ft = FieldType(TypeKind.STRING, length=-1, nullable=nullable, json=True)
     else:
         raise ValueError(f"unsupported column type {name!r}")
     return ft

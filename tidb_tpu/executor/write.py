@@ -43,10 +43,19 @@ def to_physical(v, ftype) -> object:
     k = ftype.kind
     if k == TypeKind.STRING:
         if isinstance(v, str):
-            return v.encode("utf-8")
-        if isinstance(v, bytes):
-            return v
-        return str(v).encode("utf-8")
+            v = v.encode("utf-8")
+        elif not isinstance(v, bytes):
+            v = str(v).encode("utf-8")
+        if ftype.json:
+            import json as _json
+
+            try:
+                v = _json.dumps(
+                    _json.loads(v.decode("utf-8")), separators=(", ", ": "), ensure_ascii=False
+                ).encode()
+            except Exception:
+                raise WriteError(f"Invalid JSON text: {v[:60]!r}")
+        return v
     if k == TypeKind.DECIMAL:
         return int(round(float(v) * (10**ftype.scale)))
     if k == TypeKind.DATE:

@@ -166,6 +166,21 @@ def _cmp(xp, ctx, op, sig=None):
         da, va = ctx.args[0]
         db, vb = ctx.args[1]
         dict_a, dict_b = ctx.arg_dicts[0], ctx.arg_dicts[1]
+        if "ci" in (ta.collation, tb.collation):
+            # case-insensitive collation: fold both sides before comparing
+            # (ref: collate.generalCICollator; host-only — pushdown legality
+            # keeps these off the device)
+            import numpy as np
+
+            sa, _ = _decode_strs(ctx, 0)
+            sb, _ = _decode_strs(ctx, 1)
+            out = np.zeros(max(len(sa), len(sb)), dtype=np.int64)
+            for i in range(len(out)):
+                x = sa[i if len(sa) > 1 else 0]
+                y = sb[i if len(sb) > 1 else 0]
+                if x is not None and y is not None:
+                    out[i] = int(op(x.lower(), y.lower()))
+            return out, and_valid(xp, va, vb)
         if ta.kind == tb.kind == TypeKind.STRING and dict_a is dict_b and dict_a is not None and dict_a.sorted:
             # same sorted dictionary: codes are order-preserving
             res = op(da, db)
@@ -816,3 +831,126 @@ def _like(xp, args, ctx):
         if s is not None and rx.match(s.decode("utf-8", "replace")):
             out[i] = 1
     return out, v
+
+
+# ---------------------------------------------------------------------------
+# JSON functions (ref: types/json + expression/builtin_json — documents are
+# normalized JSON text on the STRING representation, host-side evaluation)
+# ---------------------------------------------------------------------------
+
+
+def _json_path_get(doc, path: str):
+    """Evaluate a '$.a.b[0]' path against a parsed document; returns a
+    sentinel (_JSON_MISS) when the path doesn't exist."""
+    import re as _re
+
+    cur = doc
+    if not path.startswith("$"):
+        raise ValueError(f"Invalid JSON path expression {path!r}")
+    for m in _re.finditer(r"\.(\w+|\*)|\[(\d+|\*)\]|\.\"([^\"]+)\"", path[1:]):
+        key, idx, qkey = m.group(1), m.group(2), m.group(3)
+        if cur is _JSON_MISS:
+            return _JSON_MISS
+        if key is not None or qkey is not None:
+            k = key if key is not None else qkey
+            if k == "*":
+                return cur if isinstance(cur, dict) else _JSON_MISS
+            cur = cur.get(k, _JSON_MISS) if isinstance(cur, dict) else _JSON_MISS
+        else:
+            if idx == "*":
+                return cur if isinstance(cur, list) else _JSON_MISS
+            i = int(idx)
+            cur = cur[i] if isinstance(cur, list) and i < len(cur) else _JSON_MISS
+    return cur
+
+
+class _JsonMiss:
+    pass
+
+
+_JSON_MISS = _JsonMiss()
+
+
+def _json_dump(v) -> bytes:
+    import json as _json
+
+    return _json.dumps(v, separators=(", ", ": "), ensure_ascii=False).encode()
+
+
+@register("json_extract", lambda args: FieldType(TypeKind.STRING, nullable=True, json=True), engines=HOST_ONLY)
+def _json_extract(xp, args, ctx):
+    import json as _json
+
+    docs, _ = _decode_strs(ctx, 0)
+    paths, _ = _decode_strs(ctx, 1)
+    out = []
+    for i in range(max(len(docs), len(paths))):
+        d = docs[i if len(docs) > 1 else 0]
+        p = paths[i if len(paths) > 1 else 0]
+        if d is None or p is None:
+            out.append(None)
+            continue
+        try:
+            doc = _json.loads(d)
+        except Exception:
+            out.append(None)
+            continue
+        got = _json_path_get(doc, (p.decode() if isinstance(p, bytes) else p))
+        out.append(None if got is _JSON_MISS else _json_dump(got))
+    return _encode_strs(ctx, out)
+
+
+@register("json_unquote", lambda args: string_type(), engines=HOST_ONLY, arity=1)
+def _json_unquote(xp, args, ctx):
+    import json as _json
+
+    strs, _ = _decode_strs(ctx, 0)
+    out = []
+    for s in strs:
+        if s is None:
+            out.append(None)
+            continue
+        t = s.decode() if isinstance(s, bytes) else s
+        if t.startswith('"') and t.endswith('"'):
+            try:
+                t = _json.loads(t)
+            except Exception:
+                pass
+        out.append(t.encode() if isinstance(t, str) else t)
+    return _encode_strs(ctx, out)
+
+
+@register("json_valid", infer_bool, engines=HOST_ONLY, arity=1)
+def _json_valid(xp, args, ctx):
+    import json as _json
+    import numpy as np
+
+    strs, v = _decode_strs(ctx, 0)
+    out = np.zeros(len(strs), dtype=np.int64)
+    for i, s in enumerate(strs):
+        if s is None:
+            continue
+        try:
+            _json.loads(s)
+            out[i] = 1
+        except Exception:
+            out[i] = 0
+    return out, v
+
+
+@register("json_type", lambda args: string_type(), engines=HOST_ONLY, arity=1)
+def _json_type(xp, args, ctx):
+    import json as _json
+
+    strs, _ = _decode_strs(ctx, 0)
+    names = {dict: b"OBJECT", list: b"ARRAY", str: b"STRING", bool: b"BOOLEAN", int: b"INTEGER", float: b"DOUBLE", type(None): b"NULL"}
+    out = []
+    for s in strs:
+        if s is None:
+            out.append(None)
+            continue
+        try:
+            out.append(names.get(type(_json.loads(s)), b"UNKNOWN"))
+        except Exception:
+            out.append(None)
+    return _encode_strs(ctx, out)

@@ -137,11 +137,18 @@ def func(sig: str, *args: Expression, ret: Optional[FieldType] = None) -> Scalar
 
 
 def _ft_pb(ft: FieldType) -> list:
-    return [int(ft.kind), ft.length, ft.scale, int(ft.nullable), ft.collation]
+    return [int(ft.kind), ft.length, ft.scale, int(ft.nullable), ft.collation, int(ft.json)]
 
 
 def _ft_from_pb(v: list) -> FieldType:
-    return FieldType(TypeKind(v[0]), length=v[1], scale=v[2], nullable=bool(v[3]), collation=v[4])
+    return FieldType(
+        TypeKind(v[0]),
+        length=v[1],
+        scale=v[2],
+        nullable=bool(v[3]),
+        collation=v[4],
+        json=bool(v[5]) if len(v) > 5 else False,
+    )
 
 
 def expr_from_pb(pb: dict) -> Expression:
@@ -173,6 +180,13 @@ def can_push_down(expr: Expression, engine: str) -> bool:
         if engine == "tpu":
             has_str = any(a.ftype.kind == TypeKind.STRING for a in expr.args)
             if has_str and expr.sig not in (_TPU_STRING_OK | _TPU_STRING_ORDER):
+                return False
+            # ci collation folds at compare time — dictionary codes on the
+            # device are raw-bytes identities, so these stay host-side
+            # (ref: pushdown disabled for new collations, infer_pushdown.go)
+            if any(
+                a.ftype.kind == TypeKind.STRING and a.ftype.collation == "ci" for a in expr.args
+            ):
                 return False
         return all(can_push_down(a, engine) for a in expr.args)
     return True

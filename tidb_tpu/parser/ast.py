@@ -147,6 +147,7 @@ class TypeDef(Node):
     length: int = -1
     scale: int = 0
     unsigned: bool = False
+    collate: str = ""  # e.g. utf8mb4_general_ci
 
 
 # -- statements -------------------------------------------------------------

@@ -24,6 +24,7 @@ class Token:
 
 
 _OPS = [
+    "->>", "->",
     "<=>", "<<", ">>", "<=", ">=", "<>", "!=", ":=", "||", "&&",
     "(", ")", ",", ".", ";", "+", "-", "*", "/", "%", "=", "<", ">",
     "!", "~", "^", "&", "|", "@", "?", "[", "]",

@@ -504,6 +504,20 @@ class Parser:
             else:
                 return left
 
+    def _postfix_json(self, e: ast.Node) -> ast.Node:
+        """col -> '$.path' and col ->> '$.path' (ref: JSON column paths)."""
+        while self.at_op("->") or self.at_op("->>"):
+            unquote = self.peek().value == "->>"
+            self.next()
+            t = self.next()
+            if t.kind != "str":
+                raise ParseError("expected JSON path string", t)
+            path = ast.Literal(t.value)
+            e = ast.FuncCall("json_extract", [e, path])
+            if unquote:
+                e = ast.FuncCall("json_unquote", [e])
+        return e
+
     def _unary(self) -> ast.Node:
         if self.at_op("-"):
             self.next()
@@ -514,7 +528,7 @@ class Parser:
         if self.at_op("~"):
             self.next()
             return ast.UnaryOp("bitneg", self._unary())
-        return self._primary()
+        return self._postfix_json(self._primary())
 
     def _primary(self) -> ast.Node:
         t = self.peek()
@@ -777,12 +791,12 @@ class Parser:
         if self.eat_kw("UNSIGNED"):
             td.unsigned = True
         self.eat_kw("SIGNED")
-        # charset/collate noise
+        # charset is noise; collation is semantic (ci vs bin compares)
         if self.eat_kw("CHARACTER"):
             self.expect_kw("SET")
             self.ident()
         if self.eat_kw("COLLATE"):
-            self.ident()
+            td.collate = self.ident().lower()
         return td
 
     def parse_create(self) -> ast.Node:

@@ -45,6 +45,8 @@ def _sql_type(ft) -> str:
     if k == TypeKind.DECIMAL:
         return f"DECIMAL({ft.length},{ft.scale})"
     if k == TypeKind.STRING:
+        if ft.json:
+            return "JSON"
         return f"VARCHAR({ft.length})" if ft.length >= 0 else "TEXT"
     if k == TypeKind.DATE:
         return "DATE"

@@ -61,6 +61,9 @@ class FieldType:
     collation: str = "bin"
     # CHAR(n) pads; VARCHAR does not — affects comparisons only at the edges
     fixed_char: bool = False
+    # JSON documents ride the STRING representation (normalized text) with
+    # this marker for display/type functions (ref: types.JSON column flag)
+    json: bool = False
 
     # -- physical mapping -------------------------------------------------
     @property
