@@ -1,0 +1,115 @@
+"""Golden-file integration test runner (ref: tests/integrationtest/
+run-tests.sh — .test SQL scripts under t/ with expected output frozen in
+r/*.result).
+
+Format: statements end with ';'. Lines starting with '#' are comments.
+Directives: '--error' (next statement must fail), '--sorted_result' (sort
+the next result's rows). Results render as the statement, then its rows
+tab-separated, then a blank line.
+
+Record mode rewrites the .result files:  python tests/integrationtest/run.py --record
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(HERE)))  # repo root
+
+
+def _statements(text: str):
+    """Yield (directives, sql) pairs."""
+    directives: list[str] = []
+    buf: list[str] = []
+    for line in text.split("\n"):
+        stripped = line.strip()
+        if not buf and stripped.startswith("--"):
+            directives.append(stripped[2:].strip())
+            continue
+        if not buf and (not stripped or stripped.startswith("#")):
+            continue
+        buf.append(line)
+        if stripped.endswith(";"):
+            sql = "\n".join(buf).strip().rstrip(";")
+            yield directives, sql
+            directives, buf = [], []
+
+
+def _render(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
+def run_file(path: str) -> str:
+    """Execute one .test file on a fresh DB; returns the rendered result."""
+    import tidb_tpu
+
+    db = tidb_tpu.open()
+    s = db.session()
+    out: list[str] = []
+    with open(path) as f:
+        text = f.read()
+    for directives, sql in _statements(text):
+        out.append(sql + ";")
+        expect_error = "error" in directives
+        try:
+            res = s.execute(sql)
+        except Exception as e:
+            if expect_error:
+                out.append(f"Error: {type(e).__name__}")
+                out.append("")
+                continue
+            raise AssertionError(f"{os.path.basename(path)}: {sql!r} failed: {e}") from e
+        if expect_error:
+            raise AssertionError(f"{os.path.basename(path)}: {sql!r} should have failed")
+        rows = res.rows
+        if "sorted_result" in directives:
+            rows = sorted(rows, key=repr)
+        for r in rows:
+            out.append("\t".join(_render(v) for v in r))
+        out.append("")
+    return "\n".join(out).rstrip() + "\n"
+
+
+def test_files() -> list[str]:
+    tdir = os.path.join(HERE, "t")
+    return sorted(
+        os.path.join(tdir, f) for f in os.listdir(tdir) if f.endswith(".test")
+    )
+
+
+def result_path(test_path: str) -> str:
+    base = os.path.splitext(os.path.basename(test_path))[0]
+    return os.path.join(HERE, "r", base + ".result")
+
+
+def main(argv=None):
+    record = "--record" in (argv or sys.argv[1:])
+    os.makedirs(os.path.join(HERE, "r"), exist_ok=True)
+    failed = []
+    for tp in test_files():
+        got = run_file(tp)
+        rp = result_path(tp)
+        if record:
+            with open(rp, "w") as f:
+                f.write(got)
+            print(f"recorded {os.path.basename(rp)}")
+            continue
+        with open(rp) as f:
+            want = f.read()
+        if got != want:
+            failed.append(os.path.basename(tp))
+            print(f"FAIL {os.path.basename(tp)}")
+    if failed:
+        raise SystemExit(f"golden mismatches: {failed}")
+    if not record:
+        print(f"ok: {len(test_files())} golden files")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""Drive the golden-file integration suite under pytest (ref:
+tests/integrationtest run-tests.sh; regenerate with
+`python tests/integrationtest/run.py --record`)."""
+
+import os
+import sys
+
+import pytest
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "integrationtest")
+sys.path.insert(0, HERE)
+
+import run as golden_runner  # noqa: E402
+
+
+@pytest.mark.parametrize("test_path", golden_runner.test_files(), ids=os.path.basename)
+def test_golden(test_path):
+    got = golden_runner.run_file(test_path)
+    with open(golden_runner.result_path(test_path)) as f:
+        want = f.read()
+    assert got == want, f"golden mismatch for {os.path.basename(test_path)}"

@@ -125,13 +125,22 @@ class TableReaderExec(Executor):
 
             cache = cache_for(self.session.store)
             views = p.partitions if p.partitions is not None else p.table.partition_views()
-            chunks = []
             for view in views:
-                self.session.check_killed()
                 cache.set_table_alias(view.id, p.table.id)
-                ch = self._execute_one(view, self._translate_ranges(view))
-                if len(ch):
-                    chunks.append(ch)
+            self.session.check_killed()
+            if len(views) > 1:
+                # partitions fan out like region tasks (ref: partitioned
+                # scans sharing the distsql concurrency budget); numpy/XLA
+                # release the GIL so tasks overlap for real
+                from concurrent.futures import ThreadPoolExecutor
+
+                conc = max(1, min(int(self.session.vars.get("tidb_distsql_scan_concurrency", 8)), len(views)))
+                with ThreadPoolExecutor(max_workers=conc, thread_name_prefix="part") as pool:
+                    results = list(pool.map(lambda v: self._execute_one(v, self._translate_ranges(v)), views))
+                self.session.check_killed()
+                chunks = [ch for ch in results if len(ch)]
+            else:
+                chunks = [ch for ch in (self._execute_one(v, self._translate_ranges(v)) for v in views) if len(ch)]
             if not chunks:
                 return _empty_chunk(p.schema)
             return Chunk.concat(chunks) if len(chunks) > 1 else chunks[0]
