@@ -35,6 +35,8 @@ def _statements(text: str):
             sql = "\n".join(buf).strip().rstrip(";")
             yield directives, sql
             directives, buf = [], []
+    if buf:  # trailing statement without ';' still executes
+        yield directives, "\n".join(buf).strip()
 
 
 def _render(v) -> str:

@@ -134,9 +134,16 @@ class TableReaderExec(Executor):
                 # release the GIL so tasks overlap for real
                 from concurrent.futures import ThreadPoolExecutor
 
-                conc = max(1, min(int(self.session.vars.get("tidb_distsql_scan_concurrency", 8)), len(views)))
-                with ThreadPoolExecutor(max_workers=conc, thread_name_prefix="part") as pool:
-                    results = list(pool.map(lambda v: self._execute_one(v, self._translate_ranges(v)), views))
+                budget = int(self.session.vars.get("tidb_distsql_scan_concurrency", 8))
+                conc = max(1, min(budget, len(views)))
+                # partitions share (not multiply) the scan budget: each
+                # per-partition request gets its slice of workers
+                self._conc_override = max(1, budget // conc)
+                try:
+                    with ThreadPoolExecutor(max_workers=conc, thread_name_prefix="part") as pool:
+                        results = list(pool.map(lambda v: self._execute_one(v, self._translate_ranges(v)), views))
+                finally:
+                    self._conc_override = None
                 self.session.check_killed()
                 chunks = [ch for ch in results if len(ch)]
             else:
@@ -209,7 +216,8 @@ class TableReaderExec(Executor):
             ranges=ranges,
             store_type=p.store_type,
             start_ts=self.session.read_ts(),
-            concurrency=int(self.session.vars.get("tidb_distsql_scan_concurrency", 8)),
+            concurrency=getattr(self, "_conc_override", None)
+            or int(self.session.vars.get("tidb_distsql_scan_concurrency", 8)),
             keep_order=p.keep_order,
         )
         client = self.session.store.get_client()

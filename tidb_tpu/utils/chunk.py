@@ -40,12 +40,17 @@ class Dictionary:
     string comparisons; appends after compaction clear ``sorted`` again.
     """
 
-    __slots__ = ("_values", "_index", "sorted")
+    __slots__ = ("_values", "_index", "sorted", "_mu")
 
     def __init__(self, values: Sequence[bytes] = ()):  # noqa: D107
+        import threading
+
         self._values: list[bytes] = list(values)
         self._index: dict[bytes, int] = {v: i for i, v in enumerate(self._values)}
         self.sorted = self._values == sorted(self._values) if self._values else True
+        # encode() appends; concurrent cop/partition worker threads share
+        # table-level dictionaries, so the mutation is locked
+        self._mu = threading.Lock()
 
     def __len__(self) -> int:
         return len(self._values)
@@ -54,13 +59,17 @@ class Dictionary:
         if isinstance(value, str):
             value = value.encode("utf-8")
         code = self._index.get(value)
-        if code is None:
-            code = len(self._values)
-            self._values.append(value)
-            self._index[value] = code
-            if self.sorted and code > 0 and self._values[code - 1] > value:
-                self.sorted = False
-            # a single element dict stays sorted
+        if code is not None:
+            return code
+        with self._mu:
+            code = self._index.get(value)
+            if code is None:
+                code = len(self._values)
+                self._values.append(value)
+                self._index[value] = code
+                if self.sorted and code > 0 and self._values[code - 1] > value:
+                    self.sorted = False
+                # a single element dict stays sorted
         return code
 
     def try_encode(self, value: "bytes | str") -> int:
