@@ -133,3 +133,26 @@ def test_rollback_does_not_count_stats_mods(db):
 def test_semi_join_explain_shape(db):
     lines = [r[0] for r in db.query("EXPLAIN SELECT name FROM c WHERE EXISTS (SELECT 1 FROM o WHERE o.cid = c.id)")]
     assert any("semi" in l for l in lines)
+
+
+def test_correlated_scalar_subquery(db):
+    db.execute("CREATE TABLE se (id BIGINT PRIMARY KEY, dept BIGINT, sal BIGINT)")
+    db.execute("INSERT INTO se VALUES (1, 1, 100), (2, 1, 200), (3, 2, 150), (4, NULL, 50), (5, 2, 150)")
+    s = db.session()
+    # agg pull-up → LEFT JOIN over the correlation key
+    assert s.query(
+        "SELECT e.id FROM se e WHERE sal > (SELECT AVG(sal) FROM se e2 WHERE e2.dept = e.dept) ORDER BY e.id"
+    ) == [(2,)]
+    assert s.query(
+        "SELECT e.id FROM se e WHERE sal = (SELECT MAX(sal) FROM se e2 WHERE e2.dept = e.dept) ORDER BY e.id"
+    ) == [(2,), (3,), (5,)]
+    # COUNT over an empty correlated set compares as 0, not NULL
+    db.execute("CREATE TABLE other (k BIGINT)")
+    assert s.query(
+        "SELECT e.id FROM se e WHERE (SELECT COUNT(*) FROM other o WHERE o.k = e.dept) = 0 ORDER BY e.id"
+    ) == [(1,), (2,), (3,), (4,), (5,)]  # NULL dept: COUNT over the never-matching set is 0 → row 4 passes
+
+    # subquery on the left side of the comparison
+    assert s.query(
+        "SELECT e.id FROM se e WHERE (SELECT MIN(sal) FROM se e2 WHERE e2.dept = e.dept) < 150 ORDER BY e.id"
+    ) == [(1,), (2,)]

@@ -218,17 +218,35 @@ class Builder:
 
         if sel.where is not None:
             residual: list[ast.Node] = []
+            scalar_conds: list[Expression] = []
+            pre_width = len(plan.schema)  # semi/anti joins keep the schema
             for cj in _split_ast_conj(sel.where):
                 joined = self._try_subquery_join(plan, cj)
                 if joined is not None:
                     plan = joined
-                else:
-                    residual.append(cj)
-            conds: list[Expression] = []
+                    continue
+                scalar = self._try_scalar_corr_join(plan, cj)
+                if scalar is not None:
+                    plan, cond = scalar
+                    scalar_conds.append(cond)
+                    continue
+                residual.append(cj)
+            conds: list[Expression] = list(scalar_conds)
             for cj in residual:
                 conds.extend(self._split_conj(self.resolve(cj, BuildCtx(plan.schema))))
             if conds:
                 plan = LogicalSelection(conditions=conds, children=[plan])
+            if len(plan.schema) > pre_width:
+                # trim correlated-scalar agg columns appended by the joins
+                tp = LogicalProjection(
+                    exprs=[
+                        ColumnRef(i, plan.schema[i].ftype, plan.schema[i].name)
+                        for i in range(pre_width)
+                    ],
+                    children=[plan],
+                )
+                tp.schema = plan.schema[:pre_width]
+                plan = tp
 
         # aggregation detection
         has_agg = bool(sel.group_by) or any(
@@ -523,6 +541,87 @@ class Builder:
             schema=[OutCol(c.name, c.ftype, c.table, c.slot) for c in plan.schema],
             children=[plan, inner_plan],
         )
+
+    def _try_scalar_corr_join(self, plan: LogicalPlan, cj: ast.Node):
+        """Correlated *scalar* subquery in a comparison —
+        ``outer.x CMP (SELECT agg(..) FROM t2 WHERE t2.k = outer.k)`` —
+        rewritten by aggregate pull-up (ref: rule_decorrelate.go pulling the
+        agg above a left outer join): the inner aggregates per correlation
+        key, LEFT JOINs onto the outer, and the comparison becomes a filter
+        over the joined agg column (NULL when no inner row, which the
+        comparison correctly rejects; COUNT wraps in IFNULL(.., 0))."""
+        if not (isinstance(cj, ast.BinaryOp) and cj.op in ("eq", "ne", "lt", "le", "gt", "ge")):
+            return None
+        for side, flip in (("right", False), ("left", True)):
+            sub = getattr(cj, side)
+            if isinstance(sub, ast.SubqueryExpr) and sub.modifier == "":
+                other_ast = cj.left if side == "right" else cj.right
+                break
+        else:
+            return None
+        inner = sub.select
+        if not isinstance(inner, ast.Select) or len(inner.items) != 1:
+            return None
+        if not self._is_correlated(inner, plan.schema):
+            return None
+        if inner.group_by or inner.limit is not None or inner.order_by or inner.having is not None:
+            raise PlanError("correlated scalar subquery with GROUP BY/ORDER BY/LIMIT is not supported")
+        item = inner.items[0]
+        if isinstance(item.expr, ast.Wildcard) or not _contains_agg(item.expr):
+            # non-aggregated correlated scalar: can yield >1 row — unsupported
+            raise PlanError("correlated scalar subquery must be an aggregate")
+        import copy as _copy
+
+        inner = _copy.deepcopy(inner)
+        probe = Builder(self.catalog, self.db, subquery_runner=lambda _sel: [])
+        inner_from = probe._build_from(inner.from_) if inner.from_ is not None else LogicalDual()
+        inner_schema = inner_from.schema
+        corr: list[tuple[ast.Node, ast.Node]] = []
+        keep: list[ast.Node] = []
+        for c in _split_ast_conj(inner.where) if inner.where is not None else []:
+            pair = self._corr_eq_pair(c, inner_schema, plan.schema, probe)
+            if pair is not None:
+                corr.append(pair)
+            else:
+                keep.append(c)
+        if not corr:
+            raise PlanError("unsupported correlated subquery (no equality correlation)")
+        inner.where = _and_join_ast(keep)
+        inner.group_by = [inner_side for _, inner_side in corr]
+        for inner_side in inner.group_by:
+            inner.items.append(ast.SelectItem(inner_side))
+        try:
+            inner_plan = self.build_select(inner)
+        except PlanError as err:
+            if "Unknown column" in str(err) and _unknown_col_in_schema(str(err), plan.schema):
+                raise PlanError(
+                    "unsupported correlated subquery: correlation must be a plain equality"
+                )
+            raise
+        base_width = len(plan.schema)
+        eq_conds: list[tuple[int, int]] = []
+        for i, (outer_side, _) in enumerate(corr):
+            oe = self.resolve(outer_side, BuildCtx(plan.schema))
+            if not isinstance(oe, ColumnRef):
+                raise PlanError("correlated comparison must reference a plain outer column")
+            eq_conds.append((oe.index, 1 + i))
+        join_schema = [OutCol(c.name, c.ftype, c.table, c.slot) for c in plan.schema] + [
+            OutCol(f"__ssub#{base_width + i}", c.ftype) for i, c in enumerate(inner_plan.schema)
+        ]
+        join = LogicalJoin(
+            kind="left",
+            eq_conds=eq_conds,
+            schema=join_schema,
+            children=[plan, inner_plan],
+        )
+        agg_ft = inner_plan.schema[0].ftype
+        sub_ref: Expression = ColumnRef(base_width, agg_ft, join_schema[base_width].name)
+        if isinstance(item.expr, ast.FuncCall) and _FN_ALIAS.get(item.expr.name, item.expr.name) == "count":
+            # COUNT over no rows is 0, not NULL
+            sub_ref = func("ifnull", sub_ref, Constant(0, agg_ft))
+        other_e = self.resolve(other_ast, BuildCtx(join.schema))
+        a, b = (sub_ref, other_e) if flip else (other_e, sub_ref)
+        return join, func(cj.op, a, b)
 
     def _is_correlated(self, inner: ast.Select, outer_schema) -> bool:
         """True when the subquery fails to resolve alone but its unknown
