@@ -127,3 +127,17 @@ def test_plan_cache_lru_eviction(db):
     assert len(s._plan_cache) == 2
     s.query(qs[0])
     assert s.vars["last_plan_from_cache"] == 0  # evicted earlier
+
+
+def test_batch_point_get(db):
+    s = db.session()
+    assert s.query("SELECT id, a FROM t WHERE id IN (2, 1, 99, 2)") == [(2, 20), (1, 10)]
+    (line,) = db.query("EXPLAIN SELECT * FROM t WHERE id IN (1, 2)")[0]
+    assert line.startswith("Batch_Point_Get")
+    # membuffer overlay applies per handle
+    s.execute("BEGIN")
+    s.execute("DELETE FROM t WHERE id = 1")
+    assert s.query("SELECT id FROM t WHERE id IN (1, 2)") == [(2,)]
+    s.execute("ROLLBACK")
+    # negated IN is not a point get but still correct
+    assert s.query("SELECT id FROM t WHERE id NOT IN (1, 2) ORDER BY id") == [(7,)]

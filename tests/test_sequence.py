@@ -1,0 +1,29 @@
+"""Sequences (ref: ddl sequence.go, expression nextval/setval)."""
+
+import pytest
+
+import tidb_tpu
+
+
+def test_sequence_basic():
+    db = tidb_tpu.open()
+    db.execute("CREATE SEQUENCE sq")
+    assert db.query("SELECT NEXTVAL(sq)") == [(1,)]
+    assert db.query("SELECT NEXTVAL(sq)") == [(2,)]
+    assert db.query("SELECT SETVAL(sq, 100)") == [(100,)]
+    assert db.query("SELECT NEXTVAL(sq)") == [(101,)]
+    with pytest.raises(Exception):
+        db.execute("CREATE SEQUENCE sq")
+    db.execute("CREATE SEQUENCE IF NOT EXISTS sq")
+    db.execute("DROP SEQUENCE sq")
+    with pytest.raises(Exception):
+        db.query("SELECT NEXTVAL(sq)")
+
+
+def test_sequence_options_and_insert():
+    db = tidb_tpu.open()
+    db.execute("CREATE SEQUENCE s2 START WITH 10 INCREMENT BY 5")
+    db.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+    db.execute("INSERT INTO t VALUES (NEXTVAL(s2), 1), (NEXTVAL(s2), 2)")
+    assert db.query("SELECT id FROM t ORDER BY id") == [(10,), (15,)]
+    assert db.query("SELECT NEXTVAL(s2)") == [(20,)]

@@ -263,6 +263,56 @@ class Catalog:
             self._persist()
             return t
 
+    # -- sequences (ref: ddl sequence.go / model.SequenceInfo) ---------------
+    def create_sequence(self, db: str, name: str, start: int, increment: int, if_not_exists: bool) -> None:
+        from tidb_tpu.catalog.schema import SequenceInfo
+
+        if increment == 0:
+            raise CatalogError("sequence INCREMENT must be non-zero")
+        with self._mu:
+            dbi = self.db(db)
+            if name.lower() in dbi.sequences:
+                if if_not_exists:
+                    return
+                raise CatalogError(f"Sequence {name!r} already exists")
+            dbi.sequences[name.lower()] = SequenceInfo(name.lower(), start, increment, start)
+            self._persist()
+
+    def drop_sequence(self, db: str, name: str, if_exists: bool = False) -> None:
+        with self._mu:
+            dbi = self.db(db)
+            if name.lower() not in dbi.sequences:
+                if if_exists:
+                    return
+                raise CatalogError(f"Unknown sequence '{name}'")
+            del dbi.sequences[name.lower()]
+            self._persist()
+
+    def sequence_nextval(self, db: str, name: str) -> int:
+        with self._mu:
+            dbi = self.db(db)
+            seq = dbi.sequences.get(name.lower())
+            if seq is None:
+                raise CatalogError(f"Unknown sequence '{name}'")
+            v = seq.next_val
+            seq.next_val += seq.increment
+            self._persist()
+            return v
+
+    def sequence_setval(self, db: str, name: str, value: int) -> int:
+        with self._mu:
+            dbi = self.db(db)
+            seq = dbi.sequences.get(name.lower())
+            if seq is None:
+                raise CatalogError(f"Unknown sequence '{name}'")
+            seq.next_val = value + seq.increment
+            self._persist()
+            return value
+
+    def sequences(self, db: str) -> list[str]:
+        dbi = self._dbs.get(db.lower())
+        return sorted(dbi.sequences.keys()) if dbi else []
+
     # -- views (ref: ddl CreateView / model.ViewInfo) ------------------------
     def create_view(self, db: str, stmt: ast.CreateView) -> None:
         from tidb_tpu.catalog.schema import ViewInfo

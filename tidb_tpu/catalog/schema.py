@@ -223,6 +223,24 @@ class TableInfo:
 
 
 @dataclass
+class SequenceInfo:
+    """CREATE SEQUENCE state (ref: model.SequenceInfo; single-process, so
+    the cache window is just the persisted next value)."""
+
+    name: str
+    next_val: int = 1
+    increment: int = 1
+    start: int = 1
+
+    def to_pb(self) -> dict:
+        return {"name": self.name, "next": self.next_val, "inc": self.increment, "start": self.start}
+
+    @staticmethod
+    def from_pb(pb: dict) -> "SequenceInfo":
+        return SequenceInfo(pb["name"], pb["next"], pb["inc"], pb["start"])
+
+
+@dataclass
 class ViewInfo:
     name: str
     text: str  # the defining SELECT, as SQL
@@ -241,12 +259,14 @@ class DBInfo:
     name: str
     tables: dict[str, TableInfo] = field(default_factory=dict)
     views: dict[str, ViewInfo] = field(default_factory=dict)
+    sequences: dict[str, SequenceInfo] = field(default_factory=dict)
 
     def to_pb(self) -> dict:
         return {
             "name": self.name,
             "tables": {k: t.to_pb() for k, t in self.tables.items()},
             "views": {k: v.to_pb() for k, v in self.views.items()},
+            "sequences": {k: s.to_pb() for k, s in self.sequences.items()},
         }
 
     @staticmethod
@@ -255,4 +275,5 @@ class DBInfo:
             pb["name"],
             {k: TableInfo.from_pb(t) for k, t in pb["tables"].items()},
             {k: ViewInfo.from_pb(v) for k, v in pb.get("views", {}).items()},
+            {k: SequenceInfo.from_pb(s) for k, s in pb.get("sequences", {}).items()},
         )

@@ -315,6 +315,21 @@ class CreateTable(Node):
 
 
 @dataclass
+class CreateSequence(Node):
+    name: str
+    db: str = ""
+    start: int = 1
+    increment: int = 1
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropSequence(Node):
+    names: list[str]
+    if_exists: bool = False
+
+
+@dataclass
 class CreateView(Node):
     """CREATE [OR REPLACE] VIEW v [(cols)] AS <select> — definition kept as
     SQL text (ref: model.ViewInfo.SelectStmt)."""

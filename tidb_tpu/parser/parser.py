@@ -844,6 +844,27 @@ class Parser:
             return ast.CreateView(tbl, [c.lower() for c in cols], text, or_replace)
         if or_replace:
             raise ParseError("OR REPLACE only applies to CREATE VIEW", self.peek())
+        if self.eat_kw("SEQUENCE"):
+            ine = self._if_not_exists()
+            tbl = self._table_ref_simple()
+            cs = ast.CreateSequence(tbl.name, db=tbl.db, if_not_exists=ine)
+            while self.peek().kind == "ident" and not self.at_op(";"):
+                kw = self.ident().upper()
+                if kw == "START":
+                    self.eat_kw("WITH")
+                    self.eat_op("=")
+                    cs.start = int(self.next().value)
+                elif kw == "INCREMENT":
+                    self.eat_kw("BY")
+                    self.eat_op("=")
+                    cs.increment = int(self.next().value)
+                elif kw in ("CACHE", "MINVALUE", "MAXVALUE"):
+                    self.next()  # value (ignored: single-process)
+                elif kw in ("NOCACHE", "NOCYCLE", "CYCLE"):
+                    pass
+                else:
+                    raise ParseError(f"unknown sequence option {kw!r}", self.peek())
+            return cs
         if self.at_kw("DATABASE", "SCHEMA"):
             self.next()
             ine = self._if_not_exists()
@@ -1017,6 +1038,12 @@ class Parser:
             while self.eat_op(","):
                 tables.append(self._table_ref_simple())
             return ast.DropView(tables, ie)
+        if self.eat_kw("SEQUENCE"):
+            ie = self._if_exists()
+            names = [self.ident().lower()]
+            while self.eat_op(","):
+                names.append(self.ident().lower())
+            return ast.DropSequence(names, ie)
         self.expect_kw("TABLE")
         ie = self._if_exists()
         tables = [self._table_ref_simple()]

@@ -937,6 +937,24 @@ class Builder:
             raise PlanError(f"aggregate {name}() used outside aggregation context")
         if name == "interval":
             raise PlanError("INTERVAL outside date arithmetic")
+        if name in ("nextval", "setval"):
+            # sequence functions allocate at resolve time (each INSERT row
+            # resolves separately, so every row draws a fresh value)
+            self.uncacheable = True
+            if not node.args or not isinstance(node.args[0], ast.ColumnName):
+                raise PlanError(f"{name}() takes a sequence name")
+            ref = node.args[0]
+            seq_db = ref.table or self.db
+            if name == "nextval":
+                v = self.catalog.sequence_nextval(seq_db, ref.name)
+            else:
+                if len(node.args) != 2:
+                    raise PlanError("setval(seq, value)")
+                arg = self.resolve(node.args[1], ctx)
+                if not isinstance(arg, Constant):
+                    raise PlanError("setval value must be constant")
+                v = self.catalog.sequence_setval(seq_db, ref.name, int(arg.value))
+            return Constant(v, bigint_type(nullable=False))
         if name in ("now", "current_timestamp"):
             import datetime
 

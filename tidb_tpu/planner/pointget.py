@@ -20,10 +20,16 @@ from tidb_tpu.parser import ast
 class PointGetPlan:
     db: str
     table: TableInfo
-    handle: int
+    # one handle = Point_Get; several = Batch_Point_Get (ref:
+    # BatchPointGetPlan for pk IN (...) lists)
+    handles: list[int]
     # projected column offsets, in output order
     out_offsets: list[int]
     out_names: list[str]
+
+    @property
+    def handle(self) -> int:
+        return self.handles[0]
 
 
 def _const_int(node: ast.Node) -> Optional[int]:
@@ -61,10 +67,8 @@ def detect_point_get(catalog, current_db: str, stmt: ast.Node) -> Optional[Point
         return None  # stale reads take the planner path
     if stmt.where is None:
         return None
-    # WHERE must be exactly `pk = const` (or `const = pk`)
+    # WHERE must be exactly `pk = const` / `const = pk` / `pk IN (consts)`
     w = stmt.where
-    if not (isinstance(w, ast.BinaryOp) and w.op == "eq"):
-        return None
     try:
         t = catalog.table(stmt.from_.db or current_db, stmt.from_.name)
     except Exception:
@@ -83,12 +87,21 @@ def detect_point_get(catalog, current_db: str, stmt: ast.Node) -> Optional[Point
             and (not n.table or n.table.lower() == alias)
         )
 
-    handle = None
-    if is_pk_col(w.left):
-        handle = _const_int(w.right)
-    elif is_pk_col(w.right):
-        handle = _const_int(w.left)
-    if handle is None:
+    handles: Optional[list[int]] = None
+    if isinstance(w, ast.BinaryOp) and w.op == "eq":
+        h = None
+        if is_pk_col(w.left):
+            h = _const_int(w.right)
+        elif is_pk_col(w.right):
+            h = _const_int(w.left)
+        if h is not None:
+            handles = [h]
+    elif isinstance(w, ast.InList) and not w.negated and is_pk_col(w.operand):
+        vals = [_const_int(x) for x in w.items]
+        if all(v is not None for v in vals):
+            # MySQL batch point get preserves the IN-list order, deduped
+            handles = list(dict.fromkeys(vals))  # type: ignore[arg-type]
+    if handles is None:
         return None
 
     # select list: plain columns or *
@@ -114,7 +127,7 @@ def detect_point_get(catalog, current_db: str, stmt: ast.Node) -> Optional[Point
         return None
     if not out_offsets:
         return None
-    return PointGetPlan(stmt.from_.db or current_db, t, handle, out_offsets, out_names)
+    return PointGetPlan(stmt.from_.db or current_db, t, handles, out_offsets, out_names)
 
 
 def _to_logical(v, ft):
@@ -145,26 +158,30 @@ def _to_logical(v, ft):
 
 
 def run_point_get(session, plan: PointGetPlan) -> list[tuple]:
-    """One KV get through the txn-aware read path (membuffer overlay first,
-    then MVCC snapshot at the session read ts)."""
+    """One KV get per handle through the txn-aware read path (membuffer
+    overlay first, then MVCC snapshot at the session read ts)."""
     from tidb_tpu.kv import tablecodec
     from tidb_tpu.kv.memstore import Snapshot
     from tidb_tpu.kv.rowcodec import RowSchema, decode_row
 
-    key = tablecodec.record_key(plan.table.id, plan.handle)
     txn = session._txn
-    if txn is not None:
-        if txn.membuf.is_deleted(key):
-            return []
-        raw = txn.membuf.get(key) if txn.membuf.contains(key) else None
+    snap = None if txn is not None else Snapshot(session.store, session.read_ts())
+    schema = RowSchema(plan.table.storage_schema)
+    out: list[tuple] = []
+    for handle in plan.handles:
+        key = tablecodec.record_key(plan.table.id, handle)
+        if txn is not None:
+            if txn.membuf.is_deleted(key):
+                continue
+            raw = txn.membuf.get(key) if txn.membuf.contains(key) else None
+            if raw is None:
+                raw = txn.get(key)
+        else:
+            raw = snap.get(key)
         if raw is None:
-            raw = txn.get(key)
-    else:
-        raw = Snapshot(session.store, session.read_ts()).get(key)
-    if raw is None:
-        return []
-    vals = decode_row(RowSchema(plan.table.storage_schema), raw)
-    row = tuple(
-        _to_logical(vals[o], plan.table.columns[o].ftype) for o in plan.out_offsets
-    )
-    return [row]
+            continue
+        vals = decode_row(schema, raw)
+        out.append(
+            tuple(_to_logical(vals[o], plan.table.columns[o].ftype) for o in plan.out_offsets)
+        )
+    return out

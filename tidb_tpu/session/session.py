@@ -295,6 +295,17 @@ class Session:
             # stats modify counter feeds auto-analyze (ref: stats delta dump)
             self.note_table_mods(t.id, res.affected)
             return res
+        if isinstance(stmt, ast.CreateSequence):
+            self.require_priv(stmt.db or self.current_db, stmt.name, "create")
+            self.catalog.create_sequence(
+                stmt.db or self.current_db, stmt.name, stmt.start, stmt.increment, stmt.if_not_exists
+            )
+            return Result()
+        if isinstance(stmt, ast.DropSequence):
+            for nm in stmt.names:
+                self.require_priv(self.current_db, nm, "drop")
+                self.catalog.drop_sequence(self.current_db, nm, stmt.if_exists)
+            return Result()
         if isinstance(stmt, ast.CreateView):
             self.require_priv(stmt.table.db or self.current_db, stmt.table.name, "create")
             self.catalog.create_view(stmt.table.db or self.current_db, stmt)
@@ -985,7 +996,10 @@ class Session:
 
         pg = detect_point_get(self.catalog, self.current_db, inner)
         if pg is not None and not stmt.analyze:
-            line = f"Point_Get  table:{pg.table.name}, handle:{pg.handle}"
+            if len(pg.handles) > 1:
+                line = f"Batch_Point_Get  table:{pg.table.name}, handles:{pg.handles}"
+            else:
+                line = f"Point_Get  table:{pg.table.name}, handle:{pg.handle}"
             return Result(columns=["plan"], rows=[(line,)])
         plan = self._plan_select(inner)
         if stmt.analyze:
