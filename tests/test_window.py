@@ -133,3 +133,47 @@ def test_lag_string_default(db):
 def test_ntile_zero_rejected(db):
     with pytest.raises(Exception, match="positive"):
         db.query("SELECT NTILE(0) OVER (ORDER BY v) FROM w")
+
+
+def test_bounded_rows_frames(db):
+    db.execute("CREATE TABLE wf (g VARCHAR(4), o BIGINT, v BIGINT)")
+    db.execute(
+        "INSERT INTO wf VALUES ('a',1,10),('a',2,20),('a',3,30),('a',4,40),('b',1,5),('b',2,NULL),('b',3,15)"
+    )
+    s = db.session()
+    # moving sum over 1 PRECEDING..CURRENT
+    rows = s.query(
+        "SELECT g, o, SUM(v) OVER (PARTITION BY g ORDER BY o ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) FROM wf ORDER BY g, o"
+    )
+    assert rows == [
+        ("a", 1, 10), ("a", 2, 30), ("a", 3, 50), ("a", 4, 70),
+        ("b", 1, 5), ("b", 2, 5), ("b", 3, 15),
+    ]
+    # centered window 1 PRECEDING..1 FOLLOWING: COUNT(*) counts rows, not nulls
+    rows = s.query(
+        "SELECT g, o, COUNT(*) OVER (PARTITION BY g ORDER BY o ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) FROM wf ORDER BY g, o"
+    )
+    assert [r[2] for r in rows] == [2, 3, 3, 2, 2, 3, 2]
+    # MIN/MAX over sliding frames
+    rows = s.query(
+        "SELECT g, o, MIN(v) OVER (PARTITION BY g ORDER BY o ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING), "
+        "MAX(v) OVER (PARTITION BY g ORDER BY o ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) FROM wf ORDER BY g, o"
+    )
+    assert rows == [
+        ("a", 1, 10, 20), ("a", 2, 10, 30), ("a", 3, 20, 40), ("a", 4, 30, 40),
+        ("b", 1, 5, 5), ("b", 2, 5, 15), ("b", 3, 15, 15),
+    ]
+    # FIRST_VALUE / LAST_VALUE honor the frame; empty frame → NULL
+    rows = s.query(
+        "SELECT g, o, FIRST_VALUE(v) OVER (PARTITION BY g ORDER BY o ROWS BETWEEN 1 FOLLOWING AND 2 FOLLOWING), "
+        "LAST_VALUE(v) OVER (PARTITION BY g ORDER BY o ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) FROM wf ORDER BY g, o"
+    )
+    assert rows == [
+        ("a", 1, 20, 10), ("a", 2, 30, 20), ("a", 3, 40, 30), ("a", 4, None, 40),
+        ("b", 1, None, 5), ("b", 2, 15, None), ("b", 3, None, 15),
+    ]
+    # shorthand: ROWS 2 PRECEDING == BETWEEN 2 PRECEDING AND CURRENT ROW
+    rows = s.query(
+        "SELECT SUM(v) OVER (PARTITION BY g ORDER BY o ROWS 2 PRECEDING) FROM wf ORDER BY g, o"
+    )
+    assert [r[0] for r in rows] == [10, 30, 60, 90, 5, 5, 20]

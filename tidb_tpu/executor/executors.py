@@ -682,8 +682,29 @@ class WindowExec(Executor):
             starts = np.flatnonzero(ps)
             ends = np.r_[starts[1:], m]
             sizes = ends - starts
-            # frame end (exclusive) per row under the supported frames
-            if p.whole_partition:
+            # frame [fs, fe) per row under the supported frames
+            fs = np.zeros(m, dtype=np.int64)
+            if p.frame is not None:
+                skind, sn, ekind, en = p.frame
+                idx = np.arange(m, dtype=np.int64)
+                if skind == "unbounded":
+                    fs = np.zeros(m, dtype=np.int64)
+                elif skind == "current":
+                    fs = idx
+                elif skind == "preceding":
+                    fs = np.maximum(idx - sn, 0)
+                else:  # following
+                    fs = np.minimum(idx + sn, m)
+                if ekind == "unbounded":
+                    fe = np.full(m, m, dtype=np.int64)
+                elif ekind == "current":
+                    fe = idx + 1
+                elif ekind == "preceding":
+                    fe = np.maximum(idx - en + 1, 0)
+                else:  # following
+                    fe = np.minimum(idx + en + 1, m)
+                fe = np.maximum(fe, fs)  # empty frames: fe == fs
+            elif p.whole_partition:
                 fe = np.full(m, m, dtype=np.int64)
             elif p.rows_frame:
                 fe = np.arange(1, m + 1, dtype=np.int64)
@@ -727,17 +748,22 @@ class WindowExec(Executor):
                     out[s:e] = np.where(ok, out[s:e], dv)
                     valid[s:e] = np.where(ok, valid[s:e], dvalid)
             elif name == "first_value":
-                out[s:e] = av[s]
-                valid[s:e] = vv[s]
+                nonempty = fe > fs
+                fs_c = np.clip(fs, 0, m - 1)
+                out[s:e] = np.where(nonempty, av[s:e][fs_c], 0)
+                valid[s:e] = np.where(nonempty, vv[s:e][fs_c], False)
             elif name == "last_value":
-                out[s:e] = av[s:e][fe - 1]
-                valid[s:e] = vv[s:e][fe - 1]
+                nonempty = fe > fs
+                fe_c = np.clip(fe - 1, 0, m - 1)
+                out[s:e] = np.where(nonempty, av[s:e][fe_c], 0)
+                valid[s:e] = np.where(nonempty, vv[s:e][fe_c], False)
             elif name in ("count", "sum", "avg", "min", "max"):
                 if name == "count" and not argcols:
-                    out[s:e] = fe
+                    out[s:e] = fe - fs
                     continue
                 pvv = vv[s:e]
-                cnt = np.cumsum(pvv.astype(np.int64))[fe - 1]
+                c0 = np.r_[0, np.cumsum(pvv.astype(np.int64))]
+                cnt = c0[fe] - c0[fs]
                 if name == "count":
                     out[s:e] = cnt
                     continue
@@ -751,22 +777,26 @@ class WindowExec(Executor):
                     else:
                         fill = np.iinfo(np.int64).max if name == "min" else np.iinfo(np.int64).min
                     lane = np.where(pvv, rank, fill)
-                    acc = (np.minimum if name == "min" else np.maximum).accumulate(lane)
-                    best = acc[fe - 1]
+                    if p.frame is None:
+                        acc = (np.minimum if name == "min" else np.maximum).accumulate(lane)
+                        best = acc[np.maximum(fe - 1, 0)]
+                    else:
+                        best = _sliding_extreme(lane, fs, fe, name == "min", fill)
                     if mm_codes is not None:
                         # all-NULL frames carry the sentinel — mask before the
                         # rank→code fancy index, not after
                         best = np.where(cnt > 0, best, 0)
-                        res = mm_codes[best]
+                        res = mm_codes[best.astype(np.int64)]
                     else:
                         res = best
                     out[s:e] = np.where(cnt > 0, res.astype(dt, copy=False), 0)
                     valid[s:e] = cnt > 0
                     continue
                 filled = np.where(pvv, pav, 0)
-                cum = np.cumsum(
-                    filled.astype(np.float64 if dt == np.float64 else np.int64)
-                )[fe - 1]
+                s0 = np.r_[
+                    0, np.cumsum(filled.astype(np.float64 if dt == np.float64 else np.int64))
+                ]
+                cum = s0[fe] - s0[fs]
                 if name == "sum":
                     out[s:e] = np.where(cnt > 0, cum.astype(dt, copy=False), 0)
                     valid[s:e] = cnt > 0
@@ -783,6 +813,33 @@ class WindowExec(Executor):
             else:
                 raise ExecError(f"unsupported window function {name}")
         return out, valid
+
+
+def _sliding_extreme(lane, fs, fe, is_min: bool, fill):
+    """MIN/MAX over sliding [fs, fe) frames via a monotonic deque (frame
+    bounds are nondecreasing for ROWS frames → O(n) total)."""
+    from collections import deque
+
+    m = len(lane)
+    out = np.full(m, fill, dtype=lane.dtype)
+    dq: deque = deque()  # indices, lane values monotonic
+    lo = 0
+    hi = 0
+    better = (lambda a, b: a <= b) if is_min else (lambda a, b: a >= b)
+    for i in range(m):
+        while hi < fe[i]:
+            v = lane[hi]
+            while dq and better(v, lane[dq[-1]]):
+                dq.pop()
+            dq.append(hi)
+            hi += 1
+        while lo < fs[i]:
+            if dq and dq[0] == lo:
+                dq.popleft()
+            lo += 1
+        if dq and fe[i] > fs[i]:
+            out[i] = lane[dq[0]]
+    return out
 
 
 def _np_dtype(ftype):

@@ -108,9 +108,12 @@ class WindowSpec(Node):
     # frame), or ROWS UNBOUNDED..CURRENT (exact cut at the current row)
     whole_partition: bool = False
     rows_frame: bool = False
+    # bounded ROWS frame: (start_kind, start_n, end_kind, end_n) with kinds
+    # "preceding"/"current"/"following"/"unbounded" (ref: ast.FrameBound)
+    frame: Optional[tuple] = None
 
     def key(self) -> str:
-        return repr((self.partition_by, self.order_by, self.whole_partition, self.rows_frame))
+        return repr((self.partition_by, self.order_by, self.whole_partition, self.rows_frame, self.frame))
 
 
 @dataclass

@@ -650,23 +650,39 @@ class Parser:
             self.expect_kw("BY")
             spec.order_by = self.parse_order_items()
         if self.at_kw("ROWS", "RANGE", "GROUPS"):
-            # explicit frames: only the canonical spellings of the implicit
-            # frames are executable (ref: executor window frames, subset)
             unit = self.next().value.upper()
-            ok = False
-            if self.eat_kw("BETWEEN") and self.eat_kw("UNBOUNDED"):
-                self.expect_kw("PRECEDING")
-                self.expect_kw("AND")
+
+            def bound(is_start: bool):
+                if self.eat_kw("UNBOUNDED"):
+                    self.expect_kw("PRECEDING" if is_start else "FOLLOWING")
+                    return ("unbounded", 0)
                 if self.eat_kw("CURRENT"):
                     self.expect_kw("ROW")
-                    spec.rows_frame = unit == "ROWS"
-                    ok = True
-                elif self.eat_kw("UNBOUNDED"):
-                    self.expect_kw("FOLLOWING")
-                    spec.whole_partition = True
-                    ok = True
-            if not ok:
-                raise ParseError("unsupported window frame", self.peek())
+                    return ("current", 0)
+                t = self.next()
+                if t.kind != "int":
+                    raise ParseError("expected frame offset", t)
+                if self.eat_kw("PRECEDING"):
+                    return ("preceding", int(t.value))
+                self.expect_kw("FOLLOWING")
+                return ("following", int(t.value))
+
+            if self.eat_kw("BETWEEN"):
+                start = bound(True)
+                self.expect_kw("AND")
+                end = bound(False)
+            else:
+                start = bound(True)
+                end = ("current", 0)
+            # canonical spellings of the implicit frames
+            if start == ("unbounded", 0) and end == ("current", 0):
+                spec.rows_frame = unit == "ROWS"
+            elif start == ("unbounded", 0) and end[0] == "unbounded":
+                spec.whole_partition = True
+            elif unit == "ROWS":
+                spec.frame = (start[0], start[1], end[0], end[1])
+            else:
+                raise ParseError("bounded RANGE/GROUPS frames are not supported", self.peek())
         self.expect_op(")")
         return spec
 

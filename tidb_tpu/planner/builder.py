@@ -699,8 +699,9 @@ class Builder:
                 funcs=funcs,
                 partition_by=part,
                 order_by=order,
-                whole_partition=spec.whole_partition or not spec.order_by,
+                whole_partition=spec.whole_partition or (not spec.order_by and spec.frame is None),
                 rows_frame=spec.rows_frame,
+                frame=spec.frame,
                 children=[plan],
             )
             win.schema = list(plan.schema) + [

@@ -561,6 +561,7 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan
             order_by=plan.order_by,
             whole_partition=plan.whole_partition,
             rows_frame=plan.rows_frame,
+            frame=plan.frame,
             schema=plan.schema,
             children=[_physical(plan.children[0], engines, stats)],
         )

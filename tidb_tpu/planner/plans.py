@@ -150,6 +150,7 @@ class LogicalWindow(LogicalPlan):
     order_by: list  # (Expression, desc) pairs
     whole_partition: bool = False
     rows_frame: bool = False
+    frame: object = None  # bounded ROWS frame tuple (see ast.WindowSpec)
     schema: Schema = field(default_factory=list)
     children: list = field(default_factory=list)
 
@@ -324,6 +325,7 @@ class PhysWindow(PhysicalPlan):
     order_by: list
     whole_partition: bool = False
     rows_frame: bool = False
+    frame: object = None  # bounded ROWS frame tuple (see ast.WindowSpec)
     schema: Schema = field(default_factory=list)
     children: list = field(default_factory=list)
 
