@@ -29,6 +29,15 @@ Q6 = """SELECT SUM(l_extendedprice * l_discount) FROM lineitem
   WHERE l_shipdate >= DATE '1994-01-01' AND l_shipdate < DATE '1995-01-01'
     AND l_discount BETWEEN 0.05 AND 0.07 AND l_quantity < 24"""
 
+# the remaining BASELINE.json configs: full-scan count, Q10-style TopN
+# pushdown, Q3-style MPP join (2-way exchange)
+COUNT_STAR = "SELECT COUNT(*) FROM lineitem"
+Q10 = """SELECT l_returnflag, l_extendedprice FROM lineitem
+  WHERE l_shipdate >= DATE '1994-01-01'
+  ORDER BY l_extendedprice DESC LIMIT 20"""
+Q3 = """SELECT o_odate, SUM(l_extendedprice) AS rev FROM lineitem2, orders
+  WHERE l_orderkey = o_orderkey GROUP BY o_odate ORDER BY rev DESC, o_odate LIMIT 10"""
+
 
 def setup():
     import numpy as np
@@ -57,6 +66,21 @@ def setup():
     t0 = time.time()
     bulk_load(db, "lineitem", cols)
     load_s = time.time() - t0
+
+    # Q3-style join tables: lineitem2 ⋈ orders on an integer key
+    n_orders = max(n // 10, 1)
+    db.execute("CREATE TABLE orders (o_orderkey BIGINT PRIMARY KEY, o_odate BIGINT)")
+    db.execute(
+        "CREATE TABLE lineitem2 (l_orderkey BIGINT, l_extendedprice DECIMAL(12,2))"
+    )
+    bulk_load(db, "orders", [np.arange(n_orders), 8036 + rng.integers(0, 100, n_orders)])
+    bulk_load(
+        db,
+        "lineitem2",
+        [rng.integers(0, n_orders, n), rng.integers(100000, 9000000, n)],
+    )
+    db.execute("ANALYZE TABLE orders")
+    db.execute("ANALYZE TABLE lineitem2")
     return db, load_s
 
 
@@ -77,11 +101,19 @@ def main():
     s.execute("SET tidb_isolation_read_engines = 'tpu'")
     q1_tpu = timed(s, Q1, REPS)
     q6_tpu = timed(s, Q6, REPS)
+    cnt_tpu = timed(s, COUNT_STAR, REPS)
+    q10_tpu = timed(s, Q10, REPS)
+    q3_tpu = timed(s, Q3, max(1, REPS // 2))
     tpu_rows = s.query(Q1)
 
     s.execute("SET tidb_isolation_read_engines = 'host'")
     q1_host = timed(s, Q1, max(1, REPS // 2))
     q6_host = timed(s, Q6, max(1, REPS // 2))
+    cnt_host = timed(s, COUNT_STAR, max(1, REPS // 2))
+    q10_host = timed(s, Q10, max(1, REPS // 2))
+    s.execute("SET tidb_allow_mpp = 0")  # host reference path for the join
+    q3_host = timed(s, Q3, max(1, REPS // 2))
+    s.execute("SET tidb_allow_mpp = 1")
     host_rows = s.query(Q1)
 
     assert [r[:2] + tuple(str(x) for x in r[2:]) for r in tpu_rows] == [
@@ -102,6 +134,12 @@ def main():
             "q6_tpu_ms": round(q6_tpu * 1e3, 1),
             "q6_host_ms": round(q6_host * 1e3, 1),
             "q6_speedup": round(q6_host / q6_tpu, 2),
+            "count_tpu_ms": round(cnt_tpu * 1e3, 1),
+            "count_host_ms": round(cnt_host * 1e3, 1),
+            "q10_topn_tpu_ms": round(q10_tpu * 1e3, 1),
+            "q10_topn_host_ms": round(q10_host * 1e3, 1),
+            "q3_join_mpp_ms": round(q3_tpu * 1e3, 1),
+            "q3_join_host_ms": round(q3_host * 1e3, 1),
             "load_s": round(load_s, 1),
             "platform": _platform(),
         },

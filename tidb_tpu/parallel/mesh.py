@@ -6,6 +6,9 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 
+_MESH_CACHE: dict = {}
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = "dp"):
     """1-D mesh over available devices. SQL fragments parallelize along one
     data axis; intra-device parallelism is XLA's job (VPU/MXU), so unlike an
@@ -25,4 +28,11 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "dp"):
         devs = devs[:n_devices]
     import numpy as np
 
-    return Mesh(np.array(devs), (axis,))
+    # one Mesh object per (devices, axis): jitted MPP programs close over
+    # the mesh, so identity stability keeps the XLA compile cache warm
+    key = (tuple(id(d) for d in devs), axis)
+    mesh = _MESH_CACHE.get(key)
+    if mesh is None:
+        mesh = Mesh(np.array(devs), (axis,))
+        _MESH_CACHE[key] = mesh
+    return mesh

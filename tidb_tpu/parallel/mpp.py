@@ -57,18 +57,27 @@ def _segment_partial(jnp, keys, vals, mask, cap):
     boundary = sm & (first | diff)
     overflow = jnp.maximum(boundary.sum() - cap, 0)
     seg = jnp.clip(jnp.cumsum(boundary) - 1, 0, None)
-    import jax
+    # scatter-free segmented reduction (TPU scatter serializes — same policy
+    # as ops/dag_kernel.py): cumsum deltas at searchsorted boundaries
+    ks = jnp.arange(cap)
+    starts = jnp.searchsorted(seg, ks)
+    starts_c = jnp.clip(starts, 0, n - 1)
+    ends_c = jnp.clip(jnp.searchsorted(seg, ks, side="right") - 1, 0, n - 1)
+    slot_live = ks < boundary.sum()
 
-    cnt = jax.ops.segment_sum(sm.astype(jnp.int64), seg, num_segments=cap)
+    def _csum_delta(x):
+        cs = jnp.cumsum(x)
+        lo = jnp.where(starts_c > 0, cs[jnp.maximum(starts_c - 1, 0)], 0)
+        return jnp.where(slot_live, cs[ends_c] - lo, 0)
+
+    cnt = _csum_delta(sm.astype(jnp.int64))
     out_keys = []
-    pos = jnp.arange(n)
-    first_pos = jnp.clip(jax.ops.segment_min(jnp.where(sm, pos, n), seg, num_segments=cap), 0, n - 1)
     for k in keys:
-        out_keys.append(k[perm][first_pos])
+        out_keys.append(jnp.where(slot_live, k[perm][starts_c], 0))
     out_sums = []
     for v in vals:
         vs = v[perm]
-        out_sums.append(jax.ops.segment_sum(jnp.where(sm, vs, 0), seg, num_segments=cap))
+        out_sums.append(_csum_delta(jnp.where(sm, vs, 0)))
     return out_keys, out_sums, cnt, overflow  # slot i valid iff cnt[i] > 0
 
 

@@ -79,6 +79,18 @@ def _remap_expr(e: Expression, mapping: dict[int, int]) -> Expression:
     return e
 
 
+def _subst_refs(e: Expression, exprs: list[Expression]):
+    """Rewrite ColumnRefs through a projection's exprs (None = not mappable)."""
+    if isinstance(e, ColumnRef):
+        return exprs[e.index] if e.index < len(exprs) else None
+    if isinstance(e, ScalarFunc):
+        args = [_subst_refs(a, exprs) for a in e.args]
+        if any(a is None for a in args):
+            return None
+        return ScalarFunc(e.sig, args, e.ftype)
+    return e
+
+
 def _expr_cols(e: Expression, out: set[int]) -> None:
     if isinstance(e, ColumnRef):
         out.add(e.index)
@@ -239,6 +251,23 @@ def _push_selections(plan: LogicalPlan) -> LogicalPlan:
             elif join.kind in ("inner", "cross") and s and min(s) >= nleft:
                 remapped = _remap_expr(cond, {i: i - nleft for i in s})
                 join.children[1] = LogicalSelection(conditions=[remapped], children=[join.children[1]])
+            elif (
+                join.kind in ("inner", "cross")
+                and isinstance(cond, ScalarFunc)
+                and cond.sig == "eq"
+                and all(isinstance(a, ColumnRef) for a in cond.args)
+                and len({a.index < nleft for a in cond.args}) == 2  # type: ignore[union-attr]
+            ):
+                # WHERE equality across a comma/cross join → join key
+                # (ref: ppdSolver turning cartesian + filter into equi-join)
+                l, r = cond.args
+                if l.index >= nleft:  # type: ignore[union-attr]
+                    l, r = r, l
+                join.eq_conds.append((l.index, r.index - nleft))  # type: ignore[union-attr]
+                join.kind = "inner"
+            elif join.kind in ("inner", "cross") and s and len({i < nleft for i in s}) == 2:
+                join.other_conds.append(cond)
+                join.kind = "inner"
             else:
                 keep.append(cond)
         # merge adjacent selections on the same side
@@ -535,16 +564,33 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan
     if isinstance(plan, LogicalLimit):
         child = _physical(plan.children[0], engines, stats)
         total = plan.limit + plan.offset
-        # topN pushdown: Limit(Sort(reader)) → reader TopN + root merge sort
-        if isinstance(child, PhysSort) and isinstance(child.children[0], PhysTableReader):
-            reader = child.children[0]
-            if reader.pushed_agg is None and reader.pushed_topn is None and reader.pushed_limit is None:
-                st = _pick_engine(engines, list(reader.pushed_conditions) + [e for e, _ in child.by])
-                if all(can_push_down(e, st.value) for e, _ in child.by) and all(
+        # topN pushdown: Limit(Sort([Projection](reader))) → reader TopN +
+        # root merge sort; sort keys remap through the projection
+        if isinstance(child, PhysSort):
+            below = child.children[0]
+            by = child.by
+            reader = None
+            if isinstance(below, PhysTableReader):
+                reader = below
+            elif isinstance(below, PhysProjection) and isinstance(
+                below.children[0], PhysTableReader
+            ):
+                remapped = [(_subst_refs(e, below.exprs), d) for e, d in by]
+                if all(r is not None for r, _ in remapped):
+                    reader = below.children[0]
+                    by = remapped
+            if (
+                reader is not None
+                and reader.pushed_agg is None
+                and reader.pushed_topn is None
+                and reader.pushed_limit is None
+            ):
+                st = _pick_engine(engines, list(reader.pushed_conditions) + [e for e, _ in by])
+                if all(can_push_down(e, st.value) for e, _ in by) and all(
                     can_push_down(c, st.value) for c in reader.pushed_conditions
                 ):
                     reader.store_type = st
-                    reader.pushed_topn = (child.by, total)
+                    reader.pushed_topn = (by, total)
         elif isinstance(child, PhysTableReader) and child.pushed_agg is None and child.pushed_topn is None:
             child.pushed_limit = total
         return PhysLimit(limit=plan.limit, offset=plan.offset, children=[child])
