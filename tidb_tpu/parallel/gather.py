@@ -33,6 +33,8 @@ from tidb_tpu.types import TypeKind
 
 # structural key → jitted MPP program (see MPPGatherExec.execute)
 _MPP_FN_CACHE: dict = {}
+# (store, table, slots, region versions, ndev) → padded device input lanes
+_MPP_DEV_CACHE: dict = {}
 
 
 @dataclass
@@ -257,13 +259,13 @@ class MPPGatherExec:
         p = self.plan
         mesh = make_mesh()
         ndev = mesh.devices.size
-        lchunk = self._reader_arrays(p.left)
+        self._dev_cacheable = (
+            not self.session._txn_dirty()
+            and self.session._read_ts_override is None
+            and not float(self.session.vars.get("tidb_read_staleness", 0) or 0)
+        )
         lconds = self._bind_conditions(p.left)
-        if p.right is not None:
-            rchunk = self._reader_arrays(p.right)
-            rconds = self._bind_conditions(p.right)
-        else:
-            rchunk, rconds = None, []
+        rconds = self._bind_conditions(p.right) if p.right is not None else []
         agg = p.agg
 
         def pad_side(chunk):
@@ -283,13 +285,43 @@ class MPPGatherExec:
             arrays.append(live)
             return arrays, n
 
-        larrays, n_l = pad_side(lchunk)
-        if rchunk is not None:
-            rarrays, n_r = pad_side(rchunk)
+        def dev_side(reader):
+            """Padded device-resident input lanes, cached per table state —
+            steady-state MPP queries re-read and re-upload nothing (same
+            identity scheme as the coprocessor engine's device cache)."""
+            key = None
+            if self._dev_cacheable:
+                from tidb_tpu.kv import tablecodec
+
+                regions = self.session.store.pd.regions_in_ranges(
+                    [tablecodec.record_range(reader.table.id)]
+                )
+                vers = tuple((r.region_id, r.data_version) for r, _ in regions)
+                key = (
+                    self.session.store.nonce,
+                    reader.table.id,
+                    tuple(reader.scan_slots),
+                    vers,
+                    ndev,
+                )
+                hit = _MPP_DEV_CACHE.get(key)
+                if hit is not None:
+                    return hit
+            arrays, n = pad_side(self._reader_arrays(reader))
+            dev = ([jnp.asarray(a) for a in arrays], n)
+            if key is not None:
+                _MPP_DEV_CACHE[key] = dev
+                while len(_MPP_DEV_CACHE) > 32:
+                    _MPP_DEV_CACHE.pop(next(iter(_MPP_DEV_CACHE)))
+            return dev
+
+        larrays, n_l = dev_side(p.left)
+        if p.right is not None:
+            rarrays, n_r = dev_side(p.right)
         else:
             rarrays, n_r = [], 0
-        ncols_l = len(lchunk.columns)
-        ncols_r = len(rchunk.columns) if rchunk is not None else 0
+        ncols_l = len(p.left.scan_slots)
+        ncols_r = len(p.right.scan_slots) if p.right is not None else 0
 
         def side_selection(conds, ncols):
             def fn(*cols):
@@ -369,7 +401,7 @@ class MPPGatherExec:
 
         n_group_lanes = 2 * len(agg.group_by) if agg.group_by else 2
         sums_idx = list(range(n_group_lanes, n_group_lanes + 2 * sum(1 for a in agg.aggs if a.arg is not None)))
-        group_cap = self._initial_group_cap(len(lchunk))
+        group_cap = self._initial_group_cap(n_l)
         per_shard = (max(n_l, 1) + ndev - 1) // ndev
         row_cap = max(2 * per_shard, 64)
         while True:
@@ -416,7 +448,7 @@ class MPPGatherExec:
                 _MPP_FN_CACHE[fn_key] = fn
                 while len(_MPP_FN_CACHE) > 64:
                     _MPP_FN_CACHE.pop(next(iter(_MPP_FN_CACHE)))
-            outs = fn(*[jnp.asarray(a) for a in larrays + rarrays])
+            outs = fn(*(list(larrays) + list(rarrays)))
             dropped = int(np.asarray(outs[-2]))
             group_overflow = int(np.asarray(outs[-1]))
             if dropped == 0 and group_overflow == 0:
