@@ -449,8 +449,24 @@ class MPPGatherExec:
                 while len(_MPP_FN_CACHE) > 64:
                     _MPP_FN_CACHE.pop(next(iter(_MPP_FN_CACHE)))
             outs = fn(*(list(larrays) + list(rarrays)))
-            dropped = int(np.asarray(outs[-2]))
-            group_overflow = int(np.asarray(outs[-1]))
+            # ONE device→host transfer for every output lane: concat int64
+            # views (floats ride value-exact only when integral — sums over
+            # DOUBLE keep per-array fetches), then split host-side
+            shapes = [tuple(o.shape) for o in outs]
+            any_float = any(str(o.dtype).startswith("float") for o in outs)
+            if not any_float:
+                flat = jnp.concatenate([jnp.ravel(o).astype(jnp.int64) for o in outs])
+                host = np.asarray(flat)
+                arrs = []
+                off = 0
+                for shp in shapes:
+                    sz = int(np.prod(shp)) if shp else 1
+                    arrs.append(host[off : off + sz].reshape(shp))
+                    off += sz
+            else:
+                arrs = [np.asarray(o) for o in outs]
+            dropped = int(arrs[-2])
+            group_overflow = int(arrs[-1])
             if dropped == 0 and group_overflow == 0:
                 break
             # grow-on-overflow, like coprocessor paging
@@ -458,7 +474,7 @@ class MPPGatherExec:
                 row_cap *= 4
             if group_overflow:
                 group_cap *= 4
-        return self._merge(outs[:-2], agg)
+        return self._merge(arrs[:-2], agg)
 
     def _initial_group_cap(self, n_left_rows: int) -> int:
         """Static per-shard group capacity: NDV-product estimate with a
