@@ -52,6 +52,8 @@ class Catalog:
         self.schema_version = 0
         self._dbs: dict[str, DBInfo] = {}
         self._autoid_cache: dict[int, tuple[int, int]] = {}  # tid → (next, max)
+        # dropped/truncated table snapshots awaiting GC (RECOVER TABLE)
+        self._recycle: list[dict] = []
         self._load()
         if "test" not in self._dbs:  # bootstrap default db (ref: session bootstrap)
             self._dbs["test"] = DBInfo("test")
@@ -64,10 +66,15 @@ class Catalog:
             pb = json.loads(raw.decode())
             self.schema_version = pb["version"]
             self._dbs = {k: DBInfo.from_pb(v) for k, v in pb["dbs"].items()}
+            self._recycle = pb.get("recycle", [])
 
     def _persist(self) -> None:
         self.schema_version += 1
-        pb = {"version": self.schema_version, "dbs": {k: v.to_pb() for k, v in self._dbs.items()}}
+        pb = {
+            "version": self.schema_version,
+            "dbs": {k: v.to_pb() for k, v in self._dbs.items()},
+            "recycle": self._recycle,
+        }
         self.store.raw_put(META_KEY, json.dumps(pb).encode())
 
     def _next_table_id(self) -> int:
@@ -239,6 +246,9 @@ class Catalog:
         return c.offset
 
     def drop_table(self, db: str, name: str, if_exists: bool = False) -> None:
+        """DROP defers data deletion: the definition moves to the recycle bin
+        with its rows intact until the GC safe point passes, enabling
+        RECOVER/FLASHBACK TABLE (ref: TiDB delayed deletion + recover)."""
         with self._mu:
             dbi = self.db(db)
             t = dbi.tables.get(name.lower())
@@ -246,22 +256,62 @@ class Catalog:
                 if if_exists:
                     return
                 raise CatalogError(f"Unknown table '{name}'")
-            self._drop_table_data(t)
+            self._recycle.append({"drop_ts": self.store.current_ts(), "db": db.lower(), "table": t.to_pb()})
             del dbi.tables[name.lower()]
             self._persist()
 
     def truncate_table(self, db: str, name: str) -> TableInfo:
-        """New table id, old data orphaned for GC (ref: TiDB truncate)."""
+        """New table id; the old snapshot goes to the recycle bin
+        (ref: TiDB truncate + FLASHBACK-after-truncate)."""
+        import copy as _copy
+
         with self._mu:
             dbi = self.db(db)
             t = self.table(db, name)
-            self._drop_table_data(t)
+            self._recycle.append(
+                {"drop_ts": self.store.current_ts(), "db": db.lower(), "table": _copy.deepcopy(t).to_pb()}
+            )
             t.id = self._next_table_id()
             if t.partition is not None:
                 for d in t.partition.defs:
                     d.id = self._next_table_id()
             self._persist()
             return t
+
+    def recover_table(self, db: str, name: str, new_name: str = "") -> TableInfo:
+        """RECOVER/FLASHBACK TABLE: restore the most recently dropped
+        definition (data was never deleted) under its old or a new name."""
+        with self._mu:
+            dbi = self.db(db)
+            for i in range(len(self._recycle) - 1, -1, -1):
+                ent = self._recycle[i]
+                if ent["db"] == db.lower() and ent["table"]["name"] == name.lower():
+                    t = TableInfo.from_pb(ent["table"])
+                    target = (new_name or t.name).lower()
+                    if target in dbi.tables:
+                        raise CatalogError(f"Table {target!r} already exists")
+                    t.name = target
+                    dbi.tables[target] = t
+                    del self._recycle[i]
+                    self._persist()
+                    return t
+            raise CatalogError(f"Can't find dropped table '{name}' in GC safe point range")
+
+    def purge_recycle_bin(self, safe_ts: int) -> int:
+        """GC: delete the data of entries dropped before the safe point."""
+        with self._mu:
+            keep = []
+            purged = 0
+            for ent in self._recycle:
+                if ent["drop_ts"] < safe_ts:
+                    self._drop_table_data(TableInfo.from_pb(ent["table"]))
+                    purged += 1
+                else:
+                    keep.append(ent)
+            if purged:
+                self._recycle = keep
+                self._persist()
+            return purged
 
     # -- sequences (ref: ddl sequence.go / model.SequenceInfo) ---------------
     def create_sequence(self, db: str, name: str, start: int, increment: int, if_not_exists: bool) -> None:

@@ -565,6 +565,15 @@ class DropBinding(Node):
 
 
 @dataclass
+class RecoverTable(Node):
+    """RECOVER TABLE t / FLASHBACK TABLE t [TO t2] (ref: ast.RecoverTableStmt,
+    FlashBackTableStmt)."""
+
+    table: TableRef
+    new_name: str = ""
+
+
+@dataclass
 class Admin(Node):
     """ADMIN CHECK TABLE / CHECK INDEX / SHOW DDL JOBS (ref: ast.AdminStmt)."""
 

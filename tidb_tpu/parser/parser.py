@@ -120,6 +120,8 @@ class Parser:
             "REVOKE": self.parse_grant,
             "TRACE": lambda: (self.next(), ast.Trace(self.parse_statement()))[1],
             "ADMIN": self.parse_admin,
+            "RECOVER": self.parse_recover,
+            "FLASHBACK": self.parse_recover,
         }.get(kw)
         if fn is None:
             raise ParseError("unsupported statement", t)
@@ -1353,6 +1355,15 @@ class Parser:
                 raise ParseError(f"unknown resource group option {kw!r}", self.peek())
             self.eat_op(",")
         return st
+
+    def parse_recover(self) -> ast.RecoverTable:
+        self.next()  # RECOVER | FLASHBACK
+        self.expect_kw("TABLE")
+        tbl = self._table_ref_simple()
+        new_name = ""
+        if self.eat_kw("TO"):
+            new_name = self.ident().lower()
+        return ast.RecoverTable(tbl, new_name)
 
     def parse_admin(self) -> ast.Admin:
         self.expect_kw("ADMIN")

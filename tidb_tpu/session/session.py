@@ -380,6 +380,10 @@ class Session:
             store = self._db.bindings if stmt.is_global else self.bindings
             store.pop(_digest(stmt.for_text), None)
             return Result()
+        if isinstance(stmt, ast.RecoverTable):
+            self.require_priv(stmt.table.db or self.current_db, stmt.table.name, "create")
+            self.catalog.recover_table(stmt.table.db or self.current_db, stmt.table.name, stmt.new_name)
+            return Result()
         if isinstance(stmt, ast.Admin):
             return self._admin(stmt)
         if isinstance(stmt, ast.ResourceGroupStmt):
@@ -1118,7 +1122,10 @@ class DB:
         tidb_gc_life_time global (seconds)."""
         life_s = float(self.global_vars.get("tidb_gc_life_time", DEFAULT_SYSVARS["tidb_gc_life_time"]))
         self.gc_worker.life_ms = int(life_s * 1000)
-        return self.gc_worker.run_once(safe_point)
+        pruned = self.gc_worker.run_once(safe_point)
+        # dropped-table snapshots become unrecoverable past the safe point
+        self.catalog.purge_recycle_bin(self.gc_worker.safe_point)
+        return pruned
 
     def session(self) -> Session:
         s = Session(self)
