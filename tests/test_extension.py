@@ -1,0 +1,59 @@
+"""Extension points + audit log (ref: pkg/extension, pkg/plugin audit)."""
+
+import pytest
+
+import tidb_tpu
+from tidb_tpu.extension import AuditLogger, Extension
+from tidb_tpu.server import Client, Server
+from tidb_tpu.server.client import MySQLError
+
+
+def test_stmt_audit_events():
+    db = tidb_tpu.open()
+    audit = AuditLogger()
+    db.extensions.register(audit)
+    db.execute("CREATE TABLE t (a BIGINT)")
+    db.execute("INSERT INTO t VALUES (1)")
+    with pytest.raises(Exception):
+        db.execute("SELECT nope FROM t")
+    events = list(audit.stmt_log)
+    assert [e.event for e in events] == ["ok", "ok", "error"]
+    assert "CREATE TABLE" in events[0].sql
+    assert events[2].error and events[2].user == "root@%"
+    assert all(e.duration_s >= 0 for e in events)
+
+
+def test_connection_audit_events():
+    db = tidb_tpu.open()
+    db.execute("CREATE USER 'eve'@'%' IDENTIFIED BY 'right'")
+    audit = AuditLogger()
+    db.extensions.register(audit)
+    server = Server(db)
+    port = server.start()
+    try:
+        c = Client(port=port, user="eve", password="right")
+        c.query("SELECT 1")
+        c.close()
+        with pytest.raises(MySQLError):
+            Client(port=port, user="eve", password="wrong")
+        import time
+
+        deadline = time.time() + 5
+        while time.time() < deadline and len(audit.conn_log) < 3:
+            time.sleep(0.05)
+        kinds = [e.event for e in audit.conn_log]
+        assert "connected" in kinds and "disconnected" in kinds and "rejected" in kinds
+    finally:
+        server.close()
+
+
+def test_broken_extension_never_breaks_queries():
+    db = tidb_tpu.open()
+
+    class Boom(Extension):
+        def on_stmt_event(self, ev):
+            raise RuntimeError("boom")
+
+    db.extensions.register(Boom())
+    db.execute("CREATE TABLE t (a BIGINT)")
+    assert db.query("SELECT COUNT(*) FROM t") == [(0,)]

@@ -67,9 +67,11 @@ class ClientConn:
             io.write(
                 p.err_packet(1045, f"Access denied for user '{self.user}'@'127.0.0.1'", "28000")
             )
+            self.server._conn_event("rejected", self)
             return False
         self.session.user = self.user
         self.session.host = "127.0.0.1"
+        self.server._conn_event("connected", self)
         if caps & p.CLIENT_CONNECT_WITH_DB and off < len(resp):
             end = resp.index(b"\x00", off)
             dbname = resp[off:end].decode()
@@ -109,6 +111,7 @@ class ClientConn:
                 else:
                     io.write(p.err_packet(1047, f"Unknown command {cmd}", "08S01"))
         finally:
+            self.server._conn_event("disconnected", self)
             self.server._deregister(self.conn_id)
             try:
                 self.sock.close()
@@ -187,6 +190,15 @@ class Server:
                 conn = ClientConn(self, sock, cid)
                 self._conns[cid] = conn
             threading.Thread(target=conn.run, daemon=True).start()
+
+    def _conn_event(self, event: str, conn: "ClientConn") -> None:
+        exts = getattr(self.db, "extensions", None)
+        if exts is not None and exts.list():
+            import time as _t
+
+            from tidb_tpu.extension import ConnEvent
+
+            exts.notify_conn(ConnEvent(_t.time(), event, conn.user, "127.0.0.1", conn.conn_id))
 
     def _deregister(self, conn_id: int) -> None:
         with self._mu:

@@ -258,9 +258,25 @@ class Session:
                 g.consume(0.125 + (len(res.rows) or res.affected))
                 if g.exec_elapsed_s and dt > g.exec_elapsed_s:
                     self._db.resource_groups.record_runaway(g.name, g.action, sql[:256])
+            if self._db.extensions.list():
+                from tidb_tpu.extension import StmtEvent
+
+                self._db.extensions.notify_stmt(
+                    StmtEvent(_time.time(), f"{self.user}@{self.host}", self.current_db, sql[:512], "ok", duration_s=dt)
+                )
             return res
-        except Exception:
+        except Exception as exc:
             _m.STMT_TOTAL.inc(type=f"{stype}:error")
+            if self._db.extensions.list():
+                from tidb_tpu.extension import StmtEvent
+
+                self._db.extensions.notify_stmt(
+                    StmtEvent(
+                        _time.time(), f"{self.user}@{self.host}", self.current_db,
+                        sql[:512], "error", error=str(exc)[:256],
+                        duration_s=_time.perf_counter() - t0,
+                    )
+                )
             g = self._db.resource_groups.get(str(self.vars.get("tidb_resource_group", "default")))
             if g is not None and g.exec_elapsed_s and (_time.perf_counter() - t0) >= g.exec_elapsed_s:
                 self._db.resource_groups.record_runaway(g.name, g.action, sql[:256])
@@ -1054,8 +1070,11 @@ class DB:
         from tidb_tpu.resourcegroup import ResourceGroupManager
         from tidb_tpu.utils.stmtsummary import StmtSummary
 
+        from tidb_tpu.extension import ExtensionRegistry
+
         self.stmt_summary = StmtSummary()
         self.resource_groups = ResourceGroupManager()
+        self.extensions = ExtensionRegistry()
         # global SQL plan bindings: digest → (for_text, using_text)
         # (ref: pkg/bindinfo binding_handle)
         self.bindings: dict[str, tuple[str, str]] = {}
