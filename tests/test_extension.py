@@ -57,3 +57,13 @@ def test_broken_extension_never_breaks_queries():
     db.extensions.register(Boom())
     db.execute("CREATE TABLE t (a BIGINT)")
     assert db.query("SELECT COUNT(*) FROM t") == [(0,)]
+
+
+def test_parse_errors_are_audited():
+    db = tidb_tpu.open()
+    audit = AuditLogger()
+    db.extensions.register(audit)
+    with pytest.raises(Exception):
+        db.execute("SELEC 1 FORM nowhere")
+    assert audit.stmt_log and audit.stmt_log[-1].event == "error"
+    assert "SELEC" in audit.stmt_log[-1].sql

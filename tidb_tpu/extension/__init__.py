@@ -49,6 +49,10 @@ class ExtensionRegistry:
     def register(self, ext: Extension) -> None:
         self._exts.append(ext)
 
+    @property
+    def have(self) -> bool:
+        return bool(self._exts)
+
     def list(self) -> list[Extension]:
         return list(self._exts)
 

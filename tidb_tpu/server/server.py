@@ -24,6 +24,7 @@ class ClientConn:
         self.user = ""
         self.current_sql: Optional[str] = None
         self.connected_at = time.time()
+        self.authed = False  # set after a successful handshake
 
     # -- handshake (protocol v10) ------------------------------------------
     def handshake(self, io: p.PacketIO) -> bool:
@@ -71,6 +72,7 @@ class ClientConn:
             return False
         self.session.user = self.user
         self.session.host = "127.0.0.1"
+        self.authed = True
         self.server._conn_event("connected", self)
         if caps & p.CLIENT_CONNECT_WITH_DB and off < len(resp):
             end = resp.index(b"\x00", off)
@@ -111,7 +113,8 @@ class ClientConn:
                 else:
                     io.write(p.err_packet(1047, f"Unknown command {cmd}", "08S01"))
         finally:
-            self.server._conn_event("disconnected", self)
+            if self.authed:  # rejected/aborted handshakes never "connected"
+                self.server._conn_event("disconnected", self)
             self.server._deregister(self.conn_id)
             try:
                 self.sock.close()
@@ -193,7 +196,7 @@ class Server:
 
     def _conn_event(self, event: str, conn: "ClientConn") -> None:
         exts = getattr(self.db, "extensions", None)
-        if exts is not None and exts.list():
+        if exts is not None and exts.have:
             import time as _t
 
             from tidb_tpu.extension import ConnEvent

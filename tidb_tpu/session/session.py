@@ -220,6 +220,20 @@ class Session:
 
         return contextlib.nullcontext()
 
+    def _audit_stmt(self, sql: str, event: str, duration_s: float, error: str = "") -> None:
+        if not self._db.extensions.have:
+            return
+        import time as _time
+
+        from tidb_tpu.extension import StmtEvent
+
+        self._db.extensions.notify_stmt(
+            StmtEvent(
+                _time.time(), f"{self.user}@{self.host}", self.current_db,
+                sql[:512], event, error=error[:256], duration_s=duration_s,
+            )
+        )
+
     # -- entry points --------------------------------------------------------
     def execute(self, sql: str) -> Result:
         import time as _time
@@ -227,8 +241,14 @@ class Session:
         from tidb_tpu.utils import metrics as _m
 
         t0 = _time.perf_counter()
-        with self.span("parse"):
-            stmt = parse(sql)
+        try:
+            with self.span("parse"):
+                stmt = parse(sql)
+        except Exception as exc:
+            # failed parses still reach the audit trail (probing attempts)
+            _m.STMT_TOTAL.inc(type="ParseError")
+            self._audit_stmt(sql, "error", _time.perf_counter() - t0, str(exc))
+            raise
         stype = type(stmt).__name__
         # plan bindings: a bound statement with a matching digest replaces
         # the incoming one (ref: bindinfo matching by normalized digest)
@@ -258,25 +278,11 @@ class Session:
                 g.consume(0.125 + (len(res.rows) or res.affected))
                 if g.exec_elapsed_s and dt > g.exec_elapsed_s:
                     self._db.resource_groups.record_runaway(g.name, g.action, sql[:256])
-            if self._db.extensions.list():
-                from tidb_tpu.extension import StmtEvent
-
-                self._db.extensions.notify_stmt(
-                    StmtEvent(_time.time(), f"{self.user}@{self.host}", self.current_db, sql[:512], "ok", duration_s=dt)
-                )
+            self._audit_stmt(sql, "ok", dt)
             return res
         except Exception as exc:
             _m.STMT_TOTAL.inc(type=f"{stype}:error")
-            if self._db.extensions.list():
-                from tidb_tpu.extension import StmtEvent
-
-                self._db.extensions.notify_stmt(
-                    StmtEvent(
-                        _time.time(), f"{self.user}@{self.host}", self.current_db,
-                        sql[:512], "error", error=str(exc)[:256],
-                        duration_s=_time.perf_counter() - t0,
-                    )
-                )
+            self._audit_stmt(sql, "error", _time.perf_counter() - t0, str(exc))
             g = self._db.resource_groups.get(str(self.vars.get("tidb_resource_group", "default")))
             if g is not None and g.exec_elapsed_s and (_time.perf_counter() - t0) >= g.exec_elapsed_s:
                 self._db.resource_groups.record_runaway(g.name, g.action, sql[:256])
