@@ -1,0 +1,48 @@
+"""Owner election + fault-injection store (ref: pkg/owner/manager.go:49,
+pkg/kv/fault_injection.go)."""
+
+import pytest
+
+import tidb_tpu
+from tidb_tpu.kv.fault_injection import InjectedStore
+from tidb_tpu.kv.owner import OwnerManager
+
+
+def test_owner_campaign_and_lease():
+    om = OwnerManager(lease_s=0.1)
+    assert om.campaign("ddl", "node-a")
+    assert om.is_owner("ddl", "node-a")
+    assert not om.campaign("ddl", "node-b")  # lease held
+    assert om.owner("ddl") == "node-a"
+    om.resign("ddl", "node-a")
+    assert om.owner("ddl") is None
+    assert om.campaign("ddl", "node-b")
+    assert om.term("ddl") == 2
+    # expired lease falls over
+    import time
+
+    time.sleep(0.15)
+    assert om.campaign("ddl", "node-c")
+    assert om.owner("ddl") == "node-c"
+
+
+def test_injected_store_errors():
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE t (a BIGINT)")
+    inj = InjectedStore(db.store)
+    # commit failure
+    inj.cfg.set_commit_error(RuntimeError("injected commit failure"))
+    txn = inj.begin()
+    txn.put(b"zz-test-key", b"v")
+    with pytest.raises(RuntimeError):
+        txn.commit()
+    inj.cfg.set_commit_error(None)
+    txn2 = inj.begin()
+    txn2.put(b"zz-test-key", b"v")
+    txn2.commit()
+    # get failure on snapshots
+    inj.cfg.set_get_error(RuntimeError("injected get failure"))
+    with pytest.raises(RuntimeError):
+        inj.get_snapshot(inj.current_ts()).get(b"zz-test-key")
+    inj.cfg.set_get_error(None)
+    assert inj.get_snapshot(inj.current_ts()).get(b"zz-test-key") == b"v"

@@ -1,0 +1,73 @@
+"""Fault-injection store wrapper (ref: pkg/kv/fault_injection.go
+InjectedStore/InjectedTransaction): wraps a MemStore so tests force
+configurable errors on get/scan/commit without failpoint rewrites."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class InjectionConfig:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.get_error: Optional[Exception] = None
+        self.commit_error: Optional[Exception] = None
+
+    def set_get_error(self, err: Optional[Exception]) -> None:
+        with self._mu:
+            self.get_error = err
+
+    def set_commit_error(self, err: Optional[Exception]) -> None:
+        with self._mu:
+            self.commit_error = err
+
+
+class InjectedSnapshot:
+    def __init__(self, snap, cfg: InjectionConfig):
+        self._snap = snap
+        self._cfg = cfg
+
+    def get(self, key):
+        if self._cfg.get_error is not None:
+            raise self._cfg.get_error
+        return self._snap.get(key)
+
+    def __getattr__(self, name):
+        return getattr(self._snap, name)
+
+
+class InjectedTxn:
+    def __init__(self, txn, cfg: InjectionConfig):
+        self._txn = txn
+        self._cfg = cfg
+
+    def get(self, key):
+        if self._cfg.get_error is not None:
+            raise self._cfg.get_error
+        return self._txn.get(key)
+
+    def commit(self):
+        if self._cfg.commit_error is not None:
+            raise self._cfg.commit_error
+        return self._txn.commit()
+
+    def __getattr__(self, name):
+        return getattr(self._txn, name)
+
+
+class InjectedStore:
+    """kv.Storage wrapper; pass the real store everywhere else."""
+
+    def __init__(self, store, cfg: Optional[InjectionConfig] = None):
+        self._store = store
+        self.cfg = cfg or InjectionConfig()
+
+    def get_snapshot(self, ts):
+        return InjectedSnapshot(self._store.get_snapshot(ts), self.cfg)
+
+    def begin(self):
+        return InjectedTxn(self._store.begin(), self.cfg)
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
