@@ -308,21 +308,18 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                     for g, (gd, gv) in zip(group_exprs, gvals):
                         out_data.append(gd[first_pos_c])
                         out_valid.append(gv[first_pos_c] & (first_pos < n))
-                    # compact live buckets to the front; pad B → agg_cap
+                    # compact live buckets to the front; outputs stay B-sized
+                    # (dense B is static and can't overflow, so there is no
+                    # reason to pad to agg_cap — smaller device→host packets)
                     if gvals:
                         order = jnp.argsort(~live, stable=True)
                         ngroups = live.sum()
                     else:
                         order = jnp.arange(B)  # scalar agg: always one group
                         ngroups = jnp.asarray(1, dtype=jnp.int64)
-
-                    def _pad(x):
-                        if B >= agg_cap:
-                            return x[:agg_cap]
-                        return jnp.zeros(agg_cap, dtype=x.dtype).at[:B].set(x)
-
-                    out_data = [_pad(o[order]) for o in out_data]
-                    out_valid = [_pad(o[order]) for o in out_valid]
+                    out_cap = min(B, agg_cap)
+                    out_data = [o[order][:out_cap] for o in out_data]
+                    out_valid = [o[order][:out_cap] for o in out_valid]
                 elif mxu_doms is not None:
                     # MXU path: one-hot matmul grouped COUNT/SUM on the
                     # systolic array, exact via byte-limb accumulation
@@ -382,14 +379,9 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                         out_valid.append(kv)
                     order = jnp.argsort(~occupied, stable=True)
                     ngroups = occupied.sum()
-
-                    def _padm(x):
-                        if B >= agg_cap:
-                            return x[:agg_cap]
-                        return jnp.zeros(agg_cap, dtype=x.dtype).at[:B].set(x)
-
-                    out_data = [_padm(o[order]) for o in out_data]
-                    out_valid = [_padm(o[order]) for o in out_valid]
+                    out_cap = min(B, agg_cap)
+                    out_data = [o[order][:out_cap] for o in out_data]
+                    out_valid = [o[order][:out_cap] for o in out_valid]
                 else:
                     lanes = [~mask]
                     for d, v in gvals:
@@ -452,11 +444,12 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                     for g, (gd, gv) in zip(group_exprs, gvals):
                         out_data.append(gd[perm][first_pos_c])
                         out_valid.append(gv[perm][first_pos_c] & (first_pos < n))
-                gslot = jnp.arange(agg_cap)
+                out_len = int(out_data[0].shape[0]) if out_data else agg_cap
+                gslot = jnp.arange(out_len)
                 gvalid_slot = gslot < ngroups
                 out_valid = [ov & gvalid_slot for ov in out_valid]
                 # rebuild batch in case more executors follow
-                batch = EvalBatch([(d, v) for d, v in zip(out_data, out_valid)], [None] * len(out_data), agg_cap)
+                batch = EvalBatch([(d, v) for d, v in zip(out_data, out_valid)], [None] * len(out_data), out_len)
                 mask = gvalid_slot
                 kind = "agg"
             elif ex.tp == dagpb.TOPN:
@@ -550,6 +543,8 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
         for d, v in outs:
             d = jnp.asarray(d)
             d = jnp.broadcast_to(d, (L,)) if d.ndim == 0 else d
+            if d.shape[0] < L:  # meta row needs ≥2 slots; short lanes pad
+                d = jnp.pad(d, (0, L - d.shape[0]))
             if jnp.issubdtype(d.dtype, jnp.floating):
                 loc.append(("f", len(flanes)))
                 flanes.append(d.astype(jnp.float64))
@@ -558,6 +553,8 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                 ilanes.append(d.astype(jnp.int64))
             vv = jnp.ones(L, dtype=bool) if v is None else jnp.asarray(v)
             vv = jnp.broadcast_to(vv, (L,)) if vv.ndim == 0 else vv
+            if vv.shape[0] < L:
+                vv = jnp.pad(vv, (0, L - vv.shape[0]))
             vloc.append(len(ilanes))
             ilanes.append(vv.astype(jnp.int64))
         lanes_holder.update({"loc": tuple(loc), "vloc": tuple(vloc)})
