@@ -205,3 +205,26 @@ def test_gc_worker_thread(db):
     time.sleep(0.1)
     w.stop()
     assert w.runs >= 1
+
+
+def test_insert_on_duplicate_key_update(db):
+    db.execute("CREATE TABLE odku (id BIGINT PRIMARY KEY, v BIGINT, u BIGINT UNIQUE)")
+    db.execute("INSERT INTO odku VALUES (1, 10, 100)")
+    # PK conflict: assignment sees the existing row
+    r = db.execute("INSERT INTO odku VALUES (1, 99, 101) ON DUPLICATE KEY UPDATE v = v + 1")
+    assert r.affected == 2
+    assert db.query("SELECT v, u FROM odku WHERE id = 1") == [(11, 100)]
+    # VALUES(col) reads the candidate row
+    db.execute("INSERT INTO odku VALUES (1, 50, 102) ON DUPLICATE KEY UPDATE v = VALUES(v) * 2")
+    assert db.query("SELECT v FROM odku WHERE id = 1") == [(100,)]
+    # no-change update reports 0 affected
+    r = db.execute("INSERT INTO odku VALUES (1, 0, 0) ON DUPLICATE KEY UPDATE v = v")
+    assert r.affected == 0
+    # fresh insert still counts 1
+    r = db.execute("INSERT INTO odku VALUES (2, 20, 200) ON DUPLICATE KEY UPDATE v = v + 1")
+    assert r.affected == 1
+    # unique-key conflict routes to the conflicting row
+    r = db.execute("INSERT INTO odku VALUES (3, 30, 200) ON DUPLICATE KEY UPDATE v = v + 7")
+    assert r.affected == 2
+    assert db.query("SELECT id, v FROM odku WHERE u = 200") == [(2, 27)]
+    assert db.query("SELECT COUNT(*) FROM odku") == [(2,)]

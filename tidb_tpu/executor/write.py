@@ -114,8 +114,9 @@ def _txn_read(session, key: bytes):
     return txn.get(key)
 
 
-def _write_row(session, t: TableInfo, vals: list, handle: int, on_dup: Optional[str] = None) -> int:
-    """Stage one row + its index entries; returns rows affected."""
+def _write_row(session, t: TableInfo, vals: list, handle: int, on_dup=None) -> int:
+    """Stage one row + its index entries; returns rows affected. ``on_dup``
+    is "replace" | "ignore" | ("update", assignments, db, alias) | None."""
     txn = session.txn()
     schema = RowSchema(t.storage_schema)
     rk = tablecodec.record_key(t.id, handle)
@@ -126,6 +127,8 @@ def _write_row(session, t: TableInfo, vals: list, handle: int, on_dup: Optional[
             _delete_row(session, t, decode_row(schema, existing), handle)
         elif on_dup == "ignore":
             return 0
+        elif isinstance(on_dup, tuple) and on_dup[0] == "update":
+            return _apply_on_dup_update(session, t, decode_row(schema, existing), handle, vals, on_dup)
         else:
             raise DupKeyError(f"PRIMARY ({handle})")
     # unique index conflict checks (delete-only indexes don't take writes,
@@ -145,6 +148,13 @@ def _write_row(session, t: TableInfo, vals: list, handle: int, on_dup: Optional[
                     _delete_row(session, t, decode_row(schema, old_raw), old_handle)
             elif on_dup == "ignore":
                 return 0
+            elif isinstance(on_dup, tuple) and on_dup[0] == "update":
+                old_handle = codec.decode_int_raw(hit)
+                old_raw = _txn_read(session, tablecodec.record_key(t.id, old_handle))
+                if old_raw is not None:
+                    return _apply_on_dup_update(
+                        session, t, decode_row(schema, old_raw), old_handle, vals, on_dup
+                    )
             else:
                 raise DupKeyError(idx.name)
     txn.put(rk, encode_row(schema, vals))
@@ -201,7 +211,11 @@ def execute_insert(session, stmt: ast.Insert) -> int:
             rows_values.append(vals)
 
     affected = 0
-    on_dup = "replace" if stmt.replace else ("ignore" if stmt.ignore else None)
+    alias = stmt.table.alias or stmt.table.name
+    if stmt.on_dup_update:
+        on_dup = ("update", stmt.on_dup_update, db, alias)
+    else:
+        on_dup = "replace" if stmt.replace else ("ignore" if stmt.ignore else None)
     for vals in rows_values:
         full: list = [None] * len(cols)
         for off, v in zip(targets, vals):
@@ -239,6 +253,61 @@ def execute_insert(session, stmt: ast.Insert) -> int:
         wt = t.partition_view(t.partition_id_for(full)) if t.partition is not None else t
         affected += _write_row(session, wt, full, handle, on_dup)
     return affected
+
+
+def _apply_on_dup_update(session, t: TableInfo, old_vals: list, handle: int, cand_vals: list, on_dup: tuple) -> int:
+    """ON DUPLICATE KEY UPDATE against the conflicting row (ref:
+    executor/insert.go onDuplicateUpdate): assignments see the existing row;
+    VALUES(col) reads the would-be inserted value. Affected rows follow
+    MySQL: 2 when the row changes, 0 when it is set to its current values."""
+    _, assignments, db, alias = on_dup
+    from tidb_tpu.planner.pointget import _to_logical
+
+    def subst_values(node):
+        # VALUES(col) → literal of the candidate row's value
+        if isinstance(node, ast.FuncCall) and node.name == "values" and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.ColumnName):
+                c = t.column(arg.name)
+                if c is None:
+                    raise WriteError(f"Unknown column '{arg.name}' in VALUES()")
+                return ast.Literal(_to_logical(cand_vals[c.offset], c.ftype))
+        import dataclasses
+
+        if dataclasses.is_dataclass(node) and isinstance(node, ast.Node):
+            return type(node)(
+                **{
+                    f.name: (
+                        subst_values(v)
+                        if isinstance(v := getattr(node, f.name), ast.Node)
+                        else ([subst_values(x) if isinstance(x, ast.Node) else x for x in v] if isinstance(v, list) else v)
+                    )
+                    for f in dataclasses.fields(node)
+                }
+            )
+        return node
+
+    chunk = _rows_to_chunk(session, t, [old_vals])
+    builder = Builder(session.catalog, db, subquery_runner=session._subquery_runner)
+    schema = [OutCol(c.name, c.ftype, table=alias, slot=c.offset) for c in t.columns]
+    batch = EvalBatch.from_chunk(chunk)
+    new_vals = list(old_vals)
+    for colname, expr_ast in assignments:
+        cname = colname if isinstance(colname, str) else colname.name
+        c = t.column(cname)
+        if c is None:
+            raise WriteError(f"Unknown column '{cname}'")
+        e = builder.resolve(subst_values(expr_ast), BuildCtx(schema))
+        out = eval_to_column(e, batch, np)
+        new_vals[c.offset] = to_physical(out.logical_value(0), c.ftype)
+    if new_vals == old_vals:
+        return 0
+    new_handle = handle
+    if t.pk_is_handle and new_vals[t.pk_offset] != old_vals[t.pk_offset]:
+        new_handle = int(new_vals[t.pk_offset])
+    _delete_row(session, t, old_vals, handle)
+    _write_row(session, t, new_vals, new_handle)
+    return 2
 
 
 def _scan_visible_rows(session, t: TableInfo):
