@@ -586,6 +586,13 @@ class Parser:
             self.next()
             lit = self.next()
             return ast.Literal(lit.value, hint=kw.lower())
+        if kw == "VALUES" and self.peek(1).value == "(":
+            # VALUES(col) inside ON DUPLICATE KEY UPDATE
+            self.next()
+            self.next()
+            col = ast.ColumnName(self.ident())
+            self.expect_op(")")
+            return ast.FuncCall("values", [col])
         if kw == "CASE":
             return self._case()
         if kw == "CAST":
