@@ -258,3 +258,17 @@ def test_order_by_aggregate(tdb):
     assert tdb.query("SELECT g, SUM(v) FROM oba GROUP BY g ORDER BY SUM(v)+g ASC") == [
         (2, 5), (3, 24), (1, 30),
     ]
+
+
+def test_show_index_and_create_table(tdb):
+    tdb.execute("CREATE TABLE si (id BIGINT PRIMARY KEY, a BIGINT, b VARCHAR(8))")
+    tdb.execute("CREATE INDEX iab ON si (a, b)")
+    rows = tdb.query("SHOW INDEX FROM si")
+    assert ("si", 0, "PRIMARY", 1, "id", "BTREE") in rows
+    assert ("si", 1, "iab", 1, "a", "BTREE") in rows and ("si", 1, "iab", 2, "b", "BTREE") in rows
+    ((name, ddl),) = tdb.query("SHOW CREATE TABLE si")
+    assert name == "si" and "PRIMARY KEY" in ddl and "KEY `iab` (`a`, `b`)" in ddl
+    # the emitted DDL round-trips through the parser
+    from tidb_tpu.parser import parse
+
+    parse(ddl)

@@ -1463,6 +1463,9 @@ class Parser:
         if self.eat_kw("COLUMNS") or self.eat_kw("FIELDS"):
             self.expect_kw("FROM")
             return ast.Show("columns", target=self.ident())
+        if self.eat_kw("INDEX") or self.eat_kw("INDEXES") or self.eat_kw("KEYS"):
+            self.expect_kw("FROM")
+            return ast.Show("index", target=self.ident())
         if self.eat_kw("STATS_HISTOGRAMS"):
             return ast.Show("stats_histograms")
         if self.eat_kw("STATS_TOPN"):

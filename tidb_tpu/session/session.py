@@ -975,9 +975,27 @@ class Session:
             ]
             return Result(columns=["Field", "Type", "Null", "Default"], rows=rows)
         if stmt.kind == "create_table":
+            from tidb_tpu.tools.dumpling import _create_table_sql
+
             t = self.catalog.table(self.current_db, stmt.target)
-            cols = ",\n  ".join(f"`{c.name}` {c.ftype}" for c in t.columns)
-            return Result(columns=["Table", "Create Table"], rows=[(t.name, f"CREATE TABLE `{t.name}` (\n  {cols}\n)")])
+            return Result(
+                columns=["Table", "Create Table"],
+                rows=[(t.name, _create_table_sql(t).rstrip().rstrip(";"))],
+            )
+        if stmt.kind == "index":
+            t = self.catalog.table(self.current_db, stmt.target)
+            rows = []
+            if t.pk_is_handle:
+                rows.append((t.name, 0, "PRIMARY", 1, t.columns[t.pk_offset].name, "BTREE"))
+            for idx in t.indexes:
+                if idx.state != "public":
+                    continue
+                for seq, off in enumerate(idx.column_offsets):
+                    rows.append((t.name, 0 if idx.unique else 1, idx.name, seq + 1, t.columns[off].name, "BTREE"))
+            return Result(
+                columns=["Table", "Non_unique", "Key_name", "Seq_in_index", "Column_name", "Index_type"],
+                rows=rows,
+            )
         raise SessionError(f"unsupported SHOW {stmt.kind}")
 
     def _show_stats(self, kind: str) -> Result:
