@@ -143,7 +143,7 @@ def test_sql_hash_exchange_path(sqldb, monkeypatch):
     """Force the shuffle (hash) exchange and the grow-on-overflow retry."""
     from tidb_tpu.parallel import gather
 
-    monkeypatch.setattr(gather, "BROADCAST_THRESHOLD", -1)
+    monkeypatch.setattr(gather, "FORCE_EXCHANGE", "hash")
     sqldb.execute("ANALYZE TABLE dim")  # stats present → threshold applies
     s = sqldb.session()
     lines = "\n".join(r[0] for r in s.execute("EXPLAIN " + MPPQ).rows)
@@ -160,7 +160,7 @@ def test_sql_mpp_overflow_retry(sqldb, monkeypatch):
     from tidb_tpu.parallel import gather
     from tidb_tpu.parallel.mpp import DistJoinSpec
 
-    monkeypatch.setattr(gather, "BROADCAST_THRESHOLD", -1)
+    monkeypatch.setattr(gather, "FORCE_EXCHANGE", "hash")
     sqldb.execute("ANALYZE TABLE dim")
     # all fact rows point at one dim id → every row shuffles to one owner
     sqldb.execute("CREATE TABLE skew (cid BIGINT, qty BIGINT)")

@@ -419,6 +419,9 @@ class Catalog:
         from tidb_tpu.copr.colcache import cache_for
 
         for view in t.partition_views():
+            # stable blocks drop wholesale first — purging them row-by-row
+            # would materialize every columnar row as a dict tombstone
+            self.store.drop_stable(view.id)
             kr = KeyRange(tablecodec.table_prefix(view.id), tablecodec.table_prefix(view.id + 1))
             txn = self.store.begin()
             for k, _ in txn.scan(kr):
@@ -552,6 +555,10 @@ class Catalog:
             for k, v in txn.scan(tablecodec.record_range(view.id)):
                 txn.put(k, encode_row(new_schema, fn(decode_row(old_schema, v))))
             txn.commit()
+            # every row (incl. stable ones, surfaced by the merged scan) was
+            # just rewritten into the delta layer under the NEW layout; the
+            # old-layout blocks would desync slot numbering — drop them
+            self.store.drop_stable(view.id)
             cache_for(self.store).invalidate_table(view.id)
 
 

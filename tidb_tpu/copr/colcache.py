@@ -28,7 +28,15 @@ from tidb_tpu.utils.chunk import Dictionary
 
 @dataclass
 class RegionColumns:
-    """One region's decoded rows for one table: sorted-by-handle columns."""
+    """One region's decoded rows for one table: sorted-by-handle columns.
+
+    Rows come from two layers merged at build time (TiFlash delta+stable):
+    stable columnar block slices (``_stable_parts``, already decoded — the
+    common bulk-load case hands zero-copy views to the device) overlaid by
+    the MVCC row-delta dict (``_buf``/``_starts``, decoded lazily per slot).
+    ``_stable_take`` selects surviving stable rows (None = all, in order);
+    ``_perm`` restores ascending-handle order over [stable_kept + delta]
+    (None = already ascending)."""
 
     handles: np.ndarray  # int64, ascending
     n: int
@@ -39,9 +47,14 @@ class RegionColumns:
     # True iff built_ts covered every commit in the region at build time —
     # only then does the entry equal the region head for this data_version
     complete: bool = True
-    # raw row buffer retained to decode further columns lazily
+    # raw row-delta buffer retained to decode further columns lazily
     _buf: bytes = b""
     _starts: np.ndarray | None = None
+    _delta_n: int = 0
+    _stable_parts: list = field(default_factory=list)  # [(block, lo, hi)]
+    _stable_take: np.ndarray | None = None
+    _delta_take: np.ndarray | None = None  # delta rows shadowed by newer blocks
+    _perm: np.ndarray | None = None
 
 
 class ColumnCache:
@@ -86,8 +99,28 @@ class ColumnCache:
                 if self._resolve(tid) == logical and slot in entry.cols:
                     data, valid = entry.cols[slot]
                     entry.cols[slot] = (remap[data], valid)
+            # stable blocks hold codes against the same dictionary: remap them
+            # so future cache builds see compacted codes
+            store = self.store
+            with store._mu:
+                for tid, blocks in store._stable.items():
+                    if self._resolve(tid) != logical:
+                        continue
+                    for b in blocks:
+                        pair = b.cols.get(slot)
+                        if pair is not None and pair[0].dtype == np.int32:
+                            b.cols[slot] = (remap[pair[0]], pair[1])
             self.epoch += 1
             return dic
+
+    def ingest_lock(self):
+        """Context manager serializing bulk dictionary encoding + block
+        ingest against :meth:`ensure_sorted_dict` compaction — codes encoded
+        for a block must be appended to ``store._stable`` before any remap
+        runs, or the block would carry pre-compaction codes. Callers must
+        fetch dictionaries via :meth:`dictionary` BEFORE entering (the lock
+        is not reentrant)."""
+        return self._mu
 
     # -- entry build/reuse -------------------------------------------------
     def get(
@@ -138,14 +171,80 @@ class ColumnCache:
                 np.empty(0, np.int64), 0, data_version=data_version, built_ts=read_ts, complete=complete
             )
         bulk = snap.scan_record_rows(kr)
+        parts = self.store.stable_parts(table_id, kr, read_ts)
+        if not parts:
+            return RegionColumns(
+                bulk.handles,
+                len(bulk),
+                data_version=data_version,
+                built_ts=read_ts,
+                complete=complete,
+                _buf=bulk.buf,
+                _starts=bulk.starts,
+                _delta_n=len(bulk),
+            )
+        return self._merge_stable(bulk, parts, data_version, read_ts, complete)
+
+    def _merge_stable(self, bulk, parts, data_version: int, read_ts: int, complete: bool) -> RegionColumns:
+        """Overlay the row-delta scan on the stable block slices with
+        newest-version-wins PER HANDLE across layers: a delta PUT/tombstone
+        masks stable rows from blocks committed before it, and a later block
+        masks both earlier blocks and older delta rows. The merged view is
+        ascending by handle."""
+        sh = np.concatenate([b.handles[lo:hi] for b, lo, hi in parts])
+        sh_ts = np.concatenate([np.full(hi - lo, b.commit_ts, np.int64) for b, lo, hi in parts])
+        take: np.ndarray | None = None
+        if len(parts) > 1 and not np.all(sh[:-1] < sh[1:]):
+            # overlapping ingests: keep the LAST occurrence of each handle
+            # (parts are in ingest order), then ascending-handle order
+            order = np.lexsort((np.arange(len(sh)), sh))  # sort by handle, ingest order ties
+            shs = sh[order]
+            last = np.ones(len(shs), dtype=bool)
+            last[:-1] = shs[:-1] != shs[1:]
+            take = order[last]
+            sh = shs[last]
+            sh_ts = sh_ts[take]
+        # delta rows shadowed by a NEWER stable block (e.g. re-import over
+        # previously updated keys) drop out of the delta side
+        delta_take: np.ndarray | None = None
+        if len(bulk) and len(sh):
+            pos = np.minimum(np.searchsorted(sh, bulk.handles), len(sh) - 1)
+            shadowed = (sh[pos] == bulk.handles) & (sh_ts[pos] > bulk.put_ts)
+            if shadowed.any():
+                delta_take = np.nonzero(~shadowed)[0]
+        # stable rows masked by a NEWER delta verdict
+        ov_h = np.concatenate([bulk.handles, bulk.tombstones])
+        if len(ov_h) and len(sh):
+            ov_ts = np.concatenate([bulk.put_ts, bulk.tomb_ts])
+            o = np.argsort(ov_h)
+            ov_h, ov_ts = ov_h[o], ov_ts[o]
+            pos = np.minimum(np.searchsorted(ov_h, sh), len(ov_h) - 1)
+            hit = (ov_h[pos] == sh) & (ov_ts[pos] > sh_ts)
+            if hit.any():
+                keep = ~hit
+                take = np.nonzero(keep)[0] if take is None else take[keep]
+                sh = sh[keep]
+        delta_handles = bulk.handles if delta_take is None else bulk.handles[delta_take]
+        perm: np.ndarray | None = None
+        if len(delta_handles):
+            handles = np.concatenate([sh, delta_handles])
+            perm = np.argsort(handles, kind="stable")
+            handles = handles[perm]
+        else:
+            handles = sh
         return RegionColumns(
-            bulk.handles,
-            len(bulk),
+            handles,
+            len(handles),
             data_version=data_version,
             built_ts=read_ts,
             complete=complete,
             _buf=bulk.buf,
             _starts=bulk.starts,
+            _delta_n=len(bulk),
+            _stable_parts=parts,
+            _stable_take=take,
+            _delta_take=delta_take,
+            _perm=perm,
         )
 
     def _decode_slots(self, entry: RegionColumns, table_id: int, schema: RowSchema, slots: Sequence[int]) -> None:
@@ -155,21 +254,61 @@ class ColumnCache:
                 dt = np.int32 if ft.kind == TypeKind.STRING else (np.float64 if ft.kind == TypeKind.FLOAT else np.int64)
                 entry.cols[s] = (np.empty(0, dt), np.empty(0, bool))
             return
-        fixed = [s for s in slots if schema.ftypes[s].kind not in (TypeKind.STRING, TypeKind.JSON)]
-        if fixed:
-            datas, valids = decode_fixed_bulk(schema, entry._buf, entry._starts, fixed)
-            for s, d, v in zip(fixed, datas, valids):
-                entry.cols[s] = (d, v)
+        # 1) decode the row-delta lanes (small in steady state)
+        delta: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        if entry._delta_n:
+            fixed = [s for s in slots if schema.ftypes[s].kind not in (TypeKind.STRING, TypeKind.JSON)]
+            if fixed:
+                datas, valids = decode_fixed_bulk(schema, entry._buf, entry._starts, fixed)
+                for s, d, v in zip(fixed, datas, valids):
+                    delta[s] = (d, v)
+            for s in slots:
+                if s in delta:
+                    continue
+                raw, valid = decode_strings_bulk(schema, entry._buf, entry._starts, s)
+                dic = self.dictionary(table_id, s)
+                with self._mu:
+                    data = np.fromiter(
+                        (0 if r is None else dic.encode(r) for r in raw), dtype=np.int32, count=len(raw)
+                    )
+                delta[s] = (data, valid)
+        # 2) overlay on stable block slices (zero-copy in the pure-stable,
+        #    single-block case — the bulk-load steady state)
         for s in slots:
             if s in entry.cols:
                 continue
-            raw, valid = decode_strings_bulk(schema, entry._buf, entry._starts, s)
-            dic = self.dictionary(table_id, s)
-            with self._mu:
-                data = np.fromiter(
-                    (0 if r is None else dic.encode(r) for r in raw), dtype=np.int32, count=len(raw)
-                )
-            entry.cols[s] = (data, valid)
+            if not entry._stable_parts:
+                entry.cols[s] = delta[s]
+                continue
+            def part_cols(b, lo, hi):
+                pair = b.cols.get(s)
+                if pair is None:
+                    # column added after this block was ingested (ADD COLUMN
+                    # without rewrite): all-NULL for the block's rows
+                    ft = schema.ftypes[s]
+                    dt = np.int32 if ft.kind in (TypeKind.STRING, TypeKind.JSON) else (
+                        np.float64 if ft.kind == TypeKind.FLOAT else np.int64
+                    )
+                    return np.zeros(hi - lo, dt), np.zeros(hi - lo, bool)
+                return pair[0][lo:hi], pair[1][lo:hi]
+
+            if len(entry._stable_parts) == 1:
+                sdata, svalid = part_cols(*entry._stable_parts[0])
+            else:
+                pieces = [part_cols(b, lo, hi) for b, lo, hi in entry._stable_parts]
+                sdata = np.concatenate([p[0] for p in pieces])
+                svalid = np.concatenate([p[1] for p in pieces])
+            if entry._stable_take is not None:
+                sdata, svalid = sdata[entry._stable_take], svalid[entry._stable_take]
+            if entry._delta_n:
+                dd, dv = delta[s]
+                if entry._delta_take is not None:
+                    dd, dv = dd[entry._delta_take], dv[entry._delta_take]
+                sdata = np.concatenate([sdata, dd.astype(sdata.dtype, copy=False)])
+                svalid = np.concatenate([svalid, dv])
+            if entry._perm is not None:
+                sdata, svalid = sdata[entry._perm], svalid[entry._perm]
+            entry.cols[s] = (sdata, svalid)
 
     def invalidate_table(self, table_id: int) -> None:
         """DDL (drop/truncate) drops cached columns."""

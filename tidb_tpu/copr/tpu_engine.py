@@ -117,15 +117,16 @@ def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: 
     while True:
         kernel = get_kernel(bound, n_pad, agg_cap)
         packed = kernel.fn(handles_dev, tuple(cols_dev), jnp.asarray(rarr), jnp.asarray(entry.n))
-        # ONE device→host transfer per task (two when float lanes exist):
-        # the packed buffer carries count, ngroups, and every (data, valid)
-        # lane (see dag_kernel._pack)
+        # ONE device→host round trip per task: device_get batches every
+        # buffer of the packed result into a single transfer — two
+        # sequential np.asarray calls would pay the tunnel RTT twice
+        import jax
+
         fbuf = None
         if isinstance(packed, tuple):
-            buf = np.asarray(packed[0])
-            fbuf = np.asarray(packed[1])
+            buf, fbuf = jax.device_get(packed)
         else:
-            buf = np.asarray(packed)
+            buf = jax.device_get(packed)
         count = int(buf[0, 0])
         ngroups = int(buf[0, 1])
         if ngroups > kernel.agg_cap:

@@ -38,31 +38,23 @@ def bulk_load(db: DB, table_name: str, columns: Sequence[Sequence], db_name: str
         else:
             phys_cols.append([to_physical(v, c.ftype) for v in vals])
 
-    # native fast path (C++ encode + SST-style ingest; ref: lightning local
-    # backend): row+key encoding and the per-key 2PC loop collapse into one
-    # C call + one bulk store insert. Indexed tables keep the txn path so
-    # index entries stay transactional with their rows.
-    from tidb_tpu.native import lib as native_lib
-
     if t.partition is not None:
         return _bulk_load_partitioned(db, t, phys_cols, n, schema)
 
-    if native_lib() is not None and not any(idx.state != "delete_only" for idx in t.indexes):
-        from tidb_tpu.native.bulk import encode_rows, split_encoded
-
+    if not any(idx.state != "delete_only" for idx in t.indexes):
+        # columnar stable-layer ingest (TiFlash stable analog): columns go
+        # into the store decoded and device-ready — no row encode at all.
+        # Indexed tables keep the txn path below so index entries stay
+        # transactional with their rows.
         if t.pk_is_handle:
             all_handles = np.ascontiguousarray(np.asarray(phys_cols[t.pk_offset], dtype=np.int64))
         else:
             base = db.catalog.alloc_autoid(t.id, n)
             all_handles = np.arange(base, base + n, dtype=np.int64)
-        enc = encode_rows(t, phys_cols, all_handles)
-        if enc is not None:
-            keys_buf, rows_buf, row_starts = enc
-            pairs = list(split_encoded(keys_buf, rows_buf, row_starts))
-            db.store.ingest([k for k, _ in pairs], [v for _, v in pairs])
-            if t.pk_is_handle and n:
-                db.catalog.rebase_autoid(t.id, int(all_handles.max()) + 1)
-            return n
+        _ingest_columnar(db, t.id, t, phys_cols, all_handles, n, schema)
+        if t.pk_is_handle and n:
+            db.catalog.rebase_autoid(t.id, int(all_handles.max()) + 1)
+        return n
 
     loaded = 0
     i = 0
@@ -89,6 +81,53 @@ def bulk_load(db: DB, table_name: str, columns: Sequence[Sequence], db_name: str
         mx = int(np.max(np.asarray(phys_cols[t.pk_offset]))) if n else 0
         db.catalog.rebase_autoid(t.id, mx + 1)
     return loaded
+
+
+def _ingest_columnar(db: DB, physical_id: int, t, phys_cols, handles: np.ndarray, n: int, schema: RowSchema) -> None:
+    """Columns → StableBlock via MemStore.ingest_columnar. Strings dictionary-
+    encode through np.unique (C-speed inverse) against the shared table
+    dictionary, so blocks hand int32 code lanes straight to the device."""
+    from tidb_tpu.copr.colcache import cache_for
+
+    cache = cache_for(db.store)
+    if physical_id != t.id:
+        cache.set_table_alias(physical_id, t.id)
+    cols: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+    dicts: dict = {}
+    string_slots: list[int] = []
+    for pos, (c, vals) in enumerate(zip(t.columns, phys_cols)):
+        k = c.ftype.kind
+        if k in (TypeKind.STRING, TypeKind.JSON):
+            string_slots.append(pos)
+            dicts[pos] = cache.dictionary(t.id, pos)  # before ingest_lock
+        elif isinstance(vals, np.ndarray):
+            dt = np.float64 if k == TypeKind.FLOAT else np.int64
+            cols[pos] = (vals.astype(dt, copy=False), np.ones(n, dtype=bool))
+        else:
+            valid = np.fromiter((v is not None for v in vals), dtype=bool, count=n)
+            dt = np.float64 if k == TypeKind.FLOAT else np.int64
+            data = np.fromiter(
+                ((0 if v is None else v) for v in vals), dtype=dt, count=n
+            )
+            cols[pos] = (data, valid)
+    # encode string codes and append the block under one cache lock: a
+    # concurrent ensure_sorted_dict compaction between encode and ingest
+    # would remap every block EXCEPT this not-yet-visible one
+    with cache.ingest_lock():
+        for pos in string_slots:
+            arr = np.asarray(phys_cols[pos], dtype=object)
+            valid = np.fromiter((v is not None for v in arr), dtype=bool, count=n)
+            dic = dicts[pos]
+            if n:
+                safe = np.where(valid, arr, b"")
+                uniq, inv = np.unique(safe, return_inverse=True)
+                code_of = np.fromiter((dic.encode(u) for u in uniq), dtype=np.int32, count=len(uniq))
+                data = code_of[inv.reshape(-1)].astype(np.int32, copy=False)
+                data = np.where(valid, data, 0).astype(np.int32, copy=False)
+            else:
+                data = np.empty(0, np.int32)
+            cols[pos] = (data, valid)
+        db.store.ingest_columnar(physical_id, handles, cols, schema, dicts)
 
 
 def _bulk_load_partitioned(db: DB, t, phys_cols, n: int, schema: RowSchema) -> int:
@@ -124,8 +163,6 @@ def _bulk_load_partitioned(db: DB, t, phys_cols, n: int, schema: RowSchema) -> i
         handles = np.arange(base, base + n, dtype=np.int64)
 
     from tidb_tpu.executor.write import index_entry
-    from tidb_tpu.native import lib as native_lib
-    from tidb_tpu.native.bulk import encode_rows, split_encoded
 
     has_index = any(idx.state != "delete_only" for idx in t.indexes)
     for k, d in enumerate(p.defs):
@@ -137,12 +174,9 @@ def _bulk_load_partitioned(db: DB, t, phys_cols, n: int, schema: RowSchema) -> i
             c[sel] if isinstance(c, np.ndarray) else [c[int(i)] for i in sel] for c in phys_cols
         ]
         sub_handles = handles[sel]
-        if native_lib() is not None and not has_index:
-            enc = encode_rows(view, sub_cols, sub_handles)
-            if enc is not None:
-                pairs = list(split_encoded(*enc))
-                db.store.ingest([kk for kk, _ in pairs], [v for _, v in pairs])
-                continue
+        if not has_index:
+            _ingest_columnar(db, view.id, t, sub_cols, sub_handles, len(sel), schema)
+            continue
         txn = db.store.begin()
         for j, h in enumerate(sub_handles):
             vals = [sub_cols[c][j] for c in range(len(t.columns))]

@@ -117,15 +117,72 @@ class PlacementDriver:
 
 class BulkRows:
     """Zero-loop handoff of a record scan: concatenated row values + offsets,
-    ready for rowcodec.decode_fixed_bulk."""
+    ready for rowcodec.decode_fixed_bulk. ``tombstones`` are handles whose
+    visible version is a delete — the columnar merge masks stable rows with
+    them (PUT handles mask implicitly via ``handles``)."""
 
-    __slots__ = ("handles", "starts", "ends", "buf")
+    __slots__ = ("handles", "starts", "ends", "buf", "tombstones", "put_ts", "tomb_ts")
 
-    def __init__(self, handles: np.ndarray, starts: np.ndarray, ends: np.ndarray, buf: bytes):
+    def __init__(
+        self,
+        handles: np.ndarray,
+        starts: np.ndarray,
+        ends: np.ndarray,
+        buf: bytes,
+        tombstones: np.ndarray | None = None,
+        put_ts: np.ndarray | None = None,
+        tomb_ts: np.ndarray | None = None,
+    ):
         self.handles, self.starts, self.ends, self.buf = handles, starts, ends, buf
+        self.tombstones = tombstones if tombstones is not None else np.empty(0, np.int64)
+        # commit_ts of each PUT / tombstone verdict: the stable merge is
+        # newest-version-wins PER HANDLE, so a delta verdict only overrides
+        # stable rows from blocks committed before it (and vice versa)
+        self.put_ts = put_ts if put_ts is not None else np.empty(0, np.int64)
+        self.tomb_ts = tomb_ts if tomb_ts is not None else np.empty(0, np.int64)
 
     def __len__(self) -> int:
         return len(self.handles)
+
+
+class StableBlock:
+    """One columnar ingest: decoded, device-ready columns for a handle span
+    of one table — the TiFlash *stable layer* analog. Row-delta writes after
+    ingest live in the MVCC dict and override by handle at read time.
+
+    ``cols``: column position → (data, valid); STRING columns hold int32
+    dictionary codes against the shared per-(table, column) dictionary (the
+    ``dicts`` mapping), so the columnar cache can hand slices straight to the
+    device. ``schema`` lets point reads re-encode a row on demand.
+    """
+
+    __slots__ = ("table_id", "handles", "cols", "schema", "dicts", "commit_ts")
+
+    def __init__(self, table_id: int, handles: np.ndarray, cols: dict, schema, dicts: dict, commit_ts: int):
+        self.table_id = table_id
+        self.handles = handles  # ascending int64
+        self.cols = cols
+        self.schema = schema
+        self.dicts = dicts
+        self.commit_ts = commit_ts
+
+    def __len__(self) -> int:
+        return len(self.handles)
+
+    def row_values(self, idx: int) -> list:
+        """Logical-physical values of one row (for encode-on-demand reads)."""
+        out = []
+        for pos in range(self.schema.n):
+            data, valid = self.cols[pos]
+            if not valid[idx]:
+                out.append(None)
+            elif data.dtype == np.int32:  # dictionary code
+                out.append(self.dicts[pos].decode(int(data[idx])))
+            elif data.dtype == np.float64:
+                out.append(float(data[idx]))
+            else:
+                out.append(int(data[idx]))
+        return out
 
 
 class Snapshot:
@@ -147,37 +204,93 @@ class Snapshot:
         with self._store._mu:
             self._store._check_lock(key, self.read_ts)
             writes = self._store._writes.get(key)
-            if not writes:
-                return None
-            w = self._visible(writes)
-            if w is None or w.op == OP_DEL:
-                return None
-            return w.value
+            w = self._visible(writes) if writes else None
+            # newest-version-wins across layers: a dict verdict only hides a
+            # stable row committed before it
+            floor_ts = w.commit_ts if w is not None else 0
+            stable = self._store._stable_get(key, self.read_ts, after_ts=floor_ts)
+            if stable is not None:
+                return stable
+            if w is not None:
+                return None if w.op == OP_DEL else w.value
+            return None
 
     def scan(self, kr: KeyRange, limit: int = 2**63, reverse: bool = False) -> list[tuple[bytes, bytes]]:
         """Eager scan — materializes under the store lock, never holds it
-        across caller iterations."""
+        across caller iterations. Merges the row-delta dict with stable
+        columnar blocks via a limit-aware k-way merge: newest version per key
+        wins, stable rows encode lazily only when yielded (a LIMIT-k scan of
+        a bulk-loaded table touches k rows, not the whole suffix)."""
+        import heapq
+
+        from tidb_tpu.kv.rowcodec import encode_row
+
+        store = self._store
         out: list[tuple[bytes, bytes]] = []
-        with self._store._mu:
-            keys = self._store._sorted_slice(kr)
+        with store._mu:
+            keys = store._sorted_slice(kr)
             if reverse:
                 keys = keys[::-1]
-            for k in keys:
-                self._store._check_lock(k, self.read_ts)
-                w = self._visible(self._store._writes[k])
-                if w is not None and w.op == OP_PUT:
-                    out.append((k, w.value))
-                    if len(out) >= limit:
-                        break
+
+            def dict_iter():
+                for k in keys:
+                    store._check_lock(k, self.read_ts)
+                    w = self._visible(store._writes[k])
+                    if w is not None:
+                        yield (k, w.commit_ts, None if w.op == OP_DEL else w.value)
+
+            streams = [dict_iter()]
+            for table_id, blocks in store._stable.items():
+                hlo, hhi = tablecodec.range_to_handles(kr, table_id)
+                if hlo >= hhi:
+                    continue
+                for block in blocks:
+                    if block.commit_ts > self.read_ts:
+                        continue
+                    lo = int(np.searchsorted(block.handles, hlo, side="left"))
+                    hi = int(np.searchsorted(block.handles, hhi, side="left"))
+                    if lo >= hi:
+                        continue
+
+                    def block_iter(b=block, lo=lo, hi=hi):
+                        rng = range(hi - 1, lo - 1, -1) if reverse else range(lo, hi)
+                        for i in rng:
+                            yield (tablecodec.record_key(b.table_id, int(b.handles[i])), b.commit_ts, (b, i))
+                    streams.append(block_iter())
+
+            merged = heapq.merge(*streams, key=lambda e: e[0], reverse=reverse)
+            cur_key: bytes | None = None
+            cur_ts = -1
+            cur_val = None
+            for k, ts, v in merged:
+                if k != cur_key:
+                    if cur_key is not None and cur_val is not None:
+                        b, i = cur_val if isinstance(cur_val, tuple) else (None, None)
+                        out.append((cur_key, encode_row(b.schema, b.row_values(i)) if b is not None else cur_val))
+                        if len(out) >= limit:
+                            cur_key = None
+                            break
+                    cur_key, cur_ts, cur_val = k, ts, v
+                elif ts > cur_ts:
+                    cur_ts, cur_val = ts, v
+            if cur_key is not None and cur_val is not None and len(out) < limit:
+                b, i = cur_val if isinstance(cur_val, tuple) else (None, None)
+                out.append((cur_key, encode_row(b.schema, b.row_values(i)) if b is not None else cur_val))
         return out
 
     def scan_record_rows(self, kr: KeyRange) -> BulkRows:
-        """Scan record keys in [kr) and pack visible row values contiguously
-        — the hot path feeding the columnar cache."""
+        """Scan record keys in [kr) from the row-delta dict and pack visible
+        row values contiguously — the hot path feeding the columnar cache.
+        Stable columnar blocks are NOT included (the cache merges them via
+        :meth:`MemStore.stable_parts`); visible deletes come back as
+        ``tombstones`` so the merge can mask stable rows."""
         handles: list[int] = []
         chunks: list[bytes] = []
         starts: list[int] = []
         ends: list[int] = []
+        put_ts: list[int] = []
+        tombs: list[int] = []
+        tomb_ts: list[int] = []
         off = 0
         with self._store._mu:
             keys = self._store._sorted_slice(kr)
@@ -188,11 +301,16 @@ class Snapshot:
                 if locks and k in locks:
                     self._store._check_lock(k, read_ts)
                 w = self._visible(writes_map[k])
-                if w is None or w.op != OP_PUT:
+                if w is None:
                     continue
                 if not tablecodec.is_record_key(k):
                     continue
+                if w.op != OP_PUT:
+                    tombs.append(tablecodec.decode_record_key(k)[1])
+                    tomb_ts.append(w.commit_ts)
+                    continue
                 handles.append(tablecodec.decode_record_key(k)[1])
+                put_ts.append(w.commit_ts)
                 chunks.append(w.value)
                 starts.append(off)
                 off += len(w.value)
@@ -202,6 +320,9 @@ class Snapshot:
             np.asarray(starts, dtype=np.int64),
             np.asarray(ends, dtype=np.int64),
             b"".join(chunks),
+            np.asarray(tombs, dtype=np.int64),
+            np.asarray(put_ts, dtype=np.int64),
+            np.asarray(tomb_ts, dtype=np.int64),
         )
 
 
@@ -217,6 +338,9 @@ class MemStore:
         self.nonce = uuid.uuid4().hex
         self._mu = threading.RLock()
         self._writes: dict[bytes, list[Write]] = {}
+        # stable columnar layer: table_id → ingest-ordered StableBlocks
+        # (later blocks override earlier ones on handle collision)
+        self._stable: dict[int, list[StableBlock]] = {}
         # key → start_ts set of rolled-back txns (out-of-band so write chains
         # stay strictly ascending by commit_ts)
         self._rollbacks: dict[bytes, set[int]] = {}
@@ -293,12 +417,51 @@ class MemStore:
                     return
 
     def _recount_region(self, r: Region) -> None:
-        r.key_count = len(self._sorted_slice(r.range()))
+        # approximate: a handle present in both the delta dict and a stable
+        # block counts twice. key_count only drives the auto-split heuristic,
+        # where a ≤2× overestimate just splits a little early.
+        n = len(self._sorted_slice(r.range()))
+        rr = r.range()
+        for tid, blocks in self._stable.items():
+            hlo, hhi = tablecodec.range_to_handles(rr, tid)
+            if hlo >= hhi:
+                continue
+            for b in blocks:
+                n += int(np.searchsorted(b.handles, hhi)) - int(np.searchsorted(b.handles, hlo))
+        r.key_count = n
+
+    def _stable_handles_in(self, r: Region) -> tuple[int | None, np.ndarray | None]:
+        """(table_id, handles) of the most-populous stable table inside r."""
+        best_tid, best_cnt, best = None, 0, None
+        rr = r.range()
+        for tid, blocks in self._stable.items():
+            hlo, hhi = tablecodec.range_to_handles(rr, tid)
+            if hlo >= hhi:
+                continue
+            parts = []
+            for b in blocks:
+                lo = int(np.searchsorted(b.handles, hlo))
+                hi = int(np.searchsorted(b.handles, hhi))
+                if lo < hi:
+                    parts.append(b.handles[lo:hi])
+            cnt = sum(len(p) for p in parts)
+            if cnt > best_cnt:
+                best_tid, best_cnt, best = tid, cnt, parts
+        if best is None:
+            return None, None
+        return best_tid, np.sort(np.concatenate(best))
 
     def _maybe_auto_split(self, r: Region) -> None:
         if r.key_count <= self._region_split_keys:
             return
         keys = self._sorted_slice(r.range())
+        tid, stable_handles = self._stable_handles_in(r)
+        if stable_handles is not None and len(stable_handles) > len(keys):
+            # columnar-dominant region: split at the median stable handle
+            split = tablecodec.record_key(tid, int(stable_handles[len(stable_handles) // 2]))
+            if r.contains(split) and split > r.start:
+                self.split_region(split)
+            return
         if len(keys) < 2:
             return
         self.split_region(keys[len(keys) // 2])
@@ -481,6 +644,100 @@ class MemStore:
                 self._maybe_auto_split(r)
             return commit_ts
 
+    def ingest_columnar(self, table_id: int, handles: np.ndarray, cols: dict, schema, dicts: dict | None = None) -> int:
+        """Bulk ingest of decoded columns as a stable block at one fresh
+        commit ts — the columnar twin of :meth:`ingest` (TiFlash stable layer;
+        ref: lightning local backend writing SSTs below the LSM). Rows never
+        take the per-key dict path: reads overlay the MVCC row-delta dict on
+        top of the block. Handles must be unique; they are sorted here."""
+        handles = np.asarray(handles, dtype=np.int64)
+        if len(handles) == 0:
+            return self.tso.ts()
+        if not np.all(handles[:-1] < handles[1:]):
+            order = np.argsort(handles, kind="stable")
+            handles = handles[order]
+            cols = {s: (d[order], v[order]) for s, (d, v) in cols.items()}
+            if np.any(handles[:-1] == handles[1:]):
+                raise ValueError("ingest_columnar: duplicate handles")
+        with self._mu:
+            self.tso.ts()  # burn a start_ts to mirror the txn path
+            commit_ts = self.tso.ts()
+            lo_key = tablecodec.record_key(table_id, int(handles[0]))
+            hi_key = tablecodec.record_key(table_id, int(handles[-1]))
+            if self._locks:
+                for k in self._locks:
+                    if lo_key <= k <= hi_key:
+                        raise KeyLockedError(k, self._locks[k])
+            block = StableBlock(table_id, handles, cols, schema, dicts or {}, commit_ts)
+            self._stable.setdefault(table_id, []).append(block)
+            touched = [
+                r
+                for r in self._regions
+                if (not r.end or lo_key < r.end) and (not r.start or hi_key >= r.start)
+            ]
+            for r in touched:
+                self._recount_region(r)
+                r.max_commit_ts = max(r.max_commit_ts, commit_ts)
+                r.data_version += 1
+            for r in touched:
+                self._maybe_auto_split(r)
+            return commit_ts
+
+    def stable_parts(self, table_id: int, kr: KeyRange, read_ts: int) -> list[tuple["StableBlock", int, int]]:
+        """[(block, lo, hi)] index slices of stable rows with record keys in
+        [kr) visible at ``read_ts``, in ingest order."""
+        hlo, hhi = tablecodec.range_to_handles(kr, table_id)
+        out = []
+        with self._mu:
+            for block in self._stable.get(table_id, ()):
+                if block.commit_ts > read_ts:
+                    continue
+                lo = int(np.searchsorted(block.handles, hlo, side="left"))
+                hi = int(np.searchsorted(block.handles, hhi, side="left"))
+                if lo < hi:
+                    out.append((block, lo, hi))
+        return out
+
+    def stable_row_count(self, table_id: int) -> int:
+        with self._mu:
+            return sum(len(b) for b in self._stable.get(table_id, ()))
+
+    def drop_stable(self, table_id: int) -> None:
+        """DDL (drop/truncate) discards the table's stable blocks."""
+        with self._mu:
+            if self._stable.pop(table_id, None) is not None:
+                for r in self._regions:
+                    self._recount_region(r)
+                    r.data_version += 1
+
+    def _stable_holds(self, key: bytes) -> bool:
+        """Does ANY stable block contain this record key's handle?"""
+        if not self._stable or not tablecodec.is_record_key(key):
+            return False
+        table_id, handle = tablecodec.decode_record_key(key)
+        for block in self._stable.get(table_id, ()):
+            i = int(np.searchsorted(block.handles, handle))
+            if i < len(block.handles) and int(block.handles[i]) == handle:
+                return True
+        return False
+
+    def _stable_get(self, key: bytes, read_ts: int, after_ts: int = 0) -> Optional[bytes]:
+        """Point read from the stable layer (encode-on-demand). Latest visible
+        block wins; blocks at or before ``after_ts`` lose to the caller's dict
+        verdict (newest-version-wins across layers)."""
+        if not self._stable or not tablecodec.is_record_key(key):
+            return None
+        table_id, handle = tablecodec.decode_record_key(key)
+        from tidb_tpu.kv.rowcodec import encode_row
+
+        for block in reversed(self._stable.get(table_id, ())):
+            if block.commit_ts > read_ts or block.commit_ts <= after_ts:
+                continue
+            i = int(np.searchsorted(block.handles, handle))
+            if i < len(block.handles) and int(block.handles[i]) == handle:
+                return encode_row(block.schema, block.row_values(i))
+        return None
+
     def rollback(self, keys: Sequence[bytes], start_ts: int) -> None:
         with self._mu:
             for k in keys:
@@ -528,7 +785,9 @@ class MemStore:
                 for i in range(len(writes) - 1, -1, -1):
                     if writes[i].commit_ts <= safe_ts:
                         keep_from = i
-                        if writes[i].op == OP_DEL:
+                        if writes[i].op == OP_DEL and not self._stable_holds(k):
+                            # a tombstone masking a stable row must survive GC
+                            # or the deleted row would resurrect from the block
                             keep_from = i + 1
                         break
                 if keep_from > 0:

@@ -125,7 +125,17 @@ class Txn:
 
     def scan(self, kr: KeyRange, limit: int = 2**63, read_ts: Optional[int] = None) -> list[tuple[bytes, bytes]]:
         snap = self.snapshot if read_ts is None else Snapshot(self.store, read_ts)
-        base = dict(self._retry_locked(lambda: snap.scan(kr)))
+        # membuf DELs can only shrink the snapshot result: limit+ndel snapshot
+        # rows always cover the first `limit` merged rows (keeps LIMIT-k scans
+        # of bulk-loaded tables O(k), e.g. the DDL backfill batches)
+        ndel = 0
+        if limit < 2**63:
+            ndel = sum(
+                1
+                for k, (op, _) in self.membuf._buf.items()
+                if op == OP_DEL and kr.start <= k < kr.end
+            )
+        base = dict(self._retry_locked(lambda: snap.scan(kr, limit=min(limit + ndel, 2**63))))
         for k, (op, v) in self.membuf._buf.items():
             if kr.start <= k < kr.end:
                 if op == OP_DEL:

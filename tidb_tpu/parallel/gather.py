@@ -109,7 +109,26 @@ def _agg_mpp_ok(agg: PhysFinalAgg) -> bool:
     return True
 
 
-BROADCAST_THRESHOLD = 100_000  # ref: broadcast-join row threshold spirit
+FORCE_EXCHANGE: str | None = None  # test hook: "hash" | "broadcast"
+
+
+def _choose_exchange(l_rows: int | None, r_rows: int | None, ndev: int) -> str:
+    """Stats-driven exchange choice (ref: fragment.go:235 exchange-type cost):
+    broadcast replicates the build side to every shard (moves r*(ndev-1)
+    rows); hash shuffles both sides (moves ~(l+r)*(ndev-1)/ndev rows) and
+    then pays per-shard routing on the probe side. Broadcast wins whenever
+    replicating the build side is cheaper than routing the probe side.
+    Without stats on a side, fall back to an absolute build-side cap rather
+    than guessing a probe size (a large analyzed build side must not be
+    replicated just because the probe is un-analyzed)."""
+    if FORCE_EXCHANGE is not None:
+        return FORCE_EXCHANGE
+    if r_rows is None or l_rows is None:
+        small = r_rows if r_rows is not None else 0
+        return "broadcast" if small <= 100_000 else "hash"
+    if r_rows * max(ndev - 1, 1) <= max(l_rows, 1):
+        return "broadcast"
+    return "hash"
 
 
 def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None) -> PhysicalPlan:
@@ -143,13 +162,19 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None) -> PhysicalPlan:
                 return p  # per-table dictionaries: string join keys differ
             if not _right_side_unique(rreader, key_slots):
                 return p
-            # broadcast when the build side is small; shuffle (hash) when the
-            # stats say it is big
-            exchange = "broadcast"
+            l_rows = r_rows = None
             if stats is not None:
-                st = stats.get(rreader.table.id)
-                if st is not None and st.row_count > BROADCAST_THRESHOLD:
-                    exchange = "hash"
+                lst = stats.get(lreader.table.id)
+                rst = stats.get(rreader.table.id)
+                l_rows = lst.row_count if lst is not None else None
+                r_rows = rst.row_count if rst is not None else None
+            from tidb_tpu.parallel import make_mesh
+
+            try:
+                ndev = make_mesh().devices.size
+            except Exception:
+                ndev = 1
+            exchange = _choose_exchange(l_rows, r_rows, ndev)
             return PhysMPPGather(
                 agg=p,
                 left=lreader,
@@ -402,8 +427,10 @@ class MPPGatherExec:
         n_group_lanes = 2 * len(agg.group_by) if agg.group_by else 2
         sums_idx = list(range(n_group_lanes, n_group_lanes + 2 * sum(1 for a in agg.aggs if a.arg is not None)))
         group_cap = self._initial_group_cap(n_l)
-        per_shard = (max(n_l, 1) + ndev - 1) // ndev
-        row_cap = max(2 * per_shard, 64)
+        # per-side receive capacity: each side sized from ITS row count — the
+        # build (dimension) side must not inherit the probe side's padding
+        l_row_cap = max(2 * ((max(n_l, 1) + ndev - 1) // ndev), 64)
+        r_row_cap = max(2 * ((max(n_r, 1) + ndev - 1) // ndev), 64)
         while True:
             spec = DistAggSpec(n_keys=n_group_lanes, sums=sums_idx, group_cap=group_cap)
             join_spec = None
@@ -412,7 +439,8 @@ class MPPGatherExec:
                     left_keys=left_keys,
                     right_keys=right_keys,
                     exchange=p.exchange,
-                    row_cap=row_cap,
+                    left_row_cap=l_row_cap,
+                    right_row_cap=r_row_cap,
                 )
             # compile cache: the jitted shard_map program is pure structure —
             # keyed on specs + bound-condition fingerprints, NOT data. Without
@@ -449,29 +477,21 @@ class MPPGatherExec:
                 while len(_MPP_FN_CACHE) > 64:
                     _MPP_FN_CACHE.pop(next(iter(_MPP_FN_CACHE)))
             outs = fn(*(list(larrays) + list(rarrays)))
-            # ONE device→host transfer for every output lane: concat int64
-            # views (floats ride value-exact only when integral — sums over
-            # DOUBLE keep per-array fetches), then split host-side
-            shapes = [tuple(o.shape) for o in outs]
-            any_float = any(str(o.dtype).startswith("float") for o in outs)
-            if not any_float:
-                flat = jnp.concatenate([jnp.ravel(o).astype(jnp.int64) for o in outs])
-                host = np.asarray(flat)
-                arrs = []
-                off = 0
-                for shp in shapes:
-                    sz = int(np.prod(shp)) if shp else 1
-                    arrs.append(host[off : off + sz].reshape(shp))
-                    off += sz
-            else:
-                arrs = [np.asarray(o) for o in outs]
+            # ONE device→host round trip for every output lane: device_get
+            # batches the whole tuple into a single transfer
+            import jax
+
+            arrs = list(jax.device_get(outs))
             dropped = int(arrs[-2])
             group_overflow = int(arrs[-1])
             if dropped == 0 and group_overflow == 0:
                 break
-            # grow-on-overflow, like coprocessor paging
+            # grow-on-overflow, like coprocessor paging (skewed owners can
+            # exceed either side's 2× headroom; the drop counter is shared,
+            # so grow both)
             if dropped:
-                row_cap *= 4
+                l_row_cap *= 4
+                r_row_cap *= 4
             if group_overflow:
                 group_cap *= 4
         return self._merge(arrs[:-2], agg)

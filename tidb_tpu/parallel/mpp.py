@@ -105,8 +105,10 @@ def build_dist_agg(mesh, spec: DistAggSpec, selection: Callable | None = None):
                 n_left=len(cols),
                 left_selection=selection,
             )
-            outs = fn(*cols)
-            if int(np.asarray(outs[-1])) == 0:  # overflow lane
+            import jax
+
+            outs = jax.device_get(fn(*cols))  # one batched transfer
+            if int(outs[-1]) == 0:  # overflow lane
                 return outs[:-2]  # drop (dropped, overflow) — both zero
             cap *= 4
 
@@ -125,12 +127,16 @@ class DistJoinSpec:
     ``exchange``: "hash" (both sides shuffled by key owner — all_to_all) or
     "broadcast" (right side replicated — all_gather).
     ``row_cap``: static per-destination receive capacity for hash exchange
-    (overflow is reported, never silently dropped on the result path)."""
+    (overflow is reported, never silently dropped on the result path);
+    ``left_row_cap``/``right_row_cap`` size the two sides independently —
+    a small build side must not inherit the probe side's capacity."""
 
     left_keys: Sequence[int]
     right_keys: Sequence[int]
     exchange: str = "hash"  # hash | broadcast
     row_cap: int = 4096
+    left_row_cap: int | None = None
+    right_row_cap: int | None = None
 
 
 def _combine_keys(jnp, keys):
@@ -146,31 +152,37 @@ def _combine_keys(jnp, keys):
 
 def _route_rows(jax, jnp, arrays, valid, owner, ndev, cap):
     """Hash-exchange rows to owner shards (all_to_all with static per-dest
-    capacity). Returns (received arrays, received valid, locally dropped)."""
+    capacity). Returns (received arrays, received valid, locally dropped).
+
+    Scatter-free: rows sort by destination, then every send-buffer slot
+    *gathers* its row (slot (d, r) ← sorted position start_d + r). TPU
+    lowers large scatters to a serialized loop; gathers vectorize."""
+    if ndev == 1:
+        # single-shard mesh: every row is already home — the exchange is the
+        # identity and padding to ``cap`` would only add work
+        return list(arrays), valid, jnp.int64(0)
     n = valid.shape[0]
-    # stable sort by destination; invalid rows park past every real dest
     order = jnp.argsort(jnp.where(valid, owner, ndev), stable=True)
     so = jnp.where(valid, owner, ndev)[order]
     sv = valid[order]
-    rank = jnp.arange(n) - jnp.searchsorted(so, so, side="left")
-    keep = sv & (rank < cap)
+    # per-destination block starts: ndev+1 searchsorted queries, not n
+    starts = jnp.searchsorted(so, jnp.arange(ndev + 1))
+    rank = jnp.arange(n) - starts[jnp.clip(so, 0, ndev)]
     dropped = (sv & (rank >= cap)).sum()
-    # non-kept rows scatter to a sacrificial slot past the buffer
-    idx = jnp.where(keep, so * cap + jnp.clip(rank, 0, cap - 1), ndev * cap)
+    dest = jnp.arange(ndev * cap) // cap
+    slot = jnp.arange(ndev * cap) % cap
+    src = starts[dest] + slot
+    src_c = jnp.clip(src, 0, n - 1)
+    ok = (src < n) & (so[src_c] == dest) & sv[src_c]
 
     def exchange(buf):
         return jax.lax.all_to_all(
             buf.reshape(ndev, cap), "dp", split_axis=0, concat_axis=0, tiled=False
         ).reshape(ndev * cap)
 
-    out_arrays = []
-    for x in arrays:
-        buf = jnp.zeros((ndev * cap + 1,), dtype=x.dtype)
-        buf = buf.at[idx].set(x[order])
-        out_arrays.append(exchange(buf[: ndev * cap]))
-    vbuf = jnp.zeros((ndev * cap + 1,), dtype=bool)
-    vbuf = vbuf.at[idx].set(keep)
-    out_valid = exchange(vbuf[: ndev * cap])
+    gidx = order[src_c]  # slot → original row, one composed gather index
+    out_arrays = [exchange(jnp.where(ok, x[gidx], 0)) for x in arrays]
+    out_valid = exchange(ok)
     return out_arrays, out_valid, dropped
 
 
@@ -253,8 +265,10 @@ def build_dist_join_agg(
         if join.exchange == "hash":
             lowner = jnp.abs(lkey) % ndev
             rowner = jnp.abs(rkey) % ndev
-            lcols2, lvalid, d1 = _route_rows(jax, jnp, lcols, lvalid, lowner, ndev, join.row_cap)
-            rcols2, rvalid, d2 = _route_rows(jax, jnp, rcols, rvalid, rowner, ndev, join.row_cap)
+            lcap = join.left_row_cap or join.row_cap
+            rcap = join.right_row_cap or join.row_cap
+            lcols2, lvalid, d1 = _route_rows(jax, jnp, lcols, lvalid, lowner, ndev, lcap)
+            rcols2, rvalid, d2 = _route_rows(jax, jnp, rcols, rvalid, rowner, ndev, rcap)
             dropped = d1 + d2
             lcols, rcols = lcols2, rcols2
             lkeys = [lcols[i] for i in join.left_keys]

@@ -309,7 +309,24 @@ def _try_point_get(plan: LogicalPlan):
         return None
     if not all(isinstance(e, ColumnRef) for e in proj.exprs):
         return None
-    pg = PhysPointGet(db=scan.db, table=scan.table, handle=int(const.value), schema=proj.schema)
+    table = scan.table
+    handle = int(const.value)
+    if table.partition is not None:
+        # route the handle to its partition's physical table (ref: point-get
+        # partition pruning, planner/core/point_get_plan.go)
+        p = table.partition
+        if p.col_offset != table.pk_offset:
+            return None
+        if p.type == "hash":
+            d = p.defs[handle % len(p.defs)]
+        else:
+            d = next(
+                (d for d in p.defs if d.less_than is None or handle < d.less_than), None
+            )
+            if d is None:
+                return None  # no partition holds this value → empty result
+        table = table.partition_view(d.id)
+    pg = PhysPointGet(db=scan.db, table=table, handle=handle, schema=proj.schema)
     pg.scan_slots = [scan.schema[e.index].slot for e in proj.exprs]  # type: ignore[attr-defined]
     return pg
 
