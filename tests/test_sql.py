@@ -272,3 +272,42 @@ def test_show_index_and_create_table(tdb):
     from tidb_tpu.parser import parse
 
     parse(ddl)
+
+
+def test_limit_pushes_through_projection(db):
+    """Plain LIMIT under a projection reaches the reader DAG (ref: TiDB limit
+    pushdown, rule_topn_push_down), so rows-kind tasks stay count-bounded."""
+    db.execute("CREATE TABLE lp (a BIGINT, b DECIMAL(10,2))")
+    db.execute("INSERT INTO lp VALUES " + ",".join(f"({i}, {i}.50)" for i in range(40)))
+    s = db.session()
+    for eng in ("tpu", "host"):
+        s.execute(f"SET tidb_isolation_read_engines = '{eng}'")
+        rows = s.query("SELECT b FROM lp WHERE a >= 10 LIMIT 5")
+        assert len(rows) == 5 and all(Decimal("10.50") <= r[0] for r in rows), eng
+    (plan,) = [r[0] for r in s.query("EXPLAIN SELECT b FROM lp WHERE a >= 10 LIMIT 5") if "TableReader" in r[0]]
+    assert "Limit" in plan
+
+
+def test_topn_single_key_fast_path_parity(db):
+    """Single-key TopN (the lax.top_k candidate path on the tpu engine) agrees
+    with the host engine for ASC/DESC including MySQL NULL placement."""
+    db.execute("CREATE TABLE tk (v DECIMAL(10,2), tag VARCHAR(4))")
+    vals = [(f"{i}.25", f"'t{i % 7}'") for i in range(200)]
+    db.execute(
+        "INSERT INTO tk VALUES "
+        + ",".join(f"({v}, {t})" for v, t in vals)
+        + ", (NULL, 'nul1'), (NULL, 'nul2')"
+    )
+    s = db.session()
+    out = {}
+    for eng in ("tpu", "host"):
+        s.execute(f"SET tidb_isolation_read_engines = '{eng}'")
+        out[eng] = (
+            s.query("SELECT tag, v FROM tk ORDER BY v DESC LIMIT 4"),
+            s.query("SELECT tag, v FROM tk ORDER BY v ASC LIMIT 4"),
+            s.query("SELECT tag, v FROM tk WHERE v > 5 ORDER BY v ASC LIMIT 4"),
+        )
+    assert out["tpu"] == out["host"]
+    # DESC: NULLs last; ASC: NULLs first
+    assert out["host"][0][0][1] == Decimal("199.25")
+    assert [r[0] for r in out["host"][1][:2]] == ["nul1", "nul2"]

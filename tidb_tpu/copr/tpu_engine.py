@@ -119,10 +119,21 @@ def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: 
         packed = kernel.fn(handles_dev, tuple(cols_dev), jnp.asarray(rarr), jnp.asarray(entry.n))
         # ONE device→host round trip per task: device_get batches every
         # buffer of the packed result into a single transfer — two
-        # sequential np.asarray calls would pay the tunnel RTT twice
+        # sequential np.asarray calls would pay the tunnel RTT twice.
+        # Exception: large rows-kind buffers (capacity = the padded table) are
+        # usually near-empty after selection, so there we spend a second tiny
+        # RTT on the meta row to learn the live count, then transfer only the
+        # live slice instead of n_pad rows per lane.
         import jax
 
         fbuf = None
+        if kernel.kind == "rows" and kernel.out_n > 65536:
+            ibuf = packed[0] if isinstance(packed, tuple) else packed
+            meta = jax.device_get(ibuf[0, :2])
+            count, ngroups = int(meta[0]), int(meta[1])
+            # bucketed width: one XLA slice program per size class, not per count
+            w = min(kernel.out_n, bucket_size(max(2, count)))
+            packed = tuple(p[:, :w] for p in packed) if isinstance(packed, tuple) else packed[:, :w]
         if isinstance(packed, tuple):
             buf, fbuf = jax.device_get(packed)
         else:

@@ -455,6 +455,62 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
             elif ex.tp == dagpb.TOPN:
                 order, limit = pre
                 cur_n = batch.n
+                # single-key fast path: two lax.top_k candidate pulls (value
+                # rows, NULL rows) + an exact lex sort over the tiny 2K
+                # candidate set. O(n) instead of a full multi-lane stable
+                # argsort over the padded table (which at 10M+ rows costs
+                # seconds to run and minutes to compile under x64 emulation).
+                # Gated on key kinds whose physical values can never equal the
+                # int64 sentinel (scaled decimals, dates, dict codes) or are
+                # floats (MySQL stores no ±inf), so sentinel collisions are
+                # impossible.
+                _TOPK_KINDS = (
+                    TypeKind.DECIMAL,
+                    TypeKind.DATE,
+                    TypeKind.DATETIME,
+                    TypeKind.DURATION,
+                    TypeKind.STRING,
+                    TypeKind.FLOAT,
+                )
+                if len(order) == 1 and out_n <= 4096 and order[0][0].ftype.kind in _TOPK_KINDS:
+                    e, desc = order[0]
+                    d, v, _ = eval_expr(e, batch, jnp)
+                    d = _bcast(d, cur_n)
+                    v = _vmask(v, cur_n)
+                    K = min(out_n, cur_n)
+                    isf = jnp.issubdtype(d.dtype, jnp.floating)
+                    d0 = jnp.where(v, d, 0)  # NULL keys zero, like the slow path
+                    if desc:
+                        key = d0
+                    else:
+                        # monotone-reversing: negate floats, complement ints
+                        # (~d avoids INT64_MIN overflow)
+                        key = -d0 if isf else ~d0
+                    sent = -jnp.inf if isf else jnp.iinfo(jnp.int64).min
+                    vkey = jnp.where(mask & v, key, sent)
+                    _, idx_val = jax.lax.top_k(vkey, K)
+                    # NULL rows in first-index order (top_k ties break low-index)
+                    _, idx_null = jax.lax.top_k(jnp.where(mask & ~v, 1, 0), K)
+                    cand = jnp.concatenate([idx_val, idx_null])
+                    # liveness is per-source: a top_k slot past the true count
+                    # points at an arbitrary row and must not leak through
+                    live_c = jnp.concatenate([(mask & v)[idx_val], (mask & ~v)[idx_null]])
+                    if desc:
+                        tier = jnp.concatenate([jnp.zeros(K, jnp.int64), jnp.ones(K, jnp.int64)])
+                    else:  # ASC: NULLs first
+                        tier = jnp.concatenate([jnp.ones(K, jnp.int64), jnp.zeros(K, jnp.int64)])
+                    ckey = jnp.where(live_c, key[cand], 0)
+                    perm2 = _lex_perm([~live_c, tier, -ckey if isf else ~ckey])
+                    head = cand[perm2[:K]]
+                    batch = EvalBatch(
+                        [(_bcast(d2, cur_n)[head], _vmask(v2, cur_n)[head]) for d2, v2 in batch.cols],
+                        batch.dicts,
+                        K,
+                    )
+                    count = jnp.minimum(limit, mask.sum())
+                    mask = jnp.arange(K) < count
+                    kind = "rows"
+                    continue
                 lanes = [~mask]
                 for e, desc in order:
                     d, v, _ = eval_expr(e, batch, jnp)
@@ -482,8 +538,9 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                 kind = "rows"
             elif ex.tp == dagpb.LIMIT:
                 cur_n = batch.n
-                perm = jnp.argsort(~mask, stable=True)
-                head = perm[: min(out_n, cur_n)]
+                # first `head_n` live rows in index order: top_k over the mask
+                # (ties break toward low indices) — O(n), no full sort
+                _, head = jax.lax.top_k(mask.astype(jnp.int32), min(out_n, cur_n))
                 batch = EvalBatch(
                     [(_bcast(d, cur_n)[head], _vmask(v, cur_n)[head]) for d, v in batch.cols],
                     batch.dicts,

@@ -608,8 +608,19 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan
                 ):
                     reader.store_type = st
                     reader.pushed_topn = (by, total)
-        elif isinstance(child, PhysTableReader) and child.pushed_agg is None and child.pushed_topn is None:
-            child.pushed_limit = total
+        else:
+            # plain LIMIT pushes through row-preserving projections into the
+            # reader (ref: limit pushdown, planner/core/rule/rule_topn_push_down)
+            below = child
+            while isinstance(below, PhysProjection):
+                below = below.children[0]
+            if (
+                isinstance(below, PhysTableReader)
+                and below.pushed_agg is None
+                and below.pushed_topn is None
+                and below.pushed_limit is None
+            ):
+                below.pushed_limit = total
         return PhysLimit(limit=plan.limit, offset=plan.offset, children=[child])
     if isinstance(plan, LogicalProjection):
         child = _physical(plan.children[0], engines, stats)
