@@ -116,11 +116,14 @@ class CopClient:
 
             return CopResponse(gen_ordered(), pool)
 
-        from concurrent.futures import as_completed
-
+        # tasks still run concurrently; yielding in task order (not completion
+        # order) costs nothing — the reader gathers every result before
+        # returning — and keeps ORDER BY tie-breaks deterministic across runs
+        # and engines (a stable root sort preserves the concat order of equal
+        # keys, so completion-order concat would make ties racy)
         def gen_unordered():
             try:
-                for f in as_completed(futures):
+                for f in futures:
                     yield f.result()
             finally:
                 pool.shutdown(wait=False)

@@ -4,12 +4,26 @@ Reference parity: the TiFlash role (columnar accelerator engine behind the
 same coprocessor contract as TiKV). Per region task:
 
 1. get/reuse host columnar cache (colcache.ColumnCache);
-2. get/reuse *device-resident* padded arrays keyed by the same
-   (region, data_version) identity — steady-state queries touch HBM only;
+2. get/reuse *device-resident* arrays keyed by the same
+   (region, data_version) identity — steady-state queries touch HBM only.
+   Large regions shard into fixed-size device blocks (``_BLOCK`` rows), so
+   one kernel compile serves every table size and HBM stays bounded by an
+   LRU budget (``TIDB_TPU_HBM_GB``) instead of growing with the data;
 3. bind the DAG (string constants → dictionary codes; binder.py);
-4. fetch/compile the fused kernel (ops/dag_kernel.py) and run it;
+4. fetch/compile the fused kernel (ops/dag_kernel.py) and run it — per
+   block for sharded regions, with all blocks dispatched asynchronously and
+   results stacked on-device into ONE host transfer;
 5. trim padded outputs by the kernel-reported count and re-attach string
    dictionaries → chunk.
+
+Block results concatenate without a merge step because of the pushdown
+contract: aggregations are dispatched in PARTIAL mode (the executor's final
+agg merges duplicate groups across tasks — and now across blocks), TopN
+tasks return candidate supersets re-sorted by the root sort, and LIMIT
+tasks over-return at most ``limit`` rows per block, trimmed by the root.
+This mirrors the coprocessor paging protocol (ref: pkg/kv/kv.go:589-596,
+copr/coprocessor.go:368-374): LIMIT DAGs stream blocks lazily
+(grow-on-demand) and stop as soon as the limit is satisfiable.
 
 Overflow protocol: if the kernel reports more groups than its static cap, we
 recompile with the next power-of-two cap and re-run (bounded doubling).
@@ -17,7 +31,9 @@ recompile with the next power-of-two cap and re-run (bounded doubling).
 
 from __future__ import annotations
 
+import os
 import threading
+from collections import OrderedDict
 
 import numpy as np
 
@@ -38,19 +54,74 @@ from tidb_tpu.ops.dag_kernel import _ensure_x64
 _ensure_x64()  # BEFORE any device_put: int64/float64 lanes must not truncate
 
 _DEFAULT_AGG_CAP = 4096
+_BLOCK = 1 << 22  # device block rows; one compile shape for all big tables
 
-_dev_mu = threading.Lock()
-# (region_id, table_id, slot, data_version, dict_epoch, n_pad) → (data, valid) on device
-_device_cols: dict[tuple, tuple] = {}
+
+class _DeviceLRU:
+    """HBM-bounded LRU of device-resident column (data, valid) pairs.
+
+    Ref: the coprocessor cache (copr/coprocessor_cache.go:32) crossed with
+    TiFlash's delta-tree page cache — capacity-bounded, recency-evicted.
+    Eviction only drops our reference; in-flight kernels keep their inputs
+    alive until dispatch completes, so eviction is always safe.
+    """
+
+    def __init__(self, budget_bytes: int):
+        self.budget = budget_bytes
+        self._mu = threading.Lock()
+        self._entries: "OrderedDict[tuple, tuple]" = OrderedDict()  # key → (pair, nbytes)
+        self.total = 0
+
+    def get(self, key):
+        with self._mu:
+            hit = self._entries.get(key)
+            if hit is None:
+                return None
+            self._entries.move_to_end(key)
+            return hit[0]
+
+    def put(self, key, pair, nbytes: int):
+        with self._mu:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.total -= old[1]
+            self._entries[key] = (pair, nbytes)
+            self.total += nbytes
+            while self.total > self.budget and len(self._entries) > 1:
+                k, (_, nb) = next(iter(self._entries.items()))
+                if k == key:  # never evict the entry just inserted
+                    break
+                del self._entries[k]
+                self.total -= nb
+
+    def evict_superseded(self, ident, ver_epoch):
+        """Drop stale epochs/versions of the same column — each write bumps
+        data_version and stale device arrays would leak HBM forever. Sibling
+        blocks of the *current* (version, epoch) stay resident."""
+        with self._mu:
+            for k in [
+                k
+                for k in self._entries
+                if k[: len(ident)] == ident and k[len(ident) : len(ident) + 2] != ver_epoch
+            ]:
+                self.total -= self._entries[k][1]
+                del self._entries[k]
+
+
+def _hbm_budget() -> int:
+    return int(float(os.environ.get("TIDB_TPU_HBM_GB", "12")) * (1 << 30))
+
+
+_DEVICE_LRU = _DeviceLRU(_hbm_budget())
 
 
 def _device_put_col(key, data: np.ndarray, valid: np.ndarray, n_pad: int, cacheable: bool = True):
+    """One padded (data, valid) pair on device, LRU-cached under ``key``."""
     import jax
     import jax.numpy as jnp
 
     if cacheable:
-        with _dev_mu:
-            hit = _device_cols.get(key)
+        hit = _DEVICE_LRU.get(key)
         if hit is not None:
             return hit
     pd = np.zeros(n_pad, dtype=data.dtype if data.dtype != np.int32 else np.int64)
@@ -59,19 +130,40 @@ def _device_put_col(key, data: np.ndarray, valid: np.ndarray, n_pad: int, cachea
     pv[: len(valid)] = valid
     out = (jax.device_put(jnp.asarray(pd)), jax.device_put(jnp.asarray(pv)))
     if cacheable:
-        with _dev_mu:
-            # evict superseded epochs of the same column: each write bumps
-            # data_version, and stale device arrays would leak HBM forever
-            ident = key[:4]  # (store_nonce, region_id, table_id, slot)
-            for k in [k for k in _device_cols if k[:4] == ident and k != key]:
-                del _device_cols[k]
-            _device_cols[key] = out
+        # key layout: (store_nonce, region_id, table_id, slot, data_version,
+        # epoch, ...shape/block suffix)
+        _DEVICE_LRU.put(key, out, pd.nbytes + pv.nbytes)
+        _DEVICE_LRU.evict_superseded(key[:4], key[4:6])
     return out
 
 
-def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int) -> Chunk:
+def _block_bounds(n: int) -> list[tuple[int, int]]:
+    return [(i, min(i + _BLOCK, n)) for i in range(0, n, _BLOCK)]
+
+
+def _probe_slice_rows(packed_list: list, kernel):
+    """Large rows-kind buffers (capacity = the padded block/table) are usually
+    near-empty after selection: fetch every block's meta row in ONE tiny
+    transfer, then slice each block's lanes to its bucketed live width so the
+    payload transfer moves live rows, not capacity. Returns (counts, sliced)."""
+    import jax
     import jax.numpy as jnp
 
+    tup = isinstance(packed_list[0], tuple)
+    ibufs = [p[0] if tup else p for p in packed_list]
+    if len(ibufs) == 1:
+        metas = jax.device_get(ibufs[0][0, :2])[None]
+    else:
+        metas = jax.device_get(jnp.stack([b[0, :2] for b in ibufs]))
+    sliced = []
+    for p, m in zip(packed_list, metas):
+        # bucketed width: one XLA slice program per size class, not per count
+        w = min(kernel.out_n, bucket_size(max(2, int(m[0]))))
+        sliced.append(tuple(q[:, :w] for q in p) if tup else p[:, :w])
+    return [int(m[0]) for m in metas], sliced
+
+
+def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int):
     scan = dag.executors[0]
     if scan.desc:
         # descending scans are order-sensitive row streams — the sorted-batch
@@ -81,25 +173,9 @@ def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: 
     slots = [c.column_id for c in scan.columns if not c.is_handle]
     cache = cache_for(store)
     entry = cache.get(region, scan.table_id, schema, slots, read_ts)
-    n_pad = bucket_size(max(entry.n, 1))
 
     binder = Binder(cache, scan.table_id, scan.columns)
     bound = binder.bind_dag(dag)
-
-    # device inputs (cached per region epoch; stale-snapshot entries bypass
-    # the device cache — they'd alias the head state of the same version)
-    epoch = cache.epoch
-    cacheable = entry.complete
-    hkey = (store.nonce, region.region_id, scan.table_id, -1, entry.data_version, epoch, n_pad)
-    handles_dev, _ = _device_put_col(hkey, entry.handles, np.ones(entry.n, bool), n_pad, cacheable)
-    cols_dev = []
-    for c in scan.columns:
-        if c.is_handle:
-            cols_dev.append(_device_put_col(hkey, entry.handles, np.ones(entry.n, bool), n_pad, cacheable))
-        else:
-            data, valid = entry.cols[c.column_id]
-            ckey = (store.nonce, region.region_id, scan.table_id, c.column_id, entry.data_version, epoch, n_pad)
-            cols_dev.append(_device_put_col(ckey, data, valid, n_pad, cacheable))
 
     # ranges → padded static array; rows outside any range are masked out
     rarr = np.zeros((MAX_RANGES, 2), dtype=np.int64)
@@ -113,6 +189,36 @@ def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: 
         for i, kr in enumerate(use):
             rarr[i] = tablecodec.range_to_handles(kr, scan.table_id)
 
+    agg_complete = any(
+        ex.tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG) and ex.agg_mode == dagpb.AGG_COMPLETE
+        for ex in dag.executors[1:]
+    )
+    if entry.n > _BLOCK and not agg_complete:
+        return _exec_blocks(store, dag, bound, scan, cache, entry, region, rarr)
+    return _exec_single(store, dag, bound, scan, cache, entry, region, rarr)
+
+
+def _exec_single(store, dag, bound, scan, cache, entry, region, rarr) -> Chunk:
+    """Small regions (≤ one block) or COMPLETE-mode aggs: one padded array,
+    one kernel invocation — the round-1 path, preserved verbatim."""
+    import jax
+    import jax.numpy as jnp
+
+    n_pad = bucket_size(max(entry.n, 1))
+    epoch = cache.epoch
+    cacheable = entry.complete
+    hkey = (store.nonce, region.region_id, scan.table_id, -1, entry.data_version, epoch, n_pad)
+    handles_pair = _device_put_col(hkey, entry.handles, np.ones(entry.n, bool), n_pad, cacheable)
+    handles_dev = handles_pair[0]
+    cols_dev = []
+    for c in scan.columns:
+        if c.is_handle:
+            cols_dev.append(handles_pair)
+        else:
+            data, valid = entry.cols[c.column_id]
+            ckey = (store.nonce, region.region_id, scan.table_id, c.column_id, entry.data_version, epoch, n_pad)
+            cols_dev.append(_device_put_col(ckey, data, valid, n_pad, cacheable))
+
     agg_cap = min(_DEFAULT_AGG_CAP, n_pad) if kernel_needs_agg(bound) else _DEFAULT_AGG_CAP
     while True:
         kernel = get_kernel(bound, n_pad, agg_cap)
@@ -120,20 +226,11 @@ def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: 
         # ONE device→host round trip per task: device_get batches every
         # buffer of the packed result into a single transfer — two
         # sequential np.asarray calls would pay the tunnel RTT twice.
-        # Exception: large rows-kind buffers (capacity = the padded table) are
-        # usually near-empty after selection, so there we spend a second tiny
-        # RTT on the meta row to learn the live count, then transfer only the
-        # live slice instead of n_pad rows per lane.
-        import jax
-
+        # Exception: large rows-kind buffers spend a second tiny RTT on the
+        # meta row and transfer only the live slice (_probe_slice_rows).
         fbuf = None
         if kernel.kind == "rows" and kernel.out_n > 65536:
-            ibuf = packed[0] if isinstance(packed, tuple) else packed
-            meta = jax.device_get(ibuf[0, :2])
-            count, ngroups = int(meta[0]), int(meta[1])
-            # bucketed width: one XLA slice program per size class, not per count
-            w = min(kernel.out_n, bucket_size(max(2, count)))
-            packed = tuple(p[:, :w] for p in packed) if isinstance(packed, tuple) else packed[:, :w]
+            _, (packed,) = _probe_slice_rows([packed], kernel)
         if isinstance(packed, tuple):
             buf, fbuf = jax.device_get(packed)
         else:
@@ -147,7 +244,134 @@ def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: 
             agg_cap = min(agg_cap * 4, n_pad)
             continue
         break
+    return _chunk_from_bufs(buf, fbuf, count, kernel, dag, cache, scan)
 
+
+def _exec_blocks(store, dag, bound, scan, cache, entry, region, rarr):
+    """Large regions: fixed-shape device blocks, one compile per DAG.
+
+    Aggs/TopN dispatch every block asynchronously and stack the packed
+    buffers on-device → one transfer; LIMIT-last DAGs stream blocks lazily
+    with early exit (coprocessor paging).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = entry.n
+    bounds = _block_bounds(n)
+    epoch = cache.epoch
+    cacheable = entry.complete
+    base = (store.nonce, region.region_id, scan.table_id)
+
+    def block_inputs(bi: int):
+        """Device arrays for ONE block, put on demand (LRU-cached) — so the
+        LIMIT paging loop's early exit also skips the H2D transfers of the
+        blocks it never reads, which dominate cold-table cost."""
+        lo, hi = bounds[bi]
+        hkey = base + (-1, entry.data_version, epoch, bi, _BLOCK)
+        hpair = _device_put_col(hkey, entry.handles[lo:hi], np.ones(hi - lo, bool), _BLOCK, cacheable)
+        cols_dev = []
+        for c in scan.columns:
+            if c.is_handle:
+                cols_dev.append(hpair)
+            else:
+                data, valid = entry.cols[c.column_id]
+                ckey = base + (c.column_id, entry.data_version, epoch, bi, _BLOCK)
+                cols_dev.append(_device_put_col(ckey, data[lo:hi], valid[lo:hi], _BLOCK, cacheable))
+        return hpair[0], tuple(cols_dev)
+
+    rarr_j = jnp.asarray(rarr)
+    nvalids = [hi - lo for lo, hi in bounds]
+    limit_last = bool(dag.executors[1:]) and dag.executors[-1].tp == dagpb.LIMIT
+
+    agg_cap = _DEFAULT_AGG_CAP
+    while True:
+        kernel = get_kernel(bound, _BLOCK, agg_cap)
+
+        def run_block(bi: int):
+            handles_dev, cols_dev = block_inputs(bi)
+            return kernel.fn(handles_dev, cols_dev, rarr_j, jnp.asarray(nvalids[bi]))
+
+        if limit_last:
+            out = _blocks_paged_limit(run_block, len(bounds), kernel, dag, cache, scan)
+        else:
+            out = _blocks_stacked(run_block, len(bounds), kernel, dag, cache, scan)
+        if out is None:  # agg overflow in some block
+            agg_cap = min(agg_cap * 4, _BLOCK)
+            continue
+        return out
+
+
+def _blocks_stacked(run_block, nb: int, kernel, dag, cache, scan):
+    """Dispatch all blocks async; stack results on-device; one transfer.
+    Returns None on agg-cap overflow (caller re-runs with a bigger cap)."""
+    import jax
+    import jax.numpy as jnp
+
+    packed = [run_block(bi) for bi in range(nb)]  # async dispatches
+    tup = isinstance(packed[0], tuple)
+    if kernel.kind == "rows" and kernel.out_n > 65536:
+        # rows-kind: counts first (one tiny transfer), then live slices only
+        counts, gets = _probe_slice_rows(packed, kernel)
+        fetched = jax.device_get(gets)
+        chunks = []
+        for cnt, got in zip(counts, fetched):
+            buf, fbuf = got if tup else (got, None)
+            chunks.append(_chunk_from_bufs(buf, fbuf, cnt, kernel, dag, cache, scan))
+        return _concat_chunks(chunks)
+    ibufs = [p[0] if tup else p for p in packed]
+    si = jnp.stack(ibufs)
+    if tup:
+        sf = jnp.stack([p[1] for p in packed])
+        bi_all, bf_all = jax.device_get((si, sf))
+    else:
+        bi_all = jax.device_get(si)
+        bf_all = None
+    if kernel.kind == "agg" and any(int(b[0, 1]) > kernel.agg_cap for b in bi_all):
+        return None
+    chunks = []
+    for b in range(nb):
+        buf = bi_all[b]
+        fbuf = bf_all[b] if bf_all is not None else None
+        chunks.append(_chunk_from_bufs(buf, fbuf, int(buf[0, 0]), kernel, dag, cache, scan))
+    return _concat_chunks(chunks)
+
+
+def _blocks_paged_limit(run_block, nb: int, kernel, dag, cache, scan):
+    """LIMIT-last: stream blocks with grow-on-demand lookahead, stop once the
+    limit is satisfiable (ref: paging page-size growth, copr/coprocessor.go:368)."""
+    import jax
+
+    limit = dag.executors[-1].limit
+    chunks = []
+    got = 0
+    window = 1
+    bi = 0
+    # `not chunks` keeps LIMIT 0 well-formed: one empty-count block result
+    # still carries the output schema for chunk assembly
+    while bi < nb and (got < limit or not chunks):
+        batch = list(range(bi, min(bi + window, nb)))
+        packed = [run_block(i) for i in batch]
+        tup = isinstance(packed[0], tuple)
+        if kernel.out_n > 65536:  # LIMIT-last DAGs are always rows-kind
+            counts, packed = _probe_slice_rows(packed, kernel)
+        fetched = jax.device_get(packed)
+        for got_b in fetched:
+            buf, fbuf = got_b if tup else (got_b, None)
+            cnt = int(buf[0, 0])
+            chunks.append(_chunk_from_bufs(buf, fbuf, cnt, kernel, dag, cache, scan))
+            got += cnt
+        bi += len(batch)
+        window = min(window * 2, 8)
+    return _concat_chunks(chunks)
+
+
+def _concat_chunks(chunks: list[Chunk]) -> Chunk:
+    return chunks[0] if len(chunks) == 1 else Chunk.concat(chunks)
+
+
+def _chunk_from_bufs(buf, fbuf, count: int, kernel, dag, cache, scan) -> Chunk:
+    """Packed kernel buffers → Chunk (trim to count, re-attach dictionaries)."""
     outs = []
     for (which, idx), vidx in zip(kernel.lane_loc, kernel.valid_loc):
         data = fbuf[idx] if which == "f" else buf[idx]

@@ -35,6 +35,11 @@ def bulk_load(db: DB, table_name: str, columns: Sequence[Sequence], db_name: str
             phys_cols.append(vals.astype(np.int64))
         elif isinstance(vals, np.ndarray) and k == TypeKind.FLOAT:
             phys_cols.append(vals.astype(np.float64))
+        elif isinstance(vals, np.ndarray) and vals.dtype.kind == "S" and k == TypeKind.STRING:
+            # fixed-width bytes: C-speed dictionary encode in the ingest path
+            # (no NULLs — an S array cannot carry None; JSON stays on the
+            # to_physical path for validation + canonical re-serialization)
+            phys_cols.append(vals)
         else:
             phys_cols.append([to_physical(v, c.ftype) for v in vals])
 
@@ -115,13 +120,18 @@ def _ingest_columnar(db: DB, physical_id: int, t, phys_cols, handles: np.ndarray
     # would remap every block EXCEPT this not-yet-visible one
     with cache.ingest_lock():
         for pos in string_slots:
-            arr = np.asarray(phys_cols[pos], dtype=object)
-            valid = np.fromiter((v is not None for v in arr), dtype=bool, count=n)
+            raw = phys_cols[pos]
+            if isinstance(raw, np.ndarray) and raw.dtype.kind == "S":
+                valid = np.ones(n, dtype=bool)
+                safe = raw
+            else:
+                arr = np.asarray(raw, dtype=object)
+                valid = np.fromiter((v is not None for v in arr), dtype=bool, count=n)
+                safe = np.where(valid, arr, b"") if n else arr
             dic = dicts[pos]
             if n:
-                safe = np.where(valid, arr, b"")
                 uniq, inv = np.unique(safe, return_inverse=True)
-                code_of = np.fromiter((dic.encode(u) for u in uniq), dtype=np.int32, count=len(uniq))
+                code_of = np.fromiter((dic.encode(bytes(u)) for u in uniq), dtype=np.int32, count=len(uniq))
                 data = code_of[inv.reshape(-1)].astype(np.int32, copy=False)
                 data = np.where(valid, data, 0).astype(np.int32, copy=False)
             else:

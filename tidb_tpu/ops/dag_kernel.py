@@ -26,6 +26,7 @@ optimization once SQL-level tolerance plumbing exists.
 
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass
 from typing import Any, Callable
@@ -104,6 +105,17 @@ def _ensure_x64():
     import jax
 
     jax.config.update("jax_enable_x64", True)
+    # persistent XLA compile cache: kernel compiles are the dominant cold-start
+    # cost (tens of seconds per DAG shape through the remote device link);
+    # caching them on disk makes every process after the first start warm
+    cc = os.environ.get("TIDB_TPU_COMPILE_CACHE", "/tmp/tidb_tpu_xla_cache")
+    if cc and not getattr(_ensure_x64, "_cc_done", False):
+        try:
+            jax.config.update("jax_compilation_cache_dir", cc)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:
+            pass  # older jax without the persistent cache: cold compiles only
+        _ensure_x64._cc_done = True
 
 
 def get_kernel(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
@@ -488,9 +500,18 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                         key = -d0 if isf else ~d0
                     sent = -jnp.inf if isf else jnp.iinfo(jnp.int64).min
                     vkey = jnp.where(mask & v, key, sent)
+                    # NOTE: TPU top_k does NOT break value ties by lowest
+                    # index (CPU does) — the exact candidate sort below
+                    # restores index order among retained ties; only a tie
+                    # group overflowing the K-candidate window (>bucket(limit)
+                    # equal keys at the boundary) can pick different rows than
+                    # the host's stable sort, which MySQL leaves unspecified
                     _, idx_val = jax.lax.top_k(vkey, K)
-                    # NULL rows in first-index order (top_k ties break low-index)
-                    _, idx_null = jax.lax.top_k(jnp.where(mask & ~v, 1, 0), K)
+                    # NULL rows deterministically in first-index order: the
+                    # key encodes the (unique) row position, so ties cannot
+                    # arise for the hardware top_k to scramble
+                    pos_n = jnp.arange(cur_n)
+                    _, idx_null = jax.lax.top_k(jnp.where(mask & ~v, -pos_n, jnp.iinfo(jnp.int64).min), K)
                     cand = jnp.concatenate([idx_val, idx_null])
                     # liveness is per-source: a top_k slot past the true count
                     # points at an arbitrary row and must not leak through
@@ -500,7 +521,9 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                     else:  # ASC: NULLs first
                         tier = jnp.concatenate([jnp.ones(K, jnp.int64), jnp.zeros(K, jnp.int64)])
                     ckey = jnp.where(live_c, key[cand], 0)
-                    perm2 = _lex_perm([~live_c, tier, -ckey if isf else ~ckey])
+                    # final lane: global row index — ties come out in scan
+                    # order, matching the host engine's stable sort
+                    perm2 = _lex_perm([~live_c, tier, -ckey if isf else ~ckey, cand])
                     head = cand[perm2[:K]]
                     batch = EvalBatch(
                         [(_bcast(d2, cur_n)[head], _vmask(v2, cur_n)[head]) for d2, v2 in batch.cols],
@@ -538,9 +561,13 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                 kind = "rows"
             elif ex.tp == dagpb.LIMIT:
                 cur_n = batch.n
-                # first `head_n` live rows in index order: top_k over the mask
-                # (ties break toward low indices) — O(n), no full sort
-                _, head = jax.lax.top_k(mask.astype(jnp.int32), min(out_n, cur_n))
+                # first `head_n` live rows in index order — O(n), no full
+                # sort. The key encodes the unique row position (TPU top_k
+                # scrambles ties, so an all-ones mask key would be wrong)
+                _, head = jax.lax.top_k(
+                    jnp.where(mask, -jnp.arange(cur_n), jnp.iinfo(jnp.int64).min),
+                    min(out_n, cur_n),
+                )
                 batch = EvalBatch(
                     [(_bcast(d, cur_n)[head], _vmask(v, cur_n)[head]) for d, v in batch.cols],
                     batch.dicts,
