@@ -208,3 +208,120 @@ def test_sql_mpp_scalar_aggregate_single_table(sqldb):
     s.execute("SET tidb_allow_mpp = 0")
     host = s.execute(q).rows
     assert mpp == host
+
+
+@pytest.fixture()
+def q3db():
+    """Three-table TPC-H Q3 shape: customer ⋈ orders ⋈ lineitem — orders is
+    NON-unique from lineitem's perspective chain and lineitem joins orders on
+    a unique PK while orders→customer fans out (non-unique probe-side chain)."""
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE customer (c_custkey BIGINT PRIMARY KEY, c_mktsegment BIGINT)")
+    d.execute("CREATE TABLE orders (o_orderkey BIGINT PRIMARY KEY, o_custkey BIGINT, o_odate BIGINT)")
+    d.execute("CREATE TABLE lineitem (l_orderkey BIGINT, l_extendedprice DECIMAL(10,2))")
+    import random
+
+    random.seed(11)
+    d.execute("INSERT INTO customer VALUES " + ",".join(f"({i},{i % 3})" for i in range(30)))
+    d.execute(
+        "INSERT INTO orders VALUES "
+        + ",".join(f"({i},{random.randint(0, 29)},{8000 + i % 50})" for i in range(200))
+    )
+    d.execute(
+        "INSERT INTO lineitem VALUES "
+        + ",".join(f"({random.randint(0, 199)},{random.randint(100, 99999) / 100})" for _ in range(1500))
+    )
+    for t in ("customer", "orders", "lineitem"):
+        d.execute(f"ANALYZE TABLE {t}")
+    return d
+
+
+Q3FULL = (
+    "SELECT o_odate, SUM(l_extendedprice) AS rev FROM lineitem"
+    " JOIN orders ON l_orderkey = o_orderkey"
+    " JOIN customer ON o_custkey = c_custkey"
+    " WHERE c_mktsegment = 1 GROUP BY o_odate ORDER BY rev DESC, o_odate LIMIT 10"
+)
+
+
+def test_mpp_two_join_chain_full_q3(q3db):
+    """The full Q3 join tree (2 joins, 3 readers) compiles into one mesh
+    program (ref: fragment trees with multiple exchanges, mpp_exec.go)."""
+    s = q3db.session()
+    lines = "\n".join(r[0] for r in s.execute("EXPLAIN " + Q3FULL).rows)
+    assert "PhysMPPGather" in lines
+    assert lines.count("Join") >= 2
+    mpp = s.execute(Q3FULL).rows
+    s.execute("SET tidb_allow_mpp = 0")
+    host = s.execute(Q3FULL).rows
+    assert mpp == host and len(mpp) == 10
+
+
+def test_mpp_non_unique_build_side(q3db):
+    """Build side with duplicate keys → expansion join (each probe row fans
+    out to its match count), not a host fallback."""
+    q3db.execute("CREATE TABLE tags (okey BIGINT, tag BIGINT)")
+    # duplicate keys: each order key appears 0..3 times
+    import random
+
+    random.seed(3)
+    q3db.execute(
+        "INSERT INTO tags VALUES "
+        + ",".join(f"({random.randint(0, 199)},{i % 7})" for i in range(400))
+    )
+    q3db.execute("ANALYZE TABLE tags")
+    q = (
+        "SELECT tag, COUNT(*), SUM(o_odate) FROM orders JOIN tags ON o_orderkey = okey"
+        " GROUP BY tag ORDER BY tag"
+    )
+    s = q3db.session()
+    lines = "\n".join(r[0] for r in s.execute("EXPLAIN " + q).rows)
+    assert "PhysMPPGather" in lines
+    mpp = s.execute(q).rows
+    s.execute("SET tidb_allow_mpp = 0")
+    host = s.execute(q).rows
+    assert mpp == host and len(mpp) == 7
+
+
+def test_mpp_non_unique_overflow_retry(q3db):
+    """Expansion capacity overflow (forced by data volume: 10k joined rows
+    against the initial per-shard cap) is detected and retried bigger."""
+    q3db.execute("CREATE TABLE dup (k BIGINT, v BIGINT)")
+    q3db.execute("INSERT INTO dup VALUES " + ",".join(f"(7,{i})" for i in range(200)))
+    q3db.execute("CREATE TABLE probe (k BIGINT)")
+    q3db.execute("INSERT INTO probe VALUES " + ",".join("(7)" for _ in range(50)))
+    q3db.execute("ANALYZE TABLE dup")
+    q3db.execute("ANALYZE TABLE probe")
+    # 50 probes × 200 matches = 10k joined rows per shard-set: overflows the
+    # initial per-shard cap and must grow
+    q = "SELECT COUNT(*) FROM probe JOIN dup ON probe.k = dup.k"
+    s = q3db.session()
+    mpp = s.execute(q).rows
+    s.execute("SET tidb_allow_mpp = 0")
+    host = s.execute(q).rows
+    assert mpp == host == [(10000,)]
+
+
+def test_mpp_topn_over_join(q3db):
+    """TopN over a join chain runs per-shard heads inside the fragment (ref:
+    TopN in mpp_exec.go fragments), root-merged."""
+    q = (
+        "SELECT o_odate, l_extendedprice FROM lineitem JOIN orders ON l_orderkey = o_orderkey"
+        " ORDER BY l_extendedprice DESC LIMIT 7"
+    )
+    s = q3db.session()
+    lines = "\n".join(r[0] for r in s.execute("EXPLAIN " + q).rows)
+    assert "PhysMPPGather" in lines and "TopN" in lines
+    mpp = s.execute(q).rows
+    s.execute("SET tidb_allow_mpp = 0")
+    host = s.execute(q).rows
+    assert mpp == host and len(mpp) == 7
+
+
+def test_mpp_limit_over_join(q3db):
+    q = "SELECT o_odate FROM lineitem JOIN orders ON l_orderkey = o_orderkey LIMIT 9"
+    s = q3db.session()
+    mpp = s.execute(q).rows
+    s.execute("SET tidb_allow_mpp = 0")
+    host = s.execute(q).rows
+    assert len(mpp) == len(host) == 9

@@ -1,5 +1,5 @@
-"""MPP gather: plan rewrite + host-side coordinator executing a join+agg
-query as ONE jitted shard_map program over the device mesh.
+"""MPP gather: plan rewrite + host-side coordinator executing a join/agg/
+topN query as ONE jitted shard_map program over the device mesh.
 
 ref: MPPGather (mpp_gather.go:69) + localMppCoordinator
 (local_mpp_coordinator.go) + fragment cutting (fragment.go:48). Redesigned:
@@ -7,10 +7,12 @@ fragments do not travel as gRPC DAGs to per-node engines — the whole
 fragment tree compiles into collectives (all_to_all / all_gather) on the
 mesh's ``dp`` axis (SURVEY §7.7).
 
-Supported shape (the TPC-H star-join core): FinalAgg ← inner equi-join of
-two table readers where the build side is unique on the join key; aggs
-count/sum/avg; any tpu-legal selection/key/arg expressions. Anything else
-stays on the host Volcano path.
+Supported shapes (ref mpp_exec.go:63-1162 executor set):
+- FinalAgg ← left-deep chain of inner equi-joins over table readers
+  (build sides unique OR non-unique — expansion join), aggs count/sum/avg;
+- TopN / Limit ← the same join chains (per-shard heads, root-trimmed);
+- single-table partial agg under tidb_enforce_mpp.
+Anything else stays on the host Volcano path.
 """
 
 from __future__ import annotations
@@ -25,6 +27,8 @@ from tidb_tpu.planner.plans import (
     OutCol,
     PhysFinalAgg,
     PhysHashJoin,
+    PhysLimit,
+    PhysSort,
     PhysTableReader,
     PhysicalPlan,
     Schema,
@@ -38,38 +42,68 @@ _MPP_DEV_CACHE: dict = {}
 
 
 @dataclass
+class MPPJoin:
+    """One join step of a left-deep MPP chain: the accumulated probe side
+    joins build ``reader[i+1]``. ``eq``: [(accumulated schema pos, build
+    reader schema pos)]."""
+
+    eq: list
+    exchange: str = "hash"  # hash | broadcast
+    unique: bool = True
+
+
+@dataclass
 class PhysMPPGather(PhysicalPlan):
     """Root of an MPP task tree (ref: PhysicalTableReader with mpp task root
     + MPPGather executor)."""
 
-    agg: PhysFinalAgg  # group_by/aggs definitions (logical content)
-    left: PhysTableReader
-    right: Optional[PhysTableReader]  # None → single-table MPP agg
-    join_eq: list  # [(left schema pos, right schema pos)]
-    exchange: str = "hash"  # join exchange type: hash | broadcast
+    agg: Optional[PhysFinalAgg]  # None → TopN/limit tail
+    readers: list = field(default_factory=list)
+    joins: list = field(default_factory=list)
+    topn: Optional[tuple] = None  # ([(ColumnRef, desc)], limit)
     schema: Schema = field(default_factory=list)
     children: list = field(default_factory=list)
 
+    # -- compat accessors (EXPLAIN rendering, tests) -----------------------
+    @property
+    def left(self) -> PhysTableReader:
+        return self.readers[0]
+
+    @property
+    def right(self) -> Optional[PhysTableReader]:
+        return self.readers[1] if len(self.readers) > 1 else None
+
+    @property
+    def exchange(self) -> str:
+        return self.joins[0].exchange if self.joins else "hash"
+
     @property
     def fragments(self) -> list[str]:
-        if self.right is None:
-            return [
-                f"Fragment#1 [mpp] {self.left.table.name}: Scan -> Selection -> PartialAgg -> HashExchange",
-                "Fragment#2 [mpp] MergeAgg -> PassThrough(gather)",
-            ]
-        if self.exchange == "broadcast":
-            # probe side stays put; only the build side moves
-            return [
-                f"Fragment#1 [mpp] {self.right.table.name}: Scan -> Selection -> BroadcastExchange",
-                f"Fragment#2 [mpp] {self.left.table.name}: Scan -> Selection -> Join -> PartialAgg -> HashExchange",
-                "Fragment#3 [mpp] MergeAgg -> PassThrough(gather)",
-            ]
-        return [
-            f"Fragment#1 [mpp] {self.left.table.name}: Scan -> Selection -> HashExchange",
-            f"Fragment#2 [mpp] {self.right.table.name}: Scan -> Selection -> HashExchange",
-            "Fragment#3 [mpp] Join -> PartialAgg -> HashExchange",
-            "Fragment#4 [mpp] MergeAgg -> PassThrough(gather)",
-        ]
+        out = []
+        fi = 1
+        if not self.joins:
+            out.append(
+                f"Fragment#{fi} [mpp] {self.readers[0].table.name}: Scan -> Selection -> PartialAgg -> HashExchange"
+            )
+            fi += 1
+        else:
+            probe = self.readers[0].table.name
+            for j, join in enumerate(self.joins):
+                build = self.readers[j + 1].table.name
+                ex = "BroadcastExchange" if join.exchange == "broadcast" else "HashExchange"
+                out.append(f"Fragment#{fi} [mpp] {build}: Scan -> Selection -> {ex}")
+                fi += 1
+            tail = "PartialAgg -> HashExchange" if self.agg is not None else (
+                "TopN" if self.topn and self.topn[0] else "Limit"
+            )
+            joins = " -> ".join("Join" for _ in self.joins)
+            out.append(f"Fragment#{fi} [mpp] {probe}: Scan -> Selection -> {joins} -> {tail}")
+            fi += 1
+        if self.agg is not None:
+            out.append(f"Fragment#{fi} [mpp] MergeAgg -> PassThrough(gather)")
+        else:
+            out.append(f"Fragment#{fi} [mpp] PassThrough(gather) -> root merge")
+        return out
 
 
 def _right_side_unique(reader: PhysTableReader, key_slots: list[int]) -> bool:
@@ -131,58 +165,159 @@ def _choose_exchange(l_rows: int | None, r_rows: int | None, ndev: int) -> str:
     return "hash"
 
 
+def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev):
+    """Left-deep chain of inner equi-joins over MPP-eligible readers →
+    (readers, joins, probe_row_estimate) or None. eq_conds left positions
+    index the child-0 schema, which for a left-deep chain IS the accumulated
+    reader schema, so they carry over unchanged. ``get_ndev`` is lazy: mesh
+    construction (JAX backend init) only happens once a candidate matched."""
+    if isinstance(p, PhysTableReader):
+        if not _reader_mpp_ok(p):
+            return None
+        rows = None
+        if stats is not None:
+            st = stats.get(p.table.id)
+            rows = st.row_count if st is not None else None
+        return ([p], [], rows)
+    if (
+        isinstance(p, PhysHashJoin)
+        and p.kind == "inner"
+        and p.eq_conds
+        and not p.other_conds
+        and len(p.children) == 2
+    ):
+        base = _flatten_join_chain(p.children[0], stats, get_ndev)
+        if base is None:
+            return None
+        r = p.children[1]
+        if not (isinstance(r, PhysTableReader) and _reader_mpp_ok(r)):
+            return None
+        readers, joins, probe_rows = base
+        acc_cols = sum(len(rd.schema) for rd in readers)
+        if any(lp >= acc_cols or rp >= len(r.schema) for lp, rp in p.eq_conds):
+            return None
+        key_slots = [r.schema[rp].slot for _, rp in p.eq_conds]
+        key_types = [r.schema[rp].ftype for _, rp in p.eq_conds]
+        if any(ft.kind == TypeKind.STRING for ft in key_types):
+            return None  # per-table dictionaries: string join keys differ
+        r_rows = None
+        st = stats.get(r.table.id) if stats is not None else None
+        if st is not None:
+            r_rows = st.row_count
+        unique = _right_side_unique(r, key_slots)
+        exchange = _choose_exchange(probe_rows, r_rows, get_ndev())
+        joins = joins + [MPPJoin(eq=list(p.eq_conds), exchange=exchange, unique=unique)]
+        out_rows = probe_rows
+        if not unique and probe_rows is not None and r_rows is not None:
+            # expansion estimate: probe rows × build fan-out (rows per
+            # distinct key when ANALYZE knows the NDV, else a ×2 guess) —
+            # feeds the NEXT join's exchange-cost comparison
+            ndv = None
+            if len(key_slots) == 1 and st is not None:
+                cs = st.cols.get(key_slots[0])
+                ndv = cs.ndv if cs is not None else None
+            fan = max(r_rows // max(ndv, 1), 1) if ndv else 2
+            out_rows = probe_rows * fan
+        return (readers + [r], joins, out_rows)
+    return None
+
+
 def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None) -> PhysicalPlan:
-    """Rewrite eligible FinalAgg-over-join subtrees into PhysMPPGather
-    (ref: the planner preferring mpp task type under tidb_allow_mpp)."""
+    """Rewrite eligible FinalAgg/TopN/Limit-over-join subtrees into
+    PhysMPPGather (ref: the planner preferring mpp task type under
+    tidb_allow_mpp)."""
     if not int(vars.get("tidb_allow_mpp", 1)):
         return plan
     enforce = int(vars.get("tidb_enforce_mpp", 0))
 
-    def walk(p: PhysicalPlan) -> PhysicalPlan:
-        for i, c in enumerate(getattr(p, "children", [])):
-            p.children[i] = walk(c)
-        if not (isinstance(p, PhysFinalAgg) and _agg_mpp_ok(p)):
-            return p
-        child = p.children[0]
-        if (
-            not p.partial_input
-            and isinstance(child, PhysHashJoin)
-            and child.kind == "inner"
-            and child.eq_conds
-            and not child.other_conds
-            and len(child.children) == 2
-            and _reader_mpp_ok(child.children[0])
-            and _reader_mpp_ok(child.children[1])
-        ):
-            lreader, rreader = child.children
-            nleft = len(lreader.schema)
-            key_slots = [rreader.schema[r].slot for _, r in child.eq_conds]
-            key_types = [rreader.schema[r].ftype for _, r in child.eq_conds]
-            if any(ft.kind == TypeKind.STRING for ft in key_types):
-                return p  # per-table dictionaries: string join keys differ
-            if not _right_side_unique(rreader, key_slots):
-                return p
-            l_rows = r_rows = None
-            if stats is not None:
-                lst = stats.get(lreader.table.id)
-                rst = stats.get(rreader.table.id)
-                l_rows = lst.row_count if lst is not None else None
-                r_rows = rst.row_count if rst is not None else None
+    # lazy: mesh construction triggers JAX backend init (seconds of cold
+    # start) — only pay it when a query actually matches an MPP shape
+    _ndev_memo: list = []
+
+    def get_ndev() -> int:
+        if not _ndev_memo:
             from tidb_tpu.parallel import make_mesh
 
             try:
-                ndev = make_mesh().devices.size
+                _ndev_memo.append(make_mesh().devices.size)
             except Exception:
-                ndev = 1
-            exchange = _choose_exchange(l_rows, r_rows, ndev)
-            return PhysMPPGather(
-                agg=p,
-                left=lreader,
-                right=rreader,
-                join_eq=list(child.eq_conds),
-                exchange=exchange,
-                schema=p.schema,
-            )
+                _ndev_memo.append(1)
+        return _ndev_memo[0]
+
+    def walk(p: PhysicalPlan) -> PhysicalPlan:
+        for i, c in enumerate(getattr(p, "children", [])):
+            p.children[i] = walk(c)
+        # TopN/Limit over a join chain: per-shard heads inside the fragment
+        if isinstance(p, PhysLimit):
+            child = p.children[0]
+            total = p.limit + p.offset
+            if isinstance(child, PhysSort):
+                from tidb_tpu.planner.optimizer import _subst_refs
+                from tidb_tpu.planner.plans import PhysProjection
+
+                below = child.children[0]
+                by = list(child.by)
+                host_parent, slot = child, 0
+                # row-preserving projections between Sort and the join chain:
+                # remap sort keys through them into the accumulated schema
+                while isinstance(below, PhysProjection):
+                    remapped = [(_subst_refs(e, below.exprs), d) for e, d in by]
+                    if any(r is None for r, _ in remapped):
+                        below = None
+                        break
+                    by = remapped
+                    host_parent, slot = below, 0
+                    below = below.children[0]
+                flat = _flatten_join_chain(below, stats, get_ndev) if below is not None else None
+                if (
+                    flat is not None
+                    and flat[1]  # single-reader TopN is the coprocessor's job
+                    and total <= 4096
+                    and all(
+                        isinstance(e, ColumnRef) and e.ftype.kind != TypeKind.STRING
+                        for e, _ in by
+                    )
+                ):
+                    readers, joins, _ = flat
+                    gather = PhysMPPGather(
+                        agg=None,
+                        readers=readers,
+                        joins=joins,
+                        topn=(by, total),
+                        schema=below.schema,
+                    )
+                    host_parent.children[slot] = gather
+                    return p
+            else:
+                from tidb_tpu.planner.plans import PhysProjection
+
+                below = child
+                host_parent, slot = p, 0
+                while isinstance(below, PhysProjection):
+                    host_parent, slot = below, 0
+                    below = below.children[0]
+                flat = _flatten_join_chain(below, stats, get_ndev)
+                if flat is not None and flat[1] and total <= 65536:
+                    readers, joins, _ = flat
+                    gather = PhysMPPGather(
+                        agg=None,
+                        readers=readers,
+                        joins=joins,
+                        topn=([], total),
+                        schema=below.schema,
+                    )
+                    host_parent.children[slot] = gather
+                    return p
+        if not (isinstance(p, PhysFinalAgg) and _agg_mpp_ok(p)):
+            return p
+        child = p.children[0]
+        if not p.partial_input:
+            flat = _flatten_join_chain(child, stats, get_ndev)
+            if flat is not None and flat[1]:
+                readers, joins, _ = flat
+                return PhysMPPGather(
+                    agg=p, readers=readers, joins=joins, schema=p.schema
+                )
         if (
             enforce
             and p.partial_input
@@ -210,7 +345,7 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None) -> PhysicalPlan:
                 scan_slots=[s for s in child.scan_slots],
                 schema=scan_schema,
             )
-            return PhysMPPGather(agg=agg, left=reader, right=None, join_eq=[], schema=p.schema)
+            return PhysMPPGather(agg=agg, readers=[reader], joins=[], schema=p.schema)
         return p
 
     return walk(plan)
@@ -232,7 +367,7 @@ def _scan_schema(reader: PhysTableReader) -> Schema:
 
 class MPPGatherExec:
     """Materialize shard inputs, jit the fragment pipeline over the mesh,
-    merge the replicated partials into the final agg chunk."""
+    merge the replicated partials (or gathered heads) into the result chunk."""
 
     def __init__(self, plan: PhysMPPGather, session):
         self.plan = plan
@@ -271,6 +406,29 @@ class MPPGatherExec:
         binder = Binder(cache, reader.table.id, scan_cols)
         return [expr_from_pb(binder.bind_expr(c.to_pb())) for c in reader.pushed_conditions]
 
+    # -- lane layout ---------------------------------------------------------
+    def _lane_maps(self):
+        """Accumulated lane layout: reader k contributes 2*ncols_k+1 lanes
+        (data/valid interleaved + live). Returns (n_lanes per reader,
+        lane_of: schema pos → data lane index in the accumulated layout)."""
+        p = self.plan
+        n_lanes = [2 * len(r.scan_slots) + 1 for r in p.readers]
+        lane_of = []
+        off = 0
+        for r in p.readers:
+            for i in range(len(r.scan_slots)):
+                lane_of.append(off + 2 * i)
+            off += 2 * len(r.scan_slots) + 1
+        return n_lanes, lane_of
+
+    def _col_source(self, pos: int):
+        """(table_id, slot) for accumulated schema position ``pos``."""
+        for r in self.plan.readers:
+            if pos < len(r.schema):
+                return (r.table.id, r.schema[pos].slot)
+            pos -= len(r.schema)
+        return None
+
     def execute(self):
         import jax.numpy as jnp
 
@@ -278,7 +436,8 @@ class MPPGatherExec:
         from tidb_tpu.parallel.mpp import (
             DistAggSpec,
             DistJoinSpec,
-            build_dist_join_agg,
+            DistTopNSpec,
+            build_dist_pipeline,
         )
 
         p = self.plan
@@ -289,8 +448,7 @@ class MPPGatherExec:
             and self.session._read_ts_override is None
             and not float(self.session.vars.get("tidb_read_staleness", 0) or 0)
         )
-        lconds = self._bind_conditions(p.left)
-        rconds = self._bind_conditions(p.right) if p.right is not None else []
+        conds = [self._bind_conditions(r) for r in p.readers]
         agg = p.agg
 
         def pad_side(chunk):
@@ -340,21 +498,19 @@ class MPPGatherExec:
                     _MPP_DEV_CACHE.pop(next(iter(_MPP_DEV_CACHE)))
             return dev
 
-        larrays, n_l = dev_side(p.left)
-        if p.right is not None:
-            rarrays, n_r = dev_side(p.right)
-        else:
-            rarrays, n_r = [], 0
-        ncols_l = len(p.left.scan_slots)
-        ncols_r = len(p.right.scan_slots) if p.right is not None else 0
+        sides = [dev_side(r) for r in p.readers]
+        all_lanes = [a for arrays, _ in sides for a in arrays]
+        nrows = [n for _, n in sides]
+        ncols = [len(r.scan_slots) for r in p.readers]
+        n_lanes, lane_of = self._lane_maps()
 
-        def side_selection(conds, ncols):
+        def side_selection(cond_list, nc):
             def fn(*cols):
-                pairs = [(cols[2 * i], cols[2 * i + 1]) for i in range(ncols)]
-                live = cols[2 * ncols]
-                batch = EvalBatch(pairs, [None] * ncols, pairs[0][0].shape[0])
+                pairs = [(cols[2 * i], cols[2 * i + 1]) for i in range(nc)]
+                live = cols[2 * nc]
+                batch = EvalBatch(pairs, [None] * nc, pairs[0][0].shape[0])
                 m = live
-                for cond in conds:
+                for cond in cond_list:
                     d, v, _ = eval_expr(cond, batch, jnp)
                     keep = jnp.broadcast_to(d != 0, m.shape)
                     if v is not None:
@@ -364,40 +520,15 @@ class MPPGatherExec:
 
             return fn
 
-        # join keys index into the interleaved lane layout
-        left_keys = [2 * l for l, _ in p.join_eq]
-        right_keys = [2 * r for _, r in p.join_eq]
+        selections = [side_selection(conds[i], ncols[i]) for i in range(len(p.readers))]
 
-        lsel = side_selection(lconds, ncols_l)
-        # join keys must be non-NULL to match (inner-join semantics)
-        base_lsel = lsel
-
-        def lsel_with_keys(*cols):
-            m = base_lsel(*cols)
-            for l, _ in p.join_eq:
-                m = m & cols[2 * l + 1]
-            return m
-
-        rsel = None
-        if p.right is not None:
-            rsel0 = side_selection(rconds, ncols_r)
-
-            def rsel(*cols):
-                m = rsel0(*cols)
-                for _, r in p.join_eq:
-                    m = m & cols[2 * r + 1]
-                return m
-
-        # agg input mapping over the joined lane layout
-        n_left_lanes = 2 * ncols_l + 1
-        joined_pairs_n = ncols_l + ncols_r
+        # agg input mapping over the accumulated lane layout
+        total_cols = sum(len(r.schema) for r in p.readers)
 
         def agg_inputs(joined):
-            # joined = left lanes (incl live) + gathered right lanes
-            pairs = [(joined[2 * i], joined[2 * i + 1]) for i in range(ncols_l)]
-            off = n_left_lanes
-            for i in range(ncols_r):
-                pairs.append((joined[off + 2 * i], joined[off + 2 * i + 1]))
+            pairs = [
+                (joined[lane_of[i]], joined[lane_of[i] + 1]) for i in range(total_cols)
+            ]
             batch = EvalBatch(pairs, [None] * len(pairs), pairs[0][0].shape[0])
             out = []
             if not agg.group_by:
@@ -424,77 +555,117 @@ class MPPGatherExec:
                 out.append(v.astype(jnp.int64))
             return out
 
-        n_group_lanes = 2 * len(agg.group_by) if agg.group_by else 2
-        sums_idx = list(range(n_group_lanes, n_group_lanes + 2 * sum(1 for a in agg.aggs if a.arg is not None)))
-        group_cap = self._initial_group_cap(n_l)
-        # per-side receive capacity: each side sized from ITS row count — the
-        # build (dimension) side must not inherit the probe side's padding
-        l_row_cap = max(2 * ((max(n_l, 1) + ndev - 1) // ndev), 64)
-        r_row_cap = max(2 * ((max(n_r, 1) + ndev - 1) // ndev), 64)
+        # per-join capacities: per-side receive capacity from ITS row count;
+        # expansion capacity from the probe row count with 2× headroom
+        shard = lambda n: max(2 * ((max(n, 1) + ndev - 1) // ndev), 64)
+        probe_cap = shard(nrows[0])
+        join_specs = []
+        for ji, join in enumerate(p.joins):
+            build_cap = shard(nrows[ji + 1])
+            lane_eq_l = [lane_of[lp] for lp, _ in join.eq]
+            # build reader's local lanes
+            lane_eq_r = [2 * rp for _, rp in join.eq]
+            join_specs.append(
+                DistJoinSpec(
+                    left_keys=lane_eq_l,
+                    right_keys=lane_eq_r,
+                    exchange=join.exchange,
+                    left_row_cap=probe_cap,
+                    right_row_cap=build_cap,
+                    unique=join.unique,
+                    out_cap=max(_pow2(probe_cap), 1024),
+                )
+            )
+            if not join.unique:
+                probe_cap = join_specs[-1].out_cap
+
+        # rebase left_keys of later joins: after join ji the accumulated lane
+        # layout = probe lanes + build lanes — lane_of already accounts for
+        # this because it is computed over the full reader list
+        # key-NULL masking: inner-join keys must be non-NULL to match
+        for ji, spec in enumerate(join_specs):
+            spec.left_key_valid = tuple(k + 1 for k in spec.left_keys)
+            spec.right_key_valid = tuple(k + 1 for k in spec.right_keys)
+
+        group_cap = self._initial_group_cap(nrows[0]) if agg is not None else 0
+        if agg is not None:
+            nk = 2 * len(agg.group_by) if agg.group_by else 2
+            sums_idx = list(range(nk, nk + 2 * sum(1 for a in agg.aggs if a.arg is not None)))
         while True:
-            spec = DistAggSpec(n_keys=n_group_lanes, sums=sums_idx, group_cap=group_cap)
-            join_spec = None
-            if p.right is not None:
-                join_spec = DistJoinSpec(
-                    left_keys=left_keys,
-                    right_keys=right_keys,
-                    exchange=p.exchange,
-                    left_row_cap=l_row_cap,
-                    right_row_cap=r_row_cap,
+            spec = (
+                DistAggSpec(n_keys=nk, sums=sums_idx, group_cap=group_cap)
+                if agg is not None
+                else None
+            )
+            topn_spec = None
+            if agg is None:
+                by, limit = p.topn
+                order = [
+                    (lane_of[e.index], lane_of[e.index] + 1, desc) for e, desc in by
+                ]
+                out_lanes = [(lane_of[i], lane_of[i] + 1) for i in range(total_cols)]
+                # a per-shard head of `limit` rows is ALWAYS sufficient — for
+                # plain LIMIT any `limit` live rows do, for TopN the per-shard
+                # best `limit` rows form a global-topN superset — so the head
+                # size is fixed and this path can never overflow-loop
+                topn_spec = DistTopNSpec(
+                    order=order,
+                    limit=_pow2(limit),
+                    out_lanes=out_lanes,
+                    out_cap=max(_pow2(limit), 1024),
                 )
             # compile cache: the jitted shard_map program is pure structure —
             # keyed on specs + bound-condition fingerprints, NOT data. Without
             # this every query pays a full XLA mesh compile (~10s+ on TPU).
             fn_key = (
                 id(mesh),
-                repr(join_spec),
+                repr(join_specs),
                 repr(spec),
-                n_left_lanes,
-                (2 * ncols_r + 1) if p.right is not None else 0,
-                repr([c.to_pb() for c in lconds]),
-                repr([c.to_pb() for c in rconds]),
-                p.exchange,
-                tuple(left_keys),
-                tuple(right_keys),
-                repr([g.to_pb() for g in agg.group_by]),
-                repr([a.to_pb() for a in agg.aggs]),
-                ncols_l,
-                ncols_r,
+                repr(topn_spec),
+                tuple(n_lanes),
+                tuple(repr([c.to_pb() for c in cl]) for cl in conds),
+                repr([g.to_pb() for g in agg.group_by]) if agg is not None else "",
+                repr([a.to_pb() for a in agg.aggs]) if agg is not None else "",
+                tuple(ncols),
             )
             fn = _MPP_FN_CACHE.get(fn_key)
             if fn is None:
-                fn = build_dist_join_agg(
+                fn = build_dist_pipeline(
                     mesh,
-                    join_spec,
+                    join_specs,
                     spec,
-                    n_left=n_left_lanes,
-                    n_right=(2 * ncols_r + 1) if p.right is not None else 0,
-                    left_selection=lsel_with_keys if p.right is not None else lsel,
-                    right_selection=rsel,
-                    agg_inputs=agg_inputs,
+                    n_lanes=n_lanes,
+                    selections=selections,
+                    agg_inputs=agg_inputs if agg is not None else None,
+                    topn=topn_spec,
                 )
                 _MPP_FN_CACHE[fn_key] = fn
                 while len(_MPP_FN_CACHE) > 64:
                     _MPP_FN_CACHE.pop(next(iter(_MPP_FN_CACHE)))
-            outs = fn(*(list(larrays) + list(rarrays)))
+            outs = fn(*all_lanes)
             # ONE device→host round trip for every output lane: device_get
             # batches the whole tuple into a single transfer
             import jax
 
             arrs = list(jax.device_get(outs))
             dropped = int(arrs[-2])
-            group_overflow = int(arrs[-1])
-            if dropped == 0 and group_overflow == 0:
+            overflow = int(arrs[-1])
+            if dropped == 0 and overflow == 0:
                 break
             # grow-on-overflow, like coprocessor paging (skewed owners can
-            # exceed either side's 2× headroom; the drop counter is shared,
-            # so grow both)
+            # exceed either side's 2× headroom; the counters are shared, so
+            # grow everything that can overflow)
             if dropped:
-                l_row_cap *= 4
-                r_row_cap *= 4
-            if group_overflow:
+                for s in join_specs:
+                    s.left_row_cap *= 4
+                    s.right_row_cap *= 4
+            if overflow:
                 group_cap *= 4
-        return self._merge(arrs[:-2], agg)
+                for s in join_specs:
+                    s.out_cap *= 4
+        if agg is not None:
+            return self._merge(arrs[:-2], agg)
+        return self._rows_chunk(arrs[:-2])
 
     def _initial_group_cap(self, n_left_rows: int) -> int:
         """Static per-shard group capacity: NDV-product estimate with a
@@ -510,7 +681,7 @@ class MPPGatherExec:
             if not isinstance(g, ColumnRef):
                 est *= 64
                 continue
-            src = self._group_source(gi)
+            src = self._col_source(g.index)
             ndv = None
             if src is not None and stats is not None:
                 st = stats.get(src[0])
@@ -521,6 +692,32 @@ class MPPGatherExec:
         if have:
             return max(_pow2(min(2 * est, 1 << 16)), 64)
         return max(_pow2(min(n_left_rows + 1, 1 << 16)), 256)
+
+    def _rows_chunk(self, arrs):
+        """Gathered TopN/limit head lanes → rows chunk (live-filtered); the
+        root Sort/Limit above re-sorts and trims the candidate union."""
+        from tidb_tpu.copr.colcache import cache_for
+        from tidb_tpu.utils.chunk import Chunk, Column
+
+        cache = cache_for(self.session.store)
+        total_cols = len(self.schema)
+        live = np.asarray(arrs[2 * total_cols]).astype(bool)
+        cols = []
+        for i, oc in enumerate(self.schema):
+            data = np.asarray(arrs[2 * i])[live]
+            valid = np.asarray(arrs[2 * i + 1])[live].astype(bool)
+            dic = None
+            if oc.ftype.kind == TypeKind.STRING:
+                src = self._col_source(i)
+                if src is not None:
+                    dic = cache.dictionary(*src)
+                data = data.astype(np.int32)
+            elif oc.ftype.kind == TypeKind.FLOAT:
+                data = data.astype(np.float64)
+            else:
+                data = data.astype(np.int64)
+            cols.append(Column(data, valid, oc.ftype, dic))
+        return Chunk(cols)
 
     def _merge(self, outs, agg: PhysFinalAgg):
         """Replicated (group lanes…, sum lanes…, count) → final agg chunk via
@@ -559,23 +756,13 @@ class MPPGatherExec:
             kvalid = arrs[2 * gi + 1][live].astype(bool)
             dic = None
             if g.ftype.kind == TypeKind.STRING and isinstance(g, ColumnRef):
-                src = self._group_source(gi)
+                src = self._col_source(g.index)
                 if src is not None:
                     dic = cache.dictionary(*src)
             dt = np.float64 if g.ftype.kind == TypeKind.FLOAT else (np.int32 if g.ftype.kind == TypeKind.STRING else np.int64)
             cols.append(Column(kdata.astype(dt), kvalid, g.ftype, dic))
         chunk = Chunk(cols)
         return merge_partials(chunk, agg.aggs, len(agg.group_by))
-
-    def _group_source(self, gi: int):
-        """(table_id, slot) whose dictionary a string group key uses."""
-        g = self.plan.agg.group_by[gi]
-        nleft = len(self.plan.left.schema)
-        if g.index < nleft:
-            return (self.plan.left.table.id, self.plan.left.schema[g.index].slot)
-        if self.plan.right is not None:
-            return (self.plan.right.table.id, self.plan.right.schema[g.index - nleft].slot)
-        return None
 
 
 def _pow2(n: int) -> int:

@@ -117,19 +117,21 @@ def build_dist_agg(mesh, spec: DistAggSpec, selection: Callable | None = None):
 
 @dataclass
 class DistJoinSpec:
-    """A distributed equi-join between two sharded tables (ref: the MPP
+    """A distributed equi-join between two sharded sides (ref: the MPP
     shuffle/broadcast hash join, mpp_exec.go join + exchange senders).
 
     ``left_keys``/``right_keys``: column indices of the join keys (int
-    lanes). The right (build) side must be unique on its key — the
-    dimension-table shape every TPC-H-style star join has; the planner
-    falls back to the host join otherwise.
+    lanes) — left indices address the accumulated probe-side lane layout,
+    right indices the build reader's local lanes.
     ``exchange``: "hash" (both sides shuffled by key owner — all_to_all) or
     "broadcast" (right side replicated — all_gather).
     ``row_cap``: static per-destination receive capacity for hash exchange
     (overflow is reported, never silently dropped on the result path);
     ``left_row_cap``/``right_row_cap`` size the two sides independently —
-    a small build side must not inherit the probe side's capacity."""
+    a small build side must not inherit the probe side's capacity.
+    ``unique``: build side proven unique on the key (PK/unique index) →
+    match-gather probe, no expansion. Otherwise the join expands each probe
+    row to its match count, bounded by ``out_cap`` (overflow retried)."""
 
     left_keys: Sequence[int]
     right_keys: Sequence[int]
@@ -137,6 +139,12 @@ class DistJoinSpec:
     row_cap: int = 4096
     left_row_cap: int | None = None
     right_row_cap: int | None = None
+    unique: bool = True
+    out_cap: int = 8192
+    # validity lanes of the join keys: inner-join keys must be non-NULL to
+    # match (NULL data slots hold 0, which would otherwise equal a real 0)
+    left_key_valid: Sequence[int] = ()
+    right_key_valid: Sequence[int] = ()
 
 
 def _combine_keys(jnp, keys):
@@ -215,79 +223,188 @@ def _local_unique_join(jax, jnp, lkey, lkeys, lvalid, rkey, rkeys, rcols, rvalid
     return gathered, match
 
 
-def build_dist_join_agg(
-    mesh,
-    join: DistJoinSpec | None,
-    agg: DistAggSpec,
-    *,
-    n_left: int,
-    n_right: int = 0,
-    left_selection: Callable | None = None,
-    right_selection: Callable | None = None,
-    agg_inputs: Callable | None = None,
-):
-    """The canonical MPP pipeline in ONE jitted shard_map (ref: §3.3 —
-    fragments: scan→sel→[exchange]→join→partial agg→hash exchange→merge→
-    gather; fragment boundaries are collectives on the ``dp`` axis).
+def _sorted_bounds(jnp, rk_s, lkey):
+    """For each probe key: (lo, hi) = [count of sorted build keys < key,
+    count ≤ key), via two sort-merges (see _sorted_lookup for why not
+    searchsorted on TPU). Match count per probe row = hi - lo."""
+    m = rk_s.shape[0]
+    np_ = lkey.shape[0]
+    # hi: ties put build rows first → cum counts build rows <= key
+    perm1 = jnp.argsort(jnp.concatenate([rk_s, lkey]), stable=True)
+    inv1 = jnp.argsort(perm1)
+    hi = jnp.cumsum(jnp.where(perm1 < m, 1, 0))[inv1[m:]]
+    # lo: ties put probe rows first → cum counts build rows < key
+    perm2 = jnp.argsort(jnp.concatenate([lkey, rk_s]), stable=True)
+    inv2 = jnp.argsort(perm2)
+    lo = jnp.cumsum(jnp.where(perm2 >= np_, 1, 0))[inv2[:np_]]
+    return lo, hi
 
-    Inputs: ``n_left`` sharded left columns then ``n_right`` sharded right
-    columns. ``agg_inputs(joined_cols) -> cols`` maps the joined row
-    (left cols + gathered right cols) to the agg input layout
-    (``agg.n_keys`` keys first, then value columns; defaults to identity).
-    Returns replicated (keys..., sums..., count, total, dropped).
-    """
+
+def _local_expand_join(jax, jnp, lkey, lkeys, lvalid, rkey, rkeys, rcols, rvalid, lcols, out_cap):
+    """Per-shard equi-join with a NON-unique build side: each probe row
+    expands to its match count. Output is ``out_cap`` static slots; slot j
+    maps back to (probe row, match ordinal) through a cumsum of per-probe
+    match counts — pure gathers, no scatter (TPU policy). Returns
+    (probe-lane outputs, build-lane outputs, live, overflow)."""
+    big = jnp.int64(2**62)
+    rperm = jnp.argsort(jnp.where(rvalid, rkey, big), stable=True)
+    rk_s = jnp.where(rvalid, rkey, big)[rperm]
+    pkey = jnp.where(lvalid, lkey, big - 1)  # dead probes match nothing
+    lo, hi = _sorted_bounds(jnp, rk_s, pkey)
+    cnt = jnp.where(lvalid, hi - lo, 0)
+    cum = jnp.cumsum(cnt)
+    total = cum[-1] if cnt.shape[0] else jnp.int64(0)
+    overflow = jnp.maximum(total - out_cap, 0)
+    j = jnp.arange(out_cap)
+    p = jnp.searchsorted(cum, j, side="right")  # out_cap queries over n probes
+    p_c = jnp.clip(p, 0, cnt.shape[0] - 1)
+    base = jnp.where(p_c > 0, cum[jnp.maximum(p_c - 1, 0)], 0)
+    ridx = jnp.clip(lo[p_c] + (j - base), 0, rk_s.shape[0] - 1)
+    live = (j < total) & lvalid[p_c] & rvalid[rperm][ridx]
+    # exact component verification: a mixed-key collision inside [lo, hi)
+    # kills the slot rather than fabricating a joined row
+    for lcomp, rcomp in zip(lkeys, rkeys):
+        live &= rcomp[rperm][ridx] == lcomp[p_c]
+    out_left = [lc[p_c] for lc in lcols]
+    out_right = [rc[rperm][ridx] for rc in rcols]
+    return out_left, out_right, live, overflow
+
+
+@dataclass
+class DistTopNSpec:
+    """Per-shard TopN/Limit/row-gather tail over the joined lane layout.
+
+    ``order``: [(lane index, valid lane index, desc)] — empty = plain
+    limit/row gather. ``limit``: static per-shard output rows (None for
+    row-gather, sized by ``out_cap``). ``out_lanes``: (data lane, valid lane)
+    pairs to emit. The root re-sorts/trims the gathered candidate union, so
+    per-shard heads are a superset protocol like coprocessor TopN tasks."""
+
+    order: Sequence[tuple]
+    limit: int | None
+    out_lanes: Sequence[tuple]
+    out_cap: int = 4096
+
+
+def build_dist_pipeline(
+    mesh,
+    joins: Sequence[DistJoinSpec],
+    agg: DistAggSpec | None,
+    *,
+    n_lanes: Sequence[int],
+    selections: Sequence[Callable | None],
+    agg_inputs: Callable | None = None,
+    topn: "DistTopNSpec | None" = None,
+):
+    """The generalized MPP pipeline in ONE jitted shard_map (ref: §3.3 —
+    fragments: scan→sel→[exchange→join]*→(partial agg→hash exchange→merge |
+    topN/limit)→gather; fragment boundaries are collectives on ``dp``).
+
+    Inputs: reader 0's ``n_lanes[0]`` sharded lanes, then reader 1's, ... A
+    left-deep join chain folds each build reader into the accumulated probe
+    lane layout (probe lanes + gathered build lanes per join). The tail is
+    either the two-phase agg (``agg`` + ``agg_inputs``) or a per-shard
+    TopN/limit head (``topn``).
+
+    Agg returns replicated (keys..., sums..., count, total, dropped,
+    overflow); TopN returns (out lanes..., live, count, total, dropped,
+    overflow)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     ndev = mesh.devices.size
-    cap = agg.group_cap
+    cap = agg.group_cap if agg is not None else 0
+    n_readers = len(n_lanes)
+    offs = [sum(n_lanes[:i]) for i in range(n_readers + 1)]
 
     def step(*cols):
-        lcols = list(cols[:n_left])
-        rcols = list(cols[n_left : n_left + n_right])
-        lvalid = jnp.ones(lcols[0].shape[0], dtype=bool)
-        if left_selection is not None:
-            lvalid = left_selection(*lcols)
-        if join is None:
-            # no-join pipeline: scan → selection → two-phase agg
-            joined, mask = lcols, lvalid
-            dropped = jnp.int64(0)
-            return _agg_tail(joined, mask, dropped)
-        rvalid = jnp.ones(rcols[0].shape[0], dtype=bool)
-        if right_selection is not None:
-            rvalid = right_selection(*rcols)
-        lkeys = [lcols[i] for i in join.left_keys]
-        rkeys = [rcols[i] for i in join.right_keys]
-        lkey = _combine_keys(jnp, lkeys)
-        rkey = _combine_keys(jnp, rkeys)
+        acc = list(cols[offs[0] : offs[1]])
+        mask = jnp.ones(acc[0].shape[0], dtype=bool)
+        if selections[0] is not None:
+            mask = selections[0](*acc)
         dropped = jnp.int64(0)
-        if join.exchange == "hash":
-            lowner = jnp.abs(lkey) % ndev
-            rowner = jnp.abs(rkey) % ndev
-            lcap = join.left_row_cap or join.row_cap
-            rcap = join.right_row_cap or join.row_cap
-            lcols2, lvalid, d1 = _route_rows(jax, jnp, lcols, lvalid, lowner, ndev, lcap)
-            rcols2, rvalid, d2 = _route_rows(jax, jnp, rcols, rvalid, rowner, ndev, rcap)
-            dropped = d1 + d2
-            lcols, rcols = lcols2, rcols2
-            lkeys = [lcols[i] for i in join.left_keys]
+        overflow = jnp.int64(0)
+        for ji, join in enumerate(joins):
+            rcols = list(cols[offs[ji + 1] : offs[ji + 2]])
+            rvalid = jnp.ones(rcols[0].shape[0], dtype=bool)
+            if selections[ji + 1] is not None:
+                rvalid = selections[ji + 1](*rcols)
+            for vl in join.left_key_valid:
+                mask = mask & acc[vl].astype(bool)
+            for vl in join.right_key_valid:
+                rvalid = rvalid & rcols[vl].astype(bool)
+            lkeys = [acc[i] for i in join.left_keys]
             rkeys = [rcols[i] for i in join.right_keys]
             lkey = _combine_keys(jnp, lkeys)
             rkey = _combine_keys(jnp, rkeys)
-        else:  # broadcast: replicate the build side on every shard
-            rcols = [jax.lax.all_gather(c, "dp").reshape(-1) for c in rcols]
-            rvalid = jax.lax.all_gather(rvalid, "dp").reshape(-1)
-            rkeys = [rcols[i] for i in join.right_keys]
-            rkey = _combine_keys(jnp, rkeys)
-        gathered, match = _local_unique_join(jax, jnp, lkey, lkeys, lvalid, rkey, rkeys, rcols, rvalid)
-        joined = lcols + gathered
-        return _agg_tail(joined, match, dropped)
+            if join.exchange == "hash":
+                lowner = jnp.abs(lkey) % ndev
+                rowner = jnp.abs(rkey) % ndev
+                lcap = join.left_row_cap or join.row_cap
+                rcap = join.right_row_cap or join.row_cap
+                acc, mask, d1 = _route_rows(jax, jnp, acc, mask, lowner, ndev, lcap)
+                rcols, rvalid, d2 = _route_rows(jax, jnp, rcols, rvalid, rowner, ndev, rcap)
+                dropped = dropped + d1 + d2
+                lkeys = [acc[i] for i in join.left_keys]
+                rkeys = [rcols[i] for i in join.right_keys]
+                lkey = _combine_keys(jnp, lkeys)
+                rkey = _combine_keys(jnp, rkeys)
+            else:  # broadcast: replicate the build side on every shard
+                rcols = [jax.lax.all_gather(c, "dp").reshape(-1) for c in rcols]
+                rvalid = jax.lax.all_gather(rvalid, "dp").reshape(-1)
+                rkeys = [rcols[i] for i in join.right_keys]
+                rkey = _combine_keys(jnp, rkeys)
+            if join.unique:
+                gathered, mask = _local_unique_join(
+                    jax, jnp, lkey, lkeys, mask, rkey, rkeys, rcols, rvalid
+                )
+                acc = acc + gathered
+            else:
+                out_l, out_r, mask, of = _local_expand_join(
+                    jax, jnp, lkey, lkeys, mask, rkey, rkeys, rcols, rvalid, acc, join.out_cap
+                )
+                overflow = overflow + of
+                acc = out_l + out_r
+        if agg is not None:
+            return _agg_tail(acc, mask, dropped, overflow)
+        return _topn_tail(acc, mask, dropped, overflow)
 
-    def _agg_tail(joined, mask, dropped):
-        import jax
-        import jax.numpy as jnp
+    def _topn_tail(joined, mask, dropped, overflow):
+        n = mask.shape[0]
+        lanes = [~mask]
+        for di, vi, desc in topn.order:
+            d = joined[di]
+            v = joined[vi].astype(bool) if vi is not None else jnp.ones(n, bool)
+            if desc:
+                lanes.append(~v)  # NULLs last
+                dd = jnp.where(v, d, 0)
+                lanes.append(-dd if jnp.issubdtype(dd.dtype, jnp.floating) else ~dd)
+            else:
+                lanes.append(v)  # NULLs first
+                lanes.append(jnp.where(v, d, 0))
+        perm = jnp.argsort(lanes[-1], stable=True) if len(lanes) > 1 else jnp.argsort(lanes[0], stable=True)
+        for lane in reversed(lanes[:-1] if len(lanes) > 1 else []):
+            perm = perm[jnp.argsort(lane[perm], stable=True)]
+        out_n = min(topn.limit if topn.limit is not None else topn.out_cap, n)
+        head = perm[:out_n]
+        cnt = mask.sum()
+        if topn.limit is None:
+            # plain row gather: exceeding the static cap is an overflow (the
+            # runner retries bigger); TopN heads are supersets by protocol
+            overflow = overflow + jnp.maximum(cnt - out_n, 0)
+        outs = []
+        for di, vi in topn.out_lanes:
+            outs.append(jax.lax.all_gather(joined[di][head], "dp").reshape(-1))
+            v = joined[vi][head] if vi is not None else jnp.ones(out_n, jnp.int64)
+            outs.append(jax.lax.all_gather(v, "dp").reshape(-1))
+        glive = jax.lax.all_gather(mask[perm][:out_n], "dp").reshape(-1)
+        total = jax.lax.psum(cnt, "dp")
+        gdropped = jax.lax.psum(dropped, "dp")
+        goverflow = jax.lax.psum(overflow, "dp")
+        return (*outs, glive, total, gdropped, goverflow)
 
+    def _agg_tail(joined, mask, dropped, overflow):
         acols = agg_inputs(joined) if agg_inputs is not None else joined
         keys = list(acols[: agg.n_keys])
         vals = [acols[i] for i in agg.sums]
@@ -318,17 +435,53 @@ def build_dist_join_agg(
         gcnt = jax.lax.all_gather(msums_cnt[-1], "dp").reshape(ndev * cap)
         total = jax.lax.psum(mask.sum(), "dp")
         gdropped = jax.lax.psum(dropped, "dp")
-        goverflow = jax.lax.psum(of1 + of_slots + of3, "dp")
+        goverflow = jax.lax.psum(overflow + of1 + of_slots + of3, "dp")
         return (*gkeys, *gsums, gcnt, total, gdropped, goverflow)
 
+    if agg is not None:
+        n_rep = agg.n_keys + len(agg.sums) + 1
+    else:
+        n_rep = 2 * len(topn.out_lanes) + 1
     fn = jax.shard_map(
         step,
         mesh=mesh,
-        in_specs=tuple(P("dp") for _ in range(n_left + n_right)),
-        out_specs=(P(None),) * (agg.n_keys + len(agg.sums) + 1) + (P(), P(), P()),
+        in_specs=tuple(P("dp") for _ in range(sum(n_lanes))),
+        out_specs=(P(None),) * n_rep + (P(), P(), P()),
         check_vma=False,
     )
     return jax.jit(fn)
+
+
+def build_dist_join_agg(
+    mesh,
+    join: DistJoinSpec | None,
+    agg: DistAggSpec,
+    *,
+    n_left: int,
+    n_right: int = 0,
+    left_selection: Callable | None = None,
+    right_selection: Callable | None = None,
+    agg_inputs: Callable | None = None,
+):
+    """Single-join (or no-join) agg pipeline — the common star-join shape,
+    kept as a thin wrapper over :func:`build_dist_pipeline`."""
+    if join is None:
+        return build_dist_pipeline(
+            mesh,
+            [],
+            agg,
+            n_lanes=[n_left],
+            selections=[left_selection],
+            agg_inputs=agg_inputs,
+        )
+    return build_dist_pipeline(
+        mesh,
+        [join],
+        agg,
+        n_lanes=[n_left, n_right],
+        selections=[left_selection, right_selection],
+        agg_inputs=agg_inputs,
+    )
 
 
 def finalize_dist_agg(outs, n_keys: int, n_sums: int):

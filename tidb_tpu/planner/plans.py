@@ -418,11 +418,15 @@ def explain_plan(p, indent: int = 0, stats=None) -> str:
     from tidb_tpu.parallel.gather import PhysMPPGather
 
     if isinstance(p, PhysMPPGather):
-        extra = f"{len(p.fragments)} fragments, {p.exchange} join exchange" if p.right is not None else f"{len(p.fragments)} fragments"
+        if p.joins:
+            ex = ",".join(j.exchange for j in p.joins)
+            extra = f"{len(p.fragments)} fragments, {ex} join exchange"
+        else:
+            extra = f"{len(p.fragments)} fragments"
         lines = [f"{pad}{name} {extra}{_info(p)}"]
         for fr in p.fragments:
             lines.append(f"{pad}  {fr}")
-        for r in [p.left] + ([p.right] if p.right is not None else []):
+        for r in p.readers:
             lines.append(explain_plan(r, indent + 1, stats))
         return "\n".join(lines)
     lines = [f"{pad}{name} {extra}".rstrip() + _info(p)]
