@@ -1,0 +1,250 @@
+"""Everyday-SQL builtin surface (round-2 expansion; ref builtin_time*.go,
+builtin_string*.go, aggregation variance/bit/group_concat): host/tpu parity
+where both engines implement a function, host-only correctness otherwise."""
+
+import datetime
+import math
+
+import pytest
+
+import tidb_tpu
+
+
+@pytest.fixture()
+def db():
+    d = tidb_tpu.open()
+    d.execute(
+        "CREATE TABLE t (id BIGINT PRIMARY KEY, s VARCHAR(32), n BIGINT,"
+        " dec DECIMAL(10,2), dt DATE, ts DATETIME, du TIME)"
+    )
+    d.execute(
+        "INSERT INTO t VALUES"
+        " (1, '  pad  ', 7, 1.50, '2024-03-05', '2024-03-05 14:30:45', '10:30:00'),"
+        " (2, 'xyzzy', 12, 2.25, '2023-12-31', '2023-12-31 23:59:59', '-01:15:30'),"
+        " (3, 'abc', 5, 0.75, '2024-01-01', '2024-01-01 00:00:00', '99:00:01'),"
+        " (4, NULL, NULL, NULL, NULL, NULL, NULL)"
+    )
+    return d
+
+
+def both(db, sql):
+    s = db.session()
+    out = {}
+    for eng in ("tpu", "host"):
+        s.execute(f"SET tidb_isolation_read_engines = '{eng}'")
+        out[eng] = s.query(sql)
+    assert out["tpu"] == out["host"], sql
+    return out["host"]
+
+
+def test_datediff_parity(db):
+    rows = both(db, "SELECT id, DATEDIFF(dt, '2024-01-01') FROM t ORDER BY id")
+    assert rows == [(1, 64), (2, -1), (3, 0), (4, None)]
+
+
+def test_calendar_functions(db):
+    rows = both(
+        db,
+        "SELECT DAYOFYEAR(dt), WEEKDAY(dt), WEEK(dt), TO_DAYS(dt) FROM t WHERE id = 1",
+    )
+    d = datetime.date(2024, 3, 5)
+    # WEEK mode 0 == strftime %U (Sunday-start, week 0 before first Sunday)
+    assert rows == [(65, d.weekday(), int(d.strftime("%U")), d.toordinal() + 365)]
+
+
+def test_last_day_and_date(db):
+    rows = both(db, "SELECT LAST_DAY(dt), DATE(ts) FROM t WHERE id = 2")
+    assert rows == [(datetime.date(2023, 12, 31), datetime.date(2023, 12, 31))]
+
+
+def test_unix_timestamp_roundtrip(db):
+    rows = both(db, "SELECT UNIX_TIMESTAMP(ts), FROM_UNIXTIME(UNIX_TIMESTAMP(ts)) FROM t WHERE id = 3")
+    assert rows == [(datetime.datetime(2024, 1, 1).replace(tzinfo=datetime.timezone.utc).timestamp(), datetime.datetime(2024, 1, 1))]
+
+
+def test_duration_arithmetic(db):
+    rows = both(
+        db,
+        "SELECT TIME_TO_SEC(du), SEC_TO_TIME(90), ADDTIME(du, '00:30:00'), TIMEDIFF(du, '00:30:00') FROM t WHERE id = 1",
+    )
+    assert rows == [
+        (
+            37800,
+            datetime.timedelta(seconds=90),
+            datetime.timedelta(hours=11),
+            datetime.timedelta(hours=10),
+        )
+    ]
+    # negative durations keep MySQL truncate-toward-zero seconds
+    assert both(db, "SELECT TIME_TO_SEC(du) FROM t WHERE id = 2") == [(-4530,)]
+    # TIME values beyond 24h survive storage and comparison
+    assert both(db, "SELECT id FROM t WHERE du > '98:59:59'") == [(3,)]
+
+
+def test_maketime_and_duration_compare(db):
+    rows = both(db, "SELECT MAKETIME(2, 30, 0) FROM t WHERE id = 1")
+    assert rows == [(datetime.timedelta(hours=2, minutes=30),)]
+
+
+def test_date_format():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE f (ts DATETIME)")
+    d.execute("INSERT INTO f VALUES ('2024-03-05 14:30:45')")
+    (row,) = d.query(
+        "SELECT DATE_FORMAT(ts, '%Y-%m-%d %H:%i:%s'), DATE_FORMAT(ts, '%W %M %D, %y'),"
+        " DATE_FORMAT(ts, '%h:%i %p'), DATE_FORMAT(ts, '%j') FROM f"
+    )
+    assert row == ("2024-03-05 14:30:45", "Tuesday March 5th, 24", "02:30 PM", "065")
+
+
+def test_str_to_date():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE f (s VARCHAR(40))")
+    d.execute("INSERT INTO f VALUES ('05/03/2024'), ('bogus')")
+    rows = d.query("SELECT STR_TO_DATE(s, '%d/%m/%Y') FROM f")
+    assert rows == [(datetime.date(2024, 3, 5),), (None,)]
+    rows = d.query("SELECT STR_TO_DATE('2024-03-05 14:30:45', '%Y-%m-%d %T') FROM f WHERE s = 'bogus'")
+    assert rows == [(datetime.datetime(2024, 3, 5, 14, 30, 45),)]
+    rows = d.query("SELECT STR_TO_DATE('March 5 2024', '%M %e %Y') FROM f WHERE s = 'bogus'")
+    assert rows == [(datetime.date(2024, 3, 5),)]
+
+
+def test_monthname_dayname(db):
+    rows = both(db, "SELECT MONTHNAME(dt), DAYNAME(dt) FROM t WHERE id = 1")
+    assert rows == [("March", "Tuesday")]
+
+
+def test_trim_family(db):
+    rows = db.query(
+        "SELECT TRIM(s), LTRIM(s), RTRIM(s), TRIM(BOTH 'x' FROM 'xxaxx'),"
+        " TRIM(LEADING 'x' FROM 'xxaxx'), TRIM(TRAILING 'x' FROM 'xxaxx'),"
+        " TRIM('y' FROM 'yyby') FROM t WHERE id = 1"
+    )
+    assert rows == [("pad", "pad  ", "  pad", "a", "axx", "xxa", "b")]
+
+
+def test_string_functions(db):
+    rows = db.query(
+        "SELECT REPLACE(s, 'z', 'q'), LOCATE('zz', s), INSTR(s, 'yz'), LPAD(s, 7, '*'),"
+        " RPAD(s, 7, '*'), LEFT(s, 2), RIGHT(s, 2), REPEAT(s, 2), REVERSE(s),"
+        " ASCII(s), STRCMP(s, 'xyzzy') FROM t WHERE id = 2"
+    )
+    assert rows == [("xyqqy", 3, 2, "**xyzzy", "xyzzy**", "xy", "zy", "xyzzyxyzzy", "yzzyx", 120, 0)]
+    assert db.query("SELECT CONCAT_WS('-', 'a', NULL, 'b') FROM t WHERE id = 1") == [("a-b",)]
+    assert db.query("SELECT LPAD('ab', -1, 'x') FROM t WHERE id = 1") == [(None,)]
+
+
+def test_variance_family_parity(db):
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE v (g BIGINT, x BIGINT, dx DECIMAL(8,2))")
+    d.execute(
+        "INSERT INTO v VALUES (1,2,1.00),(1,4,2.00),(1,6,3.00),(2,10,5.00),(2,10,5.00),(3,7,NULL)"
+    )
+    s = d.session()
+    out = {}
+    for eng in ("tpu", "host"):
+        s.execute(f"SET tidb_isolation_read_engines = '{eng}'")
+        out[eng] = s.query(
+            "SELECT g, VAR_POP(x), VAR_SAMP(x), STDDEV_POP(x), STDDEV_SAMP(x), VARIANCE(dx)"
+            " FROM v GROUP BY g ORDER BY g"
+        )
+    assert out["tpu"] == out["host"]
+    g1 = out["host"][0]
+    assert g1[1] == pytest.approx(8 / 3)
+    assert g1[2] == pytest.approx(4.0)
+    assert g1[3] == pytest.approx(math.sqrt(8 / 3))
+    assert g1[4] == pytest.approx(2.0)
+    assert g1[5] == pytest.approx(2 / 3)
+    # sample variance of a single row is NULL
+    g3 = out["host"][2]
+    assert g3[2] is None and g3[4] is None and g3[1] == 0.0
+
+
+def test_bit_aggs_parity():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE b (g BIGINT, x BIGINT)")
+    d.execute("INSERT INTO b VALUES (1,6),(1,3),(2,8),(2,NULL),(3,NULL)")
+    s = d.session()
+    out = {}
+    for eng in ("tpu", "host"):
+        s.execute(f"SET tidb_isolation_read_engines = '{eng}'")
+        out[eng] = s.query(
+            "SELECT g, BIT_AND(x), BIT_OR(x), BIT_XOR(x) FROM b GROUP BY g ORDER BY g"
+        )
+    assert out["tpu"] == out["host"]
+    # BIT_* are BIGINT UNSIGNED: the empty-group BIT_AND identity is all ones
+    assert out["host"] == [(1, 2, 7, 5), (2, 8, 8, 8), (3, 18446744073709551615, 0, 0)]
+
+
+def test_group_concat():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE gc (g BIGINT, s VARCHAR(8), n DECIMAL(6,2))")
+    d.execute("INSERT INTO gc VALUES (1,'a',1.50),(1,'b',2.00),(2,'c',3.25),(1,NULL,NULL)")
+    assert d.query("SELECT g, GROUP_CONCAT(s) FROM gc GROUP BY g ORDER BY g") == [
+        (1, "a,b"),
+        (2, "c"),
+    ]
+    assert d.query("SELECT g, GROUP_CONCAT(s SEPARATOR ' | ') FROM gc GROUP BY g ORDER BY g") == [
+        (1, "a | b"),
+        (2, "c"),
+    ]
+    assert d.query("SELECT GROUP_CONCAT(n) FROM gc WHERE g = 1") == [("1.50,2.00",)]
+    # multi-region: group_concat stays a root aggregate (no partial push)
+    lines = "\n".join(r[0] for r in d.query("EXPLAIN SELECT g, GROUP_CONCAT(s) FROM gc GROUP BY g"))
+    assert "PartialAgg" not in lines
+
+
+def test_week_boundary_parity(db):
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE w (dt DATE)")
+    # Jan 1 on a Sunday (2023) vs mid-week (2024) vs Dec 31
+    d.execute("INSERT INTO w VALUES ('2023-01-01'), ('2024-01-01'), ('2024-12-31'), ('2023-01-08')")
+    s = d.session()
+    out = {}
+    for eng in ("tpu", "host"):
+        s.execute(f"SET tidb_isolation_read_engines = '{eng}'")
+        out[eng] = s.query("SELECT dt, WEEK(dt) FROM w ORDER BY dt")
+    assert out["tpu"] == out["host"]
+    got = {str(r[0]): r[1] for r in out["host"]}
+    assert got == {"2023-01-01": 1, "2023-01-08": 2, "2024-01-01": 0, "2024-12-31": 52}
+
+
+def test_order_by_group_expression():
+    """ORDER BY a GROUP BY *expression* (not a bare column) resolves against
+    the aggregation (regression: previously 'Unknown column')."""
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE og (dt DATE, v BIGINT)")
+    d.execute(
+        "INSERT INTO og VALUES ('2023-06-01',1),('2024-01-15',2),('2024-07-04',3),('2023-02-02',4)"
+    )
+    rows = d.query("SELECT YEAR(dt), SUM(v) FROM og GROUP BY YEAR(dt) ORDER BY YEAR(dt)")
+    assert rows == [(2023, 5), (2024, 5)]
+    rows = d.query("SELECT YEAR(dt), SUM(v) FROM og GROUP BY YEAR(dt) ORDER BY YEAR(dt) DESC")
+    assert rows == [(2024, 5), (2023, 5)]
+    # expressions over the group key work too
+    rows = d.query("SELECT YEAR(dt) FROM og GROUP BY YEAR(dt) ORDER BY YEAR(dt) + 0 DESC")
+    assert rows == [(2024,), (2023,)]
+
+
+def test_review_fixes():
+    """Regressions from review: two-sided time coercion, per-row LOCATE pos,
+    ISO WEEKOFYEAR, distinct separators, multi-arg GROUP_CONCAT."""
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE r (id BIGINT PRIMARY KEY, s VARCHAR(16))")
+    d.execute("INSERT INTO r VALUES (1, 'banana'), (3, 'bananas')")
+    assert d.query("SELECT ADDTIME('10:00:00', '01:00:00') FROM r WHERE id = 1") == [
+        (datetime.timedelta(hours=11),)
+    ]
+    assert d.query("SELECT TIMEDIFF('10:00:00', '09:00:00') FROM r WHERE id = 1") == [
+        (datetime.timedelta(hours=1),)
+    ]
+    # per-row position argument
+    assert d.query("SELECT id, LOCATE('an', s, id) FROM r ORDER BY id") == [(1, 2), (3, 4)]
+    # WEEKOFYEAR is ISO (week 1 contains the first Thursday); WEEK takes modes
+    assert d.query("SELECT WEEKOFYEAR('2026-01-01'), WEEK('2026-01-01'), WEEK('2026-01-01', 3) FROM r WHERE id=1") == [
+        (1, 0, 1)
+    ]
+    assert d.query(
+        "SELECT GROUP_CONCAT(s SEPARATOR '-'), GROUP_CONCAT(s SEPARATOR '+') FROM r"
+    ) == [("banana-bananas", "banana+bananas")]
+    assert d.query("SELECT GROUP_CONCAT(id, s) FROM r") == [("1banana,3bananas",)]

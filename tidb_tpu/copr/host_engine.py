@@ -32,7 +32,7 @@ from tidb_tpu.kv import KeyRange, tablecodec
 from tidb_tpu.kv.memstore import MemStore, Region
 from tidb_tpu.kv.rowcodec import RowSchema
 from tidb_tpu.types import FieldType, TypeKind
-from tidb_tpu.types.field_type import bigint_type
+from tidb_tpu.types.field_type import bigint_type, double_type
 from tidb_tpu.utils.chunk import Chunk, Column, Dictionary
 
 
@@ -192,7 +192,27 @@ def _segment_reduce(op: str, data: np.ndarray, valid: np.ndarray, seg: np.ndarra
         b[1:] = seg[1:] != seg[:-1]
         first_idx[seg[b]] = np.nonzero(b)[0]
         return data[first_idx], valid[first_idx].astype(np.int64) * np.maximum(cnt, 1)
+    if op == "sumsq":
+        # variance accumulates in double (int64 squares overflow; MySQL
+        # computes VAR/STDDEV in double regardless of the argument type)
+        d = data.astype(np.float64)
+        s = np.bincount(seg, weights=np.where(valid, d * d, 0.0), minlength=ngroups)
+        return s, cnt
+    if op in ("bit_and", "bit_or", "bit_xor"):
+        return bit_reduce(op, data, valid, seg, ngroups), cnt
     raise ValueError(op)
+
+
+def bit_reduce(op: str, data: np.ndarray, valid: np.ndarray, seg: np.ndarray, ngroups: int) -> np.ndarray:
+    """Segmented bitwise reduction with MySQL identities (AND → all ones);
+    NULL rows reduce as the identity. Shared by the cop engine and the
+    partial merge in the executor."""
+    ident = -1 if op == "bit_and" else 0
+    out = np.full(ngroups, ident, dtype=np.int64)
+    d = np.where(valid, data, ident).astype(np.int64)
+    ufn = {"bit_and": np.bitwise_and, "bit_or": np.bitwise_or, "bit_xor": np.bitwise_xor}[op]
+    ufn.at(out, seg, d)
+    return out
 
 
 def _aggregate(chunk: Chunk, ex: dagpb.ExecutorPB) -> Chunk:
@@ -238,6 +258,14 @@ def _aggregate(chunk: Chunk, ex: dagpb.ExecutorPB) -> Chunk:
                 res, cnt = _segment_reduce(kind, data, valid, seg_a, ngroups)
                 sentinel_ok = cnt > 0 if kind != "first_row" else (cnt > 0)
                 out_cols.append(Column(res.astype(data.dtype), sentinel_ok, aft, adic))
+            elif kind == "sumsq":
+                res, cnt = _segment_reduce("sumsq", data, valid, seg_a, ngroups)
+                out_cols.append(Column(res, cnt > 0, double_type()))
+            elif kind in ("bit_and", "bit_or", "bit_xor"):
+                res, cnt = _segment_reduce(kind, data, valid, seg_a, ngroups)
+                out_cols.append(Column(res, np.ones(ngroups, bool), bigint_type(nullable=False)))
+            elif kind == "group_concat":
+                out_cols.append(_group_concat_col(a, data, valid, seg_a, ngroups, aft, adic))
     for gc in gcols:
         first, cnt = _segment_reduce("first_row", gc.data[perm], gc.validity[perm], seg, ngroups)
         out_cols.append(Column(first.astype(gc.data.dtype), cnt > 0, gc.ftype, gc.dictionary))
@@ -245,6 +273,33 @@ def _aggregate(chunk: Chunk, ex: dagpb.ExecutorPB) -> Chunk:
     if ex.agg_mode in (dagpb.AGG_COMPLETE,):
         result = finalize_agg(result, aggs, [g.ftype for g in gcols], [g.dictionary for g in gcols])
     return result
+
+
+def _group_concat_col(a: AggDesc, data, valid, seg, ngroups: int, aft, adic) -> Column:
+    """GROUP_CONCAT: per-group string join in row order (MySQL default —
+    no ORDER BY inside the call; ref builtin group_concat)."""
+    from tidb_tpu.types.field_type import string_type
+    from tidb_tpu.utils.chunk import Dictionary
+    from tidb_tpu.types.datum import format_physical
+
+    def fmt(x) -> bytes:
+        if aft.kind == TypeKind.STRING:
+            return adic.decode(int(x)) if adic is not None else str(int(x)).encode()
+        return format_physical(x, aft)
+
+    sep = a.sep.encode() if isinstance(a.sep, str) else a.sep
+    parts: list[list[bytes]] = [[] for _ in range(ngroups)]
+    for i in range(len(data)):
+        if valid[i]:
+            parts[int(seg[i])].append(fmt(data[i]))
+    dic = Dictionary()
+    out = np.zeros(ngroups, dtype=np.int32)
+    ok = np.zeros(ngroups, dtype=bool)
+    for g in range(ngroups):
+        if parts[g]:
+            out[g] = dic.encode(sep.join(parts[g]))
+            ok[g] = True
+    return Column(out, ok, string_type(), dic)
 
 
 def finalize_agg(partial: Chunk, aggs: list[AggDesc], group_fts: list[FieldType], group_dicts: list) -> Chunk:
@@ -266,6 +321,25 @@ def finalize_agg(partial: Chunk, aggs: list[AggDesc], group_fts: list[FieldType]
                 out.append(Column(q, cnt.data > 0, ft))
             else:
                 out.append(Column(s.data / denom, cnt.data > 0, ft))
+        elif a.name in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
+            cnt, s, sq = cols[i], cols[i + 1], cols[i + 2]
+            i += 3
+            n = cnt.data.astype(np.float64)
+            scale = 10.0 ** a.arg.ftype.scale if a.arg.ftype.kind == TypeKind.DECIMAL else 1.0
+            sv = s.data.astype(np.float64) / scale
+            sqv = sq.data / (scale * scale)
+            mean = sv / np.maximum(n, 1)
+            varp = np.maximum(sqv / np.maximum(n, 1) - mean * mean, 0.0)
+            if a.name.endswith("_samp"):
+                # sample variance: n/(n-1) correction; NULL when n < 2
+                v = varp * n / np.maximum(n - 1, 1)
+                ok = cnt.data > 1
+            else:
+                v = varp
+                ok = cnt.data > 0
+            if a.name.startswith("stddev"):
+                v = np.sqrt(v)
+            out.append(Column(v, ok, a.ftype))
         else:
             c = cols[i]
             i += 1

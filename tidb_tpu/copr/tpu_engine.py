@@ -423,6 +423,12 @@ def output_ftypes(dag: dagpb.DAGRequest) -> list[FieldType]:
                             out.append(bigint_type(nullable=False))
                         elif pk == "sum":
                             out.append(AggDesc("sum", a.arg).ftype)
+                        elif pk == "sumsq":
+                            from tidb_tpu.types.field_type import double_type
+
+                            out.append(double_type())
+                        elif pk in ("bit_and", "bit_or", "bit_xor"):
+                            out.append(bigint_type(nullable=False))
                         else:
                             out.append(a.arg.ftype if a.arg is not None else bigint_type())
             for g in ex.group_by:

@@ -525,6 +525,21 @@ def merge_partials(chunk: Chunk, aggs: list[AggDesc], ngroup: int) -> Chunk:
                     out[seg[firsts]] = data[firsts]
                     anyv[seg[firsts]] = valid[firsts]
                 state_cols.append(Column(out, anyv, c.ftype, c.dictionary))
+            elif pk == "sumsq":
+                # partial sums of squares (double) merge by addition
+                out = np.bincount(seg, weights=np.where(valid, data, 0.0), minlength=ngroups)
+                anyv = np.zeros(ngroups, dtype=bool)
+                np.logical_or.at(anyv, seg, valid)
+                state_cols.append(Column(out, anyv, c.ftype))
+            elif pk in ("bit_and", "bit_or", "bit_xor"):
+                from tidb_tpu.copr.host_engine import bit_reduce
+
+                out = bit_reduce(pk, data, valid, seg, ngroups)
+                state_cols.append(Column(out, np.ones(ngroups, bool), c.ftype))
+            elif pk == "group_concat":
+                # group_concat never pushes partials (planner gate); merging
+                # would need value-order metadata the lanes don't carry
+                raise ValueError("group_concat cannot merge as a partial aggregate")
     # key outputs: value at first row of each group
     out_keys: list[Column] = []
     if ngroup and n:

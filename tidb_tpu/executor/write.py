@@ -74,6 +74,10 @@ def to_physical(v, ftype) -> object:
     if k == TypeKind.UINT:
         v = int(v)
         return v - (1 << 64) if v >= 1 << 63 else v
+    if k == TypeKind.DURATION and not isinstance(v, (int, np.integer)):
+        from tidb_tpu.types.datum import duration_to_micros
+
+        return duration_to_micros(v)
     return int(v)
 
 

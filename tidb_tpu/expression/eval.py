@@ -587,7 +587,11 @@ def _cast_decimal(xp, args, ctx):
 
 
 def _civil_from_days(xp, days):
-    z = days + 719468
+    # int32 throughout: calendar day counts fit comfortably, and 64-bit
+    # integer division is emulated on TPU (each i64 div compiles to a large
+    # multiword sequence — a chain of them made WEEK()-style expressions
+    # take minutes to compile); 32-bit division lowers natively
+    z = xp.asarray(days + 719468).astype(xp.int32)
     era = z // 146097
     doe = z - era * 146097
     yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
@@ -717,6 +721,8 @@ def _cast_string(xp, args, ctx):
     if t.kind == TypeKind.STRING:
         strs, _ = _decode_strs(ctx, 0)
         return _encode_strs(ctx, [_trunc(s) for s in strs])
+    from tidb_tpu.types.datum import format_physical
+
     (d, v) = args[0]
     n = len(d) if hasattr(d, "__len__") else ctx.n
     out = []
@@ -725,20 +731,7 @@ def _cast_string(xp, args, ctx):
             out.append(None)
             continue
         x = d if not hasattr(d, "__len__") else d[k]
-        if t.kind == TypeKind.DECIMAL and t.scale > 0:
-            iv = int(x)
-            sign = "-" if iv < 0 else ""
-            iv = abs(iv)
-            s = f"{sign}{iv // 10**t.scale}.{iv % 10**t.scale:0{t.scale}d}"
-        elif t.kind == TypeKind.FLOAT:
-            s = repr(float(x))
-        elif t.kind == TypeKind.DATE:
-            s = str(days_to_date(int(x)))
-        elif t.kind == TypeKind.DATETIME:
-            s = str(micros_to_datetime(int(x)))
-        else:
-            s = str(int(x))
-        out.append(_trunc(s.encode() if isinstance(s, str) else s))
+        out.append(_trunc(format_physical(x, t)))
     return _encode_strs(ctx, out)
 
 
@@ -953,4 +946,628 @@ def _json_type(xp, args, ctx):
             out.append(names.get(type(_json.loads(s)), b"UNKNOWN"))
         except Exception:
             out.append(None)
+    return _encode_strs(ctx, out)
+
+
+# ---------------------------------------------------------------------------
+# everyday date/time surface (ref: builtin_time*.go). Pure integer calendar
+# math stays device-legal; string formatting is host-only.
+# ---------------------------------------------------------------------------
+
+
+def _days_from_civil(xp, y, m, d):
+    """Inverse of _civil_from_days (Howard Hinnant's civil_from_days).
+    int32 math — see _civil_from_days for why."""
+    y = xp.asarray(y).astype(xp.int32) - (m <= 2)
+    era = xp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = xp.asarray(m).astype(xp.int32) + xp.where(m > 2, -3, 9)
+    doy = (153 * mp + 2) // 5 + xp.asarray(d).astype(xp.int32) - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _to_days_any(xp, ctx, i):
+    (d, v) = ctx.args[i]
+    if ctx.arg_types[i].kind == TypeKind.DATETIME:
+        d = d // 86_400_000_000
+    return d, v
+
+
+@register("datediff", lambda args: bigint_type())
+def _datediff(xp, args, ctx):
+    da, va = _to_days_any(xp, ctx, 0)
+    db, vb = _to_days_any(xp, ctx, 1)
+    return da - db, and_valid(xp, va, vb)
+
+
+@register("to_days", lambda args: bigint_type(), arity=1)
+def _to_days(xp, args, ctx):
+    d, v = _to_days_any(xp, ctx, 0)
+    return d + 719528, v  # MySQL day 0 = year 0000-01-01 (proleptic)
+
+
+@register("dayofyear", lambda args: bigint_type(), arity=1)
+def _dayofyear(xp, args, ctx):
+    d, v = _to_days_any(xp, ctx, 0)
+    y, _, _ = _civil_from_days(xp, d)
+    jan1 = _days_from_civil(xp, y, 1 + 0 * y, 1 + 0 * y)
+    return d - jan1 + 1, v
+
+
+@register("weekday", lambda args: bigint_type(), arity=1)
+def _weekday(xp, args, ctx):
+    d, v = _to_days_any(xp, ctx, 0)
+    # 1970-01-01 is a Thursday; MySQL WEEKDAY: 0=Monday
+    return (d + 3) % 7, v
+
+
+def _iso_week(xp, d):
+    """ISO 8601 week number (MySQL WEEK mode 3): Monday start, week 1 is the
+    week containing the year's first Thursday."""
+    dow = (d + 3) % 7  # 0=Monday
+    thursday = d - dow + 3
+    ty, _, _ = _civil_from_days(xp, thursday)
+    jan1 = _days_from_civil(xp, ty, 1 + 0 * ty, 1 + 0 * ty)
+    return (thursday - jan1) // 7 + 1
+
+
+@register("week", lambda args: bigint_type(), variadic=True, arity=1)
+def _week(xp, args, ctx):
+    """WEEK(date[, mode]) — mode 0 (MySQL default: Sunday start, week 0
+    before the first Sunday), mode 1 (Monday start, week 1 if ≥4 days), and
+    mode 3 (ISO). Other modes fall back to their base behavior (0↔2, 1↔3
+    differ only in how week 0 renders, not in the split points)."""
+    d, v = _to_days_any(xp, ctx, 0)
+    mode = 0
+    if len(args) > 1:
+        m0 = args[1][0]
+        mode = int(m0 if not hasattr(m0, "__len__") else m0[0]) & 7
+    if mode in (1, 3):
+        w = _iso_week(xp, d)
+        if mode == 1:
+            # mode 1 counts days before ISO week 1 as week 0 of this year,
+            # where ISO rolls them into last year's week 52/53
+            y, _, _ = _civil_from_days(xp, d)
+            ty, _, _ = _civil_from_days(xp, d - ((d + 3) % 7) + 3)
+            w = xp.where(ty < y, 0, w)
+        return w, v
+    y, _, _ = _civil_from_days(xp, d)
+    jan1 = _days_from_civil(xp, y, 1 + 0 * y, 1 + 0 * y)
+    doy0 = d - jan1  # 0-based day of year
+    jan1_dow = (jan1 + 4) % 7  # 0=Sunday
+    first_sunday = (7 - jan1_dow) % 7
+    w = xp.where(doy0 < first_sunday, 0, (doy0 - first_sunday) // 7 + 1)
+    return w, v
+
+
+@register("weekofyear", lambda args: bigint_type(), arity=1)
+def _weekofyear(xp, args, ctx):
+    """WEEKOFYEAR = WEEK(date, 3) — the ISO week number."""
+    d, v = _to_days_any(xp, ctx, 0)
+    return _iso_week(xp, d), v
+
+
+@register("last_day", lambda args: args[0], arity=1)
+def _last_day(xp, args, ctx):
+    d, v = _to_days_any(xp, ctx, 0)
+    y, m, _ = _civil_from_days(xp, d)
+    ny = xp.where(m == 12, y + 1, y)
+    nm = xp.where(m == 12, 1, m + 1)
+    out = _days_from_civil(xp, ny, nm, 1 + 0 * ny) - 1
+    if ctx.arg_types[0].kind == TypeKind.DATETIME:
+        out = out * 86_400_000_000
+    return out, v
+
+
+@register("date", lambda args: FieldType(TypeKind.DATE, nullable=args[0].nullable), arity=1)
+def _date(xp, args, ctx):
+    d, v = _to_days_any(xp, ctx, 0)
+    return d, v
+
+
+@register("unix_timestamp", lambda args: bigint_type(), arity=1)
+def _unix_timestamp(xp, args, ctx):
+    (d, v) = args[0]
+    if ctx.arg_types[0].kind == TypeKind.DATE:
+        return d * 86_400, v
+    return d // 1_000_000, v
+
+
+@register("from_unixtime", lambda args: FieldType(TypeKind.DATETIME, nullable=args[0].nullable), arity=1)
+def _from_unixtime(xp, args, ctx):
+    (d, v) = args[0]
+    return d * 1_000_000, v
+
+
+@register("time_to_sec", lambda args: bigint_type(), arity=1)
+def _time_to_sec(xp, args, ctx):
+    (d, v) = args[0]
+    return xp.sign(d) * (xp.abs(d) // 1_000_000), v
+
+
+@register("sec_to_time", lambda args: FieldType(TypeKind.DURATION, nullable=args[0].nullable), arity=1)
+def _sec_to_time(xp, args, ctx):
+    (d, v) = args[0]
+    return d * 1_000_000, v
+
+
+@register("maketime", lambda args: FieldType(TypeKind.DURATION), variadic=True, arity=3)
+def _maketime(xp, args, ctx):
+    (h, vh), (m, vm), (s, vs) = args
+    us = (xp.abs(h) * 3600 + m * 60 + s) * 1_000_000
+    return xp.where(h < 0, -us, us), and_valid(xp, vh, vm, vs)
+
+
+@register("addtime", infer_first)
+def _addtime(xp, args, ctx):
+    (da, va), (db, vb) = args
+    return da + db, and_valid(xp, va, vb)
+
+
+@register("subtime", infer_first)
+def _subtime(xp, args, ctx):
+    (da, va), (db, vb) = args
+    return da - db, and_valid(xp, va, vb)
+
+
+@register("timediff", lambda args: FieldType(TypeKind.DURATION), arity=2)
+def _timediff(xp, args, ctx):
+    (da, va), (db, vb) = args
+    # both args share a kind (parser coerces); DATETIME/DURATION both carry
+    # microseconds, so the difference is already a duration
+    return da - db, and_valid(xp, va, vb)
+
+
+_MONTH_NAMES = [b"January", b"February", b"March", b"April", b"May", b"June", b"July",
+                b"August", b"September", b"October", b"November", b"December"]
+_DAY_NAMES = [b"Monday", b"Tuesday", b"Wednesday", b"Thursday", b"Friday", b"Saturday", b"Sunday"]
+
+
+def _py_civil(days: int):
+    from tidb_tpu.types.datum import days_to_date
+
+    return days_to_date(days)
+
+
+@register("monthname", lambda args: string_type(), engines=HOST_ONLY, arity=1)
+def _monthname(xp, args, ctx):
+    d, v = _to_days_any(xp, ctx, 0)
+    out = []
+    n = len(d) if hasattr(d, "__len__") else ctx.n
+    for k in range(n):
+        ok = v is None or v is True or (v if isinstance(v, bool) else v[k])
+        out.append(_MONTH_NAMES[_py_civil(int(d if not hasattr(d, "__len__") else d[k])).month - 1] if ok else None)
+    return _encode_strs(ctx, out)
+
+
+@register("dayname", lambda args: string_type(), engines=HOST_ONLY, arity=1)
+def _dayname(xp, args, ctx):
+    d, v = _to_days_any(xp, ctx, 0)
+    out = []
+    n = len(d) if hasattr(d, "__len__") else ctx.n
+    for k in range(n):
+        ok = v is None or v is True or (v if isinstance(v, bool) else v[k])
+        out.append(_DAY_NAMES[_py_civil(int(d if not hasattr(d, "__len__") else d[k])).weekday()] if ok else None)
+    return _encode_strs(ctx, out)
+
+
+def _format_one(dt, fmt: bytes) -> bytes:
+    """MySQL DATE_FORMAT specifiers over a python datetime."""
+    out = []
+    i = 0
+    s = fmt.decode("utf-8", "surrogateescape")
+    H = dt.hour
+    h12 = H % 12 or 12
+    while i < len(s):
+        c = s[i]
+        if c != "%" or i + 1 >= len(s):
+            out.append(c)
+            i += 1
+            continue
+        sp = s[i + 1]
+        i += 2
+        if sp == "Y":
+            out.append(f"{dt.year:04d}")
+        elif sp == "y":
+            out.append(f"{dt.year % 100:02d}")
+        elif sp == "m":
+            out.append(f"{dt.month:02d}")
+        elif sp == "c":
+            out.append(str(dt.month))
+        elif sp == "d":
+            out.append(f"{dt.day:02d}")
+        elif sp == "e":
+            out.append(str(dt.day))
+        elif sp == "H":
+            out.append(f"{H:02d}")
+        elif sp == "k":
+            out.append(str(H))
+        elif sp == "h" or sp == "I":
+            out.append(f"{h12:02d}")
+        elif sp == "l":
+            out.append(str(h12))
+        elif sp == "i":
+            out.append(f"{dt.minute:02d}")
+        elif sp == "s" or sp == "S":
+            out.append(f"{dt.second:02d}")
+        elif sp == "f":
+            out.append(f"{dt.microsecond:06d}")
+        elif sp == "p":
+            out.append("AM" if H < 12 else "PM")
+        elif sp == "M":
+            out.append(_MONTH_NAMES[dt.month - 1].decode())
+        elif sp == "b":
+            out.append(_MONTH_NAMES[dt.month - 1].decode()[:3])
+        elif sp == "W":
+            out.append(_DAY_NAMES[dt.weekday()].decode())
+        elif sp == "a":
+            out.append(_DAY_NAMES[dt.weekday()].decode()[:3])
+        elif sp == "j":
+            out.append(f"{dt.timetuple().tm_yday:03d}")
+        elif sp == "r":
+            out.append(f"{h12:02d}:{dt.minute:02d}:{dt.second:02d} {'AM' if H < 12 else 'PM'}")
+        elif sp == "T":
+            out.append(f"{H:02d}:{dt.minute:02d}:{dt.second:02d}")
+        elif sp == "D":
+            d = dt.day
+            suf = "th" if 11 <= d % 100 <= 13 else {1: "st", 2: "nd", 3: "rd"}.get(d % 10, "th")
+            out.append(f"{d}{suf}")
+        elif sp == "%":
+            out.append("%")
+        else:
+            out.append(sp)
+    return "".join(out).encode()
+
+
+@register("date_format", lambda args: string_type(), engines=HOST_ONLY)
+def _date_format(xp, args, ctx):
+    from tidb_tpu.types.datum import days_to_date, micros_to_datetime
+    import datetime as _dt
+
+    (d, v) = args[0]
+    fmts, _ = _decode_strs(ctx, 1)
+    is_dt = ctx.arg_types[0].kind == TypeKind.DATETIME
+    out = []
+    n = len(d) if hasattr(d, "__len__") else ctx.n
+    for k in range(n):
+        ok = v is None or v is True or (v if isinstance(v, bool) else v[k])
+        fmt = fmts[k if len(fmts) > 1 else 0]
+        if not ok or fmt is None:
+            out.append(None)
+            continue
+        x = int(d if not hasattr(d, "__len__") else d[k])
+        dt = micros_to_datetime(x) if is_dt else _dt.datetime.combine(days_to_date(x), _dt.time())
+        out.append(_format_one(dt, fmt))
+    return _encode_strs(ctx, out)
+
+
+_STR_TO_DATE_PAT = {
+    "Y": r"(?P<Y>\d{4})", "y": r"(?P<y>\d{1,2})", "m": r"(?P<m>\d{1,2})",
+    "c": r"(?P<m>\d{1,2})", "d": r"(?P<d>\d{1,2})", "e": r"(?P<d>\d{1,2})",
+    "H": r"(?P<H>\d{1,2})", "k": r"(?P<H>\d{1,2})", "h": r"(?P<I>\d{1,2})",
+    "l": r"(?P<I>\d{1,2})", "i": r"(?P<M>\d{1,2})", "s": r"(?P<S>\d{1,2})",
+    "S": r"(?P<S>\d{1,2})", "f": r"(?P<f>\d{1,6})", "p": r"(?P<p>[AP]M)",
+    "M": r"(?P<Mn>[A-Za-z]+)", "b": r"(?P<Mb>[A-Za-z]{3})", "j": r"(?P<j>\d{1,3})",
+}
+
+
+def str_to_date_has_time(fmt: str) -> bool:
+    i = 0
+    while i < len(fmt) - 1:
+        if fmt[i] == "%" and fmt[i + 1] in "HkhlisSfprT":
+            return True
+        i += 2 if fmt[i] == "%" else 1
+    return False
+
+
+@register("str_to_date", lambda args: FieldType(TypeKind.DATETIME, nullable=True), engines=HOST_ONLY)
+def _str_to_date(xp, args, ctx):
+    import re
+    import datetime as _dt
+
+    from tidb_tpu.types.datum import date_to_days, datetime_to_micros
+
+    strs, _ = _decode_strs(ctx, 0)
+    fmts, _ = _decode_strs(ctx, 1)
+    want_date = ctx.ret_type.kind == TypeKind.DATE
+    import numpy as np
+
+    data = np.zeros(len(strs), dtype=np.int64)
+    valid = np.ones(len(strs), dtype=bool)
+    pat_cache: dict = {}
+    for k, s in enumerate(strs):
+        fmt = fmts[k if len(fmts) > 1 else 0]
+        if s is None or fmt is None:
+            valid[k] = False
+            continue
+        f = fmt.decode("utf-8", "surrogateescape")
+        rx = pat_cache.get(f)
+        if rx is None:
+            parts = []
+            i = 0
+            while i < len(f):
+                if f[i] == "%" and i + 1 < len(f):
+                    sp = f[i + 1]
+                    if sp == "T":
+                        parts.append(r"(?P<H>\d{1,2}):(?P<M>\d{1,2}):(?P<S>\d{1,2})")
+                    elif sp == "r":
+                        parts.append(r"(?P<I>\d{1,2}):(?P<M>\d{1,2}):(?P<S>\d{1,2}) (?P<p>[AP]M)")
+                    elif sp == "%":
+                        parts.append("%")
+                    else:
+                        parts.append(_STR_TO_DATE_PAT.get(sp, re.escape(sp)))
+                    i += 2
+                else:
+                    parts.append(re.escape(f[i]))
+                    i += 1
+            rx = pat_cache[f] = re.compile("^" + "".join(parts) + r"\s*$")
+        m = rx.match(s.decode("utf-8", "surrogateescape").strip())
+        if not m:
+            valid[k] = False
+            continue
+        g = m.groupdict()
+        try:
+            year = int(g.get("Y") or (2000 + int(g["y"]) if g.get("y") and int(g["y"]) < 70 else (1900 + int(g["y"]) if g.get("y") else 2000)))
+            month = int(g.get("m") or 0)
+            if g.get("Mn"):
+                month = [x.decode().lower() for x in _MONTH_NAMES].index(g["Mn"].lower()) + 1
+            if g.get("Mb"):
+                month = [x.decode().lower()[:3] for x in _MONTH_NAMES].index(g["Mb"].lower()) + 1
+            day = int(g.get("d") or 1)
+            if g.get("j"):
+                dt0 = _dt.date(year, 1, 1) + _dt.timedelta(days=int(g["j"]) - 1)
+                month, day = dt0.month, dt0.day
+            hour = int(g.get("H") or 0)
+            if g.get("I"):
+                hour = int(g["I"]) % 12 + (12 if (g.get("p") or "AM") == "PM" else 0)
+            minute = int(g.get("M") or 0)
+            sec = int(g.get("S") or 0)
+            frac = int(((g.get("f") or "0") + "000000")[:6])
+            if want_date:
+                data[k] = date_to_days(_dt.date(year, month or 1, day))
+            else:
+                data[k] = datetime_to_micros(_dt.datetime(year, month or 1, day, hour, minute, sec, frac))
+        except (ValueError, IndexError):
+            valid[k] = False
+    return data, valid
+
+
+# ---------------------------------------------------------------------------
+# everyday string surface (host engine; ref builtin_string*.go)
+# ---------------------------------------------------------------------------
+
+
+@register("trim", lambda args: string_type(), engines=HOST_ONLY, variadic=True, arity=1)
+def _trim(xp, args, ctx):
+    """trim(s[, remstr, mode]) — mode 0=both 1=leading 2=trailing (the parser
+    lowers TRIM([BOTH|LEADING|TRAILING] [remstr] FROM s) into this)."""
+    strs, _ = _decode_strs(ctx, 0)
+    rems = [b" "]
+    mode = 0
+    if len(args) > 1:
+        rems, _ = _decode_strs(ctx, 1)
+        if len(args) > 2:
+            m0 = args[2][0]
+            mode = int(m0 if not hasattr(m0, "__len__") else m0[0])
+    out = []
+    for i, s in enumerate(strs):
+        rem = rems[i if len(rems) > 1 else 0]
+        if s is None or rem is None or not rem:
+            out.append(None if s is None or rem is None else s)
+            continue
+        t = s
+        if mode in (0, 1):
+            while t.startswith(rem):
+                t = t[len(rem):]
+        if mode in (0, 2):
+            while t.endswith(rem):
+                t = t[: len(t) - len(rem)]
+        out.append(t)
+    return _encode_strs(ctx, out)
+
+
+@register("ltrim", lambda args: string_type(), engines=HOST_ONLY, arity=1)
+def _ltrim(xp, args, ctx):
+    strs, _ = _decode_strs(ctx, 0)
+    return _encode_strs(ctx, [None if s is None else s.lstrip(b" ") for s in strs])
+
+
+@register("rtrim", lambda args: string_type(), engines=HOST_ONLY, arity=1)
+def _rtrim(xp, args, ctx):
+    strs, _ = _decode_strs(ctx, 0)
+    return _encode_strs(ctx, [None if s is None else s.rstrip(b" ") for s in strs])
+
+
+@register("replace", lambda args: string_type(), engines=HOST_ONLY, variadic=True, arity=3)
+def _replace(xp, args, ctx):
+    strs, _ = _decode_strs(ctx, 0)
+    froms, _ = _decode_strs(ctx, 1)
+    tos, _ = _decode_strs(ctx, 2)
+    out = []
+    for i, s in enumerate(strs):
+        f = froms[i if len(froms) > 1 else 0]
+        t = tos[i if len(tos) > 1 else 0]
+        if s is None or f is None or t is None:
+            out.append(None)
+        elif not f:
+            out.append(s)
+        else:
+            out.append(s.replace(f, t))
+    return _encode_strs(ctx, out)
+
+
+@register("locate", lambda args: bigint_type(), engines=HOST_ONLY, variadic=True, arity=2)
+def _locate(xp, args, ctx):
+    """LOCATE(substr, str[, pos]) — 1-based, 0 when absent."""
+    import numpy as np
+
+    subs, _ = _decode_strs(ctx, 0)
+    strs, _ = _decode_strs(ctx, 1)
+    n = max(len(subs), len(strs))
+    poss = _int_args(args, 2, n) if len(args) > 2 else [1] * n
+    data = np.zeros(n, dtype=np.int64)
+    valid = np.ones(n, dtype=bool)
+    for i in range(n):
+        sub = subs[i if len(subs) > 1 else 0]
+        s = strs[i if len(strs) > 1 else 0]
+        pos = poss[i if len(poss) > 1 else 0]
+        if sub is None or s is None or pos is None:
+            valid[i] = False
+        elif pos < 1:
+            data[i] = 0
+        else:
+            data[i] = s.find(sub, pos - 1) + 1
+    return data, valid
+
+
+@register("instr", lambda args: bigint_type(), engines=HOST_ONLY)
+def _instr(xp, args, ctx):
+    import numpy as np
+
+    strs, _ = _decode_strs(ctx, 0)
+    subs, _ = _decode_strs(ctx, 1)
+    n = max(len(subs), len(strs))
+    data = np.zeros(n, dtype=np.int64)
+    valid = np.ones(n, dtype=bool)
+    for i in range(n):
+        s = strs[i if len(strs) > 1 else 0]
+        sub = subs[i if len(subs) > 1 else 0]
+        if sub is None or s is None:
+            valid[i] = False
+        else:
+            data[i] = s.find(sub) + 1
+    return data, valid
+
+
+def _pad(strs, lns, pads, left: bool):
+    out = []
+    n = max(len(strs), len(lns), len(pads))
+    for i in range(n):
+        s = strs[i if len(strs) > 1 else 0]
+        ln = lns[i if len(lns) > 1 else 0]
+        p = pads[i if len(pads) > 1 else 0]
+        if s is None or ln is None or p is None or ln < 0:
+            out.append(None)
+            continue
+        ln = int(ln)
+        if len(s) >= ln:
+            out.append(s[:ln])
+            continue
+        if not p:
+            out.append(None)  # MySQL: empty pad cannot reach the target
+            continue
+        fill = (p * ((ln - len(s)) // len(p) + 1))[: ln - len(s)]
+        out.append(fill + s if left else s + fill)
+    return out
+
+
+def _int_args(args, i, n):
+    d, v = args[i]
+    out = []
+    for k in range(n):
+        ok = v is None or v is True or (v if isinstance(v, bool) else (v[k] if hasattr(v, "__len__") else v))
+        x = d if not hasattr(d, "__len__") else d[k if len(d) > 1 else 0]
+        out.append(int(x) if ok else None)
+    return out
+
+
+@register("lpad", lambda args: string_type(nullable=True), engines=HOST_ONLY, variadic=True, arity=3)
+def _lpad(xp, args, ctx):
+    strs, _ = _decode_strs(ctx, 0)
+    pads, _ = _decode_strs(ctx, 2)
+    lns = _int_args(args, 1, max(len(strs), 1))
+    return _encode_strs(ctx, _pad(strs, lns, pads, True))
+
+
+@register("rpad", lambda args: string_type(nullable=True), engines=HOST_ONLY, variadic=True, arity=3)
+def _rpad(xp, args, ctx):
+    strs, _ = _decode_strs(ctx, 0)
+    pads, _ = _decode_strs(ctx, 2)
+    lns = _int_args(args, 1, max(len(strs), 1))
+    return _encode_strs(ctx, _pad(strs, lns, pads, False))
+
+
+@register("left", lambda args: string_type(), engines=HOST_ONLY)
+def _left(xp, args, ctx):
+    strs, _ = _decode_strs(ctx, 0)
+    lns = _int_args(args, 1, max(len(strs), 1))
+    out = []
+    for i, s in enumerate(strs):
+        ln = lns[i if len(lns) > 1 else 0]
+        out.append(None if s is None or ln is None else (b"" if ln <= 0 else s[:ln]))
+    return _encode_strs(ctx, out)
+
+
+@register("right", lambda args: string_type(), engines=HOST_ONLY)
+def _right(xp, args, ctx):
+    strs, _ = _decode_strs(ctx, 0)
+    lns = _int_args(args, 1, max(len(strs), 1))
+    out = []
+    for i, s in enumerate(strs):
+        ln = lns[i if len(lns) > 1 else 0]
+        out.append(None if s is None or ln is None else (b"" if ln <= 0 else s[-ln:]))
+    return _encode_strs(ctx, out)
+
+
+@register("repeat", lambda args: string_type(), engines=HOST_ONLY)
+def _repeat(xp, args, ctx):
+    strs, _ = _decode_strs(ctx, 0)
+    lns = _int_args(args, 1, max(len(strs), 1))
+    out = []
+    for i, s in enumerate(strs):
+        ln = lns[i if len(lns) > 1 else 0]
+        out.append(None if s is None or ln is None else s * max(ln, 0))
+    return _encode_strs(ctx, out)
+
+
+@register("reverse", lambda args: string_type(), engines=HOST_ONLY, arity=1)
+def _reverse(xp, args, ctx):
+    strs, _ = _decode_strs(ctx, 0)
+    out = []
+    for s in strs:
+        out.append(None if s is None else s.decode("utf-8", "surrogateescape")[::-1].encode("utf-8", "surrogateescape"))
+    return _encode_strs(ctx, out)
+
+
+@register("ascii", lambda args: bigint_type(), engines=HOST_ONLY, arity=1)
+def _ascii(xp, args, ctx):
+    import numpy as np
+
+    strs, v = _decode_strs(ctx, 0)
+    return np.array([0 if not s else s[0] for s in [x or b"" for x in strs]], dtype=np.int64), v
+
+
+@register("strcmp", lambda args: bigint_type(), engines=HOST_ONLY)
+def _strcmp(xp, args, ctx):
+    import numpy as np
+
+    a, _ = _decode_strs(ctx, 0)
+    b, _ = _decode_strs(ctx, 1)
+    n = max(len(a), len(b))
+    data = np.zeros(n, dtype=np.int64)
+    valid = np.ones(n, dtype=bool)
+    for i in range(n):
+        x = a[i if len(a) > 1 else 0]
+        y = b[i if len(b) > 1 else 0]
+        if x is None or y is None:
+            valid[i] = False
+        else:
+            data[i] = -1 if x < y else (1 if x > y else 0)
+    return data, valid
+
+
+@register("concat_ws", lambda args: string_type(), engines=HOST_ONLY, variadic=True, arity=2)
+def _concat_ws(xp, args, ctx):
+    seps, _ = _decode_strs(ctx, 0)
+    cols = [_decode_strs(ctx, i)[0] for i in range(1, len(args))]
+    n = max(len(c) for c in cols) if cols else len(seps)
+    out = []
+    for i in range(n):
+        sep = seps[i if len(seps) > 1 else 0]
+        if sep is None:
+            out.append(None)
+            continue
+        parts = [c[i if len(c) > 1 else 0] for c in cols]
+        out.append(sep.join(p for p in parts if p is not None))
     return _encode_strs(ctx, out)

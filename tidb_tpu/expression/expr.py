@@ -289,7 +289,25 @@ def eval_to_column(expr: Expression, batch: EvalBatch, xp=np) -> ChunkColumn:
 # aggregates (descriptors; execution lives in the engines)
 # ---------------------------------------------------------------------------
 
-AGG_FUNCS = {"count", "sum", "avg", "min", "max", "first_row"}
+AGG_FUNCS = {
+    "count",
+    "sum",
+    "avg",
+    "min",
+    "max",
+    "first_row",
+    "group_concat",
+    "stddev_pop",
+    "stddev_samp",
+    "var_pop",
+    "var_samp",
+    "bit_and",
+    "bit_or",
+    "bit_xor",
+}
+# variance family shares the (count, sum, sumsq) partial state
+VAR_AGGS = {"stddev_pop", "stddev_samp", "var_pop", "var_samp"}
+BIT_AGGS = {"bit_and", "bit_or", "bit_xor"}
 
 
 @dataclass
@@ -301,11 +319,16 @@ class AggDesc:
     name: str
     arg: Optional[Expression]  # None for COUNT(*)
     distinct: bool = False
+    sep: str = ","  # GROUP_CONCAT separator
 
     @property
     def ftype(self) -> FieldType:
         if self.name == "count":
             return bigint_type(nullable=False)
+        if self.name == "group_concat":
+            from tidb_tpu.types.field_type import string_type
+
+            return string_type()
         at = self.arg.ftype
         if self.name == "sum":
             if at.kind == TypeKind.DECIMAL:
@@ -317,6 +340,12 @@ class AggDesc:
             if at.kind == TypeKind.DECIMAL:
                 return decimal_type(38, min(at.scale + 4, 30))
             return double_type()
+        if self.name in VAR_AGGS:
+            return double_type()
+        if self.name in BIT_AGGS:
+            # MySQL bit aggregates are BIGINT UNSIGNED: the BIT_AND identity
+            # (all ones) must render as 18446744073709551615, not -1
+            return FieldType(TypeKind.UINT, nullable=False)
         return at  # min/max/first_row
 
     @property
@@ -329,15 +358,34 @@ class AggDesc:
             return ["count", "sum"]
         if self.name in ("min", "max", "first_row"):
             return [self.name]
+        if self.name in VAR_AGGS:
+            return ["count", "sum", "sumsq"]
+        if self.name in BIT_AGGS:
+            return [self.name]
+        if self.name == "group_concat":
+            # no distributable partial state: the planner keeps group_concat
+            # at the complete (root) stage
+            return ["group_concat"]
         raise ValueError(self.name)
 
     def to_pb(self) -> dict:
-        return {"name": self.name, "arg": self.arg.to_pb() if self.arg is not None else None, "distinct": self.distinct}
+        return {
+            "name": self.name,
+            "arg": self.arg.to_pb() if self.arg is not None else None,
+            "distinct": self.distinct,
+            "sep": self.sep,
+        }
 
     @staticmethod
     def from_pb(pb: dict) -> "AggDesc":
-        return AggDesc(pb["name"], expr_from_pb(pb["arg"]) if pb["arg"] is not None else None, pb["distinct"])
+        return AggDesc(
+            pb["name"],
+            expr_from_pb(pb["arg"]) if pb["arg"] is not None else None,
+            pb["distinct"],
+            pb.get("sep", ","),
+        )
 
     def __repr__(self):
         inner = "*" if self.arg is None else repr(self.arg)
-        return f"{self.name}({'distinct ' if self.distinct else ''}{inner})"
+        sep = f" separator={self.sep!r}" if self.name == "group_concat" and self.sep != "," else ""
+        return f"{self.name}({'distinct ' if self.distinct else ''}{inner}{sep})"

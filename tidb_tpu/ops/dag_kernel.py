@@ -212,7 +212,12 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                 # key holds its NULLs.
                 dense_doms = None
                 mxu_doms = None
-                if group_exprs:
+                # bit aggregates reduce with non-additive ops: only the sort
+                # path's segmented associative scan handles them
+                has_bit = any(
+                    pk in ("bit_and", "bit_or", "bit_xor") for a in aggs for pk in a.partial_kinds
+                )
+                if group_exprs and not has_bit:
                     doms = []
                     for g in group_exprs:
                         from tidb_tpu.expression.expr import ColumnRef as _CR
@@ -268,6 +273,9 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                                 isf = a.arg is not None and a.arg.ftype.kind == TypeKind.FLOAT
                                 out_data.append(red["sumf"]() if isf else red["sum"]())
                                 out_valid.append(cnt > 0)
+                            elif pk == "sumsq":
+                                out_data.append(red["sumsq"]())
+                                out_valid.append(cnt > 0)
                             elif pk in ("min", "max"):
                                 if d.dtype == jnp.float64:
                                     sentinel = jnp.inf if pk == "min" else -jnp.inf
@@ -275,12 +283,15 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                                     sentinel = _I64_MAX if pk == "min" else _I64_MIN
                                 out_data.append(red[pk](sentinel))
                                 out_valid.append(cnt > 0)
+                            elif pk in ("bit_and", "bit_or", "bit_xor"):
+                                out_data.append(red[pk]())
+                                out_valid.append(jnp.ones(ones_n, dtype=bool))
                             elif pk == "first_row":
                                 out_data.append(d[first_pos_c])
                                 out_valid.append(v[first_pos_c] & (first_pos < n))
                     return out_data, out_valid
 
-                if dense_doms is not None or not gvals:
+                if (dense_doms is not None or not gvals) and not has_bit:
                     doms = dense_doms if dense_doms is not None else []
                     B = 1
                     for dm in doms:
@@ -310,6 +321,7 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                             "count": lambda: wm.sum(axis=1),
                             "sum": lambda: jnp.where(wm, d[None, :], 0).sum(axis=1),
                             "sumf": lambda: jnp.where(wm, d[None, :] * 1.0, 0.0).sum(axis=1),
+                            "sumsq": lambda: jnp.where(wm, (d[None, :] * 1.0) ** 2, 0.0).sum(axis=1),
                             "min": lambda s: jnp.where(wm, d[None, :], s).min(axis=1),
                             "max": lambda s: jnp.where(wm, d[None, :], s).max(axis=1),
                         }
@@ -446,8 +458,12 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                             "count": lambda: _csum_delta(w.astype(jnp.int64)),
                             "sum": lambda: _csum_delta(jnp.where(w, d, 0)),
                             "sumf": lambda: _csum_delta(jnp.where(w, d * 1.0, 0.0)),
+                            "sumsq": lambda: _csum_delta(jnp.where(w, (d * 1.0) ** 2, 0.0)),
                             "min": lambda s: _seg_scan_red(jnp.where(w, d, s), jnp.minimum),
                             "max": lambda s: _seg_scan_red(jnp.where(w, d, s), jnp.maximum),
+                            "bit_and": lambda: _seg_scan_red(jnp.where(w, d, -1), jnp.bitwise_and),
+                            "bit_or": lambda: _seg_scan_red(jnp.where(w, d, 0), jnp.bitwise_or),
+                            "bit_xor": lambda: _seg_scan_red(jnp.where(w, d, 0), jnp.bitwise_xor),
                         }
 
                     out_data, out_valid = _collect_aggs(eval_arg, reducers, first_pos, first_pos_c, agg_cap)
@@ -668,6 +684,22 @@ def _finalize_device(jnp, aggs, state_data, state_valid):
             else:
                 out_d.append(s / denom)
             out_v.append(cnt > 0)
+        elif a.name in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
+            cnt, s, sq = state_data[i], state_data[i + 1], state_data[i + 2]
+            i += 3
+            scale = 10.0 ** a.arg.ftype.scale if a.arg.ftype.kind == TypeKind.DECIMAL else 1.0
+            nf = cnt * 1.0
+            sv = s / scale
+            sqv = sq / (scale * scale)
+            mean = sv / jnp.maximum(nf, 1)
+            varp = jnp.maximum(sqv / jnp.maximum(nf, 1) - mean * mean, 0.0)
+            if a.name.endswith("_samp"):
+                v = varp * nf / jnp.maximum(nf - 1, 1)
+                ok = cnt > 1
+            else:
+                v, ok = varp, cnt > 0
+            out_d.append(jnp.sqrt(v) if a.name.startswith("stddev") else v)
+            out_v.append(ok)
         else:
             out_d.append(state_data[i])
             out_v.append(state_valid[i])

@@ -95,6 +95,7 @@ class FuncCall(Node):
     distinct: bool = False
     star: bool = False  # COUNT(*)
     over: Optional["WindowSpec"] = None  # window call when set
+    separator: Optional[str] = None  # GROUP_CONCAT(... SEPARATOR 'x')
 
 
 @dataclass

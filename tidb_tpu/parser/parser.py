@@ -617,14 +617,51 @@ class Parser:
             return ast.FuncCall("interval", [n, ast.Literal(unit)])
         return self._column_or_call()
 
+    def _trim_call(self) -> ast.Node:
+        """TRIM([{BOTH|LEADING|TRAILING}] [remstr] FROM str) | TRIM(str) —
+        lowered to trim(str[, remstr, mode]) with mode 0=both 1=lead 2=trail."""
+        mode = 0
+        explicit = False
+        if self.eat_kw("BOTH"):
+            explicit = True
+        elif self.eat_kw("LEADING"):
+            mode, explicit = 1, True
+        elif self.eat_kw("TRAILING"):
+            mode, explicit = 2, True
+        rem = None
+        if explicit:
+            if not self.at_kw("FROM"):
+                rem = self.parse_expr()
+            self.expect_kw("FROM")
+            s = self.parse_expr()
+        else:
+            first = self.parse_expr()
+            if self.eat_kw("FROM"):
+                rem, s = first, self.parse_expr()
+            else:
+                s = first
+        self.expect_op(")")
+        args = [s]
+        if rem is not None or mode != 0:
+            args.append(rem if rem is not None else ast.Literal(" "))
+            args.append(ast.Literal(mode))
+        return ast.FuncCall("trim", args)
+
     def _column_or_call(self) -> ast.Node:
         t = self.peek()
         if t.kind == "ident" and t.value.upper() in RESERVED:
-            raise ParseError("expected expression", t)
+            # reserved words used as functions (REPLACE(x,..), LEFT(s,n), …)
+            if self.peek(1).kind == "op" and self.peek(1).value == "(":
+                pass
+            else:
+                raise ParseError("expected expression", t)
         name = self.ident()
         if self.at_op("("):
             self.next()
-            fc = ast.FuncCall(name.lower())
+            lname = name.lower()
+            if lname == "trim":
+                return self._trim_call()
+            fc = ast.FuncCall(lname)
             if self.at_op("*"):
                 self.next()
                 fc.star = True
@@ -633,6 +670,12 @@ class Parser:
                 fc.args.append(self.parse_expr())
                 while self.eat_op(","):
                     fc.args.append(self.parse_expr())
+                if lname == "group_concat" and self.eat_kw("SEPARATOR"):
+                    sep = self.peek()
+                    if sep.kind != "str":
+                        raise ParseError("SEPARATOR expects a string literal", sep)
+                    self.next()
+                    fc.separator = sep.value
             self.expect_op(")")
             if self.at_kw("OVER"):
                 self.next()

@@ -54,6 +54,11 @@ _FN_ALIAS = {
     "lcase": "lower",
     "ucase": "upper",
     "ceiling": "ceil",
+    "std": "stddev_pop",
+    "stddev": "stddev_pop",
+    "variance": "var_pop",
+    "adddate": "date_add_days",
+    "position": "locate",
 }
 
 
@@ -314,7 +319,10 @@ class Builder:
             order_agg_exprs: list[Expression] = []
             if sel.order_by:
                 for i_o, oi in enumerate(sel.order_by):
-                    if _contains_agg(oi.expr):
+                    # aggregates AND group-by expressions (ORDER BY YEAR(dt)
+                    # after GROUP BY YEAR(dt)) resolve against the agg — the
+                    # projection schema no longer carries the base columns
+                    if _contains_agg(oi.expr) or _contains_group_expr(oi.expr, sel.group_by or []):
                         e_o = self._resolve_in_agg(oi.expr, base_schema, aggs, group_exprs, sel.group_by, aliases)
                         order_agg_map[i_o] = len(order_agg_exprs)
                         order_agg_exprs.append(e_o)
@@ -964,6 +972,47 @@ class Builder:
             import datetime
 
             return Constant(datetime.date.today(), FieldType(TypeKind.DATE, nullable=False))
+        if name in ("curtime", "current_time"):
+            import datetime
+
+            t = datetime.datetime.now().time()
+            us = ((t.hour * 3600 + t.minute * 60 + t.second) * 1_000_000) + t.microsecond
+            return Constant(us, FieldType(TypeKind.DURATION, nullable=False))
+        if name == "str_to_date" and len(node.args) == 2:
+            # result kind depends on the format string: time specifiers →
+            # DATETIME, else DATE (ref: builtin_time.go strToDate)
+            args = [self._resolve(a, ctx) for a in node.args]
+            fmt = args[1]
+            if isinstance(fmt, Constant) and isinstance(fmt.value, (str, bytes)):
+                from tidb_tpu.expression.eval import str_to_date_has_time
+
+                f = fmt.value.decode() if isinstance(fmt.value, bytes) else fmt.value
+                kind = TypeKind.DATETIME if str_to_date_has_time(f) else TypeKind.DATE
+                return func("str_to_date", *args, ret=FieldType(kind, nullable=True))
+            return func("str_to_date", *args)
+        if name in ("datediff", "timediff", "addtime", "subtime"):
+            # string-literal operands coerce to the temporal kind MySQL
+            # implies: dates for DATEDIFF; for the time functions a literal
+            # with a date part reads as DATETIME, else as a DURATION
+            def time_like(e):
+                if not (isinstance(e, Constant) and e.ftype.kind == TypeKind.STRING):
+                    return e
+                v = e.value.decode() if isinstance(e.value, bytes) else str(e.value)
+                kind = TypeKind.DATETIME if ("-" in v.lstrip("-") or " " in v.strip()) else TypeKind.DURATION
+                return self._coerce_to(FieldType(kind), e)
+
+            args = [self._resolve(a, ctx) for a in node.args]
+            if len(args) == 2:
+                a, b = args
+                if name == "datediff":
+                    tgt = FieldType(TypeKind.DATE)
+                    a = self._coerce_to(tgt, a) if a.ftype.kind == TypeKind.STRING else a
+                    b = self._coerce_to(tgt, b) if b.ftype.kind == TypeKind.STRING else b
+                else:  # addtime/subtime/timediff: both sides time-like
+                    a = time_like(a)
+                    b = time_like(b)
+                return func(name, a, b)
+            return func(name, *args)
         if name == "nullif":
             a = self._resolve(node.args[0], ctx)
             b = self._resolve(node.args[1], ctx)
@@ -1002,6 +1051,11 @@ class Builder:
                 return Constant(datetime_to_micros(s), ft.not_null())
             except ValueError:
                 return Constant(datetime_to_micros(s + " 00:00:00"), ft.not_null())
+        if ft.kind == TypeKind.DURATION and isinstance(v, (str, bytes)):
+            from tidb_tpu.types.datum import duration_to_micros
+
+            s = v.decode() if isinstance(v, bytes) else v
+            return Constant(duration_to_micros(s), ft.not_null())
         return e
 
     # -- agg resolution -------------------------------------------------------
@@ -1024,8 +1078,20 @@ class Builder:
                     if n.star:
                         desc = AggDesc("count", None)
                     else:
-                        arg = self.resolve(n.args[0], BuildCtx(base_schema))
-                        desc = AggDesc(name, arg, distinct=n.distinct)
+                        if name == "group_concat" and len(n.args) > 1:
+                            # GROUP_CONCAT(a, b, ...) concatenates the values
+                            # per row first (MySQL semantics)
+                            parts = [self.resolve(a, BuildCtx(base_schema)) for a in n.args]
+                            parts = [
+                                p if p.ftype.kind == TypeKind.STRING else func("cast_string", p, ret=string_type())
+                                for p in parts
+                            ]
+                            arg = func("concat", *parts)
+                        else:
+                            arg = self.resolve(n.args[0], BuildCtx(base_schema))
+                        desc = AggDesc(
+                            name, arg, distinct=n.distinct, sep=n.separator if n.separator is not None else ","
+                        )
                     for i, existing in enumerate(aggs):
                         if repr(existing) == repr(desc):
                             return ColumnRef(i, existing.ftype, f"agg#{i}")
@@ -1212,6 +1278,27 @@ def _const_like(v) -> Constant:
     if isinstance(v, datetime.date):
         return Constant(date_to_days(v), FieldType(TypeKind.DATE, nullable=False))
     return Constant(v, string_type(nullable=False))
+
+
+def _contains_group_expr(node, group_asts) -> bool:
+    """Does the expression contain a subtree matching a GROUP BY item?
+    (bare column names excluded — the projection path already handles them)"""
+    if not group_asts:
+        return False
+    if not isinstance(node, ast.ColumnName) and any(_ast_eq(node, g) for g in group_asts):
+        return True
+    if isinstance(node, ast.FuncCall):
+        return any(_contains_group_expr(a, group_asts) for a in node.args)
+    for attr in ("left", "right", "operand", "low", "high", "else_value"):
+        v = getattr(node, attr, None)
+        if v is not None and isinstance(v, ast.Node) and _contains_group_expr(v, group_asts):
+            return True
+    if isinstance(node, ast.CaseWhen):
+        return any(
+            _contains_group_expr(c, group_asts) or _contains_group_expr(v, group_asts)
+            for c, v in node.branches
+        )
+    return False
 
 
 def _contains_agg(node) -> bool:

@@ -160,7 +160,8 @@ def _prune(plan: LogicalPlan, needed: Optional[set[int]]):
         plan.children = [child]
         plan.group_by = [_remap_expr(g, cmap) for g in plan.group_by]
         plan.aggs = [
-            AggDesc(a.name, _remap_expr(a.arg, cmap) if a.arg is not None else None, a.distinct) for a in plan.aggs
+            AggDesc(a.name, _remap_expr(a.arg, cmap) if a.arg is not None else None, a.distinct, a.sep)
+            for a in plan.aggs
         ]
         return plan, {i: i for i in range(len(plan.schema))}
     if isinstance(plan, (LogicalSort, LogicalLimit, LogicalDistinct)):
@@ -559,6 +560,9 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan
             and child.pushed_topn is None
             and child.pushed_limit is None
             and not any(a.distinct for a in plan.aggs)
+            # group_concat has no distributable partial state (value order
+            # would be lost across task merges) — keep it at the root
+            and all(a.name != "group_concat" for a in plan.aggs)
         )
         if can_push:
             st = _pick_engine(engines, list(child.pushed_conditions) + exprs)

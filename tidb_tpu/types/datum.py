@@ -79,6 +79,8 @@ class Datum:
                     return datetime_to_micros(v + " 00:00:00")
             return int(v)
         if k == TypeKind.DURATION:
+            if isinstance(v, (str, _dt.timedelta)):
+                return duration_to_micros(v)
             return int(v)
         raise TypeError(f"no physical scalar for {self.ftype}")
 
@@ -101,3 +103,53 @@ def datetime_to_micros(v: "str | _dt.datetime") -> int:
 
 def micros_to_datetime(us: int) -> _dt.datetime:
     return _EPOCH_DT + _dt.timedelta(microseconds=int(us))
+
+
+def duration_to_micros(v: "str | _dt.timedelta") -> int:
+    """MySQL TIME '[-][H]H:MM:SS[.ffffff]' (hours may exceed 23, up to 838)
+    → signed microseconds (ref: types/duration.go parsing)."""
+    if isinstance(v, _dt.timedelta):
+        return int(v.total_seconds() * 1_000_000)
+    s = v.strip()
+    neg = s.startswith("-")
+    if neg:
+        s = s[1:]
+    frac = 0
+    if "." in s:
+        s, f = s.split(".", 1)
+        frac = int((f + "000000")[:6])
+    parts = s.split(":")
+    if len(parts) == 3:
+        h, m, sec = (int(p) for p in parts)
+    elif len(parts) == 2:
+        h, m, sec = int(parts[0]), int(parts[1]), 0
+    else:
+        # bare number: MySQL reads it as [HH]MMSS
+        x = int(parts[0])
+        h, m, sec = x // 10000, (x // 100) % 100, x % 100
+    us = ((h * 3600 + m * 60 + sec) * 1_000_000) + frac
+    return -us if neg else us
+
+
+def micros_to_duration(us: int) -> _dt.timedelta:
+    return _dt.timedelta(microseconds=int(us))
+
+
+def format_physical(x, ftype) -> bytes:
+    """MySQL-style text rendering of one physical (non-NULL, non-string)
+    value — shared by CAST(... AS CHAR) and GROUP_CONCAT."""
+    from tidb_tpu.types.field_type import TypeKind
+
+    k = ftype.kind
+    if k == TypeKind.DECIMAL and ftype.scale > 0:
+        iv = int(x)
+        sign = "-" if iv < 0 else ""
+        iv = abs(iv)
+        return f"{sign}{iv // 10**ftype.scale}.{iv % 10**ftype.scale:0{ftype.scale}d}".encode()
+    if k == TypeKind.FLOAT:
+        return repr(float(x)).encode()
+    if k == TypeKind.DATE:
+        return str(days_to_date(int(x))).encode()
+    if k == TypeKind.DATETIME:
+        return str(micros_to_datetime(int(x))).encode()
+    return str(int(x)).encode()

@@ -25,7 +25,15 @@ from typing import Iterable, Sequence
 import numpy as np
 
 from tidb_tpu.types import FieldType, TypeKind
-from tidb_tpu.types.datum import NULL, date_to_days, datetime_to_micros, days_to_date, micros_to_datetime
+from tidb_tpu.types.datum import (
+    NULL,
+    date_to_days,
+    datetime_to_micros,
+    days_to_date,
+    duration_to_micros,
+    micros_to_datetime,
+    micros_to_duration,
+)
 
 # ---------------------------------------------------------------------------
 # Dictionary (string encoding)
@@ -179,6 +187,8 @@ class Column:
                     data[i] = date_to_days(v)
                 elif k == TypeKind.DATETIME and not isinstance(v, (int, np.integer)):
                     data[i] = datetime_to_micros(v)
+                elif k == TypeKind.DURATION and not isinstance(v, (int, np.integer)):
+                    data[i] = duration_to_micros(v)
                 elif k == TypeKind.UINT and v >= (1 << 63):
                     data[i] = int(v) - (1 << 64)  # two's complement wrap
                 else:
@@ -207,6 +217,8 @@ class Column:
             return days_to_date(int(v))
         if k == TypeKind.DATETIME:
             return micros_to_datetime(int(v))
+        if k == TypeKind.DURATION:
+            return micros_to_duration(int(v))
         if k == TypeKind.FLOAT:
             return float(v)
         if k == TypeKind.UINT and v < 0:
