@@ -248,3 +248,25 @@ def test_review_fixes():
         "SELECT GROUP_CONCAT(s SEPARATOR '-'), GROUP_CONCAT(s SEPARATOR '+') FROM r"
     ) == [("banana-bananas", "banana+bananas")]
     assert d.query("SELECT GROUP_CONCAT(id, s) FROM r") == [("1banana,3bananas",)]
+
+
+def test_string_literal_temporal_args():
+    """String literals coerce for ALL temporal builtins (regression: only
+    four functions got coercion; the rest read dictionary codes as days)."""
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE z (x BIGINT)")
+    d.execute("INSERT INTO z VALUES (1)")
+    (row,) = d.query(
+        "SELECT DAYOFYEAR('2008-12-31'), TO_DAYS('2008-12-31'), MONTHNAME('2008-12-31'),"
+        " LAST_DAY('2008-02-05'), WEEK('2008-12-31', 1), HOUR('11:22:33') FROM z"
+    )
+    assert row == (366, 733772, "December", datetime.date(2008, 2, 29), 53, 11)
+
+
+def test_timediff_on_dates_and_duration_cast():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE z (d1 DATE, d2 DATE)")
+    d.execute("INSERT INTO z VALUES ('2008-12-31', '2008-12-28')")
+    assert d.query("SELECT TIMEDIFF(d1, d2) FROM z") == [(datetime.timedelta(days=3),)]
+    assert d.query("SELECT CAST(MAKETIME(1, 1, 1) AS CHAR) FROM z") == [("01:01:01",)]
+    assert d.query("SELECT GROUP_CONCAT(TIMEDIFF(d1, d2)) FROM z") == [("72:00:00",)]

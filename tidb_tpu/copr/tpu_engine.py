@@ -169,6 +169,12 @@ def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: 
         # descending scans are order-sensitive row streams — the sorted-batch
         # kernel has no cheap equivalent; delegate to the host engine
         return host_execute_dag(store, dag, region, ranges, read_ts)
+    if len(ranges) > MAX_RANGES:
+        # many-range tasks are point-lookup workloads (index joins, batch
+        # gets): a covering-span fallback would degrade to a full scan, and
+        # the host engine slices exactly the requested handles from the same
+        # column cache — the TiKV-serves-point-reads role
+        return host_execute_dag(store, dag, region, ranges, read_ts)
     schema = RowSchema(scan.storage_schema)
     slots = [c.column_id for c in scan.columns if not c.is_handle]
     cache = cache_for(store)
@@ -179,15 +185,8 @@ def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: 
 
     # ranges → padded static array; rows outside any range are masked out
     rarr = np.zeros((MAX_RANGES, 2), dtype=np.int64)
-    use = ranges[:MAX_RANGES]
-    if len(ranges) > MAX_RANGES:
-        # merge overflow ranges into a single covering span (mask is a filter
-        # on top of region contents, so over-covering only loses pruning)
-        los, his = zip(*[tablecodec.range_to_handles(kr, scan.table_id) for kr in ranges])
-        rarr[0] = (min(los), max(his))
-    else:
-        for i, kr in enumerate(use):
-            rarr[i] = tablecodec.range_to_handles(kr, scan.table_id)
+    for i, kr in enumerate(ranges):
+        rarr[i] = tablecodec.range_to_handles(kr, scan.table_id)
 
     agg_complete = any(
         ex.tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG) and ex.agg_mode == dagpb.AGG_COMPLETE

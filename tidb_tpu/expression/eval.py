@@ -1023,14 +1023,17 @@ def _week(xp, args, ctx):
     if len(args) > 1:
         m0 = args[1][0]
         mode = int(m0 if not hasattr(m0, "__len__") else m0[0]) & 7
-    if mode in (1, 3):
-        w = _iso_week(xp, d)
-        if mode == 1:
-            # mode 1 counts days before ISO week 1 as week 0 of this year,
-            # where ISO rolls them into last year's week 52/53
-            y, _, _ = _civil_from_days(xp, d)
-            ty, _, _ = _civil_from_days(xp, d - ((d + 3) % 7) + 3)
-            w = xp.where(ty < y, 0, w)
+    if mode == 3:
+        return _iso_week(xp, d), v
+    if mode == 1:
+        # Monday-start weeks counted within the date's own year: week 1 is
+        # the first week with ≥4 days in the year; year-end days past the
+        # last Sunday stay week 53 (not next year's week 1, unlike ISO)
+        y, _, _ = _civil_from_days(xp, d)
+        jan1 = _days_from_civil(xp, y, 1 + 0 * y, 1 + 0 * y)
+        wd = (jan1 + 3) % 7  # 0=Monday
+        start = xp.where(wd <= 3, jan1 - wd, jan1 + 7 - wd)
+        w = xp.where(xp.asarray(d).astype(xp.int32) < start, 0, (xp.asarray(d).astype(xp.int32) - start) // 7 + 1)
         return w, v
     y, _, _ = _civil_from_days(xp, d)
     jan1 = _days_from_civil(xp, y, 1 + 0 * y, 1 + 0 * y)
@@ -1114,8 +1117,11 @@ def _subtime(xp, args, ctx):
 @register("timediff", lambda args: FieldType(TypeKind.DURATION), arity=2)
 def _timediff(xp, args, ctx):
     (da, va), (db, vb) = args
-    # both args share a kind (parser coerces); DATETIME/DURATION both carry
-    # microseconds, so the difference is already a duration
+    # normalize to microseconds: DATE physicals are day counts
+    if ctx.arg_types[0].kind == TypeKind.DATE:
+        da = da * 86_400_000_000
+    if ctx.arg_types[1].kind == TypeKind.DATE:
+        db = db * 86_400_000_000
     return da - db, and_valid(xp, va, vb)
 
 

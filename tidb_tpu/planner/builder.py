@@ -62,6 +62,16 @@ _FN_ALIAS = {
 }
 
 
+# builtins whose first argument is a date/datetime (string literals coerce —
+# else dictionary codes would be read as day counts) or a time
+_DATE_ARG0_FNS = {
+    "year", "month", "dayofmonth", "dayofweek", "weekday", "week", "dayofyear",
+    "to_days", "last_day", "date", "monthname", "dayname", "date_format",
+    "unix_timestamp",
+}
+_TIME_ARG0_FNS = {"hour", "minute", "second", "time_to_sec"}
+
+
 def _common_type(l: FieldType, r: FieldType) -> FieldType:
     """Result type of a set-operation column pair (ref: unionJoinFieldType,
     expression/util.go aggFieldType): numeric promotion, else exact kind."""
@@ -815,6 +825,20 @@ class Builder:
                         join.eq_conds.append(pair)
                     else:
                         join.other_conds.append(c)
+            # join-algorithm hints (ref: HASH_JOIN/MERGE_JOIN/INL_JOIN hints,
+            # planner hint handling). Scope: the build/inner (right) side's
+            # tables, plus the left side only when it is a single base table —
+            # a chain's upper joins must not match a lower join's table just
+            # because its columns flow through the accumulated schema
+            tables = {c.table.lower() for c in right.schema if c.table}
+            left_tables = {c.table.lower() for c in left.schema if c.table}
+            if len(left_tables) == 1:
+                tables |= left_tables
+            for hname, hargs in self.hints:
+                h = hname.lower()
+                alg = {"hash_join": "hash", "merge_join": "merge", "inl_join": "index", "index_join": "index"}.get(h)
+                if alg and any(a.strip().lower() in tables for a in hargs):
+                    join.preferred = alg
             return join
         raise PlanError(f"unsupported FROM clause {type(node).__name__}")
 
@@ -1018,6 +1042,12 @@ class Builder:
             b = self._resolve(node.args[1], ctx)
             return func("case_when", self._binary("eq", a, b), Constant(None, FieldType(TypeKind.NULLTYPE)), a)
         args = [self._resolve(a, ctx) for a in node.args]
+        if name in _DATE_ARG0_FNS and args and isinstance(args[0], Constant) and args[0].ftype.kind == TypeKind.STRING:
+            v = args[0].value.decode() if isinstance(args[0].value, bytes) else str(args[0].value)
+            kind = TypeKind.DATETIME if ":" in v else TypeKind.DATE
+            args[0] = self._coerce_to(FieldType(kind), args[0])
+        elif name in _TIME_ARG0_FNS and args and isinstance(args[0], Constant) and args[0].ftype.kind == TypeKind.STRING:
+            args[0] = self._coerce_to(FieldType(TypeKind.DURATION), args[0])
         try:
             return func(name, *args)
         except KeyError:

@@ -37,8 +37,10 @@ from tidb_tpu.planner.plans import (
     PhysDistinct,
     PhysFinalAgg,
     PhysHashJoin,
+    PhysIndexJoin,
     PhysIndexLookUp,
     PhysIndexReader,
+    PhysMergeJoin,
     PhysLimit,
     PhysMemSource,
     PhysPointGet,
@@ -653,15 +655,121 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan
     if isinstance(plan, LogicalJoin):
         left = _physical(plan.children[0], engines, stats)
         right = _physical(plan.children[1], engines, stats)
-        return PhysHashJoin(
-            kind=plan.kind,
-            eq_conds=plan.eq_conds,
-            other_conds=plan.other_conds,
-            null_aware=plan.null_aware,
-            schema=plan.schema,
-            children=[left, right],
-        )
+        return _choose_join(plan, left, right, stats)
     raise PlanError(f"physical: unhandled node {type(plan).__name__}")
+
+
+_INT_JOIN_KINDS = (TypeKind.INT, TypeKind.UINT, TypeKind.DECIMAL, TypeKind.DATE, TypeKind.DATETIME, TypeKind.DURATION)
+
+
+def _plain_reader(rd) -> bool:
+    return (
+        isinstance(rd, PhysTableReader)
+        and rd.pushed_agg is None
+        and rd.pushed_topn is None
+        and rd.pushed_limit is None
+        and rd.table.partition is None
+    )
+
+
+def _merge_join_ok(plan: LogicalJoin, left, right) -> bool:
+    """Both inputs stream in join-key order: single-key equi-join where each
+    side's key IS its table's integer handle (readers return handle order)."""
+    if plan.kind not in ("inner", "left") or len(plan.eq_conds) != 1 or plan.null_aware:
+        return False
+    l, r = plan.eq_conds[0]
+
+    def sorted_on_key(rd, pos):
+        return (
+            _plain_reader(rd)
+            and rd.table.pk_is_handle
+            and pos < len(rd.schema)
+            and rd.schema[pos].slot == rd.table.pk_offset
+        )
+
+    return sorted_on_key(left, l) and sorted_on_key(right, r)
+
+
+def _index_join_inner(plan: LogicalJoin, right):
+    """('pk', None) / ('idx', IndexInfo) when the inner (right) side is point-
+    readable on the join keys; None otherwise."""
+    if plan.kind not in ("inner", "left") or not plan.eq_conds or plan.null_aware:
+        return None
+    if not _plain_reader(right):
+        return None
+    if any(right.schema[r].ftype.kind not in _INT_JOIN_KINDS for _, r in plan.eq_conds):
+        return None
+    key_slots = [right.schema[r].slot for _, r in plan.eq_conds]
+    t = right.table
+    if len(key_slots) == 1 and t.pk_is_handle and key_slots[0] == t.pk_offset:
+        return ("pk", None)
+    for idx in t.indexes:
+        if idx.state == "public" and list(idx.column_offsets[: len(key_slots)]) == key_slots:
+            return ("idx", idx)
+    return None
+
+
+def _choose_join(plan: LogicalJoin, left, right, stats):
+    """Join algorithm by cost (ref: physical join enumeration in
+    find_best_task / builder.go:216-320), overridable by HASH_JOIN /
+    MERGE_JOIN / INL_JOIN hints. Index join wins when the outer side is
+    far smaller than the indexed inner (reads only matching inner rows);
+    merge join wins for handle-ordered inputs (no build memory); hash
+    otherwise."""
+    hash_join = PhysHashJoin(
+        kind=plan.kind,
+        eq_conds=plan.eq_conds,
+        other_conds=plan.other_conds,
+        null_aware=plan.null_aware,
+        schema=plan.schema,
+        children=[left, right],
+    )
+    if plan.kind in ("semi", "anti", "cross", "right"):
+        return hash_join
+    inner = _index_join_inner(plan, right)
+    merge_ok = _merge_join_ok(plan, left, right)
+
+    def mk(alg):
+        if alg == "merge" and merge_ok:
+            return PhysMergeJoin(
+                kind=plan.kind,
+                eq_conds=plan.eq_conds,
+                other_conds=plan.other_conds,
+                schema=plan.schema,
+                children=[left, right],
+            )
+        if alg == "index" and inner is not None:
+            return PhysIndexJoin(
+                kind=plan.kind,
+                eq_conds=plan.eq_conds,
+                other_conds=plan.other_conds,
+                inner_index=inner[1],
+                schema=plan.schema,
+                children=[left, right],
+            )
+        return hash_join
+
+    if plan.preferred:
+        return mk(plan.preferred)
+    l_rows = r_rows = None
+    if stats is not None:
+        if isinstance(left, PhysTableReader):
+            st = stats.get(left.table.id)
+            l_rows = st.row_count if st is not None else None
+        if isinstance(right, PhysTableReader):
+            st = stats.get(right.table.id)
+            r_rows = st.row_count if st is not None else None
+    if (
+        inner is not None
+        and l_rows is not None
+        and r_rows is not None
+        and l_rows <= 100_000
+        and l_rows * 16 < r_rows
+    ):
+        return mk("index")
+    if merge_ok:
+        return mk("merge")
+    return hash_join
 
 
 def _partial_schema(agg: LogicalAggregation) -> list:

@@ -124,6 +124,8 @@ class LogicalJoin(LogicalPlan):
     other_conds: list[Expression] = field(default_factory=list)
     # NOT IN: a NULL on either side of the key poisons the anti-match
     null_aware: bool = False
+    # join-algorithm hint: "" (cost-based) | hash | merge | index
+    preferred: str = ""
     schema: Schema = field(default_factory=list)
     children: list = field(default_factory=list)
 
@@ -310,6 +312,33 @@ class PhysHashJoin(PhysicalPlan):
 
 
 @dataclass
+class PhysMergeJoin(PhysicalPlan):
+    """Sort-merge join over key-ordered inputs (ref: executor/join/
+    merge_join.go; chosen when both sides stream in join-key order, e.g.
+    handle-ordered PK scans — no build table, no hash memory)."""
+
+    kind: str  # inner/left
+    eq_conds: list[tuple[int, int]] = field(default_factory=list)
+    other_conds: list = field(default_factory=list)
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+
+@dataclass
+class PhysIndexJoin(PhysicalPlan):
+    """Index nested-loop join (ref: executor/join index-join variants,
+    builder.go:216-320): probe-side rows drive point lookups into the inner
+    table's index/PK, reading only matching inner rows."""
+
+    kind: str  # inner/left
+    eq_conds: list[tuple[int, int]] = field(default_factory=list)
+    other_conds: list = field(default_factory=list)
+    inner_index: object = None  # IndexInfo | None (None = PK/handle)
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)  # [outer, inner PhysTableReader template]
+
+
+@dataclass
 class PhysDistinct(PhysicalPlan):
     children: list = field(default_factory=list)
 
@@ -400,6 +429,11 @@ def explain_plan(p, indent: int = 0, stats=None) -> str:
         extra = f"limit={p.limit} offset={p.offset}"
     elif isinstance(p, PhysHashJoin):
         extra = f"{p.kind} on {p.eq_conds}"
+    elif isinstance(p, PhysMergeJoin):
+        extra = f"{p.kind} on {p.eq_conds} (sorted inputs)"
+    elif isinstance(p, PhysIndexJoin):
+        idx = p.inner_index.name if p.inner_index is not None else "PRIMARY"
+        extra = f"{p.kind} on {p.eq_conds} (inner index {idx})"
     elif isinstance(p, PhysSetOp):
         extra = f"{p.op}{' all' if p.all else ''}"
     elif isinstance(p, PhysWindow):

@@ -152,4 +152,13 @@ def format_physical(x, ftype) -> bytes:
         return str(days_to_date(int(x))).encode()
     if k == TypeKind.DATETIME:
         return str(micros_to_datetime(int(x))).encode()
+    if k == TypeKind.DURATION:
+        us = int(x)
+        sign = "-" if us < 0 else ""
+        us = abs(us)
+        sec, frac = divmod(us, 1_000_000)
+        h, rem = divmod(sec, 3600)
+        m, s = divmod(rem, 60)
+        base = f"{sign}{h:02d}:{m:02d}:{s:02d}"
+        return (base + (f".{frac:06d}" if frac else "")).encode()
     return str(int(x)).encode()
