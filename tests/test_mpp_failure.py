@@ -1,0 +1,136 @@
+"""MPP failure detection and retry (ref: copr/mpp_probe.go:62,190,235 device
+blacklisting + executor_with_retry.go:40 retry/fallback), driven by
+failpoint injection on the virtual CPU mesh."""
+
+import time
+
+import pytest
+
+import tidb_tpu
+from tidb_tpu.parallel.probe import DeviceProber, GLOBAL_PROBER, MPPRetryExhausted
+from tidb_tpu.utils import failpoint
+
+
+@pytest.fixture()
+def mppdb():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE fact (cid BIGINT, qty BIGINT)")
+    d.execute("CREATE TABLE dim (id BIGINT PRIMARY KEY, cat BIGINT)")
+    d.execute("INSERT INTO dim VALUES " + ",".join(f"({i},{i % 4})" for i in range(30)))
+    d.execute(
+        "INSERT INTO fact VALUES " + ",".join(f"({i % 30},{i % 7})" for i in range(600))
+    )
+    yield d
+    GLOBAL_PROBER._failed.clear()
+
+
+MPPQ = "SELECT cat, COUNT(*), SUM(qty) FROM fact JOIN dim ON fact.cid = dim.id GROUP BY cat ORDER BY cat"
+
+
+def host_rows(d, q):
+    s = d.session()
+    s.execute("SET tidb_allow_mpp = 0")
+    return s.query(q)
+
+
+def test_device_failure_blacklists_and_retries(mppdb):
+    """First attempt loses one device: the retry plans over the survivors
+    and the query still answers correctly."""
+    calls = {"n": 0}
+
+    def boom(mesh):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            err = RuntimeError("device lost: injected")
+            err.mpp_device = mesh.devices.flat[0]
+            raise err
+
+    failpoint.enable("mpp_run_fragment", boom)
+    try:
+        rows = mppdb.query(MPPQ)
+    finally:
+        failpoint.disable("mpp_run_fragment")
+    assert calls["n"] == 2  # failed once, succeeded on retry
+    assert GLOBAL_PROBER.failed_count() == 1  # the lost device is blacklisted
+    assert rows == host_rows(mppdb, MPPQ)
+
+
+def test_unattributed_failures_exhaust_then_fall_back(mppdb):
+    """Persistent failures (no device to blame) exhaust the retry budget;
+    the session re-plans without MPP and the query still succeeds."""
+    calls = {"n": 0}
+
+    def always_boom(mesh):
+        calls["n"] += 1
+        raise RuntimeError("shard OOM: injected")
+
+    failpoint.enable("mpp_run_fragment", always_boom)
+    try:
+        rows = mppdb.query(MPPQ)
+    finally:
+        failpoint.disable("mpp_run_fragment")
+    assert calls["n"] == 2  # no progress twice -> budget consumed
+    assert rows == host_rows(mppdb, MPPQ)  # host fallback answered
+
+
+def test_all_devices_blacklisted_falls_back(mppdb):
+    import jax
+
+    for dev in jax.devices():
+        GLOBAL_PROBER.report_failure(dev)
+    try:
+        rows = mppdb.query(MPPQ)  # MPPRetryExhausted → host fallback
+    finally:
+        GLOBAL_PROBER._failed.clear()
+    assert rows == host_rows(mppdb, MPPQ)
+
+
+def test_prober_recovery_window():
+    p = DeviceProber(recovery_s=0.05)
+
+    class Dev:
+        pass
+
+    d1, d2 = Dev(), Dev()
+    p.report_failure(d1)
+    assert p.alive([d1, d2]) == [d2]
+    time.sleep(0.06)
+    # past the recovery window the device is re-probed (rejoins the mesh)
+    assert p.alive([d1, d2]) == [d1, d2]
+    p.report_failure(d1)
+    p.report_ok(d1)
+    assert p.alive([d1, d2]) == [d1, d2]
+
+
+def test_reduced_mesh_correctness(mppdb):
+    """Queries on a permanently reduced mesh (one device blacklisted the
+    whole time) still match the host engine — capacities re-derive from the
+    surviving device count."""
+    import jax
+
+    GLOBAL_PROBER.report_failure(jax.devices()[0])
+    try:
+        rows = mppdb.query(MPPQ)
+    finally:
+        GLOBAL_PROBER._failed.clear()
+    assert rows == host_rows(mppdb, MPPQ)
+
+
+def test_kill_is_not_retried(mppdb):
+    """KILL/OOM verdicts must pass through the retry loop untouched —
+    retrying would defeat the kill or the memory quota."""
+    from tidb_tpu.utils.memory import QueryKilledError
+
+    calls = {"n": 0}
+
+    def kill(mesh):
+        calls["n"] += 1
+        raise QueryKilledError("killed: injected")
+
+    failpoint.enable("mpp_run_fragment", kill)
+    try:
+        with pytest.raises(QueryKilledError):
+            mppdb.query(MPPQ)
+    finally:
+        failpoint.disable("mpp_run_fragment")
+    assert calls["n"] == 1  # no retry, no host fallback

@@ -430,9 +430,55 @@ class MPPGatherExec:
         return None
 
     def execute(self):
-        import jax.numpy as jnp
+        """Attempt the mesh pipeline with failure detection and retry (ref:
+        ExecutorWithRetry + MPPFailedStoreProber, executor_with_retry.go:40,
+        mpp_probe.go:62): a device failure blacklists the device and the
+        next attempt runs on the survivors; unattributable failures get one
+        same-mesh retry; exhaustion raises MPPRetryExhausted so the session
+        re-plans without MPP."""
+        import jax
 
         from tidb_tpu.parallel import make_mesh
+        from tidb_tpu.parallel.probe import GLOBAL_PROBER, MPPRetryExhausted, probe_and_blacklist
+        from tidb_tpu.utils import failpoint
+        from tidb_tpu.utils.memory import QueryKilledError, QueryOOMError
+
+        no_progress = 0
+        total = 0
+        max_total = max(len(jax.devices()) + 2, 4)  # cascading-loss bound
+        while True:
+            devices = GLOBAL_PROBER.alive(jax.devices())
+            if not devices:
+                raise MPPRetryExhausted("no alive devices for MPP")
+            mesh = make_mesh(devices=devices)
+            try:
+                failpoint.inject("mpp_run_fragment", mesh)
+                return self._execute_attempt(mesh)
+            except (MPPRetryExhausted, QueryKilledError, QueryOOMError):
+                # kills and quota cancels are statement verdicts, not device
+                # failures — retrying would defeat KILL / the memory quota
+                raise
+            except RuntimeError as exc:  # device loss / per-shard OOM / injected
+                total += 1
+                bad = getattr(exc, "mpp_device", None)
+                if bad is not None:
+                    GLOBAL_PROBER.report_failure(bad)
+                    progressed = True
+                else:
+                    # attribute by probing (MPPAlive analog): any device that
+                    # fails the round-trip is blacklisted; the next attempt
+                    # runs on the survivors
+                    progressed = probe_and_blacklist(devices) > 0
+                if not progressed:
+                    no_progress += 1
+                if no_progress >= 2 or total >= max_total:
+                    raise MPPRetryExhausted(
+                        f"mpp execution failed after {total} attempts: {exc}"
+                    ) from exc
+
+    def _execute_attempt(self, mesh):
+        import jax.numpy as jnp
+
         from tidb_tpu.parallel.mpp import (
             DistAggSpec,
             DistJoinSpec,
@@ -441,7 +487,6 @@ class MPPGatherExec:
         )
 
         p = self.plan
-        mesh = make_mesh()
         ndev = mesh.devices.size
         self._dev_cacheable = (
             not self.session._txn_dirty()

@@ -9,15 +9,18 @@ from typing import Optional, Sequence
 _MESH_CACHE: dict = {}
 
 
-def make_mesh(n_devices: Optional[int] = None, axis: str = "dp"):
+def make_mesh(n_devices: Optional[int] = None, axis: str = "dp", devices: Optional[Sequence] = None):
     """1-D mesh over available devices. SQL fragments parallelize along one
     data axis; intra-device parallelism is XLA's job (VPU/MXU), so unlike an
     LLM stack there is no tp/pp split — dp + collectives covers the MPP
-    model (hash/broadcast/passthrough exchanges ride ICI)."""
+    model (hash/broadcast/passthrough exchanges ride ICI).
+
+    ``devices`` overrides the device list (MPP failure retry builds a mesh
+    over the surviving devices only — ref mpp_probe blacklisting)."""
     import jax
     from jax.sharding import Mesh
 
-    devs = jax.devices()
+    devs = list(devices) if devices is not None else jax.devices()
     if n_devices is not None:
         if n_devices > len(devs):
             raise RuntimeError(

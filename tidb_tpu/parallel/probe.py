@@ -1,0 +1,75 @@
+"""MPP device failure detection & recovery.
+
+Reference parity: the TiFlash liveness prober (pkg/store/copr/mpp_probe.go:62
+MPPFailedStoreProber — detect loop :190, recovery :235) and the MPP retry
+wrapper (pkg/executor/internal/mpp/executor_with_retry.go:40). A mesh has its
+own failure modes — device loss, per-shard OOM, a hung ICI collective — so
+the gather executor reports failures here, plans its next attempt on the
+surviving devices, and blacklisted devices are re-probed (time-based) so a
+recovered chip rejoins the mesh.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class DeviceProber:
+    """Blacklist with timed recovery. Keys are stable device identifiers
+    (``id(device)`` of jax Device objects — the process-lifetime identity the
+    mesh cache also uses)."""
+
+    def __init__(self, recovery_s: float = 60.0):
+        self.recovery_s = recovery_s
+        self._mu = threading.Lock()
+        self._failed: dict[int, float] = {}  # dev key → fail time
+
+    def report_failure(self, dev) -> None:
+        with self._mu:
+            self._failed[id(dev)] = time.monotonic()
+
+    def report_ok(self, dev) -> None:
+        with self._mu:
+            self._failed.pop(id(dev), None)
+
+    def alive(self, devices: list) -> list:
+        """Filter out blacklisted devices; entries past the recovery window
+        are dropped (the next attempt re-probes them — ref mpp_probe
+        MaxObsoletTime recovery)."""
+        now = time.monotonic()
+        with self._mu:
+            for k in [k for k, t in self._failed.items() if now - t > self.recovery_s]:
+                del self._failed[k]
+            return [d for d in devices if id(d) not in self._failed]
+
+    def failed_count(self) -> int:
+        with self._mu:
+            return len(self._failed)
+
+
+GLOBAL_PROBER = DeviceProber()
+
+
+def probe_and_blacklist(devices, prober: DeviceProber = GLOBAL_PROBER) -> int:
+    """Liveness-probe each device with a tiny round-trip computation (the
+    MPPAlive probe analog, mpp_probe.go detect loop) and blacklist the ones
+    that fail. Returns how many new failures were recorded — the production
+    attribution path when an XLA error doesn't name its device."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 0
+    for d in devices:
+        try:
+            jax.device_get(jax.device_put(jnp.zeros(8, jnp.int32), d) + 1)
+            prober.report_ok(d)
+        except Exception:
+            prober.report_failure(d)
+            n += 1
+    return n
+
+
+class MPPRetryExhausted(Exception):
+    """All MPP attempts failed — the session re-plans without MPP (ref:
+    executor_with_retry giving up → error surfaced / fallback)."""

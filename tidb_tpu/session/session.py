@@ -723,9 +723,25 @@ class Session:
                 plan = self._plan_select(stmt, cache_key=cache_key)
             from tidb_tpu.executor import build_executor
 
-            with self.span("execute"):
-                ex = build_executor(plan, self)
-                chunk = ex.execute()
+            from tidb_tpu.parallel.probe import MPPRetryExhausted
+
+            try:
+                with self.span("execute"):
+                    ex = build_executor(plan, self)
+                    chunk = ex.execute()
+            except MPPRetryExhausted:
+                # MPP gave up (device failures) → re-plan without MPP and run
+                # on the surviving engines (ref: mpp retry exhaustion falling
+                # back rather than failing the statement)
+                prev = self.vars.get("tidb_allow_mpp", 1)
+                self.vars["tidb_allow_mpp"] = 0
+                try:
+                    with self.span("mpp-fallback"):
+                        plan = self._plan_select(stmt, cache_key=None)
+                        ex = build_executor(plan, self)
+                        chunk = ex.execute()
+                finally:
+                    self.vars["tidb_allow_mpp"] = prev
         finally:
             self._read_ts_override = None
             self._deadline = None
