@@ -38,7 +38,14 @@ Q6 = """SELECT SUM(l_extendedprice * l_discount) FROM lineitem
     AND l_discount BETWEEN 0.05 AND 0.07 AND l_quantity < 24"""
 
 # the remaining BASELINE.json configs: full-scan count, Q10-style TopN
-# pushdown, Q3-style MPP join (2-way exchange)
+# pushdown, Q3-style MPP join (2-way exchange); plus a windowed config
+# (ranking + framed agg over sorted partitions — the device window kernel)
+WINDOWED = """SELECT l_returnflag, MAX(rn), MAX(cum) FROM (
+    SELECT l_returnflag,
+           ROW_NUMBER() OVER (PARTITION BY l_returnflag ORDER BY l_extendedprice) AS rn,
+           SUM(l_quantity) OVER (PARTITION BY l_returnflag ORDER BY l_extendedprice) AS cum
+    FROM lineitem WHERE l_shipdate < DATE '1994-01-01') t
+    GROUP BY l_returnflag ORDER BY l_returnflag"""
 COUNT_STAR = "SELECT COUNT(*) FROM lineitem"
 Q10 = """SELECT l_returnflag, l_extendedprice FROM lineitem
   WHERE l_shipdate >= DATE '1994-01-01'
@@ -113,6 +120,7 @@ def main():
     cnt_tpu = timed(s, COUNT_STAR, REPS)
     q10_tpu = timed(s, Q10, REPS)
     q3_tpu = timed(s, Q3, max(1, REPS // 2))
+    win_tpu = timed(s, WINDOWED, max(1, REPS // 2))
     tpu_rows = s.query(Q1)
 
     s.execute("SET tidb_isolation_read_engines = 'host'")
@@ -122,6 +130,7 @@ def main():
     q10_host = timed(s, Q10, HOST_REPS)
     s.execute("SET tidb_allow_mpp = 0")  # host reference path for the join
     q3_host = timed(s, Q3, HOST_REPS)
+    win_host = timed(s, WINDOWED, HOST_REPS)
     s.execute("SET tidb_allow_mpp = 1")
     host_rows = s.query(Q1)
 
@@ -149,6 +158,8 @@ def main():
             "q10_topn_host_ms": round(q10_host * 1e3, 1),
             "q3_join_mpp_ms": round(q3_tpu * 1e3, 1),
             "q3_join_host_ms": round(q3_host * 1e3, 1),
+            "window_tpu_ms": round(win_tpu * 1e3, 1),
+            "window_host_ms": round(win_host * 1e3, 1),
             "load_s": round(load_s, 1),
             "platform": _platform(),
         },

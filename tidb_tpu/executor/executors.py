@@ -12,7 +12,7 @@ from tidb_tpu.copr import dagpb
 from tidb_tpu.copr.host_engine import _aggregate as host_aggregate  # complete-mode agg
 from tidb_tpu.copr.host_engine import _selection as host_selection
 from tidb_tpu.copr.host_engine import finalize_agg, sort_perm
-from tidb_tpu.expression.expr import AggDesc, ColumnRef, EvalBatch, eval_to_column
+from tidb_tpu.expression.expr import AggDesc, ColumnRef, Constant, EvalBatch, eval_to_column
 from tidb_tpu.kv import tablecodec
 from tidb_tpu.kv.kv import Request, RequestType, StoreType
 from tidb_tpu.kv.rowcodec import RowSchema, decode_row
@@ -87,7 +87,7 @@ def _build_executor(plan, session) -> Executor:
     if isinstance(plan, PhysSetOp):
         return SetOpExec(plan, [build_executor(c, session) for c in plan.children])
     if isinstance(plan, PhysWindow):
-        return WindowExec(plan, build_executor(plan.children[0], session))
+        return WindowExec(plan, build_executor(plan.children[0], session), session)
     if isinstance(plan, PhysDual):
         return DualExec(plan)
     if isinstance(plan, PhysMemSource):
@@ -636,6 +636,7 @@ class WindowExec(Executor):
 
     plan: PhysWindow
     child: Executor
+    session: object = None
 
     def __post_init__(self):
         self.schema = self.plan.schema
@@ -652,6 +653,9 @@ class WindowExec(Executor):
                     for f in p.funcs
                 ]
             )
+        dev = self._try_device(chunk, n)
+        if dev is not None:
+            return dev
         keys = [[e.to_pb(), False] for e in p.partition_by] + [
             [e.to_pb(), d] for e, d in p.order_by
         ]
@@ -661,14 +665,16 @@ class WindowExec(Executor):
         part_start[0] = True
         for e in p.partition_by:
             c = eval_to_column(e, batch, np)
-            d, v = c.data[perm], c.validity[perm]
+            # mask NULL slots: computed-expression garbage must not split a
+            # NULL partition (same rule as the device kernel)
+            d, v = np.where(c.validity, c.data, 0)[perm], c.validity[perm]
             part_start[1:] |= (d[1:] != d[:-1]) | (v[1:] != v[:-1])
         # order-key peer groups: ranking functions always use these, whatever
         # the frame says (MySQL ignores frames for ranking)
         peer_start = part_start.copy()
         for e, _ in p.order_by:
             c = eval_to_column(e, batch, np)
-            d, v = c.data[perm], c.validity[perm]
+            d, v = np.where(c.validity, c.data, 0)[perm], c.validity[perm]
             peer_start[1:] |= (d[1:] != d[:-1]) | (v[1:] != v[:-1])
         pbounds = np.flatnonzero(part_start).tolist() + [n]
         out_cols = []
@@ -685,6 +691,115 @@ class WindowExec(Executor):
                 else None
             )
             out_cols.append(Column(data, valid, f.ftype, dic))
+        return Chunk(list(chunk.columns) + out_cols)
+
+    def _try_device(self, chunk: Chunk, n: int):
+        """Window evaluation on the device via ops/window_kernel (sorted-batch
+        segment program) when the shape qualifies; None → host sweep."""
+        from tidb_tpu.ops import window_kernel as wk
+
+        p = self.plan
+        if self.session is None or not (wk.DEVICE_MIN_ROWS <= n <= wk.DEVICE_MAX_ROWS):
+            return None
+        engines = str(self.session.vars.get("tidb_isolation_read_engines", "tpu,host"))
+        if "tpu" not in engines:
+            return None
+        # frame tag (node-level)
+        if p.frame is not None:
+            frame_tag = ("rows",) + tuple(p.frame)
+        elif p.whole_partition:
+            frame_tag = "whole"
+        elif p.rows_frame:
+            frame_tag = "rows_cur"
+        else:
+            frame_tag = "range_cur"
+        bounded = isinstance(frame_tag, tuple)
+        # phase 1: reject on static structure only (expression ftypes and
+        # plan-time constants) — no column evaluation until the shape is
+        # known-supported, so fallbacks don't pay O(n) twice
+        if any((e.ftype.kind == TypeKind.STRING) for e, _ in p.order_by):
+            return None  # dict codes are not ORDER-comparable
+        specs = []
+        for f in p.funcs:
+            if f.name not in wk.SUPPORTED:
+                return None
+            if bounded and f.name in ("min", "max"):
+                return None  # sliding extreme: host sweep only
+            has_arg = bool(f.args)
+            is_f = bool(f.args) and f.args[0].ftype.kind == TypeKind.FLOAT
+            c0 = c1 = 0
+            c2f = False
+            if has_arg and f.args[0].ftype.kind == TypeKind.STRING:
+                return None
+            if f.name == "ntile":
+                if not isinstance(f.args[0], Constant) or f.args[0].value is None:
+                    return None
+                c0 = int(f.args[0].value)
+                has_arg = False
+                if c0 <= 0:
+                    return None
+            elif f.name in ("lead", "lag"):
+                if len(f.args) > 1:
+                    if not isinstance(f.args[1], Constant) or f.args[1].value is None:
+                        return None
+                    c0 = int(f.args[1].value)
+                else:
+                    c0 = 1
+                if len(f.args) > 2:
+                    d2 = f.args[2]
+                    if not isinstance(d2, Constant) or d2.ftype.kind == TypeKind.STRING:
+                        return None
+                    from tidb_tpu.types.datum import Datum
+
+                    c2f = d2.value is not None
+                    c1 = Datum(d2.value, d2.ftype).physical() if c2f else 0
+            elif f.name == "avg":
+                c0 = 10 ** (f.ftype.scale - f.args[0].ftype.scale) if f.ftype.kind == TypeKind.DECIMAL else 0
+            specs.append((f.name, has_arg, is_f, c0, c1, c2f))
+
+        # phase 2: evaluate lanes (shape is supported from here on)
+        batch = EvalBatch.from_chunk(chunk)
+
+        def lane_of(e):
+            c = eval_to_column(e, batch, np)
+            return (c.data.astype(np.float64 if c.ftype.kind == TypeKind.FLOAT else np.int64), c.validity)
+
+        # partition keys need only identity → dictionary codes qualify
+        part = [lane_of(e) for e in p.partition_by]
+        order = [lane_of(e) for e, _ in p.order_by]
+        arg_lanes = []
+        for f, (name, has_arg, is_f, _, _, _) in zip(p.funcs, specs):
+            arg_lanes.append(lane_of(f.args[0]) if has_arg else None)
+
+        from tidb_tpu.utils.chunk import bucket_size
+
+        n_pad = bucket_size(n)
+
+        def pad(pair):
+            d, v = pair
+            pd = np.zeros(n_pad, dtype=d.dtype)
+            pd[:n] = d
+            pv = np.zeros(n_pad, dtype=bool)
+            pv[:n] = v
+            return (pd, pv)
+
+        spec = (len(part), tuple(d for _, d in p.order_by), frame_tag, tuple(specs))
+        fn = wk.get_window_fn(spec, n_pad)
+        import jax
+
+        flat = fn(
+            tuple(pad(x) for x in part),
+            tuple(pad(x) for x in order),
+            tuple(pad(x) if x is not None else (np.zeros(n_pad, np.int64), np.zeros(n_pad, bool)) for x in arg_lanes),
+            np.int64(n),
+        )
+        got = jax.device_get(flat)  # one batched transfer
+        out_cols = []
+        for i, f in enumerate(p.funcs):
+            data = np.asarray(got[2 * i])[:n]
+            valid = np.asarray(got[2 * i + 1])[:n].astype(bool)
+            dt = _np_dtype(f.ftype)
+            out_cols.append(Column(data.astype(dt, copy=False), valid, f.ftype))
         return Chunk(list(chunk.columns) + out_cols)
 
     def _compute(self, f, argcols, perm, pbounds, peer_start):
