@@ -110,3 +110,47 @@ def test_many_threads(srv):
     for t in threads:
         t.join(timeout=30)
     assert not errs
+
+
+def test_binary_prepared_protocol(srv):
+    """COM_STMT_PREPARE/EXECUTE/CLOSE — the wire path real drivers use for
+    parameterized queries (ref: conn.go:1281-1428 binary protocol)."""
+    import datetime
+
+    server, port = srv
+    c = Client(port=port)
+    c.query("CREATE TABLE bp (id BIGINT PRIMARY KEY, v DECIMAL(8,2), s VARCHAR(16), d DATE, t DATETIME, du TIME)")
+    sid, nparams = c.prepare("INSERT INTO bp VALUES (?, ?, ?, ?, ?, ?)")
+    assert nparams == 6
+    assert c.execute(sid, [1, "12.50", "hello", "2024-03-05", "2024-03-05 10:00:01", "08:30:00"]) == 1
+    assert c.execute(sid, [2, None, None, None, None, None]) == 1
+    c.stmt_close(sid)
+
+    sid2, np2 = c.prepare("SELECT id, v, s, d, t, du FROM bp WHERE id >= ? ORDER BY id")
+    assert np2 == 1
+    rows = c.execute(sid2, [1])
+    assert rows == [
+        (1, "12.50", "hello", datetime.date(2024, 3, 5),
+         datetime.datetime(2024, 3, 5, 10, 0, 1), datetime.timedelta(hours=8, minutes=30)),
+        (2, None, None, None, None, None),
+    ]
+    # re-execute with different params, types carried from first execute
+    assert c.execute(sid2, [2]) == [(2, None, None, None, None, None)]
+    c.stmt_close(sid2)
+    # closed statement is gone
+    import pytest as _pytest
+
+    with _pytest.raises(MySQLError):
+        c.execute(sid2, [1])
+    c.close()
+
+
+def test_binary_protocol_param_types(srv):
+    server, port = srv
+    c = Client(port=port)
+    c.query("CREATE TABLE bt (a BIGINT, b DOUBLE)")
+    sid, _ = c.prepare("INSERT INTO bt VALUES (?, ?)")
+    c.execute(sid, [-5, 2.25])
+    sid2, _ = c.prepare("SELECT a, b FROM bt WHERE a = ? AND b < ?")
+    assert c.execute(sid2, [-5, 3.0]) == [(-5, 2.25)]
+    c.close()

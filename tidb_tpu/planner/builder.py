@@ -1286,6 +1286,16 @@ def _literal(node: ast.Literal) -> Constant:
         return Constant(v, bigint_type(nullable=False))
     if isinstance(v, float):
         return Constant(v, double_type(nullable=False))
+    import datetime
+
+    if isinstance(v, datetime.timedelta):
+        from tidb_tpu.types.datum import duration_to_micros
+
+        return Constant(duration_to_micros(v), FieldType(TypeKind.DURATION, nullable=False))
+    if isinstance(v, datetime.datetime):
+        return Constant(datetime_to_micros(v), FieldType(TypeKind.DATETIME, nullable=False))
+    if isinstance(v, datetime.date):
+        return Constant(date_to_days(v), FieldType(TypeKind.DATE, nullable=False))
     return Constant(v, string_type(nullable=False))
 
 
@@ -1307,6 +1317,10 @@ def _const_like(v) -> Constant:
         return Constant(datetime_to_micros(v), FieldType(TypeKind.DATETIME, nullable=False))
     if isinstance(v, datetime.date):
         return Constant(date_to_days(v), FieldType(TypeKind.DATE, nullable=False))
+    if isinstance(v, datetime.timedelta):
+        from tidb_tpu.types.datum import duration_to_micros
+
+        return Constant(duration_to_micros(v), FieldType(TypeKind.DURATION, nullable=False))
     return Constant(v, string_type(nullable=False))
 
 

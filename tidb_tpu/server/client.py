@@ -82,14 +82,18 @@ class Client:
         self.columns = cols
         return rows
 
-    def _parse_coldef(self, pkt: bytes) -> str:
+    def _parse_coldef(self, pkt: bytes, with_type: bool = False):
         off = 0
         vals = []
         for _ in range(6):  # catalog, schema, table, org_table, name, org_name
             ln, off = p.read_lenc_int(pkt, off)
             vals.append(pkt[off : off + ln])
             off += ln
-        return vals[4].decode()
+        name = vals[4].decode()
+        if with_type:
+            # fixed block: 0x0c marker, charset u16, length u32, then type
+            return name, pkt[off + 1 + 2 + 4]
+        return name
 
     def _parse_row(self, pkt: bytes, ncols: int) -> tuple:
         off = 0
@@ -107,6 +111,136 @@ class Client:
     def _expect_eof(self) -> None:
         pkt = self.io.read()
         assert pkt[0] == 0xFE, "expected EOF packet"
+
+    # -- binary prepared protocol (COM_STMT_*; what real drivers use for
+    # parameterized queries — PyMySQL/Connector-J prepare by default) -------
+    def prepare(self, sql: str) -> tuple[int, int]:
+        """→ (stmt_id, n_params)."""
+        self.io.reset_seq()
+        self.io.write(bytes([p.COM_STMT_PREPARE]) + sql.encode("utf-8"))
+        pkt = self.io.read()
+        if pkt[0] == 0xFF:
+            raise self._err(pkt)
+        stmt_id, ncols, nparams = struct.unpack_from("<IHH", pkt, 1)
+        for _ in range(nparams):
+            self.io.read()  # param defs
+        if nparams:
+            self._expect_eof()
+        for _ in range(ncols):
+            self.io.read()  # column defs
+        if ncols:
+            self._expect_eof()
+        return stmt_id, nparams
+
+    def execute(self, stmt_id: int, params: list = ()):
+        """Binary execute → list of decoded python tuples, or affected count."""
+        body = bytearray(struct.pack("<IBI", stmt_id, 0, 1))
+        n = len(params)
+        if n:
+            nb = bytearray((n + 7) // 8)
+            types = bytearray()
+            vals = bytearray()
+            for i, v in enumerate(params):
+                if v is None:
+                    nb[i // 8] |= 1 << (i % 8)
+                    types += struct.pack("<H", p.T_NULL)
+                elif isinstance(v, bool):
+                    types += struct.pack("<H", p.T_TINY)
+                    vals += struct.pack("<b", int(v))
+                elif isinstance(v, int):
+                    types += struct.pack("<H", p.T_LONGLONG)
+                    vals += struct.pack("<q", v)
+                elif isinstance(v, float):
+                    types += struct.pack("<H", p.T_DOUBLE)
+                    vals += struct.pack("<d", v)
+                else:
+                    b = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+                    types += struct.pack("<H", p.T_VAR_STRING)
+                    vals += p.lenc_str(b)
+            body += bytes(nb) + b"\x01" + bytes(types) + bytes(vals)
+        self.io.reset_seq()
+        self.io.write(bytes([p.COM_STMT_EXECUTE]) + bytes(body))
+        pkt = self.io.read()
+        if pkt[0] == 0xFF:
+            raise self._err(pkt)
+        if pkt[0] == 0x00:  # OK (a resultset column count is never 0)
+            affected, _ = p.read_lenc_int(pkt, 1)
+            return affected
+        ncols, _ = p.read_lenc_int(pkt, 0)
+        coltypes = []
+        cols = []
+        for _ in range(ncols):
+            name, tc = self._parse_coldef(self.io.read(), with_type=True)
+            cols.append(name)
+            coltypes.append(tc)
+        self._expect_eof()
+        rows = []
+        while True:
+            pkt = self.io.read()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            if pkt[0] == 0xFF:
+                raise self._err(pkt)
+            rows.append(self._parse_binary_row(pkt, coltypes))
+        self.columns = cols
+        return rows
+
+    def stmt_close(self, stmt_id: int) -> None:
+        self.io.reset_seq()
+        self.io.write(bytes([p.COM_STMT_CLOSE]) + struct.pack("<I", stmt_id))
+
+    def _parse_binary_row(self, pkt: bytes, coltypes: list) -> tuple:
+        import datetime as _dt
+
+        n = len(coltypes)
+        nb_len = (n + 9) // 8
+        nb = pkt[1 : 1 + nb_len]
+        off = 1 + nb_len
+        out = []
+        for i, t in enumerate(coltypes):
+            if nb[(i + 2) // 8] & (1 << ((i + 2) % 8)):
+                out.append(None)
+                continue
+            if t == p.T_LONGLONG:
+                out.append(struct.unpack_from("<q", pkt, off)[0])
+                off += 8
+            elif t == p.T_DOUBLE:
+                out.append(struct.unpack_from("<d", pkt, off)[0])
+                off += 8
+            elif t == p.T_DATE:
+                ln = pkt[off]
+                off += 1
+                y, mo, d = struct.unpack_from("<HBB", pkt, off) if ln >= 4 else (0, 1, 1)
+                out.append(_dt.date(y, mo, d))
+                off += ln
+            elif t == p.T_DATETIME:
+                ln = pkt[off]
+                off += 1
+                y = mo = d = h = mi = s = us = 0
+                if ln >= 4:
+                    y, mo, d = struct.unpack_from("<HBB", pkt, off)
+                if ln >= 7:
+                    h, mi, s = struct.unpack_from("<BBB", pkt, off + 4)
+                if ln >= 11:
+                    us = struct.unpack_from("<I", pkt, off + 7)[0]
+                out.append(_dt.datetime(y, mo, d, h, mi, s, us))
+                off += ln
+            elif t == p.T_TIME:
+                ln = pkt[off]
+                off += 1
+                if ln == 0:
+                    out.append(_dt.timedelta(0))
+                else:
+                    neg, days, h, mi, s = struct.unpack_from("<BIBBB", pkt, off)
+                    us = struct.unpack_from("<I", pkt, off + 8)[0] if ln >= 12 else 0
+                    td = _dt.timedelta(days=days, hours=h, minutes=mi, seconds=s, microseconds=us)
+                    out.append(-td if neg else td)
+                off += ln
+            else:  # lenc-encoded (decimal/string/json)
+                ln, off = p.read_lenc_int(pkt, off)
+                out.append(pkt[off : off + ln].decode("utf-8", "replace"))
+                off += ln
+        return tuple(out)
 
     def ping(self) -> bool:
         self.io.reset_seq()

@@ -166,3 +166,146 @@ def text_value(v) -> Optional[bytes]:
             return v.isoformat(sep=" ").encode()
         return v.isoformat().encode()
     return str(v).encode("utf-8")
+
+
+# -- binary (prepared-statement) protocol ------------------------------------
+# ref: conn.go:1281-1428 COM_STMT_* dispatch + MySQL binary resultset rows
+
+COM_STMT_PREPARE = 0x16
+COM_STMT_EXECUTE = 0x17
+COM_STMT_SEND_LONG_DATA = 0x18
+COM_STMT_CLOSE = 0x19
+COM_STMT_RESET = 0x1A
+
+T_TINY = 1
+T_SHORT = 2
+T_LONG = 3
+T_FLOAT = 4
+T_NULL = 6
+T_INT24 = 9
+T_YEAR = 13
+T_VARCHAR = 15
+T_BLOB = 252
+T_STRING = 254
+
+
+def stmt_prepare_ok(stmt_id: int, num_cols: int, num_params: int) -> bytes:
+    return b"\x00" + struct.pack("<IHH", stmt_id, num_cols, num_params) + b"\x00" + struct.pack("<H", 0)
+
+
+def decode_binary_params(data: bytes, off: int, n_params: int, prev_types=None):
+    """COM_STMT_EXECUTE payload → python values (ref: parseExecArgs /
+    binary protocol value layout). Returns (values, types) — types persist
+    across executions when new_params_bound is 0."""
+    if n_params == 0:
+        return [], prev_types
+    nb_len = (n_params + 7) // 8
+    null_bitmap = data[off : off + nb_len]
+    off += nb_len
+    new_bound = data[off]
+    off += 1
+    if new_bound:
+        types = [struct.unpack_from("<H", data, off + 2 * i)[0] for i in range(n_params)]
+        off += 2 * n_params
+    else:
+        types = prev_types
+        if types is None:
+            raise ValueError("binary execute without parameter types")
+    vals: list = []
+    for i in range(n_params):
+        if null_bitmap[i // 8] & (1 << (i % 8)):
+            vals.append(None)
+            continue
+        t = types[i] & 0xFF
+        unsigned = bool(types[i] & 0x8000)
+        if t in (T_TINY,):
+            vals.append(struct.unpack_from("<b", data, off)[0])
+            off += 1
+        elif t in (T_SHORT, T_YEAR):
+            vals.append(struct.unpack_from("<h", data, off)[0])
+            off += 2
+        elif t in (T_LONG, T_INT24):
+            vals.append(struct.unpack_from("<i", data, off)[0])
+            off += 4
+        elif t == T_LONGLONG:
+            fmt = "<Q" if unsigned else "<q"
+            vals.append(struct.unpack_from(fmt, data, off)[0])
+            off += 8
+        elif t == T_FLOAT:
+            vals.append(struct.unpack_from("<f", data, off)[0])
+            off += 4
+        elif t == T_DOUBLE:
+            vals.append(struct.unpack_from("<d", data, off)[0])
+            off += 8
+        elif t == T_NULL:
+            vals.append(None)
+        elif t in (T_DATE, T_DATETIME, 7):  # 7 = TIMESTAMP
+            import datetime as _dt
+
+            ln = data[off]
+            off += 1
+            y = mo = d = h = mi = s = us = 0
+            if ln >= 4:
+                y, mo, d = struct.unpack_from("<HBB", data, off)
+            if ln >= 7:
+                h, mi, s = struct.unpack_from("<BBB", data, off + 4)
+            if ln >= 11:
+                us = struct.unpack_from("<I", data, off + 7)[0]
+            off += ln
+            if t == T_DATE and ln <= 4:
+                vals.append(_dt.date(y, mo, d) if ln else None)
+            else:
+                vals.append(_dt.datetime(y, mo, d, h, mi, s, us) if ln else None)
+        elif t == T_TIME:
+            import datetime as _dt
+
+            ln = data[off]
+            off += 1
+            if ln == 0:
+                vals.append(_dt.timedelta(0))
+            else:
+                neg, days, h, mi, s = struct.unpack_from("<BIBBB", data, off)
+                us = struct.unpack_from("<I", data, off + 8)[0] if ln >= 12 else 0
+                td = _dt.timedelta(days=days, hours=h, minutes=mi, seconds=s, microseconds=us)
+                vals.append(-td if neg else td)
+            off += ln
+        else:  # lenc string/blob/decimal
+            v, off = read_lenc_int(data, off)
+            raw = data[off : off + v]
+            off += v
+            vals.append(raw.decode("utf-8", "surrogateescape"))
+    return vals, types
+
+
+def binary_row(row, ftypes) -> bytes:
+    """One binary-protocol resultset row (ref: writeBinaryRow): 0x00 header,
+    null bitmap with offset 2, then per-type values."""
+    n = len(row)
+    nb = bytearray((n + 9) // 8)
+    body = bytearray()
+    for i, v in enumerate(row):
+        if v is None:
+            nb[(i + 2) // 8] |= 1 << ((i + 2) % 8)
+            continue
+        ft = ftypes[i] if ftypes is not None and i < len(ftypes) and ftypes[i] is not None else None
+        tc = type_for(ft)[0] if ft is not None else T_VAR_STRING
+        if tc == T_LONGLONG:
+            body += struct.pack("<q", int(v) if int(v) < 1 << 63 else int(v) - (1 << 64))
+        elif tc == T_DOUBLE:
+            body += struct.pack("<d", float(v))
+        elif tc == T_DATE:
+            body += bytes([4]) + struct.pack("<HBB", v.year, v.month, v.day)
+        elif tc == T_DATETIME:
+            body += bytes([11]) + struct.pack("<HBBBBB", v.year, v.month, v.day, v.hour, v.minute, v.second) + struct.pack("<I", v.microsecond)
+        elif tc == T_TIME:
+            total_us = int(v.total_seconds() * 1_000_000)
+            neg = total_us < 0
+            a = abs(total_us)
+            days, rem = divmod(a, 86_400_000_000)
+            h, rem = divmod(rem, 3_600_000_000)
+            mi, rem = divmod(rem, 60_000_000)
+            s, us = divmod(rem, 1_000_000)
+            body += bytes([12]) + struct.pack("<BIBBB", int(neg), days, h, mi, s) + struct.pack("<I", us)
+        else:  # decimal/string/json → lenc text
+            body += lenc_str(text_value(v) or b"")
+    return b"\x00" + bytes(nb) + bytes(body)

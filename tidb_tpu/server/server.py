@@ -25,6 +25,10 @@ class ClientConn:
         self.current_sql: Optional[str] = None
         self.connected_at = time.time()
         self.authed = False  # set after a successful handshake
+        # binary-protocol prepared statements: stmt_id → (name, n_params,
+        # param types from the last execute) (ref: conn.go stmts map)
+        self.stmts: dict[int, list] = {}
+        self._next_stmt_id = 1
 
     # -- handshake (protocol v10) ------------------------------------------
     def handshake(self, io: p.PacketIO) -> bool:
@@ -110,6 +114,21 @@ class ClientConn:
                     self._run_sql(io, f"USE `{data.decode()}`")
                 elif cmd == p.COM_QUERY:
                     self._run_sql(io, data.decode("utf-8"))
+                elif cmd == p.COM_STMT_PREPARE:
+                    self._stmt_prepare(io, data.decode("utf-8"))
+                elif cmd == p.COM_STMT_EXECUTE:
+                    self._stmt_execute(io, data)
+                elif cmd == p.COM_STMT_CLOSE:
+                    sid = struct.unpack_from("<I", data, 0)[0]
+                    st = self.stmts.pop(sid, None)
+                    if st is not None:
+                        self.session.prepared.pop(st[0], None)
+                    # COM_STMT_CLOSE sends no response (protocol)
+                elif cmd == p.COM_STMT_SEND_LONG_DATA:
+                    pass  # protocol: no response; long data unsupported → the
+                    # execute fails cleanly on the missing parameter
+                elif cmd == p.COM_STMT_RESET:
+                    io.write(p.ok_packet())
                 else:
                     io.write(p.err_packet(1047, f"Unknown command {cmd}", "08S01"))
         finally:
@@ -120,6 +139,60 @@ class ClientConn:
                 self.sock.close()
             except OSError:
                 pass
+
+    # -- binary prepared protocol (ref: conn.go:1281-1428 COM_STMT_*) --------
+    def _stmt_prepare(self, io: p.PacketIO, sql: str) -> None:
+        try:
+            name = f"__bin_{self._next_stmt_id}"
+            self.session.prepare(sql, name)
+            ps = self.session.prepared[name]
+        except Exception as e:
+            io.write(p.err_packet(1105, str(e)))
+            return
+        sid = self._next_stmt_id
+        self._next_stmt_id += 1
+        self.stmts[sid] = [name, ps.n_params, None]
+        # column count is statement-dependent; drivers tolerate 0 here and
+        # read the real defs from the execute response (the reference also
+        # reports best-effort metadata at prepare time)
+        io.write(p.stmt_prepare_ok(sid, 0, ps.n_params))
+        if ps.n_params:
+            for i in range(ps.n_params):
+                io.write(p.column_def(f"?{i}", p.T_VAR_STRING))
+            io.write(p.eof_packet())
+
+    def _stmt_execute(self, io: p.PacketIO, data: bytes) -> None:
+        sid = struct.unpack_from("<I", data, 0)[0]
+        st = self.stmts.get(sid)
+        if st is None:
+            io.write(p.err_packet(1243, f"Unknown prepared statement handler ({sid})", "HY000"))
+            return
+        name, n_params, prev_types = st
+        try:
+            vals, types = p.decode_binary_params(data, 9, n_params, prev_types)
+            st[2] = types
+            self.current_sql = f"EXECUTE {name}"
+            res = self.session.execute_prepared(name, vals)
+        except Exception as e:
+            io.write(p.err_packet(1105, str(e)))
+            return
+        finally:
+            self.current_sql = None
+        if not res.columns:
+            io.write(p.ok_packet(affected=res.affected, last_insert_id=res.last_insert_id))
+            return
+        ftypes = getattr(res, "ftypes", None)
+        io.write(p.lenc_int(len(res.columns)))
+        for i, cname in enumerate(res.columns):
+            if ftypes is not None and i < len(ftypes) and ftypes[i] is not None:
+                tc, ln, dec = p.type_for(ftypes[i])
+            else:
+                tc, ln, dec = p.T_VAR_STRING, 255, 0
+            io.write(p.column_def(str(cname), tc, ln, dec))
+        io.write(p.eof_packet())
+        for row in res.rows:
+            io.write(p.binary_row(row, ftypes))
+        io.write(p.eof_packet())
 
     def _run_sql(self, io: p.PacketIO, sql: str) -> None:
         self.current_sql = sql
