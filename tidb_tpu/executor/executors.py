@@ -438,6 +438,10 @@ class ProjectionExec(Executor):
 class FinalAggExec(Executor):
     plan: PhysFinalAgg
     child: Executor
+    session: object = None
+
+    # engage the partial/final worker pipeline past this input size
+    PARALLEL_MIN_ROWS = 200_000
 
     def __post_init__(self):
         self.schema = self.plan.schema
@@ -447,6 +451,9 @@ class FinalAggExec(Executor):
         aggs = self.plan.aggs
         ngroup = len(self.plan.group_by)
         if not self.plan.partial_input:
+            splittable = not any(a.distinct or a.name == "group_concat" for a in aggs)
+            if splittable and len(chunk) >= self.PARALLEL_MIN_ROWS:
+                return self._partial_final_pipeline(chunk)
             ex = dagpb.ExecutorPB(
                 dagpb.AGGREGATION,
                 group_by=[g.to_pb() for g in self.plan.group_by],
@@ -455,6 +462,53 @@ class FinalAggExec(Executor):
             )
             return host_aggregate(chunk, ex)
         return merge_partials(chunk, aggs, ngroup)
+
+    def _partial_final_pipeline(self, chunk: Chunk) -> Chunk:
+        """Partial/final worker pipeline (ref: parallel HashAgg,
+        aggregate/agg_hash_executor.go:94): slices aggregate to partial
+        state concurrently; partials spill through a tracker-registered
+        RowContainer (ref: agg_spill.go) before the final merge."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from tidb_tpu.utils.rowcontainer import RowContainer
+
+        p = self.plan
+        n = len(chunk)
+        conc = 4
+        tracker = None
+        if self.session is not None:
+            conc = max(1, int(self.session.vars.get("tidb_executor_concurrency", 4)))
+            tracker = getattr(self.session, "mem_tracker", None)
+        per = max((n + conc - 1) // conc, 65536)
+        bounds = [(i, min(i + per, n)) for i in range(0, n, per)]
+        pex = dagpb.ExecutorPB(
+            dagpb.AGGREGATION,
+            group_by=[g.to_pb() for g in p.group_by],
+            aggs=[a.to_pb() for a in p.aggs],
+            agg_mode=dagpb.AGG_PARTIAL,
+        )
+        rc = RowContainer(tracker, "agg-partials")
+        try:
+            if len(bounds) > 1:
+                with ThreadPoolExecutor(max_workers=min(conc, len(bounds)), thread_name_prefix="agg") as pool:
+                    parts = list(pool.map(lambda b: host_aggregate(chunk.slice(*b), pex), bounds))
+            else:
+                parts = [host_aggregate(chunk.slice(*b), pex) for b in bounds]
+            for part in parts:
+                rc.add(part)
+            merged = rc.to_chunk()
+        finally:
+            rc.close()
+        if merged is None or not len(merged):
+            # empty input: fall through to the complete-mode scalar handling
+            ex = dagpb.ExecutorPB(
+                dagpb.AGGREGATION,
+                group_by=[g.to_pb() for g in p.group_by],
+                aggs=[a.to_pb() for a in p.aggs],
+                agg_mode=dagpb.AGG_COMPLETE,
+            )
+            return host_aggregate(chunk, ex)
+        return merge_partials(merged, p.aggs, len(p.group_by))
 
 
 def merge_partials(chunk: Chunk, aggs: list[AggDesc], ngroup: int) -> Chunk:
@@ -1071,6 +1125,7 @@ class HashJoinExec(Executor):
     plan: PhysHashJoin
     left: Executor
     right: Executor
+    session: object = None
 
     def __post_init__(self):
         self.schema = self.plan.schema
@@ -1086,7 +1141,6 @@ class HashJoinExec(Executor):
         p = self.plan
         lc = self.left.execute()
         rc = self.right.execute()
-        nleft = len(lc.columns)
         if p.kind in ("semi", "anti"):
             return self._semi_anti(lc, rc)
         if p.kind == "cross" and not p.eq_conds:
@@ -1096,6 +1150,60 @@ class HashJoinExec(Executor):
                 [c.take(li) for c in lc.columns] + [c.take(ri) for c in rc.columns]
             )
             return self._apply_other(joined)
+        # grace-join spill (ref: join/hash_join_spill.go): when the inputs
+        # exceed a share of the memory quota, partition both sides by key
+        # hash and join partition-by-partition, accumulating output through
+        # a tracker-registered spillable container — peak memory is bounded
+        # by one partition plus spilled output pages
+        tracker = getattr(self.session, "mem_tracker", None) if self.session is not None else None
+        quota = tracker.limit if tracker is not None and tracker.limit > 0 else -1
+        if quota > 0 and p.eq_conds:
+            in_bytes = sum(
+                c.data.nbytes + c.validity.nbytes for c in list(lc.columns) + list(rc.columns)
+            )
+            numeric = not any(
+                lc.columns[l].ftype.kind == TypeKind.STRING or rc.columns[r].ftype.kind == TypeKind.STRING
+                for l, r in p.eq_conds
+            )
+            if in_bytes > quota // 4 and numeric:
+                return self._partitioned_join(lc, rc, in_bytes, quota, tracker)
+        return self._join_pair(lc, rc)
+
+    def _partitioned_join(self, lc: Chunk, rc: Chunk, in_bytes: int, quota: int, tracker) -> Chunk:
+        from tidb_tpu.utils.rowcontainer import RowContainer
+
+        p = self.plan
+        K = 2
+        while K < 64 and in_bytes // K > max(quota // 8, 1):
+            K *= 2
+        MIX = np.int64(-7046029254386353131)
+
+        def owners(chunk, poss):
+            with np.errstate(over="ignore"):
+                h = chunk.columns[poss[0]].data.astype(np.int64).copy()
+                for pos in poss[1:]:
+                    h = h * MIX + chunk.columns[pos].data.astype(np.int64)
+            return (np.abs(h) % K).astype(np.int64)
+
+        lown = owners(lc, [l for l, _ in p.eq_conds])
+        rown = owners(rc, [r for _, r in p.eq_conds])
+        out = RowContainer(tracker, "join-output")
+        try:
+            for k in range(K):
+                lsub = lc.take(np.nonzero(lown == k)[0])
+                rsub = rc.take(np.nonzero(rown == k)[0])
+                if len(lsub) == 0 and (p.kind != "right" or len(rsub) == 0):
+                    continue
+                part = self._join_pair(lsub, rsub)
+                if len(part):
+                    out.add(part)
+            merged = out.to_chunk()
+        finally:
+            out.close()
+        return merged if merged is not None else _empty_chunk(self.schema)
+
+    def _join_pair(self, lc: Chunk, rc: Chunk) -> Chunk:
+        p = self.plan
         # build on right, probe left (ref: hash_join build/probe)
         rkeys = [self._key_array(rc, r) for _, r in p.eq_conds]
         rvalid = [rc.columns[r].validity for _, r in p.eq_conds]
