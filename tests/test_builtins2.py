@@ -270,3 +270,44 @@ def test_timediff_on_dates_and_duration_cast():
     assert d.query("SELECT TIMEDIFF(d1, d2) FROM z") == [(datetime.timedelta(days=3),)]
     assert d.query("SELECT CAST(MAKETIME(1, 1, 1) AS CHAR) FROM z") == [("01:01:01",)]
     assert d.query("SELECT GROUP_CONCAT(TIMEDIFF(d1, d2)) FROM z") == [("72:00:00",)]
+
+
+def test_timediff_mixed_kinds_null(db):
+    # MySQL: TIMEDIFF with mismatched temporal kinds (datetime vs time) is
+    # NULL — the physicals live in different epochs (ref: builtin_time.go)
+    rows = both(db, "SELECT TIMEDIFF(ts, du), TIMEDIFF(du, ts), TIMEDIFF(dt, du) FROM t WHERE id = 1")
+    assert rows == [(None, None, None)]
+    # like kinds still subtract
+    rows = both(db, "SELECT TIMEDIFF(ts, ts), TIMEDIFF(du, du) FROM t WHERE id = 1")
+    assert rows == [(datetime.timedelta(0), datetime.timedelta(0))]
+    # DATE vs DATETIME are both datetime-like
+    rows = both(db, "SELECT TIMEDIFF(ts, dt) FROM t WHERE id = 1")
+    assert rows == [(datetime.timedelta(hours=14, minutes=30, seconds=45),)]
+
+
+def test_addtime_subtime_mixed_kinds(db):
+    # second operand must be a TIME: datetime second args are NULL
+    rows = both(db, "SELECT ADDTIME(ts, ts), SUBTIME(du, dt) FROM t WHERE id = 1")
+    assert rows == [(None, None)]
+    rows = both(db, "SELECT ADDTIME(ts, du), SUBTIME(ts, du) FROM t WHERE id = 1")
+    assert rows == [
+        (datetime.datetime(2024, 3, 6, 1, 0, 45), datetime.datetime(2024, 3, 5, 4, 0, 45))
+    ]
+    # DATE first operand promotes to DATETIME (midnight + duration)
+    rows = both(db, "SELECT ADDTIME(dt, du) FROM t WHERE id = 1")
+    assert rows == [(datetime.datetime(2024, 3, 5, 10, 30, 0),)]
+
+
+def test_week_all_modes(db):
+    # expected values verified against MySQL 8.0 (modes 2/4-7 previously
+    # aliased 0/1/3 and returned wrong numbers)
+    cases = {
+        ("2025-01-01", 0): 0, ("2025-01-01", 1): 1, ("2025-01-01", 2): 52,
+        ("2025-01-01", 3): 1, ("2025-01-01", 4): 1, ("2025-01-01", 5): 0,
+        ("2025-01-01", 6): 1, ("2025-01-01", 7): 53,
+        ("2023-01-01", 2): 1, ("2016-01-02", 6): 52, ("2016-01-03", 4): 1,
+        ("2024-12-31", 1): 53,
+    }
+    for (ds, m), exp in cases.items():
+        got = both(db, f"SELECT WEEK('{ds}', {m}) FROM t WHERE id = 1")
+        assert got == [(exp,)], (ds, m, exp, got)

@@ -122,12 +122,15 @@ def test_binary_prepared_protocol(srv):
     c.query("CREATE TABLE bp (id BIGINT PRIMARY KEY, v DECIMAL(8,2), s VARCHAR(16), d DATE, t DATETIME, du TIME)")
     sid, nparams = c.prepare("INSERT INTO bp VALUES (?, ?, ?, ?, ?, ?)")
     assert nparams == 6
+    assert c.last_prepare_cols == 0  # DML: no result metadata
     assert c.execute(sid, [1, "12.50", "hello", "2024-03-05", "2024-03-05 10:00:01", "08:30:00"]) == 1
     assert c.execute(sid, [2, None, None, None, None, None]) == 1
     c.stmt_close(sid)
 
     sid2, np2 = c.prepare("SELECT id, v, s, d, t, du FROM bp WHERE id >= ? ORDER BY id")
     assert np2 == 1
+    # prepare-time column definitions (mysql_stmt_result_metadata analog)
+    assert c.last_prepare_cols == 6
     rows = c.execute(sid2, [1])
     assert rows == [
         (1, "12.50", "hello", datetime.date(2024, 3, 5),

@@ -127,19 +127,37 @@ def test_no_partition_parity(db):
     both(db, "SELECT v, RANK() OVER (ORDER BY v), SUM(v) OVER (ORDER BY v) FROM w ORDER BY v, x")
 
 
+def test_window_pushes_into_reader(db):
+    # the window lands INSIDE the cop fragment on the tpu engine (ref: tipb
+    # window pushdown to TiFlash) — the fused DAG kernel serves it
+    s = db.session()
+    s.execute("SET tidb_isolation_read_engines = 'tpu,host'")
+    plan = "\n".join(
+        str(r[0]) for r in s.query("EXPLAIN SELECT SUM(v) OVER (PARTITION BY g ORDER BY v) FROM w")
+    )
+    assert "Window(" in plan and "[tpu]" in plan, plan
+    s.execute("SET tidb_isolation_read_engines = 'host'")
+    plan = "\n".join(
+        str(r[0]) for r in s.query("EXPLAIN SELECT SUM(v) OVER (PARTITION BY g ORDER BY v) FROM w")
+    )
+    assert "Window(" not in plan.split("\n")[-1], plan  # host: window stays at the root
+
+
 def test_device_path_actually_engages(db, monkeypatch):
     calls = {"n": 0}
     real = wk.get_window_fn
 
-    def spy(spec, n_pad):
+    def spy(spec, n_pad, bounds=None):
         calls["n"] += 1
-        return real(spec, n_pad)
+        return real(spec, n_pad, bounds)
 
     monkeypatch.setattr(wk, "get_window_fn", spy)
-    db.query("SELECT SUM(v) OVER (PARTITION BY g ORDER BY v) FROM w")
-    assert calls["n"] == 1
-    # string ORDER key → host sweep (dict codes aren't order-comparable)
-    db.query("SELECT RANK() OVER (ORDER BY g) FROM w")
+    # two OVER specs: the second window's child is the already-windowed
+    # reader, so it stays at the root where the standalone kernel serves it
+    db.query(
+        "SELECT SUM(v) OVER (PARTITION BY g ORDER BY v),"
+        " RANK() OVER (PARTITION BY g ORDER BY x) FROM w"
+    )
     assert calls["n"] == 1
 
 

@@ -1,0 +1,138 @@
+"""Window pushdown into the coprocessor fragment (ref: tipb window pushdown
+to TiFlash; unistore has no cop window, so the oracle is the root WindowExec
+host sweep). Covers the fused DAG kernel path single-block and multi-block
+(shrunken _BLOCK), the multi-region host-tail fallback, string order keys
+via sorted dictionaries, and Agg-over-Window fusion."""
+
+import numpy as np
+import pytest
+
+import tidb_tpu
+from tidb_tpu.copr import tpu_engine
+from tidb_tpu.executor.load import bulk_load
+
+
+def _fill(d, n=5000, seed=7):
+    d.execute("CREATE TABLE w (g VARCHAR(4), v BIGINT, x DOUBLE, d2 DECIMAL(8,2))")
+    rng = np.random.default_rng(seed)
+    bulk_load(
+        d,
+        "w",
+        [
+            np.array([b"aa", b"bb", b"cc", b"dd"], dtype="S2")[rng.integers(0, 4, n)],
+            rng.integers(-50, 50, n),
+            rng.random(n) * 10,
+            rng.integers(0, 10000, n),
+        ],
+    )
+    d.execute("INSERT INTO w VALUES (NULL, NULL, NULL, NULL), ('aa', NULL, NULL, NULL)")
+
+
+@pytest.fixture()
+def db():
+    d = tidb_tpu.open(region_split_keys=1 << 62)
+    _fill(d)
+    return d
+
+
+WIN_AGG = (
+    "SELECT g, MAX(rn), MAX(cum) FROM ("
+    " SELECT g, ROW_NUMBER() OVER (PARTITION BY g ORDER BY v) AS rn,"
+    " SUM(v) OVER (PARTITION BY g ORDER BY v) AS cum"
+    " FROM w WHERE v > -20) t GROUP BY g ORDER BY g"
+)
+WIN_ROWS = (
+    "SELECT g, v, RANK() OVER (PARTITION BY g ORDER BY v DESC),"
+    " AVG(d2) OVER (PARTITION BY g) FROM w WHERE v < 30 ORDER BY g, v, x"
+)
+
+
+def both(db, sql):
+    s = db.session()
+    s.execute("SET tidb_isolation_read_engines = 'tpu,host'")
+    dev = s.query(sql)
+    s.execute("SET tidb_isolation_read_engines = 'host'")
+    host = s.query(sql)
+    assert len(dev) == len(host), sql
+    for a, b in zip(sorted(map(str, dev)), sorted(map(str, host))):
+        assert a == b, sql
+    return host
+
+
+def test_pushdown_parity_single_block(db):
+    both(db, WIN_AGG)
+    both(db, WIN_ROWS)
+
+
+def test_agg_fuses_into_reader(db):
+    s = db.session()
+    s.execute("SET tidb_isolation_read_engines = 'tpu,host'")
+    plan = "\n".join(str(r[0]) for r in s.query("EXPLAIN " + WIN_AGG))
+    assert "Window(" in plan and "PartialAgg(" in plan, plan
+    # the fused fragment leaves only the final merge above the reader
+    assert "WindowExec" not in plan
+
+
+def test_multiblock_fused_kernel(db, monkeypatch):
+    # shrink the device block so 5k rows span several blocks: exercises the
+    # concatenated multi-block window program (_exec_window_blocks)
+    monkeypatch.setattr(tpu_engine, "_BLOCK", 1 << 10)
+    calls = {"n": 0}
+    real = tpu_engine._exec_window_blocks
+
+    def spy(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(tpu_engine, "_exec_window_blocks", spy)
+    both(db, WIN_AGG)
+    both(db, WIN_ROWS)
+    assert calls["n"] >= 2
+
+
+def test_multi_region_falls_back_to_host_tail(db):
+    d = tidb_tpu.open(region_split_keys=512)
+    _fill(d, n=3000)
+    s = d.session()
+    s.execute("SET tidb_isolation_read_engines = 'tpu,host'")
+    dev = s.query(WIN_AGG)
+    s.execute("SET tidb_isolation_read_engines = 'host'")
+    host = s.query(WIN_AGG)
+    assert sorted(map(str, dev)) == sorted(map(str, host))
+
+
+def test_string_order_key_pushes_with_sorted_dict(db):
+    both(
+        db,
+        "SELECT v, RANK() OVER (ORDER BY g), DENSE_RANK() OVER (PARTITION BY g ORDER BY g)"
+        " FROM w ORDER BY g, v, x",
+    )
+
+
+def test_window_then_topn_pushdown(db):
+    both(
+        db,
+        "SELECT * FROM (SELECT v, ROW_NUMBER() OVER (PARTITION BY g ORDER BY v) AS rn"
+        " FROM w) t ORDER BY rn, v LIMIT 7",
+    )
+
+
+def _strict_guard(bound, n):
+    """Pack guard with no small-n exemption: any unpackable window sort
+    raises, forcing the host fallback even on tiny test tables."""
+    from tidb_tpu.copr import dagpb
+    from tidb_tpu.copr.binder import UnsupportedForDevice
+    from tidb_tpu.ops.window_core import packed_bits
+
+    for ex in bound.executors[1:]:
+        if ex.tp == dagpb.WINDOW:
+            sb = [tuple(b) if b is not None else None for b in ex.sort_bounds] or None
+            if packed_bits(sb, max(n, 1)) is None:
+                raise UnsupportedForDevice("unpackable (strict test guard)")
+
+
+def test_unpackable_sort_falls_back(db, monkeypatch):
+    # float order keys carry no integer bounds; past the pack-guard scale the
+    # engine must fall back to the host rather than compile a multi-lane sort
+    monkeypatch.setattr(tpu_engine, "_window_pack_guard", _strict_guard)
+    both(db, "SELECT v, RANK() OVER (PARTITION BY g ORDER BY x) FROM w ORDER BY g, v, x")

@@ -35,11 +35,14 @@ _INT_FT = [int(TypeKind.INT), 20, 0, 1, "bin"]
 
 
 class Binder:
-    def __init__(self, cache: ColumnCache, table_id: int, scan_cols: list[dagpb.ColumnInfoPB]):
+    def __init__(self, cache: ColumnCache, table_id: int, scan_cols: list[dagpb.ColumnInfoPB], entry=None):
         self.cache = cache
         self.table_id = table_id
         # scan output offset → (storage slot, ftype)
         self.scan_cols = scan_cols
+        # the region's decoded columns (colcache.RegionColumns) — source of
+        # per-column min/max for the packed window sort; optional
+        self.entry = entry
 
     def _dict_for_offset(self, offset: int):
         c = self.scan_cols[offset]
@@ -48,6 +51,9 @@ class Binder:
     def bind_dag(self, dag: dagpb.DAGRequest) -> dagpb.DAGRequest:
         out = copy.deepcopy(dag)
         scan_seen = False
+        # once an agg/projection rewrites the batch, ColumnRef indexes no
+        # longer address scan outputs and column statistics don't apply
+        refs_are_scan = True
         for ex in out.executors:
             if ex.tp == dagpb.TABLE_SCAN:
                 scan_seen = True
@@ -75,6 +81,7 @@ class Binder:
                             self._force_sorted(a["arg"])
                             allow = True
                         a["arg"] = self.bind_expr(a["arg"], allow_string_ref=allow or a["name"] in ("min", "max"))
+                refs_are_scan = False
             elif ex.tp == dagpb.TOPN:
                 new_order = []
                 for item in ex.order_by:
@@ -83,13 +90,59 @@ class Binder:
                         self._force_sorted(pb)
                     new_order.append([self.bind_expr(pb, allow_string_ref=True), desc])
                 ex.order_by = new_order
+                if refs_are_scan:
+                    # value bounds let the single-key top_k pack the row index
+                    # into the key → exact lowest-index tie-breaking even when
+                    # a tie group overflows the candidate window
+                    ex.sort_bounds = self._bounds_for([pb for pb, _ in new_order])
             elif ex.tp == dagpb.PROJECTION:
                 ex.exprs = [self.bind_expr(e, allow_string_ref=True) for e in ex.exprs]
+                refs_are_scan = False
+            elif ex.tp == dagpb.WINDOW:
+                # partition keys need identity only → string codes qualify
+                ex.partition_by = [self.bind_expr(p, allow_string_ref=True) for p in ex.partition_by]
+                new_order = []
+                for pb, desc in ex.order_by:
+                    if self._is_string(pb):
+                        # sorted dictionary makes codes order-preserving
+                        self._force_sorted(pb)
+                    new_order.append((self.bind_expr(pb, allow_string_ref=True), desc))
+                ex.order_by = new_order
+                for f in ex.win_funcs:
+                    f["args"] = [self.bind_expr(a) for a in f["args"]]
+                ex.sort_bounds = self._window_bounds(ex)
             elif ex.tp == dagpb.LIMIT:
                 pass
             else:
                 raise UnsupportedForDevice(f"executor {ex.tp} on device")
         return out
+
+    def _bounds_for(self, pbs: list) -> list:
+        """(lo, hi) per expression from cached column min/max — powers the
+        packed single-key sorts (window sort, exact-tie TopN). None per lane
+        when the key is an expression, a float, or no region entry is at
+        hand; consumers then fall back (multi-lane sort / heuristic top_k /
+        host engine)."""
+        from tidb_tpu.ops.window_core import widen_bounds
+
+        bounds = []
+        for pb in pbs:
+            b = None
+            if pb["tp"] == "col" and pb["idx"] < len(self.scan_cols):
+                c = self.scan_cols[pb["idx"]]
+                if c.ftype.kind == TypeKind.STRING:
+                    b = (0, max(len(self._dict_for_offset(pb["idx"])) - 1, 0))
+                elif c.ftype.kind != TypeKind.FLOAT and self.entry is not None:
+                    if c.is_handle:
+                        h = self.entry.handles
+                        b = (int(h.min()), int(h.max())) if len(h) else (0, 0)
+                    else:
+                        b = self.entry.minmax(c.column_id)
+            bounds.append(b)
+        return widen_bounds(bounds)
+
+    def _window_bounds(self, ex: dagpb.ExecutorPB) -> list:
+        return self._bounds_for(ex.partition_by + [p for p, _ in ex.order_by])
 
     # -- expression rewriting ----------------------------------------------
     def _is_string(self, pb: dict) -> bool:

@@ -55,6 +55,18 @@ class RegionColumns:
     _stable_take: np.ndarray | None = None
     _delta_take: np.ndarray | None = None  # delta rows shadowed by newer blocks
     _perm: np.ndarray | None = None
+    # per-slot (min, max) over valid values, computed lazily — feeds the
+    # packed window-sort key (binder._window_bounds)
+    _minmax: dict = field(default_factory=dict)
+
+    def minmax(self, slot: int) -> tuple[int, int]:
+        mm = self._minmax.get(slot)
+        if mm is None:
+            d, v = self.cols[slot]
+            lv = d[v]
+            mm = (int(lv.min()), int(lv.max())) if lv.size else (0, 0)
+            self._minmax[slot] = mm
+        return mm
 
 
 class ColumnCache:

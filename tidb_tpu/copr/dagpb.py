@@ -30,6 +30,7 @@ EXCHANGE_SENDER = "exchange_sender"
 EXCHANGE_RECEIVER = "exchange_receiver"
 JOIN = "join"
 EXPAND = "expand"
+WINDOW = "window"
 
 # aggregation modes (two-phase aggregation)
 AGG_PARTIAL = "partial"
@@ -83,6 +84,15 @@ class ExecutorPB:
     limit: int = 0
     # projection
     exprs: list[dict] = field(default_factory=list)
+    # window (ref: tipb.Window — funcs over one OVER spec; partition_by +
+    # order_by reuse ExprPB; frame is the window_core frame tag, JSON-able)
+    partition_by: list[dict] = field(default_factory=list)
+    frame: Any = "range_cur"
+    win_funcs: list[dict] = field(default_factory=list)  # {name, args, ft}
+    # per (partition_by + order_by) sort lane: [lo, hi] integer value bounds
+    # or None — stamped by the device binder from column-cache min/max to
+    # enable the packed single-key sort (window_core.sort_perm)
+    sort_bounds: list = field(default_factory=list)
     # exchange (MPP)
     exchange_type: str = ""  # hash | broadcast | passthrough
     hash_keys: list[dict] = field(default_factory=list)
@@ -117,11 +127,26 @@ class ExecutorPB:
         elif self.tp in (AGGREGATION, STREAM_AGG):
             d.update(group_by=self.group_by, aggs=self.aggs, agg_mode=self.agg_mode)
         elif self.tp == TOPN:
-            d.update(order_by=self.order_by, limit=self.limit)
+            d.update(
+                order_by=self.order_by,
+                limit=self.limit,
+                # binder-stamped value bounds are baked into the compiled
+                # kernel — they MUST participate in fingerprint() or a data
+                # change reuses a kernel with stale bounds
+                sort_bounds=[list(b) if b is not None else None for b in self.sort_bounds],
+            )
         elif self.tp == LIMIT:
             d.update(limit=self.limit)
         elif self.tp == PROJECTION:
             d.update(exprs=self.exprs)
+        elif self.tp == WINDOW:
+            d.update(
+                partition_by=self.partition_by,
+                order_by=[list(o) for o in self.order_by],
+                frame=list(self.frame) if isinstance(self.frame, tuple) else self.frame,
+                win_funcs=self.win_funcs,
+                sort_bounds=[list(b) if b is not None else None for b in self.sort_bounds],
+            )
         return d
 
     @staticmethod
@@ -147,10 +172,18 @@ class ExecutorPB:
             e.group_by, e.aggs, e.agg_mode = pb["group_by"], pb["aggs"], pb["agg_mode"]
         elif e.tp == TOPN:
             e.order_by, e.limit = pb["order_by"], pb["limit"]
+            e.sort_bounds = [tuple(b) if b is not None else None for b in pb.get("sort_bounds", [])]
         elif e.tp == LIMIT:
             e.limit = pb["limit"]
         elif e.tp == PROJECTION:
             e.exprs = pb["exprs"]
+        elif e.tp == WINDOW:
+            e.partition_by = pb["partition_by"]
+            e.order_by = [tuple(o) for o in pb["order_by"]]
+            f = pb.get("frame", "range_cur")
+            e.frame = tuple(f) if isinstance(f, list) else f
+            e.win_funcs = pb["win_funcs"]
+            e.sort_bounds = [tuple(b) if b is not None else None for b in pb.get("sort_bounds", [])]
         return e
 
 

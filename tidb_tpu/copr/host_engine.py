@@ -25,6 +25,7 @@ from tidb_tpu.copr.colcache import RegionColumns, cache_for
 from tidb_tpu.expression.expr import (
     AggDesc,
     EvalBatch,
+    _ft_from_pb,
     eval_to_column,
     expr_from_pb,
 )
@@ -380,6 +381,38 @@ def _topn(chunk: Chunk, ex: dagpb.ExecutorPB) -> Chunk:
     return chunk.take(perm[: ex.limit])
 
 
+def _window(chunk: Chunk, ex: dagpb.ExecutorPB) -> Chunk:
+    """WINDOW executor: appends one column per func (ref: the role tipb
+    window pushdown plays for TiFlash). Reuses the executor-layer host sweep
+    (WindowExec) over the materialized chunk — same code path the root
+    executor runs, so cop-pushed windows agree with it bit-for-bit."""
+    from tidb_tpu.executor.executors import WindowExec
+    from tidb_tpu.planner.plans import PhysWindow, WindowFuncDesc
+
+    funcs = [
+        WindowFuncDesc(f["name"], [expr_from_pb(a) for a in f["args"]], _ft_from_pb(f["ft"]))
+        for f in ex.win_funcs
+    ]
+    frame = ex.frame
+    plan = PhysWindow(
+        funcs=funcs,
+        partition_by=[expr_from_pb(p) for p in ex.partition_by],
+        order_by=[(expr_from_pb(p), d) for p, d in ex.order_by],
+        whole_partition=frame == "whole",
+        rows_frame=frame == "rows_cur",
+        frame=tuple(frame[1:]) if isinstance(frame, tuple) else None,
+        schema=[],
+    )
+
+    class _ChunkChild:
+        schema: list = []
+
+        def execute(self_inner) -> Chunk:
+            return chunk
+
+    return WindowExec(plan, _ChunkChild(), None).execute()
+
+
 def run_operators(chunk: Chunk, executors: list, output_offsets: list[int]) -> Chunk:
     """Apply post-scan DAG operators to a materialized chunk — shared by the
     per-region host path and the union-scan (dirty-txn) path."""
@@ -395,6 +428,8 @@ def run_operators(chunk: Chunk, executors: list, output_offsets: list[int]) -> C
         elif ex.tp == dagpb.PROJECTION:
             batch = EvalBatch.from_chunk(chunk)
             chunk = Chunk([eval_to_column(expr_from_pb(pb), batch, np) for pb in ex.exprs])
+        elif ex.tp == dagpb.WINDOW:
+            chunk = _window(chunk, ex)
         else:
             raise NotImplementedError(f"host engine: executor {ex.tp}")
     if output_offsets:

@@ -141,6 +141,26 @@ def _block_bounds(n: int) -> list[tuple[int, int]]:
     return [(i, min(i + _BLOCK, n)) for i in range(0, n, _BLOCK)]
 
 
+def _block_device_inputs(store, scan, cache, entry, region, bi: int, lo: int, hi: int, cacheable: bool):
+    """Device arrays for ONE block, put on demand (LRU-cached). The single
+    construction site for the per-block device-LRU key layout — shared by the
+    independent-block path and the fused multi-block window path, so the two
+    always hit the same cache entries."""
+    epoch = cache.epoch
+    base = (store.nonce, region.region_id, scan.table_id)
+    hkey = base + (-1, entry.data_version, epoch, bi, _BLOCK)
+    hpair = _device_put_col(hkey, entry.handles[lo:hi], np.ones(hi - lo, bool), _BLOCK, cacheable)
+    cols_dev = []
+    for c in scan.columns:
+        if c.is_handle:
+            cols_dev.append(hpair)
+        else:
+            data, valid = entry.cols[c.column_id]
+            ckey = base + (c.column_id, entry.data_version, epoch, bi, _BLOCK)
+            cols_dev.append(_device_put_col(ckey, data[lo:hi], valid[lo:hi], _BLOCK, cacheable))
+    return hpair[0], tuple(cols_dev)
+
+
 def _probe_slice_rows(packed_list: list, kernel):
     """Large rows-kind buffers (capacity = the padded block/table) are usually
     near-empty after selection: fetch every block's meta row in ONE tiny
@@ -164,6 +184,16 @@ def _probe_slice_rows(packed_list: list, kernel):
 
 
 def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int):
+    try:
+        return _execute_dag_device(store, dag, region, ranges, read_ts)
+    except UnsupportedForDevice:
+        # the planner's legality gate keeps most host-only shapes off this
+        # engine; anything it misses (unbindable constants, unpackable window
+        # sorts) falls back to the host engine — the TiKV-serves-it role
+        return host_execute_dag(store, dag, region, ranges, read_ts)
+
+
+def _execute_dag_device(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int):
     scan = dag.executors[0]
     if scan.desc:
         # descending scans are order-sensitive row streams — the sorted-batch
@@ -180,7 +210,7 @@ def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: 
     cache = cache_for(store)
     entry = cache.get(region, scan.table_id, schema, slots, read_ts)
 
-    binder = Binder(cache, scan.table_id, scan.columns)
+    binder = Binder(cache, scan.table_id, scan.columns, entry)
     bound = binder.bind_dag(dag)
 
     # ranges → padded static array; rows outside any range are masked out
@@ -188,6 +218,13 @@ def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: 
     for i, kr in enumerate(ranges):
         rarr[i] = tablecodec.range_to_handles(kr, scan.table_id)
 
+    has_window = any(ex.tp == dagpb.WINDOW for ex in dag.executors[1:])
+    if has_window:
+        _window_pack_guard(bound, entry.n)
+    if has_window and entry.n > _BLOCK:
+        # windows need every row of a partition in one computation — blocks
+        # cannot run independently; fuse them into one multi-block program
+        return _exec_window_blocks(store, dag, bound, scan, cache, entry, region, rarr)
     agg_complete = any(
         ex.tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG) and ex.agg_mode == dagpb.AGG_COMPLETE
         for ex in dag.executors[1:]
@@ -258,26 +295,14 @@ def _exec_blocks(store, dag, bound, scan, cache, entry, region, rarr):
 
     n = entry.n
     bounds = _block_bounds(n)
-    epoch = cache.epoch
     cacheable = entry.complete
-    base = (store.nonce, region.region_id, scan.table_id)
 
     def block_inputs(bi: int):
-        """Device arrays for ONE block, put on demand (LRU-cached) — so the
-        LIMIT paging loop's early exit also skips the H2D transfers of the
-        blocks it never reads, which dominate cold-table cost."""
+        # on-demand (LRU-cached) puts: the LIMIT paging loop's early exit
+        # also skips the H2D transfers of blocks it never reads, which
+        # dominate cold-table cost
         lo, hi = bounds[bi]
-        hkey = base + (-1, entry.data_version, epoch, bi, _BLOCK)
-        hpair = _device_put_col(hkey, entry.handles[lo:hi], np.ones(hi - lo, bool), _BLOCK, cacheable)
-        cols_dev = []
-        for c in scan.columns:
-            if c.is_handle:
-                cols_dev.append(hpair)
-            else:
-                data, valid = entry.cols[c.column_id]
-                ckey = base + (c.column_id, entry.data_version, epoch, bi, _BLOCK)
-                cols_dev.append(_device_put_col(ckey, data[lo:hi], valid[lo:hi], _BLOCK, cacheable))
-        return hpair[0], tuple(cols_dev)
+        return _block_device_inputs(store, scan, cache, entry, region, bi, lo, hi, cacheable)
 
     rarr_j = jnp.asarray(rarr)
     nvalids = [hi - lo for lo, hi in bounds]
@@ -334,6 +359,59 @@ def _blocks_stacked(run_block, nb: int, kernel, dag, cache, scan):
         fbuf = bf_all[b] if bf_all is not None else None
         chunks.append(_chunk_from_bufs(buf, fbuf, int(buf[0, 0]), kernel, dag, cache, scan))
     return _concat_chunks(chunks)
+
+
+def _exec_window_blocks(store, dag, bound, scan, cache, entry, region, rarr):
+    """Window DAGs over large regions: ONE fused multi-block program.
+
+    Windows need every row of a partition in the same computation (ref: the
+    Shuffle repartitioner's partition isolation, shuffle.go:86), so instead of
+    independent per-block kernels the fused kernel concatenates the per-block
+    device arrays (same LRU identities as _exec_blocks — warm tables pay no
+    new H2D transfer) and sorts the whole region with the packed single-key
+    sort. The binder's sort bounds make that sort a single int64 argsort;
+    unpackable shapes raised UnsupportedForDevice upstream."""
+    import jax
+    import jax.numpy as jnp
+
+    n = entry.n
+    bounds = _block_bounds(n)
+    nb = len(bounds)
+    cacheable = entry.complete
+    handles_blocks = []
+    cols_blocks: list[list] = [[] for _ in scan.columns]
+    for bi, (lo, hi) in enumerate(bounds):
+        h, cols_dev = _block_device_inputs(store, scan, cache, entry, region, bi, lo, hi, cacheable)
+        handles_blocks.append(h)
+        for ci, pair in enumerate(cols_dev):
+            cols_blocks[ci].append(pair)
+    nvalids = jnp.asarray(np.array([hi - lo for lo, hi in bounds], dtype=np.int64))
+    n_total = nb * _BLOCK
+    agg_cap = min(_DEFAULT_AGG_CAP, n_total) if kernel_needs_agg(bound) else _DEFAULT_AGG_CAP
+    while True:
+        kernel = get_kernel(bound, _BLOCK, agg_cap, nb=nb)
+        packed = kernel.fn(
+            tuple(handles_blocks),
+            tuple(tuple(cb) for cb in cols_blocks),
+            jnp.asarray(rarr),
+            nvalids,
+        )
+        fbuf = None
+        if kernel.kind == "rows" and kernel.out_n > 65536:
+            _, (packed,) = _probe_slice_rows([packed], kernel)
+        if isinstance(packed, tuple):
+            buf, fbuf = jax.device_get(packed)
+        else:
+            buf = jax.device_get(packed)
+        count = int(buf[0, 0])
+        ngroups = int(buf[0, 1])
+        if ngroups > kernel.agg_cap:
+            if agg_cap >= n_total:
+                raise RuntimeError("aggregation group overflow beyond row count")
+            agg_cap = min(agg_cap * 4, n_total)
+            continue
+        break
+    return _chunk_from_bufs(buf, fbuf, count, kernel, dag, cache, scan)
 
 
 def _blocks_paged_limit(run_block, nb: int, kernel, dag, cache, scan):
@@ -399,6 +477,22 @@ def _chunk_from_bufs(buf, fbuf, count: int, kernel, dag, cache, scan) -> Chunk:
     return Chunk(cols)
 
 
+def _window_pack_guard(bound: dagpb.DAGRequest, n: int) -> None:
+    """Reject device windows whose sort can't pack into one int64 key at a
+    scale where the multi-lane stable-sort chain is pathological (minutes of
+    x64-emulated compile past ~1M rows) — the host sweep takes over."""
+    from tidb_tpu.ops.window_core import packed_bits
+
+    if n <= (1 << 20):
+        return
+    n_total = bucket_size(max(n, 1)) if n <= _BLOCK else -(-n // _BLOCK) * _BLOCK
+    for ex in bound.executors[1:]:
+        if ex.tp == dagpb.WINDOW:
+            sb = [tuple(b) if b is not None else None for b in ex.sort_bounds] or None
+            if packed_bits(sb, n_total) is None:
+                raise UnsupportedForDevice("window sort not packable at this scale")
+
+
 def kernel_needs_agg(dag: dagpb.DAGRequest) -> bool:
     return any(ex.tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG) for ex in dag.executors)
 
@@ -435,6 +529,8 @@ def output_ftypes(dag: dagpb.DAGRequest) -> list[FieldType]:
             fts = out
         elif ex.tp == dagpb.PROJECTION:
             fts = [expr_from_pb(e).ftype for e in ex.exprs]
+        elif ex.tp == dagpb.WINDOW:
+            fts = fts + [_ft_from_pb(f["ft"]) for f in ex.win_funcs]
     return fts
 
 
@@ -462,6 +558,9 @@ def string_slot_for_output(dag: dagpb.DAGRequest, offset: int):
             for e in ex.exprs:
                 out.append(prov[e["idx"]] if e.get("tp") == "col" and e["idx"] < len(prov) else None)
             prov = out
+        elif ex.tp == dagpb.WINDOW:
+            # window outputs carry no dictionaries (string args are host-only)
+            prov = prov + [None] * len(ex.win_funcs)
     src = prov[offset] if offset < len(prov) else None
     if src is None:
         return None

@@ -105,6 +105,31 @@ def _build_executor(plan, session) -> Executor:
     raise ExecError(f"no executor for {type(plan).__name__}")
 
 
+def _window_pb(w) -> dagpb.ExecutorPB:
+    """Serialize a pushed LogicalWindow into the DAG wire form (ref: the
+    tipb.Window message TiFlash consumes)."""
+    from tidb_tpu.expression.expr import _ft_pb
+
+    if w.frame is not None:
+        frame = ("rows",) + tuple(w.frame)
+    elif w.whole_partition:
+        frame = "whole"
+    elif w.rows_frame:
+        frame = "rows_cur"
+    else:
+        frame = "range_cur"
+    return dagpb.ExecutorPB(
+        dagpb.WINDOW,
+        partition_by=[e.to_pb() for e in w.partition_by],
+        order_by=[(e.to_pb(), d) for e, d in w.order_by],
+        frame=frame,
+        win_funcs=[
+            {"name": f.name, "args": [a.to_pb() for a in f.args], "ft": _ft_pb(f.ftype)}
+            for f in w.funcs
+        ],
+    )
+
+
 def _empty_chunk(schema) -> Chunk:
     cols = []
     for oc in schema:
@@ -193,6 +218,8 @@ class TableReaderExec(Executor):
         executors = [scan]
         if p.pushed_conditions:
             executors.append(dagpb.ExecutorPB(dagpb.SELECTION, conditions=[c.to_pb() for c in p.pushed_conditions]))
+        if p.pushed_window is not None:
+            executors.append(_window_pb(p.pushed_window))
         if p.pushed_agg is not None:
             executors.append(
                 dagpb.ExecutorPB(
@@ -216,6 +243,17 @@ class TableReaderExec(Executor):
             # union-scan path (ref: UnionScanExec): scan through the txn's
             # membuffer overlay and replay pushed operators host-side
             return self._union_scan(dag, ranges, t)
+        host_tail: list = []
+        if p.pushed_window is not None:
+            # windows need every partition row in ONE computation; a table
+            # spanning multiple regions splits into independent cop tasks, so
+            # run the scan prefix remotely and the window (plus anything
+            # above it) host-side over the gathered rows
+            n_regions = sum(1 for _ in self.session.store.pd.regions_in_ranges(ranges))
+            if n_regions > 1:
+                widx = next(i for i, ex in enumerate(executors) if ex.tp == dagpb.WINDOW)
+                host_tail = executors[widx:]
+                dag = dagpb.DAGRequest(executors=executors[:widx])
         req = Request(
             tp=RequestType.DAG,
             data=dag,
@@ -242,6 +280,10 @@ class TableReaderExec(Executor):
             rc.close()
         if out is None:
             return _empty_chunk(p.schema)
+        if host_tail:
+            from tidb_tpu.copr.host_engine import run_operators
+
+            out = run_operators(out, host_tail, [])
         # string columns may carry per-region-identical dictionaries (table-
         # level, shared) — concat requires the same object, which holds here
         return out
@@ -750,6 +792,7 @@ class WindowExec(Executor):
     def _try_device(self, chunk: Chunk, n: int):
         """Window evaluation on the device via ops/window_kernel (sorted-batch
         segment program) when the shape qualifies; None → host sweep."""
+        from tidb_tpu.ops import window_core as wc
         from tidb_tpu.ops import window_kernel as wk
 
         p = self.plan
@@ -758,58 +801,22 @@ class WindowExec(Executor):
         engines = str(self.session.vars.get("tidb_isolation_read_engines", "tpu,host"))
         if "tpu" not in engines:
             return None
-        # frame tag (node-level)
-        if p.frame is not None:
-            frame_tag = ("rows",) + tuple(p.frame)
-        elif p.whole_partition:
-            frame_tag = "whole"
-        elif p.rows_frame:
-            frame_tag = "rows_cur"
-        else:
-            frame_tag = "range_cur"
-        bounded = isinstance(frame_tag, tuple)
         # phase 1: reject on static structure only (expression ftypes and
         # plan-time constants) — no column evaluation until the shape is
         # known-supported, so fallbacks don't pay O(n) twice
-        if any((e.ftype.kind == TypeKind.STRING) for e, _ in p.order_by):
-            return None  # dict codes are not ORDER-comparable
-        specs = []
-        for f in p.funcs:
-            if f.name not in wk.SUPPORTED:
-                return None
-            if bounded and f.name in ("min", "max"):
-                return None  # sliding extreme: host sweep only
-            has_arg = bool(f.args)
-            is_f = bool(f.args) and f.args[0].ftype.kind == TypeKind.FLOAT
-            c0 = c1 = 0
-            c2f = False
-            if has_arg and f.args[0].ftype.kind == TypeKind.STRING:
-                return None
-            if f.name == "ntile":
-                if not isinstance(f.args[0], Constant) or f.args[0].value is None:
-                    return None
-                c0 = int(f.args[0].value)
-                has_arg = False
-                if c0 <= 0:
-                    return None
-            elif f.name in ("lead", "lag"):
-                if len(f.args) > 1:
-                    if not isinstance(f.args[1], Constant) or f.args[1].value is None:
-                        return None
-                    c0 = int(f.args[1].value)
-                else:
-                    c0 = 1
-                if len(f.args) > 2:
-                    d2 = f.args[2]
-                    if not isinstance(d2, Constant) or d2.ftype.kind == TypeKind.STRING:
-                        return None
-                    from tidb_tpu.types.datum import Datum
-
-                    c2f = d2.value is not None
-                    c1 = Datum(d2.value, d2.ftype).physical() if c2f else 0
-            elif f.name == "avg":
-                c0 = 10 ** (f.ftype.scale - f.args[0].ftype.scale) if f.ftype.kind == TypeKind.DECIMAL else 0
-            specs.append((f.name, has_arg, is_f, c0, c1, c2f))
+        spec_res = wc.derive_specs(
+            p.funcs,
+            whole_partition=p.whole_partition,
+            rows_frame=p.rows_frame,
+            frame=p.frame,
+            # dict codes are not ORDER-comparable at this layer (the cop
+            # binder legalizes them with sorted dictionaries; here the chunk
+            # may carry arbitrary-order codes)
+            order_is_string=any(e.ftype.kind == TypeKind.STRING for e, _ in p.order_by),
+        )
+        if spec_res is None:
+            return None
+        frame_tag, specs = spec_res
 
         # phase 2: evaluate lanes (shape is supported from here on)
         batch = EvalBatch.from_chunk(chunk)
@@ -828,6 +835,21 @@ class WindowExec(Executor):
         from tidb_tpu.utils.chunk import bucket_size
 
         n_pad = bucket_size(n)
+        # integer sort-lane bounds (one cheap numpy pass) enable the packed
+        # single-key sort; without them large batches stay on the host sweep
+        # (the multi-lane sort compiles/runs pathologically past one block)
+        bounds = []
+        for d, v in part + order:
+            if np.issubdtype(d.dtype, np.floating):
+                bounds.append(None)
+                continue
+            lv = d[v]
+            bounds.append((int(lv.min()), int(lv.max())) if lv.size else (0, 0))
+        bounds = wc.widen_bounds(bounds)
+        if wc.packed_bits(bounds, n_pad) is None:
+            if n > wk.MULTILANE_MAX_ROWS:
+                return None
+            bounds = None
 
         def pad(pair):
             d, v = pair
@@ -838,7 +860,7 @@ class WindowExec(Executor):
             return (pd, pv)
 
         spec = (len(part), tuple(d for _, d in p.order_by), frame_tag, tuple(specs))
-        fn = wk.get_window_fn(spec, n_pad)
+        fn = wk.get_window_fn(spec, n_pad, tuple(bounds) if bounds is not None else None)
         import jax
 
         flat = fn(
@@ -1293,8 +1315,10 @@ class HashJoinExec(Executor):
         base = np.repeat(np.cumsum(cnt) - cnt, cnt)
         ri_s = np.repeat(lo, cnt) + (np.arange(total) - base)
         ri = rperm[ri_s]
-        # exact verification: a mix collision must not fabricate a match
-        live = np.ones(total, dtype=bool)
+        # exact verification: a mix collision must not fabricate a match, and
+        # a legal probe key equal to the int64 sentinel must not range over
+        # NULL build slots (mirrors _local_expand_join's rvalid mask)
+        live = rval[ri]
         for la, ra in zip(lkeys, rkeys):
             live &= la[li] == ra[ri]
         li, ri = li[live], ri[live]

@@ -1012,36 +1012,64 @@ def _iso_week(xp, d):
     return (thursday - jan1) // 7 + 1
 
 
+def _calc_week(xp, d, mode: int):
+    """MySQL calc_week over epoch-day vectors (ref: sql/time.cc calc_week /
+    TiDB types/mytime.go calcWeek), all 8 modes. Returns (week, week_year).
+
+    Mode bits: 1 = Monday-first, 2 = week-year rendering (early January can
+    be week 52/53 of the previous year instead of 0), 4 = "week 1 is the
+    first week with the start day in it" (vs the ≥4-days rule); per MySQL's
+    week_mode(), Sunday-first modes flip bit 4."""
+    mf = bool(mode & 1)
+    wy0 = bool(mode & 2)
+    fw = bool(mode & 4)
+    if not mf:
+        fw = not fw
+    one = 1 + 0 * d
+    y, _, _ = _civil_from_days(xp, d)
+    jan1 = _days_from_civil(xp, y, one, one)
+    wd = (jan1 + (3 if mf else 4)) % 7  # weekday of Jan 1, 0 = week-start day
+    early = (d - jan1) < (7 - wd)  # before the year's first full week
+    week0 = (wd != 0) if fw else (wd >= 4)
+    # days that don't render week 0 borrow the previous year's numbering
+    pjan1 = _days_from_civil(xp, y - 1, one, one)
+    pwd = (wd + 53 * 7 - (jan1 - pjan1)) % 7
+    borrow = early & (True if wy0 else ~week0)
+    y_e = xp.where(borrow, y - 1, y)
+    jan1_e = xp.where(borrow, pjan1, jan1)
+    wd_e = xp.where(borrow, pwd, wd)
+    week0_e = (wd_e != 0) if fw else (wd_e >= 4)
+    start = xp.where(week0_e, jan1_e + (7 - wd_e), jan1_e - wd_e)
+    days = d - start
+    week = days // 7 + 1
+    # 53-week wrap: a final partial week whose next-year Jan 1 starts a
+    # "week 1" renders as next year's week 1 under week-year modes
+    wy_eff = borrow | wy0
+    diy = _days_from_civil(xp, y_e + 1, one, one) - jan1_e
+    wd2 = (wd_e + diy) % 7
+    wrap = wy_eff & (days >= 52 * 7) & ((wd2 == 0) if fw else (wd2 < 4))
+    week = xp.where(wrap, 1, week)
+    wyear = xp.where(wrap, y_e + 1, y_e)
+    week = xp.where(early & ~borrow, 0, week)
+    return week, wyear
+
+
 @register("week", lambda args: bigint_type(), variadic=True, arity=1)
 def _week(xp, args, ctx):
-    """WEEK(date[, mode]) — mode 0 (MySQL default: Sunday start, week 0
-    before the first Sunday), mode 1 (Monday start, week 1 if ≥4 days), and
-    mode 3 (ISO). Other modes fall back to their base behavior (0↔2, 1↔3
-    differ only in how week 0 renders, not in the split points)."""
+    """WEEK(date[, mode]) — all 8 MySQL modes via _calc_week. A constant
+    mode evaluates once; a per-row mode column selects among the 8 variants
+    with where-masks (branch-free, so the tree stays jit-traceable)."""
     d, v = _to_days_any(xp, ctx, 0)
-    mode = 0
-    if len(args) > 1:
-        m0 = args[1][0]
-        mode = int(m0 if not hasattr(m0, "__len__") else m0[0]) & 7
-    if mode == 3:
-        return _iso_week(xp, d), v
-    if mode == 1:
-        # Monday-start weeks counted within the date's own year: week 1 is
-        # the first week with ≥4 days in the year; year-end days past the
-        # last Sunday stay week 53 (not next year's week 1, unlike ISO)
-        y, _, _ = _civil_from_days(xp, d)
-        jan1 = _days_from_civil(xp, y, 1 + 0 * y, 1 + 0 * y)
-        wd = (jan1 + 3) % 7  # 0=Monday
-        start = xp.where(wd <= 3, jan1 - wd, jan1 + 7 - wd)
-        w = xp.where(xp.asarray(d).astype(xp.int32) < start, 0, (xp.asarray(d).astype(xp.int32) - start) // 7 + 1)
-        return w, v
-    y, _, _ = _civil_from_days(xp, d)
-    jan1 = _days_from_civil(xp, y, 1 + 0 * y, 1 + 0 * y)
-    doy0 = d - jan1  # 0-based day of year
-    jan1_dow = (jan1 + 4) % 7  # 0=Sunday
-    first_sunday = (7 - jan1_dow) % 7
-    w = xp.where(doy0 < first_sunday, 0, (doy0 - first_sunday) // 7 + 1)
-    return w, v
+    if len(args) <= 1:
+        return _calc_week(xp, d, 0)[0], v
+    m0, mv = args[1]
+    if not hasattr(m0, "__len__"):
+        return _calc_week(xp, d, int(m0) & 7)[0], and_valid(xp, v, mv)
+    m = xp.asarray(m0) % 8
+    out = 0 * d
+    for mode in range(8):
+        out = xp.where(m == mode, _calc_week(xp, d, mode)[0], out)
+    return out, and_valid(xp, v, mv)
 
 
 @register("weekofyear", lambda args: bigint_type(), arity=1)
@@ -1102,26 +1130,69 @@ def _maketime(xp, args, ctx):
     return xp.where(h < 0, -us, us), and_valid(xp, vh, vm, vs)
 
 
-@register("addtime", infer_first)
+_DATETIME_LIKE = (TypeKind.DATETIME, TypeKind.DATE)
+
+
+def _temporal_micros(xp, ctx, i, args):
+    """Physical value of temporal arg ``i`` in microseconds (DATE days →
+    epoch micros); None when the kind has no microsecond form."""
+    d, v = args[i]
+    k = ctx.arg_types[i].kind
+    if k == TypeKind.DATE:
+        return d * 86_400_000_000, v
+    if k in (TypeKind.DATETIME, TypeKind.DURATION):
+        return d, v
+    return None
+
+
+def _addtime_ft(args):
+    # a DATE first operand is promoted to DATETIME (day 0:00 + the duration)
+    if args[0].kind == TypeKind.DATE:
+        return FieldType(TypeKind.DATETIME, nullable=True)
+    return args[0]
+
+
+@register("addtime", _addtime_ft)
 def _addtime(xp, args, ctx):
-    (da, va), (db, vb) = args
+    # second operand must be a TIME: mixed kinds (datetime + datetime) are
+    # NULL, like the reference's type check (ref: builtin_time.go AddTime)
+    if ctx.arg_types[1].kind in _DATETIME_LIKE:
+        return args[0][0] * 0, False
+    a = _temporal_micros(xp, ctx, 0, args)
+    if a is None:
+        return args[0][0] * 0, False
+    da, va = a
+    db, vb = args[1]
     return da + db, and_valid(xp, va, vb)
 
 
-@register("subtime", infer_first)
+@register("subtime", _addtime_ft)
 def _subtime(xp, args, ctx):
-    (da, va), (db, vb) = args
+    if ctx.arg_types[1].kind in _DATETIME_LIKE:
+        return args[0][0] * 0, False
+    a = _temporal_micros(xp, ctx, 0, args)
+    if a is None:
+        return args[0][0] * 0, False
+    da, va = a
+    db, vb = args[1]
     return da - db, and_valid(xp, va, vb)
 
 
 @register("timediff", lambda args: FieldType(TypeKind.DURATION), arity=2)
 def _timediff(xp, args, ctx):
-    (da, va), (db, vb) = args
-    # normalize to microseconds: DATE physicals are day counts
-    if ctx.arg_types[0].kind == TypeKind.DATE:
-        da = da * 86_400_000_000
-    if ctx.arg_types[1].kind == TypeKind.DATE:
-        db = db * 86_400_000_000
+    # MySQL returns NULL when the operand kinds differ (time vs datetime):
+    # the physicals live in different epochs, so subtraction is meaningless
+    # (ref: builtin_time.go TimeDiff type check)
+    ka, kb = ctx.arg_types[0].kind, ctx.arg_types[1].kind
+    a_dt, b_dt = ka in _DATETIME_LIKE, kb in _DATETIME_LIKE
+    if a_dt != b_dt:
+        return args[0][0] * 0, False
+    a = _temporal_micros(xp, ctx, 0, args)
+    b = _temporal_micros(xp, ctx, 1, args)
+    if a is None or b is None:
+        return args[0][0] * 0, False
+    da, va = a
+    db, vb = b
     return da - db, and_valid(xp, va, vb)
 
 

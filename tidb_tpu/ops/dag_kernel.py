@@ -34,7 +34,7 @@ from typing import Any, Callable
 import numpy as np
 
 from tidb_tpu.copr import dagpb
-from tidb_tpu.expression.expr import AggDesc, EvalBatch, eval_expr, expr_from_pb
+from tidb_tpu.expression.expr import AggDesc, EvalBatch, _ft_from_pb, eval_expr, expr_from_pb
 from tidb_tpu.types import TypeKind
 from tidb_tpu.utils.chunk import bucket_size
 
@@ -118,18 +118,18 @@ def _ensure_x64():
         _ensure_x64._cc_done = True
 
 
-def get_kernel(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
-    key = (dag.fingerprint(), n_pad, agg_cap)
+def get_kernel(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1) -> CompiledKernel:
+    key = (dag.fingerprint(), n_pad, agg_cap, nb)
     with _CACHE_MU:
         k = _COMPILE_CACHE.get(key)
     if k is None:
-        k = _build(dag, n_pad, agg_cap)
+        k = _build(dag, n_pad, agg_cap, nb)
         with _CACHE_MU:
             _COMPILE_CACHE[key] = k
     return k
 
 
-def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
+def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1) -> CompiledKernel:
     _ensure_x64()
     import jax
     import jax.numpy as jnp
@@ -153,16 +153,52 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
             parsed.append(([(expr_from_pb(p), d) for p, d in ex.order_by], ex.limit))
         elif ex.tp == dagpb.PROJECTION:
             parsed.append([expr_from_pb(e) for e in ex.exprs])
+        elif ex.tp == dagpb.WINDOW:
+            from types import SimpleNamespace
+
+            from tidb_tpu.ops.window_core import derive_specs
+
+            funcs_ir = [
+                SimpleNamespace(
+                    name=f["name"],
+                    args=[expr_from_pb(a) for a in f["args"]],
+                    ftype=_ft_from_pb(f["ft"]),
+                )
+                for f in ex.win_funcs
+            ]
+            fr = ex.frame
+            res = derive_specs(
+                funcs_ir,
+                whole_partition=fr == "whole",
+                rows_frame=fr == "rows_cur",
+                frame=tuple(fr[1:]) if isinstance(fr, tuple) else None,
+                # order-key strings were legalized to sorted-dict codes by
+                # the binder, so codes ARE order-comparable here
+                order_is_string=False,
+            )
+            if res is None:
+                raise ValueError("window shape not device-supported (planner gate missed)")
+            parsed.append(
+                (
+                    [expr_from_pb(p) for p in ex.partition_by],
+                    [(expr_from_pb(p), d) for p, d in ex.order_by],
+                    res[0],
+                    res[1],
+                    funcs_ir,
+                    [tuple(b) if b is not None else None for b in ex.sort_bounds] or None,
+                )
+            )
         else:
             parsed.append(None)
 
+    n_total = n_pad * nb
     agg_is_last = bool(executors[1:]) and executors[-1].tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG)
     topn_like = [ex for ex in executors[1:] if ex.tp in (dagpb.TOPN, dagpb.LIMIT)]
-    out_n = n_pad
+    out_n = n_total
     if agg_is_last:
         out_n = agg_cap
     elif topn_like:
-        out_n = min(n_pad, bucket_size(max(ex.limit for ex in topn_like)))
+        out_n = min(n_total, bucket_size(max(ex.limit for ex in topn_like)))
 
     def _bcast(d, n):
         d = jnp.asarray(d)
@@ -183,19 +219,32 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
         return perm
 
     def kernel(handles, cols, ranges, nvalid):
-        n = n_pad
+        n = n_total
+        if nb > 1:
+            # fused multi-block program (window DAGs: the whole region in one
+            # computation, reusing the per-block device LRU arrays); padding
+            # is interspersed at each block's tail, masked via per-block counts
+            handles = jnp.concatenate(handles)
+            cols = tuple(
+                (jnp.concatenate([b[0] for b in c]), jnp.concatenate([b[1] for b in c]))
+                for c in cols
+            )
+            iota = jnp.arange(n)
+            live = (iota % n_pad) < nvalid[iota // n_pad]
+        else:
+            live = jnp.arange(n) < nvalid
         # range mask: padded (MAX_RANGES, 2); empty slots have lo >= hi
         mask = jnp.zeros(n, dtype=bool)
         for r in range(MAX_RANGES):
             lo, hi = ranges[r, 0], ranges[r, 1]
             mask = mask | ((handles >= lo) & (handles < hi))
-        mask = mask & (jnp.arange(n) < nvalid)  # padding rows are never live
+        mask = mask & live  # padding rows are never live
         batch = EvalBatch([(d, v) for d, v in cols], [None] * len(cols), n)
         kind = "rows"
         count = None
         ngroups = None
 
-        for ex, pre in zip(executors[1:], parsed):
+        for exi, (ex, pre) in enumerate(zip(executors[1:], parsed)):
             if ex.tp == dagpb.SELECTION:
                 for cond in pre:
                     d, v, _ = eval_expr(cond, batch, jnp)
@@ -518,10 +567,25 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                     vkey = jnp.where(mask & v, key, sent)
                     # NOTE: TPU top_k does NOT break value ties by lowest
                     # index (CPU does) — the exact candidate sort below
-                    # restores index order among retained ties; only a tie
-                    # group overflowing the K-candidate window (>bucket(limit)
-                    # equal keys at the boundary) can pick different rows than
-                    # the host's stable sort, which MySQL leaves unspecified
+                    # restores index order among retained ties. With binder-
+                    # stamped value bounds the row index packs INTO the key,
+                    # so even a tie group overflowing the K-candidate window
+                    # selects exactly the host's stable-sort rows; without
+                    # bounds (floats, expressions) boundary-overflow ties
+                    # remain engine-unspecified, as MySQL allows
+                    b0 = ex.sort_bounds[0] if getattr(ex, "sort_bounds", None) else None
+                    if b0 is not None and not isf:
+                        lo_, hi_ = int(b0[0]), int(b0[1])
+                        span = hi_ - lo_ + 2
+                        if span * (cur_n + 1) <= (1 << 62):
+                            code = jnp.clip(d - lo_ + 1, 1, span - 1)
+                            rank_code = code if desc else span - code
+                            pidx = jnp.arange(cur_n)
+                            vkey = jnp.where(
+                                mask & v,
+                                rank_code * cur_n + (cur_n - 1 - pidx),
+                                jnp.iinfo(jnp.int64).min,
+                            )
                     _, idx_val = jax.lax.top_k(vkey, K)
                     # NULL rows deterministically in first-index order: the
                     # key encodes the (unique) row position, so ties cannot
@@ -599,6 +663,47 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int) -> CompiledKernel:
                     d, v, _ = eval_expr(e, batch, jnp)
                     new_cols.append((_bcast(d, cur_n), _vmask(v, cur_n)))
                 batch = EvalBatch(new_cols, [None] * len(new_cols), cur_n)
+            elif ex.tp == dagpb.WINDOW:
+                from tidb_tpu.ops.window_core import window_program
+
+                part_exprs, order_pairs, frame_tag, specs, funcs_ir, bounds = pre
+
+                def lane(e):
+                    d, v, _ = eval_expr(e, batch, jnp)
+                    return (_bcast(d, n), _vmask(v, n))
+
+                part_lanes = [lane(e) for e in part_exprs]
+                order_lanes = [lane(e) for e, _ in order_pairs]
+                arg_lanes = []
+                for f, sp in zip(funcs_ir, specs):
+                    if sp[1]:  # has_arg
+                        arg_lanes.append(lane(f.args[0]))
+                    else:
+                        arg_lanes.append((jnp.zeros(n, jnp.int64), jnp.ones(n, bool)))
+                outs, perm, sm = window_program(
+                    jax,
+                    jnp,
+                    mask=mask,
+                    part_lanes=part_lanes,
+                    order_lanes=order_lanes,
+                    order_descs=[d for _, d in order_pairs],
+                    frame_tag=frame_tag,
+                    specs=specs,
+                    arg_lanes=arg_lanes,
+                    n=n,
+                    bounds=bounds,
+                )
+                base_cols = [(_bcast(d, n), _vmask(v, n)) for d, v in batch.cols]
+                nxt = executors[2 + exi].tp if 2 + exi < len(executors) else None
+                if nxt in (dagpb.AGGREGATION, dagpb.STREAM_AGG):
+                    # an aggregation consumes rows order-free: keep everything
+                    # in sorted order and skip the inverse-permutation sort
+                    new_cols = [(d[perm], v[perm]) for d, v in base_cols] + list(outs)
+                    mask = sm
+                else:
+                    inv = jnp.argsort(perm)
+                    new_cols = base_cols + [(d[inv], v[inv]) for d, v in outs]
+                batch = EvalBatch(new_cols, list(batch.dicts) + [None] * len(outs), n)
 
         # final packaging; ngroups travels out so the caller can detect
         # agg-cap overflow even when agg is not the last executor

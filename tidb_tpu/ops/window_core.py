@@ -1,0 +1,340 @@
+"""Device window program core, shared by the standalone window kernel
+(ops/window_kernel.py — WindowExec's device path) and the fused DAG kernel
+(ops/dag_kernel.py — WINDOW executors pushed into coprocessor requests).
+
+Reference parity: pkg/executor WindowExec semantics (ranking, framed
+aggregates, lead/lag family) and the Shuffle repartitioner's partition
+isolation (shuffle.go:86) — computed as one sorted-batch segment program:
+
+  sort rows by (live, partition keys, order keys, row index)
+  → partition/peer segment boundaries → ranking by positional arithmetic,
+  framed aggregates by prefix-sum differences and segmented scans.
+
+The sort is the scaling knob. When every sort lane has known integer value
+bounds (column min/max from the column cache, dictionary sizes, or a host-side
+numpy pass), the whole lex order packs into ONE int64 key — live bit, then
+per-lane offset codes, then the row index for stability — and a single
+argsort replaces the multi-lane stable-argsort chain, which under x64
+emulation costs minutes of compile time and seconds of run time past ~4M
+rows (measured: packed sort+gathers ≈ 2.1s at 2^25 on one chip; the 4-lane
+chain ≈ 1.1s at 2^23 with a 69s compile)."""
+
+from __future__ import annotations
+
+# window functions the device program implements (ref: WindowExec func set)
+SUPPORTED = {
+    "row_number",
+    "rank",
+    "dense_rank",
+    "percent_rank",
+    "cume_dist",
+    "ntile",
+    "lead",
+    "lag",
+    "first_value",
+    "last_value",
+    "count",
+    "sum",
+    "avg",
+    "min",
+    "max",
+}
+
+
+def derive_specs(funcs, *, whole_partition, rows_frame, frame, order_is_string):
+    """Static device-support check + per-func spec derivation, shared by
+    WindowExec's device gate, the planner's pushdown gate, and the DAG binder.
+
+    ``funcs``: WindowFuncDesc-likes (.name, .args Expressions, .ftype).
+    Returns (frame_tag, specs) or None when the shape is host-only.
+    spec = (name, has_arg, arg_is_float, c0, c1, c2_is_float): consts carry
+    ntile k / lead-lag offset + default / avg scale_up baked into the program.
+    """
+    from tidb_tpu.expression.expr import Constant
+    from tidb_tpu.types import TypeKind
+
+    if frame is not None:
+        frame_tag = ("rows",) + tuple(frame)
+    elif whole_partition:
+        frame_tag = "whole"
+    elif rows_frame:
+        frame_tag = "rows_cur"
+    else:
+        frame_tag = "range_cur"
+    bounded = isinstance(frame_tag, tuple)
+    if order_is_string:
+        return None  # caller must legalize string order keys first (sorted dict)
+    specs = []
+    for f in funcs:
+        if f.name not in SUPPORTED:
+            return None
+        if bounded and f.name in ("min", "max"):
+            return None  # sliding extreme: host sweep only
+        has_arg = bool(f.args)
+        is_f = bool(f.args) and f.args[0].ftype.kind == TypeKind.FLOAT
+        c0 = c1 = 0
+        c2f = False
+        if has_arg and f.args[0].ftype.kind == TypeKind.STRING:
+            return None
+        if f.name == "ntile":
+            if not isinstance(f.args[0], Constant) or f.args[0].value is None:
+                return None
+            c0 = int(f.args[0].value)
+            has_arg = False
+            if c0 <= 0:
+                return None
+        elif f.name in ("lead", "lag"):
+            if len(f.args) > 1:
+                if not isinstance(f.args[1], Constant) or f.args[1].value is None:
+                    return None
+                c0 = int(f.args[1].value)
+            else:
+                c0 = 1
+            if len(f.args) > 2:
+                d2 = f.args[2]
+                if not isinstance(d2, Constant) or d2.ftype.kind == TypeKind.STRING:
+                    return None
+                from tidb_tpu.types.datum import Datum
+
+                c2f = d2.value is not None
+                c1 = Datum(d2.value, d2.ftype).physical() if c2f else 0
+        elif f.name == "avg":
+            c0 = 10 ** (f.ftype.scale - f.args[0].ftype.scale) if f.ftype.kind == TypeKind.DECIMAL else 0
+        specs.append((f.name, has_arg, is_f, c0, c1, c2f))
+    return frame_tag, tuple(specs)
+
+
+def widen_bounds(bounds):
+    """Round (lo, hi) outward to power-of-two envelopes so measured bounds
+    become stable across small data changes — jitted programs bake bounds as
+    constants, and coarse buckets keep the compile cache warm."""
+    out = []
+    for b in bounds:
+        if b is None:
+            out.append(None)
+            continue
+        lo, hi = int(b[0]), int(b[1])
+        lo2 = 0 if lo >= 0 else -(1 << (-lo).bit_length())
+        hi2 = (1 << (hi + 1).bit_length()) - 1 if hi >= 0 else 0
+        out.append((lo2, hi2))
+    return out
+
+
+def packed_bits(bounds, n: int):
+    """Per-lane widths + total key capacity for the packed single-key sort.
+    bounds: [(lo, hi)] per sort lane (parts then orders), any None → not
+    packable. Returns list of lane widths (value span + NULL slot) or None."""
+    if bounds is None or any(b is None for b in bounds):
+        return None
+    widths = []
+    cap = 2 * max(n, 1)  # live bit × index lane
+    for lo, hi in bounds:
+        if hi < lo:
+            hi = lo
+        w = (hi - lo) + 2  # one extra slot for NULL
+        widths.append(w)
+        cap *= w
+        if cap > (1 << 62):
+            return None
+    return widths
+
+
+def sort_perm(jax, jnp, mask, key_lanes, descs, n, bounds=None):
+    """Permutation ordering rows by (live first, lanes asc/desc with MySQL
+    NULL placement, original index). key_lanes: [(data, valid)] in original
+    row order; descs aligns with key_lanes (partition keys are ``False``).
+
+    Packed single-key argsort when ``bounds`` covers every lane and fits
+    62 bits; multi-lane stable-argsort chain otherwise."""
+    iota = jnp.arange(n)
+    widths = packed_bits(bounds, n)
+    if widths is not None:
+        key = (~mask).astype(jnp.int64)  # live rows first
+        for (d, v), desc, w, (lo, _hi) in zip(key_lanes, descs, widths, bounds):
+            d64 = d.astype(jnp.int64) if not jnp.issubdtype(d.dtype, jnp.floating) else d
+            if desc:
+                # descending values, NULLs last
+                code = jnp.where(v, (lo + w - 2) - d64, w - 1)
+            else:
+                # ascending values, NULLs first
+                code = jnp.where(v, d64 - lo + 1, 0)
+            code = jnp.clip(code, 0, w - 1)  # dead-row garbage stays in-lane
+            key = key * w + code
+        key = key * n + iota  # stability + unique keys
+        return jnp.argsort(key)
+    lanes = [~mask]
+    for (d, v), desc in zip(key_lanes, descs):
+        if desc:
+            lanes.append(~v)  # NULLs last
+            lanes.append(-d if jnp.issubdtype(d.dtype, jnp.floating) else ~d)
+        else:
+            lanes.append(v)  # NULLs first
+            lanes.append(d)
+    perm = jnp.argsort(lanes[-1], stable=True)
+    for lane in reversed(lanes[:-1]):
+        perm = perm[jnp.argsort(lane[perm], stable=True)]
+    return perm
+
+
+def window_program(jax, jnp, *, mask, part_lanes, order_lanes, order_descs,
+                   frame_tag, specs, arg_lanes, n, bounds=None):
+    """The full device window computation over one padded batch.
+
+    mask: live-row mask in ORIGINAL row order (False = padding or rows
+    filtered out by an upstream selection). part/order/arg lanes: (data,
+    valid) pairs in original order. bounds: per part+order sort lane (lo, hi)
+    or None entries (see sort_perm). Returns (outs_sorted, perm, sm):
+    per-func (data, valid) in SORTED row order, the sort permutation, and the
+    sorted live mask — the caller inverse-permutes when original order
+    matters, or keeps sorted order when an aggregation follows."""
+    iota = jnp.arange(n)
+    # NULL slots mask to 0 so computed-expression garbage can't split a NULL
+    # partition or peer group
+    part_m = [(jnp.where(v, d, 0), v) for d, v in part_lanes]
+    order_m = [(jnp.where(v, d, 0), v) for d, v in order_lanes]
+    key_lanes = part_m + order_m
+    descs = [False] * len(part_m) + list(order_descs)
+    perm = sort_perm(jax, jnp, mask, key_lanes, descs, n, bounds)
+    sm = mask[perm]
+
+    first = iota == 0
+    # dead rows sort last; the live→dead transition starts its own
+    # "partition" so dead rows can never inflate a real partition's extent
+    pboundary = first | jnp.concatenate([jnp.zeros(1, bool), sm[1:] != sm[:-1]])
+    for d, v in part_m:
+        ds, vs = d[perm], v[perm]
+        pboundary = pboundary | jnp.concatenate(
+            [jnp.zeros(1, bool), (ds[1:] != ds[:-1]) | (vs[1:] != vs[:-1])]
+        )
+    peer = pboundary
+    for d, v in order_m:
+        ds, vs = d[perm], v[perm]
+        peer = peer | jnp.concatenate(
+            [jnp.zeros(1, bool), (ds[1:] != ds[:-1]) | (vs[1:] != vs[:-1])]
+        )
+
+    pid = jnp.cumsum(pboundary) - 1
+    ps = jnp.searchsorted(pid, pid, side="left")  # partition start index
+    pe = jnp.searchsorted(pid, pid, side="right")  # partition end index
+    pos = iota - ps
+    m = pe - ps
+    # peer-group first row and end row (rank/cume_dist)
+    peer_first = jax.lax.associative_scan(jnp.maximum, jnp.where(peer, iota, -1))
+    b_pos = jnp.where(peer, iota, n)
+    sfx_min = jax.lax.associative_scan(jnp.minimum, b_pos, reverse=True)
+    peer_end = jnp.minimum(jnp.concatenate([sfx_min[1:], jnp.full(1, n)]), pe)
+    cum_peer = jnp.cumsum(peer)
+    dense = cum_peer - cum_peer[ps] + 1
+    rank = peer_first - ps + 1
+
+    # frame [fs, fe) per row
+    if frame_tag == "whole":
+        fs, fe = ps, pe
+    elif frame_tag == "rows_cur":
+        fs, fe = ps, iota + 1
+    elif frame_tag == "range_cur":
+        fs, fe = ps, peer_end
+    else:
+        _, sk, sn_, ek, en_ = frame_tag
+        if sk == "unbounded":
+            fs = ps
+        elif sk == "current":
+            fs = iota
+        elif sk == "preceding":
+            fs = jnp.maximum(iota - sn_, ps)
+        else:
+            fs = jnp.minimum(iota + sn_, pe)
+        if ek == "unbounded":
+            fe = pe
+        elif ek == "current":
+            fe = iota + 1
+        elif ek == "preceding":
+            fe = jnp.maximum(iota - en_ + 1, ps)
+        else:
+            fe = jnp.minimum(iota + en_ + 1, pe)
+        fe = jnp.maximum(fe, fs)
+
+    outs = []
+    for (name, has_arg, is_f, c0_, c1_, c2f), al in zip(specs, arg_lanes):
+        if has_arg:
+            av = al[0][perm]
+            vv = al[1][perm] & sm
+        else:
+            av = jnp.zeros(n, jnp.int64)
+            vv = sm
+        if name == "row_number":
+            outs.append((pos + 1, sm))
+        elif name == "rank":
+            outs.append((rank, sm))
+        elif name == "dense_rank":
+            outs.append((dense, sm))
+        elif name == "percent_rank":
+            outs.append((jnp.where(m > 1, (rank - 1) / jnp.maximum(m - 1, 1), 0.0), sm))
+        elif name == "cume_dist":
+            outs.append(((peer_end - ps) / jnp.maximum(m, 1), sm))
+        elif name == "ntile":
+            k = c0_
+            q, rem = m // k, m % k
+            big = rem * (q + 1)
+            bucket = jnp.where(pos < big, pos // (q + 1), rem + (pos - big) // jnp.maximum(q, 1))
+            outs.append((bucket + 1, sm))
+        elif name in ("lead", "lag"):
+            off = -c0_ if name == "lag" else c0_
+            src = pos + off
+            ok = (src >= 0) & (src < m)
+            gidx = jnp.clip(ps + src, 0, n - 1)
+            d = jnp.where(ok, av[gidx], c1_)
+            v = jnp.where(ok, vv[gidx], bool(c2f))
+            outs.append((d, v & sm))
+        elif name == "first_value":
+            ne = fe > fs
+            g = jnp.clip(fs, 0, n - 1)
+            outs.append((jnp.where(ne, av[g], 0), ne & vv[g] & sm))
+        elif name == "last_value":
+            ne = fe > fs
+            g = jnp.clip(fe - 1, 0, n - 1)
+            outs.append((jnp.where(ne, av[g], 0), ne & vv[g] & sm))
+        elif name in ("count", "sum", "avg"):
+            w = vv if has_arg else sm
+            c0 = jnp.concatenate([jnp.zeros(1, jnp.int64), jnp.cumsum(w.astype(jnp.int64))])
+            cnt = c0[fe] - c0[fs]
+            if name == "count":
+                outs.append((cnt, sm))
+                continue
+            filled = jnp.where(w, av, 0)
+            if is_f:
+                s0 = jnp.concatenate([jnp.zeros(1, jnp.float64), jnp.cumsum(filled * 1.0)])
+            else:
+                s0 = jnp.concatenate([jnp.zeros(1, jnp.int64), jnp.cumsum(filled)])
+            cum = s0[fe] - s0[fs]
+            if name == "sum":
+                outs.append((jnp.where(cnt > 0, cum, 0), (cnt > 0) & sm))
+            else:  # avg; c0_ = scale_up (0 → float avg)
+                safe = jnp.maximum(cnt, 1)
+                if c0_:
+                    val = jnp.round(cum * c0_ / safe).astype(jnp.int64)
+                else:
+                    val = cum / safe
+                outs.append((jnp.where(cnt > 0, val, 0), (cnt > 0) & sm))
+        elif name in ("min", "max"):
+            # segmented running extreme (reset at partition boundary);
+            # whole/range_cur gather at the frame end, rows_cur at self
+            if is_f:
+                sent = jnp.inf if name == "min" else -jnp.inf
+            else:
+                sent = jnp.iinfo(jnp.int64).max if name == "min" else jnp.iinfo(jnp.int64).min
+            lane = jnp.where(vv, av, sent)
+
+            def comb(ab, cd, _name=name):
+                f1, v1 = ab
+                f2, v2 = cd
+                op = jnp.minimum if _name == "min" else jnp.maximum
+                return (f1 | f2, jnp.where(f2, v2, op(v1, v2)))
+
+            _, run = jax.lax.associative_scan(comb, (pboundary, lane))
+            g = jnp.clip(fe - 1, 0, n - 1)
+            c0 = jnp.concatenate([jnp.zeros(1, jnp.int64), jnp.cumsum(vv.astype(jnp.int64))])
+            cnt = c0[fe] - c0[fs]
+            outs.append((jnp.where(cnt > 0, run[g], 0), (cnt > 0) & sm))
+
+    return outs, perm, sm

@@ -2,9 +2,10 @@
 
 Reference parity: pkg/executor WindowExec + the Shuffle intra-node
 repartitioner (shuffle.go:86) — but instead of per-partition Go loops, the
-whole operator evaluates as ONE sorted-batch program over padded lanes:
+whole operator evaluates as ONE sorted-batch program over padded lanes (the
+shared core in ops/window_core.py):
 
-  lex-sort rows by (partition keys, order keys)  →  partition/peer segment
+  sort rows by (partition keys, order keys)  →  partition/peer segment
   boundaries  →  ranking = positional arithmetic on segment starts;
   framed aggregates = prefix-sum differences (count/sum/avg) and segmented
   running scans (min/max)  →  inverse-permutation gather restores row order.
@@ -14,63 +15,47 @@ on-device: whole partition, RANGE UNBOUNDED..CURRENT (peers share), ROWS
 UNBOUNDED..CURRENT, and bounded ROWS for the prefix-sum aggregates. The
 executor falls back to the host sweep for anything else (bounded-frame
 MIN/MAX, string order keys, non-constant ntile/lead offsets).
+
+Scale: when the caller supplies integer value bounds for every sort lane
+(numpy min/max — one cheap host pass), the sort packs into a single int64
+key (window_core.sort_perm), so the kernel stays fast far past the old
+4M-row multi-lane-sort ceiling.
 """
 
 from __future__ import annotations
 
 import threading
 
-from tidb_tpu.utils.chunk import bucket_size
-
-# functions the device kernel implements (ref: WindowExec function set)
-SUPPORTED = {
-    "row_number",
-    "rank",
-    "dense_rank",
-    "percent_rank",
-    "cume_dist",
-    "ntile",
-    "lead",
-    "lag",
-    "first_value",
-    "last_value",
-    "count",
-    "sum",
-    "avg",
-    "min",
-    "max",
-}
+from tidb_tpu.ops.window_core import SUPPORTED, window_program  # noqa: F401 (re-export)
 
 # below this row count a host sweep beats the device round trip — the lane
 # upload + result download amortize only once the host's O(n log n) sort
 # dominates (tests shrink it to force the device path on tiny data)
 DEVICE_MIN_ROWS = 2_000_000
-# above one device block the multi-lane sort's compile cost explodes under
-# x64 emulation; larger windows stay on the host sweep until the kernel
-# learns the packed single-key sort
-DEVICE_MAX_ROWS = 1 << 22
+# packed single-key sorts scale to one full device batch; without bounds the
+# multi-lane sort's compile cost explodes under x64 emulation past one block
+DEVICE_MAX_ROWS = 1 << 25
+MULTILANE_MAX_ROWS = 1 << 22
 
 _CACHE: dict = {}
 _MU = threading.Lock()
 
 
-def get_window_fn(spec: tuple, n_pad: int):
-    key = (spec, n_pad)
+def get_window_fn(spec: tuple, n_pad: int, bounds: tuple = None):
+    key = (spec, n_pad, bounds)
     with _MU:
         fn = _CACHE.get(key)
     if fn is None:
-        fn = _build(spec, n_pad)
+        fn = _build(spec, n_pad, bounds)
         with _MU:
             _CACHE[key] = fn
     return fn
 
 
-def _build(spec: tuple, n_pad: int):
-    """spec = (n_part_keys, order_descs, frame_tag, funcs) where
-    frame_tag ∈ {"whole", "range_cur", "rows_cur", ("rows", fs_kind, fs_n,
-    fe_kind, fe_n)} and funcs = tuple of (name, has_arg, arg_is_float,
-    const0, const1, const2_is_float) — consts carry ntile k / lead offset +
-    default / avg scale_up baked into the program."""
+def _build(spec: tuple, n_pad: int, bounds):
+    """spec = (n_part_keys, order_descs, frame_tag, funcs) — see
+    window_core.derive_specs for the funcs tuple layout. ``bounds``: per
+    part+order sort lane (lo, hi) or None (enables the packed sort)."""
     import jax
     import jax.numpy as jnp
 
@@ -78,165 +63,21 @@ def _build(spec: tuple, n_pad: int):
     n = n_pad
 
     def fn(part_lanes, order_lanes, arg_lanes, nvalid):
-        iota = jnp.arange(n)
-        mask = iota < nvalid
-        # NULL slots mask to 0 so computed-expression garbage can't split a
-        # NULL partition or peer group
-        part_m = [(jnp.where(v, d, 0), v) for d, v in part_lanes]
-        order_m = [(jnp.where(v, d, 0), v) for d, v in order_lanes]
-        lanes = [~mask]
-        for d, v in part_m:
-            lanes.append(~v)
-            lanes.append(d)
-        for (d, v), desc in zip(order_m, order_descs):
-            if desc:
-                lanes.append(~v)  # NULLs last
-                lanes.append(-d if jnp.issubdtype(d.dtype, jnp.floating) else ~d)
-            else:
-                lanes.append(v)  # NULLs first
-                lanes.append(d)
-        perm = jnp.argsort(lanes[-1], stable=True)
-        for lane in reversed(lanes[:-1]):
-            perm = perm[jnp.argsort(lane[perm], stable=True)]
-        inv = jnp.argsort(perm, stable=True)
-        sm = mask[perm]
-
-        first = iota == 0
-        # padding rows sort last; the live→pad transition starts its own
-        # "partition" so pads can never inflate a real partition's extent
-        pboundary = first | jnp.concatenate([jnp.zeros(1, bool), sm[1:] != sm[:-1]])
-        for d, v in part_m:
-            ds, vs = d[perm], v[perm]
-            pboundary = pboundary | jnp.concatenate([jnp.zeros(1, bool), (ds[1:] != ds[:-1]) | (vs[1:] != vs[:-1])])
-        peer = pboundary
-        for d, v in order_m:
-            ds, vs = d[perm], v[perm]
-            peer = peer | jnp.concatenate([jnp.zeros(1, bool), (ds[1:] != ds[:-1]) | (vs[1:] != vs[:-1])])
-
-        pid = jnp.cumsum(pboundary) - 1
-        ps = jnp.searchsorted(pid, pid, side="left")  # partition start index
-        pe = jnp.searchsorted(pid, pid, side="right")  # partition end index
-        pos = iota - ps
-        m = pe - ps
-        # peer-group first row and end row (rank/cume_dist)
-        peer_first = jax.lax.associative_scan(jnp.maximum, jnp.where(peer, iota, -1))
-        b_pos = jnp.where(peer, iota, n)
-        sfx_min = jax.lax.associative_scan(jnp.minimum, b_pos, reverse=True)
-        peer_end = jnp.minimum(jnp.concatenate([sfx_min[1:], jnp.full(1, n)]), pe)
-        cum_peer = jnp.cumsum(peer)
-        dense = cum_peer - cum_peer[ps] + 1
-        rank = peer_first - ps + 1
-
-        # frame [fs, fe) per row
-        if frame_tag == "whole":
-            fs, fe = ps, pe
-        elif frame_tag == "rows_cur":
-            fs, fe = ps, iota + 1
-        elif frame_tag == "range_cur":
-            fs, fe = ps, peer_end
-        else:
-            _, sk, sn_, ek, en_ = frame_tag
-            if sk == "unbounded":
-                fs = ps
-            elif sk == "current":
-                fs = iota
-            elif sk == "preceding":
-                fs = jnp.maximum(iota - sn_, ps)
-            else:
-                fs = jnp.minimum(iota + sn_, pe)
-            if ek == "unbounded":
-                fe = pe
-            elif ek == "current":
-                fe = iota + 1
-            elif ek == "preceding":
-                fe = jnp.maximum(iota - en_ + 1, ps)
-            else:
-                fe = jnp.minimum(iota + en_ + 1, pe)
-            fe = jnp.maximum(fe, fs)
-
-        outs = []
-        for (name, has_arg, is_f, c0_, c1_, c2f), al in zip(funcs, arg_lanes):
-            if has_arg:
-                av = al[0][perm]
-                vv = al[1][perm] & sm
-            else:
-                av = jnp.zeros(n, jnp.int64)
-                vv = sm
-            if name == "row_number":
-                outs.append((pos + 1, sm))
-            elif name == "rank":
-                outs.append((rank, sm))
-            elif name == "dense_rank":
-                outs.append((dense, sm))
-            elif name == "percent_rank":
-                outs.append((jnp.where(m > 1, (rank - 1) / jnp.maximum(m - 1, 1), 0.0), sm))
-            elif name == "cume_dist":
-                outs.append(((peer_end - ps) / jnp.maximum(m, 1), sm))
-            elif name == "ntile":
-                k = c0_
-                q, rem = m // k, m % k
-                big = rem * (q + 1)
-                bucket = jnp.where(pos < big, pos // (q + 1), rem + (pos - big) // jnp.maximum(q, 1))
-                outs.append((bucket + 1, sm))
-            elif name in ("lead", "lag"):
-                off = -c0_ if name == "lag" else c0_
-                src = pos + off
-                ok = (src >= 0) & (src < m)
-                gidx = jnp.clip(ps + src, 0, n - 1)
-                d = jnp.where(ok, av[gidx], c1_)
-                v = jnp.where(ok, vv[gidx], bool(c2f))
-                outs.append((d, v & sm))
-            elif name == "first_value":
-                ne = fe > fs
-                g = jnp.clip(fs, 0, n - 1)
-                outs.append((jnp.where(ne, av[g], 0), ne & vv[g] & sm))
-            elif name == "last_value":
-                ne = fe > fs
-                g = jnp.clip(fe - 1, 0, n - 1)
-                outs.append((jnp.where(ne, av[g], 0), ne & vv[g] & sm))
-            elif name in ("count", "sum", "avg"):
-                w = vv if has_arg else sm
-                c0 = jnp.concatenate([jnp.zeros(1, jnp.int64), jnp.cumsum(w.astype(jnp.int64))])
-                cnt = c0[fe] - c0[fs]
-                if name == "count":
-                    outs.append((cnt, sm))
-                    continue
-                filled = jnp.where(w, av, 0)
-                if is_f:
-                    s0 = jnp.concatenate([jnp.zeros(1, jnp.float64), jnp.cumsum(filled * 1.0)])
-                else:
-                    s0 = jnp.concatenate([jnp.zeros(1, jnp.int64), jnp.cumsum(filled)])
-                cum = s0[fe] - s0[fs]
-                if name == "sum":
-                    outs.append((jnp.where(cnt > 0, cum, 0), (cnt > 0) & sm))
-                else:  # avg; c0_ = scale_up (0 → float avg)
-                    safe = jnp.maximum(cnt, 1)
-                    if c0_:
-                        val = jnp.round(cum * c0_ / safe).astype(jnp.int64)
-                    else:
-                        val = cum / safe
-                    outs.append((jnp.where(cnt > 0, val, 0), (cnt > 0) & sm))
-            elif name in ("min", "max"):
-                # segmented running extreme (reset at partition boundary);
-                # whole/range_cur gather at the frame end, rows_cur at self
-                if is_f:
-                    sent = jnp.inf if name == "min" else -jnp.inf
-                else:
-                    sent = jnp.iinfo(jnp.int64).max if name == "min" else jnp.iinfo(jnp.int64).min
-                lane = jnp.where(vv, av, sent)
-
-                def comb(ab, cd):
-                    f1, v1 = ab
-                    f2, v2 = cd
-                    op = jnp.minimum if name == "min" else jnp.maximum
-                    return (f1 | f2, jnp.where(f2, v2, op(v1, v2)))
-
-                _, run = jax.lax.associative_scan(comb, (pboundary, lane))
-                g = jnp.clip(fe - 1, 0, n - 1)
-                c0 = jnp.concatenate([jnp.zeros(1, jnp.int64), jnp.cumsum(vv.astype(jnp.int64))])
-                cnt = c0[fe] - c0[fs]
-                outs.append((jnp.where(cnt > 0, run[g], 0), (cnt > 0) & sm))
-
+        mask = jnp.arange(n) < nvalid
+        outs, perm, _sm = window_program(
+            jax,
+            jnp,
+            mask=mask,
+            part_lanes=list(part_lanes),
+            order_lanes=list(order_lanes),
+            order_descs=order_descs,
+            frame_tag=frame_tag,
+            specs=funcs,
+            arg_lanes=list(arg_lanes),
+            n=n,
+            bounds=list(bounds) if bounds is not None else None,
+        )
+        inv = jnp.argsort(perm)
         # restore original row order
         flat = []
         for d, v in outs:

@@ -530,7 +530,13 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan
             if ipath is not None:
                 return ipath
         child = _physical(plan.children[0], engines, stats)
-        if isinstance(child, PhysTableReader) and child.pushed_agg is None and child.pushed_topn is None and child.pushed_limit is None:
+        if (
+            isinstance(child, PhysTableReader)
+            and child.pushed_agg is None
+            and child.pushed_topn is None
+            and child.pushed_limit is None
+            and child.pushed_window is None
+        ):
             st = _pick_engine(engines, plan.conditions)
             pushable = [c for c in plan.conditions if can_push_down(c, st.value)]
             host_side = [c for c in plan.conditions if not can_push_down(c, st.value)]
@@ -555,29 +561,62 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan
         return PhysSelection(conditions=plan.conditions, children=[child])
     if isinstance(plan, LogicalAggregation):
         child = _physical(plan.children[0], engines, stats)
-        exprs: list[Expression] = list(plan.group_by) + [a.arg for a in plan.aggs if a.arg is not None]
+        # look through row-preserving projections (ref: projection elimination
+        # before agg pushdown): remap group/arg exprs through each projection
+        # so the agg can land in the reader fragment — the path that fuses
+        # Agg over a cop-pushed Window into one device program
+        reader = child
+        proj_stack: list[PhysProjection] = []
+        while isinstance(reader, PhysProjection):
+            proj_stack.append(reader)
+            reader = reader.children[0]
+
+        def _remap_through(e: Expression) -> Optional[Expression]:
+            for pr in proj_stack:
+                e = _subst_refs(e, pr.exprs)
+                if e is None:
+                    return None
+            return e
+
+        group_r = plan.group_by
+        aggs_r = plan.aggs
+        remap_ok = True
+        if proj_stack:
+            group_r = [_remap_through(g) for g in plan.group_by]
+            aggs_r = []
+            for a in plan.aggs:
+                na = _remap_through(a.arg) if a.arg is not None else None
+                if a.arg is not None and na is None:
+                    remap_ok = False
+                aggs_r.append(AggDesc(a.name, na, a.distinct, a.sep))
+            remap_ok = remap_ok and all(g is not None for g in group_r)
         can_push = (
-            isinstance(child, PhysTableReader)
-            and child.pushed_agg is None
-            and child.pushed_topn is None
-            and child.pushed_limit is None
+            remap_ok
+            and isinstance(reader, PhysTableReader)
+            and reader.pushed_agg is None
+            and reader.pushed_topn is None
+            and reader.pushed_limit is None
             and not any(a.distinct for a in plan.aggs)
             # group_concat has no distributable partial state (value order
             # would be lost across task merges) — keep it at the root
             and all(a.name != "group_concat" for a in plan.aggs)
         )
         if can_push:
-            st = _pick_engine(engines, list(child.pushed_conditions) + exprs)
+            exprs: list[Expression] = list(group_r) + [a.arg for a in aggs_r if a.arg is not None]
+            st = _pick_engine(engines, list(reader.pushed_conditions) + exprs)
             if all(can_push_down(e, st.value) for e in exprs) and all(
-                can_push_down(c, st.value) for c in child.pushed_conditions
+                can_push_down(c, st.value) for c in reader.pushed_conditions
             ):
-                child.store_type = st
-                child.pushed_agg = plan
-                child.pushed_agg_mode = "partial"
+                reader.store_type = st
+                pushed = LogicalAggregation(
+                    group_by=group_r, aggs=aggs_r, schema=plan.schema, children=[reader]
+                )
+                reader.pushed_agg = pushed
+                reader.pushed_agg_mode = "partial"
                 # reader output schema = partial lanes + keys
-                child.schema = _partial_schema(plan)
+                reader.schema = _partial_schema(pushed)
                 final = PhysFinalAgg(
-                    group_by=plan.group_by, aggs=plan.aggs, partial_input=True, schema=plan.schema, children=[child]
+                    group_by=plan.group_by, aggs=plan.aggs, partial_input=True, schema=plan.schema, children=[reader]
                 )
                 return final
         return PhysFinalAgg(group_by=plan.group_by, aggs=plan.aggs, partial_input=False, schema=plan.schema, children=[child])
@@ -635,6 +674,9 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan
         child = _physical(plan.children[0], engines, stats)
         return PhysDistinct(children=[child])
     if isinstance(plan, LogicalWindow):
+        child = _physical(plan.children[0], engines, stats)
+        if _try_push_window(plan, child, engines):
+            return child  # the reader absorbed the window
         return PhysWindow(
             funcs=plan.funcs,
             partition_by=plan.partition_by,
@@ -643,7 +685,7 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan
             rows_frame=plan.rows_frame,
             frame=plan.frame,
             schema=plan.schema,
-            children=[_physical(plan.children[0], engines, stats)],
+            children=[child],
         )
     if isinstance(plan, LogicalSetOp):
         return PhysSetOp(
@@ -659,6 +701,53 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan
     raise PlanError(f"physical: unhandled node {type(plan).__name__}")
 
 
+def _try_push_window(plan: LogicalWindow, child, engines: list[str]) -> bool:
+    """Window pushdown into the coprocessor fragment (ref: the role tipb
+    window pushdown plays for TiFlash in pkg/planner/core — window executed
+    inside the columnar engine, feeding a fused device program). Gated on the
+    TPU engine: a host cop window would just move the same host sweep behind
+    an extra indirection. The cop client falls back to a host-side window
+    when the table spans multiple regions (partition rows must share one
+    computation)."""
+    if not (
+        isinstance(child, PhysTableReader)
+        and child.pushed_agg is None
+        and child.pushed_topn is None
+        and child.pushed_limit is None
+        and child.pushed_window is None
+        and child.table.partition is None
+    ):
+        return False
+    from tidb_tpu.ops.window_core import derive_specs
+
+    spec = derive_specs(
+        plan.funcs,
+        whole_partition=plan.whole_partition,
+        rows_frame=plan.rows_frame,
+        frame=plan.frame,
+        # string order keys are legal in the fragment: the device binder
+        # rank-sorts the dictionary, the host fallback compares bytes
+        order_is_string=False,
+    )
+    if spec is None:
+        return False
+    keys = list(plan.partition_by) + [e for e, _ in plan.order_by]
+    # ci collation folds at compare time — device dictionary codes are raw-
+    # byte identities, so case-insensitive grouping/ordering stays host-side
+    if any(e.ftype.kind == TypeKind.STRING and e.ftype.collation == "ci" for e in keys):
+        return False
+    exprs = keys + [a for f in plan.funcs for a in f.args]
+    st = _pick_engine(engines, list(child.pushed_conditions) + exprs)
+    if st != StoreType.TPU:
+        return False
+    if not all(can_push_down(e, st.value) for e in exprs):
+        return False
+    child.store_type = st
+    child.pushed_window = plan
+    child.schema = plan.schema
+    return True
+
+
 _INT_JOIN_KINDS = (TypeKind.INT, TypeKind.UINT, TypeKind.DECIMAL, TypeKind.DATE, TypeKind.DATETIME, TypeKind.DURATION)
 
 
@@ -668,6 +757,7 @@ def _plain_reader(rd) -> bool:
         and rd.pushed_agg is None
         and rd.pushed_topn is None
         and rd.pushed_limit is None
+        and rd.pushed_window is None
         and rd.table.partition is None
     )
 

@@ -201,6 +201,10 @@ class PhysTableReader(PhysicalPlan):
     pushed_agg_mode: str = "partial"
     pushed_topn: Optional[tuple[list, int]] = None  # (order_by, limit+offset)
     pushed_limit: Optional[int] = None
+    # window executed inside the coprocessor fragment (ref: tipb window
+    # pushdown to TiFlash); appends one output column per func to the scan
+    # schema, evaluated between Selection and any pushed Agg
+    pushed_window: Optional[LogicalWindow] = None
     scan_slots: list[int] = field(default_factory=list)  # storage slots scanned
     ranges: Optional[list[KeyRange]] = None
     keep_order: bool = False
@@ -410,6 +414,10 @@ def explain_plan(p, indent: int = 0, stats=None) -> str:
         ops = ["Scan"]
         if p.pushed_conditions:
             ops.append(f"Selection({', '.join(map(repr, p.pushed_conditions))})")
+        if p.pushed_window is not None:
+            w = p.pushed_window
+            over = f"partition by {w.partition_by}" if w.partition_by else "()"
+            ops.append(f"Window({', '.join(map(repr, w.funcs))} over {over})")
         if p.pushed_agg is not None:
             ops.append(f"{'Partial' if p.pushed_agg_mode == 'partial' else ''}Agg({', '.join(map(repr, p.pushed_agg.aggs))})")
         if p.pushed_topn is not None:

@@ -130,6 +130,8 @@ class Client:
             self.io.read()  # column defs
         if ncols:
             self._expect_eof()
+        # prepare-time result metadata (mysql_stmt_result_metadata analog)
+        self.last_prepare_cols = ncols
         return stmt_id, nparams
 
     def execute(self, stmt_id: int, params: list = ()):

@@ -152,13 +152,23 @@ class ClientConn:
         sid = self._next_stmt_id
         self._next_stmt_id += 1
         self.stmts[sid] = [name, ps.n_params, None]
-        # column count is statement-dependent; drivers tolerate 0 here and
-        # read the real defs from the execute response (the reference also
-        # reports best-effort metadata at prepare time)
-        io.write(p.stmt_prepare_ok(sid, 0, ps.n_params))
+        # real prepare-time column definitions when the schema is derivable
+        # (drivers like libmysqlclient read result metadata here); falls back
+        # to 0 columns for DML / parameter-dependent schemas
+        meta = self.session.prepared_result_schema(name)
+        ncols = len(meta[0]) if meta else 0
+        io.write(p.stmt_prepare_ok(sid, ncols, ps.n_params))
         if ps.n_params:
             for i in range(ps.n_params):
                 io.write(p.column_def(f"?{i}", p.T_VAR_STRING))
+            io.write(p.eof_packet())
+        if ncols:
+            for cname, ft in zip(meta[0], meta[1]):
+                if ft is not None:
+                    tc, ln, dec = p.type_for(ft)
+                else:
+                    tc, ln, dec = p.T_VAR_STRING, 255, 0
+                io.write(p.column_def(str(cname), tc, ln, dec))
             io.write(p.eof_packet())
 
     def _stmt_execute(self, io: p.PacketIO, data: bytes) -> None:

@@ -634,6 +634,25 @@ class Session:
         self._prepare(ast.Prepare(name, text=sql))
         return name
 
+    def prepared_result_schema(self, name: str):
+        """Prepare-time result metadata: plan the SELECT with NULL parameters
+        and return (columns, ftypes); None for non-SELECTs or statements
+        whose schema can't be derived before execution (ref: conn.go
+        returning real column definitions in the COM_STMT_PREPARE response)."""
+        ps = self.prepared.get(name)
+        if ps is None or not isinstance(ps.stmt, (ast.Select, ast.SetOp)):
+            return None
+        import copy
+
+        try:
+            bound = copy.deepcopy(ps.stmt)
+            if ps.n_params:
+                bound = ast.bind_params(bound, [None] * ps.n_params)
+            plan = self._plan_select(bound, cache_key=None)
+        except Exception:
+            return None
+        return [oc.name for oc in plan.schema], [oc.ftype for oc in plan.schema]
+
     def execute_prepared(self, name: str, params: Optional[list] = None) -> Result:
         ps = self.prepared.get(name)
         if ps is None:
