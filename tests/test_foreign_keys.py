@@ -1,0 +1,209 @@
+"""Foreign keys: DDL, DML checks, referential actions (ref:
+planner/core/foreign_key.go:78 plan nodes + the executor FK check/cascade
+execs + model.FKInfo). Checks run through the txn membuffer, so uncommitted
+rows participate."""
+
+import pytest
+
+import tidb_tpu
+from tidb_tpu.session.session import SessionError
+
+
+@pytest.fixture()
+def db():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE parent (id BIGINT PRIMARY KEY, name VARCHAR(16))")
+    d.execute(
+        "CREATE TABLE child (id BIGINT PRIMARY KEY, pid BIGINT,"
+        " CONSTRAINT fk_pid FOREIGN KEY (pid) REFERENCES parent (id) ON DELETE CASCADE ON UPDATE CASCADE)"
+    )
+    d.execute("INSERT INTO parent VALUES (1, 'a'), (2, 'b'), (3, 'c')")
+    d.execute("INSERT INTO child VALUES (10, 1), (11, 1), (12, 2), (13, NULL)")
+    return d
+
+
+def test_insert_violation(db):
+    with pytest.raises(Exception, match="foreign key constraint fails"):
+        db.execute("INSERT INTO child VALUES (20, 99)")
+    db.execute("INSERT INTO child VALUES (20, NULL)")  # NULL keys are exempt
+    db.execute("INSERT INTO child VALUES (21, 3)")
+
+
+def test_update_child_violation(db):
+    with pytest.raises(Exception, match="foreign key constraint fails"):
+        db.execute("UPDATE child SET pid = 77 WHERE id = 10")
+    db.execute("UPDATE child SET pid = 2 WHERE id = 10")
+    assert db.query("SELECT pid FROM child WHERE id = 10") == [(2,)]
+
+
+def test_delete_cascade(db):
+    db.execute("DELETE FROM parent WHERE id = 1")
+    assert db.query("SELECT id FROM child ORDER BY id") == [(12,), (13,)]
+
+
+def test_update_cascade(db):
+    db.execute("UPDATE parent SET id = 50 WHERE id = 1")
+    assert db.query("SELECT pid FROM child WHERE id IN (10, 11)") == [(50,), (50,)]
+
+
+def test_restrict(db):
+    db.execute(
+        "CREATE TABLE strict_child (id BIGINT PRIMARY KEY, pid BIGINT,"
+        " FOREIGN KEY (pid) REFERENCES parent (id))"
+    )
+    db.execute("INSERT INTO strict_child VALUES (1, 2)")
+    with pytest.raises(Exception, match="foreign key constraint fails"):
+        db.execute("DELETE FROM parent WHERE id = 2")
+    with pytest.raises(Exception, match="foreign key constraint fails"):
+        db.execute("UPDATE parent SET id = 99 WHERE id = 2")
+    db.execute("DELETE FROM strict_child WHERE id = 1")
+    db.execute("DELETE FROM parent WHERE id = 2")  # now unreferenced
+
+
+def test_set_null(db):
+    db.execute(
+        "CREATE TABLE sn_child (id BIGINT PRIMARY KEY, pid BIGINT,"
+        " FOREIGN KEY (pid) REFERENCES parent (id) ON DELETE SET NULL)"
+    )
+    db.execute("INSERT INTO sn_child VALUES (1, 3)")
+    db.execute("DELETE FROM parent WHERE id = 3")
+    assert db.query("SELECT pid FROM sn_child WHERE id = 1") == [(None,)]
+
+
+def test_multilevel_cascade(db):
+    db.execute(
+        "CREATE TABLE grandchild (id BIGINT PRIMARY KEY, cid BIGINT,"
+        " FOREIGN KEY (cid) REFERENCES child (id) ON DELETE CASCADE)"
+    )
+    db.execute("INSERT INTO grandchild VALUES (100, 10), (101, 12)")
+    db.execute("DELETE FROM parent WHERE id = 1")  # deletes child 10, 11 → gc 100
+    assert db.query("SELECT id FROM grandchild ORDER BY id") == [(101,)]
+
+
+def test_txn_membuffer_visibility(db):
+    s = db.session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO parent VALUES (70, 'x')")
+    s.execute("INSERT INTO child VALUES (30, 70)")  # parent staged, not committed
+    s.execute("COMMIT")
+    assert db.query("SELECT pid FROM child WHERE id = 30") == [(70,)]
+    s.execute("BEGIN")
+    s.execute("INSERT INTO child VALUES (31, 2)")
+    # the staged (uncommitted) child row participates in the cascade
+    s.execute("DELETE FROM parent WHERE id = 2")
+    assert s.query("SELECT COUNT(*) FROM child WHERE pid = 2") == [(0,)]
+    s.execute("ROLLBACK")
+    assert db.query("SELECT COUNT(*) FROM child WHERE pid = 2") == [(1,)]
+
+
+def test_alter_add_fk_validates_existing_rows(db):
+    db.execute("CREATE TABLE orphan (id BIGINT PRIMARY KEY, pid BIGINT)")
+    db.execute("INSERT INTO orphan VALUES (1, 999)")
+    with pytest.raises(Exception, match="has no parent"):
+        db.execute("ALTER TABLE orphan ADD CONSTRAINT fk_o FOREIGN KEY (pid) REFERENCES parent (id)")
+    db.execute("UPDATE orphan SET pid = 1 WHERE id = 1")
+    db.execute("ALTER TABLE orphan ADD CONSTRAINT fk_o FOREIGN KEY (pid) REFERENCES parent (id)")
+    with pytest.raises(Exception, match="foreign key constraint fails"):
+        db.execute("INSERT INTO orphan VALUES (2, 999)")
+    # the FK auto-created a supporting index on pid
+    plan = "\n".join(str(r[0]) for r in db.query("EXPLAIN SELECT id FROM orphan WHERE pid = 1"))
+    assert "fk_o" in plan, plan
+    db.execute("ALTER TABLE orphan DROP FOREIGN KEY fk_o")
+    db.execute("INSERT INTO orphan VALUES (2, 999)")  # constraint gone
+
+
+def test_drop_parent_blocked(db):
+    db.execute(
+        "CREATE TABLE child2 (id BIGINT PRIMARY KEY, pid BIGINT,"
+        " FOREIGN KEY (pid) REFERENCES parent (id))"
+    )
+    with pytest.raises(Exception, match="referenced by foreign key"):
+        db.execute("DROP TABLE parent")
+    db.execute("DROP TABLE child")
+    with pytest.raises(Exception, match="referenced by foreign key"):
+        db.execute("DROP TABLE parent")  # child2 still references it
+    db.execute("DROP TABLE child2")
+    db.execute("DROP TABLE parent")
+
+
+def test_foreign_key_checks_off(db):
+    s = db.session()
+    s.execute("SET foreign_key_checks = 0")
+    s.execute("INSERT INTO child VALUES (40, 999)")  # no parent, allowed
+    s.execute("DELETE FROM parent WHERE id = 1")  # no cascade with checks off
+    assert s.query("SELECT COUNT(*) FROM child WHERE pid = 1") == [(2,)]
+    s.execute("SET foreign_key_checks = 1")
+    with pytest.raises(Exception, match="foreign key constraint fails"):
+        s.execute("INSERT INTO child VALUES (41, 999)")
+
+
+def test_mid_ddl_and_errors(db):
+    # parent must expose a PK/unique index over the referenced columns
+    with pytest.raises(Exception, match="primary key or a unique index"):
+        db.execute(
+            "CREATE TABLE bad (id BIGINT PRIMARY KEY, nm VARCHAR(16),"
+            " FOREIGN KEY (nm) REFERENCES parent (name))"
+        )
+    # incompatible kinds
+    with pytest.raises(Exception, match="incompatible"):
+        db.execute(
+            "CREATE TABLE bad2 (id BIGINT PRIMARY KEY, pid VARCHAR(4),"
+            " FOREIGN KEY (pid) REFERENCES parent (id))"
+        )
+    # self-referential FK
+    db.execute(
+        "CREATE TABLE tree (id BIGINT PRIMARY KEY, up BIGINT,"
+        " FOREIGN KEY (up) REFERENCES tree (id) ON DELETE CASCADE)"
+    )
+    db.execute("INSERT INTO tree VALUES (1, NULL), (2, 1), (3, 2)")
+    db.execute("DELETE FROM tree WHERE id = 1")
+    assert db.query("SELECT COUNT(*) FROM tree") == [(0,)]
+
+
+def test_show_create_roundtrip(db):
+    sql = db.query("SHOW CREATE TABLE child")[0][1]
+    assert "CONSTRAINT `fk_pid` FOREIGN KEY (`pid`) REFERENCES `parent` (`id`)" in sql
+    assert "ON DELETE CASCADE" in sql and "ON UPDATE CASCADE" in sql
+    d2 = tidb_tpu.open()
+    d2.execute("CREATE TABLE parent (id BIGINT PRIMARY KEY, name VARCHAR(16))")
+    d2.execute(sql)  # round-trips
+    with pytest.raises(Exception, match="foreign key constraint fails"):
+        d2.execute("INSERT INTO child VALUES (1, 5)")
+
+
+def test_fk_covered_by_extending_unique_index(db):
+    # a UNIQUE(a, b) covers FK(a): unique entries carry no key-tail handle,
+    # so child-row discovery must read the handle from the value
+    db.execute(
+        "CREATE TABLE ext (id BIGINT PRIMARY KEY, a BIGINT, b BIGINT,"
+        " UNIQUE KEY uab (a, b), FOREIGN KEY (a) REFERENCES parent (id))"
+    )
+    db.execute("INSERT INTO ext VALUES (1, 2, 5)")
+    with pytest.raises(Exception, match="foreign key constraint fails"):
+        db.execute("DELETE FROM parent WHERE id = 2")
+    db.execute("DELETE FROM ext WHERE id = 1")
+    db.execute("DELETE FROM parent WHERE id = 2")
+
+
+def test_rename_parent_keeps_fk(db):
+    db.execute("ALTER TABLE parent RENAME TO parent2")
+    with pytest.raises(Exception, match="foreign key constraint fails"):
+        db.execute("INSERT INTO child VALUES (60, 999)")
+    db.execute("DELETE FROM parent2 WHERE id = 1")  # cascade still wired
+    assert db.query("SELECT COUNT(*) FROM child WHERE pid = 1") == [(0,)]
+    with pytest.raises(Exception, match="referenced by foreign key"):
+        db.execute("DROP TABLE parent2")
+
+
+def test_truncate_parent_blocked(db):
+    with pytest.raises(Exception, match="referenced by foreign key"):
+        db.execute("TRUNCATE TABLE parent")
+
+
+def test_failed_alter_add_fk_leaves_no_index(db):
+    db.execute("CREATE TABLE orph2 (id BIGINT PRIMARY KEY, pid BIGINT)")
+    db.execute("INSERT INTO orph2 VALUES (1, 999)")
+    with pytest.raises(Exception, match="has no parent"):
+        db.execute("ALTER TABLE orph2 ADD CONSTRAINT fko2 FOREIGN KEY (pid) REFERENCES parent (id)")
+    rows = db.query("SHOW INDEX FROM orph2")
+    assert not any(r[2] == "fko2" for r in rows), rows

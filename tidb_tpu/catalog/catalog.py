@@ -54,6 +54,10 @@ class Catalog:
         self._autoid_cache: dict[int, tuple[int, int]] = {}  # tid → (next, max)
         # dropped/truncated table snapshots awaiting GC (RECOVER TABLE)
         self._recycle: list[dict] = []
+        # parent table id → [(child, fk, parent)] memo; DDL (every _persist)
+        # drops it — DML calls this once per mutated row, so the raw
+        # full-catalog sweep would make bulk deletes O(rows × tables)
+        self._fk_ref_cache: dict = {}
         self._load()
         if "test" not in self._dbs:  # bootstrap default db (ref: session bootstrap)
             self._dbs["test"] = DBInfo("test")
@@ -70,6 +74,7 @@ class Catalog:
 
     def _persist(self) -> None:
         self.schema_version += 1
+        self._fk_ref_cache = {}
         pb = {
             "version": self.schema_version,
             "dbs": {k: v.to_pb() for k, v in self._dbs.items()},
@@ -205,7 +210,15 @@ class Catalog:
                 t.partition = self._build_partition_info(t, stmt.partition_by)
             if stmt.ttl is not None:
                 self._set_ttl(t, stmt.ttl, stmt.ttl_enable)
+            # register before FK resolution so self-referential FKs resolve;
+            # roll the registration back if a constraint is invalid
             dbi.tables[tname] = t
+            try:
+                for fkd in stmt.foreign_keys:
+                    self._install_fk(db, t, fkd, validate_rows=False)
+            except Exception:
+                del dbi.tables[tname]
+                raise
             self._persist()
             return t
 
@@ -256,9 +269,46 @@ class Catalog:
                 if if_exists:
                     return
                 raise CatalogError(f"Unknown table '{name}'")
+            # a referenced parent can't be dropped while children point at it
+            # (self-references don't count — they drop with the table)
+            for cdb, ct, fk in self.referencing_fks(db, name.lower()):
+                if ct.id != t.id:
+                    raise CatalogError(
+                        f"cannot drop table {name!r}: referenced by foreign key "
+                        f"{fk.name!r} of {cdb}.{ct.name}"
+                    )
             self._recycle.append({"drop_ts": self.store.current_ts(), "db": db.lower(), "table": t.to_pb()})
             del dbi.tables[name.lower()]
             self._persist()
+
+    def referencing_fks(self, db: str, table_name: str) -> list:
+        """(child_db, child TableInfo, FKInfo) triples whose FK references
+        ``db.table_name`` (ref: infoschema referredFKs lookup)."""
+        out = []
+        dbl, tnl = db.lower(), table_name.lower()
+        for dbn, dbi in self._dbs.items():
+            for ct in dbi.tables.values():
+                for fk in ct.foreign_keys:
+                    if fk.ref_db == dbl and fk.ref_table == tnl:
+                        out.append((dbn, ct, fk))
+        return out
+
+    def referencing_fks_by_id(self, table_id: int) -> list:
+        """(child TableInfo, FKInfo, parent TableInfo) triples whose FK
+        resolves to the table with ``table_id`` — the DML parent-side hook.
+        Memoized per schema version (cleared by _persist)."""
+        hit = self._fk_ref_cache.get(table_id)
+        if hit is not None:
+            return hit
+        out = []
+        for dbi in self._dbs.values():
+            for ct in dbi.tables.values():
+                for fk in ct.foreign_keys:
+                    p = self.try_table(fk.ref_db, fk.ref_table)
+                    if p is not None and p.id == table_id:
+                        out.append((ct, fk, p))
+        self._fk_ref_cache[table_id] = out
+        return out
 
     def truncate_table(self, db: str, name: str) -> TableInfo:
         """New table id; the old snapshot goes to the recycle bin
@@ -268,6 +318,14 @@ class Catalog:
         with self._mu:
             dbi = self.db(db)
             t = self.table(db, name)
+            # MySQL: cannot truncate a table referenced by another table's FK
+            # (self-references are fine — their rows truncate together)
+            for cdb, ct, fk in self.referencing_fks(db, name):
+                if ct.id != t.id:
+                    raise CatalogError(
+                        f"cannot truncate table {name!r}: referenced by foreign key "
+                        f"{fk.name!r} of {cdb}.{ct.name}"
+                    )
             self._recycle.append(
                 {"drop_ts": self.store.current_ts(), "db": db.lower(), "table": _copy.deepcopy(t).to_pb()}
             )
@@ -448,6 +506,21 @@ class Catalog:
         machine (catalog/ddl.py). Layout-changing ALTERs (add/drop column)
         rewrite the table's rows in one transaction — a documented divergence
         from per-column online states."""
+        if stmt.action == "add_fk":
+            with self._mu:
+                t = self.table(db, stmt.table.name)
+                self._install_fk(db, t, stmt.fk, validate_rows=True)
+                self._persist()
+            return
+        if stmt.action == "drop_fk":
+            with self._mu:
+                t = self.table(db, stmt.table.name)
+                before = len(t.foreign_keys)
+                t.foreign_keys = [f for f in t.foreign_keys if f.name != stmt.name]
+                if len(t.foreign_keys) == before:
+                    raise CatalogError(f"foreign key {stmt.name!r} doesn't exist")
+                self._persist()
+            return
         if stmt.action == "add_index":
             t = self.table(db, stmt.table.name)
             for c in stmt.index.columns:
@@ -481,6 +554,16 @@ class Catalog:
                 if c is None:
                     raise CatalogError(f"column {stmt.name!r} doesn't exist")
                 off = c.offset
+                if any(off in fk.col_offsets for fk in t.foreign_keys):
+                    raise CatalogError(f"column {stmt.name!r} is used by a foreign key")
+                for cdb, ct, fk in self.referencing_fks(db, t.name):
+                    if c.name in fk.ref_col_names:
+                        raise CatalogError(
+                            f"column {stmt.name!r} is referenced by foreign key {fk.name!r} of {cdb}.{ct.name}"
+                        )
+                # child FK offsets past the dropped column shift down
+                for fk in t.foreign_keys:
+                    fk.col_offsets = [o - 1 if o > off else o for o in fk.col_offsets]
                 old_schema = RowSchema(t.storage_schema)
                 t.columns = [x for x in t.columns if x.offset != off]
                 for i, x in enumerate(t.columns):
@@ -505,9 +588,13 @@ class Catalog:
                 self._rewrite_rows(t, old_schema, lambda vals: vals[:off] + vals[off + 1 :])
             elif stmt.action == "rename":
                 dbi = self.db(db)
-                del dbi.tables[t.name]
+                old_name = t.name
+                del dbi.tables[old_name]
                 t.name = stmt.name.lower()
                 dbi.tables[t.name] = t
+                # children name the parent by (db, table): follow the rename
+                for _, ct, fk in self.referencing_fks(db, old_name):
+                    fk.ref_table = t.name
             elif stmt.action == "set_ttl":
                 self._set_ttl(t, stmt.ttl, True)
             elif stmt.action == "remove_ttl":
@@ -546,6 +633,105 @@ class Catalog:
                 raise CatalogError(f"unsupported ALTER action {stmt.action!r}")
             self._persist()
 
+    # -- foreign keys (ref: model.FKInfo + ddl foreign-key checks) ----------
+    def _install_fk(self, db: str, t: TableInfo, fkd, validate_rows: bool) -> None:
+        """Resolve + validate an FKDef against the catalog, auto-create the
+        child index when none covers the FK prefix (MySQL behavior), and
+        attach the FKInfo. ``validate_rows``: ALTER-time check that existing
+        child rows all have parents (CREATE TABLE starts empty)."""
+        from tidb_tpu.catalog.schema import FKInfo
+
+        if t.partition is not None:
+            raise CatalogError("foreign keys on partitioned tables are not supported")
+        ref_db = (fkd.ref_table.db or db).lower()
+        parent = self.table(ref_db, fkd.ref_table.name)
+        if parent.partition is not None:
+            raise CatalogError("foreign keys referencing partitioned tables are not supported")
+        if not fkd.columns or len(fkd.columns) != len(fkd.ref_columns):
+            raise CatalogError("foreign key column count mismatch")
+        col_offs = [self._col_offset(t, c) for c in fkd.columns]
+        ref_offs = [self._col_offset(parent, c) for c in fkd.ref_columns]
+        for co, ro in zip(col_offs, ref_offs):
+            if t.columns[co].ftype.kind != parent.columns[ro].ftype.kind:
+                raise CatalogError(
+                    f"foreign key column {t.columns[co].name!r} is incompatible with "
+                    f"referenced column {parent.columns[ro].name!r}"
+                )
+        if not _fk_parent_indexed(parent, ref_offs):
+            raise CatalogError(
+                "referenced columns must be the parent's primary key or a unique index"
+            )
+        if any(f.name == fkd.name for f in t.foreign_keys):
+            raise CatalogError(f"duplicate foreign key name {fkd.name!r}")
+        if fkd.on_delete == "set_null" and any(not t.columns[o].ftype.nullable for o in col_offs):
+            raise CatalogError("ON DELETE SET NULL requires nullable foreign key columns")
+        # validate BEFORE any mutation: a failed ALTER ... ADD FOREIGN KEY
+        # must leave no phantom index behind (validation scans rows directly,
+        # so it needs no index)
+        if validate_rows:
+            self._validate_fk_rows(t, parent, col_offs, ref_offs, fkd.name)
+        covered = (t.pk_is_handle and col_offs == [t.pk_offset]) or any(
+            idx.state == "public" and list(idx.column_offsets[: len(col_offs)]) == col_offs
+            for idx in t.indexes
+        )
+        if not covered:
+            # MySQL auto-creates an index on the FK columns when none exists
+            t.indexes.append(IndexInfo(t.next_index_id, fkd.name, list(col_offs)))
+            t.next_index_id += 1
+            if validate_rows:
+                self._backfill_index_now(t, t.indexes[-1])
+        fk_id = max((f.id for f in t.foreign_keys), default=0) + 1
+        t.foreign_keys.append(
+            FKInfo(
+                fk_id,
+                fkd.name,
+                list(col_offs),
+                ref_db,
+                parent.name,
+                [parent.columns[o].name for o in ref_offs],
+                fkd.on_delete,
+                fkd.on_update,
+            )
+        )
+
+    def _backfill_index_now(self, t: TableInfo, idx) -> None:
+        """Synchronous index backfill for FK auto-indexes (the async F1 path
+        serves user ADD INDEX; an FK's supporting index must exist before the
+        constraint validates)."""
+        from tidb_tpu.executor.write import index_entry
+
+        schema = RowSchema(t.storage_schema)
+        txn = self.store.begin()
+        for k, v in txn.scan(tablecodec.record_range(t.id)):
+            _, handle = tablecodec.decode_record_key(k)
+            vals = decode_row(schema, v)
+            ik, iv = index_entry(t, idx, vals, handle)
+            txn.put(ik, iv)
+        txn.commit()
+        from tidb_tpu.copr.colcache import cache_for
+
+        cache_for(self.store).invalidate_table(t.id)
+
+    def _validate_fk_rows(self, t: TableInfo, parent: TableInfo, col_offs, ref_offs, fk_name: str) -> None:
+        """Every existing child key must have a parent (ref: ALTER TABLE ADD
+        FOREIGN KEY validating with foreign_key_checks=ON)."""
+        schema_p = RowSchema(parent.storage_schema)
+        txn = self.store.begin()
+        parent_keys = set()
+        for _, v in txn.scan(tablecodec.record_range(parent.id)):
+            vals = decode_row(schema_p, v)
+            parent_keys.add(tuple(vals[o] for o in ref_offs))
+        schema_c = RowSchema(t.storage_schema)
+        for _, v in txn.scan(tablecodec.record_range(t.id)):
+            vals = decode_row(schema_c, v)
+            key = tuple(vals[o] for o in col_offs)
+            if any(k is None for k in key):
+                continue
+            if key not in parent_keys:
+                raise CatalogError(
+                    f"cannot add foreign key {fk_name!r}: child row {key} has no parent"
+                )
+
     def _rewrite_rows(self, t: TableInfo, old_schema: RowSchema, fn: Callable[[list], list]) -> None:
         from tidb_tpu.copr.colcache import cache_for
 
@@ -560,6 +746,20 @@ class Catalog:
             # old-layout blocks would desync slot numbering — drop them
             self.store.drop_stable(view.id)
             cache_for(self.store).invalidate_table(view.id)
+
+
+def _fk_parent_indexed(parent: TableInfo, ref_offs: list[int]) -> bool:
+    """Referenced columns must be the parent PK or exactly a unique index
+    (uniqueness makes child→parent lookups point reads and keeps RESTRICT
+    semantics unambiguous)."""
+    if parent.pk_is_handle and ref_offs == [parent.pk_offset]:
+        return True
+    for idx in parent.indexes:
+        if idx.state != "public" or not (idx.unique or idx.primary):
+            continue
+        if list(idx.column_offsets) == list(ref_offs):
+            return True
+    return False
 
 
 def _fold_default(node: ast.Node, ft) -> object:

@@ -96,6 +96,51 @@ class IndexInfo:
 
 
 @dataclass
+class FKInfo:
+    """Child-side foreign-key constraint (ref: model.FKInfo +
+    planner/core/foreign_key.go:78 plan nodes). ``ref_*`` name the parent by
+    (db, table) so renames keep working through catalog lookup at check time;
+    offsets address the CHILD's storage slots."""
+
+    id: int
+    name: str
+    col_offsets: list[int]
+    ref_db: str
+    ref_table: str
+    ref_col_names: list[str]
+    on_delete: str = "restrict"  # restrict | cascade | set_null | no_action
+    on_update: str = "restrict"
+    state: str = "public"  # mid-DDL FKs enforce writes but not reads
+
+    def to_pb(self) -> dict:
+        return {
+            "id": self.id,
+            "name": self.name,
+            "cols": self.col_offsets,
+            "ref_db": self.ref_db,
+            "ref_table": self.ref_table,
+            "ref_cols": self.ref_col_names,
+            "on_delete": self.on_delete,
+            "on_update": self.on_update,
+            "state": self.state,
+        }
+
+    @staticmethod
+    def from_pb(pb: dict) -> "FKInfo":
+        return FKInfo(
+            pb["id"],
+            pb["name"],
+            pb["cols"],
+            pb["ref_db"],
+            pb["ref_table"],
+            pb["ref_cols"],
+            pb.get("on_delete", "restrict"),
+            pb.get("on_update", "restrict"),
+            pb.get("state", "public"),
+        )
+
+
+@dataclass
 class PartitionDef:
     """One partition: its own physical table id (ref: model.PartitionDefinition
     — partitions are physical tables sharing one schema)."""
@@ -146,6 +191,8 @@ class TableInfo:
     ttl_col_offset: int = -1
     ttl_days: int = 0
     ttl_enable: bool = True
+    # child-side foreign keys (ref: model.TableInfo.ForeignKeys)
+    foreign_keys: list[FKInfo] = field(default_factory=list)
 
     def column(self, name: str) -> Optional[ColumnInfo]:
         lname = name.lower()
@@ -204,6 +251,7 @@ class TableInfo:
             "next_index_id": self.next_index_id,
             "partition": self.partition.to_pb() if self.partition else None,
             "ttl": [self.ttl_col_offset, self.ttl_days, self.ttl_enable],
+            "fks": [fk.to_pb() for fk in self.foreign_keys],
         }
 
     @staticmethod
@@ -219,6 +267,7 @@ class TableInfo:
             pb["next_index_id"],
             PartitionInfo.from_pb(pb["partition"]) if pb.get("partition") else None,
             *(pb.get("ttl") or [-1, 0, True]),
+            [FKInfo.from_pb(f) for f in pb.get("fks", [])],
         )
 
 

@@ -24,6 +24,7 @@ from tidb_tpu.planner.plans import (
     PhysIndexJoin,
     PhysMergeJoin,
     PhysIndexLookUp,
+    PhysIndexMerge,
     PhysIndexReader,
     PhysLimit,
     PhysMemSource,
@@ -98,6 +99,8 @@ def _build_executor(plan, session) -> Executor:
         return IndexReaderExec(plan, session)
     if isinstance(plan, PhysIndexLookUp):
         return IndexLookUpExec(plan, session)
+    if isinstance(plan, PhysIndexMerge):
+        return IndexMergeExec(plan, session)
     from tidb_tpu.parallel.gather import MPPGatherExec, PhysMPPGather
 
     if isinstance(plan, PhysMPPGather):
@@ -442,6 +445,89 @@ class IndexLookUpExec(Executor):
             pushed_conditions=list(p.residual_conditions),
             scan_slots=list(p.scan_slots),
             ranges=_coalesce_handle_ranges(t.id, handles),
+            schema=p.schema,
+        )
+        return TableReaderExec(reader, self.session).execute()
+
+
+@dataclass
+class IndexMergeExec(Executor):
+    """Union/intersection of per-path handle sets feeding one table lookup
+    (ref: IndexMergeReaderExecutor, executor/index_merge_reader.go:88 —
+    partial index/table workers → handle union → table worker). Paths run
+    concurrently on the cop pool; the table side re-applies the FULL
+    condition list, so over-approximating paths stay correct."""
+
+    plan: "PhysIndexMerge"
+    session: object
+
+    def __post_init__(self):
+        self.schema = self.plan.schema
+
+    def _path_handles(self, path) -> np.ndarray:
+        p = self.plan
+        t = p.table
+        if path[0] == "table":
+            scan = dagpb.ExecutorPB(
+                dagpb.TABLE_SCAN,
+                table_id=t.id,
+                columns=[dagpb.ColumnInfoPB(-1, bigint_type(nullable=False), is_handle=True)],
+                storage_schema=t.storage_schema,
+            )
+            ranges = path[1]
+        else:
+            idx = path[1]
+            scan = dagpb.ExecutorPB(
+                dagpb.INDEX_SCAN,
+                table_id=t.id,
+                index_id=idx.id,
+                index_col_offsets=list(idx.column_offsets),
+                unique=idx.unique,
+                columns=[dagpb.ColumnInfoPB(-1, bigint_type(nullable=False), is_handle=True)],
+                storage_schema=t.storage_schema,
+            )
+            ranges = path[2]
+        if not ranges:
+            return np.empty(0, np.int64)
+        req = Request(
+            tp=RequestType.DAG,
+            data=dagpb.DAGRequest(executors=[scan]),
+            ranges=ranges,
+            store_type=StoreType.HOST,
+            start_ts=self.session.read_ts(),
+            concurrency=int(self.session.vars.get("tidb_distsql_scan_concurrency", 8)),
+        )
+        chunks = [res.chunk for res in self.session.store.get_client().send(req) if len(res.chunk)]
+        if not chunks:
+            return np.empty(0, np.int64)
+        return np.concatenate([c.columns[0].data for c in chunks])
+
+    def execute(self) -> Chunk:
+        p = self.plan
+        if self.session._txn_dirty():
+            return _union_scan_fallback(self.session, p.table, p.scan_slots, p.all_conditions, p.schema)
+        from concurrent.futures import ThreadPoolExecutor
+
+        if len(p.paths) > 1:
+            with ThreadPoolExecutor(max_workers=min(4, len(p.paths)), thread_name_prefix="imerge") as pool:
+                handle_sets = list(pool.map(self._path_handles, p.paths))
+        else:
+            handle_sets = [self._path_handles(path) for path in p.paths]
+        if p.intersection:
+            handles = handle_sets[0]
+            for h in handle_sets[1:]:
+                handles = np.intersect1d(handles, h)
+        else:
+            handles = np.unique(np.concatenate(handle_sets)) if handle_sets else np.empty(0, np.int64)
+        if not len(handles):
+            return _empty_chunk(p.schema)
+        reader = PhysTableReader(
+            db=p.db,
+            table=p.table,
+            store_type=StoreType.HOST,
+            pushed_conditions=list(p.residual_conditions),
+            scan_slots=list(p.scan_slots),
+            ranges=_coalesce_handle_ranges(p.table.id, handles),
             schema=p.schema,
         )
         return TableReaderExec(reader, self.session).execute()

@@ -104,6 +104,204 @@ def index_entry(t: TableInfo, idx: IndexInfo, vals: list, handle: int) -> tuple[
     return tablecodec.index_key(t.id, idx.id, bytes(enc), handle), b"0"
 
 
+# -- foreign keys (ref: planner/core/foreign_key.go:78 FK check/cascade plan
+# nodes + the executor's FK check / FK cascade execs). Checks read through
+# the txn membuffer, so same-statement and same-txn rows count. -------------
+_FK_MAX_DEPTH = 15  # MySQL cascade depth limit
+
+
+def _fk_on(session) -> bool:
+    try:
+        return bool(int(session.vars.get("foreign_key_checks", 1)))
+    except (TypeError, ValueError):
+        return True
+
+
+def _encode_fk_key(t: TableInfo, offsets: list[int], key_vals: list) -> bytes:
+    """Memcomparable encoding of (non-NULL) FK key values, matching
+    index_entry's datum layout."""
+    enc = bytearray()
+    for off, v in zip(offsets, key_vals):
+        ft = t.columns[off].ftype
+        if ft.kind == TypeKind.STRING:
+            enc += codec.encode_key_bytes(v if isinstance(v, bytes) else str(v).encode())
+        elif ft.kind == TypeKind.FLOAT:
+            enc += codec.encode_key_float(float(v))
+        else:
+            enc += codec.encode_key_int(int(v))
+    return bytes(enc)
+
+
+def _fk_resolve(session, fk):
+    """(parent TableInfo, ref column offsets) or None when the parent is
+    gone (dropped with checks off)."""
+    parent = session.catalog.try_table(fk.ref_db, fk.ref_table)
+    if parent is None:
+        return None
+    ref_offs = []
+    for n in fk.ref_col_names:
+        c = parent.column(n)
+        if c is None:
+            return None
+        ref_offs.append(c.offset)
+    return parent, ref_offs
+
+
+def _fk_parent_exists(session, parent: TableInfo, ref_offs: list[int], key_vals: list) -> bool:
+    if parent.pk_is_handle and ref_offs == [parent.pk_offset]:
+        return _txn_read(session, tablecodec.record_key(parent.id, int(key_vals[0]))) is not None
+    idx = next(
+        (
+            i
+            for i in parent.indexes
+            if i.state == "public" and (i.unique or i.primary) and list(i.column_offsets) == list(ref_offs)
+        ),
+        None,
+    )
+    if idx is None:  # parent index dropped with checks off: fail open
+        return True
+    ik = tablecodec.index_key(parent.id, idx.id, _encode_fk_key(parent, ref_offs, key_vals))
+    return _txn_read(session, ik) is not None
+
+
+def _fk_check_child(session, t: TableInfo, vals: list) -> None:
+    """INSERT/UPDATE on a child: every non-NULL FK key needs a parent row."""
+    if not t.foreign_keys or not _fk_on(session):
+        return
+    for fk in t.foreign_keys:
+        key = [vals[o] for o in fk.col_offsets]
+        if any(k is None for k in key):
+            continue  # SQL: NULL keys are exempt from the check
+        res = _fk_resolve(session, fk)
+        if res is None:
+            continue
+        parent, ref_offs = res
+        if not _fk_parent_exists(session, parent, ref_offs, key):
+            raise WriteError(
+                f"Cannot add or update a child row: a foreign key constraint fails ({fk.name})"
+            )
+
+
+def _fk_child_rows(session, ct: TableInfo, fk, key_vals: list) -> list:
+    """[(handle, vals)] of child rows whose FK equals key_vals, read through
+    the membuffer via the FK's supporting index (auto-created at DDL time)."""
+    from tidb_tpu.kv.kv import KeyRange
+    from tidb_tpu.planner.ranger import prefix_next
+
+    txn = session.txn()
+    schema = RowSchema(ct.storage_schema)
+    if ct.pk_is_handle and fk.col_offsets == [ct.pk_offset]:
+        h = int(key_vals[0])
+        raw = _txn_read(session, tablecodec.record_key(ct.id, h))
+        return [(h, decode_row(schema, raw))] if raw is not None else []
+    idx = next(
+        (
+            i
+            for i in ct.indexes
+            if i.state == "public"
+            and list(i.column_offsets[: len(fk.col_offsets)]) == list(fk.col_offsets)
+        ),
+        None,
+    )
+    out = []
+    if idx is not None:
+        prefix = tablecodec.index_key(ct.id, idx.id, _encode_fk_key(ct, fk.col_offsets, key_vals))
+        for k, v in txn.scan(KeyRange(prefix, prefix_next(prefix))):
+            # unique non-NULL entries carry the handle in an 8-byte value; a
+            # longer key alone does NOT imply a key-tail handle — a unique
+            # index extending the FK prefix appends more column datums instead
+            if len(v) == 8:
+                h = codec.decode_int_raw(v)
+            else:  # non-unique / NULL-containing unique: handle rides the key tail
+                h = codec.decode_int_raw(k[-8:])
+            raw = _txn_read(session, tablecodec.record_key(ct.id, h))
+            if raw is not None:
+                out.append((h, decode_row(schema, raw)))
+        return out
+    # no usable index (dropped with checks off): full visible scan
+    for k, v in txn.scan(tablecodec.record_range(ct.id)):
+        _, h = tablecodec.decode_record_key(k)
+        vals = decode_row(schema, v)
+        if [vals[o] for o in fk.col_offsets] == list(key_vals):
+            out.append((h, vals))
+    return out
+
+
+def _fk_on_parent_delete(session, t: TableInfo, vals: list, depth: int = 0) -> None:
+    """DELETE of a (potential) parent row: RESTRICT / CASCADE / SET NULL
+    over every referencing child (ref: FK cascade exec)."""
+    if not _fk_on(session):
+        return
+    refs = session.catalog.referencing_fks_by_id(t.id)
+    if not refs:
+        return
+    if depth >= _FK_MAX_DEPTH:
+        raise WriteError("foreign key cascade depth exceeded")
+    for ct, fk, parent in refs:
+        ref_offs = [parent.column(n).offset for n in fk.ref_col_names]
+        key = [vals[o] for o in ref_offs]
+        if any(k is None for k in key):
+            continue
+        rows = _fk_child_rows(session, ct, fk, key)
+        # a row referencing itself doesn't restrict its own delete
+        rows = [(h, cv) for h, cv in rows if not (ct.id == t.id and cv == vals)]
+        if not rows:
+            continue
+        if fk.on_delete in ("restrict", "no_action"):
+            raise WriteError(
+                f"Cannot delete or update a parent row: a foreign key constraint fails ({fk.name})"
+            )
+        for h, cvals in rows:
+            if fk.on_delete == "cascade":
+                _delete_row(session, ct, cvals, h, fk_depth=depth + 1)
+            else:  # set_null
+                nv = list(cvals)
+                for o in fk.col_offsets:
+                    nv[o] = None
+                _fk_rewrite_child(session, ct, cvals, h, nv, depth + 1)
+
+
+def _fk_on_parent_update(session, t: TableInfo, old_vals: list, new_vals: list, depth: int = 0) -> None:
+    """Referenced key changed on an UPDATE: apply each child FK's ON UPDATE
+    action. Runs AFTER the parent's new row is staged, so cascaded child
+    rewrites pass their own child-side checks."""
+    if not _fk_on(session):
+        return
+    refs = session.catalog.referencing_fks_by_id(t.id)
+    if not refs:
+        return
+    if depth >= _FK_MAX_DEPTH:
+        raise WriteError("foreign key cascade depth exceeded")
+    for ct, fk, parent in refs:
+        ref_offs = [parent.column(n).offset for n in fk.ref_col_names]
+        okey = [old_vals[o] for o in ref_offs]
+        nkey = [new_vals[o] for o in ref_offs]
+        if okey == nkey or any(k is None for k in okey):
+            continue
+        rows = _fk_child_rows(session, ct, fk, okey)
+        if not rows:
+            continue
+        if fk.on_update in ("restrict", "no_action"):
+            raise WriteError(
+                f"Cannot delete or update a parent row: a foreign key constraint fails ({fk.name})"
+            )
+        for h, cvals in rows:
+            nv = list(cvals)
+            for o, newv in zip(fk.col_offsets, nkey if fk.on_update == "cascade" else [None] * len(nkey)):
+                nv[o] = newv
+            _fk_rewrite_child(session, ct, cvals, h, nv, depth + 1)
+
+
+def _fk_rewrite_child(session, ct: TableInfo, old_vals: list, handle: int, new_vals: list, depth: int) -> None:
+    """In-place child row rewrite for cascaded SET NULL / UPDATE: stage the
+    rewrite, then propagate to grandchildren (their cascades read the child's
+    new key from the membuffer; a RESTRICT aborts the whole statement and the
+    stage rolls back)."""
+    _delete_row(session, ct, old_vals, handle, fk_depth=None)
+    _write_row(session, ct, new_vals, handle)
+    _fk_on_parent_update(session, ct, old_vals, new_vals, depth)
+
+
 def _txn_read(session, key: bytes):
     """Read through the membuffer; in an explicit pessimistic txn the base
     snapshot is for_update_ts (current read), else start_ts. Constraint
@@ -161,6 +359,7 @@ def _write_row(session, t: TableInfo, vals: list, handle: int, on_dup=None) -> i
                     )
             else:
                 raise DupKeyError(idx.name)
+    _fk_check_child(session, t, vals)
     txn.put(rk, encode_row(schema, vals))
     for idx in t.indexes:
         if idx.state == "delete_only":
@@ -170,13 +369,17 @@ def _write_row(session, t: TableInfo, vals: list, handle: int, on_dup=None) -> i
     return 1
 
 
-def _delete_row(session, t: TableInfo, vals: list, handle: int) -> None:
+def _delete_row(session, t: TableInfo, vals: list, handle: int, fk_depth: "int | None" = 0) -> None:
+    """``fk_depth``: referential-action recursion depth; None = plain
+    storage delete with no FK handling (update paths manage keys themselves)."""
     txn = session.txn()
     session.lock_for_write([tablecodec.record_key(t.id, handle)])
     txn.delete(tablecodec.record_key(t.id, handle))
     for idx in t.indexes:
         ik, _ = index_entry(t, idx, vals, handle)
         txn.delete(ik)
+    if fk_depth is not None:
+        _fk_on_parent_delete(session, t, vals, fk_depth)
 
 
 def execute_insert(session, stmt: ast.Insert) -> int:
@@ -309,8 +512,9 @@ def _apply_on_dup_update(session, t: TableInfo, old_vals: list, handle: int, can
     new_handle = handle
     if t.pk_is_handle and new_vals[t.pk_offset] != old_vals[t.pk_offset]:
         new_handle = int(new_vals[t.pk_offset])
-    _delete_row(session, t, old_vals, handle)
+    _delete_row(session, t, old_vals, handle, fk_depth=None)
     _write_row(session, t, new_vals, new_handle)
+    _fk_on_parent_update(session, t, old_vals, new_vals)
     return 2
 
 
@@ -466,8 +670,9 @@ def execute_update(session, stmt: ast.Update) -> int:
             new_handle = int(new_vals[t.pk_offset])
         old_t = row_tables[i]
         new_t = t.partition_view(t.partition_id_for(new_vals)) if t.partition is not None else t
-        _delete_row(session, old_t, old_vals, handle)
+        _delete_row(session, old_t, old_vals, handle, fk_depth=None)
         _write_row(session, new_t, new_vals, new_handle)
+        _fk_on_parent_update(session, t, old_vals, new_vals)
         affected += 1
     return affected
 

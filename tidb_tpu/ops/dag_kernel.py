@@ -486,13 +486,17 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1) -> Comp
                         lo = jnp.where(starts_c > 0, cs[jnp.maximum(starts_c - 1, 0)], 0)
                         return jnp.where(slot_live, cs[ends_c] - lo, 0)
 
-                    def _seg_scan_red(x, op):
-                        def comb(ab, cd):
-                            f1, v1 = ab
-                            f2, v2 = cd
-                            return (f1 | f2, jnp.where(f2, v2, op(v1, v2)))
+                    seg_ps = jax.lax.cummax(
+                        jnp.where(boundary, jnp.arange(n, dtype=jnp.int32), -1)
+                    )
 
-                        _, r = jax.lax.associative_scan(comb, (boundary, x))
+                    def _seg_scan_red(x, op):
+                        # log-doubling segmented running reduce — the generic
+                        # associative_scan combinator compiles pathologically
+                        # on TPU at scale (see window_core._seg_running)
+                        from tidb_tpu.ops.window_core import _seg_running
+
+                        r = _seg_running(jax, jnp, x, seg_ps, op, None, n)
                         return r[ends_c]
 
                     def eval_arg(a):

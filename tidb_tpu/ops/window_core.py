@@ -176,6 +176,24 @@ def sort_perm(jax, jnp, mask, key_lanes, descs, n, bounds=None):
     return perm
 
 
+def _seg_running(jax, jnp, x, ps, op, sentinel, n: int):
+    """Segmented running reduce: out[i] = op over x[ps[i]..i] where segments
+    are contiguous (rows sorted by partition). Log-doubling gathers instead
+    of jax.lax.associative_scan with a pair combiner — the generic scan
+    combinator compiles for MINUTES at multi-million rows on TPU under x64,
+    while ~log2(n) unrolled gather+select steps compile in seconds."""
+    iota = jnp.arange(n, dtype=jnp.int32)
+    y = x
+    step = 1
+    while step < n:
+        src = iota - step
+        ok = src >= ps
+        prev = y[jnp.maximum(src, 0)]
+        y = jnp.where(ok, op(y, prev), y)
+        step <<= 1
+    return y
+
+
 def window_program(jax, jnp, *, mask, part_lanes, order_lanes, order_descs,
                    frame_tag, specs, arg_lanes, n, bounds=None):
     """The full device window computation over one padded batch.
@@ -186,8 +204,13 @@ def window_program(jax, jnp, *, mask, part_lanes, order_lanes, order_descs,
     or None entries (see sort_perm). Returns (outs_sorted, perm, sm):
     per-func (data, valid) in SORTED row order, the sort permutation, and the
     sorted live mask — the caller inverse-permutes when original order
-    matters, or keeps sorted order when an aggregation follows."""
-    iota = jnp.arange(n)
+    matters, or keeps sorted order when an aggregation follows.
+
+    Compile-cost discipline (TPU x64): positions are int32; partition/peer
+    extents come from native lax.cummax/cummin (never associative_scan, never
+    self-searchsorted — both compile pathologically at scale); segmented
+    extremes use log-doubling gathers."""
+    iota = jnp.arange(n, dtype=jnp.int32)
     # NULL slots mask to 0 so computed-expression garbage can't split a NULL
     # partition or peer group
     part_m = [(jnp.where(v, d, 0), v) for d, v in part_lanes]
@@ -213,17 +236,18 @@ def window_program(jax, jnp, *, mask, part_lanes, order_lanes, order_descs,
             [jnp.zeros(1, bool), (ds[1:] != ds[:-1]) | (vs[1:] != vs[:-1])]
         )
 
-    pid = jnp.cumsum(pboundary) - 1
-    ps = jnp.searchsorted(pid, pid, side="left")  # partition start index
-    pe = jnp.searchsorted(pid, pid, side="right")  # partition end index
+    # partition start/end per row: last boundary at-or-before i / first
+    # boundary after i
+    ps = jax.lax.cummax(jnp.where(pboundary, iota, -1))
+    pb_next = jnp.concatenate([jnp.where(pboundary, iota, n)[1:], jnp.full(1, n, jnp.int32)])
+    pe = jax.lax.cummin(pb_next[::-1])[::-1]
     pos = iota - ps
     m = pe - ps
     # peer-group first row and end row (rank/cume_dist)
-    peer_first = jax.lax.associative_scan(jnp.maximum, jnp.where(peer, iota, -1))
-    b_pos = jnp.where(peer, iota, n)
-    sfx_min = jax.lax.associative_scan(jnp.minimum, b_pos, reverse=True)
-    peer_end = jnp.minimum(jnp.concatenate([sfx_min[1:], jnp.full(1, n)]), pe)
-    cum_peer = jnp.cumsum(peer)
+    peer_first = jax.lax.cummax(jnp.where(peer, iota, -1))
+    pr_next = jnp.concatenate([jnp.where(peer, iota, n)[1:], jnp.full(1, n, jnp.int32)])
+    peer_end = jnp.minimum(jax.lax.cummin(pr_next[::-1])[::-1], pe)
+    cum_peer = jnp.cumsum(peer, dtype=jnp.int32)
     dense = cum_peer - cum_peer[ps] + 1
     rank = peer_first - ps + 1
 
@@ -324,14 +348,8 @@ def window_program(jax, jnp, *, mask, part_lanes, order_lanes, order_descs,
             else:
                 sent = jnp.iinfo(jnp.int64).max if name == "min" else jnp.iinfo(jnp.int64).min
             lane = jnp.where(vv, av, sent)
-
-            def comb(ab, cd, _name=name):
-                f1, v1 = ab
-                f2, v2 = cd
-                op = jnp.minimum if _name == "min" else jnp.maximum
-                return (f1 | f2, jnp.where(f2, v2, op(v1, v2)))
-
-            _, run = jax.lax.associative_scan(comb, (pboundary, lane))
+            op = jnp.minimum if name == "min" else jnp.maximum
+            run = _seg_running(jax, jnp, lane, ps, op, sent, n)
             g = jnp.clip(fe - 1, 0, n - 1)
             c0 = jnp.concatenate([jnp.zeros(1, jnp.int64), jnp.cumsum(vv.astype(jnp.int64))])
             cnt = c0[fe] - c0[fs]

@@ -298,6 +298,19 @@ class IndexDef(Node):
 
 
 @dataclass
+class FKDef(Node):
+    """FOREIGN KEY (cols) REFERENCES tbl (cols) with referential actions
+    (ref: ast.Constraint ConstraintForeignKey + model.FKInfo)."""
+
+    name: str
+    columns: list[str]
+    ref_table: "TableRef"
+    ref_columns: list[str]
+    on_delete: str = "restrict"  # restrict | cascade | set_null | no_action
+    on_update: str = "restrict"
+
+
+@dataclass
 class PartitionByDef(Node):
     """PARTITION BY RANGE (col) (...) | HASH (col) PARTITIONS n."""
 
@@ -312,6 +325,7 @@ class CreateTable(Node):
     table: TableRef
     columns: list[ColumnDef] = field(default_factory=list)
     indexes: list[IndexDef] = field(default_factory=list)
+    foreign_keys: list[FKDef] = field(default_factory=list)
     if_not_exists: bool = False
     partition_by: Optional[PartitionByDef] = None
     ttl: Optional[tuple[str, int]] = None  # (column, days)
@@ -370,6 +384,7 @@ class AlterTable(Node):
     action: str = ""
     column: Optional[ColumnDef] = None
     index: Optional[IndexDef] = None
+    fk: Optional[FKDef] = None  # add_fk payload
     name: str = ""  # drop target, rename target, or partition name
     less_than: Optional[int] = None  # add_partition bound (None = MAXVALUE)
     ttl: Optional[tuple[str, int]] = None  # set_ttl payload

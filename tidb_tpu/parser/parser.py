@@ -955,7 +955,19 @@ class Parser:
         ct = ast.CreateTable(tbl, if_not_exists=ine)
         self.expect_op("(")
         while True:
-            if self.at_kw("PRIMARY"):
+            cons_name = ""
+            if self.at_kw("CONSTRAINT"):
+                self.next()
+                if not self.at_kw("FOREIGN", "PRIMARY", "UNIQUE"):
+                    cons_name = self.ident()
+            if self.at_kw("FOREIGN"):
+                self.next()
+                self.expect_kw("KEY")
+                if self.peek().kind in ("ident", "qident") and not self.at_op("("):
+                    iname = self.ident()  # always consume the index name
+                    cons_name = cons_name or iname
+                ct.foreign_keys.append(self._fk_tail(cons_name or f"fk_{len(ct.foreign_keys) + 1}"))
+            elif self.at_kw("PRIMARY"):
                 self.next()
                 self.expect_kw("KEY")
                 self.expect_op("(")
@@ -1134,7 +1146,17 @@ class Parser:
         tbl = self._table_ref_simple()
         at = ast.AlterTable(tbl)
         if self.eat_kw("ADD"):
-            if self.at_kw("PARTITION"):
+            if self.at_kw("CONSTRAINT", "FOREIGN"):
+                cons_name = ""
+                if self.eat_kw("CONSTRAINT") and not self.at_kw("FOREIGN"):
+                    cons_name = self.ident()
+                self.expect_kw("FOREIGN")
+                self.expect_kw("KEY")
+                if self.peek().kind in ("ident", "qident") and not self.at_op("("):
+                    iname = self.ident()  # always consume the index name
+                    cons_name = cons_name or iname
+                at.action, at.fk = "add_fk", self._fk_tail(cons_name or "fk_1")
+            elif self.at_kw("PARTITION"):
                 self.next()
                 self.expect_op("(")
                 name, lt = self._partition_def()
@@ -1163,7 +1185,11 @@ class Parser:
                     cd.default = self.parse_expr()
                 at.action, at.column = "add_column", cd
         elif self.eat_kw("DROP"):
-            if self.at_kw("PARTITION"):
+            if self.at_kw("FOREIGN"):
+                self.next()
+                self.expect_kw("KEY")
+                at.action, at.name = "drop_fk", self.ident().lower()
+            elif self.at_kw("PARTITION"):
                 self.next()
                 at.action, at.name = "drop_partition", self.ident()
             elif self.at_kw("INDEX", "KEY"):
@@ -1192,6 +1218,44 @@ class Parser:
         else:
             raise ParseError("unsupported ALTER action", self.peek())
         return at
+
+    def _fk_tail(self, name: str) -> "ast.FKDef":
+        """(cols) REFERENCES tbl (cols) [ON DELETE act] [ON UPDATE act]
+        (ref: parser.y ReferenceDef)."""
+        self.expect_op("(")
+        cols = [self.ident()]
+        while self.eat_op(","):
+            cols.append(self.ident())
+        self.expect_op(")")
+        self.expect_kw("REFERENCES")
+        ref = self._table_ref_simple()
+        self.expect_op("(")
+        rcols = [self.ident()]
+        while self.eat_op(","):
+            rcols.append(self.ident())
+        self.expect_op(")")
+        fk = ast.FKDef(name.lower(), [c.lower() for c in cols], ref, [c.lower() for c in rcols])
+
+        def action() -> str:
+            if self.eat_kw("RESTRICT"):
+                return "restrict"
+            if self.eat_kw("CASCADE"):
+                return "cascade"
+            if self.eat_kw("SET"):
+                self.expect_kw("NULL")
+                return "set_null"
+            self.expect_kw("NO")
+            self.expect_kw("ACTION")
+            return "no_action"
+
+        while self.at_kw("ON"):
+            self.next()
+            if self.eat_kw("DELETE"):
+                fk.on_delete = action()
+            else:
+                self.expect_kw("UPDATE")
+                fk.on_update = action()
+        return fk
 
     def _partition_def(self) -> tuple[str, "int | None"]:
         """PARTITION name VALUES LESS THAN (n) | MAXVALUE"""

@@ -783,6 +783,9 @@ class Builder:
                             scan.use_index = hargs[1].strip().lower()
                         else:
                             scan.ignore_index = hargs[1].strip().lower()
+                elif hname == "use_index_merge" and hargs:
+                    if hargs[0].strip().lower() in (alias.lower(), node.name.lower()):
+                        scan.use_index_merge = True
             scan.schema = [
                 OutCol(c.name, c.ftype, table=alias, slot=c.offset) for c in t.columns
             ]

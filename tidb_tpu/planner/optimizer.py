@@ -39,6 +39,7 @@ from tidb_tpu.planner.plans import (
     PhysHashJoin,
     PhysIndexJoin,
     PhysIndexLookUp,
+    PhysIndexMerge,
     PhysIndexReader,
     PhysMergeJoin,
     PhysLimit,
@@ -410,6 +411,89 @@ def _choose_index_path(scan: LogicalScan, conds: list[Expression], stats=None):
     return _build_index_access(scan, best[1], conds)
 
 
+def _flatten_bool(e: Expression, sig: str, out: list) -> None:
+    if isinstance(e, ScalarFunc) and e.sig == sig:
+        for a in e.args:
+            _flatten_bool(a, sig, out)
+    else:
+        out.append(e)
+
+
+def _try_index_merge(scan: LogicalScan, conds: list[Expression], stats=None):
+    """Union-type IndexMerge (ref: planner/core/indexmerge_path.go
+    generateIndexMergeOrPaths): an OR condition whose every disjunct is
+    independently index- (or PK-) accessible becomes a union of handle sets
+    feeding one table lookup. Chosen when no single-index path exists (the
+    classic a=? OR b=? shape defeats single-index pruning) or when forced by
+    USE_INDEX_MERGE. Correctness does not depend on path tightness: the
+    executor re-applies the full condition list after the fetch."""
+    t = scan.table
+    if t.partition is not None:
+        return None
+    or_cond = None
+    for c in conds:
+        if isinstance(c, ScalarFunc) and c.sig == "or":
+            or_cond = c
+            break
+    if or_cond is None:
+        return None
+    disjuncts: list[Expression] = []
+    _flatten_bool(or_cond, "or", disjuncts)
+    if len(disjuncts) < 2:
+        return None
+    paths = []
+    est_rows = 0.0
+    tstats = stats.get(t.id) if stats is not None else None
+    for d in disjuncts:
+        conjs: list[Expression] = []
+        _flatten_bool(d, "and", conjs)
+        # PK-as-handle path: only point/two-sided ranges qualify (a one-sided
+        # bound is a near-full scan and would sink the union without stats)
+        hr = _derive_ranges(scan, conjs)
+        path = None
+        if hr is not None and all(
+            -(2**62) < lo and hi < 2**62
+            for lo, hi in (tablecodec.range_to_handles(kr, t.id) for kr in hr)
+        ):
+            path = ("table", hr)
+        if path is None:
+            best = None
+            for idx in t.indexes:
+                if idx.state != "public" or idx.name == scan.ignore_index:
+                    continue
+                acc = ranger.detach_index_conditions(conjs, scan.schema, t, idx)
+                if acc is None or not acc.used:
+                    continue
+                key = (acc.eq_prefix_len, idx.unique, acc.has_range)
+                if best is None or key > best[0]:
+                    best = (key, acc)
+            if best is not None:
+                path = ("idx", best[1].index, best[1].ranges)
+                if tstats is not None and tstats.row_count > 0:
+                    from tidb_tpu.statistics.selectivity import estimate_selectivity
+
+                    est_rows += tstats.row_count * estimate_selectivity(
+                        best[1].used, scan.schema, tstats
+                    )
+        if path is None:
+            return None  # one unindexable disjunct sinks the whole merge
+        paths.append(path)
+    # cost gate (ref: the index-merge path pruning by row estimates): random
+    # handle lookups must beat the columnar full scan
+    if not scan.use_index_merge and tstats is not None and tstats.row_count > 0:
+        if _COST_SETUP + est_rows * _COST_LOOKUP_ROW >= tstats.row_count * _COST_TABLE_ROW:
+            return None
+    return PhysIndexMerge(
+        db=scan.db,
+        table=t,
+        paths=paths,
+        scan_slots=[oc.slot for oc in scan.schema],
+        residual_conditions=list(conds),
+        all_conditions=list(conds),
+        schema=scan.schema,
+    )
+
+
 def _index_path_for(scan: LogicalScan, idx, conds: list[Expression]):
     """USE_INDEX hint: force an access path over ``idx`` when any range can
     be derived from the conditions."""
@@ -527,6 +611,10 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan
     if isinstance(plan, LogicalSelection):
         if isinstance(plan.children[0], LogicalScan):
             ipath = _choose_index_path(plan.children[0], plan.conditions, stats)
+            if ipath is None:
+                # OR shapes defeat single-index pruning; a union of index
+                # paths can still serve them (ref: indexmerge_path.go)
+                ipath = _try_index_merge(plan.children[0], plan.conditions, stats)
             if ipath is not None:
                 return ipath
         child = _physical(plan.children[0], engines, stats)

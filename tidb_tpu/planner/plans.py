@@ -47,9 +47,11 @@ class LogicalScan(LogicalPlan):
     children: list = field(default_factory=list)
     # filled by predicate pushdown / range derivation
     ranges: Optional[list[KeyRange]] = None
-    # optimizer hints targeting this table (ref: USE_INDEX/IGNORE_INDEX)
+    # optimizer hints targeting this table (ref: USE_INDEX/IGNORE_INDEX/
+    # USE_INDEX_MERGE)
     use_index: Optional[str] = None
     ignore_index: Optional[str] = None
+    use_index_merge: bool = False
 
 
 @dataclass
@@ -249,6 +251,28 @@ class PhysIndexLookUp(PhysicalPlan):
     ranges: list[KeyRange] = field(default_factory=list)
     scan_slots: list[int] = field(default_factory=list)  # table-side outputs
     # residual filters over the table-side scan schema
+    residual_conditions: list[Expression] = field(default_factory=list)
+    all_conditions: list[Expression] = field(default_factory=list)
+    schema: Schema = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+
+@dataclass
+class PhysIndexMerge(PhysicalPlan):
+    """Union (OR) or intersection (AND) of several index/PK access paths
+    feeding ONE table lookup (ref: PhysicalIndexMergeReader /
+    executor/index_merge_reader.go:88; path derivation
+    planner/core/indexmerge_path.go). Each path contributes a handle set;
+    handles are set-combined, the table side fetches the rows, and the FULL
+    original condition list re-filters them (paths may over-approximate
+    their disjunct)."""
+
+    db: str
+    table: TableInfo
+    # per path: ("idx", IndexInfo, [KeyRange]) or ("table", [KeyRange])
+    paths: list = field(default_factory=list)
+    intersection: bool = False
+    scan_slots: list[int] = field(default_factory=list)
     residual_conditions: list[Expression] = field(default_factory=list)
     all_conditions: list[Expression] = field(default_factory=list)
     schema: Schema = field(default_factory=list)
@@ -457,6 +481,16 @@ def explain_plan(p, indent: int = 0, stats=None) -> str:
     elif isinstance(p, PhysIndexLookUp):
         conds = f" -> Selection({', '.join(map(repr, p.residual_conditions))})" if p.residual_conditions else ""
         extra = f"[host] {p.table.name}: IndexScan({p.index.name}, {len(p.ranges)} ranges) -> TableRowIDScan{conds}"
+    elif isinstance(p, PhysIndexMerge):
+        parts = []
+        for path in p.paths:
+            if path[0] == "idx":
+                parts.append(f"{path[1].name}({len(path[2])} ranges)")
+            else:
+                parts.append(f"PRIMARY({len(path[1])} ranges)")
+        kind = "intersection" if p.intersection else "union"
+        conds = f" -> Selection({', '.join(map(repr, p.residual_conditions))})" if p.residual_conditions else ""
+        extra = f"[host] {p.table.name}: IndexMerge({kind}: {', '.join(parts)}) -> TableRowIDScan{conds}"
     from tidb_tpu.parallel.gather import PhysMPPGather
 
     if isinstance(p, PhysMPPGather):

@@ -1015,7 +1015,7 @@ class Session:
             t = self.catalog.table(self.current_db, stmt.target)
             return Result(
                 columns=["Table", "Create Table"],
-                rows=[(t.name, _create_table_sql(t).rstrip().rstrip(";"))],
+                rows=[(t.name, _create_table_sql(t, self.current_db).rstrip().rstrip(";"))],
             )
         if stmt.kind == "index":
             t = self.catalog.table(self.current_db, stmt.target)

@@ -59,7 +59,9 @@ def _sql_type(ft) -> str:
     return "BIGINT"
 
 
-def _create_table_sql(t) -> str:
+def _create_table_sql(t, db: str = "") -> str:
+    """``db``: the table's own database — cross-database FK references get
+    qualified so restores bind the constraint to the right table."""
     parts = []
     for c in t.columns:
         line = f"`{c.name}` {_sql_type(c.ftype)}"
@@ -76,6 +78,17 @@ def _create_table_sql(t) -> str:
         cols = ", ".join(f"`{t.columns[o].name}`" for o in idx.column_offsets)
         kw = "UNIQUE KEY" if idx.unique else "KEY"
         parts.append(f"{kw} `{idx.name}` ({cols})")
+    for fk in t.foreign_keys:
+        cols = ", ".join(f"`{t.columns[o].name}`" for o in fk.col_offsets)
+        rcols = ", ".join(f"`{n}`" for n in fk.ref_col_names)
+        ref = f"`{fk.ref_table}`" if fk.ref_db == (db or fk.ref_db) else f"`{fk.ref_db}`.`{fk.ref_table}`"
+        line = f"CONSTRAINT `{fk.name}` FOREIGN KEY ({cols}) REFERENCES {ref} ({rcols})"
+        acts = {"restrict": "RESTRICT", "cascade": "CASCADE", "set_null": "SET NULL", "no_action": "NO ACTION"}
+        if fk.on_delete != "restrict":
+            line += f" ON DELETE {acts[fk.on_delete]}"
+        if fk.on_update != "restrict":
+            line += f" ON UPDATE {acts[fk.on_update]}"
+        parts.append(line)
     body = ",\n  ".join(parts)
     tail = ""
     if t.partition is not None:
@@ -105,7 +118,7 @@ def dump_database(db, db_name: str, dest: str, fmt: str = "sql") -> dict:
     for name in db.catalog.tables(db_name):
         t = db.catalog.table(db_name, name)
         with open(os.path.join(dest, f"{db_name}.{name}-schema.sql"), "w") as f:
-            f.write(_create_table_sql(t))
+            f.write(_create_table_sql(t, db_name))
         rows = s.query(f"SELECT * FROM `{name}`")
         out[name] = len(rows)
         colnames = ", ".join(f"`{c.name}`" for c in t.columns)
