@@ -661,22 +661,30 @@ class Catalog:
             raise CatalogError(
                 "referenced columns must be the parent's primary key or a unique index"
             )
-        if any(f.name == fkd.name for f in t.foreign_keys):
-            raise CatalogError(f"duplicate foreign key name {fkd.name!r}")
-        if fkd.on_delete == "set_null" and any(not t.columns[o].ftype.nullable for o in col_offs):
-            raise CatalogError("ON DELETE SET NULL requires nullable foreign key columns")
+        fk_name = fkd.name
+        if not fk_name:  # unnamed: auto-generate a distinct name (MySQL _ibfk_N)
+            n = 1
+            while any(f.name == f"fk_{n}" for f in t.foreign_keys):
+                n += 1
+            fk_name = f"fk_{n}"
+        if any(f.name == fk_name for f in t.foreign_keys):
+            raise CatalogError(f"duplicate foreign key name {fk_name!r}")
+        if (fkd.on_delete == "set_null" or fkd.on_update == "set_null") and any(
+            not t.columns[o].ftype.nullable for o in col_offs
+        ):
+            raise CatalogError("SET NULL actions require nullable foreign key columns")
         # validate BEFORE any mutation: a failed ALTER ... ADD FOREIGN KEY
         # must leave no phantom index behind (validation scans rows directly,
         # so it needs no index)
         if validate_rows:
-            self._validate_fk_rows(t, parent, col_offs, ref_offs, fkd.name)
+            self._validate_fk_rows(t, parent, col_offs, ref_offs, fk_name)
         covered = (t.pk_is_handle and col_offs == [t.pk_offset]) or any(
             idx.state == "public" and list(idx.column_offsets[: len(col_offs)]) == col_offs
             for idx in t.indexes
         )
         if not covered:
             # MySQL auto-creates an index on the FK columns when none exists
-            t.indexes.append(IndexInfo(t.next_index_id, fkd.name, list(col_offs)))
+            t.indexes.append(IndexInfo(t.next_index_id, fk_name, list(col_offs)))
             t.next_index_id += 1
             if validate_rows:
                 self._backfill_index_now(t, t.indexes[-1])
@@ -684,7 +692,7 @@ class Catalog:
         t.foreign_keys.append(
             FKInfo(
                 fk_id,
-                fkd.name,
+                fk_name,
                 list(col_offs),
                 ref_db,
                 parent.name,

@@ -496,7 +496,7 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1) -> Comp
                         # on TPU at scale (see window_core._seg_running)
                         from tidb_tpu.ops.window_core import _seg_running
 
-                        r = _seg_running(jax, jnp, x, seg_ps, op, None, n)
+                        r = _seg_running(jax, jnp, x, seg_ps, op, n)
                         return r[ends_c]
 
                     def eval_arg(a):

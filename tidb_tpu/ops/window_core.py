@@ -176,7 +176,7 @@ def sort_perm(jax, jnp, mask, key_lanes, descs, n, bounds=None):
     return perm
 
 
-def _seg_running(jax, jnp, x, ps, op, sentinel, n: int):
+def _seg_running(jax, jnp, x, ps, op, n: int):
     """Segmented running reduce: out[i] = op over x[ps[i]..i] where segments
     are contiguous (rows sorted by partition). Log-doubling gathers instead
     of jax.lax.associative_scan with a pair combiner — the generic scan
@@ -349,7 +349,7 @@ def window_program(jax, jnp, *, mask, part_lanes, order_lanes, order_descs,
                 sent = jnp.iinfo(jnp.int64).max if name == "min" else jnp.iinfo(jnp.int64).min
             lane = jnp.where(vv, av, sent)
             op = jnp.minimum if name == "min" else jnp.maximum
-            run = _seg_running(jax, jnp, lane, ps, op, sent, n)
+            run = _seg_running(jax, jnp, lane, ps, op, n)
             g = jnp.clip(fe - 1, 0, n - 1)
             c0 = jnp.concatenate([jnp.zeros(1, jnp.int64), jnp.cumsum(vv.astype(jnp.int64))])
             cnt = c0[fe] - c0[fs]

@@ -244,6 +244,126 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None) -> PhysicalPlan:
                 _ndev_memo.append(1)
         return _ndev_memo[0]
 
+    def _try_agg_below_join(p: PhysFinalAgg, readers: list, joins: list):
+        """Partial-agg pushdown below the join (ref: the aggregation-
+        pushdown-through-join rule): when every agg arg reads the probe
+        reader only, the probe side pre-aggregates by (join keys ∪ its group
+        keys) THROUGH THE COPROCESSOR (device block path) before entering
+        the MPP pipeline, and the pipeline sums the partial lanes. A 10:1
+        key fan-in turns a 4M-row join into a 400k-row join. Sum-of-partial-
+        sums needs no group completeness, so per-region/per-block partial
+        duplicates are harmless. Returns the rewritten plan or None."""
+        from tidb_tpu.planner.optimizer import _expr_cols as _acc_expr_cols
+        from tidb_tpu.planner.optimizer import _partial_schema, _remap_expr
+        from tidb_tpu.planner.plans import LogicalAggregation
+
+        r0 = readers[0]
+        n0 = len(r0.schema)
+        if stats is None:
+            return None
+        st0 = stats.get(r0.table.id)
+        if st0 is None or st0.row_count <= 0:
+            return None
+        # every agg argument must read reader-0 columns only
+        arg_cols: set[int] = set()
+        for a in p.aggs:
+            if a.arg is not None:
+                _acc_expr_cols(a.arg, arg_cols)
+        if any(c >= n0 for c in arg_cols):
+            return None
+        # pre-group keys: reader-0 join keys (all joins) + reader-0 group keys
+        pre_keys: list[int] = []
+        for join in joins:
+            for lp, _ in join.eq:
+                if lp < n0 and lp not in pre_keys:
+                    pre_keys.append(lp)
+                elif lp >= n0:
+                    pass  # later-join keys on build lanes shift below
+        for g in p.group_by:
+            if isinstance(g, ColumnRef) and g.index < n0:
+                if g.index not in pre_keys:
+                    pre_keys.append(g.index)
+            else:
+                s: set[int] = set()
+                _acc_expr_cols(g, s)
+                if any(c < n0 for c in s) and not isinstance(g, ColumnRef):
+                    return None  # expression group key over probe cols: skip
+        if not pre_keys:
+            return None
+        # only worthwhile when the pre-agg actually collapses rows
+        ndv = 1
+        for pos in pre_keys:
+            cs = st0.cols.get(r0.schema[pos].slot)
+            ndv *= cs.ndv if cs is not None and cs.ndv else st0.row_count
+        if ndv * 2 > st0.row_count:
+            return None
+        pushed = LogicalAggregation(
+            group_by=[ColumnRef(pos, r0.schema[pos].ftype, r0.schema[pos].name) for pos in pre_keys],
+            aggs=list(p.aggs),
+            schema=[],
+            children=[r0],  # _partial_schema resolves group-key slots here
+        )
+        pre_schema = _partial_schema(pushed)
+        n_lanes_partial = len(pre_schema) - len(pre_keys)
+        r0p = PhysTableReader(
+            db=r0.db,
+            table=r0.table,
+            store_type=r0.store_type,
+            pushed_conditions=list(r0.pushed_conditions),
+            pushed_agg=pushed,
+            pushed_agg_mode="partial",
+            scan_slots=list(r0.scan_slots),
+            ranges=r0.ranges,
+            schema=pre_schema,
+        )
+        delta = len(pre_schema) - n0
+
+        def remap_left(lp: int) -> int:
+            if lp < n0:
+                return n_lanes_partial + pre_keys.index(lp)
+            return lp + delta
+
+        new_joins = [
+            MPPJoin(
+                eq=[(remap_left(lp), rp) for lp, rp in join.eq],
+                exchange=join.exchange,
+                unique=join.unique,
+            )
+            for join in joins
+        ]
+        new_groups = []
+        for g in p.group_by:
+            if isinstance(g, ColumnRef) and g.index < n0:
+                new_groups.append(ColumnRef(n_lanes_partial + pre_keys.index(g.index), g.ftype, g.name))
+            elif isinstance(g, ColumnRef):
+                new_groups.append(ColumnRef(g.index + delta, g.ftype, g.name))
+            else:
+                s = set()
+                _acc_expr_cols(g, s)
+                new_groups.append(_remap_expr(g, {i: i + delta for i in s}))
+        syn_aggs = [
+            AggDesc("sum", ColumnRef(j, pre_schema[j].ftype, pre_schema[j].name))
+            for j in range(n_lanes_partial)
+        ]
+        syn = PhysFinalAgg(
+            group_by=new_groups, aggs=syn_aggs, partial_input=False, schema=[], children=[]
+        )
+        from types import SimpleNamespace
+
+        acc_schema = [oc for r in readers for oc in r.schema]
+        orig_shape = LogicalAggregation(
+            group_by=p.group_by, aggs=p.aggs, schema=[], children=[SimpleNamespace(schema=acc_schema)]
+        )
+        gather = PhysMPPGather(
+            agg=syn,
+            readers=[r0p] + readers[1:],
+            joins=new_joins,
+            schema=_partial_schema(orig_shape),
+        )
+        return PhysFinalAgg(
+            group_by=p.group_by, aggs=p.aggs, partial_input=True, schema=p.schema, children=[gather]
+        )
+
     def walk(p: PhysicalPlan) -> PhysicalPlan:
         for i, c in enumerate(getattr(p, "children", [])):
             p.children[i] = walk(c)
@@ -315,6 +435,9 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None) -> PhysicalPlan:
             flat = _flatten_join_chain(child, stats, get_ndev)
             if flat is not None and flat[1]:
                 readers, joins, _ = flat
+                below = _try_agg_below_join(p, readers, joins)
+                if below is not None:
+                    return below
                 return PhysMPPGather(
                     agg=p, readers=readers, joins=joins, schema=p.schema
                 )
@@ -376,11 +499,17 @@ class MPPGatherExec:
 
     # -- input materialization ------------------------------------------------
     def _reader_arrays(self, reader: PhysTableReader):
-        """Full-table columns as (data, validity) pairs + dictionaries,
-        via the host read path (MVCC-consistent at the session read ts)."""
+        """Reader materialization for one MPP side, MVCC-consistent at the
+        session read ts. Pre-aggregated readers (agg pushed below the join)
+        execute AS-IS through the coprocessor — scan, selection, and the
+        partial agg all run on the reader's engine (device block path) and
+        only the collapsed rows reach the exchange. Plain readers return raw
+        columns; their conditions evaluate inside the fragment program."""
         from tidb_tpu.executor.executors import TableReaderExec
         from tidb_tpu.kv.kv import StoreType
 
+        if reader.pushed_agg is not None:
+            return TableReaderExec(reader, self.session).execute()
         bare = PhysTableReader(
             db=reader.db,
             table=reader.table,
@@ -397,6 +526,8 @@ class MPPGatherExec:
         from tidb_tpu.copr.binder import Binder
         from tidb_tpu.copr.colcache import cache_for
 
+        if reader.pushed_agg is not None:
+            return []  # conditions already applied inside the cop DAG
         if not reader.pushed_conditions:
             return []
         cache = cache_for(self.session.store)
@@ -412,13 +543,15 @@ class MPPGatherExec:
         (data/valid interleaved + live). Returns (n_lanes per reader,
         lane_of: schema pos → data lane index in the accumulated layout)."""
         p = self.plan
-        n_lanes = [2 * len(r.scan_slots) + 1 for r in p.readers]
+        # lane count follows the reader's OUTPUT schema (pre-aggregated
+        # readers emit partial lanes + keys, not raw scan columns)
+        n_lanes = [2 * len(r.schema) + 1 for r in p.readers]
         lane_of = []
         off = 0
         for r in p.readers:
-            for i in range(len(r.scan_slots)):
+            for i in range(len(r.schema)):
                 lane_of.append(off + 2 * i)
-            off += 2 * len(r.scan_slots) + 1
+            off += 2 * len(r.schema) + 1
         return n_lanes, lane_of
 
     def _col_source(self, pos: int):
@@ -497,10 +630,13 @@ class MPPGatherExec:
         agg = p.agg
 
         def pad_side(chunk):
+            from tidb_tpu.ops.window_core import widen_bounds
+
             n = len(chunk)
             per = max((n + ndev - 1) // ndev, 8)
             tot = per * ndev
             arrays = []
+            bounds = []
             for c in chunk.columns:
                 d = np.zeros(tot, dtype=c.data.dtype)
                 d[:n] = c.data
@@ -508,10 +644,17 @@ class MPPGatherExec:
                 v[:n] = c.validity
                 arrays.append(np.where(v, d, 0))
                 arrays.append(v)
+                # per-column value bounds power the packed narrow-lane sorts
+                # in the fragment program (mpp._pack_keys)
+                if np.issubdtype(c.data.dtype, np.floating):
+                    bounds.append(None)
+                else:
+                    lv = c.data[: n][c.validity[: n]]
+                    bounds.append((int(lv.min()), int(lv.max())) if lv.size else (0, 0))
             live = np.zeros(tot, dtype=bool)
             live[:n] = True
             arrays.append(live)
-            return arrays, n
+            return arrays, n, widen_bounds(bounds)
 
         def dev_side(reader):
             """Padded device-resident input lanes, cached per table state —
@@ -525,18 +668,30 @@ class MPPGatherExec:
                     [tablecodec.record_range(reader.table.id)]
                 )
                 vers = tuple((r.region_id, r.data_version) for r, _ in regions)
+                agg_fp = ""
+                if reader.pushed_agg is not None:
+                    # pre-agg readers materialize DIFFERENT arrays than raw
+                    # scans of the same table — the identity must say so
+                    agg_fp = repr(
+                        (
+                            [g.to_pb() for g in reader.pushed_agg.group_by],
+                            [a.to_pb() for a in reader.pushed_agg.aggs],
+                            [c.to_pb() for c in reader.pushed_conditions],
+                        )
+                    )
                 key = (
                     self.session.store.nonce,
                     reader.table.id,
                     tuple(reader.scan_slots),
                     vers,
                     ndev,
+                    agg_fp,
                 )
                 hit = _MPP_DEV_CACHE.get(key)
                 if hit is not None:
                     return hit
-            arrays, n = pad_side(self._reader_arrays(reader))
-            dev = ([jnp.asarray(a) for a in arrays], n)
+            arrays, n, bounds = pad_side(self._reader_arrays(reader))
+            dev = ([jnp.asarray(a) for a in arrays], n, bounds)
             if key is not None:
                 _MPP_DEV_CACHE[key] = dev
                 while len(_MPP_DEV_CACHE) > 32:
@@ -544,9 +699,11 @@ class MPPGatherExec:
             return dev
 
         sides = [dev_side(r) for r in p.readers]
-        all_lanes = [a for arrays, _ in sides for a in arrays]
-        nrows = [n for _, n in sides]
-        ncols = [len(r.scan_slots) for r in p.readers]
+        all_lanes = [a for arrays, _, _ in sides for a in arrays]
+        nrows = [n for _, n, _ in sides]
+        # accumulated-schema-position → column bounds (packed fragment sorts)
+        all_bounds = [b for _, _, bs in sides for b in bs]
+        ncols = [len(r.schema) for r in p.readers]
         n_lanes, lane_of = self._lane_maps()
 
         def side_selection(cond_list, nc):
@@ -604,12 +761,21 @@ class MPPGatherExec:
         # expansion capacity from the probe row count with 2× headroom
         shard = lambda n: max(2 * ((max(n, 1) + ndev - 1) // ndev), 64)
         probe_cap = shard(nrows[0])
+        schema_base = [sum(len(rd.schema) for rd in p.readers[:k]) for k in range(len(p.readers))]
         join_specs = []
         for ji, join in enumerate(p.joins):
             build_cap = shard(nrows[ji + 1])
             lane_eq_l = [lane_of[lp] for lp, _ in join.eq]
             # build reader's local lanes
             lane_eq_r = [2 * rp for _, rp in join.eq]
+            # JOINT per-key bounds (both sides must pack identically)
+            kb = []
+            for lp, rp in join.eq:
+                lb = all_bounds[lp] if lp < len(all_bounds) else None
+                rb = all_bounds[schema_base[ji + 1] + rp]
+                kb.append(
+                    (min(lb[0], rb[0]), max(lb[1], rb[1])) if lb is not None and rb is not None else None
+                )
             join_specs.append(
                 DistJoinSpec(
                     left_keys=lane_eq_l,
@@ -619,6 +785,7 @@ class MPPGatherExec:
                     right_row_cap=build_cap,
                     unique=join.unique,
                     out_cap=max(_pow2(probe_cap), 1024),
+                    key_bounds=tuple(kb),
                 )
             )
             if not join.unique:
@@ -636,9 +803,18 @@ class MPPGatherExec:
         if agg is not None:
             nk = 2 * len(agg.group_by) if agg.group_by else 2
             sums_idx = list(range(nk, nk + 2 * sum(1 for a in agg.aggs if a.arg is not None)))
+            # group-key lanes interleave (data, valid); bounded data lanes
+            # let the fragment pack the whole group key into one narrow sort
+            if agg.group_by:
+                agg_kb = []
+                for g in agg.group_by:
+                    agg_kb.append(all_bounds[g.index] if isinstance(g, ColumnRef) and g.index < len(all_bounds) else None)
+                    agg_kb.append((0, 1))
+            else:
+                agg_kb = [(0, 0), (1, 1)]  # synthetic constant group key
         while True:
             spec = (
-                DistAggSpec(n_keys=nk, sums=sums_idx, group_cap=group_cap)
+                DistAggSpec(n_keys=nk, sums=sums_idx, group_cap=group_cap, key_bounds=tuple(agg_kb))
                 if agg is not None
                 else None
             )

@@ -30,30 +30,71 @@ class DistAggSpec:
     ``n_keys`` leading input columns are the group keys (int lanes);
     ``sums``: indices of value columns to SUM; COUNT(*) always included.
     ``group_cap``: static max distinct groups per shard (and per exchange
-    bucket)."""
+    bucket). ``key_bounds``: per data key (lo, hi) value bounds or None —
+    bounded keys pack into ONE narrow sort lane (int32 when the domain
+    fits), replacing the multi-lane stable-argsort chain with a single
+    native sort."""
 
     n_keys: int
     sums: Sequence[int]
     group_cap: int = 256
+    key_bounds: tuple = ()
 
 
-def _segment_partial(jnp, keys, vals, mask, cap):
+def _pack_keys(jnp, keys, bounds):
+    """Collision-FREE packing of bounded key components into one sort lane,
+    int32 when the domain fits — native TPU sorts instead of x64-emulated
+    pair sorts (the dominant MPP cost at millions of rows). Returns
+    (lane, n_codes) or None when any component is unbounded/out-of-budget;
+    codes occupy [0, n_codes), leaving headroom for dead-row sentinels."""
+    if not bounds or any(b is None for b in bounds):
+        return None
+    spans = []
+    total = 1
+    for lo, hi in bounds:
+        s = int(hi) - int(lo) + 1
+        if s < 1:
+            s = 1
+        spans.append(s)
+        total *= s
+        if total > (1 << 60):
+            return None
+    acc = None
+    for (lo, _hi), k, s in zip(bounds, keys, spans):
+        code = jnp.clip(k.astype(jnp.int64) - int(lo), 0, s - 1)
+        acc = code if acc is None else acc * s + code
+    if total <= (1 << 30):
+        return acc.astype(jnp.int32), total
+    return acc, total
+
+
+def _segment_partial(jnp, keys, vals, mask, cap, bounds=()):
     """Sort-based grouped partial agg on one shard (same algorithm as
     ops/dag_kernel.py — key-exact, no hash collisions). Returns
     (keys, sums, counts, overflow): ``overflow`` counts distinct groups
     beyond ``cap`` — results are invalid unless it is zero, so callers
     surface it and retry with a bigger cap."""
     n = keys[0].shape[0]
-    lanes = [~mask] + list(keys)
-    perm = jnp.argsort(lanes[-1], stable=True)
-    for lane in reversed(lanes[:-1]):
-        perm = perm[jnp.argsort(lane[perm], stable=True)]
-    sm = mask[perm]
-    first = jnp.arange(n) == 0
-    diff = jnp.zeros(n, dtype=bool)
-    for k in keys:
-        ks = k[perm]
-        diff = diff | jnp.concatenate([jnp.zeros(1, bool), ks[1:] != ks[:-1]])
+    packed = _pack_keys(jnp, keys, bounds)
+    if packed is not None:
+        lane, n_codes = packed
+        dead = n_codes if n_codes < (1 << 30) else jnp.int64(n_codes)
+        perm = jnp.argsort(jnp.where(mask, lane, dead))
+        sm = mask[perm]
+        ls = lane[perm]
+        first = jnp.arange(n) == 0
+        diff = jnp.concatenate([jnp.zeros(1, bool), ls[1:] != ls[:-1]])
+    else:
+        lanes = [~mask] + list(keys)
+        perm = jnp.argsort(lanes[-1], stable=True)
+        for lane in reversed(lanes[:-1]):
+            perm = perm[jnp.argsort(lane[perm], stable=True)]
+        sm = mask[perm]
+        first = jnp.arange(n) == 0
+        diff = jnp.zeros(n, dtype=bool)
+        for k in keys:
+            ks = k[perm]
+            diff = diff | jnp.concatenate([jnp.zeros(1, bool), ks[1:] != ks[:-1]])
     boundary = sm & (first | diff)
     overflow = jnp.maximum(boundary.sum() - cap, 0)
     seg = jnp.clip(jnp.cumsum(boundary) - 1, 0, None)
@@ -145,6 +186,10 @@ class DistJoinSpec:
     # match (NULL data slots hold 0, which would otherwise equal a real 0)
     left_key_valid: Sequence[int] = ()
     right_key_valid: Sequence[int] = ()
+    # JOINT (both sides) per-key (lo, hi) value bounds or () — bounded keys
+    # pack into one narrow exact lane (int32 when the domain fits): native
+    # sorts, and component re-verification becomes belt-and-braces
+    key_bounds: tuple = ()
 
 
 def _combine_keys(jnp, keys):
@@ -208,13 +253,18 @@ def _sorted_lookup(jnp, rk_s, lkey):
     return jnp.clip(cum_right[pos] - 1, 0, m - 1)
 
 
-def _local_unique_join(jax, jnp, lkey, lkeys, lvalid, rkey, rkeys, rcols, rvalid):
+def _local_unique_join(jax, jnp, lkey, lkeys, lvalid, rkey, rkeys, rcols, rvalid,
+                       dead_build=None, dead_probe=None):
     """Per-shard probe of a unique-key build side: for each left row find its
-    right match (≤1 by uniqueness). Returns (gathered right cols, match)."""
-    rperm = jnp.argsort(jnp.where(rvalid, rkey, jnp.int64(2**62)), stable=True)
-    rk_s = jnp.where(rvalid, rkey, jnp.int64(2**62))[rperm]
-    idx = _sorted_lookup(jnp, rk_s, lkey)
-    match = (rk_s[idx] == lkey) & lvalid
+    right match (≤1 by uniqueness). Returns (gathered right cols, match).
+    ``dead_build``/``dead_probe``: sentinels above every live key code
+    (packed-lane dtype-aware); default to the mixed-key int64 sentinels."""
+    db = jnp.int64(2**62) if dead_build is None else dead_build
+    rperm = jnp.argsort(jnp.where(rvalid, rkey, db))
+    rk_s = jnp.where(rvalid, rkey, db)[rperm]
+    pkey = lkey if dead_probe is None else jnp.where(lvalid, lkey, dead_probe)
+    idx = _sorted_lookup(jnp, rk_s, pkey)
+    match = (rk_s[idx] == pkey) & lvalid
     match &= rvalid[rperm][idx]
     # exact component verification (mix collisions can't fabricate a match)
     for lcomp, rcomp in zip(lkeys, rkeys):
@@ -240,16 +290,18 @@ def _sorted_bounds(jnp, rk_s, lkey):
     return lo, hi
 
 
-def _local_expand_join(jax, jnp, lkey, lkeys, lvalid, rkey, rkeys, rcols, rvalid, lcols, out_cap):
+def _local_expand_join(jax, jnp, lkey, lkeys, lvalid, rkey, rkeys, rcols, rvalid, lcols, out_cap,
+                       dead_build=None, dead_probe=None):
     """Per-shard equi-join with a NON-unique build side: each probe row
     expands to its match count. Output is ``out_cap`` static slots; slot j
     maps back to (probe row, match ordinal) through a cumsum of per-probe
     match counts — pure gathers, no scatter (TPU policy). Returns
     (probe-lane outputs, build-lane outputs, live, overflow)."""
-    big = jnp.int64(2**62)
-    rperm = jnp.argsort(jnp.where(rvalid, rkey, big), stable=True)
+    big = jnp.int64(2**62) if dead_build is None else dead_build
+    big_p = big - 1 if dead_probe is None else dead_probe
+    rperm = jnp.argsort(jnp.where(rvalid, rkey, big))
     rk_s = jnp.where(rvalid, rkey, big)[rperm]
-    pkey = jnp.where(lvalid, lkey, big - 1)  # dead probes match nothing
+    pkey = jnp.where(lvalid, lkey, big_p)  # dead probes match nothing
     lo, hi = _sorted_bounds(jnp, rk_s, pkey)
     cnt = jnp.where(lvalid, hi - lo, 0)
     cum = jnp.cumsum(cnt)
@@ -334,13 +386,21 @@ def build_dist_pipeline(
                 mask = mask & acc[vl].astype(bool)
             for vl in join.right_key_valid:
                 rvalid = rvalid & rcols[vl].astype(bool)
+            kb = tuple(join.key_bounds) if join.key_bounds else None
+
+            def join_lane(comps, _kb=kb):
+                p = _pack_keys(jnp, comps, _kb) if _kb else None
+                if p is None:
+                    return _combine_keys(jnp, comps), None
+                return p
+
             lkeys = [acc[i] for i in join.left_keys]
             rkeys = [rcols[i] for i in join.right_keys]
-            lkey = _combine_keys(jnp, lkeys)
-            rkey = _combine_keys(jnp, rkeys)
+            lkey, ncodes = join_lane(lkeys)
+            rkey, _ = join_lane(rkeys)
             if join.exchange == "hash":
-                lowner = jnp.abs(lkey) % ndev
-                rowner = jnp.abs(rkey) % ndev
+                lowner = jnp.abs(lkey).astype(jnp.int64) % ndev
+                rowner = jnp.abs(rkey).astype(jnp.int64) % ndev
                 lcap = join.left_row_cap or join.row_cap
                 rcap = join.right_row_cap or join.row_cap
                 acc, mask, d1 = _route_rows(jax, jnp, acc, mask, lowner, ndev, lcap)
@@ -348,21 +408,26 @@ def build_dist_pipeline(
                 dropped = dropped + d1 + d2
                 lkeys = [acc[i] for i in join.left_keys]
                 rkeys = [rcols[i] for i in join.right_keys]
-                lkey = _combine_keys(jnp, lkeys)
-                rkey = _combine_keys(jnp, rkeys)
+                lkey, ncodes = join_lane(lkeys)
+                rkey, _ = join_lane(rkeys)
             else:  # broadcast: replicate the build side on every shard
                 rcols = [jax.lax.all_gather(c, "dp").reshape(-1) for c in rcols]
                 rvalid = jax.lax.all_gather(rvalid, "dp").reshape(-1)
                 rkeys = [rcols[i] for i in join.right_keys]
-                rkey = _combine_keys(jnp, rkeys)
+                rkey, _ = join_lane(rkeys)
+            # dead-row sentinels above every live key code (packed lanes stay
+            # in their narrow dtype; mixed-hash lanes use the int64 bigs)
+            dead_b = None if ncodes is None else ncodes + 1
+            dead_p = None if ncodes is None else ncodes
             if join.unique:
                 gathered, mask = _local_unique_join(
-                    jax, jnp, lkey, lkeys, mask, rkey, rkeys, rcols, rvalid
+                    jax, jnp, lkey, lkeys, mask, rkey, rkeys, rcols, rvalid, dead_b, dead_p
                 )
                 acc = acc + gathered
             else:
                 out_l, out_r, mask, of = _local_expand_join(
-                    jax, jnp, lkey, lkeys, mask, rkey, rkeys, rcols, rvalid, acc, join.out_cap
+                    jax, jnp, lkey, lkeys, mask, rkey, rkeys, rcols, rvalid, acc, join.out_cap,
+                    dead_b, dead_p
                 )
                 overflow = overflow + of
                 acc = out_l + out_r
@@ -408,7 +473,7 @@ def build_dist_pipeline(
         acols = agg_inputs(joined) if agg_inputs is not None else joined
         keys = list(acols[: agg.n_keys])
         vals = [acols[i] for i in agg.sums]
-        pkeys, psums, pcnt, of1 = _segment_partial(jnp, keys, vals, mask, cap)
+        pkeys, psums, pcnt, of1 = _segment_partial(jnp, keys, vals, mask, cap, agg.key_bounds)
         h = _combine_keys(jnp, pkeys)
         owner = jnp.where(pcnt > 0, jnp.abs(h) % ndev, ndev - 1)
         order = jnp.argsort(owner, stable=True)
@@ -429,7 +494,7 @@ def build_dist_pipeline(
         rxkeys = [exchange(bucketize(k)) for k in pkeys]
         rxsums = [exchange(bucketize(s)) for s in psums]
         rxcnt = exchange(bucketize(pcnt))
-        mkeys, msums_cnt, _, of3 = _segment_partial(jnp, rxkeys, rxsums + [rxcnt], rxcnt > 0, cap)
+        mkeys, msums_cnt, _, of3 = _segment_partial(jnp, rxkeys, rxsums + [rxcnt], rxcnt > 0, cap, agg.key_bounds)
         gkeys = [jax.lax.all_gather(k, "dp").reshape(ndev * cap) for k in mkeys]
         gsums = [jax.lax.all_gather(s, "dp").reshape(ndev * cap) for s in msums_cnt[:-1]]
         gcnt = jax.lax.all_gather(msums_cnt[-1], "dp").reshape(ndev * cap)

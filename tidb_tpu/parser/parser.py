@@ -1155,7 +1155,7 @@ class Parser:
                 if self.peek().kind in ("ident", "qident") and not self.at_op("("):
                     iname = self.ident()  # always consume the index name
                     cons_name = cons_name or iname
-                at.action, at.fk = "add_fk", self._fk_tail(cons_name or "fk_1")
+                at.action, at.fk = "add_fk", self._fk_tail(cons_name)
             elif self.at_kw("PARTITION"):
                 self.next()
                 self.expect_op("(")

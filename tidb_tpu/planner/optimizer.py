@@ -451,11 +451,16 @@ def _try_index_merge(scan: LogicalScan, conds: list[Expression], stats=None):
         # bound is a near-full scan and would sink the union without stats)
         hr = _derive_ranges(scan, conjs)
         path = None
-        if hr is not None and all(
-            -(2**62) < lo and hi < 2**62
-            for lo, hi in (tablecodec.range_to_handles(kr, t.id) for kr in hr)
-        ):
-            path = ("table", hr)
+        if hr is not None:
+            spans = [tablecodec.range_to_handles(kr, t.id) for kr in hr]
+            if all(-(2**62) < lo and hi < 2**62 for lo, hi in spans):
+                path = ("table", hr)
+                if tstats is not None and tstats.row_count > 0:
+                    # PK paths cost lookups too: a wide handle range must
+                    # count against the merge, not ride for free
+                    est_rows += min(
+                        float(sum(hi - lo for lo, hi in spans)), float(tstats.row_count)
+                    )
         if path is None:
             best = None
             for idx in t.indexes:
