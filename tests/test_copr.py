@@ -343,3 +343,49 @@ def test_mvcc_visibility_through_engines(store):
     )
     rows = [r for res in client.send(req) for r in res.chunk.rows()]
     assert rows[0][0] == 999999
+
+
+def test_corner_bounds_oracle():
+    """Magnitude proofs for MXU routing: multilinear expressions get exact
+    pow2-envelope bounds; repeated columns / unsupported ops are rejected
+    (corner enumeration is unsound for them)."""
+    import numpy as np
+
+    import tidb_tpu
+    from tidb_tpu.copr import dagpb
+    from tidb_tpu.copr.binder import Binder
+    from tidb_tpu.copr.colcache import cache_for
+    from tidb_tpu.executor.load import bulk_load
+    from tidb_tpu.kv import tablecodec
+    from tidb_tpu.kv.rowcodec import RowSchema
+    from tidb_tpu.planner.builder import Builder, BuildCtx
+    from tidb_tpu.planner.plans import OutCol
+    from tidb_tpu.parser import parse
+
+    db = tidb_tpu.open(region_split_keys=1 << 62)
+    db.execute("CREATE TABLE cb (a BIGINT, b BIGINT)")
+    bulk_load(db, "cb", [np.arange(0, 1000), np.arange(0, 2000, 2)])
+    t = db.catalog.table("test", "cb")
+    store = db.store
+    region, _ = next(iter(store.pd.regions_in_ranges([tablecodec.record_range(t.id)])))
+    cache = cache_for(store)
+    entry = cache.get(region, t.id, RowSchema(t.storage_schema), [0, 1], store.current_ts())
+    scan_cols = [dagpb.ColumnInfoPB(c.offset, c.ftype) for c in t.columns]
+    binder = Binder(cache, t.id, scan_cols, entry)
+    builder = Builder(db.catalog, "test")
+    schema = [OutCol(c.name, c.ftype, table="cb", slot=c.offset) for c in t.columns]
+
+    def bounds_of(expr_sql):
+        stmt = parse(f"SELECT {expr_sql} FROM cb")
+        e = builder.resolve(stmt.items[0].expr, BuildCtx(schema))
+        return binder._corner_bounds(e.to_pb())
+
+    b = bounds_of("a * (1 - b)")  # multilinear: max |v| = 999 * 1997
+    assert b is not None and b[1] >= 999 * 1997 and b[1] <= 4 * 999 * 1997, b
+    # repeated column: corner extremes are NOT the box extremes — reject
+    assert bounds_of("a * (1000 - a)") is None
+    # non-whitelisted op
+    assert bounds_of("a / (b + 1)") is None
+    # huge synthetic constants must not wrap into a small lie
+    big = bounds_of("a * 9223372036854775")
+    assert big is None or big[1] >= 999 * 9223372036854775, big

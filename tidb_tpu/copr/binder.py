@@ -81,6 +81,14 @@ class Binder:
                             self._force_sorted(a["arg"])
                             allow = True
                         a["arg"] = self.bind_expr(a["arg"], allow_string_ref=allow or a["name"] in ("min", "max"))
+                if refs_are_scan:
+                    # exact value bounds per SUM argument (corner evaluation
+                    # over column min/max) — unlocks the MXU grouped-sum
+                    # kernel for expression args the ftype whitelist rejects
+                    ex.arg_bounds = [
+                        self._corner_bounds(a["arg"]) if a["arg"] is not None else None
+                        for a in ex.aggs
+                    ]
                 refs_are_scan = False
             elif ex.tp == dagpb.TOPN:
                 new_order = []
@@ -129,20 +137,104 @@ class Binder:
         for pb in pbs:
             b = None
             if pb["tp"] == "col" and pb["idx"] < len(self.scan_cols):
-                c = self.scan_cols[pb["idx"]]
-                if c.ftype.kind == TypeKind.STRING:
-                    b = (0, max(len(self._dict_for_offset(pb["idx"])) - 1, 0))
-                elif c.ftype.kind != TypeKind.FLOAT and self.entry is not None:
-                    if c.is_handle:
-                        h = self.entry.handles
-                        b = (int(h.min()), int(h.max())) if len(h) else (0, 0)
-                    else:
-                        b = self.entry.minmax(c.column_id)
+                b = self._col_stats(pb["idx"])
             bounds.append(b)
         return widen_bounds(bounds)
 
+    def _col_stats(self, offset: int):
+        """(min, max) of one scan output column from the region entry /
+        dictionary — the single stat source for every bound producer."""
+        c = self.scan_cols[offset]
+        if c.ftype.kind == TypeKind.STRING:
+            return (0, max(len(self._dict_for_offset(offset)) - 1, 0))
+        if c.ftype.kind == TypeKind.FLOAT or self.entry is None:
+            return None
+        if c.is_handle:
+            h = self.entry.handles
+            return (int(h.min()), int(h.max())) if len(h) else (0, 0)
+        try:
+            return self.entry.minmax(c.column_id)
+        except (KeyError, ValueError):
+            return None
+
     def _window_bounds(self, ex: dagpb.ExecutorPB) -> list:
         return self._bounds_for(ex.partition_by + [p for p, _ in ex.order_by])
+
+    # expression ops whose extremes over a box of inputs occur at the box's
+    # corners — interval evaluation by CORNER ENUMERATION through the real
+    # evaluator needs no second copy of decimal-scale semantics
+    _CORNER_SIGS = frozenset({"plus", "minus", "mul", "unaryminus"})
+
+    def _corner_bounds(self, pb: dict):
+        """Magnitude proof for an integer-kind expression: evaluate it on
+        every corner combination of its columns' cached min/max. Sound only
+        for MULTILINEAR expressions — {+, -, *, unary-} with each column
+        occurring AT MOST ONCE (a box's extremes then sit at its corners) —
+        and with exact Python-int arithmetic (object-dtype lanes) so int64
+        wraparound can't fake a small bound. The result is quantized to a
+        power-of-two magnitude envelope so data drift doesn't churn kernel
+        fingerprints. None = unbounded/unsupported — callers fall back."""
+        import itertools
+
+        import numpy as np
+
+        from tidb_tpu.expression.expr import EvalBatch, eval_expr, expr_from_pb
+
+        if self.entry is None:
+            return None
+        cols: list[int] = []
+        sound = [True]
+
+        def walk(node) -> bool:
+            tp = node["tp"]
+            if tp == "const":
+                return node["ft"][0] != int(TypeKind.STRING)
+            if tp == "col":
+                ft0 = node["ft"][0]
+                if ft0 in (int(TypeKind.STRING), int(TypeKind.FLOAT)):
+                    return False
+                if node["idx"] >= len(self.scan_cols):
+                    return False  # window-appended column: no cached stats
+                if node["idx"] in cols:
+                    sound[0] = False  # repeated column: not multilinear
+                    return False
+                cols.append(node["idx"])
+                return True
+            if tp == "func":
+                if node["sig"] not in self._CORNER_SIGS:
+                    return False
+                return all(walk(k) for k in node["children"])
+            return False
+
+        if not walk(pb) or not sound[0] or len(cols) > 6:
+            return None
+        mms = []
+        for off in cols:
+            mm = self._col_stats(off)
+            if mm is None:
+                return None
+            mms.append(mm)
+        corners = list(itertools.product(*mms)) or [()]
+        n = len(corners)
+        width = len(self.scan_cols)
+        # object dtype = exact Python-int arithmetic: corner products that
+        # would wrap int64 surface as huge values instead of small lies
+        batch_cols = [
+            (np.zeros(n, dtype=object) + 0, np.ones(n, bool)) for _ in range(width)
+        ]
+        for ci, off in enumerate(cols):
+            batch_cols[off] = (
+                np.array([int(cr[ci]) for cr in corners], dtype=object),
+                np.ones(n, bool),
+            )
+        try:
+            d, v, _ = eval_expr(expr_from_pb(pb), EvalBatch(batch_cols, [None] * width, n), np)
+            vals = [int(x) for x in np.broadcast_to(np.asarray(d, dtype=object), (n,))]
+        except Exception:
+            return None
+        m = max(abs(min(vals)), abs(max(vals)), 1)
+        m2 = 1 << (m - 1).bit_length()  # pow2 envelope: fingerprint-stable
+        return (-m2, m2)
 
     # -- expression rewriting ----------------------------------------------
     def _is_string(self, pb: dict) -> bool:

@@ -79,6 +79,10 @@ class ExecutorPB:
     group_by: list[dict] = field(default_factory=list)
     aggs: list[dict] = field(default_factory=list)  # AggDesc pb
     agg_mode: str = AGG_COMPLETE
+    # binder-stamped exact (lo, hi) per agg argument (None = unbounded) —
+    # static magnitude proofs for the MXU grouped-sum path; participates in
+    # to_pb so kernels never reuse stale bounds
+    arg_bounds: list = field(default_factory=list)
     # topn: order_by = [(ExprPB, desc: bool)]
     order_by: list = field(default_factory=list)
     limit: int = 0
@@ -125,7 +129,12 @@ class ExecutorPB:
         elif self.tp == SELECTION:
             d.update(conditions=self.conditions)
         elif self.tp in (AGGREGATION, STREAM_AGG):
-            d.update(group_by=self.group_by, aggs=self.aggs, agg_mode=self.agg_mode)
+            d.update(
+                group_by=self.group_by,
+                aggs=self.aggs,
+                agg_mode=self.agg_mode,
+                arg_bounds=[list(b) if b is not None else None for b in self.arg_bounds],
+            )
         elif self.tp == TOPN:
             d.update(
                 order_by=self.order_by,
@@ -170,6 +179,7 @@ class ExecutorPB:
             e.conditions = pb["conditions"]
         elif e.tp in (AGGREGATION, STREAM_AGG):
             e.group_by, e.aggs, e.agg_mode = pb["group_by"], pb["aggs"], pb["agg_mode"]
+            e.arg_bounds = [tuple(b) if b is not None else None for b in pb.get("arg_bounds", [])]
         elif e.tp == TOPN:
             e.order_by, e.limit = pb["order_by"], pb["limit"]
             e.sort_bounds = [tuple(b) if b is not None else None for b in pb.get("sort_bounds", [])]

@@ -116,7 +116,10 @@ _DEVICE_LRU = _DeviceLRU(_hbm_budget())
 
 
 def _device_put_col(key, data: np.ndarray, valid: np.ndarray, n_pad: int, cacheable: bool = True):
-    """One padded (data, valid) pair on device, LRU-cached under ``key``."""
+    """One padded (data, valid) pair on device, LRU-cached under ``key``.
+    Narrow dtypes are kept narrow in HBM (int32 dict codes / narrowed value
+    lanes read half the bytes; the kernel upcasts on use, which XLA fuses
+    into the consumer)."""
     import jax
     import jax.numpy as jnp
 
@@ -124,7 +127,7 @@ def _device_put_col(key, data: np.ndarray, valid: np.ndarray, n_pad: int, cachea
         hit = _DEVICE_LRU.get(key)
         if hit is not None:
             return hit
-    pd = np.zeros(n_pad, dtype=data.dtype if data.dtype != np.int32 else np.int64)
+    pd = np.zeros(n_pad, dtype=data.dtype)
     pd[: len(data)] = data
     pv = np.zeros(n_pad, dtype=bool)
     pv[: len(valid)] = valid
@@ -135,6 +138,23 @@ def _device_put_col(key, data: np.ndarray, valid: np.ndarray, n_pad: int, cachea
         _DEVICE_LRU.put(key, out, pd.nbytes + pv.nbytes)
         _DEVICE_LRU.evict_superseded(key[:4], key[4:6])
     return out
+
+
+def _narrowed(entry, column_id: int, data: np.ndarray) -> np.ndarray:
+    """int64 value lanes whose min/max fit int32 ship to HBM as int32 —
+    bounded DECIMALs, DATE days, and small ints cover the analytic hot path
+    (ref: the per-width column discipline of util/chunk/column.go:74). The
+    narrowing is deterministic per data version, so it can't split the
+    device LRU identity."""
+    if data.dtype != np.int64:
+        return data
+    try:
+        lo, hi = entry.minmax(column_id)
+    except (KeyError, ValueError):
+        return data
+    if -(2**31) < lo and hi < 2**31 - 1:
+        return data.astype(np.int32)
+    return data
 
 
 def _block_bounds(n: int) -> list[tuple[int, int]]:
@@ -157,7 +177,7 @@ def _block_device_inputs(store, scan, cache, entry, region, bi: int, lo: int, hi
         else:
             data, valid = entry.cols[c.column_id]
             ckey = base + (c.column_id, entry.data_version, epoch, bi, _BLOCK)
-            cols_dev.append(_device_put_col(ckey, data[lo:hi], valid[lo:hi], _BLOCK, cacheable))
+            cols_dev.append(_device_put_col(ckey, _narrowed(entry, c.column_id, data[lo:hi]), valid[lo:hi], _BLOCK, cacheable))
     return hpair[0], tuple(cols_dev)
 
 
@@ -253,7 +273,7 @@ def _exec_single(store, dag, bound, scan, cache, entry, region, rarr) -> Chunk:
         else:
             data, valid = entry.cols[c.column_id]
             ckey = (store.nonce, region.region_id, scan.table_id, c.column_id, entry.data_version, epoch, n_pad)
-            cols_dev.append(_device_put_col(ckey, data, valid, n_pad, cacheable))
+            cols_dev.append(_device_put_col(ckey, _narrowed(entry, c.column_id, data), valid, n_pad, cacheable))
 
     agg_cap = min(_DEFAULT_AGG_CAP, n_pad) if kernel_needs_agg(bound) else _DEFAULT_AGG_CAP
     while True:

@@ -54,13 +54,15 @@ def _dense_b_total(doms) -> int:
     return b
 
 
-def _mxu_aggs_ok(aggs) -> bool:
+def _mxu_aggs_ok(aggs, arg_bounds=()) -> bool:
     """The pallas grouped-sum kernel covers COUNT/SUM lanes whose values are
-    provably < 2^45 (exact byte-limb accumulation): DECIMAL with bounded
-    precision and DATE days. Anything else takes the sort path."""
+    provably < 2^45 (exact byte-limb accumulation). Proof sources, in order:
+    the binder's exact corner-evaluated bounds (covers expression args like
+    price*(1-disc)), then the conservative ftype whitelist (bounded DECIMAL,
+    DATE days). Anything else takes the eqmask/sort path."""
     from tidb_tpu.types import TypeKind
 
-    for a in aggs:
+    for i, a in enumerate(aggs):
         for pk in a.partial_kinds:
             if pk == "count":
                 continue
@@ -69,6 +71,9 @@ def _mxu_aggs_ok(aggs) -> bool:
             ft = a.arg.ftype if a.arg is not None else None
             if ft is None:
                 return False
+            b = arg_bounds[i] if i < len(arg_bounds) else None
+            if b is not None and max(abs(int(b[0])), abs(int(b[1]))) < (1 << 45):
+                continue
             if ft.kind == TypeKind.DECIMAL and 0 < ft.length <= 13:
                 continue
             if ft.kind == TypeKind.DATE:
@@ -233,6 +238,14 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1) -> Comp
             live = (iota % n_pad) < nvalid[iota // n_pad]
         else:
             live = jnp.arange(n) < nvalid
+        # HBM lanes may be narrowed (int32 dict codes / bounded values — see
+        # tpu_engine._narrowed); compute stays int64, with the upcast fused
+        # into each lane's first consumer
+        handles = handles.astype(jnp.int64)
+        cols = tuple(
+            (d.astype(jnp.int64) if jnp.issubdtype(d.dtype, jnp.integer) else d, v)
+            for d, v in cols
+        )
         # range mask: padded (MAX_RANGES, 2); empty slots have lo >= hi
         mask = jnp.zeros(n, dtype=bool)
         for r in range(MAX_RANGES):
@@ -276,19 +289,27 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1) -> Comp
                         else:
                             doms = None
                             break
-                    # equality-mask reduce cost is B*n per agg lane; past
-                    # _DENSE_EQMASK_MAX buckets the MXU pallas kernel takes
-                    # over (up to _DENSE_MXU_MAX) for COUNT/SUM shapes, and
-                    # the lex-sort path covers the rest
+                    # equality-mask reduce costs B*n per agg lane on the VPU
+                    # (in emulated x64); the MXU pallas kernel rides the
+                    # systolic array instead. Route to the MXU whenever the
+                    # magnitude proof holds and the batch is big enough to
+                    # amortize its fixed cost — even for tiny B, where the
+                    # eqmask was the round-2 default; the lex-sort path
+                    # covers everything else
                     if doms:
-                        bt = _dense_b_total(doms)
-                        if bt <= min(agg_cap, _DENSE_EQMASK_MAX):
-                            dense_doms = doms
-                        elif bt <= min(agg_cap, _DENSE_MXU_MAX) and _mxu_aggs_ok(aggs):
-                            from tidb_tpu.ops.pallas_groupby import MAX_ROWS
+                        from tidb_tpu.ops.pallas_groupby import MAX_ROWS, _BLK
 
-                            if n_pad <= MAX_ROWS:
-                                mxu_doms = doms
+                        bt = _dense_b_total(doms)
+                        mxu_fits = (
+                            bt <= min(agg_cap, _DENSE_MXU_MAX)
+                            and _mxu_aggs_ok(aggs, getattr(ex, "arg_bounds", ()))
+                            and n <= MAX_ROWS
+                            and n % _BLK == 0
+                        )
+                        if mxu_fits and (bt > _DENSE_EQMASK_MAX or n >= (1 << 21)):
+                            mxu_doms = doms
+                        elif bt <= min(agg_cap, _DENSE_EQMASK_MAX):
+                            dense_doms = doms
 
                 gvals = []
                 for g in group_exprs:
