@@ -73,6 +73,18 @@ class Catalog:
             self._recycle = pb.get("recycle", [])
 
     def _persist(self) -> None:
+        # cross-process guard (ref: domain schema-validator leases, here as
+        # optimistic versioning): if another SQL-layer process moved the
+        # persisted catalog past what this process loaded, rewriting it
+        # wholesale would erase that DDL — reload and make the caller retry
+        raw = self.store.raw_get(META_KEY)
+        if raw:
+            persisted = json.loads(raw.decode()).get("version", 0)
+            if persisted != self.schema_version:
+                self.reload()
+                raise CatalogError(
+                    "schema changed by another process; catalog reloaded — retry the statement"
+                )
         self.schema_version += 1
         self._fk_ref_cache = {}
         pb = {
@@ -81,6 +93,14 @@ class Catalog:
             "recycle": self._recycle,
         }
         self.store.raw_put(META_KEY, json.dumps(pb).encode())
+
+    def reload(self) -> None:
+        """Re-read the persisted catalog (another process's DDL landed)."""
+        with self._mu:
+            self._dbs = {}
+            self._recycle = []
+            self._load()
+            self._fk_ref_cache = {}
 
     def _next_table_id(self) -> int:
         raw = self.store.raw_get(META_NEXT_ID)

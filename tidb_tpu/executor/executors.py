@@ -1674,9 +1674,7 @@ class PointGetExec(Executor):
             raw = txn.membuf.get(rk)
         else:
             # honors current-read overrides (FOR UPDATE at for_update_ts)
-            from tidb_tpu.kv.memstore import Snapshot
-
-            raw = txn._retry_locked(lambda: Snapshot(self.session.store, self.session.read_ts()).get(rk))
+            raw = txn._retry_locked(lambda: self.session.store.get_snapshot(self.session.read_ts()).get(rk))
         slots = getattr(self.plan, "scan_slots", list(range(len(t.columns))))
         if raw is None:
             return _empty_chunk(self.plan.schema)

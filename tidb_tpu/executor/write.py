@@ -310,9 +310,7 @@ def _txn_read(session, key: bytes):
     if txn.membuf.contains(key):
         return txn.membuf.get(key)
     if session._explicit and txn.pessimistic:
-        from tidb_tpu.kv.memstore import Snapshot
-
-        return Snapshot(session.store, txn.for_update_ts).get(key)
+            return session.store.get_snapshot(txn.for_update_ts).get(key)
     return txn.get(key)
 
 
@@ -584,14 +582,12 @@ def _pessimistic_current_read(session, t: TableInfo, handles, rows, chunk, idxs,
     txn = session._txn
     if not (session._explicit and txn is not None and txn.pessimistic) or len(idxs) == 0:
         return idxs, rows, chunk
-    from tidb_tpu.kv.memstore import Snapshot
-
     def _tid(i) -> int:
         return row_tables[int(i)].id if row_tables is not None else t.id
 
     keys = [tablecodec.record_key(_tid(i), handles[int(i)]) for i in idxs]
     session.lock_for_write(keys)
-    snap = Snapshot(session.store, txn.for_update_ts)
+    snap = session.store.get_snapshot(txn.for_update_ts)
     schema = RowSchema(t.storage_schema)
     changed = False
     live = []

@@ -81,7 +81,7 @@ class Txn:
     def __init__(self, store: MemStore, start_ts: Optional[int] = None, pessimistic: bool = False):
         self.store = store
         self.start_ts = start_ts if start_ts is not None else store.tso.ts()
-        self.snapshot = Snapshot(store, self.start_ts)
+        self.snapshot = store.get_snapshot(self.start_ts)
         self.membuf = MemBuffer()
         self.commit_ts: Optional[int] = None
         self._done = False
@@ -124,7 +124,7 @@ class Txn:
         return self._retry_locked(lambda: self.snapshot.get(key))
 
     def scan(self, kr: KeyRange, limit: int = 2**63, read_ts: Optional[int] = None) -> list[tuple[bytes, bytes]]:
-        snap = self.snapshot if read_ts is None else Snapshot(self.store, read_ts)
+        snap = self.snapshot if read_ts is None else self.store.get_snapshot(read_ts)
         # membuf DELs can only shrink the snapshot result: limit+ndel snapshot
         # rows always cover the first `limit` merged rows (keeps LIMIT-k scans
         # of bulk-loaded tables O(k), e.g. the DDL backfill batches)

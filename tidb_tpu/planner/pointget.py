@@ -161,11 +161,10 @@ def run_point_get(session, plan: PointGetPlan) -> list[tuple]:
     """One KV get per handle through the txn-aware read path (membuffer
     overlay first, then MVCC snapshot at the session read ts)."""
     from tidb_tpu.kv import tablecodec
-    from tidb_tpu.kv.memstore import Snapshot
     from tidb_tpu.kv.rowcodec import RowSchema, decode_row
 
     txn = session._txn
-    snap = None if txn is not None else Snapshot(session.store, session.read_ts())
+    snap = None if txn is not None else session.store.get_snapshot(session.read_ts())
     schema = RowSchema(plan.table.storage_schema)
     out: list[tuple] = []
     for handle in plan.handles:

@@ -1114,10 +1114,13 @@ class Session:
 
 
 class DB:
-    """Embedded database handle (testkit.CreateMockStore analog)."""
+    """Embedded database handle (testkit.CreateMockStore analog). With
+    ``store`` given (e.g. a kv.remote.RemoteStore), this process is a pure
+    SQL layer: catalog, planner, and executors run here; every byte of data
+    lives behind the store's wire (the TiDB-process-over-TiKV shape)."""
 
-    def __init__(self, region_split_keys: int = 500_000):
-        self.store = MemStore(region_split_keys=region_split_keys)
+    def __init__(self, region_split_keys: int = 500_000, store=None):
+        self.store = store if store is not None else MemStore(region_split_keys=region_split_keys)
         self.catalog = Catalog(self.store)
         self.global_vars: dict[str, Any] = {}
         self._mu = threading.Lock()
@@ -1199,6 +1202,8 @@ class DB:
         """One synchronous MVCC GC cycle (tests / admin). Honors the
         tidb_gc_life_time global (seconds)."""
         life_s = float(self.global_vars.get("tidb_gc_life_time", DEFAULT_SYSVARS["tidb_gc_life_time"]))
+        if hasattr(self.store, "run_gc"):  # remote-backed: GC where the data lives
+            return self.store.run_gc(safe_point, life_ms=int(life_s * 1000))
         self.gc_worker.life_ms = int(life_s * 1000)
         pruned = self.gc_worker.run_once(safe_point)
         # dropped-table snapshots become unrecoverable past the safe point
@@ -1225,5 +1230,12 @@ class DB:
         return self._ses().query(sql)
 
 
-def open_db(region_split_keys: int = 500_000) -> DB:
+def open_db(region_split_keys: int = 500_000, remote: "str | None" = None) -> DB:
+    """``remote="host:port"`` attaches this process as a SQL layer to a
+    running kv.remote.StoreServer instead of embedding a MemStore."""
+    if remote is not None:
+        from tidb_tpu.kv.remote import RemoteStore
+
+        host, _, port = remote.rpartition(":")
+        return DB(store=RemoteStore(host or "127.0.0.1", int(port)))
     return DB(region_split_keys=region_split_keys)
