@@ -74,17 +74,16 @@ class Catalog:
 
     def _persist(self) -> None:
         # cross-process guard (ref: domain schema-validator leases, here as
-        # optimistic versioning): if another SQL-layer process moved the
-        # persisted catalog past what this process loaded, rewriting it
-        # wholesale would erase that DDL — reload and make the caller retry
+        # optimistic versioning): the write lands ATOMICALLY only if nobody
+        # moved the persisted catalog since this process last read it —
+        # otherwise reload and make the caller retry. A read-then-write pair
+        # would let two processes erase each other's DDL.
         raw = self.store.raw_get(META_KEY)
-        if raw:
-            persisted = json.loads(raw.decode()).get("version", 0)
-            if persisted != self.schema_version:
-                self.reload()
-                raise CatalogError(
-                    "schema changed by another process; catalog reloaded — retry the statement"
-                )
+        if raw is not None and json.loads(raw.decode()).get("version", 0) != self.schema_version:
+            self.reload()
+            raise CatalogError(
+                "schema changed by another process; catalog reloaded — retry the statement"
+            )
         self.schema_version += 1
         self._fk_ref_cache = {}
         pb = {
@@ -92,7 +91,16 @@ class Catalog:
             "dbs": {k: v.to_pb() for k, v in self._dbs.items()},
             "recycle": self._recycle,
         }
-        self.store.raw_put(META_KEY, json.dumps(pb).encode())
+        new = json.dumps(pb).encode()
+        if hasattr(self.store, "raw_cas"):
+            if not self.store.raw_cas(META_KEY, raw, new):
+                self.schema_version -= 1
+                self.reload()
+                raise CatalogError(
+                    "schema changed by another process; catalog reloaded — retry the statement"
+                )
+        else:
+            self.store.raw_put(META_KEY, new)
 
     def reload(self) -> None:
         """Re-read the persisted catalog (another process's DDL landed)."""

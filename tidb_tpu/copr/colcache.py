@@ -125,6 +125,44 @@ class ColumnCache:
             self.epoch += 1
             return dic
 
+    def unify_dictionaries(self, table_a: int, slot_a: int, table_b: int, slot_b: int) -> Dictionary:
+        """Make two string columns share ONE dictionary so their codes are
+        directly comparable (string equi-join keys across tables — ref: the
+        role collation-consistent encodings play for TiFlash join keys).
+        The second column's codes remap into the first's dictionary; cached
+        region entries and stable blocks follow, and the epoch bump drops
+        device copies. Idempotent and persistent: later encodes on either
+        column land in the shared dictionary."""
+        with self._mu:
+            ka = (self._resolve(table_a), slot_a)
+            kb = (self._resolve(table_b), slot_b)
+            da = self._dicts.setdefault(ka, Dictionary())
+            db = self._dicts.setdefault(kb, Dictionary())
+            if da is db:
+                return da
+            vals = db.values_array()
+            remap = np.fromiter((da.encode(v) for v in vals), dtype=np.int32, count=len(vals))
+            for (rid, tid), entry in self._entries.items():
+                if self._resolve(tid) == kb[0] and slot_b in entry.cols:
+                    data, valid = entry.cols[slot_b]
+                    entry.cols[slot_b] = (remap[data] if len(vals) else data, valid)
+                    entry._minmax.pop(slot_b, None)
+            store = self.store
+            with store._mu:
+                for tid, blocks in store._stable.items():
+                    if self._resolve(tid) != kb[0]:
+                        continue
+                    for b in blocks:
+                        pair = b.cols.get(slot_b)
+                        if pair is not None and pair[0].dtype == np.int32 and len(vals):
+                            b.cols[slot_b] = (remap[pair[0]], pair[1])
+                        # row-read decode must follow the shared dictionary
+                        if getattr(b, "dicts", None) and slot_b in b.dicts:
+                            b.dicts[slot_b] = da
+            self._dicts[kb] = da
+            self.epoch += 1
+            return da
+
     def ingest_lock(self):
         """Context manager serializing bulk dictionary encoding + block
         ingest against :meth:`ensure_sorted_dict` compaction — codes encoded

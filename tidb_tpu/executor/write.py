@@ -310,7 +310,7 @@ def _txn_read(session, key: bytes):
     if txn.membuf.contains(key):
         return txn.membuf.get(key)
     if session._explicit and txn.pessimistic:
-            return session.store.get_snapshot(txn.for_update_ts).get(key)
+        return session.store.get_snapshot(txn.for_update_ts).get(key)
     return txn.get(key)
 
 

@@ -827,6 +827,33 @@ class MemStore:
     def raw_get(self, key: bytes) -> Optional[bytes]:
         return Snapshot(self, self.tso.ts()).get(key)
 
+    def raw_cas(self, key: bytes, expected: Optional[bytes], value: bytes) -> bool:
+        """Atomic compare-and-swap on a raw key (``expected`` None = key must
+        be absent). The catalog's cross-process DDL guard hangs off this —
+        two read-then-write RPCs cannot serialize schema rewrites."""
+        with self._mu:
+            ts = self.tso.ts()
+            cur = None
+            chain = self._writes.get(key)
+            if chain:
+                for w in reversed(chain):
+                    if w.commit_ts <= ts:
+                        cur = None if w.op == OP_DEL else w.value
+                        break
+            if cur != expected:
+                return False
+            chain = self._writes.setdefault(key, [])
+            if not chain and self._sorted is not None:
+                if self._sorted and self._sorted[-1] < key:
+                    self._sorted.append(key)
+                else:
+                    self._sorted = None
+            chain.append(Write(ts, ts, OP_PUT, value))
+            r = self.region_for_key(key)
+            r.max_commit_ts = max(r.max_commit_ts, ts)
+            r.data_version += 1
+            return True
+
     def raw_delete(self, key: bytes) -> None:
         with self._mu:
             ts = self.tso.ts()

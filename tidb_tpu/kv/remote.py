@@ -168,6 +168,10 @@ class StoreServer:
         if cmd == "raw_put":
             st.raw_put(_ub(h["key"]), blobs[0])
             return {"ok": 1}, []
+        if cmd == "raw_cas":
+            expected = blobs[0] if h["has_expected"] else None
+            ok = st.raw_cas(_ub(h["key"]), expected, blobs[-1])
+            return {"ok": int(ok)}, []
         if cmd == "raw_scan":
             pairs = st.raw_scan(KeyRange(_ub(h["start"]), _ub(h["end"])), limit=h.get("limit", 2**62))
             out = bytearray()
@@ -178,7 +182,8 @@ class StoreServer:
             from tidb_tpu.kv.gcworker import GCWorker
 
             w = GCWorker(st, life_ms=h.get("life_ms", 600_000))
-            return {"pruned": w.run_once(h.get("safe_point"))}, []
+            pruned = w.run_once(h.get("safe_point"))
+            return {"pruned": pruned, "safe_point": w.safe_point}, []
         if cmd == "snap_get":
             v = st.get_snapshot(h["ts"]).get(_ub(h["key"]))
             return ({"hit": v is not None}, [v] if v is not None else [])
@@ -398,9 +403,10 @@ class RemoteStore:
     a dead server surfaces as ConnectionError to the caller, which the
     session layers report like any region error."""
 
-    def __init__(self, host: str, port: int, connect_timeout: float = 5.0):
+    def __init__(self, host: str, port: int, connect_timeout: float = 5.0, read_timeout: float = 600.0):
         self.host, self.port = host, port
         self._timeout = connect_timeout
+        self._read_timeout = read_timeout
         self._local = threading.local()
         self.nonce = f"remote:{host}:{port}"
         self.tso = _RemoteTSO(self)
@@ -419,7 +425,9 @@ class RemoteStore:
         c = getattr(self._local, "conn", None)
         if c is None:
             c = socket.create_connection((self.host, self.port), timeout=self._timeout)
-            c.settimeout(60.0)
+            # long deadline: first-query jit compiles + big scans legitimately
+            # run minutes; a genuinely dead server still fails fast on connect
+            c.settimeout(self._read_timeout)
             self._local.conn = c
         return c
 
@@ -453,6 +461,13 @@ class RemoteStore:
     def raw_put(self, key: bytes, value: bytes) -> None:
         self._call({"cmd": "raw_put", "key": _b(key)}, [value])
 
+    def raw_cas(self, key: bytes, expected, value: bytes) -> bool:
+        blobs = ([expected] if expected is not None else []) + [value]
+        h, _ = self._call(
+            {"cmd": "raw_cas", "key": _b(key), "has_expected": expected is not None}, blobs
+        )
+        return bool(h["ok"])
+
     def raw_scan(self, kr: KeyRange, limit: int = 2**62):
         h, blobs = self._call(
             {"cmd": "raw_scan", "start": _b(kr.start), "end": _b(kr.end), "limit": min(limit, 2**62)}
@@ -467,10 +482,11 @@ class RemoteStore:
             off += klen + vlen
         return out
 
-    def run_gc(self, safe_point=None, life_ms: int = 600_000) -> int:
-        """MVCC GC runs where the data lives — proxied to the server."""
+    def run_gc(self, safe_point=None, life_ms: int = 600_000):
+        """MVCC GC runs where the data lives — proxied to the server.
+        Returns (pruned, safe_point) so callers can expire recoverables."""
         h, _ = self._call({"cmd": "run_gc", "safe_point": safe_point, "life_ms": life_ms})
-        return h["pruned"]
+        return h["pruned"], h.get("safe_point", 0)
 
     def get_snapshot(self, ts: int) -> _RemoteSnapshot:
         return _RemoteSnapshot(self, ts)

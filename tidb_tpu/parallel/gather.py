@@ -44,12 +44,17 @@ _MPP_DEV_CACHE: dict = {}
 @dataclass
 class MPPJoin:
     """One join step of a left-deep MPP chain: the accumulated probe side
-    joins build ``reader[i+1]``. ``eq``: [(accumulated schema pos, build
-    reader schema pos)]."""
+    joins build ``reader[i+1]``. ``eq``: [(accumulated PLAN-schema pos, build
+    reader schema pos)]. ``kind``: inner | left | semi | anti (semi/anti
+    append no build columns to the plan schema). ``str_keys``: [(probe
+    (table_id, slot), build (table_id, slot))] string key pairs whose
+    dictionaries unify at execution time."""
 
     eq: list
     exchange: str = "hash"  # hash | broadcast
     unique: bool = True
+    kind: str = "inner"
+    str_keys: list = field(default_factory=list)
 
 
 @dataclass
@@ -124,15 +129,17 @@ def _reader_mpp_ok(reader: PhysTableReader) -> bool:
         and reader.pushed_agg is None
         and reader.pushed_topn is None
         and reader.pushed_limit is None
-        and reader.table.partition is None  # partitioned MPP: later round
+        and reader.pushed_window is None
         and all(can_push_down(c, "tpu") for c in reader.pushed_conditions)
     )
 
 
 def _agg_mpp_ok(agg: PhysFinalAgg) -> bool:
     for a in agg.aggs:
-        if a.name not in ("count", "sum", "avg") or a.distinct:
+        if a.name not in ("count", "sum", "avg", "min", "max") or a.distinct:
             return False
+        if a.name in ("min", "max") and a.arg is not None and a.arg.ftype.kind == TypeKind.STRING:
+            return False  # dict codes are identities, not an order
         if a.arg is not None and not can_push_down(a.arg, "tpu"):
             return False
     for g in agg.group_by:
@@ -181,34 +188,61 @@ def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev):
         return ([p], [], rows)
     if (
         isinstance(p, PhysHashJoin)
-        and p.kind == "inner"
+        and p.kind in ("inner", "left", "semi", "anti")
         and p.eq_conds
         and not p.other_conds
+        and not p.null_aware
         and len(p.children) == 2
     ):
         base = _flatten_join_chain(p.children[0], stats, get_ndev)
         if base is None:
             return None
         r = p.children[1]
+        eq_conds = list(p.eq_conds)
+        # column-only projections over the build reader (subquery rewrites
+        # emit them) just remap the right key positions
+        from tidb_tpu.planner.plans import PhysProjection
+
+        while isinstance(r, PhysProjection) and all(isinstance(e, ColumnRef) for e in r.exprs):
+            eq_conds = [(lp, r.exprs[rp].index) for lp, rp in eq_conds]
+            r = r.children[0]
         if not (isinstance(r, PhysTableReader) and _reader_mpp_ok(r)):
             return None
         readers, joins, probe_rows = base
-        acc_cols = sum(len(rd.schema) for rd in readers)
-        if any(lp >= acc_cols or rp >= len(r.schema) for lp, rp in p.eq_conds):
+        acc_cols = _plan_schema_len(readers, joins)
+        if any(lp >= acc_cols or rp >= len(r.schema) for lp, rp in eq_conds):
             return None
-        key_slots = [r.schema[rp].slot for _, rp in p.eq_conds]
-        key_types = [r.schema[rp].ftype for _, rp in p.eq_conds]
-        if any(ft.kind == TypeKind.STRING for ft in key_types):
-            return None  # per-table dictionaries: string join keys differ
+        key_slots = [r.schema[rp].slot for _, rp in eq_conds]
+        key_types = [r.schema[rp].ftype for _, rp in eq_conds]
+        str_keys = []
+        for (lp, rp), ft in zip(eq_conds, key_types):
+            lsrc = _plan_col_source(readers, joins, lp)
+            if ft.kind == TypeKind.STRING or (lsrc is not None and lsrc[2].kind == TypeKind.STRING):
+                if (
+                    ft.kind != TypeKind.STRING
+                    or lsrc is None
+                    or lsrc[2].kind != TypeKind.STRING
+                    or ft.collation == "ci"
+                    or lsrc[2].collation == "ci"
+                ):
+                    return None  # mixed kinds / ci collation: host join
+                str_keys.append(((lsrc[0], lsrc[1]), (r.table.id, r.schema[rp].slot)))
+        unique = _right_side_unique(r, key_slots)
+        if p.kind in ("semi", "anti", "left") and not unique and len(eq_conds) > 1:
+            # multi-key existence/outer shapes need packed-exact keys;
+            # without a uniqueness proof the collision-safe path is the
+            # host join (a mixed-hash collision would duplicate or drop)
+            return None
         r_rows = None
         st = stats.get(r.table.id) if stats is not None else None
         if st is not None:
             r_rows = st.row_count
-        unique = _right_side_unique(r, key_slots)
         exchange = _choose_exchange(probe_rows, r_rows, get_ndev())
-        joins = joins + [MPPJoin(eq=list(p.eq_conds), exchange=exchange, unique=unique)]
+        joins = joins + [
+            MPPJoin(eq=list(eq_conds), exchange=exchange, unique=unique, kind=p.kind, str_keys=str_keys)
+        ]
         out_rows = probe_rows
-        if not unique and probe_rows is not None and r_rows is not None:
+        if p.kind == "inner" and not unique and probe_rows is not None and r_rows is not None:
             # expansion estimate: probe rows × build fan-out (rows per
             # distinct key when ANALYZE knows the NDV, else a ×2 guess) —
             # feeds the NEXT join's exchange-cost comparison
@@ -222,11 +256,42 @@ def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev):
     return None
 
 
-def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None) -> PhysicalPlan:
+def _plan_schema_len(readers: list, joins: list) -> int:
+    """Length of the accumulated PLAN schema: semi/anti joins contribute no
+    build columns."""
+    n = len(readers[0].schema)
+    for ji, j in enumerate(joins):
+        if j.kind in ("inner", "left"):
+            n += len(readers[ji + 1].schema)
+    return n
+
+
+def _plan_col_source(readers: list, joins: list, pos: int):
+    """(table_id, slot, ftype) for accumulated plan-schema position."""
+    if pos < len(readers[0].schema):
+        oc = readers[0].schema[pos]
+        return (readers[0].table.id, oc.slot, oc.ftype)
+    pos -= len(readers[0].schema)
+    for ji, j in enumerate(joins):
+        if j.kind not in ("inner", "left"):
+            continue
+        r = readers[ji + 1]
+        if pos < len(r.schema):
+            oc = r.schema[pos]
+            return (r.table.id, oc.slot, oc.ftype)
+        pos -= len(r.schema)
+    return None
+
+
+def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> PhysicalPlan:
     """Rewrite eligible FinalAgg/TopN/Limit-over-join subtrees into
     PhysMPPGather (ref: the planner preferring mpp task type under
     tidb_allow_mpp)."""
     if not int(vars.get("tidb_allow_mpp", 1)):
+        return plan
+    if store is not None and not hasattr(store, "_stable"):
+        # remote-backed SQL layer: the MPP coordinator belongs where the
+        # data (and the device) live — the storage-server process
         return plan
     enforce = int(vars.get("tidb_enforce_mpp", 0))
 
@@ -259,7 +324,7 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None) -> PhysicalPlan:
 
         r0 = readers[0]
         n0 = len(r0.schema)
-        if stats is None:
+        if stats is None or any(j.kind != "inner" for j in joins):
             return None
         st0 = stats.get(r0.table.id)
         if st0 is None or st0.row_count <= 0:
@@ -341,8 +406,14 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None) -> PhysicalPlan:
                 s = set()
                 _acc_expr_cols(g, s)
                 new_groups.append(_remap_expr(g, {i: i + delta for i in s}))
+        # partial lanes re-reduce by their own kind: count/sum lanes SUM,
+        # min/max lanes MIN/MAX (min of mins is exact)
+        lane_kinds = []
+        for a in p.aggs:
+            for pk in a.partial_kinds:
+                lane_kinds.append(pk if pk in ("min", "max") else "sum")
         syn_aggs = [
-            AggDesc("sum", ColumnRef(j, pre_schema[j].ftype, pre_schema[j].name))
+            AggDesc(lane_kinds[j], ColumnRef(j, pre_schema[j].ftype, pre_schema[j].name))
             for j in range(n_lanes_partial)
         ]
         syn = PhysFinalAgg(
@@ -544,23 +615,28 @@ class MPPGatherExec:
         lane_of: schema pos → data lane index in the accumulated layout)."""
         p = self.plan
         # lane count follows the reader's OUTPUT schema (pre-aggregated
-        # readers emit partial lanes + keys, not raw scan columns)
+        # readers emit partial lanes + keys, not raw scan columns). The
+        # PLAN schema skips semi/anti build readers — their lanes exist in
+        # the INPUT but contribute no output columns, matching the step
+        # function's accumulated layout.
         n_lanes = [2 * len(r.schema) + 1 for r in p.readers]
         lane_of = []
         off = 0
-        for r in p.readers:
-            for i in range(len(r.schema)):
-                lane_of.append(off + 2 * i)
-            off += 2 * len(r.schema) + 1
+        for ri, r in enumerate(p.readers):
+            in_plan = ri == 0 or p.joins[ri - 1].kind in ("inner", "left")
+            if in_plan:
+                for i in range(len(r.schema)):
+                    lane_of.append(off + 2 * i)
+                # the ACCUMULATED layout only grows for joins that append
+                # build lanes — semi/anti readers exist in the INPUT but
+                # contribute nothing to acc, so the offset must not move
+                off += 2 * len(r.schema) + 1
         return n_lanes, lane_of
 
     def _col_source(self, pos: int):
-        """(table_id, slot) for accumulated schema position ``pos``."""
-        for r in self.plan.readers:
-            if pos < len(r.schema):
-                return (r.table.id, r.schema[pos].slot)
-            pos -= len(r.schema)
-        return None
+        """(table_id, slot) for accumulated PLAN-schema position ``pos``."""
+        src = _plan_col_source(self.plan.readers, self.plan.joins, pos)
+        return (src[0], src[1]) if src is not None else None
 
     def execute(self):
         """Attempt the mesh pipeline with failure detection and retry (ref:
@@ -626,6 +702,14 @@ class MPPGatherExec:
             and self.session._read_ts_override is None
             and not float(self.session.vars.get("tidb_read_staleness", 0) or 0)
         )
+        from tidb_tpu.copr.colcache import cache_for as _cache_for
+
+        _cache = _cache_for(self.session.store)
+        for join in p.joins:
+            for (ta, sa), (tb, sb) in join.str_keys:
+                # string join keys compare as dictionary codes: both columns
+                # must share ONE dictionary (idempotent after the first query)
+                _cache.unify_dictionaries(ta, sa, tb, sb)
         conds = [self._bind_conditions(r) for r in p.readers]
         agg = p.agg
 
@@ -664,9 +748,10 @@ class MPPGatherExec:
             if self._dev_cacheable:
                 from tidb_tpu.kv import tablecodec
 
-                regions = self.session.store.pd.regions_in_ranges(
-                    [tablecodec.record_range(reader.table.id)]
-                )
+                prs = [
+                    tablecodec.record_range(v.id) for v in reader.table.partition_views()
+                ]
+                regions = self.session.store.pd.regions_in_ranges(prs)
                 vers = tuple((r.region_id, r.data_version) for r, _ in regions)
                 agg_fp = ""
                 if reader.pushed_agg is not None:
@@ -686,6 +771,7 @@ class MPPGatherExec:
                     vers,
                     ndev,
                     agg_fp,
+                    _cache.epoch,  # dictionary merges/compactions remap codes
                 )
                 hit = _MPP_DEV_CACHE.get(key)
                 if hit is not None:
@@ -701,8 +787,13 @@ class MPPGatherExec:
         sides = [dev_side(r) for r in p.readers]
         all_lanes = [a for arrays, _, _ in sides for a in arrays]
         nrows = [n for _, n, _ in sides]
-        # accumulated-schema-position → column bounds (packed fragment sorts)
-        all_bounds = [b for _, _, bs in sides for b in bs]
+        bounds_by_reader = [bs for _, _, bs in sides]
+        # accumulated PLAN-schema position → column bounds (packed sorts);
+        # semi/anti build readers contribute no plan columns
+        all_bounds = list(bounds_by_reader[0])
+        for ji, join in enumerate(p.joins):
+            if join.kind in ("inner", "left"):
+                all_bounds.extend(bounds_by_reader[ji + 1])
         ncols = [len(r.schema) for r in p.readers]
         n_lanes, lane_of = self._lane_maps()
 
@@ -725,7 +816,7 @@ class MPPGatherExec:
         selections = [side_selection(conds[i], ncols[i]) for i in range(len(p.readers))]
 
         # agg input mapping over the accumulated lane layout
-        total_cols = sum(len(r.schema) for r in p.readers)
+        total_cols = _plan_schema_len(p.readers, p.joins)
 
         def agg_inputs(joined):
             pairs = [
@@ -753,7 +844,18 @@ class MPPGatherExec:
                 n = pairs[0][0].shape[0]
                 d = jnp.broadcast_to(d, (n,))
                 v = jnp.broadcast_to(v if v is not None else True, (n,))
-                out.append(jnp.where(v, d, 0))
+                if a.name in ("min", "max"):
+                    # extremes reduce with sentinels, not zeros: an invalid
+                    # row must not look like a legitimate 0
+                    if jnp.issubdtype(d.dtype, jnp.floating):
+                        sent = jnp.inf if a.name == "min" else -jnp.inf
+                    else:
+                        sent = (
+                            jnp.iinfo(jnp.int64).max if a.name == "min" else jnp.iinfo(jnp.int64).min
+                        )
+                    out.append(jnp.where(v, d, sent))
+                else:
+                    out.append(jnp.where(v, d, 0))
                 out.append(v.astype(jnp.int64))
             return out
 
@@ -761,7 +863,6 @@ class MPPGatherExec:
         # expansion capacity from the probe row count with 2× headroom
         shard = lambda n: max(2 * ((max(n, 1) + ndev - 1) // ndev), 64)
         probe_cap = shard(nrows[0])
-        schema_base = [sum(len(rd.schema) for rd in p.readers[:k]) for k in range(len(p.readers))]
         join_specs = []
         for ji, join in enumerate(p.joins):
             build_cap = shard(nrows[ji + 1])
@@ -772,7 +873,7 @@ class MPPGatherExec:
             kb = []
             for lp, rp in join.eq:
                 lb = all_bounds[lp] if lp < len(all_bounds) else None
-                rb = all_bounds[schema_base[ji + 1] + rp]
+                rb = bounds_by_reader[ji + 1][rp]
                 kb.append(
                     (min(lb[0], rb[0]), max(lb[1], rb[1])) if lb is not None and rb is not None else None
                 )
@@ -780,6 +881,7 @@ class MPPGatherExec:
                 DistJoinSpec(
                     left_keys=lane_eq_l,
                     right_keys=lane_eq_r,
+                    kind=join.kind,
                     exchange=join.exchange,
                     left_row_cap=probe_cap,
                     right_row_cap=build_cap,
@@ -788,7 +890,7 @@ class MPPGatherExec:
                     key_bounds=tuple(kb),
                 )
             )
-            if not join.unique:
+            if not join.unique and join.kind in ("inner", "left"):
                 probe_cap = join_specs[-1].out_cap
 
         # rebase left_keys of later joins: after join ji the accumulated lane
@@ -803,6 +905,11 @@ class MPPGatherExec:
         if agg is not None:
             nk = 2 * len(agg.group_by) if agg.group_by else 2
             sums_idx = list(range(nk, nk + 2 * sum(1 for a in agg.aggs if a.arg is not None)))
+            val_kinds = []
+            for a in agg.aggs:
+                if a.arg is not None:
+                    val_kinds.append(a.name if a.name in ("min", "max") else "sum")
+                    val_kinds.append("sum")  # the validity/count lane
             # group-key lanes interleave (data, valid); bounded data lanes
             # let the fragment pack the whole group key into one narrow sort
             if agg.group_by:
@@ -814,7 +921,13 @@ class MPPGatherExec:
                 agg_kb = [(0, 0), (1, 1)]  # synthetic constant group key
         while True:
             spec = (
-                DistAggSpec(n_keys=nk, sums=sums_idx, group_cap=group_cap, key_bounds=tuple(agg_kb))
+                DistAggSpec(
+                    n_keys=nk,
+                    sums=sums_idx,
+                    group_cap=group_cap,
+                    key_bounds=tuple(agg_kb),
+                    val_kinds=tuple(val_kinds),
+                )
                 if agg is not None
                 else None
             )
@@ -965,6 +1078,11 @@ class MPPGatherExec:
             for pk in a.partial_kinds:
                 if pk == "count":
                     cols.append(Column(vcount.astype(np.int64), np.ones(live.sum(), bool), bigint_type(nullable=False)))
+                elif pk in ("min", "max"):
+                    ft = a.arg.ftype  # string extremes are host-only (codes
+                    # are identity, not order) — _agg_mpp_ok rejects them
+                    dt = np.float64 if ft.kind == TypeKind.FLOAT else np.int64
+                    cols.append(Column(vdata.astype(dt), vcount > 0, ft))
                 else:  # sum lane
                     ft = AggDesc("sum", a.arg).ftype
                     dt = np.float64 if ft.kind == TypeKind.FLOAT else np.int64

@@ -39,6 +39,9 @@ class DistAggSpec:
     sums: Sequence[int]
     group_cap: int = 256
     key_bounds: tuple = ()
+    # per ``sums`` PAIR (data+valid): "sum" | "min" | "max" — how the value
+    # lane reduces within a group (and re-reduces across the exchange)
+    val_kinds: tuple = ()
 
 
 def _pack_keys(jnp, keys, bounds):
@@ -68,7 +71,7 @@ def _pack_keys(jnp, keys, bounds):
     return acc, total
 
 
-def _segment_partial(jnp, keys, vals, mask, cap, bounds=()):
+def _segment_partial(jnp, keys, vals, mask, cap, bounds=(), val_kinds=()):
     """Sort-based grouped partial agg on one shard (same algorithm as
     ops/dag_kernel.py — key-exact, no hash collisions). Returns
     (keys, sums, counts, overflow): ``overflow`` counts distinct groups
@@ -116,9 +119,28 @@ def _segment_partial(jnp, keys, vals, mask, cap, bounds=()):
     for k in keys:
         out_keys.append(jnp.where(slot_live, k[perm][starts_c], 0))
     out_sums = []
-    for v in vals:
+    for vi, v in enumerate(vals):
+        kind = val_kinds[vi] if vi < len(val_kinds) else "sum"
         vs = v[perm]
-        out_sums.append(_csum_delta(jnp.where(sm, vs, 0)))
+        if kind in ("min", "max"):
+            # segmented running extreme over the sorted rows (log-doubling —
+            # see window_core._seg_running for why not associative_scan),
+            # gathered at each group's last row
+            import jax as _jax
+
+            from tidb_tpu.ops.window_core import _seg_running
+
+            if jnp.issubdtype(vs.dtype, jnp.floating):
+                sent = jnp.inf if kind == "min" else -jnp.inf
+            else:
+                sent = (jnp.iinfo(jnp.int64).max if kind == "min" else jnp.iinfo(jnp.int64).min)
+            lane = jnp.where(sm, vs, sent)
+            seg_ps = _jax.lax.cummax(jnp.where(boundary, jnp.arange(n, dtype=jnp.int32), -1))
+            op = jnp.minimum if kind == "min" else jnp.maximum
+            run = _seg_running(_jax, jnp, lane, seg_ps, op, n)
+            out_sums.append(jnp.where(slot_live, run[ends_c], 0))
+        else:
+            out_sums.append(_csum_delta(jnp.where(sm, vs, 0)))
     return out_keys, out_sums, cnt, overflow  # slot i valid iff cnt[i] > 0
 
 
@@ -176,6 +198,9 @@ class DistJoinSpec:
 
     left_keys: Sequence[int]
     right_keys: Sequence[int]
+    # inner | left | semi | anti (ref: mpp_exec.go join types; outer fills
+    # NULL build lanes, semi/anti filter the probe and append nothing)
+    kind: str = "inner"
     exchange: str = "hash"  # hash | broadcast
     row_cap: int = 4096
     left_row_cap: int | None = None
@@ -291,19 +316,25 @@ def _sorted_bounds(jnp, rk_s, lkey):
 
 
 def _local_expand_join(jax, jnp, lkey, lkeys, lvalid, rkey, rkeys, rcols, rvalid, lcols, out_cap,
-                       dead_build=None, dead_probe=None):
+                       dead_build=None, dead_probe=None, left_outer=False, lmatch=None):
     """Per-shard equi-join with a NON-unique build side: each probe row
     expands to its match count. Output is ``out_cap`` static slots; slot j
     maps back to (probe row, match ordinal) through a cumsum of per-probe
-    match counts — pure gathers, no scatter (TPU policy). Returns
-    (probe-lane outputs, build-lane outputs, live, overflow)."""
+    match counts — pure gathers, no scatter (TPU policy). ``left_outer``:
+    matchless probe rows still emit ONE slot with the build lanes zeroed
+    (NULL-extended); ``lmatch`` narrows which live probes may MATCH (NULL-key
+    rows emit but never match). Returns (probe-lane outputs, build-lane
+    outputs, live, overflow)."""
     big = jnp.int64(2**62) if dead_build is None else dead_build
     big_p = big - 1 if dead_probe is None else dead_probe
+    if lmatch is None:
+        lmatch = lvalid
     rperm = jnp.argsort(jnp.where(rvalid, rkey, big))
     rk_s = jnp.where(rvalid, rkey, big)[rperm]
-    pkey = jnp.where(lvalid, lkey, big_p)  # dead probes match nothing
+    pkey = jnp.where(lmatch, lkey, big_p)  # dead/NULL-key probes match nothing
     lo, hi = _sorted_bounds(jnp, rk_s, pkey)
-    cnt = jnp.where(lvalid, hi - lo, 0)
+    mcnt = jnp.where(lmatch, hi - lo, 0)  # true match count per probe
+    cnt = jnp.where(lvalid & (mcnt == 0), 1, mcnt) if left_outer else mcnt
     cum = jnp.cumsum(cnt)
     total = cum[-1] if cnt.shape[0] else jnp.int64(0)
     overflow = jnp.maximum(total - out_cap, 0)
@@ -312,14 +343,33 @@ def _local_expand_join(jax, jnp, lkey, lkeys, lvalid, rkey, rkeys, rcols, rvalid
     p_c = jnp.clip(p, 0, cnt.shape[0] - 1)
     base = jnp.where(p_c > 0, cum[jnp.maximum(p_c - 1, 0)], 0)
     ridx = jnp.clip(lo[p_c] + (j - base), 0, rk_s.shape[0] - 1)
-    live = (j < total) & lvalid[p_c] & rvalid[rperm][ridx]
+    matched = (j < total) & lmatch[p_c] & (mcnt[p_c] > 0) & rvalid[rperm][ridx]
     # exact component verification: a mixed-key collision inside [lo, hi)
     # kills the slot rather than fabricating a joined row
     for lcomp, rcomp in zip(lkeys, rkeys):
-        live &= rcomp[rperm][ridx] == lcomp[p_c]
+        matched &= rcomp[rperm][ridx] == lcomp[p_c]
     out_left = [lc[p_c] for lc in lcols]
-    out_right = [rc[rperm][ridx] for rc in rcols]
+    if left_outer:
+        live = (j < total) & lvalid[p_c]
+        out_right = [jnp.where(matched, rc[rperm][ridx], 0) for rc in rcols]
+    else:
+        live = matched
+        out_right = [rc[rperm][ridx] for rc in rcols]
     return out_left, out_right, live, overflow
+
+
+def _local_match_counts(jax, jnp, lkey, lkeys, lvalid, rkey, rkeys, rvalid, dead_build=None, dead_probe=None):
+    """Per-probe match count against the build side (semi/anti joins need no
+    expansion — just existence). Exact for single-component or packed keys;
+    for mixed multi-key hashes a count>0 may be a collision, so callers only
+    get this path when keys are packed or single."""
+    big = jnp.int64(2**62) if dead_build is None else dead_build
+    big_p = big - 1 if dead_probe is None else dead_probe
+    rperm = jnp.argsort(jnp.where(rvalid, rkey, big))
+    rk_s = jnp.where(rvalid, rkey, big)[rperm]
+    pkey = jnp.where(lvalid, lkey, big_p)
+    lo, hi = _sorted_bounds(jnp, rk_s, pkey)
+    return jnp.where(lvalid, hi - lo, 0)
 
 
 @dataclass
@@ -382,10 +432,6 @@ def build_dist_pipeline(
             rvalid = jnp.ones(rcols[0].shape[0], dtype=bool)
             if selections[ji + 1] is not None:
                 rvalid = selections[ji + 1](*rcols)
-            for vl in join.left_key_valid:
-                mask = mask & acc[vl].astype(bool)
-            for vl in join.right_key_valid:
-                rvalid = rvalid & rcols[vl].astype(bool)
             kb = tuple(join.key_bounds) if join.key_bounds else None
 
             def join_lane(comps, _kb=kb):
@@ -394,12 +440,22 @@ def build_dist_pipeline(
                     return _combine_keys(jnp, comps), None
                 return p
 
+            kind = join.kind
             lkeys = [acc[i] for i in join.left_keys]
             rkeys = [rcols[i] for i in join.right_keys]
+            # probe rows with NULL keys: inner/semi joins drop them up front;
+            # left joins must keep them (NULL-extended), anti joins must keep
+            # them (a NULL key matches nothing)
+            lkv = jnp.ones(mask.shape[0], dtype=bool)
+            for vl in join.left_key_valid:
+                lkv = lkv & acc[vl].astype(bool)
+            if kind in ("inner", "semi"):
+                mask = mask & lkv
             lkey, ncodes = join_lane(lkeys)
             rkey, _ = join_lane(rkeys)
             if join.exchange == "hash":
-                lowner = jnp.abs(lkey).astype(jnp.int64) % ndev
+                # NULL-key survivors route to shard 0 (they match nothing)
+                lowner = jnp.where(lkv, jnp.abs(lkey).astype(jnp.int64) % ndev, 0)
                 rowner = jnp.abs(rkey).astype(jnp.int64) % ndev
                 lcap = join.left_row_cap or join.row_cap
                 rcap = join.right_row_cap or join.row_cap
@@ -408,6 +464,9 @@ def build_dist_pipeline(
                 dropped = dropped + d1 + d2
                 lkeys = [acc[i] for i in join.left_keys]
                 rkeys = [rcols[i] for i in join.right_keys]
+                lkv = jnp.ones(mask.shape[0], dtype=bool)
+                for vl in join.left_key_valid:
+                    lkv = lkv & acc[vl].astype(bool)
                 lkey, ncodes = join_lane(lkeys)
                 rkey, _ = join_lane(rkeys)
             else:  # broadcast: replicate the build side on every shard
@@ -415,21 +474,40 @@ def build_dist_pipeline(
                 rvalid = jax.lax.all_gather(rvalid, "dp").reshape(-1)
                 rkeys = [rcols[i] for i in join.right_keys]
                 rkey, _ = join_lane(rkeys)
+            for vl in join.right_key_valid:
+                rvalid = rvalid & rcols[vl].astype(bool)
             # dead-row sentinels above every live key code (packed lanes stay
             # in their narrow dtype; mixed-hash lanes use the int64 bigs)
             dead_b = None if ncodes is None else ncodes + 1
             dead_p = None if ncodes is None else ncodes
-            if join.unique:
-                gathered, mask = _local_unique_join(
-                    jax, jnp, lkey, lkeys, mask, rkey, rkeys, rcols, rvalid, dead_b, dead_p
+            probe_live = mask & lkv  # rows eligible to match
+            if kind in ("semi", "anti") and not join.unique:
+                cnt = _local_match_counts(
+                    jax, jnp, lkey, lkeys, probe_live, rkey, rkeys, rvalid, dead_b, dead_p
                 )
-                acc = acc + gathered
+                mask = mask & (cnt > 0) if kind == "semi" else mask & (cnt == 0)
+            elif join.unique:
+                gathered, match = _local_unique_join(
+                    jax, jnp, lkey, lkeys, probe_live, rkey, rkeys, rcols, rvalid, dead_b, dead_p
+                )
+                if kind == "inner":
+                    mask = match
+                    acc = acc + gathered
+                elif kind == "left":
+                    # NULL-extend the build lanes for matchless probe rows
+                    acc = acc + [jnp.where(match, g, 0) for g in gathered]
+                elif kind == "semi":
+                    mask = match
+                else:  # anti
+                    mask = mask & ~match
             else:
-                out_l, out_r, mask, of = _local_expand_join(
-                    jax, jnp, lkey, lkeys, mask, rkey, rkeys, rcols, rvalid, acc, join.out_cap,
-                    dead_b, dead_p
+                out_l, out_r, newmask, of = _local_expand_join(
+                    jax, jnp, lkey, lkeys, probe_live if kind == "inner" else mask, rkey, rkeys,
+                    rcols, rvalid, acc, join.out_cap, dead_b, dead_p,
+                    left_outer=(kind == "left"), lmatch=probe_live
                 )
                 overflow = overflow + of
+                mask = newmask
                 acc = out_l + out_r
         if agg is not None:
             return _agg_tail(acc, mask, dropped, overflow)
@@ -473,7 +551,7 @@ def build_dist_pipeline(
         acols = agg_inputs(joined) if agg_inputs is not None else joined
         keys = list(acols[: agg.n_keys])
         vals = [acols[i] for i in agg.sums]
-        pkeys, psums, pcnt, of1 = _segment_partial(jnp, keys, vals, mask, cap, agg.key_bounds)
+        pkeys, psums, pcnt, of1 = _segment_partial(jnp, keys, vals, mask, cap, agg.key_bounds, agg.val_kinds)
         h = _combine_keys(jnp, pkeys)
         owner = jnp.where(pcnt > 0, jnp.abs(h) % ndev, ndev - 1)
         order = jnp.argsort(owner, stable=True)
@@ -494,7 +572,7 @@ def build_dist_pipeline(
         rxkeys = [exchange(bucketize(k)) for k in pkeys]
         rxsums = [exchange(bucketize(s)) for s in psums]
         rxcnt = exchange(bucketize(pcnt))
-        mkeys, msums_cnt, _, of3 = _segment_partial(jnp, rxkeys, rxsums + [rxcnt], rxcnt > 0, cap, agg.key_bounds)
+        mkeys, msums_cnt, _, of3 = _segment_partial(jnp, rxkeys, rxsums + [rxcnt], rxcnt > 0, cap, agg.key_bounds, tuple(agg.val_kinds) + ("sum",))
         gkeys = [jax.lax.all_gather(k, "dp").reshape(ndev * cap) for k in mkeys]
         gsums = [jax.lax.all_gather(s, "dp").reshape(ndev * cap) for s in msums_cnt[:-1]]
         gcnt = jax.lax.all_gather(msums_cnt[-1], "dp").reshape(ndev * cap)

@@ -910,7 +910,7 @@ class Session:
         plan = optimize(logical, engines, stats=self._db.stats)
         from tidb_tpu.parallel.gather import try_mpp_rewrite
 
-        plan = try_mpp_rewrite(plan, self.vars, stats=self._db.stats)
+        plan = try_mpp_rewrite(plan, self.vars, stats=self._db.stats, store=self.store)
         if key is not None and not builder.uncacheable:
             self._plan_cache[key] = plan
             cap = int(self.vars.get("tidb_prepared_plan_cache_size", 100))
@@ -1203,7 +1203,10 @@ class DB:
         tidb_gc_life_time global (seconds)."""
         life_s = float(self.global_vars.get("tidb_gc_life_time", DEFAULT_SYSVARS["tidb_gc_life_time"]))
         if hasattr(self.store, "run_gc"):  # remote-backed: GC where the data lives
-            return self.store.run_gc(safe_point, life_ms=int(life_s * 1000))
+            pruned, sp = self.store.run_gc(safe_point, life_ms=int(life_s * 1000))
+            # dropped-table snapshots past the safe point are gone server-side
+            self.catalog.purge_recycle_bin(sp)
+            return pruned
         self.gc_worker.life_ms = int(life_s * 1000)
         pruned = self.gc_worker.run_once(safe_point)
         # dropped-table snapshots become unrecoverable past the safe point
