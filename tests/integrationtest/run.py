@@ -18,6 +18,20 @@ import sys
 HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(os.path.dirname(HERE)))  # repo root
 
+# goldens are environment-pinned to the hermetic test mesh (tests/conftest.py):
+# plan shapes like the MPP exchange choice depend on the device count, so the
+# recorder must match the pytest runner exactly
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except RuntimeError:
+    pass
+jax.config.update("jax_enable_x64", True)
+
 
 def _statements(text: str):
     """Yield (directives, sql) pairs."""

@@ -375,8 +375,17 @@ def _isnull(xp, args, ctx):
     return (~v).astype("int64"), None
 
 
+def _string_rows(ctx, i):
+    """Decoded (bytes|None) per row for arg i (see _decode_strs below)."""
+    return _decode_strs(ctx, i)[0]
+
+
 @register("ifnull", infer_merge)
 def _ifnull(xp, args, ctx):
+    if ctx.ret_type.kind == TypeKind.STRING and xp.__name__.startswith("numpy"):
+        a = _string_rows(ctx, 0)
+        b = _string_rows(ctx, 1)
+        return _encode_strs(ctx, [x if x is not None else y for x, y in zip(a, b)])
     (da, va), (db, vb) = args
     if va is None:
         return da, None
@@ -385,6 +394,12 @@ def _ifnull(xp, args, ctx):
 
 @register("coalesce", infer_merge, variadic=True)
 def _coalesce(xp, args, ctx):
+    if ctx.ret_type.kind == TypeKind.STRING and xp.__name__.startswith("numpy"):
+        rows = [_string_rows(ctx, i) for i in range(len(args))]
+        out = []
+        for tup in zip(*rows):
+            out.append(next((x for x in tup if x is not None), None))
+        return _encode_strs(ctx, out)
     out_d, out_v = args[-1]
     for (d, v) in reversed(args[:-1]):
         if v is None:
@@ -400,6 +415,13 @@ def _coalesce(xp, args, ctx):
 @register("if", lambda args: infer_merge(args[1:]), variadic=True, arity=3)
 def _if(xp, args, ctx):
     (dc, vc), (da, va), (db, vb) = args
+    if ctx.ret_type.kind == TypeKind.STRING and xp.__name__.startswith("numpy"):
+        import numpy as _np
+
+        cond = _np.broadcast_to(_np.asarray((dc != 0) if vc is None else ((dc != 0) & vc)), (ctx.n,))
+        a = _string_rows(ctx, 1)
+        b = _string_rows(ctx, 2)
+        return _encode_strs(ctx, [x if c else y for c, x, y in zip(cond, a, b)])
     cond = (dc != 0) if vc is None else ((dc != 0) & vc)
     data = xp.where(cond, da, db)
     if va is None and vb is None:
@@ -409,10 +431,56 @@ def _if(xp, args, ctx):
     return data, xp.where(cond, va_, vb_)
 
 
-@register("case_when", infer_merge, variadic=True)
+@register("nulleq", infer_bool, arity=2)
+def _nulleq(xp, args, ctx):
+    """<=> NULL-safe equality: never NULL; NULL <=> NULL is 1. The value
+    comparison routes through the same coercion/dictionary machinery as
+    ``=`` — only the NULL handling differs."""
+    (da, va), (db, vb) = args
+    eq_d, eq_v = _cmp(xp, ctx, lambda a, b: a == b, "eq")
+    null_a = xp.zeros(ctx.n, bool) if va is None else ~xp.broadcast_to(xp.asarray(va), (ctx.n,))
+    null_b = xp.zeros(ctx.n, bool) if vb is None else ~xp.broadcast_to(xp.asarray(vb), (ctx.n,))
+    eq = xp.broadcast_to(xp.asarray(eq_d) != 0, (ctx.n,))
+    if eq_v is not None and eq_v is not True:
+        eq = eq & xp.broadcast_to(xp.asarray(eq_v), (ctx.n,))
+    out = xp.where(null_a | null_b, null_a & null_b, eq)
+    return out.astype(xp.int64), None
+
+
+def _infer_case(args):
+    # the result type merges the VALUE arms only — conditions are boolean
+    has_else = len(args) % 2 == 1
+    vals = [args[i] for i in range(1, len(args) - (1 if has_else else 0), 2)]
+    if has_else:
+        vals.append(args[-1])
+    return infer_merge(vals) if vals else args[0]
+
+
+@register("case_when", _infer_case, variadic=True)
 def _case_when(xp, args, ctx):
     """args: cond1, val1, cond2, val2, ..., [else_val]."""
     has_else = len(args) % 2 == 1
+    if ctx.ret_type.kind == TypeKind.STRING and xp.__name__.startswith("numpy"):
+        import numpy as _np
+
+        n = ctx.n
+        conds = []
+        vals = []
+        for i in range(0, len(args) - (1 if has_else else 0), 2):
+            dc, vc = args[i]
+            c = _np.broadcast_to(_np.asarray((dc != 0) if vc is None else ((dc != 0) & vc)), (n,))
+            conds.append(c)
+            vals.append(_string_rows(ctx, i + 1))
+        els = _string_rows(ctx, len(args) - 1) if has_else else [None] * n
+        out = []
+        for r in range(n):
+            chosen = els[r]
+            for c, vv in zip(conds, vals):
+                if c[r]:
+                    chosen = vv[r]
+                    break
+            out.append(chosen)
+        return _encode_strs(ctx, out)
     if has_else:
         out_d, out_v = args[-1]
         pairs = args[:-1]
@@ -625,6 +693,76 @@ def _month(xp, args, ctx):
     return m, v
 
 
+def _fold_extreme(xp, ctx, op):
+    """GREATEST/LEAST: normalize every operand to the merged result type's
+    physical representation (decimal scales / float conversion), then fold.
+    MySQL yields NULL when any argument is NULL."""
+    rft = ctx.ret_type
+    if rft.kind == TypeKind.STRING:
+        rows = [_string_rows(ctx, i) for i in range(len(ctx.args))]
+        pick = max if op is xp.maximum else min
+        out = []
+        for tup in zip(*rows):
+            out.append(None if any(x is None for x in tup) else pick(tup))
+        return _encode_strs(ctx, out)
+    d, v = None, None
+    for i, (dd, vv) in enumerate(ctx.args):
+        ft = ctx.arg_types[i]
+        dd = xp.asarray(dd)
+        if rft.kind == TypeKind.FLOAT:
+            dd = dd / (10.0 ** ft.scale) if ft.kind == TypeKind.DECIMAL else dd * 1.0
+        elif rft.kind == TypeKind.DECIMAL:
+            ds = ft.scale if ft.kind == TypeKind.DECIMAL else 0
+            dd = dd * (10 ** (rft.scale - ds))
+        if d is None:
+            d, v = dd, vv
+        else:
+            d = op(d, dd)
+            v = and_valid(xp, v, vv)
+    return d, v
+
+
+@register("greatest", infer_merge, variadic=True, arity=2)
+def _greatest(xp, args, ctx):
+    return _fold_extreme(xp, ctx, xp.maximum)
+
+
+@register("least", infer_merge, variadic=True, arity=2)
+def _least(xp, args, ctx):
+    return _fold_extreme(xp, ctx, xp.minimum)
+
+
+@register("truncate", lambda args: args[0], arity=2)
+def _truncate(xp, args, ctx):
+    (d, v), (nd, nv) = args
+    ft = ctx.arg_types[0]
+    k = int(nd if not hasattr(nd, "__len__") else nd[0])
+    def _trunc_step(a, step):
+        # truncation is toward ZERO (floor division would round negatives
+        # away from zero): sign * (|a| // step * step)
+        a = xp.asarray(a)
+        return xp.sign(a) * (xp.abs(a) // step * step)
+
+    if ft.kind == TypeKind.DECIMAL:
+        # physical is scale-s int: zero out digits below 10^(s-k)
+        step = 10 ** max(ft.scale - k, 0)
+        q = _trunc_step(d, step) if step > 1 else xp.asarray(d)
+        return q, and_valid(xp, v, nv)
+    if ft.kind == TypeKind.FLOAT:
+        m = 10.0 ** k
+        return xp.trunc(xp.asarray(d) * m) / m, and_valid(xp, v, nv)
+    if k >= 0:
+        return d, and_valid(xp, v, nv)
+    return _trunc_step(d, 10 ** (-k)), and_valid(xp, v, nv)
+
+
+@register("quarter", lambda args: bigint_type(), arity=1)
+def _quarter(xp, args, ctx):
+    d, v = _days_arg(xp, ctx, 0)
+    _, m, _ = _civil_from_days(xp, d)
+    return (m + 2) // 3, v
+
+
 @register("dayofmonth", lambda args: bigint_type(), arity=1)
 def _dayofmonth(xp, args, ctx):
     d, v = _days_arg(xp, ctx, 0)
@@ -676,13 +814,24 @@ def _decode_strs(ctx, i):
     dic = ctx.arg_dicts[i]
     import numpy as np
 
+    from tidb_tpu.types.datum import format_physical
+
+    ft = ctx.arg_types[i]
     n = len(d) if hasattr(d, "__len__") else ctx.n
     out = []
     for k in range(n):
         if v is not None and v is not True and not (v if isinstance(v, bool) else v[k]):
             out.append(None)
+            continue
+        x = d if not hasattr(d, "__len__") else d[k]
+        if dic is not None:
+            out.append(dic.decode(int(x)))
+        elif ft.kind == TypeKind.STRING:
+            # string-valued but dictionary-less (e.g. folded constants)
+            out.append(x if isinstance(x, bytes) else str(x).encode())
         else:
-            out.append(dic.decode(int(d if not hasattr(d, "__len__") else d[k])))
+            # non-string operand: MySQL coerces to its string form
+            out.append(format_physical(x, ft))
     return out, v
 
 
@@ -1128,6 +1277,59 @@ def _maketime(xp, args, ctx):
     (h, vh), (m, vm), (s, vs) = args
     us = (xp.abs(h) * 3600 + m * 60 + s) * 1_000_000
     return xp.where(h < 0, -us, us), and_valid(xp, vh, vm, vs)
+
+
+# -- scalar bit operators (ref: builtin_op.go bit builtins; MySQL returns
+# BIGINT UNSIGNED — the UINT kind renders wrapped int64 physicals unsigned) --
+
+
+def _uint_ft(args):
+    return FieldType(TypeKind.UINT, nullable=any(a.nullable for a in args))
+
+
+@register("bitand", _uint_ft, arity=2)
+def _bitand(xp, args, ctx):
+    (da, va), (db, vb) = args
+    return xp.asarray(da).astype(xp.int64) & xp.asarray(db).astype(xp.int64), and_valid(xp, va, vb)
+
+
+@register("bitor", _uint_ft, arity=2)
+def _bitor(xp, args, ctx):
+    (da, va), (db, vb) = args
+    return xp.asarray(da).astype(xp.int64) | xp.asarray(db).astype(xp.int64), and_valid(xp, va, vb)
+
+
+@register("bitxor", _uint_ft, arity=2)
+def _bitxor(xp, args, ctx):
+    (da, va), (db, vb) = args
+    return xp.asarray(da).astype(xp.int64) ^ xp.asarray(db).astype(xp.int64), and_valid(xp, va, vb)
+
+
+@register("bitneg", _uint_ft, arity=1)
+def _bitneg(xp, args, ctx):
+    (d, v) = args[0]
+    return ~xp.asarray(d).astype(xp.int64), v
+
+
+def _shift(xp, da, db, left: bool):
+    a = xp.asarray(da).astype(xp.int64)
+    b = xp.asarray(db).astype(xp.int64)
+    safe = xp.clip(b, 0, 63)
+    out = (a << safe) if left else ((a.astype(xp.uint64) >> safe.astype(xp.uint64)).astype(xp.int64))
+    # MySQL: shifts outside [0, 64) yield 0 (operands are 64-bit unsigned)
+    return xp.where((b < 0) | (b >= 64), 0, out)
+
+
+@register("shl", _uint_ft, arity=2)
+def _shl(xp, args, ctx):
+    (da, va), (db, vb) = args
+    return _shift(xp, da, db, True), and_valid(xp, va, vb)
+
+
+@register("shr", _uint_ft, arity=2)
+def _shr(xp, args, ctx):
+    (da, va), (db, vb) = args
+    return _shift(xp, da, db, False), and_valid(xp, va, vb)
 
 
 _DATETIME_LIKE = (TypeKind.DATETIME, TypeKind.DATE)

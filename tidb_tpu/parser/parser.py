@@ -489,22 +489,30 @@ class Parser:
         return left
 
     def _multiplicative(self) -> ast.Node:
-        left = self._unary()
+        left = self._bitxor()
         while True:
             if self.at_op("*"):
                 self.next()
-                left = ast.BinaryOp("mul", left, self._unary())
+                left = ast.BinaryOp("mul", left, self._bitxor())
             elif self.at_op("/"):
                 self.next()
-                left = ast.BinaryOp("div", left, self._unary())
+                left = ast.BinaryOp("div", left, self._bitxor())
             elif self.at_op("%") or self.at_kw("MOD"):
                 self.next()
-                left = ast.BinaryOp("mod", left, self._unary())
+                left = ast.BinaryOp("mod", left, self._bitxor())
             elif self.at_kw("DIV"):
                 self.next()
-                left = ast.BinaryOp("intdiv", left, self._unary())
+                left = ast.BinaryOp("intdiv", left, self._bitxor())
             else:
                 return left
+
+    def _bitxor(self) -> ast.Node:
+        # MySQL: ^ binds tighter than * (and looser than unary)
+        left = self._unary()
+        while self.at_op("^"):
+            self.next()
+            left = ast.BinaryOp("bitxor", left, self._unary())
+        return left
 
     def _postfix_json(self, e: ast.Node) -> ast.Node:
         """col -> '$.path' and col ->> '$.path' (ref: JSON column paths)."""

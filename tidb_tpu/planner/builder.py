@@ -65,9 +65,9 @@ _FN_ALIAS = {
 # builtins whose first argument is a date/datetime (string literals coerce —
 # else dictionary codes would be read as day counts) or a time
 _DATE_ARG0_FNS = {
-    "year", "month", "dayofmonth", "dayofweek", "weekday", "week", "dayofyear",
-    "to_days", "last_day", "date", "monthname", "dayname", "date_format",
-    "unix_timestamp",
+    "year", "month", "quarter", "dayofmonth", "dayofweek", "weekday", "week",
+    "dayofyear", "to_days", "last_day", "date", "monthname", "dayname",
+    "date_format", "unix_timestamp",
 }
 _TIME_ARG0_FNS = {"hour", "minute", "second", "time_to_sec"}
 
@@ -892,6 +892,8 @@ class Builder:
                 return func("not", self._resolve(node.operand, ctx))
             if node.op == "unaryminus":
                 return func("unaryminus", self._resolve(node.operand, ctx))
+            if node.op == "bitneg":
+                return func("bitneg", self._resolve(node.operand, ctx))
             raise PlanError(f"unsupported unary op {node.op}")
         if isinstance(node, ast.IsNull):
             e = func("isnull", self._resolve(node.operand, ctx))
