@@ -157,3 +157,60 @@ def test_binary_protocol_param_types(srv):
     sid2, _ = c.prepare("SELECT a, b FROM bt WHERE a = ? AND b < ?")
     assert c.execute(sid2, [-5, 3.0]) == [(-5, 2.25)]
     c.close()
+
+
+def test_caching_sha2_password_auth(srv):
+    """caching_sha2_password fast auth, incl. the auth-switch leg when the
+    client announces the wrong plugin (ref: conn.go auth-switch)."""
+    server, port = srv
+    root = Client(port=port)
+    root.query("CREATE USER 'sha2u'@'%' IDENTIFIED WITH 'caching_sha2_password' BY 'secret2'")
+    root.query("GRANT SELECT ON *.* TO 'sha2u'@'%'")
+    # right plugin announced up front
+    c = Client(port=port, user="sha2u", password="secret2", auth_plugin="caching_sha2_password")
+    assert c.query("SELECT 1 + 1") == [("2",)]
+    # wrong plugin announced → server sends AuthSwitchRequest
+    c2 = Client(port=port, user="sha2u", password="secret2")
+    assert c2.query("SELECT 2 + 2") == [("4",)]
+    import pytest as _pytest
+
+    with _pytest.raises(Exception, match="Access denied"):
+        Client(port=port, user="sha2u", password="wrong", auth_plugin="caching_sha2_password")
+
+
+def test_tls_roundtrip():
+    """Encrypted wire: SSLRequest upgrade, then normal auth + queries."""
+    import tidb_tpu
+    from tidb_tpu.server.server import Server
+
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE tlst (id BIGINT PRIMARY KEY, v VARCHAR(8))")
+    db.execute("INSERT INTO tlst VALUES (1, 'enc')")
+    server = Server(db, tls=True)
+    port = server.start()
+    try:
+        c = Client(port=port, tls=True)
+        assert c.tls
+        assert c.query("SELECT v FROM tlst WHERE id = 1") == [("enc",)]
+        # TLS + caching_sha2 combined
+        c.query("CREATE USER 'tu'@'%' IDENTIFIED WITH 'caching_sha2_password' BY 'pw9'")
+        c.query("GRANT SELECT ON *.* TO 'tu'@'%'")
+        c2 = Client(port=port, user="tu", password="pw9", tls=True, auth_plugin="caching_sha2_password")
+        assert c2.query("SELECT COUNT(*) FROM tlst") == [("1",)]
+        # plaintext clients still work against a TLS-capable server
+        c3 = Client(port=port)
+        assert c3.query("SELECT 5") == [("5",)]
+        # tls=True against a plaintext server fails with a CLEAR error
+        db2 = tidb_tpu.open()
+        plain = Server(db2)
+        pport = plain.start()
+        try:
+            try:
+                Client(port=pport, tls=True)
+                raise AssertionError("tls against plaintext server must fail")
+            except MySQLError as e:
+                assert "TLS" in str(e)
+        finally:
+            plain.close()
+    finally:
+        server.close()

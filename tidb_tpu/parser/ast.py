@@ -511,6 +511,7 @@ class UserSpec(Node):
     name: str
     host: str = "%"
     password: str = ""
+    plugin: str = "mysql_native_password"
 
 
 @dataclass

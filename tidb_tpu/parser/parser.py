@@ -1392,8 +1392,18 @@ class Parser:
                 host = self.ident()
         spec = ast.UserSpec(name, host)
         if self.eat_kw("IDENTIFIED"):
-            self.expect_kw("BY")
-            spec.password = self._string_lit()
+            if self.eat_kw("WITH"):
+                t = self.peek()
+                if t.kind == "str":
+                    self.next()
+                    spec.plugin = t.value.decode() if isinstance(t.value, bytes) else t.value
+                else:
+                    spec.plugin = self.ident()
+                if self.eat_kw("BY"):
+                    spec.password = self._string_lit()
+            else:
+                self.expect_kw("BY")
+                spec.password = self._string_lit()
         return spec
 
     def parse_create_user(self) -> ast.CreateUser:

@@ -6,6 +6,9 @@ from tidb_tpu.privilege.privileges import (
     PrivChecker,
     bootstrap_priv_tables,
     encode_password,
+    encode_password_with,
+    sha2_auth_token,
+    verify_sha2_password,
     native_auth_token,
     verify_native_password,
 )
@@ -15,6 +18,9 @@ __all__ = [
     "PrivChecker",
     "bootstrap_priv_tables",
     "encode_password",
+    "encode_password_with",
+    "sha2_auth_token",
+    "verify_sha2_password",
     "native_auth_token",
     "verify_native_password",
 ]

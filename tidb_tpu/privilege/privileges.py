@@ -35,6 +35,47 @@ def encode_password(pw: str) -> str:
     return "*" + _sha1(_sha1(pw.encode())).hex().upper()
 
 
+def _sha256(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+def encode_password_with(pw: str, plugin: str) -> str:
+    """→ mysql.user.authentication_string for the given auth plugin.
+    caching_sha2_password stores the fast-auth cache entry
+    SHA256(SHA256(password)) hex (a documented divergence from MySQL's
+    $A$-crypt storage: this build always fast-auths, never falling back to
+    the full RSA/plain exchange)."""
+    if plugin == "caching_sha2_password":
+        if not pw:
+            return ""
+        return "$2$" + _sha256(_sha256(pw.encode())).hex().upper()
+    return encode_password(pw)
+
+
+def sha2_auth_token(pw: str, nonce: bytes) -> bytes:
+    """Client side of caching_sha2_password fast auth:
+    XOR(SHA256(pw), SHA256(SHA256(SHA256(pw)) || nonce))."""
+    if not pw:
+        return b""
+    h1 = _sha256(pw.encode())
+    h2 = _sha256(h1)
+    mix = _sha256(h2 + nonce)
+    return bytes(a ^ b for a, b in zip(h1, mix))
+
+
+def verify_sha2_password(stored: str, token: bytes, nonce: bytes) -> bool:
+    """Server side: token XOR SHA256(cache || nonce) must SHA256 to the
+    stored cache entry (ref: caching_sha2 fast-auth verification)."""
+    if not stored:
+        return not token
+    if not token:
+        return False
+    cache = bytes.fromhex(stored[3:]) if stored.startswith("$2$") else b""
+    mix = _sha256(cache + nonce)
+    h1 = bytes(a ^ b for a, b in zip(token, mix))
+    return _sha256(h1) == cache
+
+
 def native_auth_token(pw: str, salt: bytes) -> bytes:
     """Client side: the 20-byte token sent in HandshakeResponse."""
     if not pw:
@@ -67,7 +108,7 @@ def bootstrap_priv_tables(db) -> None:
     priv_cols = ", ".join(f"{_PRIV_COL[p]} VARCHAR(1)" for p in ALL_PRIVS)
     s.execute(
         f"CREATE TABLE IF NOT EXISTS mysql.user (Host VARCHAR(255), User VARCHAR(32), "
-        f"authentication_string VARCHAR(64), {priv_cols})"
+        f"authentication_string VARCHAR(128), plugin VARCHAR(32), {priv_cols})"
     )
     s.execute(
         f"CREATE TABLE IF NOT EXISTS mysql.db (Host VARCHAR(255), DB VARCHAR(64), "
@@ -78,7 +119,7 @@ def bootstrap_priv_tables(db) -> None:
         "User VARCHAR(32), Table_name VARCHAR(64), Table_priv VARCHAR(255))"
     )
     ys = ", ".join(["'Y'"] * len(ALL_PRIVS))
-    s.execute(f"INSERT INTO mysql.user VALUES ('%', 'root', '', {ys})")
+    s.execute(f"INSERT INTO mysql.user VALUES ('%', 'root', '', 'mysql_native_password', {ys})")
     db.priv_version += 1
 
 
@@ -88,6 +129,7 @@ class _UserRec:
     user: str
     auth: str
     privs: set = field(default_factory=set)
+    plugin: str = "mysql_native_password"
 
 
 class PrivChecker:
@@ -108,8 +150,9 @@ class PrivChecker:
         users = []
         for row in s.query("SELECT * FROM mysql.user"):
             host, user, auth = row[0], row[1], row[2] or ""
-            privs = {p for p, v in zip(ALL_PRIVS, row[3:]) if v == "Y"}
-            users.append(_UserRec(host, user, auth, privs))
+            plugin = row[3] or "mysql_native_password"
+            privs = {p for p, v in zip(ALL_PRIVS, row[4:]) if v == "Y"}
+            users.append(_UserRec(host, user, auth, privs, plugin))
         dbp = []
         for row in s.query("SELECT * FROM mysql.db"):
             host, dbn, user = row[0], row[1], row[2]
@@ -138,6 +181,8 @@ class PrivChecker:
         u = self.find_user(user, host)
         if u is None:
             return False
+        if u.plugin == "caching_sha2_password":
+            return verify_sha2_password(u.auth, token, salt)
         return verify_native_password(u.auth, token, salt)
 
     def check(self, user: str, host: str, db: str, table: str, priv: str) -> bool:

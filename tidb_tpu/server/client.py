@@ -17,12 +17,32 @@ class MySQLError(Exception):
 
 
 class Client:
-    def __init__(self, host: str = "127.0.0.1", port: int = 4000, user: str = "root", password: str = "", db: str = ""):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 4000,
+        user: str = "root",
+        password: str = "",
+        db: str = "",
+        tls: bool = False,
+        auth_plugin: str = "mysql_native_password",
+    ):
         self.sock = socket.create_connection((host, port), timeout=30)
         self.io = p.PacketIO(self.sock)
-        self._handshake(user, password, db)
+        self.tls = False
+        self._handshake(user, password, db, tls, auth_plugin)
 
-    def _handshake(self, user: str, password: str, db: str) -> None:
+    @staticmethod
+    def _token_for(plugin: str, password: str, nonce: bytes) -> bytes:
+        if plugin == "caching_sha2_password":
+            from tidb_tpu.privilege import sha2_auth_token
+
+            return sha2_auth_token(password, nonce)
+        from tidb_tpu.privilege import native_auth_token
+
+        return native_auth_token(password, nonce)
+
+    def _handshake(self, user: str, password: str, db: str, tls: bool, auth_plugin: str) -> None:
         greeting = self.io.read()
         assert greeting[0] == 10, "unexpected protocol version"
         # salt = 8 bytes after ver+thread_id, then 12 more past the caps block
@@ -30,23 +50,48 @@ class Client:
         salt1 = greeting[off : off + 8]
         off2 = off + 8 + 1 + 2 + 1 + 2 + 2 + 1 + 10
         salt2 = greeting[off2 : off2 + 12]
-        from tidb_tpu.privilege import native_auth_token
-
-        token = native_auth_token(password, salt1 + salt2)
+        nonce = salt1 + salt2
         caps = p.CLIENT_PROTOCOL_41 | p.CLIENT_SECURE_CONNECTION | p.CLIENT_PLUGIN_AUTH
         if db:
             caps |= p.CLIENT_CONNECT_WITH_DB
+        if tls:
+            import ssl
+
+            srv_caps_lo = struct.unpack_from("<H", greeting, off2 - 1 - 2 - 1 - 2 - 2 - 10)[0]
+            if not srv_caps_lo & p.CLIENT_SSL:
+                raise MySQLError(2026, "server does not support TLS")
+
+            caps |= p.CLIENT_SSL
+            # SSLRequest leg, then wrap the socket (self-signed test certs:
+            # no verification, like --ssl-mode=REQUIRED without CA pinning)
+            self.io.write(struct.pack("<IIB", caps, 1 << 24, 33) + b"\x00" * 23)
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            self.sock = ctx.wrap_socket(self.sock)
+            self.io.sock = self.sock
+            self.tls = True
+        token = self._token_for(auth_plugin, password, nonce)
         resp = (
             struct.pack("<IIB", caps, 1 << 24, 33)
             + b"\x00" * 23
             + user.encode() + b"\x00"
             + bytes([len(token)]) + token
             + ((db.encode() + b"\x00") if db else b"")
-            + b"mysql_native_password\x00"
+            + auth_plugin.encode() + b"\x00"
         )
         self.io.write(resp)
         pkt = self.io.read()
-        if pkt[0] == 0xFF:
+        if pkt and pkt[0] == 0xFE and len(pkt) > 1:
+            # AuthSwitchRequest: plugin name NUL nonce NUL
+            end = pkt.index(b"\x00", 1)
+            plugin = pkt[1:end].decode()
+            new_nonce = pkt[end + 1 :].rstrip(b"\x00")
+            self.io.write(self._token_for(plugin, password, new_nonce))
+            pkt = self.io.read()
+        if pkt and pkt[0] == 0x01:  # AuthMoreData (sha2 fast-auth success)
+            pkt = self.io.read()
+        if pkt and pkt[0] == 0xFF:
             raise self._err(pkt)
 
     def _err(self, pkt: bytes) -> MySQLError:

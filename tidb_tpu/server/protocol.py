@@ -11,6 +11,7 @@ from typing import Optional
 CLIENT_LONG_PASSWORD = 0x1
 CLIENT_PROTOCOL_41 = 0x200
 CLIENT_CONNECT_WITH_DB = 0x8
+CLIENT_SSL = 0x800
 CLIENT_SECURE_CONNECTION = 0x8000
 CLIENT_PLUGIN_AUTH = 0x80000
 CLIENT_DEPRECATE_EOF = 0x1000000

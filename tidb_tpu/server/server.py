@@ -14,6 +14,14 @@ from typing import Optional
 from tidb_tpu.server import protocol as p
 
 
+def _nonce() -> bytes:
+    """20-byte NUL-free auth nonce (clients parse the greeting's salt halves
+    positionally, but NULs would break drivers that scan for terminators)."""
+    import os as _os
+
+    return bytes((b % 255) + 1 for b in _os.urandom(20))
+
+
 class ClientConn:
     def __init__(self, server: "Server", sock, conn_id: int):
         self.server = server
@@ -32,19 +40,18 @@ class ClientConn:
 
     # -- handshake (protocol v10) ------------------------------------------
     def handshake(self, io: p.PacketIO) -> bool:
-        import os as _os
-
-        salt = _os.urandom(20)
+        salt = _nonce()
+        caps_adv = p.SERVER_CAPS | (p.CLIENT_SSL if self.server.tls_ctx else 0)
         pkt = (
             bytes([10])
             + b"8.0.11-tidb-tpu\x00"
             + struct.pack("<I", self.conn_id)
             + salt[:8]
             + b"\x00"
-            + struct.pack("<H", p.SERVER_CAPS & 0xFFFF)
+            + struct.pack("<H", caps_adv & 0xFFFF)
             + bytes([33])  # utf8_general_ci
             + struct.pack("<H", 2)  # status: autocommit
-            + struct.pack("<H", (p.SERVER_CAPS >> 16) & 0xFFFF)
+            + struct.pack("<H", (caps_adv >> 16) & 0xFFFF)
             + bytes([21])
             + b"\x00" * 10
             + salt[8:] + b"\x00"
@@ -53,6 +60,18 @@ class ClientConn:
         io.write(pkt)
         resp = io.read()
         caps = struct.unpack_from("<I", resp, 0)[0]
+        if caps & p.CLIENT_SSL and len(resp) <= 32:
+            # SSLRequest: upgrade the raw socket to TLS, then redo the
+            # response read over the encrypted channel (ref: conn.go TLS
+            # upgrade on the same sequence numbering)
+            if self.server.tls_ctx is None:
+                io.write(p.err_packet(1045, "TLS not enabled on this server", "28000"))
+                return False
+            self.sock = self.server.tls_ctx.wrap_socket(self.sock, server_side=True)
+            io.sock = self.sock
+            resp = io.read()
+            caps = struct.unpack_from("<I", resp, 0)[0]
+            self.tls = True
         off = 4 + 4 + 1 + 23
         end = resp.index(b"\x00", off)
         self.user = resp[off:end].decode()
@@ -65,22 +84,38 @@ class ClientConn:
             end = resp.index(b"\x00", off)
             token = resp[off:end]
             off = end + 1
-        # mysql_native_password verification against mysql.user
-        # (ref: privilege.ConnectionVerification)
+        db_off = off
+        client_plugin = "mysql_native_password"
+        if caps & p.CLIENT_CONNECT_WITH_DB and off < len(resp):
+            end = resp.index(b"\x00", off)
+            db_off, off = off, end + 1  # remembered for the db-select below
+        if caps & p.CLIENT_PLUGIN_AUTH and off < len(resp) and b"\x00" in resp[off:]:
+            end = resp.index(b"\x00", off)
+            client_plugin = resp[off:end].decode() or client_plugin
+        # per-user plugin dispatch with AuthSwitch when the client guessed
+        # wrong (ref: conn.go auth-switch handling)
         checker = self.server.db.priv_checker
+        u = checker.find_user(self.user, "127.0.0.1")
+        want = u.plugin if u is not None else "mysql_native_password"
+        if u is not None and client_plugin != want:
+            salt = _nonce()
+            io.write(bytes([0xFE]) + want.encode() + b"\x00" + salt + b"\x00")
+            token = io.read()
         if not checker.auth(self.user, "127.0.0.1", token, salt):
             io.write(
                 p.err_packet(1045, f"Access denied for user '{self.user}'@'127.0.0.1'", "28000")
             )
             self.server._conn_event("rejected", self)
             return False
+        if want == "caching_sha2_password":
+            io.write(b"\x01\x03")  # AuthMoreData: fast-auth success
         self.session.user = self.user
         self.session.host = "127.0.0.1"
         self.authed = True
         self.server._conn_event("connected", self)
-        if caps & p.CLIENT_CONNECT_WITH_DB and off < len(resp):
-            end = resp.index(b"\x00", off)
-            dbname = resp[off:end].decode()
+        if caps & p.CLIENT_CONNECT_WITH_DB and db_off < len(resp):
+            end = resp.index(b"\x00", db_off)
+            dbname = resp[db_off:end].decode()
             if dbname:
                 try:
                     self.session.catalog.db(dbname)
@@ -241,7 +276,7 @@ class Server:
     bound port; connections are thread-per-conn like the reference's
     goroutine-per-conn."""
 
-    def __init__(self, db, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, db, host: str = "127.0.0.1", port: int = 0, tls: bool = False):
         self.db = db
         self.host = host
         self.port = port
@@ -251,7 +286,31 @@ class Server:
         self._mu = threading.Lock()
         self._accept_thread: Optional[threading.Thread] = None
         self._stopping = False
+        # TLS: a per-server self-signed certificate (openssl) — clients
+        # upgrade via the SSLRequest leg of the handshake (ref: conn.go TLS)
+        self.tls_ctx = self._make_tls_ctx() if tls else None
         db.server = self  # processlist/kill hook for sessions
+
+    @staticmethod
+    def _make_tls_ctx():
+        import ssl
+        import subprocess
+        import tempfile
+
+        d = tempfile.mkdtemp(prefix="tidb_tpu_tls_")
+        cert, key = f"{d}/server.crt", f"{d}/server.key"
+        subprocess.run(
+            [
+                "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+                "-keyout", key, "-out", cert, "-days", "30",
+                "-subj", "/CN=tidb-tpu-test",
+            ],
+            check=True,
+            capture_output=True,
+        )
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert, key)
+        return ctx
 
     def start(self) -> int:
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)

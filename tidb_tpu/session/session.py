@@ -533,7 +533,7 @@ class Session:
         return s
 
     def _create_user(self, stmt: ast.CreateUser) -> Result:
-        from tidb_tpu.privilege import ALL_PRIVS, encode_password
+        from tidb_tpu.privilege import ALL_PRIVS, encode_password_with
 
         self.require_priv("mysql", "user", "insert")
         self._db.ensure_priv_bootstrap()
@@ -546,9 +546,12 @@ class Session:
                 if stmt.if_not_exists:
                     continue
                 raise SessionError(f"Operation CREATE USER failed for '{u.name}'@'{u.host}'")
+            if u.plugin not in ("mysql_native_password", "caching_sha2_password"):
+                raise SessionError(f"unknown auth plugin {u.plugin!r}")
             ns = ", ".join(["'N'"] * len(ALL_PRIVS))
             s.execute(
-                f"INSERT INTO mysql.user VALUES ('{u.host}', '{u.name}', '{encode_password(u.password)}', {ns})"
+                f"INSERT INTO mysql.user VALUES ('{u.host}', '{u.name}', "
+                f"'{encode_password_with(u.password, u.plugin)}', '{u.plugin}', {ns})"
             )
         self._db.priv_version += 1
         return Result()
