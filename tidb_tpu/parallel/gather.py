@@ -184,7 +184,15 @@ def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev):
         rows = None
         if stats is not None:
             st = stats.get(p.table.id)
-            rows = st.row_count if st is not None else None
+            if st is not None:
+                rows = st.row_count
+                if p.pushed_conditions and rows:
+                    # post-selection cardinality drives the exchange choice:
+                    # a selective filter can shrink a "big" build side under
+                    # the broadcast threshold (ref: cardinality.Selectivity)
+                    from tidb_tpu.statistics.selectivity import estimate_selectivity
+
+                    rows = max(rows * estimate_selectivity(p.pushed_conditions, p.schema, st), 1.0)
         return ([p], [], rows)
     if (
         isinstance(p, PhysHashJoin)
@@ -237,21 +245,48 @@ def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev):
         st = stats.get(r.table.id) if stats is not None else None
         if st is not None:
             r_rows = st.row_count
+            if r.pushed_conditions and r_rows:
+                from tidb_tpu.statistics.selectivity import estimate_selectivity
+
+                r_rows = max(r_rows * estimate_selectivity(r.pushed_conditions, r.schema, st), 1.0)
         exchange = _choose_exchange(probe_rows, r_rows, get_ndev())
         joins = joins + [
             MPPJoin(eq=list(eq_conds), exchange=exchange, unique=unique, kind=p.kind, str_keys=str_keys)
         ]
         out_rows = probe_rows
         if p.kind == "inner" and not unique and probe_rows is not None and r_rows is not None:
-            # expansion estimate: probe rows × build fan-out (rows per
-            # distinct key when ANALYZE knows the NDV, else a ×2 guess) —
-            # feeds the NEXT join's exchange-cost comparison
-            ndv = None
-            if len(key_slots) == 1 and st is not None:
-                cs = st.cols.get(key_slots[0])
-                ndv = cs.ndv if cs is not None else None
-            fan = max(r_rows // max(ndv, 1), 1) if ndv else 2
-            out_rows = probe_rows * fan
+            # expansion estimate for the NEXT join's exchange-cost
+            # comparison: histogram+TopN join cardinality when the single
+            # join-key columns are analyzed on both sides, the NDV fan-out
+            # heuristic otherwise (ref: cardinality join estimation)
+            est = None
+            if len(eq_conds) == 1 and st is not None and not str_keys:
+                # (string keys: each side's stats store its OWN dictionary's
+                # codes — cross-table code comparison is meaningless)
+                lp, rp = eq_conds[0]
+                lsrc = _plan_col_source(readers, joins[:-1], lp)
+                lst = stats.get(lsrc[0]) if lsrc is not None else None
+                lcs = lst.cols.get(lsrc[1]) if lst is not None else None
+                rcs = st.cols.get(r.schema[rp].slot)
+                if lcs is not None and rcs is not None and lst.row_count and st.row_count:
+                    from tidb_tpu.statistics.selectivity import estimate_join_rows
+
+                    # estimate over the BASE tables, then scale by how much
+                    # each side's effective cardinality (filters, upstream
+                    # expansions) differs — TopN counts are base-table counts
+                    base_est = estimate_join_rows(
+                        lcs, rcs, float(lst.row_count), float(st.row_count)
+                    )
+                    est = base_est * (probe_rows / lst.row_count) * (r_rows / st.row_count)
+            if est is not None:
+                out_rows = est
+            else:
+                ndv = None
+                if len(key_slots) == 1 and st is not None:
+                    cs = st.cols.get(key_slots[0])
+                    ndv = cs.ndv if cs is not None else None
+                fan = max(r_rows // max(ndv, 1), 1) if ndv else 2
+                out_rows = probe_rows * fan
         return (readers + [r], joins, out_rows)
     return None
 

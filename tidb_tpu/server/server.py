@@ -33,6 +33,7 @@ class ClientConn:
         self.current_sql: Optional[str] = None
         self.connected_at = time.time()
         self.authed = False  # set after a successful handshake
+        self.tls = False  # flipped by the SSLRequest upgrade
         # binary-protocol prepared statements: stmt_id → (name, n_params,
         # param types from the last execute) (ref: conn.go stmts map)
         self.stmts: dict[int, list] = {}
@@ -297,20 +298,27 @@ class Server:
         import subprocess
         import tempfile
 
+        import shutil
+
         d = tempfile.mkdtemp(prefix="tidb_tpu_tls_")
-        cert, key = f"{d}/server.crt", f"{d}/server.key"
-        subprocess.run(
-            [
-                "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
-                "-keyout", key, "-out", cert, "-days", "30",
-                "-subj", "/CN=tidb-tpu-test",
-            ],
-            check=True,
-            capture_output=True,
-        )
-        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-        ctx.load_cert_chain(cert, key)
-        return ctx
+        try:
+            cert, key = f"{d}/server.crt", f"{d}/server.key"
+            subprocess.run(
+                [
+                    "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+                    "-keyout", key, "-out", cert, "-days", "30",
+                    "-subj", "/CN=tidb-tpu-test",
+                ],
+                check=True,
+                capture_output=True,
+            )
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(cert, key)
+            return ctx
+        finally:
+            # the context holds the loaded key; the PRIVATE KEY must not
+            # linger on disk
+            shutil.rmtree(d, ignore_errors=True)
 
     def start(self) -> int:
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)

@@ -144,3 +144,32 @@ def _cond_sel(c: Expression, schema, stats: TableStats | None) -> float:
                     rows += int(tc)
             return min(rows / total, 1.0) if total else PSEUDO_LESS
     return DEFAULT_SEL
+
+
+def estimate_join_rows(lcs, rcs, l_rows: float, r_rows: float) -> float:
+    """Equi-join output cardinality (ref: cardinality estimation over
+    histograms + TopN in pkg/planner/cardinality): the containment baseline
+    l*r/max(ndv) refined with exact TopN skew — each heavy build value
+    contributes probe.est_eq(v) * its count, and the remaining mass joins at
+    the baseline rate. Skewed keys make the baseline wildly wrong in both
+    directions; the TopN term is what lets the exchange/expansion choices
+    see the skew."""
+    if not l_rows or not r_rows:
+        return 0.0
+    ndv_l = max(lcs.ndv, 1) if lcs is not None else 1
+    ndv_r = max(rcs.ndv, 1) if rcs is not None else 1
+    base_rate = 1.0 / max(ndv_l, ndv_r)
+    if lcs is None or rcs is None:
+        return l_rows * r_rows * base_rate
+    out = 0.0
+    r_topn_mass = 0
+    l_topn_matched = 0.0
+    for v, c in zip(rcs.topn.values, rcs.topn.counts):
+        lc = lcs.est_eq(v, int(l_rows))
+        out += lc * int(c)
+        r_topn_mass += int(c)
+        l_topn_matched += lc
+    tail_l = max(l_rows - l_topn_matched, 0.0)
+    tail_r = max(r_rows - r_topn_mass, 0.0)
+    out += tail_l * tail_r * base_rate
+    return max(out, 1.0)
