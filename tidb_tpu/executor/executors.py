@@ -605,7 +605,9 @@ class FinalAggExec(Executor):
         conc = 4
         tracker = None
         if self.session is not None:
-            conc = max(1, int(self.session.vars.get("tidb_executor_concurrency", 4)))
+            from tidb_tpu.session.session import executor_concurrency
+
+            conc = executor_concurrency(self.session.vars, "tidb_hashagg_partial_concurrency")
             tracker = getattr(self.session, "mem_tracker", None)
         per = max((n + conc - 1) // conc, 65536)
         bounds = [(i, min(i + per, n)) for i in range(0, n, per)]

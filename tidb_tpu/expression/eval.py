@@ -803,6 +803,42 @@ def _date_add_days(xp, args, ctx):
     return da + db, and_valid(xp, va, vb)
 
 
+def _dt_micros_ft(args):
+    # adding sub-day units promotes DATE to DATETIME (midnight base)
+    if args[0].kind == TypeKind.DATE:
+        return FieldType(TypeKind.DATETIME, nullable=args[0].nullable)
+    return args[0]
+
+
+@register("date_add_micros", _dt_micros_ft, arity=2)
+def _date_add_micros(xp, args, ctx):
+    (da, va), (db, vb) = args
+    base = da * 86_400_000_000 if ctx.arg_types[0].kind == TypeKind.DATE else da
+    return base + db, and_valid(xp, va, vb)
+
+
+@register("date_add_months", infer_first, arity=2)
+def _date_add_months(xp, args, ctx):
+    """Calendar month arithmetic with day-of-month clamping (MySQL:
+    '2024-01-31' + INTERVAL 1 MONTH = '2024-02-29')."""
+    (da, va), (db, vb) = args
+    is_dt = ctx.arg_types[0].kind == TypeKind.DATETIME
+    days = xp.asarray(da) // 86_400_000_000 if is_dt else xp.asarray(da)
+    tod = xp.asarray(da) % 86_400_000_000 if is_dt else 0
+    y, m, d = _civil_from_days(xp, days)
+    months = (y * 12 + (m - 1)) + xp.asarray(db)
+    ny = months // 12
+    nm = months % 12 + 1
+    # clamp the day to the target month's length
+    first = _days_from_civil(xp, ny, nm, 1 + 0 * ny)
+    ny2 = xp.where(nm == 12, ny + 1, ny)
+    nm2 = xp.where(nm == 12, 1, nm + 1)
+    days_in = _days_from_civil(xp, ny2, nm2, 1 + 0 * ny) - first
+    out_days = first + xp.minimum(d, days_in) - 1
+    out = out_days * 86_400_000_000 + tod if is_dt else out_days
+    return out, and_valid(xp, va, vb)
+
+
 # ---------------------------------------------------------------------------
 # strings (host engine only; device string ops happen on dictionary codes and
 # are produced by the binder, never through these entry points)

@@ -153,7 +153,7 @@ def _agg_mpp_ok(agg: PhysFinalAgg) -> bool:
 FORCE_EXCHANGE: str | None = None  # test hook: "hash" | "broadcast"
 
 
-def _choose_exchange(l_rows: int | None, r_rows: int | None, ndev: int) -> str:
+def _choose_exchange(l_rows: int | None, r_rows: int | None, ndev: int, bcast_thr: int = 100_000) -> str:
     """Stats-driven exchange choice (ref: fragment.go:235 exchange-type cost):
     broadcast replicates the build side to every shard (moves r*(ndev-1)
     rows); hash shuffles both sides (moves ~(l+r)*(ndev-1)/ndev rows) and
@@ -166,13 +166,13 @@ def _choose_exchange(l_rows: int | None, r_rows: int | None, ndev: int) -> str:
         return FORCE_EXCHANGE
     if r_rows is None or l_rows is None:
         small = r_rows if r_rows is not None else 0
-        return "broadcast" if small <= 100_000 else "hash"
+        return "broadcast" if small <= bcast_thr else "hash"
     if r_rows * max(ndev - 1, 1) <= max(l_rows, 1):
         return "broadcast"
     return "hash"
 
 
-def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev):
+def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev, bcast_thr: int = 100_000):
     """Left-deep chain of inner equi-joins over MPP-eligible readers →
     (readers, joins, probe_row_estimate) or None. eq_conds left positions
     index the child-0 schema, which for a left-deep chain IS the accumulated
@@ -202,7 +202,7 @@ def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev):
         and not p.null_aware
         and len(p.children) == 2
     ):
-        base = _flatten_join_chain(p.children[0], stats, get_ndev)
+        base = _flatten_join_chain(p.children[0], stats, get_ndev, bcast_thr)
         if base is None:
             return None
         r = p.children[1]
@@ -249,7 +249,7 @@ def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev):
                 from tidb_tpu.statistics.selectivity import estimate_selectivity
 
                 r_rows = max(r_rows * estimate_selectivity(r.pushed_conditions, r.schema, st), 1.0)
-        exchange = _choose_exchange(probe_rows, r_rows, get_ndev())
+        exchange = _choose_exchange(probe_rows, r_rows, get_ndev(), bcast_thr)
         joins = joins + [
             MPPJoin(eq=list(eq_conds), exchange=exchange, unique=unique, kind=p.kind, str_keys=str_keys)
         ]
@@ -470,6 +470,11 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> P
             group_by=p.group_by, aggs=p.aggs, partial_input=True, schema=p.schema, children=[gather]
         )
 
+    try:
+        bcast_thr = int(vars.get("tidb_broadcast_join_threshold_count", 100_000))
+    except (TypeError, ValueError):
+        bcast_thr = 100_000
+
     def walk(p: PhysicalPlan) -> PhysicalPlan:
         for i, c in enumerate(getattr(p, "children", [])):
             p.children[i] = walk(c)
@@ -494,7 +499,7 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> P
                     by = remapped
                     host_parent, slot = below, 0
                     below = below.children[0]
-                flat = _flatten_join_chain(below, stats, get_ndev) if below is not None else None
+                flat = _flatten_join_chain(below, stats, get_ndev, bcast_thr) if below is not None else None
                 if (
                     flat is not None
                     and flat[1]  # single-reader TopN is the coprocessor's job
@@ -522,7 +527,7 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> P
                 while isinstance(below, PhysProjection):
                     host_parent, slot = below, 0
                     below = below.children[0]
-                flat = _flatten_join_chain(below, stats, get_ndev)
+                flat = _flatten_join_chain(below, stats, get_ndev, bcast_thr)
                 if flat is not None and flat[1] and total <= 65536:
                     readers, joins, _ = flat
                     gather = PhysMPPGather(
@@ -538,7 +543,7 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> P
             return p
         child = p.children[0]
         if not p.partial_input:
-            flat = _flatten_join_chain(child, stats, get_ndev)
+            flat = _flatten_join_chain(child, stats, get_ndev, bcast_thr)
             if flat is not None and flat[1]:
                 readers, joins, _ = flat
                 below = _try_agg_below_join(p, readers, joins)

@@ -292,7 +292,30 @@ class Builder:
         if has_agg:
             base_schema = plan.schema
             aggs: list[AggDesc] = []
-            group_exprs = [self.resolve(g, BuildCtx(base_schema)) for g in sel.group_by]
+            # GROUP BY accepts select-item aliases (MySQL extension):
+            # an unresolvable bare name retries as the aliased expression
+            alias_map: dict = {}
+            dup_aliases: set = set()
+            for it in sel.items:
+                if it.alias:
+                    a = it.alias.lower()
+                    if a in alias_map:
+                        dup_aliases.add(a)
+                    alias_map[a] = it.expr
+
+            def resolve_group(g):
+                try:
+                    return self.resolve(g, BuildCtx(base_schema))
+                except PlanError:
+                    if isinstance(g, ast.ColumnName) and not g.table and g.name.lower() in alias_map:
+                        if g.name.lower() in dup_aliases:
+                            raise PlanError(
+                                f"Column '{g.name}' in group statement is ambiguous"
+                            )
+                        return self.resolve(alias_map[g.name.lower()], BuildCtx(base_schema))
+                    raise
+
+            group_exprs = [resolve_group(g) for g in sel.group_by]
             agg_ctx = BuildCtx(schema=[], agg_list=aggs, agg_base=base_schema)
 
             # first pass: group-key expressions resolve positionally
@@ -880,10 +903,8 @@ class Builder:
                         n = self._resolve(side.args[0], ctx)
                         unit = side.args[1].value
                         base = self._resolve(other, ctx)
-                        if unit != "day":
-                            raise PlanError(f"unsupported INTERVAL unit {unit}")
-                        delta = n if node.op == "plus" else func("unaryminus", n)
-                        return func("date_add_days", base, delta)
+                        neg = node.op == "minus"
+                        return self._date_interval(base, n, unit, neg)
             left = self._resolve(node.left, ctx)
             right = self._resolve(node.right, ctx)
             return self._binary(node.op, left, right)
@@ -919,6 +940,11 @@ class Builder:
         if isinstance(node, ast.Like):
             e = func("like", self._resolve(node.operand, ctx), self._resolve(node.pattern, ctx))
             return func("not", e) if node.negated else e
+        if isinstance(node, ast.FuncCall) and node.name in ("date_add", "date_sub", "adddate", "subdate") and len(node.args) == 2 and isinstance(node.args[1], ast.FuncCall) and node.args[1].name == "interval":
+            base = self._resolve(node.args[0], ctx)
+            iv = node.args[1]
+            n = self._resolve(iv.args[0], ctx)
+            return self._date_interval(base, n, iv.args[1].value, node.name in ("date_sub", "subdate"))
         if isinstance(node, ast.FuncCall):
             if self._win_map and id(node) in self._win_map:
                 return self._win_map[id(node)]
@@ -945,6 +971,39 @@ class Builder:
                 raise PlanError("scalar subquery returned more than one row")
             return _const_like(vals[0][0]) if vals else Constant(None, FieldType(TypeKind.NULLTYPE))
         raise PlanError(f"unsupported expression {type(node).__name__}")
+
+    def _date_interval(self, base, n, unit: str, negate: bool):
+        """date ± INTERVAL n unit → the date_add_* builtins (ref: MySQL
+        date arithmetic units; day-ish units in days, sub-day in micros,
+        month-ish via calendar month math with day clamping)."""
+        from tidb_tpu.expression.expr import Constant
+        from tidb_tpu.types.field_type import bigint_type
+
+        def times(e, k: int):
+            if k == 1:
+                return e
+            return func("mul", e, Constant(k, bigint_type(nullable=False)))
+
+        if base.ftype.kind == TypeKind.STRING:
+            if not isinstance(base, Constant):
+                # no runtime string→temporal cast yet: dictionary-code
+                # arithmetic would be garbage — fail loudly instead
+                raise PlanError("INTERVAL arithmetic needs a DATE/DATETIME operand (CAST the string column)")
+            v = base.value.decode() if isinstance(base.value, bytes) else str(base.value)
+            kind = TypeKind.DATETIME if ":" in v else TypeKind.DATE
+            base = self._coerce_to(FieldType(kind), base)
+        if negate:
+            n = func("unaryminus", n)
+        u = unit.lower()
+        if u in ("day", "week"):
+            return func("date_add_days", base, times(n, 7 if u == "week" else 1))
+        if u in ("month", "quarter", "year"):
+            k = {"month": 1, "quarter": 3, "year": 12}[u]
+            return func("date_add_months", base, times(n, k))
+        if u in ("hour", "minute", "second", "microsecond"):
+            k = {"hour": 3_600_000_000, "minute": 60_000_000, "second": 1_000_000, "microsecond": 1}[u]
+            return func("date_add_micros", base, times(n, k))
+        raise PlanError(f"unsupported INTERVAL unit {unit}")
 
     def _resolve_column(self, node: ast.ColumnName, ctx: BuildCtx) -> Expression:
         name = node.name.lower()

@@ -57,16 +57,17 @@ from tidb_tpu.planner.plans import (
 from tidb_tpu.types import TypeKind
 
 
-def optimize(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan:
+def optimize(plan: LogicalPlan, engines: list[str], stats=None, vars=None) -> PhysicalPlan:
     """engines: allowed read engines in preference order (session var
     tidb_isolation_read_engines analog). ``stats``: StatsHandle feeding the
-    cost-based access-path choice (pseudo-stats heuristics when absent)."""
+    cost-based access-path choice (pseudo-stats heuristics when absent);
+    ``vars``: session variables for planner toggles."""
     plan, _ = _prune(plan, None)
     plan = _push_selections(plan)
     fast = _try_point_get(plan)
     if fast is not None:
         return fast
-    return _physical(plan, engines, stats)
+    return _physical(plan, engines, stats, vars or {})
 
 
 # ---------------------------------------------------------------------------
@@ -598,7 +599,8 @@ def _derive_ranges(scan: LogicalScan, conds: list[Expression]) -> Optional[list[
     return [tablecodec.handle_range(t.id, lo, hi)]
 
 
-def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan:
+def _physical(plan: LogicalPlan, engines: list[str], stats=None, vars=None) -> PhysicalPlan:
+    vars = vars or {}
     if isinstance(plan, LogicalDual):
         return PhysDual(schema=plan.schema)
     if isinstance(plan, LogicalMemSource):
@@ -616,13 +618,13 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan
     if isinstance(plan, LogicalSelection):
         if isinstance(plan.children[0], LogicalScan):
             ipath = _choose_index_path(plan.children[0], plan.conditions, stats)
-            if ipath is None:
+            if ipath is None and int(vars.get("tidb_enable_index_merge", 1)):
                 # OR shapes defeat single-index pruning; a union of index
                 # paths can still serve them (ref: indexmerge_path.go)
                 ipath = _try_index_merge(plan.children[0], plan.conditions, stats)
             if ipath is not None:
                 return ipath
-        child = _physical(plan.children[0], engines, stats)
+        child = _physical(plan.children[0], engines, stats, vars)
         if (
             isinstance(child, PhysTableReader)
             and child.pushed_agg is None
@@ -653,7 +655,7 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan
             return child
         return PhysSelection(conditions=plan.conditions, children=[child])
     if isinstance(plan, LogicalAggregation):
-        child = _physical(plan.children[0], engines, stats)
+        child = _physical(plan.children[0], engines, stats, vars)
         # look through row-preserving projections (ref: projection elimination
         # before agg pushdown): remap group/arg exprs through each projection
         # so the agg can land in the reader fragment — the path that fuses
@@ -714,10 +716,10 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan
                 return final
         return PhysFinalAgg(group_by=plan.group_by, aggs=plan.aggs, partial_input=False, schema=plan.schema, children=[child])
     if isinstance(plan, LogicalSort):
-        child = _physical(plan.children[0], engines, stats)
+        child = _physical(plan.children[0], engines, stats, vars)
         return PhysSort(by=plan.by, children=[child])
     if isinstance(plan, LogicalLimit):
-        child = _physical(plan.children[0], engines, stats)
+        child = _physical(plan.children[0], engines, stats, vars)
         total = plan.limit + plan.offset
         # topN pushdown: Limit(Sort([Projection](reader))) → reader TopN +
         # root merge sort; sort keys remap through the projection
@@ -761,13 +763,13 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan
                 below.pushed_limit = total
         return PhysLimit(limit=plan.limit, offset=plan.offset, children=[child])
     if isinstance(plan, LogicalProjection):
-        child = _physical(plan.children[0], engines, stats)
+        child = _physical(plan.children[0], engines, stats, vars)
         return PhysProjection(exprs=plan.exprs, schema=plan.schema, children=[child])
     if isinstance(plan, LogicalDistinct):
-        child = _physical(plan.children[0], engines, stats)
+        child = _physical(plan.children[0], engines, stats, vars)
         return PhysDistinct(children=[child])
     if isinstance(plan, LogicalWindow):
-        child = _physical(plan.children[0], engines, stats)
+        child = _physical(plan.children[0], engines, stats, vars)
         if _try_push_window(plan, child, engines):
             return child  # the reader absorbed the window
         return PhysWindow(
@@ -785,11 +787,11 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None) -> PhysicalPlan
             op=plan.op,
             all=plan.all,
             schema=plan.schema,
-            children=[_physical(c, engines, stats) for c in plan.children],
+            children=[_physical(c, engines, stats, vars) for c in plan.children],
         )
     if isinstance(plan, LogicalJoin):
-        left = _physical(plan.children[0], engines, stats)
-        right = _physical(plan.children[1], engines, stats)
+        left = _physical(plan.children[0], engines, stats, vars)
+        right = _physical(plan.children[1], engines, stats, vars)
         return _choose_join(plan, left, right, stats)
     raise PlanError(f"physical: unhandled node {type(plan).__name__}")
 

@@ -59,7 +59,66 @@ DEFAULT_SYSVARS = {
     # 1 when the previous statement's plan came from the plan cache
     # (ref: last_plan_from_cache status var)
     "last_plan_from_cache": 0,
+    # -- executor concurrency family (ref: vardef executor concurrency
+    # knobs; tidb_executor_concurrency is the unified default the split
+    # knobs fall back to, exactly the reference's layering) --
+    "tidb_executor_concurrency": 4,
+    "tidb_hash_join_concurrency": -1,  # -1 → tidb_executor_concurrency
+    "tidb_hashagg_partial_concurrency": -1,
+    "tidb_hashagg_final_concurrency": -1,
+    "tidb_window_concurrency": -1,
+    "tidb_streamagg_concurrency": 1,
+    "tidb_index_lookup_concurrency": -1,
+    "tidb_index_lookup_join_concurrency": -1,
+    "tidb_index_serial_scan_concurrency": 1,
+    "tidb_projection_concurrency": -1,
+    "tidb_ddl_reorg_worker_cnt": 4,
+    "tidb_ddl_reorg_batch_size": 256,
+    # -- memory/spill family (ref: mem-quota + spill knobs) --
+    "tidb_mem_quota_apply_cache": 32 << 20,
+    "tidb_enable_tmp_storage_on_oom": 1,
+    "tidb_mem_quota_binding_cache": 64 << 20,
+    "tidb_server_memory_limit": 0,  # 0 = unlimited (embedded default)
+    "tidb_enable_rate_limit_action": 0,
+    # -- planner/stats family --
+    "tidb_auto_analyze_ratio": 0.5,
+    "tidb_enable_index_merge": 1,
+    "tidb_broadcast_join_threshold_count": 100_000,
+    # -- txn/retry family --
+    "tidb_retry_limit": 10,
+    "tidb_disable_txn_auto_retry": 1,
+    "tidb_constraint_check_in_place": 0,
+    "foreign_key_checks": 1,
+    # -- misc MySQL-compat knobs the wire surface reports (accepted,
+    # surfaced by SHOW VARIABLES, not consulted by the engine) --
+    "tidb_opt_agg_push_down": 1,
+    "tidb_opt_distinct_agg_push_down": 0,
+    "tidb_build_stats_concurrency": 4,
+    "tidb_stats_cache_mem_quota": 0,
+    "tidb_opt_mpp_outer_join_fixed_build_side": 0,
+    "tidb_broadcast_join_threshold_size": 100 << 20,
+    "max_allowed_packet": 64 << 20,
+    "version_comment": "tidb-tpu",
+    "character_set_server": "utf8mb4",
+    "collation_server": "utf8mb4_bin",
+    "time_zone": "SYSTEM",
+    "wait_timeout": 28800,
 }
+
+
+def executor_concurrency(vars: dict, knob: str) -> int:
+    """Split concurrency knobs default to the unified
+    tidb_executor_concurrency when set to -1 (ref: vardef fallback)."""
+    try:
+        v = int(vars.get(knob, -1))
+    except (TypeError, ValueError):
+        v = -1
+    if v > 0:
+        return v
+    try:
+        return max(int(vars.get("tidb_executor_concurrency", 4)), 1)
+    except (TypeError, ValueError):
+        return 4
 
 
 @dataclass
@@ -910,7 +969,7 @@ class Session:
                         hinted.append({"tikv": "host", "tiflash": "tpu"}.get(eng, eng))
                 if hinted:
                     engines = hinted
-        plan = optimize(logical, engines, stats=self._db.stats)
+        plan = optimize(logical, engines, stats=self._db.stats, vars=self.vars)
         from tidb_tpu.parallel.gather import try_mpp_rewrite
 
         plan = try_mpp_rewrite(plan, self.vars, stats=self._db.stats, store=self.store)
@@ -1170,6 +1229,12 @@ class DB:
 
         s = self.session()
         analyzed: list[str] = []
+        try:
+            self.stats.auto_analyze_ratio = float(
+                self.global_vars.get("tidb_auto_analyze_ratio", DEFAULT_SYSVARS["tidb_auto_analyze_ratio"])
+            )
+        except (TypeError, ValueError):
+            pass
         stale = set(self.stats.stale_tables())
         for db_name in self.catalog.databases():
             for tname in self.catalog.tables(db_name):
