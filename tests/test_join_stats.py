@@ -109,3 +109,56 @@ def test_estimate_join_rows_sees_skew():
     # ~50; assert the skew term dominates
     heavy = acs.est_eq(7, 10_000) * (bcs.topn.count_of(7) or 0)
     assert est >= heavy > 20_000, (est, heavy)
+
+
+def test_sysvar_strings_and_broadcast_disable():
+    d = tidb_tpu.open(region_split_keys=1 << 62)
+    rng = np.random.default_rng(31)
+    n_b, n_p = 400_000, 600_000
+    d.execute("CREATE TABLE build (k BIGINT PRIMARY KEY, flag BIGINT)")
+    d.execute("CREATE TABLE probe (k BIGINT, v BIGINT)")
+    bulk_load(d, "build", [np.arange(n_b), (np.arange(n_b) % 1000 == 0).astype(np.int64)])
+    bulk_load(d, "probe", [rng.integers(0, n_b, n_p), rng.integers(0, 100, n_p)])
+    d.execute("ANALYZE TABLE build")
+    d.execute("ANALYZE TABLE probe")
+    s = d.session()
+    sql = (
+        "SELECT flag, COUNT(*) FROM probe, build WHERE probe.k = build.k"
+        " AND flag = 1 GROUP BY flag"
+    )
+    assert _exchange_of(d, sql) == "broadcast"
+    # threshold 0 = never replicate a build side (the TiDB idiom)
+    s.execute("SET GLOBAL tidb_broadcast_join_threshold_count = 0")
+    plan = "\n".join(str(r[0]) for r in s.query("EXPLAIN " + sql))
+    assert "HashExchange" in plan and "BroadcastExchange" not in plan, plan
+    s.execute("SET GLOBAL tidb_broadcast_join_threshold_count = 100000")
+    # ON/OFF strings must not crash planning (SET stores raw strings)
+    s.execute("SET tidb_enable_index_merge = 'OFF'")
+    assert s.query("SELECT COUNT(*) FROM build WHERE k = 1 OR flag = 2")
+    s.execute("SET tidb_enable_index_merge = 'ON'")
+    assert s.query("SELECT COUNT(*) FROM build WHERE k = 1 OR flag = 2")
+
+
+def test_plan_cache_invalidated_by_planner_sysvars():
+    d = tidb_tpu.open(region_split_keys=1 << 62)
+    rng = np.random.default_rng(41)
+    n_b, n_p = 200_000, 400_000
+    d.execute("CREATE TABLE build (k BIGINT PRIMARY KEY, flag BIGINT)")
+    d.execute("CREATE TABLE probe (k BIGINT, v BIGINT)")
+    bulk_load(d, "build", [np.arange(n_b), (np.arange(n_b) % 1000 == 0).astype(np.int64)])
+    bulk_load(d, "probe", [rng.integers(0, n_b, n_p), rng.integers(0, 100, n_p)])
+    d.execute("ANALYZE TABLE build")
+    d.execute("ANALYZE TABLE probe")
+    s = d.session()
+    s.execute(
+        "PREPARE p FROM 'SELECT flag, COUNT(*) FROM probe, build"
+        " WHERE probe.k = build.k AND flag = 1 GROUP BY flag'"
+    )
+    first = s.execute("EXECUTE p").rows
+    assert s.execute("EXECUTE p").rows == first
+    assert s.vars["last_plan_from_cache"] == 1
+    # flipping a plan-shaping sysvar must MISS the cache (stale plans would
+    # otherwise keep running the now-forbidden broadcast exchange)
+    s.execute("SET tidb_broadcast_join_threshold_count = 0")
+    assert s.execute("EXECUTE p").rows == first
+    assert s.vars["last_plan_from_cache"] == 0

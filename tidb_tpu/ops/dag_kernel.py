@@ -19,9 +19,11 @@ chain into a single XLA program over padded columnar batches:
   capped at ``agg_cap`` (kernel reports true group count; the caller re-runs
   with a bigger cap on overflow — the recompile-storm guard from SURVEY §7).
 
-Numeric policy: int64/float64 lanes (x64 enabled). TPU executes i64/f64 as
-emulated pairs — correct first; a bf16/int32 fast path is a later round's
-optimization once SQL-level tolerance plumbing exists.
+Numeric policy: compute in int64/float64 (x64 enabled; TPU emulates i64 as
+pairs), but STORAGE narrows — device-cached lanes whose min/max fit int32
+ship as int32 and upcast on first use (tpu_engine._narrowed), and group-bys
+with proven value magnitudes ride the MXU pallas grouped-sum kernel
+(byte-limb exact accumulation) instead of emulated VPU reductions.
 """
 
 from __future__ import annotations

@@ -34,6 +34,7 @@ from tidb_tpu.planner.plans import (
     Schema,
 )
 from tidb_tpu.types import TypeKind
+from tidb_tpu.utils import sysvar_int
 
 # structural key → jitted MPP program (see MPPGatherExec.execute)
 _MPP_FN_CACHE: dict = {}
@@ -164,9 +165,13 @@ def _choose_exchange(l_rows: int | None, r_rows: int | None, ndev: int, bcast_th
     replicated just because the probe is un-analyzed)."""
     if FORCE_EXCHANGE is not None:
         return FORCE_EXCHANGE
+    if bcast_thr <= 0:
+        return "hash"  # the TiDB idiom: threshold 0 disables broadcast
     if r_rows is None or l_rows is None:
         small = r_rows if r_rows is not None else 0
         return "broadcast" if small <= bcast_thr else "hash"
+    if r_rows > bcast_thr:
+        return "hash"  # build side exceeds the user's replication cap
     if r_rows * max(ndev - 1, 1) <= max(l_rows, 1):
         return "broadcast"
     return "hash"
@@ -322,13 +327,13 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> P
     """Rewrite eligible FinalAgg/TopN/Limit-over-join subtrees into
     PhysMPPGather (ref: the planner preferring mpp task type under
     tidb_allow_mpp)."""
-    if not int(vars.get("tidb_allow_mpp", 1)):
+    if not sysvar_int(vars, "tidb_allow_mpp", 1):
         return plan
     if store is not None and not hasattr(store, "_stable"):
         # remote-backed SQL layer: the MPP coordinator belongs where the
         # data (and the device) live — the storage-server process
         return plan
-    enforce = int(vars.get("tidb_enforce_mpp", 0))
+    enforce = sysvar_int(vars, "tidb_enforce_mpp", 0)
 
     # lazy: mesh construction triggers JAX backend init (seconds of cold
     # start) — only pay it when a query actually matches an MPP shape
@@ -470,10 +475,7 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> P
             group_by=p.group_by, aggs=p.aggs, partial_input=True, schema=p.schema, children=[gather]
         )
 
-    try:
-        bcast_thr = int(vars.get("tidb_broadcast_join_threshold_count", 100_000))
-    except (TypeError, ValueError):
-        bcast_thr = 100_000
+    bcast_thr = sysvar_int(vars, "tidb_broadcast_join_threshold_count", 100_000)
 
     def walk(p: PhysicalPlan) -> PhysicalPlan:
         for i, c in enumerate(getattr(p, "children", [])):

@@ -55,6 +55,7 @@ from tidb_tpu.planner.plans import (
     PlanError,
 )
 from tidb_tpu.types import TypeKind
+from tidb_tpu.utils import sysvar_int
 
 
 def optimize(plan: LogicalPlan, engines: list[str], stats=None, vars=None) -> PhysicalPlan:
@@ -618,7 +619,7 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None, vars=None) -> P
     if isinstance(plan, LogicalSelection):
         if isinstance(plan.children[0], LogicalScan):
             ipath = _choose_index_path(plan.children[0], plan.conditions, stats)
-            if ipath is None and int(vars.get("tidb_enable_index_merge", 1)):
+            if ipath is None and sysvar_int(vars, "tidb_enable_index_merge", 1):
                 # OR shapes defeat single-index pruning; a union of index
                 # paths can still serve them (ref: indexmerge_path.go)
                 ipath = _try_index_merge(plan.children[0], plan.conditions, stats)

@@ -21,6 +21,7 @@ from tidb_tpu.parser import ast, parse
 from tidb_tpu.planner.builder import Builder
 from tidb_tpu.planner.optimizer import optimize
 from tidb_tpu.planner.plans import PlanError, explain_plan
+from tidb_tpu.utils import sysvar_int
 from tidb_tpu.utils.chunk import Chunk
 
 DEFAULT_SYSVARS = {
@@ -109,16 +110,10 @@ DEFAULT_SYSVARS = {
 def executor_concurrency(vars: dict, knob: str) -> int:
     """Split concurrency knobs default to the unified
     tidb_executor_concurrency when set to -1 (ref: vardef fallback)."""
-    try:
-        v = int(vars.get(knob, -1))
-    except (TypeError, ValueError):
-        v = -1
+    v = sysvar_int(vars, knob, -1)
     if v > 0:
         return v
-    try:
-        return max(int(vars.get("tidb_executor_concurrency", 4)), 1)
-    except (TypeError, ValueError):
-        return 4
+    return max(sysvar_int(vars, "tidb_executor_concurrency", 4), 1)
 
 
 @dataclass
@@ -515,7 +510,7 @@ class Session:
         if isinstance(stmt, ast.ImportInto):
             from tidb_tpu.tools.importer import import_into, import_into_disttask
 
-            if int(self.vars.get("tidb_enable_dist_task", 0)):
+            if sysvar_int(self.vars, "tidb_enable_dist_task", 0):
                 import_into = import_into_disttask
             n = import_into(
                 self._db,
@@ -784,7 +779,7 @@ class Session:
 
         from tidb_tpu.utils.memory import Tracker
 
-        self.mem_tracker = Tracker("query", int(self.vars.get("tidb_mem_quota_query", 1 << 30)))
+        self.mem_tracker = Tracker("query", sysvar_int(self.vars, "tidb_mem_quota_query", 1 << 30))
         met = float(self.vars.get("max_execution_time", 0) or 0)
         for hname, hargs in getattr(stmt, "hints", []) or []:
             if hname == "max_execution_time" and hargs:
@@ -924,6 +919,8 @@ class Session:
             self._db.stats.version,
             self.vars.get("tidb_allow_mpp"),
             self.vars.get("tidb_enforce_mpp"),
+            self.vars.get("tidb_enable_index_merge"),
+            self.vars.get("tidb_broadcast_join_threshold_count"),
         )
 
     def _plan_select(self, stmt, cache_key=None):
@@ -975,7 +972,7 @@ class Session:
         plan = try_mpp_rewrite(plan, self.vars, stats=self._db.stats, store=self.store)
         if key is not None and not builder.uncacheable:
             self._plan_cache[key] = plan
-            cap = int(self.vars.get("tidb_prepared_plan_cache_size", 100))
+            cap = sysvar_int(self.vars, "tidb_prepared_plan_cache_size", 100)
             while len(self._plan_cache) > cap:
                 self._plan_cache.popitem(last=False)
         return plan
