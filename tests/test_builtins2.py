@@ -311,3 +311,117 @@ def test_week_all_modes(db):
     for (ds, m), exp in cases.items():
         got = both(db, f"SELECT WEEK('{ds}', {m}) FROM t WHERE id = 1")
         assert got == [(exp,)], (ds, m, exp, got)
+
+
+def test_regexp_elt_field():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE rx (id BIGINT PRIMARY KEY, s VARCHAR(20))")
+    d.execute("INSERT INTO rx VALUES (1,'apple'),(2,'banana'),(3,NULL),(4,'Apricot')")
+    s = d.session()
+    assert s.query("SELECT id FROM rx WHERE s REGEXP '^a' ORDER BY id") == [(1,)]
+    assert s.query("SELECT id FROM rx WHERE s RLIKE 'an+a' ORDER BY id") == [(2,)]
+    assert s.query("SELECT id FROM rx WHERE s NOT REGEXP 'p' ORDER BY id") == [(2,)]
+    # NULL operand -> NULL, not matched
+    assert s.query("SELECT REGEXP_LIKE(s, 'a') FROM rx WHERE id = 3") == [(None,)]
+    with pytest.raises(Exception, match="regular expression"):
+        s.query("SELECT id FROM rx WHERE s REGEXP '('")
+    assert s.query("SELECT ELT(2, 'x', 'y', 'z'), ELT(0, 'x'), ELT(4, 'x')") == [("y", None, None)]
+    assert s.query("SELECT FIELD('y', 'x', 'y', 'z'), FIELD('q', 'x'), FIELD(NULL, 'x')") == [(2, 0, 0)]
+
+
+def test_group_concat_order_by():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE gc (g BIGINT, s VARCHAR(10), v BIGINT)")
+    d.execute(
+        "INSERT INTO gc VALUES (1,'apple',30),(1,'banana',10),(1,'apricot',20),"
+        "(2,'cherry',20),(2,NULL,5)"
+    )
+    s = d.session()
+    assert s.query(
+        "SELECT g, GROUP_CONCAT(s ORDER BY v DESC SEPARATOR '|') FROM gc GROUP BY g ORDER BY g"
+    ) == [(1, "apple|apricot|banana"), (2, "cherry")]
+    # NULL order keys sort first ASC (the v=5 row has s NULL, v NOT NULL:
+    # the VALUE is kept; only NULL arguments drop out of the concat)
+    assert s.query(
+        "SELECT g, GROUP_CONCAT(v ORDER BY s) FROM gc GROUP BY g ORDER BY g"
+    ) == [(1, "30,20,10"), (2, "5,20")]
+    assert s.query(
+        "SELECT g, GROUP_CONCAT(v ORDER BY s DESC) FROM gc WHERE g = 2 GROUP BY g"
+    ) == [(2, "20,5")]
+    # DISTINCT dedupes before ordering; two-key ordering breaks ties
+    d.execute("INSERT INTO gc VALUES (1,'apple',30)")
+    assert s.query(
+        "SELECT g, GROUP_CONCAT(DISTINCT s ORDER BY s DESC) FROM gc WHERE g = 1 GROUP BY g"
+    ) == [(1, "banana,apricot,apple")]
+    assert s.query(
+        "SELECT g, GROUP_CONCAT(s ORDER BY v DESC, s ASC) FROM gc WHERE g = 1 GROUP BY g"
+    ) == [(1, "apple,apple,apricot,banana")]
+
+
+def test_table_index_hints():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE th (id BIGINT PRIMARY KEY, g BIGINT, v BIGINT)")
+    d.execute("INSERT INTO th VALUES (1,1,10),(2,1,20),(3,2,30)")
+    d.execute("CREATE INDEX idx_g ON th (g)")
+    s = d.session()
+    plans = {}
+    for hint in ("USE INDEX (idx_g)", "FORCE INDEX (idx_g)", "IGNORE INDEX (idx_g)", "USE INDEX ()"):
+        plan = "\n".join(str(r[0]) for r in s.query(f"EXPLAIN SELECT * FROM th {hint} WHERE g = 1"))
+        plans[hint] = "Index" in plan
+        assert s.query(f"SELECT id FROM th {hint} WHERE g = 1 ORDER BY id") == [(1,), (2,)]
+    assert plans["USE INDEX (idx_g)"] and plans["FORCE INDEX (idx_g)"]
+    assert not plans["IGNORE INDEX (idx_g)"] and not plans["USE INDEX ()"]
+    # hints attach after an alias, too
+    assert s.query("SELECT t2.id FROM th t2 USE INDEX (idx_g) WHERE t2.g = 2") == [(3,)]
+
+
+def test_index_hint_restriction_and_merge():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE hr (id BIGINT PRIMARY KEY, a BIGINT, b BIGINT)")
+    d.execute("INSERT INTO hr VALUES (1,1,10),(2,1,20),(3,2,20)")
+    d.execute("CREATE INDEX idx_a ON hr (a)")
+    d.execute("CREATE INDEX idx_b ON hr (b)")
+    s = d.session()
+
+    def plan(sql):
+        return "\n".join(str(r[0]) for r in s.query("EXPLAIN " + sql))
+
+    # USE INDEX restricts candidates: idx_a is useless for b=20, and MySQL
+    # then table-scans rather than picking the unhinted idx_b
+    p = plan("SELECT * FROM hr USE INDEX (idx_a) WHERE b = 20")
+    assert "idx_b" not in p, p
+    # multi-name FORCE keeps every hinted candidate
+    p = plan("SELECT * FROM hr FORCE INDEX (idx_a, idx_b) WHERE b = 20")
+    assert "idx_b" in p, p
+    # repeated IGNORE clauses merge (both indexes excluded)
+    p = plan("SELECT * FROM hr IGNORE INDEX (idx_a) IGNORE INDEX (idx_b) WHERE a = 1 AND b = 20")
+    assert "idx_a" not in p and "idx_b" not in p, p
+    # USE INDEX () is not un-forced by a later IGNORE
+    p = plan("SELECT * FROM hr USE INDEX () IGNORE INDEX (idx_a) WHERE b = 20")
+    assert "idx_" not in p, p
+    for hint in ("USE INDEX (idx_a)", "FORCE INDEX (idx_a, idx_b)",
+                 "IGNORE INDEX (idx_a) IGNORE INDEX (idx_b)", "USE INDEX () IGNORE INDEX (idx_a)"):
+        assert s.query(f"SELECT id FROM hr {hint} WHERE b = 20 ORDER BY id") == [(2,), (3,)], hint
+
+
+def test_regexp_dot_excludes_newline():
+    d = tidb_tpu.open()
+    s = d.session()
+    assert s.query("SELECT 'a\nb' REGEXP 'a.b', 'axb' REGEXP 'a.b'") == [(0, 1)]
+
+
+def test_ignore_overrides_use_and_field_ci():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE ov (id BIGINT PRIMARY KEY, a BIGINT)")
+    d.execute("INSERT INTO ov VALUES (1,1),(2,2)")
+    d.execute("CREATE INDEX idx_a ON ov (a)")
+    s = d.session()
+    p = "\n".join(
+        str(r[0]) for r in s.query("EXPLAIN SELECT * FROM ov USE INDEX (idx_a) IGNORE INDEX (idx_a) WHERE a = 1")
+    )
+    assert "idx_a" not in p, p
+    assert s.query("SELECT id FROM ov USE INDEX (idx_a) IGNORE INDEX (idx_a) WHERE a = 1") == [(1,)]
+    # FIELD respects ci collation; bin stays case-sensitive
+    d.execute("CREATE TABLE fci (s VARCHAR(5) COLLATE utf8mb4_general_ci, b VARCHAR(5))")
+    d.execute("INSERT INTO fci VALUES ('A', 'A')")
+    assert s.query("SELECT FIELD(s, 'a', 'b'), FIELD(b, 'a', 'b') FROM fci") == [(1, 0)]

@@ -244,8 +244,10 @@ def _aggregate(chunk: Chunk, ex: dagpb.ExecutorPB) -> Chunk:
             keep = np.ones(len(d2), dtype=bool)
             keep[1:] = (s2[1:] != s2[:-1]) | (d2[1:] != d2[:-1]) | (v2[1:] != v2[:-1])
             data, valid, seg_a = d2[keep], v2[keep], s2[keep]
+            sel = order[keep]  # row selection, for per-agg side columns
         else:
             seg_a = seg
+            sel = None
         for kind in a.partial_kinds:
             if kind == "count":
                 res, cnt = _segment_reduce("count", data, valid, seg_a, ngroups)
@@ -266,7 +268,14 @@ def _aggregate(chunk: Chunk, ex: dagpb.ExecutorPB) -> Chunk:
                 res, cnt = _segment_reduce(kind, data, valid, seg_a, ngroups)
                 out_cols.append(Column(res, np.ones(ngroups, bool), bigint_type(nullable=False)))
             elif kind == "group_concat":
-                out_cols.append(_group_concat_col(a, data, valid, seg_a, ngroups, aft, adic))
+                gc_keys = []
+                for e, desc in a.order_by:
+                    oc = eval_to_column(e, batch, np)
+                    kd, kv = oc.data[perm], oc.validity[perm]
+                    if sel is not None:
+                        kd, kv = kd[sel], kv[sel]
+                    gc_keys.append((kd, kv, oc.dictionary, oc.ftype, desc))
+                out_cols.append(_group_concat_col(a, data, valid, seg_a, ngroups, aft, adic, gc_keys))
     for gc in gcols:
         first, cnt = _segment_reduce("first_row", gc.data[perm], gc.validity[perm], seg, ngroups)
         out_cols.append(Column(first.astype(gc.data.dtype), cnt > 0, gc.ftype, gc.dictionary))
@@ -276,9 +285,10 @@ def _aggregate(chunk: Chunk, ex: dagpb.ExecutorPB) -> Chunk:
     return result
 
 
-def _group_concat_col(a: AggDesc, data, valid, seg, ngroups: int, aft, adic) -> Column:
-    """GROUP_CONCAT: per-group string join in row order (MySQL default —
-    no ORDER BY inside the call; ref builtin group_concat)."""
+def _group_concat_col(a: AggDesc, data, valid, seg, ngroups: int, aft, adic, gc_keys=()) -> Column:
+    """GROUP_CONCAT: per-group string join — row order by default, or by the
+    call's ORDER BY keys (``gc_keys``: aligned (data, valid, dict, ftype,
+    desc) per key; ref builtin group_concat with order-by properties)."""
     from tidb_tpu.types.field_type import string_type
     from tidb_tpu.utils.chunk import Dictionary
     from tidb_tpu.types.datum import format_physical
@@ -289,10 +299,27 @@ def _group_concat_col(a: AggDesc, data, valid, seg, ngroups: int, aft, adic) -> 
         return format_physical(x, aft)
 
     sep = a.sep.encode() if isinstance(a.sep, str) else a.sep
-    parts: list[list[bytes]] = [[] for _ in range(ngroups)]
+    rows: list[list[int]] = [[] for _ in range(ngroups)]
     for i in range(len(data)):
         if valid[i]:
-            parts[int(seg[i])].append(fmt(data[i]))
+            rows[int(seg[i])].append(i)
+    # ORDER BY inside the call: repeated stable sorts, last key first, so
+    # the first key dominates; NULLs first ASC / last DESC (reverse flips
+    # the (is_null, value) tuple ordering, matching MySQL)
+    for kd, kv, kdic, kft, desc in reversed(gc_keys):
+        def sort_key(i, kd=kd, kv=kv, kdic=kdic, kft=kft):
+            # NULL keys first ASC / last DESC (reverse flips the tuple),
+            # so the not-null flag leads: False (null) < True (value)
+            if not kv[i]:
+                return (False, b"" if kft.kind == TypeKind.STRING else 0)
+            if kft.kind == TypeKind.STRING:
+                v = kdic.decode(int(kd[i])) if kdic is not None else str(int(kd[i])).encode()
+            else:
+                v = kd[i].item() if hasattr(kd[i], "item") else kd[i]
+            return (True, v)
+        for lst in rows:
+            lst.sort(key=sort_key, reverse=desc)
+    parts: list[list[bytes]] = [[fmt(data[i]) for i in idx] for idx in rows]
     dic = Dictionary()
     out = np.zeros(ngroups, dtype=np.int32)
     ok = np.zeros(ngroups, dtype=bool)

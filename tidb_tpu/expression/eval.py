@@ -1011,6 +1011,84 @@ def _like(xp, args, ctx):
     return out, v
 
 
+@register("regexp", infer_bool, engines=HOST_ONLY)
+def _regexp(xp, args, ctx):
+    """a REGEXP p / REGEXP_LIKE(a, p): substring-search semantics (unlike
+    LIKE's full match); case sensitivity follows the operand collation
+    (ref: builtin_regexp — ICU there, Python re here; an invalid pattern
+    raises like MySQL ERROR 3685)."""
+    import re
+
+    import numpy as np
+
+    strs, _ = _decode_strs(ctx, 0)
+    pats, _ = _decode_strs(ctx, 1)
+    # NO re.DOTALL: MySQL/ICU '.' stops at line terminators by default
+    # (unlike LIKE, whose '%' must span newlines)
+    flags = re.IGNORECASE if ctx.arg_types[0].collation == "ci" else 0
+    cache: dict = {}
+    n = max(len(strs), len(pats))
+    out = np.zeros(n, dtype=np.int64)
+    valid = np.ones(n, dtype=bool)
+    for i in range(n):
+        s = strs[i if len(strs) > 1 else 0]
+        p = pats[i if len(pats) > 1 else 0]
+        if s is None or p is None:
+            valid[i] = False
+            continue
+        rx = cache.get(p)
+        if rx is None:
+            try:
+                rx = cache[p] = re.compile(p.decode("utf-8", "replace"), flags)
+            except re.error as e:
+                raise ValueError(f"Invalid regular expression: {e}") from None
+        out[i] = 1 if rx.search(s.decode("utf-8", "replace")) else 0
+    return out, valid
+
+
+register("regexp_like", infer_bool, engines=HOST_ONLY)(_regexp)
+
+
+@register("elt", lambda args: string_type(nullable=True), engines=HOST_ONLY, variadic=True)
+def _elt(xp, args, ctx):
+    """ELT(n, s1, s2, ...): the n-th string, NULL out of range (1-based)."""
+    ns = _int_args(args, 0, max(len(a[0]) if hasattr(a[0], "__len__") else 1 for a in args))
+    cols = [_decode_strs(ctx, i)[0] for i in range(1, len(args))]
+    out = []
+    for i, nv in enumerate(ns):
+        if nv is None or not (1 <= nv <= len(cols)):
+            out.append(None)
+        else:
+            c = cols[nv - 1]
+            out.append(c[i if len(c) > 1 else 0])
+    return _encode_strs(ctx, out)
+
+
+@register("field", lambda args: bigint_type(nullable=False), engines=HOST_ONLY, variadic=True)
+def _field(xp, args, ctx):
+    """FIELD(x, a, b, ...): 1-based index of the first argument equal to x,
+    0 when absent or x is NULL (string comparison under the operand
+    collation — ASCII casefold for ci, like the LIKE/REGEXP neighbors)."""
+    import numpy as np
+
+    ci = ctx.arg_types[0].collation == "ci"
+    cols = [_decode_strs(ctx, i)[0] for i in range(len(args))]
+    n = max(len(c) for c in cols)
+    out = np.zeros(n, dtype=np.int64)
+    for i in range(n):
+        x = cols[0][i if len(cols[0]) > 1 else 0]
+        if x is None:
+            continue
+        if ci:
+            x = x.lower()
+        for k, c in enumerate(cols[1:], start=1):
+            v = c[i if len(c) > 1 else 0]
+            if v is not None and (v.lower() if ci else v) == x:
+                out[i] = k
+                break
+    return out, np.ones(n, dtype=bool)
+
+
 # ---------------------------------------------------------------------------
 # JSON functions (ref: types/json + expression/builtin_json — documents are
 # normalized JSON text on the STRING representation, host-side evaluation)

@@ -320,6 +320,8 @@ class AggDesc:
     arg: Optional[Expression]  # None for COUNT(*)
     distinct: bool = False
     sep: str = ","  # GROUP_CONCAT separator
+    # GROUP_CONCAT(... ORDER BY e [DESC], ...): [(Expression, desc)]
+    order_by: list = field(default_factory=list)
 
     @property
     def ftype(self) -> FieldType:
@@ -374,6 +376,7 @@ class AggDesc:
             "arg": self.arg.to_pb() if self.arg is not None else None,
             "distinct": self.distinct,
             "sep": self.sep,
+            "order_by": [(e.to_pb(), d) for e, d in self.order_by],
         }
 
     @staticmethod
@@ -383,9 +386,14 @@ class AggDesc:
             expr_from_pb(pb["arg"]) if pb["arg"] is not None else None,
             pb["distinct"],
             pb.get("sep", ","),
+            order_by=[(expr_from_pb(e), d) for e, d in pb.get("order_by", [])],
         )
 
     def __repr__(self):
         inner = "*" if self.arg is None else repr(self.arg)
         sep = f" separator={self.sep!r}" if self.name == "group_concat" and self.sep != "," else ""
-        return f"{self.name}({'distinct ' if self.distinct else ''}{inner}{sep})"
+        ob = ""
+        if self.order_by:
+            keys = ", ".join(f"{e!r}{' desc' if d else ''}" for e, d in self.order_by)
+            ob = f" order by {keys}"
+        return f"{self.name}({'distinct ' if self.distinct else ''}{inner}{ob}{sep})"

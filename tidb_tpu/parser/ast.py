@@ -86,6 +86,7 @@ class Like(Node):
     operand: Node
     pattern: Node
     negated: bool = False
+    regexp: bool = False  # a REGEXP/RLIKE b (search semantics, not LIKE)
 
 
 @dataclass
@@ -96,6 +97,7 @@ class FuncCall(Node):
     star: bool = False  # COUNT(*)
     over: Optional["WindowSpec"] = None  # window call when set
     separator: Optional[str] = None  # GROUP_CONCAT(... SEPARATOR 'x')
+    order_by: Optional[list] = None  # GROUP_CONCAT(... ORDER BY e [DESC])
 
 
 @dataclass
@@ -169,6 +171,8 @@ class TableRef(Node):
     db: str = ""
     alias: str = ""
     as_of: Optional[Node] = None  # stale read: AS OF TIMESTAMP expr
+    # USE/IGNORE/FORCE INDEX (...) table hints: [(kind, [index names])]
+    index_hints: Optional[list] = None
 
 
 @dataclass

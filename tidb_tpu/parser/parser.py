@@ -375,9 +375,28 @@ class Parser:
         elif self.peek().kind in ("ident", "qident") and not self.at_kw(
             "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "JOIN", "ON", "LEFT", "RIGHT",
             "INNER", "CROSS", "SET", "UNION", "INTERSECT", "EXCEPT", "USING", "FOR",
+            "USE", "IGNORE", "FORCE",  # index hints, reserved in MySQL
         ):
             alias = self.ident()
-        return ast.TableRef(name, db=db, alias=alias, as_of=as_of)
+        hints = None
+        while self.at_kw("USE", "IGNORE", "FORCE") and self.peek(1).value.upper() in ("INDEX", "KEY"):
+            kind = self.next().value.lower()
+            self.next()  # INDEX | KEY
+            if self.eat_kw("FOR"):
+                # FOR JOIN | FOR ORDER BY | FOR GROUP BY — scope qualifiers
+                # are accepted and applied globally (single-scan planner)
+                if not self.eat_kw("JOIN"):
+                    self.next()
+                    self.expect_kw("BY")
+            self.expect_op("(")
+            names = []
+            if not self.at_op(")"):
+                names.append("primary" if self.eat_kw("PRIMARY") else self.ident().lower())
+                while self.eat_op(","):
+                    names.append("primary" if self.eat_kw("PRIMARY") else self.ident().lower())
+            self.expect_op(")")
+            hints = (hints or []) + [(kind, names)]
+        return ast.TableRef(name, db=db, alias=alias, as_of=as_of, index_hints=hints)
 
     # -- expressions ---------------------------------------------------------
     def parse_expr(self) -> ast.Node:
@@ -455,6 +474,10 @@ class Parser:
             if self.at_kw("LIKE"):
                 self.next()
                 left = ast.Like(left, self._bitor(), negated=neg)
+                continue
+            if self.at_kw("REGEXP", "RLIKE"):
+                self.next()
+                left = ast.Like(left, self._bitor(), negated=neg, regexp=True)
                 continue
             if neg:
                 self.i = save
@@ -678,6 +701,17 @@ class Parser:
                 fc.args.append(self.parse_expr())
                 while self.eat_op(","):
                     fc.args.append(self.parse_expr())
+                if lname == "group_concat" and self.eat_kw("ORDER"):
+                    self.expect_kw("BY")
+                    fc.order_by = []
+                    while True:
+                        e = self.parse_expr()
+                        desc = bool(self.eat_kw("DESC"))
+                        if not desc:
+                            self.eat_kw("ASC")
+                        fc.order_by.append((e, desc))
+                        if not self.eat_op(","):
+                            break
                 if lname == "group_concat" and self.eat_kw("SEPARATOR"):
                     sep = self.peek()
                     if sep.kind != "str":

@@ -802,13 +802,28 @@ class Builder:
             for hname, hargs in self.hints:
                 if hname in ("use_index", "ignore_index") and len(hargs) >= 2:
                     if hargs[0].strip().lower() in (alias.lower(), node.name.lower()):
+                        hnames = [a.strip().lower() for a in hargs[1:]]
                         if hname == "use_index":
-                            scan.use_index = hargs[1].strip().lower()
+                            scan.use_index = hnames[0]
+                            scan.allowed_indexes = frozenset(hnames) | (scan.allowed_indexes or frozenset())
                         else:
-                            scan.ignore_index = hargs[1].strip().lower()
+                            scan.ignored_indexes = scan.ignored_indexes | frozenset(hnames)
                 elif hname == "use_index_merge" and hargs:
                     if hargs[0].strip().lower() in (alias.lower(), node.name.lower()):
                         scan.use_index_merge = True
+            for kind, names in node.index_hints or []:
+                # table-level USE/IGNORE/FORCE INDEX (...) — MySQL merges
+                # every clause on the reference: USE/FORCE union into the
+                # candidate restriction (empty = USE INDEX () = table scan),
+                # IGNORE unions into the exclusion set (ref: the
+                # tableHintInfo → path pruning in planbuilder.go)
+                if kind in ("use", "force"):
+                    # restriction only — cost still chooses among the hinted
+                    # candidates (MySQL: USE/FORCE narrow the set; only the
+                    # /*+ use_index */ optimizer hint pins one index)
+                    scan.allowed_indexes = frozenset(names) | (scan.allowed_indexes or frozenset())
+                else:
+                    scan.ignored_indexes = scan.ignored_indexes | frozenset(names)
             scan.schema = [
                 OutCol(c.name, c.ftype, table=alias, slot=c.offset) for c in t.columns
             ]
@@ -938,7 +953,8 @@ class Builder:
             e = func("and", self._binary("ge", operand, lo), self._binary("le", operand, hi))
             return func("not", e) if node.negated else e
         if isinstance(node, ast.Like):
-            e = func("like", self._resolve(node.operand, ctx), self._resolve(node.pattern, ctx))
+            sig = "regexp" if node.regexp else "like"
+            e = func(sig, self._resolve(node.operand, ctx), self._resolve(node.pattern, ctx))
             return func("not", e) if node.negated else e
         if isinstance(node, ast.FuncCall) and node.name in ("date_add", "date_sub", "adddate", "subdate") and len(node.args) == 2 and isinstance(node.args[1], ast.FuncCall) and node.args[1].name == "interval":
             base = self._resolve(node.args[0], ctx)
@@ -1183,8 +1199,17 @@ class Builder:
                             arg = func("concat", *parts)
                         else:
                             arg = self.resolve(n.args[0], BuildCtx(base_schema))
+                        gc_order = []
+                        if name == "group_concat" and n.order_by:
+                            gc_order = [
+                                (self.resolve(e, BuildCtx(base_schema)), d) for e, d in n.order_by
+                            ]
                         desc = AggDesc(
-                            name, arg, distinct=n.distinct, sep=n.separator if n.separator is not None else ","
+                            name,
+                            arg,
+                            distinct=n.distinct,
+                            sep=n.separator if n.separator is not None else ",",
+                            order_by=gc_order,
                         )
                     for i, existing in enumerate(aggs):
                         if repr(existing) == repr(desc):

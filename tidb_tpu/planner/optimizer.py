@@ -161,11 +161,19 @@ def _prune(plan: LogicalPlan, needed: Optional[set[int]]):
         for a in plan.aggs:
             if a.arg is not None:
                 _expr_cols(a.arg, child_needed)
+            for e, _ in a.order_by:
+                _expr_cols(e, child_needed)
         child, cmap = _prune(plan.children[0], child_needed)
         plan.children = [child]
         plan.group_by = [_remap_expr(g, cmap) for g in plan.group_by]
         plan.aggs = [
-            AggDesc(a.name, _remap_expr(a.arg, cmap) if a.arg is not None else None, a.distinct, a.sep)
+            AggDesc(
+                a.name,
+                _remap_expr(a.arg, cmap) if a.arg is not None else None,
+                a.distinct,
+                a.sep,
+                order_by=[(_remap_expr(e, cmap), d) for e, d in a.order_by],
+            )
             for a in plan.aggs
         ]
         return plan, {i: i for i in range(len(plan.schema))}
@@ -352,6 +360,15 @@ _COST_LOOKUP_ROW = 6.0
 _COST_SETUP = 40.0
 
 
+def _idx_eligible(scan, idx) -> bool:
+    """Hint-aware candidate filter: public state, not IGNOREd, and inside
+    the USE/FORCE restriction when one is present (an empty restriction —
+    USE INDEX () — allows nothing, forcing the table scan)."""
+    if idx.state != "public" or idx.name in scan.ignored_indexes:
+        return False
+    return scan.allowed_indexes is None or idx.name in scan.allowed_indexes
+
+
 def _choose_index_path(scan: LogicalScan, conds: list[Expression], stats=None):
     """Access-path choice. With statistics: estimate rows per candidate index
     from histograms and compare costs against the columnar full scan (ref:
@@ -360,7 +377,8 @@ def _choose_index_path(scan: LogicalScan, conds: list[Expression], stats=None):
     PK handle ranges are handled by _derive_ranges on the table-reader path."""
     t = scan.table
     if scan.use_index is not None:
-        idx = next((i for i in t.indexes if i.name == scan.use_index and i.state == "public"), None)
+        # the forced pick still honors IGNORE/USE sets (IGNORE beats USE)
+        idx = next((i for i in t.indexes if i.name == scan.use_index and _idx_eligible(scan, i)), None)
         if idx is not None:
             forced = _index_path_for(scan, idx, conds)
             if forced is not None:
@@ -379,7 +397,7 @@ def _choose_index_path(scan: LogicalScan, conds: list[Expression], stats=None):
         # full columnar scan baseline: sequential, device-friendly
         best_cost = float(total) * _COST_TABLE_ROW
         for idx in t.indexes:
-            if idx.state != "public" or idx.name == scan.ignore_index:
+            if not _idx_eligible(scan, idx):
                 continue  # in-flight online-DDL / hint-ignored indexes
             acc = ranger.detach_index_conditions(conds, scan.schema, t, idx)
             if acc is None or not acc.used:
@@ -395,7 +413,7 @@ def _choose_index_path(scan: LogicalScan, conds: list[Expression], stats=None):
                 best = ((), acc)
     else:
         for idx in t.indexes:
-            if idx.state != "public" or idx.name == scan.ignore_index:
+            if not _idx_eligible(scan, idx):
                 continue  # in-flight online-DDL / hint-ignored indexes
             acc = ranger.detach_index_conditions(conds, scan.schema, t, idx)
             if acc is None or acc.eq_prefix_len == 0:
@@ -466,7 +484,7 @@ def _try_index_merge(scan: LogicalScan, conds: list[Expression], stats=None):
         if path is None:
             best = None
             for idx in t.indexes:
-                if idx.state != "public" or idx.name == scan.ignore_index:
+                if not _idx_eligible(scan, idx):
                     continue
                 acc = ranger.detach_index_conditions(conjs, scan.schema, t, idx)
                 if acc is None or not acc.used:
@@ -684,7 +702,10 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None, vars=None) -> P
                 na = _remap_through(a.arg) if a.arg is not None else None
                 if a.arg is not None and na is None:
                     remap_ok = False
-                aggs_r.append(AggDesc(a.name, na, a.distinct, a.sep))
+                ob = [(_remap_through(e), d) for e, d in a.order_by]
+                if any(e is None for e, _ in ob):
+                    remap_ok = False
+                aggs_r.append(AggDesc(a.name, na, a.distinct, a.sep, order_by=ob))
             remap_ok = remap_ok and all(g is not None for g in group_r)
         can_push = (
             remap_ok

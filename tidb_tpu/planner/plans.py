@@ -49,8 +49,11 @@ class LogicalScan(LogicalPlan):
     ranges: Optional[list[KeyRange]] = None
     # optimizer hints targeting this table (ref: USE_INDEX/IGNORE_INDEX/
     # USE_INDEX_MERGE)
-    use_index: Optional[str] = None
-    ignore_index: Optional[str] = None
+    use_index: Optional[str] = None  # preferred index (tried first)
+    # candidate restriction from USE/FORCE INDEX (None = every index);
+    # an EMPTY set (USE INDEX ()) allows none — forced table scan
+    allowed_indexes: Optional[frozenset] = None
+    ignored_indexes: frozenset = frozenset()
     use_index_merge: bool = False
 
 
