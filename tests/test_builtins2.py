@@ -425,3 +425,22 @@ def test_ignore_overrides_use_and_field_ci():
     d.execute("CREATE TABLE fci (s VARCHAR(5) COLLATE utf8mb4_general_ci, b VARCHAR(5))")
     d.execute("INSERT INTO fci VALUES ('A', 'A')")
     assert s.query("SELECT FIELD(s, 'a', 'b'), FIELD(b, 'a', 'b') FROM fci") == [(1, 0)]
+
+
+def test_force_index_range_and_unknown_name():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE fi (id BIGINT PRIMARY KEY, g BIGINT)")
+    d.execute("INSERT INTO fi VALUES (1,1),(2,5),(3,9)")
+    d.execute("CREATE INDEX idx_g ON fi (g)")
+    s = d.session()
+    # FORCE INDEX uses the index even for a range-only predicate (no-stats
+    # heuristics would otherwise table-scan); USE INDEX stays cost-driven
+    p = "\n".join(str(r[0]) for r in s.query("EXPLAIN SELECT id FROM fi FORCE INDEX (idx_g) WHERE g > 1"))
+    assert "idx_g" in p, p
+    assert s.query("SELECT id FROM fi FORCE INDEX (idx_g) WHERE g > 1 ORDER BY id") == [(2,), (3,)]
+    # a typo'd hint name errors like MySQL ER_KEY_DOES_NOT_EXIST, instead of
+    # silently disabling every index on the table
+    with pytest.raises(Exception, match="doesn't exist"):
+        s.query("SELECT id FROM fi USE INDEX (nope) WHERE g = 1")
+    with pytest.raises(Exception, match="doesn't exist"):
+        s.query("SELECT id FROM fi IGNORE INDEX (nope) WHERE g = 1")

@@ -57,3 +57,27 @@ def test_json_functions(db):
     assert s.query("SELECT JSON_VALID('{}'), JSON_VALID('nope')") == [(1, 0)]
     # filter on a JSON path
     assert s.query("SELECT id FROM j WHERE d ->> '$.a' = '1'") == [(1,)]
+
+
+def test_json_length_keys_contains_path():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE jl (id BIGINT PRIMARY KEY, doc VARCHAR(200))")
+    d.execute(
+        """INSERT INTO jl VALUES (1,'{"a": [1,2,3], "b": {"x": 1}}'),"""
+        """(2,'[1,2]'),(3,'5'),(4,NULL)"""
+    )
+    s = d.session()
+    assert s.query(
+        "SELECT id, JSON_LENGTH(doc), JSON_LENGTH(doc, '$.a') FROM jl ORDER BY id"
+    ) == [(1, 2, 3), (2, 2, None), (3, 1, None), (4, None, None)]
+    assert s.query("SELECT JSON_KEYS(doc), JSON_KEYS(doc, '$.b') FROM jl WHERE id = 1") == [
+        ('["a", "b"]', '["x"]')
+    ]
+    assert s.query("SELECT JSON_KEYS(doc) FROM jl WHERE id = 2") == [(None,)]
+    assert s.query(
+        "SELECT JSON_CONTAINS_PATH(doc, 'one', '$.a', '$.zz'),"
+        " JSON_CONTAINS_PATH(doc, 'all', '$.a', '$.zz'),"
+        " JSON_CONTAINS_PATH(doc, 'all', '$.a', '$.b.x') FROM jl WHERE id = 1"
+    ) == [(1, 0, 1)]
+    with pytest.raises(Exception, match="one' or 'all"):
+        s.query("SELECT JSON_CONTAINS_PATH(doc, 'some', '$.a') FROM jl WHERE id = 1")

@@ -1194,6 +1194,105 @@ def _json_valid(xp, args, ctx):
     return out, v
 
 
+@register("json_length", lambda args: bigint_type(nullable=True), engines=HOST_ONLY, variadic=True, arity=2)
+def _json_length(xp, args, ctx):
+    """JSON_LENGTH(doc[, path]): elements of an array, keys of an object,
+    1 for scalars; NULL on missing path (ref: builtin_json JSONLength)."""
+    import json as _json
+
+    import numpy as np
+
+    docs, _ = _decode_strs(ctx, 0)
+    paths = _decode_strs(ctx, 1)[0] if len(args) > 1 else None
+    n = max(len(docs), len(paths) if paths else 1)
+    out = np.zeros(n, dtype=np.int64)
+    valid = np.ones(n, dtype=bool)
+    for i in range(n):
+        d = docs[i if len(docs) > 1 else 0]
+        p = paths[i if len(paths) > 1 else 0] if paths else b"$"
+        if d is None or p is None:
+            valid[i] = False
+            continue
+        try:
+            doc = _json.loads(d)
+        except Exception:
+            valid[i] = False
+            continue
+        got = _json_path_get(doc, p.decode() if isinstance(p, bytes) else p)
+        if got is _JSON_MISS:
+            valid[i] = False
+        elif isinstance(got, (dict, list)):
+            out[i] = len(got)
+        else:
+            out[i] = 1
+    return out, valid
+
+
+@register("json_keys", lambda args: FieldType(TypeKind.STRING, nullable=True, json=True), engines=HOST_ONLY, variadic=True, arity=2)
+def _json_keys(xp, args, ctx):
+    """JSON_KEYS(doc[, path]): object keys as a JSON array; NULL for
+    non-objects or missing paths (ref: builtin_json JSONKeys)."""
+    import json as _json
+
+    docs, _ = _decode_strs(ctx, 0)
+    paths = _decode_strs(ctx, 1)[0] if len(args) > 1 else None
+    out = []
+    n = max(len(docs), len(paths) if paths else 1)
+    for i in range(n):
+        d = docs[i if len(docs) > 1 else 0]
+        p = paths[i if len(paths) > 1 else 0] if paths else b"$"
+        if d is None or p is None:
+            out.append(None)
+            continue
+        try:
+            doc = _json.loads(d)
+        except Exception:
+            out.append(None)
+            continue
+        got = _json_path_get(doc, p.decode() if isinstance(p, bytes) else p)
+        out.append(_json_dump(list(got.keys())) if isinstance(got, dict) else None)
+    return _encode_strs(ctx, out)
+
+
+@register("json_contains_path", lambda args: bigint_type(nullable=True), engines=HOST_ONLY, variadic=True, arity=3)
+def _json_contains_path(xp, args, ctx):
+    """JSON_CONTAINS_PATH(doc, 'one'|'all', p1, p2, ...)."""
+    import json as _json
+
+    import numpy as np
+
+    docs, _ = _decode_strs(ctx, 0)
+    modes, _ = _decode_strs(ctx, 1)
+    pcols = [_decode_strs(ctx, i)[0] for i in range(2, len(args))]
+    n = max(len(docs), len(modes), *(len(c) for c in pcols))
+    out = np.zeros(n, dtype=np.int64)
+    valid = np.ones(n, dtype=bool)
+    for i in range(n):
+        d = docs[i if len(docs) > 1 else 0]
+        m = modes[i if len(modes) > 1 else 0]
+        if d is None or m is None:
+            valid[i] = False
+            continue
+        m = m.lower()
+        if m not in (b"one", b"all"):
+            raise ValueError("The oneOrAll argument to json_contains_path may take these values: 'one' or 'all'")
+        try:
+            doc = _json.loads(d)
+        except Exception:
+            valid[i] = False
+            continue
+        hits = []
+        for c in pcols:
+            p = c[i if len(c) > 1 else 0]
+            if p is None:
+                valid[i] = False
+                break
+            hits.append(_json_path_get(doc, p.decode() if isinstance(p, bytes) else p) is not _JSON_MISS)
+        else:
+            out[i] = int(any(hits) if m == b"one" else all(hits))
+    return out, valid
+
+
 @register("json_type", lambda args: string_type(), engines=HOST_ONLY, arity=1)
 def _json_type(xp, args, ctx):
     import json as _json

@@ -811,17 +811,24 @@ class Builder:
                 elif hname == "use_index_merge" and hargs:
                     if hargs[0].strip().lower() in (alias.lower(), node.name.lower()):
                         scan.use_index_merge = True
+            known = {i.name for i in t.indexes} | ({"primary"} if t.pk_is_handle else set())
             for kind, names in node.index_hints or []:
                 # table-level USE/IGNORE/FORCE INDEX (...) — MySQL merges
                 # every clause on the reference: USE/FORCE union into the
-                # candidate restriction (empty = USE INDEX () = table scan),
-                # IGNORE unions into the exclusion set (ref: the
-                # tableHintInfo → path pruning in planbuilder.go)
+                # candidate restriction (empty = USE INDEX () = table scan)
+                # with cost choosing among the candidates, IGNORE unions
+                # into the exclusion set, FORCE additionally demotes the
+                # table scan to a last resort (ref: the tableHintInfo →
+                # path pruning in planbuilder.go)
+                for nm in names:
+                    if nm not in known:
+                        # ER_KEY_DOES_NOT_EXIST — a typo must not silently
+                        # disable every index on the table
+                        raise PlanError(f"Key '{nm}' doesn't exist in table '{t.name}'")
                 if kind in ("use", "force"):
-                    # restriction only — cost still chooses among the hinted
-                    # candidates (MySQL: USE/FORCE narrow the set; only the
-                    # /*+ use_index */ optimizer hint pins one index)
                     scan.allowed_indexes = frozenset(names) | (scan.allowed_indexes or frozenset())
+                    if kind == "force":
+                        scan.force_index = True
                 else:
                     scan.ignored_indexes = scan.ignored_indexes | frozenset(names)
             scan.schema = [

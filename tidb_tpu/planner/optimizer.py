@@ -394,8 +394,9 @@ def _choose_index_path(scan: LogicalScan, conds: list[Expression], stats=None):
         from tidb_tpu.statistics.selectivity import estimate_selectivity
 
         total = tstats.row_count
-        # full columnar scan baseline: sequential, device-friendly
-        best_cost = float(total) * _COST_TABLE_ROW
+        # full columnar scan baseline: sequential, device-friendly —
+        # unless FORCE INDEX demotes it to a last resort
+        best_cost = float("inf") if scan.force_index else float(total) * _COST_TABLE_ROW
         for idx in t.indexes:
             if not _idx_eligible(scan, idx):
                 continue  # in-flight online-DDL / hint-ignored indexes
@@ -416,7 +417,11 @@ def _choose_index_path(scan: LogicalScan, conds: list[Expression], stats=None):
             if not _idx_eligible(scan, idx):
                 continue  # in-flight online-DDL / hint-ignored indexes
             acc = ranger.detach_index_conditions(conds, scan.schema, t, idx)
-            if acc is None or acc.eq_prefix_len == 0:
+            if acc is None or not acc.used:
+                continue
+            if acc.eq_prefix_len == 0 and not scan.force_index:
+                # range-only access wins no heuristic without stats — except
+                # under FORCE INDEX, where the table scan is the last resort
                 continue
             key = (acc.eq_prefix_len, idx.unique, acc.has_range)
             if best is None or key > best[0]:

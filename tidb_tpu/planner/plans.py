@@ -54,6 +54,8 @@ class LogicalScan(LogicalPlan):
     # an EMPTY set (USE INDEX ()) allows none — forced table scan
     allowed_indexes: Optional[frozenset] = None
     ignored_indexes: frozenset = frozenset()
+    # FORCE INDEX: a table scan becomes the last resort, not a baseline
+    force_index: bool = False
     use_index_merge: bool = False
 
 
