@@ -1,0 +1,146 @@
+"""Round-3 builtin batch: radix/byte strings, digests, trig, calendar
+periods, TIMESTAMPDIFF/ADD (ref: builtin_string.go / builtin_math.go /
+builtin_time.go)."""
+
+import datetime
+
+import pytest
+
+import tidb_tpu
+
+
+@pytest.fixture()
+def db():
+    return tidb_tpu.open()
+
+
+def q(db, sql):
+    return db.session().query(sql)
+
+
+def test_radix_and_bytes(db):
+    assert q(db, "SELECT HEX(255), HEX('AB'), UNHEX('4142'), BIN(5), OCT(8)") == [
+        ("FF", "4142", "AB", "101", "10")
+    ]
+    assert q(db, "SELECT CONV('ff',16,10), CONV(10,10,-2), CONV(-1,10,16)") == [
+        ("255", "1010", "FFFFFFFFFFFFFFFF")
+    ]
+    assert q(db, "SELECT CHAR(65,66), ORD('A'), ORD('€'), ASCII('A'), SPACE(2)") == [
+        ("AB", 65, 14844588, 65, "  ")
+    ]
+    assert q(db, "SELECT QUOTE(\"a'b\"), QUOTE(NULL)") == [("'a\\'b'", "NULL")]
+    assert q(db, "SELECT SOUNDEX('Robert'), SOUNDEX('Rupert'), SOUNDEX('')") == [
+        ("R163", "R163", "")
+    ]
+    assert q(db, "SELECT FORMAT(1234567.891, 2), FORMAT(12, 0)") == [("1,234,567.89", "12")]
+
+
+def test_sets_and_nets(db):
+    assert q(db, "SELECT FIND_IN_SET('b','a,b,c'), FIND_IN_SET('q','a,b'), FIND_IN_SET(NULL,'a')") == [
+        (2, 0, None)
+    ]
+    assert q(db, "SELECT SUBSTRING_INDEX('a.b.c','.',2), SUBSTRING_INDEX('a.b.c','.',-1), SUBSTRING_INDEX('abc','.',1)") == [
+        ("a.b", "c", "abc")
+    ]
+    assert q(db, "SELECT EXPORT_SET(5,'Y','N',',',4), MAKE_SET(5,'a','b','c'), MAKE_SET(1|4,'x',NULL,'z')") == [
+        ("Y,N,Y,N", "a,c", "x,z")
+    ]
+    assert q(db, "SELECT INET_ATON('1.2.3.4'), INET_ATON('bad'), INET_NTOA(16909060), INET_NTOA(-1)") == [
+        (16909060, None, "1.2.3.4", None)
+    ]
+
+
+def test_digests(db):
+    assert q(db, "SELECT CRC32('abc'), MD5('abc'), SHA1(''), SHA2('abc',0), SHA2('abc',999)") == [
+        (
+            891568578,
+            "900150983cd24fb0d6963f7d28e17f72",
+            "da39a3ee5e6b4b0d3255bfef95601890afd80709",
+            "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad",
+            None,
+        )
+    ]
+
+
+def test_trig(db):
+    rows = q(db, "SELECT SIN(0), COS(0), ROUND(DEGREES(PI()),2), ROUND(RADIANS(180),5), ROUND(ATAN(1,1),4), ROUND(ATAN2(1,1),4), COT(0), ROUND(COT(1),4), ROUND(ASIN(1),4), ACOS(5)")
+    assert rows == [(0.0, 1.0, 180.0, 3.14159, 0.7854, 0.7854, None, 0.6421, 1.5708, None)]
+
+
+def test_periods_fromdays_yearweek(db):
+    assert q(db, "SELECT PERIOD_ADD(202401,2), PERIOD_ADD(9912,1), PERIOD_DIFF(202402,202312)") == [
+        (202403, 200001, 2)
+    ]
+    assert q(db, "SELECT FROM_DAYS(739000), FROM_DAYS(TO_DAYS('2024-05-17'))") == [
+        (datetime.date(2023, 4, 25), datetime.date(2024, 5, 17))
+    ]
+    assert q(db, "SELECT YEARWEEK('2024-01-05'), YEARWEEK('2024-01-05', 1)") == [(202353, 202401)]
+
+
+def test_timestampdiff_add(db):
+    assert q(db, "SELECT TIMESTAMPDIFF(DAY,'2024-01-01','2024-02-15'),"
+                " TIMESTAMPDIFF(MONTH,'2024-01-31','2024-02-29'),"
+                " TIMESTAMPDIFF(MONTH,'2024-01-15','2024-03-14'),"
+                " TIMESTAMPDIFF(YEAR,'2022-06-01','2024-05-31'),"
+                " TIMESTAMPDIFF(QUARTER,'2023-01-01','2024-01-01')") == [(45, 0, 1, 1, 4)]
+    assert q(db, "SELECT TIMESTAMPDIFF(HOUR,'2024-01-01 00:00:00','2024-01-01 05:30:00'),"
+                " TIMESTAMPDIFF(MINUTE,'2024-01-01 00:00:00','2024-01-01 01:30:30'),"
+                " TIMESTAMPDIFF(WEEK,'2024-01-01','2024-01-20'),"
+                " TIMESTAMPDIFF(DAY,'2024-02-15','2024-01-01')") == [(5, 90, 2, -45)]
+    assert q(db, "SELECT TIMESTAMPADD(DAY, 10, '2024-01-01'),"
+                " TIMESTAMPADD(SQL_TSI_MONTH, 1, '2024-01-31'),"
+                " TIMESTAMPADD(MINUTE, 30, '2024-01-01 10:00:00')") == [
+        (datetime.date(2024, 1, 11), datetime.date(2024, 2, 29), datetime.datetime(2024, 1, 1, 10, 30))
+    ]
+    with pytest.raises(Exception, match="unit"):
+        q(db, "SELECT TIMESTAMPDIFF(FORTNIGHT,'2024-01-01','2024-02-01')")
+
+
+def test_misc(db):
+    assert q(db, "SELECT ANY_VALUE(7)") == [(7,)]
+    assert q(db, "SELECT LENGTH(UTC_DATE()), LENGTH(UTC_TIMESTAMP())") == [(10, 19)]
+    # table-driven: the batch evaluates per row, not just on constants
+    db.execute("CREATE TABLE b3 (id BIGINT PRIMARY KEY, n BIGINT, s VARCHAR(20))")
+    db.execute("INSERT INTO b3 VALUES (1, 255, 'a,b'), (2, 5, 'x,y'), (3, NULL, NULL)")
+    assert q(db, "SELECT id, HEX(n), FIND_IN_SET('y', s) FROM b3 ORDER BY id") == [
+        (1, "FF", 0), (2, "5", 2), (3, None, None)
+    ]
+
+
+def test_is_null_on_folded_string_functions(db):
+    # constant-folded string functions carry scalar validity; IS [NOT] NULL
+    # must handle it (regression: 'bool' object has no attribute 'astype')
+    assert q(db, "SELECT CONCAT('a','b') IS NULL, ELT(9,'x') IS NOT NULL, UNHEX('zz') IS NULL") == [
+        (0, 0, 1)
+    ]
+
+
+def test_review_fixes(db):
+    db.execute("CREATE TABLE rf (g BIGINT, x BIGINT, dt DATETIME, b BIGINT, n BIGINT)")
+    db.execute(
+        "INSERT INTO rf VALUES (1, 5, '2024-01-15 10:00:00', 5, 1),"
+        "(1, 7, '2024-03-15 09:00:00', 5, 4)"
+    )
+    # ANY_VALUE / TIMESTAMPDIFF inside GROUP BY resolution
+    # 60 days minus one hour truncates to 59 whole days
+    assert q(db, "SELECT g, ANY_VALUE(x), TIMESTAMPDIFF(DAY, MIN(dt), MAX(dt)) FROM rf GROUP BY g") == [
+        (1, 5, 59)
+    ]
+    # month diff compares time-of-day, not just day-of-month
+    assert q(db, "SELECT TIMESTAMPDIFF(MONTH,'2024-01-15 10:00:00','2024-02-15 09:00:00'),"
+                " TIMESTAMPDIFF(MONTH,'2024-01-15 10:00:00','2024-02-15 10:00:00')") == [(0, 1)]
+    # EXPORT_SET reads number_of_bits per row
+    assert q(db, "SELECT x, EXPORT_SET(b,'1','0',',',n) FROM rf ORDER BY x") == [
+        (5, "1"), (7, "1,0,1,0")
+    ]
+    # numeric HEX/BIN/OCT round like MySQL instead of leaking the physical
+    db.execute("CREATE TABLE dec1 (d DECIMAL(4,1))")
+    db.execute("INSERT INTO dec1 VALUES (2.5), (-2.5)")
+    assert q(db, "SELECT HEX(d), BIN(d) FROM dec1 ORDER BY d DESC") == [
+        ("3", "11"), ("FFFFFFFFFFFFFFFD", "1" * 62 + "01")
+    ]
+    # FORMAT rounds half away from zero; CONV keeps the valid prefix
+    assert q(db, "SELECT FORMAT(2.5, 0), FORMAT(3.5, 0), FORMAT(-2.5, 0)") == [("3", "4", "-3")]
+    assert q(db, "SELECT CONV('1Z', 16, 10), CONV('10x', 10, 10), CONV('zz', 10, 10)") == [
+        ("1", "10", "0")
+    ]

@@ -349,7 +349,9 @@ def _or(xp, args, ctx):
 def _not(xp, args, ctx):
     (d, v) = args[0]
     res = d == 0
-    return res.astype("int64"), v
+    # scalar lane from a constant-folded child (e.g. ISNULL on a folded
+    # string function) yields a python bool, not an array
+    return res.astype("int64") if hasattr(res, "astype") else int(res), v
 
 
 @register("xor", infer_bool)
@@ -367,9 +369,9 @@ def _xor(xp, args, ctx):
 @register("isnull", infer_bool, arity=1)
 def _isnull(xp, args, ctx):
     (d, v) = args[0]
-    if v is None:
+    if v is None or v is True:  # scalar True: constant-folded valid value
         z = d != d  # all False
-        return z.astype("int64"), None
+        return z.astype("int64") if hasattr(z, "astype") else 0, None
     if v is False:
         return (d * 0 + 1).astype("int64") if hasattr(d, "astype") else 1, None
     return (~v).astype("int64"), None
@@ -2063,3 +2065,543 @@ def _concat_ws(xp, args, ctx):
         parts = [c[i if len(c) > 1 else 0] for c in cols]
         out.append(sep.join(p for p in parts if p is not None))
     return _encode_strs(ctx, out)
+
+
+# ---------------------------------------------------------------------------
+# trig / angular math (ref: builtin_math.go) — pure elementwise, device-legal
+# ---------------------------------------------------------------------------
+
+
+@register("sin", infer_double, arity=1)
+def _sin(xp, args, ctx):
+    (d, v) = args[0]
+    return xp.sin(d * 1.0), v
+
+
+@register("cos", infer_double, arity=1)
+def _cos(xp, args, ctx):
+    (d, v) = args[0]
+    return xp.cos(d * 1.0), v
+
+
+@register("tan", infer_double, arity=1)
+def _tan(xp, args, ctx):
+    (d, v) = args[0]
+    return xp.tan(d * 1.0), v
+
+
+@register("cot", infer_double, arity=1)
+def _cot(xp, args, ctx):
+    (d, v) = args[0]
+    t = xp.tan(d * 1.0)
+    ok = t != 0
+    return xp.where(ok, 1.0 / xp.where(ok, t, 1.0), 0.0), and_valid(xp, v, ok)
+
+
+@register("asin", infer_double, arity=1)
+def _asin(xp, args, ctx):
+    (d, v) = args[0]
+    d = d * 1.0
+    ok = (d >= -1) & (d <= 1)
+    return xp.arcsin(xp.where(ok, d, 0.0)), and_valid(xp, v, ok)
+
+
+@register("acos", infer_double, arity=1)
+def _acos(xp, args, ctx):
+    (d, v) = args[0]
+    d = d * 1.0
+    ok = (d >= -1) & (d <= 1)
+    return xp.arccos(xp.where(ok, d, 0.0)), and_valid(xp, v, ok)
+
+
+@register("atan", infer_double, variadic=True, arity=1)
+def _atan(xp, args, ctx):
+    (d, v) = args[0]
+    if len(args) == 1:
+        return xp.arctan(d * 1.0), v
+    (d2, v2) = args[1]  # ATAN(y, x) == ATAN2(y, x)
+    return xp.arctan2(d * 1.0, d2 * 1.0), and_valid(xp, v, v2)
+
+
+@register("atan2", infer_double)
+def _atan2(xp, args, ctx):
+    (da, va), (db, vb) = args
+    return xp.arctan2(da * 1.0, db * 1.0), and_valid(xp, va, vb)
+
+
+@register("degrees", infer_double, arity=1)
+def _degrees(xp, args, ctx):
+    (d, v) = args[0]
+    return d * (180.0 / 3.141592653589793), v
+
+
+@register("radians", infer_double, arity=1)
+def _radians(xp, args, ctx):
+    (d, v) = args[0]
+    return d * (3.141592653589793 / 180.0), v
+
+
+@register("crc32", lambda args: FieldType(TypeKind.UINT, nullable=True), engines=HOST_ONLY, arity=1)
+def _crc32(xp, args, ctx):
+    import zlib
+
+    import numpy as np
+
+    strs, v = _decode_strs(ctx, 0)
+    out = np.zeros(len(strs), dtype=np.int64)
+    for i, s in enumerate(strs):
+        if s is not None:
+            out[i] = zlib.crc32(s)
+    return out, v
+
+
+def _digest_fn(algo):
+    def impl(xp, args, ctx):
+        import hashlib
+
+        strs, _ = _decode_strs(ctx, 0)
+        out = []
+        for s in strs:
+            out.append(None if s is None else hashlib.new(algo, s).hexdigest().encode())
+        return _encode_strs(ctx, out)
+
+    return impl
+
+
+register("md5", lambda args: string_type(), engines=HOST_ONLY, arity=1)(_digest_fn("md5"))
+register("sha1", lambda args: string_type(), engines=HOST_ONLY, arity=1)(_digest_fn("sha1"))
+
+
+@register("sha2", lambda args: string_type(nullable=True), engines=HOST_ONLY)
+def _sha2(xp, args, ctx):
+    import hashlib
+
+    strs, _ = _decode_strs(ctx, 0)
+    lens = _int_args(args, 1, len(strs))
+    algos = {0: "sha256", 224: "sha224", 256: "sha256", 384: "sha384", 512: "sha512"}
+    out = []
+    for i, s in enumerate(strs):
+        ln = lens[i if len(lens) > 1 else 0]
+        a = algos.get(ln if ln is not None else -1)
+        out.append(None if s is None or a is None else hashlib.new(a, s).hexdigest().encode())
+    return _encode_strs(ctx, out)
+
+
+# ---------------------------------------------------------------------------
+# radix / byte-wrangling string surface (ref: builtin_string.go)
+# ---------------------------------------------------------------------------
+
+
+
+
+def _round_int_args(xp, args, ctx, i, n):
+    """_int_args with MySQL numeric semantics: DECIMAL physicals descale and
+    FLOATs round half away from zero (HEX(2.5) is the hex of 3, not of the
+    scale-1 physical 25)."""
+    k = ctx.arg_types[i]
+    vals = _int_args(args, i, n)
+    if k.kind == TypeKind.DECIMAL and k.scale:
+        f = 10**k.scale
+        return [None if x is None else (abs(x) + f // 2) // f * (1 if x >= 0 else -1) for x in vals]
+    if k.kind == TypeKind.FLOAT:
+        d, v = args[i]
+        out = []
+        for j in range(n):
+            ok = v is None or v is True or (v if isinstance(v, bool) else (v[j] if hasattr(v, "__len__") else v))
+            x = d if not hasattr(d, "__len__") else d[j if len(d) > 1 else 0]
+            out.append(int(float(x) + (0.5 if float(x) >= 0 else -0.5)) if ok else None)
+        return out
+    return vals
+
+
+@register("hex", lambda args: string_type(), engines=HOST_ONLY, arity=1)
+def _hex(xp, args, ctx):
+    if ctx.arg_types[0].kind == TypeKind.STRING:
+        strs, _ = _decode_strs(ctx, 0)
+        return _encode_strs(ctx, [None if s is None else s.hex().upper().encode() for s in strs])
+    d, v = args[0]
+    vals = _round_int_args(xp, args, ctx, 0, len(d) if hasattr(d, "__len__") else 1)
+    return _encode_strs(ctx, [None if x is None else format(x & (2**64 - 1), "X").encode() for x in vals])
+
+
+@register("unhex", lambda args: string_type(nullable=True), engines=HOST_ONLY, arity=1)
+def _unhex(xp, args, ctx):
+    strs, _ = _decode_strs(ctx, 0)
+    out = []
+    for s in strs:
+        if s is None:
+            out.append(None)
+            continue
+        try:
+            t = s.decode()
+            out.append(bytes.fromhex("0" + t if len(t) % 2 else t))
+        except ValueError:
+            out.append(None)
+    return _encode_strs(ctx, out)
+
+
+def _radix_fn(base):
+    def impl(xp, args, ctx):
+        n = len(args[0][0]) if hasattr(args[0][0], "__len__") else 1
+        vals = _round_int_args(xp, args, ctx, 0, n)
+        fmt = {2: "b", 8: "o", 16: "X"}[base]
+        return _encode_strs(
+            ctx, [None if x is None else format(x & (2**64 - 1), fmt).encode() for x in vals]
+        )
+
+    return impl
+
+
+register("bin", lambda args: string_type(), engines=HOST_ONLY, arity=1)(_radix_fn(2))
+register("oct", lambda args: string_type(), engines=HOST_ONLY, arity=1)(_radix_fn(8))
+
+
+@register("conv", lambda args: string_type(nullable=True), engines=HOST_ONLY, variadic=True, arity=3)
+def _conv(xp, args, ctx):
+    """CONV(N, from_base, to_base); bases 2..36, negative to_base → signed."""
+    strs, _ = _decode_strs(ctx, 0)
+    n = len(strs)
+    fbs = _int_args(args, 1, n)
+    tbs = _int_args(args, 2, n)
+    digits = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    out = []
+    for i, s in enumerate(strs):
+        fb = fbs[i if len(fbs) > 1 else 0]
+        tb = tbs[i if len(tbs) > 1 else 0]
+        if s is None or fb is None or tb is None or not (2 <= abs(fb) <= 36 and 2 <= abs(tb) <= 36):
+            out.append(None)
+            continue
+        t = s.decode().strip()
+        neg_in = t.startswith("-")
+        t = t.lstrip("+-")
+        k = 0
+        while k < len(t) and digits.find(t[k].upper()) not in (-1,) and digits.index(t[k].upper()) < abs(fb):
+            k += 1
+        val = int(t[:k], abs(fb)) if k else 0  # longest valid prefix (strtoll)
+        if neg_in:
+            val = -val
+        signed = tb < 0
+        if not signed:
+            val &= 2**64 - 1
+        neg = val < 0
+        val = abs(val)
+        buf = ""
+        while True:
+            buf = digits[val % abs(tb)] + buf
+            val //= abs(tb)
+            if not val:
+                break
+        out.append((("-" if neg and signed else "") + buf).encode())
+    return _encode_strs(ctx, out)
+
+
+@register("char", lambda args: string_type(nullable=True), engines=HOST_ONLY, variadic=True, arity=1)
+def _char_fn(xp, args, ctx):
+    """CHAR(n, ...): bytes from integer code points (NULL args skipped)."""
+    n = max((len(a[0]) if hasattr(a[0], "__len__") else 1) for a in args)
+    cols = [_int_args(args, i, n) for i in range(len(args))]
+    out = []
+    for i in range(n):
+        bs = b""
+        for c in cols:
+            x = c[i if len(c) > 1 else 0]
+            if x is None:
+                continue
+            x &= 2**32 - 1
+            bs += bytes(reversed([(x >> (8 * k)) & 0xFF for k in range(4) if x >> (8 * k)])) or b"\x00"
+        out.append(bs)
+    return _encode_strs(ctx, out)
+
+
+@register("ord", lambda args: bigint_type(), engines=HOST_ONLY, arity=1)
+def _ord(xp, args, ctx):
+    """ORD: leading-byte code, multibyte-aware for UTF-8 heads."""
+    import numpy as np
+
+    strs, v = _decode_strs(ctx, 0)
+    out = np.zeros(len(strs), dtype=np.int64)
+    for i, s in enumerate(strs):
+        if not s:
+            continue
+        nb = 1
+        b0 = s[0]
+        if b0 >= 0xF0:
+            nb = 4
+        elif b0 >= 0xE0:
+            nb = 3
+        elif b0 >= 0xC0:
+            nb = 2
+        acc = 0
+        for b in s[:nb]:
+            acc = acc * 256 + b
+        out[i] = acc
+    return out, v
+
+
+@register("space", lambda args: string_type(nullable=True), engines=HOST_ONLY, arity=1)
+def _space(xp, args, ctx):
+    n = len(args[0][0]) if hasattr(args[0][0], "__len__") else 1
+    vals = _int_args(args, 0, n)
+    return _encode_strs(ctx, [None if x is None or x < 0 else b" " * min(int(x), 1 << 20) for x in vals])
+
+
+@register("quote", lambda args: string_type(), engines=HOST_ONLY, arity=1)
+def _quote(xp, args, ctx):
+    strs, _ = _decode_strs(ctx, 0)
+    out = []
+    for s in strs:
+        if s is None:
+            out.append(b"NULL")
+            continue
+        q = s.replace(b"\\", b"\\\\").replace(b"'", b"\\'").replace(b"\x00", b"\\0").replace(b"\x1a", b"\\Z")
+        out.append(b"'" + q + b"'")
+    return _encode_strs(ctx, out)
+
+
+@register("soundex", lambda args: string_type(), engines=HOST_ONLY, arity=1)
+def _soundex(xp, args, ctx):
+    codes = {c: d for cs, d in (("BFPV", "1"), ("CGJKQSXZ", "2"), ("DT", "3"), ("L", "4"), ("MN", "5"), ("R", "6")) for c in cs}
+    out = []
+    strs, _ = _decode_strs(ctx, 0)
+    for s in strs:
+        if s is None:
+            out.append(None)
+            continue
+        t = "".join(c for c in s.decode("utf-8", "replace").upper() if c.isalpha())
+        if not t:
+            out.append(b"")
+            continue
+        res = t[0]
+        prev = codes.get(t[0], "")
+        for c in t[1:]:
+            d = codes.get(c, "")
+            if d and d != prev:
+                res += d
+            if c not in "HW":  # H/W are transparent for adjacency
+                prev = d
+        out.append((res + "000")[: max(4, len(res))].encode())
+    return _encode_strs(ctx, out)
+
+
+@register("format", lambda args: string_type(nullable=True), engines=HOST_ONLY, variadic=True, arity=2)
+def _format(xp, args, ctx):
+    """FORMAT(X, D): thousands separators + D decimals (en_US locale)."""
+    d, v = args[0]
+    scale = ctx.arg_types[0].scale if ctx.arg_types[0].kind == TypeKind.DECIMAL else None
+    n = len(d) if hasattr(d, "__len__") else 1
+    decs = _int_args(args, 1, n)
+    out = []
+    for i in range(n):
+        ok = v is None or (v if not hasattr(v, "__len__") else v[i])
+        x = d if not hasattr(d, "__len__") else d[i]
+        dd = decs[i if len(decs) > 1 else 0]
+        if not ok or dd is None:
+            out.append(None)
+            continue
+        from decimal import ROUND_HALF_UP, Decimal
+
+        val = Decimal(int(x)).scaleb(-scale) if scale is not None else Decimal(repr(float(x)))
+        dd = max(0, min(int(dd), 30))
+        q = val.quantize(Decimal(1).scaleb(-dd), rounding=ROUND_HALF_UP)
+        out.append(f"{q:,.{dd}f}".encode())
+    return _encode_strs(ctx, out)
+
+
+@register("find_in_set", lambda args: bigint_type(), engines=HOST_ONLY)
+def _find_in_set(xp, args, ctx):
+    import numpy as np
+
+    needles, _ = _decode_strs(ctx, 0)
+    hays, _ = _decode_strs(ctx, 1)
+    n = max(len(needles), len(hays))
+    out = np.zeros(n, dtype=np.int64)
+    valid = np.ones(n, dtype=bool)
+    for i in range(n):
+        x = needles[i if len(needles) > 1 else 0]
+        h = hays[i if len(hays) > 1 else 0]
+        if x is None or h is None:
+            valid[i] = False
+        elif h:
+            parts = h.split(b",")
+            out[i] = parts.index(x) + 1 if x in parts else 0
+    return out, valid
+
+
+@register("substring_index", lambda args: string_type(), engines=HOST_ONLY, variadic=True, arity=3)
+def _substring_index(xp, args, ctx):
+    strs, _ = _decode_strs(ctx, 0)
+    delims, _ = _decode_strs(ctx, 1)
+    n = max(len(strs), len(delims))
+    counts = _int_args(args, 2, n)
+    out = []
+    for i in range(n):
+        s = strs[i if len(strs) > 1 else 0]
+        dl = delims[i if len(delims) > 1 else 0]
+        c = counts[i if len(counts) > 1 else 0]
+        if s is None or dl is None or c is None:
+            out.append(None)
+        elif not dl or c == 0:
+            out.append(b"")
+        else:
+            parts = s.split(dl)
+            out.append(dl.join(parts[:c] if c > 0 else parts[c:]))
+    return _encode_strs(ctx, out)
+
+
+@register("export_set", lambda args: string_type(), engines=HOST_ONLY, variadic=True, arity=5)
+def _export_set(xp, args, ctx):
+    bits = _int_args(args, 0, len(args[0][0]) if hasattr(args[0][0], "__len__") else 1)
+    ons, _ = _decode_strs(ctx, 1)
+    offs, _ = _decode_strs(ctx, 2)
+    seps = _decode_strs(ctx, 3)[0] if len(args) > 3 else [b","]
+    n = max(len(bits), len(ons), len(offs))
+    nbits = _int_args(args, 4, n) if len(args) > 4 else [64]
+    out = []
+    for i in range(n):
+        b = bits[i if len(bits) > 1 else 0]
+        on = ons[i if len(ons) > 1 else 0]
+        off = offs[i if len(offs) > 1 else 0]
+        sep = seps[i if len(seps) > 1 else 0]
+        nb = nbits[i if len(nbits) > 1 else 0]
+        if b is None or on is None or off is None or sep is None or nb is None:
+            out.append(None)
+            continue
+        nb = min(max(int(nb), 0), 64)
+        out.append(sep.join(on if (b >> k) & 1 else off for k in range(nb)))
+    return _encode_strs(ctx, out)
+
+
+@register("make_set", lambda args: string_type(nullable=True), engines=HOST_ONLY, variadic=True, arity=2)
+def _make_set(xp, args, ctx):
+    bits = _int_args(args, 0, len(args[0][0]) if hasattr(args[0][0], "__len__") else 1)
+    cols = [_decode_strs(ctx, i)[0] for i in range(1, len(args))]
+    out = []
+    n = max([len(bits)] + [len(c) for c in cols])
+    for i in range(n):
+        b = bits[i if len(bits) > 1 else 0]
+        if b is None:
+            out.append(None)
+            continue
+        parts = []
+        for k, c in enumerate(cols):
+            v = c[i if len(c) > 1 else 0]
+            if (b >> k) & 1 and v is not None:
+                parts.append(v)
+        out.append(b",".join(parts))
+    return _encode_strs(ctx, out)
+
+
+@register("inet_aton", lambda args: FieldType(TypeKind.UINT, nullable=True), engines=HOST_ONLY, arity=1)
+def _inet_aton(xp, args, ctx):
+    import numpy as np
+
+    strs, _ = _decode_strs(ctx, 0)
+    out = np.zeros(len(strs), dtype=np.int64)
+    valid = np.ones(len(strs), dtype=bool)
+    for i, s in enumerate(strs):
+        if s is None:
+            valid[i] = False
+            continue
+        parts = s.split(b".")
+        try:
+            octs = [int(p) for p in parts]
+        except ValueError:
+            valid[i] = False
+            continue
+        if not 1 <= len(octs) <= 4 or any(not 0 <= o <= 255 for o in octs):
+            valid[i] = False
+            continue
+        # MySQL: 'a.b' == a<<24 | b (short forms widen the LAST octet)
+        acc = 0
+        for o in octs[:-1]:
+            acc = (acc << 8) | o
+        out[i] = (acc << (8 * (4 - len(octs) + 1))) | octs[-1] if len(octs) > 1 else octs[0]
+    return out, valid
+
+
+@register("inet_ntoa", lambda args: string_type(nullable=True), engines=HOST_ONLY, arity=1)
+def _inet_ntoa(xp, args, ctx):
+    n = len(args[0][0]) if hasattr(args[0][0], "__len__") else 1
+    vals = _int_args(args, 0, n)
+    out = []
+    for x in vals:
+        if x is None or not 0 <= x <= 2**32 - 1:
+            out.append(None)
+        else:
+            out.append(".".join(str((x >> s) & 0xFF) for s in (24, 16, 8, 0)).encode())
+    return _encode_strs(ctx, out)
+
+
+# ---------------------------------------------------------------------------
+# calendar periods + FROM_DAYS/YEARWEEK/TIMESTAMPDIFF internals
+# (ref: builtin_time.go periodAdd/periodDiff/fromDays/yearWeek/timestampDiff)
+# ---------------------------------------------------------------------------
+
+
+def _period_to_months(xp, p):
+    y = p // 100
+    m = p % 100
+    y = xp.where(y < 70, y + 2000, xp.where(y < 100, y + 1900, y))
+    return y * 12 + m - 1
+
+
+@register("period_add", lambda args: bigint_type())
+def _period_add(xp, args, ctx):
+    (p, vp), (n, vn) = args
+    months = _period_to_months(xp, p) + n
+    return (months // 12) * 100 + months % 12 + 1, and_valid(xp, vp, vn)
+
+
+@register("period_diff", lambda args: bigint_type())
+def _period_diff(xp, args, ctx):
+    (p1, v1), (p2, v2) = args
+    return _period_to_months(xp, p1) - _period_to_months(xp, p2), and_valid(xp, v1, v2)
+
+
+@register("from_days", lambda args: FieldType(TypeKind.DATE, nullable=True), arity=1)
+def _from_days(xp, args, ctx):
+    (d, v) = args[0]
+    days = d - 719528  # MySQL day number → epoch days
+    ok = (days >= -719162) & (days <= 2932896)  # year 1..9999
+    return xp.where(ok, days, 0), and_valid(xp, v, ok)
+
+
+@register("yearweek", lambda args: bigint_type(), variadic=True, arity=1)
+def _yearweek(xp, args, ctx):
+    d, v = _to_days_any(xp, ctx, 0)
+    mode = 0
+    if len(args) > 1:
+        m0, mv = args[1]
+        mode = int(m0 if not hasattr(m0, "__len__") else m0[0])
+        v = and_valid(xp, v, mv)
+    # YEARWEEK uses the week-year-coupled modes (WEEK mode | 2 semantics)
+    week, wy = _calc_week(xp, d, mode & 7 | 2)
+    return wy * 100 + week, v
+
+
+@register("tsdiff_micros", lambda args: bigint_type())
+def _tsdiff_micros(xp, args, ctx):
+    a = _temporal_micros(xp, ctx, 0, args)
+    b = _temporal_micros(xp, ctx, 1, args)
+    if a is None or b is None:
+        raise ValueError("TIMESTAMPDIFF needs temporal operands")
+    return b[0] - a[0], and_valid(xp, a[1], b[1])
+
+
+@register("tsdiff_months", lambda args: bigint_type())
+def _tsdiff_months(xp, args, ctx):
+    """Whole calendar months from arg0 to arg1, truncated toward zero down
+    to microseconds (ref: types/mytime.go monthDiff)."""
+    da, va = _to_days_any(xp, ctx, 0)
+    db, vb = _to_days_any(xp, ctx, 1)
+    y1, m1, d1 = _civil_from_days(xp, da)
+    y2, m2, d2 = _civil_from_days(xp, db)
+    # intra-month position: day-of-month plus time-of-day (0 for DATEs)
+    ua = _temporal_micros(xp, ctx, 0, ctx.args)
+    ub = _temporal_micros(xp, ctx, 1, ctx.args)
+    day_us = 86_400_000_000
+    p1 = d1.astype("int64") * day_us + (ua[0] % day_us if ua is not None else 0)
+    p2 = d2.astype("int64") * day_us + (ub[0] % day_us if ub is not None else 0)
+    months = (y2.astype("int64") - y1) * 12 + (m2 - m1)
+    months = months - ((months > 0) & (p2 < p1)) + ((months < 0) & (p2 > p1))
+    return months, and_valid(xp, va, vb)

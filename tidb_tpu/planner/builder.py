@@ -67,7 +67,7 @@ _FN_ALIAS = {
 _DATE_ARG0_FNS = {
     "year", "month", "quarter", "dayofmonth", "dayofweek", "weekday", "week",
     "dayofyear", "to_days", "last_day", "date", "monthname", "dayname",
-    "date_format", "unix_timestamp",
+    "date_format", "unix_timestamp", "yearweek", "weekofyear",
 }
 _TIME_ARG0_FNS = {"hour", "minute", "second", "time_to_sec"}
 
@@ -1089,6 +1089,23 @@ class Builder:
             t = datetime.datetime.now().time()
             us = ((t.hour * 3600 + t.minute * 60 + t.second) * 1_000_000) + t.microsecond
             return Constant(us, FieldType(TypeKind.DURATION, nullable=False))
+        if name in ("utc_date", "utc_timestamp", "utc_time"):
+            import datetime
+
+            u = datetime.datetime.now(datetime.timezone.utc).replace(tzinfo=None, microsecond=0)
+            if name == "utc_date":
+                return Constant(u.date(), FieldType(TypeKind.DATE, nullable=False))
+            if name == "utc_timestamp":
+                return Constant(u, FieldType(TypeKind.DATETIME, nullable=False))
+            us = ((u.hour * 3600 + u.minute * 60 + u.second) * 1_000_000) + u.microsecond
+            return Constant(us, FieldType(TypeKind.DURATION, nullable=False))
+        if name == "pi" and not node.args:
+            return Constant(3.141592653589793, FieldType(TypeKind.FLOAT, nullable=False))
+        if name == "any_value" and len(node.args) == 1:
+            # MySQL: suppresses ONLY_FULL_GROUP_BY checking; value passthrough
+            return self._resolve(node.args[0], ctx)
+        if name in ("timestampdiff", "timestampadd") and len(node.args) == 3:
+            return self._timestamp_func(name, node, ctx, self._resolve)
         if name == "str_to_date" and len(node.args) == 2:
             # result kind depends on the format string: time specifiers →
             # DATETIME, else DATE (ref: builtin_time.go strToDate)
@@ -1223,6 +1240,11 @@ class Builder:
                             return ColumnRef(i, existing.ftype, f"agg#{i}")
                     aggs.append(desc)
                     return ColumnRef(len(aggs) - 1, desc.ftype, f"agg#{len(aggs) - 1}")
+                if name in ("timestampdiff", "timestampadd") and len(n.args) == 3:
+                    # args[0] is the unit keyword, not a column
+                    return ast.FuncCall(n.name, [n.args[0], walk(n.args[1]), walk(n.args[2])])
+                if name == "any_value" and len(n.args) == 1:
+                    return walk(n.args[0])
                 return ast.FuncCall(n.name, [walk(a) for a in n.args], n.distinct, n.star)
             if isinstance(n, ast.BinaryOp):
                 return ast.BinaryOp(n.op, walk(n.left), walk(n.right))
@@ -1270,6 +1292,51 @@ class Builder:
         # them once the agg list stops growing (after all items + HAVING)
         return self._resolve_mixed(rewritten, BuildCtx(agg_out, aliases=aliases))
 
+
+    _TS_UNIT_US = {
+        "microsecond": 1,
+        "second": 1_000_000,
+        "minute": 60_000_000,
+        "hour": 3_600_000_000,
+        "day": 86_400_000_000,
+        "week": 7 * 86_400_000_000,
+    }
+
+    def _timestamp_func(self, name, node, ctx, rfn):
+        """TIMESTAMPDIFF/TIMESTAMPADD(unit, ...) — shared by the plain and
+        the aggregate resolution paths (``rfn`` resolves the non-unit args;
+        the unit arrives as a bare identifier, never a column)."""
+        u = node.args[0]
+        unit = u.name.lower() if isinstance(u, ast.ColumnName) and not u.table else None
+        if unit and unit.startswith("sql_tsi_"):
+            unit = unit[8:]
+        if unit is None or (unit not in self._TS_UNIT_US and unit not in ("month", "quarter", "year")):
+            raise PlanError(f"unknown interval unit for {name.upper()}")
+
+        def dt_coerce(e):
+            if isinstance(e, Constant) and e.ftype.kind == TypeKind.STRING:
+                v = e.value.decode() if isinstance(e.value, bytes) else str(e.value)
+                kind = TypeKind.DATETIME if ":" in v else TypeKind.DATE
+                return self._coerce_to(FieldType(kind), e)
+            return e
+
+        if name == "timestampadd":
+            nexp = rfn(node.args[1], ctx)
+            base = dt_coerce(rfn(node.args[2], ctx))
+            return self._date_interval(base, nexp, unit, False)
+        a = dt_coerce(rfn(node.args[1], ctx))
+        b = dt_coerce(rfn(node.args[2], ctx))
+        if unit in ("month", "quarter", "year"):
+            months = func("tsdiff_months", a, b)
+            if unit == "month":
+                return months
+            per = 3 if unit == "quarter" else 12
+            return func("intdiv", months, Constant(per, bigint_type(nullable=False)))
+        diff = func("tsdiff_micros", a, b)
+        if self._TS_UNIT_US[unit] == 1:
+            return diff
+        return func("intdiv", diff, Constant(self._TS_UNIT_US[unit], bigint_type(nullable=False)))
+
     def _resolve_mixed(self, node, ctx: BuildCtx) -> Expression:
         if isinstance(node, Expression):
             return node
@@ -1280,6 +1347,10 @@ class Builder:
             return func(op if op != "unaryplus" else "plus", self._resolve_mixed(node.operand, ctx))
         if isinstance(node, ast.FuncCall):
             name = _FN_ALIAS.get(node.name, node.name)
+            if name in ("timestampdiff", "timestampadd") and len(node.args) == 3:
+                return self._timestamp_func(name, node, ctx, self._resolve_mixed)
+            if name == "any_value" and len(node.args) == 1:
+                return self._resolve_mixed(node.args[0], ctx)
             args = [self._resolve_mixed(a, ctx) for a in node.args]
             return func(name, *args)
         if isinstance(node, ast.CaseWhen):
