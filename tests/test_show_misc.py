@@ -1,0 +1,83 @@
+"""Misc statement surface: SHOW family, DESCRIBE, RENAME TABLE, DO,
+CHECKSUM TABLE, the MySQL 8 TABLE statement (ref: executor/show.go +
+ast statement list)."""
+
+import tidb_tpu
+
+
+def test_show_family():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE t (a BIGINT PRIMARY KEY, b BIGINT)")
+    d.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+    d.execute("ANALYZE TABLE t")
+    s = d.session()
+    st = s.query("SHOW TABLE STATUS")
+    assert st[0][0] == "t" and st[0][4] == 2  # Name, Rows
+    assert s.query("SHOW TABLE STATUS LIKE 'nope'") == []
+    assert "CREATE DATABASE `test`" in s.query("SHOW CREATE DATABASE test")[0][1]
+    assert ("utf8mb4_bin", "utf8mb4") == s.query("SHOW COLLATION")[0][:2]
+    assert s.query("SHOW CHARSET")[0][0] == "utf8mb4"
+    assert s.query("SHOW ENGINES")[0][1] == "DEFAULT"
+    assert s.query("SHOW TRIGGERS") == []
+    status = dict(s.query("SHOW STATUS"))
+    assert int(status["Queries"]) > 0
+    assert s.query("SHOW GLOBAL VARIABLES LIKE 'autocommit'") == [("autocommit", "1")]
+    assert s.query("SHOW WARNINGS") == []
+    assert s.query("SHOW ERRORS") == []
+
+
+def test_describe_and_table_stmt():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE t (a BIGINT PRIMARY KEY, b BIGINT)")
+    d.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+    s = d.session()
+    cols = [r[0] for r in s.query("DESCRIBE t")]
+    assert cols == ["a", "b"]
+    assert s.query("DESC t") == s.query("DESCRIBE t")
+    assert s.query("TABLE t ORDER BY a DESC LIMIT 1") == [(2, 20)]
+    assert s.query("TABLE t") == [(1, 10), (2, 20)]
+
+
+def test_rename_do_checksum():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE t (a BIGINT PRIMARY KEY)")
+    d.execute("CREATE TABLE u (a BIGINT PRIMARY KEY)")
+    d.execute("INSERT INTO t VALUES (1), (2)")
+    s = d.session()
+    s.execute("RENAME TABLE t TO t2, u TO u2")
+    assert sorted(r[0] for r in s.query("SHOW TABLES")) == ["t2", "u2"]
+    assert s.query("SELECT COUNT(*) FROM t2") == [(2,)]
+    assert s.execute("DO 1+1, (SELECT MAX(a) FROM t2)").rows == []
+    c1 = s.query("CHECKSUM TABLE t2")
+    assert c1[0][0] == "test.t2" and isinstance(c1[0][1], int)
+    # stable across runs; changes when data changes
+    assert s.query("CHECKSUM TABLE t2") == c1
+    d.execute("INSERT INTO t2 VALUES (3)")
+    assert s.query("CHECKSUM TABLE t2") != c1
+    assert s.query("CHECKSUM TABLE missing")[0][1] is None
+
+
+def test_rename_safety_and_qualified_names():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE a (x BIGINT PRIMARY KEY)")
+    d.execute("CREATE TABLE b (x BIGINT PRIMARY KEY)")
+    d.execute("INSERT INTO b VALUES (7)")
+    s = d.session()
+    import pytest
+
+    # renaming onto an existing table must not clobber it
+    with pytest.raises(Exception, match="already exists"):
+        s.execute("RENAME TABLE a TO b")
+    assert s.query("SELECT * FROM b") == [(7,)]
+    # multi-pair renames are all-or-nothing
+    with pytest.raises(Exception, match="doesn't exist"):
+        s.execute("RENAME TABLE a TO a2, missing TO m2")
+    assert sorted(r[0] for r in s.query("SHOW TABLES")) == ["a", "b"]
+    # chained pair lists validate against the in-flight state
+    s.execute("RENAME TABLE a TO tmp, b TO a, tmp TO b")
+    assert s.query("SELECT * FROM a") == [(7,)]
+    # db-qualified forms parse everywhere
+    assert [r[0] for r in s.query("DESCRIBE test.a")] == ["x"]
+    assert s.query("CHECKSUM TABLE test.a")[0][0] == "test.a"
+    assert s.query("TABLE test.a LIMIT 5 OFFSET 0") == [(7,)]
+    assert s.query("TABLE test.a LIMIT 0, 5") == [(7,)]

@@ -617,6 +617,9 @@ class Catalog:
             elif stmt.action == "rename":
                 dbi = self.db(db)
                 old_name = t.name
+                new_name = stmt.name.lower()
+                if new_name != old_name and (new_name in dbi.tables or new_name in dbi.views):
+                    raise CatalogError(f"Table '{new_name}' already exists")
                 del dbi.tables[old_name]
                 t.name = stmt.name.lower()
                 dbi.tables[t.name] = t

@@ -496,6 +496,21 @@ class Show(Node):
 
 
 @dataclass
+class RenameTables(Node):
+    pairs: list = field(default_factory=list)  # [(old, new)]
+
+
+@dataclass
+class DoStmt(Node):
+    exprs: list = field(default_factory=list)
+
+
+@dataclass
+class ChecksumTable(Node):
+    tables: list = field(default_factory=list)
+
+
+@dataclass
 class Begin(Node):
     mode: str = ""  # "" (session default) | pessimistic | optimistic
 

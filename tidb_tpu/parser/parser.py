@@ -101,6 +101,11 @@ class Parser:
             "TRUNCATE": self.parse_truncate,
             "EXPLAIN": self.parse_explain,
             "DESC": self.parse_explain,
+            "DESCRIBE": self.parse_explain,
+            "RENAME": self.parse_rename,
+            "DO": self.parse_do,
+            "CHECKSUM": self.parse_checksum,
+            "TABLE": self.parse_table_stmt,
             "SET": self.parse_set,
             "SHOW": self.parse_show,
             "USE": self.parse_use,
@@ -1325,10 +1330,56 @@ class Parser:
         return ast.TruncateTable(self._table_ref_simple())
 
     # -- misc -----------------------------------------------------------------
-    def parse_explain(self) -> ast.Explain:
-        self.next()  # EXPLAIN/DESC
+    def parse_explain(self):
+        self.next()  # EXPLAIN/DESC/DESCRIBE
         analyze = self.eat_kw("ANALYZE")
+        # DESCRIBE t / EXPLAIN t: table describe == SHOW COLUMNS FROM t
+        t = self.peek()
+        if not analyze and t.kind in ("ident", "qident") and t.value.upper() not in (
+            "SELECT", "INSERT", "UPDATE", "DELETE", "REPLACE", "WITH", "TABLE", "FORMAT"
+        ):
+            ref = self._table_ref_simple()
+            target = f"{ref.db}.{ref.name}" if ref.db else ref.name
+            return ast.Show("columns", target=target)
         return ast.Explain(self.parse_statement(), analyze=analyze)
+
+    def parse_rename(self) -> ast.Node:
+        # RENAME TABLE a TO b [, c TO d ...] → validated + applied as a unit
+        self.expect_kw("RENAME")
+        self.expect_kw("TABLE")
+        pairs = []
+        while True:
+            old = self._table_ref_simple()
+            self.expect_kw("TO")
+            pairs.append((old, self._table_ref_simple()))
+            if not self.eat_op(","):
+                break
+        return ast.RenameTables(pairs)
+
+    def parse_do(self) -> ast.Node:
+        self.expect_kw("DO")
+        exprs = [self.parse_expr()]
+        while self.eat_op(","):
+            exprs.append(self.parse_expr())
+        return ast.DoStmt(exprs)
+
+    def parse_checksum(self) -> ast.Node:
+        self.expect_kw("CHECKSUM")
+        self.expect_kw("TABLE")
+        names = [self._table_ref_simple()]
+        while self.eat_op(","):
+            names.append(self._table_ref_simple())
+        return ast.ChecksumTable(names)
+
+    def parse_table_stmt(self) -> ast.Node:
+        # MySQL 8.0 TABLE t [ORDER BY ...] [LIMIT ...] == SELECT * FROM t ...
+        self.expect_kw("TABLE")
+        sel = ast.Select(items=[ast.SelectItem(ast.Wildcard())], from_=self._table_ref_simple())
+        if self.eat_kw("ORDER"):
+            self.expect_kw("BY")
+            sel.order_by = self.parse_order_items()
+        self._parse_limit(sel)
+        return sel
 
     def parse_set(self):
         self.expect_kw("SET")
@@ -1602,7 +1653,17 @@ class Parser:
             self.eat_kw("GLOBAL") or self.eat_kw("SESSION")
             if self.eat_kw("BINDINGS"):
                 return ast.Show("bindings")
-            raise ParseError("expected BINDINGS", self.peek())
+            if self.eat_kw("VARIABLES"):
+                like = None
+                if self.eat_kw("LIKE"):
+                    like = self.next().value
+                return ast.Show("variables", like=like)
+            if self.eat_kw("STATUS"):
+                like = None
+                if self.eat_kw("LIKE"):
+                    like = self.next().value
+                return ast.Show("status", like=like)
+            raise ParseError("expected BINDINGS, VARIABLES, or STATUS", self.peek())
         if self.eat_kw("GRANTS"):
             target = ""
             if self.eat_kw("FOR"):
@@ -1617,8 +1678,45 @@ class Parser:
                 like = self.next().value
             return ast.Show("variables", like=like)
         if self.eat_kw("CREATE"):
+            if self.eat_kw("DATABASE") or self.eat_kw("SCHEMA"):
+                return ast.Show("create_database", target=self.ident())
             self.expect_kw("TABLE")
             return ast.Show("create_table", target=self.ident())
+        if self.at_kw("TABLE") and self.peek(1).value.upper() == "STATUS":
+            self.next()
+            self.next()
+            like = None
+            if self.eat_kw("LIKE"):
+                like = self.next().value
+            return ast.Show("table_status", like=like)
+        if self.eat_kw("COLLATION"):
+            like = None
+            if self.eat_kw("LIKE"):
+                like = self.next().value
+            return ast.Show("collation", like=like)
+        if self.eat_kw("CHARSET") or (self.at_kw("CHARACTER") and self.peek(1).value.upper() == "SET"):
+            if self.at_kw("SET"):
+                self.next()
+            elif self.at_kw("CHARACTER"):
+                self.next()
+                self.next()
+            like = None
+            if self.eat_kw("LIKE"):
+                like = self.next().value
+            return ast.Show("charset", like=like)
+        if self.eat_kw("ENGINES"):
+            return ast.Show("engines")
+        if self.eat_kw("TRIGGERS"):
+            return ast.Show("triggers")
+        if self.eat_kw("STATUS"):
+            like = None
+            if self.eat_kw("LIKE"):
+                like = self.next().value
+            return ast.Show("status", like=like)
+        if self.eat_kw("WARNINGS"):
+            return ast.Show("warnings")
+        if self.eat_kw("ERRORS"):
+            return ast.Show("errors")
         if self.eat_kw("COLUMNS") or self.eat_kw("FIELDS"):
             self.expect_kw("FROM")
             return ast.Show("columns", target=self.ident())

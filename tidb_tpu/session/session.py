@@ -185,6 +185,9 @@ class Session:
         # session LRU plan cache (ref: core/plan_cache_lru.go:44); key
         # includes schema/stats versions so DDL and ANALYZE invalidate it
         self._plan_cache: OrderedDict[tuple, Any] = OrderedDict()
+        # SHOW WARNINGS buffer [(level, code, message)] + statement counter
+        self.warnings: list[tuple] = []
+        self._stmt_count = 0
 
     # -- txn lifecycle (ref: LazyTxn) ---------------------------------------
     def txn(self) -> Txn:
@@ -314,6 +317,9 @@ class Session:
             if bound is not None:
                 sql = bound[1]
                 stmt = parse(sql)
+        self._stmt_count += 1
+        if not isinstance(stmt, ast.Show):  # SHOW WARNINGS must see them
+            self.warnings = []
         try:
             res = self._execute_stmt(stmt, sql_text=sql)
             if not self._explicit and self._txn is not None:
@@ -431,6 +437,33 @@ class Session:
             return self._set_var(stmt)
         if isinstance(stmt, ast.Show):
             return self._show(stmt)
+        if isinstance(stmt, ast.RenameTables):
+            # all-or-nothing like MySQL: simulate the left-to-right chain
+            # against a name snapshot before touching the catalog
+            names: dict = {}
+            for old, new in stmt.pairs:
+                odb = (old.db or self.current_db).lower()
+                ndb = (new.db or self.current_db).lower()
+                if odb != ndb:
+                    raise SessionError("RENAME TABLE across databases is not supported")
+                live = names.setdefault(odb, set(self.catalog.tables(odb)) | set(self.catalog.views(odb)))
+                if old.name.lower() not in live:
+                    raise SessionError(f"Table '{odb}.{old.name}' doesn't exist")
+                if new.name.lower() in live:
+                    raise SessionError(f"Table '{new.name}' already exists")
+                live.discard(old.name.lower())
+                live.add(new.name.lower())
+            for old, new in stmt.pairs:
+                alter = ast.AlterTable(ast.TableRef(old.name), action="rename", name=new.name)
+                self.catalog.alter_table((old.db or self.current_db).lower(), alter)
+            return Result()
+        if isinstance(stmt, ast.DoStmt):
+            # DO evaluates for side effects and discards results (errors
+            # still surface, unlike SELECT's result shipping)
+            self._select(ast.Select(items=[ast.SelectItem(e) for e in stmt.exprs]))
+            return Result()
+        if isinstance(stmt, ast.ChecksumTable):
+            return self._checksum(stmt)
         if isinstance(stmt, ast.Begin):
             self.begin(stmt.mode)
             return Result()
@@ -1018,6 +1051,38 @@ class Session:
         self.vars[stmt.name] = v
         return Result()
 
+    def _checksum(self, stmt) -> Result:
+        """CHECKSUM TABLE: a stable CRC over every row's text form (MySQL's
+        live checksum analog; ADMIN CHECK TABLE does the integrity pass)."""
+        import zlib
+
+        rows = []
+        for ref in stmt.tables:
+            db = (ref.db or self.current_db).lower()
+            try:
+                self.catalog.table(db, ref.name)
+            except CatalogError:
+                rows.append((f"{db}.{ref.name}", None))
+                continue
+            data = self.query(f"SELECT * FROM `{db}`.`{ref.name}`")
+            acc = 0
+            for r in data:
+                acc = zlib.crc32(repr(r).encode(), acc)
+            rows.append((f"{db}.{ref.name}", acc))
+        return Result(columns=["Table", "Checksum"], rows=rows)
+
+    @staticmethod
+    def _like_filter(rows, pat, key=0):
+        """SHOW ... LIKE filtering over rows by rows[i][key]."""
+        if not pat:
+            return rows
+        import re
+
+        from tidb_tpu.expression.eval import like_to_regex
+
+        rx = re.compile(like_to_regex(pat))
+        return [r for r in rows if rx.match(r[key])]
+
     def _show(self, stmt: ast.Show) -> Result:
         if stmt.kind in ("stats_histograms", "stats_topn", "stats_buckets"):
             return self._show_stats(stmt.kind)
@@ -1041,28 +1106,17 @@ class Session:
         if stmt.kind == "tables":
             names = sorted(set(self.catalog.tables(self.current_db)) | set(self.catalog.views(self.current_db)))
             rows = [(t,) for t in names]
-            if stmt.like:
-                import re
-
-                from tidb_tpu.expression.eval import like_to_regex
-
-                rx = re.compile(like_to_regex(stmt.like))
-                rows = [r for r in rows if rx.match(r[0])]
+            rows = self._like_filter(rows, stmt.like)
             return Result(columns=[f"Tables_in_{self.current_db}"], rows=rows)
         if stmt.kind == "databases":
             return Result(columns=["Database"], rows=[(d,) for d in self.catalog.databases()])
         if stmt.kind == "variables":
             rows = sorted((k, str(v)) for k, v in self.vars.items())
-            if stmt.like:
-                import re
-
-                from tidb_tpu.expression.eval import like_to_regex
-
-                rx = re.compile(like_to_regex(stmt.like))
-                rows = [r for r in rows if rx.match(r[0])]
+            rows = self._like_filter(rows, stmt.like)
             return Result(columns=["Variable_name", "Value"], rows=rows)
         if stmt.kind == "columns":
-            t = self.catalog.table(self.current_db, stmt.target)
+            tdb, _, tname = stmt.target.rpartition(".")
+            t = self.catalog.table(tdb or self.current_db, tname)
             rows = [
                 (c.name, str(c.ftype), "YES" if c.ftype.nullable else "NO", str(c.default or ""))
                 for c in t.columns
@@ -1076,6 +1130,73 @@ class Session:
                 columns=["Table", "Create Table"],
                 rows=[(t.name, _create_table_sql(t, self.current_db).rstrip().rstrip(";"))],
             )
+        if stmt.kind == "table_status":
+            import datetime
+
+            rows = []
+            for name in sorted(self.catalog.tables(self.current_db)):
+                t = self.catalog.table(self.current_db, name)
+                st = self._db.stats.get(t.id)
+                nrows = st.row_count if st is not None else 0
+                rows.append((name, "tidb-tpu", 10, "Fixed", nrows, 0, 0, None,
+                             "utf8mb4_bin", ""))
+            rows = self._like_filter(rows, stmt.like)
+            return Result(
+                columns=["Name", "Engine", "Version", "Row_format", "Rows",
+                         "Avg_row_length", "Data_length", "Auto_increment",
+                         "Collation", "Comment"],
+                rows=rows,
+            )
+        if stmt.kind == "create_database":
+            self.catalog.db(stmt.target)  # raises if unknown
+            return Result(
+                columns=["Database", "Create Database"],
+                rows=[(stmt.target, f"CREATE DATABASE `{stmt.target}` /*!40100 DEFAULT CHARACTER SET utf8mb4 */")],
+            )
+        if stmt.kind == "collation":
+            rows = [
+                ("utf8mb4_bin", "utf8mb4", 46, "", "Yes", 1),
+                ("utf8mb4_general_ci", "utf8mb4", 45, "", "Yes", 1),
+                ("binary", "binary", 63, "Yes", "Yes", 1),
+            ]
+            rows = self._like_filter(rows, stmt.like)
+            return Result(
+                columns=["Collation", "Charset", "Id", "Default", "Compiled", "Sortlen"],
+                rows=rows,
+            )
+        if stmt.kind == "charset":
+            rows = [
+                ("utf8mb4", "UTF-8 Unicode", "utf8mb4_bin", 4),
+                ("binary", "Binary pseudo charset", "binary", 1),
+            ]
+            rows = self._like_filter(rows, stmt.like)
+            return Result(
+                columns=["Charset", "Description", "Default collation", "Maxlen"], rows=rows
+            )
+        if stmt.kind == "engines":
+            return Result(
+                columns=["Engine", "Support", "Comment", "Transactions", "XA", "Savepoints"],
+                rows=[("tidb-tpu", "DEFAULT", "TPU-native columnar engine + host reference engine", "YES", "NO", "NO")],
+            )
+        if stmt.kind == "triggers":
+            return Result(columns=["Trigger", "Event", "Table", "Statement", "Timing"], rows=[])
+        if stmt.kind == "status":
+            from tidb_tpu.utils.metrics import STMT_TOTAL
+
+            total = sum(STMT_TOTAL._vals.values())
+            rows = [
+                ("Queries", str(self._stmt_count)),
+                ("Questions", str(int(total))),
+                ("Threads_connected", "1"),
+                ("Uptime", "0"),
+            ]
+            rows = self._like_filter(rows, stmt.like)
+            return Result(columns=["Variable_name", "Value"], rows=rows)
+        if stmt.kind in ("warnings", "errors"):
+            src = self.warnings if stmt.kind == "warnings" else [
+                w for w in self.warnings if w[0] == "Error"
+            ]
+            return Result(columns=["Level", "Code", "Message"], rows=list(src))
         if stmt.kind == "index":
             t = self.catalog.table(self.current_db, stmt.target)
             rows = []
