@@ -81,3 +81,62 @@ def test_rename_safety_and_qualified_names():
     assert s.query("CHECKSUM TABLE test.a")[0][0] == "test.a"
     assert s.query("TABLE test.a LIMIT 5 OFFSET 0") == [(7,)]
     assert s.query("TABLE test.a LIMIT 0, 5") == [(7,)]
+
+
+def test_information_schema_constraint_tables():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE p (id BIGINT PRIMARY KEY)")
+    d.execute(
+        "CREATE TABLE c (id BIGINT PRIMARY KEY, pid BIGINT,"
+        " CONSTRAINT fk_c FOREIGN KEY (pid) REFERENCES p (id) ON DELETE CASCADE)"
+    )
+    d.execute("CREATE UNIQUE INDEX uq ON c (pid)")
+    d.execute("CREATE VIEW v1 AS SELECT id FROM p")
+    s = d.session()
+    assert s.query("SELECT TABLE_NAME, VIEW_DEFINITION FROM information_schema.views") == [
+        ("v1", "SELECT id FROM p")
+    ]
+    fks = s.query(
+        "SELECT CONSTRAINT_NAME, COLUMN_NAME, REFERENCED_TABLE_NAME, REFERENCED_COLUMN_NAME"
+        " FROM information_schema.key_column_usage WHERE REFERENCED_TABLE_NAME IS NOT NULL"
+    )
+    assert fks == [("fk_c", "pid", "p", "id")]
+    kinds = {r[0]: r[1] for r in s.query(
+        "SELECT CONSTRAINT_NAME, CONSTRAINT_TYPE FROM information_schema.table_constraints"
+        " WHERE TABLE_NAME = 'c'"
+    )}
+    assert kinds == {"PRIMARY": "PRIMARY KEY", "uq": "UNIQUE", "fk_c": "FOREIGN KEY"}
+    assert s.query(
+        "SELECT DELETE_RULE, UPDATE_RULE FROM information_schema.referential_constraints"
+    ) == [("CASCADE", "RESTRICT")]
+    assert s.query(
+        "SELECT DEFAULT_COLLATE_NAME FROM information_schema.character_sets WHERE CHARACTER_SET_NAME = 'utf8mb4'"
+    ) == [("utf8mb4_bin",)]
+    assert ("utf8mb4_bin", "utf8mb4") == s.query(
+        "SELECT COLLATION_NAME, CHARACTER_SET_NAME FROM information_schema.collations"
+    )[0][:2]
+
+
+def test_server_survives_garbage_handshake():
+    import socket
+    import time
+
+    import tidb_tpu
+    from tidb_tpu.server.client import Client
+    from tidb_tpu.server.server import Server
+
+    d = tidb_tpu.open()
+    srv = Server(d)
+    srv.start()
+    # port-scan probes: drop mid-handshake, then garbage well-framed bytes
+    raw = socket.create_connection(("127.0.0.1", srv.port))
+    raw.recv(128)
+    raw.close()
+    raw2 = socket.create_connection(("127.0.0.1", srv.port))
+    raw2.recv(128)
+    raw2.sendall(b"\x2c\x00\x00\x01" + b"\x00" * 4 + b"\xff" * 40)
+    time.sleep(0.1)
+    raw2.close()
+    c = Client(port=srv.port)
+    assert c.query("SELECT 1") == [("1",)]
+    c.close()

@@ -28,6 +28,12 @@ def memtable_rows(db, session, name: str) -> Optional[tuple[list, list, list]]:
         "slow_query": _slow_query,
         "resource_groups": _resource_groups,
         "runaway_watches": _runaway_watches,
+        "views": _views,
+        "key_column_usage": _key_column_usage,
+        "table_constraints": _table_constraints,
+        "referential_constraints": _referential_constraints,
+        "character_sets": _character_sets,
+        "collations": _collations,
     }.get(name)
     if fn is None:
         return None
@@ -188,3 +194,85 @@ def _engines(db, session):
         ("host", "YES", "NumPy reference coprocessor engine"),
     ]
     return cols, [_S(), _S(), _S(256)], rows
+
+
+def _views(db, session):
+    cols = ["TABLE_CATALOG", "TABLE_SCHEMA", "TABLE_NAME", "VIEW_DEFINITION", "IS_UPDATABLE", "DEFINER"]
+    rows = []
+    for dname in sorted(db.catalog.databases()):
+        for vname in sorted(db.catalog.views(dname)):
+            v = db.catalog.view(dname, vname)
+            rows.append(("def", dname, vname, v.text, "NO", "root@%"))
+    return cols, [_S(256)] * 6, rows
+
+
+def _key_column_usage(db, session):
+    """PK/unique/FK key columns (ref: infoschema keyColumnUsage memtable)."""
+    cols = ["CONSTRAINT_SCHEMA", "CONSTRAINT_NAME", "TABLE_SCHEMA", "TABLE_NAME",
+            "COLUMN_NAME", "ORDINAL_POSITION", "REFERENCED_TABLE_SCHEMA",
+            "REFERENCED_TABLE_NAME", "REFERENCED_COLUMN_NAME"]
+    fts = [_S(), _S(), _S(), _S(), _S(), _I(), _S(), _S(), _S()]
+    rows = []
+    for dname, t in _iter_tables(db):
+        if t.pk_is_handle:
+            rows.append((dname, "PRIMARY", dname, t.name, t.columns[t.pk_offset].name, 1, None, None, None))
+        for idx in t.indexes:
+            if idx.state != "public" or not (idx.unique or idx.primary):
+                continue
+            name = "PRIMARY" if idx.primary else idx.name
+            for seq, off in enumerate(idx.column_offsets):
+                rows.append((dname, name, dname, t.name, t.columns[off].name, seq + 1, None, None, None))
+        for fk in t.foreign_keys:
+            for seq, (off, rname) in enumerate(zip(fk.col_offsets, fk.ref_col_names)):
+                rows.append((dname, fk.name, dname, t.name, t.columns[off].name, seq + 1,
+                             fk.ref_db or dname, fk.ref_table, rname))
+    return cols, fts, rows
+
+
+def _table_constraints(db, session):
+    cols = ["CONSTRAINT_SCHEMA", "CONSTRAINT_NAME", "TABLE_SCHEMA", "TABLE_NAME", "CONSTRAINT_TYPE"]
+    rows = []
+    for dname, t in _iter_tables(db):
+        if t.pk_is_handle:
+            rows.append((dname, "PRIMARY", dname, t.name, "PRIMARY KEY"))
+        for idx in t.indexes:
+            if idx.state != "public":
+                continue
+            if idx.primary:
+                rows.append((dname, "PRIMARY", dname, t.name, "PRIMARY KEY"))
+            elif idx.unique:
+                rows.append((dname, idx.name, dname, t.name, "UNIQUE"))
+        for fk in t.foreign_keys:
+            rows.append((dname, fk.name, dname, t.name, "FOREIGN KEY"))
+    return cols, [_S()] * 5, rows
+
+
+def _referential_constraints(db, session):
+    cols = ["CONSTRAINT_SCHEMA", "CONSTRAINT_NAME", "UNIQUE_CONSTRAINT_SCHEMA",
+            "REFERENCED_TABLE_NAME", "UPDATE_RULE", "DELETE_RULE", "TABLE_NAME"]
+    rows = []
+    for dname, t in _iter_tables(db):
+        for fk in t.foreign_keys:
+            rows.append((dname, fk.name, fk.ref_db or dname, fk.ref_table,
+                         (fk.on_update or "restrict").replace("_", " ").upper(),
+                         (fk.on_delete or "restrict").replace("_", " ").upper(), t.name))
+    return cols, [_S()] * 7, rows
+
+
+def _character_sets(db, session):
+    cols = ["CHARACTER_SET_NAME", "DEFAULT_COLLATE_NAME", "DESCRIPTION", "MAXLEN"]
+    rows = [
+        ("utf8mb4", "utf8mb4_bin", "UTF-8 Unicode", 4),
+        ("binary", "binary", "Binary pseudo charset", 1),
+    ]
+    return cols, [_S(), _S(), _S(), _I()], rows
+
+
+def _collations(db, session):
+    cols = ["COLLATION_NAME", "CHARACTER_SET_NAME", "ID", "IS_DEFAULT", "IS_COMPILED", "SORTLEN"]
+    rows = [
+        ("utf8mb4_bin", "utf8mb4", 46, "Yes", "Yes", 1),
+        ("utf8mb4_general_ci", "utf8mb4", 45, "", "Yes", 1),
+        ("binary", "binary", 63, "Yes", "Yes", 1),
+    ]
+    return cols, [_S(), _S(), _I(), _S(), _S(), _I()], rows

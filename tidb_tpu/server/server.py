@@ -131,8 +131,11 @@ class ClientConn:
     def run(self) -> None:
         io = p.PacketIO(self.sock)
         try:
-            if not self.handshake(io):
-                return
+            try:
+                if not self.handshake(io):
+                    return
+            except Exception:
+                return  # port-scan: dropped client or garbage handshake bytes
             while True:
                 io.reset_seq()
                 try:
