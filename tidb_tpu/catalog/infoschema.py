@@ -223,6 +223,8 @@ def _key_column_usage(db, session):
             for seq, off in enumerate(idx.column_offsets):
                 rows.append((dname, name, dname, t.name, t.columns[off].name, seq + 1, None, None, None))
         for fk in t.foreign_keys:
+            if fk.state != "public":
+                continue  # mid-DDL constraints stay invisible, like indexes
             for seq, (off, rname) in enumerate(zip(fk.col_offsets, fk.ref_col_names)):
                 rows.append((dname, fk.name, dname, t.name, t.columns[off].name, seq + 1,
                              fk.ref_db or dname, fk.ref_table, rname))
@@ -243,6 +245,8 @@ def _table_constraints(db, session):
             elif idx.unique:
                 rows.append((dname, idx.name, dname, t.name, "UNIQUE"))
         for fk in t.foreign_keys:
+            if fk.state != "public":
+                continue  # mid-DDL constraints stay invisible, like indexes
             rows.append((dname, fk.name, dname, t.name, "FOREIGN KEY"))
     return cols, [_S()] * 5, rows
 
@@ -253,26 +257,33 @@ def _referential_constraints(db, session):
     rows = []
     for dname, t in _iter_tables(db):
         for fk in t.foreign_keys:
+            if fk.state != "public":
+                continue  # mid-DDL constraints stay invisible, like indexes
             rows.append((dname, fk.name, fk.ref_db or dname, fk.ref_table,
                          (fk.on_update or "restrict").replace("_", " ").upper(),
                          (fk.on_delete or "restrict").replace("_", " ").upper(), t.name))
     return cols, [_S()] * 7, rows
 
 
+# the single source of truth for supported charsets/collations — SHOW
+# COLLATION/CHARSET (session.py) and these memtables must never diverge
+CHARSETS = [
+    ("utf8mb4", "UTF-8 Unicode", "utf8mb4_bin", 4),
+    ("binary", "Binary pseudo charset", "binary", 1),
+]
+COLLATIONS = [
+    ("utf8mb4_bin", "utf8mb4", 46, "Yes", "Yes", 1),
+    ("utf8mb4_general_ci", "utf8mb4", 45, "", "Yes", 1),
+    ("binary", "binary", 63, "Yes", "Yes", 1),
+]
+
+
 def _character_sets(db, session):
     cols = ["CHARACTER_SET_NAME", "DEFAULT_COLLATE_NAME", "DESCRIPTION", "MAXLEN"]
-    rows = [
-        ("utf8mb4", "utf8mb4_bin", "UTF-8 Unicode", 4),
-        ("binary", "binary", "Binary pseudo charset", 1),
-    ]
+    rows = [(name, default, desc, maxlen) for name, desc, default, maxlen in CHARSETS]
     return cols, [_S(), _S(), _S(), _I()], rows
 
 
 def _collations(db, session):
     cols = ["COLLATION_NAME", "CHARACTER_SET_NAME", "ID", "IS_DEFAULT", "IS_COMPILED", "SORTLEN"]
-    rows = [
-        ("utf8mb4_bin", "utf8mb4", 46, "Yes", "Yes", 1),
-        ("utf8mb4_general_ci", "utf8mb4", 45, "", "Yes", 1),
-        ("binary", "binary", 63, "Yes", "Yes", 1),
-    ]
-    return cols, [_S(), _S(), _I(), _S(), _S(), _I()], rows
+    return cols, [_S(), _S(), _I(), _S(), _S(), _I()], list(COLLATIONS)

@@ -1154,21 +1154,18 @@ class Session:
                 rows=[(stmt.target, f"CREATE DATABASE `{stmt.target}` /*!40100 DEFAULT CHARACTER SET utf8mb4 */")],
             )
         if stmt.kind == "collation":
-            rows = [
-                ("utf8mb4_bin", "utf8mb4", 46, "", "Yes", 1),
-                ("utf8mb4_general_ci", "utf8mb4", 45, "", "Yes", 1),
-                ("binary", "binary", 63, "Yes", "Yes", 1),
-            ]
+            from tidb_tpu.catalog.infoschema import COLLATIONS
+
+            rows = list(COLLATIONS)
             rows = self._like_filter(rows, stmt.like)
             return Result(
                 columns=["Collation", "Charset", "Id", "Default", "Compiled", "Sortlen"],
                 rows=rows,
             )
         if stmt.kind == "charset":
-            rows = [
-                ("utf8mb4", "UTF-8 Unicode", "utf8mb4_bin", 4),
-                ("binary", "Binary pseudo charset", "binary", 1),
-            ]
+            from tidb_tpu.catalog.infoschema import CHARSETS
+
+            rows = list(CHARSETS)
             rows = self._like_filter(rows, stmt.like)
             return Result(
                 columns=["Charset", "Description", "Default collation", "Maxlen"], rows=rows
