@@ -37,6 +37,10 @@ bulk_load(db, "li", [
 ])
 db.execute("CREATE TABLE kvt (id BIGINT PRIMARY KEY, v BIGINT)")
 db.execute("INSERT INTO kvt VALUES (1, 10), (2, 20)")
+db.execute("CREATE TABLE kd (id BIGINT PRIMARY KEY, grp BIGINT)")
+db.execute("INSERT INTO kd VALUES " + ", ".join("(%d, %d)" % (i, i % 5) for i in range(100, 400)))
+db.execute("CREATE TABLE d (id BIGINT PRIMARY KEY, grp BIGINT)")
+db.execute("INSERT INTO d VALUES " + ", ".join("(%d, %d)" % (i, i % 7) for i in range(100, 700)))
 srv = StoreServer(db.store)
 port = srv.start()
 print(f"PORT {{port}}", flush=True)
@@ -116,6 +120,59 @@ def test_point_get_and_dml_through_the_wire(remote):
     assert s.query("SELECT COUNT(*) FROM kvt") == [(3,)]
 
 
+MPPQ = (
+    "SELECT d.grp, COUNT(*), SUM(li.price) FROM li JOIN d ON li.qty = d.id"
+    " GROUP BY d.grp ORDER BY d.grp"
+)
+
+
+def test_mpp_dispatched_to_store_server(remote):
+    """A remote SQL layer PLANS MPP and the storage server EXECUTES it (ref:
+    kv/mpp.go DispatchMPPTask/EstablishMPPConns) — the round-3 silent
+    downgrade to serial host Volcano is dead."""
+    _, db = remote
+    s = db.session()
+    lines = "\n".join(r[0] for r in s.query("EXPLAIN " + MPPQ))
+    assert "PhysMPPGather" in lines, lines
+    rows = s.query(MPPQ)
+    s.execute("SET tidb_allow_mpp = 0")
+    host_rows = s.query(MPPQ)
+    s.execute("SET tidb_allow_mpp = 1")
+    assert rows == host_rows
+    assert len(rows) == 7 and sum(r[1] for r in rows) > 0
+
+
+def test_mpp_remote_txn_dirty_falls_back(remote):
+    """The server cannot see this session's uncommitted buffer — a dirty
+    transaction must fall back to the host path and still see its own
+    writes (the reference keeps MPP off dirty reads the same way)."""
+    _, db = remote
+    s = db.session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO d VALUES (100000, 6)")
+    with_dirty = s.query(MPPQ)
+    s.execute("ROLLBACK")
+    clean = s.query(MPPQ)
+    assert with_dirty == clean  # key 100000 joins no li row; plans must agree
+
+
+def test_mpp_remote_ddl_resync(remote):
+    """DDL done by the client lands in the server's catalog snapshot before
+    the next dispatch resolves table ids (schema_ver handshake)."""
+    _, db = remote
+    s = db.session()
+    s.execute("CREATE TABLE d2 (id BIGINT PRIMARY KEY, grp BIGINT)")
+    s.execute("INSERT INTO d2 VALUES (100, 1), (101, 2)")
+    q = (
+        "SELECT d2.grp, COUNT(*) FROM li JOIN d2 ON li.qty = d2.id"
+        " GROUP BY d2.grp ORDER BY d2.grp"
+    )
+    lines = "\n".join(r[0] for r in s.query("EXPLAIN " + q))
+    assert "PhysMPPGather" in lines, lines
+    rows = s.query(q)
+    assert len(rows) == 2 and all(r[1] > 0 for r in rows)
+
+
 def test_killing_the_remote_mid_query_surfaces(remote):
     proc, db = remote
     s = db.session()
@@ -123,10 +180,17 @@ def test_killing_the_remote_mid_query_surfaces(remote):
     started = threading.Event()
 
     def hammer():
+        # alternate a cop query and an MPP dispatch so the SIGKILL lands
+        # mid-flight on both protocols (ref: the mid-query region-error path)
         try:
             started.set()
-            for _ in range(200):
-                s.query("SELECT flag, COUNT(*) FROM li GROUP BY flag")
+            for i in range(200):
+                if i % 2:
+                    s.query(
+                        "SELECT kd.grp, COUNT(*) FROM li JOIN kd ON li.qty = kd.id GROUP BY kd.grp"
+                    )
+                else:
+                    s.query("SELECT flag, COUNT(*) FROM li GROUP BY flag")
         except Exception as e:  # noqa: BLE001
             errs.append(e)
 

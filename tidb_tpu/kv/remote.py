@@ -105,6 +105,16 @@ class StoreServer:
         self.host, self.port = self._sock.getsockname()[:2]
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop, daemon=True, name="store-server")
+        self._mpp = None  # lazy MPPTaskManager (first dispatch pays SQL-context open)
+        self._mpp_mu = threading.Lock()
+
+    def _mpp_mgr(self):
+        with self._mpp_mu:
+            if self._mpp is None:
+                from tidb_tpu.parallel.mpptask import MPPTaskManager
+
+                self._mpp = MPPTaskManager(self.store)
+            return self._mpp
 
     def start(self) -> int:
         self._thread.start()
@@ -247,6 +257,25 @@ class StoreServer:
                     }
                 )
             return {"regions": out}, []
+        if cmd == "mpp_ndev":
+            return {"ndev": self._mpp_mgr().ndev()}, []
+        if cmd == "mpp_dispatch":
+            # DispatchMPPTask analog (ref: kv/mpp.go:189): the gather spec
+            # arrives as table ids + expression pbs; execution starts on a
+            # worker thread against the LOCAL store + mesh
+            task_id = self._mpp_mgr().dispatch(h["spec"], h["read_ts"])
+            return {"task_id": task_id}, []
+        if cmd == "mpp_conn":
+            # EstablishMPPConns analog: long-poll for the merged result frame
+            done, blob, kind, msg = self._mpp_mgr().conn(h["task_id"], h.get("wait_s", 1.0))
+            if not done:
+                return {"done": 0}, []
+            if kind:
+                return {"done": 1, "err_kind": kind, "msg": msg}, []
+            return {"done": 1}, [blob]
+        if cmd == "mpp_cancel":
+            self._mpp_mgr().cancel(h["task_id"])
+            return {"ok": 1}, []
         if cmd == "cop":
             # the coprocessor boundary: DAG in, chunk out (ref: Cop gRPC)
             from tidb_tpu.copr import dagpb
@@ -418,6 +447,7 @@ class RemoteStore:
         from concurrent.futures import ThreadPoolExecutor
 
         self._cop_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="rcop")
+        self._mpp_ndev: Optional[int] = None
         self._call({"cmd": "ping"})  # fail fast on a bad endpoint
 
     # -- plumbing ----------------------------------------------------------
@@ -498,6 +528,51 @@ class RemoteStore:
 
     def get_client(self) -> _RemoteCopClient:
         return _RemoteCopClient(self)
+
+    # -- MPP dispatch (ref: kv/mpp.go DispatchMPPTask/EstablishMPPConns) ----
+    def mpp_ndev(self) -> int:
+        """Mesh size of the server's device mesh — the remote planner's
+        exchange-cost model needs the REAL ndev, not this process's."""
+        if self._mpp_ndev is None:
+            self._mpp_ndev = int(self._call({"cmd": "mpp_ndev"})[0]["ndev"])
+        return self._mpp_ndev
+
+    def mpp_dispatch(self, spec: dict, read_ts: int) -> str:
+        h, _ = self._call({"cmd": "mpp_dispatch", "spec": spec, "read_ts": read_ts})
+        return h["task_id"]
+
+    def mpp_conn(self, task_id: str, check_killed=None):
+        """Block until the task's merged chunk arrives (long-poll loop so a
+        client-side KILL propagates as mpp_cancel). Raises the task's error
+        with its original kind when the server reports one."""
+        while True:
+            h, blobs = self._call({"cmd": "mpp_conn", "task_id": task_id, "wait_s": 1.0})
+            if h["done"]:
+                break
+            if check_killed is not None:
+                try:
+                    check_killed()
+                except BaseException:
+                    try:
+                        self._call({"cmd": "mpp_cancel", "task_id": task_id})
+                    except ConnectionError:
+                        pass
+                    raise
+        if h.get("err_kind"):
+            from tidb_tpu.parallel.probe import MPPRetryExhausted
+            from tidb_tpu.utils.memory import QueryKilledError, QueryOOMError
+
+            kinds = {
+                "MPPRetryExhausted": MPPRetryExhausted,
+                "QueryKilledError": QueryKilledError,
+                "QueryOOMError": QueryOOMError,
+            }
+            raise kinds.get(h["err_kind"], RuntimeError)(
+                f"remote mpp task failed: {h['msg']}"
+            )
+        from tidb_tpu.utils.chunk import decode_chunk
+
+        return decode_chunk(blobs[0])
 
     # -- percolator verbs (ref: unistore mvcc server surface) ---------------
     def prewrite(self, mutations: Sequence[Mutation], primary: bytes, start_ts: int) -> None:

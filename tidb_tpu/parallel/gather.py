@@ -329,22 +329,23 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> P
     tidb_allow_mpp)."""
     if not sysvar_int(vars, "tidb_allow_mpp", 1):
         return plan
-    if store is not None and not hasattr(store, "_stable"):
-        # remote-backed SQL layer: the MPP coordinator belongs where the
-        # data (and the device) live — the storage-server process
-        return plan
     enforce = sysvar_int(vars, "tidb_enforce_mpp", 0)
 
     # lazy: mesh construction triggers JAX backend init (seconds of cold
-    # start) — only pay it when a query actually matches an MPP shape
+    # start) — only pay it when a query actually matches an MPP shape. A
+    # remote-backed SQL layer asks the STORAGE server for its mesh size
+    # (the fragment program runs there; this process never touches jax).
     _ndev_memo: list = []
 
     def get_ndev() -> int:
         if not _ndev_memo:
-            from tidb_tpu.parallel import make_mesh
-
             try:
-                _ndev_memo.append(make_mesh().devices.size)
+                if store is not None and hasattr(store, "mpp_ndev"):
+                    _ndev_memo.append(int(store.mpp_ndev()))
+                else:
+                    from tidb_tpu.parallel import make_mesh
+
+                    _ndev_memo.append(make_mesh().devices.size)
             except Exception:
                 _ndev_memo.append(1)
         return _ndev_memo[0]
@@ -686,7 +687,12 @@ class MPPGatherExec:
         mpp_probe.go:62): a device failure blacklists the device and the
         next attempt runs on the survivors; unattributable failures get one
         same-mesh retry; exhaustion raises MPPRetryExhausted so the session
-        re-plans without MPP."""
+        re-plans without MPP. A remote-backed session dispatches the whole
+        gather to the storage server instead (DispatchMPPTask analog) —
+        BEFORE any jax import: the SQL-layer process must never initialize
+        a device backend it does not own."""
+        if hasattr(self.session.store, "mpp_dispatch"):
+            return self._execute_remote()
         import jax
 
         from tidb_tpu.parallel import make_mesh
@@ -727,6 +733,32 @@ class MPPGatherExec:
                         f"mpp execution failed after {total} attempts: {exc}"
                     ) from exc
 
+    def _execute_remote(self):
+        """Ship the gather to the storage-server process (ref: kv/mpp.go
+        DispatchMPPTask + EstablishMPPConns): the server owns the data, the
+        device cache, and the mesh; this process gets the merged chunk. A
+        dirty transaction falls back to the host Volcano path — the server
+        cannot see this session's uncommitted buffer (the reference likewise
+        keeps MPP off dirty-table reads, which need UnionScan)."""
+        from tidb_tpu.parallel.mpptask import gather_to_pb
+        from tidb_tpu.parallel.probe import MPPRetryExhausted
+
+        sess = self.session
+        if sess._txn_dirty():
+            raise MPPRetryExhausted("remote MPP cannot observe txn-local mutations")
+        stats = sess._db.stats
+        cap = None
+        if self.plan.agg is not None:
+            rows = None
+            st = stats.get(self.plan.readers[0].table.id) if stats is not None else None
+            if st is not None:
+                rows = st.row_count
+            cap = self._initial_group_cap(rows if rows else 1 << 16)
+        spec = gather_to_pb(self.plan, cap, schema_ver=sess._db.catalog.schema_version)
+        store = sess.store
+        task_id = store.mpp_dispatch(spec, sess.read_ts())
+        return store.mpp_conn(task_id, check_killed=sess.check_killed)
+
     def _execute_attempt(self, mesh):
         import jax.numpy as jnp
 
@@ -739,10 +771,12 @@ class MPPGatherExec:
 
         p = self.plan
         ndev = mesh.devices.size
-        self._dev_cacheable = (
-            not self.session._txn_dirty()
-            and self.session._read_ts_override is None
-            and not float(self.session.vars.get("tidb_read_staleness", 0) or 0)
+        # pinned read ts (stale read / server-side dispatched task): caching
+        # stays legal per reader as long as no region committed PAST the pin —
+        # checked against region.max_commit_ts in dev_side
+        self._pin_ts = self.session._read_ts_override
+        self._dev_cacheable = not self.session._txn_dirty() and not float(
+            self.session.vars.get("tidb_read_staleness", 0) or 0
         )
         from tidb_tpu.copr.colcache import cache_for as _cache_for
 
@@ -794,6 +828,13 @@ class MPPGatherExec:
                     tablecodec.record_range(v.id) for v in reader.table.partition_views()
                 ]
                 regions = self.session.store.pd.regions_in_ranges(prs)
+                if self._pin_ts is not None and any(
+                    getattr(r, "max_commit_ts", 1 << 62) > self._pin_ts for r, _ in regions
+                ):
+                    # a commit landed past the pinned snapshot: the current-
+                    # version arrays are NOT this read's data — run uncached
+                    regions = None
+            if self._dev_cacheable and regions is not None:
                 vers = tuple((r.region_id, r.data_version) for r, _ in regions)
                 agg_fp = ""
                 if reader.pushed_agg is not None:
@@ -943,7 +984,11 @@ class MPPGatherExec:
             spec.left_key_valid = tuple(k + 1 for k in spec.left_keys)
             spec.right_key_valid = tuple(k + 1 for k in spec.right_keys)
 
-        group_cap = self._initial_group_cap(nrows[0]) if agg is not None else 0
+        group_cap = 0
+        if agg is not None:
+            # a dispatching client may ship its stats-informed cap with the
+            # task (the server's stats handle starts empty)
+            group_cap = getattr(self, "_group_cap_hint", None) or self._initial_group_cap(nrows[0])
         if agg is not None:
             nk = 2 * len(agg.group_by) if agg.group_by else 2
             sums_idx = list(range(nk, nk + 2 * sum(1 for a in agg.aggs if a.arg is not None)))
