@@ -129,3 +129,96 @@ def test_inner_join_after_semi(db):
         " GROUP BY d_week ORDER BY d_week",
         mpp_expected=False,  # the IN may fold to a constant list
     )
+
+
+def test_right_outer_join_unique_build(db):
+    """ref: mpp.go:397 right-outer build-side preservation — unmatched build
+    rows emit once with probe lanes NULL-extended, matched emit like inner."""
+    rows = both(
+        db,
+        "SELECT o_odate, COUNT(*), COUNT(l_price), SUM(l_price) FROM li"
+        " RIGHT JOIN orders ON l_orderkey = o_orderkey"
+        " GROUP BY o_odate ORDER BY o_odate",
+    )
+    # COUNT(*) >= COUNT(l_price): every order emits even without lineitems
+    assert all(r[1] >= r[2] for r in rows)
+
+
+def test_right_outer_join_expand_build(db):
+    # build side (li, non-unique) preserved: dangling li keys must survive
+    rows = both(
+        db,
+        "SELECT COUNT(*), COUNT(o_odate), SUM(l_price) FROM orders"
+        " RIGHT JOIN li ON o_orderkey = l_orderkey",
+    )
+    assert rows[0][0] >= rows[0][1]
+
+
+def test_right_outer_forced_hash_exchange(db):
+    from tidb_tpu.parallel import gather
+
+    gather.FORCE_EXCHANGE = "hash"
+    try:
+        both(
+            db,
+            "SELECT o_odate, COUNT(*), COUNT(l_price) FROM li"
+            " RIGHT JOIN orders ON l_orderkey = o_orderkey"
+            " GROUP BY o_odate ORDER BY o_odate",
+        )
+    finally:
+        gather.FORCE_EXCHANGE = None
+
+
+def test_count_distinct_single_table(db):
+    s = db.session()
+    s.execute("SET tidb_enforce_mpp = 1")
+    q = "SELECT o_odate, COUNT(DISTINCT o_tag), COUNT(*) FROM orders GROUP BY o_odate ORDER BY o_odate"
+    plan = "\n".join(str(r[0]) for r in s.query("EXPLAIN " + q))
+    assert "fragments" in plan, plan
+    mpp = s.query(q)
+    s.execute("SET tidb_enforce_mpp = 0")
+    s.execute("SET tidb_allow_mpp = 0")
+    host = s.query(q)
+    assert mpp == host
+
+
+def test_distinct_aggs_over_join(db):
+    both(
+        db,
+        "SELECT o_odate, COUNT(DISTINCT l_price), COUNT(*), SUM(l_price) FROM li, orders"
+        " WHERE l_orderkey = o_orderkey GROUP BY o_odate ORDER BY o_odate",
+    )
+    both(
+        db,
+        "SELECT o_odate, SUM(DISTINCT l_price), AVG(DISTINCT l_price) FROM li, orders"
+        " WHERE l_orderkey = o_orderkey GROUP BY o_odate ORDER BY o_odate",
+    )
+
+
+def test_scalar_count_distinct(db):
+    s = db.session()
+    s.execute("SET tidb_enforce_mpp = 1")
+    q = "SELECT COUNT(DISTINCT o_tag) FROM orders"
+    mpp = s.query(q)
+    s.execute("SET tidb_enforce_mpp = 0")
+    s.execute("SET tidb_allow_mpp = 0")
+    host = s.query(q)
+    assert mpp == host == [(4,)]
+
+
+def test_partitioned_single_table_mpp_agg(db):
+    db.execute(
+        "CREATE TABLE pagg (k BIGINT, v BIGINT) PARTITION BY HASH (k) PARTITIONS 4"
+    )
+    rng = np.random.default_rng(5)
+    bulk_load(db, "pagg", [rng.integers(0, 50, 5000), rng.integers(1, 100, 5000)])
+    s = db.session()
+    s.execute("SET tidb_enforce_mpp = 1")
+    q = "SELECT k, COUNT(*), SUM(v) FROM pagg GROUP BY k ORDER BY k"
+    plan = "\n".join(str(r[0]) for r in s.query("EXPLAIN " + q))
+    assert "fragments" in plan, plan
+    mpp = s.query(q)
+    s.execute("SET tidb_enforce_mpp = 0")
+    s.execute("SET tidb_allow_mpp = 0")
+    host = s.query(q)
+    assert mpp == host

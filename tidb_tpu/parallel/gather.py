@@ -135,10 +135,31 @@ def _reader_mpp_ok(reader: PhysTableReader) -> bool:
     )
 
 
+def _distinct_handled(a: AggDesc) -> bool:
+    """Distinct aggs the fragment dedups via the (g, x) exchange; min/max
+    distinct is a no-op and runs as plain min/max."""
+    return a.distinct and a.name in ("count", "sum", "avg")
+
+
 def _agg_mpp_ok(agg: PhysFinalAgg) -> bool:
+    darg_pb = None
     for a in agg.aggs:
-        if a.name not in ("count", "sum", "avg", "min", "max") or a.distinct:
+        if a.name not in ("count", "sum", "avg", "min", "max"):
             return False
+        if _distinct_handled(a):
+            if a.arg is None:
+                return False
+            if a.arg.ftype.kind == TypeKind.STRING and not (
+                a.name == "count" and isinstance(a.arg, ColumnRef) and a.arg.ftype.collation != "ci"
+            ):
+                # count-distinct over dict codes is exact (code ≡ value,
+                # modulo ci folding); sum/avg of codes is meaningless
+                return False
+            pb = repr(a.arg.to_pb())
+            if darg_pb is None:
+                darg_pb = pb
+            elif pb != darg_pb:
+                return False  # one shared distinct tuple per gather
         if a.name in ("min", "max") and a.arg is not None and a.arg.ftype.kind == TypeKind.STRING:
             return False  # dict codes are identities, not an order
         if a.arg is not None and not can_push_down(a.arg, "tpu"):
@@ -201,7 +222,7 @@ def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev, bcast_thr: int = 100_0
         return ([p], [], rows)
     if (
         isinstance(p, PhysHashJoin)
-        and p.kind in ("inner", "left", "semi", "anti")
+        and p.kind in ("inner", "left", "semi", "anti", "right")
         and p.eq_conds
         and not p.other_conds
         and not p.null_aware
@@ -245,6 +266,11 @@ def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev, bcast_thr: int = 100_0
             # multi-key existence/outer shapes need packed-exact keys;
             # without a uniqueness proof the collision-safe path is the
             # host join (a mixed-hash collision would duplicate or drop)
+            return None
+        if p.kind == "right" and len(eq_conds) > 1:
+            # build-side outer preservation rides exact per-build-row match
+            # counts — single-key only (a mixed-hash count could mask a
+            # legitimately unmatched build row)
             return None
         r_rows = None
         st = stats.get(r.table.id) if stats is not None else None
@@ -301,7 +327,7 @@ def _plan_schema_len(readers: list, joins: list) -> int:
     build columns."""
     n = len(readers[0].schema)
     for ji, j in enumerate(joins):
-        if j.kind in ("inner", "left"):
+        if j.kind in ("inner", "left", "right"):
             n += len(readers[ji + 1].schema)
     return n
 
@@ -313,7 +339,7 @@ def _plan_col_source(readers: list, joins: list, pos: int):
         return (readers[0].table.id, oc.slot, oc.ftype)
     pos -= len(readers[0].schema)
     for ji, j in enumerate(joins):
-        if j.kind not in ("inner", "left"):
+        if j.kind not in ("inner", "left", "right"):
             continue
         r = readers[ji + 1]
         if pos < len(r.schema):
@@ -367,6 +393,8 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> P
         n0 = len(r0.schema)
         if stats is None or any(j.kind != "inner" for j in joins):
             return None
+        if any(_distinct_handled(a) for a in p.aggs):
+            return None  # partial pre-agg below the join cannot dedup globally
         st0 = stats.get(r0.table.id)
         if st0 is None or st0.row_count <= 0:
             return None
@@ -555,6 +583,15 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> P
                 return PhysMPPGather(
                     agg=p, readers=readers, joins=joins, schema=p.schema
                 )
+            if (
+                flat is not None
+                and enforce
+                and any(_distinct_handled(a) for a in p.aggs)
+            ):
+                # single-table distinct agg: the coprocessor's per-region
+                # partial lanes cannot dedup globally, but the (g, x)
+                # exchange can — run the no-join fragment pipeline
+                return PhysMPPGather(agg=p, readers=list(flat[0]), joins=[], schema=p.schema)
         if (
             enforce
             and p.partial_input
@@ -562,7 +599,6 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> P
             and child.pushed_agg is not None
             and child.pushed_topn is None
             and child.pushed_limit is None
-            and child.table.partition is None  # partitioned MPP: later round
             and all(can_push_down(c, "tpu") for c in child.pushed_conditions)
         ):
             # single-table MPP agg (exercised mainly by multi-device runs)
@@ -581,6 +617,7 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> P
                 pushed_conditions=list(child.pushed_conditions),
                 scan_slots=[s for s in child.scan_slots],
                 schema=scan_schema,
+                partitions=child.partitions,  # pruned views scan like regions
             )
             return PhysMPPGather(agg=agg, readers=[reader], joins=[], schema=p.schema)
         return p
@@ -617,22 +654,79 @@ class MPPGatherExec:
         session read ts. Pre-aggregated readers (agg pushed below the join)
         execute AS-IS through the coprocessor — scan, selection, and the
         partial agg all run on the reader's engine (device block path) and
-        only the collapsed rows reach the exchange. Plain readers return raw
-        columns; their conditions evaluate inside the fragment program."""
+        only the collapsed rows reach the exchange. Plain readers assemble
+        columns STRAIGHT from the columnar cache (ref: the in-fragment
+        tableScan, cophandler/mpp_exec.go:136) — no Volcano tree, no
+        per-region chunk copies, no dictionary re-encoding; their conditions
+        evaluate inside the fragment program on device."""
+        import numpy as np
+
         from tidb_tpu.executor.executors import TableReaderExec
-        from tidb_tpu.kv.kv import StoreType
 
         if reader.pushed_agg is not None:
             return TableReaderExec(reader, self.session).execute()
-        bare = PhysTableReader(
-            db=reader.db,
-            table=reader.table,
-            store_type=StoreType.HOST,
-            scan_slots=list(reader.scan_slots),
-            schema=reader.schema,
-        )
-        chunk = TableReaderExec(bare, self.session).execute()
-        return chunk
+        if self.session._txn_dirty():
+            # uncommitted session writes live in the txn buffer, not the
+            # columnar cache — route through the executor so the union-scan
+            # overlay applies (ref: UnionScanExec over dirty tables)
+            from tidb_tpu.kv.kv import StoreType
+
+            bare = PhysTableReader(
+                db=reader.db,
+                table=reader.table,
+                store_type=StoreType.HOST,
+                scan_slots=list(reader.scan_slots),
+                schema=reader.schema,
+                partitions=reader.partitions,
+            )
+            return TableReaderExec(bare, self.session).execute()
+        from tidb_tpu.copr.colcache import cache_for
+        from tidb_tpu.kv import tablecodec
+        from tidb_tpu.kv.rowcodec import RowSchema
+        from tidb_tpu.utils.chunk import Chunk, Column
+
+        store = self.session.store
+        cache = cache_for(store)
+        read_ts = self.session.read_ts()
+        t = reader.table
+        views = reader.partitions if reader.partitions is not None else t.partition_views()
+        schema = RowSchema(t.storage_schema)
+        want = [oc.slot for oc in reader.schema]
+        slots = [s for s in want if s >= 0]
+        parts: list[list[tuple]] = []  # per region: [(data, valid)] per column
+        for v in views:
+            cache.set_table_alias(v.id, t.id)
+            for region, _krs in store.pd.regions_in_ranges([tablecodec.record_range(v.id)]):
+                entry = cache.get(region, v.id, schema, slots, read_ts)
+                if entry.n == 0:
+                    continue
+                parts.append(
+                    [
+                        (entry.handles, np.ones(entry.n, bool)) if s < 0 else entry.cols[s]
+                        for s in want
+                    ]
+                )
+        cols = []
+        for ci, oc in enumerate(reader.schema):
+            if len(parts) == 1:
+                data, valid = parts[0][ci]
+            elif parts:
+                data = np.concatenate([p[ci][0] for p in parts])
+                valid = np.concatenate([p[ci][1] for p in parts])
+            else:
+                dt = (
+                    np.float64
+                    if oc.ftype.kind == TypeKind.FLOAT
+                    else (np.int32 if oc.ftype.kind == TypeKind.STRING else np.int64)
+                )
+                data, valid = np.zeros(0, dt), np.zeros(0, bool)
+            dic = (
+                cache.dictionary(t.id, oc.slot)
+                if oc.ftype.kind == TypeKind.STRING and oc.slot >= 0
+                else None
+            )
+            cols.append(Column(data, valid, oc.ftype, dic))
+        return Chunk(cols)
 
     def _bind_conditions(self, reader: PhysTableReader) -> list[Expression]:
         """String constants → dictionary codes (device legalization)."""
@@ -666,7 +760,7 @@ class MPPGatherExec:
         lane_of = []
         off = 0
         for ri, r in enumerate(p.readers):
-            in_plan = ri == 0 or p.joins[ri - 1].kind in ("inner", "left")
+            in_plan = ri == 0 or p.joins[ri - 1].kind in ("inner", "left", "right")
             if in_plan:
                 for i in range(len(r.schema)):
                     lane_of.append(off + 2 * i)
@@ -824,9 +918,12 @@ class MPPGatherExec:
             if self._dev_cacheable:
                 from tidb_tpu.kv import tablecodec
 
-                prs = [
-                    tablecodec.record_range(v.id) for v in reader.table.partition_views()
-                ]
+                _views = (
+                    reader.partitions
+                    if reader.partitions is not None
+                    else reader.table.partition_views()
+                )
+                prs = [tablecodec.record_range(v.id) for v in _views]
                 regions = self.session.store.pd.regions_in_ranges(prs)
                 if self._pin_ts is not None and any(
                     getattr(r, "max_commit_ts", 1 << 62) > self._pin_ts for r, _ in regions
@@ -875,7 +972,7 @@ class MPPGatherExec:
         # semi/anti build readers contribute no plan columns
         all_bounds = list(bounds_by_reader[0])
         for ji, join in enumerate(p.joins):
-            if join.kind in ("inner", "left"):
+            if join.kind in ("inner", "left", "right"):
                 all_bounds.extend(bounds_by_reader[ji + 1])
         ncols = [len(r.schema) for r in p.readers]
         n_lanes, lane_of = self._lane_maps()
@@ -901,6 +998,9 @@ class MPPGatherExec:
         # agg input mapping over the accumulated lane layout
         total_cols = _plan_schema_len(p.readers, p.joins)
 
+        # the shared distinct argument (one per gather, _agg_mpp_ok enforces)
+        dist_arg = next((a.arg for a in agg.aggs if _distinct_handled(a)), None) if agg else None
+
         def agg_inputs(joined):
             pairs = [
                 (joined[lane_of[i]], joined[lane_of[i] + 1]) for i in range(total_cols)
@@ -920,8 +1020,16 @@ class MPPGatherExec:
                 v = jnp.broadcast_to(v if v is not None else True, (n,))
                 out.append(jnp.where(v, d, 0))
                 out.append(v.astype(jnp.int64))
+            if dist_arg is not None:
+                # the distinct argument rides as an extra segment-key pair
+                d, v, _ = eval_expr(dist_arg, batch, jnp)
+                n = pairs[0][0].shape[0]
+                d = jnp.broadcast_to(d, (n,))
+                v = jnp.broadcast_to(v if v is not None else True, (n,))
+                out.append(jnp.where(v, d, 0))
+                out.append(v.astype(jnp.int64))
             for a in agg.aggs:
-                if a.arg is None:
+                if a.arg is None or _distinct_handled(a):
                     continue
                 d, v, _ = eval_expr(a.arg, batch, jnp)
                 n = pairs[0][0].shape[0]
@@ -973,7 +1081,12 @@ class MPPGatherExec:
                     key_bounds=tuple(kb),
                 )
             )
-            if not join.unique and join.kind in ("inner", "left"):
+            if join.kind == "right":
+                # the fragment appends one static build-sized segment of
+                # (possibly) unmatched build rows to the accumulated layout
+                base = join_specs[-1].out_cap if not join.unique else probe_cap
+                probe_cap = base + build_cap
+            elif not join.unique and join.kind in ("inner", "left"):
                 probe_cap = join_specs[-1].out_cap
 
         # rebase left_keys of later joins: after join ji the accumulated lane
@@ -991,10 +1104,13 @@ class MPPGatherExec:
             group_cap = getattr(self, "_group_cap_hint", None) or self._initial_group_cap(nrows[0])
         if agg is not None:
             nk = 2 * len(agg.group_by) if agg.group_by else 2
-            sums_idx = list(range(nk, nk + 2 * sum(1 for a in agg.aggs if a.arg is not None)))
+            ndk = 2 if dist_arg is not None else 0
+            n_plain = sum(1 for a in agg.aggs if a.arg is not None and not _distinct_handled(a))
+            sums_idx = list(range(nk + ndk, nk + ndk + 2 * n_plain))
+            dmask = tuple(_distinct_handled(a) for a in agg.aggs if a.arg is not None)
             val_kinds = []
             for a in agg.aggs:
-                if a.arg is not None:
+                if a.arg is not None and not _distinct_handled(a):
                     val_kinds.append(a.name if a.name in ("min", "max") else "sum")
                     val_kinds.append("sum")  # the validity/count lane
             # group-key lanes interleave (data, valid); bounded data lanes
@@ -1006,6 +1122,13 @@ class MPPGatherExec:
                     agg_kb.append((0, 1))
             else:
                 agg_kb = [(0, 0), (1, 1)]  # synthetic constant group key
+            if dist_arg is not None:
+                agg_kb.append(
+                    all_bounds[dist_arg.index]
+                    if isinstance(dist_arg, ColumnRef) and dist_arg.index < len(all_bounds)
+                    else None
+                )
+                agg_kb.append((0, 1))
         while True:
             spec = (
                 DistAggSpec(
@@ -1014,6 +1137,8 @@ class MPPGatherExec:
                     group_cap=group_cap,
                     key_bounds=tuple(agg_kb),
                     val_kinds=tuple(val_kinds),
+                    n_dkeys=ndk,
+                    distinct_mask=dmask if ndk else (),
                 )
                 if agg is not None
                 else None
@@ -1093,12 +1218,15 @@ class MPPGatherExec:
         ×2 margin when ANALYZE stats exist, else a conservative bound on the
         probe row count. Undersizing is safe — overflow is detected and the
         coordinator retries bigger."""
-        if not self.plan.agg.group_by:
+        keys = list(self.plan.agg.group_by)
+        # the distinct argument multiplies the stage-1 (g, x) slot count
+        keys += [a.arg for a in self.plan.agg.aggs if _distinct_handled(a)][:1]
+        if not keys:
             return 8  # scalar aggregate: one synthetic group
         stats = self.session._db.stats
         est = 1
         have = False
-        for gi, g in enumerate(self.plan.agg.group_by):
+        for gi, g in enumerate(keys):
             if not isinstance(g, ColumnRef):
                 est *= 64
                 continue

@@ -42,6 +42,15 @@ class DistAggSpec:
     # per ``sums`` PAIR (data+valid): "sum" | "min" | "max" — how the value
     # lane reduces within a group (and re-reduces across the exchange)
     val_kinds: tuple = ()
+    # distinct aggregates (ref: TiFlash two-phase distinct agg): ``n_dkeys``
+    # input lanes AFTER the group keys hold the (shared) distinct argument
+    # as a (data, valid) pair. Stage 1 groups by (g, x) — deduping x within
+    # g — the exchange routes by g only, and a final per-g reduction counts/
+    # sums the surviving distinct slots. ``distinct_mask``: per agg-with-arg
+    # (output order), True when its (value, count) output pair reads the
+    # distinct slot reduction instead of a plain value lane.
+    n_dkeys: int = 0
+    distinct_mask: tuple = ()
 
 
 def _pack_keys(jnp, keys, bounds):
@@ -474,6 +483,8 @@ def build_dist_pipeline(
                 rvalid = jax.lax.all_gather(rvalid, "dp").reshape(-1)
                 rkeys = [rcols[i] for i in join.right_keys]
                 rkey, _ = join_lane(rkeys)
+            rlive = rvalid  # post-selection build rows (right joins preserve
+            # these even with NULL keys — key validity only gates MATCHING)
             for vl in join.right_key_valid:
                 rvalid = rvalid & rcols[vl].astype(bool)
             # dead-row sentinels above every live key code (packed lanes stay
@@ -481,7 +492,50 @@ def build_dist_pipeline(
             dead_b = None if ncodes is None else ncodes + 1
             dead_p = None if ncodes is None else ncodes
             probe_live = mask & lkv  # rows eligible to match
-            if kind in ("semi", "anti") and not join.unique:
+            if kind == "right":
+                # build-side outer (ref: mpp.go:397 right-out join build):
+                # matched pairs emit like inner; build rows NO probe row
+                # matched emit once with the probe lanes NULL-extended. With
+                # hash exchange each build row lives on exactly one shard, so
+                # the unmatched flag is local; with broadcast the flag must
+                # AND across shards (psum of per-shard match counts) and only
+                # shard 0 emits the survivors.
+                if join.unique:
+                    gathered, match = _local_unique_join(
+                        jax, jnp, lkey, lkeys, probe_live, rkey, rkeys, rcols, rvalid, dead_b, dead_p
+                    )
+                    macc = acc + gathered
+                    mmask = match
+                else:
+                    out_l, out_r, mmask, of = _local_expand_join(
+                        jax, jnp, lkey, lkeys, probe_live, rkey, rkeys,
+                        rcols, rvalid, acc, join.out_cap, dead_b, dead_p,
+                        left_outer=False, lmatch=probe_live
+                    )
+                    overflow = overflow + of
+                    macc = out_l + out_r
+                # per-build-row probe-match counts (roles swapped; exact —
+                # the planner admits single-key right joins only)
+                cnt_b = _local_match_counts(
+                    jax, jnp, rkey, rkeys, rvalid, lkey, lkeys, probe_live, dead_b, dead_p
+                )
+                if join.exchange == "broadcast":
+                    cnt_b = jax.lax.psum(cnt_b, "dp")
+                    emit = jax.lax.axis_index("dp") == 0
+                    unmatched = rlive & (cnt_b == 0) & emit
+                else:
+                    unmatched = rlive & (cnt_b == 0)
+                n_probe_lanes = len(acc)
+                rn = rlive.shape[0]
+                acc = [
+                    jnp.concatenate([a, jnp.zeros(rn, a.dtype)])
+                    for a in macc[:n_probe_lanes]
+                ] + [
+                    jnp.concatenate([a, rc])
+                    for a, rc in zip(macc[n_probe_lanes:], rcols)
+                ]
+                mask = jnp.concatenate([mmask, unmatched])
+            elif kind in ("semi", "anti") and not join.unique:
                 cnt = _local_match_counts(
                     jax, jnp, lkey, lkeys, probe_live, rkey, rkeys, rvalid, dead_b, dead_p
                 )
@@ -549,10 +603,14 @@ def build_dist_pipeline(
 
     def _agg_tail(joined, mask, dropped, overflow):
         acols = agg_inputs(joined) if agg_inputs is not None else joined
-        keys = list(acols[: agg.n_keys])
+        G, D = agg.n_keys, agg.n_dkeys
+        # distinct lanes join the stage-1 segment keys: grouping by (g, x)
+        # IS the dedup (ref: TiFlash two-phase distinct aggregation)
+        keys = list(acols[: G + D])
         vals = [acols[i] for i in agg.sums]
         pkeys, psums, pcnt, of1 = _segment_partial(jnp, keys, vals, mask, cap, agg.key_bounds, agg.val_kinds)
-        h = _combine_keys(jnp, pkeys)
+        h = _combine_keys(jnp, pkeys[:G])  # route by GROUP keys only: every
+        # (g, *) slot lands on g's owner shard, where x dedups globally
         owner = jnp.where(pcnt > 0, jnp.abs(h) % ndev, ndev - 1)
         order = jnp.argsort(owner, stable=True)
         so = owner[order]
@@ -573,16 +631,45 @@ def build_dist_pipeline(
         rxsums = [exchange(bucketize(s)) for s in psums]
         rxcnt = exchange(bucketize(pcnt))
         mkeys, msums_cnt, _, of3 = _segment_partial(jnp, rxkeys, rxsums + [rxcnt], rxcnt > 0, cap, agg.key_bounds, tuple(agg.val_kinds) + ("sum",))
-        gkeys = [jax.lax.all_gather(k, "dp").reshape(ndev * cap) for k in mkeys]
-        gsums = [jax.lax.all_gather(s, "dp").reshape(ndev * cap) for s in msums_cnt[:-1]]
-        gcnt = jax.lax.all_gather(msums_cnt[-1], "dp").reshape(ndev * cap)
+        if D:
+            # stage 3: per-g reduction over the deduped (g, x) slots — the
+            # distinct output pair is (Σ distinct x, count of distinct x);
+            # plain value lanes re-reduce by their own kinds
+            bcnt = msums_cnt[-1]
+            slot_live = bcnt > 0
+            xvalid = mkeys[G + 1].astype(bool) & slot_live
+            dval = jnp.where(xvalid, mkeys[G], 0)
+            cvals = list(msums_cnt[:-1]) + [dval, xvalid.astype(jnp.int64), bcnt]
+            ckinds = tuple(agg.val_kinds) + ("sum", "sum", "sum")
+            fkeys, fsums, _, of4 = _segment_partial(
+                jnp, list(mkeys[:G]), cvals, slot_live, cap, tuple(agg.key_bounds[:G]), ckinds
+            )
+            of3 = of3 + of4
+            nv = len(agg.sums)
+            out_sums = []
+            vi = 0
+            for is_d in agg.distinct_mask:
+                if is_d:
+                    out_sums += [fsums[nv], fsums[nv + 1]]
+                else:
+                    out_sums += [fsums[vi], fsums[vi + 1]]
+                    vi += 2
+            out_keys, gcnt_local = fkeys, fsums[-1]
+        else:
+            out_keys, out_sums, gcnt_local = mkeys, list(msums_cnt[:-1]), msums_cnt[-1]
+        gkeys = [jax.lax.all_gather(k, "dp").reshape(ndev * cap) for k in out_keys]
+        gsums = [jax.lax.all_gather(s, "dp").reshape(ndev * cap) for s in out_sums]
+        gcnt = jax.lax.all_gather(gcnt_local, "dp").reshape(ndev * cap)
         total = jax.lax.psum(mask.sum(), "dp")
         gdropped = jax.lax.psum(dropped, "dp")
         goverflow = jax.lax.psum(overflow + of1 + of_slots + of3, "dp")
         return (*gkeys, *gsums, gcnt, total, gdropped, goverflow)
 
     if agg is not None:
-        n_rep = agg.n_keys + len(agg.sums) + 1
+        if agg.n_dkeys:
+            n_rep = agg.n_keys + 2 * len(agg.distinct_mask) + 1
+        else:
+            n_rep = agg.n_keys + len(agg.sums) + 1
     else:
         n_rep = 2 * len(topn.out_lanes) + 1
     fn = jax.shard_map(

@@ -84,6 +84,7 @@ def gather_to_pb(plan, group_cap: Optional[int] = None, schema_ver: int = -1) ->
                 "agg": agg_pb,
                 "schema": [_oc_pb(oc) for oc in r.schema],
                 "ranges": _ranges_pb(r.ranges),
+                "parts": [v.id for v in r.partitions] if r.partitions is not None else None,
             }
         )
     joins = [
@@ -145,6 +146,11 @@ def gather_from_pb(pb: dict, table_by_id):
                 scan_slots=list(rp["slots"]),
                 ranges=_ranges_from_pb(rp["ranges"]),
                 schema=[_oc_from_pb(v) for v in rp["schema"]],
+                partitions=(
+                    [table.partition_view(pid) for pid in rp["parts"]]
+                    if rp.get("parts") is not None
+                    else None
+                ),
             )
         )
     joins = [

@@ -225,6 +225,8 @@ class Select(Node):
     from_: Optional[Node] = None  # TableRef | Join | SubquerySource
     where: Optional[Node] = None
     group_by: list[Node] = field(default_factory=list)
+    # GROUP BY ... WITH ROLLUP (ref: parser.y WITH ROLLUP production)
+    rollup: bool = False
     having: Optional[Node] = None
     order_by: list[OrderItem] = field(default_factory=list)
     limit: Optional[int] = None

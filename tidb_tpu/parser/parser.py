@@ -272,6 +272,10 @@ class Parser:
             sel.group_by.append(self.parse_expr())
             while self.eat_op(","):
                 sel.group_by.append(self.parse_expr())
+            if self.at_kw("WITH"):
+                self.next()
+                self.expect_kw("ROLLUP")
+                sel.rollup = True
         if self.eat_kw("HAVING"):
             sel.having = self.parse_expr()
         if self.at_kw("ORDER"):

@@ -334,7 +334,7 @@ class Builder:
             for it in sel.items:
                 if isinstance(it.expr, ast.Wildcard):
                     raise PlanError("SELECT * with GROUP BY is not supported")
-                e = self._resolve_in_agg(it.expr, base_schema, aggs, group_exprs, sel.group_by)
+                e = self._resolve_in_agg(it.expr, base_schema, aggs, group_exprs, sel.group_by, rollup=sel.rollup)
                 proj_exprs.append(e)
                 nm = it.alias or _display_name(it.expr)
                 names.append(nm)
@@ -344,7 +344,7 @@ class Builder:
             plan = agg
             having_conds: list[Expression] = []
             if sel.having is not None:
-                h = self._resolve_in_agg(sel.having, base_schema, aggs, group_exprs, sel.group_by, aliases)
+                h = self._resolve_in_agg(sel.having, base_schema, aggs, group_exprs, sel.group_by, aliases, rollup=sel.rollup)
                 having_conds = self._split_conj(h)
             # ORDER BY items containing aggregates resolve against the agg
             # (may append new aggs, so this must precede finalization); they
@@ -356,16 +356,21 @@ class Builder:
                     # after GROUP BY YEAR(dt)) resolve against the agg — the
                     # projection schema no longer carries the base columns
                     if _contains_agg(oi.expr) or _contains_group_expr(oi.expr, sel.group_by or []):
-                        e_o = self._resolve_in_agg(oi.expr, base_schema, aggs, group_exprs, sel.group_by, aliases)
+                        e_o = self._resolve_in_agg(oi.expr, base_schema, aggs, group_exprs, sel.group_by, aliases, rollup=sel.rollup)
                         order_agg_map[i_o] = len(order_agg_exprs)
                         order_agg_exprs.append(e_o)
             # agg list is final now: patch deferred group-key refs everywhere
             agg.schema = agg_schema()
-            proj_exprs = [_patch_group_refs(e, len(aggs)) for e in proj_exprs]
-            having_conds = [_patch_group_refs(e, len(aggs)) for e in having_conds]
-            order_agg_exprs = [_patch_group_refs(e, len(aggs)) for e in order_agg_exprs]
+            ng = len(group_exprs)
+            proj_exprs = [_patch_group_refs(e, len(aggs), ng) for e in proj_exprs]
+            having_conds = [_patch_group_refs(e, len(aggs), ng) for e in having_conds]
+            order_agg_exprs = [_patch_group_refs(e, len(aggs), ng) for e in order_agg_exprs]
             for a in aliases:
-                aliases[a] = _patch_group_refs(aliases[a], len(aggs))
+                aliases[a] = _patch_group_refs(aliases[a], len(aggs), ng)
+            if sel.rollup:
+                # GROUP BY ... WITH ROLLUP → union of grouping-set branches
+                # (see _expand_rollup for the Expand redesign rationale)
+                plan = _expand_rollup(agg)
             if having_conds:
                 plan = LogicalSelection(conditions=having_conds, children=[plan])
             proj = LogicalProjection(exprs=proj_exprs, children=[plan])
@@ -1193,7 +1198,7 @@ class Builder:
         return e
 
     # -- agg resolution -------------------------------------------------------
-    def _resolve_in_agg(self, node, base_schema, aggs, group_exprs, group_asts, aliases=None):
+    def _resolve_in_agg(self, node, base_schema, aggs, group_exprs, group_asts, aliases=None, rollup=False):
         """Resolve an expression in SELECT/HAVING of an aggregated query:
         agg calls → refs into the agg output; group-by exprs → group key refs;
         bare columns → implicit first_row (MySQL non-strict)."""
@@ -1208,6 +1213,17 @@ class Builder:
                     return ColumnRef(-1 - gi, e.ftype, f"gb#{gi}")
             if isinstance(n, ast.FuncCall):
                 name = _FN_ALIAS.get(n.name, n.name)
+                if name == "grouping" and len(n.args) == 1:
+                    # GROUPING(g): 1 on super-aggregate (rolled-up) rows,
+                    # 0 otherwise (ref: expression.grouping + Expand). Only
+                    # meaningful under WITH ROLLUP; resolves to a deferred
+                    # flag-column ref the rollup rewrite materializes.
+                    if not rollup:
+                        raise PlanError("GROUPING() is only valid with GROUP BY ... WITH ROLLUP")
+                    for gi, gast in enumerate(group_asts):
+                        if _ast_eq(n.args[0], gast):
+                            return ColumnRef(-20001 - gi, bigint_type(nullable=False), f"grouping#{gi}")
+                    raise PlanError("GROUPING() argument must be a GROUP BY expression")
                 if name in AGG_FUNCS or n.star:
                     if n.star:
                         desc = AggDesc("count", None)
@@ -1420,14 +1436,95 @@ class Builder:
         return rows
 
 
-def _patch_group_refs(e: Expression, n_aggs: int) -> Expression:
+def _expand_rollup(agg: "LogicalAggregation") -> "LogicalSetOp":
+    """GROUP BY a, b WITH ROLLUP → UNION ALL of the grouping-set branches
+    (a, b), (a), () — each a plain aggregation whose projection NULL-extends
+    the rolled-up keys and emits the GROUPING() flags.
+
+    Ref: the reference's MPP Expand executor (cophandler/mpp_exec.go:422-466)
+    replicates every input row once per grouping set before a single shared
+    aggregation. Redesigned for the device path: row replication multiplies
+    the HBM working set by the set count, while branch aggregations re-read
+    the SAME cached device lanes (the fragment/device caches key on table
+    state, not plan), so each extra set costs one more tiny reduction over
+    resident data instead of a full copy."""
+    import copy
+
+    from tidb_tpu.planner.plans import LogicalProjection, LogicalSetOp
+    from tidb_tpu.types.field_type import bigint_type
+
+    A = len(agg.aggs)
+    G = len(agg.group_by)
+    flag_ft = bigint_type(nullable=False)
+    out_schema = list(agg.schema) + [OutCol(f"grouping#{j}", flag_ft) for j in range(G)]
+    # rolled-up key columns turn nullable in the union output
+    for j in range(G):
+        oc = out_schema[A + j]
+        if not oc.ftype.nullable:
+            import dataclasses
+
+            out_schema[A + j] = dataclasses.replace(
+                oc, ftype=dataclasses.replace(oc.ftype, nullable=True)
+            )
+    branches = []
+    for k in range(G, -1, -1):
+        aggs_b = copy.deepcopy(agg.aggs)
+        if k == 0:
+            # the () grand-total branch is a scalar aggregation, which always
+            # yields one row — MySQL semantics want one row IFF the input is
+            # non-empty, and want it even with no aggregate functions at all:
+            # a hidden COUNT(*) provides both (filtered below, not projected)
+            aggs_b.append(AggDesc("count", None))
+        b: "LogicalPlan" = LogicalAggregation(
+            group_by=[copy.deepcopy(g) for g in agg.group_by[:k]],
+            aggs=aggs_b,
+            children=[copy.deepcopy(agg.children[0])],
+        )
+        b.schema = [OutCol(f"agg#{i}", a.ftype) for i, a in enumerate(aggs_b)] + [
+            agg.schema[A + j] for j in range(k)
+        ]
+        if k == 0:
+            from tidb_tpu.expression.expr import func as _func
+            from tidb_tpu.planner.plans import LogicalSelection
+
+            b = LogicalSelection(
+                conditions=[
+                    _func("gt", ColumnRef(A, bigint_type(nullable=False)), Constant(0, bigint_type(nullable=False)))
+                ],
+                children=[b],
+            )
+        exprs: list[Expression] = [
+            ColumnRef(i, agg.schema[i].ftype, agg.schema[i].name) for i in range(A)
+        ]
+        for j in range(G):
+            oc = out_schema[A + j]
+            if j < k:
+                exprs.append(ColumnRef(A + j, oc.ftype, oc.name))
+            else:
+                exprs.append(Constant(None, oc.ftype))
+        for j in range(G):
+            exprs.append(Constant(0 if j < k else 1, flag_ft))
+        branches.append(LogicalProjection(exprs=exprs, schema=list(out_schema), children=[b]))
+    # the set-op executor is binary: fold into a left-deep UNION ALL chain
+    plan = branches[0]
+    for nxt in branches[1:]:
+        plan = LogicalSetOp(op="union", all=True, schema=out_schema, children=[plan, nxt])
+    return plan
+
+
+def _patch_group_refs(e: Expression, n_aggs: int, n_groups: int = 0) -> Expression:
     """Rewrite deferred group-key refs (negative indices) now that the agg
-    lane count is final: ColumnRef(-1-gi) → ColumnRef(n_aggs+gi)."""
+    lane count is final: ColumnRef(-1-gi) → ColumnRef(n_aggs+gi); deferred
+    GROUPING flags ColumnRef(-20001-gi) → ColumnRef(n_aggs+n_groups+gi)
+    (the rollup rewrite appends one flag column per group key)."""
+    if isinstance(e, ColumnRef) and e.index <= -20001:
+        gi = -20001 - e.index
+        return ColumnRef(n_aggs + n_groups + gi, e.ftype, e.name)
     if isinstance(e, ColumnRef) and e.index < 0:
         gi = -1 - e.index
         return ColumnRef(n_aggs + gi, e.ftype, e.name)
     if isinstance(e, ScalarFunc):
-        return ScalarFunc(e.sig, [_patch_group_refs(a, n_aggs) for a in e.args], e.ftype)
+        return ScalarFunc(e.sig, [_patch_group_refs(a, n_aggs, n_groups) for a in e.args], e.ftype)
     return e
 
 
@@ -1514,7 +1611,9 @@ def _contains_group_expr(node, group_asts) -> bool:
 
 def _contains_agg(node) -> bool:
     if isinstance(node, ast.FuncCall):
-        if node.over is None and (_FN_ALIAS.get(node.name, node.name) in AGG_FUNCS or node.star):
+        name = _FN_ALIAS.get(node.name, node.name)
+        # GROUPING() resolves against the agg output like an aggregate
+        if node.over is None and (name in AGG_FUNCS or node.star or name == "grouping"):
             return True
         return any(_contains_agg(a) for a in node.args)
     for attr in ("left", "right", "operand", "low", "high", "pattern", "else_value"):
