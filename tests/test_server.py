@@ -214,3 +214,23 @@ def test_tls_roundtrip():
             plain.close()
     finally:
         server.close()
+
+
+def test_warning_count_on_the_wire(srv):
+    """The OK/EOF warning-count field carries session warnings (ref: the
+    OK_Packet/EOF_Packet warnings u16 MySQL clients read)."""
+    _, port = srv
+    c = Client("127.0.0.1", port)
+    try:
+        rows = c.query("SELECT 1/0")
+        assert rows == [(None,)] or rows == [("NULL",)] or rows[0][0] is None
+        assert c.warning_count == 1, c.warning_count
+        c.query("CREATE TABLE ww (x DECIMAL(6,2), i BIGINT)")
+        c.query("INSERT INTO ww VALUES (1.005, '9zz')")
+        assert c.warning_count == 2, c.warning_count  # 1265 + 1366
+        warns = c.query("SHOW WARNINGS")
+        assert len(warns) == 2
+        c.query("SELECT 1")
+        assert c.warning_count == 0
+    finally:
+        c.close()

@@ -70,8 +70,8 @@ def test_blocked_limit_pages_lazily(blockdb, monkeypatch):
     calls = {"n": 0}
     real = tpu_engine.get_kernel
 
-    def counting(bound, n_pad, agg_cap):
-        k = real(bound, n_pad, agg_cap)
+    def counting(bound, n_pad, agg_cap, **kw):
+        k = real(bound, n_pad, agg_cap, **kw)
         orig_fn = k.fn
 
         def fn(*a, **kw):

@@ -1,0 +1,64 @@
+"""Warnings pipeline (ref: stmtctx.AppendWarning, stmtctx.go:1025):
+emitters (zero-division, DML coercion/truncation), SHOW WARNINGS,
+@@warning_count, the max_error_count cap, and strict-mode errors."""
+
+import pytest
+
+import tidb_tpu
+
+
+@pytest.fixture()
+def db():
+    return tidb_tpu.open()
+
+
+def test_div_zero_warnings(db):
+    s = db.session()
+    assert s.query("SELECT 1/0") == [(None,)]
+    assert s.query("SHOW WARNINGS") == [("Warning", 1365, "Division by 0")]
+    assert s.query("SELECT @@warning_count") == [(1,)]
+    db.execute("CREATE TABLE t (a BIGINT, b BIGINT)")
+    db.execute("INSERT INTO t VALUES (1,0),(2,1),(3,0)")
+    s.query("SELECT a / b FROM t")
+    assert len(s.query("SHOW WARNINGS")) == 2
+
+
+def test_insert_coercion_warnings(db):
+    s = db.session()
+    db.execute("CREATE TABLE c (x DECIMAL(8,2), i BIGINT)")
+    s.execute("INSERT INTO c VALUES (1.005, '12abc')")
+    w = s.query("SHOW WARNINGS")
+    # '12abc' has a numeric prefix → 1265 Data truncated (MySQL); garbage
+    # with no digits would be 1366
+    assert sorted(x[1] for x in w) == [1265, 1265]
+    s.execute("INSERT INTO c VALUES (2, 'zz')")
+    assert [x[1] for x in s.query("SHOW WARNINGS")] == [1366]
+    s.execute("INSERT INTO c VALUES (3, '12.5')")
+    assert s.query("SHOW WARNINGS") == []  # clean numeric string rounds
+    assert s.query("SELECT i FROM c WHERE x = 3") == [(13,)]
+    assert s.query("SELECT x, i FROM c")[0][1] == 12
+    import decimal
+
+    assert s.query("SELECT x FROM c")[0][0] == decimal.Decimal("1.01")
+
+
+def test_strict_mode_errors(db):
+    s = db.session()
+    db.execute("CREATE TABLE c2 (i BIGINT)")
+    s.execute("SET sql_mode = 'STRICT_TRANS_TABLES'")
+    with pytest.raises(Exception, match="Incorrect integer"):
+        s.execute("INSERT INTO c2 VALUES ('zz')")
+    s.execute("SET sql_mode = ''")
+    s.execute("INSERT INTO c2 VALUES ('zz')")
+    assert s.query("SELECT i FROM c2") == [(0,)]
+
+
+def test_warning_cap(db):
+    s = db.session()
+    db.execute("CREATE TABLE big (a BIGINT, b BIGINT)")
+    db.execute("INSERT INTO big VALUES " + ", ".join(f"({i}, 0)" for i in range(100)))
+    s.query("SELECT a / b FROM big")
+    assert len(s.query("SHOW WARNINGS")) == 64  # max_error_count default
+    s.execute("SET max_error_count = 5")
+    s.query("SELECT a / b FROM big")
+    assert len(s.query("SHOW WARNINGS")) == 5

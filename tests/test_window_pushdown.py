@@ -75,16 +75,16 @@ def test_agg_fuses_into_reader(db):
 
 def test_multiblock_fused_kernel(db, monkeypatch):
     # shrink the device block so 5k rows span several blocks: exercises the
-    # concatenated multi-block window program (_exec_window_blocks)
+    # concatenated multi-block window program (_exec_fused_blocks)
     monkeypatch.setattr(tpu_engine, "_BLOCK", 1 << 10)
     calls = {"n": 0}
-    real = tpu_engine._exec_window_blocks
+    real = tpu_engine._exec_fused_blocks
 
     def spy(*a, **k):
         calls["n"] += 1
         return real(*a, **k)
 
-    monkeypatch.setattr(tpu_engine, "_exec_window_blocks", spy)
+    monkeypatch.setattr(tpu_engine, "_exec_fused_blocks", spy)
     both(db, WIN_AGG)
     both(db, WIN_ROWS)
     assert calls["n"] >= 2

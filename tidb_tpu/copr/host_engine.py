@@ -128,10 +128,10 @@ def _index_scan(store: MemStore, region: Region, ex: dagpb.ExecutorPB, ranges: l
     return Chunk(cols)
 
 
-def _selection(chunk: Chunk, conditions: list[dict]) -> Chunk:
+def _selection(chunk: Chunk, conditions: list[dict], warn=None) -> Chunk:
     if not len(chunk):
         return chunk
-    batch = EvalBatch.from_chunk(chunk)
+    batch = EvalBatch.from_chunk(chunk, warn=warn)
     keep = np.ones(len(chunk), dtype=bool)
     for pb in conditions:
         c = eval_to_column(expr_from_pb(pb), batch, np)

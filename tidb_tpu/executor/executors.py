@@ -68,9 +68,9 @@ def _build_executor(plan, session) -> Executor:
     if isinstance(plan, PhysTableReader):
         return TableReaderExec(plan, session)
     if isinstance(plan, PhysSelection):
-        return SelectionExec(plan, build_executor(plan.children[0], session))
+        return SelectionExec(plan, build_executor(plan.children[0], session), session)
     if isinstance(plan, PhysProjection):
-        return ProjectionExec(plan, build_executor(plan.children[0], session))
+        return ProjectionExec(plan, build_executor(plan.children[0], session), session)
     if isinstance(plan, PhysFinalAgg):
         return FinalAggExec(plan, build_executor(plan.children[0], session))
     if isinstance(plan, PhysSort):
@@ -537,26 +537,30 @@ class IndexMergeExec(Executor):
 class SelectionExec(Executor):
     plan: PhysSelection
     child: Executor
+    session: object = None
 
     def __post_init__(self):
         self.schema = self.plan.schema
 
     def execute(self) -> Chunk:
         chunk = self.child.execute()
-        return host_selection(chunk, [c.to_pb() for c in self.plan.conditions])
+        warn = self.session.append_warning if self.session is not None else None
+        return host_selection(chunk, [c.to_pb() for c in self.plan.conditions], warn=warn)
 
 
 @dataclass
 class ProjectionExec(Executor):
     plan: PhysProjection
     child: Executor
+    session: object = None
 
     def __post_init__(self):
         self.schema = self.plan.schema
 
     def execute(self) -> Chunk:
         chunk = self.child.execute()
-        batch = EvalBatch.from_chunk(chunk)
+        warn = self.session.append_warning if self.session is not None else None
+        batch = EvalBatch.from_chunk(chunk, warn=warn)
         if len(chunk) == 0:
             return _empty_chunk(self.plan.schema)
         return Chunk([eval_to_column(e, batch, np) for e in self.plan.exprs])

@@ -37,10 +37,89 @@ class DupKeyError(WriteError):
 # -- value coercion: literal → physical slot value ---------------------------
 
 
-def to_physical(v, ftype) -> object:
+def _strict(session) -> bool:
+    return "STRICT" in str(session.vars.get("sql_mode", "")).upper()
+
+
+def _warn_of(session):
+    return session.append_warning
+
+
+def to_physical(v, ftype, warn=None, strict: bool = True, col: str = "") -> object:
+    """Logical → storage value. Non-strict mode coerces MySQL-style —
+    leading-numeric string prefixes, clamped garbage — and reports through
+    ``warn`` (ref: types truncation + stmtctx.AppendWarning: 1265/1366);
+    strict mode raises like MySQL's STRICT_TRANS_TABLES."""
     if v is None:
         return None
     k = ftype.kind
+    if k in (TypeKind.INT, TypeKind.UINT) and isinstance(v, str):
+        import re as _re
+        from decimal import ROUND_HALF_UP, Decimal
+
+        num = _re.match(r"\s*([+-]?(?:\d+(?:\.\d*)?|\.\d+)(?:[eE][+-]?\d+)?)\s*$", v)
+        if num is not None:
+            # clean numeric string: MySQL rounds half away from zero, no
+            # warning ('12.5' → 13)
+            v = int(Decimal(num.group(1)).to_integral_value(rounding=ROUND_HALF_UP))
+        else:
+            m = _re.match(r"\s*[+-]?\d+", v)
+            if m is not None:
+                # numeric prefix + trailing garbage → 1265 Data truncated
+                msg = f"Data truncated for column '{col}'"
+                code = 1265
+            else:
+                msg = f"Incorrect integer value: '{v}' for column '{col}'"
+                code = 1366
+            if strict:
+                raise WriteError(msg)
+            if warn is not None:
+                warn("Warning", code, msg)
+            v = int(m.group()) if m else 0
+    if k == TypeKind.FLOAT and isinstance(v, str):
+        try:
+            v = float(v)
+        except ValueError:
+            msg = f"Incorrect DOUBLE value: '{v}' for column '{col}'"
+            if strict:
+                raise WriteError(msg)
+            if warn is not None:
+                warn("Warning", 1366, msg)
+            v = 0.0
+    if k == TypeKind.DECIMAL:
+        from decimal import ROUND_HALF_UP, Decimal, InvalidOperation
+
+        if isinstance(v, (str, Decimal)):
+            # exact decimal path: MySQL rounds half AWAY from zero on the
+            # decimal digits, which binary floats misrepresent (1.005)
+            try:
+                d = v if isinstance(v, Decimal) else Decimal(v.strip())
+            except InvalidOperation:
+                msg = f"Incorrect DECIMAL value: '{v}' for column '{col}'"
+                if strict:
+                    raise WriteError(msg)
+                if warn is not None:
+                    warn("Warning", 1366, msg)
+                return 0
+            scaled = d.scaleb(ftype.scale)
+            q = int(scaled.to_integral_value(rounding=ROUND_HALF_UP))
+            if warn is not None and scaled != q:
+                warn("Note", 1265, f"Data truncated for column '{col}'")
+            return q
+        try:
+            exact = float(v) * (10**ftype.scale)
+        except (TypeError, ValueError):
+            msg = f"Incorrect DECIMAL value: '{v}' for column '{col}'"
+            if strict:
+                raise WriteError(msg)
+            if warn is not None:
+                warn("Warning", 1366, msg)
+            return 0
+        q = int(round(exact))
+        if warn is not None and abs(exact - q) > 1e-9:
+            # fractional digits beyond the column scale were rounded away
+            warn("Note", 1265, f"Data truncated for column '{col}'")
+        return q
     if k == TypeKind.STRING:
         if isinstance(v, str):
             v = v.encode("utf-8")
@@ -56,8 +135,6 @@ def to_physical(v, ftype) -> object:
             except Exception:
                 raise WriteError(f"Invalid JSON text: {v[:60]!r}")
         return v
-    if k == TypeKind.DECIMAL:
-        return int(round(float(v) * (10**ftype.scale)))
     if k == TypeKind.DATE:
         if isinstance(v, (int, np.integer)):
             return int(v)
@@ -401,7 +478,7 @@ def execute_insert(session, stmt: ast.Insert) -> int:
         for r in rows:
             rows_values.append(list(r))
     else:
-        builder = Builder(session.catalog, db, subquery_runner=session._subquery_runner)
+        builder = Builder(session.catalog, db, subquery_runner=session._subquery_runner, warn=session.append_warning)
         for row in stmt.values:
             if len(row) != len(targets):
                 raise WriteError("Column count doesn't match value count")
@@ -424,7 +501,11 @@ def execute_insert(session, stmt: ast.Insert) -> int:
     for vals in rows_values:
         full: list = [None] * len(cols)
         for off, v in zip(targets, vals):
-            full[off] = to_physical(v, cols[off].ftype) if not isinstance(v, (bytes,)) or cols[off].ftype.kind == TypeKind.STRING else v
+            full[off] = (
+                to_physical(v, cols[off].ftype, warn=_warn_of(session), strict=_strict(session), col=cols[off].name)
+                if not isinstance(v, (bytes,)) or cols[off].ftype.kind == TypeKind.STRING
+                else v
+            )
         # defaults + auto increment
         handle = None
         for c in cols:
@@ -493,9 +574,9 @@ def _apply_on_dup_update(session, t: TableInfo, old_vals: list, handle: int, can
         return node
 
     chunk = _rows_to_chunk(session, t, [old_vals])
-    builder = Builder(session.catalog, db, subquery_runner=session._subquery_runner)
+    builder = Builder(session.catalog, db, subquery_runner=session._subquery_runner, warn=session.append_warning)
     schema = [OutCol(c.name, c.ftype, table=alias, slot=c.offset) for c in t.columns]
-    batch = EvalBatch.from_chunk(chunk)
+    batch = EvalBatch.from_chunk(chunk, warn=_warn_of(session))
     new_vals = list(old_vals)
     for colname, expr_ast in assignments:
         cname = colname if isinstance(colname, str) else colname.name
@@ -504,7 +585,9 @@ def _apply_on_dup_update(session, t: TableInfo, old_vals: list, handle: int, can
             raise WriteError(f"Unknown column '{cname}'")
         e = builder.resolve(subst_values(expr_ast), BuildCtx(schema))
         out = eval_to_column(e, batch, np)
-        new_vals[c.offset] = to_physical(out.logical_value(0), c.ftype)
+        new_vals[c.offset] = to_physical(
+            out.logical_value(0), c.ftype, warn=_warn_of(session), strict=_strict(session), col=c.name
+        )
     if new_vals == old_vals:
         return 0
     new_handle = handle
@@ -566,10 +649,10 @@ def _rows_to_chunk(session, t: TableInfo, rows: list[list]) -> Chunk:
 def _where_mask(session, t: TableInfo, chunk: Chunk, where, db: str, alias: str) -> np.ndarray:
     if where is None:
         return np.ones(len(chunk), dtype=bool)
-    builder = Builder(session.catalog, db, subquery_runner=session._subquery_runner)
+    builder = Builder(session.catalog, db, subquery_runner=session._subquery_runner, warn=session.append_warning)
     schema = [OutCol(c.name, c.ftype, table=alias, slot=c.offset) for c in t.columns]
     cond = builder.resolve(where, BuildCtx(schema))
-    col = eval_to_column(cond, EvalBatch.from_chunk(chunk), np)
+    col = eval_to_column(cond, EvalBatch.from_chunk(chunk, warn=_warn_of(session)), np)
     return (col.data != 0) & col.validity
 
 
@@ -626,7 +709,7 @@ def execute_update(session, stmt: ast.Update) -> int:
     if stmt.order_by:
         from tidb_tpu.copr.host_engine import sort_perm
 
-        builder = Builder(session.catalog, db, subquery_runner=session._subquery_runner)
+        builder = Builder(session.catalog, db, subquery_runner=session._subquery_runner, warn=session.append_warning)
         schema = [OutCol(c.name, c.ftype, table=alias, slot=c.offset) for c in t.columns]
         by = [[builder.resolve(oi.expr, BuildCtx(schema)).to_pb(), oi.desc] for oi in stmt.order_by]
         sub = chunk.take(idxs)
@@ -638,9 +721,9 @@ def execute_update(session, stmt: ast.Update) -> int:
     )
 
     # evaluate assignment expressions over the full chunk (row values)
-    builder = Builder(session.catalog, db, subquery_runner=session._subquery_runner)
+    builder = Builder(session.catalog, db, subquery_runner=session._subquery_runner, warn=session.append_warning)
     schema = [OutCol(c.name, c.ftype, table=alias, slot=c.offset) for c in t.columns]
-    batch = EvalBatch.from_chunk(chunk)
+    batch = EvalBatch.from_chunk(chunk, warn=_warn_of(session))
     new_cols = {}
     for colname, expr_ast in stmt.assignments:
         c = t.column(colname.name)
@@ -657,7 +740,9 @@ def execute_update(session, stmt: ast.Update) -> int:
         new_vals = list(old_vals)
         for off, out in new_cols.items():
             lv = out.logical_value(int(i))
-            new_vals[off] = to_physical(lv, t.columns[off].ftype)
+            new_vals[off] = to_physical(
+                lv, t.columns[off].ftype, warn=_warn_of(session), strict=_strict(session), col=t.columns[off].name
+            )
         if new_vals == old_vals:
             continue
         handle = handles[i]
@@ -686,7 +771,7 @@ def execute_delete(session, stmt: ast.Delete) -> int:
     if stmt.order_by:
         from tidb_tpu.copr.host_engine import sort_perm
 
-        builder = Builder(session.catalog, db, subquery_runner=session._subquery_runner)
+        builder = Builder(session.catalog, db, subquery_runner=session._subquery_runner, warn=session.append_warning)
         schema = [OutCol(c.name, c.ftype, table=alias, slot=c.offset) for c in t.columns]
         by = [[builder.resolve(oi.expr, BuildCtx(schema)).to_pb(), oi.desc] for oi in stmt.order_by]
         sub = chunk.take(idxs)

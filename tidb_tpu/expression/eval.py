@@ -105,11 +105,31 @@ def _mul(xp, args, ctx):
     return da * db, and_valid(xp, va, vb)
 
 
+def _warn_div0(xp, ctx, nz, va, vb):
+    """MySQL 1365 per offending row (ref: stmtctx.AppendWarning via
+    builtin_arithmetic division). Host numpy eval only — a jitted trace
+    cannot count data-dependent events."""
+    import numpy as _np
+
+    warn = getattr(ctx, "warn", None)
+    if warn is None or xp is not _np:
+        return
+    bad = ~_np.asarray(nz)
+    for v in (va, vb):
+        if v is not None and v is not True:
+            bad = bad & _np.asarray(v)
+    # a scalar-constant zero denominator offends EVERY row of the batch
+    cnt = int(bad.sum()) if bad.ndim else (ctx.n if bool(bad) else 0)
+    for _ in range(cnt):
+        warn("Warning", 1365, "Division by 0")
+
+
 @register("div", infer_div)
 def _div(xp, args, ctx):
     (da, va), (db, vb) = args
     ta, tb = ctx.arg_types
     nz = db != 0
+    _warn_div0(xp, ctx, nz, va, vb)
     if ctx.ret_type.kind == TypeKind.DECIMAL:
         # decimal/decimal: result scale = sa+4; numerator rescaled so the int
         # division is exact to the target scale. Truncate toward zero, then
@@ -131,6 +151,7 @@ def _div(xp, args, ctx):
 def _intdiv(xp, args, ctx):
     da, va, db, vb = _coerce_pair(xp, ctx, 0, 1)
     nz = db != 0
+    _warn_div0(xp, ctx, nz, va, vb)
     den = xp.where(nz, db, 1)
     if ctx.arg_types[0].kind == TypeKind.FLOAT or ctx.arg_types[1].kind == TypeKind.FLOAT:
         q = (da / den).astype("int64") if hasattr(da / den, "astype") else int(da / den)
@@ -144,6 +165,7 @@ def _intdiv(xp, args, ctx):
 def _mod(xp, args, ctx):
     da, va, db, vb = _coerce_pair(xp, ctx, 0, 1)
     nz = db != 0
+    _warn_div0(xp, ctx, nz, va, vb)
     den = xp.where(nz, db, 1)
     r = xp.fmod(da, den)  # sign of dividend, MySQL semantics
     return r, and_valid(xp, va, vb, nz)

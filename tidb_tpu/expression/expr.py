@@ -200,29 +200,35 @@ def can_push_down(expr: Expression, engine: str) -> bool:
 @dataclass
 class EvalBatch:
     """Input columns for one operator: parallel (data, validity) pairs.
-    validity None = all valid. ``dicts[i]`` set for string columns."""
+    validity None = all valid. ``dicts[i]`` set for string columns.
+    ``warn(level, code, msg)``: per-statement warning sink (ref: stmtctx
+    AppendWarning, pkg/sessionctx/stmtctx/stmtctx.go:1025) — host-side eval
+    reports truncation/zero-division through it; device traces leave it
+    None (a jitted program cannot append per-row diagnostics)."""
 
     cols: list[tuple]
     dicts: list[Optional[Dictionary]]
     n: int
+    warn: Optional[object] = None
 
     @staticmethod
-    def from_chunk(chunk) -> "EvalBatch":
+    def from_chunk(chunk, warn=None) -> "EvalBatch":
         cols = [(c.data, c.validity) for c in chunk.columns]
         dicts = [c.dictionary for c in chunk.columns]
-        return EvalBatch(cols, dicts, len(chunk))
+        return EvalBatch(cols, dicts, len(chunk), warn)
 
 
 class _Ctx:
-    __slots__ = ("args", "arg_types", "arg_dicts", "ret_type", "ret_dict", "n")
+    __slots__ = ("args", "arg_types", "arg_dicts", "ret_type", "ret_dict", "n", "warn")
 
-    def __init__(self, args, arg_types, arg_dicts, ret_type, ret_dict, n):
+    def __init__(self, args, arg_types, arg_dicts, ret_type, ret_dict, n, warn=None):
         self.args = args
         self.arg_types = arg_types
         self.arg_dicts = arg_dicts
         self.ret_type = ret_type
         self.ret_dict = ret_dict
         self.n = n
+        self.warn = warn
 
 
 def _const_physical(c: Constant, xp):
@@ -260,7 +266,7 @@ def eval_expr(expr: Expression, batch: EvalBatch, xp=np):
             args.append((d, v))
             dicts.append(dic)
         ret_dict = Dictionary() if expr.ftype.kind == TypeKind.STRING else None
-        ctx = _Ctx(args, [a.ftype for a in expr.args], dicts, expr.ftype, ret_dict, batch.n)
+        ctx = _Ctx(args, [a.ftype for a in expr.args], dicts, expr.ftype, ret_dict, batch.n, batch.warn)
         d, v = spec.impl(xp, args, ctx)
         return d, v, ret_dict
     raise TypeError(f"cannot evaluate {expr!r}")

@@ -57,30 +57,25 @@ def _dense_b_total(doms) -> int:
 
 
 def _mxu_aggs_ok(aggs, arg_bounds=()) -> bool:
-    """The pallas grouped-sum kernel covers COUNT/SUM lanes whose values are
-    provably < 2^45 (exact byte-limb accumulation). Proof sources, in order:
-    the binder's exact corner-evaluated bounds (covers expression args like
-    price*(1-disc)), then the conservative ftype whitelist (bounded DECIMAL,
-    DATE days). Anything else takes the eqmask/sort path."""
-    from tidb_tpu.types import TypeKind
-
+    """The MXU grouped-sum paths cover COUNT/SUM lanes whose values are
+    provably < 2^45 (exact limb accumulation). The magnitude proof itself
+    lives in :func:`_pair_bound` — the SAME function the dot path uses to
+    plan its limbs, so the gate and the kernel can never disagree on which
+    lanes are bounded. Anything else takes the eqmask/sort path."""
     for i, a in enumerate(aggs):
-        for pk in a.partial_kinds:
+        kinds = a.partial_kinds
+        if all(pk == "count" for pk in kinds):
+            continue  # value lane unused (zeros)
+        for pk in kinds:
             if pk == "count":
                 continue
             if pk != "sum":
                 return False  # min/max/first_row: no matmul form
-            ft = a.arg.ftype if a.arg is not None else None
-            if ft is None:
+            if a.arg is None:
                 return False
-            b = arg_bounds[i] if i < len(arg_bounds) else None
-            if b is not None and max(abs(int(b[0])), abs(int(b[1]))) < (1 << 45):
-                continue
-            if ft.kind == TypeKind.DECIMAL and 0 < ft.length <= 13:
-                continue
-            if ft.kind == TypeKind.DATE:
-                continue
-            return False
+            b = _pair_bound(a, arg_bounds[i] if i < len(arg_bounds) else None)
+            if b is None or max(abs(int(b[0])), abs(int(b[1]))) >= (1 << 45):
+                return False
     return True
 
 
@@ -125,18 +120,23 @@ def _ensure_x64():
         _ensure_x64._cc_done = True
 
 
-def get_kernel(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1) -> CompiledKernel:
-    key = (dag.fingerprint(), n_pad, agg_cap, nb)
+def get_kernel(
+    dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_scan: bool = False
+) -> CompiledKernel:
+    """``full_scan``: the caller proved every entry row is inside the
+    requested ranges — the kernel skips the 8-range handle mask (8 emulated
+    int64 compares per row, pure overhead on the typical analytic scan)."""
+    key = (dag.fingerprint(), n_pad, agg_cap, nb, full_scan)
     with _CACHE_MU:
         k = _COMPILE_CACHE.get(key)
     if k is None:
-        k = _build(dag, n_pad, agg_cap, nb)
+        k = _build(dag, n_pad, agg_cap, nb, full_scan)
         with _CACHE_MU:
             _COMPILE_CACHE[key] = k
     return k
 
 
-def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1) -> CompiledKernel:
+def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_scan: bool = False) -> CompiledKernel:
     _ensure_x64()
     import jax
     import jax.numpy as jnp
@@ -236,10 +236,12 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1) -> Comp
                 (jnp.concatenate([b[0] for b in c]), jnp.concatenate([b[1] for b in c]))
                 for c in cols
             )
-            iota = jnp.arange(n)
-            live = (iota % n_pad) < nvalid[iota // n_pad]
+            # int32 iota: n is static and < 2^31, and the emulated-int64
+            # mod/div pair would cost real time at 20M+ rows
+            iota = jnp.arange(n, dtype=jnp.int32)
+            live = (iota % n_pad) < nvalid.astype(jnp.int32)[iota // n_pad]
         else:
-            live = jnp.arange(n) < nvalid
+            live = jnp.arange(n, dtype=jnp.int32) < nvalid.astype(jnp.int32)
         # HBM lanes may be narrowed (int32 dict codes / bounded values — see
         # tpu_engine._narrowed); compute stays int64, with the upcast fused
         # into each lane's first consumer
@@ -248,12 +250,15 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1) -> Comp
             (d.astype(jnp.int64) if jnp.issubdtype(d.dtype, jnp.integer) else d, v)
             for d, v in cols
         )
-        # range mask: padded (MAX_RANGES, 2); empty slots have lo >= hi
-        mask = jnp.zeros(n, dtype=bool)
-        for r in range(MAX_RANGES):
-            lo, hi = ranges[r, 0], ranges[r, 1]
-            mask = mask | ((handles >= lo) & (handles < hi))
-        mask = mask & live  # padding rows are never live
+        if full_scan:
+            mask = live  # the caller proved range coverage statically
+        else:
+            # range mask: padded (MAX_RANGES, 2); empty slots have lo >= hi
+            mask = jnp.zeros(n, dtype=bool)
+            for r in range(MAX_RANGES):
+                lo, hi = ranges[r, 0], ranges[r, 1]
+                mask = mask | ((handles >= lo) & (handles < hi))
+            mask = mask & live  # padding rows are never live
         batch = EvalBatch([(d, v) for d, v in cols], [None] * len(cols), n)
         kind = "rows"
         count = None
@@ -276,6 +281,7 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1) -> Comp
                 # key holds its NULLs.
                 dense_doms = None
                 mxu_doms = None
+                mxu_dot = False  # XLA int8 dot_general vs the pallas kernel
                 # bit aggregates reduce with non-additive ops: only the sort
                 # path's segmented associative scan handles them
                 has_bit = any(
@@ -299,17 +305,26 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1) -> Comp
                     # eqmask was the round-2 default; the lex-sort path
                     # covers everything else
                     if doms:
+                        from tidb_tpu.ops.mxu_groupby import MAX_B as _DOT_MAX_B
                         from tidb_tpu.ops.pallas_groupby import MAX_ROWS, _BLK
 
                         bt = _dense_b_total(doms)
+                        sums_ok = _mxu_aggs_ok(aggs, getattr(ex, "arg_bounds", ()))
+                        # int8 dot_general: XLA's native MXU mode — no row
+                        # cap (chunked int64 accumulation), no block-multiple
+                        # constraint, ~4x the pallas grid throughput at small
+                        # B; pallas keeps the 64 < B <= 512 middle band where
+                        # a materialized (B, n) one-hot would thrash HBM
+                        dot_fits = bt <= min(agg_cap, _DOT_MAX_B) and sums_ok
                         mxu_fits = (
                             bt <= min(agg_cap, _DENSE_MXU_MAX)
-                            and _mxu_aggs_ok(aggs, getattr(ex, "arg_bounds", ()))
+                            and sums_ok
                             and n <= MAX_ROWS
                             and n % _BLK == 0
                         )
-                        if mxu_fits and (bt > _DENSE_EQMASK_MAX or n >= (1 << 21)):
+                        if (dot_fits or mxu_fits) and (bt > _DENSE_EQMASK_MAX or n >= (1 << 21)):
                             mxu_doms = doms
+                            mxu_dot = dot_fits
                         elif bt <= min(agg_cap, _DENSE_EQMASK_MAX):
                             dense_doms = doms
 
@@ -434,23 +449,47 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1) -> Comp
                     strides = list(reversed(strides))  # align with gvals order
                     seg = jnp.where(mask, seg, B)  # dead rows match nothing
 
+                    arg_bounds = getattr(ex, "arg_bounds", ())
                     pairs = []
+                    pair_bounds = []
                     lane_of_agg = []
-                    for a in aggs:
+                    _zero64 = jnp.zeros(n, dtype=jnp.int64)
+                    _all_true = jnp.ones(n, dtype=bool)
+                    _arg_memo: dict = {}  # SUM(x) + AVG(x) share one lane set
+                    for ai, a in enumerate(aggs):
+                        count_only = all(pk == "count" for pk in a.partial_kinds)
                         if a.arg is not None:
-                            d, v, _ = eval_expr(a.arg, batch, jnp)
-                            d = _bcast(d, n).astype(jnp.int64)
-                            v = _vmask(v, n)
+                            memo_key = repr(a.arg.to_pb())
+                            got = _arg_memo.get(memo_key)
+                            if got is None:
+                                d0, v0, _ = eval_expr(a.arg, batch, jnp)
+                                got = (_bcast(d0, n).astype(jnp.int64), mask & _vmask(v0, n))
+                                _arg_memo[memo_key] = got
+                            d, w = got
+                            # COUNT(x) reads only the weight lane: zero the
+                            # value so an unbounded arg needs no limb proof
+                            if count_only:
+                                d = _zero64
                         else:
-                            d = jnp.zeros(n, dtype=jnp.int64)
-                            v = jnp.ones(n, dtype=bool)
+                            d, w = _zero64, mask & _all_true
                         lane_of_agg.append(len(pairs))
-                        pairs.append((d, mask & v))
+                        pairs.append((d, w))
+                        pair_bounds.append(
+                            (0, 0) if count_only else _pair_bound(a, arg_bounds[ai] if ai < len(arg_bounds) else None)
+                        )
                     occ_lane = len(pairs)
                     pairs.append((jnp.zeros(n, dtype=jnp.int64), mask))  # occupancy
+                    pair_bounds.append((0, 0))
 
-                    interpret = jax.default_backend() != "tpu"
-                    counts, sums = grouped_sums(seg.astype(jnp.int32), pairs, B, n, interpret)
+                    if mxu_dot:
+                        from tidb_tpu.ops.mxu_groupby import grouped_sums_dot
+
+                        counts, sums = grouped_sums_dot(
+                            seg.astype(jnp.int32), pairs, B, n, pair_bounds
+                        )
+                    else:
+                        interpret = jax.default_backend() != "tpu"
+                        counts, sums = grouped_sums(seg.astype(jnp.int32), pairs, B, n, interpret)
 
                     out_data, out_valid = [], []
                     for a, li in zip(aggs, lane_of_agg):
@@ -798,6 +837,23 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1) -> Comp
 
     jitted = jax.jit(kernel)
     return CompiledKernel(jitted, "agg" if agg_is_last else "rows", out_n, agg_cap, lanes_holder)
+
+
+def _pair_bound(a, b):
+    """(lo, hi) magnitude proof for one agg's value lane — the binder's
+    corner bounds when stamped, else the conservative ftype envelope the
+    MXU gate (_mxu_aggs_ok) admitted."""
+    if b is not None:
+        return (int(b[0]), int(b[1]))
+    ft = a.arg.ftype if a.arg is not None else None
+    if ft is None:
+        return (0, 0)  # count(*): zeros lane
+    if ft.kind == TypeKind.DECIMAL and 0 < ft.length <= 13:
+        m = 10 ** ft.length
+        return (-m, m)
+    if ft.kind == TypeKind.DATE:
+        return (0, 1 << 23)
+    return None  # int32 dtype envelope inside grouped_sums_dot
 
 
 def _finalize_device(jnp, aggs, state_data, state_valid):

@@ -134,7 +134,11 @@ class Builder:
         global_vars: Optional[dict] = None,
         memtable_provider: Optional[Callable] = None,
         scan_checker: Optional[Callable] = None,
+        dyn_sys_vars: Optional[dict] = None,
+        warn: Optional[Callable] = None,
     ):
+        self.dyn_sys_vars = dyn_sys_vars
+        self.warn = warn
         self.catalog = catalog
         self.db = current_db
         self.subquery_runner = subquery_runner
@@ -896,9 +900,16 @@ class Builder:
         raise PlanError(f"unsupported FROM clause {type(node).__name__}")
 
     # -- expression resolution ----------------------------------------------
+    def _fold_warn(self, level, code, msg):
+        # a fold-time warning is data-independent but STATEMENT-scoped: the
+        # plan must not be cached, or repeats would silently stop warning
+        self.uncacheable = True
+        if self.warn is not None:
+            self.warn(level, code, msg)
+
     def resolve(self, node: ast.Node, ctx: BuildCtx) -> Expression:
         e = self._resolve(node, ctx)
-        return _fold(e)
+        return _fold(e, self._fold_warn)
 
     def _resolve(self, node: ast.Node, ctx: BuildCtx) -> Expression:
         if isinstance(node, ast.Literal):
@@ -910,6 +921,10 @@ class Builder:
             # such plans must not be cached (ref: plan-cache skips them)
             self.uncacheable = True
             if node.sys:
+                if self.dyn_sys_vars is not None and node.name in self.dyn_sys_vars:
+                    # statement-scope dynamics (@@warning_count/@@error_count
+                    # — ref: session.go variable read hooks)
+                    return _literal(ast.Literal(self.dyn_sys_vars[node.name]))
                 src = self.sys_vars if node.scope != "global" else self.global_vars
                 if src is None or node.name not in src:
                     raise PlanError(f"unknown system variable '{node.name}'")
@@ -1746,12 +1761,14 @@ def _as_equi_pair(cond: Expression, nleft: int):
     return None
 
 
-def _fold(e: Expression) -> Expression:
-    """Constant folding: all-constant scalar funcs evaluate at build time."""
+def _fold(e: Expression, warn=None) -> Expression:
+    """Constant folding: all-constant scalar funcs evaluate at build time.
+    ``warn`` receives fold-time diagnostics (SELECT 1/0 → 1365) so constant
+    expressions warn like row expressions do."""
     if isinstance(e, ScalarFunc):
-        e = ScalarFunc(e.sig, [_fold(a) for a in e.args], e.ftype)
+        e = ScalarFunc(e.sig, [_fold(a, warn) for a in e.args], e.ftype)
         if e.sig != "like" and all(isinstance(a, Constant) for a in e.args):
-            batch = EvalBatch([], [], 1)
+            batch = EvalBatch([], [], 1, warn)
             try:
                 col = eval_to_column(e, batch, np)
             except Exception:

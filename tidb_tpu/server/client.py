@@ -110,6 +110,9 @@ class Client:
             raise self._err(pkt)
         if pkt[0] == 0x00:  # OK
             affected, off = p.read_lenc_int(pkt, 1)
+            _lii, off = p.read_lenc_int(pkt, off)
+            # status u16, warnings u16 (ref: OK_Packet warning count)
+            self.warning_count = struct.unpack_from("<H", pkt, off + 2)[0] if len(pkt) >= off + 4 else 0
             return affected
         ncols, _ = p.read_lenc_int(pkt, 0)
         cols = []
@@ -120,6 +123,7 @@ class Client:
         while True:
             pkt = self.io.read()
             if pkt[0] == 0xFE and len(pkt) < 9:
+                self.warning_count = struct.unpack_from("<H", pkt, 1)[0]
                 break
             if pkt[0] == 0xFF:
                 raise self._err(pkt)

@@ -105,16 +105,16 @@ class PacketIO:
         return buf
 
 
-def ok_packet(affected: int = 0, last_insert_id: int = 0, status: int = 2, info: bytes = b"") -> bytes:
-    return b"\x00" + lenc_int(affected) + lenc_int(last_insert_id) + struct.pack("<HH", status, 0) + info
+def ok_packet(affected: int = 0, last_insert_id: int = 0, status: int = 2, info: bytes = b"", warnings: int = 0) -> bytes:
+    return b"\x00" + lenc_int(affected) + lenc_int(last_insert_id) + struct.pack("<HH", status, warnings) + info
 
 
 def err_packet(code: int, msg: str, sqlstate: str = "HY000") -> bytes:
     return b"\xff" + struct.pack("<H", code) + b"#" + sqlstate.encode() + msg.encode("utf-8")
 
 
-def eof_packet(status: int = 2) -> bytes:
-    return b"\xfe" + struct.pack("<HH", 0, status)
+def eof_packet(status: int = 2, warnings: int = 0) -> bytes:
+    return b"\xfe" + struct.pack("<HH", warnings, status)
 
 
 def column_def(name: str, col_type: int, col_len: int = 255, decimals: int = 0, charset: int = 33) -> bytes:

@@ -227,8 +227,9 @@ class ClientConn:
             return
         finally:
             self.current_sql = None
+        wc = min(len(self.session.warnings), 0xFFFF)
         if not res.columns:
-            io.write(p.ok_packet(affected=res.affected, last_insert_id=res.last_insert_id))
+            io.write(p.ok_packet(affected=res.affected, last_insert_id=res.last_insert_id, warnings=wc))
             return
         ftypes = getattr(res, "ftypes", None)
         io.write(p.lenc_int(len(res.columns)))
@@ -241,7 +242,7 @@ class ClientConn:
         io.write(p.eof_packet())
         for row in res.rows:
             io.write(p.binary_row(row, ftypes))
-        io.write(p.eof_packet())
+        io.write(p.eof_packet(warnings=wc))
 
     def _run_sql(self, io: p.PacketIO, sql: str) -> None:
         self.current_sql = sql
@@ -252,8 +253,9 @@ class ClientConn:
             return
         finally:
             self.current_sql = None
+        wc = min(len(self.session.warnings), 0xFFFF)
         if not res.columns:
-            io.write(p.ok_packet(affected=res.affected, last_insert_id=res.last_insert_id))
+            io.write(p.ok_packet(affected=res.affected, last_insert_id=res.last_insert_id, warnings=wc))
             return
         out = [p.lenc_int(len(res.columns))]
         ftypes = getattr(res, "ftypes", None)
@@ -270,7 +272,7 @@ class ClientConn:
                 tv = p.text_value(v)
                 rb += b"\xfb" if tv is None else p.lenc_str(tv)
             out.append(bytes(rb))
-        out.append(p.eof_packet())
+        out.append(p.eof_packet(warnings=wc))
         for pkt in out:
             io.write(pkt)
 

@@ -32,6 +32,7 @@ DEFAULT_SYSVARS = {
     "autocommit": 1,
     "tidb_current_ts": 0,
     "sql_mode": "",
+    "max_error_count": 64,
     "max_execution_time": 0,
     # ref: vardef TiDBTxnMode (pessimistic is the reference default)
     "tidb_txn_mode": "pessimistic",
@@ -187,7 +188,22 @@ class Session:
         self._plan_cache: OrderedDict[tuple, Any] = OrderedDict()
         # SHOW WARNINGS buffer [(level, code, message)] + statement counter
         self.warnings: list[tuple] = []
+        # the buffer as of the LAST statement — @@warning_count reads this
+        # (the reading statement already cleared self.warnings)
+        self._prev_warnings: list[tuple] = []
         self._stmt_count = 0
+
+    def append_warning(self, level: str, code: int, msg: str) -> None:
+        """Statement-context warning accumulation (ref: stmtctx.go:1025
+        AppendWarning), capped at max_error_count like MySQL."""
+        cap = 64
+        try:
+            cap = int(self.vars.get("max_error_count", 64))
+        except (TypeError, ValueError):
+            pass
+        cap = min(cap, 65535)  # the wire count field is a u16 (MySQL clamps)
+        if len(self.warnings) < cap:
+            self.warnings.append((level, code, msg))
 
     # -- txn lifecycle (ref: LazyTxn) ---------------------------------------
     def txn(self) -> Txn:
@@ -319,6 +335,7 @@ class Session:
                 stmt = parse(sql)
         self._stmt_count += 1
         if not isinstance(stmt, ast.Show):  # SHOW WARNINGS must see them
+            self._prev_warnings = self.warnings
             self.warnings = []
         try:
             res = self._execute_stmt(stmt, sql_text=sql)
@@ -985,6 +1002,11 @@ class Session:
             global_vars=self._db.global_vars,
             memtable_provider=self._memtable_provider,
             scan_checker=lambda db, tbl: self.require_priv(db, tbl, "select"),
+            dyn_sys_vars={
+                "warning_count": len(self._prev_warnings),
+                "error_count": sum(1 for w in self._prev_warnings if w[0] == "Error"),
+            },
+            warn=self.append_warning,
         )
         logical = builder.build_query(stmt)
         engines = [e.strip() for e in str(self.vars["tidb_isolation_read_engines"]).split(",") if e.strip()]
