@@ -1,0 +1,100 @@
+"""Black-box boot of the server process (ref: cmd/tidb-server/main.go:262):
+``python -m tidb_tpu`` with flags + TOML, embedded and two-process
+(SQL layer over --store-server) topologies."""
+
+import os
+import signal
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from tidb_tpu.server.client import Client
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _boot(args, env_extra=None):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", **(env_extra or {})}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tidb_tpu", *args],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        cwd=REPO,
+        env=env,
+    )
+    line = proc.stdout.readline()
+    if not line.startswith("ready"):
+        proc.kill()
+        raise RuntimeError(f"server did not report ready: {line!r}")
+    parts = dict(kv.split("=") for kv in line.split()[1:])
+    return proc, {k: int(v) for k, v in parts.items()}
+
+
+def _stop(proc):
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_boot_embedded_and_query():
+    proc, ports = _boot(["--port", "0", "--status-port", "0"])
+    try:
+        c = Client("127.0.0.1", ports["port"])
+        c.query("CREATE TABLE bb (a BIGINT PRIMARY KEY, b VARCHAR(8))")
+        c.query("INSERT INTO bb VALUES (1, 'x'), (2, 'y')")
+        assert c.query("SELECT a, b FROM bb ORDER BY a") == [("1", "x"), ("2", "y")]
+        c.close()
+        # status server answers
+        with urllib.request.urlopen(f"http://127.0.0.1:{ports['status']}/status", timeout=5) as r:
+            assert b"tidb-tpu" in r.read()
+    finally:
+        _stop(proc)
+    assert proc.returncode == 0  # SIGTERM → clean shutdown
+
+
+def test_boot_toml_config(tmp_path):
+    cfg = tmp_path / "tidb.toml"
+    cfg.write_text(
+        """
+[server]
+port = 0
+
+[status]
+report-status = false
+
+[session.variables]
+tidb_allow_mpp = 0
+"""
+    )
+    proc, ports = _boot(["--config", str(cfg)])
+    try:
+        c = Client("127.0.0.1", ports["port"])
+        assert c.query("SELECT @@tidb_allow_mpp") == [("0",)]
+        assert "status" not in ports
+        c.close()
+    finally:
+        _stop(proc)
+
+
+def test_boot_two_process_topology():
+    store_proc, store_ports = _boot(["--store-server", "--port", "0"])
+    sql_proc = None
+    try:
+        sql_proc, sql_ports = _boot(
+            ["--store", "remote", "--path", f"127.0.0.1:{store_ports['port']}", "--port", "0", "--no-status"]
+        )
+        c = Client("127.0.0.1", sql_ports["port"])
+        c.query("CREATE TABLE tt (a BIGINT PRIMARY KEY, v BIGINT)")
+        c.query("INSERT INTO tt VALUES (1, 10), (2, 20)")
+        assert c.query("SELECT SUM(v) FROM tt") == [("30",)]
+        c.close()
+    finally:
+        if sql_proc is not None:
+            _stop(sql_proc)
+        _stop(store_proc)

@@ -12,7 +12,12 @@ from tidb_tpu.ops import window_kernel as wk
 
 @pytest.fixture()
 def db(monkeypatch):
-    monkeypatch.setattr(wk, "DEVICE_MIN_ROWS", 0)
+    # force the device path on tiny data: zero fixed costs so the measured
+    # cost model always picks the device
+    monkeypatch.setattr(wk, "DEV_FIXED_S", 0.0)
+    monkeypatch.setattr(wk, "H2D_NS_PER_BYTE", 0.0)
+    monkeypatch.setattr(wk, "DEV_ROW_NS_PER_FUNC", 0.0)
+    monkeypatch.setattr(wk, "COMPILE_GATE_ROWS", 0)
     d = tidb_tpu.open()
     d.execute("CREATE TABLE w (g VARCHAR(4), v BIGINT, x DOUBLE, dv DECIMAL(8,2))")
     rng = np.random.default_rng(13)

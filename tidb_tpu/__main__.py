@@ -1,0 +1,80 @@
+"""Bootable server process: ``python -m tidb_tpu [flags]``.
+
+Reference parity: `cmd/tidb-server/main.go:262` — config + flags, store
+registration, wire server, status server, clean signal shutdown. Two roles:
+
+- SQL server (default): MySQL wire protocol on ``--port``, HTTP status on
+  ``--status-port``; storage is an embedded store or a remote StoreServer
+  (``--store remote --path host:port`` — the TiDB-over-TiKV shape).
+- ``--store-server``: the storage process — serves KV verbs, coprocessor
+  DAGs, and MPP dispatch to SQL-layer processes, and owns the device.
+
+Both roles print ``ready port=N [status=M]`` once listening (port 0 binds an
+ephemeral port; orchestration reads the line).
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    from tidb_tpu.config import load
+
+    cfg, args = load(argv)
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:
+            pass  # non-main thread (embedded use)
+
+    if getattr(args, "store_server", False):
+        import tidb_tpu
+        from tidb_tpu.kv.remote import StoreServer
+
+        db = tidb_tpu.open(region_split_keys=cfg.region_split_keys)
+        srv = StoreServer(db.store, host=cfg.host, port=cfg.port)
+        port = srv.start()
+        print(f"ready port={port}", flush=True)
+        stop.wait()
+        srv.shutdown()
+        return 0
+
+    import tidb_tpu
+    from tidb_tpu.server import Server
+    from tidb_tpu.server.status import StatusServer
+
+    if cfg.store == "remote":
+        if not cfg.store_path:
+            print("--store remote requires --path host:port", file=sys.stderr)
+            return 2
+        db = tidb_tpu.open(remote=cfg.store_path)
+    else:
+        db = tidb_tpu.open(region_split_keys=cfg.region_split_keys)
+    for k, v in cfg.sysvars.items():
+        db.global_vars[k] = v
+
+    server = Server(db, host=cfg.host, port=cfg.port, tls=cfg.ssl_enabled)
+    port = server.start()
+    status_port = None
+    status = None
+    if cfg.status_enabled:
+        status = StatusServer(db, host=cfg.host, port=cfg.status_port)
+        status_port = status.start()
+    extra = f" status={status_port}" if status_port is not None else ""
+    print(f"ready port={port}{extra}", flush=True)
+    stop.wait()
+    server.close()
+    if status is not None:
+        try:
+            status.close()
+        except Exception:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

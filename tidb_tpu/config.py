@@ -1,0 +1,104 @@
+"""Static configuration tier: TOML file + command-line flags.
+
+Reference parity: `pkg/config/config.go:170` (the Config struct TOML-mapped)
++ `cmd/tidb-server/main.go:262` (flag overrides config file overrides
+defaults). The surface is intentionally the subset a bootable process needs:
+wire server, status server, store selection, TLS, and session defaults —
+everything dynamic lives in system variables like the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class Config:
+    # [server]
+    host: str = "127.0.0.1"
+    port: int = 4000
+    # [status]
+    status_port: int = 10080
+    status_enabled: bool = True
+    # [storage]  mode: "embedded" | "remote" (attach to a store server)
+    store: str = "embedded"
+    store_path: str = ""  # host:port of the remote StoreServer
+    region_split_keys: int = 500_000
+    # [security]
+    ssl_enabled: bool = False
+    ssl_cert: str = ""
+    ssl_key: str = ""
+    # [session] global system-variable defaults applied at boot
+    sysvars: dict = field(default_factory=dict)
+
+    @staticmethod
+    def from_toml(path: str) -> "Config":
+        import tomllib
+
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+        cfg = Config()
+        srv = raw.get("server", {})
+        cfg.host = srv.get("host", cfg.host)
+        cfg.port = int(srv.get("port", cfg.port))
+        st = raw.get("status", {})
+        cfg.status_port = int(st.get("status-port", st.get("port", cfg.status_port)))
+        cfg.status_enabled = bool(st.get("report-status", cfg.status_enabled))
+        sto = raw.get("storage", {})
+        cfg.store = sto.get("store", cfg.store)
+        cfg.store_path = sto.get("path", cfg.store_path)
+        cfg.region_split_keys = int(sto.get("region-split-keys", cfg.region_split_keys))
+        sec = raw.get("security", {})
+        cfg.ssl_cert = sec.get("ssl-cert", cfg.ssl_cert)
+        cfg.ssl_key = sec.get("ssl-key", cfg.ssl_key)
+        cfg.ssl_enabled = bool(sec.get("enable-ssl", bool(cfg.ssl_cert)))
+        cfg.sysvars = dict(raw.get("session", {}).get("variables", {}))
+        return cfg
+
+    def merged_flags(self, args) -> "Config":
+        """Flags override the file (ref: main.go overrideConfig)."""
+        out = dataclasses.replace(self)
+        for flag, attr in (
+            ("host", "host"),
+            ("port", "port"),
+            ("status_port", "status_port"),
+            ("store", "store"),
+            ("path", "store_path"),
+        ):
+            v = getattr(args, flag, None)
+            if v is not None:
+                setattr(out, attr, v)
+        if getattr(args, "no_status", False):
+            out.status_enabled = False
+        return out
+
+
+def parse_args(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="tidb_tpu",
+        description="tidb_tpu server (ref: cmd/tidb-server/main.go)",
+    )
+    p.add_argument("--config", help="TOML config file path")
+    p.add_argument("--host", help="wire-server bind host")
+    p.add_argument("-P", "--port", type=int, help="wire-server port (0 = ephemeral)")
+    p.add_argument("--status-port", dest="status_port", type=int, help="HTTP status port")
+    p.add_argument("--no-status", dest="no_status", action="store_true", help="disable the status server")
+    p.add_argument("--store", choices=["embedded", "remote"], help="storage backend")
+    p.add_argument("--path", help="host:port of the remote store server (store=remote)")
+    p.add_argument(
+        "--store-server",
+        dest="store_server",
+        action="store_true",
+        help="boot as a STORAGE server process (serves KV + coprocessor + MPP)",
+    )
+    return p.parse_args(argv)
+
+
+def load(argv=None) -> tuple[Config, object]:
+    args = parse_args(argv)
+    cfg = Config.from_toml(args.config) if args.config else Config()
+    return cfg.merged_flags(args), args

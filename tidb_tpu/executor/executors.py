@@ -888,7 +888,7 @@ class WindowExec(Executor):
         from tidb_tpu.ops import window_kernel as wk
 
         p = self.plan
-        if self.session is None or not (wk.DEVICE_MIN_ROWS <= n <= wk.DEVICE_MAX_ROWS):
+        if self.session is None or n > wk.DEVICE_MAX_ROWS:
             return None
         engines = str(self.session.vars.get("tidb_isolation_read_engines", "tpu,host"))
         if "tpu" not in engines:
@@ -909,6 +909,18 @@ class WindowExec(Executor):
         if spec_res is None:
             return None
         frame_tag, specs = spec_res
+
+        # measured-cost routing (not a hard row floor): device wins when its
+        # fixed dispatch + upload + per-row work undercut the host sweep —
+        # but a shape's FIRST compile only pays off on big batches
+        from tidb_tpu.utils.chunk import bucket_size as _bs
+
+        spec_key = (len(p.partition_by), tuple(d for _, d in p.order_by), frame_tag, tuple(specs))
+        n_lanes_up = len(p.partition_by) + len(p.order_by) + sum(1 for _n, ha, *_ in specs if ha)
+        if not wk.device_beats_host(
+            n, n_lanes_up, len(p.funcs), wk.is_compiled(spec_key, _bs(n))
+        ):
+            return None
 
         # phase 2: evaluate lanes (shape is supported from here on)
         batch = EvalBatch.from_chunk(chunk)
@@ -952,13 +964,21 @@ class WindowExec(Executor):
             return (pd, pv)
 
         spec = (len(part), tuple(d for _, d in p.order_by), frame_tag, tuple(specs))
-        fn = wk.get_window_fn(spec, n_pad, tuple(bounds) if bounds is not None else None)
+        bkey = tuple(bounds) if bounds is not None else None
+        if n < wk.COMPILE_GATE_ROWS and not wk.is_compiled(spec, n_pad, bkey):
+            # the compile key includes the widened bounds: a bounds variant
+            # of an otherwise-warm shape still costs a 30-120s compile,
+            # which a small batch must not buy
+            return None
+        fn = wk.get_window_fn(spec, n_pad, bkey)
         import jax
 
         flat = fn(
             tuple(pad(x) for x in part),
             tuple(pad(x) for x in order),
-            tuple(pad(x) if x is not None else (np.zeros(n_pad, np.int64), np.zeros(n_pad, bool)) for x in arg_lanes),
+            # only real arg lanes travel: zeros pairs for no-arg funcs would
+            # ride the variadic sort as dead payload operands
+            tuple(pad(x) for x in arg_lanes if x is not None),
             np.int64(n),
         )
         got = jax.device_get(flat)  # one batched transfer

@@ -742,11 +742,29 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                 order_lanes = [lane(e) for e, _ in order_pairs]
                 arg_lanes = []
                 for f, sp in zip(funcs_ir, specs):
-                    if sp[1]:  # has_arg
-                        arg_lanes.append(lane(f.args[0]))
-                    else:
-                        arg_lanes.append((jnp.zeros(n, jnp.int64), jnp.ones(n, bool)))
-                outs, perm, sm = window_program(
+                    # None (not a zeros pair) for no-arg funcs: arg lanes ride
+                    # the window sort as payloads, and dead payloads would
+                    # inflate the variadic sort for nothing
+                    arg_lanes.append(lane(f.args[0]) if sp[1] else None)
+                base_cols = [(_bcast(d, n), _vmask(v, n)) for d, v in batch.cols]
+                nxt = executors[2 + exi].tp if 2 + exi < len(executors) else None
+                agg_next = nxt in (dagpb.AGGREGATION, dagpb.STREAM_AGG)
+                # only base columns the rest of the DAG actually reads ride
+                # the sort — every extra payload operand inflates the
+                # variadic sort's compile time (minutes at 20M rows)
+                used: set[int] = set()
+                if agg_next:
+                    from tidb_tpu.planner.optimizer import _expr_cols as _cols_of
+
+                    g_exprs, a_descs, _mode = parsed[exi + 1]
+                    for e in g_exprs:
+                        _cols_of(e, used)
+                    for a in a_descs:
+                        if a.arg is not None:
+                            _cols_of(a.arg, used)
+                    used = {i for i in used if i < len(base_cols)}
+                ship = sorted(used)
+                outs, perm, sm, base_sorted = window_program(
                     jax,
                     jnp,
                     mask=mask,
@@ -758,13 +776,19 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                     arg_lanes=arg_lanes,
                     n=n,
                     bounds=bounds,
+                    # base columns ride the sort when the consumer keeps
+                    # sorted order — d[perm] gathers cost ~0.5s each at 21M
+                    extra_lanes=[base_cols[i] for i in ship] if agg_next else [],
                 )
-                base_cols = [(_bcast(d, n), _vmask(v, n)) for d, v in batch.cols]
-                nxt = executors[2 + exi].tp if 2 + exi < len(executors) else None
-                if nxt in (dagpb.AGGREGATION, dagpb.STREAM_AGG):
+                if agg_next:
                     # an aggregation consumes rows order-free: keep everything
-                    # in sorted order and skip the inverse-permutation sort
-                    new_cols = [(d[perm], v[perm]) for d, v in base_cols] + list(outs)
+                    # in sorted order and skip the inverse-permutation sort;
+                    # unread positions keep their (unsorted) lanes — the agg
+                    # never evaluates them
+                    new_cols = list(base_cols)
+                    for pos, col_pair in zip(ship, base_sorted):
+                        new_cols[pos] = col_pair
+                    new_cols = new_cols + list(outs)
                     mask = sm
                 else:
                     inv = jnp.argsort(perm)

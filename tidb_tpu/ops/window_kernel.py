@@ -28,14 +28,50 @@ import threading
 
 from tidb_tpu.ops.window_core import SUPPORTED, window_program  # noqa: F401 (re-export)
 
-# below this row count a host sweep beats the device round trip — the lane
-# upload + result download amortize only once the host's O(n log n) sort
-# dominates (tests shrink it to force the device path on tiny data)
-DEVICE_MIN_ROWS = 2_000_000
+# measured-cost device-vs-host choice (replaces the old hard 2M-row floor).
+# Constants measured on v5e through the remote device link (July 2026):
+# dispatch+sync ≈ 8ms; H2D ≈ 20ns/byte; device sort+scan ≈ 15ns/row/func;
+# host sweep ≈ 500ns/row/func + 150ns/row sort. A shape's FIRST compile
+# costs 30-120s, so uncompiled shapes only go to the device when the batch
+# is big enough that the compile amortizes across a session's reuse.
+DEV_FIXED_S = 8e-3
+H2D_NS_PER_BYTE = 20.0
+D2H_NS_PER_BYTE = 43.0  # the slower direction on the remote link (~23MB/s)
+DEV_ROW_NS_PER_FUNC = 15.0
+HOST_ROW_NS_PER_FUNC = 500.0
+HOST_SORT_ROW_NS = 150.0
+COMPILE_GATE_ROWS = 2_000_000
 # packed single-key sorts scale to one full device batch; without bounds the
 # multi-lane sort's compile cost explodes under x64 emulation past one block
 DEVICE_MAX_ROWS = 1 << 25
 MULTILANE_MAX_ROWS = 1 << 22
+
+
+def device_beats_host(n: int, n_lanes_up: int, n_funcs: int, compiled: bool) -> bool:
+    """Calibrated cost comparison (ref: the reference's row-count-driven
+    Shuffle concurrency choice, shuffle.go:86 — redesigned as a measured
+    device/host cost model)."""
+    if not compiled and n < COMPILE_GATE_ROWS:
+        return False  # never buy a 30-120s compile for a small batch
+    nf = max(n_funcs, 1)
+    dev = DEV_FIXED_S + n * (
+        H2D_NS_PER_BYTE * 9 * n_lanes_up  # upload: (data+valid) per lane
+        + D2H_NS_PER_BYTE * 9 * nf  # download: (data, valid) per function
+        + DEV_ROW_NS_PER_FUNC * nf
+    ) * 1e-9
+    host = n * (HOST_ROW_NS_PER_FUNC * nf + HOST_SORT_ROW_NS) * 1e-9
+    return dev < host
+
+
+def is_compiled(spec: tuple, n_pad: int, bounds: "tuple | None" = ...) -> bool:
+    """Is this window shape compiled at this batch size? With ``bounds``
+    given, an EXACT cache-key check (the compile key includes the widened
+    sort bounds — a near-miss variant still costs a full compile); without,
+    an any-variant pre-check used before bounds are known."""
+    with _MU:
+        if bounds is not ...:
+            return (spec, n_pad, bounds) in _CACHE
+        return any(k[0] == spec and k[1] == n_pad for k in _CACHE)
 
 _CACHE: dict = {}
 _MU = threading.Lock()
@@ -64,6 +100,10 @@ def _build(spec: tuple, n_pad: int, bounds):
 
     def fn(part_lanes, order_lanes, arg_lanes, nvalid):
         mask = jnp.arange(n) < nvalid
+        # re-expand the compacted arg tuple (has-arg lanes only) to the
+        # per-func layout window_program expects (None = no argument)
+        it = iter(arg_lanes)
+        full_args = [next(it) if f[1] else None for f in funcs]
         outs, perm, _sm = window_program(
             jax,
             jnp,
@@ -73,7 +113,7 @@ def _build(spec: tuple, n_pad: int, bounds):
             order_descs=order_descs,
             frame_tag=frame_tag,
             specs=funcs,
-            arg_lanes=list(arg_lanes),
+            arg_lanes=full_args,
             n=n,
             bounds=list(bounds) if bounds is not None else None,
         )
