@@ -1,0 +1,60 @@
+"""general_ci weight framework (utils/collate) + MySQL regexp dialect
+translation (utils/mysql_regex) — unit level; SQL-level behavior is frozen
+in tests/integrationtest/t/collation_ci.test."""
+
+import pytest
+
+from tidb_tpu.utils.collate import weight_bytes, weight_str
+from tidb_tpu.utils.mysql_regex import translate
+
+
+def test_weight_classes():
+    assert weight_str("a") == weight_str("A") == weight_str("á") == "A"
+    assert weight_str("ß") == weight_str("s") == "S"
+    assert weight_str("Straße") == weight_str("STRASE")  # per-char: ß → S
+    assert weight_bytes("Ünïcodé".encode()) == b"UNICODE"
+    assert weight_str("a", collation="bin") == "a"
+
+
+def test_weight_ordering():
+    # weight order: a-class < b-class < s-class regardless of case/accents
+    vals = ["b", "á", "S", "A", "ß"]
+    assert sorted(vals, key=weight_str) == ["á", "A", "b", "S", "ß"] or sorted(
+        map(weight_str, vals)
+    ) == ["A", "A", "B", "S", "S"]
+
+
+def test_posix_classes():
+    import re
+
+    assert re.search(translate("[[:digit:]]+"), "abc123")
+    assert not re.search(translate("[[:digit:]]+"), "abc")
+    assert re.search(translate("[[:alpha:][:digit:]]"), "a")
+    assert re.search(translate("[^[:digit:]]"), "a")
+    assert not re.search(translate("[^[:digit:]]"), "123")
+    assert re.search(translate("[[:space:]]"), "a b")
+    assert re.search(translate("[[:xdigit:]]+$"), "DEADbeef")
+
+
+def test_word_boundaries():
+    import re
+
+    rx = re.compile(translate("[[:<:]]cat[[:>:]]"))
+    assert rx.search("the cat sat")
+    assert not rx.search("concat")
+    assert not rx.search("cats")
+
+
+def test_literal_bracket_and_escapes():
+    import re
+
+    assert re.search(translate("[]]"), "a]b")
+    assert re.search(translate(r"a\.b"), "a.b")
+    assert not re.search(translate(r"a\.b"), "axb")
+
+
+def test_bad_patterns_raise():
+    with pytest.raises(ValueError, match="unknown class"):
+        translate("[[:bogus:]]")
+    with pytest.raises(ValueError, match="unterminated"):
+        translate("[abc")

@@ -21,7 +21,7 @@ from tidb_tpu.disttask import (
 class SumExt(SchedulerExt):
     steps = [1, 2]
 
-    def plan_subtasks(self, task, step):
+    def plan_subtasks(self, task, step, manager):
         if step == 1:
             n = task.meta["n"]
             return [{"lo": i * 10, "hi": (i + 1) * 10} for i in range(n)]

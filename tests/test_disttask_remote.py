@@ -1,0 +1,182 @@
+"""Disttask subtasks across the process boundary (ref: taskexecutor.Manager
+nodes claiming subtasks from shared storage, taskexecutor/manager.go +
+scheduler balanceSubtasks re-queueing dead nodes' subtasks): a two-process
+IMPORT INTO where the storage process executes the subtasks, and a
+killed-worker run where expired claim leases re-queue to survivors."""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import tidb_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_STORE_NODE = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import tidb_tpu
+from tidb_tpu.kv.remote import StoreServer
+from tidb_tpu.disttask import DistTaskManager
+from tidb_tpu.tools.importer import register_import_task_type
+
+db = tidb_tpu.open()
+db.execute("CREATE TABLE imp (a BIGINT, b VARCHAR(16))")
+srv = StoreServer(db.store)
+port = srv.start()
+if {with_node!r} == "yes":
+    register_import_task_type()
+    mgr = DistTaskManager(db, node_prefix="store")
+    mgr.start_executor_node("store-node")
+print(f"PORT {{port}}", flush=True)
+while True:
+    time.sleep(1)
+"""
+
+_SLEEPY_WORKER = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import tidb_tpu
+from tidb_tpu.disttask import DistTaskManager
+from tidb_tpu.tools.importer import register_import_task_type
+from tidb_tpu.utils import failpoint
+
+db = tidb_tpu.open(remote={addr!r})
+register_import_task_type()
+# claim a subtask, then hang forever mid-run — the test SIGKILLs this
+# process and the owner's lease sweep must re-queue the claim
+failpoint.enable("import_subtask_before_ingest", lambda st: (print(f"CLAIMED {{st.id}}", flush=True), time.sleep(3600)))
+mgr = DistTaskManager(db, node_prefix="sleepy")
+mgr.start_executor_node("sleepy-node", poll_s=0.05)
+print("WORKER READY", flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+def _spawn(script, **fmt):
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script.format(repo=REPO, **fmt)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    return proc
+
+
+def _read_until(proc, prefix, timeout=120):
+    got = []
+
+    def reader():
+        for line in proc.stdout:
+            if line.startswith(prefix):
+                got.append(line.strip())
+                return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    t.join(timeout)
+    if not got:
+        proc.kill()
+        raise RuntimeError(f"subprocess never printed {prefix!r}")
+    return got[0]
+
+
+@pytest.fixture()
+def csv_path(tmp_path):
+    p = tmp_path / "imp.csv"
+    with open(p, "w") as f:
+        for i in range(1000):
+            f.write(f"{i},row{i}\n")
+    return str(p)
+
+
+def test_import_subtasks_run_in_storage_process(csv_path):
+    """The SQL layer plans and owns the task; the STORAGE process executes
+    every subtask (owner runs zero local workers)."""
+    from tidb_tpu.disttask import DistTaskManager
+    from tidb_tpu.tools import importer
+
+    proc = _spawn(_STORE_NODE, with_node="yes")
+    try:
+        port = int(_read_until(proc, "PORT ").split()[1])
+        db = tidb_tpu.open(remote=f"127.0.0.1:{port}")
+        importer._SUBTASK_ROWS, saved = 300, importer._SUBTASK_ROWS
+        try:
+            db._disttask_mgr = DistTaskManager(db, n_workers=0)  # owner only
+            n = importer.import_into_disttask(db, "test", "imp", csv_path)
+        finally:
+            importer._SUBTASK_ROWS = saved
+        assert n == 1000
+        s = db.session()
+        assert s.query("SELECT COUNT(*) FROM imp") == [(1000,)]
+        execs = s.query("SELECT DISTINCT exec_id FROM mysql.tidb_background_subtask WHERE state = 'succeed'")
+        assert execs == [("store-node",)], execs
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_killed_worker_lease_requeues(csv_path):
+    """A worker process SIGKILLed mid-subtask leaves an expired lease; the
+    owner re-queues the claim and local workers finish the import."""
+    from tidb_tpu.disttask import DistTaskManager
+    from tidb_tpu.tools import importer
+
+    store = _spawn(_STORE_NODE, with_node="no")
+    worker = None
+    try:
+        port = int(_read_until(store, "PORT ").split()[1])
+        addr = f"127.0.0.1:{port}"
+        db = tidb_tpu.open(remote=addr)
+        db.session().execute("CREATE TABLE imp2 (a BIGINT, b VARCHAR(16))")
+        worker = _spawn(_SLEEPY_WORKER, addr=addr)
+        _read_until(worker, "WORKER READY")
+        importer._SUBTASK_ROWS, saved = 300, importer._SUBTASK_ROWS
+        from tidb_tpu.utils import failpoint
+
+        # local workers hold back so the sleepy node deterministically
+        # claims first (then gets SIGKILLed holding the lease)
+        failpoint.enable("disttask_local_worker_start", lambda _eid: time.sleep(2.0))
+        result: dict = {}
+
+        def run_import():
+            try:
+                # short lease so the dead worker's claim expires quickly;
+                # delay the local workers so the sleepy node claims first
+                mgr = DistTaskManager(db, n_workers=2, lease_ms=1500)
+                db._disttask_mgr = mgr
+                result["rows"] = importer.import_into_disttask(db, "test", "imp2", csv_path)
+            except Exception as e:  # pragma: no cover
+                result["error"] = e
+
+        try:
+            t = threading.Thread(target=run_import)
+            t.start()
+            # wait until the sleepy worker has claimed a subtask, then KILL it
+            _read_until(worker, "CLAIMED", timeout=60)
+            worker.send_signal(signal.SIGKILL)
+            worker.wait()
+            t.join(timeout=120)
+        finally:
+            importer._SUBTASK_ROWS = saved
+            failpoint.disable("disttask_local_worker_start")
+        assert not t.is_alive(), "import hung after worker death"
+        assert "error" not in result, result.get("error")
+        assert result["rows"] == 1000
+        assert db.session().query("SELECT COUNT(*) FROM imp2") == [(1000,)]
+    finally:
+        if worker is not None and worker.poll() is None:
+            worker.kill()
+        store.kill()
+        store.wait()

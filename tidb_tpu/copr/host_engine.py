@@ -146,7 +146,17 @@ def _group_sort(chunk: Chunk, key_cols: list[Column]) -> tuple[np.ndarray, np.nd
     if not key_cols:
         return np.arange(n), np.zeros(n, dtype=np.int64), 1
     lanes = []
-    masked = [np.where(c.validity, c.data, 0) for c in key_cols]  # NULL lanes
+    from tidb_tpu.utils.collate import canon_codes, is_ci_string
+
+    # ci collation: group keys compare by general_ci WEIGHT — map every
+    # code to its weight-class representative so 'a'/'A'/'á' collapse
+    # into one group (ref: collate-aware group keys)
+    masked = [
+        canon_codes(c.data, c.validity, c.dictionary)
+        if is_ci_string(c)
+        else np.where(c.validity, c.data, 0)
+        for c in key_cols
+    ]  # NULL lanes
     for c, md in zip(key_cols, masked):  # may hold garbage from computed exprs
         lanes.append(md)
         lanes.append(~c.validity)  # NULLs form their own (single) group
@@ -238,12 +248,18 @@ def _aggregate(chunk: Chunk, ex: dagpb.ExecutorPB) -> Chunk:
             valid = np.ones(len(data), dtype=bool)
             adic, aft = None, bigint_type(nullable=False)
         if a.distinct:
-            # dedupe (group, value) pairs before reducing
-            order = np.lexsort((data, ~valid, seg))
-            d2, v2, s2 = data[order], valid[order], seg[order]
-            keep = np.ones(len(d2), dtype=bool)
-            keep[1:] = (s2[1:] != s2[:-1]) | (d2[1:] != d2[:-1]) | (v2[1:] != v2[:-1])
-            data, valid, seg_a = d2[keep], v2[keep], s2[keep]
+            # dedupe (group, value) pairs before reducing; ci string values
+            # dedupe by general_ci weight class, like GROUP BY/DISTINCT
+            from tidb_tpu.utils.collate import canon_codes
+
+            key = data
+            if aft.kind == TypeKind.STRING and aft.collation == "ci" and adic is not None:
+                key = canon_codes(data, valid, adic)
+            order = np.lexsort((key, ~valid, seg))
+            k2, v2, s2 = key[order], valid[order], seg[order]
+            keep = np.ones(len(k2), dtype=bool)
+            keep[1:] = (s2[1:] != s2[:-1]) | (k2[1:] != k2[:-1]) | (v2[1:] != v2[:-1])
+            data, valid, seg_a = data[order][keep], v2[keep], s2[keep]
             sel = order[keep]  # row selection, for per-agg side columns
         else:
             seg_a = seg
@@ -384,10 +400,20 @@ def sort_perm(chunk: Chunk, order_by: list) -> np.ndarray:
     for pb, desc in order_by:
         c = eval_to_column(expr_from_pb(pb), batch, np)
         data = c.data
-        if c.ftype.kind == TypeKind.STRING and c.dictionary is not None and not c.dictionary.sorted:
-            # unsorted dictionary: rank codes host-side
+        ci = c.ftype.kind == TypeKind.STRING and c.ftype.collation == "ci"
+        if c.ftype.kind == TypeKind.STRING and c.dictionary is not None and (ci or not c.dictionary.sorted):
+            # unsorted dictionary (or ci collation, whose order is weight
+            # order, not byte order): rank codes host-side
             vals = c.dictionary.decode_many(data)
-            rank = {v: i for i, v in enumerate(sorted(set(vals)))}
+            if ci:
+                from tidb_tpu.utils.collate import weight_bytes
+
+                # equal-weight values share a rank → stable tie order
+                uniq_w = sorted({weight_bytes(v) for v in set(vals)})
+                wrank = {w: i for i, w in enumerate(uniq_w)}
+                rank = {v: wrank[weight_bytes(v)] for v in set(vals)}
+            else:
+                rank = {v: i for i, v in enumerate(sorted(set(vals)))}
             data = np.array([rank[v] for v in vals], dtype=np.int64)
         if desc:
             priority.append((~c.validity).astype(np.int8))  # NULLs last

@@ -48,6 +48,7 @@ class Subtask:
     exec_id: str
     meta: dict
     summary: dict
+    lease: int = 0  # epoch ms the claim expires; 0 = unclaimed
 
 
 @dataclass
@@ -67,8 +68,10 @@ class SchedulerExt:
     #: step numbers, in order; the task succeeds after the last one
     steps: list[int] = [1]
 
-    def plan_subtasks(self, task: Task, step: int) -> list[dict]:
-        """→ subtask metas for this step."""
+    def plan_subtasks(self, task: Task, step: int, manager: "DistTaskManager") -> list[dict]:
+        """→ subtask metas for this step. Metas must be self-contained JSON:
+        they travel through the shared system tables to OTHER processes'
+        executor nodes (ref: subtask meta bytes crossing nodes)."""
         raise NotImplementedError
 
     def on_done(self, task: Task, manager: "DistTaskManager") -> None:
@@ -94,10 +97,11 @@ class DistTaskManager:
     """Owner-side scheduler + executor pool in one process (the reference
     splits these across nodes; the contracts are the same)."""
 
-    def __init__(self, db, n_workers: int = 4, node_prefix: str = "exec"):
+    def __init__(self, db, n_workers: int = 4, node_prefix: str = "exec", lease_ms: int = 10_000):
         self.db = db
         self.n_workers = n_workers
         self.node_prefix = node_prefix
+        self.lease_ms = lease_ms
         self._mu = threading.Lock()
         self._cancel_flags: dict[int, threading.Event] = {}
         self._ensure_tables()
@@ -105,17 +109,25 @@ class DistTaskManager:
     # -- storage (system tables; ref: framework/storage) --------------------
     def _ensure_tables(self) -> None:
         s = self._session()
-        s.execute("CREATE DATABASE IF NOT EXISTS mysql")
-        s.execute(
+        for ddl in (
+            "CREATE DATABASE IF NOT EXISTS mysql",
             "CREATE TABLE IF NOT EXISTS mysql.tidb_global_task (id BIGINT PRIMARY KEY, "
             "task_type VARCHAR(64), state VARCHAR(32), step BIGINT, concurrency BIGINT, "
-            "meta TEXT, error TEXT)"
-        )
-        s.execute(
+            "meta TEXT, error TEXT)",
             "CREATE TABLE IF NOT EXISTS mysql.tidb_background_subtask (id BIGINT PRIMARY KEY, "
             "task_id BIGINT, step BIGINT, state VARCHAR(32), exec_id VARCHAR(64), "
-            "meta TEXT, summary TEXT)"
-        )
+            "meta TEXT, summary TEXT, lease BIGINT)",
+        ):
+            # managers in several processes bootstrap concurrently; the
+            # catalog's optimistic versioning reloads and asks for a retry
+            for attempt in range(5):
+                try:
+                    s.execute(ddl)
+                    break
+                except Exception as e:
+                    if "retry the statement" not in str(e) or attempt == 4:
+                        raise
+                    time.sleep(0.05 * (attempt + 1))
 
     def _session(self):
         s = self.db.session()
@@ -159,10 +171,12 @@ class DistTaskManager:
     def subtasks(self, task_id: int, step: Optional[int] = None) -> list[Subtask]:
         cond = f"task_id = {task_id}" + (f" AND step = {step}" if step is not None else "")
         out = []
-        for sid, tid, st, state, ex, meta, summary in self._q(
+        for sid, tid, st, state, ex, meta, summary, lease in self._q(
             f"SELECT * FROM mysql.tidb_background_subtask WHERE {cond} ORDER BY id"
         ):
-            out.append(Subtask(sid, tid, st, state, ex, json.loads(meta), json.loads(summary or "{}")))
+            out.append(
+                Subtask(sid, tid, st, state, ex, json.loads(meta), json.loads(summary or "{}"), lease or 0)
+            )
         return out
 
     def cancel_task(self, task_id: int) -> None:
@@ -206,14 +220,14 @@ class DistTaskManager:
                 task = self.get_task(task_id)
                 existing = self.subtasks(task_id, step)
                 if not existing:
-                    metas = ext.plan_subtasks(task, step)
+                    metas = ext.plan_subtasks(task, step, self)
                     with self._mu:
                         base = self._next_id("tidb_background_subtask")
                         for i, m in enumerate(metas):
                             self._x(
                                 "INSERT INTO mysql.tidb_background_subtask VALUES "
                                 f"({base + i}, {task_id}, {step}, '{SubtaskState.PENDING}', '', "
-                                f"'{self._esc(json.dumps(m))}', '{{}}')"
+                                f"'{self._esc(json.dumps(m))}', '{{}}', 0)"
                             )
                 self._x(
                     f"UPDATE mysql.tidb_global_task SET step = {step} WHERE id = {task_id}"
@@ -233,50 +247,175 @@ class DistTaskManager:
             with self._mu:
                 self._cancel_flags.pop(task_id, None)
 
+    # -- cross-process subtask claiming (ref: taskexecutor manager claiming
+    # subtasks from shared storage; scheduler balanceSubtasks re-queueing
+    # subtasks whose node died) ---------------------------------------------
+    def claim_subtask(self, exec_id: str, lease_ms: int = 10_000, task_id: Optional[int] = None):
+        """Atomically claim one pending subtask of a running task. The claim
+        is an optimistic conditional UPDATE — two nodes racing the same row
+        hit a write conflict and one loses cleanly. Returns (Task, Subtask)
+        or None."""
+        cond = f"AND t.id = {task_id}" if task_id is not None else ""
+        # only claim task types REGISTERED in this process — a node must not
+        # take work it cannot execute (ref: executors advertising task types)
+        known = ", ".join(f"'{self._esc(k)}'" for k in _REGISTRY) or "''"
+        rows = self._q(
+            "SELECT s.id, s.task_id FROM mysql.tidb_background_subtask s, "
+            "mysql.tidb_global_task t WHERE s.task_id = t.id AND "
+            f"t.state = '{TaskState.RUNNING}' AND s.state = '{SubtaskState.PENDING}' "
+            f"AND t.task_type IN ({known}) {cond} "
+            "ORDER BY s.id LIMIT 4"
+        )
+        now_ms = int(time.time() * 1000)
+        for sid, tid in rows:
+            try:
+                res = self._x(
+                    f"UPDATE mysql.tidb_background_subtask SET state = '{SubtaskState.RUNNING}', "
+                    f"exec_id = '{self._esc(exec_id)}', lease = {now_ms + lease_ms} "
+                    f"WHERE id = {sid} AND state = '{SubtaskState.PENDING}'"
+                )
+            except Exception:
+                continue  # write conflict: another node won the claim
+            if getattr(res, "affected", 0) != 1:
+                continue
+            task = self.get_task(tid)
+            st = next(s for s in self.subtasks(tid) if s.id == sid)
+            return task, st
+        return None
+
+    def run_claimed(self, task: Task, st: Subtask) -> None:
+        """Execute a claimed subtask and persist its terminal state.
+
+        While the subtask runs, a heartbeat thread RENEWS the claim lease —
+        a slow-but-alive node must not lose its claim to the scheduler's
+        expiry sweep (ref: subtask heartbeat/balance). The terminal write is
+        FENCED on still owning the claim: if the lease was lost anyway and
+        the subtask re-queued, the stale worker's result is discarded."""
+        reg = _REGISTRY.get(task.type)
+        if reg is None:  # claim filter should prevent this; never kill the node loop
+            self._fenced_set(st, SubtaskState.FAILED, {"error": f"task type {task.type!r} not registered"})
+            return
+        _, executor = reg
+        hb_stop = threading.Event()
+
+        def heartbeat():
+            while not hb_stop.wait(self.lease_ms / 3000.0):
+                try:
+                    self._x(
+                        f"UPDATE mysql.tidb_background_subtask SET lease = "
+                        f"{int(time.time() * 1000) + self.lease_ms} WHERE id = {st.id} "
+                        f"AND state = '{SubtaskState.RUNNING}' AND exec_id = '{self._esc(st.exec_id)}'"
+                    )
+                except Exception:
+                    pass  # store briefly unreachable; the next beat retries
+
+        hb = threading.Thread(target=heartbeat, daemon=True)
+        hb.start()
+        try:
+            summary = executor.run_subtask(task, st, self)
+            self._fenced_set(st, SubtaskState.SUCCEED, summary or {})
+        except Exception as e:
+            self._fenced_set(st, SubtaskState.FAILED, {"error": str(e)})
+        finally:
+            hb_stop.set()
+            hb.join()
+
+    def _fenced_set(self, st: Subtask, state: str, summary: dict) -> bool:
+        """Terminal subtask write, conditional on the claim still being
+        ours — a re-queued claim makes the stale execution a no-op."""
+        try:
+            res = self._x(
+                f"UPDATE mysql.tidb_background_subtask SET state = '{state}', "
+                f"summary = '{self._esc(json.dumps(summary))}' WHERE id = {st.id} "
+                f"AND state = '{SubtaskState.RUNNING}' AND exec_id = '{self._esc(st.exec_id)}'"
+            )
+            return getattr(res, "affected", 0) == 1
+        except Exception:
+            return False
+
+    def _requeue_expired(self, task_id: int, step: int) -> int:
+        """Running subtasks whose claim lease expired (node died mid-run)
+        go back to pending for another node to pick up."""
+        now_ms = int(time.time() * 1000)
+        n = 0
+        for st in self.subtasks(task_id, step):
+            if st.state == SubtaskState.RUNNING and 0 < st.lease < now_ms:
+                try:
+                    res = self._x(
+                        f"UPDATE mysql.tidb_background_subtask SET state = '{SubtaskState.PENDING}', "
+                        f"exec_id = '', lease = 0 WHERE id = {st.id} AND state = '{SubtaskState.RUNNING}' "
+                        f"AND lease = {st.lease}"
+                    )
+                    n += getattr(res, "affected", 0)
+                except Exception:
+                    pass
+        return n
+
     def _run_step(self, task_id: int, step: int, cancel: threading.Event) -> tuple[bool, str]:
+        """Drive one step to completion. Local worker threads AND executor
+        nodes in other processes (TaskExecutorNode over the same store)
+        claim subtasks from the shared tables; the owner loop re-queues
+        expired claims and waits until every subtask is terminal."""
         task = self.get_task(task_id)
         _, executor = _REGISTRY[task.type]
-        pending = [st for st in self.subtasks(task_id, step) if st.state == SubtaskState.PENDING]
-        qlock = threading.Lock()
-        errors: list[str] = []
+        stop_workers = threading.Event()
 
         def worker(node_id: int):
+            from tidb_tpu.utils import failpoint
+
             exec_id = f"{self.node_prefix}-{node_id}"
-            while not cancel.is_set():
-                with qlock:
-                    if not pending:
-                        return
-                    st = pending.pop(0)
-                self._x(
-                    f"UPDATE mysql.tidb_background_subtask SET state = '{SubtaskState.RUNNING}', "
-                    f"exec_id = '{exec_id}' WHERE id = {st.id}"
-                )
-                try:
-                    summary = executor.run_subtask(task, st, self)
-                    self._set_subtask(st.id, SubtaskState.SUCCEED, summary or {})
-                except Exception as e:
-                    self._set_subtask(st.id, SubtaskState.FAILED, {"error": str(e)})
-                    errors.append(str(e))
-                    cancel.set()  # fail fast; remaining subtasks cancel
-                    return
+            failpoint.inject("disttask_local_worker_start", exec_id)
+            idle = 0
+            while not cancel.is_set() and not stop_workers.is_set():
+                got = self.claim_subtask(exec_id, lease_ms=self.lease_ms, task_id=task_id)
+                if got is None:
+                    idle += 1
+                    if idle > 2:
+                        return  # no pending work left for this step
+                    time.sleep(0.05)
+                    continue
+                idle = 0
+                self.run_claimed(*got)
 
         n = min(max(task.concurrency, 1), self.n_workers)
         threads = [threading.Thread(target=worker, args=(i,), daemon=True) for i in range(n)]
         for t in threads:
             t.start()
+        err = ""
+        while True:
+            sts = self.subtasks(task_id, step)
+            failed = [s for s in sts if s.state == SubtaskState.FAILED]
+            if failed:
+                err = failed[0].summary.get("error", "subtask failed")
+                cancel.set()
+                break
+            if cancel.is_set():
+                break
+            if all(s.state == SubtaskState.SUCCEED for s in sts):
+                break
+            # a remote node may have died mid-claim: expired leases re-queue,
+            # and idle local workers restart to pick them up
+            if self._requeue_expired(task_id, step) and all(not t.is_alive() for t in threads):
+                threads = [
+                    threading.Thread(target=worker, args=(i,), daemon=True) for i in range(n)
+                ]
+                for t in threads:
+                    t.start()
+            time.sleep(0.05)
+        stop_workers.set()
         for t in threads:
             t.join()
-        if errors:
+        if err or cancel.is_set():
             for st in self.subtasks(task_id, step):
                 if st.state == SubtaskState.PENDING:
                     self._set_subtask(st.id, SubtaskState.CANCELED)
-            return False, errors[0]
-        if cancel.is_set():
-            for st in self.subtasks(task_id, step):
-                if st.state == SubtaskState.PENDING:
-                    self._set_subtask(st.id, SubtaskState.CANCELED)
-            return False, "cancelled"
+            return False, err or "cancelled"
         return True, ""
+
+    def start_executor_node(self, node_id: str, poll_s: float = 0.1) -> "TaskExecutorNode":
+        node = TaskExecutorNode(self, node_id, poll_s=poll_s)
+        node.start()
+        return node
 
     def resume_pending(self) -> list[int]:
         """Re-drive tasks left non-terminal (crash recovery — ref: disttask
@@ -288,3 +427,37 @@ class DistTaskManager:
             self.run_task(tid)
             out.append(tid)
         return out
+
+
+class TaskExecutorNode:
+    """A subtask-executing node — typically running in ANOTHER process
+    attached to the same store (the storage-server process, a worker pod)
+    (ref: taskexecutor.Manager, taskexecutor/manager.go — nodes poll shared
+    storage for claimable subtasks; no dispatch RPC exists, the tables ARE
+    the dispatch)."""
+
+    def __init__(self, manager: DistTaskManager, node_id: str, poll_s: float = 0.1):
+        self.manager = manager
+        self.node_id = node_id
+        self.poll_s = poll_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True, name=f"disttask-{node_id}")
+        self.executed = 0
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                got = self.manager.claim_subtask(self.node_id, lease_ms=self.manager.lease_ms)
+            except Exception:
+                got = None  # store briefly unreachable: keep polling
+            if got is None:
+                time.sleep(self.poll_s)
+                continue
+            self.manager.run_claimed(*got)
+            self.executed += 1

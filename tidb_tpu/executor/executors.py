@@ -651,17 +651,27 @@ def merge_partials(chunk: Chunk, aggs: list[AggDesc], ngroup: int) -> Chunk:
     ncols = chunk.num_cols
     key_cols = chunk.columns[ncols - ngroup :] if ngroup else []
     n = len(chunk)
-    # group rows by key columns
+    # group rows by key columns; ci string keys group by their general_ci
+    # WEIGHT class (per-region partials may split 'a'/'A'/'á' — the merge
+    # is where they collapse, ref: collate-aware final HashAgg)
+    def _key_lane(c) -> np.ndarray:
+        from tidb_tpu.utils.collate import canon_codes, is_ci_string
+
+        if is_ci_string(c):
+            return canon_codes(c.data, c.validity, c.dictionary)
+        return c.data
+
     if ngroup and n:
+        key_lanes = [_key_lane(c) for c in key_cols]
         lanes = []
-        for c in key_cols:
-            lanes.append(c.data)
+        for c, kd in zip(key_cols, key_lanes):
+            lanes.append(kd)
             lanes.append(~c.validity)
         perm = np.lexsort(tuple(reversed(lanes)))
         boundary = np.zeros(n, dtype=bool)
         boundary[0] = True
-        for c in key_cols:
-            ds, vs = c.data[perm], c.validity[perm]
+        for c, kd in zip(key_cols, key_lanes):
+            ds, vs = kd[perm], c.validity[perm]
             boundary[1:] |= ds[1:] != ds[:-1]
             boundary[1:] |= vs[1:] != vs[:-1]
         seg = np.cumsum(boundary) - 1
@@ -792,24 +802,27 @@ class DistinctExec(Executor):
         n = len(chunk)
         if n == 0:
             return chunk
+
+        def key_of(c) -> np.ndarray:
+            # codes identify values within one dictionary; ci collations
+            # dedupe by general_ci WEIGHT class ('a' ≡ 'A' ≡ 'á')
+            from tidb_tpu.utils.collate import canon_codes, is_ci_string
+
+            if is_ci_string(c):
+                return canon_codes(c.data, c.validity, c.dictionary)
+            return c.data
+
+        keys = [key_of(c) for c in chunk.columns]
         lanes = []
-        for c in chunk.columns:
-            key = c.data
-            if c.ftype.kind == TypeKind.STRING and c.dictionary is not None:
-                pass  # codes identify values within one dictionary
-            lanes.append(key)
+        for c, kd in zip(chunk.columns, keys):
+            lanes.append(kd)
             lanes.append(~c.validity)
         perm = np.lexsort(tuple(reversed(lanes)))
-        keep = np.ones(n, dtype=bool)
-        for c in chunk.columns:
-            ds, vs = c.data[perm], c.validity[perm]
-            if len(ds) > 1:
-                keep[1:] &= ~((ds[1:] == ds[:-1]) & (vs[1:] == vs[:-1]))
-        # keep[i] True where any column differs from previous
+        # keep the first row of each distinct key tuple
         diff = np.zeros(n, dtype=bool)
         diff[0] = True
-        for c in chunk.columns:
-            ds, vs = c.data[perm], c.validity[perm]
+        for c, kd in zip(chunk.columns, keys):
+            ds, vs = kd[perm], c.validity[perm]
             diff[1:] |= ds[1:] != ds[:-1]
             diff[1:] |= vs[1:] != vs[:-1]
         return chunk.take(np.sort(perm[diff]))

@@ -189,10 +189,13 @@ def _cmp(xp, ctx, op, sig=None):
         db, vb = ctx.args[1]
         dict_a, dict_b = ctx.arg_dicts[0], ctx.arg_dicts[1]
         if "ci" in (ta.collation, tb.collation):
-            # case-insensitive collation: fold both sides before comparing
-            # (ref: collate.generalCICollator; host-only — pushdown legality
+            # case-insensitive collation: compare WEIGHT STRINGS (the
+            # general_ci transform — accent + case folding per codepoint;
+            # ref: collate.generalCICollator; host-only — pushdown legality
             # keeps these off the device)
             import numpy as np
+
+            from tidb_tpu.utils.collate import weight_bytes
 
             sa, _ = _decode_strs(ctx, 0)
             sb, _ = _decode_strs(ctx, 1)
@@ -201,7 +204,7 @@ def _cmp(xp, ctx, op, sig=None):
                 x = sa[i if len(sa) > 1 else 0]
                 y = sb[i if len(sb) > 1 else 0]
                 if x is not None and y is not None:
-                    out[i] = int(op(x.lower(), y.lower()))
+                    out[i] = int(op(weight_bytes(x), weight_bytes(y)))
             return out, and_valid(xp, va, vb)
         if ta.kind == tb.kind == TypeKind.STRING and dict_a is dict_b and dict_a is not None and dict_a.sorted:
             # same sorted dictionary: codes are order-preserving
@@ -1027,10 +1030,24 @@ def _like(xp, args, ctx):
     strs, v = _decode_strs(ctx, 0)
     pat_code = int(args[1][0])
     pat = ctx.arg_dicts[1].decode(pat_code).decode("utf-8", "replace")
-    rx = re.compile(like_to_regex(pat), re.DOTALL | re.IGNORECASE if ctx.arg_types[0].collation == "ci" else re.DOTALL)
+    ci = ctx.arg_types[0].collation == "ci"
+    if ci:
+        # ci LIKE folds through general_ci WEIGHTS (accents too, beyond
+        # IGNORECASE) — the transform is per-codepoint, so % and _ survive
+        from tidb_tpu.utils.collate import weight_str
+
+        pat = weight_str(pat)
+    else:
+        weight_str = None
+    rx = re.compile(like_to_regex(pat), re.DOTALL)
     out = np.zeros(len(strs), dtype=np.int64)
     for i, s in enumerate(strs):
-        if s is not None and rx.match(s.decode("utf-8", "replace")):
+        if s is None:
+            continue
+        sv = s.decode("utf-8", "replace")
+        if ci:
+            sv = weight_str(sv)
+        if rx.match(sv):
             out[i] = 1
     return out, v
 
@@ -1062,9 +1079,11 @@ def _regexp(xp, args, ctx):
             continue
         rx = cache.get(p)
         if rx is None:
+            from tidb_tpu.utils import mysql_regex
+
             try:
-                rx = cache[p] = re.compile(p.decode("utf-8", "replace"), flags)
-            except re.error as e:
+                rx = cache[p] = mysql_regex.compile(p.decode("utf-8", "replace"), flags)
+            except (re.error, ValueError) as e:
                 raise ValueError(f"Invalid regular expression: {e}") from None
         out[i] = 1 if rx.search(s.decode("utf-8", "replace")) else 0
     return out, valid
@@ -1092,8 +1111,10 @@ def _elt(xp, args, ctx):
 def _field(xp, args, ctx):
     """FIELD(x, a, b, ...): 1-based index of the first argument equal to x,
     0 when absent or x is NULL (string comparison under the operand
-    collation — ASCII casefold for ci, like the LIKE/REGEXP neighbors)."""
+    collation — general_ci weight strings for ci)."""
     import numpy as np
+
+    from tidb_tpu.utils.collate import weight_bytes
 
     ci = ctx.arg_types[0].collation == "ci"
     cols = [_decode_strs(ctx, i)[0] for i in range(len(args))]
@@ -1104,10 +1125,10 @@ def _field(xp, args, ctx):
         if x is None:
             continue
         if ci:
-            x = x.lower()
+            x = weight_bytes(x)
         for k, c in enumerate(cols[1:], start=1):
             v = c[i if len(c) > 1 else 0]
-            if v is not None and (v.lower() if ci else v) == x:
+            if v is not None and (weight_bytes(v) if ci else v) == x:
                 out[i] = k
                 break
     return out, np.ones(n, dtype=bool)

@@ -257,6 +257,53 @@ class StoreServer:
                     }
                 )
             return {"regions": out}, []
+        if cmd == "ingest":
+            # bulk committed-row ingest (restore path): pairs ride one blob
+            buf = blobs[0]
+            keys, vals = [], []
+            off = 0
+            for _ in range(h["n"]):
+                klen, vlen = struct.unpack_from("<IQ", buf, off)
+                off += 12
+                keys.append(buf[off : off + klen])
+                off += klen
+                vals.append(buf[off : off + vlen])
+                off += vlen
+            ts = st.ingest(keys, vals)
+            return {"ts": ts}, []
+        if cmd == "ingest_columnar":
+            # the lightning-style columnar ingest crossing the process
+            # boundary (ref: lightning local backend writing into TiKV)
+            import numpy as _np
+
+            from tidb_tpu.expression.expr import _ft_from_pb
+            from tidb_tpu.kv.rowcodec import RowSchema
+            from tidb_tpu.utils.chunk import Dictionary
+
+            n = h["n"]
+            handles = _np.frombuffer(blobs[0], dtype=_np.int64).copy()
+            cols = {}
+            bi = 1
+            for slot, dt in h["slots"]:
+                data = _np.frombuffer(blobs[bi], dtype=_np.dtype(dt)).copy()
+                valid = _np.frombuffer(blobs[bi + 1], dtype=_np.bool_).copy()
+                cols[slot] = (data, valid)
+                bi += 2
+            dicts = {}
+            for slot in h["dict_slots"]:
+                buf = blobs[bi]
+                bi += 1
+                vals = []
+                off = 0
+                while off < len(buf):
+                    (ln,) = struct.unpack_from("<I", buf, off)
+                    off += 4
+                    vals.append(buf[off : off + ln])
+                    off += ln
+                dicts[slot] = Dictionary(vals)
+            schema = RowSchema([_ft_from_pb(f) for f in h["schema"]])
+            ts = st.ingest_columnar(h["table_id"], handles[:n], cols, schema, dicts)
+            return {"ts": ts}, []
         if cmd == "mpp_ndev":
             return {"ndev": self._mpp_mgr().ndev()}, []
         if cmd == "mpp_dispatch":
@@ -528,6 +575,47 @@ class RemoteStore:
 
     def get_client(self) -> _RemoteCopClient:
         return _RemoteCopClient(self)
+
+    # -- bulk ingest (ref: lightning local backend → TiKV ingest RPCs) -----
+    def ingest(self, keys: Sequence[bytes], values: Sequence[bytes]) -> int:
+        buf = bytearray()
+        for k, v in zip(keys, values):
+            buf += struct.pack("<IQ", len(k), len(v)) + k + v
+        h, _ = self._call({"cmd": "ingest", "n": len(keys)}, [bytes(buf)])
+        return h["ts"]
+
+    def ingest_columnar(self, table_id: int, handles, cols: dict, schema, dicts=None) -> int:
+        import numpy as np
+
+        from tidb_tpu.expression.expr import _ft_pb
+
+        handles = np.ascontiguousarray(np.asarray(handles, dtype=np.int64))
+        blobs = [handles.tobytes()]
+        slots = []
+        for slot, (data, valid) in cols.items():
+            data = np.ascontiguousarray(data)
+            slots.append([slot, data.dtype.str])
+            blobs.append(data.tobytes())
+            blobs.append(np.ascontiguousarray(valid, dtype=np.bool_).tobytes())
+        dict_slots = []
+        for slot, dic in (dicts or {}).items():
+            dict_slots.append(slot)
+            buf = bytearray()
+            for v in dic._values:
+                buf += struct.pack("<I", len(v)) + v
+            blobs.append(bytes(buf))
+        h, _ = self._call(
+            {
+                "cmd": "ingest_columnar",
+                "table_id": table_id,
+                "n": len(handles),
+                "slots": slots,
+                "dict_slots": dict_slots,
+                "schema": [_ft_pb(f) for f in schema.ftypes],
+            },
+            blobs,
+        )
+        return h["ts"]
 
     # -- MPP dispatch (ref: kv/mpp.go DispatchMPPTask/EstablishMPPConns) ----
     def mpp_ndev(self) -> int:

@@ -62,15 +62,14 @@ _SUBTASK_ROWS = 100_000
 class _ImportExt:
     steps = [1]
 
-    def plan_subtasks(self, task, step):
-        from tidb_tpu.session.session import DB  # noqa: F401 (type only)
-
+    def plan_subtasks(self, task, step, manager):
         m = task.meta
-        db = _DB_BY_ID[m["db_ref"]]
-        t = db.catalog.table(m["db"], m["table"])
+        t = manager.db.catalog.table(m["db"], m["table"])
         n = len(parse_csv_rows(t, m["path"], m.get("skip_header"), m.get("delimiter", ",")))
         if n == 0:
             return []
+        # metas are self-contained (row ranges over a shared file path):
+        # an executor node in ANOTHER process re-parses its slice
         return [
             {"start": i, "end": min(i + _SUBTASK_ROWS, n)} for i in range(0, n, _SUBTASK_ROWS)
         ]
@@ -81,32 +80,38 @@ class _ImportExt:
 
 class _ImportExec:
     def run_subtask(self, task, subtask, manager):
+        from tidb_tpu.utils import failpoint
+
         m = task.meta
-        db = _DB_BY_ID[m["db_ref"]]
+        db = manager.db
         t = db.catalog.table(m["db"], m["table"])
         rows = parse_csv_rows(t, m["path"], m.get("skip_header"), m.get("delimiter", ","))
+        failpoint.inject("import_subtask_before_ingest", subtask)
         sl = rows[subtask.meta["start"] : subtask.meta["end"]]
         n = import_rows_slice(db, m["db"], m["table"], sl)
         return {"rows": n}
 
 
-# process-local handle registry: task meta must be JSON, the DB object isn't
-_DB_BY_ID: dict = {}
+def register_import_task_type() -> None:
+    """Idempotent registration — every process that may EXECUTE import
+    subtasks (SQL layer, storage server, worker pods) calls this."""
+    from tidb_tpu.disttask import register_task_type
+
+    register_task_type("import_into", _ImportExt(), _ImportExec())
 
 
 def import_into_disttask(db, db_name: str, table_name: str, path: str, *, skip_header=None, delimiter=",") -> int:
     """IMPORT INTO through the distributed task framework; returns rows."""
-    from tidb_tpu.disttask import DistTaskManager, register_task_type
+    from tidb_tpu.disttask import DistTaskManager
 
-    register_task_type("import_into", _ImportExt(), _ImportExec())
-    _DB_BY_ID[id(db)] = db
+    register_import_task_type()
     mgr = getattr(db, "_disttask_mgr", None)
     if mgr is None:
         mgr = DistTaskManager(db)
         db._disttask_mgr = mgr
     tid = mgr.submit_task(
         "import_into",
-        {"db_ref": id(db), "db": db_name, "table": table_name, "path": path, "skip_header": skip_header, "delimiter": delimiter},
+        {"db": db_name, "table": table_name, "path": path, "skip_header": skip_header, "delimiter": delimiter},
     )
     task = mgr.run_task(tid)
     if task.state != "succeed":
