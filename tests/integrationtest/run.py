@@ -105,10 +105,15 @@ def result_path(test_path: str) -> str:
 
 
 def main(argv=None):
-    record = "--record" in (argv or sys.argv[1:])
+    args = argv or sys.argv[1:]
+    record = "--record" in args
+    names = [a for a in args if not a.startswith("--")]
     os.makedirs(os.path.join(HERE, "r"), exist_ok=True)
     failed = []
-    for tp in test_files():
+    files = test_files()
+    if names:  # positional args select files by substring
+        files = [f for f in files if any(n in os.path.basename(f) for n in names)]
+    for tp in files:
         got = run_file(tp)
         rp = result_path(tp)
         if record:
@@ -124,7 +129,7 @@ def main(argv=None):
     if failed:
         raise SystemExit(f"golden mismatches: {failed}")
     if not record:
-        print(f"ok: {len(test_files())} golden files")
+        print(f"ok: {len(files)} golden files")
 
 
 if __name__ == "__main__":

@@ -173,6 +173,17 @@ def _group_sort(chunk: Chunk, key_cols: list[Column]) -> tuple[np.ndarray, np.nd
     return perm, seg, ngroups
 
 
+def minmax_sentinel(op: str, dtype):
+    """Neutral element for a segmented min/max over lanes of ``dtype``.
+    Must fit the lane dtype: string codes travel as int32, and an int64
+    max would wrap to -1 there (shared by the cop engine and the
+    executor's partial merge)."""
+    if np.dtype(dtype).kind == "f":
+        return np.inf if op == "min" else -np.inf
+    info = np.iinfo(dtype)
+    return info.max if op == "min" else info.min
+
+
 def _segment_reduce(op: str, data: np.ndarray, valid: np.ndarray, seg: np.ndarray, ngroups: int):
     """→ (result, valid_count) per group."""
     w = valid.astype(np.int64)
@@ -187,11 +198,8 @@ def _segment_reduce(op: str, data: np.ndarray, valid: np.ndarray, seg: np.ndarra
             np.add.at(s, seg, np.where(valid, data, 0))
         return s, cnt
     if op in ("min", "max"):
-        if data.dtype == np.float64:
-            sentinel = np.inf if op == "min" else -np.inf
-        else:
-            sentinel = np.iinfo(np.int64).max if op == "min" else np.iinfo(np.int64).min
-        d = np.where(valid, data, sentinel)
+        sentinel = minmax_sentinel(op, data.dtype)
+        d = np.where(valid, data, sentinel).astype(data.dtype)
         out = np.full(ngroups, sentinel, dtype=data.dtype)
         (np.minimum if op == "min" else np.maximum).at(out, seg, d)
         return out, cnt

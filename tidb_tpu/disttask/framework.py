@@ -357,7 +357,6 @@ class DistTaskManager:
         claim subtasks from the shared tables; the owner loop re-queues
         expired claims and waits until every subtask is terminal."""
         task = self.get_task(task_id)
-        _, executor = _REGISTRY[task.type]
         stop_workers = threading.Event()
 
         def worker(node_id: int):

@@ -705,11 +705,10 @@ def merge_partials(chunk: Chunk, aggs: list[AggDesc], ngroup: int) -> Chunk:
                 np.logical_or.at(anyv, seg, valid)
                 state_cols.append(Column(out.astype(data.dtype), anyv, c.ftype))
             elif pk in ("min", "max"):
-                if data.dtype == np.float64:
-                    sentinel = np.inf if pk == "min" else -np.inf
-                else:
-                    sentinel = np.iinfo(np.int64).max if pk == "min" else np.iinfo(np.int64).min
-                d = np.where(valid, data, sentinel)
+                from tidb_tpu.copr.host_engine import minmax_sentinel
+
+                sentinel = minmax_sentinel(pk, data.dtype)
+                d = np.where(valid, data, sentinel).astype(data.dtype)
                 out = np.full(ngroups, sentinel, dtype=data.dtype)
                 (np.minimum if pk == "min" else np.maximum).at(out, seg, d)
                 anyv = np.zeros(ngroups, dtype=bool)

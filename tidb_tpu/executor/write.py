@@ -125,6 +125,21 @@ def to_physical(v, ftype, warn=None, strict: bool = True, col: str = "") -> obje
             v = v.encode("utf-8")
         elif not isinstance(v, bytes):
             v = str(v).encode("utf-8")
+        if ftype.length is not None and ftype.length >= 0 and not ftype.json:
+            chars = v.decode("utf-8", "surrogateescape")
+            if len(chars) > ftype.length:
+                # VARCHAR(n) overflow: strict errors (MySQL 1406) unless only
+                # trailing spaces overflow (truncated with a note even in
+                # strict mode); non-strict truncates at a character boundary
+                only_spaces = chars[ftype.length:].strip(" ") == ""
+                if strict and not only_spaces:
+                    raise WriteError(f"Data too long for column '{col}'")
+                if warn is not None:
+                    if only_spaces:
+                        warn("Note", 1265, f"Data truncated for column '{col}'")
+                    else:
+                        warn("Warning", 1265, f"Data truncated for column '{col}'")
+                v = chars[: ftype.length].encode("utf-8", "surrogateescape")
         if ftype.json:
             import json as _json
 

@@ -638,10 +638,84 @@ def _sign(xp, args, ctx):
 # ---------------------------------------------------------------------------
 
 
+_NUM_PREFIX = None  # lazily compiled regex
+
+
+def _str_numeric(ctx, kind_name: str):
+    """MySQL string→number coercion: parse the longest numeric prefix,
+    warn 1292 per row with trailing garbage (ref: types.StrToFloat /
+    strconv with truncation warnings). Integer-looking prefixes stay exact
+    Python ints (no float round-trip) so int64-boundary values survive.
+    → list[int|float|None]."""
+    import re
+
+    global _NUM_PREFIX
+    if _NUM_PREFIX is None:
+        _NUM_PREFIX = re.compile(rb"^\s*([+-]?)(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?")
+    strs, _ = _decode_strs(ctx, 0)
+    warn = getattr(ctx, "warn", None)
+    out = []
+    for s in strs:
+        if s is None:
+            out.append(None)
+            continue
+        m = _NUM_PREFIX.match(s)
+        if m is None:
+            out.append(0.0)
+            if warn is not None:
+                warn("Warning", 1292, f"Truncated incorrect {kind_name} value: '{s.decode('utf-8', 'replace')}'")
+            continue
+        if m.group(3) is None and b"." not in m.group(2):
+            x = int(m.group(1) + m.group(2))  # exact integer, no float loss
+        else:
+            try:
+                x = float(m.group(0))
+            except (ValueError, OverflowError):
+                x = 0.0
+            if x == float("inf") or x == float("-inf"):  # 1e400 clamps
+                x = float("1.7976931348623157e308") * (1 if x > 0 else -1)
+        if m.end() < len(s) and s[m.end():].strip():
+            if warn is not None:
+                warn("Warning", 1292, f"Truncated incorrect {kind_name} value: '{s.decode('utf-8', 'replace')}'")
+        out.append(x)
+    return out
+
+
+_I64_LO, _I64_HI = -(2**63), 2**63 - 1
+
+
+def _clamp_i64(x, warn, kind_name: str):
+    """Round to int and clamp to int64 with MySQL 1264 on overflow."""
+    if isinstance(x, float):
+        if x != x:  # NaN
+            x = 0.0
+        elif x > 9.3e18 or x < -9.3e18:  # covers inf: clamp before int()
+            if warn is not None:
+                warn("Warning", 1264, f"Out of range value for {kind_name}")
+            return _I64_HI if x > 0 else _I64_LO
+    i = int(x + (0.5 if x >= 0 else -0.5)) if isinstance(x, float) else x
+    if i > _I64_HI or i < _I64_LO:
+        if warn is not None:
+            warn("Warning", 1264, f"Out of range value for {kind_name}")
+        return _I64_HI if i > 0 else _I64_LO
+    return i
+
+
 @register("cast_int", lambda args: bigint_type(), arity=1)
 def _cast_int(xp, args, ctx):
     (d, v) = args[0]
     t = ctx.arg_types[0]
+    if t.kind == TypeKind.STRING:
+        import numpy as np
+
+        warn = getattr(ctx, "warn", None)
+        vals = _str_numeric(ctx, "INTEGER")
+        data = np.array(
+            [0 if x is None else _clamp_i64(x, warn, "BIGINT") for x in vals],
+            dtype=np.int64,
+        )
+        valid = np.array([x is not None for x in vals], dtype=bool)
+        return data, valid
     if t.kind == TypeKind.DECIMAL:
         f = 10**t.scale
         return xp.sign(d) * ((xp.abs(d) + f // 2) // f), v
@@ -654,6 +728,13 @@ def _cast_int(xp, args, ctx):
 def _cast_float(xp, args, ctx):
     (d, v) = args[0]
     t = ctx.arg_types[0]
+    if t.kind == TypeKind.STRING:
+        import numpy as np
+
+        vals = _str_numeric(ctx, "DOUBLE")
+        data = np.array([0.0 if x is None else x for x in vals], dtype=np.float64)
+        valid = np.array([x is not None for x in vals], dtype=bool)
+        return data, valid
     if t.kind == TypeKind.DECIMAL:
         return d / (10**t.scale), v
     return d * 1.0, v
@@ -664,6 +745,31 @@ def _cast_decimal(xp, args, ctx):
     (d, v) = args[0]
     t = ctx.arg_types[0]
     target = ctx.ret_type
+    if t.kind == TypeKind.STRING:
+        import numpy as np
+
+        warn = getattr(ctx, "warn", None)
+        vals = _str_numeric(ctx, "DECIMAL")
+        f = 10**target.scale
+        # DECIMAL(p,s) range: scaled magnitude < 10^p (clamp like MySQL 1264)
+        prec = target.length if target.length and target.length > 0 else 18
+        cap = 10 ** min(prec, 18) - 1
+        out = []
+        for x in vals:
+            if x is None:
+                out.append(0)
+                continue
+            # cap-clamp below always fires for out-of-range (cap < int64 max),
+            # so the inner clamp stays silent to avoid a double 1264
+            q = _clamp_i64(x * f, None, "DECIMAL")
+            if q > cap or q < -cap:
+                if warn is not None:
+                    warn("Warning", 1264, "Out of range value for DECIMAL")
+                q = cap if q > 0 else -cap
+            out.append(q)
+        data = np.array(out, dtype=np.int64)
+        valid = np.array([x is not None for x in vals], dtype=bool)
+        return data, valid
     if t.kind == TypeKind.DECIMAL:
         diff = target.scale - t.scale
         if diff >= 0:
@@ -920,13 +1026,19 @@ def _cast_string(xp, args, ctx):
     from tidb_tpu.types.datum import days_to_date, micros_to_datetime
 
     maxlen = ctx.ret_type.length  # CHAR(n) truncates; -1 = unbounded
+    warn = getattr(ctx, "warn", None)
 
     def _trunc(b):
         if maxlen < 0 or b is None:
             return b
         if isinstance(b, bytes):
             # CHAR(n) counts characters, not bytes — never split a codepoint
-            return b.decode("utf-8", "surrogateescape")[:maxlen].encode("utf-8", "surrogateescape")
+            chars = b.decode("utf-8", "surrogateescape")
+            if len(chars) > maxlen and warn is not None:
+                warn("Warning", 1292, f"Truncated incorrect CHAR({maxlen}) value: '{chars}'")
+            return chars[:maxlen].encode("utf-8", "surrogateescape")
+        if len(b) > maxlen and warn is not None:
+            warn("Warning", 1292, f"Truncated incorrect CHAR({maxlen}) value: '{b}'")
         return b[:maxlen]
 
     t = ctx.arg_types[0]
@@ -1502,6 +1614,73 @@ def _last_day(xp, args, ctx):
 def _date(xp, args, ctx):
     d, v = _to_days_any(xp, ctx, 0)
     return d, v
+
+
+def _cast_temporal(xp, args, ctx, want_date: bool):
+    """CAST(x AS DATE/DATETIME): numeric temporals convert arithmetically;
+    strings parse on host with NULL + warning 1292 per bad row (ref:
+    types.Context truncation warnings, builtin_cast date paths)."""
+    import numpy as np
+
+    kind = ctx.arg_types[0].kind
+    unit = 86_400_000_000
+    if kind == TypeKind.DATE:
+        (d, v) = args[0]
+        return (d, v) if want_date else (d * unit, v)
+    if kind == TypeKind.DATETIME:
+        (d, v) = args[0]
+        return (d // unit, v) if want_date else (d, v)
+    from tidb_tpu.types.datum import date_to_days, datetime_to_micros
+
+    if kind == TypeKind.STRING:
+        strs, _ = _decode_strs(ctx, 0)
+    else:  # MySQL numeric literal dates: 20240105 / 20240105093000
+        (d, v) = args[0]
+        n = len(d) if hasattr(d, "__len__") else ctx.n
+        ok = v is None or v is True
+        # DECIMAL physicals are scaled ints — recover the integer part
+        div = 10 ** ctx.arg_types[0].scale if kind == TypeKind.DECIMAL else 1
+        strs = [
+            (str(int(d if not hasattr(d, "__len__") else d[k]) // div).encode()
+             if (ok or (v if isinstance(v, bool) else v[k])) else None)
+            for k in range(n)
+        ]
+    warn = getattr(ctx, "warn", None)
+    data = np.zeros(len(strs), dtype=np.int64)
+    valid = np.ones(len(strs), dtype=bool)
+    for k, s in enumerate(strs):
+        if s is None:
+            valid[k] = False
+            continue
+        txt = s.decode("utf-8", "surrogateescape").strip()
+        try:
+            if len(txt) == 8 and txt.isdigit():
+                txt = f"{txt[:4]}-{txt[4:6]}-{txt[6:]}"
+            elif len(txt) == 14 and txt.isdigit():
+                txt = f"{txt[:4]}-{txt[4:6]}-{txt[6:8]} {txt[8:10]}:{txt[10:12]}:{txt[12:]}"
+            has_time = ":" in txt or " " in txt or "T" in txt[10:11]
+            if has_time:
+                us = datetime_to_micros(txt.replace("T", " ", 1))
+                data[k] = us // unit if want_date else us
+            else:
+                days = date_to_days(txt)
+                data[k] = days if want_date else days * unit
+        except Exception:
+            valid[k] = False
+            if warn is not None:
+                tn = "date" if want_date else "datetime"
+                warn("Warning", 1292, f"Incorrect {tn} value: '{txt}'")
+    return data, valid
+
+
+@register("cast_date", lambda args: FieldType(TypeKind.DATE, nullable=True), arity=1, engines=HOST_ONLY)
+def _cast_date(xp, args, ctx):
+    return _cast_temporal(xp, args, ctx, want_date=True)
+
+
+@register("cast_datetime", lambda args: FieldType(TypeKind.DATETIME, nullable=True), arity=1, engines=HOST_ONLY)
+def _cast_datetime(xp, args, ctx):
+    return _cast_temporal(xp, args, ctx, want_date=False)
 
 
 @register("unix_timestamp", lambda args: bigint_type(), arity=1)

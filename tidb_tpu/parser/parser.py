@@ -1721,6 +1721,15 @@ class Parser:
             return ast.Show("warnings")
         if self.eat_kw("ERRORS"):
             return ast.Show("errors")
+        if self.at_kw("COUNT"):  # SHOW COUNT(*) WARNINGS | ERRORS
+            self.next()
+            self.expect_op("(")
+            self.expect_op("*")
+            self.expect_op(")")
+            if self.eat_kw("WARNINGS"):
+                return ast.Show("warning_count")
+            self.expect_kw("ERRORS")
+            return ast.Show("error_count")
         if self.eat_kw("COLUMNS") or self.eat_kw("FIELDS"):
             self.expect_kw("FROM")
             return ast.Show("columns", target=self.ident())

@@ -108,6 +108,10 @@ def _cast_expr(e: Expression, target: ast.TypeDef) -> Expression:
     if tname in ("char", "varchar", "binary", "nchar"):
         # ret_type.length carries CHAR(n)'s truncation length to the eval
         return func("cast_string", e, ret=string_type(length=target.length))
+    if tname == "date":
+        return func("cast_date", e)
+    if tname == "datetime":
+        return func("cast_datetime", e)
     raise PlanError(f"unsupported CAST target {tname}")
 
 

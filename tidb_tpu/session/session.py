@@ -1216,6 +1216,12 @@ class Session:
                 w for w in self.warnings if w[0] == "Error"
             ]
             return Result(columns=["Level", "Code", "Message"], rows=list(src))
+        if stmt.kind in ("warning_count", "error_count"):
+            src = self.warnings if stmt.kind == "warning_count" else [
+                w for w in self.warnings if w[0] == "Error"
+            ]
+            col = "@@session.warning_count" if stmt.kind == "warning_count" else "@@session.error_count"
+            return Result(columns=[col], rows=[(len(src),)])
         if stmt.kind == "index":
             t = self.catalog.table(self.current_db, stmt.target)
             rows = []

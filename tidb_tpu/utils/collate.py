@@ -33,9 +33,6 @@ def _weight_char(ch: str) -> str:
     return up[:1] if len(up) > 1 else up
 
 
-_TABLE_CACHE: dict = {}
-
-
 def weight_str(s: str, collation: str = "ci") -> str:
     """Weight string under the collation ('ci' = general_ci semantics;
     anything else is binary identity)."""
