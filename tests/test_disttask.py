@@ -136,3 +136,106 @@ def test_import_into_sql_dist_task_var(tmp_path):
     s.execute("SET tidb_enable_dist_task = 1")
     assert s.execute(f"IMPORT INTO t FROM '{p}'").affected == 2
     assert db.query("SELECT task_type FROM mysql.tidb_global_task") == [("import_into",)]
+
+
+def test_import_subtask_rerun_is_idempotent(tmp_path):
+    """A lease-expired subtask re-runs while its first (slow-but-alive)
+    worker still completes the ingest — handles are reserved at plan time,
+    so both executions write the SAME keys and no rows duplicate
+    (ref: lightning re-importing a failed engine's deterministic keys)."""
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE noidx (a BIGINT, b VARCHAR(16))")
+    p = tmp_path / "dup.csv"
+    p.write_text("".join(f"{i},row{i}\n" for i in range(400)))
+    from tidb_tpu.tools import importer
+
+    importer.register_import_task_type()
+    mgr = DistTaskManager(db, n_workers=0)
+    db._disttask_mgr = mgr
+    importer._SUBTASK_ROWS, saved = 150, importer._SUBTASK_ROWS
+    try:
+        tid = mgr.submit_task(
+            "import_into",
+            {"db": "test", "table": "noidx", "path": str(p),
+             "skip_header": False, "delimiter": ","},
+        )
+        done = {}
+        th = threading.Thread(target=lambda: done.update(task=mgr.run_task(tid)))
+        th.start()
+        # wait for the owner to plan subtasks and enter RUNNING
+        for _ in range(200):
+            if mgr.get_task(tid).state == TaskState.RUNNING and mgr.subtasks(tid):
+                break
+            time.sleep(0.05)
+        claimed = mgr.claim_subtask("worker-A", lease_ms=60_000, task_id=tid)
+        assert claimed is not None
+        task, st = claimed
+        from tidb_tpu.utils import failpoint
+
+        hold = threading.Event()
+        entered = threading.Event()
+
+        def slow_first(sub):
+            if sub.id == st.id and not entered.is_set():
+                entered.set()
+                hold.wait(30)  # block worker A mid-subtask, pre-ingest
+
+        failpoint.enable("import_subtask_before_ingest", slow_first)
+        try:
+            ta = threading.Thread(target=lambda: mgr.run_claimed(task, st))
+            ta.start()
+            assert entered.wait(10)
+            # lease-expiry sweep: the claim goes back to pending
+            mgr._x(
+                "UPDATE mysql.tidb_background_subtask SET state = 'pending', "
+                f"exec_id = '', lease = 0 WHERE id = {st.id}"
+            )
+            failpoint.disable("import_subtask_before_ingest")
+            re_claimed = mgr.claim_subtask("worker-B", lease_ms=60_000, task_id=tid)
+            assert re_claimed is not None and re_claimed[1].id == st.id
+            mgr.run_claimed(*re_claimed)  # B completes the subtask
+            hold.set()  # A wakes and ALSO ingests the same slice
+            ta.join(timeout=60)
+            assert not ta.is_alive()
+            # drain the remaining subtasks (no local workers in this test)
+            while True:
+                nxt = mgr.claim_subtask("worker-B", lease_ms=60_000, task_id=tid)
+                if nxt is None:
+                    break
+                mgr.run_claimed(*nxt)
+        finally:
+            failpoint.disable("import_subtask_before_ingest")
+            hold.set()
+        th.join(timeout=120)
+        assert not th.is_alive(), "owner loop hung"
+        assert done["task"].state == TaskState.SUCCEED
+        assert db.query("SELECT COUNT(*) FROM noidx") == [(400,)]
+        assert db.query("SELECT COUNT(DISTINCT a) FROM noidx") == [(400,)]
+    finally:
+        importer._SUBTASK_ROWS = saved
+
+
+def test_import_rerun_idempotent_pk_and_partitioned(tmp_path):
+    """Direct re-run of the same slice (same reserved handles) replaces
+    rather than appends — PK-handle and partitioned columnar paths."""
+    from tidb_tpu.tools.importer import import_rows_slice
+
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE pkh (id BIGINT PRIMARY KEY, v BIGINT)")
+    rows = [[str(i), str(i * 2)] for i in range(100)]
+    import_rows_slice(db, "test", "pkh", rows, on_existing="verify")
+    import_rows_slice(db, "test", "pkh", rows, on_existing="verify")
+    assert db.query("SELECT COUNT(*) FROM pkh") == [(100,)]
+    # a CONFLICTING re-import of the same PKs must surface, not silently drop
+    with pytest.raises(Exception, match="duplicate key"):
+        import_rows_slice(
+            db, "test", "pkh", [["5", "999"]], on_existing="verify"
+        )
+    assert db.query("SELECT v FROM pkh WHERE id = 5") == [(10,)]
+    db.execute("CREATE TABLE ph (k BIGINT, v BIGINT) PARTITION BY HASH(k) PARTITIONS 3")
+    prow = [[str(i % 7), str(i)] for i in range(90)]
+    base = db.catalog.alloc_autoid(db.catalog.table("test", "ph").id, 90)
+    import_rows_slice(db, "test", "ph", prow, handle_base=base, on_existing="skip")
+    import_rows_slice(db, "test", "ph", prow, handle_base=base, on_existing="skip")
+    assert db.query("SELECT COUNT(*) FROM ph") == [(90,)]
+    assert db.query("SELECT SUM(v) FROM ph") == [(sum(range(90)),)]

@@ -290,7 +290,10 @@ class DistTaskManager:
         a slow-but-alive node must not lose its claim to the scheduler's
         expiry sweep (ref: subtask heartbeat/balance). The terminal write is
         FENCED on still owning the claim: if the lease was lost anyway and
-        the subtask re-queued, the stale worker's result is discarded."""
+        the subtask re-queued, the stale worker's state write is discarded.
+        Data side effects survive the fence, so executors must be idempotent
+        under re-runs — the import executor writes deterministic handle
+        ranges reserved at plan time (see tools/importer plan_subtasks)."""
         reg = _REGISTRY.get(task.type)
         if reg is None:  # claim filter should prevent this; never kill the node loop
             self._fenced_set(st, SubtaskState.FAILED, {"error": f"task type {task.type!r} not registered"})

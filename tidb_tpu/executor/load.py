@@ -19,9 +19,16 @@ from tidb_tpu.session.session import DB
 from tidb_tpu.types import TypeKind
 
 
-def bulk_load(db: DB, table_name: str, columns: Sequence[Sequence], db_name: str = "test", batch: int = 200_000) -> int:
+def bulk_load(db: DB, table_name: str, columns: Sequence[Sequence], db_name: str = "test", batch: int = 200_000, handle_base: int | None = None, on_existing: str | None = None) -> int:
     """Load columnar data (one sequence per table column, logical values).
-    Handles come from the int PK column when pk_is_handle, else autoid."""
+    Handles come from the int PK column when pk_is_handle, else autoid.
+
+    ``handle_base`` pins the autoid handles to a pre-reserved range so a
+    re-run writes the SAME keys; ``on_existing`` ('skip' for reserved ranges,
+    'verify' for user-keyed PK tables) dedupes the columnar ingest against
+    already-stable handles — together they make a restarted import subtask
+    idempotent, and 'verify' surfaces duplicate-PK conflicts (ref: lightning
+    checkpoint re-import + duplicate detection)."""
     t = db.catalog.table(db_name, table_name)
     ncols = len(t.columns)
     assert len(columns) == ncols, f"expected {ncols} columns"
@@ -44,7 +51,7 @@ def bulk_load(db: DB, table_name: str, columns: Sequence[Sequence], db_name: str
             phys_cols.append([to_physical(v, c.ftype) for v in vals])
 
     if t.partition is not None:
-        return _bulk_load_partitioned(db, t, phys_cols, n, schema)
+        return _bulk_load_partitioned(db, t, phys_cols, n, schema, handle_base=handle_base, on_existing=on_existing)
 
     if not any(idx.state != "delete_only" for idx in t.indexes):
         # columnar stable-layer ingest (TiFlash stable analog): columns go
@@ -53,10 +60,12 @@ def bulk_load(db: DB, table_name: str, columns: Sequence[Sequence], db_name: str
         # transactional with their rows.
         if t.pk_is_handle:
             all_handles = np.ascontiguousarray(np.asarray(phys_cols[t.pk_offset], dtype=np.int64))
+        elif handle_base is not None:
+            all_handles = np.arange(handle_base, handle_base + n, dtype=np.int64)
         else:
             base = db.catalog.alloc_autoid(t.id, n)
             all_handles = np.arange(base, base + n, dtype=np.int64)
-        _ingest_columnar(db, t.id, t, phys_cols, all_handles, n, schema)
+        _ingest_columnar(db, t.id, t, phys_cols, all_handles, n, schema, on_existing=on_existing)
         if t.pk_is_handle and n:
             db.catalog.rebase_autoid(t.id, int(all_handles.max()) + 1)
         return n
@@ -68,6 +77,8 @@ def bulk_load(db: DB, table_name: str, columns: Sequence[Sequence], db_name: str
         txn = db.store.begin()
         if t.pk_is_handle:
             handles = phys_cols[t.pk_offset][i:j]
+        elif handle_base is not None:
+            handles = range(handle_base + i, handle_base + j)
         else:
             base = db.catalog.alloc_autoid(t.id, j - i)
             handles = range(base, base + (j - i))
@@ -88,7 +99,7 @@ def bulk_load(db: DB, table_name: str, columns: Sequence[Sequence], db_name: str
     return loaded
 
 
-def _ingest_columnar(db: DB, physical_id: int, t, phys_cols, handles: np.ndarray, n: int, schema: RowSchema) -> None:
+def _ingest_columnar(db: DB, physical_id: int, t, phys_cols, handles: np.ndarray, n: int, schema: RowSchema, on_existing: str | None = None) -> None:
     """Columns → StableBlock via MemStore.ingest_columnar. Strings dictionary-
     encode through np.unique (C-speed inverse) against the shared table
     dictionary, so blocks hand int32 code lanes straight to the device."""
@@ -137,10 +148,10 @@ def _ingest_columnar(db: DB, physical_id: int, t, phys_cols, handles: np.ndarray
             else:
                 data = np.empty(0, np.int32)
             cols[pos] = (data, valid)
-        db.store.ingest_columnar(physical_id, handles, cols, schema, dicts)
+        db.store.ingest_columnar(physical_id, handles, cols, schema, dicts, on_existing=on_existing)
 
 
-def _bulk_load_partitioned(db: DB, t, phys_cols, n: int, schema: RowSchema) -> int:
+def _bulk_load_partitioned(db: DB, t, phys_cols, n: int, schema: RowSchema, handle_base: int | None = None, on_existing: str | None = None) -> int:
     """Partition-routed load: rows group by partition id, then each group
     loads through the native ingest (or txn fallback) under its partition's
     physical table id."""
@@ -168,6 +179,8 @@ def _bulk_load_partitioned(db: DB, t, phys_cols, n: int, schema: RowSchema) -> i
 
     if t.pk_is_handle:
         handles = np.ascontiguousarray(np.asarray(phys_cols[t.pk_offset], dtype=np.int64))
+    elif handle_base is not None:
+        handles = np.arange(handle_base, handle_base + n, dtype=np.int64)
     else:
         base = db.catalog.alloc_autoid(t.id, n)
         handles = np.arange(base, base + n, dtype=np.int64)
@@ -185,7 +198,7 @@ def _bulk_load_partitioned(db: DB, t, phys_cols, n: int, schema: RowSchema) -> i
         ]
         sub_handles = handles[sel]
         if not has_index:
-            _ingest_columnar(db, view.id, t, sub_cols, sub_handles, len(sel), schema)
+            _ingest_columnar(db, view.id, t, sub_cols, sub_handles, len(sel), schema, on_existing=on_existing)
             continue
         txn = db.store.begin()
         for j, h in enumerate(sub_handles):

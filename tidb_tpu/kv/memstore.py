@@ -644,12 +644,25 @@ class MemStore:
                 self._maybe_auto_split(r)
             return commit_ts
 
-    def ingest_columnar(self, table_id: int, handles: np.ndarray, cols: dict, schema, dicts: dict | None = None) -> int:
+    def ingest_columnar(self, table_id: int, handles: np.ndarray, cols: dict, schema, dicts: dict | None = None, on_existing: str | None = None) -> int:
         """Bulk ingest of decoded columns as a stable block at one fresh
         commit ts — the columnar twin of :meth:`ingest` (TiFlash stable layer;
         ref: lightning local backend writing SSTs below the LSM). Rows never
         take the per-key dict path: reads overlay the MVCC row-delta dict on
-        top of the block. Handles must be unique; they are sorted here."""
+        top of the block. Handles must be unique; they are sorted here.
+
+        ``on_existing`` governs handles already in a stable block:
+
+        - ``'skip'``: drop them from THIS ingest (first-writer-wins). Safe
+          only for task-reserved handle ranges, where presence proves the
+          same subtask already wrote the identical row — a restarted import
+          subtask becomes idempotent WITHOUT rewriting committed history, so
+          in-flight snapshots stay consistent (ref: lightning re-importing a
+          failed engine's deterministic keys).
+        - ``'verify'``: skip rows whose stored values match this ingest
+          row-for-row; raise on any mismatch — the duplicate-PK conflict
+          surface for user-keyed tables (ref: lightning duplicate detection).
+        - ``None``: append blindly."""
         handles = np.asarray(handles, dtype=np.int64)
         if len(handles) == 0:
             return self.tso.ts()
@@ -660,6 +673,16 @@ class MemStore:
             if np.any(handles[:-1] == handles[1:]):
                 raise ValueError("ingest_columnar: duplicate handles")
         with self._mu:
+            if on_existing is not None:
+                present = self._stable_present_locked(
+                    table_id, handles, cols if on_existing == "verify" else None
+                )
+                if present.all():
+                    return self.tso.ts()  # full duplicate: nothing to do
+                if present.any():
+                    keep = ~present
+                    handles = handles[keep]
+                    cols = {s: (d[keep], v[keep]) for s, (d, v) in cols.items()}
             self.tso.ts()  # burn a start_ts to mirror the txn path
             commit_ts = self.tso.ts()
             lo_key = tablecodec.record_key(table_id, int(handles[0]))
@@ -682,6 +705,38 @@ class MemStore:
             for r in touched:
                 self._maybe_auto_split(r)
             return commit_ts
+
+    def _stable_present_locked(self, table_id: int, handles: np.ndarray, verify_cols: dict | None = None) -> np.ndarray:
+        """Bool mask: which of these (sorted) handles already sit in a stable
+        block. Span-disjoint blocks (the common first-run case — subtasks
+        write disjoint reserved ranges) skip in O(1). With ``verify_cols``,
+        every present handle's stored values must equal this ingest's values
+        (string codes share the per-table dictionary, so codes compare) —
+        a mismatch raises the duplicate-key conflict."""
+        present = np.zeros(len(handles), dtype=bool)
+        lo, hi = int(handles[0]), int(handles[-1])
+        for b in self._stable.get(table_id, ()):
+            if not len(b.handles) or int(b.handles[-1]) < lo or int(b.handles[0]) > hi:
+                continue
+            i = np.searchsorted(b.handles, handles)
+            i = np.minimum(i, len(b.handles) - 1)
+            hit = b.handles[i] == handles
+            if verify_cols is not None and hit.any():
+                new_idx = np.nonzero(hit)[0]
+                blk_idx = i[hit]
+                for slot, (nd, nv) in verify_cols.items():
+                    bd, bv = b.cols[slot]
+                    same_valid = bv[blk_idx] == nv[new_idx]
+                    both = bv[blk_idx] & nv[new_idx]
+                    same_val = ~both | (bd[blk_idx] == nd[new_idx])
+                    bad = ~(same_valid & same_val)
+                    if bad.any():
+                        k = int(handles[new_idx[np.nonzero(bad)[0][0]]])
+                        raise ValueError(
+                            f"duplicate key conflict on handle {k}: existing row differs"
+                        )
+            present |= hit
+        return present
 
     def stable_parts(self, table_id: int, kr: KeyRange, read_ts: int) -> list[tuple["StableBlock", int, int]]:
         """[(block, lo, hi)] index slices of stable rows with record keys in

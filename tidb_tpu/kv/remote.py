@@ -302,7 +302,10 @@ class StoreServer:
                     off += ln
                 dicts[slot] = Dictionary(vals)
             schema = RowSchema([_ft_from_pb(f) for f in h["schema"]])
-            ts = st.ingest_columnar(h["table_id"], handles[:n], cols, schema, dicts)
+            ts = st.ingest_columnar(
+                h["table_id"], handles[:n], cols, schema, dicts,
+                on_existing=h.get("on_existing"),
+            )
             return {"ts": ts}, []
         if cmd == "mpp_ndev":
             return {"ndev": self._mpp_mgr().ndev()}, []
@@ -584,7 +587,7 @@ class RemoteStore:
         h, _ = self._call({"cmd": "ingest", "n": len(keys)}, [bytes(buf)])
         return h["ts"]
 
-    def ingest_columnar(self, table_id: int, handles, cols: dict, schema, dicts=None) -> int:
+    def ingest_columnar(self, table_id: int, handles, cols: dict, schema, dicts=None, on_existing: str | None = None) -> int:
         import numpy as np
 
         from tidb_tpu.expression.expr import _ft_pb
@@ -608,6 +611,7 @@ class RemoteStore:
             {
                 "cmd": "ingest_columnar",
                 "table_id": table_id,
+                "on_existing": on_existing,
                 "n": len(handles),
                 "slots": slots,
                 "dict_slots": dict_slots,

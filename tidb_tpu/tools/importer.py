@@ -34,8 +34,9 @@ def parse_csv_rows(t, path: str, skip_header: bool | None, delimiter: str) -> li
     return rows
 
 
-def import_rows_slice(db, db_name: str, table_name: str, rows: list[list]) -> int:
-    """Convert + load one slice of parsed CSV rows."""
+def import_rows_slice(db, db_name: str, table_name: str, rows: list[list], handle_base: int | None = None, on_existing: str | None = None) -> int:
+    """Convert + load one slice of parsed CSV rows. ``handle_base``/``on_existing``
+    make a disttask subtask re-run idempotent (see bulk_load)."""
     t = db.catalog.table(db_name, table_name)
     ncols = len(t.columns)
     cols: list[list] = [[] for _ in range(ncols)]
@@ -50,7 +51,7 @@ def import_rows_slice(db, db_name: str, table_name: str, rows: list[list]) -> in
                 cols[c].append(_convert(field, ft))
     from tidb_tpu.executor.load import bulk_load
 
-    return bulk_load(db, table_name, cols, db_name=db_name)
+    return bulk_load(db, table_name, cols, db_name=db_name, handle_base=handle_base, on_existing=on_existing)
 
 
 # -- disttask integration (ref: disttask/importinto: the IMPORT INTO SQL
@@ -69,9 +70,17 @@ class _ImportExt:
         if n == 0:
             return []
         # metas are self-contained (row ranges over a shared file path):
-        # an executor node in ANOTHER process re-parses its slice
+        # an executor node in ANOTHER process re-parses its slice. The whole
+        # autoid range is reserved HERE, once — each subtask writes a
+        # deterministic handle span, so a lease-expired subtask that re-runs
+        # (possibly racing its not-actually-dead first worker) rewrites the
+        # SAME keys instead of appending duplicates (ref: lightning
+        # checkpoints re-importing a failed engine's deterministic keys)
+        hbase = None if t.pk_is_handle else manager.db.catalog.alloc_autoid(t.id, n)
         return [
-            {"start": i, "end": min(i + _SUBTASK_ROWS, n)} for i in range(0, n, _SUBTASK_ROWS)
+            {"start": i, "end": min(i + _SUBTASK_ROWS, n),
+             "hbase": None if hbase is None else hbase + i}
+            for i in range(0, n, _SUBTASK_ROWS)
         ]
 
     def on_done(self, task, manager):
@@ -88,7 +97,11 @@ class _ImportExec:
         rows = parse_csv_rows(t, m["path"], m.get("skip_header"), m.get("delimiter", ","))
         failpoint.inject("import_subtask_before_ingest", subtask)
         sl = rows[subtask.meta["start"] : subtask.meta["end"]]
-        n = import_rows_slice(db, m["db"], m["table"], sl)
+        n = import_rows_slice(
+            db, m["db"], m["table"], sl,
+            handle_base=subtask.meta.get("hbase"),
+            on_existing="skip" if subtask.meta.get("hbase") is not None else "verify",
+        )
         return {"rows": n}
 
 
