@@ -54,10 +54,19 @@ def _statements(text: str):
 
 
 def _render(v) -> str:
+    import datetime as _dt
+
     if v is None:
         return "NULL"
     if isinstance(v, float):
         return repr(v)
+    if isinstance(v, _dt.timedelta):  # MySQL TIME text: HH:MM:SS[.ffffff]
+        us = round(v.total_seconds() * 1_000_000)
+        sign, us = ("-" if us < 0 else ""), abs(us)
+        sec, frac = divmod(us, 1_000_000)
+        h, rem = divmod(sec, 3600)
+        m, s = divmod(rem, 60)
+        return f"{sign}{h:02d}:{m:02d}:{s:02d}" + (f".{frac:06d}" if frac else "")
     return str(v)
 
 

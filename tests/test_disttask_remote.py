@@ -154,7 +154,7 @@ def test_killed_worker_lease_requeues(csv_path):
             try:
                 # short lease so the dead worker's claim expires quickly;
                 # delay the local workers so the sleepy node claims first
-                mgr = DistTaskManager(db, n_workers=2, lease_ms=1500)
+                mgr = DistTaskManager(db, n_workers=2, lease_ms=3000)
                 db._disttask_mgr = mgr
                 result["rows"] = importer.import_into_disttask(db, "test", "imp2", csv_path)
             except Exception as e:  # pragma: no cover

@@ -62,9 +62,27 @@ def test_uncorrelated_scalar_subquery(db):
     assert rows == [("cat",)]
 
 
-def test_nonequality_correlation_rejected(db):
-    with pytest.raises(Exception, match="correlat"):
-        db.query("SELECT name FROM c WHERE EXISTS (SELECT 1 FROM o WHERE o.amt < c.id)")
+def test_nonequality_correlation(db):
+    # pure non-eq correlation: nested-loop semi join with other_conds
+    # (o.amt values are 100, 50, 70 — none below 3, so only amt < id
+    # can never hold... except none match: min(amt)=50 > 3)
+    rows = db.query("SELECT name FROM c WHERE EXISTS (SELECT 1 FROM o WHERE o.amt < c.id)")
+    assert rows == []
+    rows = db.query(
+        "SELECT name FROM c WHERE EXISTS (SELECT 1 FROM o WHERE o.amt > c.id * 30) ORDER BY name"
+    )
+    # amt>30: ann(30): 100,50,70 → yes; bob(60): 100,70 → yes; cat(90): 100 → yes
+    assert rows == [("ann",), ("bob",), ("cat",)]
+    # mixed: eq correlation + non-eq correlation
+    rows = db.query(
+        "SELECT name FROM c WHERE EXISTS (SELECT 1 FROM o WHERE o.cid = c.id AND o.amt > c.id * 60) ORDER BY name"
+    )
+    # ann: cid=1 amts {100,50} > 60 → yes; cat: cid=3 amt 70 > 180 → no
+    assert rows == [("ann",)]
+    rows = db.query(
+        "SELECT name FROM c WHERE NOT EXISTS (SELECT 1 FROM o WHERE o.cid = c.id AND o.amt > c.id * 60) ORDER BY name"
+    )
+    assert rows == [("bob",), ("cat",)]
 
 
 def test_null_in_correlation_column_does_not_poison(db):

@@ -248,7 +248,10 @@ class Catalog:
                 del dbi.tables[tname]
                 raise
             self._persist()
-            return t
+        if getattr(stmt, "auto_increment_base", None):
+            # AUTO_INCREMENT = n table option seeds the allocator
+            self.rebase_autoid(t.id, int(stmt.auto_increment_base))
+        return t
 
     def _set_ttl(self, t: TableInfo, ttl: tuple, enable: bool) -> None:
         col, days = ttl

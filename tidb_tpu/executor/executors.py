@@ -1458,6 +1458,8 @@ class HashJoinExec(Executor):
         p = self.plan
         if p.kind == "anti" and p.null_aware:
             return self._null_aware_anti(lc, rc)
+        if p.other_conds:
+            return self._semi_anti_other(lc, rc)
         rkeys = [self._key_array(rc, r) for _, r in p.eq_conds]
         rvalid = [rc.columns[r].validity for _, r in p.eq_conds]
         table: set = set()
@@ -1473,6 +1475,48 @@ class HashJoinExec(Executor):
             if (p.kind == "semi") == matched:
                 keep.append(i)
         return Chunk([c.take(np.asarray(keep, dtype=np.int64)) for c in lc.columns])
+
+    def _semi_anti_other(self, lc: Chunk, rc: Chunk) -> Chunk:
+        """Semi/anti with non-equality join conditions (ref: the reference's
+        Apply → semi join with otherConds): expand candidate pairs on the eq
+        keys (all pairs when none — the nested-loop Apply shape), filter the
+        joined rows through other_conds, then EXISTS-reduce per left row."""
+        p = self.plan
+        n_l, n_r = len(lc), len(rc)
+        if p.eq_conds:
+            rkeys = [self._key_array(rc, r) for _, r in p.eq_conds]
+            rvalid = [rc.columns[r].validity for _, r in p.eq_conds]
+            table: dict = {}
+            for j in range(n_r):
+                if all(v[j] for v in rvalid):
+                    table.setdefault(tuple(ka[j] for ka in rkeys), []).append(j)
+            lkeys = [self._key_array(lc, l) for l, _ in p.eq_conds]
+            lvalid = [lc.columns[l].validity for l, _ in p.eq_conds]
+            li_list, ri_list = [], []
+            for i in range(n_l):
+                if all(v[i] for v in lvalid):
+                    for j in table.get(tuple(ka[i] for ka in lkeys), ()):
+                        li_list.append(i)
+                        ri_list.append(j)
+            li = np.asarray(li_list, dtype=np.int64)
+            ri = np.asarray(ri_list, dtype=np.int64)
+        else:  # pure non-eq correlation: nested-loop over all pairs
+            li = np.repeat(np.arange(n_l, dtype=np.int64), n_r)
+            ri = np.tile(np.arange(n_r, dtype=np.int64), n_l)
+        matched = np.zeros(n_l, dtype=bool)
+        if len(li):
+            joined = Chunk([c.take(li) for c in lc.columns] + [c.take(ri) for c in rc.columns])
+            from tidb_tpu.expression.expr import EvalBatch, eval_to_column, expr_from_pb
+
+            batch = EvalBatch.from_chunk(joined)
+            keep = np.ones(len(joined), dtype=bool)
+            for c in p.other_conds:
+                col = eval_to_column(expr_from_pb(c.to_pb()), batch, np)
+                keep &= (col.data != 0) & col.validity
+            matched[li[keep]] = True
+        want = matched if p.kind == "semi" else ~matched
+        sel = np.nonzero(want)[0]
+        return Chunk([c.take(sel) for c in lc.columns])
 
     def _null_aware_anti(self, lc: Chunk, rc: Chunk) -> Chunk:
         """NOT IN semantics per correlation group (ref: null-aware anti join,

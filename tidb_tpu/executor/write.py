@@ -508,6 +508,7 @@ def execute_insert(session, stmt: ast.Insert) -> int:
             rows_values.append(vals)
 
     affected = 0
+    first_auto_id = None  # first generated AUTO_INCREMENT id this statement
     alias = stmt.table.alias or stmt.table.name
     if stmt.on_dup_update:
         on_dup = ("update", stmt.on_dup_update, db, alias)
@@ -528,6 +529,8 @@ def execute_insert(session, stmt: ast.Insert) -> int:
                 if c.auto_increment:
                     nid = session.catalog.alloc_autoid(t.id)
                     full[c.offset] = nid
+                    if first_auto_id is None:
+                        first_auto_id = int(nid)
                 elif c.default is not None and c.default != "CURRENT_TIMESTAMP":
                     full[c.offset] = to_physical(c.default, c.ftype)
                 elif c.default == "CURRENT_TIMESTAMP":
@@ -541,6 +544,8 @@ def execute_insert(session, stmt: ast.Insert) -> int:
             if pkv is None and cols[t.pk_offset].auto_increment:
                 pkv = session.catalog.alloc_autoid(t.id)
                 full[t.pk_offset] = pkv
+                if first_auto_id is None:
+                    first_auto_id = int(pkv)
             if pkv is None:
                 raise WriteError("primary key cannot be NULL")
             handle = int(pkv)
@@ -553,6 +558,12 @@ def execute_insert(session, stmt: ast.Insert) -> int:
         # partition before the write)
         wt = t.partition_view(t.partition_id_for(full)) if t.partition is not None else t
         affected += _write_row(session, wt, full, handle, on_dup)
+    # OK-packet id is statement-local (0 when nothing was generated);
+    # LAST_INSERT_ID() stays sticky across non-generating statements
+    # (ref: session vars LastInsertID vs mysql_insert_id())
+    session._stmt_insert_id = first_auto_id or 0
+    if first_auto_id is not None:
+        session.last_insert_id = first_auto_id
     return affected
 
 

@@ -382,7 +382,7 @@ def _not(xp, args, ctx):
 @register("xor", infer_bool)
 def _xor(xp, args, ctx):
     (da, va), (db, vb) = args
-    res = (da != 0) ^ (db != 0)
+    res = xp.asarray((da != 0) ^ (db != 0))  # scalar const ^ const is a bool
     return res.astype("int64"), and_valid(xp, va, vb)
 
 
@@ -631,6 +631,20 @@ def _log10(xp, args, ctx):
 def _sign(xp, args, ctx):
     (d, v) = args[0]
     return xp.sign(d).astype("int64"), v
+
+
+@register("bit_count", lambda args: bigint_type(), arity=1)
+def _bit_count(xp, args, ctx):
+    (d, v) = args[0]
+    # popcount over the two's-complement uint64 view (MySQL BIT_COUNT(-1)=64)
+    if xp.__name__.startswith("jax"):
+        import jax.lax as _lax
+
+        return _lax.population_count(xp.asarray(d, dtype=xp.uint64)).astype(xp.int64), v
+    import numpy as np
+
+    arr = np.asarray(d, dtype=np.int64).view(np.uint64)
+    return np.array([int(y).bit_count() for y in np.atleast_1d(arr)], dtype=np.int64), v
 
 
 # ---------------------------------------------------------------------------

@@ -364,9 +364,13 @@ def window_program(jax, jnp, *, mask, part_lanes, order_lanes, order_descs,
         elif name == "dense_rank":
             outs.append((dense, sm))
         elif name == "percent_rank":
-            outs.append((jnp.where(m > 1, (rank - 1) / jnp.maximum(m - 1, 1), 0.0), sm))
+            # divide in f64: int32 lanes would promote to f32 and put
+            # 7-digit artifacts on the wire (MySQL computes in double)
+            pr = (rank - 1).astype(jnp.float64) / jnp.maximum(m - 1, 1).astype(jnp.float64)
+            outs.append((jnp.where(m > 1, pr, 0.0), sm))
         elif name == "cume_dist":
-            outs.append(((peer_end - ps) / jnp.maximum(m, 1), sm))
+            cd = (peer_end - ps).astype(jnp.float64) / jnp.maximum(m, 1).astype(jnp.float64)
+            outs.append((cd, sm))
         elif name == "ntile":
             k = c0_
             q, rem = m // k, m % k

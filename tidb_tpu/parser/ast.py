@@ -144,6 +144,17 @@ class SubqueryExpr(Node):
     modifier: str = ""
 
 
+@dataclass
+class QuantifiedCmp(Node):
+    """`left OP ANY|ALL (subquery)` — lowered by the planner per context
+    (WHERE: EXISTS rewrite; value: NULL-correct extreme comparison)."""
+
+    op: str  # eq/ne/lt/le/gt/ge
+    left: Node
+    select: "Select"
+    is_all: bool = False
+
+
 # -- type definitions (DDL) -------------------------------------------------
 
 
@@ -336,6 +347,7 @@ class CreateTable(Node):
     partition_by: Optional[PartitionByDef] = None
     ttl: Optional[tuple[str, int]] = None  # (column, days)
     ttl_enable: bool = True
+    auto_increment_base: Optional[int] = None  # AUTO_INCREMENT = n option
 
 
 @dataclass
@@ -533,6 +545,9 @@ class UserSpec(Node):
     host: str = "%"
     password: str = ""
     plugin: str = "mysql_native_password"
+    # IDENTIFIED clause present? (ALTER USER without one must not touch
+    # the stored credential)
+    has_auth: bool = False
 
 
 @dataclass
@@ -543,6 +558,14 @@ class CreateUser(Node):
 
 @dataclass
 class DropUser(Node):
+    users: list[UserSpec] = field(default_factory=list)
+    if_exists: bool = False
+
+
+@dataclass
+class AlterUser(Node):
+    """ALTER USER ... IDENTIFIED BY (ref: ast.AlterUserStmt)."""
+
     users: list[UserSpec] = field(default_factory=list)
     if_exists: bool = False
 

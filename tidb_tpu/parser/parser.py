@@ -446,11 +446,26 @@ class Parser:
             t = self.peek()
             if t.kind == "op" and t.value in self._CMP:
                 self.next()
+                if self.at_kw("ANY", "SOME", "ALL"):
+                    left = self._quantified_cmp(self._CMP[t.value], left)
+                    continue
                 left = ast.BinaryOp(self._CMP[t.value], left, self._bitor())
                 continue
             if self.at_kw("IS"):
                 self.next()
                 neg = self.eat_kw("NOT")
+                if self.at_kw("TRUE", "FALSE", "UNKNOWN"):
+                    kind = self.next().value.upper()
+                    # IS TRUE ⇔ IFNULL(x,0) <> 0; IS FALSE ⇔ IFNULL(x,1) = 0;
+                    # IS UNKNOWN ⇔ IS NULL (ref: builtin_op.go isTrue/isFalse)
+                    if kind == "UNKNOWN":
+                        e: ast.Node = ast.IsNull(left)
+                    elif kind == "TRUE":
+                        e = ast.BinaryOp("ne", ast.FuncCall("ifnull", [left, ast.Literal(0)]), ast.Literal(0))
+                    else:
+                        e = ast.BinaryOp("eq", ast.FuncCall("ifnull", [left, ast.Literal(1)]), ast.Literal(0))
+                    left = ast.UnaryOp("not", e) if neg else e
+                    continue
                 self.expect_kw("NULL")
                 left = ast.IsNull(left, negated=neg)
                 continue
@@ -491,6 +506,19 @@ class Parser:
             if neg:
                 self.i = save
             return left
+
+    def _quantified_cmp(self, op: str, left: ast.Node) -> ast.Node:
+        """`expr OP ANY|SOME|ALL (subquery)` → QuantifiedCmp, lowered by the
+        planner per context (ref: expression_rewriter.go quantified
+        comparison handling)."""
+        is_all = self.at_kw("ALL")
+        self.next()
+        self.expect_op("(")
+        sel = self.parse_select_stmt()
+        self.expect_op(")")
+        if len(sel.items) != 1 or isinstance(sel.items[0].expr, ast.Wildcard):
+            raise ParseError("quantified subquery must select exactly one column", self.peek())
+        return ast.QuantifiedCmp(op, left, sel, is_all)
 
     def _bitor(self) -> ast.Node:
         left = self._bitand()
@@ -1102,6 +1130,12 @@ class Parser:
                 self.expect_op("=")
                 ct.ttl_enable = self._string_lit().upper() == "ON"
                 continue
+            if self.at_kw("AUTO_INCREMENT"):
+                self.next()
+                self.expect_op("=")
+                t = self.next()
+                ct.auto_increment_base = int(t.value)
+                continue
             self.next()
             if self.eat_op("="):
                 self.next()
@@ -1193,6 +1227,12 @@ class Parser:
         self.expect_kw("ALTER")
         if self.at_kw("RESOURCE"):
             return self._resource_group("alter")
+        if self.eat_kw("USER"):
+            ie = self._if_exists()
+            users = [self._user_spec()]
+            while self.eat_op(","):
+                users.append(self._user_spec())
+            return ast.AlterUser(users, ie)
         self.expect_kw("TABLE")
         tbl = self._table_ref_simple()
         at = ast.AlterTable(tbl)
@@ -1480,6 +1520,8 @@ class Parser:
             else:
                 host = self.ident()
         spec = ast.UserSpec(name, host)
+        if self.at_kw("IDENTIFIED"):
+            spec.has_auth = True
         if self.eat_kw("IDENTIFIED"):
             if self.eat_kw("WITH"):
                 t = self.peek()

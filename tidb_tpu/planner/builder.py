@@ -227,11 +227,13 @@ class Builder:
 
     def build_select(self, sel: ast.Select) -> LogicalPlan:
         prev_hints = self.hints
+        prev_sub_map = getattr(self, "_scalar_sub_map", None)
         self.hints = getattr(sel, "hints", []) or prev_hints
         try:
             return self._build_select(sel)
         finally:
             self.hints = prev_hints
+            self._scalar_sub_map = prev_sub_map
 
     def _build_select(self, sel: ast.Select) -> LogicalPlan:
         if sel.from_ is None:
@@ -244,6 +246,10 @@ class Builder:
             scalar_conds: list[Expression] = []
             pre_width = len(plan.schema)  # semi/anti joins keep the schema
             for cj in _split_ast_conj(sel.where):
+                if isinstance(cj, ast.QuantifiedCmp):
+                    cj = _quantified_to_exists(cj)
+                elif isinstance(cj, ast.UnaryOp) and cj.op == "not" and isinstance(cj.operand, ast.QuantifiedCmp):
+                    cj = ast.UnaryOp("not", _quantified_to_exists(cj.operand))
                 joined = self._try_subquery_join(plan, cj)
                 if joined is not None:
                     plan = joined
@@ -271,6 +277,23 @@ class Builder:
                 tp.schema = plan.schema[:pre_width]
                 plan = tp
 
+        # correlated scalar subqueries in the SELECT list (ref: scalar Apply
+        # decorrelation in projections, rule_decorrelate.go): each expands to
+        # a LEFT JOIN against the per-key inner aggregate; the item resolves
+        # to the joined agg column via _scalar_sub_map
+        pre_sub_width = len(plan.schema)
+        sub_map_saved = getattr(self, "_scalar_sub_map", None)
+        self._scalar_sub_map = dict(sub_map_saved or {})
+        for it in sel.items:
+            if isinstance(it.expr, ast.Wildcard):
+                continue
+            for sub in _scalar_subquery_nodes(it.expr):
+                if isinstance(sub.select, ast.Select) and self._is_correlated(sub.select, plan.schema):
+                    got = self._scalar_corr_expand(plan, sub)
+                    if got is not None:
+                        plan, e = got
+                        self._scalar_sub_map[id(sub)] = e
+
         # aggregation detection
         has_agg = bool(sel.group_by) or any(
             _contains_agg(it.expr) for it in sel.items
@@ -284,8 +307,8 @@ class Builder:
                 _collect_windows(it.expr, win_calls)
         for oi in sel.order_by:
             _collect_windows(oi.expr, win_calls)
-        # SELECT * must expand to the pre-window schema only
-        wild_n = len(plan.schema)
+        # SELECT * must expand to the pre-window, pre-scalar-join schema only
+        wild_n = pre_sub_width
         if win_calls:
             if has_agg:
                 raise PlanError(
@@ -533,10 +556,17 @@ class Builder:
         inner_schema = inner_from.schema
         corr: list[tuple[ast.Node, ast.Node]] = []  # (outer side, inner side)
         keep: list[ast.Node] = []
+        corr_other: list[ast.Node] = []  # correlated NON-equality conjuncts
         for c in _split_ast_conj(inner.where) if inner.where is not None else []:
             pair = self._corr_eq_pair(c, inner_schema, plan.schema, probe)
             if pair is not None:
                 corr.append(pair)
+            elif self._conj_is_mixed(c, inner_schema, plan.schema, probe):
+                # e.g. `x.v > outer.v`: becomes a join other-condition over
+                # the joined row (ref: Apply/semi-join otherConds in the
+                # reference's decorrelation; rule_decorrelate.go keeps
+                # non-eq correlated filters on the join)
+                corr_other.append(c)
             else:
                 keep.append(c)
         inner_has_agg = bool(inner.group_by) or any(
@@ -559,10 +589,20 @@ class Builder:
                     return plan
                 return LogicalSelection(conditions=[Constant(0, bool_type())], children=[plan])
             raise PlanError("unsupported correlated subquery with aggregation")
-        if not corr and operand_ast is None:
+        if not corr and operand_ast is None and not corr_other:
             raise PlanError("unsupported correlated subquery (no equality correlation)")
+        if corr_other and negated and null_aware:
+            raise PlanError("NOT IN with non-equality correlation is not supported")
         inner.where = _and_join_ast(keep)
         base_items = len(inner.items)
+        # inner-side columns the non-eq conjuncts reference must be projected
+        # (before the corr items, which stay the LAST n_extra of the schema)
+        for c in corr_other:
+            for col_node in _column_nodes(c):
+                if _resolves(probe, col_node, inner_schema) and not any(
+                    _ast_eq(col_node, it.expr) for it in inner.items[base_items:]
+                ):
+                    inner.items.append(ast.SelectItem(col_node))
         for _, inner_side in corr:
             inner.items.append(ast.SelectItem(inner_side))
         try:
@@ -588,9 +628,17 @@ class Builder:
             if not isinstance(oe, ColumnRef):
                 raise PlanError("correlated comparison must reference a plain outer column")
             eq_conds.append((oe.index, first_extra + i))
+        other_exprs = []
+        if corr_other:
+            # resolve over the JOINED layout [outer cols ++ inner cols] —
+            # table aliases disambiguate same-named columns across sides
+            joined_schema = list(plan.schema) + list(inner_plan.schema)
+            for c in corr_other:
+                other_exprs.append(self.resolve(c, BuildCtx(joined_schema)))
         return LogicalJoin(
             kind="anti" if negated else "semi",
             eq_conds=eq_conds,
+            other_conds=other_exprs,
             null_aware=null_aware,
             schema=[OutCol(c.name, c.ftype, c.table, c.slot) for c in plan.schema],
             children=[plan, inner_plan],
@@ -613,10 +661,24 @@ class Builder:
                 break
         else:
             return None
+        if not (isinstance(sub.select, ast.Select) and self._is_correlated(sub.select, plan.schema)):
+            return None
+        got = self._scalar_corr_expand(plan, sub)
+        if got is None:
+            return None
+        join, sub_ref = got
+        other_e = self.resolve(other_ast, BuildCtx(join.schema))
+        a, b = (sub_ref, other_e) if flip else (other_e, sub_ref)
+        return join, func(cj.op, a, b)
+
+    def _scalar_corr_expand(self, plan: LogicalPlan, sub: ast.SubqueryExpr):
+        """Expand one correlated scalar-aggregate subquery into a LEFT JOIN
+        of ``plan`` against the per-correlation-key inner aggregate.
+        → (join_plan, Expression for the scalar value) or None when the node
+        isn't an expandable scalar subquery. Shared by the WHERE-comparison
+        and SELECT-item paths."""
         inner = sub.select
         if not isinstance(inner, ast.Select) or len(inner.items) != 1:
-            return None
-        if not self._is_correlated(inner, plan.schema):
             return None
         if inner.group_by or inner.limit is not None or inner.order_by or inner.having is not None:
             raise PlanError("correlated scalar subquery with GROUP BY/ORDER BY/LIMIT is not supported")
@@ -673,9 +735,7 @@ class Builder:
         if isinstance(item.expr, ast.FuncCall) and _FN_ALIAS.get(item.expr.name, item.expr.name) == "count":
             # COUNT over no rows is 0, not NULL
             sub_ref = func("ifnull", sub_ref, Constant(0, agg_ft))
-        other_e = self.resolve(other_ast, BuildCtx(join.schema))
-        a, b = (sub_ref, other_e) if flip else (other_e, sub_ref)
-        return join, func(cj.op, a, b)
+        return join, sub_ref
 
     def _is_correlated(self, inner: ast.Select, outer_schema) -> bool:
         """True when the subquery fails to resolve alone but its unknown
@@ -691,6 +751,19 @@ class Builder:
             if _unknown_col_in_schema(str(err), outer_schema):
                 return True
             raise
+
+    def _conj_is_mixed(self, c: ast.Node, inner_schema, outer_schema, probe: "Builder") -> bool:
+        """True when ``c`` references BOTH scopes (a correlated non-eq
+        conjunct) — every column resolves somewhere, at least one per side."""
+        saw_inner = saw_outer = False
+        for node in _column_nodes(c):
+            if _resolves(probe, node, inner_schema):
+                saw_inner = True
+            elif _resolves(probe, node, outer_schema):
+                saw_outer = True
+            else:
+                return False  # a genuinely unknown column: not ours to claim
+        return saw_inner and saw_outer
 
     def _corr_eq_pair(self, c: ast.Node, inner_schema, outer_schema, probe: "Builder"):
         """(outer_ast, inner_ast) when ``c`` is `inner_col = outer_col` (either
@@ -1009,7 +1082,12 @@ class Builder:
             return func("case_when", *args)
         if isinstance(node, ast.Cast):
             return _cast_expr(self._resolve(node.operand, ctx), node.target)
+        if isinstance(node, ast.QuantifiedCmp):
+            return self._resolve_quantified(node, ctx)
         if isinstance(node, ast.SubqueryExpr):
+            m = getattr(self, "_scalar_sub_map", None)
+            if m and id(node) in m:
+                return m[id(node)]  # pre-expanded correlated scalar join col
             if node.modifier == "exists":
                 vals = self._run_subquery(node.select, limit=1)
                 return Constant(1 if vals else 0, bool_type())
@@ -1018,6 +1096,49 @@ class Builder:
                 raise PlanError("scalar subquery returned more than one row")
             return _const_like(vals[0][0]) if vals else Constant(None, FieldType(TypeKind.NULLTYPE))
         raise PlanError(f"unsupported expression {type(node).__name__}")
+
+    def _resolve_quantified(self, node: "ast.QuantifiedCmp", ctx: BuildCtx) -> Expression:
+        """Value-context `left OP ANY|ALL (S)` with full three-valued-logic
+        semantics: S runs eagerly (uncorrelated) and the result folds to a
+        comparison against the relevant extreme, OR/AND-ed with NULL when S
+        contains NULLs — so SELECT-list uses return NULL exactly where MySQL
+        does (ref: expression_rewriter.go buildQuantifierPlan min/max form)."""
+        # eq ANY ≡ IN, ne ALL ≡ NOT IN — exact, reuse those paths
+        if node.op == "eq" and not node.is_all:
+            return self._resolve(ast.InList(node.left, [ast.SubqueryExpr(node.select, "in")]), ctx)
+        if node.op == "ne" and node.is_all:
+            return self._resolve(
+                ast.InList(node.left, [ast.SubqueryExpr(node.select, "in")], negated=True), ctx
+            )
+        vals = self._run_subquery(node.select, expect_cols=1)
+        left = self._resolve(node.left, ctx)
+        xs = [v[0] for v in vals]
+        has_null = any(x is None for x in xs)
+        nn = sorted({x for x in xs if x is not None})
+        null_c = Constant(None, FieldType(TypeKind.NULLTYPE))
+        if not nn:
+            if not vals:  # empty set: ALL vacuously TRUE, ANY FALSE
+                return Constant(1 if node.is_all else 0, bool_type())
+            return null_c  # only NULLs: every comparison is NULL
+        if node.op in ("lt", "le", "gt", "ge"):
+            if node.is_all:
+                ext = nn[0] if node.op in ("lt", "le") else nn[-1]
+            else:
+                ext = nn[-1] if node.op in ("lt", "le") else nn[0]
+            base = self._binary(node.op, left, _const_like(ext))
+            if has_null:
+                return func("and" if node.is_all else "or", base, null_c)
+            return base
+        if node.op == "eq":  # eq ALL: all values must equal left
+            base = self._binary("eq", left, _const_like(nn[0]))
+            if len(nn) > 1:  # two distinct values: FALSE for any non-NULL left
+                base = func("and", base, self._binary("eq", left, _const_like(nn[1])))
+            return func("and", base, null_c) if has_null else base
+        # ne ANY: some value differs from left
+        base = self._binary("ne", left, _const_like(nn[0]))
+        if len(nn) > 1:
+            base = func("or", base, self._binary("ne", left, _const_like(nn[1])))
+        return func("or", base, null_c) if has_null else base
 
     def _date_interval(self, base, n, unit: str, negate: bool):
         """date ± INTERVAL n unit → the date_add_* builtins (ref: MySQL
@@ -1125,6 +1246,10 @@ class Builder:
             return Constant(us, FieldType(TypeKind.DURATION, nullable=False))
         if name == "pi" and not node.args:
             return Constant(3.141592653589793, FieldType(TypeKind.FLOAT, nullable=False))
+        if name == "last_insert_id" and not node.args:
+            self.uncacheable = True  # session-scope dynamic, like @@warning_count
+            v = (self.dyn_sys_vars or {}).get("last_insert_id", 0)
+            return Constant(int(v), bigint_type(nullable=False))
         if name == "any_value" and len(node.args) == 1:
             # MySQL: suppresses ONLY_FULL_GROUP_BY checking; value passthrough
             return self._resolve(node.args[0], ctx)
@@ -1187,10 +1312,19 @@ class Builder:
         return func(op, left, right)
 
     def _coerce_cmp(self, a: Expression, b: Expression):
-        """Temporal-vs-string-constant coercion (MySQL implicit casts)."""
+        """Implicit comparison casts (MySQL type-conversion rules):
+        temporal vs string constant parses the literal; numeric vs string
+        compares as floating point (both sides to DOUBLE)."""
         for x, y in ((a, b), (b, a)):
             if x.ftype.is_temporal and isinstance(y, Constant) and y.ftype.kind == TypeKind.STRING:
                 conv = self._coerce_to(x.ftype, y)
+                if x is a:
+                    return a, conv
+                return conv, b
+        numeric = {TypeKind.INT, TypeKind.UINT, TypeKind.FLOAT, TypeKind.DECIMAL}
+        for x, y in ((a, b), (b, a)):
+            if x.ftype.kind in numeric and y.ftype.kind == TypeKind.STRING and not x.ftype.is_temporal:
+                conv = func("cast_float", y)
                 if x is a:
                     return a, conv
                 return conv, b
@@ -1297,6 +1431,18 @@ class Builder:
                         return ColumnRef(i, existing.ftype, f"agg#{i}")
                 aggs.append(desc)
                 return ColumnRef(len(aggs) - 1, desc.ftype, f"agg#{len(aggs) - 1}")
+            if isinstance(n, ast.SubqueryExpr):
+                m = getattr(self, "_scalar_sub_map", None)
+                if m and id(n) in m:
+                    # pre-expanded correlated scalar: functionally dependent
+                    # on its correlation keys — implicit first_row per group
+                    desc = AggDesc("first_row", m[id(n)])
+                    for i, existing in enumerate(aggs):
+                        if repr(existing) == repr(desc):
+                            return ColumnRef(i, existing.ftype, f"agg#{i}")
+                    aggs.append(desc)
+                    return ColumnRef(len(aggs) - 1, desc.ftype, f"agg#{len(aggs) - 1}")
+                return n
             if isinstance(n, (ast.Literal, Expression)):
                 return n
             if isinstance(n, ast.CaseWhen):
@@ -1655,6 +1801,76 @@ def _unknown_col_in_schema(err_msg: str, schema) -> bool:
     return any(
         oc.name.lower() == col and (not tbl or oc.table.lower() == tbl) for oc in schema
     )
+
+
+def _quantified_to_exists(q: "ast.QuantifiedCmp") -> ast.Node:
+    """WHERE-context lowering of `left OP ANY|ALL (S)` (ref:
+    expression_rewriter.go):
+
+    - OP ANY (S)  ⇔  EXISTS (SELECT 1 FROM (S) q WHERE left OP q.v)
+    - OP ALL (S)  ⇔  NOT EXISTS (SELECT 1 FROM (S) q WHERE
+                       NOT(left OP q.v) OR (left OP q.v) IS NULL)
+
+    Exact in WHERE context: ANY is TRUE iff some comparison is TRUE; ALL is
+    not-TRUE iff some comparison is FALSE or NULL (vacuously TRUE on empty).
+    Value contexts need the NULL-distinguishing form instead (_resolve)."""
+    import copy as _copy
+
+    sel = _copy.deepcopy(q.select)
+    sel.items[0].alias = "__qv"
+    src = ast.SubquerySource(sel, alias="__qsub")
+    cmp = ast.BinaryOp(q.op, q.left, ast.ColumnName("__qv", table="__qsub"))
+    if q.is_all:
+        cond: ast.Node = ast.BinaryOp("or", ast.UnaryOp("not", cmp), ast.IsNull(cmp))
+        inner = ast.Select([ast.SelectItem(ast.Literal(1))], from_=src, where=cond)
+        return ast.UnaryOp("not", ast.SubqueryExpr(inner, "exists"))
+    inner = ast.Select([ast.SelectItem(ast.Literal(1))], from_=src, where=cmp)
+    return ast.SubqueryExpr(inner, "exists")
+
+
+def _scalar_subquery_nodes(node) -> list:
+    """All bare scalar SubqueryExpr nodes (modifier '') in an expression,
+    excluding those nested inside deeper selects (their own build handles
+    them)."""
+    out = []
+    if isinstance(node, ast.SubqueryExpr):
+        if node.modifier == "":
+            out.append(node)
+        return out  # don't descend into the subquery body
+    if isinstance(node, ast.Select):
+        return out
+    if isinstance(node, (list, tuple)):
+        for x in node:
+            out.extend(_scalar_subquery_nodes(x))
+        return out
+    if hasattr(node, "__dataclass_fields__"):
+        for f in node.__dataclass_fields__:
+            out.extend(_scalar_subquery_nodes(getattr(node, f)))
+    return out
+
+
+def _column_nodes(node) -> list:
+    """All ast.ColumnName nodes inside an expression tree (dataclass walk)."""
+    out = []
+    if isinstance(node, ast.ColumnName):
+        out.append(node)
+        return out
+    if isinstance(node, (list, tuple)):
+        for x in node:
+            out.extend(_column_nodes(x))
+        return out
+    if hasattr(node, "__dataclass_fields__"):
+        for f in node.__dataclass_fields__:
+            out.extend(_column_nodes(getattr(node, f)))
+    return out
+
+
+def _resolves(probe: "Builder", node, schema) -> bool:
+    try:
+        probe.resolve(node, BuildCtx(schema))
+        return True
+    except PlanError:
+        return False
 
 
 def _split_ast_conj(node: ast.Node) -> list:

@@ -200,15 +200,26 @@ def _prune(plan: LogicalPlan, needed: Optional[set[int]]):
         return plan, {i: i for i in range(len(plan.schema))}
     if isinstance(plan, LogicalJoin) and plan.kind in ("semi", "anti"):
         # output schema is the LEFT side only; right contributes join keys
-        ln = set(needed) if needed is not None else set(range(len(plan.children[0].schema)))
+        # (and any columns the non-eq other_conds evaluate over)
+        nleft = len(plan.children[0].schema)
+        ln = set(needed) if needed is not None else set(range(nleft))
         rn: set[int] = set()
         for l, r in plan.eq_conds:
             ln.add(l)
             rn.add(r)
+        for c in plan.other_conds:
+            s: set[int] = set()
+            _expr_cols(c, s)
+            for i in s:
+                (ln if i < nleft else rn).add(i if i < nleft else i - nleft)
         lchild, lmap = _prune(plan.children[0], ln)
         rchild, rmap = _prune(plan.children[1], rn)
         plan.children = [lchild, rchild]
         plan.eq_conds = [(lmap[l], rmap[r]) for l, r in plan.eq_conds]
+        full_map = dict(lmap)
+        for old, new in rmap.items():
+            full_map[old + nleft] = new + len(lchild.schema)
+        plan.other_conds = [_remap_expr(c, full_map) for c in plan.other_conds]
         plan.schema = [plan.schema[i] for i in sorted(lmap)]
         return plan, {old: new for new, old in enumerate(sorted(lmap))}
     if isinstance(plan, LogicalJoin):

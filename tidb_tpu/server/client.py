@@ -27,7 +27,7 @@ class Client:
         tls: bool = False,
         auth_plugin: str = "mysql_native_password",
     ):
-        self.sock = socket.create_connection((host, port), timeout=30)
+        self.sock = socket.create_connection((host, port), timeout=120)  # first-compile on a loaded box can be slow
         self.io = p.PacketIO(self.sock)
         self.tls = False
         self._handshake(user, password, db, tls, auth_plugin)

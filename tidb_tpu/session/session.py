@@ -169,6 +169,9 @@ class Session:
         self._read_ts_override: Optional[int] = None
         # table_id → row mods staged by the open txn (flushed at commit)
         self._pending_mods: dict[int, int] = {}
+        # first AUTO_INCREMENT value generated by the last INSERT
+        # (ref: LastInsertID in the session vars / OK packet)
+        self.last_insert_id = 0
         # EXPLAIN ANALYZE per-operator stats (ref: util/execdetails)
         self.runtime_stats = None
         # TRACE statement span collector (None = tracing off)
@@ -391,6 +394,8 @@ class Session:
             self.require_priv(stmt.table.db or self.current_db, stmt.table.name, priv)
             t = self.catalog.table(stmt.table.db or self.current_db, stmt.table.name)
             res = self._dml(lambda: fn(self, stmt))
+            if isinstance(stmt, ast.Insert):
+                res.last_insert_id = getattr(self, "_stmt_insert_id", 0)
             # stats modify counter feeds auto-analyze (ref: stats delta dump)
             self.note_table_mods(t.id, res.affected)
             return res
@@ -550,6 +555,8 @@ class Session:
             return self._create_user(stmt)
         if isinstance(stmt, ast.DropUser):
             return self._drop_user(stmt)
+        if isinstance(stmt, ast.AlterUser):
+            return self._alter_user(stmt)
         if isinstance(stmt, ast.Grant):
             return self._grant(stmt)
         if isinstance(stmt, ast.Kill):
@@ -656,6 +663,31 @@ class Session:
             s.execute(
                 f"INSERT INTO mysql.user VALUES ('{u.host}', '{u.name}', "
                 f"'{encode_password_with(u.password, u.plugin)}', '{u.plugin}', {ns})"
+            )
+        self._db.priv_version += 1
+        return Result()
+
+    def _alter_user(self, stmt) -> Result:
+        from tidb_tpu.privilege import encode_password_with
+
+        self.require_priv("mysql", "user", "update")
+        self._db.ensure_priv_bootstrap()
+        s = self._internal_root()
+        for u in stmt.users:
+            if not s.query(
+                f"SELECT 1 FROM mysql.user WHERE User = '{u.name}' AND Host = '{u.host}'"
+            ):
+                if stmt.if_exists:
+                    continue
+                raise SessionError(f"Operation ALTER USER failed for '{u.name}'@'{u.host}'")
+            if not u.has_auth:
+                continue  # no IDENTIFIED clause: leave the credential alone
+            if u.plugin not in ("mysql_native_password", "caching_sha2_password"):
+                raise SessionError(f"unknown auth plugin {u.plugin!r}")
+            s.execute(
+                f"UPDATE mysql.user SET authentication_string = "
+                f"'{encode_password_with(u.password, u.plugin)}', plugin = '{u.plugin}' "
+                f"WHERE User = '{u.name}' AND Host = '{u.host}'"
             )
         self._db.priv_version += 1
         return Result()
@@ -1005,6 +1037,7 @@ class Session:
             dyn_sys_vars={
                 "warning_count": len(self._prev_warnings),
                 "error_count": sum(1 for w in self._prev_warnings if w[0] == "Error"),
+                "last_insert_id": self.last_insert_id,
             },
             warn=self.append_warning,
         )
