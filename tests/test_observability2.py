@@ -1,0 +1,108 @@
+"""Top-SQL, continuous profiling, and the plan replayer (ref: util/topsql,
+util/cpuprofile, domain/plan_replayer.go)."""
+
+import json
+import time
+import urllib.request
+
+import tidb_tpu
+
+
+def test_topsql_attributes_cpu_to_digests():
+    from tidb_tpu.utils.topsql import collector
+
+    db = tidb_tpu.open()
+    s = db.session()
+    s.execute("SET tidb_enable_top_sql = 1")  # off by default, like the reference
+    s.execute("CREATE TABLE hot (a BIGINT, b BIGINT)")
+    s.execute(
+        "INSERT INTO hot VALUES " + ", ".join(f"({i}, {i * 7})" for i in range(2000))
+    )
+    c = collector()
+    c.interval_s = 0.002  # sample fast so a short test still lands hits
+    deadline = time.time() + 20
+    rows = []
+    while time.time() < deadline:
+        for _ in range(3):
+            s.execute("SELECT SUM(a * b), COUNT(*) FROM hot WHERE a % 3 = 1")
+        rows = c.top_sql(last_s=30)
+        if rows:
+            break
+    assert rows, "sampler never attributed a sample"
+    assert any("hot" in r[2] for r in rows), rows
+    # the digest groups repeated executions: sample text is the query
+    top = max(rows, key=lambda r: r[4])
+    assert top[3] > 0  # cpu seconds
+    # memtable surface
+    mrows = s.execute("SELECT SQL_DIGEST, SAMPLES FROM information_schema.tidb_top_sql").rows
+    assert mrows
+    # collapsed stacks exist for the profile endpoint
+    assert c.profile(last_s=30)
+    # nested internal statements (CREATE USER runs internal queries) must
+    # not strip the outer attribution: the attach stack restores it
+    from tidb_tpu.utils import topsql as _ts
+    c.attach("outer-digest", "", "outer sql")
+    s.execute("CREATE USER 'tsu'@'%' IDENTIFIED BY 'x'")
+    import threading
+    assert c._attached.get(threading.get_ident()), "outer attachment lost"
+    c.detach()
+    assert not c._attached.get(threading.get_ident())
+
+
+def test_topsql_status_endpoints():
+    from tidb_tpu.server.status import StatusServer
+    from tidb_tpu.utils.topsql import collector
+
+    db = tidb_tpu.open()
+    s = db.session()
+    s.execute("SET tidb_enable_top_sql = 1")
+    s.execute("CREATE TABLE t1 (a BIGINT)")
+    c = collector()
+    c.interval_s = 0.002
+    deadline = time.time() + 20
+    while time.time() < deadline and not c.top_sql(last_s=30):
+        for i in range(200):
+            s.execute("SELECT COUNT(*) FROM t1 WHERE a > 1")
+    srv = StatusServer(db)
+    port = srv.start()
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/topsql", timeout=10) as r:
+            data = json.loads(r.read())
+        assert isinstance(data, list) and data, data
+        assert {"sql_digest", "cpu_time_sec", "samples"} <= set(data[0])
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/debug/pprof/profile", timeout=10) as r:
+            text = r.read().decode()
+        assert text and " " in text.splitlines()[0]  # "stack count" lines
+    finally:
+        srv.close()
+
+
+def test_plan_replayer_roundtrip(tmp_path):
+    db = tidb_tpu.open()
+    s = db.session()
+    s.execute("CREATE TABLE f (k BIGINT, v BIGINT)")
+    s.execute("CREATE TABLE d (k BIGINT PRIMARY KEY, g BIGINT)")
+    s.execute("INSERT INTO d VALUES (0, 10), (1, 11), (2, 12)")
+    s.execute("INSERT INTO f VALUES (0, 1), (1, 2), (1, 3), (2, 4), (0, 5)")
+    s.execute("ANALYZE TABLE f")
+    s.execute("ANALYZE TABLE d")
+    q = "SELECT g, SUM(v) FROM f, d WHERE f.k = d.k GROUP BY g"
+    plan_src = "\n".join(r[0] for r in s.execute("EXPLAIN " + q).rows)
+    from tidb_tpu.tools import replayer
+
+    path = replayer.dump(s, q, out_dir=str(tmp_path))
+    # the SQL surface returns the dump token too
+    tok = s.execute(f"PLAN REPLAYER DUMP EXPLAIN {q}").rows[0][0]
+    assert tok.endswith(".zip")
+
+    # fresh database: load schema + stats, the plan reproduces WITHOUT analyze
+    db2 = tidb_tpu.open()
+    s2 = db2.session()
+    loaded_sql = s2.execute(f"PLAN REPLAYER LOAD '{path}'").rows[0][0]
+    assert loaded_sql == q
+    assert s2.execute("SHOW CREATE TABLE f").rows  # schema arrived
+    plan_dst = "\n".join(r[0] for r in s2.execute("EXPLAIN " + q).rows)
+    assert plan_dst == plan_src
+    # stats really landed (row counts drove the same MPP/exchange choice)
+    t = db2.catalog.table("test", "f")
+    assert db2.stats.get(t.id) is not None and db2.stats.get(t.id).row_count == 5

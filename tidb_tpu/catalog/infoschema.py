@@ -34,6 +34,7 @@ def memtable_rows(db, session, name: str) -> Optional[tuple[list, list, list]]:
         "referential_constraints": _referential_constraints,
         "character_sets": _character_sets,
         "collations": _collations,
+        "tidb_top_sql": _top_sql,
     }.get(name)
     if fn is None:
         return None
@@ -154,6 +155,17 @@ def _statements_summary(db, session):
         d, _, norm = st.digest.partition("|")
         rows.append((d, norm, st.exec_count, st.sum_latency, st.max_latency, st.avg_latency, st.sum_rows, st.sample))
     return cols, fts, rows
+
+
+def _top_sql(db, session):
+    """Trailing-minute per-digest CPU attribution (ref: util/topsql
+    reporter; the dashboard's Top SQL page)."""
+    from tidb_tpu.types.field_type import double_type
+    from tidb_tpu.utils.topsql import collector
+
+    cols = ["SQL_DIGEST", "PLAN_DIGEST", "QUERY_SAMPLE_TEXT", "CPU_TIME_SEC", "SAMPLES"]
+    fts = [_S(80), _S(80), _S(256), double_type(), _I()]
+    return cols, fts, collector().top_sql()
 
 
 def _slow_query(db, session):

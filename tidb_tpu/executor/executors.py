@@ -1483,6 +1483,25 @@ class HashJoinExec(Executor):
         joined rows through other_conds, then EXISTS-reduce per left row."""
         p = self.plan
         n_l, n_r = len(lc), len(rc)
+        matched = np.zeros(n_l, dtype=bool)
+
+        def probe_pairs(li: np.ndarray, ri: np.ndarray) -> None:
+            if not len(li):
+                return
+            joined = Chunk([c.take(li) for c in lc.columns] + [c.take(ri) for c in rc.columns])
+            from tidb_tpu.expression.expr import EvalBatch, eval_to_column, expr_from_pb
+
+            batch = EvalBatch.from_chunk(joined)
+            keep = np.ones(len(joined), dtype=bool)
+            for c in p.other_conds:
+                col = eval_to_column(expr_from_pb(c.to_pb()), batch, np)
+                keep &= (col.data != 0) & col.validity
+            matched[li[keep]] = True
+
+        # cap the materialized pair batch — the nested loop is O(n_l*n_r)
+        # time either way, but memory stays bounded (ref: Apply executor's
+        # chunked probing)
+        PAIR_BATCH = 1 << 20
         if p.eq_conds:
             rkeys = [self._key_array(rc, r) for _, r in p.eq_conds]
             rvalid = [rc.columns[r].validity for _, r in p.eq_conds]
@@ -1498,22 +1517,17 @@ class HashJoinExec(Executor):
                     for j in table.get(tuple(ka[i] for ka in lkeys), ()):
                         li_list.append(i)
                         ri_list.append(j)
-            li = np.asarray(li_list, dtype=np.int64)
-            ri = np.asarray(ri_list, dtype=np.int64)
-        else:  # pure non-eq correlation: nested-loop over all pairs
-            li = np.repeat(np.arange(n_l, dtype=np.int64), n_r)
-            ri = np.tile(np.arange(n_r, dtype=np.int64), n_l)
-        matched = np.zeros(n_l, dtype=bool)
-        if len(li):
-            joined = Chunk([c.take(li) for c in lc.columns] + [c.take(ri) for c in rc.columns])
-            from tidb_tpu.expression.expr import EvalBatch, eval_to_column, expr_from_pb
-
-            batch = EvalBatch.from_chunk(joined)
-            keep = np.ones(len(joined), dtype=bool)
-            for c in p.other_conds:
-                col = eval_to_column(expr_from_pb(c.to_pb()), batch, np)
-                keep &= (col.data != 0) & col.validity
-            matched[li[keep]] = True
+                if len(li_list) >= PAIR_BATCH:
+                    probe_pairs(np.asarray(li_list, dtype=np.int64), np.asarray(ri_list, dtype=np.int64))
+                    li_list, ri_list = [], []
+            probe_pairs(np.asarray(li_list, dtype=np.int64), np.asarray(ri_list, dtype=np.int64))
+        elif n_r:  # pure non-eq correlation: blocked nested loop
+            rows_per_block = max(PAIR_BATCH // n_r, 1)
+            for i0 in range(0, n_l, rows_per_block):
+                i1 = min(i0 + rows_per_block, n_l)
+                li = np.repeat(np.arange(i0, i1, dtype=np.int64), n_r)
+                ri = np.tile(np.arange(n_r, dtype=np.int64), i1 - i0)
+                probe_pairs(li, ri)
         want = matched if p.kind == "semi" else ~matched
         sel = np.nonzero(want)[0]
         return Chunk([c.take(sel) for c in lc.columns])

@@ -643,8 +643,9 @@ def _bit_count(xp, args, ctx):
         return _lax.population_count(xp.asarray(d, dtype=xp.uint64)).astype(xp.int64), v
     import numpy as np
 
-    arr = np.asarray(d, dtype=np.int64).view(np.uint64)
-    return np.array([int(y).bit_count() for y in np.atleast_1d(arr)], dtype=np.int64), v
+    arr = np.atleast_1d(np.asarray(d, dtype=np.int64)).view(np.uint64)
+    bits = np.unpackbits(arr.view(np.uint8)).reshape(len(arr), 64).sum(axis=1)
+    return bits.astype(np.int64), v
 
 
 # ---------------------------------------------------------------------------

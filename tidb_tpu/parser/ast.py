@@ -563,6 +563,16 @@ class DropUser(Node):
 
 
 @dataclass
+class PlanReplayer(Node):
+    """PLAN REPLAYER DUMP EXPLAIN <sql> | LOAD '<path>' (ref:
+    ast.PlanReplayerStmt)."""
+
+    kind: str  # dump | load
+    sql: str = ""
+    path: str = ""
+
+
+@dataclass
 class AlterUser(Node):
     """ALTER USER ... IDENTIFIED BY (ref: ast.AlterUserStmt)."""
 

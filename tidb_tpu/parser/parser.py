@@ -127,6 +127,7 @@ class Parser:
             "ADMIN": self.parse_admin,
             "RECOVER": self.parse_recover,
             "FLASHBACK": self.parse_recover,
+            "PLAN": self.parse_plan_replayer,
         }.get(kw)
         if fn is None:
             raise ParseError("unsupported statement", t)
@@ -1223,6 +1224,19 @@ class Parser:
             return True
         return False
 
+    def parse_plan_replayer(self) -> ast.Node:
+        """PLAN REPLAYER DUMP EXPLAIN <stmt> | PLAN REPLAYER LOAD '<path>'
+        (ref: parser.y PlanReplayerStmt)."""
+        self.expect_kw("PLAN")
+        self.expect_kw("REPLAYER")
+        if self.eat_kw("LOAD"):
+            return ast.PlanReplayer("load", path=self._string_lit())
+        self.expect_kw("DUMP")
+        self.expect_kw("EXPLAIN")
+        start = self.peek().pos
+        self.parse_statement()  # validate; the dump captures the raw text
+        return ast.PlanReplayer("dump", sql=self.sql[start:].strip().rstrip(";"))
+
     def parse_alter(self):
         self.expect_kw("ALTER")
         if self.at_kw("RESOURCE"):
@@ -1520,9 +1534,8 @@ class Parser:
             else:
                 host = self.ident()
         spec = ast.UserSpec(name, host)
-        if self.at_kw("IDENTIFIED"):
-            spec.has_auth = True
         if self.eat_kw("IDENTIFIED"):
+            spec.has_auth = True
             if self.eat_kw("WITH"):
                 t = self.peek()
                 if t.kind == "str":
@@ -1727,7 +1740,10 @@ class Parser:
             if self.eat_kw("DATABASE") or self.eat_kw("SCHEMA"):
                 return ast.Show("create_database", target=self.ident())
             self.expect_kw("TABLE")
-            return ast.Show("create_table", target=self.ident())
+            name = self.ident()
+            if self.eat_op("."):  # qualified `db`.`table`
+                name = f"{name}.{self.ident()}"
+            return ast.Show("create_table", target=name)
         if self.at_kw("TABLE") and self.peek(1).value.upper() == "STATUS":
             self.next()
             self.next()

@@ -596,13 +596,26 @@ class Builder:
         inner.where = _and_join_ast(keep)
         base_items = len(inner.items)
         # inner-side columns the non-eq conjuncts reference must be projected
-        # (before the corr items, which stay the LAST n_extra of the schema)
+        # (before the corr items, which stay the LAST n_extra of the schema).
+        # Each gets a synthetic __corr#k alias and the conjunct's references
+        # rewrite to it: MySQL scoping says an unqualified name that exists
+        # in BOTH scopes binds to the INNER one, and the alias sidesteps the
+        # joined-layout resolver calling it ambiguous.
+        corr_other = [_copy.deepcopy(c) for c in corr_other]
+        inner_refs: list[ast.Node] = []
         for c in corr_other:
             for col_node in _column_nodes(c):
-                if _resolves(probe, col_node, inner_schema) and not any(
-                    _ast_eq(col_node, it.expr) for it in inner.items[base_items:]
-                ):
-                    inner.items.append(ast.SelectItem(col_node))
+                if _resolves(probe, col_node, inner_schema):
+                    for j, prev in enumerate(inner_refs):
+                        if _ast_eq(col_node, prev):
+                            k = j
+                            break
+                    else:
+                        k = len(inner_refs)
+                        inner_refs.append(_copy.deepcopy(col_node))
+                        inner.items.append(ast.SelectItem(inner_refs[k], alias=f"__corr#{k}"))
+                    # rewrite IN PLACE to the aliased projection
+                    col_node.name, col_node.table, col_node.db = f"__corr#{k}", "", ""
         for _, inner_side in corr:
             inner.items.append(ast.SelectItem(inner_side))
         try:

@@ -36,6 +36,25 @@ class StatusServer:
                         out[d] = {t: outer.db.catalog.table(d, t).to_pb() for t in outer.db.catalog.tables(d)}
                     body = json.dumps(out).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/topsql"):
+                    # ref: the dashboard Top-SQL API fed by util/topsql
+                    from tidb_tpu.utils.topsql import collector
+
+                    body = json.dumps(
+                        [
+                            {"sql_digest": d, "plan_digest": p, "sample": s,
+                             "cpu_time_sec": c, "samples": n}
+                            for d, p, s, c, n in collector().top_sql()
+                        ]
+                    ).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/debug/pprof/profile") or self.path.startswith("/profile"):
+                    # collapsed-stack text, flamegraph.pl input format
+                    # (ref: util/cpuprofile's shared continuous profiler)
+                    from tidb_tpu.utils.topsql import collector
+
+                    body = "\n".join(f"{s} {n}" for s, n in collector().profile()).encode()
+                    ctype = "text/plain"
                 else:
                     self.send_response(404)
                     self.end_headers()

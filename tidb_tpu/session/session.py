@@ -43,6 +43,9 @@ DEFAULT_SYSVARS = {
     "tidb_enforce_mpp": 0,
     # slow query log threshold in ms (ref: tidb_slow_log_threshold)
     "tidb_slow_log_threshold": 300,
+    # Top-SQL sampling attribution; OFF by default like the reference —
+    # the digest + sampler cost stays off the hot path until enabled
+    "tidb_enable_top_sql": 0,
     # session resource group (ref: tidb_resource_control + resource groups)
     "tidb_resource_group": "default",
     # IMPORT INTO via the distributed task framework (ref:
@@ -340,6 +343,15 @@ class Session:
         if not isinstance(stmt, ast.Show):  # SHOW WARNINGS must see them
             self._prev_warnings = self.warnings
             self.warnings = []
+        # Top-SQL attribution: samples taken while this thread executes the
+        # statement land on its digest (ref: topsql.AttachSQLInfo)
+        topsql = None
+        if self.vars.get("tidb_enable_top_sql", 0):
+            from tidb_tpu.utils.stmtsummary import digest as _digest
+            from tidb_tpu.utils.topsql import collector as _topsql
+
+            topsql = _topsql()
+            topsql.attach(_digest(sql).split("|")[0], "", sql)
         try:
             res = self._execute_stmt(stmt, sql_text=sql)
             if not self._explicit and self._txn is not None:
@@ -374,6 +386,9 @@ class Session:
                 # membuffer staging in _execute_stmt for DML
                 pass
             raise
+        finally:
+            if topsql is not None:
+                topsql.detach()
 
     def query(self, sql: str) -> list[tuple]:
         return self.execute(sql).rows
@@ -557,6 +572,14 @@ class Session:
             return self._drop_user(stmt)
         if isinstance(stmt, ast.AlterUser):
             return self._alter_user(stmt)
+        if isinstance(stmt, ast.PlanReplayer):
+            from tidb_tpu.tools import replayer
+
+            if stmt.kind == "dump":
+                path = replayer.dump(self, stmt.sql)
+                return Result(columns=["File_token"], rows=[(path,)])
+            sql = replayer.load(self, stmt.path)
+            return Result(columns=["Loaded_SQL"], rows=[(sql,)])
         if isinstance(stmt, ast.Grant):
             return self._grant(stmt)
         if isinstance(stmt, ast.Kill):
@@ -1180,10 +1203,12 @@ class Session:
         if stmt.kind == "create_table":
             from tidb_tpu.tools.dumpling import _create_table_sql
 
-            t = self.catalog.table(self.current_db, stmt.target)
+            dbn, _, tn = stmt.target.rpartition(".")
+            dbn = dbn or self.current_db
+            t = self.catalog.table(dbn, tn)
             return Result(
                 columns=["Table", "Create Table"],
-                rows=[(t.name, _create_table_sql(t, self.current_db).rstrip().rstrip(";"))],
+                rows=[(t.name, _create_table_sql(t, dbn).rstrip().rstrip(";"))],
             )
         if stmt.kind == "table_status":
             import datetime
