@@ -34,6 +34,12 @@ class GCWorker:
         """One GC cycle: resolve expired locks under the safe point, then
         prune versions. Returns pruned version count."""
         sp = self.compute_safe_point() if safe_point is None else safe_point
+        # service safepoints (log-backup checkpoints) pin GC — versions the
+        # change feed has not captured yet must survive (ref: PD service
+        # safepoints registered by br log backup)
+        svc = self.store.min_service_safepoint()
+        if svc is not None:
+            sp = min(sp, svc)
         # resolve abandoned locks first (ref: gc_worker resolveLocks phase)
         with self.store._mu:
             stale = [

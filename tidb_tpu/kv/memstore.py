@@ -345,6 +345,8 @@ class MemStore:
         # stay strictly ascending by commit_ts)
         self._rollbacks: dict[bytes, set[int]] = {}
         self._locks: dict[bytes, Lock] = {}
+        # GC pins from services (log backup checkpoints): name → ts
+        self._service_safepoints: dict[str, int] = {}
         self._sorted: list[bytes] | None = []
         self.tso = TimestampOracle()
         self._region_split_keys = region_split_keys
@@ -865,6 +867,66 @@ class MemStore:
         return pruned
 
     # -- raw ops (catalog/meta convenience; single-key autocommit) ----------
+    def resolved_ts(self) -> int:
+        """A ts every commit at or below which has fully APPLIED (ref: the
+        resolved-ts concept in TiKV). Percolator draws commit_ts after
+        prewrite locks are placed, so any drawn-but-unapplied commit still
+        holds locks — the minimum live lock start_ts bounds it."""
+        with self._mu:
+            if self._locks:
+                return min(l.start_ts for l in self._locks.values()) - 1
+            return self.tso.ts()
+
+    def register_service_safepoint(self, name: str, ts: int) -> None:
+        """Pin GC: versions newer than ``ts`` stay until the service (e.g. a
+        log-backup task's checkpoint) advances (ref: PD service safepoints
+        that br registers for log backup)."""
+        with self._mu:
+            self._service_safepoints[name] = ts
+
+    def remove_service_safepoint(self, name: str) -> None:
+        with self._mu:
+            self._service_safepoints.pop(name, None)
+
+    def min_service_safepoint(self) -> Optional[int]:
+        with self._mu:
+            return min(self._service_safepoints.values()) if self._service_safepoints else None
+
+    def changes_since(self, after_ts: int, upto_ts: int, record_only: bool = True):
+        """Committed versions with after_ts < commit_ts <= upto_ts, commit-ts
+        ordered — the log-backup change feed (ref: br log backup observing
+        the KV change stream). Stable-block ingests emit as row puts at the
+        block's commit ts. ``record_only`` filters to table record keys (the
+        PITR replay recomputes index entries from rows)."""
+        out: list[tuple[bytes, str, bytes, int]] = []
+        in_window: list[tuple[int, "StableBlock"]] = []
+        with self._mu:
+            for key, chain in self._writes.items():
+                if record_only and not tablecodec.is_record_key(key):
+                    continue
+                for w in chain:
+                    if after_ts < w.commit_ts <= upto_ts:
+                        out.append((key, w.op, w.value, w.commit_ts))
+            for tid, blocks in self._stable.items():
+                for b in blocks:
+                    if after_ts < b.commit_ts <= upto_ts:
+                        in_window.append((tid, b))
+        # blocks are immutable once ingested: encode OUTSIDE the store lock
+        from tidb_tpu.kv.rowcodec import encode_row
+
+        for tid, b in in_window:
+            for i in range(len(b.handles)):
+                out.append(
+                    (
+                        tablecodec.record_key(tid, int(b.handles[i])),
+                        OP_PUT,
+                        encode_row(b.schema, b.row_values(i)),
+                        b.commit_ts,
+                    )
+                )
+        out.sort(key=lambda e: e[3])
+        return out
+
     def raw_put(self, key: bytes, value: bytes) -> None:
         with self._mu:  # ts drawn under the lock keeps chains ascending
             ts = self.tso.ts()

@@ -616,7 +616,7 @@ class Session:
         if isinstance(stmt, ast.Restore):
             from tidb_tpu.tools.brie import restore_database
 
-            out = restore_database(self._db, stmt.src, stmt.db or None)
+            out, _ = restore_database(self._db, stmt.src, stmt.db or None)
             return Result(columns=["Table", "Rows"], rows=sorted(out.items()))
         if isinstance(stmt, ast.Prepare):
             return self._prepare(stmt)

@@ -43,9 +43,11 @@ def backup_database(db, db_name: str, dest: str, tables: list[str] | None = None
     return meta
 
 
-def restore_database(db, src: str, db_name: str | None = None) -> dict:
-    """Restore a backup directory; returns {table: rows}. Tables must not
-    already exist (ref: BR restore refusing to overwrite)."""
+def restore_database(db, src: str, db_name: str | None = None) -> tuple[dict, dict]:
+    """Restore a backup directory; returns ({table: rows}, {old physical
+    table id: new id}) — the id map lets PITR log replay re-key entries
+    recorded under the ORIGINAL ids. Tables must not already exist (ref: BR
+    restore refusing to overwrite)."""
     with open(os.path.join(src, "backupmeta.json")) as f:
         meta = json.load(f)
     target_db = db_name or meta["db"]
@@ -58,13 +60,17 @@ def restore_database(db, src: str, db_name: str | None = None) -> dict:
             raise CatalogError(f"restore target table {target_db}.{name} already exists")
 
     out: dict = {}
+    id_map: dict[int, int] = {}  # old physical table id → new (PITR replay re-keys)
     for name, tmeta in meta["tables"].items():
         old = TableInfo.from_pb(tmeta["schema"])
         new_t = db.catalog.register_restored_table(target_db, old)
+        id_map[old.id] = new_t.id
+        for ov, nv in zip(old.partition_views(), new_t.partition_views()):
+            id_map[ov.id] = nv.id
         rows_path = os.path.join(src, tmeta["file"])
         n = _restore_rows(db, new_t, rows_path)
         out[name] = n
-    return out
+    return out, id_map
 
 
 def _restore_rows(db, t: TableInfo, path: str) -> int:
