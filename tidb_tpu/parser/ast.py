@@ -90,6 +90,17 @@ class Like(Node):
 
 
 @dataclass
+class Collate(Node):
+    """expr COLLATE name / BINARY expr — explicit collation override; the
+    strongest coercibility level, it wins over both operands' implicit
+    collations (ref: parser.y "Expression COLLATE", expression/collation.go
+    deriveCollation explicit-priority rule)."""
+
+    operand: Node
+    collation: str  # lowercased MySQL collation name, or "binary"
+
+
+@dataclass
 class FuncCall(Node):
     name: str  # lowercased
     args: list[Node] = field(default_factory=list)
@@ -184,6 +195,9 @@ class TableRef(Node):
     as_of: Optional[Node] = None  # stale read: AS OF TIMESTAMP expr
     # USE/IGNORE/FORCE INDEX (...) table hints: [(kind, [index names])]
     index_hints: Optional[list] = None
+    # t PARTITION (p0, p1) explicit partition selection (ref: parser.y
+    # TableFactor PartitionNameListOpt; logical_plan_builder partition check)
+    partitions: Optional[list] = None
 
 
 @dataclass

@@ -66,7 +66,13 @@ def tokenize(sql: str) -> list[Token]:
                 ch = sql[j]
                 if ch == "\\" and j + 1 < n:
                     esc = sql[j + 1]
-                    buf.append({"n": "\n", "t": "\t", "r": "\r", "0": "\0", "\\": "\\", q: q}.get(esc, esc))
+                    if esc in ("%", "_"):
+                        # \% and \_ keep the backslash: they are LIKE-pattern
+                        # escapes resolved at match time, not string escapes
+                        # (ref: MySQL string-literal rules for \% \_)
+                        buf.append("\\" + esc)
+                    else:
+                        buf.append({"n": "\n", "t": "\t", "r": "\r", "0": "\0", "\\": "\\", q: q}.get(esc, esc))
                     j += 2
                     continue
                 if ch == q:

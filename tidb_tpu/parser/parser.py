@@ -200,14 +200,21 @@ class Parser:
         """LIMIT n | LIMIT off, n | LIMIT n OFFSET off — sets node.limit/offset."""
         if not self.eat_kw("LIMIT"):
             return
-        a = int(self.next().value)
+        a = self._limit_value()
         if self.eat_op(","):
             node.offset = a
-            node.limit = int(self.next().value)
+            node.limit = self._limit_value()
         else:
             node.limit = a
             if self.eat_kw("OFFSET"):
-                node.offset = int(self.next().value)
+                node.offset = self._limit_value()
+
+    def _limit_value(self) -> int:
+        """MySQL's u64 LIMIT/OFFSET literals (18446744073709551615 = "no
+        limit") clamp to int64 max HERE, at the parse boundary — a user
+        literal must never reach a jitted computation unclamped (ref:
+        ast/misc.go Limit uint64)."""
+        return min(int(self.next().value), 2**63 - 1)
 
     def _paren_select_ahead(self) -> bool:
         """True when the upcoming '('... run of parens wraps a SELECT/WITH (as
@@ -372,6 +379,15 @@ class Parser:
         db = ""
         if self.eat_op("."):
             db, name = name, self.ident()
+        partitions = None
+        if self.at_kw("PARTITION") and self.peek(1).kind == "op" and self.peek(1).value == "(":
+            # t PARTITION (p0, p1) — explicit partition selection
+            self.next()
+            self.expect_op("(")
+            partitions = [self.ident().lower()]
+            while self.eat_op(","):
+                partitions.append(self.ident().lower())
+            self.expect_op(")")
         as_of = None
         alias = ""
         if self.at_kw("AS") and self.peek(1).value.upper() == "OF":
@@ -406,7 +422,7 @@ class Parser:
                     names.append("primary" if self.eat_kw("PRIMARY") else self.ident().lower())
             self.expect_op(")")
             hints = (hints or []) + [(kind, names)]
-        return ast.TableRef(name, db=db, alias=alias, as_of=as_of, index_hints=hints)
+        return ast.TableRef(name, db=db, alias=alias, as_of=as_of, index_hints=hints, partitions=partitions)
 
     # -- expressions ---------------------------------------------------------
     def parse_expr(self) -> ast.Node:
@@ -599,7 +615,20 @@ class Parser:
         if self.at_op("~"):
             self.next()
             return ast.UnaryOp("bitneg", self._unary())
-        return self._postfix_json(self._primary())
+        if self.at_kw("BINARY") and not (
+            # CAST-style "BINARY(n)" never appears in expression position;
+            # bare BINARY here is MySQL's unary collate-to-binary operator
+            # (ref: parser.y SimpleExpr "BINARY SimpleExpr")
+            self.peek(1).kind == "op" and self.peek(1).value in (")", ",")
+        ):
+            self.next()
+            return ast.Collate(self._unary(), "binary")
+        e = self._postfix_json(self._primary())
+        # postfix COLLATE binds tightest of all operators
+        # (ref: parser.y "Expression COLLATE CollationName")
+        while self.eat_kw("COLLATE"):
+            e = ast.Collate(e, self.ident().lower())
+        return e
 
     def _primary(self) -> ast.Node:
         t = self.peek()
@@ -893,7 +922,7 @@ class Parser:
             self.expect_kw("BY")
             upd.order_by = self.parse_order_items()
         if self.eat_kw("LIMIT"):
-            upd.limit = int(self.next().value)
+            upd.limit = self._limit_value()
         return upd
 
     def parse_delete(self) -> ast.Delete:
@@ -908,7 +937,7 @@ class Parser:
             self.expect_kw("BY")
             d.order_by = self.parse_order_items()
         if self.eat_kw("LIMIT"):
-            d.limit = int(self.next().value)
+            d.limit = self._limit_value()
         return d
 
     def _table_ref_simple(self, allow_alias: bool = False) -> ast.TableRef:

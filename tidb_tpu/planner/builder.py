@@ -95,6 +95,48 @@ def _common_type(l: FieldType, r: FieldType) -> FieldType:
     raise PlanError(f"incompatible set-operand column types {l.kind.name} vs {r.kind.name}")
 
 
+# known collation names → the engine's two-way collation model
+# (catalog/infoschema.py COLLATIONS is the introspection mirror of this)
+_COLLATION_MAP = {"utf8mb4_bin": "bin", "utf8mb4_general_ci": "ci", "binary": "bin"}
+
+
+def _collate_expr(e: Expression, name: str) -> Expression:
+    """expr COLLATE name / BINARY expr: override the expression's collation.
+
+    Explicit collation is the strongest coercibility level — comparisons
+    propagate it to the other operand (ref: expression/collation.go
+    deriveCollation; CoercibilityExplicit wins)."""
+    import copy as _copy
+    from dataclasses import replace as _dc_replace
+
+    if name not in _COLLATION_MAP:
+        raise PlanError(f"Unknown collation: '{name}'")
+    coll = _COLLATION_MAP[name]
+    out = _copy.copy(e)
+    if out.ftype.kind == TypeKind.STRING:
+        out.ftype = _dc_replace(out.ftype, collation=coll)
+    out._explicit_collation = coll  # type: ignore[attr-defined]
+    return out
+
+
+def _apply_explicit_collation(a: Expression, b: Expression):
+    """If either comparison operand carries an explicit COLLATE, it governs
+    the whole comparison: rewrite BOTH operands' string collation to it."""
+    import copy as _copy
+    from dataclasses import replace as _dc_replace
+
+    coll = getattr(a, "_explicit_collation", None) or getattr(b, "_explicit_collation", None)
+    if coll is None:
+        return a, b
+    out = []
+    for e in (a, b):
+        if e.ftype.kind == TypeKind.STRING and e.ftype.collation != coll:
+            e = _copy.copy(e)
+            e.ftype = _dc_replace(e.ftype, collation=coll)
+        out.append(e)
+    return out[0], out[1]
+
+
 def _cast_expr(e: Expression, target: ast.TypeDef) -> Expression:
     """CAST target mapping (shared by the plain and mixed resolvers)."""
     tname = target.name
@@ -319,6 +361,7 @@ class Builder:
         aliases: dict[str, Expression] = {}
         hidden = 0
         order_agg_map: dict[int, int] = {}  # order-item idx → hidden agg col
+        order_hidden_map: dict[int, int] = {}  # order-item idx → hidden proj col
         order_agg_base = 0
         if has_agg:
             base_schema = plan.schema
@@ -456,10 +499,16 @@ class Builder:
             # ORDER BY may reference non-projected columns → hidden extras
             if sel.order_by and sel.from_ is not None:
                 base = plan.schema
-                for oi in sel.order_by:
+                for i_o, oi in enumerate(sel.order_by):
                     if self._order_needs_hidden(oi.expr, proj.schema, aliases):
                         e = self.resolve(oi.expr, BuildCtx(base))
                         src = _source_outcol(e, base)
+                        # the sort must target this slot directly — the order
+                        # expression references BASE columns the projection no
+                        # longer carries (ORDER BY COALESCE(v,-1) where only
+                        # the alias survives), so re-resolving it against the
+                        # projection schema would fail
+                        order_hidden_map[i_o] = len(proj.schema)
                         # name the hidden column after its source so ORDER BY
                         # resolution finds it (duplicates with visible items
                         # are impossible — those wouldn't need a hidden col)
@@ -493,6 +542,9 @@ class Builder:
                 if i_o in order_agg_map:
                     idx = order_agg_base + order_agg_map[i_o]
                     e: Expression = ColumnRef(idx, plan.schema[idx].ftype, plan.schema[idx].name)
+                elif i_o in order_hidden_map:
+                    idx = order_hidden_map[i_o]
+                    e = ColumnRef(idx, plan.schema[idx].ftype, plan.schema[idx].name)
                 else:
                     e = self._resolve_order(oi.expr, plan.schema, aliases)
                 by.append((e, oi.desc))
@@ -898,6 +950,14 @@ class Builder:
                 self.scan_checker(db, node.name)
             alias = node.alias or node.name
             scan = LogicalScan(db=db, table=t, alias=alias)
+            if node.partitions is not None:
+                if t.partition is None:
+                    raise PlanError(f"PARTITION () clause on nonpartitioned table '{t.name}'")
+                known_parts = {d.name.lower() for d in t.partition.defs}
+                for pn in node.partitions:
+                    if pn not in known_parts:
+                        raise PlanError(f"Unknown partition '{pn}' in table '{t.name}'")
+                scan.partition_select = list(node.partitions)
             for hname, hargs in self.hints:
                 if hname in ("use_index", "ignore_index") and len(hargs) >= 2:
                     if hargs[0].strip().lower() in (alias.lower(), node.name.lower()):
@@ -1071,8 +1131,13 @@ class Builder:
             return func("not", e) if node.negated else e
         if isinstance(node, ast.Like):
             sig = "regexp" if node.regexp else "like"
-            e = func(sig, self._resolve(node.operand, ctx), self._resolve(node.pattern, ctx))
+            operand = self._resolve(node.operand, ctx)
+            pattern = self._resolve(node.pattern, ctx)
+            operand, pattern = _apply_explicit_collation(operand, pattern)
+            e = func(sig, operand, pattern)
             return func("not", e) if node.negated else e
+        if isinstance(node, ast.Collate):
+            return _collate_expr(self._resolve(node.operand, ctx), node.collation)
         if isinstance(node, ast.FuncCall) and node.name in ("date_add", "date_sub", "adddate", "subdate") and len(node.args) == 2 and isinstance(node.args[1], ast.FuncCall) and node.args[1].name == "interval":
             base = self._resolve(node.args[0], ctx)
             iv = node.args[1]
@@ -1322,6 +1387,7 @@ class Builder:
     def _binary(self, op: str, left: Expression, right: Expression) -> Expression:
         if op in ("eq", "ne", "lt", "le", "gt", "ge"):
             left, right = self._coerce_cmp(left, right)
+            left, right = _apply_explicit_collation(left, right)
         return func(op, left, right)
 
     def _coerce_cmp(self, a: Expression, b: Expression):

@@ -321,7 +321,7 @@ def _try_point_get(plan: LogicalPlan):
     if not (isinstance(sel, LogicalSelection) and isinstance(sel.children[0], LogicalScan)):
         return None
     scan = sel.children[0]
-    if not scan.table.pk_is_handle or len(sel.conditions) != 1:
+    if not scan.table.pk_is_handle or len(sel.conditions) != 1 or scan.partition_select is not None:
         return None
     cond = sel.conditions[0]
     if not (isinstance(cond, ScalarFunc) and cond.sig == "eq"):
@@ -369,6 +369,20 @@ _COST_TABLE_ROW = 1.0
 _COST_IDX_ROW = 1.5
 _COST_LOOKUP_ROW = 6.0
 _COST_SETUP = 40.0
+
+
+def _has_collation_override(e, schema) -> bool:
+    """True when any column reference in the expression compares under a
+    collation other than the column's declared one — the footprint of an
+    explicit COLLATE override (builder._collate_expr rewrites the ref's
+    ftype; optimization rules copy refs, so the ftype diff is the durable
+    signal). Index ranges are ordered by the DECLARED collation, so such
+    conditions must not drive index access."""
+    if isinstance(e, ColumnRef) and e.ftype.kind == TypeKind.STRING:
+        if 0 <= e.index < len(schema) and schema[e.index].ftype.kind == TypeKind.STRING:
+            if e.ftype.collation != schema[e.index].ftype.collation:
+                return True
+    return any(_has_collation_override(c, schema) for c in e.children())
 
 
 def _idx_eligible(scan, idx) -> bool:
@@ -649,9 +663,23 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None, vars=None) -> P
             ranges=plan.ranges,
             schema=plan.schema,
         )
+        if plan.partition_select is not None:
+            sel = set(plan.partition_select)
+            reader.partitions = [
+                plan.table.partition_view(d.id)
+                for d in plan.table.partition.defs
+                if d.name.lower() in sel
+            ]
         return reader
     if isinstance(plan, LogicalSelection):
-        if isinstance(plan.children[0], LogicalScan):
+        if (
+            isinstance(plan.children[0], LogicalScan)
+            and plan.children[0].partition_select is None
+            and not any(_has_collation_override(c, plan.children[0].schema) for c in plan.conditions)
+        ):
+            # an explicit COLLATE override changes comparison semantics away
+            # from the index's stored order — index ranges derived from such
+            # conditions would return wrong rows, so keep the full scan
             ipath = _choose_index_path(plan.children[0], plan.conditions, stats)
             if ipath is None and sysvar_int(vars, "tidb_enable_index_merge", 1):
                 # OR shapes defeat single-index pruning; a union of index
@@ -679,9 +707,17 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None, vars=None) -> P
                 if plan.children[0].table.partition is not None:
                     from tidb_tpu.planner.partition import prune_partitions
 
-                    child.partitions = prune_partitions(
+                    pruned = prune_partitions(
                         child.table, plan.children[0].schema, plan.conditions
                     )
+                    if pruned is not None:
+                        if child.partitions is not None:
+                            # intersect condition pruning with explicit
+                            # PARTITION (p, ...) selection
+                            keep_ids = {v.id for v in child.partitions}
+                            child.partitions = [v for v in pruned if v.id in keep_ids]
+                        else:
+                            child.partitions = pruned
             if host_side:
                 # host-only residue forces the host engine for correctness of
                 # the whole fragment ordering? No — residue evaluates above
@@ -758,7 +794,9 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None, vars=None) -> P
         return PhysSort(by=plan.by, children=[child])
     if isinstance(plan, LogicalLimit):
         child = _physical(plan.children[0], engines, stats, vars)
-        total = plan.limit + plan.offset
+        # limit+offset saturates at int64 max — MySQL's u64 "no limit" idiom
+        # must stay a valid device scalar (never reach a jit boundary wider)
+        total = min(plan.limit + plan.offset, 2**63 - 1)
         # topN pushdown: Limit(Sort([Projection](reader))) → reader TopN +
         # root merge sort; sort keys remap through the projection
         if isinstance(child, PhysSort):

@@ -57,6 +57,9 @@ class LogicalScan(LogicalPlan):
     # FORCE INDEX: a table scan becomes the last resort, not a baseline
     force_index: bool = False
     use_index_merge: bool = False
+    # explicit `t PARTITION (p0, ...)` selection: lowercased partition names
+    # (ref: logical_plan_builder.go partition-name check + PartitionPruning)
+    partition_select: Optional[list] = None
 
 
 @dataclass

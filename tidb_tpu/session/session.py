@@ -1205,6 +1205,16 @@ class Session:
 
             dbn, _, tn = stmt.target.rpartition(".")
             dbn = dbn or self.current_db
+            view = self.catalog.view(dbn, tn)
+            if view is not None:
+                # SHOW CREATE TABLE on a view → View/Create View row
+                # (ref: executor/show.go fetchShowCreateTable4View)
+                cols = f" ({', '.join(f'`{c}`' for c in view.columns)})" if view.columns else ""
+                create = f"CREATE VIEW `{view.name}`{cols} AS {view.text}"
+                return Result(
+                    columns=["View", "Create View", "character_set_client", "collation_connection"],
+                    rows=[(view.name, create, "utf8mb4", "utf8mb4_bin")],
+                )
             t = self.catalog.table(dbn, tn)
             return Result(
                 columns=["Table", "Create Table"],
