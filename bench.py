@@ -110,11 +110,13 @@ def timed(session, sql, reps):
     return best
 
 
-def q1_chip_time(db, session) -> float:
-    """Amortized ON-CHIP Q1 time: dispatch the production-shaped kernel K
-    times asynchronously and sync once, dividing out the host↔device round
-    trip (the remote tunnel adds a variable 60-800 ms per synchronous query
-    that says nothing about the chip). Returns seconds per full-table run."""
+def chip_time(db, session, sql) -> float:
+    """Amortized ON-CHIP time for one query's device task: dispatch the
+    production-shaped kernel K times asynchronously and sync once, dividing
+    out the host↔device round trip (the remote tunnel adds a fixed
+    ~5-15ms/dispatch plus 60-800ms per synchronous fetch that says nothing
+    about the chip; K=32 pushes the amortized dispatch share under ~3ms).
+    Returns seconds per full-table run."""
     from tidb_tpu.copr import tpu_engine as te
 
     captured = {}
@@ -126,13 +128,13 @@ def q1_chip_time(db, session) -> float:
 
     te._execute_dag_device = cap
     try:
-        session.query(Q1)
+        session.query(sql)
     finally:
         te._execute_dag_device = real
     dag, region, ranges, read_ts = captured["args"]
     run_once, sync = te.device_probe_fn(db.store, dag, region, ranges, read_ts)
     sync(run_once())  # warm
-    K = 8
+    K = 32
     t0 = time.perf_counter()
     outs = [run_once() for _ in range(K)]
     sync(outs[-1])
@@ -145,11 +147,17 @@ def main():
 
     s.execute("SET tidb_isolation_read_engines = 'tpu'")
     q1_tpu = timed(s, Q1, REPS)
-    try:
-        q1_chip = q1_chip_time(db, s)
-    except Exception as e:  # best-effort diagnostics — but never silently
-        print(f"chip probe failed: {e!r}", file=sys.stderr)
-        q1_chip = None
+
+    def chip(sql, label):
+        try:
+            return chip_time(db, s, sql)
+        except Exception as e:  # best-effort diagnostics — but never silently
+            print(f"{label} chip probe failed: {e!r}", file=sys.stderr)
+            return None
+
+    q1_chip = chip(Q1, "q1")
+    q6_chip = chip(Q6, "q6")
+    q10_chip = chip(Q10, "q10")
     q6_tpu = timed(s, Q6, REPS)
     cnt_tpu = timed(s, COUNT_STAR, REPS)
     q10_tpu = timed(s, Q10, REPS)
@@ -188,6 +196,8 @@ def main():
             "q1_chip_rows_per_sec": round(N_ROWS / q1_chip) if q1_chip else None,
             "q1_host_ms": round(q1_host * 1e3, 1),
             "q6_tpu_ms": round(q6_tpu * 1e3, 1),
+            "q6_chip_ms": round(q6_chip * 1e3, 1) if q6_chip else None,
+            "q10_chip_ms": round(q10_chip * 1e3, 1) if q10_chip else None,
             "q6_host_ms": round(q6_host * 1e3, 1),
             "q6_speedup": round(q6_host / q6_tpu, 2),
             "count_tpu_ms": round(cnt_tpu * 1e3, 1),

@@ -70,6 +70,8 @@ class Binder:
                 raise UnsupportedForDevice("DAG must start with a scan")
             if ex.tp == dagpb.SELECTION:
                 ex.conditions = [self.bind_expr(c) for c in ex.conditions]
+                if refs_are_scan and self.entry is not None:
+                    ex.narrow_ok = [self.narrow_safe(c) for c in ex.conditions]
             elif ex.tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG):
                 ex.group_by = [self.bind_expr(g, allow_string_ref=True) for g in ex.group_by]
                 for a in ex.aggs:
@@ -89,6 +91,12 @@ class Binder:
                         self._corner_bounds(a["arg"]) if a["arg"] is not None else None
                         for a in ex.aggs
                     ]
+                    if self.entry is not None:
+                        ex.group_narrow = [self.narrow_safe(g) for g in ex.group_by]
+                        ex.arg_narrow = [
+                            a["arg"] is not None and self.narrow_safe(a["arg"])
+                            for a in ex.aggs
+                        ]
                 refs_are_scan = False
             elif ex.tp == dagpb.TOPN:
                 new_order = []
@@ -234,7 +242,53 @@ class Binder:
             return None
         m = max(abs(min(vals)), abs(max(vals)), 1)
         m2 = 1 << (m - 1).bit_length()  # pow2 envelope: fingerprint-stable
-        return (-m2, m2)
+        # provably-nonnegative expressions keep a zero floor — halving the
+        # span unlocks narrower limb plans and the int32 compute lanes
+        return (0 if min(vals) >= 0 else -m2, m2)
+
+    # -- int32 narrow-eval proofs -------------------------------------------
+    # the kernel evaluates proven expressions on the NARROW (storage-dtype)
+    # lanes: int32 VPU ops run native where emulated-pair int64 ops would run
+    # 2-3x wider (ref: the per-width column discipline, util/chunk/column.go:74)
+    _NARROW_CMP = frozenset({"eq", "ne", "nulleq", "lt", "le", "gt", "ge", "in"})
+    _NARROW_LOGIC = frozenset({"and", "or", "not", "isnull"})
+    _I32_LO, _I32_HI = -(1 << 31), (1 << 31) - 1
+
+    def narrow_safe(self, pb: dict) -> bool:
+        """Proof that evaluating this bound expression over int32 lanes is
+        EXACT: every integer subtree's value range (column stats / corner
+        bounds) fits int32, so no intermediate can wrap. Comparisons and
+        logic over proven operands are width-independent."""
+        tp = pb["tp"]
+        if tp == "const":
+            return self._const_fits_i32(pb)
+        if tp == "col":
+            ft0 = pb["ft"][0]
+            if ft0 == int(TypeKind.STRING):
+                return True  # dictionary codes: int32 by construction
+            if ft0 == int(TypeKind.FLOAT):
+                return False
+            mm = self._col_stats(pb["idx"]) if pb["idx"] < len(self.scan_cols) else None
+            return mm is not None and self._I32_LO <= mm[0] and mm[1] <= self._I32_HI
+        sig = pb["sig"]
+        kids = pb["children"]
+        if sig in self._NARROW_CMP or sig in self._NARROW_LOGIC:
+            return all(self.narrow_safe(k) for k in kids)
+        if sig in self._CORNER_SIGS:
+            b = self._corner_bounds(pb)
+            if b is None or b[0] < self._I32_LO or b[1] > self._I32_HI:
+                return False
+            return all(self.narrow_safe(k) for k in kids)
+        return False
+
+    def _const_fits_i32(self, pb: dict) -> bool:
+        from tidb_tpu.expression.expr import _const_physical, expr_from_pb
+
+        try:
+            pv, _ = _const_physical(expr_from_pb(pb), None)
+        except Exception:
+            return False
+        return isinstance(pv, int) and self._I32_LO <= pv <= self._I32_HI
 
     # -- expression rewriting ----------------------------------------------
     def _is_string(self, pb: dict) -> bool:

@@ -75,6 +75,10 @@ class ExecutorPB:
     domains: list[int] = field(default_factory=list)
     # selection: conditions (ExprPB dicts), implicitly AND-ed
     conditions: list[dict] = field(default_factory=list)
+    # binder-stamped int32 narrow-eval proof per condition (see
+    # Binder.narrow_safe); participates in to_pb — the compiled kernel bakes
+    # the lane widths in, so stale flags must change the fingerprint
+    narrow_ok: list = field(default_factory=list)
     # aggregation
     group_by: list[dict] = field(default_factory=list)
     aggs: list[dict] = field(default_factory=list)  # AggDesc pb
@@ -83,6 +87,9 @@ class ExecutorPB:
     # static magnitude proofs for the MXU grouped-sum path; participates in
     # to_pb so kernels never reuse stale bounds
     arg_bounds: list = field(default_factory=list)
+    # binder-stamped int32 narrow-eval proofs (group keys / agg arguments)
+    group_narrow: list = field(default_factory=list)
+    arg_narrow: list = field(default_factory=list)
     # topn: order_by = [(ExprPB, desc: bool)]
     order_by: list = field(default_factory=list)
     limit: int = 0
@@ -127,13 +134,15 @@ class ExecutorPB:
                 storage_schema=[_ft_pb(ft) for ft in self.storage_schema],
             )
         elif self.tp == SELECTION:
-            d.update(conditions=self.conditions)
+            d.update(conditions=self.conditions, narrow_ok=list(self.narrow_ok))
         elif self.tp in (AGGREGATION, STREAM_AGG):
             d.update(
                 group_by=self.group_by,
                 aggs=self.aggs,
                 agg_mode=self.agg_mode,
                 arg_bounds=[list(b) if b is not None else None for b in self.arg_bounds],
+                group_narrow=list(self.group_narrow),
+                arg_narrow=list(self.arg_narrow),
             )
         elif self.tp == TOPN:
             d.update(
@@ -177,9 +186,12 @@ class ExecutorPB:
             e.storage_schema = [_ft_from_pb(f) for f in pb.get("storage_schema", [])]
         elif e.tp == SELECTION:
             e.conditions = pb["conditions"]
+            e.narrow_ok = pb.get("narrow_ok", [])
         elif e.tp in (AGGREGATION, STREAM_AGG):
             e.group_by, e.aggs, e.agg_mode = pb["group_by"], pb["aggs"], pb["agg_mode"]
             e.arg_bounds = [tuple(b) if b is not None else None for b in pb.get("arg_bounds", [])]
+            e.group_narrow = pb.get("group_narrow", [])
+            e.arg_narrow = pb.get("arg_narrow", [])
         elif e.tp == TOPN:
             e.order_by, e.limit = pb["order_by"], pb["limit"]
             e.sort_bounds = [tuple(b) if b is not None else None for b in pb.get("sort_bounds", [])]

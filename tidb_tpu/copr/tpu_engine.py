@@ -120,11 +120,13 @@ def _hbm_budget() -> int:
 _DEVICE_LRU = _DeviceLRU(_hbm_budget())
 
 
-def _device_put_col(key, data: np.ndarray, valid: np.ndarray, n_pad: int, cacheable: bool = True):
+def _device_put_col(key, make_pair, n_pad: int, cacheable: bool = True):
     """One padded (data, valid) pair on device, LRU-cached under ``key``.
-    Narrow dtypes are kept narrow in HBM (int32 dict codes / narrowed value
-    lanes read half the bytes; the kernel upcasts on use, which XLA fuses
-    into the consumer)."""
+    ``make_pair`` is a THUNK returning (data, valid) — host-side prep (the
+    int32 narrowing astype walks the whole column) must only run on an LRU
+    miss, never on the warm path. Narrow dtypes are kept narrow in HBM
+    (int32 dict codes / narrowed value lanes read half the bytes; the kernel
+    upcasts on use, which XLA fuses into the consumer)."""
     import jax
     import jax.numpy as jnp
 
@@ -132,6 +134,7 @@ def _device_put_col(key, data: np.ndarray, valid: np.ndarray, n_pad: int, cachea
         hit = _DEVICE_LRU.get(key)
         if hit is not None:
             return hit
+    data, valid = make_pair()
     pd = np.zeros(n_pad, dtype=data.dtype)
     pd[: len(data)] = data
     pv = np.zeros(n_pad, dtype=bool)
@@ -214,15 +217,21 @@ def _block_device_inputs(store, scan, cache, entry, region, bi: int, lo: int, hi
     epoch = cache.epoch
     base = (store.nonce, region.region_id, scan.table_id)
     hkey = base + (-1, entry.data_version, epoch, bi, _BLOCK)
-    hpair = _device_put_col(hkey, entry.handles[lo:hi], np.ones(hi - lo, bool), _BLOCK, cacheable)
+    hpair = _device_put_col(
+        hkey, lambda: (entry.handles[lo:hi], np.ones(hi - lo, bool)), _BLOCK, cacheable
+    )
     cols_dev = []
     for c in scan.columns:
         if c.is_handle:
             cols_dev.append(hpair)
         else:
-            data, valid = entry.cols[c.column_id]
             ckey = base + (c.column_id, entry.data_version, epoch, bi, _BLOCK)
-            cols_dev.append(_device_put_col(ckey, _narrowed(entry, c.column_id, data[lo:hi]), valid[lo:hi], _BLOCK, cacheable))
+
+            def mk(cid=c.column_id):
+                data, valid = entry.cols[cid]
+                return _narrowed(entry, cid, data[lo:hi]), valid[lo:hi]
+
+            cols_dev.append(_device_put_col(ckey, mk, _BLOCK, cacheable))
     return hpair[0], tuple(cols_dev)
 
 
@@ -312,15 +321,21 @@ def _single_device_inputs(store, scan, cache, entry, region, n_pad):
     epoch = cache.epoch
     cacheable = entry.complete
     hkey = (store.nonce, region.region_id, scan.table_id, -1, entry.data_version, epoch, n_pad)
-    handles_pair = _device_put_col(hkey, entry.handles, np.ones(entry.n, bool), n_pad, cacheable)
+    handles_pair = _device_put_col(
+        hkey, lambda: (entry.handles, np.ones(entry.n, bool)), n_pad, cacheable
+    )
     cols_dev = []
     for c in scan.columns:
         if c.is_handle:
             cols_dev.append(handles_pair)
         else:
-            data, valid = entry.cols[c.column_id]
             ckey = (store.nonce, region.region_id, scan.table_id, c.column_id, entry.data_version, epoch, n_pad)
-            cols_dev.append(_device_put_col(ckey, _narrowed(entry, c.column_id, data), valid, n_pad, cacheable))
+
+            def mk(cid=c.column_id):
+                data, valid = entry.cols[cid]
+                return _narrowed(entry, cid, data), valid
+
+            cols_dev.append(_device_put_col(ckey, mk, n_pad, cacheable))
     return handles_pair[0], cols_dev
 
 

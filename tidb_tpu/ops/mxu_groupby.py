@@ -40,15 +40,11 @@ def _limbs_needed(span: int) -> int:
     return n
 
 
-def grouped_sums_dot(seg, pairs, B: int, n: int, bounds=None):
-    """Exact grouped COUNT/SUM via one int8 MXU matmul per row chunk.
-
-    seg    : (n,) int32 — bucket per row in [0, B); dead rows >= B.
-    pairs  : [(vals int lane, w bool lane)] — w gates each row's contribution.
-    bounds : per pair (lo, hi) proven value bounds or None (int64 envelope).
-    → (counts int64 (B, L), sums int64 (B, L)).
-    """
-    import jax
+def dot_plan(pairs, bounds):
+    """Static lane plan for a pair list: per-lane (bias, limb count, span),
+    the dot's column layout, and the w/limb column assignments. Computed from
+    ONE batch's pair objects; the layout is positional, so the same plan
+    serves every equally-structured batch (the per-block fused kernel)."""
     import jax.numpy as jnp
 
     L = len(pairs)
@@ -66,7 +62,7 @@ def grouped_sums_dot(seg, pairs, B: int, n: int, bounds=None):
             lo, hi = int(info.min), int(info.max)
             if hi - lo >= (1 << 62):
                 raise ValueError("unbounded int64 lane: prove bounds before the dot path")
-        plans.append((lo, _limbs_needed(max(hi - lo, 0))))
+        plans.append((lo, _limbs_needed(max(hi - lo, 0)), max(hi - lo, 0)))
 
     # column layout: [w0, w1, ...] shared per distinct weight lane id, then
     # per pair its limb columns. Dedup: pairs sharing (value id, weight id)
@@ -82,7 +78,7 @@ def grouped_sums_dot(seg, pairs, B: int, n: int, bounds=None):
         w_col_of.append(w_ids[wid])
     limb_cols_of: list[list[int]] = []
     lane_ids: dict[tuple, int] = {}
-    for i, (lo, nl) in enumerate(plans):
+    for i, (lo, nl, _span) in enumerate(plans):
         if plans[i][1] == 1 and bounds[i] is not None and int(bounds[i][0]) == int(bounds[i][1]):
             limb_cols_of.append([])  # constant lane: sum = cnt * lo, no limbs
             continue
@@ -97,7 +93,16 @@ def grouped_sums_dot(seg, pairs, B: int, n: int, bounds=None):
             cols_i.append(len(col_specs))
             col_specs.append(("limb", i, k))
         limb_cols_of.append(cols_i)
-    C = len(col_specs)
+    return (plans, col_specs, w_col_of, limb_cols_of, len(col_specs))
+
+
+def dot_acc(seg, pairs, B: int, n: int, plan, acc=None):
+    """Accumulate one batch's grouped int8 matmuls into ``acc`` (B, C) int64.
+    Chunks internally so the int32 accumulator never overflows."""
+    import jax
+    import jax.numpy as jnp
+
+    plans, col_specs, _w_col_of, _limb_cols_of, C = plan
 
     def build_cols(sl):
         cols = []
@@ -111,35 +116,55 @@ def grouped_sums_dot(seg, pairs, B: int, n: int, bounds=None):
                 _, i, k = spec
                 if i not in shifted:
                     v, w = pairs[i]
-                    lo, nl = plans[i]
-                    vb = jnp.where(w[sl], v[sl].astype(jnp.int64) - lo, 0)
-                    if nl * _LIMB_BITS < 32:
-                        # span proven < 2^31: the limb shifts run in NATIVE
-                        # int32 instead of emulated-pair int64 — the narrow
-                        # compute lane this module exists for
-                        vb = vb.astype(jnp.int32)
+                    lo, nl, span = plans[i]
+                    if (
+                        v.dtype == jnp.int32
+                        and span < (1 << 31)
+                        and -(1 << 31) <= lo
+                    ):
+                        # narrow input lane + proven span: bias-subtract AND
+                        # limb shifts all run NATIVE int32 — no emulated-pair
+                        # int64 op ever touches this lane
+                        vb = jnp.where(w[sl], v[sl] - jnp.int32(lo), 0)
+                    else:
+                        vb = jnp.where(w[sl], v[sl].astype(jnp.int64) - lo, 0)
+                        if span < (1 << 31):
+                            # span proven < 2^31: the limb shifts run in
+                            # NATIVE int32 instead of emulated-pair int64
+                            vb = vb.astype(jnp.int32)
                     shifted[i] = vb
                 cols.append(
                     (((shifted[i] >> (_LIMB_BITS * k)) & _LIMB_MASK) - _LIMB_BIAS).astype(jnp.int8)
                 )
-        return jnp.stack(cols, axis=1)  # (chunk, C)
+        # (C, chunk): the row dimension is the MINOR axis on both operands —
+        # a (chunk, C) layout would pad C up to the 128-lane vreg width and
+        # turn ~150MB of limb bytes into >1GB of HBM traffic per chunk
+        return jnp.stack(cols, axis=0)
 
-    acc = jnp.zeros((B, C), dtype=jnp.int64)
+    if acc is None:
+        acc = jnp.zeros((B, C), dtype=jnp.int64)
     bidx = jnp.arange(B, dtype=jnp.int32)
     for start in range(0, n, _CHUNK):
         sl = slice(start, min(start + _CHUNK, n))
         onehot = (seg[sl][None, :] == bidx[:, None]).astype(jnp.int8)
         limbs = build_cols(sl)
         part = jax.lax.dot_general(
-            onehot, limbs, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
+            onehot, limbs, (((1,), (1,)), ((), ())), preferred_element_type=jnp.int32
         )
         acc = acc + part.astype(jnp.int64)
+    return acc
 
+
+def dot_recombine(acc, plan, L: int, B: int):
+    """(B, C) limb accumulator → exact (counts, sums), both (B, L) int64."""
+    import jax.numpy as jnp
+
+    plans, _col_specs, w_col_of, limb_cols_of, _C = plan
     occ = acc[:, 0]  # rows per bucket (w-independent)
     counts, sums = [], []
     for i in range(L):
         cnt = acc[:, w_col_of[i]]
-        lo, nl = plans[i]
+        lo, nl, _span = plans[i]
         s = jnp.zeros(B, dtype=jnp.int64)
         for k, cidx in enumerate(limb_cols_of[i]):
             # un-bias: every bucket-routed row contributed (limb - 128) to
@@ -149,3 +174,16 @@ def grouped_sums_dot(seg, pairs, B: int, n: int, bounds=None):
         sums.append(s + cnt * lo)
         counts.append(cnt)
     return jnp.stack(counts, axis=1), jnp.stack(sums, axis=1)
+
+
+def grouped_sums_dot(seg, pairs, B: int, n: int, bounds=None):
+    """Exact grouped COUNT/SUM via one int8 MXU matmul per row chunk.
+
+    seg    : (n,) int32 — bucket per row in [0, B); dead rows >= B.
+    pairs  : [(vals int lane, w bool lane)] — w gates each row's contribution.
+    bounds : per pair (lo, hi) proven value bounds or None (int64 envelope).
+    → (counts int64 (B, L), sums int64 (B, L)).
+    """
+    plan = dot_plan(pairs, bounds)
+    acc = dot_acc(seg, pairs, B, n, plan)
+    return dot_recombine(acc, plan, len(pairs), B)
