@@ -9,7 +9,7 @@ import pytest
 import tidb_tpu
 
 
-def _run_all(workers, timeout_s=60):
+def _run_all(workers, timeout_s=180):
     errs = []
 
     def wrap(fn):
@@ -17,7 +17,9 @@ def _run_all(workers, timeout_s=60):
             try:
                 fn()
             except Exception as e:  # pragma: no cover
-                errs.append(e)
+                import traceback
+
+                errs.append((repr(e), traceback.format_exc()))
 
         return go
 
@@ -26,6 +28,10 @@ def _run_all(workers, timeout_s=60):
         t.start()
     for t in threads:
         t.join(timeout=timeout_s)
+    # a silently-unfinished worker would surface later as lost updates —
+    # fail HERE with a clear message instead
+    stuck = [t for t in threads if t.is_alive()]
+    assert not stuck, f"{len(stuck)} workers still running after {timeout_s}s"
     assert not errs, errs[:3]
 
 

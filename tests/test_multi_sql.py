@@ -1,0 +1,164 @@
+"""N SQL nodes over one storage process: schema lease convergence, store-
+backed owner election, and cross-node KILL via global connection ids.
+
+Reference parity: domain/schema_validator.go (a SQL node serves reads only
+within its schema lease and re-syncs at the boundary), pkg/owner/manager.go
+(etcd election → exactly one TTL/stats/GC owner per cluster; here the store
+process plays etcd), util/globalconn + tests/globalkilltest (KILL of a
+global conn id reaches the owning SQL node).
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import tidb_tpu
+from tests.test_sharded_store import _start_raw_server
+
+
+@pytest.fixture(scope="module")
+def store_proc():
+    proc, port = _start_raw_server()
+    yield port
+    if proc.poll() is None:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+@pytest.fixture(scope="module")
+def two_nodes(store_proc):
+    """Two SQL-layer DB handles over ONE store server."""
+    a = tidb_tpu.open(remote=f"127.0.0.1:{store_proc}")
+    b = tidb_tpu.open(remote=f"127.0.0.1:{store_proc}")
+    a.schema_lease_s = b.schema_lease_s = 0.25
+    return a, b
+
+
+def test_ddl_converges_within_schema_lease(two_nodes):
+    a, b = two_nodes
+    sa, sb = a.session(), b.session()
+    sa.execute("CREATE TABLE conv (id BIGINT PRIMARY KEY, v BIGINT)")
+    sa.execute("INSERT INTO conv VALUES (1, 10)")
+    deadline = time.monotonic() + 5.0
+    seen = None
+    while time.monotonic() < deadline:
+        try:
+            seen = sb.execute("SELECT v FROM conv WHERE id = 1").rows
+            break
+        except Exception:
+            time.sleep(0.05)
+    assert seen == [(10,)], "node B must see node A's DDL within the schema lease"
+    # ALTER on B becomes visible on A the same way
+    sb.execute("ALTER TABLE conv ADD COLUMN w BIGINT")
+    deadline = time.monotonic() + 5.0
+    ok = False
+    while time.monotonic() < deadline:
+        try:
+            sa.execute("SELECT w FROM conv WHERE id = 1")
+            ok = True
+            break
+        except Exception:
+            time.sleep(0.05)
+    assert ok, "node A must see node B's ALTER within the schema lease"
+
+
+def test_single_background_owner(two_nodes):
+    """Both nodes run background loops; the store-backed election lets only
+    ONE node per owner key actually sweep."""
+    a, b = two_nodes
+    ran = {"a": 0, "b": 0}
+    got_a = a._owner_gated("ttl", lambda: ran.__setitem__("a", ran["a"] + 1) or {"ran": "a"})
+    got_b = b._owner_gated("ttl", lambda: ran.__setitem__("b", ran["b"] + 1) or {"ran": "b"})
+    assert (ran["a"], ran["b"]) == (1, 0), (got_a, got_b)
+    assert got_b == {"skipped": "not owner"}
+    assert a.store.owner_of("ttl") == a.node_id
+    # the owner resigning hands the lease to the next campaigner
+    a.store.owner_resign("ttl", a.node_id)
+    got_b2 = b._owner_gated("ttl", lambda: {"ran": "b"})
+    assert got_b2 == {"ran": "b"}
+    assert b.store.owner_of("ttl") == b.node_id
+
+
+def test_schema_lease_refuses_reads_when_store_lost():
+    """Past its schema lease with the store UNREACHABLE, a SQL node refuses
+    reads instead of serving a stale catalog (ErrInfoSchemaExpired)."""
+    proc, port = _start_raw_server()
+    try:
+        db = tidb_tpu.open(remote=f"127.0.0.1:{port}")
+        db.schema_lease_s = 0.2
+        s = db.session()
+        s.execute("CREATE TABLE lz (id BIGINT PRIMARY KEY)")
+        assert s.execute("SELECT COUNT(*) FROM lz").rows == [(0,)]
+        proc.kill()
+        proc.wait(timeout=10)
+        time.sleep(0.4)  # sail past the lease
+        with pytest.raises(Exception) as ei:
+            s.execute("SELECT COUNT(*) FROM lz")
+        assert "refusing stale reads" in str(ei.value) or "unreachable" in str(ei.value)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_cross_node_kill(store_proc, two_nodes):
+    """KILL on node A of a query running on node B: the global conn id
+    routes through the store's kill-marker plane to B's poller."""
+    from tidb_tpu.server import Server
+    from tidb_tpu.server.client import Client
+    from tidb_tpu.utils import failpoint
+
+    a, b = two_nodes
+    srv_a = Server(a, port=0)
+    srv_b = Server(b, port=0)
+    port_a = srv_a.start()
+    port_b = srv_b.start()
+    try:
+        assert srv_a.server_id != srv_b.server_id
+        cb = Client("127.0.0.1", port_b)
+        cb.query("CREATE TABLE kt (id BIGINT PRIMARY KEY, v BIGINT)")
+        cb.query("INSERT INTO kt VALUES (1, 1), (2, 2)")
+        parked = threading.Event()
+        release = threading.Event()
+
+        def park(ex):
+            # scope to the victim table: auth/bootstrap reads on OTHER
+            # sessions in this process must not park
+            if ex.plan.table.name != "kt":
+                return
+            parked.set()
+            release.wait(timeout=30)
+
+        failpoint.enable("table_reader_begin", park)
+        errs: list = []
+
+        def victim():
+            try:
+                cb.query("SELECT COUNT(*) FROM kt")
+                errs.append("query finished without being killed")
+            except Exception as e:
+                errs.append(e)
+
+        t = threading.Thread(target=victim, daemon=True)
+        t.start()
+        assert parked.wait(timeout=30), "victim query never reached the reader"
+        # B's conn id from B's processlist — KILL it FROM NODE A
+        rows = srv_b.processlist()
+        vic = next(cid for cid, *_rest, sql in rows if sql and "kt" in sql)
+        assert vic >> Server._GCONN_SHIFT == srv_b.server_id
+        ca = Client("127.0.0.1", port_a)
+        ca.query(f"KILL QUERY {vic}")
+        time.sleep(0.6)  # B's kill poller consumes the marker
+        release.set()
+        t.join(timeout=30)
+        assert errs and not isinstance(errs[0], str), errs
+        assert "interrupt" in str(errs[0]).lower()
+        ca.close()
+        cb.close()
+    finally:
+        failpoint.disable("table_reader_begin")
+        srv_a.close()
+        srv_b.close()

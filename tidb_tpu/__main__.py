@@ -32,11 +32,20 @@ def main(argv=None) -> int:
             pass  # non-main thread (embedded use)
 
     if getattr(args, "store_server", False):
-        import tidb_tpu
         from tidb_tpu.kv.remote import StoreServer
 
-        db = tidb_tpu.open(region_split_keys=cfg.region_split_keys)
-        srv = StoreServer(db.store, host=cfg.host, port=cfg.port)
+        if getattr(args, "raw_store", False):
+            # store-fleet member: an empty store, no embedded SQL bootstrap —
+            # the connecting SQL layer owns meta (replicated per shard by
+            # kv/sharded.py when the fleet has >1 member)
+            from tidb_tpu.kv.memstore import MemStore
+
+            backing = MemStore(region_split_keys=cfg.region_split_keys)
+        else:
+            import tidb_tpu
+
+            backing = tidb_tpu.open(region_split_keys=cfg.region_split_keys).store
+        srv = StoreServer(backing, host=cfg.host, port=cfg.port)
         port = srv.start()
         print(f"ready port={port}", flush=True)
         stop.wait()

@@ -34,6 +34,7 @@ from tidb_tpu.types import TypeKind
 from tidb_tpu.types.datum import date_to_days, datetime_to_micros
 
 META_KEY = b"m:catalog"
+META_VER_KEY = b"m:catalog_ver"  # bare version int (schema-lease fast path)
 META_NEXT_ID = b"m:next_table_id"
 AUTOID_PREFIX = b"m:autoid:"
 AUTOID_BATCH = 5000
@@ -101,6 +102,19 @@ class Catalog:
                 )
         else:
             self.store.raw_put(META_KEY, new)
+        # small side-key: schema-lease checks read ONE integer instead of
+        # deserializing the whole catalog every lease window
+        self.store.raw_put(META_VER_KEY, str(self.schema_version).encode())
+
+    def persisted_version(self) -> int:
+        """The store's current catalog version — the schema-validator lease
+        primitive. Reads the small version key; falls back to the full
+        catalog blob for stores written before the key existed."""
+        raw = self.store.raw_get(META_VER_KEY)
+        if raw is not None:
+            return int(raw)
+        blob = self.store.raw_get(META_KEY)
+        return json.loads(blob.decode()).get("version", 0) if blob else 0
 
     def reload(self) -> None:
         """Re-read the persisted catalog (another process's DDL landed)."""

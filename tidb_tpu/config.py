@@ -95,6 +95,14 @@ def parse_args(argv=None):
         action="store_true",
         help="boot as a STORAGE server process (serves KV + coprocessor + MPP)",
     )
+    p.add_argument(
+        "--raw-store",
+        dest="raw_store",
+        action="store_true",
+        help="with --store-server: serve a RAW empty store (no embedded SQL "
+        "bootstrap) — the store-fleet member role; a SQL layer connecting "
+        "with a multi-endpoint --path shards tables across the fleet",
+    )
     return p.parse_args(argv)
 
 

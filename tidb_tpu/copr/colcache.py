@@ -220,7 +220,11 @@ class ColumnCache:
             return RegionColumns(
                 np.empty(0, np.int64), 0, data_version=data_version, built_ts=read_ts, complete=complete
             )
-        bulk = snap.scan_record_rows(kr)
+        from tidb_tpu.kv.txn import retry_locked
+
+        # a concurrent writer's prewrite lock resolves-and-retries here, the
+        # reader-side ResolveLocks loop (ref: client-go snapshot backoff)
+        bulk = retry_locked(self.store, lambda: snap.scan_record_rows(kr))
         parts = self.store.stable_parts(table_id, kr, read_ts)
         if not parts:
             return RegionColumns(

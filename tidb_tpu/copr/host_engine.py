@@ -90,11 +90,14 @@ def _index_scan(store: MemStore, region: Region, ex: dagpb.ExecutorPB, ranges: l
     fts = [ex.storage_schema[off] for off in ex.index_col_offsets]
     per_col: list[list] = [[] for _ in ex.index_col_offsets]
     handles: list[int] = []
+    from tidb_tpu.kv.txn import retry_locked
+
     for kr in ranges:
         rr = kr.intersect(region.range())
         if rr is None:
             continue
-        for k, v in snap.scan(rr):
+        # reader-side lock resolution (same loop the record scan runs)
+        for k, v in retry_locked(store, lambda rr=rr: snap.scan(rr)):
             off = plen
             for ci in range(len(fts)):
                 val, off = ucodec.decode_key_one(k, off)

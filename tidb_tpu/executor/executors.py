@@ -150,6 +150,11 @@ class TableReaderExec(Executor):
         self.schema = self.plan.schema
 
     def execute(self) -> Chunk:
+        from tidb_tpu.utils import failpoint
+
+        # test hook: park a reader mid-statement (cross-node KILL tests);
+        # receives the executor so hooks can filter by plan/table
+        failpoint.inject("table_reader_begin", self)
         p = self.plan
         if p.table.partition is not None:
             # one request per partition (each is its own physical table —

@@ -355,6 +355,23 @@ class MemStore:
         self.pd = PlacementDriver(self)
         self._client = None  # installed by copr.CopClient wiring
         self.detector = DeadlockDetector()
+        # cluster-singleton election lives WITH the data (ref: etcd-backed
+        # owner.Manager — here the store process is the etcd analog, so N
+        # SQL layers sharing this store elect exactly one TTL/stats/GC/DDL
+        # owner; kv/owner.py holds the lease machinery)
+        from tidb_tpu.kv.owner import OwnerManager
+
+        self.owner_mgr = OwnerManager()
+
+    # -- owner election (ref: pkg/owner/manager.go:49) ----------------------
+    def owner_campaign(self, key: str, node_id: str, lease_s: float | None = None) -> bool:
+        return self.owner_mgr.campaign(key, node_id, lease_s)
+
+    def owner_of(self, key: str):
+        return self.owner_mgr.owner(key)
+
+    def owner_resign(self, key: str, node_id: str) -> None:
+        self.owner_mgr.resign(key, node_id)
 
     # -- kv.Storage surface ------------------------------------------------
     def current_ts(self) -> int:

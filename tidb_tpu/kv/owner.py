@@ -20,6 +20,7 @@ class _Election:
     owner_id: Optional[str] = None
     lease_deadline: float = 0.0
     term: int = 0
+    lease_s: Optional[float] = None  # per-election override of the default
 
 
 class OwnerManager:
@@ -30,17 +31,21 @@ class OwnerManager:
         self._elections: dict[str, _Election] = {}
         self.lease_s = lease_s
 
-    def campaign(self, key: str, node_id: str) -> bool:
+    def campaign(self, key: str, node_id: str, lease_s: Optional[float] = None) -> bool:
         """Try to become the owner of ``key``; re-campaigning refreshes the
-        lease. Returns True when ``node_id`` is (now) the owner."""
+        lease. ``lease_s`` overrides the lease duration for THIS election
+        only (other keys keep the manager default). Returns True when
+        ``node_id`` is (now) the owner."""
         now = time.monotonic()
         with self._mu:
             el = self._elections.setdefault(key, _Election())
+            if lease_s is not None:
+                el.lease_s = lease_s
             if el.owner_id is None or el.owner_id == node_id or now > el.lease_deadline:
                 if el.owner_id != node_id:
                     el.term += 1
                 el.owner_id = node_id
-                el.lease_deadline = now + self.lease_s
+                el.lease_deadline = now + (el.lease_s if el.lease_s is not None else self.lease_s)
                 return True
             return False
 

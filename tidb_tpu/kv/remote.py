@@ -178,6 +178,9 @@ class StoreServer:
         if cmd == "raw_put":
             st.raw_put(_ub(h["key"]), blobs[0])
             return {"ok": 1}, []
+        if cmd == "raw_delete":
+            st.raw_delete(_ub(h["key"]))
+            return {"ok": 1}, []
         if cmd == "raw_cas":
             expected = blobs[0] if h["has_expected"] else None
             ok = st.raw_cas(_ub(h["key"]), expected, blobs[-1])
@@ -229,6 +232,20 @@ class StoreServer:
         if cmd == "rollback":
             st.rollback([_ub(k) for k in h["keys"]], h["start_ts"])
             return {"ok": 1}, []
+        if cmd == "drop_stable":
+            st.drop_stable(h["table_id"])
+            return {"ok": 1}, []
+        if cmd == "owner_campaign":
+            ok = st.owner_campaign(h["key"], h["node_id"], h.get("lease_s"))
+            return {"ok": int(ok)}, []
+        if cmd == "owner_of":
+            return {"owner": st.owner_of(h["key"])}, []
+        if cmd == "owner_resign":
+            st.owner_resign(h["key"], h["node_id"])
+            return {"ok": 1}, []
+        if cmd == "check_txn_status":
+            status, commit_ts = st.check_txn_status(_ub(h["primary"]), h["start_ts"])
+            return {"status": status, "commit_ts": commit_ts}, []
         if cmd == "pessimistic_rollback":
             st.pessimistic_rollback([_ub(k) for k in h["keys"]], h["start_ts"])
             return {"ok": 1}, []
@@ -541,6 +558,9 @@ class RemoteStore:
     def raw_put(self, key: bytes, value: bytes) -> None:
         self._call({"cmd": "raw_put", "key": _b(key)}, [value])
 
+    def raw_delete(self, key: bytes) -> None:
+        self._call({"cmd": "raw_delete", "key": _b(key)})
+
     def raw_cas(self, key: bytes, expected, value: bytes) -> bool:
         blobs = ([expected] if expected is not None else []) + [value]
         h, _ = self._call(
@@ -666,7 +686,32 @@ class RemoteStore:
 
         return decode_chunk(blobs[0])
 
+    def mpp_cancel(self, task_id: str) -> None:
+        self._call({"cmd": "mpp_cancel", "task_id": task_id})
+
+    def drop_stable(self, table_id: int) -> None:
+        """Discard a table's stable columnar blocks (reorg DDL rewrote the
+        rows into the delta layer server-side)."""
+        self._call({"cmd": "drop_stable", "table_id": table_id})
+
+    # -- owner election: the store process is the etcd analog ----------------
+    def owner_campaign(self, key: str, node_id: str, lease_s: Optional[float] = None) -> bool:
+        h, _ = self._call({"cmd": "owner_campaign", "key": key, "node_id": node_id, "lease_s": lease_s})
+        return bool(h["ok"])
+
+    def owner_of(self, key: str):
+        return self._call({"cmd": "owner_of", "key": key})[0]["owner"]
+
+    def owner_resign(self, key: str, node_id: str) -> None:
+        self._call({"cmd": "owner_resign", "key": key, "node_id": node_id})
+
     # -- percolator verbs (ref: unistore mvcc server surface) ---------------
+    def check_txn_status(self, primary: bytes, start_ts: int):
+        """→ ("committed"|"rolled_back"|"locked", commit_ts) — the cross-
+        store lock-resolution primitive (ref: kvproto CheckTxnStatus)."""
+        h, _ = self._call({"cmd": "check_txn_status", "primary": _b(primary), "start_ts": start_ts})
+        return h["status"], h["commit_ts"]
+
     def prewrite(self, mutations: Sequence[Mutation], primary: bytes, start_ts: int) -> None:
         buf = bytearray()
         for m in mutations:

@@ -1,0 +1,347 @@
+"""Multi-store-server topology: N storage processes, one kv.Storage.
+
+Reference parity: the region-sharded TiKV fleet behind one SQL layer — PD
+maps key ranges to store owners (pkg/store/copr/coprocessor.go:334 splits
+cop tasks per region and the region cache routes each to its store), 2PC
+spans stores with a single TSO authority, and MPP tasks are scheduled onto
+the engine nodes that own the data (pkg/planner/core/fragment.go:116).
+
+Placement here is TABLE-granular: every key routes by its table id (meta /
+non-table keys live on shard 0, the PD-analog authority), so one query's cop
+fan-out crosses store processes while each range still has exactly one
+owner. Timestamps come from shard 0's wall-clock TSO; the other shards'
+oracles run on the same physical-time layout ((ms << 18) | logical,
+kv/kv.py:87), so same-host shards are mutually consistent to clock skew —
+the deployment assumption is documented PD behavior, not an accident.
+
+MPP placement rule: a gather is dispatched to the ONE store owning every
+table it reads; a gather spanning owners raises MPPRetryExhausted and the
+session re-plans without MPP (cop scans + host join), mirroring the
+reference's fallback when no engine can serve the fragment set.
+
+Percolator across shards: prewrite/commit/rollback group keys by owner; a
+stuck lock resolves by consulting the PRIMARY key's owner (check_txn_status
+there) and then committing/rolling back the lock on its own owner — the
+cross-store resolve path of pkg/store/mockstore/unistore/tikv/mvcc.go.
+
+Meta replication: the "m"/system keyspace (catalog, DDL jobs, sysvars)
+REPLICATES to every shard on write and reads authoritatively from shard 0 —
+the storage processes resolve MPP gathers against their own catalog copy,
+exactly how TiFlash keeps a synced schema snapshot per engine node (ref:
+the schema-sync the coprocessor's schema-version check relies on).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+from tidb_tpu.kv import tablecodec
+from tidb_tpu.kv.kv import KeyRange, Request, RequestType
+from tidb_tpu.kv.memstore import Lock, Mutation
+
+
+class _ShardedPD:
+    """Region lookup across shards: each owner answers for its own ranges;
+    region ids are namespaced by shard so two stores' region 1s never
+    collide (ref: PD's globally-unique region ids)."""
+
+    _SHARD_BITS = 48
+
+    def __init__(self, store: "ShardedStore"):
+        self._store = store
+
+    def regions_in_ranges(self, ranges: Sequence[KeyRange]):
+        out = []
+        for si, sub in self._store.group_ranges(ranges):
+            for region, krs in self._store.stores[si].pd.regions_in_ranges(sub):
+                region.region_id |= si << self._SHARD_BITS
+                out.append((region, krs))
+        return out
+
+
+class _ShardedSnapshot:
+    def __init__(self, store: "ShardedStore", ts: int):
+        self._store = store
+        self.read_ts = ts
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._store.store_for_key(key).get_snapshot(self.read_ts).get(key)
+
+    def scan(self, kr: KeyRange, limit: int = 2**63, reverse: bool = False):
+        if not ShardedStore.is_table_key(kr.start):
+            # meta keyspace reads come from the authoritative replica
+            return self._store.stores[0].get_snapshot(self.read_ts).scan(
+                kr, limit=limit, reverse=reverse
+            )
+        outs = []
+        for s in self._store.stores:
+            outs.extend(s.get_snapshot(self.read_ts).scan(kr, limit=limit, reverse=reverse))
+        outs.sort(key=lambda kv: kv[0], reverse=reverse)
+        return outs[:limit] if limit < 2**62 else outs
+
+
+class _ShardedCopClient:
+    """Cop fan-out per range OWNER: consecutive same-owner ranges form one
+    sub-request served by that store's own cop client; segment results are
+    emitted in range order so keep-order semantics survive the split."""
+
+    def __init__(self, store: "ShardedStore"):
+        self.store = store
+
+    def send(self, req: Request):
+        from tidb_tpu.copr.client import CopResponse
+
+        assert req.tp == RequestType.DAG
+        segments = self.store.group_ranges(req.ranges, consecutive=True)
+        if len(segments) == 1:
+            si, sub = segments[0]
+            return self.store.stores[si].get_client().send(self._sub(req, sub))
+        responses = [
+            self.store.stores[si].get_client().send(self._sub(req, sub))
+            for si, sub in segments
+        ]
+
+        def gen():
+            for resp in responses:
+                yield from resp.results
+
+        return CopResponse(gen(), None)
+
+    @staticmethod
+    def _sub(req: Request, ranges) -> Request:
+        import copy as _copy
+
+        sub = _copy.copy(req)
+        sub.ranges = list(ranges)
+        return sub
+
+
+class ShardedStore:
+    """kv.Storage over N store servers with table-granular placement."""
+
+    def __init__(self, stores: list, placement: Optional[dict] = None):
+        if not stores:
+            raise ValueError("ShardedStore needs at least one store")
+        self.stores = list(stores)
+        # explicit table_id → shard index; unlisted tables hash by id
+        self.placement = dict(placement or {})
+        self.nonce = "sharded(" + ",".join(s.nonce for s in self.stores) + ")"
+        self.tso = self.stores[0].tso  # single authority (the PD TSO role)
+        self.detector = self.stores[0].detector
+        self.pd = _ShardedPD(self)
+        self._mu = threading.Lock()
+
+    # -- placement ----------------------------------------------------------
+    def shard_of_table(self, table_id: int) -> int:
+        got = self.placement.get(table_id)
+        if got is not None:
+            return got % len(self.stores)
+        return table_id % len(self.stores)
+
+    @staticmethod
+    def is_table_key(key: bytes) -> bool:
+        return key[:1] == tablecodec.TABLE_PREFIX and len(key) >= 9
+
+    def shard_of_key(self, key: bytes) -> int:
+        """Owner shard for reads: table keys by placement, meta keys by the
+        authority (shard 0 holds the authoritative replica)."""
+        if self.is_table_key(key):
+            from tidb_tpu.utils import codec
+
+            return self.shard_of_table(codec.decode_int_raw(key, 1))
+        return 0  # meta / system keyspace: authoritative copy on shard 0
+
+    def write_shards(self, key: bytes) -> list[int]:
+        """Shards a WRITE of ``key`` lands on: one owner for table keys,
+        EVERY shard for meta keys (replicated catalog)."""
+        if self.is_table_key(key):
+            return [self.shard_of_key(key)]
+        return list(range(len(self.stores)))
+
+    def store_for_key(self, key: bytes):
+        return self.stores[self.shard_of_key(key)]
+
+    def group_ranges(self, ranges: Sequence[KeyRange], consecutive: bool = False):
+        """[(shard, [ranges])] — grouped by owner; with ``consecutive`` the
+        original range order is preserved as same-owner runs (keep-order)."""
+        out: list = []
+        for kr in ranges:
+            si = self.shard_of_key(kr.start)
+            if out and out[-1][0] == si:
+                out[-1][1].append(kr)
+            elif not consecutive:
+                for entry in out:
+                    if entry[0] == si:
+                        entry[1].append(kr)
+                        break
+                else:
+                    out.append((si, [kr]))
+            else:
+                out.append((si, [kr]))
+        return out
+
+    # -- kv.Storage surface -------------------------------------------------
+    def current_ts(self) -> int:
+        return self.stores[0].current_ts()
+
+    def raw_get(self, key: bytes):
+        return self.store_for_key(key).raw_get(key)
+
+    def raw_put(self, key: bytes, value: bytes) -> None:
+        for si in self.write_shards(key):
+            self.stores[si].raw_put(key, value)
+
+    def raw_delete(self, key: bytes) -> None:
+        for si in self.write_shards(key):
+            self.stores[si].raw_delete(key)
+
+    def raw_cas(self, key: bytes, expected, value: bytes) -> bool:
+        # the authority decides; replicas follow on success (meta keys only)
+        shards = self.write_shards(key)
+        ok = self.stores[shards[0]].raw_cas(key, expected, value)
+        if ok:
+            for si in shards[1:]:
+                self.stores[si].raw_put(key, value)
+        return ok
+
+    def raw_scan(self, kr: KeyRange, limit: int = 2**62):
+        if not self.is_table_key(kr.start):
+            # meta keyspace: authoritative replica only (fanning would
+            # surface every shard's copy of the same row)
+            return self.stores[0].raw_scan(kr, limit=limit)
+        outs = []
+        for s in self.stores:
+            outs.extend(s.raw_scan(kr, limit=limit))
+        outs.sort(key=lambda kv: kv[0])
+        return outs[:limit]
+
+    def run_gc(self, safe_point=None, life_ms: int = 600_000):
+        pruned = 0
+        sp = None
+        for s in self.stores:
+            p, spt = s.run_gc(safe_point, life_ms)
+            pruned += p
+            sp = spt if sp is None else min(sp, spt)
+        return pruned, sp or 0
+
+    def get_snapshot(self, ts: int) -> _ShardedSnapshot:
+        return _ShardedSnapshot(self, ts)
+
+    def begin(self):
+        from tidb_tpu.kv.txn import Txn
+
+        return Txn(self)
+
+    def get_client(self) -> _ShardedCopClient:
+        return _ShardedCopClient(self)
+
+    # -- percolator verbs, grouped by owner (meta writes fan to every
+    # replica; the lock/commit state converges via the shared primary) ------
+    def _group_keys(self, keys: Sequence[bytes]):
+        by: dict[int, list] = {}
+        for k in keys:
+            for si in self.write_shards(k):
+                by.setdefault(si, []).append(k)
+        return by.items()
+
+    def prewrite(self, mutations: Sequence[Mutation], primary: bytes, start_ts: int) -> None:
+        by: dict[int, list] = {}
+        for m in mutations:
+            for si in self.write_shards(m.key):
+                by.setdefault(si, []).append(m)
+        for si, muts in by.items():
+            self.stores[si].prewrite(muts, primary, start_ts)
+
+    def commit(self, keys: Sequence[bytes], start_ts: int, commit_ts: int) -> None:
+        for si, ks in self._group_keys(keys):
+            self.stores[si].commit(ks, start_ts, commit_ts)
+
+    def rollback(self, keys: Sequence[bytes], start_ts: int) -> None:
+        for si, ks in self._group_keys(keys):
+            self.stores[si].rollback(ks, start_ts)
+
+    def check_txn_status(self, primary: bytes, start_ts: int):
+        return self.store_for_key(primary).check_txn_status(primary, start_ts)
+
+    def resolve_lock(self, key: bytes, lock: Lock) -> None:
+        key_shard = self.shard_of_key(key)
+        primary_shard = self.shard_of_key(lock.primary)
+        if key_shard == primary_shard:
+            self.stores[key_shard].resolve_lock(key, lock)
+            return
+        # cross-shard: the primary's owner is the source of truth
+        status, commit_ts = self.stores[primary_shard].check_txn_status(lock.primary, lock.start_ts)
+        if status == "committed":
+            self.stores[key_shard].commit([key], lock.start_ts, commit_ts)
+        elif status == "rolled_back":
+            self.stores[key_shard].rollback([key], lock.start_ts)
+        # "locked": primary still alive → caller backs off and retries
+
+    def acquire_pessimistic_lock(self, keys, primary, start_ts, for_update_ts, wait_timeout_ms=3000):
+        by: dict[int, list] = {}
+        for k in keys:
+            by.setdefault(self.shard_of_key(k), []).append(k)
+        for si, ks in by.items():
+            self.stores[si].acquire_pessimistic_lock(ks, primary, start_ts, for_update_ts, wait_timeout_ms)
+
+    def pessimistic_rollback(self, keys: Sequence[bytes], start_ts: int) -> None:
+        for si, ks in self._group_keys(keys):
+            self.stores[si].pessimistic_rollback(ks, start_ts)
+
+    # -- bulk ingest --------------------------------------------------------
+    def ingest(self, keys: Sequence[bytes], values: Sequence[bytes]) -> int:
+        by: dict[int, tuple[list, list]] = {}
+        for k, v in zip(keys, values):
+            e = by.setdefault(self.shard_of_key(k), ([], []))
+            e[0].append(k)
+            e[1].append(v)
+        ts = 0
+        for si, (ks, vs) in by.items():
+            ts = max(ts, self.stores[si].ingest(ks, vs))
+        return ts
+
+    def ingest_columnar(self, table_id: int, handles, cols, schema, dicts=None, on_existing=None) -> int:
+        return self.stores[self.shard_of_table(table_id)].ingest_columnar(
+            table_id, handles, cols, schema, dicts, on_existing
+        )
+
+    def drop_stable(self, table_id: int) -> None:
+        self.stores[self.shard_of_table(table_id)].drop_stable(table_id)
+
+    # -- owner election: the authority shard is the etcd analog --------------
+    def owner_campaign(self, key: str, node_id: str, lease_s: Optional[float] = None) -> bool:
+        return self.stores[0].owner_campaign(key, node_id, lease_s)
+
+    def owner_of(self, key: str):
+        return self.stores[0].owner_of(key)
+
+    def owner_resign(self, key: str, node_id: str) -> None:
+        self.stores[0].owner_resign(key, node_id)
+
+    # -- MPP: single-owner placement ----------------------------------------
+    def mpp_ndev(self) -> int:
+        return self.stores[0].mpp_ndev()
+
+    def _mpp_owner(self, spec: dict) -> int:
+        owners = {self.shard_of_table(r["tid"]) for r in spec.get("readers", [])}
+        if len(owners) != 1:
+            from tidb_tpu.parallel.probe import MPPRetryExhausted
+
+            raise MPPRetryExhausted(
+                f"MPP gather reads tables on {len(owners)} store shards; "
+                "single-owner placement required (falls back to cop + host join)"
+            )
+        return owners.pop()
+
+    def mpp_dispatch(self, spec: dict, read_ts: int) -> str:
+        owner = self._mpp_owner(spec)
+        return f"{owner}:{self.stores[owner].mpp_dispatch(spec, read_ts)}"
+
+    def mpp_conn(self, task_id: str, check_killed=None):
+        owner, _, tid = task_id.partition(":")
+        return self.stores[int(owner)].mpp_conn(tid, check_killed=check_killed)
+
+    def mpp_cancel(self, task_id: str) -> None:
+        owner, _, tid = task_id.partition(":")
+        self.stores[int(owner)].mpp_cancel(tid)

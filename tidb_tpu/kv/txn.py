@@ -72,6 +72,24 @@ class MemBuffer:
         return len(self._buf)
 
 
+def retry_locked(store, fn, max_retries: int = 16):
+    """Run ``fn``, resolving any pending lock it trips over and backing off
+    while the lock's holder is still alive — the reader-side
+    Backoffer+ResolveLocks loop every kv read path needs (ref: client-go's
+    snapshot reads; a reader surfacing KeyLocked raw would make every scan
+    race concurrent writers)."""
+    import time
+
+    for i in range(max_retries):
+        try:
+            return fn()
+        except KeyLockedError as e:
+            store.resolve_lock(e.key, e.lock)
+            if i > 0:
+                time.sleep(min(0.001 * (1 << i), 0.1))  # backoff while lock holder lives
+    raise TxnAbortedError("lock resolution did not converge")
+
+
 class Txn:
     """One transaction. Reads go to a start_ts snapshot overlaid with the
     membuffer; commit runs percolator 2PC against the store. In pessimistic
@@ -145,16 +163,7 @@ class Txn:
         return sorted(base.items())[:limit]
 
     def _retry_locked(self, fn, max_retries: int = 16):
-        import time
-
-        for i in range(max_retries):
-            try:
-                return fn()
-            except KeyLockedError as e:
-                self.store.resolve_lock(e.key, e.lock)
-                if i > 0:
-                    time.sleep(min(0.001 * (1 << i), 0.1))  # backoff while lock holder lives
-        raise TxnAbortedError("lock resolution did not converge")
+        return retry_locked(self.store, fn, max_retries)
 
     # -- writes ------------------------------------------------------------
     def put(self, key: bytes, value: bytes) -> None:

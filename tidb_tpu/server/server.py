@@ -325,6 +325,45 @@ class Server:
             # linger on disk
             shutil.rmtree(d, ignore_errors=True)
 
+    # global connection ids: (server_id << _GCONN_SHIFT) | local id — every
+    # SQL node's ids are cluster-unique, so KILL routes across nodes (ref:
+    # pkg/util/globalconn/globalconn.go)
+    _GCONN_SHIFT = 24
+    _GSRV_NEXT = b"gsrv:next"
+    _GSRV_REG = b"gsrv:reg:"
+    _GKILL = b"gkill:"
+
+    # MySQL's handshake carries a 4-byte thread id, so server ids must stay
+    # under 2^(32 - _GCONN_SHIFT) — dead registrations are REUSED first
+    _GSRV_MAX = (1 << (32 - 24)) - 1  # 255
+
+    def _alloc_server_id(self) -> int:
+        """Cluster-unique server id from the store (the PD allocation role);
+        an embedded bench store without raw_cas just gets id 1. Ids of
+        closed servers (blank registration) are reclaimed so a long-lived
+        store never exhausts the 8-bit id space."""
+        store = self.db.store
+        if not hasattr(store, "raw_cas"):
+            return 1
+        from tidb_tpu.kv.kv import KeyRange
+
+        # reclaim a dead slot: registration blanked by close_registration
+        for k, v in store.raw_scan(KeyRange(self._GSRV_REG, self._GSRV_REG + b"\xff")):
+            if v == b"":
+                sid = int(k[len(self._GSRV_REG):])
+                if store.raw_cas(k, b"", b"alive"):
+                    return sid
+        while True:
+            raw = store.raw_get(self._GSRV_NEXT)
+            nxt = int(raw) if raw else 1
+            if nxt > self._GSRV_MAX:
+                raise RuntimeError(
+                    f"server id space exhausted ({self._GSRV_MAX} live SQL nodes)"
+                )
+            if store.raw_cas(self._GSRV_NEXT, raw, str(nxt + 1).encode()):
+                store.raw_put(self._GSRV_REG + str(nxt).encode(), b"alive")
+                return nxt
+
     def start(self) -> int:
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -332,8 +371,11 @@ class Server:
         s.listen(64)
         self.port = s.getsockname()[1]
         self._lsock = s
+        self.server_id = self._alloc_server_id()
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._accept_thread.start()
+        self._kill_thread = threading.Thread(target=self._kill_poll_loop, daemon=True)
+        self._kill_thread.start()
         return self.port
 
     def _accept_loop(self) -> None:
@@ -343,11 +385,69 @@ class Server:
             except OSError:
                 return
             with self._mu:
-                cid = self._next_id
-                self._next_id += 1
+                # wrap the local counter inside its 24-bit field, skipping
+                # still-live ids — a bleed into the server-id bits would
+                # misroute cross-node KILL
+                local_mask = (1 << self._GCONN_SHIFT) - 1
+                while True:
+                    local = self._next_id & local_mask
+                    self._next_id = (self._next_id + 1) & local_mask or 1
+                    cid = (self.server_id << self._GCONN_SHIFT) | local
+                    if local and cid not in self._conns:
+                        break
                 conn = ClientConn(self, sock, cid)
                 self._conns[cid] = conn
             threading.Thread(target=conn.run, daemon=True).start()
+
+    # -- cross-node KILL (ref: tests/globalkilltest; util/globalconn) --------
+    def _kill_poll_loop(self) -> None:
+        """Consume kill markers addressed to this server id: another SQL
+        node's KILL of a global conn id lands as a store row this node's
+        poller picks up (the store replaces etcd as the signalling plane)."""
+        import time as _t
+
+        from tidb_tpu.kv.kv import KeyRange
+
+        store = self.db.store
+        while not self._stopping:
+            _t.sleep(0.2)
+            try:
+                rows = store.raw_scan(KeyRange(self._GKILL, self._GKILL + b"\xff"))
+                for k, v in rows:
+                    if not v:
+                        continue  # consumed
+                    try:
+                        cid = int(k[len(self._GKILL):])
+                    except ValueError:
+                        continue
+                    if cid >> self._GCONN_SHIFT != self.server_id:
+                        continue
+                    self.kill(cid, query_only=v == b"q")
+                    store.raw_delete(k)  # consumed markers must not pile up
+            except ConnectionError:
+                continue  # store briefly unreachable: retry next tick
+
+    def kill_global(self, conn_id: int, query_only: bool = True) -> bool:
+        """KILL for a conn id this node does not own: post a marker the
+        owning node's poller consumes. True if the target server is known."""
+        store = self.db.store
+        if not hasattr(store, "raw_scan"):
+            return False
+        sid = conn_id >> self._GCONN_SHIFT
+        if sid == self.server_id:
+            # our own prefix and Server.kill already failed → the conn is
+            # gone; posting a marker to ourselves would fake success
+            return False
+        if store.raw_get(self._GSRV_REG + str(sid).encode()) != b"alive":
+            return False
+        store.raw_put(self._GKILL + str(conn_id).encode(), b"q" if query_only else b"c")
+        return True
+
+    def close_registration(self) -> None:
+        try:
+            self.db.store.raw_put(self._GSRV_REG + str(self.server_id).encode(), b"")
+        except ConnectionError:
+            pass
 
     def _conn_event(self, event: str, conn: "ClientConn") -> None:
         exts = getattr(self.db, "extensions", None)
@@ -395,6 +495,8 @@ class Server:
 
     def close(self) -> None:
         self._stopping = True
+        if getattr(self, "server_id", None) is not None:
+            self.close_registration()
         if self._lsock is not None:
             try:
                 self._lsock.close()

@@ -10,6 +10,7 @@ operators host-side (ref: UnionScanExec merging membuffer over snapshot).
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Optional
@@ -340,6 +341,10 @@ class Session:
                 sql = bound[1]
                 stmt = parse(sql)
         self._stmt_count += 1
+        # schema-validator lease: cross-node DDL becomes visible at most one
+        # lease behind; past the lease with an unreachable store the node
+        # refuses to answer from its stale catalog
+        self._db.ensure_schema_lease()
         if not isinstance(stmt, ast.Show):  # SHOW WARNINGS must see them
             self._prev_warnings = self.warnings
             self.warnings = []
@@ -584,9 +589,13 @@ class Session:
             return self._grant(stmt)
         if isinstance(stmt, ast.Kill):
             server = getattr(self._db, "server", None)
-            if server is None or not server.kill(stmt.conn_id, stmt.query_only):
-                raise SessionError(f"Unknown thread id: {stmt.conn_id}")
-            return Result()
+            if server is not None and server.kill(stmt.conn_id, stmt.query_only):
+                return Result()
+            # not local: route by the global conn id's server prefix (ref:
+            # util/globalconn — KILL works across SQL nodes)
+            if server is not None and server.kill_global(stmt.conn_id, stmt.query_only):
+                return Result()
+            raise SessionError(f"Unknown thread id: {stmt.conn_id}")
         if isinstance(stmt, ast.ImportInto):
             from tidb_tpu.tools.importer import import_into, import_into_disttask
 
@@ -1397,6 +1406,16 @@ class DB:
         self.catalog = Catalog(self.store)
         self.global_vars: dict[str, Any] = {}
         self._mu = threading.Lock()
+        # this SQL node's cluster identity (owner campaigns, schema lease)
+        import uuid as _uuid
+
+        self.node_id = _uuid.uuid4().hex[:12]
+        # schema-validator lease (ref: domain/schema_validator.go): a SQL
+        # node re-checks the persisted catalog version at most this often;
+        # past the lease with an UNREACHABLE store it refuses reads rather
+        # than serve a stale catalog
+        self.schema_lease_s = 1.5
+        self._schema_checked = time.monotonic()
         from tidb_tpu.kv.gcworker import GCWorker
         from tidb_tpu.statistics import StatsHandle
 
@@ -1461,16 +1480,68 @@ class DB:
 
         return run_ttl_once(self)
 
+    def ensure_schema_lease(self) -> None:
+        """Schema-validator lease check, run per statement: within the lease
+        the cached catalog serves reads; past it, the persisted version is
+        re-checked (cross-node DDL becomes visible here, bounded by the
+        lease) and an UNREACHABLE store makes this node refuse the read
+        instead of answering from a stale catalog (ref:
+        domain/schema_validator.go ErrInfoSchemaExpired)."""
+        now = time.monotonic()
+        if now - self._schema_checked <= self.schema_lease_s:
+            return
+        try:
+            ver = self.catalog.persisted_version()
+        except ConnectionError as e:
+            raise SessionError(
+                f"schema validator lease expired and the store is unreachable ({e}); refusing stale reads"
+            )
+        if ver != self.catalog.schema_version:
+            self.catalog.reload()
+        self._schema_checked = time.monotonic()
+
+    def _owner_gated(self, key: str, fn):
+        """Run ``fn`` only while this node holds the cluster-singleton lease
+        for ``key`` — with a store-backed election, N SQL nodes sharing one
+        store run each background owner exactly once (ref: owner.Manager
+        campaigns guarding the domain workers). A keepalive refreshes the
+        lease while ``fn`` runs, so a sweep longer than the lease cannot
+        lose the singleton mid-flight (the etcd session-keepalive role)."""
+        campaign = getattr(self.store, "owner_campaign", None)
+        if campaign is None:
+            return fn()
+        if not campaign(key, self.node_id):
+            return {"skipped": "not owner"}
+        done = threading.Event()
+
+        def keepalive():
+            while not done.wait(2.0):
+                try:
+                    campaign(key, self.node_id)
+                except ConnectionError:
+                    return
+
+        ka = threading.Thread(target=keepalive, daemon=True, name=f"owner-ka-{key}")
+        ka.start()
+        try:
+            return fn()
+        finally:
+            done.set()
+
     def start_background(self, ttl_interval_s: float = 60, analyze_interval_s: float = 60, gc_interval_s: float = 120) -> None:
         """Start the Domain-style background loops (ref: domain.Start —
-        TTL, auto-analyze, GC workers on the timer framework)."""
+        TTL, auto-analyze, GC workers on the timer framework). Each sweep
+        first campaigns for its owner key, so only one SQL node per cluster
+        actually runs it."""
         from tidb_tpu.utils.timer import TimerRuntime
 
         if getattr(self, "timers", None) is None:
             self.timers = TimerRuntime()
-        self.timers.register("ttl", ttl_interval_s, self.run_ttl)
-        self.timers.register("auto_analyze", analyze_interval_s, self.run_auto_analyze)
-        self.timers.register("gc", gc_interval_s, self.run_gc)
+        self.timers.register("ttl", ttl_interval_s, lambda: self._owner_gated("ttl", self.run_ttl))
+        self.timers.register(
+            "auto_analyze", analyze_interval_s, lambda: self._owner_gated("stats", self.run_auto_analyze)
+        )
+        self.timers.register("gc", gc_interval_s, lambda: self._owner_gated("gc", self.run_gc))
         self.timers.start()
 
     def stop_background(self) -> None:
@@ -1514,10 +1585,20 @@ class DB:
 
 def open_db(region_split_keys: int = 500_000, remote: "str | None" = None) -> DB:
     """``remote="host:port"`` attaches this process as a SQL layer to a
-    running kv.remote.StoreServer instead of embedding a MemStore."""
+    running kv.remote.StoreServer instead of embedding a MemStore. A comma-
+    separated list ("h1:p1,h2:p2") shards the keyspace across N store
+    servers (table-granular placement, kv/sharded.py)."""
     if remote is not None:
         from tidb_tpu.kv.remote import RemoteStore
 
-        host, _, port = remote.rpartition(":")
-        return DB(store=RemoteStore(host or "127.0.0.1", int(port)))
+        endpoints = [e.strip() for e in remote.split(",") if e.strip()]
+        stores = []
+        for ep in endpoints:
+            host, _, port = ep.rpartition(":")
+            stores.append(RemoteStore(host or "127.0.0.1", int(port)))
+        if len(stores) == 1:
+            return DB(store=stores[0])
+        from tidb_tpu.kv.sharded import ShardedStore
+
+        return DB(store=ShardedStore(stores))
     return DB(region_split_keys=region_split_keys)
