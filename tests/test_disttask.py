@@ -239,3 +239,20 @@ def test_import_rerun_idempotent_pk_and_partitioned(tmp_path):
     import_rows_slice(db, "test", "ph", prow, handle_base=base, on_existing="skip")
     assert db.query("SELECT COUNT(*) FROM ph") == [(90,)]
     assert db.query("SELECT SUM(v) FROM ph") == [(sum(range(90)),)]
+
+
+def test_import_verify_on_indexed_table_txn_path():
+    """on_existing='verify' must hold on the TXN fallback path too (tables
+    with secondary indexes bypass columnar ingest): identical re-runs are
+    idempotent, conflicting rows raise instead of silently overwriting."""
+    from tidb_tpu.tools.importer import import_rows_slice
+
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE ivt (id BIGINT PRIMARY KEY, v BIGINT, KEY kv (v))")
+    rows = [[str(i), str(i * 3)] for i in range(50)]
+    import_rows_slice(db, "test", "ivt", rows, on_existing="verify")
+    import_rows_slice(db, "test", "ivt", rows, on_existing="verify")
+    assert db.query("SELECT COUNT(*) FROM ivt") == [(50,)]
+    with pytest.raises(Exception, match="duplicate key"):
+        import_rows_slice(db, "test", "ivt", [["7", "1234"]], on_existing="verify")
+    assert db.query("SELECT v FROM ivt WHERE id = 7") == [(21,)]

@@ -142,6 +142,28 @@ def test_mpp_dispatched_to_store_server(remote):
     assert len(rows) == 7 and sum(r[1] for r in rows) > 0
 
 
+def test_remote_mpp_carries_warnings(remote):
+    """Warnings born inside the storage server's MPP task (division by 0 in
+    an agg argument) must cross mpp_conn back into THIS session — the
+    per-SelectResponse warning carriage of the reference (tipb)."""
+    _, db = remote
+    s = db.session()
+    s.execute("CREATE TABLE IF NOT EXISTS wmp (id BIGINT PRIMARY KEY, g BIGINT, z BIGINT)")
+    s.execute("DELETE FROM wmp")
+    s.execute("INSERT INTO wmp VALUES " + ", ".join(f"({i}, {i % 3}, {i % 2})" for i in range(60)))
+    s.execute("ANALYZE TABLE wmp")
+    s.execute("SET tidb_enforce_mpp = 1")
+    try:
+        lines = "\n".join(r[0] for r in s.query("EXPLAIN SELECT g, SUM(id / z) FROM wmp GROUP BY g ORDER BY g"))
+        assert "PhysMPPGather" in lines, lines
+        rows = s.execute("SELECT g, SUM(id / z) FROM wmp GROUP BY g ORDER BY g").rows
+        warns = s.execute("SHOW WARNINGS").rows
+        assert len(rows) == 3
+        assert any(w[1] == 1365 for w in warns), warns
+    finally:
+        s.execute("SET tidb_enforce_mpp = 0")
+
+
 def test_mpp_remote_txn_dirty_falls_back(remote):
     """The server cannot see this session's uncommitted buffer — a dirty
     transaction must fall back to the host path and still see its own
@@ -204,3 +226,4 @@ def test_killing_the_remote_mid_query_surfaces(remote):
     assert not t.is_alive(), "query thread hung after server death"
     assert errs, "killing the store mid-query must surface an error"
     assert isinstance(errs[0], (ConnectionError, RuntimeError, OSError)), errs[0]
+

@@ -93,6 +93,11 @@ class Catalog:
             "recycle": self._recycle,
         }
         new = json.dumps(pb).encode()
+        # version side-key FIRST: the hint may run AHEAD of the blob (a
+        # too-new hint merely triggers a harmless reload) but must never lag
+        # it — a crash after the blob-cas with a stale hint would hide the
+        # DDL from every other node's schema-lease check indefinitely
+        self.store.raw_put(META_VER_KEY, str(self.schema_version).encode())
         if hasattr(self.store, "raw_cas"):
             if not self.store.raw_cas(META_KEY, raw, new):
                 self.schema_version -= 1
@@ -102,9 +107,6 @@ class Catalog:
                 )
         else:
             self.store.raw_put(META_KEY, new)
-        # small side-key: schema-lease checks read ONE integer instead of
-        # deserializing the whole catalog every lease window
-        self.store.raw_put(META_VER_KEY, str(self.schema_version).encode())
 
     def persisted_version(self) -> int:
         """The store's current catalog version — the schema-validator lease

@@ -93,7 +93,7 @@ class CopClient:
         concurrency = max(1, min(req.concurrency, len(tasks)))
 
         def run(task: CopTask) -> CopResult:
-            chunk = engine(self.store, dag, task.region, task.ranges, read_ts)
+            chunk = engine(self.store, dag, task.region, task.ranges, read_ts, warn=req.warn)
             return CopResult(chunk, task.task_id, task.region.region_id)
 
         if concurrency == 1 or len(tasks) == 1:

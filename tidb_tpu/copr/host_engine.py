@@ -237,8 +237,8 @@ def bit_reduce(op: str, data: np.ndarray, valid: np.ndarray, seg: np.ndarray, ng
     return out
 
 
-def _aggregate(chunk: Chunk, ex: dagpb.ExecutorPB) -> Chunk:
-    batch = EvalBatch.from_chunk(chunk)
+def _aggregate(chunk: Chunk, ex: dagpb.ExecutorPB, warn=None) -> Chunk:
+    batch = EvalBatch.from_chunk(chunk, warn=warn)
     gcols = [eval_to_column(expr_from_pb(pb), batch, np) for pb in ex.group_by]
     aggs = [AggDesc.from_pb(pb) for pb in ex.aggs]
     n = len(chunk)
@@ -477,20 +477,20 @@ def _window(chunk: Chunk, ex: dagpb.ExecutorPB) -> Chunk:
     return WindowExec(plan, _ChunkChild(), None).execute()
 
 
-def run_operators(chunk: Chunk, executors: list, output_offsets: list[int]) -> Chunk:
+def run_operators(chunk: Chunk, executors: list, output_offsets: list[int], warn=None) -> Chunk:
     """Apply post-scan DAG operators to a materialized chunk — shared by the
     per-region host path and the union-scan (dirty-txn) path."""
     for ex in executors:
         if ex.tp == dagpb.SELECTION:
-            chunk = _selection(chunk, ex.conditions)
+            chunk = _selection(chunk, ex.conditions, warn=warn)
         elif ex.tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG):
-            chunk = _aggregate(chunk, ex)
+            chunk = _aggregate(chunk, ex, warn=warn)
         elif ex.tp == dagpb.TOPN:
             chunk = _topn(chunk, ex)
         elif ex.tp == dagpb.LIMIT:
             chunk = chunk.slice(0, min(ex.limit, len(chunk)))
         elif ex.tp == dagpb.PROJECTION:
-            batch = EvalBatch.from_chunk(chunk)
+            batch = EvalBatch.from_chunk(chunk, warn=warn)
             chunk = Chunk([eval_to_column(expr_from_pb(pb), batch, np) for pb in ex.exprs])
         elif ex.tp == dagpb.WINDOW:
             chunk = _window(chunk, ex)
@@ -501,10 +501,10 @@ def run_operators(chunk: Chunk, executors: list, output_offsets: list[int]) -> C
     return chunk
 
 
-def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int) -> Chunk:
+def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int, warn=None) -> Chunk:
     assert dag.executors and dag.executors[0].tp in (dagpb.TABLE_SCAN, dagpb.INDEX_SCAN)
     if dag.executors[0].tp == dagpb.INDEX_SCAN:
         chunk = _index_scan(store, region, dag.executors[0], ranges, read_ts)
     else:
         chunk = _scan(store, region, dag.executors[0], ranges, read_ts)
-    return run_operators(chunk, dag.executors[1:], dag.output_offsets)
+    return run_operators(chunk, dag.executors[1:], dag.output_offsets, warn=warn)

@@ -257,28 +257,40 @@ def _probe_slice_rows(packed_list: list, kernel):
     return [int(m[0]) for m in metas], sliced
 
 
-def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int):
+def _emit_kernel_warnings(buf, kernel, warn) -> None:
+    """Device warning counts ride the kernel's meta row (extra packed
+    outputs — see dag_kernel._DeviceWarnSink); convert nonzero counts back
+    into session warnings, capped like MySQL's max_error_count."""
+    if warn is None:
+        return
+    for code, msg, slot in kernel.warn_specs:
+        cnt = int(buf[0, slot]) if slot < buf.shape[0 if buf.ndim == 1 else 1] else 0
+        for _ in range(min(cnt, 64)):
+            warn("Warning", code, msg)
+
+
+def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int, warn=None):
     try:
-        return _execute_dag_device(store, dag, region, ranges, read_ts)
+        return _execute_dag_device(store, dag, region, ranges, read_ts, warn)
     except UnsupportedForDevice:
         # the planner's legality gate keeps most host-only shapes off this
         # engine; anything it misses (unbindable constants, unpackable window
         # sorts) falls back to the host engine — the TiKV-serves-it role
-        return host_execute_dag(store, dag, region, ranges, read_ts)
+        return host_execute_dag(store, dag, region, ranges, read_ts, warn)
 
 
-def _execute_dag_device(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int):
+def _execute_dag_device(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int, warn=None):
     scan = dag.executors[0]
     if scan.desc:
         # descending scans are order-sensitive row streams — the sorted-batch
         # kernel has no cheap equivalent; delegate to the host engine
-        return host_execute_dag(store, dag, region, ranges, read_ts)
+        return host_execute_dag(store, dag, region, ranges, read_ts, warn)
     if len(ranges) > MAX_RANGES:
         # many-range tasks are point-lookup workloads (index joins, batch
         # gets): a covering-span fallback would degrade to a full scan, and
         # the host engine slices exactly the requested handles from the same
         # column cache — the TiKV-serves-point-reads role
-        return host_execute_dag(store, dag, region, ranges, read_ts)
+        return host_execute_dag(store, dag, region, ranges, read_ts, warn)
     schema = RowSchema(scan.storage_schema)
     slots = [c.column_id for c in scan.columns if not c.is_handle]
     cache = cache_for(store)
@@ -298,20 +310,20 @@ def _execute_dag_device(store: MemStore, dag: dagpb.DAGRequest, region: Region, 
     if has_window and entry.n > _BLOCK:
         # windows need every row of a partition in one computation — blocks
         # cannot run independently; fuse them into one multi-block program
-        return _exec_fused_blocks(store, dag, bound, scan, cache, entry, region, rarr)
+        return _exec_fused_blocks(store, dag, bound, scan, cache, entry, region, rarr, warn)
     if _should_fuse_agg(dag, entry):
         # aggregations over big tables fuse every block into ONE kernel
         # dispatch: the per-dispatch cost through the device link (~2-3ms
         # each, measured) would otherwise multiply by the block count, and
         # a single program needs no partial-merge pass over block results
-        return _exec_fused_blocks(store, dag, bound, scan, cache, entry, region, rarr)
+        return _exec_fused_blocks(store, dag, bound, scan, cache, entry, region, rarr, warn)
     agg_complete = any(
         ex.tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG) and ex.agg_mode == dagpb.AGG_COMPLETE
         for ex in dag.executors[1:]
     )
     if entry.n > _BLOCK and not agg_complete:
-        return _exec_blocks(store, dag, bound, scan, cache, entry, region, rarr)
-    return _exec_single(store, dag, bound, scan, cache, entry, region, rarr)
+        return _exec_blocks(store, dag, bound, scan, cache, entry, region, rarr, warn)
+    return _exec_single(store, dag, bound, scan, cache, entry, region, rarr, warn)
 
 
 def _single_device_inputs(store, scan, cache, entry, region, n_pad):
@@ -339,7 +351,7 @@ def _single_device_inputs(store, scan, cache, entry, region, n_pad):
     return handles_pair[0], cols_dev
 
 
-def _exec_single(store, dag, bound, scan, cache, entry, region, rarr) -> Chunk:
+def _exec_single(store, dag, bound, scan, cache, entry, region, rarr, warn=None) -> Chunk:
     """Small regions (≤ one block) or COMPLETE-mode aggs: one padded array,
     one kernel invocation — the round-1 path, preserved verbatim."""
     import jax
@@ -374,10 +386,11 @@ def _exec_single(store, dag, bound, scan, cache, entry, region, rarr) -> Chunk:
             agg_cap = min(agg_cap * 4, n_pad)
             continue
         break
+    _emit_kernel_warnings(buf, kernel, warn)
     return _chunk_from_bufs(buf, fbuf, count, kernel, dag, cache, scan)
 
 
-def _exec_blocks(store, dag, bound, scan, cache, entry, region, rarr):
+def _exec_blocks(store, dag, bound, scan, cache, entry, region, rarr, warn=None):
     """Large regions: fixed-shape device blocks, one compile per DAG.
 
     Aggs/TopN dispatch every block asynchronously and stack the packed
@@ -412,16 +425,16 @@ def _exec_blocks(store, dag, bound, scan, cache, entry, region, rarr):
             return kernel.fn(handles_dev, cols_dev, rarr_j, jnp.asarray(nvalids[bi]))
 
         if limit_last:
-            out = _blocks_paged_limit(run_block, len(bounds), kernel, dag, cache, scan)
+            out = _blocks_paged_limit(run_block, len(bounds), kernel, dag, cache, scan, warn)
         else:
-            out = _blocks_stacked(run_block, len(bounds), kernel, dag, cache, scan)
+            out = _blocks_stacked(run_block, len(bounds), kernel, dag, cache, scan, warn)
         if out is None:  # agg overflow in some block
             agg_cap = min(agg_cap * 4, _BLOCK)
             continue
         return out
 
 
-def _blocks_stacked(run_block, nb: int, kernel, dag, cache, scan):
+def _blocks_stacked(run_block, nb: int, kernel, dag, cache, scan, warn=None):
     """Dispatch all blocks async; stack results on-device; one transfer.
     Returns None on agg-cap overflow (caller re-runs with a bigger cap)."""
     import jax
@@ -436,6 +449,7 @@ def _blocks_stacked(run_block, nb: int, kernel, dag, cache, scan):
         chunks = []
         for cnt, got in zip(counts, fetched):
             buf, fbuf = got if tup else (got, None)
+            _emit_kernel_warnings(buf, kernel, warn)
             chunks.append(_chunk_from_bufs(buf, fbuf, cnt, kernel, dag, cache, scan))
         return _concat_chunks(chunks)
     ibufs = [p[0] if tup else p for p in packed]
@@ -452,11 +466,12 @@ def _blocks_stacked(run_block, nb: int, kernel, dag, cache, scan):
     for b in range(nb):
         buf = bi_all[b]
         fbuf = bf_all[b] if bf_all is not None else None
+        _emit_kernel_warnings(buf, kernel, warn)
         chunks.append(_chunk_from_bufs(buf, fbuf, int(buf[0, 0]), kernel, dag, cache, scan))
     return _concat_chunks(chunks)
 
 
-def _exec_fused_blocks(store, dag, bound, scan, cache, entry, region, rarr):
+def _exec_fused_blocks(store, dag, bound, scan, cache, entry, region, rarr, warn=None):
     """Whole-region DAGs (windows, aggregations) over large regions: ONE
     fused multi-block program, one dispatch.
 
@@ -497,10 +512,11 @@ def _exec_fused_blocks(store, dag, bound, scan, cache, entry, region, rarr):
             agg_cap = min(agg_cap * 4, n_total)
             continue
         break
+    _emit_kernel_warnings(buf, kernel, warn)
     return _chunk_from_bufs(buf, fbuf, count, kernel, dag, cache, scan)
 
 
-def _blocks_paged_limit(run_block, nb: int, kernel, dag, cache, scan):
+def _blocks_paged_limit(run_block, nb: int, kernel, dag, cache, scan, warn=None):
     """LIMIT-last: stream blocks with grow-on-demand lookahead, stop once the
     limit is satisfiable (ref: paging page-size growth, copr/coprocessor.go:368)."""
     import jax
@@ -522,6 +538,7 @@ def _blocks_paged_limit(run_block, nb: int, kernel, dag, cache, scan):
         for got_b in fetched:
             buf, fbuf = got_b if tup else (got_b, None)
             cnt = int(buf[0, 0])
+            _emit_kernel_warnings(buf, kernel, warn)
             chunks.append(_chunk_from_bufs(buf, fbuf, cnt, kernel, dag, cache, scan))
             got += cnt
         bi += len(batch)

@@ -271,6 +271,7 @@ class TableReaderExec(Executor):
             concurrency=getattr(self, "_conc_override", None)
             or int(self.session.vars.get("tidb_distsql_scan_concurrency", 8)),
             keep_order=p.keep_order,
+            warn=self.session.append_warning,
         )
         client = self.session.store.get_client()
         # gather through a spillable container accounted against the query's

@@ -82,9 +82,29 @@ def bulk_load(db: DB, table_name: str, columns: Sequence[Sequence], db_name: str
         else:
             base = db.catalog.alloc_autoid(t.id, j - i)
             handles = range(base, base + (j - i))
+        existing: dict = {}
+        if on_existing == "verify":
+            # duplicate-PK conflict surfacing on the txn path too — ONE
+            # snapshot scan over the batch's handle span replaces a per-row
+            # point get (which would be one RPC per row on a remote store)
+            hs = list(handles)
+            if hs:
+                span = tablecodec.handle_range(t.id, int(min(hs)), int(max(hs)))
+                snap = db.store.get_snapshot(db.store.current_ts())
+                existing = dict(snap.scan(span))
         for r, h in zip(range(i, j), handles):
             vals = [phys_cols[c][r] for c in range(ncols)]
-            txn.put(tablecodec.record_key(t.id, int(h)), encode_row(schema, vals))
+            rk = tablecodec.record_key(t.id, int(h))
+            row = encode_row(schema, vals)
+            if on_existing == "verify":
+                prev = existing.get(rk)
+                if prev is not None:
+                    if prev == row:
+                        continue  # idempotent re-run: identical row
+                    raise ValueError(
+                        f"duplicate key conflict on handle {int(h)}: existing row differs"
+                    )
+            txn.put(rk, row)
             for idx in t.indexes:
                 if idx.state == "delete_only":
                     continue  # writes don't maintain delete-only indexes

@@ -107,21 +107,28 @@ def _mul(xp, args, ctx):
 
 def _warn_div0(xp, ctx, nz, va, vb):
     """MySQL 1365 per offending row (ref: stmtctx.AppendWarning via
-    builtin_arithmetic division). Host numpy eval only — a jitted trace
-    cannot count data-dependent events."""
+    builtin_arithmetic division). On the host the count is concrete and
+    warnings append immediately; under a jitted trace the count is a traced
+    scalar handed to a device warn sink (dag_kernel packs it into the
+    kernel's meta row as an extra output — the "overflow/invalid masks as
+    kernel outputs" device-warning channel)."""
     import numpy as _np
 
     warn = getattr(ctx, "warn", None)
-    if warn is None or xp is not _np:
+    if warn is None:
         return
-    bad = ~_np.asarray(nz)
+    bad = ~xp.asarray(nz)
     for v in (va, vb):
         if v is not None and v is not True:
-            bad = bad & _np.asarray(v)
-    # a scalar-constant zero denominator offends EVERY row of the batch
-    cnt = int(bad.sum()) if bad.ndim else (ctx.n if bool(bad) else 0)
-    for _ in range(cnt):
-        warn("Warning", 1365, "Division by 0")
+            bad = bad & xp.asarray(v)
+    if xp is _np:
+        # a scalar-constant zero denominator offends EVERY row of the batch
+        cnt = int(bad.sum()) if bad.ndim else (ctx.n if bool(bad) else 0)
+        for _ in range(cnt):
+            warn("Warning", 1365, "Division by 0")
+        return
+    if hasattr(warn, "add_traced"):  # device sink: traced per-row count
+        warn.add_traced(1365, "Division by 0", xp.sum(bad))
 
 
 @register("div", infer_div)

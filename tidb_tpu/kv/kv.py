@@ -55,6 +55,10 @@ class Request:
     # partition pushdown: list of (physical_table_id, ranges) like
     # kv.Request.PartitionIDAndRanges (kv.go:544)
     partition_ranges: list[tuple[int, list[KeyRange]]] = field(default_factory=list)
+    # per-statement warning sink ``warn(level, code, msg)`` — engine-side
+    # warnings (cast truncation, division by 0) travel back to the session
+    # like the reference's per-SelectResponse warnings (tipb.SelectResponse)
+    warn: Any = None
 
 
 class Response(Protocol):

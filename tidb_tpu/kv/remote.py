@@ -334,12 +334,12 @@ class StoreServer:
             return {"task_id": task_id}, []
         if cmd == "mpp_conn":
             # EstablishMPPConns analog: long-poll for the merged result frame
-            done, blob, kind, msg = self._mpp_mgr().conn(h["task_id"], h.get("wait_s", 1.0))
+            done, blob, kind, msg, warns = self._mpp_mgr().conn(h["task_id"], h.get("wait_s", 1.0))
             if not done:
                 return {"done": 0}, []
             if kind:
                 return {"done": 1, "err_kind": kind, "msg": msg}, []
-            return {"done": 1}, [blob]
+            return {"done": 1, "warnings": warns}, [blob]
         if cmd == "mpp_cancel":
             self._mpp_mgr().cancel(h["task_id"])
             return {"ok": 1}, []
@@ -353,8 +353,14 @@ class StoreServer:
             region = next(r for r in st.regions() if r.region_id == h["region_id"])
             ranges = [KeyRange(_ub(a), _ub(b)) for a, b in h["ranges"]]
             engine = _engines()[StoreType(h["store_type"])]
-            chunk = engine(st, dag, region, ranges, h["read_ts"])
-            return {"ok": 1}, [encode_chunk(chunk)]
+            # engine warnings ride the response header, the per-
+            # SelectResponse warning carriage of the reference (tipb)
+            warns: list = []
+            chunk = engine(
+                st, dag, region, ranges, h["read_ts"],
+                warn=lambda lv, code, msg: len(warns) < 64 and warns.append([lv, code, msg]),
+            )
+            return {"ok": 1, "warnings": warns}, [encode_chunk(chunk)]
         raise ValueError(f"unknown command {cmd!r}")
 
 
@@ -478,6 +484,9 @@ class _RemoteCopClient:
                     "store_type": req.store_type.value,
                 }
             )
+            if req.warn is not None:
+                for lv, code, msg in h.get("warnings", ()):
+                    req.warn(lv, code, msg)
             return CopResult(unify(decode_chunk(blobs[0])), ti, region.region_id)
 
         items = list(enumerate(tasks))
@@ -653,7 +662,7 @@ class RemoteStore:
         h, _ = self._call({"cmd": "mpp_dispatch", "spec": spec, "read_ts": read_ts})
         return h["task_id"]
 
-    def mpp_conn(self, task_id: str, check_killed=None):
+    def mpp_conn(self, task_id: str, check_killed=None, warn=None):
         """Block until the task's merged chunk arrives (long-poll loop so a
         client-side KILL propagates as mpp_cancel). Raises the task's error
         with its original kind when the server reports one."""
@@ -684,6 +693,9 @@ class RemoteStore:
             )
         from tidb_tpu.utils.chunk import decode_chunk
 
+        if warn is not None:
+            for lv, code, msg in h.get("warnings", ()):
+                warn(lv, code, msg)
         return decode_chunk(blobs[0])
 
     def mpp_cancel(self, task_id: str) -> None:

@@ -52,11 +52,17 @@ class _ShardedPD:
         self._store = store
 
     def regions_in_ranges(self, ranges: Sequence[KeyRange]):
+        import copy as _copy
+
         out = []
         for si, sub in self._store.group_ranges(ranges):
             for region, krs in self._store.stores[si].pd.regions_in_ranges(sub):
-                region.region_id |= si << self._SHARD_BITS
-                out.append((region, krs))
+                # namespace on a COPY: in-process stores hand out their live
+                # Region objects, and mutating those would corrupt the
+                # store's own metadata (cache keys, plan-cache versions)
+                r2 = _copy.copy(region)
+                r2.region_id = region.region_id | (si << self._SHARD_BITS)
+                out.append((r2, krs))
         return out
 
 
@@ -72,6 +78,13 @@ class _ShardedSnapshot:
         if not ShardedStore.is_table_key(kr.start):
             # meta keyspace reads come from the authoritative replica
             return self._store.stores[0].get_snapshot(self.read_ts).scan(
+                kr, limit=limit, reverse=reverse
+            )
+        one = self._store.single_owner(kr)
+        if one is not None:
+            # the whole range lives on one owner (the common per-table scan):
+            # no reason to pay N-1 always-empty fan-out RPCs
+            return self._store.stores[one].get_snapshot(self.read_ts).scan(
                 kr, limit=limit, reverse=reverse
             )
         outs = []
@@ -162,6 +175,21 @@ class ShardedStore:
     def store_for_key(self, key: bytes):
         return self.stores[self.shard_of_key(key)]
 
+    def single_owner(self, kr: KeyRange) -> Optional[int]:
+        """The one shard owning the WHOLE range, or None when it spans
+        tables on different owners (fan-out required)."""
+        if not self.is_table_key(kr.start):
+            return None
+        from tidb_tpu.utils import codec
+
+        t0 = codec.decode_int_raw(kr.start, 1)
+        if self.is_table_key(kr.end):
+            t1 = codec.decode_int_raw(kr.end, 1)
+            # the end bound may be the exclusive prefix of the NEXT table
+            if t1 not in (t0, t0 + 1) and kr.end > tablecodec.table_prefix(t0 + 1):
+                return None
+        return self.shard_of_table(t0)
+
     def group_ranges(self, ranges: Sequence[KeyRange], consecutive: bool = False):
         """[(shard, [ranges])] — grouped by owner; with ``consecutive`` the
         original range order is preserved as same-owner runs (keep-order)."""
@@ -210,6 +238,9 @@ class ShardedStore:
             # meta keyspace: authoritative replica only (fanning would
             # surface every shard's copy of the same row)
             return self.stores[0].raw_scan(kr, limit=limit)
+        one = self.single_owner(kr)
+        if one is not None:
+            return self.stores[one].raw_scan(kr, limit=limit)
         outs = []
         for s in self.stores:
             outs.extend(s.raw_scan(kr, limit=limit))
@@ -338,9 +369,9 @@ class ShardedStore:
         owner = self._mpp_owner(spec)
         return f"{owner}:{self.stores[owner].mpp_dispatch(spec, read_ts)}"
 
-    def mpp_conn(self, task_id: str, check_killed=None):
+    def mpp_conn(self, task_id: str, check_killed=None, warn=None):
         owner, _, tid = task_id.partition(":")
-        return self.stores[int(owner)].mpp_conn(tid, check_killed=check_killed)
+        return self.stores[int(owner)].mpp_conn(tid, check_killed=check_killed, warn=warn)
 
     def mpp_cancel(self, task_id: str) -> None:
         owner, _, tid = task_id.partition(":")

@@ -78,6 +78,25 @@ def _mxu_aggs_ok(aggs, arg_bounds=()) -> bool:
     return True
 
 
+class _DeviceWarnSink:
+    """Collects TRACED warning counts during a kernel trace — the device
+    analog of stmtctx.AppendWarning. Each (code, msg) site contributes one
+    traced scalar; the kernel packs them into its meta row as extra outputs
+    and the engine converts nonzero counts back into session warnings.
+    Counts are per-row over valid lanes; rows a later mask drops may be
+    included (MySQL itself is loose about warning multiplicity)."""
+
+    def __init__(self):
+        self.items: list = []  # [(code, msg, traced_count)]
+
+    def add_traced(self, code: int, msg: str, cnt) -> None:
+        self.items.append((code, msg, cnt))
+
+    def __call__(self, level, code, msg):  # host-style calls inside a trace
+        # concrete (trace-time constant) warnings: count 1 per call
+        self.items.append((code, msg, 1))
+
+
 @dataclass
 class CompiledKernel:
     fn: Callable  # (handles, cols, ranges, nvalid) -> packed buffer(s)
@@ -96,6 +115,10 @@ class CompiledKernel:
     @property
     def valid_loc(self):  # per-output row index of the valid lane (int buffer)
         return self._lanes["vloc"]
+
+    @property
+    def warn_specs(self):  # [(code, msg, meta_slot)] packed at meta[slot]
+        return self._lanes.get("warns", ())
 
 
 _COMPILE_CACHE: dict[tuple, CompiledKernel] = {}
@@ -368,6 +391,13 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
 
     blockwise_doms = _static_dot_route()
 
+    # per-trace device warn sink (holder survives into _pack; kernel() resets
+    # it at trace start, so each compile owns exactly its own counts)
+    warn_holder: list = []
+
+    def _cur_dws():
+        return warn_holder[-1] if warn_holder else None
+
     def _blockwise_dot(handles_blocks, cols_blocks, ranges, nvalid):
         from tidb_tpu.ops.mxu_groupby import dot_acc, dot_plan, dot_recombine
 
@@ -398,8 +428,8 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                 (d.astype(jnp.int64) if jnp.issubdtype(d.dtype, jnp.integer) else d, v)
                 for d, v in cols_nw_b
             )
-            batch_b = EvalBatch(list(cols64_b), [None] * len(cols64_b), n_pad)
-            batch_nw_b = EvalBatch(list(cols_nw_b), [None] * len(cols_nw_b), n_pad)
+            batch_b = EvalBatch(list(cols64_b), [None] * len(cols64_b), n_pad, warn=_cur_dws())
+            batch_nw_b = EvalBatch(list(cols_nw_b), [None] * len(cols_nw_b), n_pad, warn=_cur_dws())
             for ex, pre in zip(executors[1:-1], parsed[:-1]):
                 nok = getattr(ex, "narrow_ok", [])
                 for ci_, cond in enumerate(pre):
@@ -437,6 +467,8 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
 
     def kernel(handles, cols, ranges, nvalid):
         n = n_total
+        warn_holder.clear()
+        warn_holder.append(_DeviceWarnSink())
         if nb > 1 and blockwise_doms is not None:
             # agg-last DAG on the MXU dot: per-block accumulation, no concat
             return _blockwise_dot(handles, cols, ranges, nvalid)
@@ -475,11 +507,11 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                 lo, hi = ranges[r, 0], ranges[r, 1]
                 mask = mask | ((handles >= lo) & (handles < hi))
             mask = mask & live  # padding rows are never live
-        batch = EvalBatch([(d, v) for d, v in cols], [None] * len(cols), n)
+        batch = EvalBatch([(d, v) for d, v in cols], [None] * len(cols), n, warn=_cur_dws())
         # storage-dtype view for binder-proven narrow evals; only valid while
         # ColumnRefs still address scan outputs (the binder stamps flags only
         # then, so stale use is impossible by construction)
-        batch_nw = EvalBatch([(d, v) for d, v in cols_nw], [None] * len(cols_nw), n)
+        batch_nw = EvalBatch([(d, v) for d, v in cols_nw], [None] * len(cols_nw), n, warn=_cur_dws())
         kind = "rows"
         count = None
         ngroups = None
@@ -764,7 +796,7 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                 gvalid_slot = gslot < ngroups
                 out_valid = [ov & gvalid_slot for ov in out_valid]
                 # rebuild batch in case more executors follow
-                batch = EvalBatch([(d, v) for d, v in zip(out_data, out_valid)], [None] * len(out_data), out_len)
+                batch = EvalBatch([(d, v) for d, v in zip(out_data, out_valid)], [None] * len(out_data), out_len, warn=_cur_dws())
                 batch_nw = batch  # lanes rebuilt: the storage-dtype view is stale
                 mask = gvalid_slot
                 kind = "agg"
@@ -849,6 +881,7 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                         [(_bcast(d2, cur_n)[head], _vmask(v2, cur_n)[head]) for d2, v2 in batch.cols],
                         batch.dicts,
                         K,
+                        warn=_cur_dws(),
                     )
                     batch_nw = batch  # lanes rebuilt: storage-dtype view stale
                     count = jnp.minimum(limit, mask.sum())
@@ -876,6 +909,7 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                     [(_bcast(d, cur_n)[head], _vmask(v, cur_n)[head]) for d, v in batch.cols],
                     batch.dicts,
                     head_n,
+                    warn=_cur_dws(),
                 )
                 batch_nw = batch  # lanes rebuilt: storage-dtype view stale
                 count = jnp.minimum(limit, mask.sum())
@@ -897,6 +931,7 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                     [(_bcast(d, cur_n)[head], _vmask(v, cur_n)[head]) for d, v in batch.cols],
                     batch.dicts,
                     len(head),
+                    warn=_cur_dws(),
                 )
                 batch_nw = batch  # lanes rebuilt: storage-dtype view stale
                 count = jnp.minimum(ex.limit, mask.sum())
@@ -908,7 +943,7 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                 for e in pre:
                     d, v, _ = eval_expr(e, batch, jnp)
                     new_cols.append((_bcast(d, cur_n), _vmask(v, cur_n)))
-                batch = EvalBatch(new_cols, [None] * len(new_cols), cur_n)
+                batch = EvalBatch(new_cols, [None] * len(new_cols), cur_n, warn=_cur_dws())
                 batch_nw = batch  # lanes rebuilt: the storage-dtype view is stale
             elif ex.tp == dagpb.WINDOW:
                 from tidb_tpu.ops.window_core import window_program
@@ -974,7 +1009,7 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                 else:
                     inv = jnp.argsort(perm)
                     new_cols = base_cols + [(d[inv], v[inv]) for d, v in outs]
-                batch = EvalBatch(new_cols, list(batch.dicts) + [None] * len(outs), n)
+                batch = EvalBatch(new_cols, list(batch.dicts) + [None] * len(outs), n, warn=_cur_dws())
                 batch_nw = batch  # lanes rebuilt: the storage-dtype view is stale
 
         # final packaging; ngroups travels out so the caller can detect
@@ -1011,11 +1046,20 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
         vloc: list = []
         ilanes: list = []
         flanes: list = []
+        dws = _cur_dws()
+        witems = list(dws.items) if dws is not None else []
         L = max((int(d.shape[0]) if d.ndim else 1) for d, _ in outs) if outs else 2
-        L = max(L, 2)
+        # meta row: [count, ngroups, warn counts...] — device warnings ride
+        # the SAME packed transfer as the data (no extra fetch round trip)
+        L = max(L, 2 + len(witems))
         meta = jnp.zeros(L, dtype=jnp.int64)
         meta = meta.at[0].set(jnp.asarray(count, dtype=jnp.int64))
         meta = meta.at[1].set(jnp.asarray(og, dtype=jnp.int64))
+        for wi, (_code, _msg, cnt) in enumerate(witems):
+            meta = meta.at[2 + wi].set(jnp.asarray(cnt, dtype=jnp.int64))
+        lanes_holder["warns"] = tuple(
+            (code, msg, 2 + wi) for wi, (code, msg, _c) in enumerate(witems)
+        )
         ilanes.append(meta)
         for d, v in outs:
             d = jnp.asarray(d)

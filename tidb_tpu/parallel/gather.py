@@ -851,7 +851,9 @@ class MPPGatherExec:
         spec = gather_to_pb(self.plan, cap, schema_ver=sess._db.catalog.schema_version)
         store = sess.store
         task_id = store.mpp_dispatch(spec, sess.read_ts())
-        return store.mpp_conn(task_id, check_killed=sess.check_killed)
+        return store.mpp_conn(
+            task_id, check_killed=sess.check_killed, warn=sess.append_warning
+        )
 
     def _execute_attempt(self, mesh):
         import jax.numpy as jnp
@@ -977,11 +979,15 @@ class MPPGatherExec:
         ncols = [len(r.schema) for r in p.readers]
         n_lanes, lane_of = self._lane_maps()
 
+        from tidb_tpu.ops.dag_kernel import _DeviceWarnSink
+
+        warn_sink = _DeviceWarnSink()
+
         def side_selection(cond_list, nc):
             def fn(*cols):
                 pairs = [(cols[2 * i], cols[2 * i + 1]) for i in range(nc)]
                 live = cols[2 * nc]
-                batch = EvalBatch(pairs, [None] * nc, pairs[0][0].shape[0])
+                batch = EvalBatch(pairs, [None] * nc, pairs[0][0].shape[0], warn=warn_sink)
                 m = live
                 for cond in cond_list:
                     d, v, _ = eval_expr(cond, batch, jnp)
@@ -1005,7 +1011,7 @@ class MPPGatherExec:
             pairs = [
                 (joined[lane_of[i]], joined[lane_of[i] + 1]) for i in range(total_cols)
             ]
-            batch = EvalBatch(pairs, [None] * len(pairs), pairs[0][0].shape[0])
+            batch = EvalBatch(pairs, [None] * len(pairs), pairs[0][0].shape[0], warn=warn_sink)
             out = []
             if not agg.group_by:
                 # scalar aggregate: one synthetic constant group key so the
@@ -1174,8 +1180,8 @@ class MPPGatherExec:
                 repr([a.to_pb() for a in agg.aggs]) if agg is not None else "",
                 tuple(ncols),
             )
-            fn = _MPP_FN_CACHE.get(fn_key)
-            if fn is None:
+            cached = _MPP_FN_CACHE.get(fn_key)
+            if cached is None:
                 fn = build_dist_pipeline(
                     mesh,
                     join_specs,
@@ -1184,19 +1190,37 @@ class MPPGatherExec:
                     selections=selections,
                     agg_inputs=agg_inputs if agg is not None else None,
                     topn=topn_spec,
+                    warn_sink=warn_sink,
                 )
-                _MPP_FN_CACHE[fn_key] = fn
+                # the sink is baked into the compiled program's closures: a
+                # cache hit must attribute warn counts via the ORIGINAL sink
+                _MPP_FN_CACHE[fn_key] = (fn, warn_sink)
                 while len(_MPP_FN_CACHE) > 64:
                     _MPP_FN_CACHE.pop(next(iter(_MPP_FN_CACHE)))
+            else:
+                fn, warn_sink = cached
             outs = fn(*all_lanes)
             # ONE device→host round trip for every output lane: device_get
             # batches the whole tuple into a single transfer
             import jax
 
             arrs = list(jax.device_get(outs))
+            wtotal = int(arrs.pop())  # the warn-count slot (always present)
             dropped = int(arrs[-2])
             overflow = int(arrs[-1])
             if dropped == 0 and overflow == 0:
+                # emit only for the SUCCESSFUL attempt — grow-and-retry
+                # attempts re-run the same rows and would duplicate warnings
+                if wtotal > 0:
+                    # single-slot attribution: the traced sites' (code, msg) —
+                    # one distinct code covers the practical case (div0);
+                    # emit up to the MySQL warning cap
+                    seen_codes = list(dict.fromkeys((c, m) for c, m, _ in warn_sink.items)) or [
+                        (1365, "Division by 0")
+                    ]
+                    code, msg = seen_codes[0]
+                    for _ in range(min(wtotal, 64)):
+                        self.session.append_warning("Warning", code, msg)
                 break
             # grow-on-overflow, like coprocessor paging (skewed owners can
             # exceed either side's 2× headroom; the counters are shared, so

@@ -406,6 +406,7 @@ def build_dist_pipeline(
     selections: Sequence[Callable | None],
     agg_inputs: Callable | None = None,
     topn: "DistTopNSpec | None" = None,
+    warn_sink=None,
 ):
     """The generalized MPP pipeline in ONE jitted shard_map (ref: §3.3 —
     fragments: scan→sel→[exchange→join]*→(partial agg→hash exchange→merge |
@@ -563,9 +564,21 @@ def build_dist_pipeline(
                 overflow = overflow + of
                 mask = newmask
                 acc = out_l + out_r
-        if agg is not None:
-            return _agg_tail(acc, mask, dropped, overflow)
-        return _topn_tail(acc, mask, dropped, overflow)
+        outs = (
+            _agg_tail(acc, mask, dropped, overflow)
+            if agg is not None
+            else _topn_tail(acc, mask, dropped, overflow)
+        )
+        if warn_sink is not None:
+            # device warnings born inside the fragment (division by 0 in a
+            # selection/agg argument) ride ONE replicated count output —
+            # psum across shards, converted back to session warnings by the
+            # gather (the per-SelectResponse warning carriage)
+            wtotal = jnp.int64(0)
+            for _code, _msg, c in warn_sink.items:
+                wtotal = wtotal + jnp.asarray(c, jnp.int64)
+            outs = (*outs, jax.lax.psum(wtotal, "dp"))
+        return outs
 
     def _topn_tail(joined, mask, dropped, overflow):
         n = mask.shape[0]
@@ -672,11 +685,12 @@ def build_dist_pipeline(
             n_rep = agg.n_keys + len(agg.sums) + 1
     else:
         n_rep = 2 * len(topn.out_lanes) + 1
+    extra = (P(),) if warn_sink is not None else ()
     fn = jax.shard_map(
         step,
         mesh=mesh,
         in_specs=tuple(P("dp") for _ in range(sum(n_lanes))),
-        out_specs=(P(None),) * n_rep + (P(), P(), P()),
+        out_specs=(P(None),) * n_rep + (P(), P(), P()) + extra,
         check_vma=False,
     )
     return jax.jit(fn)

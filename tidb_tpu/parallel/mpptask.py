@@ -276,17 +276,20 @@ class MPPTaskManager:
         return task_id
 
     def conn(self, task_id: str, wait_s: float):
-        """(done, blob, err_kind, err_msg). Long-poll: blocks up to
-        ``wait_s`` so the client loop can interleave KILL checks."""
+        """(done, blob, err_kind, err_msg, warnings). Long-poll: blocks up
+        to ``wait_s`` so the client loop can interleave KILL checks."""
         with self._mu:
             task = self._tasks.get(task_id)
         if task is None:
-            return True, None, "ValueError", f"unknown mpp task {task_id}"
+            return True, None, "ValueError", f"unknown mpp task {task_id}", ()
         if not task["ev"].wait(wait_s):
-            return False, None, None, None
+            return False, None, None, None, ()
         with self._mu:
             self._tasks.pop(task_id, None)
-        return True, task["blob"], task["kind"], task["err"]
+        # the task session's accumulated warnings travel back with the result
+        # (ref: per-SelectResponse warning carriage)
+        warns = [[lv, code, msg] for lv, code, msg in task["sess"].warnings[:64]]
+        return True, task["blob"], task["kind"], task["err"], warns
 
     def cancel(self, task_id: str) -> None:
         with self._mu:

@@ -675,6 +675,13 @@ class Session:
         s.user, s.host = "root", "%"
         return s
 
+    @staticmethod
+    def _sq(v) -> str:
+        """Escape a value for single-quoted INTERNAL SQL: user/host names can
+        contain quotes, and the privileged internal session must not be
+        injectable through them."""
+        return str(v).replace("\\", "\\\\").replace("'", "\\'")
+
     def _create_user(self, stmt: ast.CreateUser) -> Result:
         from tidb_tpu.privilege import ALL_PRIVS, encode_password_with
 
@@ -683,17 +690,17 @@ class Session:
         s = self._internal_root()
         for u in stmt.users:
             exists = s.query(
-                f"SELECT 1 FROM mysql.user WHERE User = '{u.name}' AND Host = '{u.host}'"
+                f"SELECT 1 FROM mysql.user WHERE User = '{self._sq(u.name)}' AND Host = '{self._sq(u.host)}'"
             )
             if exists:
                 if stmt.if_not_exists:
                     continue
-                raise SessionError(f"Operation CREATE USER failed for '{u.name}'@'{u.host}'")
+                raise SessionError(f"Operation CREATE USER failed for '{self._sq(u.name)}'@'{self._sq(u.host)}'")
             if u.plugin not in ("mysql_native_password", "caching_sha2_password"):
                 raise SessionError(f"unknown auth plugin {u.plugin!r}")
             ns = ", ".join(["'N'"] * len(ALL_PRIVS))
             s.execute(
-                f"INSERT INTO mysql.user VALUES ('{u.host}', '{u.name}', "
+                f"INSERT INTO mysql.user VALUES ('{self._sq(u.host)}', '{self._sq(u.name)}', "
                 f"'{encode_password_with(u.password, u.plugin)}', '{u.plugin}', {ns})"
             )
         self._db.priv_version += 1
@@ -707,11 +714,11 @@ class Session:
         s = self._internal_root()
         for u in stmt.users:
             if not s.query(
-                f"SELECT 1 FROM mysql.user WHERE User = '{u.name}' AND Host = '{u.host}'"
+                f"SELECT 1 FROM mysql.user WHERE User = '{self._sq(u.name)}' AND Host = '{self._sq(u.host)}'"
             ):
                 if stmt.if_exists:
                     continue
-                raise SessionError(f"Operation ALTER USER failed for '{u.name}'@'{u.host}'")
+                raise SessionError(f"Operation ALTER USER failed for '{self._sq(u.name)}'@'{self._sq(u.host)}'")
             if not u.has_auth:
                 continue  # no IDENTIFIED clause: leave the credential alone
             if u.plugin not in ("mysql_native_password", "caching_sha2_password"):
@@ -719,7 +726,7 @@ class Session:
             s.execute(
                 f"UPDATE mysql.user SET authentication_string = "
                 f"'{encode_password_with(u.password, u.plugin)}', plugin = '{u.plugin}' "
-                f"WHERE User = '{u.name}' AND Host = '{u.host}'"
+                f"WHERE User = '{self._sq(u.name)}' AND Host = '{self._sq(u.host)}'"
             )
         self._db.priv_version += 1
         return Result()
@@ -730,12 +737,12 @@ class Session:
         s = self._internal_root()
         for u in stmt.users:
             n = s.execute(
-                f"DELETE FROM mysql.user WHERE User = '{u.name}' AND Host = '{u.host}'"
+                f"DELETE FROM mysql.user WHERE User = '{self._sq(u.name)}' AND Host = '{self._sq(u.host)}'"
             ).affected
             if not n and not stmt.if_exists:
-                raise SessionError(f"Operation DROP USER failed for '{u.name}'@'{u.host}'")
-            s.execute(f"DELETE FROM mysql.db WHERE User = '{u.name}' AND Host = '{u.host}'")
-            s.execute(f"DELETE FROM mysql.tables_priv WHERE User = '{u.name}' AND Host = '{u.host}'")
+                raise SessionError(f"Operation DROP USER failed for '{self._sq(u.name)}'@'{self._sq(u.host)}'")
+            s.execute(f"DELETE FROM mysql.db WHERE User = '{self._sq(u.name)}' AND Host = '{self._sq(u.host)}'")
+            s.execute(f"DELETE FROM mysql.tables_priv WHERE User = '{self._sq(u.name)}' AND Host = '{self._sq(u.host)}'")
         self._db.priv_version += 1
         return Result()
 
@@ -746,27 +753,27 @@ class Session:
         self._db.ensure_priv_bootstrap()
         privs = [p for p in ALL_PRIVS if p != "super"] if stmt.privs == ["all"] else stmt.privs
         s = self._internal_root()
-        if not s.query(f"SELECT 1 FROM mysql.user WHERE User = '{stmt.user}' AND Host = '{stmt.host}'"):
-            raise SessionError(f"unknown user '{stmt.user}'@'{stmt.host}'")
+        if not s.query(f"SELECT 1 FROM mysql.user WHERE User = '{self._sq(stmt.user)}' AND Host = '{self._sq(stmt.host)}'"):
+            raise SessionError(f"unknown user '{self._sq(stmt.user)}'@'{self._sq(stmt.host)}'")
         val = "'N'" if stmt.revoke else "'Y'"
         db = stmt.db or (self.current_db if stmt.table else "")
         if not db and not stmt.table:
             # global level → mysql.user flags
             sets = ", ".join(f"{p.capitalize()}_priv = {val}" for p in privs)
-            s.execute(f"UPDATE mysql.user SET {sets} WHERE User = '{stmt.user}' AND Host = '{stmt.host}'")
+            s.execute(f"UPDATE mysql.user SET {sets} WHERE User = '{self._sq(stmt.user)}' AND Host = '{self._sq(stmt.host)}'")
         elif not stmt.table:
             # db level → mysql.db row upsert
-            if not s.query(f"SELECT 1 FROM mysql.db WHERE User = '{stmt.user}' AND Host = '{stmt.host}' AND DB = '{db}'"):
+            if not s.query(f"SELECT 1 FROM mysql.db WHERE User = '{self._sq(stmt.user)}' AND Host = '{self._sq(stmt.host)}' AND DB = '{self._sq(db)}'"):
                 ns = ", ".join(["'N'"] * len(ALL_PRIVS))
-                s.execute(f"INSERT INTO mysql.db VALUES ('{stmt.host}', '{db}', '{stmt.user}', {ns})")
+                s.execute(f"INSERT INTO mysql.db VALUES ('{self._sq(stmt.host)}', '{self._sq(db)}', '{self._sq(stmt.user)}', {ns})")
             sets = ", ".join(f"{p.capitalize()}_priv = {val}" for p in privs)
             s.execute(
-                f"UPDATE mysql.db SET {sets} WHERE User = '{stmt.user}' AND Host = '{stmt.host}' AND DB = '{db}'"
+                f"UPDATE mysql.db SET {sets} WHERE User = '{self._sq(stmt.user)}' AND Host = '{self._sq(stmt.host)}' AND DB = '{self._sq(db)}'"
             )
         else:
             # table level → mysql.tables_priv SET-string merge
             cur = s.query(
-                f"SELECT Table_priv FROM mysql.tables_priv WHERE User = '{stmt.user}' AND Host = '{stmt.host}' AND DB = '{db}' AND Table_name = '{stmt.table}'"
+                f"SELECT Table_priv FROM mysql.tables_priv WHERE User = '{self._sq(stmt.user)}' AND Host = '{self._sq(stmt.host)}' AND DB = '{self._sq(db)}' AND Table_name = '{self._sq(stmt.table)}'"
             )
             have = set()
             if cur:
@@ -775,11 +782,11 @@ class Session:
             ps = ",".join(sorted(p.capitalize() for p in have))
             if cur:
                 s.execute(
-                    f"UPDATE mysql.tables_priv SET Table_priv = '{ps}' WHERE User = '{stmt.user}' AND Host = '{stmt.host}' AND DB = '{db}' AND Table_name = '{stmt.table}'"
+                    f"UPDATE mysql.tables_priv SET Table_priv = '{ps}' WHERE User = '{self._sq(stmt.user)}' AND Host = '{self._sq(stmt.host)}' AND DB = '{self._sq(db)}' AND Table_name = '{self._sq(stmt.table)}'"
                 )
             else:
                 s.execute(
-                    f"INSERT INTO mysql.tables_priv VALUES ('{stmt.host}', '{db}', '{stmt.user}', '{stmt.table}', '{ps}')"
+                    f"INSERT INTO mysql.tables_priv VALUES ('{self._sq(stmt.host)}', '{self._sq(db)}', '{self._sq(stmt.user)}', '{self._sq(stmt.table)}', '{ps}')"
                 )
         self._db.priv_version += 1
         return Result()

@@ -109,11 +109,18 @@ class TopSQLCollector:
                     self._samples_of[dg] = sample
                     self._plan_of[dg] = pdg
                     swin[stack] += 1
-                # expire old windows
+                # expire old windows — and prune digest metadata no retained
+                # window references, or a long-lived server accumulates one
+                # sample/plan entry per distinct SQL digest forever
                 if len(self._windows) > self.keep:
                     for k in sorted(self._windows)[: len(self._windows) - self.keep]:
                         self._windows.pop(k, None)
                         self._stacks.pop(k, None)
+                    live = {dg for counts in self._windows.values() for dg in counts}
+                    for dg in list(self._samples_of):
+                        if dg not in live:
+                            self._samples_of.pop(dg, None)
+                            self._plan_of.pop(dg, None)
 
     # -- reports ------------------------------------------------------------
     def top_sql(self, last_s: int = 60, limit: int = 30) -> list[tuple]:
