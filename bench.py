@@ -122,9 +122,9 @@ def chip_time(db, session, sql) -> float:
     captured = {}
     real = te._execute_dag_device
 
-    def cap(store, dag, region, ranges, read_ts):
+    def cap(store, dag, region, ranges, read_ts, warn=None):
         captured["args"] = (dag, region, ranges, read_ts)
-        return real(store, dag, region, ranges, read_ts)
+        return real(store, dag, region, ranges, read_ts, warn)
 
     te._execute_dag_device = cap
     try:
@@ -141,7 +141,81 @@ def chip_time(db, session, sql) -> float:
     return (time.perf_counter() - t0) / K
 
 
+_REMOTE_SERVER_SCRIPT = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+import os
+os.environ["BENCH_ROWS"] = str({rows})
+os.environ["BENCH_JOIN_ROWS"] = str({jrows})
+import bench
+db, _ = bench.setup()
+from tidb_tpu.kv.remote import StoreServer
+srv = StoreServer(db.store)
+print(f"PORT {{srv.start()}}", flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+def remote_probe():
+    """Q1/Q3 through the REAL topology: this process is a pure SQL layer
+    over a storage-server subprocess that owns the data AND the device (ref:
+    tests/realtikvtest — the reference benches against real TiKV, not only
+    unistore). Runs BEFORE the embedded benches so the parent process has
+    not initialized the device backend the server needs to own."""
+    import subprocess
+    import threading
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _REMOTE_SERVER_SCRIPT.format(
+            repo=repo, rows=N_ROWS, jrows=N_JOIN)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    got: list = []
+
+    def reader():
+        for line in proc.stdout:
+            if line.startswith("PORT "):
+                got.append(int(line.split()[1]))
+                return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    t.join(timeout=600)
+    if not got:
+        proc.kill()
+        err_tail = ""
+        try:
+            err_tail = (proc.stderr.read() or "")[-2000:]
+        except Exception:
+            pass
+        raise RuntimeError(f"bench store server did not come up: {err_tail}")
+    try:
+        import tidb_tpu
+
+        db = tidb_tpu.open(remote=f"127.0.0.1:{got[0]}")
+        s = db.session()
+        s.execute("SET tidb_isolation_read_engines = 'tpu'")
+        q1_remote = timed(s, Q1, max(1, REPS // 2))
+        s.execute("ANALYZE TABLE orders")
+        s.execute("ANALYZE TABLE lineitem2")
+        q3_remote = timed(s, Q3, max(1, REPS // 2))
+        return q1_remote, q3_remote
+    finally:
+        proc.kill()
+        try:
+            proc.wait(timeout=30)
+        except Exception:
+            pass  # a slow reap must not discard the measured results
+
+
 def main():
+    try:
+        q1_remote, q3_remote = remote_probe()
+    except Exception as e:  # the remote topology must never sink the bench
+        print(f"remote probe failed: {e!r}", file=sys.stderr)
+        q1_remote = q3_remote = None
     db, load_s = setup()
     s = db.session()
 
@@ -206,6 +280,9 @@ def main():
             "q10_topn_host_ms": round(q10_host * 1e3, 1),
             "q3_join_mpp_ms": round(q3_tpu * 1e3, 1),
             "q3_join_host_ms": round(q3_host * 1e3, 1),
+            # the REAL topology: SQL layer + storage-server process over TCP
+            "q1_remote_ms": round(q1_remote * 1e3, 1) if q1_remote else None,
+            "q3_remote_mpp_ms": round(q3_remote * 1e3, 1) if q3_remote else None,
             "window_tpu_ms": round(win_tpu * 1e3, 1),
             "window_host_ms": round(win_host * 1e3, 1),
             "load_s": round(load_s, 1),
