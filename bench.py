@@ -182,14 +182,17 @@ def remote_probe():
 
     t = threading.Thread(target=reader, daemon=True)
     t.start()
+    # drain stderr concurrently: a chatty child must not deadlock on a full
+    # pipe buffer before it prints PORT
+    err_chunks: list = []
+    te = threading.Thread(
+        target=lambda: err_chunks.append(proc.stderr.read()), daemon=True
+    )
+    te.start()
     t.join(timeout=600)
     if not got:
         proc.kill()
-        err_tail = ""
-        try:
-            err_tail = (proc.stderr.read() or "")[-2000:]
-        except Exception:
-            pass
+        err_tail = (err_chunks[0] if err_chunks else "" or "")[-2000:]
         raise RuntimeError(f"bench store server did not come up: {err_tail}")
     try:
         import tidb_tpu

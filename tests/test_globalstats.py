@@ -1,0 +1,83 @@
+"""Partition global-stats merge + persisted/async-loaded stats (ref:
+statistics/handle/globalstats/global_stats.go + handle/syncload)."""
+
+import time
+
+import tidb_tpu
+from tidb_tpu.session.session import DB
+
+
+def _mkdb():
+    db = tidb_tpu.open()
+    s = db.session()
+    s.execute(
+        "CREATE TABLE pt (id BIGINT PRIMARY KEY, g BIGINT, v BIGINT, KEY kg (g)) "
+        "PARTITION BY HASH(id) PARTITIONS 4"
+    )
+    s.execute(
+        "INSERT INTO pt VALUES " + ", ".join(f"({i}, {i % 700}, {i})" for i in range(3000))
+    )
+    return db, s
+
+
+def test_partition_analyze_merges_global():
+    db, s = _mkdb()
+    s.execute("ANALYZE TABLE pt PARTITION p0, p1")
+    t = db.catalog.table("test", "pt")
+    # partial coverage: per-partition stats exist, NO global refresh yet
+    assert db.stats.get(t.partition.defs[0].id) is not None
+    s.execute("ANALYZE TABLE pt PARTITION p2, p3")
+    gs = db.stats.get(t.id)
+    assert gs is not None and gs.row_count == 3000
+    # true g-NDV is 700; FM union must not add per-partition NDVs
+    # (each partition individually sees ~530 of the 700 values)
+    assert 560 <= gs.cols[1].ndv <= 1000, gs.cols[1].ndv
+    assert gs.cols[1].null_count == 0
+    # index NDV merges through the key-tuple FM sketches
+    assert 560 <= gs.idxs[1].ndv <= 1000, gs.idxs[1].ndv
+    # merged histogram+topn mass conserves the row count
+    cs = gs.cols[1]
+    assert abs((cs.topn.total + cs.hist.total) - 3000) <= 1
+
+
+def test_stats_persist_and_async_load():
+    db, s = _mkdb()
+    s.execute("ANALYZE TABLE pt PARTITION p0, p1, p2, p3")
+    t = db.catalog.table("test", "pt")
+    want_ndv = db.stats.get(t.id).cols[1].ndv
+    # a FRESH SQL layer over the SAME store: sync load (the blocking variant)
+    db2 = DB(store=db.store)
+    st = db2.stats.load_sync(t.id)
+    assert st is not None and st.row_count == 3000 and st.cols[1].ndv == want_ndv
+    # async: first get() misses and schedules a background load
+    db3 = DB(store=db.store)
+    assert db3.stats.get(t.id) is None
+    deadline = time.monotonic() + 5
+    got = None
+    while time.monotonic() < deadline:
+        got = db3.stats._tables.get(t.id)
+        if got is not None:
+            break
+        time.sleep(0.05)
+    assert got is not None and got.row_count == 3000
+
+
+def test_global_stats_flip_exchange_choice():
+    """The stats_global.test golden's assertion in unit form: merged global
+    stats flip the MPP join exchange from broadcast to hash."""
+    db = tidb_tpu.open()
+    s = db.session()
+    s.execute(
+        "CREATE TABLE pl (id BIGINT PRIMARY KEY, k BIGINT, v BIGINT) "
+        "PARTITION BY HASH(id) PARTITIONS 4"
+    )
+    s.execute("CREATE TABLE dm (d_id BIGINT PRIMARY KEY, cat BIGINT)")
+    s.execute("INSERT INTO pl VALUES " + ", ".join(f"({i}, {i % 40}, {i})" for i in range(300)))
+    s.execute("INSERT INTO dm VALUES " + ", ".join(f"({i}, {i % 5})" for i in range(2000)))
+    q = "EXPLAIN SELECT cat, SUM(v) FROM pl, dm WHERE k = d_id GROUP BY cat ORDER BY cat"
+    before = "\n".join(r[0] for r in s.query(q))
+    assert "broadcast join exchange" in before, before
+    s.execute("ANALYZE TABLE dm")
+    s.execute("ANALYZE TABLE pl PARTITION p0, p1, p2, p3")
+    after = "\n".join(r[0] for r in s.query(q))
+    assert "hash join exchange" in after, after

@@ -1851,6 +1851,16 @@ class Parser:
         self.expect_kw("ANALYZE")
         self.expect_kw("TABLE")
         tables = [self._table_ref_simple()]
+        # ANALYZE TABLE t PARTITION p0[, p1...] — partition-level analyze
+        # whose results merge into table-level global stats (ref:
+        # statistics/handle/globalstats)
+        if self.at_kw("PARTITION"):
+            self.next()
+            parts = [self.ident().lower()]
+            while self.eat_op(","):
+                parts.append(self.ident().lower())
+            tables[0].partitions = parts
+            return ast.AnalyzeTable(tables)
         while self.eat_op(","):
             tables.append(self._table_ref_simple())
         return ast.AnalyzeTable(tables)

@@ -1394,6 +1394,36 @@ class Session:
         for tr in stmt.tables:
             db_name = tr.db or self.current_db
             t = self.catalog.table(db_name, tr.name)
+            if getattr(tr, "partitions", None):
+                # partition-level analyze: per-partition stats land under the
+                # partition's physical id, then every analyzed partition's
+                # stats merge into table-level GLOBAL stats (ref:
+                # statistics/handle/globalstats/global_stats.go)
+                from tidb_tpu.statistics.globalstats import merge_global_stats
+
+                if t.partition is None:
+                    raise SessionError(f"table '{t.name}' is not partitioned")
+                by_name = {d.name.lower(): d for d in t.partition.defs}
+                for pn in tr.partitions:
+                    d = by_name.get(pn)
+                    if d is None:
+                        raise SessionError(f"Unknown partition '{pn}' in table '{t.name}'")
+                    view = t.partition_view(d.id)
+                    self._db.stats.put(analyze_table(self, db_name, view))
+                part_stats = [
+                    ps
+                    for d in t.partition.defs
+                    # sync load: persisted per-partition stats from a prior
+                    # process must count toward merge completeness (ANALYZE
+                    # is a cold path; blocking here is fine)
+                    if (ps := self._db.stats.get(d.id) or self._db.stats.load_sync(d.id)) is not None
+                ]
+                if len(part_stats) == len(t.partition.defs):
+                    # all partitions analyzed → refresh table-level globals
+                    self._db.stats.put(
+                        merge_global_stats(t.id, self.read_ts(), part_stats)
+                    )
+                continue
             self._db.stats.put(analyze_table(self, db_name, t))
         return Result()
 
@@ -1428,6 +1458,14 @@ class DB:
 
         self.gc_worker = GCWorker(self.store)
         self.stats = StatsHandle()
+        # persisted ANALYZE results load lazily from the store (syncload);
+        # string stats re-attach their sorted dictionaries from the cache
+        def _dict_resolver(tid, off):
+            from tidb_tpu.copr.colcache import cache_for
+
+            return cache_for(self.store).dictionary(tid, off)
+
+        self.stats.attach_store(self.store, _dict_resolver)
         from tidb_tpu.resourcegroup import ResourceGroupManager
         from tidb_tpu.utils.stmtsummary import StmtSummary
 

@@ -74,5 +74,17 @@ def analyze_table(session, db_name: str, t: TableInfo) -> TableStats:
             ndv = int(len(np.unique(tuples)))
         else:
             ndv = 0
-        stats.idxs[idx.id] = IndexStats(index_id=idx.id, ndv=ndv)
+        fm = FMSketch()
+        if lanes and n:
+            # combined key-tuple hash: mergeable NDV for global-stats union
+            from tidb_tpu.statistics.sketch import _mix64
+
+            h = np.zeros(n, dtype=np.uint64)
+            for li, lane in enumerate(lanes[::2]):  # data lanes only
+                lv = np.asarray(lane)
+                if lv.dtype != np.int64:
+                    lv = lv.astype(np.int64, copy=False) if lv.dtype.kind in "iub" else lv.view(np.int64)
+                h ^= _mix64(lv, 0x51ED2701 + li)
+            fm.insert_many(h.view(np.int64))
+        stats.idxs[idx.id] = IndexStats(index_id=idx.id, ndv=ndv, fm=fm)
     return stats
