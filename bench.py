@@ -52,6 +52,10 @@ Q10 = """SELECT l_returnflag, l_extendedprice FROM lineitem
   ORDER BY l_extendedprice DESC LIMIT 20"""
 Q3 = """SELECT o_odate, SUM(l_extendedprice) AS rev FROM lineitem2, orders
   WHERE l_orderkey = o_orderkey GROUP BY o_odate ORDER BY rev DESC, o_odate LIMIT 10"""
+Q1_ROLLUP = """SELECT l_returnflag, l_linestatus, COUNT(*), SUM(l_quantity),
+    SUM(l_extendedprice) FROM lineitem WHERE l_shipdate <= DATE '1998-09-02'
+  GROUP BY l_returnflag, l_linestatus WITH ROLLUP
+  ORDER BY GROUPING(l_returnflag), GROUPING(l_linestatus), l_returnflag, l_linestatus"""
 
 
 def setup():
@@ -238,6 +242,11 @@ def main():
     q6_tpu = timed(s, Q6, REPS)
     cnt_tpu = timed(s, COUNT_STAR, REPS)
     q10_tpu = timed(s, Q10, REPS)
+    # the Expand fusion vs the per-set union (same query, toggled rewrite)
+    rollup_fused = timed(s, Q1_ROLLUP, max(1, REPS // 2))
+    s.execute("SET tidb_opt_fused_rollup = 0")
+    rollup_union = timed(s, Q1_ROLLUP, max(1, REPS // 2))
+    s.execute("SET tidb_opt_fused_rollup = 1")
     q3_tpu = timed(s, Q3, max(1, REPS // 2))
     win_tpu = timed(s, WINDOWED, max(1, REPS // 2))
     tpu_rows = s.query(Q1)
@@ -280,6 +289,8 @@ def main():
             "count_tpu_ms": round(cnt_tpu * 1e3, 1),
             "count_host_ms": round(cnt_host * 1e3, 1),
             "q10_topn_tpu_ms": round(q10_tpu * 1e3, 1),
+            "rollup_fused_ms": round(rollup_fused * 1e3, 1),
+            "rollup_union_ms": round(rollup_union * 1e3, 1),
             "q10_topn_host_ms": round(q10_host * 1e3, 1),
             "q3_join_mpp_ms": round(q3_tpu * 1e3, 1),
             "q3_join_host_ms": round(q3_host * 1e3, 1),

@@ -222,3 +222,35 @@ def test_partitioned_single_table_mpp_agg(db):
     s.execute("SET tidb_allow_mpp = 0")
     host = s.query(q)
     assert mpp == host
+
+
+def test_fused_rollup_one_pass_parity():
+    """WITH ROLLUP fuses every grouping set into ONE pushed aggregation (a
+    (G+1)-hot MXU dot — the Expand fusion): the plan shows a single scan,
+    results match the per-set union rewrite exactly, and host/device agree."""
+    import tidb_tpu
+
+    db = tidb_tpu.open()
+    s = db.session()
+    s.execute("CREATE TABLE fr (rf VARCHAR(1), ls VARCHAR(1), q BIGINT)")
+    s.execute(
+        "INSERT INTO fr VALUES "
+        + ", ".join(f"('{'ANR'[i % 3]}', '{'FO'[i % 2]}', {i % 50})" for i in range(400))
+    )
+    q = (
+        "SELECT rf, ls, COUNT(*), SUM(q) FROM fr GROUP BY rf, ls WITH ROLLUP "
+        "ORDER BY GROUPING(rf), GROUPING(ls), rf, ls"
+    )
+    plan = "\n".join(r[0] for r in s.query("EXPLAIN " + q))
+    assert plan.count("Scan") == 1 and "ROLLUP" in plan, plan
+    fused = s.execute(q).rows
+    s.execute("SET tidb_opt_fused_rollup = 0")
+    plan_u = "\n".join(r[0] for r in s.query("EXPLAIN " + q))
+    assert plan_u.count("Scan") == 3, plan_u
+    union = s.execute(q).rows
+    s.execute("SET tidb_opt_fused_rollup = 1")
+    assert fused == union
+    s.execute("SET tidb_isolation_read_engines = 'host'")
+    host = s.execute(q).rows
+    assert fused == host
+    assert len(fused) == 10  # 6 leaf + 3 per-rf + 1 grand total

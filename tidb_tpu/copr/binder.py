@@ -57,6 +57,7 @@ class Binder:
         for ex in out.executors:
             if ex.tp == dagpb.TABLE_SCAN:
                 scan_seen = True
+                self._scan_domains = None  # filled below
                 # capture value domains: string codes live in [0, len(dict));
                 # enables the kernel's dense no-sort group-by fast path
                 ex.domains = [
@@ -65,6 +66,7 @@ class Binder:
                     else -1
                     for c in ex.columns
                 ]
+                self._scan_domains = ex.domains
                 continue
             if not scan_seen:
                 raise UnsupportedForDevice("DAG must start with a scan")
@@ -97,6 +99,8 @@ class Binder:
                             a["arg"] is not None and self.narrow_safe(a["arg"])
                             for a in ex.aggs
                         ]
+                if getattr(ex, "rollup", False):
+                    self._gate_device_rollup(ex)
                 refs_are_scan = False
             elif ex.tp == dagpb.TOPN:
                 new_order = []
@@ -132,6 +136,31 @@ class Binder:
             else:
                 raise UnsupportedForDevice(f"executor {ex.tp} on device")
         return out
+
+    def _gate_device_rollup(self, ex) -> None:
+        """Device WITH ROLLUP runs ONLY as the (G+1)-hot MXU dot: every key
+        needs a dictionary domain and every aggregate a bounded COUNT/SUM
+        form, with the summed window space inside the dot's bucket cap.
+        Anything else is the host engine's loop-over-sets (still one scan)."""
+        from tidb_tpu.expression.expr import AggDesc
+        from tidb_tpu.ops.dag_kernel import _mxu_aggs_ok
+        from tidb_tpu.ops.mxu_groupby import MAX_B
+
+        doms = []
+        dmn = getattr(self, "_scan_domains", None) or []
+        for g in ex.group_by:
+            if g["tp"] == "col" and g["idx"] < len(dmn) and dmn[g["idx"]] > 0:
+                doms.append(dmn[g["idx"]])
+            else:
+                raise UnsupportedForDevice("rollup key without a dictionary domain")
+        from tidb_tpu.ops.mxu_groupby import rollup_bucket_space
+
+        b_total = rollup_bucket_space(doms)
+        if b_total > MAX_B:
+            raise UnsupportedForDevice(f"rollup window space {b_total} exceeds the dot cap")
+        aggs = [AggDesc.from_pb(a) for a in ex.aggs]
+        if not _mxu_aggs_ok(aggs, getattr(ex, "arg_bounds", ())):
+            raise UnsupportedForDevice("rollup aggregate without a bounded COUNT/SUM form")
 
     def _bounds_for(self, pbs: list) -> list:
         """(lo, hi) per expression from cached column min/max — powers the

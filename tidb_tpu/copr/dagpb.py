@@ -90,6 +90,9 @@ class ExecutorPB:
     # binder-stamped int32 narrow-eval proofs (group keys / agg arguments)
     group_narrow: list = field(default_factory=list)
     arg_narrow: list = field(default_factory=list)
+    # GROUP BY ... WITH ROLLUP pushdown: the engine computes EVERY prefix
+    # grouping set in one pass, emitting NULLed keys + GROUPING() flags
+    rollup: bool = False
     # topn: order_by = [(ExprPB, desc: bool)]
     order_by: list = field(default_factory=list)
     limit: int = 0
@@ -143,6 +146,7 @@ class ExecutorPB:
                 arg_bounds=[list(b) if b is not None else None for b in self.arg_bounds],
                 group_narrow=list(self.group_narrow),
                 arg_narrow=list(self.arg_narrow),
+                rollup=self.rollup,
             )
         elif self.tp == TOPN:
             d.update(
@@ -192,6 +196,7 @@ class ExecutorPB:
             e.arg_bounds = [tuple(b) if b is not None else None for b in pb.get("arg_bounds", [])]
             e.group_narrow = pb.get("group_narrow", [])
             e.arg_narrow = pb.get("arg_narrow", [])
+            e.rollup = pb.get("rollup", False)
         elif e.tp == TOPN:
             e.order_by, e.limit = pb["order_by"], pb["limit"]
             e.sort_bounds = [tuple(b) if b is not None else None for b in pb.get("sort_bounds", [])]

@@ -143,6 +143,54 @@ def _selection(chunk: Chunk, conditions: list[dict], warn=None) -> Chunk:
     return chunk.take(idx)
 
 
+def _aggregate_rollup(chunk: Chunk, ex: dagpb.ExecutorPB, warn=None) -> Chunk:
+    """WITH ROLLUP over one materialized chunk: one grouped aggregation per
+    PREFIX set over the SAME scanned rows (one scan, G+1 cheap re-groupings
+    — the host fallback of the device's (G+1)-hot dot), output layout
+    [agg lanes, keys (NULL when rolled up), GROUPING flags]."""
+    from tidb_tpu.types.field_type import bigint_type
+
+    G = len(ex.group_by)
+    flag_ft = bigint_type(nullable=False)
+    outs: list[Chunk] = []
+    key_fts = [_ft_from_pb(g["ft"]) for g in ex.group_by]
+    # NULLed rolled-up key columns must share the REAL key column's
+    # dictionary or the set concat would mix incompatible code spaces
+    key_dics = [
+        chunk.columns[g["idx"]].dictionary
+        if g.get("tp") == "col" and g["idx"] < chunk.num_cols
+        else None
+        for g in ex.group_by
+    ]
+    for k in range(G, -1, -1):
+        if k == 0 and len(chunk) == 0:
+            continue  # MySQL: no () super-aggregate over empty input
+        sub = dagpb.ExecutorPB(
+            ex.tp, group_by=ex.group_by[:k], aggs=ex.aggs, agg_mode=ex.agg_mode
+        )
+        part = _aggregate(chunk, sub, warn)
+        m = len(part)
+        n_aggs = part.num_cols - k
+        cols = list(part.columns[:n_aggs])
+        cols.extend(part.columns[n_aggs:])  # the k leading keys
+        for j in range(k, G):  # rolled-up keys: NULL
+            ft = key_fts[j]
+            dt = np.int32 if ft.kind == TypeKind.STRING else (np.float64 if ft.kind == TypeKind.FLOAT else np.int64)
+            cols.append(Column(np.zeros(m, dt), np.zeros(m, bool), ft, key_dics[j]))
+        for j in range(G):  # GROUPING() flags
+            cols.append(Column(np.full(m, 0 if j < k else 1, np.int64), np.ones(m, bool), flag_ft))
+        outs.append(Chunk(cols))
+    if not outs:
+        # empty input: zero rows with the full column layout
+        sub = dagpb.ExecutorPB(ex.tp, group_by=ex.group_by, aggs=ex.aggs, agg_mode=ex.agg_mode)
+        base = _aggregate(chunk, sub, warn)
+        cols = list(base.columns) + [
+            Column(np.empty(0, np.int64), np.empty(0, bool), flag_ft) for _ in range(G)
+        ]
+        return Chunk([Column(c.data[:0], c.validity[:0], c.ftype, c.dictionary) for c in cols])
+    return Chunk.concat(outs) if len(outs) > 1 else outs[0]
+
+
 def _group_sort(chunk: Chunk, key_cols: list[Column]) -> tuple[np.ndarray, np.ndarray, int]:
     """Lexsort rows by group keys → (perm, segment_ids_sorted, n_groups)."""
     n = len(chunk)
@@ -238,6 +286,8 @@ def bit_reduce(op: str, data: np.ndarray, valid: np.ndarray, seg: np.ndarray, ng
 
 
 def _aggregate(chunk: Chunk, ex: dagpb.ExecutorPB, warn=None) -> Chunk:
+    if getattr(ex, "rollup", False):
+        return _aggregate_rollup(chunk, ex, warn)
     batch = EvalBatch.from_chunk(chunk, warn=warn)
     gcols = [eval_to_column(expr_from_pb(pb), batch, np) for pb in ex.group_by]
     aggs = [AggDesc.from_pb(pb) for pb in ex.aggs]

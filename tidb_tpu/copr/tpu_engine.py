@@ -629,6 +629,8 @@ def output_ftypes(dag: dagpb.DAGRequest) -> list[FieldType]:
                             out.append(a.arg.ftype if a.arg is not None else bigint_type())
             for g in ex.group_by:
                 out.append(expr_from_pb(g).ftype)
+            if getattr(ex, "rollup", False):
+                out.extend(bigint_type(nullable=False) for _ in ex.group_by)
             fts = out
         elif ex.tp == dagpb.PROJECTION:
             fts = [expr_from_pb(e).ftype for e in ex.exprs]
@@ -655,6 +657,8 @@ def string_slot_for_output(dag: dagpb.DAGRequest, offset: int):
                 out.extend([src] * n_lanes)
             for g in ex.group_by:
                 out.append(prov[g["idx"]] if g.get("tp") == "col" and g["idx"] < len(prov) else None)
+            if getattr(ex, "rollup", False):
+                out.extend([None] * len(ex.group_by))  # GROUPING flags: ints
             prov = out
         elif ex.tp == dagpb.PROJECTION:
             out = []

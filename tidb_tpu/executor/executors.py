@@ -235,6 +235,7 @@ class TableReaderExec(Executor):
                     group_by=[g.to_pb() for g in p.pushed_agg.group_by],
                     aggs=[a.to_pb() for a in p.pushed_agg.aggs],
                     agg_mode=dagpb.AGG_PARTIAL if p.pushed_agg_mode == "partial" else dagpb.AGG_COMPLETE,
+                    rollup=getattr(p.pushed_agg, "rollup", False),
                 )
             )
         if p.pushed_topn is not None:
@@ -587,7 +588,9 @@ class FinalAggExec(Executor):
     def execute(self) -> Chunk:
         chunk = self.child.execute()
         aggs = self.plan.aggs
-        ngroup = len(self.plan.group_by)
+        # rollup partials interleave GROUPING() flags after the keys — the
+        # merge identity is (keys, flags) and both pass through
+        ngroup = len(self.plan.group_by) * (2 if getattr(self.plan, "rollup", False) else 1)
         if not self.plan.partial_input:
             splittable = not any(a.distinct or a.name == "group_concat" for a in aggs)
             if splittable and len(chunk) >= self.PARALLEL_MIN_ROWS:

@@ -355,6 +355,105 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
             ngroups,
         )
 
+    def _rollup_layout(ex, group_exprs):
+        """Static grouping-set layout for WITH ROLLUP on the MXU dot: the
+        prefix sets (g1..gG), ..., (g1), () each own a bucket WINDOW; one
+        (G+1)-hot matmul computes them all in a single pass (the Expand
+        fusion — the reference replicates rows per set instead,
+        cophandler/mpp_exec.go:422-466). Returns None when any key lacks a
+        dictionary domain (the binder also gates this)."""
+        from tidb_tpu.expression.expr import ColumnRef as _CR
+
+        doms = []
+        for g in group_exprs:
+            if isinstance(g, _CR) and g.index < len(scan.domains) and scan.domains[g.index] > 0:
+                doms.append(scan.domains[g.index])
+            else:
+                return None
+        G = len(doms)
+        sets = list(range(G, -1, -1))  # prefix lengths, widest first
+        windows = []  # per set: (k, offset, B_k, strides[:k])
+        off = 0
+        for k in sets:
+            stride = 1
+            strides = []
+            for dom in reversed(doms[:k]):
+                strides.append(stride)
+                stride *= dom + 1
+            strides = list(reversed(strides))
+            b_k = stride
+            windows.append((k, off, b_k, strides))
+            off += b_k
+        return {"doms": doms, "G": G, "windows": windows, "B_total": off}
+
+    def _mxu_rollup_segs(layout, gvals, mask_b, nn):
+        """Per grouping set: a GLOBAL bucket-id lane (window offset + local
+        bucket); dead rows point past every window."""
+        B_total = layout["B_total"]
+        segs = []
+        for k, off, b_k, strides in layout["windows"]:
+            seg_dtype = (
+                jnp.int32
+                if all(d.dtype == jnp.int32 for d, _ in gvals[:k])
+                else jnp.int64
+            )
+            seg = jnp.zeros(nn, dtype=seg_dtype)
+            for (d, v), dom, st in zip(gvals[:k], layout["doms"][:k], strides):
+                adj = jnp.where(v, d, dom)  # NULL values → their own bucket
+                seg = seg + adj * st
+            seg = jnp.where(mask_b, seg + off, B_total)
+            segs.append((seg.astype(jnp.int32), off, off + b_k))
+        return segs
+
+    def _mxu_rollup_outputs(counts, sums, lane_of_agg, occ_lane, aggs, mode, layout):
+        """Bucket lanes → [agg outputs, keys (NULL when rolled up), GROUPING
+        flags], compacted to occupied buckets."""
+        B_total = layout["B_total"]
+        doms, G = layout["doms"], layout["G"]
+        out_data, out_valid = [], []
+        for a, li in zip(aggs, lane_of_agg):
+            cnt = counts[:, li]
+            for pk in a.partial_kinds:
+                if pk == "count":
+                    out_data.append(cnt)
+                    out_valid.append(jnp.ones(B_total, dtype=bool))
+                else:  # sum (gated by _mxu_aggs_ok)
+                    out_data.append(sums[:, li])
+                    out_valid.append(cnt > 0)
+        if mode == dagpb.AGG_COMPLETE:
+            out_data, out_valid = _finalize_device(jnp, aggs, out_data, out_valid)
+        occupied = counts[:, occ_lane] > 0
+        # keys + flags decode per window, concatenated along the bucket axis
+        flag_lanes = []  # flags append AFTER all keys
+        for j in range(G):
+            dparts, vparts, fparts = [], [], []
+            for k, off, b_k, strides in layout["windows"]:
+                lidx = jnp.arange(b_k)
+                if j < k:
+                    code = (lidx // strides[j]) % (doms[j] + 1)
+                    kv = (code != doms[j]) & occupied[off : off + b_k]
+                    dparts.append(jnp.where(kv, code, 0).astype(jnp.int64))
+                    vparts.append(kv)
+                    fparts.append(jnp.zeros(b_k, dtype=jnp.int64))
+                else:  # rolled-up key: NULL, flag 1
+                    dparts.append(jnp.zeros(b_k, dtype=jnp.int64))
+                    vparts.append(jnp.zeros(b_k, dtype=bool))
+                    fparts.append(jnp.ones(b_k, dtype=jnp.int64))
+            out_data.append(jnp.concatenate(dparts))
+            out_valid.append(vparts[0] if len(vparts) == 1 else jnp.concatenate(vparts))
+            flag_lanes.append(jnp.concatenate(fparts))
+        for f in flag_lanes:
+            out_data.append(f)
+            out_valid.append(jnp.ones(B_total, dtype=bool))
+        order = jnp.argsort(~occupied, stable=True)
+        ngroups = occupied.sum()
+        out_cap = min(B_total, agg_cap)
+        return (
+            [o[order][:out_cap] for o in out_data],
+            [o[order][:out_cap] for o in out_valid],
+            ngroups,
+        )
+
     def _static_dot_route():
         """Per-block fused routing gate: [scan, selection*, agg-last] DAGs
         whose agg provably rides the int8 MXU dot can skip the nb-block
@@ -380,14 +479,20 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                 doms.append(scan.domains[g.index])
             else:
                 return None
-        bt = _dense_b_total(doms)
         if not _mxu_aggs_ok(aggs, getattr(executors[-1], "arg_bounds", ())):
             return None
+        if getattr(executors[-1], "rollup", False):
+            # rollup bucket space = sum over prefix-set windows
+            layout = _rollup_layout(executors[-1], group_exprs)
+            if layout is None or layout["B_total"] > min(agg_cap, _DOT_MAX_B):
+                return None
+            return ("rollup", doms)
+        bt = _dense_b_total(doms)
         if bt > min(agg_cap, _DOT_MAX_B):
             return None
         if not (bt > _DENSE_EQMASK_MAX or n_total >= (1 << 21)):
             return None
-        return doms
+        return ("plain", doms)
 
     blockwise_doms = _static_dot_route()
 
@@ -406,8 +511,13 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
         arg_bounds = getattr(agg_ex, "arg_bounds", ())
         arg_narrow = getattr(agg_ex, "arg_narrow", ())
         gnar = getattr(agg_ex, "group_narrow", [])
-        doms = blockwise_doms
-        B = _dense_b_total(doms)
+        route_kind, doms = blockwise_doms
+        rollup_layout = None
+        if route_kind == "rollup":
+            rollup_layout = _rollup_layout(agg_ex, parsed[-1][0])
+            B = rollup_layout["B_total"]
+        else:
+            B = _dense_b_total(doms)
         acc = None
         plan = None
         strides = None
@@ -441,7 +551,11 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                         keep = keep & _vmask(v, n_pad)
                     mask_b = mask_b & keep
             gvals_b = _gvals_for(group_exprs, gnar, batch_b, batch_nw_b, n_pad)
-            seg, strides_b = _mxu_seg(gvals_b, doms, mask_b, n_pad, B)
+            if rollup_layout is not None:
+                seg = _mxu_rollup_segs(rollup_layout, gvals_b, mask_b, n_pad)
+                strides_b = None
+            else:
+                seg, strides_b = _mxu_seg(gvals_b, doms, mask_b, n_pad, B)
             pairs, pair_bounds, lane_of_agg, occ_lane = _mxu_pairs(
                 aggs, arg_bounds, arg_narrow, batch_b, batch_nw_b, mask_b, n_pad
             )
@@ -452,11 +566,18 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                 plan = dot_plan(pairs, pair_bounds)
                 strides = strides_b
                 n_pairs = len(pairs)
-            acc = dot_acc(seg.astype(jnp.int32), pairs, B, n_pad, plan, acc)
+            acc = dot_acc(
+                seg if isinstance(seg, list) else seg.astype(jnp.int32), pairs, B, n_pad, plan, acc
+            )
         counts, sums = dot_recombine(acc, plan, n_pairs, B)
-        out_data, out_valid, ngroups = _mxu_outputs(
-            counts, sums, lane_of_agg, occ_lane, aggs, mode, doms, strides, B
-        )
+        if rollup_layout is not None:
+            out_data, out_valid, ngroups = _mxu_rollup_outputs(
+                counts, sums, lane_of_agg, occ_lane, aggs, mode, rollup_layout
+            )
+        else:
+            out_data, out_valid, ngroups = _mxu_outputs(
+                counts, sums, lane_of_agg, occ_lane, aggs, mode, doms, strides, B
+            )
         out_len = int(out_data[0].shape[0])
         gslot = jnp.arange(out_len)
         gvalid_slot = gslot < ngroups
@@ -590,6 +711,42 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                     d = _bcast(d, n)
                     v = _vmask(v, n)
                     gvals.append((jnp.where(v, d, 0), v))
+                if getattr(ex, "rollup", False):
+                    # WITH ROLLUP: one (G+1)-hot MXU dot computes every
+                    # grouping set in this same pass (the binder gated
+                    # domains/bounds; anything it missed falls back to host)
+                    from tidb_tpu.copr.binder import UnsupportedForDevice
+                    from tidb_tpu.ops.mxu_groupby import MAX_B as _DOT_MAX_B
+                    from tidb_tpu.ops.mxu_groupby import dot_acc, dot_plan, dot_recombine
+
+                    layout = _rollup_layout(ex, group_exprs)
+                    if (
+                        layout is None
+                        or layout["B_total"] > _DOT_MAX_B
+                        or not _mxu_aggs_ok(aggs, getattr(ex, "arg_bounds", ()))
+                    ):
+                        raise UnsupportedForDevice("device rollup needs dict-domain keys + bounded sums")
+                    segs = _mxu_rollup_segs(layout, gvals, mask, n)
+                    pairs, pair_bounds, lane_of_agg, occ_lane = _mxu_pairs(
+                        aggs, getattr(ex, "arg_bounds", ()), getattr(ex, "arg_narrow", ()), batch, batch_nw, mask, n
+                    )
+                    plan_ = dot_plan(pairs, pair_bounds)
+                    acc_r = dot_acc(segs, pairs, layout["B_total"], n, plan_)
+                    counts, sums = dot_recombine(acc_r, plan_, len(pairs), layout["B_total"])
+                    out_data, out_valid, ngroups = _mxu_rollup_outputs(
+                        counts, sums, lane_of_agg, occ_lane, aggs, mode, layout
+                    )
+                    out_len = int(out_data[0].shape[0])
+                    gslot = jnp.arange(out_len)
+                    gvalid_slot = gslot < ngroups
+                    out_valid = [ov & gvalid_slot for ov in out_valid]
+                    batch = EvalBatch(
+                        [(d, v) for d, v in zip(out_data, out_valid)], [None] * len(out_data), out_len, warn=_cur_dws()
+                    )
+                    batch_nw = batch
+                    mask = gvalid_slot
+                    kind = "agg"
+                    continue
                 # dense/MXU bucket arithmetic runs int32 when every key lane
                 # is narrow (B is tiny, so the products always fit)
                 seg_dtype = (

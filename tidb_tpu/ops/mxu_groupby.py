@@ -33,6 +33,20 @@ _LIMB_BIAS = 1 << (_LIMB_BITS - 1)
 MAX_B = 64  # onehot is materialized (B, chunk) int8 — keep it < ~512MB
 
 
+def rollup_bucket_space(doms) -> int:
+    """Total bucket-window space of WITH ROLLUP's prefix grouping sets:
+    sum over k of prod(dom_i + 1, i < k). THE single formula both the
+    binder's device gate and the kernel's window layout use — drift between
+    them would admit DAGs the kernel rejects (or vice versa)."""
+    total = 0
+    for k in range(len(doms), -1, -1):
+        b_k = 1
+        for dom in doms[:k]:
+            b_k *= dom + 1
+        total += b_k
+    return total
+
+
 def _limbs_needed(span: int) -> int:
     n = 1
     while span >> (_LIMB_BITS * n):
@@ -144,9 +158,19 @@ def dot_acc(seg, pairs, B: int, n: int, plan, acc=None):
     if acc is None:
         acc = jnp.zeros((B, C), dtype=jnp.int64)
     bidx = jnp.arange(B, dtype=jnp.int32)
+    # grouping sets: ``seg`` may be a LIST of (seg_lane, lo, hi) windows —
+    # each row then belongs to ONE bucket per window and the "one-hot"
+    # becomes (n_sets)-hot, computing every grouping set in the SAME matmul
+    # with zero row replication (the Expand fusion;
+    # ref: cophandler/mpp_exec.go:422-466 replicates rows instead)
+    windows = seg if isinstance(seg, list) else [(seg, 0, B)]
     for start in range(0, n, _CHUNK):
         sl = slice(start, min(start + _CHUNK, n))
-        onehot = (seg[sl][None, :] == bidx[:, None]).astype(jnp.int8)
+        hot = [
+            (s[sl][None, :] == bidx[lo:hi, None]).astype(jnp.int8)
+            for s, lo, hi in windows
+        ]
+        onehot = hot[0] if len(hot) == 1 else jnp.concatenate(hot, axis=0)
         limbs = build_cols(sl)
         part = jax.lax.dot_general(
             onehot, limbs, (((1,), (1,)), ((), ())), preferred_element_type=jnp.int32

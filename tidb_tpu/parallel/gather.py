@@ -142,6 +142,10 @@ def _distinct_handled(a: AggDesc) -> bool:
 
 
 def _agg_mpp_ok(agg: PhysFinalAgg) -> bool:
+    if getattr(agg, "rollup", False):
+        # grouping sets run the fused one-pass rollup on the cop path (a
+        # (G+1)-hot MXU dot); the fragment spec has no Expand yet
+        return False
     darg_pb = None
     for a in agg.aggs:
         if a.name not in ("count", "sum", "avg", "min", "max"):

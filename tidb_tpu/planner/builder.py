@@ -442,9 +442,28 @@ class Builder:
             for a in aliases:
                 aliases[a] = _patch_group_refs(aliases[a], len(aggs), ng)
             if sel.rollup:
-                # GROUP BY ... WITH ROLLUP → union of grouping-set branches
-                # (see _expand_rollup for the Expand redesign rationale)
-                plan = _expand_rollup(agg)
+                # GROUP BY ... WITH ROLLUP: mark the agg and extend its
+                # schema with the GROUPING() flag columns — the OPTIMIZER
+                # picks between the fused one-pass device rollup and the
+                # per-set union fallback (_expand_rollup); the deferred
+                # schema layout matches the union's exactly, so every
+                # downstream reference (incl. patched GROUPING() sentinels)
+                # is route-independent
+                import dataclasses as _dc
+
+                agg.rollup = True
+                flag_ft = bigint_type(nullable=False)
+                rolled_schema = list(agg.schema)
+                for j in range(ng):
+                    oc = rolled_schema[len(aggs) + j]
+                    if not oc.ftype.nullable:
+                        rolled_schema[len(aggs) + j] = _dc.replace(
+                            oc, ftype=_dc.replace(oc.ftype, nullable=True)
+                        )
+                agg.schema = rolled_schema + [
+                    OutCol(f"grouping#{j}", flag_ft) for j in range(ng)
+                ]
+                plan = agg
             if having_conds:
                 plan = LogicalSelection(conditions=having_conds, children=[plan])
             proj = LogicalProjection(exprs=proj_exprs, children=[plan])

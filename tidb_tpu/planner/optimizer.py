@@ -725,6 +725,8 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None, vars=None) -> P
                 return PhysSelection(conditions=host_side, children=[child])
             return child
         return PhysSelection(conditions=plan.conditions, children=[child])
+    if isinstance(plan, LogicalAggregation) and plan.rollup:
+        return _physical_rollup(plan, engines, stats, vars)
     if isinstance(plan, LogicalAggregation):
         child = _physical(plan.children[0], engines, stats, vars)
         # look through row-preserving projections (ref: projection elimination
@@ -1033,6 +1035,70 @@ def _choose_join(plan: LogicalJoin, left, right, stats):
     return hash_join
 
 
+def _physical_rollup(plan: LogicalAggregation, engines, stats, vars) -> PhysicalPlan:
+    """GROUP BY ... WITH ROLLUP. Preferred route: push ONE rollup partial
+    aggregation into the reader — the device kernel computes every grouping
+    set in a single pass over the scan (a (G+1)-hot MXU dot; the Expand
+    fusion, ref: cophandler/mpp_exec.go:422-466) and the final merge groups
+    by (keys, flags). Fallback: the per-set UNION rewrite (one aggregation
+    per grouping set), which every engine already runs."""
+    G = len(plan.group_by)
+    # cheap shape gates FIRST: a non-fusable rollup must not pay a wasted
+    # full child-planning pass before the union fallback re-plans per set
+    fusable = (
+        sysvar_int(vars, "tidb_opt_fused_rollup", 1) != 0
+        and not any(a.distinct for a in plan.aggs)
+        and all(a.name != "group_concat" for a in plan.aggs)
+    )
+    child = _physical(plan.children[0], engines, stats, vars) if fusable else None
+    can_push = (
+        fusable
+        and isinstance(child, PhysTableReader)
+        and child.pushed_agg is None
+        and child.pushed_topn is None
+        and child.pushed_limit is None
+        and child.pushed_window is None
+    )
+    if can_push:
+        exprs: list[Expression] = list(plan.group_by) + [
+            a.arg for a in plan.aggs if a.arg is not None
+        ]
+        st = _pick_engine(engines, list(child.pushed_conditions) + exprs)
+        if all(can_push_down(e, st.value) for e in exprs) and all(
+            can_push_down(c, st.value) for c in child.pushed_conditions
+        ):
+            child.store_type = st
+            pushed = LogicalAggregation(
+                group_by=plan.group_by,
+                aggs=plan.aggs,
+                schema=plan.schema,
+                children=[child],
+                rollup=True,
+            )
+            child.pushed_agg = pushed
+            child.pushed_agg_mode = "partial"
+            child.schema = _partial_schema(pushed)
+            return PhysFinalAgg(
+                group_by=plan.group_by,
+                aggs=plan.aggs,
+                partial_input=True,
+                schema=plan.schema,
+                children=[child],
+                rollup=True,
+            )
+    # union fallback over the LOGICAL child (the per-branch deep copies
+    # re-derive their own physical plans)
+    from tidb_tpu.planner.builder import _expand_rollup
+
+    plain = LogicalAggregation(
+        group_by=plan.group_by,
+        aggs=plan.aggs,
+        schema=plan.schema[: len(plan.schema) - G],
+        children=plan.children,
+    )
+    return _physical(_expand_rollup(plain), engines, stats, vars)
+
+
 def _partial_schema(agg: LogicalAggregation) -> list:
     from tidb_tpu.types.field_type import bigint_type
 
@@ -1049,4 +1115,8 @@ def _partial_schema(agg: LogicalAggregation) -> list:
     for gi, g in enumerate(agg.group_by):
         src = agg.children[0].schema[g.index] if isinstance(g, ColumnRef) else None
         out.append(OutCol(f"gb#{gi}", g.ftype, slot=src.slot if src else -1, table=src.table if src else ""))
+    if agg.rollup:
+        # grouping flags ride after the keys: part of the merge identity
+        for gi in range(len(agg.group_by)):
+            out.append(OutCol(f"grouping#{gi}", bigint_type(nullable=False)))
     return out

@@ -103,6 +103,11 @@ class LogicalAggregation(LogicalPlan):
     aggs: list[AggDesc]
     schema: Schema = field(default_factory=list)  # [aggs..., group keys...]
     children: list = field(default_factory=list)
+    # GROUP BY ... WITH ROLLUP: schema additionally carries one GROUPING()
+    # flag column per key; the optimizer fuses the grouping-set expansion
+    # into ONE device pass or falls back to a per-set union (ref: the
+    # reference's Expand operator, cophandler/mpp_exec.go:422-466)
+    rollup: bool = False
 
 
 @dataclass
@@ -314,6 +319,9 @@ class PhysFinalAgg(PhysicalPlan):
     partial_input: bool  # True: child emits partial state lanes
     schema: Schema = field(default_factory=list)
     children: list = field(default_factory=list)
+    # rollup partials interleave grouping flags after the keys: the merge
+    # groups by (keys, flags) and passes the flags through
+    rollup: bool = False
 
 
 @dataclass
@@ -451,7 +459,8 @@ def explain_plan(p, indent: int = 0, stats=None) -> str:
             over = f"partition by {w.partition_by}" if w.partition_by else "()"
             ops.append(f"Window({', '.join(map(repr, w.funcs))} over {over})")
         if p.pushed_agg is not None:
-            ops.append(f"{'Partial' if p.pushed_agg_mode == 'partial' else ''}Agg({', '.join(map(repr, p.pushed_agg.aggs))})")
+            roll = " ROLLUP" if getattr(p.pushed_agg, "rollup", False) else ""
+            ops.append(f"{'Partial' if p.pushed_agg_mode == 'partial' else ''}Agg({', '.join(map(repr, p.pushed_agg.aggs))}){roll}")
         if p.pushed_topn is not None:
             ops.append(f"TopN({p.pushed_topn[1]})")
         if p.pushed_limit is not None:

@@ -90,6 +90,9 @@ DEFAULT_SYSVARS = {
     "tidb_auto_analyze_ratio": 0.5,
     "tidb_enable_index_merge": 1,
     "tidb_broadcast_join_threshold_count": 100_000,
+    # 1 = WITH ROLLUP fuses every grouping set into one device pass (the
+    # Expand fusion); 0 = the per-set union rewrite (comparison/debug)
+    "tidb_opt_fused_rollup": 1,
     # -- txn/retry family --
     "tidb_retry_limit": 10,
     "tidb_disable_txn_auto_retry": 1,
@@ -1042,6 +1045,7 @@ class Session:
             self.vars.get("tidb_enforce_mpp"),
             self.vars.get("tidb_enable_index_merge"),
             self.vars.get("tidb_broadcast_join_threshold_count"),
+            self.vars.get("tidb_opt_fused_rollup"),
         )
 
     def _plan_select(self, stmt, cache_key=None):
