@@ -1,0 +1,45 @@
+"""LOAD DATA INFILE (ref: pkg/executor/load_data.go) — the statement-level
+bulk CSV path sharing IMPORT INTO's conversion + ingest."""
+
+import os
+import tempfile
+
+import tidb_tpu
+
+
+def test_load_data_basic_and_column_list():
+    db = tidb_tpu.open()
+    s = db.session()
+    s.execute("CREATE TABLE ld (id BIGINT PRIMARY KEY, name VARCHAR(16), v BIGINT)")
+    p = os.path.join(tempfile.mkdtemp(), "d.csv")
+    with open(p, "w") as f:
+        f.write("id,name,v\n1,alpha,10\n2,beta,20\n3,\\N,30\n")
+    r = s.execute(f"LOAD DATA INFILE '{p}' INTO TABLE ld FIELDS TERMINATED BY ',' IGNORE 1 LINES")
+    assert r.affected == 3
+    assert s.execute("SELECT * FROM ld ORDER BY id").rows == [
+        (1, "alpha", 10), (2, "beta", 20), (3, None, 30),
+    ]
+    # TAB default + explicit column list (reorder, missing cols NULL)
+    p2 = os.path.join(tempfile.mkdtemp(), "d.tsv")
+    with open(p2, "w") as f:
+        f.write("40\t4\n50\t5\n")
+    r2 = s.execute(f"LOAD DATA LOCAL INFILE '{p2}' INTO TABLE ld (v, id)")
+    assert r2.affected == 2
+    assert s.execute("SELECT id, name, v FROM ld WHERE id >= 4 ORDER BY id").rows == [
+        (4, None, 40), (5, None, 50),
+    ]
+
+
+def test_load_data_errors():
+    import pytest
+
+    db = tidb_tpu.open()
+    s = db.session()
+    s.execute("CREATE TABLE le (a BIGINT, b BIGINT)")
+    p = os.path.join(tempfile.mkdtemp(), "e.csv")
+    with open(p, "w") as f:
+        f.write("1,2\n")
+    with pytest.raises(Exception, match="Unknown column"):
+        s.execute(f"LOAD DATA INFILE '{p}' INTO TABLE le FIELDS TERMINATED BY ',' (a, nope)")
+    with pytest.raises(Exception):
+        s.execute("LOAD DATA INFILE '/definitely/not/here.csv' INTO TABLE le")

@@ -1,0 +1,40 @@
+"""COM_STMT_FETCH cursor-read mode (ref: pkg/server/conn_stmt.go cursor
+handling): execute with CURSOR_TYPE_READ_ONLY parks the result server-side;
+the client drains it in fetch batches; the final EOF carries LAST_ROW_SENT."""
+
+import tidb_tpu
+from tidb_tpu.server import Server
+from tidb_tpu.server.client import Client
+
+
+def test_cursor_fetch_batches():
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE cf (id BIGINT PRIMARY KEY, v BIGINT)")
+    db.execute("INSERT INTO cf VALUES " + ", ".join(f"({i}, {i * 2})" for i in range(25)))
+    srv = Server(db, port=0)
+    port = srv.start()
+    try:
+        c = Client("127.0.0.1", port)
+        sid, nparams = c.prepare("SELECT id, v FROM cf ORDER BY id")
+        assert nparams == 0
+        cols = c.execute_cursor(sid)
+        assert cols == ["id", "v"]
+        got = []
+        done = False
+        fetches = 0
+        while not done:
+            rows, done = c.fetch(sid, 10)
+            got.extend(rows)
+            fetches += 1
+        assert fetches == 3  # 10 + 10 + 5
+        assert len(got) == 25
+        assert got[0] == (0, 0) and got[-1] == (24, 48)
+        # a closed statement drops its cursor
+        c.stmt_close(sid)
+        # plain (non-cursor) execution still streams everything at once
+        sid2, _ = c.prepare("SELECT COUNT(*) FROM cf")
+        rows = c.execute(sid2)
+        assert rows[0][0] in (25, "25")
+        c.close()
+    finally:
+        srv.close()

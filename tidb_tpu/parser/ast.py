@@ -680,6 +680,22 @@ class AnalyzeTable(Node):
     tables: list[TableRef] = field(default_factory=list)
 
 
+@dataclass
+class LoadData(Node):
+    """LOAD DATA [LOCAL] INFILE 'path' INTO TABLE t ... (ref:
+    pkg/executor/load_data.go; the INSERT-like bulk path over a CSV file —
+    IMPORT INTO's statement-level sibling)."""
+
+    path: str
+    table: TableRef
+    local: bool = False
+    fields_terminated: str = "\t"  # MySQL default: TAB
+    fields_enclosed: str = ""
+    ignore_lines: int = 0
+    columns: list = field(default_factory=list)  # subset/reorder; [] = all
+    dup_mode: str = ""  # "" | "ignore" | "replace"
+
+
 def bind_params(node, values):
     """Return a copy of the AST with each ParamMarker replaced by a Literal
     of the corresponding value (EXECUTE ... USING binding)."""

@@ -114,6 +114,7 @@ class Parser:
             "COMMIT": lambda: (self.next(), ast.Commit())[1],
             "ROLLBACK": lambda: (self.next(), ast.Rollback())[1],
             "ANALYZE": self.parse_analyze,
+            "LOAD": self.parse_load_data,
             "PREPARE": self.parse_prepare,
             "EXECUTE": self.parse_execute_stmt,
             "DEALLOCATE": self.parse_deallocate,
@@ -1846,6 +1847,54 @@ class Parser:
         elif self.eat_kw("OPTIMISTIC"):
             mode = "optimistic"
         return ast.Begin(mode=mode)
+
+    def parse_load_data(self) -> "ast.LoadData":
+        """LOAD DATA [LOCAL] INFILE 'path' INTO TABLE t [FIELDS TERMINATED
+        BY 'x' [ENCLOSED BY 'y']] [LINES TERMINATED BY 'z'] [IGNORE n
+        LINES|ROWS] [(cols)] (ref: parser.y LoadDataStmt)."""
+        self.expect_kw("LOAD")
+        self.expect_kw("DATA")
+        local = self.eat_kw("LOCAL")
+        self.expect_kw("INFILE")
+        t = self.next()
+        if t.kind != "str":
+            raise ParseError("expected file path string", t)
+        path = t.value
+        dup_mode = ""
+        if self.eat_kw("IGNORE"):
+            dup_mode = "ignore"
+        elif self.eat_kw("REPLACE"):
+            dup_mode = "replace"
+        self.expect_kw("INTO")
+        self.expect_kw("TABLE")
+        tbl = self._table_ref_simple()
+        stmt = ast.LoadData(path=path, table=tbl, local=local, dup_mode=dup_mode)
+        if self.eat_kw("FIELDS") or self.eat_kw("COLUMNS"):
+            while self.at_kw("TERMINATED", "ENCLOSED", "ESCAPED", "OPTIONALLY"):
+                self.eat_kw("OPTIONALLY")
+                if self.eat_kw("TERMINATED"):
+                    self.expect_kw("BY")
+                    stmt.fields_terminated = self.next().value
+                elif self.eat_kw("ENCLOSED"):
+                    self.expect_kw("BY")
+                    stmt.fields_enclosed = self.next().value
+                elif self.eat_kw("ESCAPED"):
+                    self.expect_kw("BY")
+                    self.next()  # accepted; csv module's default escape rules
+        if self.eat_kw("LINES"):
+            self.expect_kw("TERMINATED")
+            self.expect_kw("BY")
+            self.next()  # newline terminators only (csv reader)
+        if self.eat_kw("IGNORE"):
+            stmt.ignore_lines = int(self.next().value)
+            if not (self.eat_kw("LINES") or self.eat_kw("ROWS")):
+                raise ParseError("expected LINES/ROWS after IGNORE n", self.peek())
+        if self.eat_op("("):
+            stmt.columns.append(self.ident().lower())
+            while self.eat_op(","):
+                stmt.columns.append(self.ident().lower())
+            self.expect_op(")")
+        return stmt
 
     def parse_analyze(self) -> ast.AnalyzeTable:
         self.expect_kw("ANALYZE")

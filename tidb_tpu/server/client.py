@@ -236,6 +236,46 @@ class Client:
         self.columns = cols
         return rows
 
+    def execute_cursor(self, stmt_id: int, params: list = ()):
+        """Binary execute in CURSOR mode: the server parks the result; rows
+        arrive via fetch(). Returns the column names."""
+        assert not params, "cursor demo client: parameterless statements"
+        body = struct.pack("<IBI", stmt_id, p.CURSOR_TYPE_READ_ONLY, 1)
+        self.io.reset_seq()
+        self.io.write(bytes([p.COM_STMT_EXECUTE]) + body)
+        pkt = self.io.read()
+        if pkt[0] == 0xFF:
+            raise self._err(pkt)
+        if pkt[0] == 0x00:
+            # OK packet: no result set (DML) → no cursor to drain
+            raise MySQLError(0, "statement returned no result set; cursor not opened")
+        ncols, _ = p.read_lenc_int(pkt, 0)
+        self._cursor_types = []
+        cols = []
+        for _ in range(ncols):
+            name, tc = self._parse_coldef(self.io.read(), with_type=True)
+            cols.append(name)
+            self._cursor_types.append(tc)
+        eof = self.io.read()
+        status = struct.unpack_from("<H", eof, 3)[0]
+        assert status & p.SERVER_STATUS_CURSOR_EXISTS, "server did not open a cursor"
+        self.columns = cols
+        return cols
+
+    def fetch(self, stmt_id: int, n: int):
+        """COM_STMT_FETCH: (rows, done) — up to n rows of the open cursor."""
+        self.io.reset_seq()
+        self.io.write(bytes([p.COM_STMT_FETCH]) + struct.pack("<II", stmt_id, n))
+        rows = []
+        while True:
+            pkt = self.io.read()
+            if pkt[0] == 0xFF:
+                raise self._err(pkt)
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                status = struct.unpack_from("<H", pkt, 3)[0]
+                return rows, bool(status & p.SERVER_STATUS_LAST_ROW_SENT)
+            rows.append(self._parse_binary_row(pkt, self._cursor_types))
+
     def stmt_close(self, stmt_id: int) -> None:
         self.io.reset_seq()
         self.io.write(bytes([p.COM_STMT_CLOSE]) + struct.pack("<I", stmt_id))

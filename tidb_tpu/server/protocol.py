@@ -177,6 +177,12 @@ COM_STMT_EXECUTE = 0x17
 COM_STMT_SEND_LONG_DATA = 0x18
 COM_STMT_CLOSE = 0x19
 COM_STMT_RESET = 0x1A
+COM_STMT_FETCH = 0x1C
+
+# cursor status flags (ref: mysql SERVER_STATUS_*; conn_stmt.go cursor mode)
+SERVER_STATUS_CURSOR_EXISTS = 0x0040
+SERVER_STATUS_LAST_ROW_SENT = 0x0080
+CURSOR_TYPE_READ_ONLY = 0x01
 
 T_TINY = 1
 T_SHORT = 2

@@ -27,6 +27,9 @@ class ClientConn:
         self.server = server
         self.sock = sock
         self.conn_id = conn_id
+        # open read-only cursors: stmt id → (remaining rows iterively
+        # drained by COM_STMT_FETCH, ftypes) (ref: conn_stmt.go cursor mode)
+        self.cursors: dict[int, list] = {}
         self.session = server.db.session()
         self.session.conn_id = conn_id
         self.user = ""
@@ -159,6 +162,7 @@ class ClientConn:
                     self._stmt_execute(io, data)
                 elif cmd == p.COM_STMT_CLOSE:
                     sid = struct.unpack_from("<I", data, 0)[0]
+                    self.cursors.pop(sid, None)
                     st = self.stmts.pop(sid, None)
                     if st is not None:
                         self.session.prepared.pop(st[0], None)
@@ -166,7 +170,10 @@ class ClientConn:
                 elif cmd == p.COM_STMT_SEND_LONG_DATA:
                     pass  # protocol: no response; long data unsupported → the
                     # execute fails cleanly on the missing parameter
+                elif cmd == p.COM_STMT_FETCH:
+                    self._stmt_fetch(io, data)
                 elif cmd == p.COM_STMT_RESET:
+                    self.cursors.pop(struct.unpack_from("<I", data, 0)[0], None)
                     io.write(p.ok_packet())
                 else:
                     io.write(p.err_packet(1047, f"Unknown command {cmd}", "08S01"))
@@ -217,6 +224,10 @@ class ClientConn:
             io.write(p.err_packet(1243, f"Unknown prepared statement handler ({sid})", "HY000"))
             return
         name, n_params, prev_types = st
+        cursor_flags = data[4] if len(data) > 4 else 0
+        # MySQL closes any open cursor on re-execute: a stale one would feed
+        # COM_STMT_FETCH rows from the PREVIOUS execution
+        self.cursors.pop(sid, None)
         try:
             vals, types = p.decode_binary_params(data, 9, n_params, prev_types)
             st[2] = types
@@ -239,10 +250,35 @@ class ClientConn:
             else:
                 tc, ln, dec = p.T_VAR_STRING, 255, 0
             io.write(p.column_def(str(cname), tc, ln, dec))
+        if cursor_flags & p.CURSOR_TYPE_READ_ONLY:
+            # cursor mode (ref: conn_stmt.go): park the result server-side;
+            # the client drains it in COM_STMT_FETCH batches
+            self.cursors[sid] = [list(res.rows), ftypes]
+            io.write(p.eof_packet(status=2 | p.SERVER_STATUS_CURSOR_EXISTS, warnings=wc))
+            return
         io.write(p.eof_packet())
         for row in res.rows:
             io.write(p.binary_row(row, ftypes))
         io.write(p.eof_packet(warnings=wc))
+
+    def _stmt_fetch(self, io: p.PacketIO, data: bytes) -> None:
+        """COM_STMT_FETCH: stream the next n rows of an open cursor (ref:
+        conn_stmt.go handleStmtFetch; EOF carries LAST_ROW_SENT once
+        drained)."""
+        sid, nrows = struct.unpack_from("<II", data, 0)
+        cur = self.cursors.get(sid)
+        if cur is None:
+            io.write(p.err_packet(1243, f"Unknown cursor for statement ({sid})", "HY000"))
+            return
+        rows, ftypes = cur
+        batch, cur[0] = rows[:nrows], rows[nrows:]
+        for row in batch:
+            io.write(p.binary_row(row, ftypes))
+        if cur[0]:
+            io.write(p.eof_packet(status=2 | p.SERVER_STATUS_CURSOR_EXISTS))
+        else:
+            self.cursors.pop(sid, None)
+            io.write(p.eof_packet(status=2 | p.SERVER_STATUS_LAST_ROW_SENT))
 
     def _run_sql(self, io: p.PacketIO, sql: str) -> None:
         self.current_sql = sql

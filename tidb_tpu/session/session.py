@@ -599,6 +599,8 @@ class Session:
             if server is not None and server.kill_global(stmt.conn_id, stmt.query_only):
                 return Result()
             raise SessionError(f"Unknown thread id: {stmt.conn_id}")
+        if isinstance(stmt, ast.LoadData):
+            return self._load_data(stmt)
         if isinstance(stmt, ast.ImportInto):
             from tidb_tpu.tools.importer import import_into, import_into_disttask
 
@@ -1387,6 +1389,57 @@ class Session:
         else:
             text = explain_plan(plan)
         return Result(columns=["plan"], rows=[(line,) for line in text.split("\n")])
+
+    def _load_data(self, stmt: "ast.LoadData") -> Result:
+        """LOAD DATA INFILE: CSV file → the bulk import path (ref:
+        pkg/executor/load_data.go; shares the IMPORT INTO conversion +
+        columnar/txn ingest). LOCAL reads the file from this process —
+        the wire server runs in-process with the session, so client-side
+        and server-side paths coincide here."""
+        import csv as _csv
+
+        from tidb_tpu.tools.importer import import_rows_slice
+
+        db_name = stmt.table.db or self.current_db
+        self.require_priv(db_name, stmt.table.name, "insert")
+        if stmt.dup_mode == "replace":
+            raise SessionError("LOAD DATA ... REPLACE is not supported yet")
+        t = self.catalog.table(db_name, stmt.table.name)
+        kw = {"delimiter": stmt.fields_terminated or "\t"}
+        if stmt.fields_enclosed:
+            kw["quotechar"] = stmt.fields_enclosed
+        else:
+            # MySQL's default is NO enclosure: quotes are data, not wrappers
+            kw["quoting"] = _csv.QUOTE_NONE
+        with open(stmt.path, newline="") as f:
+            # IGNORE n LINES counts PHYSICAL lines (blank ones included)
+            all_lines = list(_csv.reader(f, **kw))
+        raw = [r for r in all_lines[stmt.ignore_lines :] if r]
+        if stmt.columns:
+            # explicit column list: reorder/pad to the full table width
+            pos = {c.name.lower(): i for i, c in enumerate(t.columns)}
+            for cname in stmt.columns:
+                if cname not in pos:
+                    raise SessionError(f"Unknown column '{cname}' in field list")
+            width = len(t.columns)
+            mapped = []
+            for r in raw:
+                if len(r) < len(stmt.columns):
+                    raise SessionError("Row does not contain data for all fields")
+                full = ["\\N"] * width
+                for cname, v in zip(stmt.columns, r):
+                    full[pos[cname]] = v
+                mapped.append(full)
+            raw = mapped
+        on_existing = "skip" if stmt.dup_mode == "ignore" else None
+        n = (
+            import_rows_slice(self._db, db_name, stmt.table.name, raw, on_existing=on_existing)
+            if raw
+            else 0
+        )
+        self.note_table_mods(t.id, n)
+        res = Result(affected=n)
+        return res
 
     def _analyze(self, stmt: ast.AnalyzeTable) -> Result:
         """ANALYZE TABLE: build histograms/TopN/CM-FM sketches per column and
