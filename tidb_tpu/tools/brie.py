@@ -1,6 +1,10 @@
 """BACKUP / RESTORE (ref: br/ physical backup; SQL surface executor/brie.go).
 
-Format: a directory holding
+Destinations are ExternalStorage URLs (tools/storage.py — the
+br/pkg/storage seam): ``file:///dir``, a bare directory path, or
+``memory://bucket/prefix`` (the hermetic object-store stand-in).
+
+Format: a storage prefix holding
   backupmeta.json        — backup_ts + per-table schema pb (catalog format)
   <db>.<table>.rows      — per physical table: [handle i64][len u32][row bytes]*
 Rows are MVCC-consistent at backup_ts. Restore recreates tables (fresh ids),
@@ -10,7 +14,6 @@ rebuilds indexes from row data (so index ids/layout never need to match)."""
 from __future__ import annotations
 
 import json
-import os
 import struct
 
 from tidb_tpu.catalog.schema import TableInfo
@@ -19,9 +22,12 @@ from tidb_tpu.kv.memstore import Snapshot
 
 
 def backup_database(db, db_name: str, dest: str, tables: list[str] | None = None) -> dict:
-    """Snapshot-consistent backup of a database (or a table subset) to
-    ``dest``; returns the meta dict (incl. backup_ts, per-table row counts)."""
-    os.makedirs(dest, exist_ok=True)
+    """Snapshot-consistent backup of a database (or a table subset) to the
+    ``dest`` storage URL; returns the meta dict (incl. backup_ts, per-table
+    row counts)."""
+    from tidb_tpu.tools.storage import open_storage
+
+    store_out = open_storage(dest)
     backup_ts = db.store.current_ts()
     names = tables if tables is not None else db.catalog.tables(db_name)
     meta: dict = {"backup_ts": backup_ts, "db": db_name, "tables": {}}
@@ -29,17 +35,16 @@ def backup_database(db, db_name: str, dest: str, tables: list[str] | None = None
     for name in names:
         t = db.catalog.table(db_name, name)
         count = 0
-        path = os.path.join(dest, f"{db_name}.{t.name}.rows")
-        with open(path, "wb") as f:
+        fname = f"{db_name}.{t.name}.rows"
+        with store_out.create(fname) as w:
             for view in t.partition_views():
                 for k, v in snap.scan(tablecodec.record_range(view.id)):
                     handle = tablecodec.decode_record_key(k)[1]
-                    f.write(struct.pack("<qI", handle, len(v)))
-                    f.write(v)
+                    w.write(struct.pack("<qI", handle, len(v)))
+                    w.write(v)
                     count += 1
-        meta["tables"][t.name] = {"schema": t.to_pb(), "rows": count, "file": os.path.basename(path)}
-    with open(os.path.join(dest, "backupmeta.json"), "w") as f:
-        json.dump(meta, f)
+        meta["tables"][t.name] = {"schema": t.to_pb(), "rows": count, "file": fname}
+    store_out.write_file("backupmeta.json", json.dumps(meta).encode())
     return meta
 
 
@@ -48,8 +53,10 @@ def restore_database(db, src: str, db_name: str | None = None) -> tuple[dict, di
     table id: new id}) — the id map lets PITR log replay re-key entries
     recorded under the ORIGINAL ids. Tables must not already exist (ref: BR
     restore refusing to overwrite)."""
-    with open(os.path.join(src, "backupmeta.json")) as f:
-        meta = json.load(f)
+    from tidb_tpu.tools.storage import open_storage
+
+    store_in = open_storage(src)
+    meta = json.loads(store_in.read_file("backupmeta.json").decode())
     target_db = db_name or meta["db"]
     if target_db not in db.catalog.databases():
         db.catalog.create_database(target_db, if_not_exists=True)
@@ -67,13 +74,12 @@ def restore_database(db, src: str, db_name: str | None = None) -> tuple[dict, di
         id_map[old.id] = new_t.id
         for ov, nv in zip(old.partition_views(), new_t.partition_views()):
             id_map[ov.id] = nv.id
-        rows_path = os.path.join(src, tmeta["file"])
-        n = _restore_rows(db, new_t, rows_path)
+        n = _restore_rows(db, new_t, store_in.read_file(tmeta["file"]))
         out[name] = n
     return out, id_map
 
 
-def _restore_rows(db, t: TableInfo, path: str) -> int:
+def _restore_rows(db, t: TableInfo, blob: bytes) -> int:
     from tidb_tpu.executor.write import index_entry
     from tidb_tpu.kv.rowcodec import RowSchema, decode_row
 
@@ -83,31 +89,32 @@ def _restore_rows(db, t: TableInfo, path: str) -> int:
     vals: list[bytes] = []
     n = 0
     max_handle = 0
-    with open(path, "rb") as f:
-        while True:
-            hdr = f.read(12)
-            if len(hdr) < 12:
-                break
-            handle, ln = struct.unpack("<qI", hdr)
-            raw = f.read(ln)
-            if t.partition is not None or has_index:
-                row = decode_row(schema, raw)
-                view = (
-                    t.partition_view(t.partition_id_for(row)) if t.partition is not None else t
-                )
-                keys.append(tablecodec.record_key(view.id, handle))
-                vals.append(raw)
-                for idx in t.indexes:
-                    if idx.state != "public":
-                        continue
-                    ik, iv = index_entry(view, idx, row, handle)
-                    keys.append(ik)
-                    vals.append(iv)
-            else:
-                keys.append(tablecodec.record_key(t.id, handle))
-                vals.append(raw)
-            max_handle = max(max_handle, handle)
-            n += 1
+    off = 0
+    while True:
+        if off + 12 > len(blob):
+            break
+        handle, ln = struct.unpack_from("<qI", blob, off)
+        off += 12
+        raw = blob[off : off + ln]
+        off += ln
+        if t.partition is not None or has_index:
+            row = decode_row(schema, raw)
+            view = (
+                t.partition_view(t.partition_id_for(row)) if t.partition is not None else t
+            )
+            keys.append(tablecodec.record_key(view.id, handle))
+            vals.append(raw)
+            for idx in t.indexes:
+                if idx.state != "public":
+                    continue
+                ik, iv = index_entry(view, idx, row, handle)
+                keys.append(ik)
+                vals.append(iv)
+        else:
+            keys.append(tablecodec.record_key(t.id, handle))
+            vals.append(raw)
+        max_handle = max(max_handle, handle)
+        n += 1
     if keys:
         db.store.ingest(keys, vals)
     db.catalog.rebase_autoid(t.id, max_handle + 1)

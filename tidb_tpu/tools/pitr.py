@@ -141,8 +141,11 @@ def restore_point(db, full_backup_dir: str, log_dir: str, target_ts: int | None 
     from tidb_tpu.executor.write import index_entry
     from tidb_tpu.tools.brie import restore_database
 
-    with open(os.path.join(full_backup_dir, "backupmeta.json")) as f:
-        backup_ts = json.load(f)["backup_ts"]
+    from tidb_tpu.tools.storage import open_storage
+
+    backup_ts = json.loads(
+        open_storage(full_backup_dir).read_file("backupmeta.json").decode()
+    )["backup_ts"]
     with open(os.path.join(log_dir, "logmeta.json")) as f:
         logmeta = json.load(f)
     if logmeta["start_ts"] > backup_ts:
