@@ -24,3 +24,11 @@ jax.config.update("jax_enable_x64", True)
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`: fast deterministic chaos tests stay in
+    # tier-1 (marked `chaos` only); long soak/multi-process topologies add
+    # `slow` so they run in the extended lane (see RESILIENCE.md)
+    config.addinivalue_line("markers", "chaos: deterministic fault-injection test")
+    config.addinivalue_line("markers", "slow: excluded from the tier-1 fast lane")

@@ -1,0 +1,206 @@
+"""Backoffer unit tests: budget exhaustion, jitter determinism under a fixed
+seed, error classification, fork budget sharing — plus the chaos-action
+toolkit (NShot / Probabilistic / Script) and the extended InjectionConfig
+one-shot semantics (ref: client-go internal/retry/backoff_test.go;
+pingcap/failpoint term grammar)."""
+
+import threading
+
+import pytest
+
+from tidb_tpu.kv.fault_injection import (
+    InjectedStore,
+    NShot,
+    Probabilistic,
+    Script,
+    reset_wire,
+)
+from tidb_tpu.kv.kv import (
+    KVError,
+    RegionError,
+    TxnAbortedError,
+    UndeterminedError,
+    WriteConflictError,
+)
+from tidb_tpu.utils.backoff import (
+    AMBIGUOUS,
+    FATAL,
+    RETRIABLE,
+    Backoffer,
+    BackoffExhausted,
+    boRegionMiss,
+    boRPC,
+    classify,
+)
+
+
+def _no_sleep(_s):
+    pass
+
+
+def test_exponential_growth_and_cap():
+    bo = Backoffer(budget_ms=10**9, seed=1, sleep=_no_sleep)
+    cfg = boRPC  # base 10ms cap 400ms, equal jitter: sleep in [raw/2, raw]
+    raws = [min(cfg.cap_ms, cfg.base_ms * (2**n)) for n in range(8)]
+    slept = [bo.backoff(cfg) for _ in range(8)]
+    for got, raw in zip(slept, raws):
+        assert raw / 2 <= got <= raw
+    assert bo.attempts(cfg) == 8
+    # cap reached: attempts 6+ draw from [200, 400]
+    assert slept[-1] <= cfg.cap_ms
+
+
+def test_jitter_deterministic_under_seed():
+    a = Backoffer(budget_ms=10**9, seed=42, sleep=_no_sleep)
+    b = Backoffer(budget_ms=10**9, seed=42, sleep=_no_sleep)
+    c = Backoffer(budget_ms=10**9, seed=43, sleep=_no_sleep)
+    sa = [a.backoff(boRPC) for _ in range(6)]
+    sb = [b.backoff(boRPC) for _ in range(6)]
+    sc = [c.backoff(boRPC) for _ in range(6)]
+    assert sa == sb, "same seed must replay the exact jitter stream"
+    assert sa != sc
+
+
+def test_budget_exhaustion_carries_last_error():
+    bo = Backoffer(budget_ms=30, seed=0, sleep=_no_sleep)
+    last = ConnectionResetError("frame dropped")
+    with pytest.raises(BackoffExhausted) as ei:
+        for _ in range(100):
+            bo.backoff(boRPC, last)
+    exc = ei.value
+    assert exc.last is last, "exhaustion must surface the CAUSE"
+    assert exc.slept_ms <= 30
+    assert exc.attempts == bo.attempts()
+    assert "frame dropped" in str(exc)
+
+
+def test_backoff_refuses_non_retriable():
+    bo = Backoffer(budget_ms=1000, sleep=_no_sleep)
+    with pytest.raises(UndeterminedError):
+        bo.backoff(boRPC, UndeterminedError("commit outcome unknown"))
+    with pytest.raises(WriteConflictError):
+        bo.backoff(boRPC, WriteConflictError(b"k", 9, 5))
+    assert bo.attempts() == 0, "fatal/ambiguous errors must not consume budget"
+
+
+def test_classification_taxonomy():
+    assert classify(ConnectionResetError("x")) == RETRIABLE
+    assert classify(TimeoutError()) == RETRIABLE
+    assert classify(OSError("wire")) == RETRIABLE
+    assert classify(RegionError(7)) == RETRIABLE  # stale routing, re-resolve
+    assert classify(UndeterminedError("?")) == AMBIGUOUS
+    assert classify(WriteConflictError(b"k", 2, 1)) == FATAL
+    assert classify(TxnAbortedError("aborted")) == FATAL
+    assert classify(KVError("verdict")) == FATAL
+    assert classify(ValueError("bug")) == FATAL
+    # opt-in marker for errors outside the known hierarchy
+    e = RuntimeError("transient")
+    e.retriable = True
+    assert classify(e) == RETRIABLE
+
+
+def test_thread_safety_budget_never_overspent():
+    bo = Backoffer(budget_ms=50, seed=0, sleep=_no_sleep)
+    exhausted = []
+
+    def worker():
+        try:
+            for _ in range(200):
+                bo.backoff(boRegionMiss)
+        except BackoffExhausted:
+            exhausted.append(1)
+
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert bo.slept_ms <= 50, "concurrent backoffs must respect the shared budget"
+    assert exhausted, "every worker eventually exhausts"
+
+
+# -- chaos actions ----------------------------------------------------------
+
+
+def test_nshot_fires_exactly_n_then_passes():
+    shot = NShot(reset_wire, n_times=2)
+    for _ in range(2):
+        with pytest.raises(ConnectionResetError):
+            shot("get")
+    assert shot("get") is None  # disarmed
+    assert shot.fired == 2 and shot.calls == 3
+
+
+def test_nshot_match_filters_by_site_args():
+    shot = NShot(reset_wire, n_times=1, match=lambda cmd: cmd == "commit")
+    assert shot("get") is None
+    with pytest.raises(ConnectionResetError):
+        shot("commit")
+    assert shot("commit") is None
+    assert shot.fired == 1
+
+
+def test_probabilistic_seeded_schedule_replays():
+    def run(seed):
+        p = Probabilistic(lambda *_: "hit", p=0.3, seed=seed)
+        return [p("x") for _ in range(50)], p.fired
+
+    out1, n1 = run(7)
+    out2, n2 = run(7)
+    out3, n3 = run(8)
+    assert out1 == out2 and n1 == n2, "seeded chaos must replay exactly"
+    assert 0 < n1 < 50
+    assert out1 != out3
+
+
+def test_script_exact_sequence():
+    seen = []
+    steps = [None, ConnectionResetError("boom"), lambda *a: seen.append(a)]
+    sc = Script(steps)
+    assert sc("a") is None
+    with pytest.raises(ConnectionResetError):
+        sc("b")
+    sc("c")
+    assert seen == [("c",)]
+    assert sc("past-the-end") is None
+
+
+# -- InjectionConfig one-shot + new hooks -----------------------------------
+
+
+def test_injection_one_shot_and_new_hooks():
+    import tidb_tpu
+
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE tb (a BIGINT)")
+    inj = InjectedStore(db.store)
+    txn = inj.begin()
+    txn.put(b"zz-bo-key", b"v")
+    txn.commit()
+
+    # one-shot get: fails exactly once, then self-disarms
+    inj.cfg.set_get_error(ConnectionResetError("once"), n_times=1)
+    snap = inj.get_snapshot(inj.current_ts())
+    with pytest.raises(ConnectionResetError):
+        snap.get(b"zz-bo-key")
+    assert snap.get(b"zz-bo-key") == b"v"
+
+    # scan hook (new): injectable on snapshots and txns
+    from tidb_tpu.kv.kv import KeyRange
+
+    kr = KeyRange(b"zz-", b"zz~")
+    inj.cfg.set_scan_error(OSError("scan wire fault"), n_times=1)
+    with pytest.raises(OSError):
+        inj.get_snapshot(inj.current_ts()).scan(kr)
+    assert inj.get_snapshot(inj.current_ts()).scan(kr)
+
+    # prewrite hook (new): fails 2PC phase one at the store surface
+    from tidb_tpu.kv.memstore import OP_PUT, Mutation
+
+    inj.cfg.set_prewrite_error(ConnectionResetError("prewrite down"), n_times=1)
+    ts = inj.tso.ts()
+    with pytest.raises(ConnectionResetError):
+        inj.prewrite([Mutation(OP_PUT, b"zz-bo-k2", b"w")], b"zz-bo-k2", ts)
+    inj.prewrite([Mutation(OP_PUT, b"zz-bo-k2", b"w")], b"zz-bo-k2", ts)
+    inj.commit([b"zz-bo-k2"], ts, inj.tso.ts())
+    assert inj.get_snapshot(inj.current_ts()).get(b"zz-bo-k2") == b"w"

@@ -4,7 +4,7 @@ import json
 
 import tidb_tpu
 from tidb_tpu.bench.benchdb import run_jobs
-from tidb_tpu.bench.benchdaily import run_all
+from tidb_tpu.bench.benchdaily import check_regression, run_all
 
 
 def test_benchdb_jobs():
@@ -23,3 +23,26 @@ def test_benchdaily_json(tmp_path):
     p = tmp_path / "daily.json"
     p.write_text(json.dumps(recs))
     assert json.loads(p.read_text())[0]["name"] == "BenchmarkChunkCodec"
+
+
+def test_regression_guard():
+    """The guard that would have caught the q3_join_mpp_ms 161.6→207.6 ms
+    drift (VERDICT round 5): +28% latency trips a 25% tolerance."""
+    base = [
+        {"name": "q3_join_mpp_ms", "ms": 161.6},
+        {"name": "BenchmarkPointGet", "ops_per_sec": 10_000},
+        {"name": "BenchmarkOnlyInBaseline", "ops_per_sec": 5},
+    ]
+    drifted = [
+        {"name": "q3_join_mpp_ms", "ms": 207.6},
+        {"name": "BenchmarkPointGet", "ops_per_sec": 9_500},
+        {"name": "BenchmarkBrandNew", "ms": 1.0},
+    ]
+    bad = check_regression(drifted, base, tolerance=0.25)
+    assert len(bad) == 1 and "q3_join_mpp_ms" in bad[0], bad
+    # within tolerance, in either metric kind → clean
+    ok = [{"name": "q3_join_mpp_ms", "ms": 180.0}, {"name": "BenchmarkPointGet", "ops_per_sec": 9_000}]
+    assert check_regression(ok, base, tolerance=0.25) == []
+    # throughput collapse trips the ops guard
+    slow = [{"name": "BenchmarkPointGet", "ops_per_sec": 5_000}]
+    assert len(check_regression(slow, base, tolerance=0.25)) == 1

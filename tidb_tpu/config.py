@@ -26,6 +26,14 @@ class Config:
     store: str = "embedded"
     store_path: str = ""  # host:port of the remote StoreServer
     region_split_keys: int = 500_000
+    # [network] one timeout pair for every TCP seam (SQL wire client and the
+    # store RPC client, kv/remote.py): connect fails fast, reads tolerate
+    # first-query JIT compiles and big scans. rpc-retry-budget-ms bounds the
+    # TOTAL backoff sleep one store RPC may spend reconnecting/replaying
+    # before it surfaces ConnectionError (utils/backoff.Backoffer budget).
+    connect_timeout_s: float = 5.0
+    read_timeout_s: float = 600.0
+    rpc_retry_budget_ms: float = 4000.0
     # [security]
     ssl_enabled: bool = False
     ssl_cert: str = ""
@@ -35,10 +43,13 @@ class Config:
 
     @staticmethod
     def from_toml(path: str) -> "Config":
-        import tomllib
+        try:
+            import tomllib
 
-        with open(path, "rb") as f:
-            raw = tomllib.load(f)
+            with open(path, "rb") as f:
+                raw = tomllib.load(f)
+        except ImportError:  # Python < 3.11: no stdlib TOML parser
+            raw = _parse_toml_subset(path)
         cfg = Config()
         srv = raw.get("server", {})
         cfg.host = srv.get("host", cfg.host)
@@ -50,6 +61,10 @@ class Config:
         cfg.store = sto.get("store", cfg.store)
         cfg.store_path = sto.get("path", cfg.store_path)
         cfg.region_split_keys = int(sto.get("region-split-keys", cfg.region_split_keys))
+        net = raw.get("network", {})
+        cfg.connect_timeout_s = float(net.get("connect-timeout", cfg.connect_timeout_s))
+        cfg.read_timeout_s = float(net.get("read-timeout", cfg.read_timeout_s))
+        cfg.rpc_retry_budget_ms = float(net.get("rpc-retry-budget-ms", cfg.rpc_retry_budget_ms))
         sec = raw.get("security", {})
         cfg.ssl_cert = sec.get("ssl-cert", cfg.ssl_cert)
         cfg.ssl_key = sec.get("ssl-key", cfg.ssl_key)
@@ -73,6 +88,57 @@ class Config:
         if getattr(args, "no_status", False):
             out.status_enabled = False
         return out
+
+
+def _parse_toml_subset(path: str) -> dict:
+    """Minimal TOML reader for the config surface above, used where the
+    stdlib ``tomllib`` is unavailable (Python 3.10 images): ``[a.b]`` tables,
+    ``key = value`` with quoted strings, booleans, ints, and floats. Arrays
+    and multi-line values are out of scope — the config file never uses them."""
+    root: dict = {}
+    table = root
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            s = line.strip()
+            if not s or s.startswith("#"):
+                continue
+            if s.startswith("["):
+                end = s.find("]")
+                rest = s[end + 1 :].strip() if end > 0 else ""
+                if end < 0 or (rest and not rest.startswith("#")):
+                    raise ValueError(f"{path}:{lineno}: malformed table header: {s!r}")
+                table = root
+                for part in s[1:end].strip().split("."):
+                    table = table.setdefault(part.strip(), {})
+                continue
+            if "=" not in s:
+                raise ValueError(f"{path}:{lineno}: not `key = value`: {s!r}")
+            key, _, val = s.partition("=")
+            key, val = key.strip().strip('"'), val.strip()
+            if val[:1] in ('"', "'"):
+                q = val[0]
+                end = val.find(q, 1)
+                rest = val[end + 1 :].strip() if end > 0 else ""
+                if end < 0 or (rest and not rest.startswith("#")):
+                    raise ValueError(f"{path}:{lineno}: malformed string value: {val!r}")
+                table[key] = val[1:end]
+                continue
+            if "#" in val:
+                val = val.split("#", 1)[0].strip()
+            if val in ("true", "false"):
+                table[key] = val == "true"
+            else:
+                try:
+                    table[key] = int(val)
+                except ValueError:
+                    try:
+                        table[key] = float(val)
+                    except ValueError:
+                        raise ValueError(
+                            f"{path}:{lineno}: unparseable value {val!r}"
+                            " (strings must be quoted)"
+                        ) from None
+    return root
 
 
 def parse_args(argv=None):
@@ -106,7 +172,26 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
+# process-wide effective config: set once at boot (load()), read by every
+# seam that needs a default it cannot be handed explicitly — RemoteStore and
+# the wire client source their timeout/retry-budget defaults here, so a
+# `--config` file's [network] section takes effect without threading a Config
+# through each constructor.
+_CURRENT: Optional[Config] = None
+
+
+def set_current(cfg: Config) -> None:
+    global _CURRENT
+    _CURRENT = cfg
+
+
+def current() -> Config:
+    return _CURRENT if _CURRENT is not None else Config()
+
+
 def load(argv=None) -> tuple[Config, object]:
     args = parse_args(argv)
     cfg = Config.from_toml(args.config) if args.config else Config()
-    return cfg.merged_flags(args), args
+    merged = cfg.merged_flags(args)
+    set_current(merged)
+    return merged, args

@@ -14,8 +14,10 @@ from dataclasses import dataclass
 from typing import Callable, Iterator, Optional
 
 from tidb_tpu.copr import dagpb
-from tidb_tpu.kv.kv import KeyRange, Request, RequestType, StoreType
+from tidb_tpu.kv.kv import KeyRange, KVError, RegionError, Request, RequestType, StoreType
 from tidb_tpu.kv.memstore import MemStore, Region
+from tidb_tpu.utils import failpoint
+from tidb_tpu.utils.backoff import Backoffer, BackoffExhausted, boRegionMiss
 from tidb_tpu.utils.chunk import Chunk
 
 # engine registry: StoreType → DAG executor over one region
@@ -50,6 +52,68 @@ class CopResult:
     region_id: int
 
 
+def run_task_resilient(
+    bo: Backoffer,
+    run_one: Callable,
+    resplit: Callable,
+    region,
+    ranges,
+    store_type: StoreType,
+    *,
+    warn=None,
+    degrade_reason: str,
+    degrade_on: tuple,
+    never_degrade: tuple = (),
+) -> Chunk:
+    """One cop task under the request's Backoffer — the single region-error /
+    degrade policy shared by the embedded and remote cop clients.
+
+    ``run_one(store_type, region, ranges) -> Chunk`` executes one attempt;
+    ``resplit(ranges) -> [(region, ranges)]`` re-resolves routing. A
+    RegionError re-splits RECURSIVELY: a second epoch change re-enters the
+    same handler, bounded by the boRegionMiss budget, whose exhaustion
+    surfaces the last region error typed (never the retry mechanism). A
+    TPU-engine failure matching ``degrade_on`` (minus ``never_degrade``)
+    falls back to the host engine for THIS task — through the same re-split
+    handler, so a degrade retry never reuses stale routing.
+    (ref: coprocessor.go buildCopTasks re-entry on region error)"""
+
+    def attempt(st, region2, ranges2):
+        try:
+            return run_one(st, region2, ranges2)
+        except RegionError as e:
+            try:
+                bo.backoff(boRegionMiss, e)
+            except BackoffExhausted as be:
+                raise (be.last or e) from be
+            parts = [attempt(st, r2, k2) for r2, k2 in resplit(ranges2)]
+            if not parts:
+                # routing no longer covers these ranges at all (dropped
+                # table, merged-away regions): surface the region verdict,
+                # not a bare concat-of-nothing assertion
+                raise e
+            return Chunk.concat(parts) if len(parts) != 1 else parts[0]
+
+    try:
+        return attempt(store_type, region, ranges)
+    except RegionError:
+        raise  # exhausted re-splits: a routing verdict, not an engine failure
+    except never_degrade:
+        raise
+    except degrade_on as e:
+        if store_type != StoreType.TPU:
+            raise
+        # graceful degradation: one task's TPU-engine failure falls back to
+        # the host engine for THAT task and is recorded — the query answers
+        # instead of dying with the device
+        if warn is not None:
+            warn(1, 1105, f"TPU cop task on region {region.region_id} degraded to host: {e}")
+        from tidb_tpu.utils import metrics as _m
+
+        _m.COP_DEGRADED.inc(reason=degrade_reason)
+        return attempt(StoreType.HOST, region, ranges)
+
+
 class CopResponse:
     """Streaming response (kv.Response). Iterates CopResults; with
     keep_order the stream follows region order, else completion order."""
@@ -78,7 +142,6 @@ class CopClient:
     def send(self, req: Request) -> CopResponse:
         assert req.tp == RequestType.DAG
         dag: dagpb.DAGRequest = req.data
-        engine = _engines()[req.store_type]
         read_ts = req.start_ts or self.store.current_ts()
 
         tasks: list[CopTask] = []
@@ -91,9 +154,35 @@ class CopClient:
             return CopResponse(iter(()), None)
 
         concurrency = max(1, min(req.concurrency, len(tasks)))
+        # one typed retry budget shared by every task of this request (ref:
+        # copIterator's Backoffer per copTask batch; worker threads share it)
+        bo = Backoffer(budget_ms=2000)
+
+        def run_engine(store_type: StoreType, region: Region, ranges: list[KeyRange]) -> Chunk:
+            # chaos seam: tests fault exact (task, engine) pairs (N-shot /
+            # scripted) without touching the engines themselves
+            failpoint.inject("cop_task_engine", region.region_id, store_type)
+            return _engines()[store_type](self.store, dag, region, ranges, read_ts, warn=req.warn)
+
+        from tidb_tpu.utils.memory import QueryKilledError, QueryOOMError
 
         def run(task: CopTask) -> CopResult:
-            chunk = engine(self.store, dag, task.region, task.ranges, read_ts, warn=req.warn)
+            chunk = run_task_resilient(
+                bo,
+                run_engine,
+                self.store.pd.regions_in_ranges,
+                task.region,
+                task.ranges,
+                req.store_type,
+                warn=req.warn,
+                degrade_reason="embedded",
+                # RuntimeError is the device-failure shape (XlaRuntimeError
+                # subclasses it); anything broader would silently mask TPU
+                # engine BUGS behind a correct host answer
+                degrade_on=(RuntimeError,),
+                # data/txn verdicts and kills: degrading engines would not help
+                never_degrade=(KVError, QueryKilledError, QueryOOMError),
+            )
             return CopResult(chunk, task.task_id, task.region.region_id)
 
         if concurrency == 1 or len(tasks) == 1:

@@ -116,6 +116,33 @@ class KVError(Exception):
     pass
 
 
+class RegionError(Exception):
+    """Stale region routing: the store no longer serves the region this task
+    named (split/merge bumped the epoch, or the region moved). Retriable
+    after re-resolving regions from PD (ref: errorpb.EpochNotMatch /
+    RegionNotFound — client-go re-splits the task under BoRegionMiss).
+
+    Deliberately NOT a KVError: the taxonomy (utils/backoff.classify) treats
+    KVError subclasses as statement verdicts (fatal to the retry layer),
+    while a region miss is pure routing staleness."""
+
+    def __init__(self, region_id: int, msg: str = ""):
+        super().__init__(msg or f"region {region_id} not served here (epoch changed?)")
+        self.region_id = region_id
+
+
+class UndeterminedError(KVError):
+    """A commit request failed AFTER it may have reached the store: the
+    transaction may be durably committed or not, and nothing client-side can
+    tell which. Never blind-retry (a re-commit can hit 'lock not found' and
+    misreport abort), never report abort (the write may be visible). Surface
+    to the client, who must check (ref: client-go ErrResultUndetermined,
+    terror CodeResultUndetermined — the 2PC safety rule)."""
+
+    def __init__(self, msg: str):
+        super().__init__(msg)
+
+
 class WriteConflictError(KVError):
     def __init__(self, key: bytes, conflict_ts: int, start_ts: int):
         super().__init__(f"write conflict on {key!r}: commit_ts {conflict_ts} > start_ts {start_ts}")

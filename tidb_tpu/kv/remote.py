@@ -29,13 +29,17 @@ from typing import Optional, Sequence
 from tidb_tpu.kv.kv import (
     KeyLockedError,
     KeyRange,
+    RegionError,
     Request,
     RequestType,
     StoreType,
     TxnAbortedError,
+    UndeterminedError,
     WriteConflictError,
 )
 from tidb_tpu.kv.memstore import OP_DEL, OP_PUT, Lock, MemStore, Mutation, Region
+from tidb_tpu.utils import failpoint
+from tidb_tpu.utils.backoff import Backoffer, BackoffExhausted, boRPC
 
 
 def _b(x: bytes) -> str:
@@ -107,6 +111,11 @@ class StoreServer:
         self._thread = threading.Thread(target=self._accept_loop, daemon=True, name="store-server")
         self._mpp = None  # lazy MPPTaskManager (first dispatch pays SQL-context open)
         self._mpp_mu = threading.Lock()
+        # live client connections, so shutdown() behaves like process death:
+        # in-flight requests see a reset, not a silent hang (chaos tests kill
+        # and resurrect in-process servers this way)
+        self._conns: set[socket.socket] = set()
+        self._conns_mu = threading.Lock()
 
     def _mpp_mgr(self):
         with self._mpp_mu:
@@ -123,9 +132,29 @@ class StoreServer:
     def shutdown(self) -> None:
         self._stop.set()
         try:
+            # wake the blocked accept() (it holds the listener's file
+            # description, so close() alone would leave the port accepting)
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass
+        with self._conns_mu:
+            conns, self._conns = list(self._conns), set()
+        for c in conns:
+            try:
+                # SHUT_RDWR, not just close(): a serve thread blocked in
+                # recv holds the open file description, so close() alone
+                # neither wakes it nor sends the peer a FIN
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -133,6 +162,19 @@ class StoreServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            # the stop re-check must happen INSIDE the registry lock:
+            # shutdown() sets _stop before draining _conns, so either it
+            # drains this conn or we observe _stop here — an unlocked check
+            # lets a conn accepted pre-shutdown slip into the fresh set and
+            # keep a "dead" server answering one client
+            with self._conns_mu:
+                if self._stop.is_set():  # raced shutdown: refuse, don't serve
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    return
+                self._conns.add(conn)
             threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
 
     def _serve(self, conn: socket.socket) -> None:
@@ -153,11 +195,21 @@ class StoreServer:
                 except TxnAbortedError as e:
                     reply, rblobs = {"err": "TxnAborted", "msg": str(e)}, []
                 except Exception as e:  # surfaced to the caller, not the server log
-                    reply, rblobs = {"err": "Generic", "msg": f"{type(e).__name__}: {e}"}, []
+                    # the kind travels with the message so the client can
+                    # re-type semantically load-bearing errors (a KILL/OOM
+                    # verdict must never be mistaken for an engine failure
+                    # and re-run on another engine — see run_task_resilient)
+                    reply, rblobs = {
+                        "err": "Generic",
+                        "kind": type(e).__name__,
+                        "msg": f"{type(e).__name__}: {e}",
+                    }, []
                 _send_frame(conn, reply, rblobs)
         except (ConnectionError, OSError):
             pass
         finally:
+            with self._conns_mu:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
@@ -350,7 +402,11 @@ class StoreServer:
             from tidb_tpu.utils.chunk import encode_chunk
 
             dag = dagpb.DAGRequest.from_pb(h["dag"])
-            region = next(r for r in st.regions() if r.region_id == h["region_id"])
+            region = next((r for r in st.regions() if r.region_id == h["region_id"]), None)
+            if region is None:
+                # typed region error, not Generic: the client re-resolves
+                # routing and re-splits the task (ref: errorpb.RegionNotFound)
+                return {"err": "RegionMiss", "region_id": h["region_id"]}, []
             ranges = [KeyRange(_ub(a), _ub(b)) for a, b in h["ranges"]]
             engine = _engines()[StoreType(h["store_type"])]
             # engine warnings ride the response header, the per-
@@ -435,7 +491,7 @@ class _RemoteCopClient:
         self.store = store
 
     def send(self, req: Request):
-        from tidb_tpu.copr.client import CopResponse, CopResult
+        from tidb_tpu.copr.client import CopResponse, CopResult, run_task_resilient
         from tidb_tpu.utils.chunk import decode_chunk
 
         assert req.tp == RequestType.DAG
@@ -472,22 +528,49 @@ class _RemoteCopClient:
                     cols.append(col)
             return Chunk(cols)
 
-        def run(item):
-            ti, (region, krs) = item
+        # one retry budget for the whole fan-out (ref: copIterator handling
+        # region errors under the request's Backoffer)
+        bo = Backoffer(budget_ms=self.store._retry_budget_ms, seed=self.store._backoff_seed)
+
+        def one_call(region_id, krs, store_type):
             h, blobs = self.store._call(
                 {
                     "cmd": "cop",
                     "dag": dag_pb,
-                    "region_id": region.region_id,
+                    "region_id": region_id,
                     "ranges": [[_b(kr.start), _b(kr.end)] for kr in krs],
                     "read_ts": read_ts,
-                    "store_type": req.store_type.value,
+                    "store_type": store_type.value,
                 }
             )
             if req.warn is not None:
                 for lv, code, msg in h.get("warnings", ()):
                     req.warn(lv, code, msg)
-            return CopResult(unify(decode_chunk(blobs[0])), ti, region.region_id)
+            return unify(decode_chunk(blobs[0]))
+
+        def run_one(st, region, krs):
+            return one_call(region.region_id, krs, st)
+
+        from tidb_tpu.utils.memory import QueryKilledError, QueryOOMError
+
+        def run(item):
+            ti, (region, krs) = item
+            # server-side engine failures arrive as RuntimeError ("remote
+            # store error: ..."); kill/quota verdicts arrive re-typed by
+            # _call (the server ships the error kind) and must pass through
+            chunk = run_task_resilient(
+                bo,
+                run_one,
+                self.store.pd.regions_in_ranges,
+                region,
+                krs,
+                req.store_type,
+                warn=req.warn,
+                degrade_reason="remote",
+                degrade_on=(RuntimeError,),
+                never_degrade=(QueryKilledError, QueryOOMError),
+            )
+            return CopResult(chunk, ti, region.region_id)
 
         items = list(enumerate(tasks))
         if req.concurrency <= 1 or len(items) == 1:
@@ -501,17 +584,49 @@ class _RemoteCopClient:
         return CopResponse(gen(), None)
 
 
+# verbs that must NOT be transparently replayed after they may have reached
+# the server. Everything else is replay-safe: reads are pure; percolator
+# prewrite/rollback/pessimistic_rollback/acquire_lock are idempotent under the
+# same start_ts (memstore re-prewrite rewrites the same lock); raw_put/delete
+# write the same value; owner verbs re-assert the same lease. ``commit`` is
+# the 2PC safety case (see UndeterminedError); ``raw_cas`` replayed after a
+# successful-but-unacked swap would misreport failure; the ingest verbs mint
+# a fresh commit_ts per call, so a replay would double the rows;
+# ``mpp_dispatch`` mints a fresh task_id per call — replaying a lost reply
+# would double-execute the gather and orphan the first task (retry belongs
+# at the gather layer, which can cancel).
+_NON_REPLAYABLE = frozenset({"commit", "raw_cas", "ingest", "ingest_columnar", "mpp_dispatch"})
+
+
 class RemoteStore:
     """kv.Storage whose every byte lives in a StoreServer process.
 
-    Per-thread pooled connections (cop fan-out runs parallel region tasks);
-    a dead server surfaces as ConnectionError to the caller, which the
-    session layers report like any region error."""
+    Per-thread pooled connections (cop fan-out runs parallel region tasks).
+    Transient wire failures are retried under a typed Backoffer: the
+    connection re-dials with backoff and replay-safe verbs are re-sent
+    transparently (ref: client-go Backoffer + RegionRequestSender retry).
+    A commit that fails after it may have reached the store surfaces
+    :class:`UndeterminedError` — the 2PC undetermined-result rule. A server
+    that stays dead past the retry budget surfaces ConnectionError, which
+    the session layers report like any region error."""
 
-    def __init__(self, host: str, port: int, connect_timeout: float = 5.0, read_timeout: float = 600.0):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        connect_timeout: Optional[float] = None,
+        read_timeout: Optional[float] = None,
+        retry_budget_ms: Optional[float] = None,
+        backoff_seed: Optional[int] = None,
+    ):
+        from tidb_tpu import config as _config
+
+        dflt = _config.current()
         self.host, self.port = host, port
-        self._timeout = connect_timeout
-        self._read_timeout = read_timeout
+        self._timeout = connect_timeout if connect_timeout is not None else dflt.connect_timeout_s
+        self._read_timeout = read_timeout if read_timeout is not None else dflt.read_timeout_s
+        self._retry_budget_ms = retry_budget_ms if retry_budget_ms is not None else dflt.rpc_retry_budget_ms
+        self._backoff_seed = backoff_seed
         self._local = threading.local()
         self.nonce = f"remote:{host}:{port}"
         self.tso = _RemoteTSO(self)
@@ -524,7 +639,10 @@ class RemoteStore:
 
         self._cop_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="rcop")
         self._mpp_ndev: Optional[int] = None
-        self._call({"cmd": "ping"})  # fail fast on a bad endpoint
+        # fail fast on a bad endpoint: zero retry budget, so a dead/refused
+        # address raises on the FIRST dial instead of looping out the full
+        # boRPC budget (fleet assembly and liveness probes construct these)
+        self._call({"cmd": "ping"}, budget_ms=0)
 
     # -- plumbing ----------------------------------------------------------
     def _conn(self) -> socket.socket:
@@ -537,14 +655,69 @@ class RemoteStore:
             self._local.conn = c
         return c
 
-    def _call(self, header: dict, blobs: Sequence[bytes] = ()):
-        try:
-            c = self._conn()
-            _send_frame(c, header, blobs)
-            h, rblobs = _recv_frame(c)
-        except (ConnectionError, OSError):
-            self._local.conn = None
-            raise ConnectionError(f"store server {self.host}:{self.port} unreachable")
+    def _drop_conn(self) -> None:
+        """Close the pooled connection so the next attempt re-dials. Closing
+        matters even for INJECTED faults: the server may have executed the
+        command and its reply is sitting in the socket — reusing the
+        connection would desynchronize the frame stream."""
+        c = getattr(self._local, "conn", None)
+        self._local.conn = None
+        if c is not None:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _call(self, header: dict, blobs: Sequence[bytes] = (), *, budget_ms: Optional[float] = None):
+        """One RPC with reconnect-and-replay under a per-request Backoffer.
+        ``budget_ms`` overrides the store's retry budget for THIS call
+        (0 = no retries, fail on the first wire error).
+
+        Chaos failpoints (see kv/fault_injection.py wire helpers):
+          - ``remote_send(cmd)`` fires BEFORE any byte hits the wire — a
+            raised ConnectionError here is retriable for every verb.
+          - ``remote_recv(cmd)`` fires after the request went out — raising
+            simulates a lost reply: the server executed the command, the
+            client never heard. Replay-safe verbs replay; commit surfaces
+            UndeterminedError.
+        """
+        cmd = header["cmd"]
+        replayable = cmd not in _NON_REPLAYABLE
+        bo: Optional[Backoffer] = None
+        while True:
+            maybe_sent = False
+            try:
+                c = self._conn()
+                failpoint.inject("remote_send", cmd)
+                maybe_sent = True
+                _send_frame(c, header, blobs)
+                failpoint.inject("remote_recv", cmd)
+                h, rblobs = _recv_frame(c)
+                break
+            except (ConnectionError, OSError) as e:
+                self._drop_conn()
+                if not replayable and maybe_sent:
+                    if cmd == "commit":
+                        raise UndeterminedError(
+                            f"commit to store {self.host}:{self.port} failed after send "
+                            f"({type(e).__name__}: {e}); transaction outcome UNDETERMINED — "
+                            "not retried, not reported as aborted"
+                        ) from e
+                    raise ConnectionError(
+                        f"non-replayable {cmd!r} to {self.host}:{self.port} failed after send: {e}"
+                    ) from e
+                if bo is None:
+                    bo = Backoffer(
+                        budget_ms=self._retry_budget_ms if budget_ms is None else budget_ms,
+                        seed=self._backoff_seed,
+                    )
+                try:
+                    bo.backoff(boRPC, e)
+                except BackoffExhausted as be:
+                    raise ConnectionError(
+                        f"store server {self.host}:{self.port} unreachable "
+                        f"(gave up after {be.attempts} retries / {be.slept_ms:.0f}ms: {e})"
+                    ) from e
         err = h.get("err")
         if err == "KeyLocked":
             raise KeyLockedError(_ub(h["key"]), _lock_from_pb(h["lock"]))
@@ -552,7 +725,18 @@ class RemoteStore:
             raise WriteConflictError(_ub(h["key"]), h["conflict_ts"], h["start_ts"])
         if err == "TxnAborted":
             raise TxnAbortedError(h["msg"])
+        if err == "RegionMiss":
+            raise RegionError(h.get("region_id", -1))
         if err:
+            kind = h.get("kind")
+            if kind in ("QueryKilledError", "QueryOOMError"):
+                # re-type the kill/quota verdicts (ref: mpp_conn's err_kind
+                # mapping): the cop degrade path must see them typed, never
+                # as a retriable-looking RuntimeError
+                from tidb_tpu.utils.memory import QueryKilledError, QueryOOMError
+
+                cls = QueryKilledError if kind == "QueryKilledError" else QueryOOMError
+                raise cls(f"remote store error: {h.get('msg', err)}")
             raise RuntimeError(f"remote store error: {h.get('msg', err)}")
         return h, rblobs
 
@@ -679,6 +863,13 @@ class RemoteStore:
                     except ConnectionError:
                         pass
                     raise
+        # ack: the final frame is safely client-side — release the server's
+        # retained copy now (it is kept after collection only so a LOST
+        # final frame can be replayed; mpp_cancel is the idempotent ack)
+        try:
+            self._call({"cmd": "mpp_cancel", "task_id": task_id})
+        except ConnectionError:
+            pass  # the server's dispatch-time sweep reclaims it
         if h.get("err_kind"):
             from tidb_tpu.parallel.probe import MPPRetryExhausted
             from tidb_tpu.utils.memory import QueryKilledError, QueryOOMError

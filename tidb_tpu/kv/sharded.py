@@ -34,11 +34,34 @@ the schema-sync the coprocessor's schema-version check relies on).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional, Sequence
 
 from tidb_tpu.kv import tablecodec
-from tidb_tpu.kv.kv import KeyRange, Request, RequestType
+from tidb_tpu.kv.kv import KeyRange, Request, RequestType, UndeterminedError
 from tidb_tpu.kv.memstore import Lock, Mutation
+from tidb_tpu.utils.backoff import Backoffer, BackoffExhausted, boStoreDown
+
+
+class _FailoverTSO:
+    """TSO authority with owner re-resolution: timestamps come from the
+    current authority shard and fail over with it (the shards' oracles share
+    the (ms << 18) | logical wall-clock layout — see the module docstring's
+    deployment assumption, which is what makes the handoff safe)."""
+
+    def __init__(self, store: "ShardedStore"):
+        self._store = store
+
+    def ts(self) -> int:
+        return self._store._monotonic_ts(lambda st: st.tso.ts(), kind="tso")
+
+
+class _FailoverDetector:
+    def __init__(self, store: "ShardedStore"):
+        self._store = store
+
+    def clean_up(self, start_ts: int) -> None:
+        self._store._authority_call(lambda st: st.detector.clean_up(start_ts), kind="detector")
 
 
 class _ShardedPD:
@@ -72,13 +95,19 @@ class _ShardedSnapshot:
         self.read_ts = ts
 
     def get(self, key: bytes) -> Optional[bytes]:
+        if not ShardedStore.is_table_key(key):
+            # meta keyspace: any live replica can answer (replicated catalog)
+            return self._store._authority_call(
+                lambda st: st.get_snapshot(self.read_ts).get(key)
+            )
         return self._store.store_for_key(key).get_snapshot(self.read_ts).get(key)
 
     def scan(self, kr: KeyRange, limit: int = 2**63, reverse: bool = False):
         if not ShardedStore.is_table_key(kr.start):
-            # meta keyspace reads come from the authoritative replica
-            return self._store.stores[0].get_snapshot(self.read_ts).scan(
-                kr, limit=limit, reverse=reverse
+            # meta keyspace reads come from the authority, failing over to a
+            # surviving replica on store-down
+            return self._store._authority_call(
+                lambda st: st.get_snapshot(self.read_ts).scan(kr, limit=limit, reverse=reverse)
             )
         one = self._store.single_owner(kr)
         if one is not None:
@@ -140,10 +169,89 @@ class ShardedStore:
         # explicit table_id → shard index; unlisted tables hash by id
         self.placement = dict(placement or {})
         self.nonce = "sharded(" + ",".join(s.nonce for s in self.stores) + ")"
-        self.tso = self.stores[0].tso  # single authority (the PD TSO role)
-        self.detector = self.stores[0].detector
+        # single authority (the PD TSO role) with store-down failover: the
+        # authority index advances to the next live shard when the current
+        # one is unreachable, and meta reads follow it (every shard carries a
+        # replicated meta keyspace, so any live replica can answer)
+        self._auth_idx = 0
+        # high-water mark over every timestamp this fleet has handed out:
+        # failover moves the TSO stream to another shard whose oracle may sit
+        # behind within the same millisecond (logical counter restarts) —
+        # percolator's conflict checks assume ONE monotonic stream, so a
+        # post-failover ts is never released until it clears this mark
+        self._ts_hwm = 0
+        self.tso = _FailoverTSO(self)
+        self.detector = _FailoverDetector(self)
         self.pd = _ShardedPD(self)
         self._mu = threading.Lock()
+
+    def _authority_call(self, fn, kind: str = "meta"):
+        """Run ``fn(store)`` against the authority shard, re-resolving the
+        authority to the next live shard on store-down. Paced by a typed
+        Backoffer (boStoreDown) so a flapping shard doesn't spin; when every
+        replica is down the LAST ConnectionError surfaces — a typed error,
+        not a hang."""
+        from tidb_tpu.utils import metrics as _m
+
+        bo = Backoffer(budget_ms=2000)
+        last: Exception | None = None
+        start = self._auth_idx
+        swept_ms = 0.0
+        while True:
+            t0 = time.monotonic()
+            for i in range(len(self.stores)):
+                j = (start + i) % len(self.stores)
+                try:
+                    out = fn(self.stores[j])
+                except ConnectionError as e:
+                    last = e
+                    continue
+                if j != self._auth_idx:
+                    with self._mu:
+                        self._auth_idx = j
+                    _m.STORE_FAILOVER.inc(kind=kind)
+                return out
+            # a FULL sweep failed — every replica looked down this pass. The
+            # backoff paces the next sweep, never the first attempt against
+            # an untried shard (an alternative live replica costs nothing to
+            # try immediately; sleeping before it is pure failover latency).
+            # Sweep wall time charges the budget CUMULATIVELY: each dead
+            # REMOTE shard burns its internal boRPC reconnect budget before
+            # surfacing ConnectionError, so without the charge the nested
+            # budgets would multiply into tens of seconds per call (total
+            # block time here is bounded by ~budget + one sweep)
+            swept_ms += (time.monotonic() - t0) * 1000.0
+            if swept_ms >= bo.remaining_ms():
+                raise last  # type: ignore[misc]
+            try:
+                bo.backoff(boStoreDown, last)
+            except BackoffExhausted:
+                raise last  # type: ignore[misc]
+
+    def _monotonic_ts(self, fn, kind: str = "tso") -> int:
+        """An authority timestamp that never regresses across failover: spin
+        past the high-water mark when the new authority's oracle is behind
+        (normally the same-millisecond logical overlap). The spin is
+        BOUNDED: skew beyond the deployment assumption (same-host clocks)
+        surfaces a typed error instead of issuing a regressed timestamp or
+        hanging — the one thing this layer may never do is either."""
+        deadline: Optional[float] = None
+        while True:
+            ts = self._authority_call(fn, kind=kind)
+            with self._mu:
+                if ts > self._ts_hwm:
+                    self._ts_hwm = ts
+                    return ts
+                hwm = self._ts_hwm
+            if deadline is None:
+                deadline = time.monotonic() + 2.0
+            elif time.monotonic() > deadline:
+                raise ConnectionError(
+                    f"TSO authority clock behind the fleet high-water mark "
+                    f"({ts} <= {hwm}) beyond skew tolerance; refusing to issue "
+                    "a regressed timestamp"
+                )
+            time.sleep(0.0005)
 
     # -- placement ----------------------------------------------------------
     def shard_of_table(self, table_id: int) -> int:
@@ -211,9 +319,11 @@ class ShardedStore:
 
     # -- kv.Storage surface -------------------------------------------------
     def current_ts(self) -> int:
-        return self.stores[0].current_ts()
+        return self._monotonic_ts(lambda st: st.current_ts(), kind="tso")
 
     def raw_get(self, key: bytes):
+        if not self.is_table_key(key):
+            return self._authority_call(lambda st: st.raw_get(key))
         return self.store_for_key(key).raw_get(key)
 
     def raw_put(self, key: bytes, value: bytes) -> None:
@@ -235,9 +345,10 @@ class ShardedStore:
 
     def raw_scan(self, kr: KeyRange, limit: int = 2**62):
         if not self.is_table_key(kr.start):
-            # meta keyspace: authoritative replica only (fanning would
-            # surface every shard's copy of the same row)
-            return self.stores[0].raw_scan(kr, limit=limit)
+            # meta keyspace: one replica only (fanning would surface every
+            # shard's copy of the same row); the authority first, survivors
+            # on store-down
+            return self._authority_call(lambda st: st.raw_scan(kr, limit=limit))
         one = self.single_owner(kr)
         if one is not None:
             return self.stores[one].raw_scan(kr, limit=limit)
@@ -285,8 +396,28 @@ class ShardedStore:
             self.stores[si].prewrite(muts, primary, start_ts)
 
     def commit(self, keys: Sequence[bytes], start_ts: int, commit_ts: int) -> None:
+        committed: list[int] = []
         for si, ks in self._group_keys(keys):
-            self.stores[si].commit(ks, start_ts, commit_ts)
+            try:
+                self.stores[si].commit(ks, start_ts, commit_ts)
+            except UndeterminedError as e:
+                # cross-shard 2PC: an ambiguous commit on ANY owner makes the
+                # round undetermined — annotate the shard and surface (never
+                # retried, never downgraded to abort)
+                raise UndeterminedError(f"shard {si}: {e}") from e
+            except ConnectionError as e:
+                if committed:
+                    # an earlier shard already durably committed this round
+                    # (replicated meta keys fan one commit over every shard):
+                    # the round's outcome is decided, only this replica is
+                    # unacked — reporting a plain failure would invite a
+                    # blind re-run of a committed transaction
+                    raise UndeterminedError(
+                        f"shard {si}: commit unreachable after shard(s) "
+                        f"{committed} committed: {e}"
+                    ) from e
+                raise
+            committed.append(si)
 
     def rollback(self, keys: Sequence[bytes], start_ts: int) -> None:
         for si, ks in self._group_keys(keys):
@@ -340,7 +471,11 @@ class ShardedStore:
     def drop_stable(self, table_id: int) -> None:
         self.stores[self.shard_of_table(table_id)].drop_stable(table_id)
 
-    # -- owner election: the authority shard is the etcd analog --------------
+    # -- owner election: shard 0 is the etcd analog. Deliberately NOT failed
+    # over: lease state lives only on shard 0 (not the replicated meta
+    # keyspace), so electing against a survivor would split-brain the owner.
+    # Losing the election authority surfaces ConnectionError — owners keep
+    # their last lease verdict until it returns (ref: etcd quorum loss). ----
     def owner_campaign(self, key: str, node_id: str, lease_s: Optional[float] = None) -> bool:
         return self.stores[0].owner_campaign(key, node_id, lease_s)
 

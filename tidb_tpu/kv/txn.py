@@ -12,7 +12,13 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
-from tidb_tpu.kv.kv import KeyLockedError, KeyRange, TxnAbortedError, WriteConflictError
+from tidb_tpu.kv.kv import (
+    KeyLockedError,
+    KeyRange,
+    TxnAbortedError,
+    UndeterminedError,
+    WriteConflictError,
+)
 from tidb_tpu.kv.memstore import MemStore, Mutation, OP_DEL, OP_PUT, Snapshot
 
 
@@ -76,17 +82,21 @@ def retry_locked(store, fn, max_retries: int = 16):
     """Run ``fn``, resolving any pending lock it trips over and backing off
     while the lock's holder is still alive — the reader-side
     Backoffer+ResolveLocks loop every kv read path needs (ref: client-go's
-    snapshot reads; a reader surfacing KeyLocked raw would make every scan
-    race concurrent writers)."""
-    import time
+    snapshot reads under BoTxnLock; a reader surfacing KeyLocked raw would
+    make every scan race concurrent writers)."""
+    from tidb_tpu.utils.backoff import Backoffer, BackoffExhausted, boTxnLock
 
+    bo = Backoffer(budget_ms=2000)
     for i in range(max_retries):
         try:
             return fn()
         except KeyLockedError as e:
             store.resolve_lock(e.key, e.lock)
             if i > 0:
-                time.sleep(min(0.001 * (1 << i), 0.1))  # backoff while lock holder lives
+                try:
+                    bo.backoff(boTxnLock)  # holder still alive: wait it out
+                except BackoffExhausted:
+                    break
     raise TxnAbortedError("lock resolution did not converge")
 
 
@@ -196,12 +206,25 @@ class Txn:
             # single retry after resolution; else surface the conflict
             self.store.prewrite(muts, primary, self.start_ts)
         self.commit_ts = self.store.tso.ts()
-        # commit primary first — the txn is durably decided once this returns
+        # commit primary first — the txn is durably decided once this returns.
+        # An UndeterminedError here (commit sent, reply lost) propagates as-is:
+        # retrying could misreport abort, rolling back could erase a commit
+        # (ref: client-go undetermined-result rule).
         self.store.commit([primary], self.start_ts, self.commit_ts)
         secondaries = [m.key for m in muts if m.key != primary]
         if secondaries:
-            self.store.commit(secondaries, self.start_ts, self.commit_ts)
-        self.store.detector.clean_up(self.start_ts)
+            try:
+                self.store.commit(secondaries, self.start_ts, self.commit_ts)
+            except (ConnectionError, UndeterminedError):
+                # the primary committed, so the txn IS committed; stranded
+                # secondary locks roll forward lazily when a reader trips on
+                # them (check_txn_status on the primary → resolve_lock), the
+                # same path client-go relies on for async secondary commit
+                pass
+        try:
+            self.store.detector.clean_up(self.start_ts)
+        except ConnectionError:
+            pass  # committed; detector hygiene must not fail the txn
         return self.commit_ts
 
     def rollback(self) -> None:

@@ -38,6 +38,15 @@ from tidb_tpu.utils import sysvar_int
 
 # structural key → jitted MPP program (see MPPGatherExec.execute)
 _MPP_FN_CACHE: dict = {}
+
+# one mesh collective in flight per process: two concurrent shard_map
+# programs race for the same device set and the XLA CPU client's collective
+# rendezvous starves on small hosts (each program waits for participants the
+# other is holding) — concurrent disttask/session threads used to deadlock
+# here. Real TPU runs one SPMD program per mesh at a time anyway.
+import threading as _threading
+
+_MESH_EXEC_LOCK = _threading.Lock()
 # (store, table, slots, region versions, ndev) → padded device input lanes
 _MPP_DEV_CACHE: dict = {}
 
@@ -794,13 +803,21 @@ class MPPGatherExec:
         import jax
 
         from tidb_tpu.parallel import make_mesh
-        from tidb_tpu.parallel.probe import GLOBAL_PROBER, MPPRetryExhausted, probe_and_blacklist
+        from tidb_tpu.parallel.probe import (
+            GLOBAL_PROBER,
+            MPPRetryExhausted,
+            gather_backoffer,
+            probe_and_blacklist,
+        )
         from tidb_tpu.utils import failpoint
+        from tidb_tpu.utils.backoff import BackoffExhausted, boMPP
         from tidb_tpu.utils.memory import QueryKilledError, QueryOOMError
 
+        # ONE shared retry budget per gather (ref: executor_with_retry.go):
+        # device re-plans and unattributed retries draw from the same
+        # Backoffer instead of ad-hoc attempt counters
+        bo = gather_backoffer()
         no_progress = 0
-        total = 0
-        max_total = max(len(jax.devices()) + 2, 4)  # cascading-loss bound
         while True:
             devices = GLOBAL_PROBER.alive(jax.devices())
             if not devices:
@@ -814,21 +831,24 @@ class MPPGatherExec:
                 # failures — retrying would defeat KILL / the memory quota
                 raise
             except RuntimeError as exc:  # device loss / per-shard OOM / injected
-                total += 1
                 bad = getattr(exc, "mpp_device", None)
                 if bad is not None:
                     GLOBAL_PROBER.report_failure(bad)
-                    progressed = True
                 else:
                     # attribute by probing (MPPAlive analog): any device that
                     # fails the round-trip is blacklisted; the next attempt
                     # runs on the survivors
-                    progressed = probe_and_blacklist(devices) > 0
-                if not progressed:
-                    no_progress += 1
-                if no_progress >= 2 or total >= max_total:
+                    if probe_and_blacklist(devices) == 0:
+                        no_progress += 1
+                if no_progress >= 2:
                     raise MPPRetryExhausted(
-                        f"mpp execution failed after {total} attempts: {exc}"
+                        f"mpp execution made no progress after {bo.attempts() + 1} attempts: {exc}"
+                    ) from exc
+                try:
+                    bo.backoff(boMPP)  # exc classifies fatal; budget-only pacing
+                except BackoffExhausted as be:
+                    raise MPPRetryExhausted(
+                        f"mpp retry budget exhausted after {be.attempts} attempts: {exc}"
                     ) from exc
 
     def _execute_remote(self):
@@ -1203,12 +1223,15 @@ class MPPGatherExec:
                     _MPP_FN_CACHE.pop(next(iter(_MPP_FN_CACHE)))
             else:
                 fn, warn_sink = cached
-            outs = fn(*all_lanes)
-            # ONE device→host round trip for every output lane: device_get
-            # batches the whole tuple into a single transfer
             import jax
 
-            arrs = list(jax.device_get(outs))
+            with _MESH_EXEC_LOCK:
+                outs = fn(*all_lanes)
+                # ONE device→host round trip for every output lane:
+                # device_get batches the whole tuple into a single transfer —
+                # and blocking inside the lock keeps the collective's device
+                # work fully drained before the next program launches
+                arrs = list(jax.device_get(outs))
             wtotal = int(arrs.pop())  # the warn-count slot (always present)
             dropped = int(arrs[-2])
             overflow = int(arrs[-1])

@@ -686,7 +686,9 @@ def build_dist_pipeline(
     else:
         n_rep = 2 * len(topn.out_lanes) + 1
     extra = (P(),) if warn_sink is not None else ()
-    fn = jax.shard_map(
+    from tidb_tpu.parallel import shard_map_compat
+
+    fn = shard_map_compat(
         step,
         mesh=mesh,
         in_specs=tuple(P("dp") for _ in range(sum(n_lanes))),

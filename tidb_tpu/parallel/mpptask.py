@@ -252,7 +252,8 @@ class MPPTaskManager:
             task_id = str(self._next)
             task = {"ev": threading.Event(), "blob": None, "err": None, "kind": "", "sess": sess}
             # abandoned tasks (client died between dispatch and conn) must not
-            # accumulate: evict finished entries nobody collected
+            # accumulate: evict finished entries (kept after collection so a
+            # lost-reply mpp_conn replay can still answer) once we grow
             if len(self._tasks) > 64:
                 for tid in [t for t, v in self._tasks.items() if v["ev"].is_set()]:
                     del self._tasks[tid]
@@ -284,8 +285,10 @@ class MPPTaskManager:
             return True, None, "ValueError", f"unknown mpp task {task_id}", ()
         if not task["ev"].wait(wait_s):
             return False, None, None, None, ()
-        with self._mu:
-            self._tasks.pop(task_id, None)
+        # deliberately NOT popped: the reply frame can be lost on the wire
+        # and the client transparently replays mpp_conn (it is replay-safe
+        # exactly because serving the result is idempotent) — finished
+        # entries are reclaimed by cancel() or the dispatch-time sweep
         # the task session's accumulated warnings travel back with the result
         # (ref: per-SelectResponse warning carriage)
         warns = [[lv, code, msg] for lv, code, msg in task["sess"].warnings[:64]]

@@ -50,6 +50,20 @@ class DeviceProber:
 
 GLOBAL_PROBER = DeviceProber()
 
+# total backoff sleep one MPP gather may spend across ALL its retry attempts
+# (device re-plans + unattributed same-mesh retries share this one budget —
+# ref: executor_with_retry.go bounding the whole retry loop, not per-attempt)
+MPP_RETRY_BUDGET_MS = 2000.0
+
+
+def gather_backoffer(seed=None):
+    """The per-gather Backoffer every MPP retry runs under (see
+    utils/backoff.py). One instance per gather execution: attempts against a
+    shrinking mesh and unattributed retries draw from the same budget."""
+    from tidb_tpu.utils.backoff import Backoffer
+
+    return Backoffer(budget_ms=MPP_RETRY_BUDGET_MS, seed=seed)
+
 
 def probe_and_blacklist(devices, prober: DeviceProber = GLOBAL_PROBER) -> int:
     """Liveness-probe each device with a tiny round-trip computation (the

@@ -26,8 +26,20 @@ class Client:
         db: str = "",
         tls: bool = False,
         auth_plugin: str = "mysql_native_password",
+        connect_timeout: Optional[float] = None,
+        read_timeout: Optional[float] = None,
     ):
-        self.sock = socket.create_connection((host, port), timeout=120)  # first-compile on a loaded box can be slow
+        # split connect/read deadlines sourced from config (mirrors the store
+        # RPC client, kv/remote.py): a dead server fails the dial fast, while
+        # an ALIVE server gets the long read deadline first-query JIT
+        # compiles and big scans legitimately need
+        from tidb_tpu import config as _config
+
+        dflt = _config.current()
+        ct = connect_timeout if connect_timeout is not None else dflt.connect_timeout_s
+        rt = read_timeout if read_timeout is not None else dflt.read_timeout_s
+        self.sock = socket.create_connection((host, port), timeout=ct)
+        self.sock.settimeout(rt)
         self.io = p.PacketIO(self.sock)
         self.tls = False
         self._handshake(user, password, db, tls, auth_plugin)

@@ -18,7 +18,6 @@ import struct
 
 from tidb_tpu.catalog.schema import TableInfo
 from tidb_tpu.kv import tablecodec
-from tidb_tpu.kv.memstore import Snapshot
 
 
 def backup_database(db, db_name: str, dest: str, tables: list[str] | None = None) -> dict:
@@ -31,7 +30,10 @@ def backup_database(db, db_name: str, dest: str, tables: list[str] | None = None
     backup_ts = db.store.current_ts()
     names = tables if tables is not None else db.catalog.tables(db_name)
     meta: dict = {"backup_ts": backup_ts, "db": db_name, "tables": {}}
-    snap = Snapshot(db.store, backup_ts)
+    # go through the store's own snapshot factory (not memstore.Snapshot
+    # directly) so backups compose with wrapped stores — fault-injected,
+    # remote, sharded — the chaos tests depend on this seam
+    snap = db.store.get_snapshot(backup_ts)
     for name in names:
         t = db.catalog.table(db_name, name)
         count = 0

@@ -111,3 +111,17 @@ QUERY_DURATION = REGISTRY.histogram(
     "tidb_tpu_server_handle_query_duration_seconds", "Statement latency"
 )
 COP_TASKS = REGISTRY.counter("tidb_tpu_copr_task_total", "Coprocessor tasks", ("engine",))
+# resilience layer (utils/backoff.py + the retrying seams; see RESILIENCE.md)
+BACKOFF_TOTAL = REGISTRY.counter(
+    "tidb_tpu_backoff_total", "Backoffer sleeps by typed config", ("config",)
+)
+COP_DEGRADED = REGISTRY.counter(
+    "tidb_tpu_copr_degraded_task_total",
+    "Cop tasks that fell back from the TPU engine to the host engine",
+    ("reason",),
+)
+STORE_FAILOVER = REGISTRY.counter(
+    "tidb_tpu_store_failover_total",
+    "Sharded-fleet reads/authority calls served by a non-primary replica",
+    ("kind",),
+)
