@@ -23,6 +23,8 @@ jax.config.update("jax_enable_x64", True)
 
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -32,3 +34,26 @@ def pytest_configure(config):
     # `slow` so they run in the extended lane (see RESILIENCE.md)
     config.addinivalue_line("markers", "chaos: deterministic fault-injection test")
     config.addinivalue_line("markers", "slow: excluded from the tier-1 fast lane")
+
+
+@pytest.fixture
+def thread_hygiene():
+    """Owner-keepalive/timer thread-leak guard: yields a ``stray()`` probe
+    and asserts at teardown that no ``owner-ka-*`` keepalive or
+    ``timer-runtime`` thread survived ``stop_background()``/sweep exit
+    (guards the lease-keepalive rework in session._owner_gated)."""
+    import threading
+    import time
+
+    def stray():
+        return [
+            t.name
+            for t in threading.enumerate()
+            if t.is_alive() and (t.name.startswith("owner-ka-") or t.name == "timer-runtime")
+        ]
+
+    yield stray
+    deadline = time.time() + 3.0
+    while stray() and time.time() < deadline:
+        time.sleep(0.02)
+    assert not stray(), f"stray background threads survived: {stray()}"

@@ -93,6 +93,27 @@ def test_commit_ambiguous_raises_undetermined_never_false_abort(wire):
     assert rdb.store.get_snapshot(rdb.store.current_ts()).get(key) == b"decided?"
 
 
+def test_undetermined_commit_resolves_after_store_returns(wire):
+    """The resolve() hook on UndeterminedError (ROADMAP: undetermined-commit
+    resolution): once the store answers again, check_txn_status on the
+    primary reports which way the ambiguous commit went — here the reply was
+    lost AFTER the server committed, so it resolves to committed and hands
+    back the store's commit_ts."""
+    db, rdb, _ = wire
+    key = tablecodec.record_key(999_998, 1)
+    txn = Txn(rdb.store)
+    txn.put(key, b"resolved")
+    shot = NShot(reset_wire, n_times=1, match=lambda cmd: cmd == "commit")
+    with failpoint.enabled("remote_recv", shot):
+        with pytest.raises(UndeterminedError) as ei:
+            txn.commit()
+    assert shot.fired == 1
+    status, commit_ts = ei.value.resolve()  # the wire is healthy again
+    assert status == "committed" and commit_ts > 0
+    assert txn.commit_ts == commit_ts  # the txn adopted the store's truth
+    assert rdb.store.get_snapshot(rdb.store.current_ts()).get(key) == b"resolved"
+
+
 def test_seeded_probabilistic_wire_chaos_is_transparent(wire):
     _, rdb, _ = wire
     chaos = Probabilistic(reset_wire, p=0.25, seed=11, match=lambda cmd: cmd == "raw_get")

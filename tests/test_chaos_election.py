@@ -1,0 +1,197 @@
+"""Kill-the-leader chaos: SIGKILL one store shard of a 3-process fleet —
+including shard 0, the old single point of election truth — while a DDL is
+mid-backfill and a lease is held (ISSUE 2 acceptance):
+
+  - a surviving node wins the election within one lease timeout,
+  - fencing tokens never regress and the deposed owner's renewal is
+    rejected (no instant with two concurrent owners),
+  - the DDL completes: replicated meta writes tolerate the dead minority.
+
+Topology: one SQL layer over THREE raw store-server processes with tight
+retry budgets (the multi-process analog of the reference losing one etcd
+member — quorum survives, the control plane keeps moving)."""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.kv.fault_injection import delay
+from tidb_tpu.kv.remote import RemoteStore
+from tidb_tpu.kv.sharded import ShardedStore
+from tidb_tpu.session.session import DB
+from tidb_tpu.utils import failpoint, metrics
+
+pytestmark = pytest.mark.chaos
+
+_SERVER_SCRIPT = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from tidb_tpu.kv.memstore import MemStore
+from tidb_tpu.kv.remote import StoreServer
+
+srv = StoreServer(MemStore(region_split_keys=100_000))
+print(f"PORT {{srv.start()}}", flush=True)
+while True:
+    time.sleep(1)
+"""
+
+LEASE = 1.0
+
+
+def _spawn():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return subprocess.Popen(
+        [sys.executable, "-c", _SERVER_SCRIPT.format(repo=repo)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def _port(proc):
+    got: list = []
+
+    def reader():
+        for line in proc.stdout:
+            if line.startswith("PORT "):
+                got.append(int(line.split()[1]))
+                return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    t.join(timeout=120)
+    if not got:
+        proc.kill()
+        raise RuntimeError("store server did not report a port within 120s")
+    return got[0]
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    procs = [_spawn(), _spawn(), _spawn()]  # concurrent startup: jax import dominates
+    ports = [_port(p) for p in procs]
+    stores = [
+        RemoteStore("127.0.0.1", p, retry_budget_ms=250, backoff_seed=0) for p in ports
+    ]
+    db = DB(store=ShardedStore(stores))
+    s = db.session()
+    # three consecutive table ids → one table per shard; the DDL targets a
+    # table whose data does NOT live on the shard we kill
+    s.execute("CREATE TABLE ea (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("CREATE TABLE eb (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("CREATE TABLE ec (id BIGINT PRIMARY KEY, v BIGINT)")
+    yield db, procs
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+            p.wait(timeout=10)
+
+
+def test_kill_lease_shard_mid_ddl_elects_survivor_within_one_lease(fleet):
+    db, procs = fleet
+    store = db.store
+    s = db.session()
+
+    # the DDL's table must survive the kill of shard 0 (table-granular data
+    # placement has exactly one owner; the election/meta keyspace is what
+    # this test proves replicated)
+    victim_table = next(
+        n for n in ("ea", "eb", "ec")
+        if store.shard_of_table(db.catalog.table("test", n).id) != 0
+    )
+    s.execute(
+        f"INSERT INTO {victim_table} VALUES "
+        + ", ".join(f"({i}, {i % 97})" for i in range(600))
+    )
+
+    # node A wins the lease; every shard (0 included) holds the replica
+    assert store.owner_campaign("ddl-owner", "node-a", lease_s=LEASE)
+    term_a = store.owner_term("ddl-owner")
+    a_deadline = time.time() + LEASE  # node A never renews: it dies with the shard
+
+    ddl_err: list = []
+
+    def run_ddl():
+        try:
+            db.session().execute(f"CREATE INDEX ie ON {victim_table} (v)")
+        except Exception as e:  # surfaced below as a hard failure
+            ddl_err.append(e)
+
+    # slow each backfill batch so the SIGKILL lands mid-DDL (600 rows / 256
+    # per batch → ~3 batches)
+    with failpoint.enabled("ddl/beforeBackfillBatch", delay(0.15)):
+        ddl = threading.Thread(target=run_ddl)
+        ddl.start()
+        time.sleep(0.2)  # inside the backfill now
+
+        # SIGKILL shard 0 — the old election pin AND the TSO/meta authority
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait(timeout=10)
+
+        # the surviving node campaigns until granted; terms are sampled on
+        # the way to prove the fencing token never regresses. Node B takes a
+        # LONG lease — failover latency is measured against node A's lease;
+        # B's own lease length only gives the post-win assertions slack
+        # (every quorum probe pays the dead shard's 250 ms retry budget)
+        won_at = None
+        terms_seen = [term_a]
+        while time.time() < a_deadline + 6.0:
+            try:
+                if store.owner_campaign("ddl-owner", "node-b", lease_s=10.0):
+                    won_at = time.time()
+                    break
+                terms_seen.append(store.owner_term("ddl-owner"))
+            except ConnectionError:
+                pass
+            time.sleep(0.05)
+
+        assert won_at is not None, "no survivor elected"
+        # lease-window assertions run NOW, while node B's grant is live
+        # (the DDL keeps backfilling in the background)
+        term_b = store.owner_term("ddl-owner")
+        assert store.owner_of("ddl-owner") == "node-b"
+        # the deposed owner's fenced renewal is rejected by the survivors
+        assert store.owner_campaign("ddl-owner", "node-a", lease_s=LEASE, term=term_a) is False
+        assert store.owner_of("ddl-owner") == "node-b"
+        ddl.join(timeout=120)
+
+    # split-brain guard: node B was only granted AFTER node A's lease ran
+    # out (A's self-view deadline) — at no instant were both owners
+    assert won_at >= a_deadline - 0.01, (won_at, a_deadline)
+    # ... and within ~one lease timeout of the loss (slack covers the dead
+    # shard's 250 ms retry budget paid by each quorum sweep)
+    assert won_at <= a_deadline + 2.0, f"failover took {won_at - a_deadline:.2f}s past the lease"
+    terms_seen.append(term_b)
+    assert terms_seen == sorted(terms_seen), f"fencing token regressed: {terms_seen}"
+    assert term_b > term_a
+    assert metrics.ELECTION_FAILOVER.get(key="ddl-owner") >= 1
+
+    # the control plane kept moving: the DDL's meta writes tolerated the
+    # dead minority and the index answers
+    assert not ddl_err, f"DDL died with the shard: {ddl_err[0]!r}"
+    got = db.session().execute(
+        f"SELECT COUNT(*) FROM {victim_table} WHERE v = 13"
+    ).rows
+    assert got == [(len([i for i in range(600) if i % 97 == 13]),)]
+
+
+def test_resign_and_reelect_with_dead_shard(fleet):
+    """With shard 0 still dead (module fixture order), resign → immediate
+    re-grant at a higher term works against the surviving majority."""
+    db, procs = fleet
+    assert procs[0].poll() is not None, "prior test leaves shard 0 dead"
+    store = db.store
+    t_before = store.owner_term("ddl-owner")
+    store.owner_resign("ddl-owner", "node-b")
+    assert store.owner_of("ddl-owner") is None
+    assert store.owner_campaign("ddl-owner", "node-c", lease_s=LEASE)
+    assert store.owner_term("ddl-owner") > t_before >= 1
+    assert store.owner_of("ddl-owner") == "node-c"

@@ -146,6 +146,45 @@ def bench_q3_join_mpp() -> float:
     return best
 
 
+@register("owner_failover_ms")
+def bench_owner_failover() -> float:
+    """Owner-election failover latency (ms, lower is better): a 3-shard
+    fleet loses the shard 0 replica while node-a holds the lease and stops
+    renewing; the clock runs until node-b's campaign is granted. Bounded
+    below by the lease (50 ms here) — the guarded quantity is the election
+    machinery's overhead on top of it (quorum sweeps past a dead shard)."""
+    import time as _t
+
+    from tidb_tpu.kv.memstore import MemStore
+    from tidb_tpu.kv.sharded import ShardedStore
+
+    class _DeadStore:
+        """Every verb raises — the in-process analog of a SIGKILLed shard."""
+
+        nonce = "dead"
+
+        def __getattr__(self, name):
+            def _down(*a, **k):
+                raise ConnectionError("bench: store down")
+
+            return _down
+
+    lease_s = 0.05
+    best = float("inf")
+    for _ in range(3):
+        fleet = ShardedStore([MemStore(region_split_keys=1000) for _ in range(3)])
+        if not fleet.owner_campaign("bench", "node-a", lease_s=lease_s):
+            # never inside an assert: under python -O that would strip the
+            # grant and the bench would time an uncontested (~0 ms) election
+            raise RuntimeError("baseline grant failed on a fresh fleet")
+        fleet.stores[0] = _DeadStore()  # the QuorumElection sees the same list
+        t0 = _t.perf_counter()
+        while not fleet.owner_campaign("bench", "node-b", lease_s=lease_s):
+            _t.sleep(0.002)
+        best = min(best, (_t.perf_counter() - t0) * 1000)
+    return best
+
+
 def run_all(names=None) -> list[dict]:
     out = []
     for name, fn in _BENCHES.items():

@@ -34,6 +34,11 @@ class Config:
     connect_timeout_s: float = 5.0
     read_timeout_s: float = 600.0
     rpc_retry_budget_ms: float = 4000.0
+    # [cluster] owner-election lease: background singleton owners (TTL,
+    # stats, GC, DDL) hold their lease this long; the session keepalive
+    # refreshes at lease/3 (kv/election.py quorum leases and kv/owner.py
+    # local leases both read this default)
+    owner_lease_s: float = 10.0
     # [security]
     ssl_enabled: bool = False
     ssl_cert: str = ""
@@ -65,6 +70,8 @@ class Config:
         cfg.connect_timeout_s = float(net.get("connect-timeout", cfg.connect_timeout_s))
         cfg.read_timeout_s = float(net.get("read-timeout", cfg.read_timeout_s))
         cfg.rpc_retry_budget_ms = float(net.get("rpc-retry-budget-ms", cfg.rpc_retry_budget_ms))
+        cl = raw.get("cluster", {})
+        cfg.owner_lease_s = float(cl.get("owner-lease-s", cfg.owner_lease_s))
         sec = raw.get("security", {})
         cfg.ssl_cert = sec.get("ssl-cert", cfg.ssl_cert)
         cfg.ssl_key = sec.get("ssl-key", cfg.ssl_key)

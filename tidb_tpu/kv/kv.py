@@ -137,10 +137,36 @@ class UndeterminedError(KVError):
     tell which. Never blind-retry (a re-commit can hit 'lock not found' and
     misreport abort), never report abort (the write may be visible). Surface
     to the client, who must check (ref: client-go ErrResultUndetermined,
-    terror CodeResultUndetermined — the 2PC safety rule)."""
+    terror CodeResultUndetermined — the 2PC safety rule).
+
+    "Who must check" is automated: the transaction layer binds a
+    ``check_txn_status``-driven resolver (``Txn.resolve_undetermined``), so
+    once the store is reachable again ``err.resolve()`` reports which way
+    the ambiguous commit actually went."""
 
     def __init__(self, msg: str):
         super().__init__(msg)
+        self._resolver = None
+
+    def bind_resolver(self, fn) -> "UndeterminedError":
+        """Attach the layer-appropriate resolver (the txn that owns the
+        primary key binds ``Txn.resolve_undetermined``)."""
+        self._resolver = fn
+        return self
+
+    def resolve(self):
+        """→ ("committed", commit_ts) | ("rolled_back", 0) | ("locked", 0).
+        Consults the primary key's owner via check_txn_status once the store
+        answers again; raises ConnectionError while it is still down, and
+        RuntimeError when no resolver was bound (the error surfaced below
+        the transaction layer)."""
+        if self._resolver is None:
+            raise RuntimeError(
+                "no resolver bound to this UndeterminedError (it surfaced "
+                "below the transaction layer); call check_txn_status on the "
+                "transaction's primary key directly"
+            )
+        return self._resolver()
 
 
 class WriteConflictError(KVError):

@@ -359,19 +359,44 @@ class MemStore:
         # owner.Manager — here the store process is the etcd analog, so N
         # SQL layers sharing this store elect exactly one TTL/stats/GC/DDL
         # owner; kv/owner.py holds the lease machinery)
+        from tidb_tpu.kv.election import ElectionReplica
         from tidb_tpu.kv.owner import OwnerManager
 
         self.owner_mgr = OwnerManager()
+        # this store's share of the QUORUM election keyspace: a sharded
+        # fleet (kv/sharded.py) replicates lease/term state to a majority of
+        # these replicas instead of using the local OwnerManager above
+        # (kv/election.py — the PD/etcd-member role)
+        self.election_replica = ElectionReplica()
 
     # -- owner election (ref: pkg/owner/manager.go:49) ----------------------
-    def owner_campaign(self, key: str, node_id: str, lease_s: float | None = None) -> bool:
-        return self.owner_mgr.campaign(key, node_id, lease_s)
+    def owner_campaign(
+        self, key: str, node_id: str, lease_s: float | None = None, term: int | None = None
+    ) -> bool:
+        return self.owner_mgr.campaign(key, node_id, lease_s, term=term)
 
     def owner_of(self, key: str):
         return self.owner_mgr.owner(key)
 
     def owner_resign(self, key: str, node_id: str) -> None:
         self.owner_mgr.resign(key, node_id)
+
+    def owner_term(self, key: str) -> int:
+        """The key's current fencing token (ref: the etcd campaign's lease
+        revision — owners carry it so stale renewals are rejectable)."""
+        return self.owner_mgr.term(key)
+
+    def owner_granted_term(self, key: str, node_id: str):
+        """Fencing token for a node that just won ``key`` (local lookup; the
+        quorum backend caches this to avoid a post-grant majority sweep)."""
+        return self.owner_mgr.term(key) if self.owner_mgr.owner(key) == node_id else None
+
+    # -- election replica verbs (quorum keyspace; see kv/election.py) -------
+    def election_propose(self, key: str, node_id: str, term: int, deadline: float):
+        return self.election_replica.propose(key, node_id, term, deadline)
+
+    def election_read(self, key: str):
+        return self.election_replica.read(key)
 
     # -- kv.Storage surface ------------------------------------------------
     def current_ts(self) -> int:

@@ -288,13 +288,25 @@ class StoreServer:
             st.drop_stable(h["table_id"])
             return {"ok": 1}, []
         if cmd == "owner_campaign":
-            ok = st.owner_campaign(h["key"], h["node_id"], h.get("lease_s"))
+            # the fencing token ("term") rides the wire so a renewal by a
+            # deposed owner is rejected server-side (kv/owner.py term check)
+            ok = st.owner_campaign(h["key"], h["node_id"], h.get("lease_s"), term=h.get("term"))
             return {"ok": int(ok)}, []
         if cmd == "owner_of":
             return {"owner": st.owner_of(h["key"])}, []
         if cmd == "owner_resign":
             st.owner_resign(h["key"], h["node_id"])
             return {"ok": 1}, []
+        if cmd == "owner_term":
+            return {"term": st.owner_term(h["key"])}, []
+        if cmd == "election_propose":
+            # quorum election replica verb (kv/election.py): idempotent —
+            # re-proposing an accepted record re-accepts, so replay-safe
+            ok, term = st.election_propose(h["key"], h["node_id"], h["term"], h["deadline"])
+            return {"ok": int(ok), "term": term}, []
+        if cmd == "election_read":
+            term, owner, deadline = st.election_read(h["key"])
+            return {"term": term, "owner": owner, "deadline": deadline}, []
         if cmd == "check_txn_status":
             status, commit_ts = st.check_txn_status(_ub(h["primary"]), h["start_ts"])
             return {"status": status, "commit_ts": commit_ts}, []
@@ -898,8 +910,12 @@ class RemoteStore:
         self._call({"cmd": "drop_stable", "table_id": table_id})
 
     # -- owner election: the store process is the etcd analog ----------------
-    def owner_campaign(self, key: str, node_id: str, lease_s: Optional[float] = None) -> bool:
-        h, _ = self._call({"cmd": "owner_campaign", "key": key, "node_id": node_id, "lease_s": lease_s})
+    def owner_campaign(
+        self, key: str, node_id: str, lease_s: Optional[float] = None, term: Optional[int] = None
+    ) -> bool:
+        h, _ = self._call(
+            {"cmd": "owner_campaign", "key": key, "node_id": node_id, "lease_s": lease_s, "term": term}
+        )
         return bool(h["ok"])
 
     def owner_of(self, key: str):
@@ -907,6 +923,21 @@ class RemoteStore:
 
     def owner_resign(self, key: str, node_id: str) -> None:
         self._call({"cmd": "owner_resign", "key": key, "node_id": node_id})
+
+    def owner_term(self, key: str) -> int:
+        return self._call({"cmd": "owner_term", "key": key})[0]["term"]
+
+    # -- quorum election replica verbs (kv/election.py: this server hosts one
+    # replica of the fleet's election keyspace; both verbs are replay-safe) --
+    def election_propose(self, key: str, node_id: str, term: int, deadline: float):
+        h, _ = self._call(
+            {"cmd": "election_propose", "key": key, "node_id": node_id, "term": term, "deadline": deadline}
+        )
+        return bool(h["ok"]), h["term"]
+
+    def election_read(self, key: str):
+        h, _ = self._call({"cmd": "election_read", "key": key})
+        return h["term"], h["owner"], h["deadline"]
 
     # -- percolator verbs (ref: unistore mvcc server surface) ---------------
     def check_txn_status(self, primary: bytes, start_ts: int):

@@ -38,7 +38,7 @@ import time
 from typing import Optional, Sequence
 
 from tidb_tpu.kv import tablecodec
-from tidb_tpu.kv.kv import KeyRange, Request, RequestType, UndeterminedError
+from tidb_tpu.kv.kv import KeyRange, Request, RequestType, TxnAbortedError, UndeterminedError
 from tidb_tpu.kv.memstore import Lock, Mutation
 from tidb_tpu.utils.backoff import Backoffer, BackoffExhausted, boStoreDown
 
@@ -184,6 +184,20 @@ class ShardedStore:
         self.detector = _FailoverDetector(self)
         self.pd = _ShardedPD(self)
         self._mu = threading.Lock()
+        # owner election: lease/term state replicates to a MAJORITY of the
+        # shards (kv/election.py), so losing any single store — including
+        # shard 0 — neither halts the control plane nor risks split-brain
+        from tidb_tpu import config as _config
+        from tidb_tpu.kv.election import QuorumElection
+
+        self.election = QuorumElection(self.stores, lease_s=_config.current().owner_lease_s)
+
+    @property
+    def quorum(self) -> int:
+        """Majority size — what replicated meta writes and election verbs
+        need to succeed (minority shard loss is tolerated, minority
+        partitions are refused)."""
+        return len(self.stores) // 2 + 1
 
     def _authority_call(self, fn, kind: str = "meta"):
         """Run ``fn(store)`` against the authority shard, re-resolving the
@@ -326,21 +340,76 @@ class ShardedStore:
             return self._authority_call(lambda st: st.raw_get(key))
         return self.store_for_key(key).raw_get(key)
 
+    def _meta_quorum_check(self, errs: list) -> None:
+        """Replicated meta writes need a MAJORITY of replicas, not all of
+        them: a dead minority is skipped (it re-bootstraps on return — a
+        killed store process restarts empty) and counted, so the control
+        plane keeps moving when any single shard dies. Below quorum the last
+        ConnectionError surfaces — a minority partition must not believe it
+        persisted cluster state it can no longer read back. Tolerable
+        batches only exist for keys that fan to EVERY shard, so the quorum
+        base is always the fleet size."""
+        if not errs:
+            return
+        from tidb_tpu.utils import metrics as _m
+
+        if len(self.stores) - len(errs) < self.quorum:
+            raise errs[-1]
+        _m.STORE_FAILOVER.inc(n=len(errs), kind="meta_write")
+
+    def _fanout_tolerant(self, items, call, tolerable) -> None:
+        """Run ``call(si, payload)`` for each ``(si, payload)``; a
+        ConnectionError from a batch where ``tolerable(payload)`` holds
+        (every key replicated on other shards) is collected and judged by
+        the meta quorum rule, anything else propagates (a table key has
+        exactly one owner — its loss cannot be masked)."""
+        errs: list = []
+        for si, payload in items:
+            try:
+                call(si, payload)
+            except ConnectionError as e:
+                if not tolerable(payload):
+                    raise
+                errs.append(e)
+        self._meta_quorum_check(errs)
+
     def raw_put(self, key: bytes, value: bytes) -> None:
-        for si in self.write_shards(key):
-            self.stores[si].raw_put(key, value)
+        shards = self.write_shards(key)
+        if len(shards) == 1:
+            self.stores[shards[0]].raw_put(key, value)
+            return
+        self._fanout_tolerant(
+            [(si, None) for si in shards],
+            lambda si, _: self.stores[si].raw_put(key, value),
+            lambda _: True,
+        )
 
     def raw_delete(self, key: bytes) -> None:
-        for si in self.write_shards(key):
-            self.stores[si].raw_delete(key)
+        shards = self.write_shards(key)
+        if len(shards) == 1:
+            self.stores[shards[0]].raw_delete(key)
+            return
+        self._fanout_tolerant(
+            [(si, None) for si in shards],
+            lambda si, _: self.stores[si].raw_delete(key),
+            lambda _: True,
+        )
 
     def raw_cas(self, key: bytes, expected, value: bytes) -> bool:
-        # the authority decides; replicas follow on success (meta keys only)
+        # the authority decides; replicas follow on success (meta keys only).
+        # The deciding replica follows the authority-failover order, so a
+        # dead shard 0 no longer wedges catalog version bumps.
         shards = self.write_shards(key)
-        ok = self.stores[shards[0]].raw_cas(key, expected, value)
+        if len(shards) == 1:
+            return self.stores[shards[0]].raw_cas(key, expected, value)
+        ok = self._authority_call(lambda st: st.raw_cas(key, expected, value))
         if ok:
-            for si in shards[1:]:
-                self.stores[si].raw_put(key, value)
+            decider = self._auth_idx
+            self._fanout_tolerant(
+                [(si, None) for si in shards if si != decider],
+                lambda si, _: self.stores[si].raw_put(key, value),
+                lambda _: True,
+            )
         return ok
 
     def raw_scan(self, kr: KeyRange, limit: int = 2**62):
@@ -392,12 +461,17 @@ class ShardedStore:
         for m in mutations:
             for si in self.write_shards(m.key):
                 by.setdefault(si, []).append(m)
-        for si, muts in by.items():
-            self.stores[si].prewrite(muts, primary, start_ts)
+        self._fanout_tolerant(
+            by.items(),
+            lambda si, muts: self.stores[si].prewrite(muts, primary, start_ts),
+            lambda muts: all(not self.is_table_key(m.key) for m in muts),
+        )
 
     def commit(self, keys: Sequence[bytes], start_ts: int, commit_ts: int) -> None:
         committed: list[int] = []
-        for si, ks in self._group_keys(keys):
+        meta_errs: list = []
+        groups = list(self._group_keys(keys))
+        for si, ks in groups:
             try:
                 self.stores[si].commit(ks, start_ts, commit_ts)
             except UndeterminedError as e:
@@ -405,7 +479,24 @@ class ShardedStore:
                 # round undetermined — annotate the shard and surface (never
                 # retried, never downgraded to abort)
                 raise UndeterminedError(f"shard {si}: {e}") from e
+            except TxnAbortedError as e:
+                if all(not self.is_table_key(k) for k in ks):
+                    # a meta REPLICA with no lock at commit time is a replica
+                    # that missed the prewrite (down then, possibly restarted
+                    # empty since — the tolerated-minority recovery model),
+                    # not a verdict on the transaction: the quorum decides
+                    # below. A genuine abort raises this from EVERY replica
+                    # and still surfaces through the below-quorum path.
+                    meta_errs.append(e)
+                    continue
+                raise
             except ConnectionError as e:
+                if all(not self.is_table_key(k) for k in ks):
+                    # pure-meta replica batch: a dead minority is tolerable —
+                    # the round is decided once a MAJORITY of replicas commit
+                    # (checked below); the straggler re-bootstraps on return
+                    meta_errs.append(e)
+                    continue
                 if committed:
                     # an earlier shard already durably committed this round
                     # (replicated meta keys fan one commit over every shard):
@@ -418,26 +509,46 @@ class ShardedStore:
                     ) from e
                 raise
             committed.append(si)
+        if meta_errs:
+            if len(self.stores) - len(meta_errs) < self.quorum:
+                if committed:
+                    raise UndeterminedError(
+                        f"meta commit below quorum after shard(s) {committed} "
+                        f"committed: {meta_errs[-1]}"
+                    ) from meta_errs[-1]
+                raise meta_errs[-1]
+            from tidb_tpu.utils import metrics as _m
+
+            _m.STORE_FAILOVER.inc(n=len(meta_errs), kind="meta_write")
 
     def rollback(self, keys: Sequence[bytes], start_ts: int) -> None:
-        for si, ks in self._group_keys(keys):
-            self.stores[si].rollback(ks, start_ts)
+        self._fanout_tolerant(
+            self._group_keys(keys),
+            lambda si, ks: self.stores[si].rollback(ks, start_ts),
+            lambda ks: all(not self.is_table_key(k) for k in ks),
+        )
 
     def check_txn_status(self, primary: bytes, start_ts: int):
+        if not self.is_table_key(primary):
+            # meta primaries are replicated: any live replica answers, the
+            # authority order picks it (a dead shard 0 must not wedge
+            # cross-shard lock resolution)
+            return self._authority_call(lambda st: st.check_txn_status(primary, start_ts))
         return self.store_for_key(primary).check_txn_status(primary, start_ts)
 
     def resolve_lock(self, key: bytes, lock: Lock) -> None:
         key_shard = self.shard_of_key(key)
         primary_shard = self.shard_of_key(lock.primary)
-        if key_shard == primary_shard:
+        if key_shard == primary_shard and self.is_table_key(key):
             self.stores[key_shard].resolve_lock(key, lock)
             return
-        # cross-shard: the primary's owner is the source of truth
-        status, commit_ts = self.stores[primary_shard].check_txn_status(lock.primary, lock.start_ts)
+        # cross-shard (or replicated meta): the primary's owner is the source
+        # of truth; commit/rollback route back through the quorum-aware verbs
+        status, commit_ts = self.check_txn_status(lock.primary, lock.start_ts)
         if status == "committed":
-            self.stores[key_shard].commit([key], lock.start_ts, commit_ts)
+            self.commit([key], lock.start_ts, commit_ts)
         elif status == "rolled_back":
-            self.stores[key_shard].rollback([key], lock.start_ts)
+            self.rollback([key], lock.start_ts)
         # "locked": primary still alive → caller backs off and retries
 
     def acquire_pessimistic_lock(self, keys, primary, start_ts, for_update_ts, wait_timeout_ms=3000):
@@ -448,8 +559,11 @@ class ShardedStore:
             self.stores[si].acquire_pessimistic_lock(ks, primary, start_ts, for_update_ts, wait_timeout_ms)
 
     def pessimistic_rollback(self, keys: Sequence[bytes], start_ts: int) -> None:
-        for si, ks in self._group_keys(keys):
-            self.stores[si].pessimistic_rollback(ks, start_ts)
+        self._fanout_tolerant(
+            self._group_keys(keys),
+            lambda si, ks: self.stores[si].pessimistic_rollback(ks, start_ts),
+            lambda ks: all(not self.is_table_key(k) for k in ks),
+        )
 
     # -- bulk ingest --------------------------------------------------------
     def ingest(self, keys: Sequence[bytes], values: Sequence[bytes]) -> int:
@@ -471,19 +585,31 @@ class ShardedStore:
     def drop_stable(self, table_id: int) -> None:
         self.stores[self.shard_of_table(table_id)].drop_stable(table_id)
 
-    # -- owner election: shard 0 is the etcd analog. Deliberately NOT failed
-    # over: lease state lives only on shard 0 (not the replicated meta
-    # keyspace), so electing against a survivor would split-brain the owner.
-    # Losing the election authority surfaces ConnectionError — owners keep
-    # their last lease verdict until it returns (ref: etcd quorum loss). ----
-    def owner_campaign(self, key: str, node_id: str, lease_s: Optional[float] = None) -> bool:
-        return self.stores[0].owner_campaign(key, node_id, lease_s)
+    # -- owner election: quorum-replicated with fenced leases (kv/election.py,
+    # the PD/etcd analog). campaign/renew/resign are majority writes carrying
+    # the fencing token (term); owner reads resolve from a majority with
+    # highest-term-wins; a minority partition can neither grant nor refresh a
+    # lease (ConnectionError — owners keep their last verdict until the lease
+    # runs out, then self-fence; ref: etcd quorum loss). Dead shards are
+    # skipped under each store's own Backoffer and read-repaired on return. --
+    def owner_campaign(
+        self, key: str, node_id: str, lease_s: Optional[float] = None, term: Optional[int] = None
+    ) -> bool:
+        return self.election.campaign(key, node_id, lease_s, term=term)
 
     def owner_of(self, key: str):
-        return self.stores[0].owner_of(key)
+        return self.election.owner(key)
 
     def owner_resign(self, key: str, node_id: str) -> None:
-        self.stores[0].owner_resign(key, node_id)
+        self.election.resign(key, node_id)
+
+    def owner_term(self, key: str) -> int:
+        return self.election.term(key)
+
+    def owner_granted_term(self, key: str, node_id: str):
+        """Locally cached fencing token of ``node_id``'s last grant — spares
+        a freshly granted owner the second majority sweep owner_term pays."""
+        return self.election.granted_term(key, node_id)
 
     # -- MPP: single-owner placement ----------------------------------------
     def mpp_ndev(self) -> int:

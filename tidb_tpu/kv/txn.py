@@ -117,6 +117,7 @@ class Txn:
         self.for_update_ts = self.start_ts
         self._locked_keys: set[bytes] = set()
         self._pess_primary: Optional[bytes] = None
+        self._primary: Optional[bytes] = None  # recorded at commit for resolve_undetermined
 
     # -- pessimistic locking ------------------------------------------------
     def lock_keys(self, keys, wait_timeout_ms: int = 3000) -> None:
@@ -199,6 +200,7 @@ class Txn:
         primary = muts[0].key
         if self.pessimistic and self._pess_primary is not None and self._pess_primary in written:
             primary = self._pess_primary  # keep lock primary stable across upgrade
+        self._primary = primary
         try:
             self.store.prewrite(muts, primary, self.start_ts)
         except KeyLockedError as e:
@@ -207,10 +209,15 @@ class Txn:
             self.store.prewrite(muts, primary, self.start_ts)
         self.commit_ts = self.store.tso.ts()
         # commit primary first — the txn is durably decided once this returns.
-        # An UndeterminedError here (commit sent, reply lost) propagates as-is:
-        # retrying could misreport abort, rolling back could erase a commit
-        # (ref: client-go undetermined-result rule).
-        self.store.commit([primary], self.start_ts, self.commit_ts)
+        # An UndeterminedError here (commit sent, reply lost) propagates with
+        # the resolver bound: retrying could misreport abort, rolling back
+        # could erase a commit (ref: client-go undetermined-result rule), but
+        # once the store answers again err.resolve() reports the truth.
+        try:
+            self.store.commit([primary], self.start_ts, self.commit_ts)
+        except UndeterminedError as e:
+            e.bind_resolver(self.resolve_undetermined)
+            raise
         secondaries = [m.key for m in muts if m.key != primary]
         if secondaries:
             try:
@@ -226,6 +233,26 @@ class Txn:
         except ConnectionError:
             pass  # committed; detector hygiene must not fail the txn
         return self.commit_ts
+
+    def resolve_undetermined(self):
+        """Resolve an ambiguous commit after the store returns (ref: the
+        ROADMAP "undetermined-commit resolution" gap; client-go resolves via
+        CheckTxnStatus on the primary). Consults the PRIMARY key's owner:
+
+        → ``("committed", commit_ts)`` — the commit landed; ``self.commit_ts``
+          is updated to the store's truth.
+        → ``("rolled_back", 0)`` — it did not land (the prewrite lock
+          expired or was rolled back); safe to re-run the transaction.
+        → ``("locked", 0)`` — still undecided: the prewrite lock is alive
+          (its TTL has not expired). Back off and call again.
+
+        Raises ConnectionError while the store is still unreachable."""
+        if self._primary is None:
+            raise RuntimeError("transaction never reached the commit phase; nothing to resolve")
+        status, commit_ts = self.store.check_txn_status(self._primary, self.start_ts)
+        if status == "committed":
+            self.commit_ts = commit_ts
+        return status, commit_ts
 
     def rollback(self) -> None:
         if self._done:

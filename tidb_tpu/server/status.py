@@ -36,6 +36,22 @@ class StatusServer:
                         out[d] = {t: outer.db.catalog.table(d, t).to_pb() for t in outer.db.catalog.tables(d)}
                     body = json.dumps(out).encode()
                     ctype = "application/json"
+                elif self.path == "/election":
+                    # owner-election observability (kv/election.py): per-key
+                    # owner, fencing term, and lease remaining — from the
+                    # quorum keyspace on a sharded fleet, the local
+                    # OwnerManager on an embedded store
+                    el = getattr(outer.db.store, "election", None)
+                    if el is not None:
+                        try:
+                            snap = el.snapshot()
+                        except ConnectionError as e:
+                            snap = {"error": str(e)}
+                    else:
+                        om = getattr(outer.db.store, "owner_mgr", None)
+                        snap = om.snapshot() if om is not None else {}
+                    body = json.dumps(snap).encode()
+                    ctype = "application/json"
                 elif self.path.startswith("/topsql"):
                     # ref: the dashboard Top-SQL API fed by util/topsql
                     from tidb_tpu.utils.topsql import collector

@@ -1510,6 +1510,14 @@ class DB:
         # than serve a stale catalog
         self.schema_lease_s = 1.5
         self._schema_checked = time.monotonic()
+        # owner-election lease ([cluster] owner-lease-s): how long this node
+        # may act as a background singleton between keepalive refreshes
+        from tidb_tpu import config as _config
+
+        self.owner_lease_s = _config.current().owner_lease_s
+        # per-key fence events: set when a running sweep's ownership was lost
+        # (deposed or lease expired unrefreshed) — see _owner_gated
+        self._owner_fences: dict[str, threading.Event] = {}
         from tidb_tpu.kv.gcworker import GCWorker
         from tidb_tpu.statistics import StatsHandle
 
@@ -1602,33 +1610,91 @@ class DB:
             self.catalog.reload()
         self._schema_checked = time.monotonic()
 
+    def owner_fenced(self, key: str) -> bool:
+        """True when the LAST owner-gated sweep of ``key`` on this node lost
+        its lease mid-flight (observability for tests and operators)."""
+        ev = self._owner_fences.get(key)
+        return ev.is_set() if ev is not None else False
+
     def _owner_gated(self, key: str, fn):
         """Run ``fn`` only while this node holds the cluster-singleton lease
         for ``key`` — with a store-backed election, N SQL nodes sharing one
         store run each background owner exactly once (ref: owner.Manager
         campaigns guarding the domain workers). A keepalive refreshes the
-        lease while ``fn`` runs, so a sweep longer than the lease cannot
-        lose the singleton mid-flight (the etcd session-keepalive role)."""
+        lease at ``lease/3`` while ``fn`` runs, so a sweep longer than the
+        lease cannot lose the singleton mid-flight (the etcd
+        session-keepalive role).
+
+        The keepalive carries the FENCING TOKEN (term) granted with the
+        lease: a renewal rejected because the term moved means another node
+        was elected — this node self-fences observably (the sweep's result
+        is wrapped in ``{"fenced": ...}`` and :meth:`owner_fenced` trips).
+        Fencing is COOPERATIVE, not preemptive: the wrapper never interrupts
+        a running ``fn``, so a sweep long enough to outlive a lost lease
+        should poll :meth:`owner_fenced` between batches and stop writing —
+        detection plus the wrapped result is what this layer guarantees. An
+        UNREACHABLE election keyspace keeps the last verdict until the lease
+        runs out, then fences too."""
         campaign = getattr(self.store, "owner_campaign", None)
         if campaign is None:
             return fn()
-        if not campaign(key, self.node_id):
-            return {"skipped": "not owner"}
+        lease_s = self.owner_lease_s
+        try:
+            if not campaign(key, self.node_id, lease_s):
+                return {"skipped": "not owner"}
+        except ConnectionError as e:
+            return {"skipped": f"election keyspace unreachable: {e}"}
+        granted = time.monotonic()
+        # the fencing token of the grant above: the quorum backend caches it
+        # locally (owner_granted_term), sparing a second majority sweep;
+        # owner_term (a fleet read) is the fallback for remote stores
+        term = None
+        granted_term = getattr(self.store, "owner_granted_term", None)
+        if granted_term is not None:
+            term = granted_term(key, self.node_id)
+        if term is None:
+            term_of = getattr(self.store, "owner_term", None)
+            try:
+                term = term_of(key) if term_of is not None else None
+            except ConnectionError:
+                term = None
         done = threading.Event()
+        fenced = threading.Event()
+        self._owner_fences[key] = fenced
 
         def keepalive():
-            while not done.wait(2.0):
+            deadline = granted + lease_s
+            while not done.wait(lease_s / 3.0):
+                asked = time.monotonic()
                 try:
-                    campaign(key, self.node_id)
+                    if term is not None:
+                        ok = campaign(key, self.node_id, lease_s, term=term)
+                    else:
+                        ok = campaign(key, self.node_id, lease_s)
                 except ConnectionError:
+                    # quorum unreachable: the lease keeps its last verdict —
+                    # but only until it expires unrefreshed
+                    if time.monotonic() > deadline:
+                        fenced.set()
+                        return
+                    continue
+                if ok:
+                    deadline = asked + lease_s
+                else:
+                    # the term moved on (another node won) — self-fence NOW
+                    fenced.set()
                     return
 
         ka = threading.Thread(target=keepalive, daemon=True, name=f"owner-ka-{key}")
         ka.start()
         try:
-            return fn()
+            out = fn()
         finally:
             done.set()
+            ka.join(timeout=5)
+        if fenced.is_set():
+            return {"fenced": f"lost ownership of {key!r} (term {term}) mid-sweep", "result": out}
+        return out
 
     def start_background(self, ttl_interval_s: float = 60, analyze_interval_s: float = 60, gc_interval_s: float = 120) -> None:
         """Start the Domain-style background loops (ref: domain.Start —

@@ -37,6 +37,35 @@ class Counter:
         return "\n".join(out)
 
 
+class Gauge:
+    """A settable level (ref: prometheus Gauge) — election terms, pool sizes."""
+
+    def __init__(self, name: str, help_: str, labels: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.labels = labels
+        self._mu = threading.Lock()
+        self._vals: dict[tuple, float] = {}
+
+    def set(self, v: float, **labels) -> None:
+        key = tuple(labels.get(k, "") for k in self.labels)
+        with self._mu:
+            self._vals[key] = v
+
+    def get(self, **labels) -> float:
+        key = tuple(labels.get(k, "") for k in self.labels)
+        with self._mu:
+            return self._vals.get(key, 0)
+
+    def render(self) -> str:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._mu:
+            for key, v in sorted(self._vals.items()):
+                lbl = ",".join(f'{k}="{val}"' for k, val in zip(self.labels, key))
+                out.append(f"{self.name}{{{lbl}}} {v:g}" if lbl else f"{self.name} {v:g}")
+        return "\n".join(out)
+
+
 class Histogram:
     def __init__(self, name: str, help_: str, buckets=_DEFAULT_BUCKETS):
         self.name = name
@@ -87,6 +116,14 @@ class Registry:
                 self._metrics[name] = m
             return m  # type: ignore[return-value]
 
+    def gauge(self, name: str, help_: str = "", labels: tuple[str, ...] = ()) -> Gauge:
+        with self._mu:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Gauge(name, help_, labels)
+                self._metrics[name] = m
+            return m  # type: ignore[return-value]
+
     def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
         with self._mu:
             m = self._metrics.get(name)
@@ -124,4 +161,20 @@ STORE_FAILOVER = REGISTRY.counter(
     "tidb_tpu_store_failover_total",
     "Sharded-fleet reads/authority calls served by a non-primary replica",
     ("kind",),
+)
+# quorum-replicated owner election (kv/election.py — the PD/etcd analog)
+ELECTION_CAMPAIGN = REGISTRY.counter(
+    "tidb_tpu_election_campaign_total",
+    "Owner-election campaign attempts by outcome (won/renewed/lost/fenced/repair)",
+    ("key", "outcome"),
+)
+ELECTION_FAILOVER = REGISTRY.counter(
+    "tidb_tpu_election_failover_total",
+    "Ownership changes: a different node won an election key",
+    ("key",),
+)
+ELECTION_TERM = REGISTRY.gauge(
+    "tidb_tpu_election_term",
+    "Current fencing token (term) per election key, as observed by this node",
+    ("key",),
 )
