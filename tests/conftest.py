@@ -41,7 +41,9 @@ def thread_hygiene():
     """Owner-keepalive/timer thread-leak guard: yields a ``stray()`` probe
     and asserts at teardown that no ``owner-ka-*`` keepalive or
     ``timer-runtime`` thread survived ``stop_background()``/sweep exit
-    (guards the lease-keepalive rework in session._owner_gated)."""
+    (guards the lease-keepalive rework in session._owner_gated). Also flags
+    ``cop_``/``rcop_`` threads: cop fan-out runs on the ONE shared
+    ``cop-shared`` pool now — a per-request pool thread is a regression."""
     import threading
     import time
 
@@ -49,7 +51,13 @@ def thread_hygiene():
         return [
             t.name
             for t in threading.enumerate()
-            if t.is_alive() and (t.name.startswith("owner-ka-") or t.name == "timer-runtime")
+            if t.is_alive()
+            and (
+                t.name.startswith("owner-ka-")
+                or t.name == "timer-runtime"
+                or t.name.startswith("cop_")
+                or t.name.startswith("rcop_")
+            )
         ]
 
     yield stray

@@ -1,0 +1,314 @@
+"""Hot-path query fast lane: statement (AST) cache before parse, the
+value-agnostic prepared-plan cache, shared cop pool hygiene, lazy Backoffer
+RNG, and digest memoization (ref: core/plan_cache_lru.go, the non-prepared
+plan cache, and plan_cache.go RebuildPlan4CachedPlan)."""
+
+import threading
+
+import pytest
+
+import tidb_tpu
+from tidb_tpu.parser import parse_count
+
+
+@pytest.fixture()
+def db():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, a BIGINT, s VARCHAR(20))")
+    d.execute(
+        "INSERT INTO t VALUES " + ",".join(f"({i}, {i * 10}, 'v{i}')" for i in range(1, 9))
+    )
+    d.execute("CREATE TABLE ti (k BIGINT, v BIGINT)")
+    d.execute("INSERT INTO ti VALUES (1, 100), (2, 200), (2, 201), (3, 300)")
+    d.execute("CREATE INDEX ik ON ti (k)")
+    d.execute("ANALYZE TABLE ti")
+    return d
+
+
+# -- statement fast lane (parse skip + invalidation) -------------------------
+
+
+def test_warm_statement_skips_parser(db):
+    s = db.session()
+    q = "SELECT COUNT(*) FROM t WHERE a > 30"
+    assert s.query(q) == [(5,)]
+    n0 = parse_count()
+    assert s.query(q) == [(5,)]
+    assert parse_count() == n0, "warm repeat must not re-enter the parser"
+    assert s.vars["last_plan_from_cache"] == 1
+
+
+def test_stmt_cache_is_per_text(db):
+    s = db.session()
+    s.query("SELECT COUNT(*) FROM t")
+    n0 = parse_count()
+    s.query("SELECT COUNT(*) FROM t WHERE a > 0")  # different text → parse
+    assert parse_count() == n0 + 1
+
+
+def test_ddl_invalidates_cached_statement(db):
+    s = db.session()
+    q = "SELECT * FROM t WHERE id = 1 OR id = 2 ORDER BY id"
+    assert [r[:2] for r in s.query(q)] == [(1, 10), (2, 20)]
+    # ALTER TABLE mid-session: the cached AST/plan must not serve the old
+    # column set
+    db.execute("ALTER TABLE t ADD COLUMN extra BIGINT")
+    rows = s.query(q)
+    assert len(rows[0]) == 4, f"stale plan served after DDL: {rows[0]!r}"
+    n0 = parse_count()
+    s.query(q)  # warms again after the re-parse
+    assert parse_count() == n0
+
+
+def test_analyze_invalidates_cached_plan(db):
+    s = db.session()
+    q = "SELECT COUNT(*) FROM ti WHERE k = 2"
+    assert s.query(q) == [(2,)]
+    s.query(q)
+    assert s.vars["last_plan_from_cache"] == 1
+    db.execute("ANALYZE TABLE ti")  # stats version bump → re-plan
+    assert s.query(q) == [(2,)]
+    assert s.vars["last_plan_from_cache"] == 0
+
+
+def test_binding_overrides_cached_statement(db):
+    s = db.session()
+    q = "SELECT a FROM t WHERE a > 25 ORDER BY a LIMIT 2"
+    assert s.query(q) == [(30,), (40,)]  # cached AST for q
+    s.execute(
+        "CREATE GLOBAL BINDING FOR SELECT a FROM t WHERE a > 25 ORDER BY a LIMIT 2 "
+        "USING SELECT a FROM t WHERE a > 25 ORDER BY a DESC LIMIT 2"
+    )
+    assert s.query(q) == [(80,), (70,)], "binding must override the cached entry"
+    s.execute(
+        "DROP GLOBAL BINDING FOR SELECT a FROM t WHERE a > 25 ORDER BY a LIMIT 2"
+    )
+    assert s.query(q) == [(30,), (40,)]
+
+
+def test_engine_isolation_change_replans(db):
+    s = db.session()
+    q = "SELECT SUM(a) FROM t"
+    s.query(q)
+    s.query(q)
+    assert s.vars["last_plan_from_cache"] == 1
+    s.execute("SET tidb_isolation_read_engines = 'host'")
+    assert s.query(q) == [(360,)]
+    assert s.vars["last_plan_from_cache"] == 0  # re-planned for the engine
+    s.query(q)
+    assert s.vars["last_plan_from_cache"] == 1
+
+
+def test_plan_cache_metric_counts(db):
+    from tidb_tpu.utils.metrics import PLAN_CACHE
+
+    s = db.session()
+    q = "SELECT COUNT(*) FROM t WHERE a >= 50"
+    h0, m0 = PLAN_CACHE.get(result="hit"), PLAN_CACHE.get(result="miss")
+    s.query(q)
+    assert PLAN_CACHE.get(result="miss") == m0 + 1
+    s.query(q)
+    assert PLAN_CACHE.get(result="hit") == h0 + 1
+
+
+def test_fastlane_correctness_under_writes(db):
+    # parse/plan reuse must never serve stale DATA
+    s = db.session()
+    q = "SELECT COUNT(*) FROM t"
+    n = s.query(q)[0][0]
+    db.execute("INSERT INTO t VALUES (100, 1000, 'x')")
+    assert s.query(q) == [(n + 1,)]
+    assert s.vars["last_plan_from_cache"] == 1  # data changes keep the plan
+
+
+# -- value-agnostic prepared plans -------------------------------------------
+
+
+def test_prepared_point_get_reports_cache_hit(db):
+    s = db.session()
+    nm = s.prepare("SELECT a FROM t WHERE id = ?")
+    assert s.execute_prepared(nm, [1]).rows == [(10,)]
+    assert s.execute_prepared(nm, [5]).rows == [(50,)]
+    assert s.vars["last_plan_from_cache"] == 1, "repeat EXECUTE must report a cache hit"
+    assert s.execute_prepared(nm, [999]).rows == []
+
+
+def test_prepared_value_agnostic_pk_ranges(db):
+    s = db.session()
+    nm = s.prepare("SELECT id FROM t WHERE id > ? ORDER BY id")
+    assert s.execute_prepared(nm, [6]).rows == [(7,), (8,)]
+    # fresh params, same plan: ranges rebuilt, correct rows, cache hit
+    assert s.execute_prepared(nm, [2]).rows == [(3,), (4,), (5,), (6,), (7,), (8,)]
+    assert s.vars["last_plan_from_cache"] == 1
+    # boundary conditions through the cached plan
+    assert s.execute_prepared(nm, [8]).rows == []
+    assert s.execute_prepared(nm, [0]).rows == [(i,) for i in range(1, 9)]
+
+
+def test_prepared_value_agnostic_no_reparse(db):
+    s = db.session()
+    nm = s.prepare("SELECT id FROM t WHERE id >= ? AND id <= ? ORDER BY id")
+    s.execute_prepared(nm, [2, 4])
+    n0 = parse_count()
+    assert s.execute_prepared(nm, [3, 5]).rows == [(3,), (4,), (5,)]
+    assert s.vars["last_plan_from_cache"] == 1
+    assert parse_count() == n0, "EXECUTE must not parse"
+
+
+def test_prepared_value_agnostic_index_ranges(db):
+    s = db.session()
+    nm = s.prepare("SELECT v FROM ti WHERE k = ? ORDER BY v")
+    assert s.execute_prepared(nm, [1]).rows == [(100,)]
+    assert s.execute_prepared(nm, [2]).rows == [(200,), (201,)]
+    assert s.vars["last_plan_from_cache"] == 1
+    assert s.execute_prepared(nm, [7]).rows == []
+
+
+def test_prepared_null_param_takes_separate_entry(db):
+    s = db.session()
+    nm = s.prepare("SELECT id FROM t WHERE a = ?")
+    assert s.execute_prepared(nm, [30]).rows == [(3,)]
+    # NULL types differently → separate cache entry, still correct (= NULL
+    # matches nothing)
+    assert s.execute_prepared(nm, [None]).rows == []
+    assert s.execute_prepared(nm, [40]).rows == [(4,)]
+
+
+def test_prepared_date_params_convert_on_rebind(db):
+    # date params convert to day numbers at plan time (builder._literal);
+    # the cached-plan rebind must apply the SAME conversion or the second
+    # EXECUTE compares raw date objects against day-encoded columns
+    import datetime
+
+    db.execute("CREATE TABLE td (id BIGINT PRIMARY KEY, d DATE)")
+    db.execute("INSERT INTO td VALUES (1, '2024-01-05'), (2, '2024-02-06'), (3, '2024-03-07')")
+    s = db.session()
+    nm = s.prepare("SELECT id FROM td WHERE d >= ? ORDER BY id")
+    assert s.execute_prepared(nm, [datetime.date(2024, 2, 1)]).rows == [(2,), (3,)]
+    # fresh date through the cached plan: converted value, correct rows
+    assert s.execute_prepared(nm, [datetime.date(2024, 3, 1)]).rows == [(3,)]
+    assert s.execute_prepared(nm, [datetime.date(2020, 1, 1)]).rows == [(1,), (2,), (3,)]
+
+
+def test_prepared_param_type_change(db):
+    s = db.session()
+    nm = s.prepare("SELECT id FROM t WHERE s = ?")
+    assert s.execute_prepared(nm, ["v2"]).rows == [(2,)]
+    assert s.execute_prepared(nm, ["v7"]).rows == [(7,)]
+    assert s.execute_prepared(nm, [3]).rows == []  # int against VARCHAR
+
+
+def test_prepared_plan_invalidated_by_ddl(db):
+    s = db.session()
+    nm = s.prepare("SELECT id FROM t WHERE id > ? ORDER BY id")
+    assert s.execute_prepared(nm, [6]).rows == [(7,), (8,)]
+    db.execute("ALTER TABLE t ADD COLUMN extra2 BIGINT")
+    # schema version is part of the cache key: re-plan, stay correct
+    assert s.execute_prepared(nm, [6]).rows == [(7,), (8,)]
+
+
+def test_prepared_folded_param_falls_back(db):
+    s = db.session()
+    # `? + 0` folds to a plain constant at build time — the plan bakes the
+    # value and must NOT be reused across parameters
+    nm = s.prepare("SELECT id FROM t WHERE id > ? + 0 ORDER BY id")
+    assert s.execute_prepared(nm, [6]).rows == [(7,), (8,)]
+    assert s.execute_prepared(nm, [2]).rows == [(3,), (4,), (5,), (6,), (7,), (8,)]
+
+
+def test_prepared_agg_value_agnostic(db):
+    s = db.session()
+    nm = s.prepare("SELECT COUNT(*), SUM(a) FROM t WHERE a > ?")
+    assert s.execute_prepared(nm, [45]).rows == [(4, 260)]
+    assert s.execute_prepared(nm, [75]).rows == [(1, 80)]
+    assert s.vars["last_plan_from_cache"] == 1
+
+
+def test_ad_hoc_vs_prepared_cache_semantics(db):
+    s = db.session()
+    # ad-hoc point get: fast path, never reported as a plan-cache hit
+    s.query("SELECT a FROM t WHERE id = 3")
+    assert s.vars["last_plan_from_cache"] == 0
+    s.query("SELECT a FROM t WHERE id = 3")
+    assert s.vars["last_plan_from_cache"] == 0
+    # ad-hoc planner statement: text-keyed, hit on repeat
+    s.query("SELECT COUNT(*) FROM t WHERE a > 15")
+    assert s.vars["last_plan_from_cache"] == 0
+    s.query("SELECT COUNT(*) FROM t WHERE a > 15")
+    assert s.vars["last_plan_from_cache"] == 1
+
+
+# -- shared cop pool ---------------------------------------------------------
+
+
+def _cop_request_threads():
+    return [t.name for t in threading.enumerate() if t.name.startswith("cop_")]
+
+
+def test_shared_pool_no_per_request_threads():
+    d = tidb_tpu.open(region_split_keys=100)  # force multi-region fan-out
+    d.execute("CREATE TABLE big (id BIGINT PRIMARY KEY, v BIGINT)")
+    d.execute("INSERT INTO big VALUES " + ",".join(f"({i},{i})" for i in range(500)))
+    s = d.session()
+    assert s.query("SELECT COUNT(*) FROM big") == [(500,)]
+    assert s.query("SELECT SUM(v) FROM big") == [(sum(range(500)),)]
+    # the old per-request pools left a churn of `cop_*` threads; the shared
+    # lane must never create them
+    assert _cop_request_threads() == []
+    shared = [t.name for t in threading.enumerate() if t.name.startswith("cop-shared")]
+    assert shared, "multi-region fan-out should run on the shared pool"
+
+
+def test_shared_pool_shutdown_idempotent():
+    from tidb_tpu.copr.client import shared_cop_pool, shutdown_shared_pool
+
+    shutdown_shared_pool()
+    shutdown_shared_pool()  # idempotent
+    pool = shared_cop_pool(4)
+    assert pool is shared_cop_pool(16)  # one pool per process
+    # lazily rebuilt after teardown, and queries still work
+    shutdown_shared_pool()
+    d = tidb_tpu.open(region_split_keys=50)
+    d.execute("CREATE TABLE sp (id BIGINT PRIMARY KEY)")
+    d.execute("INSERT INTO sp VALUES " + ",".join(f"({i})" for i in range(200)))
+    assert d.query("SELECT COUNT(*) FROM sp") == [(200,)]
+
+
+# -- Backoffer lazy RNG ------------------------------------------------------
+
+
+def test_backoffer_rng_lazy_and_deterministic():
+    from tidb_tpu.utils.backoff import Backoffer, boRPC
+
+    bo = Backoffer(budget_ms=10**9, seed=7, sleep=lambda s: None)
+    assert bo._rng is None, "a request that never backs off must not seed an RNG"
+    a = [bo.backoff(boRPC) for _ in range(5)]
+    assert bo._rng is not None
+    # lazily-built RNG replays the exact jitter stream of an eager one
+    bo2 = Backoffer(budget_ms=10**9, seed=7, sleep=lambda s: None)
+    assert [bo2.backoff(boRPC) for _ in range(5)] == a
+
+
+# -- digest memoization ------------------------------------------------------
+
+
+def test_digest_memoized(monkeypatch):
+    from tidb_tpu.utils import stmtsummary
+
+    q = "SELECT COUNT(*) FROM memo_probe WHERE x = 42"
+    d1 = stmtsummary.digest(q)
+    # a second call must not tokenize again: poison the uncached path
+    monkeypatch.setattr(
+        stmtsummary, "_digest_uncached", lambda sql: pytest.fail("memo missed")
+    )
+    assert stmtsummary.digest(q) == d1
+
+
+def test_digest_memo_distinguishes_statements():
+    from tidb_tpu.utils import stmtsummary
+
+    a = stmtsummary.digest("SELECT 1 FROM x WHERE y = 1")
+    b = stmtsummary.digest("SELECT 1 FROM x WHERE y = 2")
+    assert a == b  # literals normalize away
+    c = stmtsummary.digest("SELECT z FROM x WHERE y = 1")
+    assert c != a

@@ -146,6 +146,61 @@ def bench_q3_join_mpp() -> float:
     return best
 
 
+@register("fixed_overhead_ms")
+def bench_fixed_overhead() -> float:
+    """Warm COUNT(*) end-to-end latency (ms, lower is better): near-zero
+    engine compute, so this IS the per-query SQL-layer tax — parse, plan,
+    dispatch, accounting. The statement fast lane (parse/plan reuse, shared
+    cop pool, memoized digest) exists to drive this down; the guard keeps
+    later PRs from quietly re-adding fixed cost."""
+    import time as _t
+
+    import numpy as np
+
+    import tidb_tpu
+    from tidb_tpu.executor.load import bulk_load
+
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE fo (id BIGINT PRIMARY KEY, v BIGINT)")
+    n = 10_000
+    bulk_load(db, "fo", [np.arange(n, dtype=np.int64), np.arange(n, dtype=np.int64)])
+    s = db.session()
+    q = "SELECT COUNT(*) FROM fo"
+    s.query(q)
+    s.query(q)  # warm: statement + plan + engine caches
+    best = float("inf")
+    for _ in range(30):
+        t0 = _t.perf_counter()
+        s.query(q)
+        best = min(best, (_t.perf_counter() - t0) * 1000)
+    return best
+
+
+@register("qps_point_select")
+def bench_qps_point_select() -> float:
+    """Concurrent point-select throughput (ops/s, higher is better): N
+    threads × N sessions EXECUTE a prepared ``pk = ?`` with rotating
+    parameters against one DB — the serving shape the value-agnostic
+    prepared-plan cache and the shared cop pool exist for."""
+    import tidb_tpu
+    from tidb_tpu.bench.qps import concurrent_qps
+
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE qp (id BIGINT PRIMARY KEY, v BIGINT)")
+    db.execute("INSERT INTO qp VALUES " + ",".join(f"({i},{i * 3})" for i in range(500)))
+
+    def setup(s, i):
+        s.prepare("SELECT v FROM qp WHERE id = ?", name="pt")
+        s.execute_prepared("pt", [i])  # warm per-session caches
+
+    def worker(s, i, k):
+        rows = s.execute_prepared("pt", [(i * 131 + k) % 500]).rows
+        if len(rows) != 1:  # never inside an assert: python -O strips it
+            raise RuntimeError(f"point select returned {len(rows)} rows")
+
+    return concurrent_qps(db, worker, 4, 250, setup=setup)
+
+
 @register("owner_failover_ms")
 def bench_owner_failover() -> float:
     """Owner-election failover latency (ms, lower is better): a 3-shard

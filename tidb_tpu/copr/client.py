@@ -4,10 +4,18 @@ Reference parity: pkg/store/copr/coprocessor.go (buildCopTasks :334 splits
 ranges by region; copIterator :684 runs a worker pool with keep-order
 channels; :87 CopClient.Send). Concurrency here is a thread pool — numpy and
 XLA release the GIL in their hot paths, so region tasks overlap for real.
+
+The worker pool is ONE lazily-built process-wide executor (ref: the
+reference's copIteratorWorker goroutines being cheap — spawning an OS thread
+pool per request here cost ~1-2 ms of fixed tax on every multi-region
+statement). Per-request concurrency is enforced by windowed submission, not
+pool size: at most ``req.concurrency`` tasks of one request are in flight,
+so a single request cannot monopolize the shared workers.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
@@ -36,6 +44,97 @@ def _engines():
         register_engine(StoreType.HOST, host_engine.execute_dag)
         register_engine(StoreType.TPU, tpu_engine.execute_dag)
     return _ENGINES
+
+
+# -- shared cop worker pool -------------------------------------------------
+
+_POOL_MU = threading.Lock()
+_POOL: Optional[ThreadPoolExecutor] = None
+
+
+def shared_cop_pool(concurrency_hint: int = 0) -> ThreadPoolExecutor:
+    """The process-wide cop worker pool, built on first use. Sized from the
+    first request's executor-concurrency hint (floored so concurrent
+    sessions overlap even when the first request was narrow); per-request
+    parallelism is throttled by submission windows, not pool size."""
+    global _POOL
+    with _POOL_MU:
+        if _POOL is None:
+            size = max(int(concurrency_hint), (os.cpu_count() or 4) * 2, 8)
+            _POOL = ThreadPoolExecutor(max_workers=size, thread_name_prefix="cop-shared")
+        return _POOL
+
+
+def shutdown_shared_pool() -> None:
+    """Idempotent teardown (tests / embedders); the pool lazily rebuilds on
+    the next cop request."""
+    global _POOL
+    with _POOL_MU:
+        pool, _POOL = _POOL, None
+    if pool is not None:
+        pool.shutdown(wait=True, cancel_futures=True)
+
+
+def windowed_fanout(pool, run: Callable, items: list, window: int):
+    """Run ``run(item)`` for every item on the shared pool with at most
+    ``window`` of THIS request in flight, yielding results in item order.
+
+    Work-conserving: ``window`` worker loops pull the next item the moment
+    they finish one (a long task never idles the other workers, unlike
+    consumer-driven admission), and the loops exit — releasing their pool
+    slots — when the queue drains. Returns ``(iterator, cancel)``;
+    ``cancel`` is idempotent and stops unstarted work. Shared by the
+    embedded and remote cop clients."""
+    from concurrent.futures import Future
+
+    n = len(items)
+    results = [Future() for _ in range(n)]
+    mu = threading.Lock()
+    state = {"next": 0, "closed": False}
+
+    def worker():
+        while True:
+            with mu:
+                if state["closed"] or state["next"] >= n:
+                    return
+                i = state["next"]
+                state["next"] += 1
+            try:
+                results[i].set_result(run(items[i]))
+            except BaseException as e:
+                try:
+                    results[i].set_exception(e)
+                except Exception:
+                    pass  # consumer already cancelled this slot
+
+    handles = [pool.submit(worker) for _ in range(min(window, n))]
+
+    def cancel():
+        with mu:
+            state["closed"] = True
+        for h in handles:
+            h.cancel()
+        for f in results:
+            f.cancel()
+
+    # a pool shutdown(cancel_futures=True) can cancel still-QUEUED worker
+    # loops out from under us — without this hook the per-item result
+    # futures would never resolve and the consumer would block forever
+    def _handle_done(h):
+        if h.cancelled():
+            cancel()
+
+    for h in handles:
+        h.add_done_callback(_handle_done)
+
+    def gen():
+        try:
+            for f in results:
+                yield f.result()
+        finally:
+            cancel()
+
+    return gen(), cancel
 
 
 @dataclass
@@ -118,9 +217,9 @@ class CopResponse:
     """Streaming response (kv.Response). Iterates CopResults; with
     keep_order the stream follows region order, else completion order."""
 
-    def __init__(self, it: Iterator[CopResult], pool: Optional[ThreadPoolExecutor]):
+    def __init__(self, it: Iterator[CopResult], cancel: Optional[Callable] = None):
         self._it = it
-        self._pool = pool
+        self._cancel = cancel
         self._closed = False
 
     def __iter__(self):
@@ -129,8 +228,10 @@ class CopResponse:
     def close(self):
         if not self._closed:
             self._closed = True
-            if self._pool is not None:
-                self._pool.shutdown(wait=False, cancel_futures=True)
+            if self._cancel is not None:
+                # cancel this request's pending work only — the shared pool
+                # serves other requests and must stay up
+                self._cancel()
 
 
 class CopClient:
@@ -190,31 +291,14 @@ class CopClient:
                 for t in tasks:
                     yield run(t)
 
-            return CopResponse(gen_serial(), None)
+            return CopResponse(gen_serial())
 
-        pool = ThreadPoolExecutor(max_workers=concurrency, thread_name_prefix="cop")
-        futures = [pool.submit(run, t) for t in tasks]
-
-        if req.keep_order:
-            def gen_ordered():
-                try:
-                    for f in futures:
-                        yield f.result()
-                finally:
-                    pool.shutdown(wait=False)
-
-            return CopResponse(gen_ordered(), pool)
-
-        # tasks still run concurrently; yielding in task order (not completion
-        # order) costs nothing — the reader gathers every result before
-        # returning — and keeps ORDER BY tie-breaks deterministic across runs
-        # and engines (a stable root sort preserves the concat order of equal
-        # keys, so completion-order concat would make ties racy)
-        def gen_unordered():
-            try:
-                for f in futures:
-                    yield f.result()
-            finally:
-                pool.shutdown(wait=False)
-
-        return CopResponse(gen_unordered(), pool)
+        # shared pool, windowed: at most ``concurrency`` tasks of THIS
+        # request occupy workers at once. Yielding in task order (not
+        # completion order) costs nothing — the reader gathers every result
+        # before returning — and keeps ORDER BY tie-breaks deterministic
+        # across runs and engines (a stable root sort preserves the concat
+        # order of equal keys, so completion-order concat would make ties
+        # racy)
+        it, cancel = windowed_fanout(shared_cop_pool(concurrency), run, tasks, concurrency)
+        return CopResponse(it, cancel)

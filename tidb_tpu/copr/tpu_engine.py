@@ -119,6 +119,45 @@ def _hbm_budget() -> int:
 
 _DEVICE_LRU = _DeviceLRU(_hbm_budget())
 
+# warm-path H2D hoisting: every dispatch used to re-transfer the (tiny)
+# padded range array and the valid-row scalar — two synchronous device puts
+# per task (~1-3 ms through a remote tunnel) that dominate the fixed cost of
+# cheap queries like COUNT(*). Both are tiny and low-cardinality, so they
+# cache device-resident keyed by value (ranges by their byte image).
+_MISC_MU = threading.Lock()
+_RANGES_DEV: "OrderedDict[bytes, object]" = OrderedDict()
+_NVALID_DEV: "OrderedDict[object, object]" = OrderedDict()
+_MISC_CAP = 512
+
+
+def _misc_cached(cache: OrderedDict, key, make):
+    with _MISC_MU:
+        hit = cache.get(key)
+        if hit is not None:
+            cache.move_to_end(key)
+            return hit
+    val = make()
+    with _MISC_MU:
+        cache[key] = val
+        while len(cache) > _MISC_CAP:
+            cache.popitem(last=False)
+    return val
+
+
+def _device_ranges(rarr: np.ndarray):
+    """Device-resident copy of the padded range array, keyed by the bound
+    ranges' byte image — repeat queries skip the per-dispatch transfer."""
+    import jax.numpy as jnp
+
+    return _misc_cached(_RANGES_DEV, rarr.tobytes(), lambda: jnp.asarray(rarr))
+
+
+def _device_nvalid(n: int):
+    """Device-resident valid-row-count scalar (one per distinct count)."""
+    import jax.numpy as jnp
+
+    return _misc_cached(_NVALID_DEV, int(n), lambda: jnp.asarray(int(n)))
+
 
 def _device_put_col(key, make_pair, n_pad: int, cacheable: bool = True):
     """One padded (data, valid) pair on device, LRU-cached under ``key``.
@@ -205,7 +244,11 @@ def _fused_block_inputs(store, scan, cache, entry, region):
         handles_blocks.append(h)
         for ci, pair in enumerate(cols_dev):
             cols_blocks[ci].append(pair)
-    nvalids = jnp.asarray(np.array([hi - lo for lo, hi in bounds], dtype=np.int64))
+    nvalids = _misc_cached(
+        _NVALID_DEV,
+        ("nvalids", tuple(bounds)),
+        lambda: jnp.asarray(np.array([hi - lo for lo, hi in bounds], dtype=np.int64)),
+    )
     return handles_blocks, cols_blocks, nvalids, len(bounds)
 
 
@@ -364,7 +407,7 @@ def _exec_single(store, dag, bound, scan, cache, entry, region, rarr, warn=None)
     fs = _covers_all(rarr, entry)
     while True:
         kernel = get_kernel(bound, n_pad, agg_cap, full_scan=fs)
-        packed = kernel.fn(handles_dev, tuple(cols_dev), jnp.asarray(rarr), jnp.asarray(entry.n))
+        packed = kernel.fn(handles_dev, tuple(cols_dev), _device_ranges(rarr), _device_nvalid(entry.n))
         # ONE device→host round trip per task: device_get batches every
         # buffer of the packed result into a single transfer — two
         # sequential np.asarray calls would pay the tunnel RTT twice.
@@ -411,7 +454,7 @@ def _exec_blocks(store, dag, bound, scan, cache, entry, region, rarr, warn=None)
         lo, hi = bounds[bi]
         return _block_device_inputs(store, scan, cache, entry, region, bi, lo, hi, cacheable)
 
-    rarr_j = jnp.asarray(rarr)
+    rarr_j = _device_ranges(rarr)
     nvalids = [hi - lo for lo, hi in bounds]
     limit_last = bool(dag.executors[1:]) and dag.executors[-1].tp == dagpb.LIMIT
 
@@ -422,7 +465,7 @@ def _exec_blocks(store, dag, bound, scan, cache, entry, region, rarr, warn=None)
 
         def run_block(bi: int):
             handles_dev, cols_dev = block_inputs(bi)
-            return kernel.fn(handles_dev, cols_dev, rarr_j, jnp.asarray(nvalids[bi]))
+            return kernel.fn(handles_dev, cols_dev, rarr_j, _device_nvalid(nvalids[bi]))
 
         if limit_last:
             out = _blocks_paged_limit(run_block, len(bounds), kernel, dag, cache, scan, warn)
@@ -494,7 +537,7 @@ def _exec_fused_blocks(store, dag, bound, scan, cache, entry, region, rarr, warn
         packed = kernel.fn(
             tuple(handles_blocks),
             tuple(tuple(cb) for cb in cols_blocks),
-            jnp.asarray(rarr),
+            _device_ranges(rarr),
             nvalids,
         )
         fbuf = None

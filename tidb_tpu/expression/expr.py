@@ -60,6 +60,10 @@ class ColumnRef(Expression):
 class Constant(Expression):
     value: Any  # logical python value; None == NULL
     ftype: FieldType
+    # EXECUTE-parameter provenance (-1 = plain constant): a cached
+    # value-agnostic prepared plan rewrites ``value`` in place per execution
+    # for every Constant carrying a parameter index (planner/prepcache.py)
+    param_idx: int = -1
 
     def to_pb(self) -> dict:
         v = self.value

@@ -587,13 +587,14 @@ class _RemoteCopClient:
         items = list(enumerate(tasks))
         if req.concurrency <= 1 or len(items) == 1:
             return CopResponse((run(it) for it in items), None)
-        futures = [self.store._cop_pool.submit(run, it) for it in items]
+        # the process-wide cop pool (copr/client.py): worker threads and
+        # their pooled per-thread sockets outlive individual queries; the
+        # window caps THIS request at its own concurrency
+        from tidb_tpu.copr.client import shared_cop_pool, windowed_fanout
 
-        def gen():
-            for f in futures:
-                yield f.result()
-
-        return CopResponse(gen(), None)
+        window = min(max(req.concurrency, 1), len(items))
+        it, cancel = windowed_fanout(shared_cop_pool(window), run, items, window)
+        return CopResponse(it, cancel)
 
 
 # verbs that must NOT be transparently replayed after they may have reached
@@ -644,12 +645,9 @@ class RemoteStore:
         self.tso = _RemoteTSO(self)
         self.detector = _RemoteDetector(self)
         self.pd = _RemotePD(self)
-        # persistent cop worker pool: threads (and their pooled sockets)
-        # outlive individual queries — per-query pools would re-dial the
-        # server concurrency times per multi-region statement
-        from concurrent.futures import ThreadPoolExecutor
-
-        self._cop_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="rcop")
+        # cop fan-out runs on the process-wide shared pool (copr/client.py):
+        # its threads (and their pooled per-thread sockets) outlive both
+        # individual queries and individual RemoteStore handles
         self._mpp_ndev: Optional[int] = None
         # fail fast on a bad endpoint: zero retry budget, so a dead/refused
         # address raises on the FIRST dial instead of looping out the full

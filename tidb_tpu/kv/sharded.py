@@ -144,11 +144,20 @@ class _ShardedCopClient:
             for si, sub in segments
         ]
 
-        def gen():
+        def cancel():
             for resp in responses:
-                yield from resp.results
+                resp.close()
 
-        return CopResponse(gen(), None)
+        def gen():
+            try:
+                for resp in responses:
+                    # CopResponse is an iterator of CopResults (it has no
+                    # .results attribute — iterating is the contract)
+                    yield from resp
+            finally:
+                cancel()
+
+        return CopResponse(gen(), cancel)
 
     @staticmethod
     def _sub(req: Request, ranges) -> Request:

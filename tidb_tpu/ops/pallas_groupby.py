@@ -112,9 +112,11 @@ def grouped_sums(seg, pairs, B: int, n_pad: int, interpret: bool = False):
     # the Mosaic lowering rejects kernels traced in x64 mode ("failed to
     # legalize func.return"); the kernel is pure int32/f32, so trace it in a
     # 32-bit scope — inputs/outputs are explicit-dtype arrays either way
-    import jax
+    # (jax.enable_x64 was removed from the top-level namespace; the
+    # supported context manager lives in jax.experimental)
+    from jax.experimental import enable_x64
 
-    with jax.enable_x64(False):
+    with enable_x64(False):
         acc = _build_call(n_pad, B_pad, n_cols, interpret)(seg1d, cols2d)  # (B_pad, 9L)
 
     def col(j):

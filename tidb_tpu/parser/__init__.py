@@ -7,6 +7,6 @@ SQL grammar, not 16k-line yacc compatibility"). Single entry point:
 ``parse(sql) -> ast.Statement`` (multi-statement: ``parse_many``).
 """
 
-from tidb_tpu.parser.parser import parse, parse_many, parse_with_params, ParseError
+from tidb_tpu.parser.parser import parse, parse_count, parse_many, parse_with_params, ParseError
 
-__all__ = ["parse", "parse_many", "parse_with_params", "ParseError"]
+__all__ = ["parse", "parse_count", "parse_many", "parse_with_params", "ParseError"]

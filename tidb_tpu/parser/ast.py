@@ -18,6 +18,10 @@ class Literal(Node):
     value: Any  # int | float | str | bytes | None | bool
     # hints: "date"/"time"/"decimal" for typed literals (DATE '1994-01-01')
     hint: str = ""
+    # which EXECUTE parameter produced this literal (-1 = a plain literal);
+    # the value-agnostic prepared-plan cache traces parameters through the
+    # builder by this index (ref: plan-cache parameter markers)
+    param_idx: int = -1
 
 
 @dataclass
@@ -696,14 +700,17 @@ class LoadData(Node):
     dup_mode: str = ""  # "" | "ignore" | "replace"
 
 
-def bind_params(node, values):
+def bind_params(node, values, mark: bool = False):
     """Return a copy of the AST with each ParamMarker replaced by a Literal
-    of the corresponding value (EXECUTE ... USING binding)."""
+    of the corresponding value (EXECUTE ... USING binding). With ``mark``,
+    each produced Literal remembers its parameter index so the builder's
+    Constants stay traceable to EXECUTE parameters (the value-agnostic
+    prepared-plan cache mutates them in place on later executions)."""
     import dataclasses
 
     def conv(v):
         if isinstance(v, ParamMarker):
-            return Literal(values[v.idx])
+            return Literal(values[v.idx], param_idx=v.idx if mark else -1)
         if isinstance(v, Node) and dataclasses.is_dataclass(v):
             return type(v)(**{f.name: conv(getattr(v, f.name)) for f in dataclasses.fields(v)})
         if isinstance(v, list):

@@ -1960,6 +1960,16 @@ def _parse_duration(s: str) -> float:
     return float(s)
 
 
+# full lexer+parser invocations since process start — the statement fast
+# lane (session._stmt_cache) is asserted against this: a warm repeated
+# statement must not move it (see tests/test_fastlane.py)
+_N_PARSES = 0
+
+
+def parse_count() -> int:
+    return _N_PARSES
+
+
 def parse(sql: str) -> ast.Node:
     return parse_with_params(sql)[0]
 
@@ -1967,6 +1977,8 @@ def parse(sql: str) -> ast.Node:
 def parse_with_params(sql: str) -> tuple[ast.Node, int]:
     """Parse one statement; also report how many ``?`` markers it contains
     (prepared-statement surface, ref: ast.ParamMarkerExpr counting)."""
+    global _N_PARSES
+    _N_PARSES += 1
     p = Parser(sql)
     stmt = p.parse_statement()
     p.eat_op(";")

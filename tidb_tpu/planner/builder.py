@@ -1795,6 +1795,15 @@ def _patch_group_refs(e: Expression, n_aggs: int, n_groups: int = 0) -> Expressi
 
 
 def _literal(node: ast.Literal) -> Constant:
+    c = _literal_const(node)
+    if node.param_idx >= 0:
+        # keep EXECUTE-parameter provenance: the value-agnostic prepared-plan
+        # cache mutates these Constants in place on later executions
+        c.param_idx = node.param_idx
+    return c
+
+
+def _literal_const(node: ast.Literal) -> Constant:
     v = node.value
     if node.hint == "date":
         return Constant(date_to_days(v), FieldType(TypeKind.DATE, nullable=False))

@@ -564,6 +564,14 @@ def _build_index_access(scan: LogicalScan, acc, conds: list[Expression]):
         oc.slot in acc.index.column_offsets or (t.pk_is_handle and oc.slot == t.pk_offset)
         for oc in scan.schema
     )
+    # value-agnostic prepared plans re-run the detachment over the SAME
+    # condition objects after parameter mutation; range_used_ids lets the
+    # rebuild verify the used/residual split did not shift under the new
+    # values (shifted split → the cached plan must not be reused)
+    maker = lambda cs=tuple(conds), scan=scan, t=t, idx=acc.index: (  # noqa: E731
+        ranger.detach_index_conditions(list(cs), scan.schema, t, idx)
+    )
+    used_ids = frozenset(id(c) for c in acc.used)
     if covering:
         output_slots = [
             -1 if (t.pk_is_handle and oc.slot == t.pk_offset) else oc.slot for oc in scan.schema
@@ -577,6 +585,8 @@ def _build_index_access(scan: LogicalScan, acc, conds: list[Expression]):
             pushed_conditions=list(acc.residual),
             all_conditions=list(conds),
             schema=scan.schema,
+            range_maker=maker,
+            range_used_ids=used_ids,
         )
     return PhysIndexLookUp(
         db=scan.db,
@@ -587,6 +597,8 @@ def _build_index_access(scan: LogicalScan, acc, conds: list[Expression]):
         residual_conditions=list(acc.residual),
         all_conditions=list(conds),
         schema=scan.schema,
+        range_maker=maker,
+        range_used_ids=used_ids,
     )
 
 
@@ -701,9 +713,17 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None, vars=None) -> P
             child.store_type = st
             child.pushed_conditions.extend(pushable)
             if isinstance(plan.children[0], LogicalScan):
-                r = _derive_ranges(plan.children[0], pushable)
+                scan0 = plan.children[0]
+                r = _derive_ranges(scan0, pushable)
                 if r is not None:
                     child.ranges = r
+                # value-agnostic prepared plans re-derive handle ranges from
+                # the SAME condition objects after parameter mutation; table
+                # ranges only narrow the scan (conditions still filter), so
+                # any rebuild outcome — including None (full scan) — is safe
+                child.range_maker = (
+                    lambda scan0=scan0, cs=tuple(pushable): _derive_ranges(scan0, list(cs))
+                )
                 if plan.children[0].table.partition is not None:
                     from tidb_tpu.planner.partition import prune_partitions
 

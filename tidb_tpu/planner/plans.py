@@ -228,6 +228,11 @@ class PhysTableReader(PhysicalPlan):
     # partitioned tables: pruned partition views to scan (None = all;
     # ref: rule_partition_processor pruning + PartitionIDAndRanges)
     partitions: Optional[list] = None
+    # re-derives ``ranges`` from the (possibly parameter-mutated) pushed
+    # conditions — the value-agnostic prepared-plan cache calls this per
+    # EXECUTE (ref: RebuildPlan4CachedPlan re-running ranger); None on plans
+    # whose ranges never came from conditions
+    range_maker: Optional[object] = field(default=None, repr=False, compare=False)
 
 
 @dataclass
@@ -250,6 +255,13 @@ class PhysIndexReader(PhysicalPlan):
     all_conditions: list[Expression] = field(default_factory=list)
     schema: Schema = field(default_factory=list)
     children: list = field(default_factory=list)
+    # value-agnostic prepared-plan support: re-runs index-range detachment
+    # over the parameter-mutated conditions; ``range_used_ids`` snapshots
+    # which condition objects the ranges consumed at plan time — a rebuild
+    # that consumes a different set means the cached residual split is no
+    # longer valid and the whole statement must re-plan
+    range_maker: Optional[object] = field(default=None, repr=False, compare=False)
+    range_used_ids: Optional[frozenset] = field(default=None, repr=False, compare=False)
 
 
 @dataclass
@@ -268,6 +280,9 @@ class PhysIndexLookUp(PhysicalPlan):
     all_conditions: list[Expression] = field(default_factory=list)
     schema: Schema = field(default_factory=list)
     children: list = field(default_factory=list)
+    # same contract as PhysIndexReader.range_maker / range_used_ids
+    range_maker: Optional[object] = field(default=None, repr=False, compare=False)
+    range_used_ids: Optional[frozenset] = field(default=None, repr=False, compare=False)
 
 
 @dataclass

@@ -1,0 +1,224 @@
+"""Value-agnostic prepared-plan reuse.
+
+Reference parity: pkg/planner/core plan_cache.go — a prepared statement
+caches ONE physical plan regardless of the bound parameter values
+(``RebuildPlan4CachedPlan``): parameters live in the plan as shared
+``Constant`` objects carrying their parameter index, and each EXECUTE
+(a) rewrites those constants' values in place and (b) re-runs the ranger
+derivation (``planner/ranger.py``) so scan ranges follow the new values.
+
+The template is built once per (statement text, parameter-type signature)
+by walking the finished physical plan:
+
+- every ``Constant`` with ``param_idx >= 0`` is collected per parameter;
+- every range-bearing node contributes a rebuild hook (``range_maker``,
+  attached by the optimizer at derivation time, closing over the SAME
+  condition objects the plan carries — mutation is visible to the rebuild);
+- shapes whose ranges cannot be re-derived safely (index merge, partition
+  pruning, a parameter folded away by constant folding, an unknown plan
+  node) refuse the template — the session falls back to value-keyed
+  caching, exactly the pre-refinement behavior.
+
+Rebuild safety for index paths: the detachment may consume a DIFFERENT
+subset of conditions under new values (e.g. a parameter turning NULL drops
+an IN-list from the access path). The residual split baked into the plan
+would then be stale, so ``rebind`` compares the consumed-condition identity
+set against the plan-time snapshot and reports failure — the caller
+re-plans from scratch for that execution.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import enum
+from decimal import Decimal
+from typing import Optional
+
+import numpy as np
+
+from tidb_tpu.catalog.schema import ColumnInfo, IndexInfo, TableInfo
+from tidb_tpu.expression.expr import Constant, Expression
+from tidb_tpu.kv.kv import KeyRange
+from tidb_tpu.planner.plans import (
+    OutCol,
+    PhysIndexLookUp,
+    PhysIndexMerge,
+    PhysIndexReader,
+    PhysTableReader,
+)
+from tidb_tpu.types import FieldType
+
+# traversal leaves: never hold parameter constants, never need rebuilding
+_ATOMS = (
+    str,
+    bytes,
+    int,
+    float,
+    bool,
+    complex,
+    type(None),
+    Decimal,
+    datetime.date,
+    datetime.time,
+    datetime.timedelta,
+    enum.Enum,
+    np.ndarray,
+    np.generic,
+    KeyRange,
+    FieldType,
+    TableInfo,
+    IndexInfo,
+    ColumnInfo,
+    OutCol,
+    frozenset,
+)
+
+
+def param_sig(p) -> object:
+    """Parameter-type signature component: plans are typed from the bound
+    value's Python type at first EXECUTE (builder._literal), so a cached
+    plan is only reusable for parameters that would type identically."""
+    if isinstance(p, Decimal):
+        return ("Decimal", p.as_tuple().exponent)
+    return type(p).__name__
+
+
+@dataclasses.dataclass
+class PlanTemplate:
+    """One cached value-agnostic plan + its parameter rewrite points."""
+
+    plan: object
+    # param idx → every Constant in the plan carrying that parameter
+    param_consts: dict[int, list[Constant]]
+    # () -> bool per range-bearing node; False = split shifted, re-plan
+    rebuilders: list
+
+
+class _Walk:
+    __slots__ = ("seen", "consts", "rebuilders", "ok")
+
+    def __init__(self):
+        self.seen: set[int] = set()
+        self.consts: dict[int, list[Constant]] = {}
+        self.rebuilders: list = []
+        self.ok = True
+
+
+def _table_rebuilder(node: PhysTableReader):
+    def rebuild() -> bool:
+        # table ranges only narrow the scan — the pushed conditions still
+        # filter exactly — so every derivation outcome (incl. None = full
+        # scan) is safe to install
+        node.ranges = node.range_maker()
+        return True
+
+    return rebuild
+
+
+def _index_rebuilder(node):
+    def rebuild() -> bool:
+        acc = node.range_maker()
+        if acc is None:
+            return False
+        if frozenset(id(c) for c in acc.used) != node.range_used_ids:
+            return False  # used/residual split shifted under the new values
+        node.ranges = acc.ranges
+        return True
+
+    return rebuild
+
+
+def _walk(obj, st: _Walk) -> None:
+    if not st.ok or obj is None or isinstance(obj, _ATOMS):
+        return
+    oid = id(obj)
+    if oid in st.seen:
+        return
+    st.seen.add(oid)
+    if isinstance(obj, Constant):
+        if obj.param_idx >= 0:
+            st.consts.setdefault(obj.param_idx, []).append(obj)
+        return
+    if isinstance(obj, (list, tuple)):
+        for x in obj:
+            _walk(x, st)
+        return
+    if isinstance(obj, dict):
+        for v in obj.values():
+            _walk(v, st)
+        return
+    if isinstance(obj, PhysIndexMerge):
+        # per-path ranges have no rebuild hook (paths mix PK and index
+        # derivations) — not value-agnostic
+        st.ok = False
+        return
+    if isinstance(obj, PhysTableReader):
+        if obj.partitions is not None:
+            st.ok = False  # partition pruning picked partitions by value
+            return
+        if obj.range_maker is not None:
+            st.rebuilders.append(_table_rebuilder(obj))
+        elif obj.ranges is not None:
+            st.ok = False  # ranges of unknown provenance can't be rebuilt
+            return
+    elif isinstance(obj, (PhysIndexReader, PhysIndexLookUp)):
+        if obj.range_maker is None or obj.range_used_ids is None:
+            st.ok = False
+            return
+        st.rebuilders.append(_index_rebuilder(obj))
+    if dataclasses.is_dataclass(obj):
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name, None)
+            if callable(v) and not isinstance(v, Expression):
+                continue  # rebuild hooks / warn sinks
+            _walk(v, st)
+        return
+    if callable(obj):
+        return
+    # an unrecognized plan shape: refuse rather than risk a stale bake
+    st.ok = False
+
+
+def make_template(plan, n_params: int) -> Optional[PlanTemplate]:
+    """Build a reuse template for ``plan``, or None when the plan is not
+    provably value-agnostic (some parameter folded into an untraceable
+    position, or a range/partition shape we cannot re-derive)."""
+    if n_params <= 0:
+        return None
+    st = _Walk()
+    _walk(plan, st)
+    if not st.ok:
+        return None
+    if set(st.consts) != set(range(n_params)):
+        # a parameter vanished (constant-folded / baked into a limit):
+        # its value is burned into the plan — not reusable
+        return None
+    return PlanTemplate(plan, st.consts, st.rebuilders)
+
+
+def _plan_value(p):
+    """A parameter's PLAN-TIME value: route through the same literal
+    conversion the builder applied at template build (date → day number,
+    datetime/timedelta → microseconds, bool → int). Assigning the raw
+    Python value would desynchronize the cached plan from what a fresh
+    bind would have produced."""
+    from tidb_tpu.parser import ast
+    from tidb_tpu.planner.builder import _literal_const
+
+    return _literal_const(ast.Literal(p)).value
+
+
+def rebind(tmpl: PlanTemplate, params: list) -> bool:
+    """Point the template's parameter constants at ``params`` and re-derive
+    every dependent range set. False = this plan cannot serve these values
+    (the caller must re-plan); the template itself stays structurally valid
+    for values that keep the original derivation shape."""
+    for idx, consts in tmpl.param_consts.items():
+        v = _plan_value(params[idx])
+        for c in consts:
+            c.value = v
+    for rb in tmpl.rebuilders:
+        if not rb():
+            return False
+    return True

@@ -134,6 +134,40 @@ class PreparedStmt:
     n_params: int
 
 
+class _CachedStmt:
+    """One statement fast-lane entry: the parsed (binding-substituted) AST
+    plus everything needed to re-execute without touching the lexer/parser
+    (ref: the non-prepared plan cache, core/plan_cache_lru.go). The AST is
+    reused by REFERENCE — safe because SELECT planning never mutates its
+    input (CTE statements, which expand destructively, are never cached).
+    ``digest`` fills lazily on first stmt-summary/Top-SQL use."""
+
+    __slots__ = ("stmt", "stype", "epoch", "exec_sql", "digest")
+
+    def __init__(self, stmt, stype, epoch, exec_sql):
+        self.stmt = stmt
+        self.stype = stype
+        self.epoch = epoch
+        self.exec_sql = exec_sql
+        self.digest: Optional[str] = None
+
+
+def _has_ctes(node) -> bool:
+    """True when any (sub)query carries a WITH clause — expand_ctes rewrites
+    those IN PLACE, so their ASTs must not be cached for reuse."""
+    import dataclasses as _dc
+
+    if isinstance(node, ast.Node):
+        if getattr(node, "ctes", None):
+            return True
+        if _dc.is_dataclass(node):
+            return any(_has_ctes(getattr(node, f.name)) for f in _dc.fields(node))
+        return False
+    if isinstance(node, (list, tuple)):
+        return any(_has_ctes(x) for x in node)
+    return False
+
+
 @dataclass
 class Result:
     columns: list[str] = field(default_factory=list)
@@ -196,6 +230,16 @@ class Session:
         # session LRU plan cache (ref: core/plan_cache_lru.go:44); key
         # includes schema/stats versions so DDL and ANALYZE invalidate it
         self._plan_cache: OrderedDict[tuple, Any] = OrderedDict()
+        # statement fast lane (ref: the non-prepared plan cache): raw SQL
+        # text → parsed AST, skipping the lexer/parser on warm repeats;
+        # entries self-invalidate via the _stmt_epoch snapshot
+        self._stmt_cache: OrderedDict[str, _CachedStmt] = OrderedDict()
+        # bumped on session-scoped CREATE/DROP BINDING (fast-lane epoch)
+        self.bindings_ver = 0
+        # value-agnostic prepared-plan lane state (see _execute_prepared_select)
+        self._prep_capture: Optional[dict] = None
+        self._prep_pg_keys: set = set()
+        self._prep_va_refused: set = set()
         # SHOW WARNINGS buffer [(level, code, message)] + statement counter
         self.warnings: list[tuple] = []
         # the buffer as of the LAST statement — @@warning_count reads this
@@ -318,36 +362,86 @@ class Session:
         )
 
     # -- entry points --------------------------------------------------------
+    def _stmt_epoch(self) -> tuple:
+        """Statement fast-lane validity snapshot: any change here (DDL,
+        ANALYZE, binding create/drop, engine isolation, sql_mode, schema
+        context) invalidates cached ASTs — a fast-lane hit must never serve
+        anything the full parse path would not have produced."""
+        return (
+            self.catalog.schema_version,
+            self._db.stats.version,
+            self.bindings_ver,
+            self._db.bindings_ver,
+            self.current_db,
+            str(self.vars.get("tidb_isolation_read_engines")),
+            str(self.vars.get("sql_mode", "")),
+        )
+
     def execute(self, sql: str) -> Result:
         import time as _time
 
         from tidb_tpu.utils import metrics as _m
 
         t0 = _time.perf_counter()
-        try:
-            with self.span("parse"):
-                stmt = parse(sql)
-        except Exception as exc:
-            # failed parses still reach the audit trail (probing attempts)
-            _m.STMT_TOTAL.inc(type="ParseError")
-            self._audit_stmt(sql, "error", _time.perf_counter() - t0, str(exc))
-            raise
-        stype = type(stmt).__name__
-        # plan bindings: a bound statement with a matching digest replaces
-        # the incoming one (ref: bindinfo matching by normalized digest)
-        if isinstance(stmt, (ast.Select, ast.SetOp)) and (self.bindings or self._db.bindings):
-            from tidb_tpu.utils.stmtsummary import digest as _digest
+        entry: Optional[_CachedStmt] = None
+        cached = self._stmt_cache.get(sql)
+        if cached is not None:
+            # lease first: a catalog reload here bumps schema_version, which
+            # the epoch comparison below must observe
+            self._db.ensure_schema_lease()
+            if cached.epoch == self._stmt_epoch():
+                self._stmt_cache.move_to_end(sql)
+                entry = cached
+            else:
+                self._stmt_cache.pop(sql, None)
+        if entry is not None:
+            stmt, stype, exec_sql = entry.stmt, entry.stype, entry.exec_sql
+        else:
+            try:
+                with self.span("parse"):
+                    stmt = parse(sql)
+            except Exception as exc:
+                # failed parses still reach the audit trail (probing attempts)
+                _m.STMT_TOTAL.inc(type="ParseError")
+                self._audit_stmt(sql, "error", _time.perf_counter() - t0, str(exc))
+                raise
+            stype = type(stmt).__name__
+            exec_sql = sql
+            # plan bindings: a bound statement with a matching digest replaces
+            # the incoming one (ref: bindinfo matching by normalized digest)
+            cacheable_ast = isinstance(stmt, (ast.Select, ast.SetOp))
+            if cacheable_ast and (self.bindings or self._db.bindings):
+                from tidb_tpu.utils.stmtsummary import digest as _digest
 
-            d = _digest(sql)
-            bound = self.bindings.get(d) or self._db.bindings.get(d)
-            if bound is not None:
-                sql = bound[1]
-                stmt = parse(sql)
+                d = _digest(sql)
+                bound = self.bindings.get(d) or self._db.bindings.get(d)
+                if bound is not None:
+                    exec_sql = bound[1]
+                    stmt = parse(exec_sql)
+            # schema-validator lease: cross-node DDL becomes visible at most
+            # one lease behind; past the lease with an unreachable store the
+            # node refuses to answer from its stale catalog
+            self._db.ensure_schema_lease()
+            if cacheable_ast and not _has_ctes(stmt):
+                entry = _CachedStmt(stmt, stype, self._stmt_epoch(), exec_sql)
+                self._stmt_cache[sql] = entry
+                cap = sysvar_int(self.vars, "tidb_prepared_plan_cache_size", 100)
+                while len(self._stmt_cache) > cap:
+                    self._stmt_cache.popitem(last=False)
+        # one digest per statement, shared by bindings/Top-SQL/stmt-summary
+        # (previously computed up to three times per statement)
+        digest_cache = [entry.digest if entry is not None else None]
+
+        def sql_digest() -> str:
+            if digest_cache[0] is None:
+                from tidb_tpu.utils.stmtsummary import digest as _digest
+
+                digest_cache[0] = _digest(exec_sql)
+                if entry is not None:
+                    entry.digest = digest_cache[0]
+            return digest_cache[0]
+
         self._stmt_count += 1
-        # schema-validator lease: cross-node DDL becomes visible at most one
-        # lease behind; past the lease with an unreachable store the node
-        # refuses to answer from its stale catalog
-        self._db.ensure_schema_lease()
         if not isinstance(stmt, ast.Show):  # SHOW WARNINGS must see them
             self._prev_warnings = self.warnings
             self.warnings = []
@@ -355,21 +449,21 @@ class Session:
         # statement land on its digest (ref: topsql.AttachSQLInfo)
         topsql = None
         if self.vars.get("tidb_enable_top_sql", 0):
-            from tidb_tpu.utils.stmtsummary import digest as _digest
             from tidb_tpu.utils.topsql import collector as _topsql
 
             topsql = _topsql()
-            topsql.attach(_digest(sql).split("|")[0], "", sql)
+            topsql.attach(sql_digest().split("|")[0], "", exec_sql)
         try:
-            res = self._execute_stmt(stmt, sql_text=sql)
+            res = self._execute_stmt(stmt, sql_text=exec_sql)
             if not self._explicit and self._txn is not None:
                 self._finish_txn(commit=True)
             dt = _time.perf_counter() - t0
             _m.STMT_TOTAL.inc(type=stype)
             _m.QUERY_DURATION.observe(dt)
             self._db.stmt_summary.record(
-                sql, dt, len(res.rows) or res.affected, f"{self.user}@{self.host}",
+                exec_sql, dt, len(res.rows) or res.affected, f"{self.user}@{self.host}",
                 float(self.vars.get("tidb_slow_log_threshold", 300)) / 1000.0,
+                digest_val=sql_digest(),
             )
             # resource-group accounting + runaway detection (ref:
             # RunawayChecker at adapter.go:553; RU model per request)
@@ -377,15 +471,15 @@ class Session:
             if g is not None:
                 g.consume(0.125 + (len(res.rows) or res.affected))
                 if g.exec_elapsed_s and dt > g.exec_elapsed_s:
-                    self._db.resource_groups.record_runaway(g.name, g.action, sql[:256])
-            self._audit_stmt(sql, "ok", dt)
+                    self._db.resource_groups.record_runaway(g.name, g.action, exec_sql[:256])
+            self._audit_stmt(exec_sql, "ok", dt)
             return res
         except Exception as exc:
             _m.STMT_TOTAL.inc(type=f"{stype}:error")
-            self._audit_stmt(sql, "error", _time.perf_counter() - t0, str(exc))
+            self._audit_stmt(exec_sql, "error", _time.perf_counter() - t0, str(exc))
             g = self._db.resource_groups.get(str(self.vars.get("tidb_resource_group", "default")))
             if g is not None and g.exec_elapsed_s and (_time.perf_counter() - t0) >= g.exec_elapsed_s:
-                self._db.resource_groups.record_runaway(g.name, g.action, sql[:256])
+                self._db.resource_groups.record_runaway(g.name, g.action, exec_sql[:256])
             if not self._explicit and self._txn is not None:
                 # autocommit statement failed → roll back its staged writes
                 self._finish_txn(commit=False)
@@ -527,12 +621,14 @@ class Session:
 
             store = self._db.bindings if stmt.is_global else self.bindings
             store[_digest(stmt.for_text)] = (stmt.for_text, stmt.using_text)
+            self._note_bindings_changed(stmt.is_global)
             return Result()
         if isinstance(stmt, ast.DropBinding):
             from tidb_tpu.utils.stmtsummary import digest as _digest
 
             store = self._db.bindings if stmt.is_global else self.bindings
             store.pop(_digest(stmt.for_text), None)
+            self._note_bindings_changed(stmt.is_global)
             return Result()
         if isinstance(stmt, ast.RecoverTable):
             self.require_priv(stmt.table.db or self.current_db, stmt.table.name, "create")
@@ -840,17 +936,85 @@ class Session:
         ps = self.prepared.get(name)
         if ps is None:
             raise SessionError(f"unknown prepared statement '{name}'")
-        params = params or []
+        params = list(params or [])
         if len(params) != ps.n_params:
             raise SessionError(
                 f"prepared statement '{name}' expects {ps.n_params} parameters, got {len(params)}"
             )
-        bound = ast.bind_params(ps.stmt, params) if ps.n_params else ps.stmt
-        # plans bake constants into scan ranges, so the cache key includes
-        # the bound parameter values (the reference instead rebuilds ranges
-        # inside a value-agnostic cached plan — a later-round refinement)
-        key = ("__prep__", ps.text, tuple(repr(p) for p in params))
-        return self._execute_stmt(bound, sql_text=key)
+        if not ps.n_params:
+            return self._execute_stmt(ps.stmt, sql_text=("__prep__", ps.text))
+        if isinstance(ps.stmt, (ast.Select, ast.SetOp)):
+            # value-agnostic lane: one cached plan per statement/type
+            # signature, scan ranges rebuilt from the fresh parameters
+            # (ref: plan_cache.go caching across parameter values)
+            return self._execute_prepared_select(ps, params)
+        # parameterized DML takes no plan cache — bind and run
+        return self._execute_stmt(ast.bind_params(ps.stmt, params), sql_text=None)
+
+    def _execute_prepared_select(self, ps: PreparedStmt, params: list) -> Result:
+        """EXECUTE of a parameterized SELECT under the value-agnostic plan
+        cache: point-gets keep their fast path (reported as cache hits on
+        repeats), template hits skip parse/build/optimize entirely, and
+        statements whose plans provably bake values (folded parameters,
+        index merges, partition pruning, subquery snapshots) fall back to
+        the old value-keyed cache after the first miss."""
+        from tidb_tpu.planner import prepcache
+
+        sig = tuple(prepcache.param_sig(p) for p in params)
+        va_key = self._plan_cache_key(("__va__", ps.text, sig))
+        # refusals are epoch-scoped: DDL/ANALYZE can change the plan shape
+        # (drop an index merge, remove partitioning) into a templatable one,
+        # so a refusal must not outlive the schema/stats that caused it
+        refuse_key = (ps.text, sig, self.catalog.schema_version, self._db.stats.version)
+        tmpl = self._plan_cache.get(va_key)
+        if isinstance(tmpl, prepcache.PlanTemplate):
+            if prepcache.rebind(tmpl, params):
+                self._plan_cache.move_to_end(va_key)
+                cap = {
+                    "outer_stmt": ps.stmt,
+                    "cached_plan": tmpl.plan,
+                    "n_params": len(params),
+                    "rebind": lambda: ast.bind_params(ps.stmt, params),
+                }
+                prev, self._prep_capture = self._prep_capture, cap
+                try:
+                    return self._execute_stmt(ps.stmt, sql_text=None)
+                finally:
+                    self._prep_capture = prev
+            # the new values shifted the range derivation (e.g. a NULL
+            # dropped an access condition): this plan can't serve them —
+            # drop it and re-plan below
+            self._plan_cache.pop(va_key, None)
+        if refuse_key in self._prep_va_refused:
+            # statement proven non-agnostic: old behavior, values in the key
+            bound = ast.bind_params(ps.stmt, params)
+            key = ("__prep__", ps.text, tuple(repr(p) for p in params))
+            return self._execute_stmt(bound, sql_text=key)
+        bound = ast.bind_params(ps.stmt, params, mark=True)
+        cap = {
+            "outer_stmt": bound,
+            "n_params": len(params),
+            "pg_warm": va_key in self._prep_pg_keys,
+        }
+        prev, self._prep_capture = self._prep_capture, cap
+        try:
+            res = self._execute_stmt(bound, sql_text=None)
+        finally:
+            self._prep_capture = prev
+        if cap.get("template") is not None:
+            self._plan_cache[va_key] = cap["template"]
+            cap_n = sysvar_int(self.vars, "tidb_prepared_plan_cache_size", 100)
+            while len(self._plan_cache) > cap_n:
+                self._plan_cache.popitem(last=False)
+        elif cap.get("point_get"):
+            if len(self._prep_pg_keys) > 512:
+                self._prep_pg_keys.clear()
+            self._prep_pg_keys.add(va_key)
+        else:
+            if len(self._prep_va_refused) > 512:
+                self._prep_va_refused.clear()
+            self._prep_va_refused.add(refuse_key)
+        return res
 
     def _execute_prepared(self, stmt: ast.ExecutePrepared) -> Result:
         vals = []
@@ -871,13 +1035,22 @@ class Session:
 
     # -- SELECT ---------------------------------------------------------------
     def _select(self, stmt, cache_key=None) -> Result:
+        # value-agnostic prepared lane: only the OUTERMOST select of the
+        # EXECUTE interacts with the capture context (subquery/CTE runners
+        # re-enter _select with inner statements)
+        cap = self._prep_capture
+        is_outer = cap is not None and stmt is cap.get("outer_stmt")
         # point-get fast path first (ref: TryFastPlan, point_get_plan.go:957)
         from tidb_tpu.planner.pointget import detect_point_get, run_point_get
 
         pg = detect_point_get(self.catalog, self.current_db, stmt)
         if pg is not None:
             self.require_priv(pg.db, pg.table.name, "select")
-            self.vars["last_plan_from_cache"] = 0
+            # a repeated prepared point-get reports as a cache hit like the
+            # reference's cached PointGetPlan (no parse, no planner ran)
+            self.vars["last_plan_from_cache"] = 1 if (is_outer and cap.get("pg_warm")) else 0
+            if is_outer:
+                cap["point_get"] = True
             return Result(columns=pg.out_names, rows=run_point_get(self, pg))
         if getattr(stmt, "ctes", None):
             from tidb_tpu.planner.cte import expand_ctes
@@ -885,11 +1058,13 @@ class Session:
             # CTE expansion can materialize data (recursive fixpoints) into
             # the AST — such plans must never be cached
             cache_key = None
+            is_outer = False
             stmt = expand_ctes(stmt, self._cte_runner)
         if isinstance(stmt, ast.SetOp) and _setop_has_for_update(stmt):
             raise SessionError("FOR UPDATE is not supported inside set operations")
         as_of_ts = self._resolve_as_of(stmt)
         if as_of_ts is not None:
+            is_outer = False  # stale reads re-resolve their ts per execution
             if self._txn_dirty():
                 raise SessionError("AS OF TIMESTAMP inside a dirty transaction is not allowed")
             if getattr(stmt, "for_update", False):
@@ -897,6 +1072,7 @@ class Session:
             cache_key = None  # stale plans bake nothing, but reads must re-ts
             self._read_ts_override = as_of_ts
         if getattr(stmt, "for_update", False):
+            is_outer = False  # locking reads are txn-state-dependent
             self._lock_select_rows(stmt)
             if self._explicit and self._txn is not None and self._txn.pessimistic:
                 # locking read returns latest committed values (current read)
@@ -922,7 +1098,7 @@ class Session:
         self._deadline = (time.monotonic() + min(limits)) if limits else None
         try:
             with self.span("plan"):
-                plan = self._plan_select(stmt, cache_key=cache_key)
+                plan = self._plan_select(stmt, cache_key=cache_key, capture=is_outer)
             from tidb_tpu.executor import build_executor
 
             from tidb_tpu.parallel.probe import MPPRetryExhausted
@@ -937,9 +1113,14 @@ class Session:
                 # back rather than failing the statement)
                 prev = self.vars.get("tidb_allow_mpp", 1)
                 self.vars["tidb_allow_mpp"] = 0
+                # on the cached-plan prepared lane `stmt` still carries its
+                # parameter markers — rebind before re-planning
+                replan_stmt = stmt
+                if is_outer and cap.get("cached_plan") is not None and cap.get("rebind") is not None:
+                    replan_stmt = cap["rebind"]()
                 try:
                     with self.span("mpp-fallback"):
-                        plan = self._plan_select(stmt, cache_key=None)
+                        plan = self._plan_select(replan_stmt, cache_key=None)
                         ex = build_executor(plan, self)
                         chunk = ex.execute()
                 finally:
@@ -1050,7 +1231,16 @@ class Session:
             self.vars.get("tidb_opt_fused_rollup"),
         )
 
-    def _plan_select(self, stmt, cache_key=None):
+    def _plan_select(self, stmt, cache_key=None, capture=False):
+        from tidb_tpu.utils import metrics as _m
+
+        # value-agnostic prepared lane, hit side: the template's plan was
+        # already re-pointed at this execution's parameters (prepcache.rebind)
+        cap = self._prep_capture if capture else None
+        if cap is not None and cap.get("cached_plan") is not None:
+            _m.PLAN_CACHE.inc(result="hit")
+            self.vars["last_plan_from_cache"] = 1
+            return cap["cached_plan"]
         # session LRU plan cache (ref: core/plan_cache_lru.go); FOR UPDATE
         # and WITH queries never cache (txn-state/plan-time-dependent)
         key = None
@@ -1062,9 +1252,13 @@ class Session:
             key = self._plan_cache_key(cache_key)
             hit = self._plan_cache.get(key)
             if hit is not None:
+                _m.PLAN_CACHE.inc(result="hit")
                 self._plan_cache.move_to_end(key)
                 self.vars["last_plan_from_cache"] = 1
                 return hit
+            _m.PLAN_CACHE.inc(result="miss")
+        elif cap is not None:
+            _m.PLAN_CACHE.inc(result="miss")
         self.vars["last_plan_from_cache"] = 0
 
         from tidb_tpu.planner.cte import expand_ctes
@@ -1105,9 +1299,21 @@ class Session:
         plan = try_mpp_rewrite(plan, self.vars, stats=self._db.stats, store=self.store)
         if key is not None and not builder.uncacheable:
             self._plan_cache[key] = plan
-            cap = sysvar_int(self.vars, "tidb_prepared_plan_cache_size", 100)
-            while len(self._plan_cache) > cap:
+            cap_n = sysvar_int(self.vars, "tidb_prepared_plan_cache_size", 100)
+            while len(self._plan_cache) > cap_n:
                 self._plan_cache.popitem(last=False)
+        if (
+            cap is not None
+            and not builder.uncacheable
+            and not getattr(stmt, "for_update", False)
+        ):
+            # value-agnostic prepared lane, miss side: try to template the
+            # finished plan for parameter-independent reuse
+            from tidb_tpu.planner import prepcache
+
+            tmpl = prepcache.make_template(plan, cap.get("n_params", 0))
+            if tmpl is not None:
+                cap["template"] = tmpl
         return plan
 
     def _run_select_ast(self, stmt) -> list[tuple]:
@@ -1488,6 +1694,14 @@ class Session:
         if n:
             self._pending_mods[table_id] = self._pending_mods.get(table_id, 0) + n
 
+    def _note_bindings_changed(self, is_global: bool) -> None:
+        """Binding create/drop invalidates the statement fast lane (cached
+        ASTs bake the binding substitution that matched at cache time)."""
+        if is_global:
+            self._db.bindings_ver += 1
+        else:
+            self.bindings_ver += 1
+
 
 class DB:
     """Embedded database handle (testkit.CreateMockStore analog). With
@@ -1542,6 +1756,9 @@ class DB:
         # global SQL plan bindings: digest → (for_text, using_text)
         # (ref: pkg/bindinfo binding_handle)
         self.bindings: dict[str, tuple[str, str]] = {}
+        # bumped on global CREATE/DROP BINDING — every session's statement
+        # fast lane re-checks bindings past this version
+        self.bindings_ver = 0
         # privilege state: grant tables bootstrap lazily (first auth/grant);
         # the cache keys on priv_version (ref: privilege reload notification)
         self.priv_version = 0

@@ -124,7 +124,13 @@ class Backoffer:
         sleep: Callable[[float], None] = time.sleep,
     ):
         self.budget_ms = budget_ms
-        self._rng = random.Random(seed)
+        # RNG construction is LAZY: one Backoffer travels with every cop
+        # request, and seeding a Mersenne state per request was measurable
+        # on the warm query path — a request that never backs off never pays
+        # it. Determinism is unchanged: Random(seed) built at first backoff
+        # replays the same jitter stream as one built here.
+        self._seed = seed
+        self._rng: Optional[random.Random] = None
         self._sleep = sleep
         self._mu = threading.Lock()
         self._attempts: dict[str, int] = {}
@@ -163,6 +169,8 @@ class Backoffer:
         with self._mu:
             if err is not None and len(self._errors) < 16:
                 self._errors.append(err)
+            if self._rng is None:
+                self._rng = random.Random(self._seed)
             n = self._attempts.get(config.name, 0)
             raw = min(config.cap_ms, config.base_ms * (2 ** n))
             if config.jitter == "equal":

@@ -148,6 +148,12 @@ QUERY_DURATION = REGISTRY.histogram(
     "tidb_tpu_server_handle_query_duration_seconds", "Statement latency"
 )
 COP_TASKS = REGISTRY.counter("tidb_tpu_copr_task_total", "Coprocessor tasks", ("engine",))
+# session plan reuse (statement fast lane + value-agnostic prepared plans)
+PLAN_CACHE = REGISTRY.counter(
+    "tidb_tpu_session_plan_cache_total",
+    "Plan-cache lookups by outcome (hit = parser/builder/optimizer skipped)",
+    ("result",),
+)
 # resilience layer (utils/backoff.py + the retrying seams; see RESILIENCE.md)
 BACKOFF_TOTAL = REGISTRY.counter(
     "tidb_tpu_backoff_total", "Backoffer sleeps by typed config", ("config",)

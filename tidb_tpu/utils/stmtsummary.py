@@ -10,9 +10,31 @@ from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 
 
+# digest memo: normalizing re-tokenizes the whole statement (a full lexer
+# pass — as costly as a parse), and the hot path needs it per statement for
+# stmt-summary/bindings/Top-SQL; warm statements take a dict hit instead
+_DIGEST_MEMO: "OrderedDict[str, str]" = OrderedDict()
+_DIGEST_MEMO_CAP = 512
+_DIGEST_MU = threading.Lock()
+
+
 def digest(sql: str) -> str:
     """Normalized SQL digest: literals → '?', whitespace folded, lowercased
-    keywords (ref: parser/digester.go)."""
+    keywords (ref: parser/digester.go). Memoized per statement text."""
+    with _DIGEST_MU:
+        hit = _DIGEST_MEMO.get(sql)
+        if hit is not None:
+            _DIGEST_MEMO.move_to_end(sql)
+            return hit
+    d = _digest_uncached(sql)
+    with _DIGEST_MU:
+        _DIGEST_MEMO[sql] = d
+        while len(_DIGEST_MEMO) > _DIGEST_MEMO_CAP:
+            _DIGEST_MEMO.popitem(last=False)
+    return d
+
+
+def _digest_uncached(sql: str) -> str:
     import hashlib
 
     from tidb_tpu.parser.lexer import tokenize
@@ -58,8 +80,18 @@ class StmtSummary:
         # slow log ring: (time, sql, latency_s, rows, user)
         self._slow: deque = deque(maxlen=slow_capacity)
 
-    def record(self, sql: str, latency_s: float, rows: int, user: str, slow_threshold_s: float) -> None:
-        d = digest(sql)
+    def record(
+        self,
+        sql: str,
+        latency_s: float,
+        rows: int,
+        user: str,
+        slow_threshold_s: float,
+        digest_val: "str | None" = None,
+    ) -> None:
+        # the session computes one digest per statement and threads it here
+        # (plus Top-SQL/bindings) instead of re-normalizing per consumer
+        d = digest_val if digest_val is not None else digest(sql)
         with self._mu:
             st = self._stats.get(d)
             if st is None:
