@@ -1,0 +1,234 @@
+"""End-to-end distributed query observability (ref: util/execdetails +
+Dapper-style trace propagation): cop tasks against a remote/sharded store
+ship ExecDetails sidecars home in every response — EXPLAIN ANALYZE renders a
+TiDB-style ``cop_task: {...}`` execution-info line from them, TRACE shows
+spans the remote StoreServer recorded under the propagated trace context,
+and the slow log / statements_summary surface the structured fields."""
+
+import re
+import threading
+
+import pytest
+
+import tidb_tpu
+from tidb_tpu.kv.memstore import MemStore
+from tidb_tpu.kv.remote import StoreServer
+from tidb_tpu.session.session import open_db
+
+COP_LINE = re.compile(
+    r"cop_task: \{num: (\d+), max: ([\d.]+)ms, avg: ([\d.]+)ms, "
+    r"p95: ([\d.]+)ms, engine: ([^,}]+), backoff: (\d+)ms, resplits: (\d+)"
+)
+
+
+@pytest.fixture(scope="module")
+def remote_db():
+    """A SQL-layer process over an (in-process) StoreServer, with the table
+    split across multiple regions so every query fans out real cop tasks."""
+    store = MemStore(region_split_keys=100)
+    srv = StoreServer(store)
+    port = srv.start()
+    db = open_db(remote=f"127.0.0.1:{port}")
+    s = db.session()
+    s.execute("SET tidb_isolation_read_engines = 'host'")
+    s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, g BIGINT, v BIGINT)")
+    s.execute("INSERT INTO t VALUES " + ", ".join(f"({i}, {i % 5}, {i * 3})" for i in range(400)))
+    assert len(store.regions()) >= 2, "fixture must span multiple regions"
+    yield db, s, f"127.0.0.1:{port}"
+    srv.shutdown()
+
+
+def test_explain_analyze_cop_task_line_remote(remote_db):
+    """The acceptance shape: EXPLAIN ANALYZE on a multi-region query against
+    a remote store renders a cop_task line with task count, proc-time stats,
+    engine mix, and backoff — all sourced from wire-shipped sidecars."""
+    db, s, addr = remote_db
+    rows = s.execute("EXPLAIN ANALYZE SELECT g, COUNT(*), SUM(v) FROM t GROUP BY g").rows
+    text = "\n".join(r[0] for r in rows)
+    m = COP_LINE.search(text)
+    assert m, text
+    assert int(m.group(1)) >= 2  # one sidecar per region task
+    assert float(m.group(2)) >= float(m.group(3)) > 0.0  # max >= avg > 0
+    assert "host×" in m.group(5)  # engine mix
+    # the line lands on the reader node that owns the pushed-down executors
+    reader_line = next(r[0] for r in rows if "PhysTableReader" in r[0])
+    assert "cop_task:" in reader_line
+
+
+def test_trace_shows_remote_recorded_spans(remote_db):
+    """TRACE: the trace context propagates inside the cop RPC, the server
+    records real spans, and they come home tagged with the store address."""
+    db, s, addr = remote_db
+    res = s.execute("TRACE SELECT g, COUNT(*) FROM t GROUP BY g")
+    labels = [r[0] for r in res.rows]
+    assert any(f"@{addr}" in l for l in labels), labels  # remote-recorded span
+    assert any("cop-rpc.r" in l for l in labels), labels  # client RPC span
+    # remote spans nest UNDER their RPC span (depth = indentation)
+    rpc = next(l for l in labels if "cop-rpc.r" in l)
+    rem = next(l for l in labels if f"@{addr}" in l)
+    assert len(rem) - len(rem.lstrip()) > len(rpc) - len(rpc.lstrip())
+    assert all(len(r) == 3 for r in res.rows)
+    assert s.tracer is None  # tracing turned itself off
+    # and tracing leaves no residue on the next (untraced) statement
+    assert s.query("SELECT COUNT(*) FROM t") == [(400,)]
+
+
+def test_slow_log_structured_fields(remote_db):
+    db, s, addr = remote_db
+    s.execute("SET tidb_slow_log_threshold = 0")
+    s.query("SELECT SUM(v) FROM t WHERE g < 4")
+    s.execute("SET tidb_slow_log_threshold = 300")
+    rows = s.query(
+        "SELECT digest, plan_digest, cop_tasks, max_task_store, backoff_time, cop_summary "
+        "FROM information_schema.slow_query WHERE query LIKE '%WHERE g < 4%'"
+    )
+    assert rows, "slow query did not land in the ring"
+    d, pd, n_tasks, store, backoff, summary = rows[-1]
+    assert d and pd, (d, pd)
+    assert n_tasks >= 2
+    assert store == addr  # the max-proc task names the remote store
+    assert backoff >= 0.0
+    assert summary.startswith("cop_task: {")
+
+
+def test_statements_summary_exec_columns(remote_db):
+    db, s, addr = remote_db
+    for _ in range(2):
+        s.query("SELECT COUNT(*) FROM t WHERE g = 1")
+    rows = s.query(
+        "SELECT plan_digest, sum_cop_tasks, sum_backoff FROM "
+        "information_schema.statements_summary WHERE digest_text LIKE '%where g =%'"
+    )
+    assert rows
+    pd, n_tasks, backoff = rows[0]
+    assert pd != ""
+    assert n_tasks >= 4  # 2 executions × ≥2 region tasks
+    assert backoff >= 0.0
+
+
+def test_slowlog_status_endpoint(remote_db):
+    import json
+    import urllib.request
+
+    from tidb_tpu.server.status import StatusServer
+
+    db, s, addr = remote_db
+    s.execute("SET tidb_slow_log_threshold = 0")
+    s.query("SELECT MAX(v) FROM t")
+    s.execute("SET tidb_slow_log_threshold = 300")
+    st = StatusServer(db)
+    port = st.start()
+    try:
+        data = json.loads(
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/slowlog", timeout=10).read()
+        )
+        assert isinstance(data, list) and data
+        rec = next(r for r in data if "MAX(v)" in r["query"])
+        assert rec["cop_tasks"] >= 2
+        assert rec["max_task_store"] == addr
+        assert {"digest", "plan_digest", "backoff_ms", "cop_summary"} <= set(rec)
+    finally:
+        st.close()
+
+
+def test_explain_analyze_cop_line_embedded():
+    """The same pipeline with an embedded store: sidecars are collected
+    locally (no wire), same render."""
+    db = tidb_tpu.open(region_split_keys=100)
+    s = db.session()
+    s.execute("SET tidb_isolation_read_engines = 'host'")
+    s.execute("CREATE TABLE e (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("INSERT INTO e VALUES " + ", ".join(f"({i}, {i})" for i in range(300)))
+    rows = s.execute("EXPLAIN ANALYZE SELECT COUNT(*) FROM e").rows
+    text = "\n".join(r[0] for r in rows)
+    m = COP_LINE.search(text)
+    assert m, text
+    assert int(m.group(1)) >= 2
+    assert m.group(5).strip() == f"host×{m.group(1)}"
+
+
+def test_sidecar_records_resplit_backoff_and_degrade():
+    """Injected chaos shows up IN the sidecars: a one-shot region-epoch
+    change produces resplits>0 + backoff>0 in the statement's sidecar
+    aggregate — chaos becomes visible per query, not just per process."""
+    from tidb_tpu.kv.kv import RegionError
+    from tidb_tpu.kv.fault_injection import NShot
+    from tidb_tpu.utils import failpoint
+
+    db = tidb_tpu.open(region_split_keys=100)
+    s = db.session()
+    s.execute("SET tidb_isolation_read_engines = 'host'")
+    s.execute("CREATE TABLE c (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("INSERT INTO c VALUES " + ", ".join(f"({i}, {i})" for i in range(300)))
+    s.query("SELECT COUNT(*) FROM c")  # warm caches
+
+    def _miss(rid, st):
+        raise RegionError(rid, f"region {rid} epoch changed (chaos)")
+
+    shot = NShot(_miss, n_times=1)
+    with failpoint.enabled("cop_task_engine", shot):
+        assert s.query("SELECT COUNT(*) FROM c") == [(300,)]
+    assert shot.fired == 1
+    ed = s.exec_summary
+    assert ed is not None and ed.resplits >= 1 and ed.backoff_ms > 0.0
+    assert ed.retries >= 1
+
+
+def test_tracer_thread_safety_and_deterministic_rows():
+    """Satellite: concurrent cop-pool workers share one statement Tracer —
+    no lost/corrupted spans, per-thread depth, deterministic rows() order."""
+    from tidb_tpu.utils.tracing import Tracer
+
+    tr = Tracer()
+    with tr.span("root") as root:
+        def worker(i):
+            for k in range(50):
+                with tr.span(f"w{i}.{k}", parent=root):
+                    with tr.span(f"inner{i}.{k}"):
+                        pass
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert len(tr.spans) == 1 + 8 * 50 * 2  # nothing lost under contention
+    by_name = {sp.name: sp for sp in tr.spans}
+    assert by_name["root"].depth == 0
+    assert by_name["w3.7"].depth == 1  # cross-thread parent honored
+    assert by_name["inner3.7"].depth == 2  # per-thread nesting below it
+    rows = tr.rows()
+    assert len(rows) == len(tr.spans)
+    assert rows == tr.rows()  # deterministic: stable (start, seq) order
+    # every span carries complete timing
+    assert all(sp.duration_s >= 0.0 for sp in tr.spans)
+
+
+def test_mpp_gather_exec_info_line():
+    """MPP gather nodes get the analogous mpp_task execution-info line."""
+    import numpy as np
+
+    from tidb_tpu.executor.load import bulk_load
+
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE mo (k BIGINT PRIMARY KEY, d BIGINT)")
+    db.execute("CREATE TABLE ml (k BIGINT, p BIGINT)")
+    rng = np.random.default_rng(7)
+    n_o, n_l = 500, 5000
+    bulk_load(db, "mo", [np.arange(n_o, dtype=np.int64), rng.integers(0, 30, n_o)])
+    bulk_load(db, "ml", [rng.integers(0, n_o, n_l), rng.integers(1, 100, n_l)])
+    s = db.session()
+    s.execute("ANALYZE TABLE mo")
+    s.execute("ANALYZE TABLE ml")
+    s.execute("SET tidb_enforce_mpp = 1")
+    q = "SELECT d, SUM(p) FROM ml, mo WHERE ml.k = mo.k GROUP BY d"
+    rows = s.execute("EXPLAIN ANALYZE " + q).rows
+    text = "\n".join(r[0] for r in rows)
+    if "PhysMPPGather" not in text:
+        pytest.skip("planner did not choose MPP on this host")
+    m = re.search(r"mpp_task: \{fragments: (\d+), ndev: (\d+), wall: ([\d.]+)ms, rows: (\d+)", text)
+    assert m, text
+    assert int(m.group(1)) >= 2 and int(m.group(2)) >= 1
+    # and the always-on statement aggregate saw it too
+    s.query(q)
+    assert s.mpp_details and s.mpp_details[0].ndev >= 1

@@ -1,0 +1,125 @@
+"""Direct tests for server/status.py (previously untested): /metrics renders
+parseable Prometheus exposition text under concurrent writes, /slowlog and
+/topsql return valid JSON, unknown paths 404 — plus the label-value escaping
+fix in utils/metrics.py."""
+
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+import tidb_tpu
+from tidb_tpu.server.status import StatusServer
+
+_SAMPLE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? (\S+)$")
+
+
+def _assert_exposition(body: str) -> int:
+    """Every non-comment line must be `name[{labels}] value` with a float
+    value — the exposition-format invariant scrapers depend on."""
+    n = 0
+    for line in body.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        float(m.group(3))
+        n += 1
+    return n
+
+
+def test_metrics_parseable_under_concurrent_writes():
+    from tidb_tpu.utils.metrics import REGISTRY
+
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE m (id BIGINT PRIMARY KEY)")
+    st = StatusServer(db)
+    port = st.start()
+    c = REGISTRY.counter("test_concurrent_writes_total", "scratch", ("k",))
+    h = REGISTRY.histogram("test_concurrent_writes_seconds", "scratch")
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            c.inc(k=f"v{i % 7}")
+            h.observe((i % 100) / 1000.0)
+            i += 1
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(10):
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ).read().decode()
+            assert _assert_exposition(body) > 0
+            assert "test_concurrent_writes_total" in body
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        st.close()
+
+
+def test_label_values_escaped_in_exposition():
+    """Satellite fix: quotes/backslashes/newlines in label values (e.g. a
+    degrade-reason carrying a quoted error message) must not emit invalid
+    exposition text."""
+    from tidb_tpu.utils.metrics import Counter, Gauge
+
+    c = Counter("esc_total", "scratch", ("reason",))
+    c.inc(reason='bad "quote" back\\slash new\nline')
+    body = c.render()
+    lines = [l for l in body.splitlines() if not l.startswith("#")]
+    assert len(lines) == 1  # the newline was escaped, not emitted
+    assert _SAMPLE.match(lines[0]), lines[0]
+    assert '\\"' in lines[0] and "\\\\" in lines[0] and "\\n" in lines[0]
+    g = Gauge("esc_gauge", "scratch", ("k",))
+    g.set(1.0, k='x"y\nz')
+    glines = [l for l in g.render().splitlines() if not l.startswith("#")]
+    assert len(glines) == 1 and _SAMPLE.match(glines[0]), glines
+
+
+def test_slowlog_and_topsql_return_valid_json():
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE s1 (id BIGINT PRIMARY KEY, v BIGINT)")
+    db.execute("INSERT INTO s1 VALUES (1, 2), (2, 4)")
+    s = db.session()
+    s.execute("SET tidb_slow_log_threshold = 0")
+    s.query("SELECT SUM(v) FROM s1")
+    s.execute("SET tidb_slow_log_threshold = 300")
+    st = StatusServer(db)
+    port = st.start()
+    try:
+        slow = json.loads(
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/slowlog", timeout=10).read()
+        )
+        assert isinstance(slow, list) and slow
+        assert {"query", "query_time", "digest", "plan_digest", "cop_tasks"} <= set(slow[0])
+        top = json.loads(
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/topsql", timeout=10).read()
+        )
+        assert isinstance(top, list)  # may be empty: top-sql is off by default
+        el = json.loads(
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/election", timeout=10).read()
+        )
+        assert isinstance(el, dict)
+    finally:
+        st.close()
+
+
+def test_unknown_path_404():
+    db = tidb_tpu.open()
+    st = StatusServer(db)
+    port = st.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/definitely-not-a-path", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        st.close()

@@ -146,13 +146,9 @@ def bench_q3_join_mpp() -> float:
     return best
 
 
-@register("fixed_overhead_ms")
-def bench_fixed_overhead() -> float:
-    """Warm COUNT(*) end-to-end latency (ms, lower is better): near-zero
-    engine compute, so this IS the per-query SQL-layer tax — parse, plan,
-    dispatch, accounting. The statement fast lane (parse/plan reuse, shared
-    cop pool, memoized digest) exists to drive this down; the guard keeps
-    later PRs from quietly re-adding fixed cost."""
+def _warm_count_best(table: str, region_split_keys: "int | None" = None) -> float:
+    """Best-of-30 warm ``SELECT COUNT(*)`` latency over a fresh 10k-row
+    table — the shared harness of the two fixed-cost lanes below."""
     import time as _t
 
     import numpy as np
@@ -160,12 +156,12 @@ def bench_fixed_overhead() -> float:
     import tidb_tpu
     from tidb_tpu.executor.load import bulk_load
 
-    db = tidb_tpu.open()
-    db.execute("CREATE TABLE fo (id BIGINT PRIMARY KEY, v BIGINT)")
+    db = tidb_tpu.open(**({"region_split_keys": region_split_keys} if region_split_keys else {}))
+    db.execute(f"CREATE TABLE {table} (id BIGINT PRIMARY KEY, v BIGINT)")
     n = 10_000
-    bulk_load(db, "fo", [np.arange(n, dtype=np.int64), np.arange(n, dtype=np.int64)])
+    bulk_load(db, table, [np.arange(n, dtype=np.int64), np.arange(n, dtype=np.int64)])
     s = db.session()
-    q = "SELECT COUNT(*) FROM fo"
+    q = f"SELECT COUNT(*) FROM {table}"
     s.query(q)
     s.query(q)  # warm: statement + plan + engine caches
     best = float("inf")
@@ -174,6 +170,28 @@ def bench_fixed_overhead() -> float:
         s.query(q)
         best = min(best, (_t.perf_counter() - t0) * 1000)
     return best
+
+
+@register("fixed_overhead_ms")
+def bench_fixed_overhead() -> float:
+    """Warm COUNT(*) end-to-end latency (ms, lower is better): near-zero
+    engine compute, so this IS the per-query SQL-layer tax — parse, plan,
+    dispatch, accounting. The statement fast lane (parse/plan reuse, shared
+    cop pool, memoized digest) exists to drive this down; the guard keeps
+    later PRs from quietly re-adding fixed cost."""
+    return _warm_count_best("fo")
+
+
+@register("trace_off_overhead_ms")
+def bench_trace_off_overhead() -> float:
+    """Warm MULTI-REGION COUNT(*) with tracing disabled (ms, lower is
+    better): the exec-details sidecar pipeline rides every cop task even
+    when TRACE is off, so this lane times exactly the path instrumentation
+    could re-tax — several region tasks per statement, sidecar allocation +
+    aggregation included, spans strictly absent. Guarded next to PR 3's
+    ``fixed_overhead_ms`` (single-region) under the same --check gate, so
+    observability can never quietly re-add fixed cost to the hot path."""
+    return _warm_count_best("tof", region_split_keys=2000)
 
 
 @register("qps_point_select")

@@ -148,12 +148,17 @@ def _variables(db, session):
 def _statements_summary(db, session):
     from tidb_tpu.types.field_type import double_type
 
-    cols = ["DIGEST", "DIGEST_TEXT", "EXEC_COUNT", "SUM_LATENCY", "MAX_LATENCY", "AVG_LATENCY", "SUM_ROWS", "QUERY_SAMPLE_TEXT"]
-    fts = [_S(80), _S(256), _I(), double_type(), double_type(), double_type(), _I(), _S(256)]
+    cols = ["DIGEST", "DIGEST_TEXT", "EXEC_COUNT", "SUM_LATENCY", "MAX_LATENCY",
+            "AVG_LATENCY", "SUM_ROWS", "QUERY_SAMPLE_TEXT", "PLAN_DIGEST",
+            "SUM_COP_TASKS", "SUM_BACKOFF"]
+    fts = [_S(80), _S(256), _I(), double_type(), double_type(), double_type(),
+           _I(), _S(256), _S(80), _I(), double_type()]
     rows = []
     for st in db.stmt_summary.stats():
         d, _, norm = st.digest.partition("|")
-        rows.append((d, norm, st.exec_count, st.sum_latency, st.max_latency, st.avg_latency, st.sum_rows, st.sample))
+        rows.append((d, norm, st.exec_count, st.sum_latency, st.max_latency,
+                     st.avg_latency, st.sum_rows, st.sample, st.plan_digest,
+                     st.sum_cop_tasks, st.sum_backoff))
     return cols, fts, rows
 
 
@@ -169,11 +174,23 @@ def _top_sql(db, session):
 
 
 def _slow_query(db, session):
+    """The slow log ring with its structured exec-detail fields (ref: the
+    slow query log's Plan_digest/Cop_time/Backoff_time columns, fed from the
+    wire-shipped cop-task sidecars)."""
     from tidb_tpu.types.field_type import double_type
 
-    cols = ["TIME", "QUERY", "QUERY_TIME", "RESULT_ROWS", "USER"]
-    fts = [double_type(), _S(512), double_type(), _I(), _S()]
-    return cols, fts, [tuple(r) for r in db.stmt_summary.slow_queries()]
+    cols = ["TIME", "QUERY", "QUERY_TIME", "RESULT_ROWS", "USER", "DIGEST",
+            "PLAN_DIGEST", "COP_TASKS", "COP_PROC_MAX", "BACKOFF_TIME",
+            "RESPLITS", "MAX_TASK_STORE", "COP_SUMMARY"]
+    fts = [double_type(), _S(512), double_type(), _I(), _S(), _S(80), _S(80),
+           _I(), double_type(), double_type(), _I(), _S(64), _S(256)]
+    rows = [
+        (e.time, e.sql, e.latency_s, e.rows, e.user, e.digest, e.plan_digest,
+         e.cop_tasks, e.cop_proc_max_ms / 1000.0, e.backoff_ms / 1000.0,
+         e.resplits, e.max_task_store, e.cop_summary)
+        for e in db.stmt_summary.slow_queries()
+    ]
+    return cols, fts, rows
 
 
 def _resource_groups(db, session):

@@ -15,8 +15,10 @@ so a single request cannot monopolize the shared workers.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Callable, Iterator, Optional
@@ -24,6 +26,7 @@ from typing import Callable, Iterator, Optional
 from tidb_tpu.copr import dagpb
 from tidb_tpu.kv.kv import KeyRange, KVError, RegionError, Request, RequestType, StoreType
 from tidb_tpu.kv.memstore import MemStore, Region
+from tidb_tpu.utils import execdetails as _ed
 from tidb_tpu.utils import failpoint
 from tidb_tpu.utils.backoff import Backoffer, BackoffExhausted, boRegionMiss
 from tidb_tpu.utils.chunk import Chunk
@@ -149,6 +152,9 @@ class CopResult:
     chunk: Chunk
     task_id: int
     region_id: int
+    # the task's ExecDetails sidecar (utils/execdetails.CopExecDetails);
+    # always collected — EXPLAIN ANALYZE / slow log aggregate it
+    details: object = None
 
 
 def run_task_resilient(
@@ -163,6 +169,7 @@ def run_task_resilient(
     degrade_reason: str,
     degrade_on: tuple,
     never_degrade: tuple = (),
+    detail=None,
 ) -> Chunk:
     """One cop task under the request's Backoffer — the single region-error /
     degrade policy shared by the embedded and remote cop clients.
@@ -182,9 +189,15 @@ def run_task_resilient(
             return run_one(st, region2, ranges2)
         except RegionError as e:
             try:
-                bo.backoff(boRegionMiss, e)
+                slept = bo.backoff(boRegionMiss, e)
             except BackoffExhausted as be:
                 raise (be.last or e) from be
+            if detail is not None:
+                # sidecar attribution: the task's OWN sleeps/re-splits, never
+                # the shared Backoffer's (other workers charge it too)
+                detail.retries += 1
+                detail.backoff_ms += slept
+                detail.resplits += 1
             parts = [attempt(st, r2, k2) for r2, k2 in resplit(ranges2)]
             if not parts:
                 # routing no longer covers these ranges at all (dropped
@@ -210,6 +223,8 @@ def run_task_resilient(
         from tidb_tpu.utils import metrics as _m
 
         _m.COP_DEGRADED.inc(reason=degrade_reason)
+        if detail is not None:
+            detail.degraded = f"{degrade_reason}:{type(e).__name__}"
         return attempt(StoreType.HOST, region, ranges)
 
 
@@ -267,24 +282,42 @@ class CopClient:
 
         from tidb_tpu.utils.memory import QueryKilledError, QueryOOMError
 
+        # sidecar timing baseline + cross-thread span parent, captured in
+        # the requesting thread (queue wait = submit → worker pickup)
+        t_submit = time.perf_counter()
+        tracer = req.tracer
+        parent_span = tracer.current() if tracer is not None else None
+
         def run(task: CopTask) -> CopResult:
-            chunk = run_task_resilient(
-                bo,
-                run_engine,
-                self.store.pd.regions_in_ranges,
-                task.region,
-                task.ranges,
-                req.store_type,
-                warn=req.warn,
-                degrade_reason="embedded",
-                # RuntimeError is the device-failure shape (XlaRuntimeError
-                # subclasses it); anything broader would silently mask TPU
-                # engine BUGS behind a correct host answer
-                degrade_on=(RuntimeError,),
-                # data/txn verdicts and kills: degrading engines would not help
-                never_degrade=(KVError, QueryKilledError, QueryOOMError),
+            det = _ed.CopExecDetails(task.region.region_id)
+            det.queue_ms = (time.perf_counter() - t_submit) * 1000.0
+            span = (
+                tracer.span(f"cop.r{task.region.region_id}", parent=parent_span)
+                if tracer is not None
+                else contextlib.nullcontext()
             )
-            return CopResult(chunk, task.task_id, task.region.region_id)
+            t0 = time.perf_counter()
+            with span, _ed.collecting(det, tracer=tracer):
+                chunk = run_task_resilient(
+                    bo,
+                    run_engine,
+                    self.store.pd.regions_in_ranges,
+                    task.region,
+                    task.ranges,
+                    req.store_type,
+                    warn=req.warn,
+                    degrade_reason="embedded",
+                    # RuntimeError is the device-failure shape (XlaRuntimeError
+                    # subclasses it); anything broader would silently mask TPU
+                    # engine BUGS behind a correct host answer
+                    degrade_on=(RuntimeError,),
+                    # data/txn verdicts and kills: degrading engines would not help
+                    never_degrade=(KVError, QueryKilledError, QueryOOMError),
+                    detail=det,
+                )
+            # processing = task wall minus its own backoff sleeps
+            det.proc_ms = max((time.perf_counter() - t0) * 1000.0 - det.backoff_ms, 0.0)
+            return CopResult(chunk, task.task_id, task.region.region_id, det)
 
         if concurrency == 1 or len(tasks) == 1:
             def gen_serial():

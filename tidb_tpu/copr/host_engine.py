@@ -552,6 +552,26 @@ def run_operators(chunk: Chunk, executors: list, output_offsets: list[int], warn
 
 
 def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int, warn=None) -> Chunk:
+    from tidb_tpu.utils import execdetails as _ed
+
+    det = _ed.current_cop()
+    if det is None:
+        return _execute_dag(store, dag, region, ranges, read_ts, warn)
+    import time as _t
+
+    t0 = _t.perf_counter()
+    try:
+        with _ed.trace_span("host-exec"):
+            return _execute_dag(store, dag, region, ranges, read_ts, warn)
+    finally:
+        # host-engine attribution into the task's ExecDetails sidecar — runs
+        # for direct host tasks AND for TPU-engine shape fallbacks (which
+        # check this delta to cede the engine label)
+        det.host_ms += (_t.perf_counter() - t0) * 1000.0
+        det.engine = "host"
+
+
+def _execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int, warn=None) -> Chunk:
     assert dag.executors and dag.executors[0].tp in (dagpb.TABLE_SCAN, dagpb.INDEX_SCAN)
     if dag.executors[0].tp == dagpb.INDEX_SCAN:
         chunk = _index_scan(store, region, dag.executors[0], ranges, read_ts)

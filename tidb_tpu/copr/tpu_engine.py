@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time as _time
 from collections import OrderedDict
 
 import numpy as np
@@ -47,6 +48,8 @@ from tidb_tpu.kv.rowcodec import RowSchema
 from tidb_tpu.ops.dag_kernel import MAX_RANGES, get_kernel
 from tidb_tpu.types import FieldType, TypeKind
 from tidb_tpu.types.field_type import bigint_type
+from tidb_tpu.utils import execdetails as _ed
+from tidb_tpu.utils import metrics as _metrics
 from tidb_tpu.utils.chunk import Chunk, Column, bucket_size
 
 from tidb_tpu.ops.dag_kernel import _ensure_x64
@@ -169,9 +172,13 @@ def _device_put_col(key, make_pair, n_pad: int, cacheable: bool = True):
     import jax
     import jax.numpy as jnp
 
+    det = _ed.current_cop()
     if cacheable:
         hit = _DEVICE_LRU.get(key)
         if hit is not None:
+            if det is not None:
+                det.dev_cache_hits += 1
+            _metrics.DEVICE_CACHE.inc(result="hit")
             return hit
     data, valid = make_pair()
     pd = np.zeros(n_pad, dtype=data.dtype)
@@ -179,6 +186,11 @@ def _device_put_col(key, make_pair, n_pad: int, cacheable: bool = True):
     pv = np.zeros(n_pad, dtype=bool)
     pv[: len(valid)] = valid
     out = (jax.device_put(jnp.asarray(pd)), jax.device_put(jnp.asarray(pv)))
+    if det is not None:
+        det.dev_cache_misses += 1
+        det.h2d_bytes += pd.nbytes + pv.nbytes
+    _metrics.DEVICE_CACHE.inc(result="miss")
+    _metrics.DEVICE_TRANSFER.inc(pd.nbytes + pv.nbytes, dir="h2d")
     if cacheable:
         # key layout: (store_nonce, region_id, table_id, slot, data_version,
         # epoch, ...shape/block suffix)
@@ -313,13 +325,34 @@ def _emit_kernel_warnings(buf, kernel, warn) -> None:
 
 
 def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int, warn=None):
+    det = _ed.current_cop()
+    if det is None:
+        try:
+            return _execute_dag_device(store, dag, region, ranges, read_ts, warn)
+        except UnsupportedForDevice:
+            # the planner's legality gate keeps most host-only shapes off this
+            # engine; anything it misses (unbindable constants, unpackable
+            # window sorts) falls back to the host engine
+            return host_execute_dag(store, dag, region, ranges, read_ts, warn)
+    t0 = _time.perf_counter()
+    h0 = det.host_ms
     try:
-        return _execute_dag_device(store, dag, region, ranges, read_ts, warn)
-    except UnsupportedForDevice:
-        # the planner's legality gate keeps most host-only shapes off this
-        # engine; anything it misses (unbindable constants, unpackable window
-        # sorts) falls back to the host engine — the TiKV-serves-it role
-        return host_execute_dag(store, dag, region, ranges, read_ts, warn)
+        try:
+            with _ed.trace_span("device-exec"):
+                return _execute_dag_device(store, dag, region, ranges, read_ts, warn)
+        except UnsupportedForDevice:
+            det.degraded = det.degraded or "unsupported-for-device"
+            return host_execute_dag(store, dag, region, ranges, read_ts, warn)
+    finally:
+        # device-time attribution: wall of the device path, unless the task
+        # (or a shape fallback inside _execute_dag_device) ran on the host
+        # engine — which attributed itself and claimed the engine label
+        host_delta = det.host_ms - h0
+        if host_delta <= 0.0:
+            dev_ms = (_time.perf_counter() - t0) * 1000.0
+            det.device_ms += dev_ms
+            det.engine = "tpu"
+            _metrics.COP_DEVICE_SECONDS.observe(dev_ms / 1000.0)
 
 
 def _execute_dag_device(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int, warn=None):
@@ -595,6 +628,11 @@ def _concat_chunks(chunks: list[Chunk]) -> Chunk:
 
 def _chunk_from_bufs(buf, fbuf, count: int, kernel, dag, cache, scan) -> Chunk:
     """Packed kernel buffers → Chunk (trim to count, re-attach dictionaries)."""
+    det = _ed.current_cop()
+    if det is not None:
+        nb = int(getattr(buf, "nbytes", 0)) + (int(getattr(fbuf, "nbytes", 0)) if fbuf is not None else 0)
+        det.d2h_bytes += nb
+        _metrics.DEVICE_TRANSFER.inc(nb, dir="d2h")
     outs = []
     for (which, idx), vidx in zip(kernel.lane_loc, kernel.valid_loc):
         data = fbuf[idx] if which == "f" else buf[idx]

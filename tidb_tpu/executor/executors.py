@@ -273,6 +273,7 @@ class TableReaderExec(Executor):
             or int(self.session.vars.get("tidb_distsql_scan_concurrency", 8)),
             keep_order=p.keep_order,
             warn=self.session.append_warning,
+            tracer=self.session.tracer,
         )
         client = self.session.store.get_client()
         # gather through a spillable container accounted against the query's
@@ -284,6 +285,11 @@ class TableReaderExec(Executor):
         try:
             for res in client.send(req):
                 self.session.check_killed()
+                # per-task ExecDetails sidecar → the statement aggregate
+                # (slow log / statements_summary) and, under EXPLAIN
+                # ANALYZE, this reader node's cop_task execution-info line
+                if res.details is not None:
+                    self.session.record_cop_detail(p, res.details)
                 rc.add(res.chunk)
             out = rc.to_chunk()
         finally:

@@ -59,6 +59,10 @@ class Request:
     # warnings (cast truncation, division by 0) travel back to the session
     # like the reference's per-SelectResponse warnings (tipb.SelectResponse)
     warn: Any = None
+    # the statement's live Tracer when TRACE is on (None = tracing off,
+    # strictly zero cost): cop clients open per-task spans under it, ship
+    # the trace context over the wire, and merge remote-recorded spans back
+    tracer: Any = None
 
 
 class Response(Protocol):

@@ -24,6 +24,7 @@ import json
 import socket
 import struct
 import threading
+import time
 from typing import Optional, Sequence
 
 from tidb_tpu.kv.kv import (
@@ -38,6 +39,7 @@ from tidb_tpu.kv.kv import (
     WriteConflictError,
 )
 from tidb_tpu.kv.memstore import OP_DEL, OP_PUT, Lock, MemStore, Mutation, Region
+from tidb_tpu.utils import execdetails as _ed
 from tidb_tpu.utils import failpoint
 from tidb_tpu.utils.backoff import Backoffer, BackoffExhausted, boRPC
 
@@ -393,17 +395,26 @@ class StoreServer:
         if cmd == "mpp_dispatch":
             # DispatchMPPTask analog (ref: kv/mpp.go:189): the gather spec
             # arrives as table ids + expression pbs; execution starts on a
-            # worker thread against the LOCAL store + mesh
-            task_id = self._mpp_mgr().dispatch(h["spec"], h["read_ts"])
+            # worker thread against the LOCAL store + mesh. An incoming
+            # trace context makes the task session record real spans that
+            # ship home with the result (Dapper-style propagation).
+            task_id = self._mpp_mgr().dispatch(h["spec"], h["read_ts"], trace=h.get("trace"))
             return {"task_id": task_id}, []
         if cmd == "mpp_conn":
             # EstablishMPPConns analog: long-poll for the merged result frame
-            done, blob, kind, msg, warns = self._mpp_mgr().conn(h["task_id"], h.get("wait_s", 1.0))
+            done, blob, kind, msg, warns, exec_pb, spans = self._mpp_mgr().conn(
+                h["task_id"], h.get("wait_s", 1.0)
+            )
             if not done:
                 return {"done": 0}, []
             if kind:
                 return {"done": 1, "err_kind": kind, "msg": msg}, []
-            return {"done": 1, "warnings": warns}, [blob]
+            reply = {"done": 1, "warnings": warns}
+            if exec_pb:
+                reply["exec"] = exec_pb
+            if spans:
+                reply["spans"] = spans
+            return reply, [blob]
         if cmd == "mpp_cancel":
             self._mpp_mgr().cancel(h["task_id"])
             return {"ok": 1}, []
@@ -424,11 +435,32 @@ class StoreServer:
             # engine warnings ride the response header, the per-
             # SelectResponse warning carriage of the reference (tipb)
             warns: list = []
-            chunk = engine(
-                st, dag, region, ranges, h["read_ts"],
-                warn=lambda lv, code, msg: len(warns) < 64 and warns.append([lv, code, msg]),
-            )
-            return {"ok": 1, "warnings": warns}, [encode_chunk(chunk)]
+            # ExecDetails sidecar (ref: tipb ExecDetails inside every cop
+            # response): store-side processing wall + the engines' device/
+            # host/compile/transfer attribution, shipped home in the header.
+            # A propagated trace context additionally opens REAL spans here
+            # that travel back for the caller to graft into its trace.
+            det = _ed.CopExecDetails(region_id=h["region_id"])
+            tracer = None
+            tctx = None
+            if h.get("trace"):
+                from tidb_tpu.utils.tracing import TraceContext, Tracer
+
+                tctx = TraceContext.from_pb(h["trace"])
+            if tctx is not None and tctx.sampled:
+                tracer = Tracer(trace_id=tctx.trace_id)
+            t0 = time.perf_counter()
+            with _ed.collecting(det, tracer=tracer):
+                with _ed.trace_span(f"cop.r{h['region_id']}"):
+                    chunk = engine(
+                        st, dag, region, ranges, h["read_ts"],
+                        warn=lambda lv, code, msg: len(warns) < 64 and warns.append([lv, code, msg]),
+                    )
+            det.proc_ms = (time.perf_counter() - t0) * 1000.0
+            reply = {"ok": 1, "warnings": warns, "exec": det.to_pb()}
+            if tracer is not None:
+                reply["spans"] = tracer.to_pb()
+            return reply, [encode_chunk(chunk)]
         raise ValueError(f"unknown command {cmd!r}")
 
 
@@ -543,18 +575,36 @@ class _RemoteCopClient:
         # one retry budget for the whole fan-out (ref: copIterator handling
         # region errors under the request's Backoffer)
         bo = Backoffer(budget_ms=self.store._retry_budget_ms, seed=self.store._backoff_seed)
+        store_addr = f"{self.store.host}:{self.store.port}"
+        tracer = req.tracer
+        parent_span = tracer.current() if tracer is not None else None
+        t_submit = time.perf_counter()
 
         def one_call(region_id, krs, store_type):
-            h, blobs = self.store._call(
-                {
-                    "cmd": "cop",
-                    "dag": dag_pb,
-                    "region_id": region_id,
-                    "ranges": [[_b(kr.start), _b(kr.end)] for kr in krs],
-                    "read_ts": read_ts,
-                    "store_type": store_type.value,
-                }
-            )
+            hdr = {
+                "cmd": "cop",
+                "dag": dag_pb,
+                "region_id": region_id,
+                "ranges": [[_b(kr.start), _b(kr.end)] for kr in krs],
+                "read_ts": read_ts,
+                "store_type": store_type.value,
+            }
+            if tracer is not None:
+                # trace-context propagation: the id travels out, the store
+                # records spans under it and ships them back (see the server
+                # cop handler); merge grafts them under this RPC's span
+                hdr["trace"] = tracer.context().to_pb()
+                with tracer.span(f"cop-rpc.r{region_id}", parent=parent_span) as sp:
+                    h, blobs = self.store._call(hdr)
+                if h.get("spans"):
+                    tracer.merge_remote(
+                        h["spans"], base_s=sp.start_s, node=store_addr, depth=sp.depth + 1
+                    )
+            else:
+                h, blobs = self.store._call(hdr)
+            d = _ed.current_cop()
+            if d is not None and h.get("exec"):
+                d.merge_pb(h["exec"])
             if req.warn is not None:
                 for lv, code, msg in h.get("warnings", ()):
                     req.warn(lv, code, msg)
@@ -567,22 +617,31 @@ class _RemoteCopClient:
 
         def run(item):
             ti, (region, krs) = item
+            det = _ed.CopExecDetails(region.region_id, store=store_addr)
+            det.queue_ms = (time.perf_counter() - t_submit) * 1000.0
+            t0 = time.perf_counter()
             # server-side engine failures arrive as RuntimeError ("remote
             # store error: ..."); kill/quota verdicts arrive re-typed by
             # _call (the server ships the error kind) and must pass through
-            chunk = run_task_resilient(
-                bo,
-                run_one,
-                self.store.pd.regions_in_ranges,
-                region,
-                krs,
-                req.store_type,
-                warn=req.warn,
-                degrade_reason="remote",
-                degrade_on=(RuntimeError,),
-                never_degrade=(QueryKilledError, QueryOOMError),
-            )
-            return CopResult(chunk, ti, region.region_id)
+            with _ed.collecting(det, tracer=tracer):
+                chunk = run_task_resilient(
+                    bo,
+                    run_one,
+                    self.store.pd.regions_in_ranges,
+                    region,
+                    krs,
+                    req.store_type,
+                    warn=req.warn,
+                    degrade_reason="remote",
+                    degrade_on=(RuntimeError,),
+                    never_degrade=(QueryKilledError, QueryOOMError),
+                    detail=det,
+                )
+            # proc_ms arrived from the server's sidecar; what remains of the
+            # client-observed wall is wire + (de)serialization time
+            wall = (time.perf_counter() - t0) * 1000.0
+            det.wire_ms = max(wall - det.proc_ms - det.backoff_ms, 0.0)
+            return CopResult(chunk, ti, region.region_id, det)
 
         items = list(enumerate(tasks))
         if req.concurrency <= 1 or len(items) == 1:
@@ -722,12 +781,18 @@ class RemoteStore:
                         seed=self._backoff_seed,
                     )
                 try:
-                    bo.backoff(boRPC, e)
+                    slept = bo.backoff(boRPC, e)
                 except BackoffExhausted as be:
                     raise ConnectionError(
                         f"store server {self.host}:{self.port} unreachable "
                         f"(gave up after {be.attempts} retries / {be.slept_ms:.0f}ms: {e})"
                     ) from e
+                # wire-level retries charge the active cop task's sidecar
+                # (one thread-local read when nothing is collecting)
+                d = _ed.current_cop()
+                if d is not None:
+                    d.retries += 1
+                    d.backoff_ms += slept
         err = h.get("err")
         if err == "KeyLocked":
             raise KeyLockedError(_ub(h["key"]), _lock_from_pb(h["lock"]))
@@ -852,14 +917,19 @@ class RemoteStore:
             self._mpp_ndev = int(self._call({"cmd": "mpp_ndev"})[0]["ndev"])
         return self._mpp_ndev
 
-    def mpp_dispatch(self, spec: dict, read_ts: int) -> str:
-        h, _ = self._call({"cmd": "mpp_dispatch", "spec": spec, "read_ts": read_ts})
+    def mpp_dispatch(self, spec: dict, read_ts: int, trace: Optional[dict] = None) -> str:
+        hdr = {"cmd": "mpp_dispatch", "spec": spec, "read_ts": read_ts}
+        if trace:
+            hdr["trace"] = trace
+        h, _ = self._call(hdr)
         return h["task_id"]
 
-    def mpp_conn(self, task_id: str, check_killed=None, warn=None):
+    def mpp_conn(self, task_id: str, check_killed=None, warn=None, on_exec=None):
         """Block until the task's merged chunk arrives (long-poll loop so a
         client-side KILL propagates as mpp_cancel). Raises the task's error
-        with its original kind when the server reports one."""
+        with its original kind when the server reports one. ``on_exec(exec,
+        spans)`` receives the server's MPP exec-details sidecar + any spans
+        it recorded under a propagated trace context."""
         while True:
             h, blobs = self._call({"cmd": "mpp_conn", "task_id": task_id, "wait_s": 1.0})
             if h["done"]:
@@ -897,6 +967,8 @@ class RemoteStore:
         if warn is not None:
             for lv, code, msg in h.get("warnings", ()):
                 warn(lv, code, msg)
+        if on_exec is not None:
+            on_exec(h.get("exec"), h.get("spans"))
         return decode_chunk(blobs[0])
 
     def mpp_cancel(self, task_id: str) -> None:

@@ -635,13 +635,13 @@ class ShardedStore:
             )
         return owners.pop()
 
-    def mpp_dispatch(self, spec: dict, read_ts: int) -> str:
+    def mpp_dispatch(self, spec: dict, read_ts: int, **kw) -> str:
         owner = self._mpp_owner(spec)
-        return f"{owner}:{self.stores[owner].mpp_dispatch(spec, read_ts)}"
+        return f"{owner}:{self.stores[owner].mpp_dispatch(spec, read_ts, **kw)}"
 
-    def mpp_conn(self, task_id: str, check_killed=None, warn=None):
+    def mpp_conn(self, task_id: str, check_killed=None, warn=None, **kw):
         owner, _, tid = task_id.partition(":")
-        return self.stores[int(owner)].mpp_conn(tid, check_killed=check_killed, warn=warn)
+        return self.stores[int(owner)].mpp_conn(tid, check_killed=check_killed, warn=warn, **kw)
 
     def mpp_cancel(self, task_id: str) -> None:
         owner, _, tid = task_id.partition(":")

@@ -825,7 +825,25 @@ class MPPGatherExec:
             mesh = make_mesh(devices=devices)
             try:
                 failpoint.inject("mpp_run_fragment", mesh)
-                return self._execute_attempt(mesh)
+                import time as _t
+
+                t0 = _t.perf_counter()
+                out = self._execute_attempt(mesh)
+                # MPP exec-details: the gather's analog of the cop sidecar —
+                # feeds EXPLAIN ANALYZE's mpp_task line on this gather node
+                from tidb_tpu.utils.execdetails import MPPExecDetails
+
+                self.session.record_mpp_detail(
+                    self.plan,
+                    MPPExecDetails(
+                        n_fragments=len(self.plan.fragments),
+                        ndev=int(mesh.devices.size),
+                        wall_ms=(_t.perf_counter() - t0) * 1000.0,
+                        rows=len(out),
+                        retries=bo.attempts(),
+                    ),
+                )
+                return out
             except (MPPRetryExhausted, QueryKilledError, QueryOOMError):
                 # kills and quota cancels are statement verdicts, not device
                 # failures — retrying would defeat KILL / the memory quota
@@ -874,10 +892,47 @@ class MPPGatherExec:
             cap = self._initial_group_cap(rows if rows else 1 << 16)
         spec = gather_to_pb(self.plan, cap, schema_ver=sess._db.catalog.schema_version)
         store = sess.store
-        task_id = store.mpp_dispatch(spec, sess.read_ts())
-        return store.mpp_conn(
-            task_id, check_killed=sess.check_killed, warn=sess.append_warning
+        import time as _t
+        from contextlib import nullcontext
+
+        from tidb_tpu.utils.execdetails import MPPExecDetails
+
+        tr = sess.tracer
+        store_addr = f"{getattr(store, 'host', 'shard')}:{getattr(store, 'port', '?')}"
+        exec_pb: list = []
+        t0 = _t.perf_counter()
+        # the dispatch+conn pair runs under ONE client span; the server's
+        # task session records its own spans under the propagated context
+        # and they graft in here, tagged with the store that recorded them
+        with (tr.span("mpp-gather-rpc") if tr is not None else nullcontext()) as sp:
+            # the trace kwarg only appears when tracing is ON — untraced
+            # dispatch keeps the plain (spec, read_ts) signature
+            kw = {"trace": tr.context().to_pb()} if tr is not None else {}
+            task_id = store.mpp_dispatch(spec, sess.read_ts(), **kw)
+
+            def on_exec(e, spans):
+                if e:
+                    exec_pb.append(e)
+                if spans and tr is not None:
+                    tr.merge_remote(spans, base_s=sp.start_s, node=store_addr, depth=sp.depth + 1)
+
+            chunk = store.mpp_conn(
+                task_id, check_killed=sess.check_killed, warn=sess.append_warning,
+                on_exec=on_exec,
+            )
+        e = exec_pb[0] if exec_pb else {}
+        sess.record_mpp_detail(
+            self.plan,
+            MPPExecDetails(
+                n_fragments=int(e.get("fragments", len(self.plan.fragments))),
+                ndev=int(e.get("ndev", 0)),
+                wall_ms=float(e.get("wall_ms", (_t.perf_counter() - t0) * 1000.0)),
+                rows=len(chunk),
+                retries=int(e.get("retries", 0)),
+                store=store_addr,
+            ),
         )
+        return chunk
 
     def _execute_attempt(self, mesh):
         import jax.numpy as jnp
@@ -990,7 +1045,10 @@ class MPPGatherExec:
                     _MPP_DEV_CACHE.pop(next(iter(_MPP_DEV_CACHE)))
             return dev
 
-        sides = [dev_side(r) for r in p.readers]
+        # traced under TRACE (or a propagated remote trace context): the two
+        # dominant phases of a gather get their own spans
+        with self.session.span("mpp-inputs"):
+            sides = [dev_side(r) for r in p.readers]
         all_lanes = [a for arrays, _, _ in sides for a in arrays]
         nrows = [n for _, n, _ in sides]
         bounds_by_reader = [bs for _, _, bs in sides]
@@ -1225,7 +1283,7 @@ class MPPGatherExec:
                 fn, warn_sink = cached
             import jax
 
-            with _MESH_EXEC_LOCK:
+            with self.session.span(f"mpp-pipeline[{ndev}dev]"), _MESH_EXEC_LOCK:
                 outs = fn(*all_lanes)
                 # ONE device→host round trip for every output lane:
                 # device_get batches the whole tuple into a single transfer —

@@ -236,11 +236,22 @@ class MPPTaskManager:
         return self._tbl_map[tid]
 
     # -- task lifecycle ------------------------------------------------------
-    def dispatch(self, spec: dict, read_ts: int) -> str:
+    def dispatch(self, spec: dict, read_ts: int, trace: Optional[dict] = None) -> str:
+        import time as _time
+
         from tidb_tpu.parallel.gather import MPPGatherExec
 
         sess = self._get_db().session()
         sess._read_ts_override = read_ts
+        if trace:
+            # propagated trace context: the task session records REAL spans
+            # (fragment input materialization, mesh execution) that ship
+            # home with the result frame
+            from tidb_tpu.utils.tracing import TraceContext, Tracer
+
+            tctx = TraceContext.from_pb(trace)
+            if tctx is not None and tctx.sampled:
+                sess.tracer = Tracer(trace_id=tctx.trace_id)
         if spec.get("schema_ver", -1) != self._tbl_version:
             # the client planned against a newer (or older) catalog than this
             # snapshot — resync before resolving ids (ALTERed tables keep
@@ -262,29 +273,45 @@ class MPPTaskManager:
         def run():
             from tidb_tpu.utils.chunk import encode_chunk
 
+            t0 = _time.perf_counter()
             try:
                 ex = MPPGatherExec(plan, sess)
                 if cap_hint:
                     ex._group_cap_hint = cap_hint
-                task["blob"] = encode_chunk(ex.execute())
+                chunk = ex.execute()
+                task["blob"] = encode_chunk(chunk)
+                # MPP exec-details sidecar: the gather recorded itself into
+                # the task session (gather.py); wall here additionally covers
+                # reader materialization + encode
+                det = sess.mpp_details[-1] if sess.mpp_details else None
+                task["exec"] = {
+                    "wall_ms": round((_time.perf_counter() - t0) * 1000.0, 3),
+                    "ndev": det.ndev if det is not None else 0,
+                    "fragments": det.n_fragments if det is not None else 0,
+                    "retries": det.retries if det is not None else 0,
+                    "rows": len(chunk),
+                }
             except Exception as e:  # travels the wire as (kind, message)
                 task["kind"] = type(e).__name__
                 task["err"] = f"{e}"
             finally:
+                if sess.tracer is not None:
+                    task["spans"] = sess.tracer.to_pb()
                 task["ev"].set()
 
         threading.Thread(target=run, daemon=True, name=f"mpp-task-{task_id}").start()
         return task_id
 
     def conn(self, task_id: str, wait_s: float):
-        """(done, blob, err_kind, err_msg, warnings). Long-poll: blocks up
-        to ``wait_s`` so the client loop can interleave KILL checks."""
+        """(done, blob, err_kind, err_msg, warnings, exec, spans). Long-poll:
+        blocks up to ``wait_s`` so the client loop can interleave KILL
+        checks."""
         with self._mu:
             task = self._tasks.get(task_id)
         if task is None:
-            return True, None, "ValueError", f"unknown mpp task {task_id}", ()
+            return True, None, "ValueError", f"unknown mpp task {task_id}", (), None, None
         if not task["ev"].wait(wait_s):
-            return False, None, None, None, ()
+            return False, None, None, None, (), None, None
         # deliberately NOT popped: the reply frame can be lost on the wire
         # and the client transparently replays mpp_conn (it is replay-safe
         # exactly because serving the result is idempotent) — finished
@@ -292,7 +319,7 @@ class MPPTaskManager:
         # the task session's accumulated warnings travel back with the result
         # (ref: per-SelectResponse warning carriage)
         warns = [[lv, code, msg] for lv, code, msg in task["sess"].warnings[:64]]
-        return True, task["blob"], task["kind"], task["err"], warns
+        return True, task["blob"], task["kind"], task["err"], warns, task.get("exec"), task.get("spans")
 
     def cancel(self, task_id: str) -> None:
         with self._mu:

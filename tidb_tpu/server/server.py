@@ -486,6 +486,12 @@ class Server:
             pass
 
     def _conn_event(self, event: str, conn: "ClientConn") -> None:
+        # wire-level connection observability: the open-connection gauge
+        # tracks authenticated sessions (ref: server connections metric)
+        if event in ("connected", "disconnected"):
+            from tidb_tpu.utils.metrics import SERVER_CONNS
+
+            SERVER_CONNS.inc(1 if event == "connected" else -1)
         exts = getattr(self.db, "extensions", None)
         if exts is not None and exts.have:
             import time as _t

@@ -52,6 +52,26 @@ class StatusServer:
                         snap = om.snapshot() if om is not None else {}
                     body = json.dumps(snap).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/slowlog"):
+                    # the slow-log ring with its structured exec-detail
+                    # fields (see information_schema.slow_query for the SQL
+                    # surface of the same data)
+                    body = json.dumps(
+                        [
+                            {"time": e.time, "query": e.sql,
+                             "query_time": e.latency_s, "rows": e.rows,
+                             "user": e.user, "digest": e.digest,
+                             "plan_digest": e.plan_digest,
+                             "cop_tasks": e.cop_tasks,
+                             "cop_proc_max_ms": e.cop_proc_max_ms,
+                             "backoff_ms": e.backoff_ms,
+                             "resplits": e.resplits,
+                             "max_task_store": e.max_task_store,
+                             "cop_summary": e.cop_summary}
+                            for e in outer.db.stmt_summary.slow_queries()
+                        ]
+                    ).encode()
+                    ctype = "application/json"
                 elif self.path.startswith("/topsql"):
                     # ref: the dashboard Top-SQL API fed by util/topsql
                     from tidb_tpu.utils.topsql import collector

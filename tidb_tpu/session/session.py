@@ -217,6 +217,13 @@ class Session:
         self.runtime_stats = None
         # TRACE statement span collector (None = tracing off)
         self.tracer = None
+        # distributed exec-details (ref: util/execdetails CopTasksDetails):
+        # the statement's cop-task sidecar aggregate + MPP gather details —
+        # always on (allocation-light), reset per statement; feeds the slow
+        # log, statements_summary, and EXPLAIN ANALYZE
+        self.exec_summary = None  # CopTasksSummary, allocated on first task
+        self.mpp_details: list = []
+        self._last_plan = None  # the finished statement's physical plan
         # per-statement memory tracker + kill flag (ref: memory.Tracker root
         # at the session, sqlkiller checked at executor boundaries)
         self.mem_tracker = None
@@ -347,6 +354,26 @@ class Session:
 
         return contextlib.nullcontext()
 
+    # -- distributed exec-details collection (ref: util/execdetails) ---------
+    def record_cop_detail(self, plan, detail) -> None:
+        """One cop task's wire-shipped/locally-collected ExecDetails sidecar:
+        into the statement aggregate and, under EXPLAIN ANALYZE, the plan
+        node's cop_task execution-info line."""
+        ed = self.exec_summary
+        if ed is None:
+            from tidb_tpu.utils.execdetails import CopTasksSummary
+
+            ed = self.exec_summary = CopTasksSummary()
+        ed.add(detail)
+        if self.runtime_stats is not None:
+            self.runtime_stats.record_cop(plan, detail)
+
+    def record_mpp_detail(self, plan, detail) -> None:
+        """One MPP gather's exec-details (local mesh or remote dispatch)."""
+        self.mpp_details.append(detail)
+        if self.runtime_stats is not None:
+            self.runtime_stats.record_mpp(plan, detail)
+
     def _audit_stmt(self, sql: str, event: str, duration_s: float, error: str = "") -> None:
         if not self._db.extensions.have:
             return
@@ -442,6 +469,10 @@ class Session:
             return digest_cache[0]
 
         self._stmt_count += 1
+        # per-statement exec-details lifecycle (cheap: three attribute sets)
+        self.exec_summary = None
+        self.mpp_details = []
+        self._last_plan = None
         if not isinstance(stmt, ast.Show):  # SHOW WARNINGS must see them
             self._prev_warnings = self.warnings
             self.warnings = []
@@ -460,10 +491,18 @@ class Session:
             dt = _time.perf_counter() - t0
             _m.STMT_TOTAL.inc(type=stype)
             _m.QUERY_DURATION.observe(dt)
+            pd = ""
+            if self._last_plan is not None:
+                from tidb_tpu.utils.execdetails import plan_digest as _plan_digest
+
+                # memoized on the plan object — cached plans pay this once
+                pd = _plan_digest(self._last_plan)
             self._db.stmt_summary.record(
                 exec_sql, dt, len(res.rows) or res.affected, f"{self.user}@{self.host}",
                 float(self.vars.get("tidb_slow_log_threshold", 300)) / 1000.0,
                 digest_val=sql_digest(),
+                plan_digest=pd,
+                cop=self.exec_summary,
             )
             # resource-group accounting + runaway detection (ref:
             # RunawayChecker at adapter.go:553; RU model per request)
@@ -1129,6 +1168,7 @@ class Session:
             self._read_ts_override = None
             self._deadline = None
             self.mem_tracker = None
+        self._last_plan = plan  # outermost select wins (inner selects ran already)
         names = [oc.name for oc in plan.schema]
         return Result(columns=names, rows=chunk.rows(), ftypes=[oc.ftype for oc in plan.schema])
 
@@ -1582,6 +1622,7 @@ class Session:
                 line = f"Point_Get  table:{pg.table.name}, handle:{pg.handle}"
             return Result(columns=["plan"], rows=[(line,)])
         plan = self._plan_select(inner)
+        self._last_plan = plan  # EXPLAIN [ANALYZE] records a plan digest too
         if stmt.analyze:
             from tidb_tpu.executor import build_executor
             from tidb_tpu.utils.execdetails import RuntimeStatsColl

@@ -10,6 +10,14 @@ from typing import Optional
 _DEFAULT_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30)
 
 
+def _esc(v) -> str:
+    """Escape a label VALUE for the Prometheus exposition format (the spec's
+    label-value escaping): backslash, double quote, and newline would
+    otherwise emit unparseable text — e.g. a degrade-reason label carrying a
+    quoted error message."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 class Counter:
     def __init__(self, name: str, help_: str, labels: tuple[str, ...] = ()):
         self.name = name
@@ -32,7 +40,7 @@ class Counter:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._mu:
             for key, v in sorted(self._vals.items()):
-                lbl = ",".join(f'{k}="{val}"' for k, val in zip(self.labels, key))
+                lbl = ",".join(f'{k}="{_esc(val)}"' for k, val in zip(self.labels, key))
                 out.append(f"{self.name}{{{lbl}}} {v:g}" if lbl else f"{self.name} {v:g}")
         return "\n".join(out)
 
@@ -52,6 +60,13 @@ class Gauge:
         with self._mu:
             self._vals[key] = v
 
+    def inc(self, n: float = 1, **labels) -> None:
+        """Atomic add — a get()+set() pair from concurrent threads loses
+        updates (each call takes the lock separately)."""
+        key = tuple(labels.get(k, "") for k in self.labels)
+        with self._mu:
+            self._vals[key] = self._vals.get(key, 0) + n
+
     def get(self, **labels) -> float:
         key = tuple(labels.get(k, "") for k in self.labels)
         with self._mu:
@@ -61,7 +76,7 @@ class Gauge:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
         with self._mu:
             for key, v in sorted(self._vals.items()):
-                lbl = ",".join(f'{k}="{val}"' for k, val in zip(self.labels, key))
+                lbl = ",".join(f'{k}="{_esc(val)}"' for k, val in zip(self.labels, key))
                 out.append(f"{self.name}{{{lbl}}} {v:g}" if lbl else f"{self.name} {v:g}")
         return "\n".join(out)
 
@@ -183,4 +198,28 @@ ELECTION_TERM = REGISTRY.gauge(
     "tidb_tpu_election_term",
     "Current fencing token (term) per election key, as observed by this node",
     ("key",),
+)
+# distributed exec-details pipeline (utils/execdetails + the cop engines):
+# device-time attribution exported process-wide; the per-query split rides
+# the ExecDetails sidecars into EXPLAIN ANALYZE / the slow log
+COP_COMPILE_SECONDS = REGISTRY.histogram(
+    "tidb_tpu_copr_compile_seconds",
+    "DAG-kernel jit compile wall (first dispatch per kernel-cache key)",
+)
+COP_DEVICE_SECONDS = REGISTRY.histogram(
+    "tidb_tpu_copr_device_seconds",
+    "Device-path wall per cop task (dispatch + on-chip + transfer back)",
+)
+DEVICE_CACHE = REGISTRY.counter(
+    "tidb_tpu_device_cache_total",
+    "Device-resident column LRU lookups (hit = no H2D transfer paid)",
+    ("result",),
+)
+DEVICE_TRANSFER = REGISTRY.counter(
+    "tidb_tpu_device_transfer_bytes_total",
+    "Host<->device bytes moved by the cop engines",
+    ("dir",),
+)
+SERVER_CONNS = REGISTRY.gauge(
+    "tidb_tpu_server_connections", "Open wire-protocol client connections"
 )

@@ -66,10 +66,39 @@ class StmtStats:
     max_latency: float = 0.0
     sum_rows: int = 0
     last_seen: float = field(default_factory=time.time)
+    # distributed exec-details (ref: statements_summary SUM_BACKOFF_TIME /
+    # SUM_COP_TASK_NUM columns), fed from the wire-shipped sidecars
+    plan_digest: str = ""
+    sum_backoff: float = 0.0  # seconds
+    sum_cop_tasks: int = 0
 
     @property
     def avg_latency(self) -> float:
         return self.sum_latency / self.exec_count if self.exec_count else 0.0
+
+
+@dataclass
+class SlowEntry:
+    """One slow-log ring record (ref: the slow query log's structured
+    fields — Plan_digest, Cop_time, Backoff_time, the max-task store)."""
+
+    time: float
+    sql: str
+    latency_s: float
+    rows: int
+    user: str
+    digest: str = ""
+    plan_digest: str = ""
+    cop_tasks: int = 0
+    cop_proc_max_ms: float = 0.0
+    backoff_ms: float = 0.0
+    resplits: int = 0
+    max_task_store: str = ""
+    cop_summary: str = ""
+
+    def __iter__(self):
+        # legacy 5-tuple shape for pre-structured consumers
+        return iter((self.time, self.sql, self.latency_s, self.rows, self.user))
 
 
 class StmtSummary:
@@ -77,7 +106,7 @@ class StmtSummary:
         self._mu = threading.Lock()
         self._stats: OrderedDict[str, StmtStats] = OrderedDict()
         self.capacity = capacity
-        # slow log ring: (time, sql, latency_s, rows, user)
+        # slow log ring of SlowEntry records
         self._slow: deque = deque(maxlen=slow_capacity)
 
     def record(
@@ -88,9 +117,12 @@ class StmtSummary:
         user: str,
         slow_threshold_s: float,
         digest_val: "str | None" = None,
+        plan_digest: str = "",
+        cop=None,
     ) -> None:
         # the session computes one digest per statement and threads it here
-        # (plus Top-SQL/bindings) instead of re-normalizing per consumer
+        # (plus Top-SQL/bindings) instead of re-normalizing per consumer;
+        # ``cop`` is the statement's CopTasksSummary (or None)
         d = digest_val if digest_val is not None else digest(sql)
         with self._mu:
             st = self._stats.get(d)
@@ -104,15 +136,31 @@ class StmtSummary:
             st.max_latency = max(st.max_latency, latency_s)
             st.sum_rows += rows
             st.last_seen = time.time()
+            if plan_digest:
+                st.plan_digest = plan_digest
+            if cop is not None and cop.num:
+                st.sum_backoff += cop.backoff_ms / 1000.0
+                st.sum_cop_tasks += cop.num
             self._stats.move_to_end(d)
             if latency_s >= slow_threshold_s:
-                self._slow.append((time.time(), sql[:512], latency_s, rows, user))
+                e = SlowEntry(
+                    time.time(), sql[:512], latency_s, rows, user,
+                    digest=d.partition("|")[0], plan_digest=plan_digest,
+                )
+                if cop is not None and cop.num:
+                    e.cop_tasks = cop.num
+                    e.cop_proc_max_ms = cop.max_proc_ms
+                    e.backoff_ms = cop.backoff_ms
+                    e.resplits = cop.resplits
+                    e.max_task_store = cop.max_task_store
+                    e.cop_summary = cop.render()
+                self._slow.append(e)
 
     def stats(self) -> list[StmtStats]:
         with self._mu:
             return list(self._stats.values())
 
-    def slow_queries(self) -> list[tuple]:
+    def slow_queries(self) -> list[SlowEntry]:
         with self._mu:
             return list(self._slow)
 

@@ -1,10 +1,29 @@
 """Tracing (ref: pkg/util/tracing dual spans + the TRACE statement,
 executor/trace.go): a per-statement span collector; instrumentation sites
-open spans through Session.span() which no-ops when tracing is off."""
+open spans through Session.span() which no-ops when tracing is off.
+
+Distributed half (ref: Dapper-style trace-context propagation): the trace id
+travels inside cop/MPP RPC headers (:class:`TraceContext`), the remote
+``StoreServer`` records spans into its own :class:`Tracer` under that
+context, and the finished spans ship home in the response where the caller
+grafts them into the statement trace with :meth:`Tracer.merge_remote` — so
+TRACE shows the full cross-process tree, each remote span tagged with the
+store that recorded it.
+
+Thread-safety: shared-cop-pool workers open spans on ONE statement tracer
+concurrently. Depth/nesting state is per-thread (a span stack in a
+``threading.local``); the span list itself appends under a lock with a
+monotonically increasing sequence number, and :meth:`rows` orders by
+``(start, seq)`` — a deterministic rule independent of interleaving.
+Cross-thread nesting (a worker's task span under the requester's
+``execute`` span) is explicit via ``span(name, parent=...)``.
+"""
 
 from __future__ import annotations
 
+import threading
 import time
+import uuid
 from contextlib import contextmanager
 from dataclasses import dataclass
 
@@ -15,29 +34,106 @@ class Span:
     start_s: float  # relative to trace start
     duration_s: float
     depth: int
+    seq: int = 0
+    # "" = recorded in this process; else the remote store that recorded it
+    node: str = ""
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """The wire form of an active trace: what a cop/MPP RPC carries outward
+    so the remote side can record spans under the same trace."""
+
+    trace_id: str
+    sampled: bool = True
+
+    def to_pb(self) -> dict:
+        return {"tid": self.trace_id, "sampled": int(self.sampled)}
+
+    @staticmethod
+    def from_pb(pb) -> "TraceContext | None":
+        if not pb:
+            return None
+        return TraceContext(str(pb.get("tid", "")), bool(pb.get("sampled", 1)))
 
 
 class Tracer:
-    def __init__(self):
+    def __init__(self, trace_id: "str | None" = None):
         self._t0 = time.perf_counter()
-        self._depth = 0
+        self.trace_id = trace_id or uuid.uuid4().hex[:16]
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        self._seq = 0
         self.spans: list[Span] = []
 
-    @contextmanager
-    def span(self, name: str):
-        start = time.perf_counter()
-        idx = len(self.spans)
-        self.spans.insert(idx, Span(name, start - self._t0, 0.0, self._depth))
-        self._depth += 1
-        try:
-            yield
-        finally:
-            self._depth -= 1
-            self.spans[idx].duration_s = time.perf_counter() - start
+    # -- span recording -----------------------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
 
+    def current(self) -> "Span | None":
+        """The innermost open span of THIS thread (cross-thread parents are
+        captured here and passed to workers via ``span(parent=...)``)."""
+        st = self._stack()
+        return st[-1] if st else None
+
+    @contextmanager
+    def span(self, name: str, parent: "Span | None" = None):
+        st = self._stack()
+        if parent is None and st:
+            parent = st[-1]
+        depth = parent.depth + 1 if parent is not None else 0
+        start = time.perf_counter()
+        sp = Span(name, start - self._t0, 0.0, depth)
+        with self._mu:
+            sp.seq = self._seq
+            self._seq += 1
+            self.spans.append(sp)
+        st.append(sp)
+        try:
+            yield sp
+        finally:
+            st.pop()
+            sp.duration_s = time.perf_counter() - start
+
+    # -- wire ----------------------------------------------------------------
+    def context(self) -> TraceContext:
+        return TraceContext(self.trace_id)
+
+    def to_pb(self) -> list[list]:
+        """Finished spans in wire form: [name, start_s, duration_s, depth],
+        ordered by the same deterministic (start, seq) rule as rows()."""
+        with self._mu:
+            spans = sorted(self.spans, key=lambda s: (s.start_s, s.seq))
+        return [[s.name, round(s.start_s, 6), round(s.duration_s, 6), s.depth] for s in spans]
+
+    def merge_remote(self, pb_spans, base_s: float, node: str, depth: int = 0) -> None:
+        """Graft spans recorded by a remote process into this trace: remote
+        starts are relative to the REMOTE trace start (its RPC handling), so
+        they rebase onto ``base_s`` — the local time the RPC span opened —
+        and indent ``depth`` levels under it. Clock skew never enters: only
+        the remote's own relative timings travel."""
+        if not pb_spans:
+            return
+        with self._mu:
+            for name, start_s, dur_s, sd in pb_spans:
+                sp = Span(
+                    str(name), base_s + float(start_s), float(dur_s), depth + int(sd), node=node
+                )
+                sp.seq = self._seq
+                self._seq += 1
+                self.spans.append(sp)
+
+    # -- rendering -----------------------------------------------------------
     def rows(self) -> list[tuple]:
+        with self._mu:
+            spans = sorted(self.spans, key=lambda s: (s.start_s, s.seq))
         out = []
-        for s in self.spans:
+        for s in spans:
             label = ("  " * s.depth) + ("└─" if s.depth else "") + s.name
+            if s.node:
+                label += f" @{s.node}"
             out.append((label, f"{s.start_s * 1e3:.3f}ms", f"{s.duration_s * 1e3:.3f}ms"))
         return out
