@@ -43,7 +43,11 @@ def thread_hygiene():
     ``timer-runtime`` thread survived ``stop_background()``/sweep exit
     (guards the lease-keepalive rework in session._owner_gated). Also flags
     ``cop_``/``rcop_`` threads: cop fan-out runs on the ONE shared
-    ``cop-shared`` pool now — a per-request pool thread is a regression."""
+    ``cop-shared`` pool now — a per-request pool thread is a regression.
+    ``trace-``-prefixed threads are flagged too: the trace reservoir and the
+    sampling coin are deliberately threadless (deposits happen on the
+    statement's own thread) — a reservoir/sampler thread appearing would
+    mean the observability layer grew background machinery it must not."""
     import threading
     import time
 
@@ -57,6 +61,7 @@ def thread_hygiene():
                 or t.name == "timer-runtime"
                 or t.name.startswith("cop_")
                 or t.name.startswith("rcop_")
+                or t.name.startswith("trace-")
             )
         ]
 

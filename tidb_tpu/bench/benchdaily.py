@@ -146,9 +146,11 @@ def bench_q3_join_mpp() -> float:
     return best
 
 
-def _warm_count_best(table: str, region_split_keys: "int | None" = None) -> float:
+def _warm_count_best(table: str, region_split_keys: "int | None" = None, setup_sql: "list | None" = None) -> float:
     """Best-of-30 warm ``SELECT COUNT(*)`` latency over a fresh 10k-row
-    table — the shared harness of the two fixed-cost lanes below."""
+    table — the shared harness of the fixed-cost lanes below.
+    ``setup_sql``: session knobs applied before warming (e.g. a sampling
+    rate for the traced lane)."""
     import time as _t
 
     import numpy as np
@@ -161,6 +163,8 @@ def _warm_count_best(table: str, region_split_keys: "int | None" = None) -> floa
     n = 10_000
     bulk_load(db, table, [np.arange(n, dtype=np.int64), np.arange(n, dtype=np.int64)])
     s = db.session()
+    for stmt in setup_sql or ():
+        s.execute(stmt)
     q = f"SELECT COUNT(*) FROM {table}"
     s.query(q)
     s.query(q)  # warm: statement + plan + engine caches
@@ -192,6 +196,21 @@ def bench_trace_off_overhead() -> float:
     ``fixed_overhead_ms`` (single-region) under the same --check gate, so
     observability can never quietly re-add fixed cost to the hot path."""
     return _warm_count_best("tof", region_split_keys=2000)
+
+
+@register("trace_sampled_overhead_ms")
+def bench_trace_sampled_overhead() -> float:
+    """Warm multi-region COUNT(*) with EVERY statement trace-sampled (ms,
+    lower is better): the worst-case cost of the always-on sampled tracer —
+    span recording on each cop task, the statement root span, and the
+    reservoir deposit. GWP's rule that an always-on profiler needs an
+    ENFORCED overhead budget, not a hoped-for one: this lane sits under the
+    same --check gate as ``trace_off_overhead_ms`` so the sampled path's tax
+    is measured and guarded, while the off lane proves rate-0 stays free."""
+    return _warm_count_best(
+        "tson", region_split_keys=2000,
+        setup_sql=["SET tidb_tpu_trace_sample_rate = 1"],
+    )
 
 
 @register("qps_point_select")

@@ -26,6 +26,7 @@ def memtable_rows(db, session, name: str) -> Optional[tuple[list, list, list]]:
         "engines": _engines,
         "statements_summary": _statements_summary,
         "slow_query": _slow_query,
+        "trace_reservoir": _trace_reservoir,
         "resource_groups": _resource_groups,
         "runaway_watches": _runaway_watches,
         "views": _views,
@@ -164,12 +165,13 @@ def _statements_summary(db, session):
 
 def _top_sql(db, session):
     """Trailing-minute per-digest CPU attribution (ref: util/topsql
-    reporter; the dashboard's Top SQL page)."""
+    reporter; the dashboard's Top SQL page). TRACE_ID cross-links to the
+    trace reservoir when a sampled statement contributed samples."""
     from tidb_tpu.types.field_type import double_type
     from tidb_tpu.utils.topsql import collector
 
-    cols = ["SQL_DIGEST", "PLAN_DIGEST", "QUERY_SAMPLE_TEXT", "CPU_TIME_SEC", "SAMPLES"]
-    fts = [_S(80), _S(80), _S(256), double_type(), _I()]
+    cols = ["SQL_DIGEST", "PLAN_DIGEST", "QUERY_SAMPLE_TEXT", "CPU_TIME_SEC", "SAMPLES", "TRACE_ID"]
+    fts = [_S(80), _S(80), _S(256), double_type(), _I(), _S(80)]
     return cols, fts, collector().top_sql()
 
 
@@ -181,14 +183,31 @@ def _slow_query(db, session):
 
     cols = ["TIME", "QUERY", "QUERY_TIME", "RESULT_ROWS", "USER", "DIGEST",
             "PLAN_DIGEST", "COP_TASKS", "COP_PROC_MAX", "BACKOFF_TIME",
-            "RESPLITS", "MAX_TASK_STORE", "COP_SUMMARY"]
+            "RESPLITS", "MAX_TASK_STORE", "COP_SUMMARY", "TRACE_ID"]
     fts = [double_type(), _S(512), double_type(), _I(), _S(), _S(80), _S(80),
-           _I(), double_type(), double_type(), _I(), _S(64), _S(256)]
+           _I(), double_type(), double_type(), _I(), _S(64), _S(256), _S(80)]
     rows = [
         (e.time, e.sql, e.latency_s, e.rows, e.user, e.digest, e.plan_digest,
          e.cop_tasks, e.cop_proc_max_ms / 1000.0, e.backoff_ms / 1000.0,
-         e.resplits, e.max_task_store, e.cop_summary)
+         e.resplits, e.max_task_store, e.cop_summary, e.trace_id)
         for e in db.stmt_summary.slow_queries()
+    ]
+    return cols, fts, rows
+
+
+def _trace_reservoir(db, session):
+    """The always-on sampled-trace reservoir (utils/tracing.TraceReservoir):
+    one row per retained trace — recent sampled statements plus tail-keep
+    slow outliers. The full span tree is served by ``GET /traces?id=...``."""
+    from tidb_tpu.types.field_type import double_type
+
+    cols = ["TRACE_ID", "TIME", "QUERY", "QUERY_TIME", "DIGEST", "SLOW", "SPANS"]
+    fts = [_S(80), double_type(), _S(512), double_type(), _S(80), _I(), _I()]
+    res = getattr(db, "trace_reservoir", None)
+    rows = [
+        (e.trace_id, e.time, e.sql, e.duration_s, e.digest,
+         1 if e.slow else 0, len(e.spans))
+        for e in (res.traces() if res is not None else [])
     ]
     return cols, fts, rows
 

@@ -39,6 +39,13 @@ class Config:
     # refreshes at lease/3 (kv/election.py quorum leases and kv/owner.py
     # local leases both read this default)
     owner_lease_s: float = 10.0
+    # [observability] always-on sampled tracing: the fraction of statements
+    # that record a full distributed trace into the reservoir (0 = off; the
+    # tidb_tpu_trace_sample_rate sysvar overrides per session/global), and
+    # how many recent traces the reservoir ring retains (tail-keep slow
+    # traces pin into a separate slow_capacity//2 section on top)
+    trace_sample_rate: float = 0.0
+    trace_reservoir_size: int = 64
     # [security]
     ssl_enabled: bool = False
     ssl_cert: str = ""
@@ -72,6 +79,9 @@ class Config:
         cfg.rpc_retry_budget_ms = float(net.get("rpc-retry-budget-ms", cfg.rpc_retry_budget_ms))
         cl = raw.get("cluster", {})
         cfg.owner_lease_s = float(cl.get("owner-lease-s", cfg.owner_lease_s))
+        obs = raw.get("observability", {})
+        cfg.trace_sample_rate = float(obs.get("trace-sample-rate", cfg.trace_sample_rate))
+        cfg.trace_reservoir_size = int(obs.get("trace-reservoir-size", cfg.trace_reservoir_size))
         sec = raw.get("security", {})
         cfg.ssl_cert = sec.get("ssl-cert", cfg.ssl_cert)
         cfg.ssl_key = sec.get("ssl-key", cfg.ssl_key)

@@ -28,6 +28,7 @@ from tidb_tpu.kv.kv import KeyRange, KVError, RegionError, Request, RequestType,
 from tidb_tpu.kv.memstore import MemStore, Region
 from tidb_tpu.utils import execdetails as _ed
 from tidb_tpu.utils import failpoint
+from tidb_tpu.utils import tracing as _tracing
 from tidb_tpu.utils.backoff import Backoffer, BackoffExhausted, boRegionMiss
 from tidb_tpu.utils.chunk import Chunk
 
@@ -285,7 +286,7 @@ class CopClient:
         # sidecar timing baseline + cross-thread span parent, captured in
         # the requesting thread (queue wait = submit → worker pickup)
         t_submit = time.perf_counter()
-        tracer = req.tracer
+        tracer = _tracing.effective(req.tracer)
         parent_span = tracer.current() if tracer is not None else None
 
         def run(task: CopTask) -> CopResult:

@@ -145,6 +145,10 @@ def _empty_chunk(schema) -> Chunk:
 class TableReaderExec(Executor):
     plan: PhysTableReader
     session: object
+    # index executors run their table phase through a SYNTHETIC reader; the
+    # sidecars must land on the visible plan node (the IndexLookUp/IndexMerge
+    # row of EXPLAIN ANALYZE), not on the synthetic one nobody renders
+    detail_target: object = None
 
     def __post_init__(self):
         self.schema = self.plan.schema
@@ -289,7 +293,7 @@ class TableReaderExec(Executor):
                 # (slow log / statements_summary) and, under EXPLAIN
                 # ANALYZE, this reader node's cop_task execution-info line
                 if res.details is not None:
-                    self.session.record_cop_detail(p, res.details)
+                    self.session.record_cop_detail(self.detail_target or p, res.details)
                 rc.add(res.chunk)
             out = rc.to_chunk()
         finally:
@@ -331,10 +335,11 @@ class TableReaderExec(Executor):
         return out if len(out.columns) else _empty_chunk(self.plan.schema)
 
 
-def _union_scan_fallback(session, table, scan_slots, conditions, schema) -> Chunk:
+def _union_scan_fallback(session, table, scan_slots, conditions, schema, target=None) -> Chunk:
     """Dirty-txn path shared by the index executors: index contents may lag
     the membuffer, so read through a membuffer-merged table scan instead
-    (ref: UnionScanExec wrapping IndexReader/IndexLookUp)."""
+    (ref: UnionScanExec wrapping IndexReader/IndexLookUp). ``target`` keeps
+    any cop sidecars attributed to the visible index plan node."""
     reader = PhysTableReader(
         db="",
         table=table,
@@ -343,7 +348,23 @@ def _union_scan_fallback(session, table, scan_slots, conditions, schema) -> Chun
         scan_slots=list(scan_slots),
         schema=schema,
     )
-    return TableReaderExec(reader, session).execute()
+    return TableReaderExec(reader, session, detail_target=target).execute()
+
+
+def _gather_index_chunks(session, plan, req) -> list:
+    """One index-side cop fan-out with the TableReaderExec sidecar
+    discipline: every task's wire-shipped ExecDetails folds into the
+    statement aggregate and — under EXPLAIN ANALYZE — into ``plan``'s own
+    ``cop_task:`` execution-info line (the index executors used to drop
+    these on the floor; ROADMAP named the gap)."""
+    chunks = []
+    for res in session.store.get_client().send(req):
+        session.check_killed()
+        if res.details is not None:
+            session.record_cop_detail(plan, res.details)
+        if len(res.chunk):
+            chunks.append(res.chunk)
+    return chunks
 
 
 def _coalesce_handle_ranges(table_id: int, handles: np.ndarray) -> list:
@@ -371,7 +392,8 @@ class IndexReaderExec(Executor):
         p = self.plan
         if self.session._txn_dirty():
             return _union_scan_fallback(
-                self.session, p.table, [oc.slot for oc in p.schema], p.all_conditions, p.schema
+                self.session, p.table, [oc.slot for oc in p.schema], p.all_conditions, p.schema,
+                target=p,
             )
         if not p.ranges:
             return _empty_chunk(p.schema)
@@ -402,8 +424,10 @@ class IndexReaderExec(Executor):
             start_ts=self.session.read_ts(),
             concurrency=int(self.session.vars.get("tidb_distsql_scan_concurrency", 8)),
             keep_order=True,
+            warn=self.session.append_warning,
+            tracer=self.session.tracer,
         )
-        chunks = [res.chunk for res in self.session.store.get_client().send(req) if len(res.chunk)]
+        chunks = _gather_index_chunks(self.session, p, req)
         if not chunks:
             return _empty_chunk(p.schema)
         return Chunk.concat(chunks) if len(chunks) > 1 else chunks[0]
@@ -423,7 +447,9 @@ class IndexLookUpExec(Executor):
     def execute(self) -> Chunk:
         p = self.plan
         if self.session._txn_dirty():
-            return _union_scan_fallback(self.session, p.table, p.scan_slots, p.all_conditions, p.schema)
+            return _union_scan_fallback(
+                self.session, p.table, p.scan_slots, p.all_conditions, p.schema, target=p
+            )
         if not p.ranges:
             return _empty_chunk(p.schema)
         t = p.table
@@ -444,13 +470,16 @@ class IndexLookUpExec(Executor):
             store_type=StoreType.HOST,
             start_ts=self.session.read_ts(),
             concurrency=int(self.session.vars.get("tidb_distsql_scan_concurrency", 8)),
+            warn=self.session.append_warning,
+            tracer=self.session.tracer,
         )
-        handle_chunks = [res.chunk for res in self.session.store.get_client().send(req) if len(res.chunk)]
+        handle_chunks = _gather_index_chunks(self.session, p, req)
         if not handle_chunks:
             return _empty_chunk(p.schema)
         handles = np.concatenate([c.columns[0].data for c in handle_chunks])
         # phase 2: table side — fetch rows by coalesced handle ranges with
-        # residual filters pushed (ref: buildTableReaderForIndexJoin)
+        # residual filters pushed (ref: buildTableReaderForIndexJoin); its
+        # cop sidecars attribute to THIS plan node's execution-info line
         reader = PhysTableReader(
             db=p.db,
             table=t,
@@ -460,7 +489,7 @@ class IndexLookUpExec(Executor):
             ranges=_coalesce_handle_ranges(t.id, handles),
             schema=p.schema,
         )
-        return TableReaderExec(reader, self.session).execute()
+        return TableReaderExec(reader, self.session, detail_target=p).execute()
 
 
 @dataclass
@@ -509,8 +538,10 @@ class IndexMergeExec(Executor):
             store_type=StoreType.HOST,
             start_ts=self.session.read_ts(),
             concurrency=int(self.session.vars.get("tidb_distsql_scan_concurrency", 8)),
+            warn=self.session.append_warning,
+            tracer=self.session.tracer,
         )
-        chunks = [res.chunk for res in self.session.store.get_client().send(req) if len(res.chunk)]
+        chunks = _gather_index_chunks(self.session, self.plan, req)
         if not chunks:
             return np.empty(0, np.int64)
         return np.concatenate([c.columns[0].data for c in chunks])
@@ -518,7 +549,9 @@ class IndexMergeExec(Executor):
     def execute(self) -> Chunk:
         p = self.plan
         if self.session._txn_dirty():
-            return _union_scan_fallback(self.session, p.table, p.scan_slots, p.all_conditions, p.schema)
+            return _union_scan_fallback(
+                self.session, p.table, p.scan_slots, p.all_conditions, p.schema, target=p
+            )
         from concurrent.futures import ThreadPoolExecutor
 
         if len(p.paths) > 1:
@@ -543,7 +576,7 @@ class IndexMergeExec(Executor):
             ranges=_coalesce_handle_ranges(p.table.id, handles),
             schema=p.schema,
         )
-        return TableReaderExec(reader, self.session).execute()
+        return TableReaderExec(reader, self.session, detail_target=p).execute()
 
 
 @dataclass

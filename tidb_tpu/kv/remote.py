@@ -41,6 +41,7 @@ from tidb_tpu.kv.kv import (
 from tidb_tpu.kv.memstore import OP_DEL, OP_PUT, Lock, MemStore, Mutation, Region
 from tidb_tpu.utils import execdetails as _ed
 from tidb_tpu.utils import failpoint
+from tidb_tpu.utils import tracing as _tracing
 from tidb_tpu.utils.backoff import Backoffer, BackoffExhausted, boRPC
 
 
@@ -576,7 +577,9 @@ class _RemoteCopClient:
         # region errors under the request's Backoffer)
         bo = Backoffer(budget_ms=self.store._retry_budget_ms, seed=self.store._backoff_seed)
         store_addr = f"{self.store.host}:{self.store.port}"
-        tracer = req.tracer
+        # the sampled=0 case: the id may exist for correlation but neither
+        # side records spans (nor ships the header) — one rule, one home
+        tracer = _tracing.effective(req.tracer)
         parent_span = tracer.current() if tracer is not None else None
         t_submit = time.perf_counter()
 

@@ -50,6 +50,33 @@ _MESH_EXEC_LOCK = _threading.Lock()
 # (store, table, slots, region versions, ndev) → padded device input lanes
 _MPP_DEV_CACHE: dict = {}
 
+# per-shard straggler observation channel: the fragment program's shard
+# probes (mpp.build_dist_pipeline shard_probe) report back through this ONE
+# module-level slot — race-free because _MESH_EXEC_LOCK serializes mesh
+# programs, and the probe function itself is stable so the compiled-program
+# cache (_MPP_FN_CACHE) keeps working across queries
+_SHARD_OBS: dict = {"t0": 0.0, "sink": None}
+
+
+def _shard_probe(idx, rows, xbytes):
+    """Host callback fired once per mesh shard inside the jitted fragment
+    program: records [shard_id, completion ms since program launch, rows
+    produced, exchanged bytes]. Completion time is the straggler signal — a
+    shard that computed (or slept) longer reports later. The
+    ``mpp_shard_slow`` failpoint lets chaos tests make one shard observably
+    slow without touching the program itself."""
+    import time as _t
+
+    from tidb_tpu.utils import failpoint as _fp
+
+    i = int(idx)
+    _fp.inject("mpp_shard_slow", i)
+    sink = _SHARD_OBS.get("sink")
+    if sink is not None:
+        sink.append(
+            [i, round((_t.perf_counter() - _SHARD_OBS["t0"]) * 1000.0, 3), int(rows), int(xbytes)]
+        )
+
 
 @dataclass
 class MPPJoin:
@@ -830,9 +857,15 @@ class MPPGatherExec:
                 t0 = _t.perf_counter()
                 out = self._execute_attempt(mesh)
                 # MPP exec-details: the gather's analog of the cop sidecar —
-                # feeds EXPLAIN ANALYZE's mpp_task line on this gather node
+                # feeds EXPLAIN ANALYZE's mpp_task line on this gather node,
+                # including the per-shard straggler breakdown the fragment
+                # program's shard probes recorded
+                from tidb_tpu.utils import metrics as _m
                 from tidb_tpu.utils.execdetails import MPPExecDetails
 
+                shards = getattr(self, "_shard_obs", [])
+                for sh in shards:
+                    _m.MPP_SHARD_SECONDS.observe(sh[1] / 1000.0)
                 self.session.record_mpp_detail(
                     self.plan,
                     MPPExecDetails(
@@ -841,6 +874,7 @@ class MPPGatherExec:
                         wall_ms=(_t.perf_counter() - t0) * 1000.0,
                         rows=len(out),
                         retries=bo.attempts(),
+                        shards=shards,
                     ),
                 )
                 return out
@@ -897,7 +931,9 @@ class MPPGatherExec:
 
         from tidb_tpu.utils.execdetails import MPPExecDetails
 
-        tr = sess.tracer
+        from tidb_tpu.utils.tracing import effective as _effective_tracer
+
+        tr = _effective_tracer(sess.tracer)
         store_addr = f"{getattr(store, 'host', 'shard')}:{getattr(store, 'port', '?')}"
         exec_pb: list = []
         t0 = _t.perf_counter()
@@ -930,6 +966,9 @@ class MPPGatherExec:
                 rows=len(chunk),
                 retries=int(e.get("retries", 0)),
                 store=store_addr,
+                # per-shard breakdown recorded by the SERVER's shard probes
+                # (the mesh lives there) — ships home in the exec sidecar
+                shards=[list(sh) for sh in (e.get("shards") or [])],
             ),
         )
         return chunk
@@ -1273,6 +1312,7 @@ class MPPGatherExec:
                     agg_inputs=agg_inputs if agg is not None else None,
                     topn=topn_spec,
                     warn_sink=warn_sink,
+                    shard_probe=_shard_probe,
                 )
                 # the sink is baked into the compiled program's closures: a
                 # cache hit must attribute warn counts via the ORIGINAL sink
@@ -1284,12 +1324,30 @@ class MPPGatherExec:
             import jax
 
             with self.session.span(f"mpp-pipeline[{ndev}dev]"), _MESH_EXEC_LOCK:
-                outs = fn(*all_lanes)
-                # ONE device→host round trip for every output lane:
-                # device_get batches the whole tuple into a single transfer —
-                # and blocking inside the lock keeps the collective's device
-                # work fully drained before the next program launches
-                arrs = list(jax.device_get(outs))
+                import time as _t
+
+                shard_obs: list = []
+                _SHARD_OBS["t0"] = _t.perf_counter()
+                _SHARD_OBS["sink"] = shard_obs
+                try:
+                    outs = fn(*all_lanes)
+                    # ONE device→host round trip for every output lane:
+                    # device_get batches the whole tuple into a single
+                    # transfer — and blocking inside the lock keeps the
+                    # collective's device work fully drained before the next
+                    # program launches
+                    arrs = list(jax.device_get(outs))
+                finally:
+                    # flush pending shard probes on EVERY exit — a failed
+                    # attempt's stragglers must not fire later into the next
+                    # program's sink (with the next program's t0)
+                    try:
+                        jax.effects_barrier()
+                    except Exception:
+                        pass  # the attempt's own error is the one to surface
+                    _SHARD_OBS["sink"] = None
+                # grow-and-retry attempts overwrite: the SUCCESSFUL run wins
+                self._shard_obs = sorted(shard_obs)
             wtotal = int(arrs.pop())  # the warn-count slot (always present)
             dropped = int(arrs[-2])
             overflow = int(arrs[-1])

@@ -407,6 +407,7 @@ def build_dist_pipeline(
     agg_inputs: Callable | None = None,
     topn: "DistTopNSpec | None" = None,
     warn_sink=None,
+    shard_probe: Callable | None = None,
 ):
     """The generalized MPP pipeline in ONE jitted shard_map (ref: §3.3 —
     fragments: scan→sel→[exchange→join]*→(partial agg→hash exchange→merge |
@@ -420,7 +421,15 @@ def build_dist_pipeline(
 
     Agg returns replicated (keys..., sums..., count, total, dropped,
     overflow); TopN returns (out lanes..., live, count, total, dropped,
-    overflow)."""
+    overflow).
+
+    ``shard_probe(shard_id, rows, exchange_bytes)``: a host callback invoked
+    ONCE per mesh shard (``jax.debug.callback``) with that shard's
+    post-fragment live row count and its exchanged byte estimate — its args
+    depend on the shard-LOCAL tail reduction (before the final replicating
+    collectives, which would synchronize every shard to the same finish
+    time), so the invocation time attributes per-shard compute: the
+    straggler probe behind the ``mpp_task: {..., slowest: shard k}`` line."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -437,6 +446,9 @@ def build_dist_pipeline(
             mask = selections[0](*acc)
         dropped = jnp.int64(0)
         overflow = jnp.int64(0)
+        # per-shard exchanged-byte estimate (8 B per lane per routed row);
+        # DCE'd when no shard_probe consumes it
+        xbytes = jnp.int64(0)
         for ji, join in enumerate(joins):
             rcols = list(cols[offs[ji + 1] : offs[ji + 2]])
             rvalid = jnp.ones(rcols[0].shape[0], dtype=bool)
@@ -469,6 +481,7 @@ def build_dist_pipeline(
                 rowner = jnp.abs(rkey).astype(jnp.int64) % ndev
                 lcap = join.left_row_cap or join.row_cap
                 rcap = join.right_row_cap or join.row_cap
+                xbytes = xbytes + mask.sum() * (8 * len(acc)) + rvalid.sum() * (8 * len(rcols))
                 acc, mask, d1 = _route_rows(jax, jnp, acc, mask, lowner, ndev, lcap)
                 rcols, rvalid, d2 = _route_rows(jax, jnp, rcols, rvalid, rowner, ndev, rcap)
                 dropped = dropped + d1 + d2
@@ -480,6 +493,7 @@ def build_dist_pipeline(
                 lkey, ncodes = join_lane(lkeys)
                 rkey, _ = join_lane(rkeys)
             else:  # broadcast: replicate the build side on every shard
+                xbytes = xbytes + rvalid.sum() * (8 * len(rcols) * max(ndev - 1, 0))
                 rcols = [jax.lax.all_gather(c, "dp").reshape(-1) for c in rcols]
                 rvalid = jax.lax.all_gather(rvalid, "dp").reshape(-1)
                 rkeys = [rcols[i] for i in join.right_keys]
@@ -564,11 +578,16 @@ def build_dist_pipeline(
                 overflow = overflow + of
                 mask = newmask
                 acc = out_l + out_r
-        outs = (
+        outs, local_rows = (
             _agg_tail(acc, mask, dropped, overflow)
             if agg is not None
             else _topn_tail(acc, mask, dropped, overflow)
         )
+        if shard_probe is not None:
+            # effect-only host callback; local_rows depends on the shard's
+            # tail reduction, so the probe fires after this shard's compute
+            # but BEFORE the synchronizing gathers equalize finish times
+            jax.debug.callback(shard_probe, jax.lax.axis_index("dp"), local_rows, xbytes)
         if warn_sink is not None:
             # device warnings born inside the fragment (division by 0 in a
             # selection/agg argument) ride ONE replicated count output —
@@ -612,7 +631,7 @@ def build_dist_pipeline(
         total = jax.lax.psum(cnt, "dp")
         gdropped = jax.lax.psum(dropped, "dp")
         goverflow = jax.lax.psum(overflow, "dp")
-        return (*outs, glive, total, gdropped, goverflow)
+        return (*outs, glive, total, gdropped, goverflow), cnt
 
     def _agg_tail(joined, mask, dropped, overflow):
         acols = agg_inputs(joined) if agg_inputs is not None else joined
@@ -676,7 +695,10 @@ def build_dist_pipeline(
         total = jax.lax.psum(mask.sum(), "dp")
         gdropped = jax.lax.psum(dropped, "dp")
         goverflow = jax.lax.psum(overflow + of1 + of_slots + of3, "dp")
-        return (*gkeys, *gsums, gcnt, total, gdropped, goverflow)
+        # shard-local live groups after the merge stage — the shard probe's
+        # "rows produced" (depends on this shard's heavy reductions)
+        local_rows = (gcnt_local > 0).sum()
+        return (*gkeys, *gsums, gcnt, total, gdropped, goverflow), local_rows
 
     if agg is not None:
         if agg.n_dkeys:

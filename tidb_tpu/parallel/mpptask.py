@@ -290,6 +290,9 @@ class MPPTaskManager:
                     "fragments": det.n_fragments if det is not None else 0,
                     "retries": det.retries if det is not None else 0,
                     "rows": len(chunk),
+                    # per-shard straggler breakdown (plain lists: the header
+                    # travels as JSON) — the dispatching client renders it
+                    "shards": det.shards if det is not None else [],
                 }
             except Exception as e:  # travels the wire as (kind, message)
                 task["kind"] = type(e).__name__

@@ -55,7 +55,7 @@ class StatusServer:
                 elif self.path.startswith("/slowlog"):
                     # the slow-log ring with its structured exec-detail
                     # fields (see information_schema.slow_query for the SQL
-                    # surface of the same data)
+                    # surface of the same data); trace_id pivots to /traces
                     body = json.dumps(
                         [
                             {"time": e.time, "query": e.sql,
@@ -67,7 +67,8 @@ class StatusServer:
                              "backoff_ms": e.backoff_ms,
                              "resplits": e.resplits,
                              "max_task_store": e.max_task_store,
-                             "cop_summary": e.cop_summary}
+                             "cop_summary": e.cop_summary,
+                             "trace_id": e.trace_id}
                             for e in outer.db.stmt_summary.slow_queries()
                         ]
                     ).encode()
@@ -79,8 +80,35 @@ class StatusServer:
                     body = json.dumps(
                         [
                             {"sql_digest": d, "plan_digest": p, "sample": s,
-                             "cpu_time_sec": c, "samples": n}
-                            for d, p, s, c, n in collector().top_sql()
+                             "cpu_time_sec": c, "samples": n, "trace_id": t}
+                            for d, p, s, c, n, t in collector().top_sql()
+                        ]
+                    ).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/traces"):
+                    # the always-on sampled-trace reservoir (utils/tracing
+                    # .TraceReservoir): ?id=<trace_id> serves one retained
+                    # trace (the slow-log/Top-SQL pivot), bare /traces lists
+                    # every retained entry with its full span tree
+                    from urllib.parse import parse_qs, urlparse
+
+                    res = getattr(outer.db, "trace_reservoir", None)
+                    tid = parse_qs(urlparse(self.path).query).get("id", [None])[0]
+                    if res is None:
+                        entries = []
+                    elif tid is not None:
+                        hit = res.get(tid)
+                        entries = [hit] if hit is not None else []
+                    else:
+                        entries = res.traces()
+                    body = json.dumps(
+                        [
+                            {"trace_id": e.trace_id, "time": e.time,
+                             "query": e.sql, "query_time": e.duration_s,
+                             "digest": e.digest, "slow": e.slow,
+                             # [name, start_ms, duration_ms, depth, node]
+                             "spans": e.spans}
+                            for e in entries
                         ]
                     ).encode()
                     ctype = "application/json"

@@ -44,6 +44,12 @@ DEFAULT_SYSVARS = {
     "tidb_enforce_mpp": 0,
     # slow query log threshold in ms (ref: tidb_slow_log_threshold)
     "tidb_slow_log_threshold": 300,
+    # always-on sampled tracing (Dapper-style): the fraction of statements
+    # that record a full distributed trace into the reservoir (0..1; 0 keeps
+    # the strict tracer-is-None zero-cost path). The seed makes the sampling
+    # coin deterministic ("" = nondeterministic; tests set an integer).
+    "tidb_tpu_trace_sample_rate": 0,
+    "tidb_tpu_trace_sample_seed": "",
     # Top-SQL sampling attribution; OFF by default like the reference —
     # the digest + sampler cost stays off the hot path until enabled
     "tidb_enable_top_sql": 0,
@@ -217,12 +223,22 @@ class Session:
         self.runtime_stats = None
         # TRACE statement span collector (None = tracing off)
         self.tracer = None
+        # always-on sampled tracing state: the tracer this statement's
+        # sampling coin armed (deposited into the DB's trace reservoir at
+        # statement end), plus the seeded coin RNG
+        self._sampled_tracer = None
+        self._trace_rng = None
+        self._trace_rng_seed = None
         # distributed exec-details (ref: util/execdetails CopTasksDetails):
         # the statement's cop-task sidecar aggregate + MPP gather details —
         # always on (allocation-light), reset per statement; feeds the slow
         # log, statements_summary, and EXPLAIN ANALYZE
         self.exec_summary = None  # CopTasksSummary, allocated on first task
         self.mpp_details: list = []
+        # cop sidecars arrive from CONCURRENT workers (partition fan-out,
+        # index-merge paths): the aggregate's check-then-create and its +=
+        # folds must not race
+        self._detail_mu = threading.Lock()
         self._last_plan = None  # the finished statement's physical plan
         # per-statement memory tracker + kill flag (ref: memory.Tracker root
         # at the session, sqlkiller checked at executor boundaries)
@@ -354,19 +370,75 @@ class Session:
 
         return contextlib.nullcontext()
 
+    def _sample_tracer(self):
+        """The per-statement sampling coin (ref: Dapper §4 uniform
+        sampling): rate from ``tidb_tpu_trace_sample_rate``, optionally
+        seeded by ``tidb_tpu_trace_sample_seed`` so tests get a
+        deterministic accept/reject sequence. Returns a sampled Tracer or
+        None. Only called when the rate sysvar is truthy — the rate-0 hot
+        path never reaches this."""
+        try:
+            r = float(self.vars.get("tidb_tpu_trace_sample_rate", 0) or 0)
+        except (TypeError, ValueError):
+            return None
+        if r <= 0:
+            return None
+        if r < 1.0:
+            seed = str(self.vars.get("tidb_tpu_trace_sample_seed", "") or "").strip()
+            if self._trace_rng is None or seed != self._trace_rng_seed:
+                import random as _random
+
+                try:
+                    self._trace_rng = _random.Random(int(seed)) if seed else _random.Random()
+                except ValueError:
+                    self._trace_rng = _random.Random(seed)
+                self._trace_rng_seed = seed
+            if self._trace_rng.random() >= r:
+                return None
+        from tidb_tpu.utils.tracing import Tracer
+
+        return Tracer(sampled=True)
+
+    def _deposit_trace(self, tracer, dt_s: float, sql: str) -> None:
+        """Finished sampled statement → the DB's trace reservoir. Tail-keep:
+        a statement over the slow-log threshold pins its trace (the slow log
+        entry carries the same trace id, so an operator pivots slow-log →
+        full span tree)."""
+        import time as _time
+
+        from tidb_tpu.utils import metrics as _m
+        from tidb_tpu.utils.stmtsummary import digest as _digest
+        from tidb_tpu.utils.tracing import TraceEntry
+
+        try:
+            thr = float(self.vars.get("tidb_slow_log_threshold", 300)) / 1000.0
+        except (TypeError, ValueError):
+            thr = 0.3
+        slow = dt_s >= thr
+        self._db.trace_reservoir.add(
+            TraceEntry(
+                tracer.trace_id, _time.time(), sql[:512],
+                _digest(sql).partition("|")[0], dt_s, slow, tracer.dump(),
+            )
+        )
+        _m.TRACE_SAMPLED.inc(kind="slow" if slow else "ok")
+
     # -- distributed exec-details collection (ref: util/execdetails) ---------
     def record_cop_detail(self, plan, detail) -> None:
         """One cop task's wire-shipped/locally-collected ExecDetails sidecar:
         into the statement aggregate and, under EXPLAIN ANALYZE, the plan
-        node's cop_task execution-info line."""
-        ed = self.exec_summary
-        if ed is None:
-            from tidb_tpu.utils.execdetails import CopTasksSummary
+        node's cop_task execution-info line. Locked: partition fan-out and
+        index-merge path workers record concurrently — an unlocked
+        check-then-create would drop whole workers' sidecars."""
+        with self._detail_mu:
+            ed = self.exec_summary
+            if ed is None:
+                from tidb_tpu.utils.execdetails import CopTasksSummary
 
-            ed = self.exec_summary = CopTasksSummary()
-        ed.add(detail)
-        if self.runtime_stats is not None:
-            self.runtime_stats.record_cop(plan, detail)
+                ed = self.exec_summary = CopTasksSummary()
+            ed.add(detail)
+            if self.runtime_stats is not None:
+                self.runtime_stats.record_cop(plan, detail)
 
     def record_mpp_detail(self, plan, detail) -> None:
         """One MPP gather's exec-details (local mesh or remote dispatch)."""
@@ -410,6 +482,21 @@ class Session:
         from tidb_tpu.utils import metrics as _m
 
         t0 = _time.perf_counter()
+        # -- always-on sampled tracing: ONE dict read when the rate is 0, so
+        # the tracer-is-None zero-cost path stays strictly intact
+        if self._sampled_tracer is not None:
+            # a prior statement died between arming and deposit (e.g. the
+            # schema-lease check raised mid-window): discard the orphan so
+            # nothing leaks across statements
+            self.tracer = None
+            self._sampled_tracer = None
+        s_span = None
+        if self.tracer is None and self.vars.get("tidb_tpu_trace_sample_rate", 0):
+            tr = self._sample_tracer()
+            if tr is not None:
+                self.tracer = self._sampled_tracer = tr
+                s_span = tr.span("statement")
+                s_span.__enter__()
         entry: Optional[_CachedStmt] = None
         cached = self._stmt_cache.get(sql)
         if cached is not None:
@@ -431,6 +518,10 @@ class Session:
                 # failed parses still reach the audit trail (probing attempts)
                 _m.STMT_TOTAL.inc(type="ParseError")
                 self._audit_stmt(sql, "error", _time.perf_counter() - t0, str(exc))
+                if self._sampled_tracer is not None:
+                    # nothing executed — a parse-error trace is noise
+                    self.tracer = None
+                    self._sampled_tracer = None
                 raise
             stype = type(stmt).__name__
             exec_sql = sql
@@ -483,7 +574,10 @@ class Session:
             from tidb_tpu.utils.topsql import collector as _topsql
 
             topsql = _topsql()
-            topsql.attach(sql_digest().split("|")[0], "", exec_sql)
+            topsql.attach(
+                sql_digest().split("|")[0], "", exec_sql,
+                trace_id=(self._sampled_tracer.trace_id if self._sampled_tracer is not None else ""),
+            )
         try:
             res = self._execute_stmt(stmt, sql_text=exec_sql)
             if not self._explicit and self._txn is not None:
@@ -503,6 +597,9 @@ class Session:
                 digest_val=sql_digest(),
                 plan_digest=pd,
                 cop=self.exec_summary,
+                # slow-log → reservoir pivot: the sampled trace's id rides
+                # the structured SlowEntry
+                trace_id=(self._sampled_tracer.trace_id if self._sampled_tracer is not None else ""),
             )
             # resource-group accounting + runaway detection (ref:
             # RunawayChecker at adapter.go:553; RU model per request)
@@ -530,6 +627,13 @@ class Session:
         finally:
             if topsql is not None:
                 topsql.detach()
+            if self._sampled_tracer is not None:
+                tr, self._sampled_tracer = self._sampled_tracer, None
+                if s_span is not None:
+                    s_span.__exit__(None, None, None)
+                if self.tracer is tr:
+                    self.tracer = None
+                self._deposit_trace(tr, _time.perf_counter() - t0, sql)
 
     def query(self, sql: str) -> list[tuple]:
         return self.execute(sql).rows
@@ -1794,6 +1898,17 @@ class DB:
         self.stmt_summary = StmtSummary()
         self.resource_groups = ResourceGroupManager()
         self.extensions = ExtensionRegistry()
+        # always-on sampled tracing: the bounded trace store ([observability]
+        # trace-reservoir-size; tail-keep pins slow-statement traces), plus
+        # the config-file default for the sampling-rate sysvar
+        from tidb_tpu.utils.tracing import TraceReservoir
+
+        _res_cap = _config.current().trace_reservoir_size
+        self.trace_reservoir = TraceReservoir(_res_cap, max(_res_cap // 2, 1))
+        if _config.current().trace_sample_rate:
+            self.global_vars.setdefault(
+                "tidb_tpu_trace_sample_rate", _config.current().trace_sample_rate
+            )
         # global SQL plan bindings: digest → (for_text, using_text)
         # (ref: pkg/bindinfo binding_handle)
         self.bindings: dict[str, tuple[str, str]] = {}

@@ -226,17 +226,30 @@ class CopTasksSummary:
 
 class MPPExecDetails:
     """One MPP gather's execution details (the cop sidecar's analog for the
-    fragment pipeline)."""
+    fragment pipeline). ``shards`` is the per-shard straggler breakdown the
+    fragment program's shard probes record: one ``[shard_id, compute_ms,
+    rows, exchange_bytes]`` row per mesh shard, so EXPLAIN ANALYZE can name
+    WHICH device inside the collective was slow."""
 
-    __slots__ = ("n_fragments", "ndev", "wall_ms", "rows", "retries", "store")
+    __slots__ = ("n_fragments", "ndev", "wall_ms", "rows", "retries", "store", "shards")
 
-    def __init__(self, n_fragments=0, ndev=0, wall_ms=0.0, rows=0, retries=0, store=""):
+    def __init__(self, n_fragments=0, ndev=0, wall_ms=0.0, rows=0, retries=0, store="", shards=None):
         self.n_fragments = n_fragments
         self.ndev = ndev
         self.wall_ms = wall_ms
         self.rows = rows
         self.retries = retries
         self.store = store  # "" = executed on the local mesh
+        self.shards = shards or []  # [[shard_id, ms, rows, xchg_bytes], ...]
+
+    def shard_summary(self) -> "tuple | None":
+        """(max_ms, min_ms, p95_ms, slowest_shard_id) or None."""
+        if not self.shards:
+            return None
+        ms = sorted(float(s[1]) for s in self.shards)
+        p95 = ms[max(0, math.ceil(0.95 * len(ms)) - 1)]
+        slowest = max(self.shards, key=lambda s: float(s[1]))
+        return ms[-1], ms[0], p95, int(slowest[0])
 
     def render(self) -> str:
         parts = [
@@ -245,6 +258,12 @@ class MPPExecDetails:
             f"wall: {self.wall_ms:.1f}ms",
             f"rows: {self.rows}",
         ]
+        ss = self.shard_summary()
+        if ss is not None:
+            mx, mn, p95, slowest = ss
+            parts.append(f"shards: {len(self.shards)}")
+            parts.append(f"shard max/min/p95: {mx:.1f}/{mn:.1f}/{p95:.1f}ms")
+            parts.append(f"slowest: shard {slowest}")
         if self.retries:
             parts.append(f"retries: {self.retries}")
         if self.store:

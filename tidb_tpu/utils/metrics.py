@@ -223,3 +223,15 @@ DEVICE_TRANSFER = REGISTRY.counter(
 SERVER_CONNS = REGISTRY.gauge(
     "tidb_tpu_server_connections", "Open wire-protocol client connections"
 )
+# always-on sampled tracing (utils/tracing.TraceReservoir + Session.execute)
+TRACE_SAMPLED = REGISTRY.counter(
+    "tidb_tpu_trace_sampled_total",
+    "Statements whose trace was sampled into the reservoir (slow = tail-keep pinned)",
+    ("kind",),
+)
+# per-shard MPP fragment attribution (parallel/gather._shard_probe): one
+# observation per mesh shard per gather — the straggler distribution
+MPP_SHARD_SECONDS = REGISTRY.histogram(
+    "tidb_tpu_mpp_shard_seconds",
+    "Per-shard MPP fragment completion wall (launch to shard-local finish)",
+)

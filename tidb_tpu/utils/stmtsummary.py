@@ -95,6 +95,9 @@ class SlowEntry:
     resplits: int = 0
     max_task_store: str = ""
     cop_summary: str = ""
+    # when the statement was trace-sampled, the reservoir key an operator
+    # pivots to for the full span tree (GET /traces?id=<trace_id>)
+    trace_id: str = ""
 
     def __iter__(self):
         # legacy 5-tuple shape for pre-structured consumers
@@ -119,6 +122,7 @@ class StmtSummary:
         digest_val: "str | None" = None,
         plan_digest: str = "",
         cop=None,
+        trace_id: str = "",
     ) -> None:
         # the session computes one digest per statement and threads it here
         # (plus Top-SQL/bindings) instead of re-normalizing per consumer;
@@ -146,6 +150,7 @@ class StmtSummary:
                 e = SlowEntry(
                     time.time(), sql[:512], latency_s, rows, user,
                     digest=d.partition("|")[0], plan_digest=plan_digest,
+                    trace_id=trace_id,
                 )
                 if cop is not None and cop.num:
                     e.cop_tasks = cop.num

@@ -28,14 +28,17 @@ class TopSQLCollector:
         self.window_s = window_s
         self.keep = keep_windows
         self._mu = threading.Lock()
-        # thread ident → stack of (sql_digest, plan_digest, sample_sql):
-        # nested internal statements (privilege checks, infoschema helpers)
-        # push/pop; samples attribute to the TOP entry
-        self._attached: dict[int, list[tuple[str, str, str]]] = {}
+        # thread ident → stack of (sql_digest, plan_digest, sample_sql,
+        # trace_id): nested internal statements (privilege checks,
+        # infoschema helpers) push/pop; samples attribute to the TOP entry
+        self._attached: dict[int, list[tuple[str, str, str, str]]] = {}
         # ring: window start ts → digest → samples
         self._windows: dict[int, dict[str, int]] = {}
         self._samples_of: dict[str, str] = {}  # digest → sample sql text
         self._plan_of: dict[str, str] = {}  # digest → plan digest
+        # digest → the last trace-sampled statement's reservoir trace id:
+        # the Top-SQL ↔ trace-reservoir pivot (GET /traces?id=...)
+        self._trace_of: dict[str, str] = {}
         # collapsed python stacks: "mod.fn;mod.fn;..." → samples
         self._stacks: dict[int, dict[str, int]] = {}
         self._stop = threading.Event()
@@ -43,11 +46,13 @@ class TopSQLCollector:
         self.enabled = True
 
     # -- statement attribution (called by the session) ----------------------
-    def attach(self, sql_digest: str, plan_digest: str, sample_sql: str) -> None:
+    def attach(self, sql_digest: str, plan_digest: str, sample_sql: str, trace_id: str = "") -> None:
         self._ensure_running()
         tid = threading.get_ident()
         with self._mu:
-            self._attached.setdefault(tid, []).append((sql_digest, plan_digest, sample_sql[:256]))
+            self._attached.setdefault(tid, []).append(
+                (sql_digest, plan_digest, sample_sql[:256], trace_id)
+            )
 
     def detach(self) -> None:
         tid = threading.get_ident()
@@ -79,13 +84,13 @@ class TopSQLCollector:
             now_w = int(time.time()) // self.window_s * self.window_s
             # collect OUTSIDE the lock and drop frame references promptly —
             # held frames pin their locals (sockets, buffers) alive
-            hits: list[tuple[str, str, str, str]] = []
+            hits: list[tuple[str, str, str, str, str]] = []
             frames = sys._current_frames()
             try:
                 for tid, stack_entries in attached.items():
                     if not stack_entries:
                         continue
-                    dg, pdg, sample = stack_entries[-1]
+                    dg, pdg, sample, trace_id = stack_entries[-1]
                     f = frames.get(tid)
                     if f is None:
                         continue
@@ -98,16 +103,18 @@ class TopSQLCollector:
                         g = g.f_back
                         depth += 1
                     del g, f
-                    hits.append((dg, pdg, sample, ";".join(reversed(parts))))
+                    hits.append((dg, pdg, sample, trace_id, ";".join(reversed(parts))))
             finally:
                 del frames
             with self._mu:
                 win = self._windows.setdefault(now_w, defaultdict(int))
                 swin = self._stacks.setdefault(now_w, defaultdict(int))
-                for dg, pdg, sample, stack in hits:
+                for dg, pdg, sample, trace_id, stack in hits:
                     win[dg] += 1
                     self._samples_of[dg] = sample
                     self._plan_of[dg] = pdg
+                    if trace_id:  # keep the last SAMPLED statement's pivot
+                        self._trace_of[dg] = trace_id
                     swin[stack] += 1
                 # expire old windows — and prune digest metadata no retained
                 # window references, or a long-lived server accumulates one
@@ -121,11 +128,14 @@ class TopSQLCollector:
                         if dg not in live:
                             self._samples_of.pop(dg, None)
                             self._plan_of.pop(dg, None)
+                            self._trace_of.pop(dg, None)
 
     # -- reports ------------------------------------------------------------
     def top_sql(self, last_s: int = 60, limit: int = 30) -> list[tuple]:
-        """[(digest, plan_digest, sample_sql, cpu_seconds, samples)] over the
-        trailing ``last_s`` seconds, hottest first."""
+        """[(digest, plan_digest, sample_sql, cpu_seconds, samples,
+        trace_id)] over the trailing ``last_s`` seconds, hottest first.
+        ``trace_id`` cross-links to the trace reservoir when a sampled
+        statement contributed samples."""
         cutoff = int(time.time()) - last_s
         agg: dict[str, int] = defaultdict(int)
         with self._mu:
@@ -140,6 +150,7 @@ class TopSQLCollector:
                     self._samples_of.get(dg, ""),
                     round(n * self.interval_s, 4),
                     n,
+                    self._trace_of.get(dg, ""),
                 )
                 for dg, n in agg.items()
             ]

@@ -17,6 +17,18 @@ monotonically increasing sequence number, and :meth:`rows` orders by
 ``(start, seq)`` — a deterministic rule independent of interleaving.
 Cross-thread nesting (a worker's task span under the requester's
 ``execute`` span) is explicit via ``span(name, parent=...)``.
+
+Always-on sampled tracing (ref: Dapper §4 — probabilistic sampling makes a
+continuous latency breakdown affordable at serving rates): a per-statement
+coin in ``Session.execute`` creates a ``Tracer`` for a small fraction of
+statements; its ``sampled`` flag rides the :class:`TraceContext` through
+every cop/MPP RPC so remote stores record spans ONLY for sampled
+statements. Finished sampled traces land in the :class:`TraceReservoir` —
+a bounded ring of recent traces plus a *tail-keep* section that pins any
+trace whose statement crossed the slow-log threshold, so the interesting
+outliers survive ring rotation (the slow log cross-links them by trace id).
+Unsampled statements never construct a tracer: the ``Request.tracer is
+None`` zero-cost rule is untouched.
 """
 
 from __future__ import annotations
@@ -24,8 +36,9 @@ from __future__ import annotations
 import threading
 import time
 import uuid
+from collections import OrderedDict, deque
 from contextlib import contextmanager
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 
 @dataclass
@@ -58,9 +71,11 @@ class TraceContext:
 
 
 class Tracer:
-    def __init__(self, trace_id: "str | None" = None):
+    def __init__(self, trace_id: "str | None" = None, sampled: bool = True):
         self._t0 = time.perf_counter()
         self.trace_id = trace_id or uuid.uuid4().hex[:16]
+        # rides the wire context: remote sides record spans only when set
+        self.sampled = sampled
         self._mu = threading.Lock()
         self._tls = threading.local()
         self._seq = 0
@@ -100,7 +115,7 @@ class Tracer:
 
     # -- wire ----------------------------------------------------------------
     def context(self) -> TraceContext:
-        return TraceContext(self.trace_id)
+        return TraceContext(self.trace_id, self.sampled)
 
     def to_pb(self) -> list[list]:
         """Finished spans in wire form: [name, start_s, duration_s, depth],
@@ -126,6 +141,16 @@ class Tracer:
                 self._seq += 1
                 self.spans.append(sp)
 
+    def dump(self) -> list[list]:
+        """Structured spans for the trace reservoir / JSON surfaces:
+        [name, start_ms, duration_ms, depth, node], (start, seq)-ordered."""
+        with self._mu:
+            spans = sorted(self.spans, key=lambda s: (s.start_s, s.seq))
+        return [
+            [s.name, round(s.start_s * 1e3, 3), round(s.duration_s * 1e3, 3), s.depth, s.node]
+            for s in spans
+        ]
+
     # -- rendering -----------------------------------------------------------
     def rows(self) -> list[tuple]:
         with self._mu:
@@ -137,3 +162,88 @@ class Tracer:
                 label += f" @{s.node}"
             out.append((label, f"{s.start_s * 1e3:.3f}ms", f"{s.duration_s * 1e3:.3f}ms"))
         return out
+
+
+def effective(tracer) -> "Tracer | None":
+    """The tracer a recording seam should actually use: None when tracing is
+    off OR the context is explicitly unsampled (``TraceContext.sampled=0``).
+    The single home of the zero-cost gating rule — every span-recording seam
+    (cop clients, MPP dispatch) routes through this, so an unsampled tracer
+    behaves byte-identically to no tracer at all."""
+    if tracer is None or not getattr(tracer, "sampled", True):
+        return None
+    return tracer
+
+
+# -- trace reservoir ---------------------------------------------------------
+
+
+@dataclass
+class TraceEntry:
+    """One finished sampled statement in the reservoir."""
+
+    trace_id: str
+    time: float  # unix seconds the statement finished
+    sql: str
+    digest: str
+    duration_s: float
+    slow: bool  # crossed the slow-log threshold → tail-keep pinned
+    spans: list = field(default_factory=list)  # Tracer.dump() rows
+
+
+class TraceReservoir:
+    """Bounded store of recent sampled traces (ref: Dapper's sampled-trace
+    collection; GWP's always-on-with-a-budget discipline). Two sections:
+
+    - a ring of the N most recent sampled traces (FIFO eviction);
+    - *tail-keep*: traces of statements over the slow-log threshold are
+      additionally pinned in their own (smaller) ring, so a latency outlier
+      survives long after ordinary ring rotation would have dropped it —
+      regardless of how many fast sampled statements follow.
+
+    No background threads: deposits happen on the statement's own thread,
+    reads under one lock. Surfaced via ``GET /traces`` and
+    ``information_schema.trace_reservoir``; the slow log cross-links entries
+    by ``trace_id``."""
+
+    def __init__(self, capacity: int = 64, slow_capacity: int = 32):
+        self._mu = threading.Lock()
+        self._ring: deque = deque(maxlen=max(int(capacity), 1))
+        self._slow: "OrderedDict[str, TraceEntry]" = OrderedDict()
+        self.slow_capacity = max(int(slow_capacity), 1)
+
+    def add(self, entry: TraceEntry) -> None:
+        with self._mu:
+            self._ring.append(entry)
+            if entry.slow:
+                self._slow[entry.trace_id] = entry
+                while len(self._slow) > self.slow_capacity:
+                    self._slow.popitem(last=False)
+
+    def get(self, trace_id: str) -> "TraceEntry | None":
+        with self._mu:
+            hit = self._slow.get(trace_id)
+            if hit is not None:
+                return hit
+            for e in self._ring:
+                if e.trace_id == trace_id:
+                    return e
+        return None
+
+    def traces(self) -> list[TraceEntry]:
+        """Every retained trace, oldest first: tail-keep entries that have
+        already rotated out of the ring, then the ring itself."""
+        with self._mu:
+            ring_ids = {e.trace_id for e in self._ring}
+            pinned = [e for tid, e in self._slow.items() if tid not in ring_ids]
+            return sorted(pinned + list(self._ring), key=lambda e: e.time)
+
+    def __len__(self) -> int:
+        with self._mu:
+            ring_ids = {e.trace_id for e in self._ring}
+            return len(self._ring) + sum(1 for t in self._slow if t not in ring_ids)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._ring.clear()
+            self._slow.clear()
