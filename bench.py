@@ -143,6 +143,24 @@ def qps_point_select(db) -> float:
     return concurrent_qps(db, worker, QPS_THREADS, QPS_ITERS, setup=setup)
 
 
+def qps_point_select_cold(db) -> float:
+    """Cold-session point selects: a FRESH session per query over text SQL —
+    the short-lived-connection serving shape. The instance-level AST cache
+    and the cross-session point-get batcher are what keep this within reach
+    of the warm-session number."""
+    db.execute("CREATE TABLE qps_c (id BIGINT PRIMARY KEY, v BIGINT)")
+    db.execute("INSERT INTO qps_c VALUES " + ",".join(f"({i},{i * 3})" for i in range(1000)))
+    db.query("SELECT v FROM qps_c WHERE id = 0")
+
+    def worker(_s, i, k):
+        s2 = db.session()
+        rows = s2.query(f"SELECT v FROM qps_c WHERE id = {(i * 7 + k) % 16}")
+        if len(rows) != 1:  # never inside an assert: python -O strips it
+            raise RuntimeError(f"cold point select returned {len(rows)} rows")
+
+    return concurrent_qps(db, worker, QPS_THREADS, QPS_ITERS)
+
+
 def qps_q1_concurrent(db) -> float:
     """Q1 under concurrency: N sessions hammer the same warm aggregation —
     measures how much of the fixed SQL-layer tax survives parallel load
@@ -304,6 +322,7 @@ def main():
             return None
 
     qps_ps = qps(qps_point_select, "point_select")
+    qps_cold = qps(qps_point_select_cold, "point_select_cold")
     qps_q1 = qps(qps_q1_concurrent, "q1_concurrent")
 
     s.execute("SET tidb_isolation_read_engines = 'host'")
@@ -347,6 +366,7 @@ def main():
             # fast lane attacks (parse/plan reuse, shared pool, digest memo)
             "fixed_overhead_ms": round(cnt_tpu * 1e3, 1),
             "qps_point_select": round(qps_ps, 1) if qps_ps else None,
+            "qps_point_select_cold": round(qps_cold, 1) if qps_cold else None,
             "qps_q1_concurrent": round(qps_q1, 2) if qps_q1 else None,
             "count_host_ms": round(cnt_host * 1e3, 1),
             "q10_topn_tpu_ms": round(q10_tpu * 1e3, 1),

@@ -1,0 +1,421 @@
+"""Instance-level serving architecture: the cross-session plan/AST cache
+(planner/instcache.py + the copy-on-execute template discipline in
+planner/prepcache.py) and the cross-session point-get batcher
+(copr/client.py + the batched snap_batch_get verb).
+
+Ref: tidb_enable_instance_plan_cache (plan_cache_instance.go) and TiKV's
+batch-commands stream (client-go batch_client.go)."""
+
+import threading
+import time
+
+import pytest
+
+import tidb_tpu
+from tidb_tpu.parser import parse_count
+from tidb_tpu.planner import prepcache
+
+
+@pytest.fixture()
+def db():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, a BIGINT, s VARCHAR(20))")
+    d.execute(
+        "INSERT INTO t VALUES " + ",".join(f"({i}, {i * 10}, 'v{i}')" for i in range(1, 9))
+    )
+    return d
+
+
+# -- cross-session AST reuse (the cold-connection parse skip) ----------------
+
+
+def test_fresh_sessions_skip_parser(db):
+    q = "SELECT a FROM t WHERE id = 3"
+    assert db.session().query(q) == [(30,)]  # one session warms the instance
+    n0 = parse_count()
+    for _ in range(5):
+        s = db.session()  # the short-lived-connection shape
+        assert s.query(q) == [(30,)]
+    assert parse_count() == n0, "fresh sessions must reuse the instance AST"
+
+
+def test_fresh_sessions_planner_statement_no_reparse(db):
+    q = "SELECT COUNT(*) FROM t WHERE a > 30"
+    assert db.session().query(q) == [(5,)]
+    n0 = parse_count()
+    for _ in range(3):
+        assert db.session().query(q) == [(5,)]
+    assert parse_count() == n0
+
+
+def test_instance_ast_metric_counts(db):
+    from tidb_tpu.utils.metrics import INSTANCE_PLAN_CACHE
+
+    q = "SELECT a FROM t WHERE id = 7"
+    h0 = INSTANCE_PLAN_CACHE.get(result="ast_hit")
+    db.session().query(q)
+    db.session().query(q)
+    assert INSTANCE_PLAN_CACHE.get(result="ast_hit") == h0 + 1
+
+
+def test_session_bindings_bypass_instance_ast(db):
+    # a session carrying SESSION-scoped bindings must not publish/serve
+    # shared ASTs (its substitution is invisible to other sessions)
+    a = db.session()
+    q = "SELECT a FROM t WHERE a > 25 ORDER BY a LIMIT 2"
+    a.execute(
+        "CREATE BINDING FOR SELECT a FROM t WHERE a > 25 ORDER BY a LIMIT 2 "
+        "USING SELECT a FROM t WHERE a > 25 ORDER BY a DESC LIMIT 2"
+    )
+    assert a.query(q) == [(80,), (70,)]
+    b = db.session()
+    assert b.query(q) == [(30,), (40,)], "A's session binding leaked cross-session"
+
+
+# -- cross-session plan templates (copy-on-execute) --------------------------
+
+
+def test_template_shared_across_sessions(db):
+    text = "SELECT id FROM t WHERE id > ? ORDER BY id"
+    a = db.session()
+    na = a.prepare(text)
+    assert a.execute_prepared(na, [6]).rows == [(7,), (8,)]
+    b = db.session()
+    nb = b.prepare(text)
+    # b's FIRST execute rides a's template: planner skipped, fresh params
+    assert b.execute_prepared(nb, [2]).rows == [(3,), (4,), (5,), (6,), (7,), (8,)]
+    assert b.vars["last_plan_from_cache"] == 1
+
+
+def test_plan_immutability_audit(db):
+    """The correctness backstop for copy-on-execute: deep-snapshot the
+    cached template, execute it from two threads with different parameters,
+    assert the shared template bytes never change and each thread sees its
+    OWN parameters' rows (no shared-Constant races)."""
+    text = "SELECT id FROM t WHERE id >= ? AND id <= ? ORDER BY id"
+    s = db.session()
+    nm = s.prepare(text)
+    assert s.execute_prepared(nm, [2, 4]).rows == [(2,), (3,), (4,)]
+    tmpls = [v for v in db.inst_plan_cache.values() if isinstance(v, prepcache.PlanTemplate)]
+    assert len(tmpls) == 1, "first EXECUTE must publish exactly one template"
+    fp0 = prepcache.plan_fingerprint(tmpls[0].plan)
+
+    errors: list = []
+    barrier = threading.Barrier(2)
+
+    def run(lo, hi, expected):
+        try:
+            ses = db.session()
+            n = ses.prepare(text)
+            barrier.wait()
+            for _ in range(40):
+                rows = ses.execute_prepared(n, [lo, hi]).rows
+                if rows != expected:
+                    errors.append((lo, hi, rows))
+                    return
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    t1 = threading.Thread(target=run, args=(1, 3, [(1,), (2,), (3,)]))
+    t2 = threading.Thread(target=run, args=(5, 8, [(5,), (6,), (7,), (8,)]))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert not errors, f"concurrent executions corrupted each other: {errors[:3]}"
+    assert prepcache.plan_fingerprint(tmpls[0].plan) == fp0, (
+        "the shared template's bytes changed under execution"
+    )
+
+
+def test_ddl_in_one_session_invalidates_templates(db):
+    text = "SELECT id FROM t WHERE id > ? ORDER BY id"
+    a = db.session()
+    na = a.prepare(text)
+    a.execute_prepared(na, [6])
+    db.execute("CREATE TABLE t_ddl_bump (x BIGINT)")  # schema_version++
+    b = db.session()
+    nb = b.prepare(text)
+    b.execute_prepared(nb, [6])
+    assert b.vars["last_plan_from_cache"] == 0, "stale-epoch template served after DDL"
+    b.execute_prepared(nb, [3])
+    assert b.vars["last_plan_from_cache"] == 1  # rebuilt and republished
+
+
+def test_analyze_in_one_session_invalidates_templates(db):
+    db.execute("CREATE TABLE ti2 (k BIGINT, v BIGINT)")
+    db.execute("INSERT INTO ti2 VALUES (1, 100), (2, 200), (2, 201)")
+    db.execute("CREATE INDEX ik2 ON ti2 (k)")
+    text = "SELECT v FROM ti2 WHERE k = ? ORDER BY v"
+    a = db.session()
+    na = a.prepare(text)
+    assert a.execute_prepared(na, [2]).rows == [(200,), (201,)]
+    db.execute("ANALYZE TABLE ti2")  # stats version bump
+    b = db.session()
+    nb = b.prepare(text)
+    assert b.execute_prepared(nb, [1]).rows == [(100,)]
+    assert b.vars["last_plan_from_cache"] == 0
+    assert b.execute_prepared(nb, [2]).rows == [(200,), (201,)]
+    assert b.vars["last_plan_from_cache"] == 1
+
+
+def test_global_binding_invalidates_instance_ast(db):
+    q = "SELECT a FROM t WHERE a > 25 ORDER BY a LIMIT 2"
+    assert db.session().query(q) == [(30,), (40,)]
+    db.execute(
+        "CREATE GLOBAL BINDING FOR SELECT a FROM t WHERE a > 25 ORDER BY a LIMIT 2 "
+        "USING SELECT a FROM t WHERE a > 25 ORDER BY a DESC LIMIT 2"
+    )
+    assert db.session().query(q) == [(80,), (70,)], "stale pre-binding AST served"
+    db.execute("DROP GLOBAL BINDING FOR SELECT a FROM t WHERE a > 25 ORDER BY a LIMIT 2")
+    assert db.session().query(q) == [(30,), (40,)]
+
+
+def test_disable_sysvar_restores_per_session(db):
+    db.execute("SET GLOBAL tidb_enable_instance_plan_cache = 0")
+    q = "SELECT a FROM t WHERE id = 5"
+    assert db.session().query(q) == [(50,)]
+    n0 = parse_count()
+    assert db.session().query(q) == [(50,)]
+    assert parse_count() == n0 + 1, "disabled instance cache must re-parse per session"
+    text = "SELECT id FROM t WHERE id > ? ORDER BY id"
+    a = db.session()
+    na = a.prepare(text)
+    a.execute_prepared(na, [6])
+    b = db.session()
+    nb = b.prepare(text)
+    b.execute_prepared(nb, [6])
+    assert b.vars["last_plan_from_cache"] == 0, "per-session mode leaked a's template"
+    # the session-local lane still warms as before
+    b.execute_prepared(nb, [3])
+    assert b.vars["last_plan_from_cache"] == 1
+
+
+# -- value-agnostic rebuild hooks: index merge + pruned partitions -----------
+
+
+def merge_db():
+    d = tidb_tpu.open()
+    d.execute("CREATE TABLE tm (id BIGINT PRIMARY KEY, a BIGINT, b BIGINT)")
+    d.execute("INSERT INTO tm VALUES " + ",".join(f"({i}, {i % 10}, {i % 7})" for i in range(100)))
+    d.execute("CREATE INDEX ia ON tm (a)")
+    d.execute("CREATE INDEX ib ON tm (b)")
+    return d
+
+
+def test_index_merge_prepared_template():
+    d = merge_db()
+    # the shape really is an IndexMerge (no single index serves the OR)
+    (line,) = [r[0] for r in d.query("EXPLAIN SELECT id FROM tm WHERE a = 3 OR b = 2") if "IndexMerge" in r[0]]
+    assert "union" in line
+    s = d.session()
+    nm = s.prepare("SELECT id FROM tm WHERE a = ? OR b = ? ORDER BY id")
+    exp = lambda x, y: sorted((i,) for i in range(100) if i % 10 == x or i % 7 == y)  # noqa: E731
+    assert s.execute_prepared(nm, [3, 2]).rows == exp(3, 2)
+    assert s.execute_prepared(nm, [5, 6]).rows == exp(5, 6)
+    assert s.vars["last_plan_from_cache"] == 1, "index-merge plans must ride the template lane now"
+    # and cross-session
+    b = d.session()
+    nb = b.prepare("SELECT id FROM tm WHERE a = ? OR b = ? ORDER BY id")
+    assert b.execute_prepared(nb, [1, 4]).rows == exp(1, 4)
+    assert b.vars["last_plan_from_cache"] == 1
+
+
+def test_partition_pruned_prepared_template():
+    d = tidb_tpu.open()
+    d.execute(
+        "CREATE TABLE tp (id BIGINT PRIMARY KEY, v BIGINT) PARTITION BY RANGE (id) ("
+        "PARTITION p0 VALUES LESS THAN (100),"
+        "PARTITION p1 VALUES LESS THAN (200),"
+        "PARTITION p2 VALUES LESS THAN (300))"
+    )
+    d.execute("INSERT INTO tp VALUES " + ",".join(f"({i},{i * 2})" for i in range(0, 300, 10)))
+    s = d.session()
+    nm = s.prepare("SELECT id, v FROM tp WHERE id > ? AND id < ? ORDER BY id")
+    assert s.execute_prepared(nm, [10, 40]).rows == [(20, 40), (30, 60)]
+    # the cached plan's parameter moves to ANOTHER partition: the pruner
+    # rebuild must re-route (a baked p0-only pruning would return nothing)
+    assert s.execute_prepared(nm, [110, 140]).rows == [(120, 240), (130, 260)]
+    assert s.vars["last_plan_from_cache"] == 1
+    # straddling two partitions through the same cached plan
+    assert s.execute_prepared(nm, [90, 120]).rows == [(100, 200), (110, 220)]
+    assert s.vars["last_plan_from_cache"] == 1
+
+
+# -- cross-session point-get batching ----------------------------------------
+
+
+def test_pointget_batch_coalesces_concurrent_sessions(db, monkeypatch):
+    """The acceptance gate: N concurrent sessions' point gets must issue
+    measurably fewer store dispatches than gets (batch histogram: count =
+    dispatches, sum = keys). The store lookup is slowed a few ms so flushes
+    genuinely overlap — batching then comes from the queue-while-in-flight
+    rule, exactly the batch-commands idiom."""
+    from tidb_tpu.kv import memstore as _ms
+    from tidb_tpu.utils.metrics import POINTGET_BATCH
+
+    orig = _ms.MemStore.snap_batch_get
+
+    def slow(self, pairs):
+        time.sleep(0.003)
+        return orig(self, pairs)
+
+    monkeypatch.setattr(_ms.MemStore, "snap_batch_get", slow)
+    n_threads, iters = 4, 10
+    n0, s0 = POINTGET_BATCH.count, POINTGET_BATCH._sum
+    barrier = threading.Barrier(n_threads)
+    errors: list = []
+
+    def run(i):
+        try:
+            barrier.wait()
+            for k in range(iters):
+                s = db.session()  # fresh session per query: the cold shape
+                rows = s.query(f"SELECT a FROM t WHERE id = {(i + k) % 8 + 1}")
+                if len(rows) != 1:
+                    errors.append(rows)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    keys = POINTGET_BATCH._sum - s0
+    dispatches = POINTGET_BATCH.count - n0
+    assert keys == n_threads * iters
+    assert dispatches < keys, (
+        f"no coalescing: {dispatches} dispatches for {keys} point gets"
+    )
+
+
+def test_pointget_batch_results_correct_under_concurrency(db):
+    barrier = threading.Barrier(6)
+    errors: list = []
+
+    def run(i):
+        try:
+            s = db.session()
+            barrier.wait()
+            for k in range(30):
+                h = (i * 3 + k) % 8 + 1
+                rows = s.query(f"SELECT id, a FROM t WHERE id = {h}")
+                if rows != [(h, h * 10)]:
+                    errors.append((h, rows))
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, f"batched point gets crossed sessions: {errors[:3]}"
+
+
+def test_batch_point_get_in_list_single_dispatch(db):
+    from tidb_tpu.utils.metrics import POINTGET_BATCH
+
+    n0 = POINTGET_BATCH.count
+    assert db.session().query("SELECT id FROM t WHERE id IN (1, 3, 5)") == [(1,), (3,), (5,)]
+    assert POINTGET_BATCH.count == n0 + 1, "an IN-list must be one batched dispatch"
+
+
+def test_memstore_batch_isolates_locked_key():
+    from tidb_tpu.kv.kv import KeyLockedError
+    from tidb_tpu.kv.memstore import MemStore, Mutation, OP_PUT
+
+    ms = MemStore(region_split_keys=1000)
+    ms.ingest([b"clean"], [b"v"])
+    ms.prewrite([Mutation(OP_PUT, b"locked", b"x")], b"locked", ms.tso.ts())
+    ts = ms.current_ts()
+    out = ms.snap_batch_get([(ts, b"locked"), (ts, b"clean"), (ts, b"absent")])
+    assert isinstance(out[0], KeyLockedError)
+    assert out[1] == b"v"
+    assert out[2] is None
+
+
+def test_remote_snap_batch_get_single_rpc():
+    from tidb_tpu.kv.memstore import MemStore
+    from tidb_tpu.kv.remote import RemoteStore, StoreServer
+
+    ms = MemStore(region_split_keys=1000)
+    ms.ingest([b"a", b"b", b"c"], [b"1", b"2", b"3"])
+    srv = StoreServer(ms)
+    srv.start()
+    try:
+        rs = RemoteStore("127.0.0.1", srv.port)
+        calls: list = []
+        orig = RemoteStore._call
+
+        def counting(self, header, blobs=(), **kw):
+            calls.append(header["cmd"])
+            return orig(self, header, blobs, **kw)
+
+        RemoteStore._call = counting
+        try:
+            ts = rs.current_ts()
+            calls.clear()
+            vals = rs.snap_batch_get([(ts, b"a"), (ts, b"zz"), (ts, b"c")])
+        finally:
+            RemoteStore._call = orig
+        assert vals == [b"1", None, b"3"]
+        assert calls == ["snap_batch_get"], f"expected one RPC, saw {calls}"
+    finally:
+        srv.shutdown()
+
+
+def test_sharded_snap_batch_get_routes_by_shard():
+    from tidb_tpu.kv import tablecodec
+    from tidb_tpu.kv.memstore import MemStore
+    from tidb_tpu.kv.rowcodec import encode_row
+    from tidb_tpu.kv.sharded import ShardedStore
+
+    fleet = ShardedStore([MemStore(region_split_keys=1000) for _ in range(3)])
+    # place two tables on (deterministically) different shards
+    keys = {}
+    for tid in (11, 12, 13):
+        k = tablecodec.record_key(tid, 1)
+        fleet.store_for_key(k).ingest([k], [b"row%d" % tid])
+        keys[tid] = k
+    # direct per-shard ingest bypasses the fleet TSO high-water sync — read
+    # at a ts that covers every shard's mint, or the test races shard clocks
+    ts = max(s.current_ts() for s in fleet.stores)
+    out = fleet.snap_batch_get([(ts, keys[11]), (ts, keys[12]), (ts, keys[13])])
+    assert out == [b"row11", b"row12", b"row13"]
+    _ = encode_row  # silence linters: imported to mirror prod encoding path
+
+
+def test_batcher_follower_rides_leader_flush():
+    """Deterministic unit check of the queue-while-in-flight rule: a reader
+    arriving during the leader's (slowed) flush is served by the leader's
+    NEXT flush, as one batch, without spawning threads of its own."""
+    from tidb_tpu.copr.client import PointGetBatcher
+    from tidb_tpu.kv.memstore import MemStore
+
+    ms = MemStore(region_split_keys=1000)
+    ms.ingest([b"x", b"y"], [b"1", b"2"])
+    batches: list = []
+    orig = ms.snap_batch_get
+
+    def spy(pairs):
+        batches.append(len(pairs))
+        time.sleep(0.01)
+        return orig(pairs)
+
+    ms.snap_batch_get = spy
+    b = PointGetBatcher(ms)
+    ts = ms.current_ts()
+    started = threading.Event()
+
+    def leader():
+        started.set()
+        assert b.get_many(ts, [b"x"]) == [b"1"]
+
+    t = threading.Thread(target=leader)
+    t.start()
+    started.wait()
+    time.sleep(0.002)  # land inside the leader's in-flight flush
+    assert b.get_many(ts, [b"y", b"x"]) == [b"2", b"1"]
+    t.join()
+    assert batches[0] == 1 and sum(batches) == 3
+    assert len(batches) == 2, f"follower keys must coalesce into one flush: {batches}"

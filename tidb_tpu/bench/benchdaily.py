@@ -238,6 +238,36 @@ def bench_qps_point_select() -> float:
     return concurrent_qps(db, worker, 4, 250, setup=setup)
 
 
+@register("qps_point_select_cold")
+def bench_qps_point_select_cold() -> float:
+    """COLD-session point-select throughput (ops/s, higher is better): a
+    FRESH session per query over text SQL — the short-lived-connection
+    shape that dominates at millions-of-users scale. Exercises exactly the
+    instance-level serving architecture: the cross-session AST cache skips
+    the parse every fresh session used to pay, and concurrent lookups
+    coalesce in the point-get batcher. Guarded under --check next to
+    ``qps_point_select`` so cold-path serving throughput cannot regress
+    silently."""
+    import tidb_tpu
+    from tidb_tpu.bench.qps import concurrent_qps
+
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE qc (id BIGINT PRIMARY KEY, v BIGINT)")
+    db.execute("INSERT INTO qc VALUES " + ",".join(f"({i},{i * 3})" for i in range(500)))
+    # rotate over a small statement set so the text-keyed instance cache
+    # warms in the first few queries and stays hot (matching a production
+    # workload's finite statement population)
+    db.query("SELECT v FROM qc WHERE id = 0")
+
+    def worker(_s, i, k):
+        s2 = db.session()  # the cold connection: no per-session warm state
+        rows = s2.query(f"SELECT v FROM qc WHERE id = {(i * 7 + k) % 16}")
+        if len(rows) != 1:  # never inside an assert: python -O strips it
+            raise RuntimeError(f"cold point select returned {len(rows)} rows")
+
+    return concurrent_qps(db, worker, 4, 250)
+
+
 @register("owner_failover_ms")
 def bench_owner_failover() -> float:
     """Owner-election failover latency (ms, lower is better): a 3-shard

@@ -46,6 +46,13 @@ class Config:
     # traces pin into a separate slow_capacity//2 section on top)
     trace_sample_rate: float = 0.0
     trace_reservoir_size: int = 64
+    # [perf] instance-level serving: capacity (entries) of EACH cross-session
+    # cache (statement ASTs / plan templates, planner/instcache.py), and the
+    # optional point-get batcher collection window in microseconds — 0 keeps
+    # coalescing purely opportunistic (zero added latency: batches form from
+    # readers that land while a flush is already in flight)
+    instance_plan_cache_size: int = 512
+    pointget_batch_window_us: float = 0.0
     # [security]
     ssl_enabled: bool = False
     ssl_cert: str = ""
@@ -82,6 +89,13 @@ class Config:
         obs = raw.get("observability", {})
         cfg.trace_sample_rate = float(obs.get("trace-sample-rate", cfg.trace_sample_rate))
         cfg.trace_reservoir_size = int(obs.get("trace-reservoir-size", cfg.trace_reservoir_size))
+        perf = raw.get("perf", {})
+        cfg.instance_plan_cache_size = int(
+            perf.get("instance-plan-cache-size", cfg.instance_plan_cache_size)
+        )
+        cfg.pointget_batch_window_us = float(
+            perf.get("pointget-batch-window-us", cfg.pointget_batch_window_us)
+        )
         sec = raw.get("security", {})
         cfg.ssl_cert = sec.get("ssl-cert", cfg.ssl_cert)
         cfg.ssl_key = sec.get("ssl-key", cfg.ssl_key)

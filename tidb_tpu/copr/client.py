@@ -141,6 +141,114 @@ def windowed_fanout(pool, run: Callable, items: list, window: int):
     return gen(), cancel
 
 
+# -- cross-session point-get batcher ----------------------------------------
+
+
+class PointGetBatcher:
+    """Coalesces concurrent snapshot point reads against ONE store into
+    batched multi-key lookups (ref: TiKV's batch-commands stream — client-go
+    batch_client.go merges whatever is queued when the stream frees up).
+
+    Opportunistic, zero added latency: the first arriving thread becomes the
+    flusher and dispatches its keys immediately; readers that land while a
+    flush is in flight queue up and ride the NEXT flush as one batch. N
+    concurrent sessions therefore pay one RPC + one store dispatch instead
+    of N, while an uncontended reader dispatches exactly as fast as before.
+    An optional collection window ([perf] pointget-batch-window-us) lets the
+    flusher sleep sub-ms per round to grow batches at a latency cost.
+
+    Outcomes are delivered PER KEY (bytes | None | exception): one session's
+    locked key or dead shard never fails the strangers sharing its batch.
+    The flusher runs on the submitting thread — no background threads to
+    leak (conftest thread-hygiene stays clean)."""
+
+    def __init__(self, store, window_s: float = 0.0):
+        self._store = store
+        self._mu = threading.Lock()
+        self._pending: list = []  # (read_ts, key, Future)
+        self._flushing = False
+        self.window_s = window_s
+
+    def get_many(self, read_ts: int, keys: list) -> list:
+        """Submit this session's keys; returns values in key order, raising
+        the first per-key error (same surface as sequential snapshot gets)."""
+        from concurrent.futures import Future
+
+        futs = [Future() for _ in keys]
+        with self._mu:
+            self._pending.extend((read_ts, k, f) for k, f in zip(keys, futs))
+            lead = not self._flushing
+            if lead:
+                self._flushing = True
+        if lead:
+            self._drain()
+        out = []
+        for f in futs:
+            v = f.result()
+            if isinstance(v, BaseException):
+                raise v
+            out.append(v)
+        return out
+
+    def _lookup(self, pairs) -> list:
+        bg = getattr(self._store, "snap_batch_get", None)
+        if bg is not None:
+            return bg(pairs)
+        # store without a batched verb: per-key reads, per-key outcomes
+        out = []
+        for ts, k in pairs:
+            try:
+                out.append(self._store.get_snapshot(ts).get(k))
+            except Exception as e:
+                out.append(e)
+        return out
+
+    def _drain(self) -> None:
+        from tidb_tpu.utils import metrics as _m
+
+        while True:
+            if self.window_s > 0:
+                time.sleep(self.window_s)
+            with self._mu:
+                batch, self._pending = self._pending, []
+                if not batch:
+                    self._flushing = False
+                    return
+            try:
+                vals = self._lookup([(ts, k) for ts, k, _ in batch])
+            except BaseException as e:
+                # whole-dispatch failure: every key in THIS flush shares it
+                vals = [e] * len(batch)
+            _m.POINTGET_BATCH.observe(len(batch))
+            for (_, _, f), v in zip(batch, vals):
+                f.set_result(v)
+
+
+_BATCHER_MU = threading.Lock()
+
+
+def point_batcher(store) -> PointGetBatcher:
+    """The per-store batcher (lazily attached — sessions of one DB share the
+    store object, so they share the batcher)."""
+    b = getattr(store, "_pointget_batcher", None)
+    if b is None:
+        with _BATCHER_MU:
+            b = getattr(store, "_pointget_batcher", None)
+            if b is None:
+                from tidb_tpu import config as _config
+
+                b = PointGetBatcher(
+                    store, window_s=_config.current().pointget_batch_window_us / 1e6
+                )
+                store._pointget_batcher = b
+    return b
+
+
+def batched_point_get(store, read_ts: int, keys: list) -> list:
+    """Snapshot point reads through the store's cross-session batcher."""
+    return point_batcher(store).get_many(read_ts, keys)
+
+
 @dataclass
 class CopTask:
     region: Region

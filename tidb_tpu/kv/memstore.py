@@ -200,20 +200,39 @@ class Snapshot:
                 return w
         return None
 
+    def _get_locked(self, key: bytes) -> Optional[bytes]:
+        """One key's read under the store mutex (caller holds it)."""
+        self._store._check_lock(key, self.read_ts)
+        writes = self._store._writes.get(key)
+        w = self._visible(writes) if writes else None
+        # newest-version-wins across layers: a dict verdict only hides a
+        # stable row committed before it
+        floor_ts = w.commit_ts if w is not None else 0
+        stable = self._store._stable_get(key, self.read_ts, after_ts=floor_ts)
+        if stable is not None:
+            return stable
+        if w is not None:
+            return None if w.op == OP_DEL else w.value
+        return None
+
     def get(self, key: bytes) -> Optional[bytes]:
         with self._store._mu:
-            self._store._check_lock(key, self.read_ts)
-            writes = self._store._writes.get(key)
-            w = self._visible(writes) if writes else None
-            # newest-version-wins across layers: a dict verdict only hides a
-            # stable row committed before it
-            floor_ts = w.commit_ts if w is not None else 0
-            stable = self._store._stable_get(key, self.read_ts, after_ts=floor_ts)
-            if stable is not None:
-                return stable
-            if w is not None:
-                return None if w.op == OP_DEL else w.value
-            return None
+            return self._get_locked(key)
+
+    def get_many(self, keys) -> list:
+        """Vectorized multi-key read: ONE lock acquisition for the whole
+        batch (the embedded analog of a batched store RPC). Per-key lock
+        conflicts come back as ``KeyLockedError`` OUTCOMES in the result
+        list — one session's locked key must never fail the other sessions'
+        reads coalesced into the same batch."""
+        out: list = []
+        with self._store._mu:
+            for k in keys:
+                try:
+                    out.append(self._get_locked(k))
+                except KeyLockedError as e:
+                    out.append(e)
+        return out
 
     def scan(self, kr: KeyRange, limit: int = 2**63, reverse: bool = False) -> list[tuple[bytes, bytes]]:
         """Eager scan — materializes under the store lock, never holds it
@@ -404,6 +423,22 @@ class MemStore:
 
     def get_snapshot(self, ts: int) -> Snapshot:
         return Snapshot(self, ts)
+
+    def snap_batch_get(self, pairs) -> list:
+        """Batched snapshot point reads: ``[(read_ts, key)]`` →
+        ``[bytes | None | KeyLockedError]`` in request order. Same-ts keys
+        share one snapshot and one lock acquisition (Snapshot.get_many) —
+        the vectorized multi-key lookup the cross-session point-get batcher
+        (copr/client.py) amortizes N sessions' reads onto."""
+        out: list = [None] * len(pairs)
+        by_ts: dict = {}
+        for i, (ts, k) in enumerate(pairs):
+            by_ts.setdefault(ts, []).append((i, k))
+        for ts, items in by_ts.items():
+            vals = self.get_snapshot(ts).get_many([k for _, k in items])
+            for (i, _), v in zip(items, vals):
+                out[i] = v
+        return out
 
     def begin(self):
         from tidb_tpu.kv.txn import Txn

@@ -255,6 +255,22 @@ class StoreServer:
         if cmd == "snap_get":
             v = st.get_snapshot(h["ts"]).get(_ub(h["key"]))
             return ({"hit": v is not None}, [v] if v is not None else [])
+        if cmd == "snap_batch_get":
+            # batched point reads (TiKV batch-commands idiom): N keys, one
+            # RPC, one vectorized store lookup. Per-key lock conflicts ship
+            # as per-key verdicts — one locked key must not fail the batch.
+            outs = st.snap_batch_get([(ts, _ub(kb)) for ts, kb in h["gets"]])
+            results = []
+            vals = []
+            for v in outs:
+                if isinstance(v, KeyLockedError):
+                    results.append({"err": "KeyLocked", "key": _b(v.key), "lock": _lock_pb(v.lock)})
+                elif v is None:
+                    results.append({"hit": 0})
+                else:
+                    results.append({"hit": 1})
+                    vals.append(v)
+            return {"gets": results}, vals
         if cmd == "snap_scan":
             kr = KeyRange(_ub(h["start"]), _ub(h["end"]))
             pairs = st.get_snapshot(h["ts"]).scan(kr, limit=h.get("limit", 2**63), reverse=h.get("reverse", False))
@@ -861,6 +877,28 @@ class RemoteStore:
 
     def get_snapshot(self, ts: int) -> _RemoteSnapshot:
         return _RemoteSnapshot(self, ts)
+
+    def snap_batch_get(self, pairs) -> list:
+        """Batched snapshot point reads: ``[(read_ts, key)]`` →
+        ``[bytes | None | KeyLockedError]``. ONE replay-safe RPC instead of
+        one per key — the wire half of the cross-session point-get batcher
+        (N sessions pay one round trip + one store dispatch)."""
+        if not pairs:
+            return []
+        h, blobs = self._call(
+            {"cmd": "snap_batch_get", "gets": [[ts, _b(k)] for ts, k in pairs]}
+        )
+        out: list = []
+        bi = 0
+        for r in h["gets"]:
+            if r.get("err") == "KeyLocked":
+                out.append(KeyLockedError(_ub(r["key"]), _lock_from_pb(r["lock"])))
+            elif r.get("hit"):
+                out.append(blobs[bi])
+                bi += 1
+            else:
+                out.append(None)
+        return out
 
     def begin(self):
         from tidb_tpu.kv.txn import Txn

@@ -448,6 +448,48 @@ class ShardedStore:
     def get_snapshot(self, ts: int) -> _ShardedSnapshot:
         return _ShardedSnapshot(self, ts)
 
+    def snap_batch_get(self, pairs) -> list:
+        """Batched snapshot point reads across the fleet: table keys group
+        by their owner shard and ride that shard's own batched verb (one
+        RPC per remote shard per flush), outcomes scatter back in request
+        order. Failures stay per-key/per-shard OUTCOMES — a dead shard or a
+        locked key fails only its own sessions' reads, never the strangers
+        coalesced into the same batch."""
+        from tidb_tpu.kv.kv import KeyLockedError
+
+        out: list = [None] * len(pairs)
+        groups: dict = {}
+        for i, (ts, k) in enumerate(pairs):
+            if not self.is_table_key(k):
+                # meta keyspace: authority read with replica failover
+                try:
+                    out[i] = self._authority_call(
+                        lambda st, ts=ts, k=k: st.get_snapshot(ts).get(k)
+                    )
+                except (KeyLockedError, ConnectionError, OSError) as e:
+                    out[i] = e
+                continue
+            st = self.store_for_key(k)
+            groups.setdefault(id(st), (st, []))[1].append((i, ts, k))
+        for st, items in groups.values():
+            sub = [(ts, k) for _, ts, k in items]
+            try:
+                bg = getattr(st, "snap_batch_get", None)
+                if bg is not None:
+                    vals = bg(sub)
+                else:
+                    vals = []
+                    for ts, k in sub:
+                        try:
+                            vals.append(st.get_snapshot(ts).get(k))
+                        except KeyLockedError as e:
+                            vals.append(e)
+            except (ConnectionError, OSError) as e:
+                vals = [e] * len(sub)
+            for (i, _, _), v in zip(items, vals):
+                out[i] = v
+        return out
+
     def begin(self):
         from tidb_tpu.kv.txn import Txn
 

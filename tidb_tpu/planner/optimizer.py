@@ -492,47 +492,24 @@ def _try_index_merge(scan: LogicalScan, conds: list[Expression], stats=None):
     if len(disjuncts) < 2:
         return None
     paths = []
+    makers = []
+    path_conds = []
     est_rows = 0.0
     tstats = stats.get(t.id) if stats is not None else None
     for d in disjuncts:
         conjs: list[Expression] = []
         _flatten_bool(d, "and", conjs)
-        # PK-as-handle path: only point/two-sided ranges qualify (a one-sided
-        # bound is a near-full scan and would sink the union without stats)
-        hr = _derive_ranges(scan, conjs)
-        path = None
-        if hr is not None:
-            spans = [tablecodec.range_to_handles(kr, t.id) for kr in hr]
-            if all(-(2**62) < lo and hi < 2**62 for lo, hi in spans):
-                path = ("table", hr)
-                if tstats is not None and tstats.row_count > 0:
-                    # PK paths cost lookups too: a wide handle range must
-                    # count against the merge, not ride for free
-                    est_rows += min(
-                        float(sum(hi - lo for lo, hi in spans)), float(tstats.row_count)
-                    )
-        if path is None:
-            best = None
-            for idx in t.indexes:
-                if not _idx_eligible(scan, idx):
-                    continue
-                acc = ranger.detach_index_conditions(conjs, scan.schema, t, idx)
-                if acc is None or not acc.used:
-                    continue
-                key = (acc.eq_prefix_len, idx.unique, acc.has_range)
-                if best is None or key > best[0]:
-                    best = (key, acc)
-            if best is not None:
-                path = ("idx", best[1].index, best[1].ranges)
-                if tstats is not None and tstats.row_count > 0:
-                    from tidb_tpu.statistics.selectivity import estimate_selectivity
-
-                    est_rows += tstats.row_count * estimate_selectivity(
-                        best[1].used, scan.schema, tstats
-                    )
+        path, est = _merge_path_for(scan, conjs, tstats)
         if path is None:
             return None  # one unindexable disjunct sinks the whole merge
+        est_rows += est
         paths.append(path)
+        path_conds.append(tuple(conjs))
+        # value-agnostic rebuild hook: pure function of the disjunct's
+        # conjunction, so cloned plan instances re-derive from their OWN
+        # cloned conditions (stats omitted — the shape is already chosen,
+        # the rebuild only refreshes ranges)
+        makers.append(lambda cs, scan=scan: _merge_path_for(scan, list(cs), None)[0])
     # cost gate (ref: the index-merge path pruning by row estimates): random
     # handle lookups must beat the columnar full scan
     if not scan.use_index_merge and tstats is not None and tstats.row_count > 0:
@@ -546,7 +523,48 @@ def _try_index_merge(scan: LogicalScan, conds: list[Expression], stats=None):
         residual_conditions=list(conds),
         all_conditions=list(conds),
         schema=scan.schema,
+        path_makers=makers,
+        path_conds=path_conds,
     )
+
+
+def _merge_path_for(scan: LogicalScan, conjs: list[Expression], tstats):
+    """One disjunct's index-merge access path: a bounded PK handle range
+    (point/two-sided only — a one-sided bound is a near-full scan and would
+    sink the union without stats) or the best single-index detachment.
+    Returns ``(path, est_rows)``; ``(None, 0.0)`` when the disjunct is
+    unindexable. Shared by plan-time derivation and the value-agnostic
+    rebuild (which passes ``tstats=None`` — the estimate is only consulted
+    by the plan-time cost gate)."""
+    t = scan.table
+    hr = _derive_ranges(scan, conjs)
+    if hr is not None:
+        spans = [tablecodec.range_to_handles(kr, t.id) for kr in hr]
+        if all(-(2**62) < lo and hi < 2**62 for lo, hi in spans):
+            est = 0.0
+            if tstats is not None and tstats.row_count > 0:
+                # PK paths cost lookups too: a wide handle range must
+                # count against the merge, not ride for free
+                est = min(float(sum(hi - lo for lo, hi in spans)), float(tstats.row_count))
+            return ("table", hr), est
+    best = None
+    for idx in t.indexes:
+        if not _idx_eligible(scan, idx):
+            continue
+        acc = ranger.detach_index_conditions(conjs, scan.schema, t, idx)
+        if acc is None or not acc.used:
+            continue
+        key = (acc.eq_prefix_len, idx.unique, acc.has_range)
+        if best is None or key > best[0]:
+            best = (key, acc)
+    if best is None:
+        return None, 0.0
+    est = 0.0
+    if tstats is not None and tstats.row_count > 0:
+        from tidb_tpu.statistics.selectivity import estimate_selectivity
+
+        est = tstats.row_count * estimate_selectivity(best[1].used, scan.schema, tstats)
+    return ("idx", best[1].index, best[1].ranges), est
 
 
 def _index_path_for(scan: LogicalScan, idx, conds: list[Expression]):
@@ -564,14 +582,17 @@ def _build_index_access(scan: LogicalScan, acc, conds: list[Expression]):
         oc.slot in acc.index.column_offsets or (t.pk_is_handle and oc.slot == t.pk_offset)
         for oc in scan.schema
     )
-    # value-agnostic prepared plans re-run the detachment over the SAME
-    # condition objects after parameter mutation; range_used_ids lets the
-    # rebuild verify the used/residual split did not shift under the new
-    # values (shifted split → the cached plan must not be reused)
-    maker = lambda cs=tuple(conds), scan=scan, t=t, idx=acc.index: (  # noqa: E731
+    # value-agnostic prepared plans re-run the detachment over the plan
+    # instance's OWN condition objects (``range_conds``, cloned per
+    # execution) after parameter mutation; range_used_pos lets the rebuild
+    # verify the used/residual split did not shift under the new values
+    # (shifted split → the cached plan must not be reused). Positional,
+    # so the check survives copy-on-execute cloning.
+    maker = lambda cs, scan=scan, t=t, idx=acc.index: (  # noqa: E731
         ranger.detach_index_conditions(list(cs), scan.schema, t, idx)
     )
-    used_ids = frozenset(id(c) for c in acc.used)
+    acc_used = {id(c) for c in acc.used}
+    used_pos = frozenset(i for i, c in enumerate(conds) if id(c) in acc_used)
     if covering:
         output_slots = [
             -1 if (t.pk_is_handle and oc.slot == t.pk_offset) else oc.slot for oc in scan.schema
@@ -586,7 +607,8 @@ def _build_index_access(scan: LogicalScan, acc, conds: list[Expression]):
             all_conditions=list(conds),
             schema=scan.schema,
             range_maker=maker,
-            range_used_ids=used_ids,
+            range_conds=tuple(conds),
+            range_used_pos=used_pos,
         )
     return PhysIndexLookUp(
         db=scan.db,
@@ -598,7 +620,8 @@ def _build_index_access(scan: LogicalScan, acc, conds: list[Expression]):
         all_conditions=list(conds),
         schema=scan.schema,
         range_maker=maker,
-        range_used_ids=used_ids,
+        range_conds=tuple(conds),
+        range_used_pos=used_pos,
     )
 
 
@@ -718,15 +741,28 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None, vars=None) -> P
                 if r is not None:
                     child.ranges = r
                 # value-agnostic prepared plans re-derive handle ranges from
-                # the SAME condition objects after parameter mutation; table
-                # ranges only narrow the scan (conditions still filter), so
-                # any rebuild outcome — including None (full scan) — is safe
+                # the plan instance's OWN conditions (cloned per execution)
+                # after parameter mutation; table ranges only narrow the scan
+                # (conditions still filter), so any rebuild outcome —
+                # including None (full scan) — is safe
                 child.range_maker = (
-                    lambda scan0=scan0, cs=tuple(pushable): _derive_ranges(scan0, list(cs))
+                    lambda cs, scan0=scan0: _derive_ranges(scan0, list(cs))
                 )
+                child.range_conds = tuple(pushable)
                 if plan.children[0].table.partition is not None:
                     from tidb_tpu.planner.partition import prune_partitions
 
+                    if scan0.partition_select is None:
+                        # value-agnostic rebuild hook: re-prune per execution
+                        # so a parameter moving to another partition re-routes
+                        # (explicit PARTITION (p, ...) selections stay baked —
+                        # such plans refuse the template)
+                        child.partition_pruner = (
+                            lambda cs, t=child.table, sch=plan.children[0].schema: (
+                                prune_partitions(t, sch, list(cs))
+                            )
+                        )
+                        child.partition_conds = tuple(plan.conditions)
                     pruned = prune_partitions(
                         child.table, plan.children[0].schema, plan.conditions
                     )

@@ -229,10 +229,19 @@ class PhysTableReader(PhysicalPlan):
     # ref: rule_partition_processor pruning + PartitionIDAndRanges)
     partitions: Optional[list] = None
     # re-derives ``ranges`` from the (possibly parameter-mutated) pushed
-    # conditions — the value-agnostic prepared-plan cache calls this per
-    # EXECUTE (ref: RebuildPlan4CachedPlan re-running ranger); None on plans
-    # whose ranges never came from conditions
+    # conditions — the value-agnostic prepared-plan cache calls
+    # ``range_maker(range_conds)`` per EXECUTE (ref: RebuildPlan4CachedPlan
+    # re-running ranger); None on plans whose ranges never came from
+    # conditions. The maker is a PURE function of the condition tuple so a
+    # cloned plan instance (copy-on-execute) rebuilds from its OWN cloned
+    # conditions, never the template's.
     range_maker: Optional[object] = field(default=None, repr=False, compare=False)
+    range_conds: Optional[tuple] = field(default=None, repr=False, compare=False)
+    # partitioned tables: ``partition_pruner(partition_conds)`` re-prunes the
+    # partition set per execution — a cached plan whose parameter moved to a
+    # different partition must re-route, not serve the plan-time pruning
+    partition_pruner: Optional[object] = field(default=None, repr=False, compare=False)
+    partition_conds: Optional[tuple] = field(default=None, repr=False, compare=False)
 
 
 @dataclass
@@ -255,13 +264,16 @@ class PhysIndexReader(PhysicalPlan):
     all_conditions: list[Expression] = field(default_factory=list)
     schema: Schema = field(default_factory=list)
     children: list = field(default_factory=list)
-    # value-agnostic prepared-plan support: re-runs index-range detachment
-    # over the parameter-mutated conditions; ``range_used_ids`` snapshots
-    # which condition objects the ranges consumed at plan time — a rebuild
-    # that consumes a different set means the cached residual split is no
-    # longer valid and the whole statement must re-plan
+    # value-agnostic prepared-plan support: ``range_maker(range_conds)``
+    # re-runs index-range detachment over the parameter-mutated conditions;
+    # ``range_used_pos`` snapshots WHICH positions of ``range_conds`` the
+    # ranges consumed at plan time — a rebuild that consumes a different set
+    # means the cached residual split is no longer valid and the whole
+    # statement must re-plan. Positional (not object-identity) so the check
+    # survives copy-on-execute cloning.
     range_maker: Optional[object] = field(default=None, repr=False, compare=False)
-    range_used_ids: Optional[frozenset] = field(default=None, repr=False, compare=False)
+    range_conds: Optional[tuple] = field(default=None, repr=False, compare=False)
+    range_used_pos: Optional[frozenset] = field(default=None, repr=False, compare=False)
 
 
 @dataclass
@@ -280,9 +292,10 @@ class PhysIndexLookUp(PhysicalPlan):
     all_conditions: list[Expression] = field(default_factory=list)
     schema: Schema = field(default_factory=list)
     children: list = field(default_factory=list)
-    # same contract as PhysIndexReader.range_maker / range_used_ids
+    # same contract as PhysIndexReader.range_maker / range_used_pos
     range_maker: Optional[object] = field(default=None, repr=False, compare=False)
-    range_used_ids: Optional[frozenset] = field(default=None, repr=False, compare=False)
+    range_conds: Optional[tuple] = field(default=None, repr=False, compare=False)
+    range_used_pos: Optional[frozenset] = field(default=None, repr=False, compare=False)
 
 
 @dataclass
@@ -305,6 +318,13 @@ class PhysIndexMerge(PhysicalPlan):
     all_conditions: list[Expression] = field(default_factory=list)
     schema: Schema = field(default_factory=list)
     children: list = field(default_factory=list)
+    # value-agnostic prepared-plan support: ``path_makers[i](path_conds[i])``
+    # re-derives path i's access ranges from its (parameter-mutated) disjunct
+    # conjunction. Tightness is not load-bearing — the executor re-applies
+    # the full condition list after the fetch — but a path whose SHAPE shifts
+    # (table↔index, or a different winning index) forces a re-plan.
+    path_makers: Optional[list] = field(default=None, repr=False, compare=False)
+    path_conds: Optional[list] = field(default=None, repr=False, compare=False)
 
 
 @dataclass

@@ -158,25 +158,33 @@ def _to_logical(v, ft):
 
 
 def run_point_get(session, plan: PointGetPlan) -> list[tuple]:
-    """One KV get per handle through the txn-aware read path (membuffer
-    overlay first, then MVCC snapshot at the session read ts)."""
+    """KV gets for the plan's handles through the txn-aware read path
+    (membuffer overlay first, then MVCC snapshot at the session read ts).
+    Autocommit snapshot reads ride the cross-session point-get batcher:
+    concurrent sessions' lookups coalesce into one multi-key store dispatch
+    (TiKV batch-commands idiom) instead of one RPC each."""
     from tidb_tpu.kv import tablecodec
     from tidb_tpu.kv.rowcodec import RowSchema, decode_row
 
     txn = session._txn
-    snap = None if txn is not None else session.store.get_snapshot(session.read_ts())
     schema = RowSchema(plan.table.storage_schema)
-    out: list[tuple] = []
-    for handle in plan.handles:
-        key = tablecodec.record_key(plan.table.id, handle)
-        if txn is not None:
+    keys = [tablecodec.record_key(plan.table.id, h) for h in plan.handles]
+    if txn is None:
+        from tidb_tpu.copr.client import batched_point_get
+
+        raws = batched_point_get(session.store, session.read_ts(), keys)
+    else:
+        raws = []
+        for key in keys:
             if txn.membuf.is_deleted(key):
+                raws.append(None)
                 continue
             raw = txn.membuf.get(key) if txn.membuf.contains(key) else None
             if raw is None:
                 raw = txn.get(key)
-        else:
-            raw = snap.get(key)
+            raws.append(raw)
+    out: list[tuple] = []
+    for raw in raws:
         if raw is None:
             continue
         vals = decode_row(schema, raw)

@@ -68,6 +68,11 @@ DEFAULT_SYSVARS = {
     "tidb_mem_oom_action": "CANCEL",
     # session plan cache capacity (ref: tidb_prepared_plan_cache_size)
     "tidb_prepared_plan_cache_size": 100,
+    # instance-level (cross-session) plan/AST cache (ref:
+    # tidb_enable_instance_plan_cache): ON by default here — short-lived
+    # connections are the serving shape this repro optimizes for; 0 restores
+    # strictly per-session caching
+    "tidb_enable_instance_plan_cache": 1,
     # 1 when the previous statement's plan came from the plan cache
     # (ref: last_plan_from_cache status var)
     "last_plan_from_cache": 0,
@@ -461,6 +466,21 @@ class Session:
         )
 
     # -- entry points --------------------------------------------------------
+    def _instance_cache_on(self) -> bool:
+        """Cross-session plan/AST reuse (ref: tidb_enable_instance_plan_cache)."""
+        return bool(sysvar_int(self.vars, "tidb_enable_instance_plan_cache", 1))
+
+    def _inst_stmt_key(self, sql: str) -> tuple:
+        """Instance AST-cache key: everything session-shaped that changes
+        what ``parse`` + binding substitution would produce rides the KEY
+        (validity epochs ride the entry — see execute())."""
+        return (
+            sql,
+            self.current_db,
+            str(self.vars.get("tidb_isolation_read_engines")),
+            str(self.vars.get("sql_mode", "")),
+        )
+
     def _stmt_epoch(self) -> tuple:
         """Statement fast-lane validity snapshot: any change here (DDL,
         ANALYZE, binding create/drop, engine isolation, sql_mode, schema
@@ -508,6 +528,29 @@ class Session:
                 entry = cached
             else:
                 self._stmt_cache.pop(sql, None)
+        # instance (cross-session) AST lane: a FRESH session reuses the warm
+        # AST another session parsed — the short-lived-connection shape.
+        # ASTs bake nothing schema/stats-shaped (planning re-derives from the
+        # live catalog), so the entry's only epoch is the GLOBAL binding
+        # version; session-local bindings bypass the shared lane entirely.
+        inst_stmt_key = None
+        inst_entry: Optional[_CachedStmt] = None
+        if entry is None and not self.bindings and self._instance_cache_on():
+            inst_stmt_key = self._inst_stmt_key(sql)
+            ie = self._db.inst_stmt_cache.get(inst_stmt_key)
+            if ie is not None:
+                self._db.ensure_schema_lease()
+                if ie.epoch == (self._db.bindings_ver,):
+                    _m.INSTANCE_PLAN_CACHE.inc(result="ast_hit")
+                    inst_entry = ie
+                    entry = _CachedStmt(ie.stmt, ie.stype, self._stmt_epoch(), ie.exec_sql)
+                    entry.digest = ie.digest
+                    self._stmt_cache[sql] = entry
+                    cap = sysvar_int(self.vars, "tidb_prepared_plan_cache_size", 100)
+                    while len(self._stmt_cache) > cap:
+                        self._stmt_cache.popitem(last=False)
+                else:
+                    self._db.inst_stmt_cache.pop(inst_stmt_key)
         if entry is not None:
             stmt, stype, exec_sql = entry.stmt, entry.stype, entry.exec_sql
         else:
@@ -546,8 +589,15 @@ class Session:
                 cap = sysvar_int(self.vars, "tidb_prepared_plan_cache_size", 100)
                 while len(self._stmt_cache) > cap:
                     self._stmt_cache.popitem(last=False)
+                if inst_stmt_key is not None:
+                    # this probe missed above → publish for other sessions
+                    _m.INSTANCE_PLAN_CACHE.inc(result="ast_miss")
+                    inst_entry = _CachedStmt(stmt, stype, (self._db.bindings_ver,), exec_sql)
+                    self._db.inst_stmt_cache.put(inst_stmt_key, inst_entry)
         # one digest per statement, shared by bindings/Top-SQL/stmt-summary
-        # (previously computed up to three times per statement)
+        # (previously computed up to three times per statement); the memo
+        # writes through to the INSTANCE entry too, so the whole fleet of
+        # short-lived sessions sharing one AST computes the digest once
         digest_cache = [entry.digest if entry is not None else None]
 
         def sql_digest() -> str:
@@ -557,6 +607,8 @@ class Session:
                 digest_cache[0] = _digest(exec_sql)
                 if entry is not None:
                     entry.digest = digest_cache[0]
+                if inst_entry is not None:
+                    inst_entry.digest = digest_cache[0]
             return digest_cache[0]
 
         self._stmt_count += 1
@@ -1102,6 +1154,7 @@ class Session:
         index merges, partition pruning, subquery snapshots) fall back to
         the old value-keyed cache after the first miss."""
         from tidb_tpu.planner import prepcache
+        from tidb_tpu.utils import metrics as _m
 
         sig = tuple(prepcache.param_sig(p) for p in params)
         va_key = self._plan_cache_key(("__va__", ps.text, sig))
@@ -1109,13 +1162,32 @@ class Session:
         # (drop an index merge, remove partitioning) into a templatable one,
         # so a refusal must not outlive the schema/stats that caused it
         refuse_key = (ps.text, sig, self.catalog.schema_version, self._db.stats.version)
-        tmpl = self._plan_cache.get(va_key)
+        # instance (cross-session) template lane: the same epoch-carrying key
+        # a session would use, plus sql_mode (sessions were previously the
+        # isolation boundary for it). Disabled → the session-local store.
+        inst_on = self._instance_cache_on()
+        inst_key = None
+        if inst_on:
+            inst_key = self._plan_cache_key(
+                ("__iva__", ps.text, sig, str(self.vars.get("sql_mode", "")))
+            )
+            tmpl = self._db.inst_plan_cache.get(inst_key)
+        else:
+            tmpl = self._plan_cache.get(va_key)
         if isinstance(tmpl, prepcache.PlanTemplate):
-            if prepcache.rebind(tmpl, params):
-                self._plan_cache.move_to_end(va_key)
+            # copy-on-execute: rebind a private clone of the shared template
+            # (param constants + range/partition/path state), so concurrent
+            # sessions executing the same template never race and the cached
+            # template bytes never change
+            inst = prepcache.instantiate(tmpl)
+            if prepcache.rebind(inst, params):
+                if inst_on:
+                    _m.INSTANCE_PLAN_CACHE.inc(result="hit")
+                else:
+                    self._plan_cache.move_to_end(va_key)
                 cap = {
                     "outer_stmt": ps.stmt,
-                    "cached_plan": tmpl.plan,
+                    "cached_plan": inst.plan,
                     "n_params": len(params),
                     "rebind": lambda: ast.bind_params(ps.stmt, params),
                 }
@@ -1125,9 +1197,13 @@ class Session:
                 finally:
                     self._prep_capture = prev
             # the new values shifted the range derivation (e.g. a NULL
-            # dropped an access condition): this plan can't serve them —
-            # drop it and re-plan below
-            self._plan_cache.pop(va_key, None)
+            # dropped an access condition): the cached plan can't serve THIS
+            # execution — re-plan below (and republish, overwriting). The
+            # shared entry stays for the sessions whose values keep the
+            # original shape: one session's atypical parameters must not
+            # keep destroying every other session's cache.
+        if inst_on:
+            _m.INSTANCE_PLAN_CACHE.inc(result="miss")
         if refuse_key in self._prep_va_refused:
             # statement proven non-agnostic: old behavior, values in the key
             bound = ast.bind_params(ps.stmt, params)
@@ -1145,10 +1221,15 @@ class Session:
         finally:
             self._prep_capture = prev
         if cap.get("template") is not None:
-            self._plan_cache[va_key] = cap["template"]
-            cap_n = sysvar_int(self.vars, "tidb_prepared_plan_cache_size", 100)
-            while len(self._plan_cache) > cap_n:
-                self._plan_cache.popitem(last=False)
+            if inst_on:
+                # publish for EVERY session of this instance; the template
+                # keeps the first execution's plan pristine (clone-on-hit)
+                self._db.inst_plan_cache.put(inst_key, cap["template"])
+            else:
+                self._plan_cache[va_key] = cap["template"]
+                cap_n = sysvar_int(self.vars, "tidb_prepared_plan_cache_size", 100)
+                while len(self._plan_cache) > cap_n:
+                    self._plan_cache.popitem(last=False)
         elif cap.get("point_get"):
             if len(self._prep_pg_keys) > 512:
                 self._prep_pg_keys.clear()
@@ -1909,6 +1990,17 @@ class DB:
             self.global_vars.setdefault(
                 "tidb_tpu_trace_sample_rate", _config.current().trace_sample_rate
             )
+        # instance-level (cross-session) serving caches (ref:
+        # tidb_enable_instance_plan_cache): statement-text → AST and the
+        # value-agnostic prepared-plan templates, shared by every session of
+        # this DB. Lock-striped LRUs; entries carry validity epochs in their
+        # keys (templates) or entry epoch (ASTs), so invalidation is
+        # miss-and-rebuild, never a global flush.
+        from tidb_tpu.planner.instcache import InstancePlanCache
+
+        _icap = _config.current().instance_plan_cache_size
+        self.inst_stmt_cache = InstancePlanCache(_icap)
+        self.inst_plan_cache = InstancePlanCache(_icap)
         # global SQL plan bindings: digest → (for_text, using_text)
         # (ref: pkg/bindinfo binding_handle)
         self.bindings: dict[str, tuple[str, str]] = {}

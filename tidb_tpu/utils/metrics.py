@@ -235,3 +235,18 @@ MPP_SHARD_SECONDS = REGISTRY.histogram(
     "tidb_tpu_mpp_shard_seconds",
     "Per-shard MPP fragment completion wall (launch to shard-local finish)",
 )
+# instance-level serving architecture (planner/instcache + the point-get
+# batcher in copr/client): cross-session cache outcomes, and how many
+# concurrent point reads each batched store dispatch coalesced (count =
+# dispatches issued, sum = keys served — count << sum proves batching)
+INSTANCE_PLAN_CACHE = REGISTRY.counter(
+    "tidb_tpu_instance_plan_cache_total",
+    "Instance (cross-session) cache lookups: hit/miss = plan templates, "
+    "ast_hit/ast_miss = statement ASTs",
+    ("result",),
+)
+POINTGET_BATCH = REGISTRY.histogram(
+    "tidb_tpu_pointget_batch_size",
+    "Point-get keys coalesced per batched store dispatch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)
