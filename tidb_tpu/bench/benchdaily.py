@@ -268,6 +268,160 @@ def bench_qps_point_select_cold() -> float:
     return concurrent_qps(db, worker, 4, 250)
 
 
+def _delta_bench_env(block_rows: int, cap: int, merge_rows: int):
+    """Context manager shrinking the device block + delta knobs so the daily
+    lanes exercise the multi-block delta/merge machinery at bench scale."""
+    import contextlib
+
+    from tidb_tpu import config as _config
+    from tidb_tpu.copr import colcache, tpu_engine
+
+    @contextlib.contextmanager
+    def ctx():
+        import dataclasses
+
+        old_block = (tpu_engine._BLOCK, colcache.DEVICE_BLOCK_ROWS)
+        old_cfg = _config.current()
+        tpu_engine._BLOCK = colcache.DEVICE_BLOCK_ROWS = block_rows
+        _config.set_current(
+            dataclasses.replace(
+                old_cfg,
+                device_delta_cap=cap,
+                device_delta_merge_rows=merge_rows,
+                device_delta_min_rows=1,
+            )
+        )
+        try:
+            yield
+        finally:
+            tpu_engine._BLOCK, colcache.DEVICE_BLOCK_ROWS = old_block
+            _config.set_current(old_cfg)
+
+    return ctx()
+
+
+@register("freshness_lag_ms")
+def bench_freshness_lag() -> float:
+    """DML → first fresh ``tpu``-engine read (ms, lower is better): a warm
+    aggregation over a loaded table, a point-UPDATE burst, then the clock
+    times the NEXT tpu query — which must return the fresh sum through the
+    delta operand with NO full re-upload (asserted via the H2D counter; a
+    regression to invalidate-and-reload fails the lane outright)."""
+    import time as _t
+
+    import numpy as np
+
+    import tidb_tpu
+    from tidb_tpu.executor.load import bulk_load
+    from tidb_tpu.utils import metrics as _m
+
+    with _delta_bench_env(block_rows=1 << 22, cap=1024, merge_rows=512):
+        db = tidb_tpu.open()
+        db.execute("CREATE TABLE fl (id BIGINT PRIMARY KEY, v BIGINT)")
+        n = 200_000
+        bulk_load(db, "fl", [np.arange(n, dtype=np.int64), np.full(n, 3, dtype=np.int64)])
+        s = db.session()
+        s.execute("SET tidb_isolation_read_engines = 'tpu'")
+        q = "SELECT COUNT(*), SUM(v) FROM fl"
+        s.query(q)
+        base = s.query(q)  # warm: device columns resident
+        burst = 200
+        s.execute(f"UPDATE fl SET v = v + 1 WHERE id < {burst}")
+        h2d0 = _m.DEVICE_TRANSFER.get(dir="h2d")
+        t0 = _t.perf_counter()
+        fresh = s.query(q)
+        dt_ms = (_t.perf_counter() - t0) * 1000
+        h2d = _m.DEVICE_TRANSFER.get(dir="h2d") - h2d0
+        if fresh[0][1] != base[0][1] + burst:  # never inside an assert (python -O)
+            raise RuntimeError(f"stale read after DML: {fresh} vs base {base}")
+        if h2d >= n * 8:  # a full column re-upload = the old invalidate path
+            raise RuntimeError(f"freshness read re-uploaded the base ({h2d} bytes)")
+        return dt_ms
+
+
+@register("incremental_load_ms")
+def bench_incremental_load() -> float:
+    """Append 1% rows to a warm multi-block table, re-run the aggregation
+    (ms, lower is better): the merge must carry clean-block device arrays
+    and re-upload ONLY the dirty tail block — asserted against the warm-up
+    upload volume via the H2D counter."""
+    import time as _t
+
+    import numpy as np
+
+    import tidb_tpu
+    from tidb_tpu.executor.load import bulk_load
+    from tidb_tpu.utils import metrics as _m
+
+    with _delta_bench_env(block_rows=65536, cap=1024, merge_rows=512):
+        db = tidb_tpu.open(region_split_keys=1 << 62)
+        db.execute("CREATE TABLE il (id BIGINT PRIMARY KEY, v BIGINT)")
+        n = 240_000
+        bulk_load(db, "il", [np.arange(n, dtype=np.int64), np.arange(n, dtype=np.int64) % 97])
+        s = db.session()
+        s.execute("SET tidb_isolation_read_engines = 'tpu'")
+        q = "SELECT COUNT(*), SUM(v) FROM il"
+        h2d_start = _m.DEVICE_TRANSFER.get(dir="h2d")
+        s.query(q)
+        s.query(q)  # warm
+        warm_bytes = _m.DEVICE_TRANSFER.get(dir="h2d") - h2d_start
+        extra = n // 100
+        bulk_load(db, "il", [np.arange(n, n + extra, dtype=np.int64), np.zeros(extra, dtype=np.int64)])
+        h2d0 = _m.DEVICE_TRANSFER.get(dir="h2d")
+        t0 = _t.perf_counter()
+        out = s.query(q)
+        dt_ms = (_t.perf_counter() - t0) * 1000
+        h2d = _m.DEVICE_TRANSFER.get(dir="h2d") - h2d0
+        if out[0][0] != n + extra:  # never inside an assert (python -O)
+            raise RuntimeError(f"append not visible: {out[0][0]} rows")
+        if warm_bytes and h2d >= warm_bytes * 0.6:
+            raise RuntimeError(
+                f"incremental load re-uploaded too much ({h2d}/{warm_bytes} bytes)"
+            )
+        return dt_ms
+
+
+@register("qps_q1_concurrent")
+def bench_qps_q1_concurrent() -> float:
+    """Q1-shaped concurrent analytics throughput (ops/s, higher is better)
+    on a scaled-down 2M-row table — the daily regression gate the full-size
+    ``qps_q1_concurrent`` headline lane (bench.py) never had: N sessions
+    hammer the same warm grouped aggregation on the ``tpu`` engine."""
+    import numpy as np
+
+    import tidb_tpu
+    from tidb_tpu.bench.qps import concurrent_qps
+    from tidb_tpu.executor.load import bulk_load
+
+    db = tidb_tpu.open(region_split_keys=1 << 62)
+    db.execute("CREATE TABLE q1c (id BIGINT PRIMARY KEY, g VARCHAR(2), v BIGINT)")
+    n = 2_000_000
+    rng = np.random.default_rng(1)
+    bulk_load(
+        db,
+        "q1c",
+        [
+            np.arange(n, dtype=np.int64),
+            np.array([b"aa", b"bb", b"cc"], dtype="S2")[rng.integers(0, 3, n)],
+            rng.integers(0, 1000, n),
+        ],
+    )
+    q = "SELECT g, COUNT(*), SUM(v) FROM q1c GROUP BY g"
+
+    def setup(s, i):
+        s.execute("SET tidb_isolation_read_engines = 'tpu'")
+        s.query(q)  # warm: compile + device residency per session
+
+    def worker(s, i, k):
+        rows = s.query(q)
+        if len(rows) != 3:  # never inside an assert (python -O)
+            raise RuntimeError(f"q1c returned {len(rows)} groups")
+
+    # 2 × 12 = 24 timed executions: enough samples for a stable ops/s
+    # baseline under check_regression (a 6-op window was scheduler noise)
+    return concurrent_qps(db, worker, 2, 12, setup=setup)
+
+
 @register("owner_failover_ms")
 def bench_owner_failover() -> float:
     """Owner-election failover latency (ms, lower is better): a 3-shard

@@ -53,6 +53,18 @@ class Config:
     # readers that land while a flush is already in flight)
     instance_plan_cache_size: int = 512
     pointget_batch_window_us: float = 0.0
+    # [perf] delta+merge device column cache (copr/colcache.py): DML lands in
+    # bounded per-(region, table) delta overlays the device kernel reads as
+    # ``base ⊕ delta``. device-delta-cap is the FIXED kernel delta-operand
+    # capacity (rows; a query past it forces a merge — part of the compile
+    # cache key, so keep it stable per process); device-delta-merge-rows is
+    # the background compactor's fold threshold; device-delta-min-rows is the
+    # smallest base entry worth delta-tracking (smaller tables just rebuild —
+    # their upload is trivial and the delta kernel variant would only burn a
+    # compile)
+    device_delta_cap: int = 8192
+    device_delta_merge_rows: int = 2048
+    device_delta_min_rows: int = 65536
     # [security]
     ssl_enabled: bool = False
     ssl_cert: str = ""
@@ -95,6 +107,13 @@ class Config:
         )
         cfg.pointget_batch_window_us = float(
             perf.get("pointget-batch-window-us", cfg.pointget_batch_window_us)
+        )
+        cfg.device_delta_cap = int(perf.get("device-delta-cap", cfg.device_delta_cap))
+        cfg.device_delta_merge_rows = int(
+            perf.get("device-delta-merge-rows", cfg.device_delta_merge_rows)
+        )
+        cfg.device_delta_min_rows = int(
+            perf.get("device-delta-min-rows", cfg.device_delta_min_rows)
         )
         sec = raw.get("security", {})
         cfg.ssl_cert = sec.get("ssl-cert", cfg.ssl_cert)

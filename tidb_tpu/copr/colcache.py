@@ -1,9 +1,15 @@
 """Region column cache — MVCC rows materialized as device-ready columns.
 
-Reference parity: TiFlash's delta/stable columnar replica, collapsed to a
-rebuild-on-write-epoch cache. Keyed by (region_id, table_id); an entry is
-valid while the region's data_version is unchanged and the read_ts is at or
-past the entry's build snapshot (any such snapshot observes identical data).
+Reference parity: TiFlash's delta tree (delta layer + stable layer + a
+background merge). Keyed by (region_id, table_id); a cached base entry is
+pinned at its build version, and committed writes after it land in a small
+:class:`DeltaOverlay` (fresh rows, updated rows, delete tombstones keyed by
+row handle) fed by the store's change log — analytics reads see
+``base ⊕ delta`` without rebuilding or re-uploading the base. A merge
+(:meth:`ColumnCache._merge` — threshold-triggered on the query path, swept
+by the session-level compactor) folds the delta into a fresh base, carrying
+per-device-block version tags (``RegionColumns.block_vers``) for blocks
+whose content provably did not change, so only dirty blocks re-enter HBM.
 
 String columns dictionary-encode against a per-(table, column) dictionary
 shared across regions, so group-by/join codes are globally consistent; a
@@ -13,17 +19,85 @@ ordering predicates, which remaps codes in every cached region of that column.
 
 from __future__ import annotations
 
+import os
 import threading
+import time as _time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 import numpy as np
 
 from tidb_tpu.kv import KeyRange, tablecodec
+from tidb_tpu.kv.kv import KeyLockedError
 from tidb_tpu.kv.memstore import MemStore, Region
 from tidb_tpu.kv.rowcodec import RowSchema, decode_fixed_bulk, decode_strings_bulk
 from tidb_tpu.types import FieldType, TypeKind
+from tidb_tpu.utils import execdetails as _ed
+from tidb_tpu.utils import failpoint
+from tidb_tpu.utils import metrics as _metrics
 from tidb_tpu.utils.chunk import Dictionary
+
+# device block granularity of the merge's dirty-block accounting; MUST match
+# tpu_engine._BLOCK (both read the same env knob). A mismatch only costs
+# carry precision, never correctness: an engine block spanning carry blocks
+# with disagreeing tags falls back to the entry's own data_version.
+DEVICE_BLOCK_ROWS = int(os.environ.get("TIDB_TPU_DEVICE_BLOCK_ROWS", str(1 << 22)))
+
+
+def _delta_limits() -> tuple[int, int, int]:
+    """(delta_cap, merge_rows, min_rows) from the effective config:
+    ``delta_cap`` is the fixed kernel delta-operand capacity (a query-path
+    merge triggers past it), ``merge_rows`` the background compactor's fold
+    threshold, ``min_rows`` the smallest base entry worth delta-tracking
+    (smaller tables rebuild outright — their upload cost is trivial and the
+    delta kernel variant would only burn a compile)."""
+    from tidb_tpu import config as _config
+
+    cfg = _config.current()
+    return (
+        int(getattr(cfg, "device_delta_cap", 8192)),
+        int(getattr(cfg, "device_delta_merge_rows", 2048)),
+        int(getattr(cfg, "device_delta_min_rows", 65536)),
+    )
+
+
+@dataclass
+class DeltaOverlay:
+    """Committed row changes on top of a pinned base entry: sorted touched
+    handles with per-handle tombstone verdicts and decoded column lanes for
+    the surviving (PUT) rows. The device DAG reads ``base ⊕ delta`` — every
+    delta handle masks its base row; non-tombstone rows union in fresh."""
+
+    handles: np.ndarray  # sorted distinct touched handles, int64
+    tomb: np.ndarray  # bool, aligned: visible version at built_ts is a delete
+    data_version: int
+    built_ts: int
+    # True iff this overlay covers every commit in the region at build time
+    complete: bool = True
+    # slot → (data, valid), aligned to ``handles`` (tombstone rows zeroed)
+    cols: dict[int, tuple[np.ndarray, np.ndarray]] = field(default_factory=dict)
+    _buf: bytes = b""
+    _starts: np.ndarray | None = None
+    _put_rows: np.ndarray | None = None  # indices into handles that are PUTs
+    _minmax: dict = field(default_factory=dict)
+
+    @property
+    def n(self) -> int:
+        return len(self.handles)
+
+    @property
+    def n_put(self) -> int:
+        return len(self._put_rows) if self._put_rows is not None else 0
+
+    def minmax(self, slot: int):
+        """(min, max) over valid PUT values, None when none are valid."""
+        mm = self._minmax.get(slot)
+        if mm is None:
+            d, v = self.cols[slot]
+            lv = d[v]
+            mm = (int(lv.min()), int(lv.max())) if lv.size else None
+            self._minmax[slot] = mm
+        return mm
 
 
 @dataclass
@@ -58,6 +132,36 @@ class RegionColumns:
     # per-slot (min, max) over valid values, computed lazily — feeds the
     # packed window-sort key (binder._window_bounds)
     _minmax: dict = field(default_factory=dict)
+    # per-DEVICE_BLOCK_ROWS-block version tags carried across merges: a block
+    # whose content provably did not change keeps its previous tag, so its
+    # device arrays stay valid in the HBM LRU (None → data_version everywhere)
+    block_vers: list | None = None
+    # device-facing version pinned at build time: revalidation (a sibling
+    # table's commit bumped the region version without touching this table)
+    # advances data_version but must NOT change device-cache identities
+    dev_version: int = -1
+    # region bounds at build time — a split/merge since then invalidates the
+    # entry even when data_version did not move
+    range_start: bytes = b""
+    range_end: bytes = b""
+
+    def vtag_span(self, lo: int, hi: int):
+        """Device-cache version tag for rows [lo, hi): the carried per-block
+        tag when every covered carry block agrees, else the entry's own
+        build version (content changed → fresh identity)."""
+        base_ver = self.dev_version if self.dev_version >= 0 else self.data_version
+        bv = self.block_vers
+        if not bv or hi <= lo:
+            return base_ver
+        b0 = lo // DEVICE_BLOCK_ROWS
+        b1 = (hi - 1) // DEVICE_BLOCK_ROWS
+        if b1 >= len(bv):
+            return base_ver
+        v = bv[b0]
+        for b in range(b0 + 1, b1 + 1):
+            if bv[b] != v:
+                return base_ver
+        return v
 
     def minmax(self, slot: int) -> tuple[int, int]:
         mm = self._minmax.get(slot)
@@ -79,6 +183,10 @@ class ColumnCache:
         self._store_ref = __import__("weakref").ref(store)
         self._mu = threading.Lock()
         self._entries: dict[tuple[int, int], RegionColumns] = {}
+        # pending delta overlays + host-materialized base⊕delta views,
+        # keyed like entries; both validate against (data_version, built_ts)
+        self._deltas: dict[tuple[int, int], DeltaOverlay] = {}
+        self._merged: dict[tuple[int, int], RegionColumns] = {}
         self._dicts: dict[tuple[int, int], Dictionary] = {}
         self._alias: dict[int, int] = {}  # partition physical id → logical id
         # bumped whenever a dictionary is compacted: device caches must drop
@@ -111,6 +219,12 @@ class ColumnCache:
                 if self._resolve(tid) == logical and slot in entry.cols:
                     data, valid = entry.cols[slot]
                     entry.cols[slot] = (remap[data], valid)
+            for coll in (self._deltas, self._merged):
+                for (rid, tid), e in coll.items():
+                    if self._resolve(tid) == logical and slot in e.cols:
+                        data, valid = e.cols[slot]
+                        e.cols[slot] = (remap[data], valid)
+                        e._minmax.pop(slot, None)
             # stable blocks hold codes against the same dictionary: remap them
             # so future cache builds see compacted codes
             store = self.store
@@ -142,11 +256,12 @@ class ColumnCache:
                 return da
             vals = db.values_array()
             remap = np.fromiter((da.encode(v) for v in vals), dtype=np.int32, count=len(vals))
-            for (rid, tid), entry in self._entries.items():
-                if self._resolve(tid) == kb[0] and slot_b in entry.cols:
-                    data, valid = entry.cols[slot_b]
-                    entry.cols[slot_b] = (remap[data] if len(vals) else data, valid)
-                    entry._minmax.pop(slot_b, None)
+            for coll in (self._entries, self._deltas, self._merged):
+                for (rid, tid), entry in coll.items():
+                    if self._resolve(tid) == kb[0] and slot_b in entry.cols:
+                        data, valid = entry.cols[slot_b]
+                        entry.cols[slot_b] = (remap[data] if len(vals) else data, valid)
+                        entry._minmax.pop(slot_b, None)
             store = self.store
             with store._mu:
                 for tid, blocks in store._stable.items():
@@ -182,26 +297,396 @@ class ColumnCache:
         read_ts: int,
     ) -> RegionColumns:
         """Columns for the given storage slots of one region, reusing cached
-        decodes when the region's write epoch is unchanged."""
+        decodes when the region's write epoch is unchanged. With a pending
+        delta the returned entry is a host-materialized ``base ⊕ delta``
+        view (the host engine's parity surface); device callers use
+        :meth:`get_split` to keep the base pinned and ship the delta as a
+        bounded kernel operand instead."""
+        base, delta = self.get_split(region, table_id, schema, slots, read_ts)
+        if delta is None or not delta.n:
+            return base
+        det = _ed.current_cop()
+        if det is not None:
+            det.delta_rows += delta.n
         key = (region.region_id, table_id)
         with self._mu:
-            entry = self._entries.get(key)
-            reusable = (
-                entry is not None
-                and entry.data_version == region.data_version
-                and read_ts >= entry.built_ts
-            )
-        if not reusable:
-            entry = self._build(region, table_id, read_ts)
-            if entry.complete:
+            m = self._merged.get(key)
+            if m is not None and not (
+                m.data_version == delta.data_version and m.built_ts == delta.built_ts and m.complete
+            ):
+                m = None
+        if m is None:
+            m = self._materialize(base, delta, table_id, schema, slots)
+            if m.complete:
                 with self._mu:
-                    self._entries[key] = entry
-            # stale-snapshot builds (read_ts behind the region head) are
-            # returned uncached: caching them would alias the head state
+                    self._merged[key] = m
+            return m
+        missing = [s for s in slots if s not in m.cols]
+        if missing:
+            mb, md, _keep, _put, _perm = m._merge_src
+            self._decode_slots(mb, table_id, schema, [s for s in missing if s not in mb.cols])
+            self._decode_delta_slots(md, table_id, schema, missing)
+            for s in missing:
+                self._materialize_slot(m, s)
+        return m
+
+    def get_split(
+        self,
+        region: Region,
+        table_id: int,
+        schema: RowSchema,
+        slots: Sequence[int],
+        read_ts: int,
+    ) -> tuple[RegionColumns, Optional[DeltaOverlay]]:
+        """(base, delta): the pinned base entry plus the pending committed
+        changes on top of it, or (entry, None) when the entry IS the head.
+        The delta path engages only when every commit since the base build
+        is itemized in the store's change log and small enough for the fixed
+        delta capacity; anything else folds through :meth:`_merge` (which
+        still re-uploads only dirty device blocks)."""
+        key = (region.region_id, table_id)
+        for _attempt in range(4):
+            base_delta = self._get_split_once(key, region, table_id, schema, slots, read_ts)
+            if base_delta is not None:
+                return base_delta
+        # repeated install races (merges landing back to back): plain merge
+        with self._mu:
+            old = self._entries.get(key)
+        return self._merge(key, region, table_id, schema, slots, read_ts, old), None
+
+    def _get_split_once(self, key, region, table_id, schema, slots, read_ts):
+        """One get_split attempt; None = a concurrent merge replaced the
+        entry AFTER we read the change log (its prune may have erased the
+        evidence our verdict rests on) — the caller re-reads and retries."""
+        with self._mu:
+            entry = self._entries.get(key)
+        if entry is not None and entry.data_version == region.data_version and read_ts >= entry.built_ts:
+            self._ensure_slots(entry, table_id, schema, slots)
+            return entry, None
+        old = entry
+        cap, _merge_rows, min_rows = _delta_limits()
+        if (
+            old is not None
+            and old.complete
+            and read_ts >= old.built_ts
+            and old.range_start == region.start
+            and old.range_end == region.end
+            and old.n >= min_rows
+        ):
+            dv = region.data_version  # BEFORE the change read: a commit that
+            # lands in between surfaces as items and rejects this path
+            kind, payload = self.store.col_changes_since(region.region_id, table_id, old.built_ts)
+            # identity re-check: install+prune are atomic under _mu, so if
+            # the installed entry is still `old` HERE, no prune ran before
+            # the log read above and the verdict is trustworthy
+            with self._mu:
+                if self._entries.get(key) is not old:
+                    return None
+            if kind == "none":
+                # version moved without record changes for this table (index
+                # backfill, a sibling table in the region, meta keys): the
+                # entry still equals the table head — revalidate in place,
+                # pinning the device-facing version so HBM identities hold
+                with self._mu:
+                    if old.dev_version < 0:
+                        old.dev_version = old.data_version
+                    old.data_version = dv
+                self._ensure_slots(old, table_id, schema, slots)
+                return old, None
+            if kind == "items":
+                cur = [it for it in payload if it[0] <= read_ts]
+                pend = [it for it in payload if it[0] > read_ts]
+                if not cur:
+                    # every change is invisible at this read_ts: base IS the view
+                    self._ensure_slots(old, table_id, schema, slots)
+                    return old, None
+                hlo, hhi = tablecodec.range_to_handles(region.range(), table_id)
+                handles = np.unique(
+                    np.asarray([h for _, h, _ in cur if hlo <= h < hhi], dtype=np.int64)
+                )
+                if len(handles) and len(handles) <= cap:
+                    complete = not pend and read_ts >= region.max_commit_ts
+                    delta = self._delta_for(
+                        key, region, table_id, schema, slots, read_ts, handles, dv, complete
+                    )
+                    if delta is not None:
+                        self._ensure_slots(old, table_id, schema, slots)
+                        return old, delta
+        return self._merge(key, region, table_id, schema, slots, read_ts, old), None
+
+    def merge_now(self, region, table_id, schema, slots, read_ts) -> RegionColumns:
+        """Fold any pending delta into the base immediately and return the
+        (head) entry — for device shapes that cannot take the delta operand
+        (windows): the merge keeps clean-block device identities, where a
+        materialized view would re-key (and evict) every resident block."""
+        key = (region.region_id, table_id)
+        with self._mu:
+            old = self._entries.get(key)
+        if old is not None and old.data_version == region.data_version and read_ts >= old.built_ts:
+            self._ensure_slots(old, table_id, schema, slots)
+            return old
+        return self._merge(key, region, table_id, schema, slots, read_ts, old)
+
+    def _ensure_slots(self, entry: RegionColumns, table_id: int, schema, slots: Sequence[int]) -> None:
+        if schema is None:
+            return
         missing = [s for s in slots if s not in entry.cols]
         if missing:
             self._decode_slots(entry, table_id, schema, missing)
+
+    def delta_rows_pending(self) -> int:
+        with self._mu:
+            return sum(len(d.handles) for d in self._deltas.values())
+
+    def _update_delta_gauge_locked(self) -> None:
+        _metrics.DEVICE_DELTA_ROWS.set(sum(len(d.handles) for d in self._deltas.values()))
+
+    # -- delta build --------------------------------------------------------
+    def _delta_for(self, key, region, table_id, schema, slots, read_ts, handles, dv, complete):
+        with self._mu:
+            d = self._deltas.get(key)
+            if d is not None and (
+                d.data_version != dv
+                or read_ts < d.built_ts
+                or not d.complete
+                or len(d.handles) != len(handles)
+                or not np.array_equal(d.handles, handles)
+            ):
+                d = None
+        if d is None:
+            d = self._build_delta(region, table_id, handles, read_ts, dv, complete)
+            if d.complete:
+                with self._mu:
+                    self._deltas[key] = d
+                    self._merged.pop(key, None)  # the view of the previous delta
+                    self._update_delta_gauge_locked()
+        if schema is not None and slots:
+            self._decode_delta_slots(d, table_id, schema, slots)
+        return d
+
+    def _build_delta(self, region, table_id, handles, read_ts, dv, complete) -> DeltaOverlay:
+        """Point-read the touched handles at read_ts and decode them into an
+        overlay. Lock conflicts resolve-and-retry like every reader path."""
+        keys = [tablecodec.record_key(table_id, int(h)) for h in handles]
+        snap = self.store.get_snapshot(read_ts)
+        vals = None
+        for _ in range(16):
+            vals = snap.get_many(keys)
+            locked = [v for v in vals if isinstance(v, KeyLockedError)]
+            if not locked:
+                break
+            for e in locked[:8]:
+                self.store.resolve_lock(e.key, e.lock)
+            _time.sleep(0.001)
+        else:
+            from tidb_tpu.kv.kv import TxnAbortedError
+
+            raise TxnAbortedError("delta build: lock resolution did not converge")
+        tomb = np.fromiter((v is None for v in vals), dtype=bool, count=len(vals))
+        put_rows = np.nonzero(~tomb)[0]
+        chunks = [vals[i] for i in put_rows]
+        starts: list[int] = []
+        off = 0
+        for c in chunks:
+            starts.append(off)
+            off += len(c)
+        return DeltaOverlay(
+            handles=handles,
+            tomb=tomb,
+            data_version=dv,
+            built_ts=read_ts,
+            # a commit racing the build bumps data_version: don't cache
+            complete=complete and region.data_version == dv,
+            _buf=b"".join(chunks),
+            _starts=np.asarray(starts, dtype=np.int64),
+            _put_rows=put_rows,
+        )
+
+    def _decode_delta_slots(self, d: DeltaOverlay, table_id: int, schema, slots: Sequence[int]) -> None:
+        missing = [s for s in slots if s not in d.cols]
+        if not missing:
+            return
+        n = d.n
+        dec: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        if d.n_put:
+            fixed = [s for s in missing if schema.ftypes[s].kind not in (TypeKind.STRING, TypeKind.JSON)]
+            if fixed:
+                datas, valids = decode_fixed_bulk(schema, d._buf, d._starts, fixed)
+                for s, dd, vv in zip(fixed, datas, valids):
+                    dec[s] = (dd, vv)
+            for s in missing:
+                if s in dec:
+                    continue
+                raw, valid = decode_strings_bulk(schema, d._buf, d._starts, s)
+                dic = self.dictionary(table_id, s)
+                with self._mu:
+                    data = np.fromiter(
+                        (0 if r is None else dic.encode(r) for r in raw), dtype=np.int32, count=len(raw)
+                    )
+                dec[s] = (data, valid)
+        for s in missing:
+            ft = schema.ftypes[s]
+            dt = np.int32 if ft.kind in (TypeKind.STRING, TypeKind.JSON) else (
+                np.float64 if ft.kind == TypeKind.FLOAT else np.int64
+            )
+            full_d = np.zeros(n, dt)
+            full_v = np.zeros(n, bool)
+            if d.n_put:
+                dd, vv = dec[s]
+                full_d[d._put_rows] = dd.astype(dt, copy=False)
+                full_v[d._put_rows] = vv
+            d.cols[s] = (full_d, full_v)
+            d._minmax.pop(s, None)
+
+    # -- host materialization (parity surface) ------------------------------
+    def _materialize(self, base: RegionColumns, delta: DeltaOverlay, table_id, schema, slots) -> RegionColumns:
+        """base ⊕ delta as plain host arrays, ascending by handle — exactly
+        what a rebuild at the delta's snapshot would have produced."""
+        keep = np.ones(base.n, dtype=bool)
+        if delta.n and base.n:
+            pos = np.minimum(np.searchsorted(delta.handles, base.handles), delta.n - 1)
+            keep = delta.handles[pos] != base.handles
+        put = ~delta.tomb
+        handles = np.concatenate([base.handles[keep], delta.handles[put]])
+        perm = np.argsort(handles, kind="stable")
+        m = RegionColumns(
+            handles[perm],
+            len(handles),
+            data_version=delta.data_version,
+            built_ts=delta.built_ts,
+            complete=base.complete and delta.complete,
+            range_start=base.range_start,
+            range_end=base.range_end,
+        )
+        m._merge_src = (base, delta, keep, put, perm)
+        for s in dict.fromkeys(slots or ()):
+            self._materialize_slot(m, s)
+        return m
+
+    def _materialize_slot(self, m: RegionColumns, s: int) -> None:
+        base, delta, keep, put, perm = m._merge_src
+        bd, bv = base.cols[s]
+        dd, dv = delta.cols[s]
+        data = np.concatenate([bd[keep], dd[put].astype(bd.dtype, copy=False)])
+        valid = np.concatenate([bv[keep], dv[put]])
+        m.cols[s] = (data[perm], valid[perm])
+
+    # -- merge (delta → base fold, dirty-block accounting) -------------------
+    def _merge(self, key, region, table_id, schema, slots, read_ts, old) -> RegionColumns:
+        """Rebuild the base at read_ts and carry per-block version tags for
+        blocks whose content provably did not change — the delta-tree merge.
+        The swap is atomic (entry replaced only after a full build), so a
+        compactor dying mid-merge leaves the old base + change log intact
+        and no torn block is ever visible."""
+        t0 = _time.perf_counter()
+        entry = self._build(region, table_id, read_ts)
+        # chaos seam: tests kill the merge here — after the build, before
+        # the swap — to prove deltas survive and re-merge
+        failpoint.inject("colcache_merge", region.region_id, table_id)
+        if (
+            old is not None
+            and entry.complete
+            and old.complete
+            and entry.n
+            and old.range_start == region.start
+            and old.range_end == region.end
+        ):
+            self._carry_block_vers(entry, old, region.region_id, table_id)
+        if entry.complete:
+            with self._mu:
+                cur = self._entries.get(key)
+                if old is not None and cur is not None and cur is not old:
+                    # another merge installed (and pruned the change log)
+                    # while we were building: our carry verdicts may rest on
+                    # pruned evidence. Discard them — serve our fresh build
+                    # uninstalled with data_version-only device identity, so
+                    # no stale-tagged HBM block can be reused.
+                    entry.block_vers = None
+                else:
+                    self._entries[key] = entry
+                    self._deltas.pop(key, None)
+                    self._merged.pop(key, None)
+                    self._update_delta_gauge_locked()
+                    # prune under the SAME lock as the install: a reader that
+                    # still observes the old entry afterwards can only have
+                    # read the log before this point (un-pruned) — see the
+                    # identity re-check in get_split
+                    self.store.col_changes_prune(region.region_id, table_id, entry.built_ts)
+        self._ensure_slots(entry, table_id, schema, slots)
+        if old is not None:
+            _metrics.DEVICE_MERGE_SECONDS.observe(_time.perf_counter() - t0)
+            det = _ed.current_cop()
+            if det is not None:
+                det.merges += 1
         return entry
+
+    def _carry_block_vers(self, new: RegionColumns, old: RegionColumns, rid: int, tid: int) -> None:
+        B = DEVICE_BLOCK_ROWS
+        kind, payload = self.store.col_changes_since(rid, tid, old.built_ts)
+        ch = span = None
+        if kind == "items":
+            ch = np.unique(np.asarray([h for _, h, _ in payload], dtype=np.int64))
+        elif kind == "span":
+            span = payload
+        else:
+            ch = np.empty(0, np.int64)
+        old_bv = old.block_vers
+        m = min(new.n, old.n)
+        if m:
+            neq = new.handles[:m] != old.handles[:m]
+            prefix = int(np.argmax(neq)) if bool(neq.any()) else m
+        else:
+            prefix = 0
+        nb = -(-new.n // B)
+        bv: list = []
+        carried = False
+        for bi in range(nb):
+            lo, hi = bi * B, min((bi + 1) * B, new.n)
+            # clean ⇔ same handles at the same positions AND no changed
+            # handle inside the block's span (values only move via logged
+            # changes). Rows the old device array holds beyond hi are dead
+            # under the kernel's nvalid mask, so a shrunk tail still carries.
+            clean = hi <= prefix
+            if clean:
+                h0, h1 = int(new.handles[lo]), int(new.handles[hi - 1])
+                if ch is not None and ch.size:
+                    i = int(np.searchsorted(ch, h0))
+                    clean = not (i < len(ch) and int(ch[i]) <= h1)
+                elif span is not None:
+                    clean = span[1] < h0 or h1 < span[0]
+            old_ver = old.dev_version if old.dev_version >= 0 else old.data_version
+            if clean:
+                bv.append(old_bv[bi] if old_bv and bi < len(old_bv) else old_ver)
+                carried = True
+            else:
+                bv.append(new.data_version)
+        if carried:
+            new.block_vers = bv
+
+    def merge_pending(self, threshold: int | None = None, should_stop=None) -> int:
+        """Fold every delta at or past ``threshold`` rows into its base (the
+        background compactor's work loop; ``should_stop`` is polled between
+        regions — the cooperative owner-fence seam)."""
+        _cap, merge_rows, _min = _delta_limits()
+        thr = merge_rows if threshold is None else threshold
+        with self._mu:
+            todo = [k for k, d in self._deltas.items() if len(d.handles) >= thr]
+        merged = 0
+        for rid, tid in todo:
+            if should_stop is not None and should_stop():
+                break
+            region = next((r for r in self.store.regions() if r.region_id == rid), None)
+            with self._mu:
+                old = self._entries.get((rid, tid))
+            if region is None:
+                with self._mu:
+                    self._deltas.pop((rid, tid), None)
+                    self._update_delta_gauge_locked()
+                continue
+            read_ts = self.store.current_ts()
+            self._merge((rid, tid), region, tid, None, (), read_ts, old)
+            merged += 1
+        return merged
 
     @property
     def store(self) -> MemStore:
@@ -211,14 +696,17 @@ class ColumnCache:
 
     def _build(self, region: Region, table_id: int, read_ts: int) -> RegionColumns:
         kr = region.range().intersect(tablecodec.record_range(table_id))
-        # capture version/coverage BEFORE the scan: a concurrent commit after
-        # this point bumps data_version and invalidates the entry
+        # capture version/coverage/bounds BEFORE the scan: a concurrent
+        # commit after this point bumps data_version and invalidates the
+        # entry; a split shifts the bounds and fails the range check
         data_version = region.data_version
+        rng = (region.start, region.end)
         complete = read_ts >= region.max_commit_ts
         snap = self.store.get_snapshot(read_ts)
         if kr is None:
             return RegionColumns(
-                np.empty(0, np.int64), 0, data_version=data_version, built_ts=read_ts, complete=complete
+                np.empty(0, np.int64), 0, data_version=data_version, built_ts=read_ts, complete=complete,
+                range_start=rng[0], range_end=rng[1],
             )
         from tidb_tpu.kv.txn import retry_locked
 
@@ -236,10 +724,12 @@ class ColumnCache:
                 _buf=bulk.buf,
                 _starts=bulk.starts,
                 _delta_n=len(bulk),
+                range_start=rng[0],
+                range_end=rng[1],
             )
-        return self._merge_stable(bulk, parts, data_version, read_ts, complete)
+        return self._merge_stable(bulk, parts, data_version, read_ts, complete, rng)
 
-    def _merge_stable(self, bulk, parts, data_version: int, read_ts: int, complete: bool) -> RegionColumns:
+    def _merge_stable(self, bulk, parts, data_version: int, read_ts: int, complete: bool, rng=(b"", b"")) -> RegionColumns:
         """Overlay the row-delta scan on the stable block slices with
         newest-version-wins PER HANDLE across layers: a delta PUT/tombstone
         masks stable rows from blocks committed before it, and a later block
@@ -299,6 +789,8 @@ class ColumnCache:
             _stable_take=take,
             _delta_take=delta_take,
             _perm=perm,
+            range_start=rng[0],
+            range_end=rng[1],
         )
 
     def _decode_slots(self, entry: RegionColumns, table_id: int, schema: RowSchema, slots: Sequence[int]) -> None:
@@ -367,11 +859,16 @@ class ColumnCache:
     def invalidate_table(self, table_id: int) -> None:
         """DDL (drop/truncate) drops cached columns."""
         with self._mu:
-            for key in [k for k in self._entries if k[1] == table_id]:
-                del self._entries[key]
+            for coll in (self._entries, self._deltas, self._merged):
+                for key in [k for k in coll if k[1] == table_id]:
+                    del coll[key]
             for key in [k for k in self._dicts if k[0] == table_id]:
                 del self._dicts[key]
             self.epoch += 1
+            self._update_delta_gauge_locked()
+        drop = getattr(self.store, "col_changes_drop", None)
+        if drop is not None:
+            drop(table_id)
 
 
 import weakref

@@ -40,7 +40,7 @@ import numpy as np
 
 from tidb_tpu.copr import dagpb
 from tidb_tpu.copr.binder import Binder, UnsupportedForDevice
-from tidb_tpu.copr.colcache import cache_for
+from tidb_tpu.copr.colcache import DEVICE_BLOCK_ROWS, cache_for
 from tidb_tpu.copr.host_engine import execute_dag as host_execute_dag
 from tidb_tpu.kv import KeyRange, tablecodec
 from tidb_tpu.kv.memstore import MemStore, Region
@@ -57,8 +57,44 @@ from tidb_tpu.ops.dag_kernel import _ensure_x64
 _ensure_x64()  # BEFORE any device_put: int64/float64 lanes must not truncate
 
 _DEFAULT_AGG_CAP = 4096
-_BLOCK = 1 << 22  # device block rows; one compile shape for all big tables
+# device block rows; one compile shape for all big tables (keep in sync with
+# colcache.DEVICE_BLOCK_ROWS — both read TIDB_TPU_DEVICE_BLOCK_ROWS)
+_BLOCK = DEVICE_BLOCK_ROWS
 _FUSE_MAX_NB = 8  # fused multi-block programs: HBM holds inputs + the concat
+
+
+def _delta_cap() -> int:
+    """The fixed delta-operand row capacity (compile-shape constant)."""
+    from tidb_tpu import config as _config
+
+    return int(getattr(_config.current(), "device_delta_cap", 8192))
+
+
+class _BinderView:
+    """Stats facade over base ⊕ delta for the binder: min/max (sort bounds,
+    MXU magnitude proofs, narrow-eval proofs) must cover delta values too,
+    or a fresh row outside the base envelope would break an exactness gate."""
+
+    def __init__(self, base, delta):
+        self.base, self.delta = base, delta
+        self.n = base.n + delta.n
+
+    @property
+    def handles(self):
+        # only the endpoints are consumed (binder._col_stats min/max)
+        hs = [h for h in (self.base.handles, self.delta.handles) if len(h)]
+        if not hs:
+            return np.empty(0, np.int64)
+        return np.array(
+            [min(int(h[0]) for h in hs), max(int(h[-1]) for h in hs)], dtype=np.int64
+        )
+
+    def minmax(self, slot: int) -> tuple[int, int]:
+        mm = self.base.minmax(slot)
+        dm = self.delta.minmax(slot)
+        if dm is None:
+            return mm
+        return (min(mm[0], dm[0]), max(mm[1], dm[1]))
 
 
 def _n_blocks(n: int) -> int:
@@ -192,10 +228,12 @@ def _device_put_col(key, make_pair, n_pad: int, cacheable: bool = True):
     _metrics.DEVICE_CACHE.inc(result="miss")
     _metrics.DEVICE_TRANSFER.inc(pd.nbytes + pv.nbytes, dir="h2d")
     if cacheable:
-        # key layout: (store_nonce, region_id, table_id, slot, data_version,
-        # epoch, ...shape/block suffix)
+        # key layout: (store_nonce, region_id, table_id, slot, unit, version,
+        # epoch, shape-suffix) — unit is a block index, "s" (single-array), or
+        # "d" (delta operand). Superseded-version eviction is per UNIT, so a
+        # merge that carries clean blocks replaces only the dirty siblings.
         _DEVICE_LRU.put(key, out, pd.nbytes + pv.nbytes)
-        _DEVICE_LRU.evict_superseded(key[:4], key[4:6])
+        _DEVICE_LRU.evict_superseded(key[:5], key[5:7])
     return out
 
 
@@ -216,15 +254,21 @@ def _narrowed(entry, column_id: int, data: np.ndarray) -> np.ndarray:
     return data
 
 
-def _covers_all(rarr: np.ndarray, entry) -> bool:
+def _covers_all(rarr: np.ndarray, entry, delta=None) -> bool:
     """True when the (padded) range set provably covers every entry row —
-    the kernel then skips the per-row handle range mask."""
+    the kernel then skips the per-row handle range mask. With a delta the
+    proof must cover the delta's handle span too."""
     if entry.n == 0:
         return False
     spans = rarr[rarr[:, 0] < rarr[:, 1]]
     if len(spans) != 1:
         return False
-    return int(spans[0, 0]) <= int(entry.handles[0]) and int(entry.handles[-1]) < int(spans[0, 1])
+    lo = int(entry.handles[0])
+    hi = int(entry.handles[-1])
+    if delta is not None and delta.n:
+        lo = min(lo, int(delta.handles[0]))
+        hi = max(hi, int(delta.handles[-1]))
+    return int(spans[0, 0]) <= lo and hi < int(spans[0, 1])
 
 
 def _block_bounds(n: int) -> list[tuple[int, int]]:
@@ -268,10 +312,12 @@ def _block_device_inputs(store, scan, cache, entry, region, bi: int, lo: int, hi
     """Device arrays for ONE block, put on demand (LRU-cached). The single
     construction site for the per-block device-LRU key layout — shared by the
     independent-block path and the fused multi-block window path, so the two
-    always hit the same cache entries."""
+    always hit the same cache entries. Blocks carry per-block version tags
+    across merges (entry.vtag_span), so a merge re-uploads ONLY dirty blocks."""
     epoch = cache.epoch
+    ver = entry.vtag_span(lo, hi)
     base = (store.nonce, region.region_id, scan.table_id)
-    hkey = base + (-1, entry.data_version, epoch, bi, _BLOCK)
+    hkey = base + (-1, bi, ver, epoch, _BLOCK)
     hpair = _device_put_col(
         hkey, lambda: (entry.handles[lo:hi], np.ones(hi - lo, bool)), _BLOCK, cacheable
     )
@@ -280,7 +326,7 @@ def _block_device_inputs(store, scan, cache, entry, region, bi: int, lo: int, hi
         if c.is_handle:
             cols_dev.append(hpair)
         else:
-            ckey = base + (c.column_id, entry.data_version, epoch, bi, _BLOCK)
+            ckey = base + (c.column_id, bi, ver, epoch, _BLOCK)
 
             def mk(cid=c.column_id):
                 data, valid = entry.cols[cid]
@@ -288,6 +334,62 @@ def _block_device_inputs(store, scan, cache, entry, region, bi: int, lo: int, hi
 
             cols_dev.append(_device_put_col(ckey, mk, _BLOCK, cacheable))
     return hpair[0], tuple(cols_dev)
+
+
+def _delta_device_inputs(store, scan, cache, delta, region):
+    """Device operands for the bounded delta: sorted touched handles (pads
+    hold int64-max so searchsorted stays legal), per-scan-column lanes, and
+    tombstone flags — all padded to the FIXED delta capacity, so every delta
+    size reuses one kernel compile. LRU-cached keyed by the delta's version:
+    repeat queries between DMLs pay zero H2D."""
+    D = _delta_cap()
+    if delta.n > D:
+        raise UnsupportedForDevice(f"delta {delta.n} rows exceeds operand capacity {D}")
+    epoch = cache.epoch
+    cacheable = delta.complete
+    base = (store.nonce, region.region_id, scan.table_id)
+
+    def pad_handles():
+        dh = np.full(D, np.iinfo(np.int64).max, dtype=np.int64)
+        dh[: delta.n] = delta.handles
+        return dh, np.ones(D, bool)
+
+    hkey = base + (-1, "d", delta.data_version, epoch, D)
+    dh_pair = _device_put_col(hkey, pad_handles, D, cacheable)
+    tkey = base + (-2, "d", delta.data_version, epoch, D)
+
+    def pad_tomb():
+        t = np.zeros(D, dtype=bool)
+        t[: delta.n] = delta.tomb
+        return t, np.ones(D, bool)
+
+    tomb_pair = _device_put_col(tkey, pad_tomb, D, cacheable)
+    cols_dev = []
+    for c in scan.columns:
+        if c.is_handle:
+            cols_dev.append(dh_pair)
+        else:
+            ckey = base + (c.column_id, "d", delta.data_version, epoch, D)
+
+            def mk(cid=c.column_id):
+                data, valid = delta.cols[cid]
+                return data, valid
+
+            cols_dev.append(_device_put_col(ckey, mk, D, cacheable))
+    return dh_pair[0], tuple(cols_dev), tomb_pair[0]
+
+
+def _delta_counts(mask_n: int, u_lo: int, u_hi: int):
+    """Device-resident [mask_n, union_lo, union_hi], cached by value: the
+    whole delta masks base rows; only [union_lo, union_hi) unions into this
+    dispatch (blocked paths route each delta row to its handle-span block)."""
+    import jax.numpy as jnp
+
+    return _misc_cached(
+        _NVALID_DEV,
+        ("dn", int(mask_n), int(u_lo), int(u_hi)),
+        lambda: jnp.asarray(np.array([mask_n, u_lo, u_hi], dtype=np.int64)),
+    )
 
 
 def _probe_slice_rows(packed_list: list, kernel):
@@ -370,9 +472,27 @@ def _execute_dag_device(store: MemStore, dag: dagpb.DAGRequest, region: Region, 
     schema = RowSchema(scan.storage_schema)
     slots = [c.column_id for c in scan.columns if not c.is_handle]
     cache = cache_for(store)
-    entry = cache.get(region, scan.table_id, schema, slots, read_ts)
+    # base stays pinned across DML; committed changes ride as a bounded
+    # delta operand the kernel folds in (mask superseded + union fresh)
+    entry, delta = cache.get_split(region, scan.table_id, schema, slots, read_ts)
+    if delta is not None and not delta.n:
+        delta = None
 
-    binder = Binder(cache, scan.table_id, scan.columns, entry)
+    has_window = any(ex.tp == dagpb.WINDOW for ex in dag.executors[1:])
+    if has_window and delta is not None:
+        # window tie-breaks are positional inside window_core — fold the
+        # delta into the base NOW instead of shipping the operand: the merge
+        # carries clean-block device identities, so only dirty blocks
+        # re-ship (a materialized view would re-key and evict them all)
+        entry = cache.merge_now(region, scan.table_id, schema, slots, read_ts)
+        delta = None
+    if delta is not None:
+        det = _ed.current_cop()
+        if det is not None:
+            det.delta_rows += delta.n
+
+    binder_entry = entry if delta is None else _BinderView(entry, delta)
+    binder = Binder(cache, scan.table_id, scan.columns, binder_entry)
     bound = binder.bind_dag(dag)
 
     # ranges → padded static array; rows outside any range are masked out
@@ -380,7 +500,6 @@ def _execute_dag_device(store: MemStore, dag: dagpb.DAGRequest, region: Region, 
     for i, kr in enumerate(ranges):
         rarr[i] = tablecodec.range_to_handles(kr, scan.table_id)
 
-    has_window = any(ex.tp == dagpb.WINDOW for ex in dag.executors[1:])
     if has_window:
         _window_pack_guard(bound, entry.n)
     if has_window and entry.n > _BLOCK:
@@ -392,14 +511,14 @@ def _execute_dag_device(store: MemStore, dag: dagpb.DAGRequest, region: Region, 
         # dispatch: the per-dispatch cost through the device link (~2-3ms
         # each, measured) would otherwise multiply by the block count, and
         # a single program needs no partial-merge pass over block results
-        return _exec_fused_blocks(store, dag, bound, scan, cache, entry, region, rarr, warn)
+        return _exec_fused_blocks(store, dag, bound, scan, cache, entry, region, rarr, warn, delta)
     agg_complete = any(
         ex.tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG) and ex.agg_mode == dagpb.AGG_COMPLETE
         for ex in dag.executors[1:]
     )
     if entry.n > _BLOCK and not agg_complete:
-        return _exec_blocks(store, dag, bound, scan, cache, entry, region, rarr, warn)
-    return _exec_single(store, dag, bound, scan, cache, entry, region, rarr, warn)
+        return _exec_blocks(store, dag, bound, scan, cache, entry, region, rarr, warn, delta)
+    return _exec_single(store, dag, bound, scan, cache, entry, region, rarr, warn, delta)
 
 
 def _single_device_inputs(store, scan, cache, entry, region, n_pad):
@@ -408,7 +527,8 @@ def _single_device_inputs(store, scan, cache, entry, region, n_pad):
     probe so their device-cache keys can never drift apart."""
     epoch = cache.epoch
     cacheable = entry.complete
-    hkey = (store.nonce, region.region_id, scan.table_id, -1, entry.data_version, epoch, n_pad)
+    ver = entry.vtag_span(0, entry.n)
+    hkey = (store.nonce, region.region_id, scan.table_id, -1, "s", ver, epoch, n_pad)
     handles_pair = _device_put_col(
         hkey, lambda: (entry.handles, np.ones(entry.n, bool)), n_pad, cacheable
     )
@@ -417,7 +537,7 @@ def _single_device_inputs(store, scan, cache, entry, region, n_pad):
         if c.is_handle:
             cols_dev.append(handles_pair)
         else:
-            ckey = (store.nonce, region.region_id, scan.table_id, c.column_id, entry.data_version, epoch, n_pad)
+            ckey = (store.nonce, region.region_id, scan.table_id, c.column_id, "s", ver, epoch, n_pad)
 
             def mk(cid=c.column_id):
                 data, valid = entry.cols[cid]
@@ -427,7 +547,7 @@ def _single_device_inputs(store, scan, cache, entry, region, n_pad):
     return handles_pair[0], cols_dev
 
 
-def _exec_single(store, dag, bound, scan, cache, entry, region, rarr, warn=None) -> Chunk:
+def _exec_single(store, dag, bound, scan, cache, entry, region, rarr, warn=None, delta=None) -> Chunk:
     """Small regions (≤ one block) or COMPLETE-mode aggs: one padded array,
     one kernel invocation — the round-1 path, preserved verbatim."""
     import jax
@@ -435,12 +555,18 @@ def _exec_single(store, dag, bound, scan, cache, entry, region, rarr, warn=None)
 
     n_pad = bucket_size(max(entry.n, 1))
     handles_dev, cols_dev = _single_device_inputs(store, scan, cache, entry, region, n_pad)
+    dcap = 0
+    dargs = ()
+    if delta is not None:
+        dcap = _delta_cap()
+        dh, dcols, dtomb = _delta_device_inputs(store, scan, cache, delta, region)
+        dargs = (dh, dcols, dtomb, _delta_counts(delta.n, 0, delta.n))
 
-    agg_cap = min(_DEFAULT_AGG_CAP, n_pad) if kernel_needs_agg(bound) else _DEFAULT_AGG_CAP
-    fs = _covers_all(rarr, entry)
+    agg_cap = min(_DEFAULT_AGG_CAP, n_pad + dcap) if kernel_needs_agg(bound) else _DEFAULT_AGG_CAP
+    fs = _covers_all(rarr, entry, delta)
     while True:
-        kernel = get_kernel(bound, n_pad, agg_cap, full_scan=fs)
-        packed = kernel.fn(handles_dev, tuple(cols_dev), _device_ranges(rarr), _device_nvalid(entry.n))
+        kernel = get_kernel(bound, n_pad, agg_cap, full_scan=fs, delta_cap=dcap)
+        packed = kernel.fn(handles_dev, tuple(cols_dev), _device_ranges(rarr), _device_nvalid(entry.n), *dargs)
         # ONE device→host round trip per task: device_get batches every
         # buffer of the packed result into a single transfer — two
         # sequential np.asarray calls would pay the tunnel RTT twice.
@@ -456,17 +582,17 @@ def _exec_single(store, dag, bound, scan, cache, entry, region, rarr, warn=None)
         count = int(buf[0, 0])
         ngroups = int(buf[0, 1])
         if ngroups > kernel.agg_cap:
-            if agg_cap >= n_pad:
+            if agg_cap >= n_pad + dcap:
                 # more groups than rows cannot happen; n_pad cap always fits
                 raise RuntimeError("aggregation group overflow beyond row count")
-            agg_cap = min(agg_cap * 4, n_pad)
+            agg_cap = min(agg_cap * 4, n_pad + dcap)
             continue
         break
     _emit_kernel_warnings(buf, kernel, warn)
     return _chunk_from_bufs(buf, fbuf, count, kernel, dag, cache, scan)
 
 
-def _exec_blocks(store, dag, bound, scan, cache, entry, region, rarr, warn=None):
+def _exec_blocks(store, dag, bound, scan, cache, entry, region, rarr, warn=None, delta=None):
     """Large regions: fixed-shape device blocks, one compile per DAG.
 
     Aggs/TopN dispatch every block asynchronously and stack the packed
@@ -491,21 +617,43 @@ def _exec_blocks(store, dag, bound, scan, cache, entry, region, rarr, warn=None)
     nvalids = [hi - lo for lo, hi in bounds]
     limit_last = bool(dag.executors[1:]) and dag.executors[-1].tp == dagpb.LIMIT
 
+    dcap = 0
+    dinp = None
+    dcuts = None
+    if delta is not None:
+        dcap = _delta_cap()
+        dinp = _delta_device_inputs(store, scan, cache, delta, region)
+        # route each delta row to the block whose handle span contains it:
+        # delta handles are sorted, so block bi's union rows are exactly the
+        # contiguous slice [dcuts[bi], dcuts[bi+1]) (block 0 reaches back to
+        # -inf, the last block forward to +inf) — block outputs then stay
+        # globally handle-ordered, matching the host engine's scan order
+        starts = [int(entry.handles[lo]) for lo, _hi in bounds]
+        dcuts = np.searchsorted(delta.handles, np.asarray(starts[1:], dtype=np.int64))
+        dcuts = [0] + [int(c) for c in dcuts] + [delta.n]
+
     agg_cap = _DEFAULT_AGG_CAP
-    fs = _covers_all(rarr, entry)
+    fs = _covers_all(rarr, entry, delta)
     while True:
-        kernel = get_kernel(bound, _BLOCK, agg_cap, full_scan=fs)
+        kernel = get_kernel(bound, _BLOCK, agg_cap, full_scan=fs, delta_cap=dcap)
 
         def run_block(bi: int):
             handles_dev, cols_dev = block_inputs(bi)
-            return kernel.fn(handles_dev, cols_dev, rarr_j, _device_nvalid(nvalids[bi]))
+            if dinp is None:
+                return kernel.fn(handles_dev, cols_dev, rarr_j, _device_nvalid(nvalids[bi]))
+            # every block masks superseded base rows; each delta row
+            # unions into exactly the block owning its handle span, so rows
+            # never double-count and block outputs concat in handle order
+            dh, dcols, dtomb = dinp
+            dn = _delta_counts(delta.n, dcuts[bi], dcuts[bi + 1])
+            return kernel.fn(handles_dev, cols_dev, rarr_j, _device_nvalid(nvalids[bi]), dh, dcols, dtomb, dn)
 
         if limit_last:
             out = _blocks_paged_limit(run_block, len(bounds), kernel, dag, cache, scan, warn)
         else:
             out = _blocks_stacked(run_block, len(bounds), kernel, dag, cache, scan, warn)
         if out is None:  # agg overflow in some block
-            agg_cap = min(agg_cap * 4, _BLOCK)
+            agg_cap = min(agg_cap * 4, _BLOCK + dcap)
             continue
         return out
 
@@ -547,7 +695,7 @@ def _blocks_stacked(run_block, nb: int, kernel, dag, cache, scan, warn=None):
     return _concat_chunks(chunks)
 
 
-def _exec_fused_blocks(store, dag, bound, scan, cache, entry, region, rarr, warn=None):
+def _exec_fused_blocks(store, dag, bound, scan, cache, entry, region, rarr, warn=None, delta=None):
     """Whole-region DAGs (windows, aggregations) over large regions: ONE
     fused multi-block program, one dispatch.
 
@@ -563,15 +711,22 @@ def _exec_fused_blocks(store, dag, bound, scan, cache, entry, region, rarr, warn
 
     handles_blocks, cols_blocks, nvalids, nb = _fused_block_inputs(store, scan, cache, entry, region)
     n_total = nb * _BLOCK
-    agg_cap = min(_DEFAULT_AGG_CAP, n_total) if kernel_needs_agg(bound) else _DEFAULT_AGG_CAP
-    fs = _covers_all(rarr, entry)
+    dcap = 0
+    dargs = ()
+    if delta is not None:
+        dcap = _delta_cap()
+        dh, dcols, dtomb = _delta_device_inputs(store, scan, cache, delta, region)
+        dargs = (dh, dcols, dtomb, _delta_counts(delta.n, 0, delta.n))
+    agg_cap = min(_DEFAULT_AGG_CAP, n_total + dcap) if kernel_needs_agg(bound) else _DEFAULT_AGG_CAP
+    fs = _covers_all(rarr, entry, delta)
     while True:
-        kernel = get_kernel(bound, _BLOCK, agg_cap, nb=nb, full_scan=fs)
+        kernel = get_kernel(bound, _BLOCK, agg_cap, nb=nb, full_scan=fs, delta_cap=dcap)
         packed = kernel.fn(
             tuple(handles_blocks),
             tuple(tuple(cb) for cb in cols_blocks),
             _device_ranges(rarr),
             nvalids,
+            *dargs,
         )
         fbuf = None
         if kernel.kind == "rows" and kernel.out_n > 65536:
@@ -583,9 +738,9 @@ def _exec_fused_blocks(store, dag, bound, scan, cache, entry, region, rarr, warn
         count = int(buf[0, 0])
         ngroups = int(buf[0, 1])
         if ngroups > kernel.agg_cap:
-            if agg_cap >= n_total:
+            if agg_cap >= n_total + dcap:
                 raise RuntimeError("aggregation group overflow beyond row count")
-            agg_cap = min(agg_cap * 4, n_total)
+            agg_cap = min(agg_cap * 4, n_total + dcap)
             continue
         break
     _emit_kernel_warnings(buf, kernel, warn)

@@ -1695,6 +1695,55 @@ class _ChunkSource(Executor):
         return self.chunk
 
 
+# past this many distinct PK probes a coalesced range scan beats point gets
+_INNER_POINT_BATCH_MAX = 4096
+
+
+def _inner_point_rows(session, inner_tpl, t, handles) -> Chunk:
+    """Index-join inner PK probes as BATCHED point reads through the
+    cross-session point-get batcher (copr/client.PointGetBatcher): one store
+    dispatch for the probe set, membuffer-overlaid inside a transaction
+    (Txn.batch_get), residual pushed conditions re-applied host-side."""
+    from tidb_tpu.copr.client import batched_point_get
+    from tidb_tpu.copr.host_engine import run_operators
+    from tidb_tpu.executor.write import _rows_to_chunk
+    from tidb_tpu.kv.rowcodec import RowSchema, decode_row
+    from tidb_tpu.kv.txn import retry_locked
+
+    keys = [tablecodec.record_key(t.id, int(h)) for h in handles]
+    txn = session._txn
+    if txn is not None:
+        raws = txn.batch_get(keys)
+    else:
+        read_ts = session.read_ts()
+        raws = retry_locked(
+            session.store, lambda: batched_point_get(session.store, read_ts, keys)
+        )
+    schema = RowSchema(t.storage_schema)
+    rows = [decode_row(schema, raw) for raw in raws if raw is not None]
+    live_handles = [h for h, raw in zip(handles, raws) if raw is not None]
+    full = _rows_to_chunk(session, t, rows)
+    cols = []
+    for slot in inner_tpl.scan_slots:
+        if slot == -1:
+            cols.append(
+                Column(
+                    np.asarray(live_handles, np.int64),
+                    np.ones(len(live_handles), bool),
+                    bigint_type(nullable=False),
+                )
+            )
+        else:
+            cols.append(full.columns[slot])
+    chunk = Chunk(cols)
+    if inner_tpl.pushed_conditions:
+        sel = dagpb.ExecutorPB(
+            dagpb.SELECTION, conditions=[c.to_pb() for c in inner_tpl.pushed_conditions]
+        )
+        chunk = run_operators(chunk, [sel], [])
+    return chunk if len(chunk.columns) else _empty_chunk(inner_tpl.schema)
+
+
 @dataclass
 class IndexJoinExec(Executor):
     """Index nested-loop join (ref: index_lookup_join.go): outer rows drive
@@ -1725,22 +1774,32 @@ class IndexJoinExec(Executor):
             if all(c.validity[i] for c in kcols):
                 keys.add(tuple(int(c.data[i]) for c in kcols))
         if p.inner_index is None:
-            ranges = [
-                KeyRange(tablecodec.record_key(t.id, k[0]), tablecodec.record_key(t.id, k[0] + 1))
-                for k in sorted(keys)
-            ]
-            inner_plan = PhysTableReader(
-                db=inner_tpl.db,
-                table=t,
-                # point lookups are the row-store role (ref: index joins read
-                # through TiKV, never the columnar engine)
-                store_type=StoreType.HOST,
-                pushed_conditions=list(inner_tpl.pushed_conditions),
-                scan_slots=list(inner_tpl.scan_slots),
-                ranges=ranges,
-                schema=inner_tpl.schema,
-            )
-            ic = TableReaderExec(inner_plan, self.session).execute() if ranges else _empty_chunk(inner_tpl.schema)
+            handles = sorted(k[0] for k in keys)
+            if handles and len(handles) <= _INNER_POINT_BATCH_MAX:
+                # PK probes through the cross-session point-get batcher: ONE
+                # batched store dispatch for the whole probe set (concurrent
+                # sessions' probes coalesce too) instead of a cop fan-out —
+                # the index-lookup inner per-key gap PERF.md named
+                ic = _inner_point_rows(self.session, inner_tpl, t, handles)
+            elif handles:
+                ranges = [
+                    KeyRange(tablecodec.record_key(t.id, h), tablecodec.record_key(t.id, h + 1))
+                    for h in handles
+                ]
+                inner_plan = PhysTableReader(
+                    db=inner_tpl.db,
+                    table=t,
+                    # point lookups are the row-store role (ref: index joins
+                    # read through TiKV, never the columnar engine)
+                    store_type=StoreType.HOST,
+                    pushed_conditions=list(inner_tpl.pushed_conditions),
+                    scan_slots=list(inner_tpl.scan_slots),
+                    ranges=ranges,
+                    schema=inner_tpl.schema,
+                )
+                ic = TableReaderExec(inner_plan, self.session).execute()
+            else:
+                ic = _empty_chunk(inner_tpl.schema)
         else:
             idx = p.inner_index
             p0 = tablecodec.index_prefix(t.id, idx.id)

@@ -42,6 +42,53 @@ OP_PUT = "P"
 OP_DEL = "D"
 OP_PESSIMISTIC_LOCK = "L"  # lock-only; carries no data, invisible to readers
 
+# per-(region, table) change-log itemization bound: past this many pending
+# record changes the log degrades to a handle-span watermark (the columnar
+# delta path then falls back to a merge instead of a delta read)
+_CHANGE_ITEMS_CAP = 65536
+
+
+class _ChangeLog:
+    """Committed record-key changes for one (region, table) since the last
+    columnar merge — the write→delta notification seam the device column
+    cache (copr/colcache.py) feeds from, the in-process analog of TiFlash's
+    raft-learner change stream. Guarded by the owning store's ``_mu``.
+
+    Two fidelity levels: itemized ``(commit_ts, handle, op)`` tuples while
+    small, degrading to a handle-span watermark (``lo``/``hi`` + ``lost``)
+    past the cap — watermarks still bound which device blocks a merge must
+    re-upload even when individual changes can no longer be enumerated."""
+
+    __slots__ = ("items", "lost", "lost_max_ts", "lo", "hi")
+
+    def __init__(self):
+        self.items: list[tuple[int, int, str]] = []  # (commit_ts, handle, op)
+        self.lost = False
+        self.lost_max_ts = 0
+        self.lo: int | None = None  # handle watermark over ALL unpruned changes
+        self.hi: int | None = None
+
+    def note(self, ts: int, handle: int, op: str) -> None:
+        self.lo = handle if self.lo is None else min(self.lo, handle)
+        self.hi = handle if self.hi is None else max(self.hi, handle)
+        if self.lost:
+            self.lost_max_ts = max(self.lost_max_ts, ts)
+            return
+        if len(self.items) >= _CHANGE_ITEMS_CAP:
+            self.items.clear()
+            self.lost = True
+            self.lost_max_ts = ts
+            return
+        self.items.append((ts, handle, op))
+
+    def note_span(self, ts: int, lo: int, hi: int) -> None:
+        """Bulk change too large to itemize: watermark only."""
+        self.lo = lo if self.lo is None else min(self.lo, lo)
+        self.hi = hi if self.hi is None else max(self.hi, hi)
+        self.items.clear()
+        self.lost = True
+        self.lost_max_ts = max(self.lost_max_ts, ts)
+
 
 @dataclass(frozen=True)
 class Write:
@@ -366,6 +413,9 @@ class MemStore:
         self._locks: dict[bytes, Lock] = {}
         # GC pins from services (log backup checkpoints): name → ts
         self._service_safepoints: dict[str, int] = {}
+        # columnar change logs: (region_id, table_id) → pending record-key
+        # changes since the last delta merge (see _ChangeLog)
+        self._changes: dict[tuple[int, int], _ChangeLog] = {}
         self._sorted: list[bytes] | None = []
         self.tso = TimestampOracle()
         self._region_split_keys = region_split_keys
@@ -416,6 +466,77 @@ class MemStore:
 
     def election_read(self, key: str):
         return self.election_replica.read(key)
+
+    # -- columnar change log (write→delta notification seam) ----------------
+    def _note_change(self, region_id: int, key: bytes, op: str, ts: int) -> None:
+        """Record one committed record-key change (caller holds ``_mu``)."""
+        if not tablecodec.is_record_key(key):
+            return
+        tid, h = tablecodec.decode_record_key(key)
+        self._changes.setdefault((region_id, tid), _ChangeLog()).note(ts, h, op)
+
+    def _note_bulk(self, table_id: int, handles: np.ndarray, regions, ts: int) -> None:
+        """Record a bulk ingest's handle set per touched region (caller holds
+        ``_mu``; ``handles`` sorted ascending). Small slices itemize (they can
+        serve the delta read path); big ones degrade to span watermarks."""
+        for r in regions:
+            hlo, hhi = tablecodec.range_to_handles(r.range(), table_id)
+            if hlo >= hhi:
+                continue
+            lo = int(np.searchsorted(handles, hlo, side="left"))
+            hi = int(np.searchsorted(handles, hhi, side="left"))
+            if lo >= hi:
+                continue
+            log = self._changes.setdefault((r.region_id, table_id), _ChangeLog())
+            if hi - lo > _CHANGE_ITEMS_CAP:
+                log.note_span(ts, int(handles[lo]), int(handles[hi - 1]))
+            else:
+                for h in handles[lo:hi]:
+                    log.note(ts, int(h), OP_PUT)
+
+    def col_changes_since(self, region_id: int, table_id: int, after_ts: int):
+        """Changes with commit_ts > after_ts for one (region, table):
+        ``("none", None)`` | ``("items", [(ts, handle, op), ...])`` |
+        ``("span", (lo, hi))`` — span means itemization was lost; only the
+        handle watermark is reliable (merge, don't delta-read)."""
+        with self._mu:
+            log = self._changes.get((region_id, table_id))
+            if log is None or log.lo is None:
+                return ("none", None)
+            if log.lost and log.lost_max_ts > after_ts:
+                return ("span", (log.lo, log.hi))
+            items = [it for it in log.items if it[0] > after_ts]
+            if not items:
+                return ("none", None)
+            return ("items", items)
+
+    def col_changes_prune(self, region_id: int, table_id: int, upto_ts: int) -> None:
+        """Forget changes at or below ``upto_ts`` — they were folded into a
+        freshly merged columnar base."""
+        with self._mu:
+            log = self._changes.get((region_id, table_id))
+            if log is None:
+                return
+            if log.lost:
+                if log.lost_max_ts > upto_ts:
+                    return  # cannot prune what we cannot itemize
+                log.lost = False
+                log.lost_max_ts = 0
+                log.items = []
+                log.lo = log.hi = None
+                return
+            log.items = [it for it in log.items if it[0] > upto_ts]
+            if log.items:
+                hs = [it[1] for it in log.items]
+                log.lo, log.hi = min(hs), max(hs)
+            else:
+                log.lo = log.hi = None
+
+    def col_changes_drop(self, table_id: int) -> None:
+        """DDL (drop/truncate) discards the table's change logs."""
+        with self._mu:
+            for k in [k for k in self._changes if k[1] == table_id]:
+                del self._changes[k]
 
     # -- kv.Storage surface ------------------------------------------------
     def current_ts(self) -> int:
@@ -661,7 +782,8 @@ class MemStore:
                 del self._locks[k]
                 chain = self._writes.setdefault(k, [])
                 is_new = not chain
-                chain.append(Write(commit_ts, start_ts, OP_PUT if lock.op == OP_PUT else OP_DEL, lock.value))
+                op = OP_PUT if lock.op == OP_PUT else OP_DEL
+                chain.append(Write(commit_ts, start_ts, op, lock.value))
                 if is_new and self._sorted is not None:
                     # cheap append keeps sortedness only if appending at tail
                     if self._sorted and self._sorted[-1] < k:
@@ -673,6 +795,7 @@ class MemStore:
                 if is_new:
                     region.key_count += 1
                 touched.add(id(region))
+                self._note_change(region.region_id, k, op, commit_ts)
             for r in self._regions:
                 if id(r) in touched:
                     r.data_version += 1
@@ -719,6 +842,14 @@ class MemStore:
                 self._recount_region(r)
                 r.max_commit_ts = max(r.max_commit_ts, commit_ts)
                 r.data_version += 1
+            # change-log the ingested record keys per (region, table)
+            by_table: dict[int, list[int]] = {}
+            for k in keys:
+                if tablecodec.is_record_key(k):
+                    tid, h = tablecodec.decode_record_key(k)
+                    by_table.setdefault(tid, []).append(h)
+            for tid, hs in by_table.items():
+                self._note_bulk(tid, np.sort(np.asarray(hs, dtype=np.int64)), touched, commit_ts)
             for r in touched:
                 self._maybe_auto_split(r)
             return commit_ts
@@ -781,6 +912,7 @@ class MemStore:
                 self._recount_region(r)
                 r.max_commit_ts = max(r.max_commit_ts, commit_ts)
                 r.data_version += 1
+            self._note_bulk(table_id, handles, touched, commit_ts)
             for r in touched:
                 self._maybe_auto_split(r)
             return commit_ts
@@ -843,6 +975,7 @@ class MemStore:
                 for r in self._regions:
                     self._recount_region(r)
                     r.data_version += 1
+        self.col_changes_drop(table_id)
 
     def _stable_holds(self, key: bytes) -> bool:
         """Does ANY stable block contain this record key's handle?"""
@@ -1017,6 +1150,7 @@ class MemStore:
             r = self.region_for_key(key)
             r.max_commit_ts = max(r.max_commit_ts, ts)
             r.data_version += 1
+            self._note_change(r.region_id, key, OP_PUT, ts)
 
     def raw_get(self, key: bytes) -> Optional[bytes]:
         return Snapshot(self, self.tso.ts()).get(key)
@@ -1046,13 +1180,16 @@ class MemStore:
             r = self.region_for_key(key)
             r.max_commit_ts = max(r.max_commit_ts, ts)
             r.data_version += 1
+            self._note_change(r.region_id, key, OP_PUT, ts)
             return True
 
     def raw_delete(self, key: bytes) -> None:
         with self._mu:
             ts = self.tso.ts()
             self._writes.setdefault(key, []).append(Write(ts, ts, OP_DEL))
-            self.region_for_key(key).data_version += 1
+            r = self.region_for_key(key)
+            r.data_version += 1
+            self._note_change(r.region_id, key, OP_DEL, ts)
 
     def raw_scan(self, kr: KeyRange, limit: int = 2**63) -> list[tuple[bytes, bytes]]:
         return Snapshot(self, self.tso.ts()).scan(kr, limit)

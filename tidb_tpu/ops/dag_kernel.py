@@ -143,16 +143,28 @@ def _ensure_x64():
 
 
 def get_kernel(
-    dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_scan: bool = False
+    dag: dagpb.DAGRequest,
+    n_pad: int,
+    agg_cap: int,
+    nb: int = 1,
+    full_scan: bool = False,
+    delta_cap: int = 0,
 ) -> CompiledKernel:
     """``full_scan``: the caller proved every entry row is inside the
     requested ranges — the kernel skips the 8-range handle mask (8 emulated
-    int64 compares per row, pure overhead on the typical analytic scan)."""
-    key = (dag.fingerprint(), n_pad, agg_cap, nb, full_scan)
+    int64 compares per row, pure overhead on the typical analytic scan).
+
+    ``delta_cap``: nonzero compiles the DELTA variant — the kernel takes a
+    bounded extra operand of committed row changes (sorted touched handles,
+    per-scan-column lanes, tombstone flags) padded to exactly ``delta_cap``
+    rows, masks superseded/deleted base rows and unions the fresh ones. The
+    cap is a fixed config constant, so varying delta SIZES reuse one compile
+    — the compile-cache keying is otherwise unchanged."""
+    key = (dag.fingerprint(), n_pad, agg_cap, nb, full_scan, delta_cap)
     with _CACHE_MU:
         k = _COMPILE_CACHE.get(key)
     if k is None:
-        k = _build(dag, n_pad, agg_cap, nb, full_scan)
+        k = _build(dag, n_pad, agg_cap, nb, full_scan, delta_cap)
         _arm_compile_probe(k)
         with _CACHE_MU:
             _COMPILE_CACHE[key] = k
@@ -201,13 +213,20 @@ def _arm_compile_probe(k: "CompiledKernel") -> None:
     k.fn = first_call
 
 
-def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_scan: bool = False) -> CompiledKernel:
+def _build(
+    dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_scan: bool = False, delta_cap: int = 0
+) -> CompiledKernel:
     _ensure_x64()
     import jax
     import jax.numpy as jnp
 
+    D = delta_cap
     executors = dag.executors
     scan = executors[0]
+    if D and any(ex.tp == dagpb.WINDOW for ex in executors[1:]):
+        # windows tie-break by row position inside window_core; the engine
+        # merges the delta first instead of shipping it (tpu_engine gates)
+        raise ValueError("window DAG cannot take a delta operand")
     # pre-parse expression trees (host-side, once per compile)
     parsed: list[Any] = []
     for ex in executors[1:]:
@@ -264,9 +283,10 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
             parsed.append(None)
 
     n_total = n_pad * nb
+    n_eff = n_total + D  # rows in the computation once the delta unions in
     agg_is_last = bool(executors[1:]) and executors[-1].tp in (dagpb.AGGREGATION, dagpb.STREAM_AGG)
     topn_like = [ex for ex in executors[1:] if ex.tp in (dagpb.TOPN, dagpb.LIMIT)]
-    out_n = n_total
+    out_n = n_eff
     if agg_is_last:
         out_n = agg_cap
     elif topn_like:
@@ -274,7 +294,7 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
         # the top_k K is a compile-shape constant, and small K is what lets
         # the hierarchical top_k keep per-row candidate sets tiny
         lim = max(ex.limit for ex in topn_like)
-        out_n = min(n_total, max(32, 1 << max(lim - 1, 0).bit_length()))
+        out_n = min(n_eff, max(32, 1 << max(lim - 1, 0).bit_length()))
 
     def _bcast(d, n):
         d = jnp.asarray(d)
@@ -507,7 +527,9 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
 
         from tidb_tpu.ops.mxu_groupby import MAX_B as _DOT_MAX_B
 
-        if nb <= 1 or not agg_is_last:
+        if nb <= 1 or not agg_is_last or D:
+            # the delta variant needs the generic concat path (delta lanes
+            # have no per-block shape); merges fold deltas away quickly
             return None
         if any(ex.tp != dagpb.SELECTION for ex in executors[1:-1]):
             return None
@@ -629,13 +651,15 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
         outs = [(out_data[i], out_valid[i]) for i in offsets]
         return _pack(outs, ngroups, ngroups)
 
-    def kernel(handles, cols, ranges, nvalid):
+    def _kernel_body(handles, cols, ranges, nvalid, delta):
         n = n_total
         warn_holder.clear()
         warn_holder.append(_DeviceWarnSink())
         if nb > 1 and blockwise_doms is not None:
             # agg-last DAG on the MXU dot: per-block accumulation, no concat
             return _blockwise_dot(handles, cols, ranges, nvalid)
+        hrank = None
+        handles_blocks = handles if nb > 1 else None
         if nb > 1:
             # fused multi-block program (window DAGs: the whole region in one
             # computation, reusing the per-block device LRU arrays); padding
@@ -651,12 +675,61 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
             live = (iota % n_pad) < nvalid.astype(jnp.int32)[iota // n_pad]
         else:
             live = jnp.arange(n, dtype=jnp.int32) < nvalid.astype(jnp.int32)
+        handles = handles.astype(jnp.int64)
+        if delta is not None:
+            dh, dcols, dtomb, dn = delta
+            dh = dh.astype(jnp.int64)  # sorted; pads hold int64-max
+            # dn = [mask_n, union_lo, union_hi]: every dispatch masks against
+            # the WHOLE delta; only rows in [union_lo, union_hi) union in —
+            # the caller routes each delta row to the block whose handle span
+            # contains it, so blocked outputs stay globally handle-ordered
+            d_mask_n = dn[0]
+            # 1) suppress superseded base rows: a base row whose handle is in
+            # the delta set carries a stale version (updated or deleted) —
+            # the delta lane holds the fresh verdict
+            pos = jnp.searchsorted(dh, handles)
+            posc = jnp.clip(pos, 0, D - 1)
+            live = live & ~((dh[posc] == handles) & (posc < d_mask_n))
+            # 2) merge ranks: each row's position in ascending-handle order
+            # over [live base + delta] — restores the host engine's scan
+            # order for tie-breaks, first_row, LIMIT, and row packing. Base
+            # rank = live-index + #delta-handles-before; delta rank counts
+            # live base handles ≤ it per block (pads → int64-max, harmless).
+            if nb > 1:
+                nv32 = nvalid.astype(jnp.int32)
+                offs = jnp.concatenate(
+                    [jnp.zeros(1, jnp.int32), jnp.cumsum(nv32)[:-1].astype(jnp.int32)]
+                )
+                iota32 = jnp.arange(n, dtype=jnp.int32)
+                li = (iota32 % n_pad) + offs[iota32 // n_pad]
+                cntb = jnp.zeros(D, dtype=jnp.int32)
+                for b in range(nb):
+                    hb = handles_blocks[b].astype(jnp.int64)
+                    hb = jnp.where(jnp.arange(n_pad) < nv32[b], hb, _I64_MAX)
+                    cntb = cntb + jnp.searchsorted(hb, dh, side="right").astype(jnp.int32)
+            else:
+                nv32 = nvalid.astype(jnp.int32)
+                li = jnp.arange(n, dtype=jnp.int32)
+                hsrt = jnp.where(jnp.arange(n) < nv32, handles, _I64_MAX)
+                cntb = jnp.searchsorted(hsrt, dh, side="right").astype(jnp.int32)
+            hrank = jnp.concatenate(
+                [li + pos.astype(jnp.int32), jnp.arange(D, dtype=jnp.int32) + cntb]
+            )
+            # 3) union the fresh rows (tombstones mask only, never union)
+            diota = jnp.arange(D)
+            dlive = (diota >= dn[1]) & (diota < dn[2]) & ~dtomb
+            handles = jnp.concatenate([handles, dh])
+            live = jnp.concatenate([live, dlive])
+            cols = tuple(
+                (jnp.concatenate([d, dd]), jnp.concatenate([v, dv]))
+                for (d, v), (dd, dv) in zip(cols, dcols)
+            )
+            n = n_eff
         # HBM lanes may be narrowed (int32 dict codes / bounded values — see
         # tpu_engine._narrowed). TWO views: the default batch upcasts integer
         # lanes to int64 (fused into each consumer); binder-proven narrow
         # expressions evaluate on the raw storage-dtype view instead, where
         # int32 VPU ops run native rather than as emulated int64 pairs
-        handles = handles.astype(jnp.int64)
         cols_nw = cols
         cols = tuple(
             (d.astype(jnp.int64) if jnp.issubdtype(d.dtype, jnp.integer) else d, v)
@@ -789,6 +862,7 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                     batch_nw = batch
                     mask = gvalid_slot
                     kind = "agg"
+                    hrank = None  # rows rebuilt: scan alignment gone
                     continue
                 # dense/MXU bucket arithmetic runs int32 when every key lane
                 # is narrow (B is tiny, so the products always fit)
@@ -856,7 +930,16 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                     livem = onehot & mask[None, :]
                     occupancy = livem.sum(axis=1)
                     live = occupancy > 0
-                    first_pos = jnp.where(livem, pos[None, :], n).min(axis=1)
+                    if hrank is not None:
+                        # first_row/key must pick the LOWEST-HANDLE row of the
+                        # group (the host engine's scan order), not the lowest
+                        # position — delta rows sit at the tail positionally
+                        minr = jnp.where(livem, hrank[None, :], n).min(axis=1)
+                        first_pos = jnp.where(
+                            livem & (hrank[None, :] == minr[:, None]), pos[None, :], n
+                        ).min(axis=1)
+                    else:
+                        first_pos = jnp.where(livem, pos[None, :], n).min(axis=1)
                     first_pos_c = jnp.clip(first_pos, 0, n - 1)
 
                     def eval_arg(a):
@@ -926,6 +1009,10 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                     for d, v in gvals:
                         lanes.append(~v)  # NULL group lane
                         lanes.append(d)
+                    if hrank is not None:
+                        # least-significant handle-order lane: intra-group
+                        # order (first_row, key pick) matches the host scan
+                        lanes.append(hrank)
                     perm = _lex_perm(lanes)
                     sm = mask[perm]
                     first = jnp.arange(n) == 0
@@ -1000,6 +1087,7 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                 batch_nw = batch  # lanes rebuilt: the storage-dtype view is stale
                 mask = gvalid_slot
                 kind = "agg"
+                hrank = None  # rows rebuilt: scan alignment gone
             elif ex.tp == dagpb.TOPN:
                 order, limit = pre
                 cur_n = batch.n
@@ -1051,7 +1139,9 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                         if span * (cur_n + 1) <= (1 << 62):
                             code = jnp.clip(d - lo_ + 1, 1, span - 1)
                             rank_code = code if desc else span - code
-                            pidx = jnp.arange(cur_n)
+                            # delta variant: ties rank by merged handle order
+                            # (the host scan order), not raw row position
+                            pidx = hrank if hrank is not None else jnp.arange(cur_n)
                             vkey = jnp.where(
                                 mask & v,
                                 rank_code * cur_n + (cur_n - 1 - pidx),
@@ -1062,7 +1152,7 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                     # key encodes the (unique) row position, so ties cannot
                     # arise for the hardware top_k to scramble. int32: row
                     # positions always fit, and int32 top_k runs native
-                    pos_n = jnp.arange(cur_n, dtype=jnp.int32)
+                    pos_n = hrank if hrank is not None else jnp.arange(cur_n, dtype=jnp.int32)
                     _, idx_null = _hier_top_k(jax, jnp, jnp.where(mask & ~v, -pos_n, jnp.iinfo(jnp.int32).min), K)
                     cand = jnp.concatenate([idx_val, idx_null])
                     # liveness is per-source: a top_k slot past the true count
@@ -1073,9 +1163,11 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                     else:  # ASC: NULLs first
                         tier = jnp.concatenate([jnp.ones(K, jnp.int64), jnp.zeros(K, jnp.int64)])
                     ckey = jnp.where(live_c, key[cand], 0)
-                    # final lane: global row index — ties come out in scan
-                    # order, matching the host engine's stable sort
-                    perm2 = _lex_perm([~live_c, tier, -ckey if isf else ~ckey, cand])
+                    # final lane: global row index (merged handle rank in the
+                    # delta variant) — ties come out in scan order, matching
+                    # the host engine's stable sort
+                    tie = hrank[cand] if hrank is not None else cand
+                    perm2 = _lex_perm([~live_c, tier, -ckey if isf else ~ckey, tie])
                     head = cand[perm2[:K]]
                     batch = EvalBatch(
                         [(_bcast(d2, cur_n)[head], _vmask(v2, cur_n)[head]) for d2, v2 in batch.cols],
@@ -1087,6 +1179,7 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                     count = jnp.minimum(limit, mask.sum())
                     mask = jnp.arange(K) < count
                     kind = "rows"
+                    hrank = None  # rows rebuilt: scan alignment gone
                     continue
                 lanes = [~mask]
                 for e, desc in order:
@@ -1102,6 +1195,10 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                     else:
                         lanes.append(v)  # NULLs first
                         lanes.append(jnp.where(v, d, 0))
+                if hrank is not None:
+                    # delta variant: stable-sort ties break by merged handle
+                    # order (the host engine's scan order), not row position
+                    lanes.append(hrank)
                 perm = _lex_perm(lanes)
                 head_n = min(out_n, cur_n)
                 head = perm[:head_n]
@@ -1115,16 +1212,19 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                 count = jnp.minimum(limit, mask.sum())
                 mask = jnp.arange(head_n) < count
                 kind = "rows"
+                hrank = None  # rows rebuilt: scan alignment gone
             elif ex.tp == dagpb.LIMIT:
                 cur_n = batch.n
                 # first `head_n` live rows in index order — O(n), no full
                 # sort. The key encodes the unique row position (TPU top_k
                 # scrambles ties, so an all-ones mask key would be wrong);
-                # int32 since row positions always fit
+                # int32 since row positions always fit. Delta variant: "first"
+                # means lowest merged handle rank, matching the host scan.
+                lim_pos = hrank if hrank is not None else jnp.arange(cur_n, dtype=jnp.int32)
                 _, head = _hier_top_k(
                     jax,
                     jnp,
-                    jnp.where(mask, -jnp.arange(cur_n, dtype=jnp.int32), jnp.iinfo(jnp.int32).min),
+                    jnp.where(mask, -lim_pos, jnp.iinfo(jnp.int32).min),
                     min(out_n, cur_n),
                 )
                 batch = EvalBatch(
@@ -1137,6 +1237,7 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
                 count = jnp.minimum(ex.limit, mask.sum())
                 mask = jnp.arange(len(head)) < count
                 kind = "rows"
+                hrank = None  # rows rebuilt: scan alignment gone
             elif ex.tp == dagpb.PROJECTION:
                 cur_n = batch.n
                 new_cols = []
@@ -1221,8 +1322,13 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
             return _pack(outs, ngroups, og)
         cur_n = batch.n
         if count is None:
-            # compact selected rows to the front
-            perm = jnp.argsort(~mask, stable=True)
+            # compact selected rows to the front; the delta variant restores
+            # ascending-handle order (the host engine's scan order) — delta
+            # rows sit at the tail positionally but not logically
+            if hrank is not None:
+                perm = _lex_perm([~mask, hrank])
+            else:
+                perm = jnp.argsort(~mask, stable=True)
             count = mask.sum()
             outs = [
                 (_bcast(d, cur_n)[perm][:out_n], _vmask(v, cur_n)[perm][:out_n]) for d, v in batch.cols
@@ -1284,6 +1390,13 @@ def _build(dag: dagpb.DAGRequest, n_pad: int, agg_cap: int, nb: int = 1, full_sc
         return jnp.stack(ilanes)
 
     import jax
+
+    if D:
+        def kernel(handles, cols, ranges, nvalid, dh, dcols, dtomb, dn):
+            return _kernel_body(handles, cols, ranges, nvalid, (dh, dcols, dtomb, dn))
+    else:
+        def kernel(handles, cols, ranges, nvalid):
+            return _kernel_body(handles, cols, ranges, nvalid, None)
 
     jitted = jax.jit(kernel)
     return CompiledKernel(jitted, "agg" if agg_is_last else "rows", out_n, agg_cap, lanes_holder)

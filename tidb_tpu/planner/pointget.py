@@ -174,15 +174,9 @@ def run_point_get(session, plan: PointGetPlan) -> list[tuple]:
 
         raws = batched_point_get(session.store, session.read_ts(), keys)
     else:
-        raws = []
-        for key in keys:
-            if txn.membuf.is_deleted(key):
-                raws.append(None)
-                continue
-            raw = txn.membuf.get(key) if txn.membuf.contains(key) else None
-            if raw is None:
-                raw = txn.get(key)
-            raws.append(raw)
+        # dirty-txn gets ride the batcher too: membuffer overlay first, then
+        # one coalesced dispatch for the snapshot misses (Txn.batch_get)
+        raws = txn.batch_get(keys)
     out: list[tuple] = []
     for raw in raws:
         if raw is None:

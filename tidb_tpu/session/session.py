@@ -2161,7 +2161,7 @@ class DB:
             return {"fenced": f"lost ownership of {key!r} (term {term}) mid-sweep", "result": out}
         return out
 
-    def start_background(self, ttl_interval_s: float = 60, analyze_interval_s: float = 60, gc_interval_s: float = 120) -> None:
+    def start_background(self, ttl_interval_s: float = 60, analyze_interval_s: float = 60, gc_interval_s: float = 120, colmerge_interval_s: float = 30) -> None:
         """Start the Domain-style background loops (ref: domain.Start —
         TTL, auto-analyze, GC workers on the timer framework). Each sweep
         first campaigns for its owner key, so only one SQL node per cluster
@@ -2175,7 +2175,25 @@ class DB:
             "auto_analyze", analyze_interval_s, lambda: self._owner_gated("stats", self.run_auto_analyze)
         )
         self.timers.register("gc", gc_interval_s, lambda: self._owner_gated("gc", self.run_gc))
+        self.timers.register(
+            "colmerge", colmerge_interval_s, lambda: self._owner_gated("colmerge", self.run_delta_merge)
+        )
         self.timers.start()
+
+    def run_delta_merge(self) -> int:
+        """One compactor sweep of the delta+merge device column cache: fold
+        every delta overlay past its merge threshold into its base entry
+        (TiFlash's background delta-tree merge). Owner-gated like the other
+        sweeps; cooperative with fencing — the region loop stops as soon as
+        :meth:`owner_fenced` trips. Embedded stores only: a remote store's
+        server process runs its own merges on the query-path threshold."""
+        if not isinstance(self.store, MemStore):
+            return 0
+        from tidb_tpu.copr.colcache import cache_for
+
+        return cache_for(self.store).merge_pending(
+            should_stop=lambda: self.owner_fenced("colmerge")
+        )
 
     def stop_background(self) -> None:
         if getattr(self, "timers", None) is not None:

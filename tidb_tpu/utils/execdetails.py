@@ -54,7 +54,7 @@ class CopExecDetails:
         "region_id", "store", "queue_ms", "wire_ms", "proc_ms", "device_ms",
         "host_ms", "compile_ms", "h2d_bytes", "d2h_bytes", "dev_cache_hits",
         "dev_cache_misses", "engine", "degraded", "retries", "backoff_ms",
-        "resplits",
+        "resplits", "delta_rows", "merges",
     )
 
     def __init__(self, region_id: int = -1, store: str = ""):
@@ -75,6 +75,8 @@ class CopExecDetails:
         self.retries = 0
         self.backoff_ms = 0.0  # cumulative Backoffer sleep charged to this task
         self.resplits = 0  # region re-splits (epoch changes)
+        self.delta_rows = 0  # columnar delta-overlay rows this scan read through
+        self.merges = 0  # delta→base merges this task triggered (query-path)
 
     def to_pb(self) -> dict:
         """Compact wire form (zeros omitted — the sidecar rides every cop
@@ -104,6 +106,10 @@ class CopExecDetails:
             out["bo"] = round(self.backoff_ms, 3)
         if self.resplits:
             out["rs"] = self.resplits
+        if self.delta_rows:
+            out["dlr"] = self.delta_rows
+        if self.merges:
+            out["mg"] = self.merges
         return out
 
     def merge_pb(self, pb: dict) -> None:
@@ -124,6 +130,8 @@ class CopExecDetails:
         self.retries += int(pb.get("rt", 0))
         self.backoff_ms += float(pb.get("bo", 0.0))
         self.resplits += int(pb.get("rs", 0))
+        self.delta_rows += int(pb.get("dlr", 0))
+        self.merges += int(pb.get("mg", 0))
 
 
 class CopTasksSummary:
@@ -134,6 +142,7 @@ class CopTasksSummary:
         "procs", "queue_ms", "wire_ms", "device_ms", "host_ms", "compile_ms",
         "h2d_bytes", "d2h_bytes", "dev_cache_hits", "dev_cache_misses",
         "engines", "degraded", "retries", "backoff_ms", "resplits",
+        "delta_rows", "merges",
         "max_proc_ms", "max_task_store", "max_task_region",
     )
 
@@ -153,6 +162,8 @@ class CopTasksSummary:
         self.retries = 0
         self.backoff_ms = 0.0
         self.resplits = 0
+        self.delta_rows = 0
+        self.merges = 0
         self.max_proc_ms = 0.0
         self.max_task_store = ""
         self.max_task_region = -1
@@ -179,6 +190,8 @@ class CopTasksSummary:
         self.retries += d.retries
         self.backoff_ms += d.backoff_ms
         self.resplits += d.resplits
+        self.delta_rows += d.delta_rows
+        self.merges += d.merges
         if d.proc_ms >= self.max_proc_ms:
             self.max_proc_ms = d.proc_ms
             self.max_task_store = d.store or "local"
@@ -217,6 +230,10 @@ class CopTasksSummary:
             parts.append(f"h2d: {self.h2d_bytes}B, d2h: {self.d2h_bytes}B")
         if self.dev_cache_hits or self.dev_cache_misses:
             parts.append(f"dev_cache: {self.dev_cache_hits}/{self.dev_cache_hits + self.dev_cache_misses}")
+        if self.delta_rows:
+            parts.append(f"delta_rows: {self.delta_rows}")  # scan paid the delta path
+        if self.merges:
+            parts.append(f"merges: {self.merges}")
         if self.degraded:
             parts.append(
                 "degraded: " + " ".join(f"{k}×{v}" for k, v in sorted(self.degraded.items()))

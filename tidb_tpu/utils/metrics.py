@@ -215,6 +215,16 @@ DEVICE_CACHE = REGISTRY.counter(
     "Device-resident column LRU lookups (hit = no H2D transfer paid)",
     ("result",),
 )
+# delta+merge device column cache (copr/colcache.py delta overlays + the
+# session-level compactor): freshness without re-uploading base blocks
+DEVICE_DELTA_ROWS = REGISTRY.gauge(
+    "tidb_tpu_device_delta_rows",
+    "Committed rows pending in columnar delta overlays (not yet merged)",
+)
+DEVICE_MERGE_SECONDS = REGISTRY.histogram(
+    "tidb_tpu_device_merge_seconds",
+    "Delta→base merge wall (rebuild + dirty-block accounting) per region",
+)
 DEVICE_TRANSFER = REGISTRY.counter(
     "tidb_tpu_device_transfer_bytes_total",
     "Host<->device bytes moved by the cop engines",
