@@ -47,7 +47,11 @@ def thread_hygiene():
     ``trace-``-prefixed threads are flagged too: the trace reservoir and the
     sampling coin are deliberately threadless (deposits happen on the
     statement's own thread) — a reservoir/sampler thread appearing would
-    mean the observability layer grew background machinery it must not."""
+    mean the observability layer grew background machinery it must not.
+    The ``metrics-history`` recorder thread (utils/metricshist) IS allowed
+    background machinery, but it is refcounted and must die with
+    ``stop_background()`` / ``StoreServer.shutdown()`` — surviving one is a
+    leak this fixture flags."""
     import threading
     import time
 
@@ -62,6 +66,7 @@ def thread_hygiene():
                 or t.name.startswith("cop_")
                 or t.name.startswith("rcop_")
                 or t.name.startswith("trace-")
+                or t.name == "metrics-history"
             )
         ]
 

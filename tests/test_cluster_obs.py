@@ -1,0 +1,520 @@
+"""Cluster observability plane (ISSUE 9): the sys_snapshot introspection
+verb + StoreHealthRegistry, the information_schema.cluster_* memtables with
+TiDB partial-result semantics, the in-process metrics history recorder, the
+adaptive trace-sampling clamp, and per-statement memory in the slow log.
+
+The chaos half SIGKILLs one store of a 3-process fleet and asserts the
+cluster memtables degrade to survivors + a warning naming the dead instance
+(no hang, no whole-query failure) while the health registry marks it stale.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tidb_tpu import config as _config
+from tidb_tpu.kv.memstore import MemStore
+from tidb_tpu.kv.remote import RemoteStore, StoreServer, sys_report
+from tidb_tpu.kv.sharded import ShardedStore
+from tidb_tpu.session.session import DB
+from tidb_tpu.utils.metrics import Registry
+from tidb_tpu.utils.metricshist import TOTAL, MetricsHistory, recorder
+from tidb_tpu.utils.tracing import clamp_rate
+
+
+# -- registry snapshot / report building -------------------------------------
+
+
+def test_registry_snapshot_shape():
+    reg = Registry()
+    c = reg.counter("c_total", "help", ("k",))
+    g = reg.gauge("g", "help")
+    h = reg.histogram("h_seconds", "help")
+    c.inc(k="a")
+    c.inc(2, k="b")
+    g.set(7)
+    h.observe(0.002)
+    snap = reg.snapshot()
+    assert snap["c_total"]["kind"] == "counter"
+    assert sorted(snap["c_total"]["values"]) == [[["a"], 1], [["b"], 2]]
+    assert snap["g"]["values"] == [[[], 7]]
+    assert snap["h_seconds"]["kind"] == "histogram"
+    assert snap["h_seconds"]["count"] == 1
+    assert snap["h_seconds"]["sum"] == pytest.approx(0.002)
+    assert c.total() == 3
+    # the overflow bucket survives the snapshot (render() parity): an
+    # observation above the top bound must not vanish from the cumulative
+    h2 = reg.histogram("h2", "help", buckets=(1, 2))
+    h2.observe(100.0)
+    b = reg.snapshot()["h2"]["buckets"]
+    assert b[-1] == ["+Inf", 1] and b[-2][1] == 0
+
+
+def test_sys_report_local_fields():
+    rep = sys_report()
+    assert rep["pid"] == os.getpid()
+    assert rep["uptime_s"] >= 0
+    assert "metrics" in rep and "tidb_tpu_executor_statement_total" in rep["metrics"]
+    assert "cop_queue" in rep and "cop_pool" in rep
+    # JSON-able end to end (it ships inside the sys_snapshot RPC header)
+    json.dumps(rep)
+    # section selection: a load probe's slim report skips the heavy parts
+    slim = sys_report(sections=())
+    assert "metrics" not in slim and "qps" in slim and "cop_pool" in slim
+
+
+def test_slow_entry_pb_roundtrip():
+    """to_pb/from_pb are exact inverses — the cluster memtables rebuild
+    records from wire dicts, so a field added to the dataclass flows to the
+    fan-out rows with no third unpack site to update."""
+    from tidb_tpu.utils.stmtsummary import SlowEntry, StmtStats
+
+    e = SlowEntry(1.0, "q", 0.5, 3, "u", digest="d", cop_tasks=2,
+                  cop_proc_max_ms=9.0, max_task_store="s:1", mem_max=4096)
+    assert SlowEntry.from_pb(json.loads(json.dumps(e.to_pb()))) == e
+    st = StmtStats("dg|q", "q", exec_count=2, sum_latency=1.0, max_mem=77)
+    rt = StmtStats.from_pb(json.loads(json.dumps(st.to_pb())))
+    assert rt == st and rt.avg_latency == pytest.approx(0.5)
+
+
+# -- metrics history ----------------------------------------------------------
+
+
+def test_metrics_history_sampling_bounds_and_rate():
+    reg = Registry()
+    c = reg.counter("q_total", "", ("t",))
+    h = reg.histogram("lat_seconds", "")
+    mh = MetricsHistory(interval_s=1.0, retention_s=5.0, registry=reg)
+    for i in range(12):
+        c.inc(10, t="sel")
+        h.observe(0.01)
+        mh.sample_now(now=100.0 + i)
+    rows = mh.series("q_total")
+    # ring bound: retention/interval + 1 points per series, oldest dropped
+    per_series = [r for r in rows if r[1] == TOTAL]
+    assert len(per_series) == 6
+    assert per_series[0][2] == pytest.approx(106.0)  # oldest retained ts
+    # histograms decompose into _sum/_count series
+    assert mh.series("lat_seconds_count")[-1][3] == 12
+    assert mh.series("lat_seconds_sum")[-1][3] == pytest.approx(0.12)
+    # cumulative rate: +10/tick over 1s ticks
+    assert mh.rate("q_total", window_s=3.0) == pytest.approx(10.0)
+    # unknown series → 0.0, never a raise
+    assert mh.rate("nope") == 0.0
+
+
+def test_metrics_history_series_cap():
+    reg = Registry()
+    c = reg.counter("many_total", "", ("k",))
+    mh = MetricsHistory(interval_s=1.0, retention_s=5.0, registry=reg, max_series=8)
+    for i in range(50):
+        c.inc(k=f"v{i}")
+    mh.sample_now(now=1.0)
+    assert len(mh.series()) <= 8
+    assert mh.dropped_series > 0
+
+
+def test_metrics_history_thread_dies_with_stop_background(thread_hygiene):
+    import tidb_tpu
+
+    db = tidb_tpu.open()
+    assert not thread_hygiene()
+    db.start_background(
+        ttl_interval_s=3600, analyze_interval_s=3600, gc_interval_s=3600,
+        colmerge_interval_s=3600,
+    )
+    try:
+        assert any(
+            t.name == "metrics-history" for t in threading.enumerate() if t.is_alive()
+        ), "start_background must start the history recorder"
+    finally:
+        db.stop_background()
+    # teardown: the fixture asserts the metrics-history thread is gone
+
+
+def test_metrics_history_memtable_and_endpoint():
+    import tidb_tpu
+    from tidb_tpu.server.status import StatusServer
+
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE mh (id BIGINT PRIMARY KEY)")
+    s = db.session()
+    recorder().sample_now()
+    rows = s.query(
+        "SELECT NAME, LABELS, VALUE FROM information_schema.metrics_history "
+        "WHERE NAME = 'tidb_tpu_executor_statement_total' AND LABELS = '__total__'"
+    )
+    assert rows, "statement counter must appear in metrics_history"
+    assert rows[-1][2] > 0
+    st = StatusServer(db, port=0)
+    port = st.start()
+    try:
+        body = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics/history?name=tidb_tpu_executor_statement_total"
+            ).read()
+        )
+        assert body and all(r["name"] == "tidb_tpu_executor_statement_total" for r in body)
+        # time-windowed: a 0-second lookback returns nothing older than now
+        body2 = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics/history?seconds=0"
+            ).read()
+        )
+        assert body2 == []
+        # a malformed lookback is a 400, not a handler crash
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics/history?seconds=abc"
+            )
+        assert ei.value.code == 400
+    finally:
+        st.close()
+
+
+# -- cluster memtables (embedded + wire) --------------------------------------
+
+
+def test_cluster_memtables_embedded():
+    import tidb_tpu
+
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE ce (id BIGINT PRIMARY KEY, v BIGINT)")
+    db.execute("INSERT INTO ce VALUES (1, 1), (2, 2)")
+    s = db.session()
+    s.query("SELECT COUNT(*) FROM ce")
+    info = s.query("SELECT INSTANCE, TYPE, STATUS FROM information_schema.cluster_info")
+    assert ("tidb", "up") in {(t, st) for _, t, st in info}
+    assert ("store", "up") in {(t, st) for _, t, st in info}
+    load = s.query(
+        "SELECT INSTANCE, COP_TASKS, UPTIME_S FROM information_schema.cluster_load"
+    )
+    assert len(load) == 2 and all(r[2] >= 0 for r in load)
+    # the registry cached the sweep
+    reps = db.health.reports()
+    assert reps and all(e["ok"] for e in reps.values())
+    inst = next(iter(reps))
+    assert db.health.staleness_s(inst) is not None
+    assert not db.health.is_stale(inst)
+
+
+def test_slow_query_and_statements_summary_mem_max():
+    import tidb_tpu
+
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE mm (id BIGINT PRIMARY KEY, v BIGINT)")
+    db.execute("INSERT INTO mm VALUES " + ",".join(f"({i},{i})" for i in range(200)))
+    s = db.session()
+    s.execute("SET tidb_slow_log_threshold = 0")
+    s.query("SELECT * FROM mm ORDER BY v")
+    rows = s.query(
+        "SELECT QUERY, MEM_MAX FROM information_schema.slow_query "
+        "WHERE QUERY LIKE '%ORDER BY v%'"
+    )
+    assert rows and rows[-1][1] > 0, "slow log must carry the tracker peak"
+    ss = s.query(
+        "SELECT MAX_MEM FROM information_schema.statements_summary "
+        "WHERE DIGEST_TEXT LIKE '%order by v%'"
+    )
+    assert ss and ss[0][0] > 0
+
+
+@pytest.fixture
+def wire_store():
+    old = _config.current()
+    # store-side cop slow threshold 0: every cop task pins a SlowEntry, so
+    # the store's ring has rows for cluster_slow_query to fan in
+    _config.set_current(dataclasses.replace(old, store_slow_cop_ms=0.0))
+    srv = StoreServer(MemStore(region_split_keys=1000))
+    srv.start()
+    try:
+        yield srv
+    finally:
+        srv.shutdown()
+        _config.set_current(old)
+
+
+def test_cluster_memtables_over_the_wire(wire_store):
+    srv = wire_store
+    db = DB(store=RemoteStore("127.0.0.1", srv.port))
+    db.execute("CREATE TABLE cw (id BIGINT PRIMARY KEY, v BIGINT)")
+    db.execute("INSERT INTO cw VALUES " + ",".join(f"({i},{i})" for i in range(50)))
+    s = db.session()
+    s.query("SELECT COUNT(*) FROM cw")
+    addr = f"127.0.0.1:{srv.port}"
+    # the introspection verb itself
+    rep = db.store.sys_snapshot()
+    assert rep["addr"] == addr
+    assert rep["conns"] >= 1
+    assert any(e["sql"].startswith("cop table=") for e in rep["slow"])
+    # section selection holds over the wire too: a slim probe ships no rings
+    slim = db.store.sys_snapshot(sections=())
+    assert "slow" not in slim and "statements" not in slim and "metrics" not in slim
+    assert slim["addr"] == addr
+    # store rows fan into cluster_slow_query, INSTANCE-tagged
+    rows = s.query(
+        "SELECT INSTANCE, QUERY FROM information_schema.cluster_slow_query"
+    )
+    assert any(i == addr and q.startswith("cop table=") for i, q in rows)
+    # cluster_statements_summary carries the store's per-digest aggregates
+    rows = s.query(
+        "SELECT INSTANCE, EXEC_COUNT FROM information_schema.cluster_statements_summary "
+        f"WHERE INSTANCE = '{addr}'"
+    )
+    assert rows and rows[0][1] >= 1
+    # history ships over the wire for the cluster variant (the server's
+    # recorder started with srv.start())
+    recorder().sample_now()
+    rows = s.query(
+        "SELECT DISTINCT INSTANCE FROM information_schema.cluster_metrics_history"
+    )
+    assert {r[0] for r in rows} >= {addr}
+    assert not s.warnings, f"healthy fleet must not warn: {s.warnings}"
+
+
+def test_cluster_endpoint(wire_store):
+    from tidb_tpu.server.status import StatusServer
+
+    srv = wire_store
+    db = DB(store=RemoteStore("127.0.0.1", srv.port))
+    st = StatusServer(db, port=0)
+    port = st.start()
+    try:
+        body = json.loads(
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/cluster").read()
+        )
+        addr = f"127.0.0.1:{srv.port}"
+        inst = {e["instance"]: e for e in body["instances"]}
+        assert inst[addr]["ok"] is True
+        rep = inst[addr]["report"]
+        assert rep["version"] and rep["uptime_s"] >= 0
+        # the heavy sections stay off the HTTP summary
+        assert "metrics" not in rep and "slow" not in rep
+        assert body["registry"][addr]["stale"] is False
+    finally:
+        st.close()
+
+
+def test_sweep_tolerates_dead_store():
+    """A ShardedStore sweep over a dead endpoint yields a per-store failure
+    OUTCOME (never raises), the registry marks the instance stale, and the
+    cluster memtables degrade to a warning + partial rows."""
+    old = _config.current()
+    _config.set_current(dataclasses.replace(old, store_slow_cop_ms=0.0))
+    srv = StoreServer(MemStore(region_split_keys=1000))
+    srv.start()
+    try:
+        live = RemoteStore("127.0.0.1", srv.port, retry_budget_ms=150, backoff_seed=0)
+        dead_srv = StoreServer(MemStore(region_split_keys=1000))
+        dead_srv.start()
+        dead = RemoteStore(
+            "127.0.0.1", dead_srv.port, retry_budget_ms=150, backoff_seed=0
+        )
+        dead_addr = f"127.0.0.1:{dead_srv.port}"
+        db = DB(store=ShardedStore([live, dead]))
+        dead_srv.shutdown()
+        t0 = time.monotonic()
+        outs = db.health.sweep()
+        wall = time.monotonic() - t0
+        by = {o["instance"]: o for o in outs}
+        assert by[f"127.0.0.1:{srv.port}"]["ok"]
+        assert not by[dead_addr]["ok"]
+        assert wall < 5.0, f"dead-store sweep must stay within the backoff budget ({wall:.1f}s)"
+        assert db.health.is_stale(dead_addr)
+        assert not db.health.is_stale(f"127.0.0.1:{srv.port}")
+        # memtable semantics: warning + partial rows, not a failed query
+        s = db.session()
+        rows = s.query("SELECT INSTANCE, STATUS FROM information_schema.cluster_info")
+        assert (dead_addr, "down") in rows
+        assert any(w for w in s.warnings if dead_addr in w[2]), s.warnings
+    finally:
+        srv.shutdown()
+        _config.set_current(old)
+
+
+# -- adaptive trace-sampling clamp --------------------------------------------
+
+
+def test_clamp_rate_rule():
+    assert clamp_rate(0.5, qps=50, clamp_qps=100) == 0.5  # idle: untouched
+    assert clamp_rate(0.5, qps=200, clamp_qps=100) == pytest.approx(0.25)
+    assert clamp_rate(1.0, qps=100_000, clamp_qps=100) == pytest.approx(0.001)
+    assert clamp_rate(0.5, qps=10_000, clamp_qps=0) == 0.5  # clamp off
+
+
+def test_trace_clamp_both_directions(monkeypatch):
+    import tidb_tpu
+
+    old = _config.current()
+    _config.set_current(dataclasses.replace(old, trace_clamp_qps=100.0))
+    try:
+        db = tidb_tpu.open()
+        db.execute("CREATE TABLE tc (id BIGINT PRIMARY KEY)")
+        db.execute("INSERT INTO tc VALUES (1)")
+        s = db.session()
+        s.execute("SET tidb_tpu_trace_sample_rate = 1")
+        s.execute("SET tidb_tpu_trace_sample_seed = 42")
+        # pressure: QPS far above the knob clamps the effective rate to
+        # 1 * 100/1e6 = 1e-4 — the seeded coin rejects every draw here
+        monkeypatch.setattr(db.health, "recent_qps", lambda: 1_000_000.0)
+        db.trace_reservoir.clear()
+        for _ in range(20):
+            s.query("SELECT id FROM tc")
+        assert len(db.trace_reservoir) == 0, "clamp must shed sampling under load"
+        # idle: the signal drops under the knob and the configured rate is
+        # restored — every statement samples again
+        monkeypatch.setattr(db.health, "recent_qps", lambda: 1.0)
+        for _ in range(5):
+            s.query("SELECT id FROM tc")
+        assert len(db.trace_reservoir) == 5, "idle must restore the configured rate"
+    finally:
+        _config.set_current(old)
+
+
+def test_recent_qps_signal_moves():
+    import tidb_tpu
+
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE rq (id BIGINT PRIMARY KEY)")
+    s = db.session()
+    db.health.recent_qps()  # arm the estimator baseline
+    for _ in range(30):
+        s.query("SELECT 1")
+    time.sleep(0.3)
+    assert db.health.recent_qps() > 0.0
+
+
+# -- chaos: partial-fleet introspection ---------------------------------------
+
+pytestmark_chaos = pytest.mark.chaos
+
+_SERVER_SCRIPT = r"""
+import sys, time, dataclasses
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from tidb_tpu import config as _c
+# store-side cop slow threshold 0 (every cop task pins a SlowEntry) and a
+# fast metrics-history tick, so the fleet has rows to introspect quickly
+_c.set_current(dataclasses.replace(
+    _c.Config(), store_slow_cop_ms=0.0,
+    metrics_history_interval_s=0.2, metrics_history_retention_s=60.0,
+))
+from tidb_tpu.kv.memstore import MemStore
+from tidb_tpu.kv.remote import StoreServer
+
+srv = StoreServer(MemStore(region_split_keys=100_000))
+print(f"PORT {{srv.start()}}", flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+def _spawn():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return subprocess.Popen(
+        [sys.executable, "-c", _SERVER_SCRIPT.format(repo=repo)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def _port(proc):
+    got: list = []
+
+    def reader():
+        for line in proc.stdout:
+            if line.startswith("PORT "):
+                got.append(int(line.split()[1]))
+                return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    t.join(timeout=120)
+    if not got:
+        proc.kill()
+        raise RuntimeError("store server did not report a port within 120s")
+    return got[0]
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    procs = [_spawn(), _spawn(), _spawn()]  # concurrent: jax import dominates
+    ports = [_port(p) for p in procs]
+    stores = [
+        RemoteStore("127.0.0.1", p, retry_budget_ms=250, backoff_seed=0)
+        for p in ports
+    ]
+    db = DB(store=ShardedStore(stores))
+    s = db.session()
+    # three consecutive table ids → one table per shard (id % 3)
+    for name in ("f0", "f1", "f2"):
+        s.execute(f"CREATE TABLE {name} (id BIGINT PRIMARY KEY, v BIGINT)")
+        s.execute(f"INSERT INTO {name} VALUES " + ",".join(f"({i},{i})" for i in range(30)))
+    shards = {db.store.shard_of_table(db.catalog.table("test", n).id) for n in ("f0", "f1", "f2")}
+    assert shards == {0, 1, 2}, "consecutive table ids must cover all three stores"
+    for name in ("f0", "f1", "f2"):  # one cop task lands on every store
+        s.query(f"SELECT COUNT(*) FROM {name}")
+    yield db, procs, ports
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+            p.wait(timeout=10)
+
+
+@pytest.mark.chaos
+def test_partial_fleet_introspection(fleet):
+    db, procs, ports = fleet
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    s = db.session()
+
+    # baseline: every store's cop slow ring is visible, INSTANCE-tagged
+    rows = s.query("SELECT INSTANCE, QUERY FROM information_schema.cluster_slow_query")
+    seen = {i for i, _ in rows}
+    assert set(addrs) <= seen, f"expected rows from every store, got {seen}"
+    assert not s.warnings
+
+    # SIGKILL a NON-authority store (shard 2): meta/TSO stay on shard 0,
+    # quorum 2-of-3 holds — exactly the partial-fleet introspection case
+    procs[2].send_signal(signal.SIGKILL)
+    procs[2].wait(timeout=10)
+    time.sleep(0.2)
+
+    t0 = time.monotonic()
+    rows = s.query("SELECT INSTANCE, QUERY FROM information_schema.cluster_slow_query")
+    wall = time.monotonic() - t0
+    seen = {i for i, _ in rows}
+    assert addrs[0] in seen and addrs[1] in seen, "survivors' rows must remain"
+    assert addrs[2] not in seen, "the dead store cannot contribute rows"
+    assert wall < 5.0, f"partial sweep must finish within one backoff budget ({wall:.1f}s)"
+    assert any(addrs[2] in w[2] for w in s.warnings), (
+        f"a warning must name the dead instance: {s.warnings}"
+    )
+
+    # cluster_load degrades the same way
+    rows = s.query("SELECT INSTANCE FROM information_schema.cluster_load")
+    seen = {r[0] for r in rows}
+    assert addrs[0] in seen and addrs[1] in seen and addrs[2] not in seen
+
+    # the health registry marks the dead store stale, survivors fresh
+    assert db.health.is_stale(addrs[2])
+    assert not db.health.is_stale(addrs[0])
+    assert not db.health.is_stale(addrs[1])
+
+    # the fleet keeps answering data queries on surviving owners
+    by_shard = {
+        db.store.shard_of_table(db.catalog.table("test", n).id): n
+        for n in ("f0", "f1", "f2")
+    }
+    assert s.query(f"SELECT COUNT(*) FROM {by_shard[0]}") == [(30,)]

@@ -66,6 +66,12 @@ def main(argv=None) -> int:
     for k, v in cfg.sysvars.items():
         db.global_vars[k] = v
 
+    # in-process metrics history: default ON for a bootable server (the
+    # [observability] metrics-history-* knobs size it; interval <= 0 disables)
+    from tidb_tpu.utils.metricshist import recorder
+
+    recorder().start()
+
     server = Server(db, host=cfg.host, port=cfg.port, tls=cfg.ssl_enabled)
     port = server.start()
     status_port = None
@@ -82,6 +88,7 @@ def main(argv=None) -> int:
             status.close()
         except Exception:
             pass
+    recorder().stop()
     return 0
 
 

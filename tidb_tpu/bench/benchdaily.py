@@ -422,6 +422,104 @@ def bench_qps_q1_concurrent() -> float:
     return concurrent_qps(db, worker, 2, 12, setup=setup)
 
 
+@register("cluster_snapshot_ms")
+def bench_cluster_snapshot() -> float:
+    """Full-fleet ``sys_snapshot`` sweep wall (ms, lower is better) over a
+    3-store wire fleet: the cost of materializing one
+    ``information_schema.cluster_*`` query's substrate — per-store report
+    building (registry walk, slow-ring serialization) plus three RPCs. The
+    guard keeps the introspection verb itself from growing a tax that makes
+    operators afraid to run it."""
+    import time as _t
+
+    from tidb_tpu.kv.memstore import MemStore
+    from tidb_tpu.kv.remote import RemoteStore, StoreServer
+    from tidb_tpu.kv.sharded import ShardedStore
+    from tidb_tpu.session.session import DB
+
+    servers = [StoreServer(MemStore(region_split_keys=100_000)) for _ in range(3)]
+    try:
+        stores = [RemoteStore("127.0.0.1", srv.start()) for srv in servers]
+        db = DB(store=ShardedStore(stores))
+        db.health.sweep()  # warm: sockets dialed, report path imported
+        best = float("inf")
+        for _ in range(10):
+            t0 = _t.perf_counter()
+            outs = db.health.sweep()
+            best = min(best, (_t.perf_counter() - t0) * 1000)
+            if not all(o["ok"] for o in outs):  # never inside an assert (-O)
+                raise RuntimeError(f"sweep lost a live store: {outs}")
+        return best
+    finally:
+        for srv in servers:
+            srv.shutdown()
+
+
+@register("metrics_history_overhead_ms")
+def bench_metrics_history_overhead() -> float:
+    """Warm COUNT(*) latency WHILE the metrics-history recorder samples at a
+    deliberately hostile 20ms interval (ms, lower is better): the recorder
+    runs off-thread, so any query-path tax it adds is lock contention on the
+    registry — this lane, gated next to fixed_overhead_ms, keeps the
+    always-on recorder honest about 'small footprint'."""
+    from tidb_tpu.utils.metricshist import recorder
+
+    rec = recorder()
+    old = rec.interval_s
+    rec.interval_s = 0.02
+    rec.start()
+    try:
+        return _warm_count_best("mho")
+    finally:
+        rec.stop()
+        rec.interval_s = old
+
+
+@register("shard_probe_overhead_ms")
+def bench_shard_probe_overhead() -> float:
+    """Host-callback tax of the per-shard straggler probes (ms, lower is
+    better): the SAME warm MPP gather timed with probes compiled in vs the
+    probe-free program variant (gather.PROBES_ENABLED=False recompiles
+    without the jax.debug.callback) — the carried OBSERVABILITY.md gap,
+    finally a number. Clamped at 0 (scheduler noise can favor either)."""
+    import time as _t
+
+    import numpy as np
+
+    import tidb_tpu
+    from tidb_tpu.executor.load import bulk_load
+    from tidb_tpu.parallel import gather
+
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE spo (id BIGINT PRIMARY KEY, g BIGINT, v BIGINT)")
+    rng = np.random.default_rng(7)
+    n = 50_000
+    bulk_load(db, "spo", [np.arange(n, dtype=np.int64), rng.integers(0, 5, n),
+                          rng.integers(0, 1000, n)])
+    s = db.session()
+    s.execute("ANALYZE TABLE spo")
+    s.execute("SET tidb_enforce_mpp = 1")
+    q = "SELECT g, COUNT(*), SUM(v) FROM spo GROUP BY g"
+
+    def best_of(k: int) -> float:
+        best = float("inf")
+        for _ in range(k):
+            t0 = _t.perf_counter()
+            s.query(q)
+            best = min(best, (_t.perf_counter() - t0) * 1000)
+        return best
+
+    try:
+        s.query(q)  # warm: compile the probed variant
+        with_probes = best_of(5)
+        gather.PROBES_ENABLED = False
+        s.query(q)  # warm: compile the probe-free variant
+        without = best_of(5)
+    finally:
+        gather.PROBES_ENABLED = True
+    return max(with_probes - without, 0.0)
+
+
 @register("owner_failover_ms")
 def bench_owner_failover() -> float:
     """Owner-election failover latency (ms, lower is better): a 3-shard

@@ -27,6 +27,16 @@ def memtable_rows(db, session, name: str) -> Optional[tuple[list, list, list]]:
         "statements_summary": _statements_summary,
         "slow_query": _slow_query,
         "trace_reservoir": _trace_reservoir,
+        # the cluster observability plane: fleet-wide memtables materialized
+        # from a live sys_snapshot sweep (dead stores degrade to a session
+        # warning + partial rows — TiDB's cluster_* semantics)
+        "cluster_info": _cluster_info,
+        "cluster_load": _cluster_load,
+        "cluster_slow_query": _cluster_slow_query,
+        "cluster_statements_summary": _cluster_statements_summary,
+        "cluster_trace_reservoir": _cluster_trace_reservoir,
+        "metrics_history": _metrics_history,
+        "cluster_metrics_history": _cluster_metrics_history,
         "resource_groups": _resource_groups,
         "runaway_watches": _runaway_watches,
         "views": _views,
@@ -146,20 +156,27 @@ def _variables(db, session):
     return cols, [_S(), _S(256)], rows
 
 
-def _statements_summary(db, session):
+def _statements_summary_shape():
     from tidb_tpu.types.field_type import double_type
 
     cols = ["DIGEST", "DIGEST_TEXT", "EXEC_COUNT", "SUM_LATENCY", "MAX_LATENCY",
             "AVG_LATENCY", "SUM_ROWS", "QUERY_SAMPLE_TEXT", "PLAN_DIGEST",
-            "SUM_COP_TASKS", "SUM_BACKOFF"]
+            "SUM_COP_TASKS", "SUM_BACKOFF", "MAX_MEM"]
     fts = [_S(80), _S(256), _I(), double_type(), double_type(), double_type(),
-           _I(), _S(256), _S(80), _I(), double_type()]
-    rows = []
-    for st in db.stmt_summary.stats():
-        d, _, norm = st.digest.partition("|")
-        rows.append((d, norm, st.exec_count, st.sum_latency, st.max_latency,
-                     st.avg_latency, st.sum_rows, st.sample, st.plan_digest,
-                     st.sum_cop_tasks, st.sum_backoff))
+           _I(), _S(256), _S(80), _I(), double_type(), _I()]
+    return cols, fts
+
+
+def _stmt_stats_row(st):
+    d, _, norm = st.digest.partition("|")
+    return (d, norm, st.exec_count, st.sum_latency, st.max_latency,
+            st.avg_latency, st.sum_rows, st.sample, st.plan_digest,
+            st.sum_cop_tasks, st.sum_backoff, st.max_mem)
+
+
+def _statements_summary(db, session):
+    cols, fts = _statements_summary_shape()
+    rows = [_stmt_stats_row(st) for st in db.stmt_summary.stats()]
     return cols, fts, rows
 
 
@@ -175,23 +192,29 @@ def _top_sql(db, session):
     return cols, fts, collector().top_sql()
 
 
-def _slow_query(db, session):
-    """The slow log ring with its structured exec-detail fields (ref: the
-    slow query log's Plan_digest/Cop_time/Backoff_time columns, fed from the
-    wire-shipped cop-task sidecars)."""
+def _slow_query_shape():
     from tidb_tpu.types.field_type import double_type
 
     cols = ["TIME", "QUERY", "QUERY_TIME", "RESULT_ROWS", "USER", "DIGEST",
             "PLAN_DIGEST", "COP_TASKS", "COP_PROC_MAX", "BACKOFF_TIME",
-            "RESPLITS", "MAX_TASK_STORE", "COP_SUMMARY", "TRACE_ID"]
+            "RESPLITS", "MAX_TASK_STORE", "COP_SUMMARY", "TRACE_ID", "MEM_MAX"]
     fts = [double_type(), _S(512), double_type(), _I(), _S(), _S(80), _S(80),
-           _I(), double_type(), double_type(), _I(), _S(64), _S(256), _S(80)]
-    rows = [
-        (e.time, e.sql, e.latency_s, e.rows, e.user, e.digest, e.plan_digest,
-         e.cop_tasks, e.cop_proc_max_ms / 1000.0, e.backoff_ms / 1000.0,
-         e.resplits, e.max_task_store, e.cop_summary, e.trace_id)
-        for e in db.stmt_summary.slow_queries()
-    ]
+           _I(), double_type(), double_type(), _I(), _S(64), _S(256), _S(80), _I()]
+    return cols, fts
+
+
+def _slow_entry_row(e):
+    return (e.time, e.sql, e.latency_s, e.rows, e.user, e.digest, e.plan_digest,
+            e.cop_tasks, e.cop_proc_max_ms / 1000.0, e.backoff_ms / 1000.0,
+            e.resplits, e.max_task_store, e.cop_summary, e.trace_id, e.mem_max)
+
+
+def _slow_query(db, session):
+    """The slow log ring with its structured exec-detail fields (ref: the
+    slow query log's Plan_digest/Cop_time/Backoff_time/Mem_max columns, fed
+    from the wire-shipped cop-task sidecars + the statement mem tracker)."""
+    cols, fts = _slow_query_shape()
+    rows = [_slow_entry_row(e) for e in db.stmt_summary.slow_queries()]
     return cols, fts, rows
 
 
@@ -324,6 +347,186 @@ COLLATIONS = [
     ("utf8mb4_general_ci", "utf8mb4", 45, "", "Yes", 1),
     ("binary", "binary", 63, "Yes", "Yes", 1),
 ]
+
+
+# -- the cluster observability plane ------------------------------------------
+# Fleet-wide memtables (ref: infoschema's cluster_* tables served over the
+# coprocessor memory-table endpoint, rpc_server.go:96): each query runs ONE
+# live sys_snapshot sweep through the DB's StoreHealthRegistry, merges the
+# LOCAL instance's rows with every store's wire-shipped rows under an
+# INSTANCE tag, and degrades an unreachable store to a session warning plus
+# partial results — never a failed query.
+
+
+def _local_instance(db) -> str:
+    return f"tidb:{db.node_id[:8]}"
+
+
+def _cluster_sweep(db, session, hist=None, sections=None):
+    """One fan-out sweep; failures become session warnings (TiDB's
+    partial-result semantics) and the good outcomes return. ``sections``
+    names the heavy report parts this memtable actually reads — a load/info
+    probe never serializes whole slow rings over the wire."""
+    outs = db.health.sweep(hist=hist, sections=sections)
+    for o in outs:
+        if not o["ok"]:
+            session.append_warning(
+                "Warning", 1105,
+                f"cluster memtable: instance {o['instance']} unreachable: {o['error']}",
+            )
+    return outs
+
+
+def _cluster_info(db, session):
+    from tidb_tpu.types.field_type import double_type
+    from tidb_tpu.utils.metricshist import PROC_START
+
+    import time as _time
+
+    cols = ["INSTANCE", "TYPE", "ADDRESS", "VERSION", "START_TIME", "UPTIME_S", "STATUS"]
+    fts = [_S(), _S(16), _S(), _S(), double_type(), double_type(), _S(16)]
+    me = _local_instance(db)
+    rows = [(me, "tidb", me, "8.0.11-tidb-tpu", PROC_START,
+             round(_time.time() - PROC_START, 3), "up")]
+    for o in _cluster_sweep(db, session, sections=()):
+        if o["ok"]:
+            rep = o["report"]
+            rows.append((o["instance"], "store", rep.get("addr", o["instance"]),
+                         rep.get("version"), rep.get("start_time"),
+                         rep.get("uptime_s"), "up"))
+        else:
+            rows.append((o["instance"], "store", o["instance"], None, None, None, "down"))
+    return cols, fts, rows
+
+
+def _load_row(instance, rep):
+    return (instance, float(rep.get("qps", 0.0)), float(rep.get("cop_qps", 0.0)),
+            int(rep.get("conns", 0)), int(rep.get("cop_queue", 0)),
+            int(rep.get("cop_pool", 0)), int(rep.get("stmts", 0)),
+            int(rep.get("cop_tasks", 0)), int(rep.get("device_cache_bytes", 0)),
+            int(rep.get("delta_rows", 0)), float(rep.get("uptime_s", 0.0)))
+
+
+def _cluster_load(db, session):
+    """Per-instance load signals (the balancer/overload-controller substrate
+    in SQL form): recent QPS needs the metrics-history recorder running on
+    the reporting process; cumulative counters are always live."""
+    from tidb_tpu.kv.memstore import MemStore
+    from tidb_tpu.types.field_type import double_type
+
+    cols = ["INSTANCE", "QPS", "COP_QPS", "CONNS", "COP_QUEUE", "COP_POOL",
+            "STMTS", "COP_TASKS", "DEVICE_CACHE_BYTES", "DELTA_ROWS", "UPTIME_S"]
+    fts = [_S(), double_type(), double_type(), _I(), _I(), _I(), _I(), _I(),
+           _I(), _I(), double_type()]
+    from tidb_tpu.kv.remote import sys_report
+
+    local = sys_report(store=db.store if isinstance(db.store, MemStore) else None, sections=())
+    # the local QPS estimator is live even when the recorder is not running
+    local["qps"] = round(db.health.recent_qps(), 3)
+    rows = [_load_row(_local_instance(db), local)]
+    for o in _cluster_sweep(db, session, sections=()):
+        if o["ok"]:
+            rows.append(_load_row(o["instance"], o["report"]))
+    return cols, fts, rows
+
+
+def _cluster_slow_query(db, session):
+    """Every instance's slow ring in one table: the LOCAL statement slow log
+    plus each store's wire-shipped cop slow log ([observability]
+    store-slow-cop-ms), INSTANCE-tagged."""
+    from tidb_tpu.utils.stmtsummary import SlowEntry
+
+    cols, fts = _slow_query_shape()
+    cols = ["INSTANCE"] + cols
+    fts = [_S()] + fts
+    me = _local_instance(db)
+    rows = [(me,) + _slow_entry_row(e) for e in db.stmt_summary.slow_queries()]
+    for o in _cluster_sweep(db, session, sections=("slow",)):
+        if not o["ok"]:
+            continue
+        for e in o["report"].get("slow", ()):
+            # rebuild the real record from the wire dict: _slow_entry_row is
+            # the ONE field-order home for local and fan-out rows alike
+            rows.append((o["instance"],) + _slow_entry_row(SlowEntry.from_pb(e)))
+    return cols, fts, rows
+
+
+def _cluster_statements_summary(db, session):
+    from tidb_tpu.utils.stmtsummary import StmtStats
+
+    cols, fts = _statements_summary_shape()
+    cols = ["INSTANCE"] + cols
+    fts = [_S()] + fts
+    me = _local_instance(db)
+    rows = [(me,) + _stmt_stats_row(st) for st in db.stmt_summary.stats()]
+    for o in _cluster_sweep(db, session, sections=("statements",)):
+        if not o["ok"]:
+            continue
+        for s in o["report"].get("statements", ()):
+            rows.append((o["instance"],) + _stmt_stats_row(StmtStats.from_pb(s)))
+    return cols, fts, rows
+
+
+def _cluster_trace_reservoir(db, session):
+    """The trace reservoirs of every SQL instance, INSTANCE-tagged. Store
+    processes record spans only under a propagated context (they keep no
+    reservoir of their own), so fan-out rows appear only from instances
+    whose report ships a ``traces`` section."""
+    from tidb_tpu.types.field_type import double_type
+
+    cols = ["INSTANCE", "TRACE_ID", "TIME", "QUERY", "QUERY_TIME", "DIGEST", "SLOW", "SPANS"]
+    fts = [_S(), _S(80), double_type(), _S(512), double_type(), _S(80), _I(), _I()]
+    me = _local_instance(db)
+    res = getattr(db, "trace_reservoir", None)
+    rows = [
+        (me, e.trace_id, e.time, e.sql, e.duration_s, e.digest,
+         1 if e.slow else 0, len(e.spans))
+        for e in (res.traces() if res is not None else [])
+    ]
+    for o in _cluster_sweep(db, session, sections=("traces",)):
+        if not o["ok"]:
+            continue
+        for t in o["report"].get("traces", ()):
+            rows.append((o["instance"], t.get("trace_id"), t.get("time"),
+                         t.get("sql"), t.get("duration_s"), t.get("digest"),
+                         t.get("slow", 0), t.get("spans", 0)))
+    return cols, fts, rows
+
+
+def _metrics_history_shape():
+    from tidb_tpu.types.field_type import double_type
+
+    cols = ["NAME", "LABELS", "TS", "VALUE"]
+    fts = [_S(128), _S(256), double_type(), double_type()]
+    return cols, fts
+
+
+def _metrics_history(db, session):
+    """This process's in-process metrics history (utils/metricshist): one
+    row per retained sample — "what did qps look like five minutes ago" as
+    SQL, with no external Prometheus."""
+    from tidb_tpu.utils.metricshist import recorder
+
+    cols, fts = _metrics_history_shape()
+    return cols, fts, list(recorder().series())
+
+
+def _cluster_metrics_history(db, session):
+    """Every instance's metrics history: the local rings plus each store's,
+    shipped inside the sys_snapshot report (``hist=True`` sweep)."""
+    from tidb_tpu.utils.metricshist import recorder
+
+    cols, fts = _metrics_history_shape()
+    cols = ["INSTANCE"] + cols
+    fts = [_S()] + fts
+    me = _local_instance(db)
+    rows = [(me,) + tuple(r) for r in recorder().series()]
+    for o in _cluster_sweep(db, session, hist=True, sections=()):
+        if not o["ok"]:
+            continue
+        for r in o["report"].get("history", ()):
+            rows.append((o["instance"],) + tuple(r))
+    return cols, fts, rows
 
 
 def _character_sets(db, session):

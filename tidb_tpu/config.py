@@ -46,6 +46,24 @@ class Config:
     # traces pin into a separate slow_capacity//2 section on top)
     trace_sample_rate: float = 0.0
     trace_reservoir_size: int = 64
+    # [observability] in-process metrics history (utils/metricshist.py): a
+    # bounded ring recorder sampling every registry counter/gauge/histogram
+    # so "what did qps look like five minutes ago" is answerable with no
+    # external Prometheus. Default ON (the recorder starts with the server /
+    # DB background loops) at a small footprint: retention/interval samples
+    # per series. interval <= 0 disables the recorder entirely.
+    metrics_history_interval_s: float = 5.0
+    metrics_history_retention_s: float = 600.0
+    # [observability] adaptive trace-sampling clamp (Dapper follow-up idiom:
+    # sample more when idle, clamp under pressure): when the local recent-QPS
+    # signal exceeds this, the effective sample rate scales down
+    # proportionally (tracing.clamp_rate). 0 = no clamp.
+    trace_clamp_qps: float = 0.0
+    # [observability] store-side cop slow log: a cop task whose store-side
+    # processing wall crosses this lands in the STORE process's own
+    # StmtSummary ring (served fleet-wide via the sys_snapshot verb /
+    # information_schema.cluster_slow_query)
+    store_slow_cop_ms: float = 300.0
     # [perf] instance-level serving: capacity (entries) of EACH cross-session
     # cache (statement ASTs / plan templates, planner/instcache.py), and the
     # optional point-get batcher collection window in microseconds — 0 keeps
@@ -101,6 +119,14 @@ class Config:
         obs = raw.get("observability", {})
         cfg.trace_sample_rate = float(obs.get("trace-sample-rate", cfg.trace_sample_rate))
         cfg.trace_reservoir_size = int(obs.get("trace-reservoir-size", cfg.trace_reservoir_size))
+        cfg.metrics_history_interval_s = float(
+            obs.get("metrics-history-interval-s", cfg.metrics_history_interval_s)
+        )
+        cfg.metrics_history_retention_s = float(
+            obs.get("metrics-history-retention", cfg.metrics_history_retention_s)
+        )
+        cfg.trace_clamp_qps = float(obs.get("trace-clamp-qps", cfg.trace_clamp_qps))
+        cfg.store_slow_cop_ms = float(obs.get("store-slow-cop-ms", cfg.store_slow_cop_ms))
         perf = raw.get("perf", {})
         cfg.instance_plan_cache_size = int(
             perf.get("instance-plan-cache-size", cfg.instance_plan_cache_size)

@@ -69,6 +69,22 @@ def shared_cop_pool(concurrency_hint: int = 0) -> ThreadPoolExecutor:
         return _POOL
 
 
+def cop_pool_stats() -> tuple[int, int]:
+    """→ (pool size, queued-task depth) of the shared cop pool — the
+    queue-pressure signal the sys_snapshot health report ships fleet-wide
+    (0, 0 when no cop request has built the pool yet). Reads executor
+    internals (_work_queue), guarded so a stdlib change degrades to zeros
+    rather than breaking introspection."""
+    with _POOL_MU:
+        pool = _POOL
+    if pool is None:
+        return 0, 0
+    try:
+        return pool._max_workers, pool._work_queue.qsize()
+    except AttributeError:
+        return 0, 0
+
+
 def shutdown_shared_pool() -> None:
     """Idempotent teardown (tests / embedders); the pool lazily rebuilds on
     the next cop request."""

@@ -192,6 +192,18 @@ class ColumnCache:
         # bumped whenever a dictionary is compacted: device caches must drop
         self.epoch = 0
 
+    def resident_bytes(self) -> int:
+        """Host bytes pinned by cached column entries (base entries, delta
+        overlays, merged views) — the device-cache working-set signal the
+        sys_snapshot health report ships per store (cluster_load)."""
+        total = 0
+        with self._mu:
+            for coll in (self._entries, self._deltas, self._merged):
+                for e in coll.values():
+                    for data, valid in getattr(e, "cols", {}).values():
+                        total += getattr(data, "nbytes", 0) + getattr(valid, "nbytes", 0)
+        return total
+
     # -- dictionaries ------------------------------------------------------
     def set_table_alias(self, physical_id: int, logical_id: int) -> None:
         """Partition physical ids share the logical table's dictionaries, so

@@ -103,6 +103,69 @@ def _lock_from_pb(pb: dict) -> Lock:
     return Lock(_ub(pb["primary"]), pb["start_ts"], pb["op"], _ub(pb["value"]), pb["ttl_ms"], pb["created_ms"])
 
 
+def sys_report(store=None, server=None, hist=None, sections=None) -> dict:
+    """One process's introspection report — what the replay-safe
+    ``sys_snapshot`` verb ships fleet-wide (ref: the gRPC coprocessor
+    endpoint for memory tables serving ``information_schema.cluster_*``,
+    rpc_server.go:96). Walks the process-global metrics registry, the
+    store-side StmtSummary ring (``server`` given), cop-pool depth,
+    device-cache residency, uptime, and process info into one JSON-able
+    dict; ``hist`` additionally attaches the metrics-history rings (True =
+    every series, a string = that metric only). ``sections`` selects the
+    HEAVY parts (any of "metrics"/"statements"/"slow"): None ships them
+    all, an iterable ships only those named — cluster_info/cluster_load
+    sweeps and GET /cluster request ``sections=()`` so a load probe never
+    serializes whole slow rings over the wire."""
+    import os as _os
+
+    from tidb_tpu.utils import metrics as _m
+    from tidb_tpu.utils import metricshist as _mh
+
+    want = None if sections is None else set(sections)
+
+    def _want(k: str) -> bool:
+        return want is None or k in want
+
+    now = time.time()
+    rec = _mh.recorder()
+    rep: dict = {
+        "pid": _os.getpid(),
+        "version": "8.0.11-tidb-tpu",
+        "start_time": _mh.PROC_START,
+        "uptime_s": round(now - _mh.PROC_START, 3),
+        "stmts": _m.STMT_TOTAL.total(),
+        "cop_tasks": _m.COP_TASKS.total(),
+        "conns": int(_m.SERVER_CONNS.get()),
+        # recent rates need the history recorder running (default on for
+        # server processes); 0.0 with no samples — never an error
+        "qps": round(rec.rate("tidb_tpu_executor_statement_total"), 3),
+        "cop_qps": round(rec.rate("tidb_tpu_copr_task_total"), 3),
+        "delta_rows": _m.DEVICE_DELTA_ROWS.get(),
+    }
+    if _want("metrics"):
+        rep["metrics"] = _m.REGISTRY.snapshot()
+    from tidb_tpu.copr.client import cop_pool_stats
+
+    rep["cop_pool"], rep["cop_queue"] = cop_pool_stats()
+    if store is not None and isinstance(store, MemStore):
+        from tidb_tpu.copr.colcache import cache_for
+
+        rep["device_cache_bytes"] = cache_for(store).resident_bytes()
+    if server is not None:
+        rep["addr"] = f"{server.host}:{server.port}"
+        with server._conns_mu:
+            rep["conns"] = len(server._conns)
+        if _want("statements"):
+            rep["statements"] = [st.to_pb() for st in server.stmt_summary.stats()[-64:]]
+        if _want("slow"):
+            rep["slow"] = [e.to_pb() for e in server.stmt_summary.slow_queries()[-128:]]
+    if hist:
+        rep["history"] = [
+            list(r) for r in rec.series(name=hist if isinstance(hist, str) else None)
+        ]
+    return rep
+
+
 class StoreServer:
     """Serves one MemStore (and its engines) to remote SQL-layer processes."""
 
@@ -119,6 +182,12 @@ class StoreServer:
         # and resurrect in-process servers this way)
         self._conns: set[socket.socket] = set()
         self._conns_mu = threading.Lock()
+        # store-side cop slow log (the TiKV-slow-log analog): every cop task
+        # records into this ring; tasks over [observability] store-slow-cop-ms
+        # pin a SlowEntry. Served fleet-wide via the sys_snapshot verb.
+        from tidb_tpu.utils.stmtsummary import StmtSummary
+
+        self.stmt_summary = StmtSummary(capacity=64, slow_capacity=128)
 
     def _mpp_mgr(self):
         with self._mpp_mu:
@@ -129,10 +198,20 @@ class StoreServer:
             return self._mpp
 
     def start(self) -> int:
+        # the in-process metrics history rides along (default on, refcounted
+        # — shared with any embedded DB's background loops in this process)
+        from tidb_tpu.utils.metricshist import recorder
+
+        recorder().start()
+        self._rec_started = True
         self._thread.start()
         return self.port
 
     def shutdown(self) -> None:
+        if getattr(self, "_rec_started", False) and not self._stop.is_set():
+            from tidb_tpu.utils.metricshist import recorder
+
+            recorder().stop()
         self._stop.set()
         try:
             # wake the blocked accept() (it holds the listener's file
@@ -223,6 +302,17 @@ class StoreServer:
         cmd = h["cmd"]
         if cmd == "ping":
             return {"ok": 1}, []
+        if cmd == "sys_snapshot":
+            # the store-introspection verb (replay-safe: a pure read of
+            # process state) — one JSON-able health/load report per store,
+            # the substrate of information_schema.cluster_* and the
+            # SQL layer's StoreHealthRegistry
+            return {
+                "report": sys_report(
+                    store=st, server=self, hist=h.get("hist"),
+                    sections=h.get("sections"),
+                )
+            }, []
         if cmd == "current_ts":
             return {"ts": st.current_ts()}, []
         if cmd == "tso":
@@ -474,6 +564,21 @@ class StoreServer:
                         warn=lambda lv, code, msg: len(warns) < 64 and warns.append([lv, code, msg]),
                     )
             det.proc_ms = (time.perf_counter() - t0) * 1000.0
+            # store-side cop slow log: record the task into THIS process's
+            # ring (digest per TABLE so repeats aggregate across regions and
+            # shapes; the fleet reads it via sys_snapshot → cluster_slow_query)
+            from tidb_tpu import config as _config
+
+            tid = dag.executors[0].table_id if dag.executors else 0
+            text = f"cop table={tid} region={h['region_id']}"
+            self.stmt_summary.record(
+                text,
+                det.proc_ms / 1000.0,
+                len(chunk),
+                user="store",
+                slow_threshold_s=_config.current().store_slow_cop_ms / 1000.0,
+                digest_val=f"cop:{tid}|cop table={tid}",
+            )
             reply = {"ok": 1, "warnings": warns, "exec": det.to_pb()}
             if tracer is not None:
                 reply["spans"] = tracer.to_pb()
@@ -868,6 +973,20 @@ class RemoteStore:
             out.append((buf[off : off + klen], buf[off + klen : off + klen + vlen]))
             off += klen + vlen
         return out
+
+    def sys_snapshot(self, hist=None, sections=None) -> dict:
+        """The store's introspection report (see ``sys_report``): one
+        replay-safe RPC under the usual boRPC Backoffer. ``hist`` attaches
+        the store's metrics-history rings (True = all, str = one metric);
+        ``sections`` selects the heavy report parts (None = all)."""
+        h, _ = self._call(
+            {
+                "cmd": "sys_snapshot",
+                "hist": hist if isinstance(hist, str) else (1 if hist else 0),
+                "sections": None if sections is None else list(sections),
+            }
+        )
+        return h["report"]
 
     def run_gc(self, safe_point=None, life_ms: int = 600_000):
         """MVCC GC runs where the data lives — proxied to the server.

@@ -662,6 +662,59 @@ class ShardedStore:
         a freshly granted owner the second majority sweep owner_term pays."""
         return self.election.granted_term(key, node_id)
 
+    # -- fleet introspection (the sys_snapshot fan-out behind
+    # information_schema.cluster_* and the StoreHealthRegistry) --------------
+    @staticmethod
+    def instance_name(st) -> str:
+        """Stable display identity of one store: the wire address for remote
+        stores, a nonce-derived tag for in-process MemStores."""
+        if hasattr(st, "host") and hasattr(st, "port"):
+            return f"{st.host}:{st.port}"
+        return f"mem:{getattr(st, 'nonce', 'embedded')[:8]}"
+
+    def sys_snapshot_all(self, hist=None, sections=None) -> list[dict]:
+        """Fan the sys_snapshot introspection verb out to EVERY shard with
+        dead-store tolerance: each remote call retries under that store's
+        own boRPC Backoffer (RemoteStore._call), and a store that stays dead
+        past its budget contributes a per-store failure OUTCOME — one dead
+        instance must never fail the whole sweep (TiDB's cluster-memtable
+        partial-result semantics). The probes run CONCURRENTLY (one short-
+        lived thread per shard, joined before return), so a sweep over N
+        dead stores stalls for max(budget), not the sum of N budgets.
+        → [{"instance", "shard", "ok", "report" | "error"}] in shard
+        order."""
+
+        def probe(si: int, st) -> dict:
+            addr = self.instance_name(st)
+            fn = getattr(st, "sys_snapshot", None)
+            try:
+                if fn is not None:
+                    rep = fn(hist=hist, sections=sections)
+                else:
+                    from tidb_tpu.kv.remote import sys_report
+
+                    rep = sys_report(store=st, hist=hist, sections=sections)
+                return {"instance": addr, "shard": si, "ok": True, "report": rep}
+            except (ConnectionError, OSError) as e:
+                return {"instance": addr, "shard": si, "ok": False, "error": str(e)}
+
+        if len(self.stores) == 1:
+            return [probe(0, self.stores[0])]
+        out: list = [None] * len(self.stores)
+
+        def run(si: int, st) -> None:
+            out[si] = probe(si, st)
+
+        threads = [
+            threading.Thread(target=run, args=(si, st), daemon=True, name=f"syssnap-{si}")
+            for si, st in enumerate(self.stores)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out
+
     # -- MPP: single-owner placement ----------------------------------------
     def mpp_ndev(self) -> int:
         return self.stores[0].mpp_ndev()

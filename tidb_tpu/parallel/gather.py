@@ -57,6 +57,13 @@ _MPP_DEV_CACHE: dict = {}
 # cache (_MPP_FN_CACHE) keeps working across queries
 _SHARD_OBS: dict = {"t0": 0.0, "sink": None}
 
+# straggler-probe switch: False compiles probe-FREE fragment programs (the
+# jax.debug.callback never enters the jaxpr), so the host-callback tax is
+# measurable as on-vs-off latency — benchdaily's shard_probe_overhead_ms
+# lane and the driver's multichip dryrun both flip this. Part of the
+# compiled-program cache key, so the two variants coexist.
+PROBES_ENABLED = True
+
 
 def _shard_probe(idx, rows, xbytes):
     """Host callback fired once per mesh shard inside the jitted fragment
@@ -1300,6 +1307,7 @@ class MPPGatherExec:
                 repr([g.to_pb() for g in agg.group_by]) if agg is not None else "",
                 repr([a.to_pb() for a in agg.aggs]) if agg is not None else "",
                 tuple(ncols),
+                PROBES_ENABLED,
             )
             cached = _MPP_FN_CACHE.get(fn_key)
             if cached is None:
@@ -1312,7 +1320,7 @@ class MPPGatherExec:
                     agg_inputs=agg_inputs if agg is not None else None,
                     topn=topn_spec,
                     warn_sink=warn_sink,
-                    shard_probe=_shard_probe,
+                    shard_probe=_shard_probe if PROBES_ENABLED else None,
                 )
                 # the sink is baked into the compiled program's closures: a
                 # cache hit must attribute warn counts via the ORIGINAL sink

@@ -24,6 +24,60 @@ class StatusServer:
 
                     body = REGISTRY.render().encode()
                     ctype = "text/plain; version=0.0.4"
+                elif self.path.startswith("/metrics/history"):
+                    # the in-process metrics history recorder
+                    # (utils/metricshist): ?name=<metric> filters one series
+                    # family, ?seconds=<s> bounds the lookback — the HTTP
+                    # face of information_schema.metrics_history
+                    import time as _time
+                    from urllib.parse import parse_qs, urlparse
+
+                    from tidb_tpu.utils.metricshist import recorder
+
+                    q = parse_qs(urlparse(self.path).query)
+                    name = q.get("name", [None])[0]
+                    secs = q.get("seconds", [None])[0]
+                    try:
+                        since = _time.time() - float(secs) if secs else None
+                    except ValueError:
+                        self.send_response(400)
+                        self.end_headers()
+                        return
+                    body = json.dumps(
+                        [
+                            {"name": n, "labels": lbl, "ts": t, "value": v}
+                            for n, lbl, t, v in recorder().series(name=name, since=since)
+                        ]
+                    ).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/cluster"):
+                    # fleet-wide introspection: one live sys_snapshot sweep
+                    # (dead stores degrade to per-instance error entries)
+                    # plus the health registry's staleness view — the HTTP
+                    # face of information_schema.cluster_info/cluster_load.
+                    # sections=() keeps the heavy report parts off the wire
+                    # entirely; the filter below only strips history rings a
+                    # hist sweep may have cached alongside.
+                    outs = outer.db.health.sweep(sections=())
+                    slim = []
+                    for o in outs:
+                        ent = {"instance": o["instance"], "shard": o["shard"], "ok": o["ok"]}
+                        if o["ok"]:
+                            ent["report"] = {
+                                k: v for k, v in o["report"].items()
+                                if k not in ("metrics", "history", "statements", "slow", "traces")
+                            }
+                        else:
+                            ent["error"] = o["error"]
+                        slim.append(ent)
+                    reg = {
+                        inst: {"ok": ent["ok"], "error": ent.get("error", ""),
+                               "staleness_s": outer.db.health.staleness_s(inst),
+                               "stale": outer.db.health.is_stale(inst)}
+                        for inst, ent in outer.db.health.reports().items()
+                    }
+                    body = json.dumps({"instances": slim, "registry": reg}).encode()
+                    ctype = "application/json"
                 elif self.path == "/status":
                     body = json.dumps(
                         {"connections": len(getattr(outer.db, "server", None)._conns) if getattr(outer.db, "server", None) else 0,

@@ -248,6 +248,9 @@ class Session:
         # per-statement memory tracker + kill flag (ref: memory.Tracker root
         # at the session, sqlkiller checked at executor boundaries)
         self.mem_tracker = None
+        # the finished statement's tracker peak (bytes): _select captures it
+        # before dropping the tracker; slow_query.MEM_MAX / MAX_MEM read it
+        self._last_mem_peak = 0
         self._killed = False
         self._deadline: Optional[float] = None
         # session-scoped plan bindings (override globals; ref: bindinfo scope)
@@ -388,6 +391,18 @@ class Session:
             return None
         if r <= 0:
             return None
+        # adaptive clamp (ROADMAP 4a): under load pressure the effective
+        # rate scales toward 0 (bounded sampled-statements/sec), restoring
+        # itself as soon as the recent-QPS signal falls back under the knob
+        from tidb_tpu import config as _config
+
+        clamp = _config.current().trace_clamp_qps
+        if clamp > 0:
+            from tidb_tpu.utils.tracing import clamp_rate
+
+            r = clamp_rate(r, self._db.health.recent_qps(), clamp)
+            if r <= 0:
+                return None
         if r < 1.0:
             seed = str(self.vars.get("tidb_tpu_trace_sample_seed", "") or "").strip()
             if self._trace_rng is None or seed != self._trace_rng_seed:
@@ -616,6 +631,7 @@ class Session:
         self.exec_summary = None
         self.mpp_details = []
         self._last_plan = None
+        self._last_mem_peak = 0
         if not isinstance(stmt, ast.Show):  # SHOW WARNINGS must see them
             self._prev_warnings = self.warnings
             self.warnings = []
@@ -652,6 +668,7 @@ class Session:
                 # slow-log → reservoir pivot: the sampled trace's id rides
                 # the structured SlowEntry
                 trace_id=(self._sampled_tracer.trace_id if self._sampled_tracer is not None else ""),
+                mem_max=self._last_mem_peak,
             )
             # resource-group accounting + runaway detection (ref:
             # RunawayChecker at adapter.go:553; RU model per request)
@@ -1352,6 +1369,10 @@ class Session:
         finally:
             self._read_ts_override = None
             self._deadline = None
+            if self.mem_tracker is not None:
+                # max over every _select of the statement (subqueries/CTEs
+                # run their own tracker before the outer one finishes)
+                self._last_mem_peak = max(self._last_mem_peak, self.mem_tracker.max_consumed)
             self.mem_tracker = None
         self._last_plan = plan  # outermost select wins (inner selects ran already)
         names = [oc.name for oc in plan.schema]
@@ -1929,6 +1950,119 @@ class Session:
             self.bindings_ver += 1
 
 
+class StoreHealthRegistry:
+    """Last-seen per-store health/load reports with staleness timestamps —
+    the SQL layer's cache over the fleet's ``sys_snapshot`` introspection
+    verb, and the load-signal substrate the placement balancer and overload
+    controller (ROADMAP items 3/4) will consume. A sweep fans out with
+    dead-store tolerance (per-store outcomes); a store that fails keeps its
+    LAST good report but its staleness clock stops advancing, so consumers
+    can distinguish "fresh", "stale", and "never seen"."""
+
+    def __init__(self, db: "DB"):
+        self._db = db
+        self._mu = threading.Lock()
+        # instance → {"report", "ts" (last OK), "checked" (last attempt),
+        #             "ok", "error", "shard"}
+        self._reports: dict[str, dict] = {}
+        # local recent-QPS estimator state (EWMA over STMT_TOTAL deltas)
+        self._qps_t: float = time.monotonic()
+        self._qps_total: "float | None" = None
+        self._qps: float = 0.0
+
+    def _outcomes(self, hist=None, sections=None) -> list[dict]:
+        store = self._db.store
+        all_fn = getattr(store, "sys_snapshot_all", None)
+        if all_fn is not None:
+            return all_fn(hist=hist, sections=sections)
+        from tidb_tpu.kv.remote import sys_report
+        from tidb_tpu.kv.sharded import ShardedStore
+
+        addr = ShardedStore.instance_name(store)
+        fn = getattr(store, "sys_snapshot", None)
+        try:
+            rep = (
+                fn(hist=hist, sections=sections)
+                if fn is not None
+                else sys_report(store=store, hist=hist, sections=sections)
+            )
+            return [{"instance": addr, "shard": 0, "ok": True, "report": rep}]
+        except (ConnectionError, OSError) as e:
+            return [{"instance": addr, "shard": 0, "ok": False, "error": str(e)}]
+
+    def sweep(self, hist=None, sections=None) -> list[dict]:
+        """One full-fleet introspection sweep: fan out, cache, return the
+        per-store outcomes (never raises for a dead store — its outcome says
+        so). ``sections`` limits the heavy report parts a consumer actually
+        reads (see ``sys_report``). Benchdaily's ``cluster_snapshot_ms``
+        lane guards this wall."""
+        from tidb_tpu.utils import metrics as _m
+
+        t0 = time.perf_counter()
+        outs = self._outcomes(hist=hist, sections=sections)
+        _m.CLUSTER_SNAPSHOT_SECONDS.observe(time.perf_counter() - t0)
+        now = time.time()
+        with self._mu:
+            for o in outs:
+                if o["ok"]:
+                    self._reports[o["instance"]] = {
+                        "report": o["report"], "ts": now, "checked": now,
+                        "ok": True, "error": "", "shard": o["shard"],
+                    }
+                else:
+                    prev = self._reports.get(o["instance"])
+                    ent = dict(prev) if prev else {"report": None, "ts": 0.0, "shard": o["shard"]}
+                    ent.update(ok=False, error=o["error"], checked=now)
+                    self._reports[o["instance"]] = ent
+        return outs
+
+    def reports(self) -> dict[str, dict]:
+        """Cached last-seen state per instance (shallow copies)."""
+        with self._mu:
+            return {k: dict(v) for k, v in self._reports.items()}
+
+    def staleness_s(self, instance: str) -> "float | None":
+        """Seconds since the last GOOD report from ``instance`` (None =
+        never seen one)."""
+        with self._mu:
+            ent = self._reports.get(instance)
+        if ent is None or not ent["ts"]:
+            return None
+        return time.time() - ent["ts"]
+
+    def is_stale(self, instance: str, max_age_s: float = 60.0) -> bool:
+        """True when ``instance`` has no fresh report: its last sweep failed
+        or its newest good report is older than ``max_age_s``."""
+        with self._mu:
+            ent = self._reports.get(instance)
+        if ent is None:
+            return True
+        if not ent["ok"]:
+            return True
+        return (time.time() - ent["ts"]) > max_age_s
+
+    def recent_qps(self) -> float:
+        """This instance's recent statement rate: an EWMA (~5s horizon) over
+        STMT_TOTAL deltas, recomputed at most every 250ms — cheap enough for
+        the trace-sampling clamp to read per sampled-statement attempt."""
+        from tidb_tpu.utils import metrics as _m
+
+        now = time.monotonic()
+        with self._mu:
+            total = _m.STMT_TOTAL.total()
+            if self._qps_total is None:
+                self._qps_t, self._qps_total = now, total
+                return self._qps
+            dt = now - self._qps_t
+            if dt < 0.25:
+                return self._qps
+            inst = max(total - self._qps_total, 0.0) / dt
+            alpha = min(dt / 5.0, 1.0)
+            self._qps += alpha * (inst - self._qps)
+            self._qps_t, self._qps_total = now, total
+            return self._qps
+
+
 class DB:
     """Embedded database handle (testkit.CreateMockStore analog). With
     ``store`` given (e.g. a kv.remote.RemoteStore), this process is a pure
@@ -2011,6 +2145,11 @@ class DB:
         # the cache keys on priv_version (ref: privilege reload notification)
         self.priv_version = 0
         self._priv_checker = None
+        # fleet health/load registry: cached sys_snapshot reports per store
+        # with staleness (the cluster_* memtable substrate; ROADMAP 3/4's
+        # load signals read from here)
+        self.health = StoreHealthRegistry(self)
+        self._rec_started = False
 
     def ensure_priv_bootstrap(self) -> None:
         from tidb_tpu.privilege import bootstrap_priv_tables
@@ -2179,6 +2318,14 @@ class DB:
             "colmerge", colmerge_interval_s, lambda: self._owner_gated("colmerge", self.run_delta_merge)
         )
         self.timers.start()
+        # the in-process metrics history recorder rides the background
+        # lifecycle (refcounted process singleton; thread "metrics-history"
+        # dies with stop_background — the thread-hygiene guard covers it)
+        if not self._rec_started:
+            from tidb_tpu.utils.metricshist import recorder
+
+            recorder().start()
+            self._rec_started = True
 
     def run_delta_merge(self) -> int:
         """One compactor sweep of the delta+merge device column cache: fold
@@ -2198,6 +2345,11 @@ class DB:
     def stop_background(self) -> None:
         if getattr(self, "timers", None) is not None:
             self.timers.stop()
+        if self._rec_started:
+            from tidb_tpu.utils.metricshist import recorder
+
+            recorder().stop()
+            self._rec_started = False
 
     def run_gc(self, safe_point: Optional[int] = None) -> int:
         """One synchronous MVCC GC cycle (tests / admin). Honors the

@@ -36,6 +36,21 @@ class Counter:
         with self._mu:
             return self._vals.get(key, 0)
 
+    def total(self) -> float:
+        """Sum over every label combination — the load-signal read (QPS
+        estimation sums statement types; per-type splits ride snapshot())."""
+        with self._mu:
+            return sum(self._vals.values())
+
+    def snapshot(self) -> dict:
+        """JSON-able state for the sys_snapshot report / metrics history."""
+        with self._mu:
+            return {
+                "kind": "counter",
+                "labels": list(self.labels),
+                "values": [[list(k), v] for k, v in sorted(self._vals.items())],
+            }
+
     def render(self) -> str:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._mu:
@@ -72,6 +87,18 @@ class Gauge:
         with self._mu:
             return self._vals.get(key, 0)
 
+    def total(self) -> float:
+        with self._mu:
+            return sum(self._vals.values())
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "kind": "gauge",
+                "labels": list(self.labels),
+                "values": [[list(k), v] for k, v in sorted(self._vals.items())],
+            }
+
     def render(self) -> str:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
         with self._mu:
@@ -104,6 +131,19 @@ class Histogram:
     @property
     def count(self) -> int:
         return self._n
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            cum = 0
+            buckets = []
+            for b, c in zip(self.buckets, self._counts):
+                cum += c
+                buckets.append([b, cum])
+            # the overflow bucket, exactly like render()'s +Inf line — without
+            # it a wire consumer reconstructing the distribution loses every
+            # observation above the top bound ("+Inf" keeps the dict JSON-able)
+            buckets.append(["+Inf", cum + self._counts[-1]])
+            return {"kind": "histogram", "sum": self._sum, "count": self._n, "buckets": buckets}
 
     def render(self) -> str:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
@@ -151,6 +191,14 @@ class Registry:
         with self._mu:
             ms = list(self._metrics.values())
         return "\n".join(m.render() for m in ms) + "\n"
+
+    def snapshot(self) -> dict:
+        """One JSON-able dict of every metric's current state — what the
+        ``sys_snapshot`` introspection verb ships fleet-wide and the metrics
+        history recorder samples per tick."""
+        with self._mu:
+            ms = list(self._metrics.items())
+        return {name: m.snapshot() for name, m in ms}
 
 
 # process-global registry (ref: metrics.go package-level collectors)
@@ -259,4 +307,14 @@ POINTGET_BATCH = REGISTRY.histogram(
     "tidb_tpu_pointget_batch_size",
     "Point-get keys coalesced per batched store dispatch",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)
+# cluster observability plane (the sys_snapshot verb + StoreHealthRegistry
+# sweeps in session.py, and the utils/metricshist.py in-process recorder)
+CLUSTER_SNAPSHOT_SECONDS = REGISTRY.histogram(
+    "tidb_tpu_cluster_snapshot_seconds",
+    "Full-fleet sys_snapshot sweep wall (all shards, dead-store tolerant)",
+)
+METRICS_HISTORY_POINTS = REGISTRY.gauge(
+    "tidb_tpu_metrics_history_points",
+    "Samples currently retained by the in-process metrics history recorder",
 )

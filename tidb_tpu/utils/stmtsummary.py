@@ -7,7 +7,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict, deque
-from dataclasses import dataclass, field
+from dataclasses import asdict, dataclass, field, fields
 
 
 # digest memo: normalizing re-tokenizes the whole statement (a full lexer
@@ -71,10 +71,31 @@ class StmtStats:
     plan_digest: str = ""
     sum_backoff: float = 0.0  # seconds
     sum_cop_tasks: int = 0
+    # peak per-statement memory (utils/memory.Tracker root max_consumed) —
+    # the statements_summary MAX_MEM column (OOM forensics without a repro)
+    max_mem: int = 0
 
     @property
     def avg_latency(self) -> float:
         return self.sum_latency / self.exec_count if self.exec_count else 0.0
+
+    def to_pb(self) -> dict:
+        """Wire form for the sys_snapshot introspection verb (the fleet-wide
+        cluster_statements_summary rows travel as these dicts)."""
+        d = asdict(self)
+        d["avg_latency"] = self.avg_latency
+        return d
+
+    @classmethod
+    def from_pb(cls, pb: dict) -> "StmtStats":
+        """Inverse of ``to_pb`` (derived/unknown keys ignored, missing keys
+        default) — the cluster_* memtables rebuild real records from wire
+        dicts so the dataclass is the ONE home of the field set."""
+        names = {f.name for f in fields(cls)}
+        d = {k: v for k, v in pb.items() if k in names}
+        d.setdefault("digest", "")
+        d.setdefault("sample", "")
+        return cls(**d)
 
 
 @dataclass
@@ -98,10 +119,26 @@ class SlowEntry:
     # when the statement was trace-sampled, the reservoir key an operator
     # pivots to for the full span tree (GET /traces?id=<trace_id>)
     trace_id: str = ""
+    # the statement's memory-tracker peak (bytes) — slow_query.MEM_MAX
+    mem_max: int = 0
 
     def __iter__(self):
         # legacy 5-tuple shape for pre-structured consumers
         return iter((self.time, self.sql, self.latency_s, self.rows, self.user))
+
+    def to_pb(self) -> dict:
+        """Wire form for the sys_snapshot verb (cluster_slow_query rows)."""
+        return asdict(self)
+
+    @classmethod
+    def from_pb(cls, pb: dict) -> "SlowEntry":
+        """Inverse of ``to_pb`` (see StmtStats.from_pb)."""
+        names = {f.name for f in fields(cls)}
+        d = {k: v for k, v in pb.items() if k in names}
+        for req, dflt in (("time", 0.0), ("sql", ""), ("latency_s", 0.0),
+                          ("rows", 0), ("user", "")):
+            d.setdefault(req, dflt)
+        return cls(**d)
 
 
 class StmtSummary:
@@ -123,6 +160,7 @@ class StmtSummary:
         plan_digest: str = "",
         cop=None,
         trace_id: str = "",
+        mem_max: int = 0,
     ) -> None:
         # the session computes one digest per statement and threads it here
         # (plus Top-SQL/bindings) instead of re-normalizing per consumer;
@@ -140,6 +178,7 @@ class StmtSummary:
             st.max_latency = max(st.max_latency, latency_s)
             st.sum_rows += rows
             st.last_seen = time.time()
+            st.max_mem = max(st.max_mem, int(mem_max))
             if plan_digest:
                 st.plan_digest = plan_digest
             if cop is not None and cop.num:
@@ -150,7 +189,7 @@ class StmtSummary:
                 e = SlowEntry(
                     time.time(), sql[:512], latency_s, rows, user,
                     digest=d.partition("|")[0], plan_digest=plan_digest,
-                    trace_id=trace_id,
+                    trace_id=trace_id, mem_max=int(mem_max),
                 )
                 if cop is not None and cop.num:
                     e.cop_tasks = cop.num

@@ -175,6 +175,20 @@ def effective(tracer) -> "Tracer | None":
     return tracer
 
 
+def clamp_rate(rate: float, qps: float, clamp_qps: float) -> float:
+    """Adaptive sampling clamp (Dapper's follow-up idiom: sample generously
+    when idle, shed tracing under pressure): above ``clamp_qps`` the
+    effective rate scales down proportionally, so the expected number of
+    sampled statements per second stays ~``rate * clamp_qps`` no matter how
+    hard the instance is driven — and recovers to the configured rate the
+    moment load falls back under the threshold. ``clamp_qps <= 0`` disables
+    the clamp. The single home of the rule: the session's sampling coin and
+    any future remote-side clamp must both route here."""
+    if clamp_qps <= 0 or qps <= clamp_qps:
+        return rate
+    return rate * (clamp_qps / qps)
+
+
 # -- trace reservoir ---------------------------------------------------------
 
 
