@@ -67,6 +67,7 @@ def thread_hygiene():
                 or t.name.startswith("rcop_")
                 or t.name.startswith("trace-")
                 or t.name == "metrics-history"
+                or t.name == "store-colmerge"
             )
         ]
 

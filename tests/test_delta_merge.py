@@ -366,3 +366,41 @@ def test_dirty_txn_gets_batched():
     # 6 snapshot misses coalesce into one dispatch (3 and 5 come from the buffer)
     assert dispatches == 1 and keys == 6, (dispatches, keys)
     s.execute("ROLLBACK")
+
+
+def test_store_colmerge_sweep_fires_and_stops(monkeypatch, thread_hygiene):
+    """PR 7 leftover, closed: a REMOTE StoreServer runs its own periodic
+    delta-merge sweep (the embedded owner-gated 'colmerge' timer mirrored
+    onto the storage tier) — it calls merge_pending on the configured
+    cadence with the server's stop event as the cooperative fence, and the
+    thread dies with shutdown()."""
+    import time
+
+    from tidb_tpu.copr import colcache as _colcache
+    from tidb_tpu.kv import remote as _remote
+    from tidb_tpu.kv.memstore import MemStore
+
+    old = _config.current()
+    _config.set_current(dataclasses.replace(old, store_colmerge_interval_s=0.05))
+    calls = []
+
+    class _Stub:
+        def merge_pending(self, threshold=None, should_stop=None):
+            calls.append(should_stop() if should_stop is not None else None)
+            return 0
+
+    monkeypatch.setattr(_colcache, "cache_for", lambda store: _Stub())
+    srv = _remote.StoreServer(MemStore(region_split_keys=1 << 62))
+    try:
+        srv.start()
+        deadline = time.time() + 5
+        while not calls and time.time() < deadline:
+            time.sleep(0.02)
+        assert calls, "store-colmerge sweep never fired"
+        assert calls[0] is False  # the fence callable reports not-stopped
+    finally:
+        srv.shutdown()
+        _config.set_current(old)
+    assert not any(
+        t.name == "store-colmerge" and t.is_alive() for t in threading.enumerate()
+    ), "store-colmerge thread survived shutdown"

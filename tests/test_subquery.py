@@ -174,3 +174,38 @@ def test_correlated_scalar_subquery(db):
     assert s.query(
         "SELECT e.id FROM se e WHERE (SELECT MIN(sal) FROM se e2 WHERE e2.dept = e.dept) < 150 ORDER BY e.id"
     ) == [(1,), (2,)]
+
+
+def test_correlated_scalar_agg_decorrelates(db):
+    """Ungrouped NULL-on-empty aggregates decorrelate to agg-over-join: rows
+    with no inner match compare against NULL (UNKNOWN → dropped), which the
+    missing group represents exactly."""
+    rows = db.query(
+        "SELECT name FROM c WHERE 60 < (SELECT AVG(amt) FROM o WHERE o.cid = c.id) ORDER BY name"
+    )
+    assert rows == [("ann",), ("cat",)]  # bob has no o rows → NULL → dropped
+
+
+def test_correlated_count_subquery_refuses(db):
+    """COUNT yields 0 (not NULL) on an empty set — the grouped rewrite forms
+    no group there, so the shape must refuse instead of dropping rows whose
+    predicate the phantom 0 would satisfy."""
+    with pytest.raises(Exception, match="correlated"):
+        db.query("SELECT name FROM c WHERE 0 IN (SELECT COUNT(*) FROM o WHERE o.cid = c.id)")
+
+
+def test_correlated_not_in_ungrouped_agg_refuses(db):
+    """NOT IN over an ungrouped aggregate: unmatched outer keys see {NULL}
+    (UNKNOWN → dropped), but the rewrite's anti join would KEEP them."""
+    with pytest.raises(Exception, match="correlated"):
+        db.query("SELECT name FROM c WHERE 100 NOT IN (SELECT SUM(amt) FROM o WHERE o.cid = c.id)")
+
+
+def test_correlated_not_in_grouped_agg_allowed(db):
+    """Grouped inner: an unmatched outer key genuinely has NO group, so the
+    anti join's keep matches NOT IN (empty) = TRUE — safe to decorrelate."""
+    rows = db.query(
+        "SELECT name FROM c WHERE id NOT IN (SELECT cid FROM o WHERE o.cid = c.id"
+        " GROUP BY cid HAVING SUM(amt) > 60) ORDER BY name"
+    )
+    assert rows == [("bob",)]

@@ -146,6 +146,93 @@ def bench_q3_join_mpp() -> float:
     return best
 
 
+@register("q17_subquery_mpp_ms")
+def bench_q17_subquery_mpp() -> float:
+    """Q17-shaped correlated-aggregate MPP latency (ms, lower is better):
+    ``l_qty < 0.2 * AVG per part`` decorrelates into an agg-over-join whose
+    build side is the materialized per-key aggregate and whose comparison
+    runs as a post-join chain filter inside the fragment — the join-heavy
+    TPC-H tier this lane keeps honest (warm: program + device lanes
+    resident)."""
+    import time as _t
+
+    import numpy as np
+
+    import tidb_tpu
+    from tidb_tpu.executor.load import bulk_load
+
+    db = tidb_tpu.open(region_split_keys=1 << 62)
+    db.execute("CREATE TABLE q17l (l_partkey BIGINT, l_qty BIGINT, l_price BIGINT)")
+    db.execute("CREATE TABLE q17p (p_partkey BIGINT PRIMARY KEY, p_brand BIGINT)")
+    rng = np.random.default_rng(17)
+    n_l, n_p = 50_000, 2_000
+    bulk_load(db, "q17l", [rng.integers(0, n_p, n_l), rng.integers(1, 50, n_l),
+                           rng.integers(100, 10_000, n_l)])
+    bulk_load(db, "q17p", [np.arange(n_p, dtype=np.int64), rng.integers(0, 9, n_p)])
+    s = db.session()
+    s.execute("ANALYZE TABLE q17l")
+    s.execute("ANALYZE TABLE q17p")
+    q = (
+        "SELECT SUM(l_price) FROM q17l, q17p WHERE p_partkey = l_partkey "
+        "AND p_brand = 3 AND l_qty < (SELECT 0.2 * AVG(l_qty) FROM q17l WHERE l_partkey = p_partkey)"
+    )
+    plan = "\n".join(str(r[0]) for r in s.query("EXPLAIN " + q))
+    if "fragments" not in plan:  # never inside an assert (python -O)
+        raise RuntimeError(f"q17 shape fell off the MPP path:\n{plan}")
+    s.query(q)  # warm: compile + subplan materialization cache paid
+    best = float("inf")
+    for _ in range(3):
+        t0 = _t.perf_counter()
+        s.query(q)
+        best = min(best, (_t.perf_counter() - t0) * 1000)
+    return best
+
+
+@register("mpp_program_reuse_ms")
+def bench_mpp_program_reuse() -> float:
+    """Warm-shape CROSS-QUERY program reuse (ms, lower is better): after a
+    Q3-shaped gather compiles on one table pair, the SAME shape over a
+    DIFFERENT table pair at a different (same power-of-two bucket) size must
+    ride the cached fragment program — the lane times that first cross-query
+    execution and HARD-FAILS if it compiled a new program (the
+    tidb_tpu_mpp_program_cache_total counter must not record a miss)."""
+    import time as _t
+
+    import numpy as np
+
+    import tidb_tpu
+    from tidb_tpu.executor.load import bulk_load
+    from tidb_tpu.utils import metrics as _m
+
+    db = tidb_tpu.open(region_split_keys=1 << 62)
+    rng = np.random.default_rng(33)
+    for t, (n_o, n_l) in (("a", (4_000, 40_000)), ("b", (3_000, 36_000))):
+        db.execute(f"CREATE TABLE ro_{t} (o_orderkey BIGINT PRIMARY KEY, o_odate BIGINT)")
+        db.execute(f"CREATE TABLE rl_{t} (l_orderkey BIGINT, l_price BIGINT)")
+        bulk_load(db, f"ro_{t}", [np.arange(n_o, dtype=np.int64), 8000 + rng.integers(0, 30, n_o)])
+        bulk_load(db, f"rl_{t}", [rng.integers(0, n_o, n_l), rng.integers(100, 10_000, n_l)])
+        db.execute(f"ANALYZE TABLE ro_{t}")
+        db.execute(f"ANALYZE TABLE rl_{t}")
+    s = db.session()
+    s.execute("SET tidb_enforce_mpp = 1")
+
+    def q(t):
+        return (
+            f"SELECT o_odate, SUM(l_price) FROM rl_{t}, ro_{t} "
+            f"WHERE l_orderkey = o_orderkey GROUP BY o_odate ORDER BY o_odate"
+        )
+
+    s.query(q("a"))  # pays the one compile for the shape
+    miss0 = _m.MPP_PROGRAM_CACHE.get(result="miss")
+    t0 = _t.perf_counter()
+    s.query(q("b"))  # different tables, different size, same bucketed shape
+    dt_ms = (_t.perf_counter() - t0) * 1000
+    missed = _m.MPP_PROGRAM_CACHE.get(result="miss") - miss0
+    if missed:  # never inside an assert (python -O)
+        raise RuntimeError(f"cross-query shape reuse broke: {missed} program compiles")
+    return dt_ms
+
+
 def _warm_count_best(table: str, region_split_keys: "int | None" = None, setup_sql: "list | None" = None) -> float:
     """Best-of-30 warm ``SELECT COUNT(*)`` latency over a fresh 10k-row
     table — the shared harness of the fixed-cost lanes below.

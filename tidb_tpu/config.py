@@ -83,6 +83,13 @@ class Config:
     device_delta_cap: int = 8192
     device_delta_merge_rows: int = 2048
     device_delta_min_rows: int = 65536
+    # [perf] background delta-merge sweep on REMOTE store servers: each
+    # StoreServer folds its own colcache deltas on this cadence (the
+    # embedded DB's owner-gated 'colmerge' timer mirrored onto the storage
+    # tier — single-owner by construction there, each server owns its
+    # store's cache). <= 0 disables; queries then merge on the query-path
+    # threshold only.
+    store_colmerge_interval_s: float = 30.0
     # [security]
     ssl_enabled: bool = False
     ssl_cert: str = ""
@@ -140,6 +147,9 @@ class Config:
         )
         cfg.device_delta_min_rows = int(
             perf.get("device-delta-min-rows", cfg.device_delta_min_rows)
+        )
+        cfg.store_colmerge_interval_s = float(
+            perf.get("store-colmerge-interval-s", cfg.store_colmerge_interval_s)
         )
         sec = raw.get("security", {})
         cfg.ssl_cert = sec.get("ssl-cert", cfg.ssl_cert)

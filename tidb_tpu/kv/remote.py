@@ -205,7 +205,31 @@ class StoreServer:
         recorder().start()
         self._rec_started = True
         self._thread.start()
+        # background delta-merge sweep (the embedded DB's owner-gated
+        # 'colmerge' timer mirrored onto the storage tier): this server is
+        # the single owner of its store's column cache by construction, so
+        # the gate is just the server's own stop event — without it a store
+        # only folds deltas when a query crosses the merge threshold
+        from tidb_tpu import config as _config
+
+        interval = _config.current().store_colmerge_interval_s
+        if interval > 0:
+            self._colmerge = threading.Thread(
+                target=self._colmerge_loop, args=(interval,), daemon=True,
+                name="store-colmerge",
+            )
+            self._colmerge.start()
         return self.port
+
+    def _colmerge_loop(self, interval: float) -> None:
+        from tidb_tpu.copr.colcache import cache_for
+
+        while not self._stop.wait(interval):
+            try:
+                cache_for(self.store).merge_pending(should_stop=self._stop.is_set)
+            except Exception:
+                pass  # a failed sweep retries next tick; queries still merge
+                # on the query-path threshold
 
     def shutdown(self) -> None:
         if getattr(self, "_rec_started", False) and not self._stop.is_set():
@@ -213,6 +237,9 @@ class StoreServer:
 
             recorder().stop()
         self._stop.set()
+        cm = getattr(self, "_colmerge", None)
+        if cm is not None and cm is not threading.current_thread():
+            cm.join(timeout=5)  # a mid-sweep merge stops at the next region
         try:
             # wake the blocked accept() (it holds the listener's file
             # description, so close() alone would leave the port accepting)

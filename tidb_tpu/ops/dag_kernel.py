@@ -1052,6 +1052,24 @@ def _build(
                         r = _seg_running(jax, jnp, x, seg_ps, op, n)
                         return r[ends_c]
 
+                    def _seg_extreme(w, d, which):
+                        # grouped extreme by order statistics (see
+                        # seg_value_sorted): invalid rows sink under a +max
+                        # sentinel, so min = the group's start slot, max =
+                        # start + valid_count - 1
+                        from tidb_tpu.ops.window_core import seg_value_sorted
+
+                        if jnp.issubdtype(d.dtype, jnp.floating):
+                            pos = jnp.inf
+                        else:
+                            pos = jnp.iinfo(d.dtype).max
+                        lane2 = seg_value_sorted(jnp, jnp.where(w, d, pos), seg)
+                        if which == "min":
+                            return jnp.where(slot_live, lane2[starts_c], 0)
+                        cw = _csum_delta(w.astype(jnp.int64))
+                        last = jnp.clip(starts + cw - 1, 0, n - 1)
+                        return jnp.where(slot_live, lane2[last], 0)
+
                     def eval_arg(a):
                         if a.arg is not None:
                             d, v, _ = eval_expr(a.arg, batch, jnp)
@@ -1065,8 +1083,8 @@ def _build(
                             "sum": lambda: _csum_delta(jnp.where(w, d, 0)),
                             "sumf": lambda: _csum_delta(jnp.where(w, d * 1.0, 0.0)),
                             "sumsq": lambda: _csum_delta(jnp.where(w, (d * 1.0) ** 2, 0.0)),
-                            "min": lambda s: _seg_scan_red(jnp.where(w, d, s), jnp.minimum),
-                            "max": lambda s: _seg_scan_red(jnp.where(w, d, s), jnp.maximum),
+                            "min": lambda s: _seg_extreme(w, d, "min"),
+                            "max": lambda s: _seg_extreme(w, d, "max"),
                             "bit_and": lambda: _seg_scan_red(jnp.where(w, d, -1), jnp.bitwise_and),
                             "bit_or": lambda: _seg_scan_red(jnp.where(w, d, 0), jnp.bitwise_or),
                             "bit_xor": lambda: _seg_scan_red(jnp.where(w, d, 0), jnp.bitwise_xor),

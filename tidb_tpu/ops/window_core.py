@@ -215,6 +215,21 @@ def packed_sort(jax, jnp, mask, key_lanes, descs, n, bounds=None, payloads=()):
     return perm, None, None, [p[perm] for p in payloads]
 
 
+def seg_value_sorted(jnp, lane, seg):
+    """Re-sort a sentinel-masked value lane by ``(seg, lane)``: ``seg`` is
+    already nondecreasing (rows sorted by group), so the returned lane is
+    group-contiguous with values ascending INSIDE each group — the group
+    minimum sits at the group's start slot and, when invalid rows are masked
+    to a ``+max`` sentinel, the maximum at ``start + valid_count - 1`` (a
+    sentinel tie still yields the tied VALUE). This order-statistics route
+    replaced the log-doubling running scan for grouped MIN/MAX in both the
+    cop sort-agg path (ops/dag_kernel) and the MPP partial/merge stages
+    (parallel/mpp): two native argsorts compile in constant time where the
+    unrolled gather chain drowned XLA codegen for minutes at 64k rows."""
+    o = jnp.argsort(lane, stable=True)
+    return lane[o[jnp.argsort(seg[o], stable=True)]]
+
+
 def _seg_running(jax, jnp, x, ps, op, n: int):
     """Segmented running reduce: out[i] = op over x[ps[i]..i] where segments
     are contiguous (rows sorted by partition). Log-doubling gathers instead

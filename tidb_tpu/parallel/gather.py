@@ -22,12 +22,23 @@ from typing import Optional
 
 import numpy as np
 
-from tidb_tpu.expression.expr import AggDesc, ColumnRef, EvalBatch, Expression, can_push_down, eval_expr, expr_from_pb
+from tidb_tpu.expression.expr import (
+    AggDesc,
+    ColumnRef,
+    Constant,
+    EvalBatch,
+    Expression,
+    can_push_down,
+    eval_expr,
+    expr_from_pb,
+)
 from tidb_tpu.planner.plans import (
     OutCol,
     PhysFinalAgg,
     PhysHashJoin,
     PhysLimit,
+    PhysProjection,
+    PhysSelection,
     PhysSort,
     PhysTableReader,
     PhysicalPlan,
@@ -92,13 +103,95 @@ class MPPJoin:
     reader schema pos)]. ``kind``: inner | left | semi | anti (semi/anti
     append no build columns to the plan schema). ``str_keys``: [(probe
     (table_id, slot), build (table_id, slot))] string key pairs whose
-    dictionaries unify at execution time."""
+    dictionaries unify at execution time. ``other``: non-equality join
+    conditions for semi/anti joins (the Q21 ``<>`` idiom) — Expressions over
+    the joined [accumulated plan cols ++ build cols] layout, evaluated as a
+    pair filter inside the fragment."""
 
     eq: list
     exchange: str = "hash"  # hash | broadcast
     unique: bool = True
     kind: str = "inner"
     str_keys: list = field(default_factory=list)
+    other: list = field(default_factory=list)
+
+
+@dataclass
+class SubplanReader:
+    """A join build side that is itself an aggregate subplan — the shape the
+    decorrelated correlated-aggregate rewrites produce (Q17's per-key
+    0.2*AVG, grouped IN/EXISTS with HAVING, Q20's per-key 0.5*SUM). The
+    aggregate MATERIALIZES through the Volcano executor (its reader runs the
+    normal cop/device path, so the agg itself is device-accelerated where
+    eligible); the JOIN against its output runs inside the fragment program.
+    Canonical form [proj] ∘ [having] ∘ FinalAgg ∘ reader — covers the TPC-H
+    tier and serializes losslessly for remote dispatch. Output lanes are in
+    chunk-physical representation (decimals scaled, etc.), identical to what
+    the host executor joins against — parity by construction."""
+
+    plan: object  # the top physical node — the materialization entry point
+    reader: PhysTableReader  # base reader: identity, versioning, stats
+    agg: PhysFinalAgg
+    having: list  # Expressions over the agg output (HAVING residue)
+    proj: Optional[list]  # Expressions over the filtered agg output, or None
+    schema: Schema = field(default_factory=list)
+    # output positions holding ALL the agg group keys (the uniqueness proof:
+    # join keys covering them make the build side unique); None = unprovable
+    group_pos: Optional[frozenset] = None
+
+    # duck-typed touch points shared with plain reader build sides
+    pushed_agg = None
+    pushed_conditions: tuple = ()
+    partitions = None
+    scan_slots: tuple = ()
+
+    @property
+    def table(self):
+        return self.reader.table
+
+    def fingerprint(self) -> str:
+        """Value identity for device-lane caching and compile keys."""
+        rd = self.reader
+        rd_agg = None
+        if rd.pushed_agg is not None:
+            rd_agg = (
+                [g.to_pb() for g in rd.pushed_agg.group_by],
+                [a.to_pb() for a in rd.pushed_agg.aggs],
+                rd.pushed_agg_mode,
+            )
+        return repr(
+            (
+                tuple(rd.scan_slots),
+                [c.to_pb() for c in rd.pushed_conditions],
+                rd_agg,
+                [g.to_pb() for g in self.agg.group_by],
+                [a.to_pb() for a in self.agg.aggs],
+                bool(self.agg.partial_input),
+                [c.to_pb() for c in self.having],
+                [e.to_pb() for e in self.proj] if self.proj is not None else None,
+            )
+        )
+
+    def rows_estimate(self, stats):
+        """Build-side cardinality for the exchange choice: the agg emits at
+        most ∏ group-key NDV rows (64 per unresolvable key), capped by the
+        base table's row count."""
+        st = stats.get(self.reader.table.id) if stats is not None else None
+        if st is None or not st.row_count:
+            return None
+        npart = len(self.reader.schema) - len(self.agg.group_by)
+        ndv = 1.0
+        for gi, g in enumerate(self.agg.group_by):
+            cs = None
+            if isinstance(g, ColumnRef):
+                # pushed-partial readers carry source slots on the trailing
+                # group OutCols; plain readers on the ref's own position
+                pos = npart + gi if self.agg.partial_input else g.index
+                oc = self.reader.schema[pos] if 0 <= pos < len(self.reader.schema) else None
+                if oc is not None and oc.slot >= 0:
+                    cs = st.cols.get(oc.slot)
+            ndv *= cs.ndv if cs is not None and cs.ndv else 64
+        return max(min(ndv, float(st.row_count)), 1.0)
 
 
 @dataclass
@@ -110,6 +203,10 @@ class PhysMPPGather(PhysicalPlan):
     readers: list = field(default_factory=list)
     joins: list = field(default_factory=list)
     topn: Optional[tuple] = None  # ([(ColumnRef, desc)], limit)
+    # post-join filters: [(position, [Expression])] — position k evaluates
+    # over the accumulated plan layout after the k-th join (0 = before any);
+    # WHERE residue that compares across join sides lands here
+    filters: list = field(default_factory=list)
     schema: Schema = field(default_factory=list)
     children: list = field(default_factory=list)
 
@@ -138,14 +235,19 @@ class PhysMPPGather(PhysicalPlan):
         else:
             probe = self.readers[0].table.name
             for j, join in enumerate(self.joins):
-                build = self.readers[j + 1].table.name
+                r = self.readers[j + 1]
+                build = r.table.name
+                ops = "Scan -> Agg -> Selection" if isinstance(r, SubplanReader) else "Scan -> Selection"
                 ex = "BroadcastExchange" if join.exchange == "broadcast" else "HashExchange"
-                out.append(f"Fragment#{fi} [mpp] {build}: Scan -> Selection -> {ex}")
+                out.append(f"Fragment#{fi} [mpp] {build}: {ops} -> {ex}")
                 fi += 1
             tail = "PartialAgg -> HashExchange" if self.agg is not None else (
                 "TopN" if self.topn and self.topn[0] else "Limit"
             )
-            joins = " -> ".join("Join" for _ in self.joins)
+            joins = " -> ".join(
+                "Join -> Filter" if (j.other or any(pos == ji + 1 for pos, _ in self.filters)) else "Join"
+                for ji, j in enumerate(self.joins)
+            )
             out.append(f"Fragment#{fi} [mpp] {probe}: Scan -> Selection -> {joins} -> {tail}")
             fi += 1
         if self.agg is not None:
@@ -245,12 +347,88 @@ def _choose_exchange(l_rows: int | None, r_rows: int | None, ndev: int, bcast_th
     return "hash"
 
 
+def _chain_cond_ok(c: Expression) -> bool:
+    """Device admission for a post-join / pair condition evaluated over the
+    accumulated fragment lanes: engine-legal and string-free (joined-layout
+    references have no single binder dictionary to legalize against)."""
+    if not can_push_down(c, "tpu"):
+        return False
+
+    def no_str(e) -> bool:
+        if isinstance(e, (ColumnRef, Constant)) and e.ftype.kind == TypeKind.STRING:
+            return False
+        return all(no_str(k) for k in e.children())
+
+    return no_str(c)
+
+
+def _subplan_side(r: PhysicalPlan) -> Optional[SubplanReader]:
+    """Admit an aggregate subplan as a join build side — canonical form
+    [PhysProjection] → [PhysSelection] → PhysFinalAgg → PhysTableReader
+    (the decorrelated correlated-aggregate shapes). Returns the wrapper or
+    None when the subtree doesn't normalize."""
+    top = r
+    proj = None
+    if isinstance(r, PhysProjection):
+        proj, r = r, r.children[0]
+    having: list = []
+    if isinstance(r, PhysSelection):
+        having, r = list(r.conditions), r.children[0]
+    if not (isinstance(r, PhysFinalAgg) and not getattr(r, "rollup", False)):
+        return None
+    agg = r
+    if any(a.name == "group_concat" for a in agg.aggs):
+        return None  # string-valued output lanes have no device identity
+    rd = agg.children[0] if agg.children else None
+    if not (
+        isinstance(rd, PhysTableReader)
+        and rd.pushed_topn is None
+        and rd.pushed_limit is None
+        and rd.pushed_window is None
+    ):
+        return None
+    schema = top.schema
+    if any(oc.ftype.kind == TypeKind.STRING for oc in schema):
+        return None  # derived lanes carry no dictionary
+    n_aggs = len(agg.aggs)
+    gset = set(range(n_aggs, n_aggs + len(agg.group_by)))
+    if proj is None:
+        gpos: Optional[frozenset] = frozenset(gset)
+    else:
+        covered = {e.index for e in proj.exprs if isinstance(e, ColumnRef)}
+        gpos = (
+            frozenset(
+                i for i, e in enumerate(proj.exprs) if isinstance(e, ColumnRef) and e.index in gset
+            )
+            if gset <= covered
+            else None  # a dropped group key: uniqueness unprovable
+        )
+    return SubplanReader(
+        plan=top,
+        reader=rd,
+        agg=agg,
+        having=having,
+        proj=list(proj.exprs) if proj is not None else None,
+        schema=list(schema),
+        group_pos=gpos,
+    )
+
+
 def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev, bcast_thr: int = 100_000):
-    """Left-deep chain of inner equi-joins over MPP-eligible readers →
-    (readers, joins, probe_row_estimate) or None. eq_conds left positions
-    index the child-0 schema, which for a left-deep chain IS the accumulated
-    reader schema, so they carry over unchanged. ``get_ndev`` is lazy: mesh
-    construction (JAX backend init) only happens once a candidate matched."""
+    """Left-deep chain of equi-joins over MPP-eligible readers →
+    (readers, joins, filters, probe_row_estimate) or None. eq_conds left
+    positions index the child-0 schema, which for a left-deep chain IS the
+    accumulated reader schema, so they carry over unchanged. ``filters``:
+    [(position, [conditions])] — Selections interposed in the chain (and
+    inner-join other_conds) become post-join fragment filters at the join
+    count where they appeared. ``get_ndev`` is lazy: mesh construction (JAX
+    backend init) only happens once a candidate matched."""
+    if isinstance(p, PhysSelection):
+        base = _flatten_join_chain(p.children[0], stats, get_ndev, bcast_thr)
+        if base is None or not all(_chain_cond_ok(c) for c in p.conditions):
+            return None
+        readers, joins, filters, rows = base
+        return (readers, joins, filters + [(len(joins), list(p.conditions))], rows)
     if isinstance(p, PhysTableReader):
         if not _reader_mpp_ok(p):
             return None
@@ -266,30 +444,59 @@ def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev, bcast_thr: int = 100_0
                     from tidb_tpu.statistics.selectivity import estimate_selectivity
 
                     rows = max(rows * estimate_selectivity(p.pushed_conditions, p.schema, st), 1.0)
-        return ([p], [], rows)
+        return ([p], [], [], rows)
     if (
         isinstance(p, PhysHashJoin)
         and p.kind in ("inner", "left", "semi", "anti", "right")
         and p.eq_conds
-        and not p.other_conds
         and not p.null_aware
         and len(p.children) == 2
     ):
+        other = list(p.other_conds)
+        if other:
+            # inner-join other_conds are exactly post-join filters; semi/anti
+            # ones gate EXISTENCE per candidate pair (the fragment's filtered
+            # expansion). Outer kinds change NULL-extension semantics — host.
+            if p.kind not in ("inner", "semi", "anti"):
+                return None
+            if not all(_chain_cond_ok(c) for c in other):
+                return None
         base = _flatten_join_chain(p.children[0], stats, get_ndev, bcast_thr)
         if base is None:
             return None
         r = p.children[1]
         eq_conds = list(p.eq_conds)
         # column-only projections over the build reader (subquery rewrites
-        # emit them) just remap the right key positions
-        from tidb_tpu.planner.plans import PhysProjection
-
+        # emit them) just remap the right key positions — and the right-side
+        # refs of any other_conds, which the builder resolved against the
+        # [left ++ projection-output] joined layout
+        nleft_node = len(p.children[0].schema)
         while isinstance(r, PhysProjection) and all(isinstance(e, ColumnRef) for e in r.exprs):
             eq_conds = [(lp, r.exprs[rp].index) for lp, rp in eq_conds]
+            if other:
+                if p.kind not in ("semi", "anti"):
+                    # a peeled build projection widens the accumulated plan
+                    # schema — inner-join post-fold filters would misindex
+                    return None
+                from tidb_tpu.planner.optimizer import _expr_cols as _oc
+                from tidb_tpu.planner.optimizer import _remap_expr
+
+                refs: set = set()
+                for c in other:
+                    _oc(c, refs)
+                mapping = {
+                    i: (i if i < nleft_node else nleft_node + r.exprs[i - nleft_node].index)
+                    for i in refs
+                }
+                other = [_remap_expr(c, mapping) for c in other]
             r = r.children[0]
+        sub = None
         if not (isinstance(r, PhysTableReader) and _reader_mpp_ok(r)):
-            return None
-        readers, joins, probe_rows = base
+            sub = _subplan_side(r)
+            if sub is None:
+                return None
+            r = sub
+        readers, joins, filters, probe_rows = base
         acc_cols = _plan_schema_len(readers, joins)
         if any(lp >= acc_cols or rp >= len(r.schema) for lp, rp in eq_conds):
             return None
@@ -308,12 +515,16 @@ def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev, bcast_thr: int = 100_0
                 ):
                     return None  # mixed kinds / ci collation: host join
                 str_keys.append(((lsrc[0], lsrc[1]), (r.table.id, r.schema[rp].slot)))
-        unique = _right_side_unique(r, key_slots)
-        if p.kind in ("semi", "anti", "left") and not unique and len(eq_conds) > 1:
-            # multi-key existence/outer shapes need packed-exact keys;
-            # without a uniqueness proof the collision-safe path is the
-            # host join (a mixed-hash collision would duplicate or drop)
-            return None
+        if sub is not None:
+            # an aggregate's output is one row per group: join keys covering
+            # every group key ARE a uniqueness proof (scalar agg: one row)
+            unique = sub.group_pos is not None and sub.group_pos <= {rp for _, rp in eq_conds}
+        else:
+            unique = _right_side_unique(r, key_slots)
+        # (multi-key semi/anti/left with a non-unique build side no longer
+        # fall back to the host join: the fragment's packed-exact composite
+        # keys — static-bound packing or rank compression — keep existence
+        # semantics collision-free; see mpp._exact_pair_lanes)
         if p.kind == "right" and len(eq_conds) > 1:
             # build-side outer preservation rides exact per-build-row match
             # counts — single-key only (a mixed-hash count could mask a
@@ -321,15 +532,29 @@ def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev, bcast_thr: int = 100_0
             return None
         r_rows = None
         st = stats.get(r.table.id) if stats is not None else None
-        if st is not None:
+        if sub is not None:
+            r_rows = sub.rows_estimate(stats)
+        elif st is not None:
             r_rows = st.row_count
             if r.pushed_conditions and r_rows:
                 from tidb_tpu.statistics.selectivity import estimate_selectivity
 
                 r_rows = max(r_rows * estimate_selectivity(r.pushed_conditions, r.schema, st), 1.0)
         exchange = _choose_exchange(probe_rows, r_rows, get_ndev(), bcast_thr)
+        if other and p.kind == "inner":
+            # inner-join other_conds filter joined rows AFTER the fold — the
+            # builder resolved them over [left ++ right] = the accumulated
+            # plan layout once this join appends its build columns
+            filters = filters + [(len(joins) + 1, other)]
         joins = joins + [
-            MPPJoin(eq=list(eq_conds), exchange=exchange, unique=unique, kind=p.kind, str_keys=str_keys)
+            MPPJoin(
+                eq=list(eq_conds),
+                exchange=exchange,
+                unique=unique,
+                kind=p.kind,
+                str_keys=str_keys,
+                other=other if p.kind in ("semi", "anti") else [],
+            )
         ]
         out_rows = probe_rows
         if p.kind == "inner" and not unique and probe_rows is not None and r_rows is not None:
@@ -365,7 +590,7 @@ def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev, bcast_thr: int = 100_0
                     ndv = cs.ndv if cs is not None else None
                 fan = max(r_rows // max(ndv, 1), 1) if ndv else 2
                 out_rows = probe_rows * fan
-        return (readers + [r], joins, out_rows)
+        return (readers + [r], joins, filters, out_rows)
     return None
 
 
@@ -562,7 +787,6 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> P
             total = p.limit + p.offset
             if isinstance(child, PhysSort):
                 from tidb_tpu.planner.optimizer import _subst_refs
-                from tidb_tpu.planner.plans import PhysProjection
 
                 below = child.children[0]
                 by = list(child.by)
@@ -587,19 +811,18 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> P
                         for e, _ in by
                     )
                 ):
-                    readers, joins, _ = flat
+                    readers, joins, filters, _ = flat
                     gather = PhysMPPGather(
                         agg=None,
                         readers=readers,
                         joins=joins,
                         topn=(by, total),
+                        filters=filters,
                         schema=below.schema,
                     )
                     host_parent.children[slot] = gather
                     return p
             else:
-                from tidb_tpu.planner.plans import PhysProjection
-
                 below = child
                 host_parent, slot = p, 0
                 while isinstance(below, PhysProjection):
@@ -607,12 +830,13 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> P
                     below = below.children[0]
                 flat = _flatten_join_chain(below, stats, get_ndev, bcast_thr)
                 if flat is not None and flat[1] and total <= 65536:
-                    readers, joins, _ = flat
+                    readers, joins, filters, _ = flat
                     gather = PhysMPPGather(
                         agg=None,
                         readers=readers,
                         joins=joins,
                         topn=([], total),
+                        filters=filters,
                         schema=below.schema,
                     )
                     host_parent.children[slot] = gather
@@ -621,24 +845,63 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> P
             return p
         child = p.children[0]
         if not p.partial_input:
-            flat = _flatten_join_chain(child, stats, get_ndev, bcast_thr)
+            # row-preserving projections between the agg and the join chain
+            # (scalar-subquery rewrites emit them): substitute their exprs
+            # into the agg's group keys / arguments so the chain below is
+            # reachable (the TopN path's peeling idiom)
+            from tidb_tpu.planner.optimizer import _subst_refs
+
+            mpp_agg = p
+            below = child
+            while isinstance(below, PhysProjection):
+                ng = [_subst_refs(g, below.exprs) for g in mpp_agg.group_by]
+                na = []
+                ok = all(g is not None for g in ng)
+                for a in mpp_agg.aggs:
+                    if a.arg is None:
+                        na.append(a)
+                        continue
+                    arg = _subst_refs(a.arg, below.exprs)
+                    if arg is None:
+                        ok = False
+                        break
+                    na.append(
+                        AggDesc(a.name, arg, distinct=a.distinct, sep=a.sep, order_by=a.order_by)
+                    )
+                if not ok:
+                    break
+                mpp_agg = PhysFinalAgg(
+                    group_by=ng, aggs=na, partial_input=False, schema=p.schema, children=[]
+                )
+                below = below.children[0]
+            if mpp_agg is not p and not _agg_mpp_ok(mpp_agg):
+                mpp_agg, below = p, child  # substituted args not device-legal
+            flat = _flatten_join_chain(below, stats, get_ndev, bcast_thr)
             if flat is not None and flat[1]:
-                readers, joins, _ = flat
-                below = _try_agg_below_join(p, readers, joins)
-                if below is not None:
-                    return below
+                readers, joins, filters, _ = flat
+                if (
+                    not filters
+                    and not any(j.other for j in joins)
+                    and not any(isinstance(r, SubplanReader) for r in readers)
+                ):
+                    # pre-agg pushdown collapses probe rows BEFORE any
+                    # post-join filter could see them — plain chains only
+                    pushed_below = _try_agg_below_join(mpp_agg, readers, joins)
+                    if pushed_below is not None:
+                        return pushed_below
                 return PhysMPPGather(
-                    agg=p, readers=readers, joins=joins, schema=p.schema
+                    agg=mpp_agg, readers=readers, joins=joins, filters=filters, schema=p.schema
                 )
             if (
                 flat is not None
+                and not flat[2]  # interposed Selections would be dropped
                 and enforce
-                and any(_distinct_handled(a) for a in p.aggs)
+                and any(_distinct_handled(a) for a in mpp_agg.aggs)
             ):
                 # single-table distinct agg: the coprocessor's per-region
                 # partial lanes cannot dedup globally, but the (g, x)
                 # exchange can — run the no-join fragment pipeline
-                return PhysMPPGather(agg=p, readers=list(flat[0]), joins=[], schema=p.schema)
+                return PhysMPPGather(agg=mpp_agg, readers=list(flat[0]), joins=[], schema=p.schema)
         if (
             enforce
             and p.partial_input
@@ -710,6 +973,20 @@ class MPPGatherExec:
 
         from tidb_tpu.executor.executors import TableReaderExec
 
+        if isinstance(reader, SubplanReader):
+            # decorrelated aggregate build side: materialize the whole
+            # [proj]∘[having]∘FinalAgg∘reader subplan through the Volcano
+            # executor (its reader rides the normal cop/device path) — the
+            # chunk is in the same physical representation the host engine
+            # joins against, so fragment-side comparisons agree bit-exactly
+            if self.session._txn_dirty():
+                # the union-scan overlay cannot reach through the agg
+                from tidb_tpu.parallel.probe import MPPRetryExhausted
+
+                raise MPPRetryExhausted("mpp subplan build side cannot observe txn-local mutations")
+            from tidb_tpu.executor.executors import build_executor
+
+            return build_executor(reader.plan, self.session).execute()
         if reader.pushed_agg is not None:
             return TableReaderExec(reader, self.session).execute()
         if self.session._txn_dirty():
@@ -852,6 +1129,7 @@ class MPPGatherExec:
         # Backoffer instead of ad-hoc attempt counters
         bo = gather_backoffer()
         no_progress = 0
+        self._compiles = 0
         while True:
             devices = GLOBAL_PROBER.alive(jax.devices())
             if not devices:
@@ -882,6 +1160,7 @@ class MPPGatherExec:
                         rows=len(out),
                         retries=bo.attempts(),
                         shards=shards,
+                        compiles=getattr(self, "_compiles", 0),
                     ),
                 )
                 return out
@@ -976,6 +1255,7 @@ class MPPGatherExec:
                 # per-shard breakdown recorded by the SERVER's shard probes
                 # (the mesh lives there) — ships home in the exec sidecar
                 shards=[list(sh) for sh in (e.get("shards") or [])],
+                compiles=int(e.get("compiles", 0)),
             ),
         )
         return chunk
@@ -1014,7 +1294,10 @@ class MPPGatherExec:
             from tidb_tpu.ops.window_core import widen_bounds
 
             n = len(chunk)
-            per = max((n + ndev - 1) // ndev, 8)
+            # power-of-two per-shard padding (masked validity): input SHAPES
+            # bucket, so same-shape queries at nearby sizes — and grow-and-
+            # retry attempts — trace and compile ONE program
+            per = _pow2(max((n + ndev - 1) // ndev, 8))
             tot = per * ndev
             arrays = []
             bounds = []
@@ -1040,15 +1323,21 @@ class MPPGatherExec:
         def dev_side(reader):
             """Padded device-resident input lanes, cached per table state —
             steady-state MPP queries re-read and re-upload nothing (same
-            identity scheme as the coprocessor engine's device cache)."""
-            key = None
+            identity scheme as the coprocessor engine's device cache). Plain
+            readers pool lanes PER COLUMN, so two queries scanning
+            overlapping column subsets of one table share the overlap
+            instead of re-uploading per gather; pre-agg and subplan build
+            sides key whole-reader on their structural fingerprint (their
+            materialized arrays are query-shape-specific)."""
+            base = reader.reader if isinstance(reader, SubplanReader) else reader
+            key = ckey = regions = None
             if self._dev_cacheable:
                 from tidb_tpu.kv import tablecodec
 
                 _views = (
-                    reader.partitions
-                    if reader.partitions is not None
-                    else reader.table.partition_views()
+                    base.partitions
+                    if base.partitions is not None
+                    else base.table.partition_views()
                 )
                 prs = [tablecodec.record_range(v.id) for v in _views]
                 regions = self.session.store.pd.regions_in_ranges(prs)
@@ -1058,10 +1347,20 @@ class MPPGatherExec:
                     # a commit landed past the pinned snapshot: the current-
                     # version arrays are NOT this read's data — run uncached
                     regions = None
-            if self._dev_cacheable and regions is not None:
+            if regions is not None:
                 vers = tuple((r.region_id, r.data_version) for r, _ in regions)
-                agg_fp = ""
-                if reader.pushed_agg is not None:
+                if isinstance(reader, SubplanReader):
+                    # the materialized agg output is a function of the whole
+                    # subplan — the fingerprint IS the identity
+                    key = (
+                        self.session.store.nonce,
+                        base.table.id,
+                        reader.fingerprint(),
+                        vers,
+                        ndev,
+                        _cache.epoch,
+                    )
+                elif reader.pushed_agg is not None:
                     # pre-agg readers materialize DIFFERENT arrays than raw
                     # scans of the same table — the identity must say so
                     agg_fp = repr(
@@ -1071,24 +1370,58 @@ class MPPGatherExec:
                             [c.to_pb() for c in reader.pushed_conditions],
                         )
                     )
-                key = (
-                    self.session.store.nonce,
-                    reader.table.id,
-                    tuple(reader.scan_slots),
-                    vers,
-                    ndev,
-                    agg_fp,
-                    _cache.epoch,  # dictionary merges/compactions remap codes
-                )
+                    key = (
+                        self.session.store.nonce,
+                        reader.table.id,
+                        tuple(reader.scan_slots),
+                        vers,
+                        ndev,
+                        agg_fp,
+                        _cache.epoch,  # dictionary merges/compactions remap codes
+                    )
+                else:
+                    ckey = (
+                        self.session.store.nonce,
+                        base.table.id,
+                        vers,
+                        ndev,
+                        _cache.epoch,
+                    )
+            if key is not None:
                 hit = _MPP_DEV_CACHE.get(key)
                 if hit is not None:
                     return hit
+            if ckey is not None:
+                pool = _MPP_DEV_CACHE.get(ckey)
+                want = [oc.slot for oc in reader.schema]
+                if pool is not None and all(s in pool["cols"] for s in want):
+                    lanes, bs = [], []
+                    for s in want:
+                        d, v, b = pool["cols"][s]
+                        lanes += [d, v]
+                        bs.append(b)
+                    return (lanes + [pool["live"]], pool["n"], bs)
             arrays, n, bounds = pad_side(self._reader_arrays(reader))
-            dev = ([jnp.asarray(a) for a in arrays], n, bounds)
-            if key is not None:
-                _MPP_DEV_CACHE[key] = dev
-                while len(_MPP_DEV_CACHE) > 32:
-                    _MPP_DEV_CACHE.pop(next(iter(_MPP_DEV_CACHE)))
+            if ckey is not None:
+                if pool is None:
+                    pool = {"n": n, "live": jnp.asarray(arrays[-1]), "cols": {}}
+                    _MPP_DEV_CACHE[ckey] = pool
+                lanes = []
+                for i, s in enumerate(want):
+                    ent = pool["cols"].get(s)
+                    if ent is None:
+                        # upload ONLY the columns the pool lacks — the
+                        # overlap with earlier queries stays resident
+                        ent = (jnp.asarray(arrays[2 * i]), jnp.asarray(arrays[2 * i + 1]), bounds[i])
+                        pool["cols"][s] = ent
+                    lanes += [ent[0], ent[1]]
+                dev = (lanes + [pool["live"]], pool["n"], [pool["cols"][s][2] for s in want])
+            else:
+                dev = ([jnp.asarray(a) for a in arrays], n, bounds)
+                if key is not None:
+                    _MPP_DEV_CACHE[key] = dev
+            while len(_MPP_DEV_CACHE) > 32:
+                _MPP_DEV_CACHE.pop(next(iter(_MPP_DEV_CACHE)))
             return dev
 
         # traced under TRACE (or a propagated remote trace context): the two
@@ -1131,6 +1464,66 @@ class MPPGatherExec:
 
         # agg input mapping over the accumulated lane layout
         total_cols = _plan_schema_len(p.readers, p.joins)
+
+        def lanes_filter(cond_list):
+            """Post-join chain filter over the ACCUMULATED lane layout:
+            plan positions resolve through lane_of; lanes of not-yet-folded
+            readers are absent, which is fine — a condition placed at chain
+            position k only references columns available after k joins."""
+
+            def fn(acc):
+                nav = len(acc)
+                pairs = [
+                    (acc[lane_of[i]], acc[lane_of[i] + 1]) if lane_of[i] + 1 < nav else None
+                    for i in range(total_cols)
+                ]
+                n = acc[0].shape[0]
+                batch = EvalBatch(pairs, [None] * len(pairs), n, warn=warn_sink)
+                m = jnp.ones(n, dtype=bool)
+                for cond in cond_list:
+                    d, v, _ = eval_expr(cond, batch, jnp)
+                    keep = jnp.broadcast_to(d != 0, m.shape)
+                    if v is not None:
+                        keep = keep & jnp.broadcast_to(v, m.shape)
+                    m = m & keep
+                return m
+
+            return fn
+
+        chain_filters = [(pos, lanes_filter(cl)) for pos, cl in p.filters]
+
+        def build_pair_filter(join, ji):
+            """Semi/anti ``other`` conditions over candidate (probe, build)
+            pairs: refs below the accumulated plan width hit probe lanes,
+            the rest hit the build reader's local lanes (the builder's
+            [left ++ right] joined layout)."""
+            nleft = _plan_schema_len(p.readers[: ji + 1], p.joins[:ji])
+            nb = len(p.readers[ji + 1].schema)
+            cond_list = list(join.other)
+
+            def fn(out_l, out_r):
+                nav = len(out_l)
+                pairs = [
+                    (out_l[lane_of[i]], out_l[lane_of[i] + 1]) if lane_of[i] + 1 < nav else None
+                    for i in range(nleft)
+                ]
+                pairs += [(out_r[2 * j], out_r[2 * j + 1]) for j in range(nb)]
+                n = pairs[-1][0].shape[0]
+                batch = EvalBatch(pairs, [None] * len(pairs), n, warn=warn_sink)
+                m = jnp.ones(n, dtype=bool)
+                for cond in cond_list:
+                    d, v, _ = eval_expr(cond, batch, jnp)
+                    keep = jnp.broadcast_to(d != 0, m.shape)
+                    if v is not None:
+                        keep = keep & jnp.broadcast_to(v, m.shape)
+                    m = m & keep
+                return m
+
+            return fn
+
+        pair_filters = [
+            build_pair_filter(j, ji) if j.other else None for ji, j in enumerate(p.joins)
+        ]
 
         # the shared distinct argument (one per gather, _agg_mpp_ok enforces)
         dist_arg = next((a.arg for a in agg.aggs if _distinct_handled(a)), None) if agg else None
@@ -1185,8 +1578,10 @@ class MPPGatherExec:
             return out
 
         # per-join capacities: per-side receive capacity from ITS row count;
-        # expansion capacity from the probe row count with 2× headroom
-        shard = lambda n: max(2 * ((max(n, 1) + ndev - 1) // ndev), 64)
+        # expansion capacity from the probe row count with 2× headroom —
+        # power-of-two bucketed so the caps (compile-key components) land on
+        # the same grid for nearby sizes and for grow-and-retry attempts
+        shard = lambda n: max(_pow2(2 * ((max(n, 1) + ndev - 1) // ndev)), 64)
         probe_cap = shard(nrows[0])
         join_specs = []
         for ji, join in enumerate(p.joins):
@@ -1235,7 +1630,9 @@ class MPPGatherExec:
         if agg is not None:
             # a dispatching client may ship its stats-informed cap with the
             # task (the server's stats handle starts empty)
-            group_cap = getattr(self, "_group_cap_hint", None) or self._initial_group_cap(nrows[0])
+            group_cap = _pow2(
+                int(getattr(self, "_group_cap_hint", None) or self._initial_group_cap(nrows[0]))
+            )
         if agg is not None:
             nk = 2 * len(agg.group_by) if agg.group_by else 2
             ndk = 2 if dist_arg is not None else 0
@@ -1295,8 +1692,11 @@ class MPPGatherExec:
                     out_cap=max(_pow2(limit), 1024),
                 )
             # compile cache: the jitted shard_map program is pure structure —
-            # keyed on specs + bound-condition fingerprints, NOT data. Without
-            # this every query pays a full XLA mesh compile (~10s+ on TPU).
+            # keyed on specs + bound-condition fingerprints, NOT data (row
+            # caps and padded shapes are power-of-two bucketed above, so
+            # same-shape queries at different sizes produce THE SAME key).
+            # Without this every query pays a full XLA mesh compile (~10s+
+            # on TPU).
             fn_key = (
                 id(mesh),
                 repr(join_specs),
@@ -1307,10 +1707,16 @@ class MPPGatherExec:
                 repr([g.to_pb() for g in agg.group_by]) if agg is not None else "",
                 repr([a.to_pb() for a in agg.aggs]) if agg is not None else "",
                 tuple(ncols),
+                repr([(pos, [c.to_pb() for c in cl]) for pos, cl in p.filters]),
+                repr([[c.to_pb() for c in j.other] for j in p.joins]),
                 PROBES_ENABLED,
             )
+            from tidb_tpu.utils import metrics as _met
+
             cached = _MPP_FN_CACHE.get(fn_key)
             if cached is None:
+                _met.MPP_PROGRAM_CACHE.inc(result="miss")
+                self._compiles = getattr(self, "_compiles", 0) + 1
                 fn = build_dist_pipeline(
                     mesh,
                     join_specs,
@@ -1321,6 +1727,8 @@ class MPPGatherExec:
                     topn=topn_spec,
                     warn_sink=warn_sink,
                     shard_probe=_shard_probe if PROBES_ENABLED else None,
+                    pair_filters=pair_filters,
+                    chain_filters=chain_filters,
                 )
                 # the sink is baked into the compiled program's closures: a
                 # cache hit must attribute warn counts via the ORIGINAL sink
@@ -1328,6 +1736,7 @@ class MPPGatherExec:
                 while len(_MPP_FN_CACHE) > 64:
                     _MPP_FN_CACHE.pop(next(iter(_MPP_FN_CACHE)))
             else:
+                _met.MPP_PROGRAM_CACHE.inc(result="hit")
                 fn, warn_sink = cached
             import jax
 

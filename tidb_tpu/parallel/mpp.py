@@ -128,26 +128,29 @@ def _segment_partial(jnp, keys, vals, mask, cap, bounds=(), val_kinds=()):
     for k in keys:
         out_keys.append(jnp.where(slot_live, k[perm][starts_c], 0))
     out_sums = []
+    seg_sorted: dict = {}  # one (seg, value)-sort serves both MIN and MAX
     for vi, v in enumerate(vals):
         kind = val_kinds[vi] if vi < len(val_kinds) else "sum"
         vs = v[perm]
         if kind in ("min", "max"):
-            # segmented running extreme over the sorted rows (log-doubling —
-            # see window_core._seg_running for why not associative_scan),
-            # gathered at each group's last row
-            import jax as _jax
+            # grouped extreme by order statistics (see seg_value_sorted):
+            # dead rows sink under a +max sentinel, so min = the group's
+            # start slot, max = start + live_count - 1
+            from tidb_tpu.ops.window_core import seg_value_sorted
 
-            from tidb_tpu.ops.window_core import _seg_running
-
-            if jnp.issubdtype(vs.dtype, jnp.floating):
-                sent = jnp.inf if kind == "min" else -jnp.inf
+            vs2 = seg_sorted.get(id(v))
+            if vs2 is None:
+                if jnp.issubdtype(vs.dtype, jnp.floating):
+                    sent = jnp.inf
+                else:
+                    sent = jnp.iinfo(vs.dtype).max
+                vs2 = seg_value_sorted(jnp, jnp.where(sm, vs, sent), seg)
+                seg_sorted[id(v)] = vs2
+            if kind == "min":
+                out_sums.append(jnp.where(slot_live, vs2[starts_c], 0))
             else:
-                sent = (jnp.iinfo(jnp.int64).max if kind == "min" else jnp.iinfo(jnp.int64).min)
-            lane = jnp.where(sm, vs, sent)
-            seg_ps = _jax.lax.cummax(jnp.where(boundary, jnp.arange(n, dtype=jnp.int32), -1))
-            op = jnp.minimum if kind == "min" else jnp.maximum
-            run = _seg_running(_jax, jnp, lane, seg_ps, op, n)
-            out_sums.append(jnp.where(slot_live, run[ends_c], 0))
+                last_live = jnp.clip(starts_c + cnt - 1, 0, n - 1)
+                out_sums.append(jnp.where(slot_live, vs2[last_live], 0))
         else:
             out_sums.append(_csum_delta(jnp.where(sm, vs, 0)))
     return out_keys, out_sums, cnt, overflow  # slot i valid iff cnt[i] > 0
@@ -235,6 +238,39 @@ def _combine_keys(jnp, keys):
         # 0x9E3779B97F4A7C15 as signed int64 (two's complement)
         h = h * jnp.int64(-7046029254386353131) + k.astype(jnp.int64)
     return h
+
+
+def _exact_pair_lanes(jnp, lcomps, rcomps):
+    """Collision-FREE single-lane encoding of a multi-component join key
+    across BOTH sides — the packed-exact fallback when no static value
+    bounds exist (floats, unbounded domains): per component, dense ranks
+    over the union of the two sides' local values (two argsorts + a
+    cumsum), folded pairwise with re-compression so the accumulator never
+    exceeds span² < 2⁶² regardless of component count. Tuple equality ⇔
+    code equality, so count-based existence joins (semi/anti) and left-outer
+    match counts are EXACT — no mixed-hash collision can duplicate or drop a
+    row. Returns (lcode, rcode, span): codes lie in [0, span), with span =
+    n_left + n_right + 1 a static Python int for dead-row sentinels."""
+    nl = lcomps[0].shape[0]
+    span = nl + rcomps[0].shape[0] + 1
+
+    def ranks(lv, rv):
+        comb = jnp.concatenate([lv, rv])
+        order = jnp.argsort(comb)
+        sv = comb[order]
+        newg = jnp.concatenate(
+            [jnp.zeros(1, jnp.int64), (sv[1:] != sv[:-1]).astype(jnp.int64)]
+        )
+        rk = jnp.cumsum(newg)
+        inv = jnp.argsort(order)
+        r = rk[inv]
+        return r[:nl], r[nl:]
+
+    accl, accr = ranks(lcomps[0], rcomps[0])
+    for lc, rc in zip(lcomps[1:], rcomps[1:]):
+        rl, rr = ranks(lc, rc)
+        accl, accr = ranks(accl * span + rl, accr * span + rr)
+    return accl, accr, span
 
 
 def _route_rows(jax, jnp, arrays, valid, owner, ndev, cap):
@@ -367,6 +403,46 @@ def _local_expand_join(jax, jnp, lkey, lkeys, lvalid, rkey, rkeys, rcols, rvalid
     return out_left, out_right, live, overflow
 
 
+def _local_filtered_exists(jax, jnp, lkey, lkeys, lvalid, rkey, rkeys, rcols, rvalid, lcols,
+                           out_cap, pair_filter, dead_build=None, dead_probe=None):
+    """Existence with non-equality join conditions (semi/anti joins carrying
+    ``other_conds``, the Q21 ``l2.l_suppkey <> l1.l_suppkey`` idiom): expand
+    each probe row to its candidate matches, verify key components exactly,
+    evaluate ``pair_filter`` over the joined (probe lanes, build lanes)
+    pairs, and reduce back to a per-probe PASSING-match count via a cumsum
+    over the probe-ordered slots. Exact: hash-collision candidates die at
+    component verification before the filter sees them, and a probe row with
+    no candidates contributes no slots (count 0 — kept by anti, dropped by
+    semi). Returns (per-probe pass counts, overflow vs ``out_cap``)."""
+    big = jnp.int64(2**62) if dead_build is None else dead_build
+    big_p = big - 1 if dead_probe is None else dead_probe
+    rperm = jnp.argsort(jnp.where(rvalid, rkey, big))
+    rk_s = jnp.where(rvalid, rkey, big)[rperm]
+    pkey = jnp.where(lvalid, lkey, big_p)
+    lo, hi = _sorted_bounds(jnp, rk_s, pkey)
+    mcnt = jnp.where(lvalid, hi - lo, 0)
+    cum = jnp.cumsum(mcnt)
+    total = cum[-1] if mcnt.shape[0] else jnp.int64(0)
+    overflow = jnp.maximum(total - out_cap, 0)
+    j = jnp.arange(out_cap)
+    p = jnp.searchsorted(cum, j, side="right")
+    p_c = jnp.clip(p, 0, mcnt.shape[0] - 1)
+    base = jnp.where(p_c > 0, cum[jnp.maximum(p_c - 1, 0)], 0)
+    ridx = jnp.clip(lo[p_c] + (j - base), 0, rk_s.shape[0] - 1)
+    cand = (j < total) & lvalid[p_c] & (mcnt[p_c] > 0) & rvalid[rperm][ridx]
+    for lcomp, rcomp in zip(lkeys, rkeys):
+        cand &= rcomp[rperm][ridx] == lcomp[p_c]
+    out_l = [lc[p_c] for lc in lcols]
+    out_r = [rc[rperm][ridx] for rc in rcols]
+    passed = cand & pair_filter(out_l, out_r)
+    cs = jnp.cumsum(passed.astype(jnp.int64))
+    base_i = cum - mcnt
+    end_c = jnp.clip(cum - 1, 0, out_cap - 1)
+    below = jnp.where(base_i > 0, cs[jnp.clip(base_i - 1, 0, out_cap - 1)], 0)
+    cnt_pass = jnp.where(mcnt > 0, cs[end_c] - below, 0)
+    return cnt_pass, overflow
+
+
 def _local_match_counts(jax, jnp, lkey, lkeys, lvalid, rkey, rkeys, rvalid, dead_build=None, dead_probe=None):
     """Per-probe match count against the build side (semi/anti joins need no
     expansion — just existence). Exact for single-component or packed keys;
@@ -408,6 +484,8 @@ def build_dist_pipeline(
     topn: "DistTopNSpec | None" = None,
     warn_sink=None,
     shard_probe: Callable | None = None,
+    pair_filters: Sequence[Callable | None] | None = None,
+    chain_filters: Sequence[tuple] = (),
 ):
     """The generalized MPP pipeline in ONE jitted shard_map (ref: §3.3 —
     fragments: scan→sel→[exchange→join]*→(partial agg→hash exchange→merge |
@@ -439,11 +517,22 @@ def build_dist_pipeline(
     n_readers = len(n_lanes)
     offs = [sum(n_lanes[:i]) for i in range(n_readers + 1)]
 
+    def _apply_chain(pos, acc, mask):
+        # post-join filters over the accumulated lane layout (a WHERE
+        # residue that compares across join sides — e.g. the decorrelated
+        # Q17 ``l_quantity < 0.2*avg`` against the joined subquery lane);
+        # position k applies after the k-th join has folded in
+        for fpos, fn in chain_filters:
+            if fpos == pos:
+                mask = mask & fn(acc)
+        return mask
+
     def step(*cols):
         acc = list(cols[offs[0] : offs[1]])
         mask = jnp.ones(acc[0].shape[0], dtype=bool)
         if selections[0] is not None:
             mask = selections[0](*acc)
+        mask = _apply_chain(0, acc, mask)
         dropped = jnp.int64(0)
         overflow = jnp.int64(0)
         # per-shard exchanged-byte estimate (8 B per lane per routed row);
@@ -506,6 +595,18 @@ def build_dist_pipeline(
             # in their narrow dtype; mixed-hash lanes use the int64 bigs)
             dead_b = None if ncodes is None else ncodes + 1
             dead_p = None if ncodes is None else ncodes
+            pf = pair_filters[ji] if pair_filters is not None else None
+            if (
+                ncodes is None
+                and len(lkeys) > 1
+                and not join.unique
+                and (kind == "left" or (kind in ("semi", "anti") and pf is None))
+            ):
+                # count-based existence / left-outer match counts must be
+                # EXACT and no static bounds packed the key — rank-compress
+                # the composite key over both sides instead (collision-free)
+                lkey, rkey, span = _exact_pair_lanes(jnp, lkeys, rkeys)
+                dead_b, dead_p = span + 1, span
             probe_live = mask & lkv  # rows eligible to match
             if kind == "right":
                 # build-side outer (ref: mpp.go:397 right-out join build):
@@ -550,6 +651,16 @@ def build_dist_pipeline(
                     for a, rc in zip(macc[n_probe_lanes:], rcols)
                 ]
                 mask = jnp.concatenate([mmask, unmatched])
+            elif kind in ("semi", "anti") and pf is not None:
+                # existence gated on non-equality pair conditions: expand,
+                # verify, filter, reduce (unique build sides ride the same
+                # path — the expansion then has ≤1 candidate per probe row)
+                cnt_pass, of = _local_filtered_exists(
+                    jax, jnp, lkey, lkeys, probe_live, rkey, rkeys, rcols, rvalid,
+                    acc, join.out_cap, pf, dead_b, dead_p,
+                )
+                overflow = overflow + of
+                mask = mask & (cnt_pass > 0) if kind == "semi" else mask & (cnt_pass == 0)
             elif kind in ("semi", "anti") and not join.unique:
                 cnt = _local_match_counts(
                     jax, jnp, lkey, lkeys, probe_live, rkey, rkeys, rvalid, dead_b, dead_p
@@ -578,6 +689,7 @@ def build_dist_pipeline(
                 overflow = overflow + of
                 mask = newmask
                 acc = out_l + out_r
+            mask = _apply_chain(ji + 1, acc, mask)
         outs, local_rows = (
             _agg_tail(acc, mask, dropped, overflow)
             if agg is not None

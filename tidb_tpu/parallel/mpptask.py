@@ -65,8 +65,7 @@ def gather_to_pb(plan, group_cap: Optional[int] = None, schema_ver: int = -1) ->
     the coprocessor DAGs use. ``schema_ver``: the dispatching catalog's
     version — the server reloads its snapshot when behind (TiFlash's
     schema-sync-on-query; ref: the coprocessor's schema-version check)."""
-    readers = []
-    for r in plan.readers:
+    def _reader_pb(r) -> dict:
         agg_pb = None
         if r.pushed_agg is not None:
             agg_pb = {
@@ -74,19 +73,42 @@ def gather_to_pb(plan, group_cap: Optional[int] = None, schema_ver: int = -1) ->
                 "aggs": [a.to_pb() for a in r.pushed_agg.aggs],
                 "mode": r.pushed_agg_mode,
             }
-        readers.append(
-            {
-                "db": r.db,
-                "tid": r.table.id,
-                "store": r.store_type.value,
-                "slots": list(r.scan_slots),
-                "conds": [c.to_pb() for c in r.pushed_conditions],
-                "agg": agg_pb,
-                "schema": [_oc_pb(oc) for oc in r.schema],
-                "ranges": _ranges_pb(r.ranges),
-                "parts": [v.id for v in r.partitions] if r.partitions is not None else None,
-            }
-        )
+        return {
+            "db": r.db,
+            "tid": r.table.id,
+            "store": r.store_type.value,
+            "slots": list(r.scan_slots),
+            "conds": [c.to_pb() for c in r.pushed_conditions],
+            "agg": agg_pb,
+            "schema": [_oc_pb(oc) for oc in r.schema],
+            "ranges": _ranges_pb(r.ranges),
+            "parts": [v.id for v in r.partitions] if r.partitions is not None else None,
+        }
+
+    from tidb_tpu.parallel.gather import SubplanReader
+
+    readers = []
+    for r in plan.readers:
+        if isinstance(r, SubplanReader):
+            readers.append(
+                {
+                    "sub": {
+                        "reader": _reader_pb(r.reader),
+                        "agg": {
+                            "group": [g.to_pb() for g in r.agg.group_by],
+                            "aggs": [a.to_pb() for a in r.agg.aggs],
+                            "partial": bool(r.agg.partial_input),
+                            "schema": [_oc_pb(oc) for oc in r.agg.schema],
+                        },
+                        "having": [c.to_pb() for c in r.having],
+                        "proj": [e.to_pb() for e in r.proj] if r.proj is not None else None,
+                        "schema": [_oc_pb(oc) for oc in r.schema],
+                        "gpos": sorted(r.group_pos) if r.group_pos is not None else None,
+                    }
+                }
+            )
+        else:
+            readers.append(_reader_pb(r))
     joins = [
         {
             "eq": [list(e) for e in j.eq],
@@ -94,6 +116,7 @@ def gather_to_pb(plan, group_cap: Optional[int] = None, schema_ver: int = -1) ->
             "unique": bool(j.unique),
             "kind": j.kind,
             "str_keys": [[list(a), list(b)] for a, b in j.str_keys],
+            "other": [c.to_pb() for c in j.other],
         }
         for j in plan.joins
     ]
@@ -112,6 +135,7 @@ def gather_to_pb(plan, group_cap: Optional[int] = None, schema_ver: int = -1) ->
         "joins": joins,
         "agg": agg_pb,
         "topn": topn_pb,
+        "filters": [[pos, [c.to_pb() for c in cl]] for pos, cl in plan.filters],
         "schema": [_oc_pb(oc) for oc in plan.schema],
         "group_cap": group_cap,
         "schema_ver": schema_ver,
@@ -122,10 +146,10 @@ def gather_from_pb(pb: dict, table_by_id):
     """Wire dict → PhysMPPGather with this process's TableInfo objects.
     ``table_by_id(tid) → (db_name, TableInfo)`` resolves against the local
     catalog; a stale id raises KeyError for the caller to reload+retry."""
-    from tidb_tpu.parallel.gather import MPPJoin, PhysMPPGather
+    from tidb_tpu.parallel.gather import MPPJoin, PhysMPPGather, SubplanReader
+    from tidb_tpu.planner.plans import PhysProjection, PhysSelection
 
-    readers = []
-    for rp in pb["readers"]:
+    def _reader_from_pb(rp):
         db_name, table = table_by_id(rp["tid"])
         pushed_agg = None
         if rp["agg"] is not None:
@@ -135,24 +159,56 @@ def gather_from_pb(pb: dict, table_by_id):
                 schema=[],
                 children=[],
             )
-        readers.append(
-            PhysTableReader(
-                db=db_name,
-                table=table,
-                store_type=StoreType(rp["store"]),
-                pushed_conditions=[expr_from_pb(c) for c in rp["conds"]],
-                pushed_agg=pushed_agg,
-                pushed_agg_mode=rp["agg"]["mode"] if rp["agg"] is not None else "partial",
-                scan_slots=list(rp["slots"]),
-                ranges=_ranges_from_pb(rp["ranges"]),
-                schema=[_oc_from_pb(v) for v in rp["schema"]],
-                partitions=(
-                    [table.partition_view(pid) for pid in rp["parts"]]
-                    if rp.get("parts") is not None
-                    else None
-                ),
-            )
+        return PhysTableReader(
+            db=db_name,
+            table=table,
+            store_type=StoreType(rp["store"]),
+            pushed_conditions=[expr_from_pb(c) for c in rp["conds"]],
+            pushed_agg=pushed_agg,
+            pushed_agg_mode=rp["agg"]["mode"] if rp["agg"] is not None else "partial",
+            scan_slots=list(rp["slots"]),
+            ranges=_ranges_from_pb(rp["ranges"]),
+            schema=[_oc_from_pb(v) for v in rp["schema"]],
+            partitions=(
+                [table.partition_view(pid) for pid in rp["parts"]]
+                if rp.get("parts") is not None
+                else None
+            ),
         )
+
+    readers = []
+    for rp in pb["readers"]:
+        if "sub" in rp:
+            sp = rp["sub"]
+            rd = _reader_from_pb(sp["reader"])
+            agg = PhysFinalAgg(
+                group_by=[expr_from_pb(g) for g in sp["agg"]["group"]],
+                aggs=[AggDesc.from_pb(a) for a in sp["agg"]["aggs"]],
+                partial_input=bool(sp["agg"]["partial"]),
+                schema=[_oc_from_pb(v) for v in sp["agg"]["schema"]],
+                children=[rd],
+            )
+            having = [expr_from_pb(c) for c in sp["having"]]
+            proj = [expr_from_pb(e) for e in sp["proj"]] if sp["proj"] is not None else None
+            schema = [_oc_from_pb(v) for v in sp["schema"]]
+            node = agg
+            if having:
+                node = PhysSelection(conditions=list(having), children=[node])
+            if proj is not None:
+                node = PhysProjection(exprs=list(proj), schema=list(schema), children=[node])
+            readers.append(
+                SubplanReader(
+                    plan=node,
+                    reader=rd,
+                    agg=agg,
+                    having=having,
+                    proj=proj,
+                    schema=schema,
+                    group_pos=frozenset(sp["gpos"]) if sp["gpos"] is not None else None,
+                )
+            )
+        else:
+            readers.append(_reader_from_pb(rp))
     joins = [
         MPPJoin(
             eq=[tuple(e) for e in jp["eq"]],
@@ -160,6 +216,7 @@ def gather_from_pb(pb: dict, table_by_id):
             unique=jp["unique"],
             kind=jp["kind"],
             str_keys=[(tuple(a), tuple(b)) for a, b in jp["str_keys"]],
+            other=[expr_from_pb(c) for c in jp.get("other", ())],
         )
         for jp in pb["joins"]
     ]
@@ -181,6 +238,9 @@ def gather_from_pb(pb: dict, table_by_id):
             readers=readers,
             joins=joins,
             topn=topn,
+            filters=[
+                (pos, [expr_from_pb(c) for c in cl]) for pos, cl in pb.get("filters", ())
+            ],
             schema=[_oc_from_pb(v) for v in pb["schema"]],
         ),
         pb.get("group_cap"),
@@ -293,6 +353,7 @@ class MPPTaskManager:
                     # per-shard straggler breakdown (plain lists: the header
                     # travels as JSON) — the dispatching client renders it
                     "shards": det.shards if det is not None else [],
+                    "compiles": det.compiles if det is not None else 0,
                 }
             except Exception as e:  # travels the wire as (kind, message)
                 task["kind"] = type(e).__name__

@@ -659,7 +659,37 @@ class Builder:
                 if not negated:
                     return plan
                 return LogicalSelection(conditions=[Constant(0, bool_type())], children=[plan])
-            raise PlanError("unsupported correlated subquery with aggregation")
+            # grouped inner / IN-with-agg: decorrelate by pulling the
+            # correlation keys into GROUP BY (agg-over-join; ref:
+            # rule_decorrelate.go aggregate pull-up). For a fixed outer key k
+            # the (g, k)-groups of the key-stripped inner ARE the original
+            # per-k groups — the extra keys split nothing — so HAVING stays a
+            # local group filter and the join tests existence per (operand,
+            # corr keys). NULL-key inner rows form their own groups and match
+            # no outer row, exactly like the stripped equality dropped them.
+            if corr_other:
+                # a correlated NON-equality conjunct filters rows BEFORE the
+                # aggregate — it cannot move above the agg with the keys
+                raise PlanError("unsupported correlated subquery with aggregation")
+            if not corr and operand_ast is None:
+                raise PlanError("unsupported correlated subquery (no equality correlation)")
+            if not inner.group_by:
+                # An UNGROUPED aggregate yields one row even for outer keys
+                # with no inner match (COUNT()=0, AVG()=NULL); the grouped
+                # rewrite forms NO group there, so refuse exactly the cases
+                # where that phantom row is observable: negated operands
+                # (the missing {NULL}/{0} row flips NOT IN from UNKNOWN to
+                # TRUE) and aggregates whose empty-set value is non-NULL
+                # (COUNT and the BIT_* family — `x = 0` must see the 0).
+                names: set = set()
+                for it in inner.items:
+                    if not isinstance(it.expr, ast.Wildcard):
+                        _agg_names(it.expr, names)
+                if inner.having is not None:
+                    _agg_names(inner.having, names)
+                if negated or names & {"count", "bit_and", "bit_or", "bit_xor"}:
+                    raise PlanError("unsupported correlated subquery with aggregation")
+            inner.group_by = list(inner.group_by or []) + [s for _, s in corr]
         if not corr and operand_ast is None and not corr_other:
             raise PlanError("unsupported correlated subquery (no equality correlation)")
         if corr_other and negated and null_aware:
@@ -1897,6 +1927,30 @@ def _contains_agg(node) -> bool:
     if isinstance(node, ast.InList):
         return any(_contains_agg(x) for x in node.items)
     return False
+
+
+def _agg_names(node, out: set) -> None:
+    """Collect the (alias-normalized) aggregate function names under
+    ``node`` — the decorrelation guard needs to know WHICH aggregates an
+    ungrouped subquery computes, not just that one exists."""
+    if isinstance(node, ast.FuncCall):
+        name = _FN_ALIAS.get(node.name, node.name)
+        if node.over is None and (name in AGG_FUNCS or node.star):
+            out.add("count" if node.star else name)
+        for a in node.args:
+            _agg_names(a, out)
+        return
+    for attr in ("left", "right", "operand", "low", "high", "pattern", "else_value"):
+        v = getattr(node, attr, None)
+        if v is not None and isinstance(v, ast.Node):
+            _agg_names(v, out)
+    if isinstance(node, ast.CaseWhen):
+        for c, v in node.branches:
+            _agg_names(c, out)
+            _agg_names(v, out)
+    if isinstance(node, ast.InList):
+        for x in node.items:
+            _agg_names(x, out)
 
 
 def _unknown_col_in_schema(err_msg: str, schema) -> bool:

@@ -267,6 +267,28 @@ def _push_selections(plan: LogicalPlan) -> LogicalPlan:
     if isinstance(plan, LogicalSelection) and isinstance(plan.children[0], LogicalJoin):
         join = plan.children[0]
         nleft = len(join.children[0].schema)
+        if join.kind in ("semi", "anti", "left"):
+            # left-side-only conditions commute with the join: semi/anti
+            # joins only FILTER left rows, and a left join preserves every
+            # left row while such conditions never read the NULL-extended
+            # side. Pushing them below (and recursing) lets residual WHERE
+            # equalities reach a cross join a subquery rewrite left
+            # underneath — where they become equi-join keys — instead of
+            # stranding above the semi/anti/left join as a host Selection.
+            down: list[Expression] = []
+            stay: list[Expression] = []
+            for cond in plan.conditions:
+                s: set[int] = set()
+                _expr_cols(cond, s)
+                (down if s and max(s) < nleft else stay).append(cond)
+            if down:
+                join.children[0] = _push_selections(
+                    LogicalSelection(conditions=down, children=[join.children[0]])
+                )
+                if not stay:
+                    return join
+                plan.conditions = stay
+            return plan
         keep: list[Expression] = []
         for cond in plan.conditions:
             s: set[int] = set()

@@ -248,9 +248,10 @@ class MPPExecDetails:
     rows, exchange_bytes]`` row per mesh shard, so EXPLAIN ANALYZE can name
     WHICH device inside the collective was slow."""
 
-    __slots__ = ("n_fragments", "ndev", "wall_ms", "rows", "retries", "store", "shards")
+    __slots__ = ("n_fragments", "ndev", "wall_ms", "rows", "retries", "store", "shards", "compiles")
 
-    def __init__(self, n_fragments=0, ndev=0, wall_ms=0.0, rows=0, retries=0, store="", shards=None):
+    def __init__(self, n_fragments=0, ndev=0, wall_ms=0.0, rows=0, retries=0, store="", shards=None,
+                 compiles=0):
         self.n_fragments = n_fragments
         self.ndev = ndev
         self.wall_ms = wall_ms
@@ -258,6 +259,9 @@ class MPPExecDetails:
         self.retries = retries
         self.store = store  # "" = executed on the local mesh
         self.shards = shards or []  # [[shard_id, ms, rows, xchg_bytes], ...]
+        # fragment programs BUILT for this gather (0 = every attempt rode the
+        # program cache) — the MPP analog of the cop sidecar's jit flag
+        self.compiles = compiles
 
     def shard_summary(self) -> "tuple | None":
         """(max_ms, min_ms, p95_ms, slowest_shard_id) or None."""
@@ -281,6 +285,8 @@ class MPPExecDetails:
             parts.append(f"shards: {len(self.shards)}")
             parts.append(f"shard max/min/p95: {mx:.1f}/{mn:.1f}/{p95:.1f}ms")
             parts.append(f"slowest: shard {slowest}")
+        if self.compiles:
+            parts.append(f"compile: {self.compiles}")
         if self.retries:
             parts.append(f"retries: {self.retries}")
         if self.store:

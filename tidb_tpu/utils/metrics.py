@@ -293,6 +293,15 @@ MPP_SHARD_SECONDS = REGISTRY.histogram(
     "tidb_tpu_mpp_shard_seconds",
     "Per-shard MPP fragment completion wall (launch to shard-local finish)",
 )
+# MPP compiled-program reuse (parallel/gather._MPP_FN_CACHE): hit = a gather
+# rode an already-built jitted fragment program, miss = it had to build one
+# (the multi-second XLA wall) — power-of-two cap bucketing keeps this warm
+# across same-shape queries of different sizes
+MPP_PROGRAM_CACHE = REGISTRY.counter(
+    "tidb_tpu_mpp_program_cache_total",
+    "MPP fragment-program cache lookups by outcome",
+    ("result",),
+)
 # instance-level serving architecture (planner/instcache + the point-get
 # batcher in copr/client): cross-session cache outcomes, and how many
 # concurrent point reads each batched store dispatch coalesced (count =
