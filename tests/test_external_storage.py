@@ -21,8 +21,11 @@ def test_backup_restore_through_memory_bucket():
     url = "memory://brtest/run1"
     meta = backup_database(db, "test", url)
     assert meta["tables"]["bs"]["rows"] == 200
-    # the bucket holds the meta + one rows file, listable like an object store
-    assert sorted(MemStorage("brtest", "run1").list_files()) == ["backupmeta.json", "test.bs.rows"]
+    # the bucket holds the meta + the per-table resume checkpoint + one rows
+    # file, listable like an object store
+    assert sorted(MemStorage("brtest", "run1").list_files()) == [
+        "backup.checkpoint.json", "backupmeta.json", "test.bs.rows",
+    ]
     db2 = tidb_tpu.open()
     out, _ = restore_database(db2, url)
     assert out == {"bs": 200}

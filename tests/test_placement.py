@@ -1,0 +1,636 @@
+"""Elastic data placement (kv/placement.py): epoch-versioned movable
+ownership, load-aware region migration, and mid-query failover (ISSUE 11).
+
+In-process tests cover the quorum placement keyspace, the migrate protocol
+(parity before/during/after, 2PC re-route across a move, fence-blackout
+retries, concurrent DML with no loss/duplication), the balancer, the
+returning-replica meta anti-entropy, and the checkpointed BACKUP resume.
+The chaos section runs a real 3-process store fleet: a stale client's MPP
+gather re-dispatches to the new owner after a migration, and a store is
+SIGKILLed *while* the balancer's migration streams its regions — queries
+either complete via re-route or fail with one typed error, no hangs, and
+placement epochs never regress."""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.kv import tablecodec
+from tidb_tpu.kv.kv import KeyRange, RegionError
+from tidb_tpu.kv.memstore import MemStore, Mutation, OP_PUT
+from tidb_tpu.kv.sharded import ShardedStore
+from tidb_tpu.session.session import DB
+from tidb_tpu.utils import failpoint, metrics
+from tidb_tpu.kv.fault_injection import Script
+
+
+def _fleet(n=3):
+    return ShardedStore([MemStore(region_split_keys=100_000) for _ in range(n)])
+
+
+def _mkdb(fleet):
+    db = DB(store=fleet)
+    return db, db.session()
+
+
+# -- quorum placement keyspace ------------------------------------------------
+
+
+def test_placement_epoch_quorum_monotone():
+    fleet = _fleet()
+    cache = fleet.placement_cache
+    assert cache.propose(101, 2, 1)
+    assert fleet.shard_of_table(101) == 2
+    assert fleet.owner_for(101) == 2  # the PD-client naming twin
+    # same epoch, different shard: refused (first writer won epoch 1)
+    ok = cache.propose(101, 0, 1)
+    assert not ok
+    # regression refused everywhere
+    assert not cache.propose(101, 0, 0)
+    assert fleet.shard_of_table(101) == 2
+    # a higher epoch moves it
+    assert cache.propose(101, 0, 2)
+    assert fleet.shard_of_table(101) == 0
+    assert fleet.placement_epoch(101) == 2
+
+
+def test_placement_read_repairs_blank_replica():
+    fleet = _fleet()
+    fleet.placement_cache.propose(55, 1, 3)
+    # a replica restarted empty: blank placement record
+    fleet.stores[2].placement_replica._recs.clear()
+    assert fleet.stores[2].placement_read(55) == (0, None)
+    epoch, shard = fleet.placement_cache.read_majority(55)
+    assert (epoch, shard) == (3, 1)
+    # read repair pushed the resolved record back onto the straggler
+    assert fleet.stores[2].placement_read(55) == (3, 1)
+
+
+# -- region migration ---------------------------------------------------------
+
+
+def test_migrate_moves_rows_bumps_epoch_and_fences_source():
+    fleet = _fleet()
+    db, s = _mkdb(fleet)
+    s.execute("CREATE TABLE pm (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("INSERT INTO pm VALUES " + ",".join(f"({i},{i * 7})" for i in range(300)))
+    tid = db.catalog.table("test", "pm").id
+    src = fleet.shard_of_table(tid)
+    before = s.query("SELECT COUNT(*), SUM(v), MIN(id), MAX(id) FROM pm")
+    ids_before = {r.region_id for r, _ in fleet.pd.regions_in_ranges([tablecodec.record_range(tid)])}
+
+    stats = fleet.migrate_table(tid, (src + 1) % 3)
+    assert stats["moved"] and stats["rows"] >= 300
+    assert stats["epoch"] == 1 and stats["blackout_ms"] <= stats["wall_ms"]
+    dst = (src + 1) % 3
+    assert fleet.shard_of_table(tid) == dst
+
+    # exact parity after the move, and DML lands on the new owner
+    assert s.query("SELECT COUNT(*), SUM(v), MIN(id), MAX(id) FROM pm") == before
+    s.execute("INSERT INTO pm VALUES (9001, 11)")
+    assert s.query("SELECT v FROM pm WHERE id = 9001") == [(11,)]
+    k = tablecodec.record_key(tid, 9001)
+    assert fleet.stores[dst].get_snapshot(fleet.tso.ts()).get(k) is not None
+
+    # the old owner is fenced AND purged: a direct read there answers the
+    # typed re-route signal, never a silently empty table
+    with pytest.raises(RegionError):
+        fleet.stores[src].get_snapshot(fleet.stores[src].current_ts()).scan(
+            tablecodec.record_range(tid)
+        )
+    assert not fleet.stores[src]._sorted_slice(
+        KeyRange(tablecodec.table_prefix(tid), tablecodec.table_prefix(tid + 1))
+    )
+
+    # satellite fix: region ids are minted from the placement epoch — a
+    # moved region is never confused with the old owner's cached identity
+    ids_after = {r.region_id for r, _ in fleet.pd.regions_in_ranges([tablecodec.record_range(tid)])}
+    assert ids_before.isdisjoint(ids_after)
+
+
+def test_stale_client_reroutes_reads_and_writes():
+    stores = [MemStore(region_split_keys=100_000) for _ in range(3)]
+    fleet_a = ShardedStore(stores)
+    db, s = _mkdb(fleet_a)
+    s.execute("CREATE TABLE sc (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("INSERT INTO sc VALUES " + ",".join(f"({i},{i})" for i in range(100)))
+    tid = db.catalog.table("test", "sc").id
+    src = fleet_a.shard_of_table(tid)
+
+    # a second SQL node over the same fleet with its own (soon stale) cache
+    fleet_b = ShardedStore(stores)
+    db_b = DB(store=fleet_b)
+    s_b = db_b.session()
+    assert s_b.query("SELECT COUNT(*) FROM sc") == [(100,)]
+    assert fleet_b.shard_of_table(tid) == src
+
+    before = metrics.PLACEMENT_REROUTE.total()
+    fleet_a.migrate_table(tid, (src + 1) % 3)
+
+    # B still routes to the fenced ex-owner → RegionError → refresh → retry
+    assert s_b.query("SELECT COUNT(*), SUM(v) FROM sc") == [(100, 4950)]
+    s_b.execute("INSERT INTO sc VALUES (777, 42)")
+    assert s_b.query("SELECT v FROM sc WHERE id = 777") == [(42,)]
+    assert fleet_b.shard_of_table(tid) == (src + 1) % 3
+    assert metrics.PLACEMENT_REROUTE.total() > before
+
+
+def test_2pc_commit_reroutes_across_move():
+    """The 'commit replay on region move' gap, closed: a txn that prewrote
+    BEFORE the migration commits AFTER it — the fenced ex-owner refuses,
+    the client re-resolves, and the migrated lock is waiting at the new
+    owner."""
+    stores = [MemStore(region_split_keys=100_000) for _ in range(3)]
+    fleet_a = ShardedStore(stores)
+    db, s = _mkdb(fleet_a)
+    s.execute("CREATE TABLE tp (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("INSERT INTO tp VALUES (1, 1)")
+    tid = db.catalog.table("test", "tp").id
+    src = fleet_a.shard_of_table(tid)
+
+    fleet_b = ShardedStore(stores)  # the txn's client; cache goes stale
+    k = tablecodec.record_key(tid, 777)
+    start_ts = fleet_b.tso.ts()
+    fleet_b.prewrite([Mutation(OP_PUT, k, b"vv")], k, start_ts)
+
+    stats = fleet_a.migrate_table(tid, (src + 1) % 3)
+    assert stats["moved"]
+
+    commit_ts = fleet_b.tso.ts()
+    fleet_b.commit([k], start_ts, commit_ts)  # re-routes; migrated lock found
+    assert fleet_b.shard_of_table(tid) == (src + 1) % 3
+    assert fleet_b.get_snapshot(fleet_b.tso.ts()).get(k) == b"vv"
+    assert fleet_b.check_txn_status(k, start_ts) == ("committed", commit_ts)
+    # and the destination's store answers check_txn_status truthfully too
+    dst_store = stores[(src + 1) % 3]
+    assert dst_store.check_txn_status(k, start_ts) == ("committed", commit_ts)
+
+
+def test_fence_blackout_queries_retry_through_cutover():
+    """A query racing the cutover blackout retries under boRegionMiss and
+    completes once the epoch bump lands — no user-visible error."""
+    fleet = _fleet()
+    db, s = _mkdb(fleet)
+    s.execute("CREATE TABLE fb (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("INSERT INTO fb VALUES " + ",".join(f"({i},{i})" for i in range(200)))
+    tid = db.catalog.table("test", "fb").id
+    src = fleet.shard_of_table(tid)
+
+    failpoint.enable("placement_cutover", Script([0.3]))  # hold the fence 300ms
+    results: list = []
+
+    def mover():
+        results.append(fleet.migrate_table(tid, (src + 1) % 3))
+
+    t = threading.Thread(target=mover)
+    t.start()
+    try:
+        deadline = time.time() + 10
+        s2 = db.session()
+        while time.time() < deadline and not results:
+            assert s2.query("SELECT COUNT(*) FROM fb") == [(200,)]
+        t.join(timeout=10)
+    finally:
+        failpoint.disable("placement_cutover")
+    assert results and results[0]["moved"]
+    assert results[0]["blackout_ms"] >= 300  # the injected hold was real
+    assert s.query("SELECT COUNT(*) FROM fb") == [(200,)]
+
+
+def test_concurrent_dml_during_migration_no_loss_no_dup():
+    fleet = _fleet()
+    db, s = _mkdb(fleet)
+    s.execute("CREATE TABLE cd (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("INSERT INTO cd VALUES " + ",".join(f"({i},{i})" for i in range(500)))
+    tid = db.catalog.table("test", "cd").id
+    src = fleet.shard_of_table(tid)
+
+    stop = threading.Event()
+    errors: list = []
+    written: list[int] = []
+
+    def writer():
+        sw = db.session()
+        i = 10_000
+        try:
+            while not stop.is_set():
+                sw.execute(f"INSERT INTO cd VALUES ({i}, {i})")
+                written.append(i)
+                i += 1
+        except Exception as e:  # any writer error fails the test
+            errors.append(e)
+
+    failpoint.enable("placement_migrate_batch", Script([0.01] * 40))
+    w = threading.Thread(target=writer)
+    w.start()
+    try:
+        time.sleep(0.05)  # let the writer race the copy phase
+        stats = fleet.migrate_table(tid, (src + 1) % 3, batch_keys=128)
+    finally:
+        stop.set()
+        w.join(timeout=10)
+        failpoint.disable("placement_migrate_batch")
+    assert stats["moved"]
+    assert not errors, errors
+    assert len(written) > 0, "writer never got a row in — widen the window"
+    expect = 500 + len(written)
+    assert s.query("SELECT COUNT(*) FROM cd") == [(expect,)]
+    got = {r[0] for r in s.query("SELECT id FROM cd")}
+    assert got == set(range(500)) | set(written)  # nothing lost
+    # nothing duplicated: COUNT(*) over the PK equals DISTINCT count
+    assert s.query("SELECT COUNT(id), COUNT(DISTINCT id) FROM cd") == [(expect, expect)]
+
+
+def test_cluster_placement_memtable_shows_epoch_history():
+    fleet = _fleet()
+    db, s = _mkdb(fleet)
+    s.execute("CREATE TABLE ph (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("INSERT INTO ph VALUES (1, 1)")
+    tid = db.catalog.table("test", "ph").id
+    src = fleet.shard_of_table(tid)
+    fleet.migrate_table(tid, (src + 1) % 3)
+    fleet.migrate_table(tid, (src + 2) % 3)
+
+    rows = s.query(
+        "SELECT SHARD, EPOCH, STATE FROM information_schema.cluster_placement "
+        f"WHERE TABLE_ID = {tid} ORDER BY EPOCH"
+    )
+    assert len(rows) >= 2  # the epoch-1 history row + the epoch-2 current row
+    epochs = [r[1] for r in rows]
+    assert epochs == sorted(epochs) and len(set(epochs)) == len(epochs), epochs
+    current = [r for r in rows if r[2] == "settled"]
+    assert current and current[-1][0] == (src + 2) % 3 and current[-1][1] == 2
+    assert any(r[2] == "history" for r in rows)
+
+
+def test_balancer_spreads_induced_skew():
+    fleet = _fleet()
+    db, s = _mkdb(fleet)
+    hot = None
+    tids = {}
+    for t in ("bz0", "bz1", "bz2"):
+        s.execute(f"CREATE TABLE {t} (id BIGINT PRIMARY KEY, v BIGINT)")
+        s.execute(f"INSERT INTO {t} VALUES " + ",".join(f"({i},{i})" for i in range(800)))
+        tids[t] = db.catalog.table("test", t).id
+        if hot is None:
+            hot = fleet.shard_of_table(tids[t])
+        else:
+            fleet.migrate_table(tids[t], hot)
+        s.execute(f"ANALYZE TABLE {t}")
+    assert {fleet.shard_of_table(t) for t in tids.values()} == {hot}
+
+    moved = 0
+    for _ in range(6):
+        out = db.run_balancer()
+        moved += len(out.get("moves", ()))
+        if out.get("balanced"):
+            break
+    assert moved >= 2, "the balancer should have spread the skew"
+    shards = {fleet.shard_of_table(t) for t in tids.values()}
+    assert len(shards) == 3, f"3 tables should spread across 3 shards: {shards}"
+    for t in ("bz0", "bz1", "bz2"):
+        assert s.query(f"SELECT COUNT(*), SUM(v) FROM {t}") == [(800, 319600)]
+
+
+def test_ttl_fence_self_heals_after_aborted_migration():
+    """A migration driver that dies between fencing and cutover leaves a
+    TTL fence that expires on its own — the table returns to its old owner
+    with nothing lost (the crash-safety rule RESILIENCE.md documents)."""
+    st = MemStore(region_split_keys=1000)
+    k = tablecodec.record_key(42, 1)
+    st.raw_put(k, b"v")
+    st.fence_table(42, ttl_s=0.15)
+    with pytest.raises(RegionError):
+        st.get_snapshot(st.current_ts()).get(k)
+    with pytest.raises(RegionError):
+        st.raw_put(k, b"w")
+    time.sleep(0.2)
+    assert st.get_snapshot(st.current_ts()).get(k) == b"v"
+    # a permanent fence (the post-move state) does NOT expire
+    st.fence_table(42, ttl_s=None)
+    time.sleep(0.2)
+    with pytest.raises(RegionError):
+        st.get_snapshot(st.current_ts()).get(k)
+
+
+# -- returning-replica meta anti-entropy --------------------------------------
+
+
+def test_returning_replica_meta_catchup():
+    """A killed-and-restarted-EMPTY shard gets the majority's meta records,
+    election records, and placement bindings replayed onto it before its
+    reads count toward quorum again (the carried PR-2 gap)."""
+    fleet = _fleet()
+    db, s = _mkdb(fleet)
+    s.execute("CREATE TABLE mc (id BIGINT PRIMARY KEY)")  # meta fans to all
+    tid = db.catalog.table("test", "mc").id
+    assert fleet.owner_campaign("catchup-key", "node-a", lease_s=30.0)
+    fleet.placement_cache.propose(tid, 0, 1)
+
+    # simulate restart-empty: a blank store takes shard 2's place, and the
+    # election client remembers the shard was down
+    fleet.stores[2] = MemStore(region_split_keys=100_000)
+    fleet.election._down[2] = (0.0, 1.0)  # cooldown expired → probe again
+    assert fleet.stores[2].raw_get(b"m:catalog") is None
+
+    # the next election sweep triggers the catch-up hook
+    assert fleet.owner_of("catchup-key") == "node-a"
+    assert metrics.META_CATCHUP.total() >= 1
+    assert fleet.stores[2].raw_get(b"m:catalog") is not None  # meta replayed
+    term, owner, _dl = fleet.stores[2].election_read("catchup-key")
+    assert owner == "node-a" and term >= 1  # election record replayed
+    assert fleet.stores[2].placement_read(tid) == (1, 0)  # binding replayed
+
+
+# -- MPP task-level recovery --------------------------------------------------
+
+
+def test_mpp_lost_task_is_typed():
+    """A server that no longer knows a dispatched task answers MPPTaskLost —
+    the gather's signal to RE-DISPATCH instead of failing the query."""
+    from tidb_tpu.kv.remote import RemoteStore, StoreServer
+    from tidb_tpu.parallel.probe import MPPTaskLostError
+
+    srv = StoreServer(MemStore(region_split_keys=100_000))
+    srv.start()
+    try:
+        store = RemoteStore("127.0.0.1", srv.port, retry_budget_ms=250)
+        with pytest.raises(MPPTaskLostError):
+            store.mpp_conn("99999")
+    finally:
+        srv.shutdown()
+
+
+# -- checkpointed BACKUP resume -----------------------------------------------
+
+
+class _FaultyScanStore:
+    """Wraps a store so snapshot scans of one table's range fail while
+    armed, and every scan start key is recorded (which tables were
+    re-round-tripped)."""
+
+    def __init__(self, store, fail_range):
+        self._store = store
+        self.fail_range = fail_range
+        self.armed = True
+        self.scan_starts: list[bytes] = []
+
+    def get_snapshot(self, ts):
+        outer = self
+        real = self._store.get_snapshot(ts)
+
+        class _Snap:
+            def scan(self, kr, **kw):
+                outer.scan_starts.append(kr.start)
+                if outer.armed and outer.fail_range.start <= kr.start < outer.fail_range.end:
+                    raise ConnectionResetError("chaos: store reset mid-backup")
+                return real.scan(kr, **kw)
+
+            def __getattr__(self, n):
+                return getattr(real, n)
+
+        return _Snap()
+
+    def __getattr__(self, n):
+        return getattr(self._store, n)
+
+
+def test_backup_resume_skips_checkpointed_tables(tmp_path):
+    import json
+
+    import tidb_tpu
+    from tidb_tpu.tools.brie import backup_database, restore_database
+
+    db = tidb_tpu.open()
+    s = db.session()
+    for t in ("bk_a", "bk_b"):
+        s.execute(f"CREATE TABLE {t} (id BIGINT PRIMARY KEY, v BIGINT)")
+        s.execute(f"INSERT INTO {t} VALUES " + ",".join(f"({i},{i})" for i in range(50)))
+    names = db.catalog.tables("test")
+    second = db.catalog.table("test", names[1])
+    dest = str(tmp_path / "bk")
+
+    faulty = _FaultyScanStore(db.store, tablecodec.record_range(second.id))
+    db.store = faulty
+    # run 1: dies scanning the SECOND table — the first table's file and the
+    # checkpoint naming it survive; no backupmeta, so nothing restorable yet
+    with pytest.raises(ConnectionResetError):
+        backup_database(db, "test", dest)
+    ck = json.loads((tmp_path / "bk" / "backup.checkpoint.json").read_text())
+    assert names[0] in ck["tables"] and names[1] not in ck["tables"]
+    assert not (tmp_path / "bk" / "backupmeta.json").exists()
+
+    # run 2 (fault healed): resumes — the checkpointed table is NOT
+    # re-scanned, the snapshot ts is the ORIGINAL one, and the backup is
+    # restorable with every row
+    faulty.armed = False
+    faulty.scan_starts.clear()
+    meta = backup_database(db, "test", dest)
+    assert meta["backup_ts"] == ck["backup_ts"]
+    first_range = tablecodec.record_range(db.catalog.table("test", names[0]).id)
+    assert all(
+        not (first_range.start <= k < first_range.end) for k in faulty.scan_starts
+    ), "resume re-scanned a checkpointed table"
+    out, _ = restore_database(db, dest, "restored")
+    assert out == {names[0]: 50, names[1]: 50}
+    assert s.query("SELECT COUNT(*) FROM restored.bk_a") == [(50,)]
+
+
+# -- chaos: a real 3-process fleet --------------------------------------------
+
+_SERVER_SCRIPT = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from tidb_tpu.kv.memstore import MemStore
+from tidb_tpu.kv.remote import StoreServer
+
+srv = StoreServer(MemStore(region_split_keys=100_000))
+print(f"PORT {{srv.start()}}", flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+def _spawn():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return subprocess.Popen(
+        [sys.executable, "-c", _SERVER_SCRIPT.format(repo=repo)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def _port(proc):
+    got: list = []
+
+    def reader():
+        for line in proc.stdout:
+            if line.startswith("PORT "):
+                got.append(int(line.split()[1]))
+                return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    t.join(timeout=120)
+    if not got:
+        proc.kill()
+        raise RuntimeError("store server did not report a port within 120s")
+    return got[0]
+
+
+def _remote_fleet(ports):
+    from tidb_tpu.kv.remote import RemoteStore
+
+    return ShardedStore(
+        [RemoteStore("127.0.0.1", p, retry_budget_ms=250, backoff_seed=0) for p in ports]
+    )
+
+
+@pytest.fixture(scope="module")
+def wire_cluster():
+    procs = [_spawn(), _spawn(), _spawn()]
+    ports = [_port(p) for p in procs]
+    admin = DB(store=_remote_fleet(ports))
+    s = admin.session()
+    s.execute("CREATE TABLE fact (cid BIGINT, qty BIGINT)")
+    s.execute("CREATE TABLE dim (id BIGINT PRIMARY KEY, cat BIGINT)")
+    s.execute("INSERT INTO dim VALUES " + ",".join(f"({i},{i % 4})" for i in range(30)))
+    s.execute(
+        "INSERT INTO fact VALUES " + ",".join(f"({i % 30},{i % 7})" for i in range(600))
+    )
+    yield admin, procs, ports
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+            p.wait(timeout=10)
+
+
+MPPQ = "SELECT cat, COUNT(*), SUM(qty) FROM fact JOIN dim ON fact.cid = dim.id GROUP BY cat ORDER BY cat"
+
+
+@pytest.mark.chaos
+def test_chaos_stale_mpp_gather_redispatches_after_move(wire_cluster):
+    admin, procs, ports = wire_cluster
+    fleet_admin = admin.store
+    fact_tid = admin.catalog.table("test", "fact").id
+    dim_tid = admin.catalog.table("test", "dim").id
+    # co-locate both tables (the balancer's co-location move, done by hand
+    # so the test controls the owners)
+    owner1 = fleet_admin.shard_of_table(fact_tid)
+    if fleet_admin.shard_of_table(dim_tid) != owner1:
+        assert fleet_admin.migrate_table(dim_tid, owner1)["moved"]
+
+    # the query client: its placement cache warms to owner1, then goes stale
+    client = DB(store=_remote_fleet(ports))
+    sc = client.session()
+    sc.execute("SET tidb_allow_mpp = 1")
+    host = client.session()
+    host.execute("SET tidb_allow_mpp = 0")
+    expect = host.query(MPPQ)
+    assert sc.query(MPPQ) == expect
+    assert sc.mpp_details, "the baseline query must have taken the MPP path"
+
+    owner2 = (owner1 + 1) % 3
+    assert fleet_admin.migrate_table(fact_tid, owner2)["moved"]
+    assert fleet_admin.migrate_table(dim_tid, owner2)["moved"]
+
+    # stale client dispatches to the fenced ex-owner → RegionError kind →
+    # placement refresh → the gather RE-DISPATCHES to the new owner
+    before = metrics.PLACEMENT_REROUTE.get(verb="mpp_dispatch")
+    sc2 = client.session()
+    sc2.execute("SET tidb_allow_mpp = 1")
+    assert sc2.query(MPPQ) == expect
+    assert client.store.shard_of_table(fact_tid) == owner2
+    assert metrics.PLACEMENT_REROUTE.get(verb="mpp_dispatch") > before
+    assert sc2.mpp_details, "the re-routed query must have stayed on MPP"
+
+
+@pytest.mark.chaos
+def test_chaos_kill_store_during_migration(wire_cluster):
+    """SIGKILL the SOURCE store while the balancer's migration is streaming
+    its regions, with a concurrent query loop running: every query either
+    completes (via re-routed placement) or fails with ONE typed error inside
+    the retry budget — no hangs — and placement epochs never regress."""
+    admin, procs, ports = wire_cluster
+    fleet_admin = admin.store
+    s = admin.session()
+    s.execute("CREATE TABLE kt (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("INSERT INTO kt VALUES " + ",".join(f"({i},{i})" for i in range(400)))
+    tid = admin.catalog.table("test", "kt").id
+    src = fleet_admin.shard_of_table(tid)
+    dst = (src + 1) % 3
+
+    observer = DB(store=_remote_fleet(ports))
+    stop = threading.Event()
+    outcomes: list = []
+    epochs: list[int] = []
+
+    def querier():
+        so = observer.session()
+        while not stop.is_set():
+            t0 = time.time()
+            try:
+                n = so.query("SELECT COUNT(*) FROM kt")[0][0]
+                outcomes.append(("ok", n, time.time() - t0))
+            except Exception as e:
+                outcomes.append(("err", type(e).__name__, time.time() - t0))
+            epochs.append(observer.store.placement_epoch(tid))
+            time.sleep(0.02)
+
+    move_result: list = []
+
+    def mover():
+        try:
+            move_result.append(("ok", fleet_admin.migrate_table(tid, dst, batch_keys=64)))
+        except Exception as e:
+            move_result.append(("err", e))
+
+    failpoint.enable("placement_migrate_batch", Script([0.05] * 60))
+    q = threading.Thread(target=querier)
+    m = threading.Thread(target=mover)
+    q.start()
+    m.start()
+    try:
+        time.sleep(0.4)  # mid-copy
+        procs[src].send_signal(signal.SIGKILL)
+        procs[src].wait(timeout=10)
+        m.join(timeout=90)
+        assert not m.is_alive(), "migration hung after the source was killed"
+        time.sleep(1.0)  # let the query loop observe the post-kill world
+    finally:
+        stop.set()
+        q.join(timeout=30)
+        failpoint.disable("placement_migrate_batch")
+    assert not q.is_alive(), "query loop hung"
+
+    # the migration either completed (cutover already decided) or failed
+    # with one TYPED error — never an undetermined mess
+    kind, payload = move_result[0]
+    if kind == "err":
+        assert isinstance(payload, (ConnectionError, OSError)), payload
+    # every query outcome: correct rows or a typed error, each bounded
+    assert outcomes, "the query loop never ran"
+    for o in outcomes:
+        assert o[2] < 30.0, f"a query stalled {o[2]:.1f}s: no hang allowed"
+        if o[0] == "ok":
+            assert o[1] == 400, f"wrong row count mid-migration: {o[1]}"
+        else:
+            assert o[1] in ("ConnectionError", "ConnectionResetError", "SessionError",
+                            "RegionError", "RuntimeError", "TimeoutError", "OSError"), o
+    # placement epochs never regress
+    assert all(a <= b for a, b in zip(epochs, epochs[1:])), epochs
+    # if the cutover landed, the survivors serve the table whole — no row
+    # lost or duplicated after the move (placement quorum still stands on
+    # the two survivors)
+    if kind == "ok" and payload["moved"]:
+        sf = observer.session()
+        assert sf.query("SELECT COUNT(*), COUNT(DISTINCT id) FROM kt") == [(400, 400)]

@@ -646,6 +646,107 @@ def bench_owner_failover() -> float:
     return best
 
 
+_REGION_MOVE_MEMO: dict = {}
+
+
+def _region_move_measure() -> dict:
+    """Migrate a populated table between stores of an embedded 3-shard
+    fleet, twice (there and back), keeping the best run. Memoized so the
+    two registered lanes (total wall / cutover blackout) pay one setup."""
+    if _REGION_MOVE_MEMO:
+        return _REGION_MOVE_MEMO
+    from tidb_tpu.kv.memstore import MemStore
+    from tidb_tpu.kv.sharded import ShardedStore
+    from tidb_tpu.session.session import DB
+
+    fleet = ShardedStore([MemStore(region_split_keys=100_000) for _ in range(3)])
+    db = DB(store=fleet)
+    s = db.session()
+    s.execute("CREATE TABLE mv (id BIGINT PRIMARY KEY, v BIGINT, s VARCHAR(16))")
+    for lo in range(0, 20_000, 4000):
+        s.execute(
+            "INSERT INTO mv VALUES "
+            + ",".join(f"({i},{i * 3},'row-{i % 97}')" for i in range(lo, lo + 4000))
+        )
+    tid = db.catalog.table("test", "mv").id
+    src = fleet.shard_of_table(tid)
+    best_wall, best_blackout = float("inf"), float("inf")
+    for step in (1, 2, 3):
+        stats = fleet.migrate_table(tid, (src + step) % 3)
+        if not stats["moved"] or stats["rows"] < 20_000:
+            raise RuntimeError(f"region-move bench migrated nothing: {stats}")
+        best_wall = min(best_wall, stats["wall_ms"])
+        best_blackout = min(best_blackout, stats["blackout_ms"])
+        # verify the move kept the data whole — a fast-but-lossy migration
+        # must fail the lane, not set a record
+        n = s.query("SELECT COUNT(*) FROM mv")[0][0]
+        if n != 20_000:
+            raise RuntimeError(f"region-move bench lost rows: {n} != 20000")
+    _REGION_MOVE_MEMO.update(wall_ms=best_wall, blackout_ms=best_blackout)
+    return _REGION_MOVE_MEMO
+
+
+@register("region_move_ms")
+def bench_region_move() -> float:
+    """Wall clock to migrate a populated 20k-row region between stores (ms,
+    lower is better): snapshot copy + catch-up + fenced cutover + purge.
+    The cutover blackout rides the separate region_move_blackout_ms lane."""
+    return _region_move_measure()["wall_ms"]
+
+
+@register("region_move_blackout_ms")
+def bench_region_move_blackout() -> float:
+    """The cutover blackout window alone (ms, lower is better): the stretch
+    where the source is fenced and the final catch-up + epoch bump run —
+    the only part a concurrent writer ever waits on (readers of the old
+    owner retry under boRegionMiss for the same window)."""
+    return _region_move_measure()["blackout_ms"]
+
+
+@register("balancer_converge_s")
+def bench_balancer_converge() -> float:
+    """Seconds from an induced 3:1 load skew to balanced placement (lower
+    is better): three populated tables migrated onto ONE store of a
+    3-shard fleet, then balancer sweeps run back-to-back until the sweep
+    reports balance under the default skew ratio. Recorded in ms (the _s
+    suffix converts) under the same --check gate."""
+    import time as _t
+
+    from tidb_tpu.kv.memstore import MemStore
+    from tidb_tpu.kv.sharded import ShardedStore
+    from tidb_tpu.session.session import DB
+
+    fleet = ShardedStore([MemStore(region_split_keys=100_000) for _ in range(3)])
+    db = DB(store=fleet)
+    s = db.session()
+    hot = None
+    for t in ("sk0", "sk1", "sk2"):
+        s.execute(f"CREATE TABLE {t} (id BIGINT PRIMARY KEY, v BIGINT)")
+        s.execute(
+            f"INSERT INTO {t} VALUES " + ",".join(f"({i},{i})" for i in range(4000))
+        )
+        tid = db.catalog.table("test", t).id
+        if hot is None:
+            hot = fleet.shard_of_table(tid)
+        else:
+            fleet.migrate_table(tid, hot)  # induce the skew: all on one store
+        s.execute(f"ANALYZE TABLE {t}")
+    t0 = _t.perf_counter()
+    for _ in range(8):
+        if db.run_balancer().get("balanced"):
+            break
+    else:
+        raise RuntimeError("balancer did not converge within 8 sweeps")
+    elapsed = _t.perf_counter() - t0
+    # converged placement must actually be spread: no shard holds all three
+    shards = {
+        fleet.shard_of_table(db.catalog.table("test", t).id) for t in ("sk0", "sk1", "sk2")
+    }
+    if len(shards) < 2:
+        raise RuntimeError(f"balancer converged without spreading: {shards}")
+    return elapsed
+
+
 def run_all(names=None) -> list[dict]:
     out = []
     for name, fn in _BENCHES.items():
@@ -655,6 +756,10 @@ def run_all(names=None) -> list[dict]:
         rec = {"name": name, "date": datetime.date.today().isoformat()}
         if name.endswith("_ms"):
             rec["ms"] = round(v, 1)
+        elif name.endswith("_s"):
+            # seconds-scale latency lane: recorded in ms so check_regression
+            # applies its lower-is-better rule unchanged
+            rec["ms"] = round(v * 1000.0, 1)
         else:
             rec["ops_per_sec"] = round(v)
         out.append(rec)

@@ -32,6 +32,7 @@ def memtable_rows(db, session, name: str) -> Optional[tuple[list, list, list]]:
         # warning + partial rows — TiDB's cluster_* semantics)
         "cluster_info": _cluster_info,
         "cluster_load": _cluster_load,
+        "cluster_placement": _cluster_placement,
         "cluster_slow_query": _cluster_slow_query,
         "cluster_statements_summary": _cluster_statements_summary,
         "cluster_trace_reservoir": _cluster_trace_reservoir,
@@ -427,6 +428,44 @@ def _cluster_load(db, session):
     for o in _cluster_sweep(db, session, sections=()):
         if o["ok"]:
             rows.append(_load_row(o["instance"], o["report"]))
+    return cols, fts, rows
+
+
+def _cluster_placement(db, session):
+    """The elastic-placement plane (kv/placement.py): every table's current
+    binding (shard, owner instance, placement epoch), the epoch HISTORY
+    this node has observed (one row per transition, STATE='history'), and
+    in-flight moves (STATE='moving src→dst'). Epoch 0 = static hash/pin
+    placement a migration never touched. Empty on a non-sharded store."""
+    from tidb_tpu.types.field_type import double_type
+
+    cols = ["TABLE_ID", "TABLE_NAME", "SHARD", "INSTANCE", "EPOCH", "STATE", "SINCE"]
+    fts = [_I(), _S(128), _I(), _S(), _I(), _S(32), double_type()]
+    store = db.store
+    snap_fn = getattr(store, "placement_snapshot", None)
+    if snap_fn is None:
+        return cols, fts, []
+    snap = snap_fn()
+    from tidb_tpu.kv.sharded import ShardedStore
+
+    names = {}
+    for dname, t in _iter_tables(db):
+        names[t.id] = f"{dname}.{t.name}"
+        for v in t.partition_views():
+            names.setdefault(v.id, f"{dname}.{t.name}")
+    inst = lambda si: ShardedStore.instance_name(store.stores[si])  # noqa: E731
+    rows = []
+    moving = snap.get("moving", {})
+    for tid in sorted(names):
+        si = store.shard_of_table(tid)
+        epoch = snap["tables"].get(tid, {}).get("epoch", 0)
+        mv = moving.get(tid)
+        state = f"moving {mv['src']}→{mv['dst']}" if mv else "settled"
+        hist = snap.get("history", {}).get(tid, ())
+        since = hist[-1][2] if hist else None
+        rows.append((tid, names[tid], si, inst(si), epoch, state, since))
+        for e, s, ts in hist[:-1] if hist else ():
+            rows.append((tid, names[tid], s, inst(s), e, "history", ts))
     return cols, fts, rows
 
 

@@ -39,6 +39,17 @@ class Config:
     # refreshes at lease/3 (kv/election.py quorum leases and kv/owner.py
     # local leases both read this default)
     owner_lease_s: float = 10.0
+    # [cluster] elastic placement (kv/placement.py): the owner-gated
+    # balancer sweep cadence (<= 0 disables), the max/min shard load ratio
+    # past which it moves a region, the region-migration copy page size,
+    # and the cutover fence TTL — an aborted migration's write/read fence
+    # on the source self-heals after this long, so a dead driver can never
+    # wedge a table (the successful path replaces it with a permanent
+    # fence + purge on the old owner)
+    balancer_interval_s: float = 30.0
+    balancer_skew_ratio: float = 2.0
+    migrate_batch_keys: int = 4096
+    placement_fence_ttl_s: float = 10.0
     # [observability] always-on sampled tracing: the fraction of statements
     # that record a full distributed trace into the reservoir (0 = off; the
     # tidb_tpu_trace_sample_rate sysvar overrides per session/global), and
@@ -123,6 +134,12 @@ class Config:
         cfg.rpc_retry_budget_ms = float(net.get("rpc-retry-budget-ms", cfg.rpc_retry_budget_ms))
         cl = raw.get("cluster", {})
         cfg.owner_lease_s = float(cl.get("owner-lease-s", cfg.owner_lease_s))
+        cfg.balancer_interval_s = float(cl.get("balancer-interval-s", cfg.balancer_interval_s))
+        cfg.balancer_skew_ratio = float(cl.get("balancer-skew-ratio", cfg.balancer_skew_ratio))
+        cfg.migrate_batch_keys = int(cl.get("migrate-batch-keys", cfg.migrate_batch_keys))
+        cfg.placement_fence_ttl_s = float(
+            cl.get("placement-fence-ttl-s", cfg.placement_fence_ttl_s)
+        )
         obs = raw.get("observability", {})
         cfg.trace_sample_rate = float(obs.get("trace-sample-rate", cfg.trace_sample_rate))
         cfg.trace_reservoir_size = int(obs.get("trace-reservoir-size", cfg.trace_reservoir_size))

@@ -119,6 +119,16 @@ class QuorumElection:
         # renewal: lets the lease holder learn its fencing token without
         # paying a second majority sweep right after campaigning
         self._granted: dict[tuple[str, str], int] = {}
+        # returning-replica anti-entropy hook (``catchup_fn(shard_idx)``):
+        # when a shard that was marked down answers again, the hook replays
+        # the majority's records onto it BEFORE its reads count toward
+        # quorum — a killed-and-restarted-EMPTY replica otherwise rejoins
+        # blank and is only read-repaired lazily, key by key (the carried
+        # PR-2 gap). ShardedStore installs a replayer covering the meta
+        # keyspace, election records, and placement bindings. Best-effort:
+        # a failed catch-up leaves the shard to lazy read-repair.
+        self.catchup_fn = None
+        self._catchup_busy: set[int] = set()
 
     @property
     def quorum(self) -> int:
@@ -145,20 +155,49 @@ class QuorumElection:
             return bool(self._down)
 
     def _clear_cooldowns(self) -> None:
+        # zero the skip deadlines but KEEP the entries: membership in _down
+        # is also the "this shard is RETURNING" witness the anti-entropy
+        # catch-up keys off — dropping it here would let a restarted-empty
+        # shard rejoin without the replay (only _mark_up, after a
+        # successful contact ran the catch-up gate, removes an entry)
         with self._down_mu:
-            self._down.clear()
+            self._down = {i: (0.0, cd) for i, (_, cd) in self._down.items()}
 
     # -- quorum plumbing ----------------------------------------------------
+    def _run_catchup(self, i: int) -> None:
+        """Fire the returning-replica hook once per return (guarded against
+        re-entry: the hook itself runs majority reads through this client)."""
+        with self._down_mu:
+            if i in self._catchup_busy:
+                return
+            self._catchup_busy.add(i)
+        try:
+            self.catchup_fn(i)
+        except Exception:
+            pass  # the shard flapped again; lazy read-repair still covers it
+        finally:
+            with self._down_mu:
+                self._catchup_busy.discard(i)
+
     def _sweep_reads(self, key: str):
         """One pass over every replica not in cooldown → ([(idx, (term,
         owner, deadline))], last ConnectionError). Dead shards are skipped;
-        each store's own Backoffer already bounded the probe."""
+        each store's own Backoffer already bounded the probe. A shard seen
+        DOWN on an earlier sweep that answers now gets the catch-up hook
+        replayed onto it (then re-read) before its vote counts — a
+        restarted-empty replica must not vote its blank keyspace."""
         out, last = [], None
         for i, st in enumerate(self.stores):
             if self._skip(i):
                 continue
+            returning = False
+            with self._down_mu:
+                returning = i in self._down and i not in self._catchup_busy
             try:
                 rec = st.election_read(key)
+                if returning and self.catchup_fn is not None:
+                    self._run_catchup(i)
+                    rec = st.election_read(key)  # post-replay state votes
             except ConnectionError as e:
                 self._mark_down(i)
                 last = e
@@ -249,12 +288,21 @@ class QuorumElection:
             for i, st in enumerate(self.stores):
                 if self._skip(i):
                     continue
+                with self._down_mu:
+                    returning = i in self._down and i not in self._catchup_busy
                 try:
                     ok, _ = st.election_propose(key, node_id, term, deadline)
                 except ConnectionError as e:
                     self._mark_down(i)
                     last = e
                     continue
+                if returning and self.catchup_fn is not None:
+                    # a returning replica whose first contact is a PROPOSE
+                    # still gets the anti-entropy replay before _mark_up
+                    # erases the returning witness — its ack for THIS record
+                    # already stands, but its blank keyspace must not vote
+                    # in later read sweeps un-caught-up
+                    self._run_catchup(i)
                 self._mark_up(i)
                 reached += 1
                 if ok:

@@ -30,6 +30,7 @@ from tidb_tpu.kv.kv import (
     KeyLockedError,
     KeyRange,
     LockWaitTimeoutError,
+    RegionError,
     StoreType,
     TimestampOracle,
     TxnAbortedError,
@@ -152,7 +153,13 @@ class PlacementDriver:
 
     def regions_in_ranges(self, ranges: Sequence[KeyRange]) -> list[tuple[Region, list[KeyRange]]]:
         """Split key ranges by region boundary (ref: copr/coprocessor.go:334
-        buildCopTasks / region_cache.SplitKeyRangesByBuckets)."""
+        buildCopTasks / region_cache.SplitKeyRangesByBuckets). A range whose
+        table is placement-FENCED here (its region moved to another store)
+        raises RegionError instead of splitting — the routing caller
+        re-resolves placement under boRegionMiss; silently returning no
+        tasks would read as an empty table."""
+        for kr in ranges:
+            self._store._check_fence_range(kr)
         out: list[tuple[Region, list[KeyRange]]] = []
         for region in self._store.regions():
             rr = region.range()
@@ -249,6 +256,7 @@ class Snapshot:
 
     def _get_locked(self, key: bytes) -> Optional[bytes]:
         """One key's read under the store mutex (caller holds it)."""
+        self._store._check_fence_key(key)
         self._store._check_lock(key, self.read_ts)
         writes = self._store._writes.get(key)
         w = self._visible(writes) if writes else None
@@ -292,6 +300,7 @@ class Snapshot:
         from tidb_tpu.kv.rowcodec import encode_row
 
         store = self._store
+        store._check_fence_range(kr)
         out: list[tuple[bytes, bytes]] = []
         with store._mu:
             keys = store._sorted_slice(kr)
@@ -358,6 +367,7 @@ class Snapshot:
         tombs: list[int] = []
         tomb_ts: list[int] = []
         off = 0
+        self._store._check_fence_range(kr)
         with self._store._mu:
             keys = self._store._sorted_slice(kr)
             writes_map = self._store._writes
@@ -437,6 +447,18 @@ class MemStore:
         # these replicas instead of using the local OwnerManager above
         # (kv/election.py — the PD/etcd-member role)
         self.election_replica = ElectionReplica()
+        # this store's share of the quorum PLACEMENT keyspace: epoch-
+        # versioned table→shard bindings the elastic-placement driver
+        # (kv/placement.py) replicates to a majority — the PD region-epoch
+        # analog that makes ownership movable at runtime
+        from tidb_tpu.kv.placement import PlacementReplica
+
+        self.placement_replica = PlacementReplica()
+        # placement fences: table_id → expiry (monotonic seconds; None =
+        # permanent). A fenced table's reads AND writes raise RegionError —
+        # the cutover signal stale routing clients re-resolve on. TTL
+        # fences self-heal when a migration driver dies mid-move.
+        self._fences: dict[int, float | None] = {}
 
     # -- owner election (ref: pkg/owner/manager.go:49) ----------------------
     def owner_campaign(
@@ -466,6 +488,220 @@ class MemStore:
 
     def election_read(self, key: str):
         return self.election_replica.read(key)
+
+    # -- placement replica verbs (quorum keyspace; see kv/placement.py) ------
+    def placement_propose(self, table_id: int, shard: int, epoch: int):
+        return self.placement_replica.propose(table_id, shard, epoch)
+
+    def placement_read(self, table_id: int | None = None):
+        if table_id is None:
+            return self.placement_replica.read_all()
+        return self.placement_replica.read(table_id)
+
+    # -- placement fences (the cutover write/read barrier) -------------------
+    def fence_table(self, table_id: int, ttl_s: float | None = None) -> None:
+        """Fence one table's keyspace: reads and writes raise RegionError
+        until unfenced. ``ttl_s`` bounds a migration's cutover blackout (a
+        dead driver's fence expires on its own); ``None`` is permanent —
+        the post-move state of the OLD owner, so a stale client always gets
+        a typed re-route signal instead of a silently empty table."""
+        import time as _time
+
+        with self._mu:
+            self._fences[table_id] = None if ttl_s is None else _time.monotonic() + ttl_s
+
+    def unfence_table(self, table_id: int) -> None:
+        with self._mu:
+            self._fences.pop(table_id, None)
+
+    def _fence_live(self, table_id: int) -> bool:
+        import time as _time
+
+        ent = self._fences.get(table_id, False)
+        if ent is False:
+            return False
+        if ent is not None and _time.monotonic() >= ent:
+            with self._mu:  # expired TTL fence: migration aborted, reopen
+                cur = self._fences.get(table_id)
+                if cur is not None and _time.monotonic() >= cur:
+                    self._fences.pop(table_id, None)
+            return False
+        return True
+
+    def _check_fence_table(self, table_id: int) -> None:
+        """The one home of the fence verdict (clients may match its text)."""
+        if self._fences and self._fence_live(table_id):
+            raise RegionError(
+                table_id, f"table {table_id} placement moved (fenced on this store)"
+            )
+
+    def _check_fence_key(self, key: bytes) -> None:
+        if not self._fences or key[:1] != tablecodec.TABLE_PREFIX or len(key) < 9:
+            return
+        from tidb_tpu.utils import codec
+
+        self._check_fence_table(codec.decode_int_raw(key, 1))
+
+    def _check_fence_range(self, kr: KeyRange) -> None:
+        """Raise when ``kr`` lies WITHIN one fenced table's keyspace (the
+        per-table scan every data path issues). Broader multi-table ranges
+        pass — after the purge there is nothing left to return, and during
+        the ms-scale cutover blackout the source's copy is still exact."""
+        if not self._fences or kr.start[:1] != tablecodec.TABLE_PREFIX or len(kr.start) < 9:
+            return
+        from tidb_tpu.utils import codec
+
+        tid = codec.decode_int_raw(kr.start, 1)
+        if kr.end <= tablecodec.table_prefix(tid + 1):
+            self._check_fence_table(tid)
+
+    # -- region migration verbs (kv/placement.py migrate_table) --------------
+    def migrate_export(self, table_id: int, after_ts: int = 0, upto_ts: int | None = None,
+                       cursor=None, limit: int = 4096, include_locks: bool = False) -> dict:
+        """One page of ``table_id``'s committed state for a region move:
+        ``(key, op, value, commit_ts, start_ts)`` items carrying their
+        ORIGINAL timestamps (concurrent snapshots must read identically
+        from either side, and check_txn_status must stay truthful at the
+        destination). Pages walk the row-delta dict first, then the stable
+        columnar blocks (encoded as row puts at the block's commit ts);
+        the FINAL page of a fenced window additionally ships the in-flight
+        prewrite locks, so a 2PC commit that re-routes finds them waiting.
+        Pure read — replay-safe over the wire. ``cursor`` is opaque:
+        ``None`` starts, the returned cursor continues, ``None`` back means
+        done."""
+        hi_ts = upto_ts if upto_ts is not None else 2**63
+        lo_key = tablecodec.table_prefix(table_id)
+        hi_key = tablecodec.table_prefix(table_id + 1)
+        phase, pos = ("dict", lo_key) if cursor is None else (cursor[0], cursor[1:])
+        items: list = []
+        next_cur = None
+        stable_jobs: list = []
+        with self._mu:
+            if phase == "dict":
+                start = pos if isinstance(pos, bytes) else pos[0]
+                for k in self._sorted_slice(KeyRange(max(lo_key, start), hi_key)):
+                    if len(items) >= limit:
+                        next_cur = ("dict", k)
+                        break
+                    for w in self._writes.get(k, ()):
+                        if after_ts < w.commit_ts <= hi_ts:
+                            items.append((k, w.op, w.value, w.commit_ts, w.start_ts))
+                else:
+                    next_cur = ("stable", 0, 0)
+            else:
+                bi, ri = int(pos[0]), int(pos[1])
+                blocks = self._stable.get(table_id, [])
+                budget = limit
+                while bi < len(blocks) and budget > 0:
+                    b = blocks[bi]
+                    if not (after_ts < b.commit_ts <= hi_ts):
+                        bi, ri = bi + 1, 0
+                        continue
+                    take = min(budget, len(b.handles) - ri)
+                    stable_jobs.append((b, ri, ri + take))
+                    budget -= take
+                    ri += take
+                    if ri >= len(b.handles):
+                        bi, ri = bi + 1, 0
+                if bi < len(blocks):
+                    next_cur = ("stable", bi, ri)
+        # stable blocks are immutable once ingested: encode OUTSIDE the lock
+        if stable_jobs:
+            from tidb_tpu.kv.rowcodec import encode_row
+
+            for b, lo, hi in stable_jobs:
+                for i in range(lo, hi):
+                    items.append(
+                        (
+                            tablecodec.record_key(table_id, int(b.handles[i])),
+                            OP_PUT,
+                            encode_row(b.schema, b.row_values(i)),
+                            b.commit_ts,
+                            b.commit_ts,
+                        )
+                    )
+        locks: list = []
+        if include_locks and next_cur is None:
+            with self._mu:
+                for k, l in self._locks.items():
+                    if lo_key <= k < hi_key:
+                        locks.append((k, l))
+        return {"items": items, "locks": locks, "cursor": next_cur}
+
+    def migrate_apply(self, items, locks=()) -> int:
+        """Install migrated versions (and in-flight locks) preserving their
+        original timestamps. Idempotent: a (key, commit_ts) already present
+        is skipped, so the wire verb is replay-safe. Region bookkeeping
+        mirrors commit — data_version bumps, change logs note the rows, so
+        the destination's device column cache revalidates."""
+        applied = 0
+        with self._mu:
+            touched: dict[int, Region] = {}
+            for k, op, v, cts, sts in items:
+                chain = self._writes.get(k)
+                is_new = chain is None
+                if is_new:
+                    chain = self._writes[k] = []
+                    if self._sorted is not None:
+                        if self._sorted and self._sorted[-1] < k:
+                            self._sorted.append(k)
+                        else:
+                            self._sorted = None
+                elif any(w.commit_ts == cts for w in chain):
+                    continue
+                chain.insert(
+                    bisect.bisect_left([w.commit_ts for w in chain], cts),
+                    Write(cts, sts, op, v),
+                )
+                applied += 1
+                r = self.region_for_key(k)
+                r.max_commit_ts = max(r.max_commit_ts, cts)
+                if is_new:
+                    r.key_count += 1
+                touched[id(r)] = r
+                self._note_change(r.region_id, k, op, cts)
+            for k, lock in locks:
+                cur = self._locks.get(k)
+                if cur is not None and cur.start_ts != lock.start_ts:
+                    continue  # a newer txn holds the key here: never clobber
+                if any(w.start_ts == lock.start_ts for w in self._writes.get(k, ())):
+                    # the lock's txn already COMMITTED on this store (a
+                    # post-cutover sweep re-shipping the source's stale copy
+                    # of a lock the client resolved here): re-installing it
+                    # would re-lock a decided key
+                    continue
+                if lock.start_ts in self._rollbacks.get(k, ()):
+                    continue  # likewise a decided rollback
+                self._locks[k] = lock
+            for r in touched.values():
+                r.data_version += 1
+                self._maybe_auto_split(r)
+        return applied
+
+    def purge_table(self, table_id: int) -> None:
+        """Drop every version/lock/stable block of ``table_id`` — post-
+        cutover hygiene on the OLD owner. Callers must keep the permanent
+        fence: without it a stale client would read a silently EMPTY table
+        instead of getting the typed re-route signal."""
+        lo, hi = tablecodec.table_prefix(table_id), tablecodec.table_prefix(table_id + 1)
+        with self._mu:
+            doomed = self._sorted_slice(KeyRange(lo, hi))
+            for k in doomed:
+                self._writes.pop(k, None)
+            for k in [k for k in self._locks if lo <= k < hi]:
+                del self._locks[k]
+            for k in [k for k in self._rollbacks if lo <= k < hi]:
+                del self._rollbacks[k]
+            self._stable.pop(table_id, None)
+            for ck in [ck for ck in self._changes if ck[1] == table_id]:
+                del self._changes[ck]
+            if doomed:
+                self._sorted = None
+            for r in self._regions:
+                rr = r.range()
+                if rr.start < hi and rr.end > lo:
+                    self._recount_region(r)
+                    r.data_version += 1
 
     # -- columnar change log (write→delta notification seam) ----------------
     def _note_change(self, region_id: int, key: bytes, op: str, ts: int) -> None:
@@ -676,6 +912,7 @@ class MemStore:
     def prewrite(self, mutations: Sequence[Mutation], primary: bytes, start_ts: int) -> None:
         with self._mu:
             for m in mutations:
+                self._check_fence_key(m.key)
                 lock = self._locks.get(m.key)
                 if lock is not None and lock.start_ts != start_ts:
                     raise KeyLockedError(m.key, lock)
@@ -723,6 +960,7 @@ class MemStore:
             for key in keys:
                 while True:
                     with self._mu:
+                        self._check_fence_key(key)
                         lock = self._locks.get(key)
                         if lock is None or lock.start_ts == start_ts:
                             writes = self._writes.get(key)
@@ -764,6 +1002,7 @@ class MemStore:
         txn may still commit other keys)."""
         with self._mu:
             for k in keys:
+                self._check_fence_key(k)
                 lock = self._locks.get(k)
                 if lock is not None and lock.start_ts == start_ts and lock.op == OP_PESSIMISTIC_LOCK:
                     del self._locks[k]
@@ -773,6 +1012,10 @@ class MemStore:
         with self._mu:
             touched: set[int] = set()
             for k in keys:
+                # fenced table: this region moved (its locks moved WITH it,
+                # see migrate_export) — the typed refusal makes the client
+                # re-resolve placement and commit at the new owner
+                self._check_fence_key(k)
                 lock = self._locks.get(k)
                 if lock is None or lock.start_ts != start_ts:
                     # idempotent re-commit or lost lock
@@ -809,6 +1052,9 @@ class MemStore:
         with self._mu:
             start_ts = self.tso.ts()
             commit_ts = self.tso.ts()
+            if self._fences:
+                for k in keys:
+                    self._check_fence_key(k)
             if self._locks:
                 for k in keys:
                     if k in self._locks:
@@ -883,6 +1129,7 @@ class MemStore:
             if np.any(handles[:-1] == handles[1:]):
                 raise ValueError("ingest_columnar: duplicate handles")
         with self._mu:
+            self._check_fence_table(table_id)
             if on_existing is not None:
                 present = self._stable_present_locked(
                     table_id, handles, cols if on_existing == "verify" else None
@@ -952,6 +1199,7 @@ class MemStore:
     def stable_parts(self, table_id: int, kr: KeyRange, read_ts: int) -> list[tuple["StableBlock", int, int]]:
         """[(block, lo, hi)] index slices of stable rows with record keys in
         [kr) visible at ``read_ts``, in ingest order."""
+        self._check_fence_table(table_id)
         hlo, hhi = tablecodec.range_to_handles(kr, table_id)
         out = []
         with self._mu:
@@ -1008,6 +1256,7 @@ class MemStore:
     def rollback(self, keys: Sequence[bytes], start_ts: int) -> None:
         with self._mu:
             for k in keys:
+                self._check_fence_key(k)
                 lock = self._locks.get(k)
                 if lock is not None and lock.start_ts == start_ts:
                     del self._locks[k]
@@ -1017,6 +1266,10 @@ class MemStore:
         """→ ("committed", commit_ts) | ("rolled_back", 0) | ("locked", 0).
         (ref: unistore CheckTxnStatus; TTL expiry handled by caller policy)"""
         with self._mu:
+            # fenced primary: its lock/write state moved with the region —
+            # answering "rolled_back" from the stale copy could erase a
+            # commit that landed at the new owner; force the re-route
+            self._check_fence_key(primary)
             lock = self._locks.get(primary)
             if lock is not None and lock.start_ts == start_ts:
                 if lock.expired():
@@ -1139,6 +1392,7 @@ class MemStore:
 
     def raw_put(self, key: bytes, value: bytes) -> None:
         with self._mu:  # ts drawn under the lock keeps chains ascending
+            self._check_fence_key(key)
             ts = self.tso.ts()
             chain = self._writes.setdefault(key, [])
             if not chain and self._sorted is not None:
@@ -1160,6 +1414,7 @@ class MemStore:
         be absent). The catalog's cross-process DDL guard hangs off this —
         two read-then-write RPCs cannot serialize schema rewrites."""
         with self._mu:
+            self._check_fence_key(key)
             ts = self.tso.ts()
             cur = None
             chain = self._writes.get(key)
@@ -1185,6 +1440,7 @@ class MemStore:
 
     def raw_delete(self, key: bytes) -> None:
         with self._mu:
+            self._check_fence_key(key)
             ts = self.tso.ts()
             self._writes.setdefault(key, []).append(Write(ts, ts, OP_DEL))
             r = self.region_for_key(key)

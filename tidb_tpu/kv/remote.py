@@ -103,6 +103,55 @@ def _lock_from_pb(pb: dict) -> Lock:
     return Lock(_ub(pb["primary"]), pb["start_ts"], pb["op"], _ub(pb["value"]), pb["ttl_ms"], pb["created_ms"])
 
 
+def _migrate_items_blob(items) -> bytes:
+    """Pack migrate_export items: per item 1B op (0=put 1=del), 4B klen,
+    key, 8B vlen, value, 8B commit_ts, 8B start_ts."""
+    buf = bytearray()
+    for k, op, v, cts, sts in items:
+        buf += bytes([0 if op == OP_PUT else 1])
+        buf += struct.pack("<I", len(k)) + k
+        buf += struct.pack("<Q", len(v)) + v
+        buf += struct.pack("<QQ", cts, sts)
+    return bytes(buf)
+
+
+def _migrate_items_unpack(buf: bytes) -> list:
+    items = []
+    off = 0
+    while off < len(buf):
+        op = OP_PUT if buf[off] == 0 else OP_DEL
+        off += 1
+        (klen,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        k = buf[off : off + klen]
+        off += klen
+        (vlen,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        v = buf[off : off + vlen]
+        off += vlen
+        cts, sts = struct.unpack_from("<QQ", buf, off)
+        off += 16
+        items.append((k, op, v, cts, sts))
+    return items
+
+
+def _cursor_pb(cur):
+    """Migration cursor → JSON-able (dict-phase cursors carry a raw key)."""
+    if cur is None:
+        return None
+    if cur[0] == "dict":
+        return ["dict", _b(cur[1])]
+    return ["stable", cur[1], cur[2]]
+
+
+def _cursor_from_pb(pb):
+    if pb is None:
+        return None
+    if pb[0] == "dict":
+        return ("dict", _ub(pb[1]))
+    return ("stable", int(pb[1]), int(pb[2]))
+
+
 def sys_report(store=None, server=None, hist=None, sections=None) -> dict:
     """One process's introspection report — what the replay-safe
     ``sys_snapshot`` verb ships fleet-wide (ref: the gRPC coprocessor
@@ -292,6 +341,12 @@ class StoreServer:
                 header, blobs = _recv_frame(conn)
                 try:
                     reply, rblobs = self._dispatch(header, blobs)
+                except RegionError as e:
+                    # typed for EVERY verb (not just cop): a placement-fenced
+                    # table refuses reads/writes/commits with RegionMiss and
+                    # the client re-resolves routing under boRegionMiss —
+                    # never a Generic error, never an UndeterminedError
+                    reply, rblobs = {"err": "RegionMiss", "region_id": getattr(e, "region_id", -1)}, []
                 except KeyLockedError as e:
                     reply, rblobs = {"err": "KeyLocked", "key": _b(e.key), "lock": _lock_pb(e.lock)}, []
                 except WriteConflictError as e:
@@ -435,6 +490,47 @@ class StoreServer:
             return {"ok": 1}, []
         if cmd == "owner_term":
             return {"term": st.owner_term(h["key"])}, []
+        if cmd == "placement_propose":
+            # quorum placement replica verb (kv/placement.py): idempotent —
+            # re-proposing an accepted binding re-accepts, so replay-safe
+            ok, epoch = st.placement_propose(h["tid"], h["shard"], h["epoch"])
+            return {"ok": int(ok), "epoch": epoch}, []
+        if cmd == "placement_read":
+            if h.get("tid") is None:
+                recs = st.placement_read(None)
+                return {"recs": [[tid, e, s] for tid, e, s in recs]}, []
+            epoch, shard = st.placement_read(h["tid"])
+            return {"epoch": epoch, "shard": shard}, []
+        if cmd == "fence_table":
+            # placement cutover fence (idempotent → replay-safe): reads and
+            # writes of the table now answer RegionMiss until unfenced
+            st.fence_table(h["tid"], h.get("ttl_s"))
+            return {"ok": 1}, []
+        if cmd == "unfence_table":
+            st.unfence_table(h["tid"])
+            return {"ok": 1}, []
+        if cmd == "migrate_export":
+            # region-move page read (pure read → replay-safe)
+            page = st.migrate_export(
+                h["tid"], after_ts=h.get("after_ts", 0), upto_ts=h.get("upto_ts"),
+                cursor=_cursor_from_pb(h.get("cursor")), limit=h.get("limit", 4096),
+                include_locks=bool(h.get("locks")),
+            )
+            return {
+                "cursor": _cursor_pb(page["cursor"]),
+                "locks": [[_b(k), _lock_pb(l)] for k, l in page["locks"]],
+            }, [_migrate_items_blob(page["items"])]
+        if cmd == "migrate_region":
+            # region-move apply (idempotent per (key, commit_ts) → replay-
+            # safe): installs migrated versions + in-flight prewrite locks
+            n = st.migrate_apply(
+                _migrate_items_unpack(blobs[0]) if blobs else [],
+                [(_ub(k), _lock_from_pb(l)) for k, l in h.get("locks", ())],
+            )
+            return {"applied": n}, []
+        if cmd == "purge_table":
+            st.purge_table(h["tid"])
+            return {"ok": 1}, []
         if cmd == "election_propose":
             # quorum election replica verb (kv/election.py): idempotent —
             # re-proposing an accepted record re-accepts, so replay-safe
@@ -1138,11 +1234,20 @@ class RemoteStore:
         except ConnectionError:
             pass  # the server's dispatch-time sweep reclaims it
         if h.get("err_kind"):
-            from tidb_tpu.parallel.probe import MPPRetryExhausted
+            from tidb_tpu.parallel.probe import MPPRetryExhausted, MPPTaskLostError
             from tidb_tpu.utils.memory import QueryKilledError, QueryOOMError
 
+            if h["err_kind"] == "RegionError":
+                # the server's gather hit a placement fence (the table moved
+                # mid-dispatch): typed so the gather re-resolves placement
+                # and re-dispatches to the new owner (kv/placement.py)
+                raise RegionError(-1, f"remote mpp task failed: {h['msg']}")
             kinds = {
                 "MPPRetryExhausted": MPPRetryExhausted,
+                # the server no longer knows this task (it restarted between
+                # dispatch and conn): the gather re-dispatches — the
+                # client-go mpp_probe lost-task recovery idiom
+                "MPPTaskLost": MPPTaskLostError,
                 "QueryKilledError": QueryKilledError,
                 "QueryOOMError": QueryOOMError,
             }
@@ -1183,6 +1288,54 @@ class RemoteStore:
 
     def owner_term(self, key: str) -> int:
         return self._call({"cmd": "owner_term", "key": key})[0]["term"]
+
+    # -- quorum placement replica verbs + region-move verbs (kv/placement.py:
+    # this server hosts one replica of the fleet's placement keyspace and
+    # serves region migration; every verb here is replay-safe — proposes and
+    # applies are idempotent, exports and fences are pure/absorbing) --------
+    def placement_propose(self, table_id: int, shard: int, epoch: int):
+        h, _ = self._call(
+            {"cmd": "placement_propose", "tid": table_id, "shard": shard, "epoch": epoch}
+        )
+        return bool(h["ok"]), h["epoch"]
+
+    def placement_read(self, table_id: Optional[int] = None):
+        if table_id is None:
+            h, _ = self._call({"cmd": "placement_read", "tid": None})
+            return [(tid, e, s) for tid, e, s in h["recs"]]
+        h, _ = self._call({"cmd": "placement_read", "tid": table_id})
+        return h["epoch"], h["shard"]
+
+    def fence_table(self, table_id: int, ttl_s: Optional[float] = None) -> None:
+        self._call({"cmd": "fence_table", "tid": table_id, "ttl_s": ttl_s})
+
+    def unfence_table(self, table_id: int) -> None:
+        self._call({"cmd": "unfence_table", "tid": table_id})
+
+    def migrate_export(self, table_id: int, after_ts: int = 0, upto_ts: Optional[int] = None,
+                       cursor=None, limit: int = 4096, include_locks: bool = False) -> dict:
+        h, blobs = self._call(
+            {
+                "cmd": "migrate_export", "tid": table_id, "after_ts": after_ts,
+                "upto_ts": upto_ts, "cursor": _cursor_pb(cursor), "limit": limit,
+                "locks": int(include_locks),
+            }
+        )
+        return {
+            "items": _migrate_items_unpack(blobs[0]) if blobs else [],
+            "locks": [(_ub(k), _lock_from_pb(l)) for k, l in h.get("locks", ())],
+            "cursor": _cursor_from_pb(h.get("cursor")),
+        }
+
+    def migrate_apply(self, items, locks=()) -> int:
+        h, _ = self._call(
+            {"cmd": "migrate_region", "locks": [[_b(k), _lock_pb(l)] for k, l in locks]},
+            [_migrate_items_blob(items)],
+        )
+        return h["applied"]
+
+    def purge_table(self, table_id: int) -> None:
+        self._call({"cmd": "purge_table", "tid": table_id})
 
     # -- quorum election replica verbs (kv/election.py: this server hosts one
     # replica of the fleet's election keyspace; both verbs are replay-safe) --

@@ -38,9 +38,16 @@ import time
 from typing import Optional, Sequence
 
 from tidb_tpu.kv import tablecodec
-from tidb_tpu.kv.kv import KeyRange, Request, RequestType, TxnAbortedError, UndeterminedError
+from tidb_tpu.kv.kv import (
+    KeyRange,
+    RegionError,
+    Request,
+    RequestType,
+    TxnAbortedError,
+    UndeterminedError,
+)
 from tidb_tpu.kv.memstore import Lock, Mutation
-from tidb_tpu.utils.backoff import Backoffer, BackoffExhausted, boStoreDown
+from tidb_tpu.utils.backoff import Backoffer, BackoffExhausted, boRegionMiss, boStoreDown
 
 
 class _FailoverTSO:
@@ -66,13 +73,28 @@ class _FailoverDetector:
 
 class _ShardedPD:
     """Region lookup across shards: each owner answers for its own ranges;
-    region ids are namespaced by shard so two stores' region 1s never
-    collide (ref: PD's globally-unique region ids)."""
+    region ids are namespaced by shard AND the table's placement epoch so
+    two stores' region 1s never collide — and a MIGRATED region's id never
+    collides with the old owner's cached copy of it (fresh ids minted from
+    the epoch, not just bit-packed shard indices: a consumer keying caches
+    or routing state off the namespaced id sees a new identity after every
+    move, ref: PD bumping RegionEpoch.version on transfer)."""
 
     _SHARD_BITS = 48
+    _EPOCH_BITS = 56
 
     def __init__(self, store: "ShardedStore"):
         self._store = store
+
+    def _mint(self, region_id: int, si: int, krs) -> int:
+        epoch = 0
+        if krs:
+            k = krs[0].start
+            if ShardedStore.is_table_key(k):
+                from tidb_tpu.utils import codec
+
+                epoch = self._store.placement_epoch(codec.decode_int_raw(k, 1))
+        return region_id | (si << self._SHARD_BITS) | (epoch << self._EPOCH_BITS)
 
     def regions_in_ranges(self, ranges: Sequence[KeyRange]):
         import copy as _copy
@@ -84,7 +106,7 @@ class _ShardedPD:
                 # Region objects, and mutating those would corrupt the
                 # store's own metadata (cache keys, plan-cache versions)
                 r2 = _copy.copy(region)
-                r2.region_id = region.region_id | (si << self._SHARD_BITS)
+                r2.region_id = self._mint(region.region_id, si, krs)
                 out.append((r2, krs))
         return out
 
@@ -100,7 +122,12 @@ class _ShardedSnapshot:
             return self._store._authority_call(
                 lambda st: st.get_snapshot(self.read_ts).get(key)
             )
-        return self._store.store_for_key(key).get_snapshot(self.read_ts).get(key)
+        # placement-routed read: a fenced ex-owner (the region moved) answers
+        # RegionError → re-resolve placement and retry at the new owner
+        return self._store._routed(
+            "snap_get",
+            lambda: self._store.store_for_key(key).get_snapshot(self.read_ts).get(key),
+        )
 
     def scan(self, kr: KeyRange, limit: int = 2**63, reverse: bool = False):
         if not ShardedStore.is_table_key(kr.start):
@@ -109,51 +136,111 @@ class _ShardedSnapshot:
             return self._store._authority_call(
                 lambda st: st.get_snapshot(self.read_ts).scan(kr, limit=limit, reverse=reverse)
             )
-        one = self._store.single_owner(kr)
-        if one is not None:
-            # the whole range lives on one owner (the common per-table scan):
-            # no reason to pay N-1 always-empty fan-out RPCs
-            return self._store.stores[one].get_snapshot(self.read_ts).scan(
-                kr, limit=limit, reverse=reverse
-            )
-        outs = []
-        for s in self._store.stores:
-            outs.extend(s.get_snapshot(self.read_ts).scan(kr, limit=limit, reverse=reverse))
-        outs.sort(key=lambda kv: kv[0], reverse=reverse)
-        return outs[:limit] if limit < 2**62 else outs
+
+        def run():
+            one = self._store.single_owner(kr)
+            if one is not None:
+                # the whole range lives on one owner (the common per-table
+                # scan): no reason to pay N-1 always-empty fan-out RPCs
+                return self._store.stores[one].get_snapshot(self.read_ts).scan(
+                    kr, limit=limit, reverse=reverse
+                )
+            outs = []
+            for s in self._store.stores:
+                outs.extend(s.get_snapshot(self.read_ts).scan(kr, limit=limit, reverse=reverse))
+            outs.sort(key=lambda kv: kv[0], reverse=reverse)
+            return outs[:limit] if limit < 2**62 else outs
+
+        return self._store._routed("snap_scan", run)
 
 
 class _ShardedCopClient:
     """Cop fan-out per range OWNER: consecutive same-owner ranges form one
     sub-request served by that store's own cop client; segment results are
-    emitted in range order so keep-order semantics survive the split."""
+    emitted in range order so keep-order semantics survive the split.
+
+    Placement-aware: a RegionError (the fenced ex-owner of a MOVED table
+    refusing the scan) or a dead owner re-resolves placement and
+    re-dispatches the segment's ranges to whoever owns them now — the cop
+    half of the boRegionMiss re-route. Both clients raise the fence verdict
+    EAGERLY in send() (region resolution runs before any task), so the
+    re-route fires before a single result streams; the rare mid-stream move
+    (results already yielded when the error lands) surfaces typed instead —
+    a silent retry there would duplicate rows. The happy path keeps the
+    pre-placement streaming + cancel semantics (a satisfied LIMIT still
+    cancels pending region tasks)."""
 
     def __init__(self, store: "ShardedStore"):
         self.store = store
+
+    def _dispatch(self, req: Request, si: int, sub, subs: list):
+        """Start one segment's sub-request; a synchronous refusal (the
+        eager fence verdict) comes back as the exception VALUE so the
+        consumer's re-route handler deals with it at consumption time."""
+        try:
+            resp = self.store.stores[si].get_client().send(self._sub(req, sub))
+            subs.append(resp)
+            return resp
+        except (RegionError, ConnectionError) as e:
+            return e
+
+    def _consume(self, req: Request, si: int, sub, attempt, bo: Backoffer, subs: list):
+        """Drain one segment's CopResults (a generator), re-routing on
+        placement moves while nothing has streamed yet."""
+        from tidb_tpu.utils import metrics as _m
+
+        while True:
+            yielded = False
+            try:
+                if isinstance(attempt, Exception):
+                    raise attempt
+                for res in attempt:
+                    yielded = True
+                    yield res
+                return
+            except (RegionError, ConnectionError) as e:
+                if yielded:
+                    raise  # mid-stream move: typed, never silently re-read
+                moved = self.store.placement_refresh()
+                if isinstance(e, ConnectionError) and not moved:
+                    raise  # dead owner and the region did not move: typed
+                try:
+                    bo.backoff(boRegionMiss, e)
+                except BackoffExhausted:
+                    raise e from None
+                _m.PLACEMENT_REROUTE.inc(verb="cop")
+                regrouped = self.store.group_ranges(sub, consecutive=True)
+                if len(regrouped) == 1:
+                    si, sub = regrouped[0]
+                    attempt = self._dispatch(req, si, sub, subs)
+                    continue
+                # the refresh split this segment across owners
+                for si2, sub2 in regrouped:
+                    yield from self._consume(
+                        req, si2, sub2, self._dispatch(req, si2, sub2, subs), bo, subs
+                    )
+                return
 
     def send(self, req: Request):
         from tidb_tpu.copr.client import CopResponse
 
         assert req.tp == RequestType.DAG
         segments = self.store.group_ranges(req.ranges, consecutive=True)
-        if len(segments) == 1:
-            si, sub = segments[0]
-            return self.store.stores[si].get_client().send(self._sub(req, sub))
-        responses = [
-            self.store.stores[si].get_client().send(self._sub(req, sub))
-            for si, sub in segments
-        ]
+        bo = Backoffer(budget_ms=2000)
+        subs: list = []  # live sub-responses, for early-exit cancellation
 
         def cancel():
-            for resp in responses:
-                resp.close()
+            for r in subs:
+                r.close()
+
+        # every segment dispatches EAGERLY (the stores start their cop work
+        # concurrently, as before placement); results drain in range order
+        started = [(si, sub, self._dispatch(req, si, sub, subs)) for si, sub in segments]
 
         def gen():
             try:
-                for resp in responses:
-                    # CopResponse is an iterator of CopResults (it has no
-                    # .results attribute — iterating is the contract)
-                    yield from resp
+                for si, sub, attempt in started:
+                    yield from self._consume(req, si, sub, attempt, bo, subs)
             finally:
                 cancel()
 
@@ -200,6 +287,18 @@ class ShardedStore:
         from tidb_tpu.kv.election import QuorumElection
 
         self.election = QuorumElection(self.stores, lease_s=_config.current().owner_lease_s)
+        # elastic placement (kv/placement.py): epoch-versioned movable
+        # table→shard bindings, quorum-replicated like the election keyspace.
+        # The cached map serves the hot routing path; a RegionError from a
+        # fenced ex-owner triggers placement_refresh — the boRegionMiss
+        # re-resolve. Explicit constructor placement seeds at epoch 0.
+        from tidb_tpu.kv.placement import PlacementClient
+
+        self.placement_cache = PlacementClient(self.stores, explicit=self.placement)
+        # returning-replica anti-entropy: a shard that answers after being
+        # marked down gets the majority's meta/election/placement records
+        # replayed onto it BEFORE its votes count again (PR-2's carried gap)
+        self.election.catchup_fn = self._replica_catchup
 
     @property
     def quorum(self) -> int:
@@ -278,10 +377,126 @@ class ShardedStore:
 
     # -- placement ----------------------------------------------------------
     def shard_of_table(self, table_id: int) -> int:
-        got = self.placement.get(table_id)
+        """Owner shard for a table: the cached placement map (quorum
+        bindings + explicit constructor pins) first, the stable hash for
+        tables no migration ever touched."""
+        got = self.placement_cache.shard_of(table_id)
         if got is not None:
             return got % len(self.stores)
         return table_id % len(self.stores)
+
+    # the PD-client naming twin (routing callers say "owner", admin says
+    # "shard"); one implementation
+    owner_for = shard_of_table
+
+    def placement_epoch(self, table_id: int) -> int:
+        """The table's current placement epoch as this client has observed
+        it (0 = never moved)."""
+        return self.placement_cache.epoch_of(table_id)
+
+    def placement_refresh(self) -> bool:
+        """Re-resolve the placement map from a majority — what a routing
+        caller runs after RegionError (fenced ex-owner) or after a dead
+        owner (did the region move away before the store died?). False when
+        nothing changed or the keyspace is below quorum (the stale cache
+        keeps serving — it may still be right)."""
+        try:
+            return self.placement_cache.refresh()
+        except ConnectionError:
+            return False
+
+    def placement_snapshot(self) -> dict:
+        """Bindings + epochs + in-flight moves for the cluster_placement
+        memtable; refreshes from the fleet first (best-effort) so the rows
+        show quorum truth, not just this client's cache."""
+        self.placement_refresh()
+        return self.placement_cache.snapshot()
+
+    def migrate_table(self, table_id: int, dst: int, **kw) -> dict:
+        """Move one table's region to shard ``dst`` (kv/placement.py
+        migrate_table): snapshot copy + change catch-up + fenced epoch-bump
+        cutover; in-flight 2PC locks move with the region."""
+        from tidb_tpu.kv.placement import migrate_table as _migrate
+
+        return _migrate(self, table_id, dst, **kw)
+
+    def _routed(self, verb: str, fn, conn_reroute: bool = True):
+        """Run a placement-routed operation with epoch-mismatch recovery:
+        ``fn`` recomputes its routing from the cached map on every attempt,
+        so after a RegionError (the fenced ex-owner's refusal) a
+        placement_refresh re-routes the retry to the new owner — the
+        boRegionMiss loop, applied to DATA verbs, which is what lets 2PC
+        re-route mid-txn when a region moves between prewrite and commit.
+        A ConnectionError (dead owner) retries only when the refresh
+        actually moved something (``conn_reroute``; commit keeps its
+        undetermined-result semantics and never re-routes on a dead wire)."""
+        from tidb_tpu.utils import metrics as _m
+
+        bo = Backoffer(budget_ms=2000)
+        while True:
+            try:
+                return fn()
+            except RegionError as e:
+                self.placement_refresh()
+                try:
+                    bo.backoff(boRegionMiss, e)
+                except BackoffExhausted:
+                    raise e from None
+                _m.PLACEMENT_REROUTE.inc(verb=verb)
+            except ConnectionError as e:
+                if not conn_reroute or not self.placement_refresh():
+                    raise
+                try:
+                    bo.backoff(boRegionMiss, e)
+                except BackoffExhausted:
+                    raise
+                _m.PLACEMENT_REROUTE.inc(verb=verb)
+
+    def _replica_catchup(self, si: int) -> None:
+        """Anti-entropy for a RETURNING replica (killed → restarted empty):
+        replay the meta keyspace from a healthy peer plus the majority's
+        election and placement records onto shard ``si`` before its votes
+        count toward quorum again. Best-effort — a failure here leaves the
+        shard to lazy read-repair, exactly the pre-catchup behavior."""
+        from tidb_tpu.utils import metrics as _m
+
+        st = self.stores[si]
+        # 1. meta keyspace (catalog / DDL jobs / sysvars replicate to every
+        #    shard): scan from the first healthy peer that is NOT the
+        #    returner — its own blank copy must not be the source. Replay
+        #    ONLY the keys the returner is MISSING: a shard that merely
+        #    flapped (data intact, possibly NEWER than the source peer,
+        #    which may itself have missed a tolerated-minority write) must
+        #    not have stale values re-stamped over it at fresh timestamps —
+        #    divergence on present keys stays with the lazy read-repair
+        #    path, exactly as before this hook existed.
+        pairs = None
+        for j in range(len(self.stores)):
+            if j == si:
+                continue
+            try:
+                pairs = self.stores[j].raw_scan(KeyRange(b"", tablecodec.TABLE_PREFIX))
+                break
+            except ConnectionError:
+                continue
+        if pairs is not None:
+            for k, v in pairs:
+                if st.raw_get(k) is None:
+                    st.raw_put(k, v)
+        # 2. election records: the majority-resolved record per seen key
+        #    (the replica accept rule keeps the higher term)
+        with self.election._mu:
+            keys = list(self.election._seen_terms)
+        for key in keys:
+            try:
+                term, owner, deadline = self.election._read_majority(key)
+            except ConnectionError:
+                break
+            if term > 0 and owner is not None:
+                st.election_propose(key, owner, term, deadline)
+        # 3. placement bindings (epoch accept rule keeps the higher epoch)
+        self.placement_cache.repair_replica(si)
+        _m.META_CATCHUP.inc()
 
     @staticmethod
     def is_table_key(key: bytes) -> bool:
@@ -347,7 +562,7 @@ class ShardedStore:
     def raw_get(self, key: bytes):
         if not self.is_table_key(key):
             return self._authority_call(lambda st: st.raw_get(key))
-        return self.store_for_key(key).raw_get(key)
+        return self._routed("raw_get", lambda: self.store_for_key(key).raw_get(key))
 
     def _meta_quorum_check(self, errs: list) -> None:
         """Replicated meta writes need a MAJORITY of replicas, not all of
@@ -385,7 +600,7 @@ class ShardedStore:
     def raw_put(self, key: bytes, value: bytes) -> None:
         shards = self.write_shards(key)
         if len(shards) == 1:
-            self.stores[shards[0]].raw_put(key, value)
+            self._routed("raw_put", lambda: self.store_for_key(key).raw_put(key, value))
             return
         self._fanout_tolerant(
             [(si, None) for si in shards],
@@ -396,7 +611,7 @@ class ShardedStore:
     def raw_delete(self, key: bytes) -> None:
         shards = self.write_shards(key)
         if len(shards) == 1:
-            self.stores[shards[0]].raw_delete(key)
+            self._routed("raw_delete", lambda: self.store_for_key(key).raw_delete(key))
             return
         self._fanout_tolerant(
             [(si, None) for si in shards],
@@ -410,7 +625,10 @@ class ShardedStore:
         # dead shard 0 no longer wedges catalog version bumps.
         shards = self.write_shards(key)
         if len(shards) == 1:
-            return self.stores[shards[0]].raw_cas(key, expected, value)
+            return self._routed(
+                "raw_cas", lambda: self.store_for_key(key).raw_cas(key, expected, value),
+                conn_reroute=False,  # CAS shares commit's replay hazard
+            )
         ok = self._authority_call(lambda st: st.raw_cas(key, expected, value))
         if ok:
             decider = self._auth_idx
@@ -427,14 +645,17 @@ class ShardedStore:
             # shard's copy of the same row); the authority first, survivors
             # on store-down
             return self._authority_call(lambda st: st.raw_scan(kr, limit=limit))
-        one = self.single_owner(kr)
-        if one is not None:
-            return self.stores[one].raw_scan(kr, limit=limit)
-        outs = []
-        for s in self.stores:
-            outs.extend(s.raw_scan(kr, limit=limit))
-        outs.sort(key=lambda kv: kv[0])
-        return outs[:limit]
+        def run():
+            one = self.single_owner(kr)
+            if one is not None:
+                return self.stores[one].raw_scan(kr, limit=limit)
+            outs = []
+            for s in self.stores:
+                outs.extend(s.raw_scan(kr, limit=limit))
+            outs.sort(key=lambda kv: kv[0])
+            return outs[:limit]
+
+        return self._routed("raw_scan", run)
 
     def run_gc(self, safe_point=None, life_ms: int = 600_000):
         pruned = 0
@@ -449,12 +670,18 @@ class ShardedStore:
         return _ShardedSnapshot(self, ts)
 
     def snap_batch_get(self, pairs) -> list:
-        """Batched snapshot point reads across the fleet: table keys group
-        by their owner shard and ride that shard's own batched verb (one
-        RPC per remote shard per flush), outcomes scatter back in request
-        order. Failures stay per-key/per-shard OUTCOMES — a dead shard or a
-        locked key fails only its own sessions' reads, never the strangers
-        coalesced into the same batch."""
+        """Batched snapshot point reads across the fleet — placement-routed:
+        a RegionError from a fenced ex-owner re-resolves and re-dispatches
+        the whole (idempotent) batch at the new owners."""
+        return self._routed("snap_batch_get", lambda: self._snap_batch_get_once(pairs))
+
+    def _snap_batch_get_once(self, pairs) -> list:
+        """One batched dispatch: table keys group by their owner shard and
+        ride that shard's own batched verb (one RPC per remote shard per
+        flush), outcomes scatter back in request order. Failures stay
+        per-key/per-shard OUTCOMES — a dead shard or a locked key fails
+        only its own sessions' reads, never the strangers coalesced into
+        the same batch."""
         from tidb_tpu.kv.kv import KeyLockedError
 
         out: list = [None] * len(pairs)
@@ -508,17 +735,38 @@ class ShardedStore:
         return by.items()
 
     def prewrite(self, mutations: Sequence[Mutation], primary: bytes, start_ts: int) -> None:
-        by: dict[int, list] = {}
-        for m in mutations:
-            for si in self.write_shards(m.key):
-                by.setdefault(si, []).append(m)
-        self._fanout_tolerant(
-            by.items(),
-            lambda si, muts: self.stores[si].prewrite(muts, primary, start_ts),
-            lambda muts: all(not self.is_table_key(m.key) for m in muts),
-        )
+        # placement-routed: the grouping recomputes per attempt, so a
+        # region that moved between two attempts re-routes (prewrite is
+        # idempotent under one start_ts — re-sending to the new owner is
+        # safe even when an earlier shard already holds its locks)
+        def once():
+            by: dict[int, list] = {}
+            for m in mutations:
+                for si in self.write_shards(m.key):
+                    by.setdefault(si, []).append(m)
+            self._fanout_tolerant(
+                by.items(),
+                lambda si, muts: self.stores[si].prewrite(muts, primary, start_ts),
+                lambda muts: all(not self.is_table_key(m.key) for m in muts),
+            )
+
+        self._routed("prewrite", once)
 
     def commit(self, keys: Sequence[bytes], start_ts: int, commit_ts: int) -> None:
+        # placement-routed on the TYPED refusal only: a fenced ex-owner
+        # rejects the commit before touching state (its locks moved with
+        # the region), so re-routing to the new owner — where the migrated
+        # lock waits — is safe, and an idempotent re-commit of shards that
+        # already applied is a no-op. A dead wire keeps the undetermined-
+        # result semantics (conn_reroute=False): re-sending a commit whose
+        # fate is unknown could double-decide.
+        self._routed(
+            "commit",
+            lambda: self._commit_once(keys, start_ts, commit_ts),
+            conn_reroute=False,
+        )
+
+    def _commit_once(self, keys: Sequence[bytes], start_ts: int, commit_ts: int) -> None:
         committed: list[int] = []
         meta_errs: list = []
         groups = list(self._group_keys(keys))
@@ -573,10 +821,13 @@ class ShardedStore:
             _m.STORE_FAILOVER.inc(n=len(meta_errs), kind="meta_write")
 
     def rollback(self, keys: Sequence[bytes], start_ts: int) -> None:
-        self._fanout_tolerant(
-            self._group_keys(keys),
-            lambda si, ks: self.stores[si].rollback(ks, start_ts),
-            lambda ks: all(not self.is_table_key(k) for k in ks),
+        self._routed(
+            "rollback",
+            lambda: self._fanout_tolerant(
+                self._group_keys(keys),
+                lambda si, ks: self.stores[si].rollback(ks, start_ts),
+                lambda ks: all(not self.is_table_key(k) for k in ks),
+            ),
         )
 
     def check_txn_status(self, primary: bytes, start_ts: int):
@@ -585,56 +836,88 @@ class ShardedStore:
             # authority order picks it (a dead shard 0 must not wedge
             # cross-shard lock resolution)
             return self._authority_call(lambda st: st.check_txn_status(primary, start_ts))
-        return self.store_for_key(primary).check_txn_status(primary, start_ts)
+        # placement-routed: a fenced ex-owner must not answer "rolled_back"
+        # from its stale copy — the truth (the migrated lock or the applied
+        # commit) lives at the new owner
+        return self._routed(
+            "check_txn_status",
+            lambda: self.store_for_key(primary).check_txn_status(primary, start_ts),
+        )
 
     def resolve_lock(self, key: bytes, lock: Lock) -> None:
-        key_shard = self.shard_of_key(key)
-        primary_shard = self.shard_of_key(lock.primary)
-        if key_shard == primary_shard and self.is_table_key(key):
-            self.stores[key_shard].resolve_lock(key, lock)
-            return
-        # cross-shard (or replicated meta): the primary's owner is the source
-        # of truth; commit/rollback route back through the quorum-aware verbs
-        status, commit_ts = self.check_txn_status(lock.primary, lock.start_ts)
-        if status == "committed":
-            self.commit([key], lock.start_ts, commit_ts)
-        elif status == "rolled_back":
-            self.rollback([key], lock.start_ts)
-        # "locked": primary still alive → caller backs off and retries
+        def once():
+            key_shard = self.shard_of_key(key)
+            primary_shard = self.shard_of_key(lock.primary)
+            if key_shard == primary_shard and self.is_table_key(key):
+                self.stores[key_shard].resolve_lock(key, lock)
+                return
+            # cross-shard (or replicated meta): the primary's owner is the
+            # source of truth; commit/rollback route back through the
+            # quorum-aware verbs
+            status, commit_ts = self.check_txn_status(lock.primary, lock.start_ts)
+            if status == "committed":
+                self.commit([key], lock.start_ts, commit_ts)
+            elif status == "rolled_back":
+                self.rollback([key], lock.start_ts)
+            # "locked": primary still alive → caller backs off and retries
+
+        self._routed("resolve_lock", once)
 
     def acquire_pessimistic_lock(self, keys, primary, start_ts, for_update_ts, wait_timeout_ms=3000):
-        by: dict[int, list] = {}
-        for k in keys:
-            by.setdefault(self.shard_of_key(k), []).append(k)
-        for si, ks in by.items():
-            self.stores[si].acquire_pessimistic_lock(ks, primary, start_ts, for_update_ts, wait_timeout_ms)
+        def once():
+            by: dict[int, list] = {}
+            for k in keys:
+                by.setdefault(self.shard_of_key(k), []).append(k)
+            for si, ks in by.items():
+                self.stores[si].acquire_pessimistic_lock(
+                    ks, primary, start_ts, for_update_ts, wait_timeout_ms
+                )
+
+        self._routed("acquire_lock", once)
 
     def pessimistic_rollback(self, keys: Sequence[bytes], start_ts: int) -> None:
-        self._fanout_tolerant(
-            self._group_keys(keys),
-            lambda si, ks: self.stores[si].pessimistic_rollback(ks, start_ts),
-            lambda ks: all(not self.is_table_key(k) for k in ks),
+        self._routed(
+            "pessimistic_rollback",
+            lambda: self._fanout_tolerant(
+                self._group_keys(keys),
+                lambda si, ks: self.stores[si].pessimistic_rollback(ks, start_ts),
+                lambda ks: all(not self.is_table_key(k) for k in ks),
+            ),
         )
 
     # -- bulk ingest --------------------------------------------------------
     def ingest(self, keys: Sequence[bytes], values: Sequence[bytes]) -> int:
-        by: dict[int, tuple[list, list]] = {}
-        for k, v in zip(keys, values):
-            e = by.setdefault(self.shard_of_key(k), ([], []))
-            e[0].append(k)
-            e[1].append(v)
-        ts = 0
-        for si, (ks, vs) in by.items():
-            ts = max(ts, self.stores[si].ingest(ks, vs))
-        return ts
+        # NOT re-routed on ConnectionError: ingest mints a fresh commit_ts
+        # per call, so a replay could double rows (same rule as the wire
+        # layer's _NON_REPLAYABLE); a typed RegionError still re-routes —
+        # the fenced store refused before ingesting anything
+        def once():
+            by: dict[int, tuple[list, list]] = {}
+            for k, v in zip(keys, values):
+                e = by.setdefault(self.shard_of_key(k), ([], []))
+                e[0].append(k)
+                e[1].append(v)
+            ts = 0
+            for si, (ks, vs) in by.items():
+                ts = max(ts, self.stores[si].ingest(ks, vs))
+            return ts
+
+        return self._routed("ingest", once, conn_reroute=False)
 
     def ingest_columnar(self, table_id: int, handles, cols, schema, dicts=None, on_existing=None) -> int:
-        return self.stores[self.shard_of_table(table_id)].ingest_columnar(
-            table_id, handles, cols, schema, dicts, on_existing
+        return self._routed(
+            "ingest_columnar",
+            lambda: self.stores[self.shard_of_table(table_id)].ingest_columnar(
+                table_id, handles, cols, schema, dicts, on_existing
+            ),
+            conn_reroute=False,
         )
 
     def drop_stable(self, table_id: int) -> None:
-        self.stores[self.shard_of_table(table_id)].drop_stable(table_id)
+        self._routed(
+            "drop_stable",
+            lambda: self.stores[self.shard_of_table(table_id)].drop_stable(table_id),
+        )
 
     # -- owner election: quorum-replicated with fenced leases (kv/election.py,
     # the PD/etcd analog). campaign/renew/resign are majority writes carrying
@@ -720,15 +1003,26 @@ class ShardedStore:
         return self.stores[0].mpp_ndev()
 
     def _mpp_owner(self, spec: dict) -> int:
-        owners = {self.shard_of_table(r["tid"]) for r in spec.get("readers", [])}
-        if len(owners) != 1:
+        def tid_of(r: dict) -> int:
+            # subplan readers nest their table reader under "sub"
+            return r["sub"]["reader"]["tid"] if "sub" in r else r["tid"]
+
+        def owners() -> set[int]:
+            return {self.shard_of_table(tid_of(r)) for r in spec.get("readers", [])}
+
+        got = owners()
+        if len(got) != 1 and self.placement_refresh():
+            # a stale map can claim a straddle right after a co-locating
+            # migration — re-resolve once before giving up on MPP
+            got = owners()
+        if len(got) != 1:
             from tidb_tpu.parallel.probe import MPPRetryExhausted
 
             raise MPPRetryExhausted(
-                f"MPP gather reads tables on {len(owners)} store shards; "
+                f"MPP gather reads tables on {len(got)} store shards; "
                 "single-owner placement required (falls back to cop + host join)"
             )
-        return owners.pop()
+        return got.pop()
 
     def mpp_dispatch(self, spec: dict, read_ts: int, **kw) -> str:
         owner = self._mpp_owner(spec)

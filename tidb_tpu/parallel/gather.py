@@ -1223,6 +1223,21 @@ class MPPGatherExec:
         store_addr = f"{getattr(store, 'host', 'shard')}:{getattr(store, 'port', '?')}"
         exec_pb: list = []
         t0 = _t.perf_counter()
+        # placement-aware task-level retry (the client-go mpp_probe recovery
+        # idiom): a lost task (server restarted), a fenced owner (the table
+        # MOVED mid-query), or a dead owner whose region moved away all
+        # RE-DISPATCH the fragment to the surviving/new owner instead of
+        # failing the whole gather. The dispatch unit here IS the gather
+        # (one fragment program), so re-dispatch = one fresh mpp_dispatch
+        # after a placement refresh; a dead owner whose region did NOT move
+        # has no surviving copy to serve it — that exhausts as
+        # MPPRetryExhausted and the session re-plans without MPP.
+        from tidb_tpu.kv.kv import RegionError
+        from tidb_tpu.parallel.probe import MPPTaskLostError, gather_backoffer
+        from tidb_tpu.utils.backoff import BackoffExhausted, boMPP
+
+        bo = gather_backoffer()
+        redispatches = 0
         # the dispatch+conn pair runs under ONE client span; the server's
         # task session records its own spans under the propagated context
         # and they graft in here, tagged with the store that recorded them
@@ -1230,7 +1245,6 @@ class MPPGatherExec:
             # the trace kwarg only appears when tracing is ON — untraced
             # dispatch keeps the plain (spec, read_ts) signature
             kw = {"trace": tr.context().to_pb()} if tr is not None else {}
-            task_id = store.mpp_dispatch(spec, sess.read_ts(), **kw)
 
             def on_exec(e, spans):
                 if e:
@@ -1238,10 +1252,35 @@ class MPPGatherExec:
                 if spans and tr is not None:
                     tr.merge_remote(spans, base_s=sp.start_s, node=store_addr, depth=sp.depth + 1)
 
-            chunk = store.mpp_conn(
-                task_id, check_killed=sess.check_killed, warn=sess.append_warning,
-                on_exec=on_exec,
-            )
+            while True:
+                try:
+                    task_id = store.mpp_dispatch(spec, sess.read_ts(), **kw)
+                    chunk = store.mpp_conn(
+                        task_id, check_killed=sess.check_killed, warn=sess.append_warning,
+                        on_exec=on_exec,
+                    )
+                    break
+                except (ConnectionError, RegionError, MPPTaskLostError) as exc:
+                    refresh = getattr(store, "placement_refresh", None)
+                    moved = bool(refresh()) if refresh is not None else False
+                    if isinstance(exc, ConnectionError) and not moved:
+                        # dead owner, region did not move: no surviving
+                        # owner can serve this fragment's data
+                        raise MPPRetryExhausted(
+                            f"remote MPP owner unreachable and its regions did "
+                            f"not move: {exc}"
+                        ) from exc
+                    try:
+                        bo.backoff(boMPP, exc)
+                    except BackoffExhausted as be:
+                        raise MPPRetryExhausted(
+                            f"mpp re-dispatch budget exhausted after "
+                            f"{be.attempts} attempts: {exc}"
+                        ) from exc
+                    redispatches += 1
+                    from tidb_tpu.utils import metrics as _m
+
+                    _m.PLACEMENT_REROUTE.inc(verb="mpp_dispatch")
         e = exec_pb[0] if exec_pb else {}
         sess.record_mpp_detail(
             self.plan,
@@ -1250,7 +1289,7 @@ class MPPGatherExec:
                 ndev=int(e.get("ndev", 0)),
                 wall_ms=float(e.get("wall_ms", (_t.perf_counter() - t0) * 1000.0)),
                 rows=len(chunk),
-                retries=int(e.get("retries", 0)),
+                retries=int(e.get("retries", 0)) + redispatches,
                 store=store_addr,
                 # per-shard breakdown recorded by the SERVER's shard probes
                 # (the mesh lives there) — ships home in the exec sidecar

@@ -373,7 +373,11 @@ class MPPTaskManager:
         with self._mu:
             task = self._tasks.get(task_id)
         if task is None:
-            return True, None, "ValueError", f"unknown mpp task {task_id}", (), None, None
+            # typed as MPPTaskLost (not a generic error): a server that
+            # restarted between dispatch and conn — or reclaimed the task —
+            # tells the gather to RE-DISPATCH rather than fail the query
+            # (the client-go mpp_probe lost-task recovery idiom)
+            return True, None, "MPPTaskLost", f"unknown mpp task {task_id}", (), None, None
         if not task["ev"].wait(wait_s):
             return False, None, None, None, (), None, None
         # deliberately NOT popped: the reply frame can be lost on the wire

@@ -87,3 +87,11 @@ def probe_and_blacklist(devices, prober: DeviceProber = GLOBAL_PROBER) -> int:
 class MPPRetryExhausted(Exception):
     """All MPP attempts failed — the session re-plans without MPP (ref:
     executor_with_retry giving up → error surfaced / fallback)."""
+
+
+class MPPTaskLostError(Exception):
+    """The storage server no longer knows a dispatched task (it restarted
+    between dispatch and conn, or the task was reclaimed). Retriable at the
+    GATHER level by a fresh dispatch — the client-go mpp_probe lost-task
+    recovery idiom: re-dispatch to a surviving owner instead of failing the
+    whole gather."""

@@ -2300,11 +2300,14 @@ class DB:
             return {"fenced": f"lost ownership of {key!r} (term {term}) mid-sweep", "result": out}
         return out
 
-    def start_background(self, ttl_interval_s: float = 60, analyze_interval_s: float = 60, gc_interval_s: float = 120, colmerge_interval_s: float = 30) -> None:
+    def start_background(self, ttl_interval_s: float = 60, analyze_interval_s: float = 60, gc_interval_s: float = 120, colmerge_interval_s: float = 30, balancer_interval_s: Optional[float] = None) -> None:
         """Start the Domain-style background loops (ref: domain.Start —
         TTL, auto-analyze, GC workers on the timer framework). Each sweep
         first campaigns for its owner key, so only one SQL node per cluster
-        actually runs it."""
+        actually runs it. The placement balancer rides the same framework
+        (``[cluster] balancer-interval-s``; one mover per cluster by the
+        owner gate, at most one region move per tick)."""
+        from tidb_tpu import config as _config
         from tidb_tpu.utils.timer import TimerRuntime
 
         if getattr(self, "timers", None) is None:
@@ -2317,6 +2320,13 @@ class DB:
         self.timers.register(
             "colmerge", colmerge_interval_s, lambda: self._owner_gated("colmerge", self.run_delta_merge)
         )
+        if balancer_interval_s is None:
+            balancer_interval_s = _config.current().balancer_interval_s
+        if balancer_interval_s > 0 and hasattr(self.store, "placement_cache"):
+            self.timers.register(
+                "balancer", balancer_interval_s,
+                lambda: self._owner_gated("balancer", self.run_balancer),
+            )
         self.timers.start()
         # the in-process metrics history recorder rides the background
         # lifecycle (refcounted process singleton; thread "metrics-history"
@@ -2341,6 +2351,16 @@ class DB:
         return cache_for(self.store).merge_pending(
             should_stop=lambda: self.owner_fenced("colmerge")
         )
+
+    def run_balancer(self) -> dict:
+        """One placement-balancer pass (kv/placement.py balancer_sweep):
+        move the heaviest movable table off the most loaded shard when the
+        fleet's load skew crosses ``[cluster] balancer-skew-ratio``. Owner-
+        gated like the other sweeps, so N SQL nodes run exactly one mover;
+        a non-sharded store is a cheap no-op."""
+        from tidb_tpu.kv.placement import balancer_sweep
+
+        return balancer_sweep(self)
 
     def stop_background(self) -> None:
         if getattr(self, "timers", None) is not None:

@@ -6,10 +6,18 @@ br/pkg/storage seam): ``file:///dir``, a bare directory path, or
 
 Format: a storage prefix holding
   backupmeta.json        — backup_ts + per-table schema pb (catalog format)
+  backup.checkpoint.json — progress: backup_ts + the tables already written
   <db>.<table>.rows      — per physical table: [handle i64][len u32][row bytes]*
 Rows are MVCC-consistent at backup_ts. Restore recreates tables (fresh ids),
 re-keys rows for the new ids, ingests through the SST-style bulk path, and
-rebuilds indexes from row data (so index ids/layout never need to match)."""
+rebuilds indexes from row data (so index ids/layout never need to match).
+
+Partial-backup resume (the RESILIENCE.md "mid-BACKUP" gap): the checkpoint
+file updates after EVERY completed table, so a backup that dies mid-way can
+be re-run against the same destination and skips the tables it already
+wrote — re-using the ORIGINAL backup_ts, so finished files and the
+remaining scans read the same snapshot (ref: br's checkpoint backup;
+restorability is still gated on backupmeta.json, which is written LAST)."""
 
 from __future__ import annotations
 
@@ -20,15 +28,47 @@ from tidb_tpu.catalog.schema import TableInfo
 from tidb_tpu.kv import tablecodec
 
 
+_CHECKPOINT = "backup.checkpoint.json"
+
+
 def backup_database(db, db_name: str, dest: str, tables: list[str] | None = None) -> dict:
     """Snapshot-consistent backup of a database (or a table subset) to the
     ``dest`` storage URL; returns the meta dict (incl. backup_ts, per-table
-    row counts)."""
+    row counts). Re-running after a mid-backup fault RESUMES from the
+    per-table checkpoint: completed tables are skipped (their files are
+    already consistent at the checkpointed backup_ts) and only the
+    remaining ones re-round-trip."""
     from tidb_tpu.tools.storage import open_storage
 
     store_out = open_storage(dest)
-    backup_ts = db.store.current_ts()
     names = tables if tables is not None else db.catalog.tables(db_name)
+    done: dict = {}
+    backup_ts = None
+    if store_out.exists(_CHECKPOINT) and not store_out.exists("backupmeta.json"):
+        import time as _time
+
+        ck = json.loads(store_out.read_file(_CHECKPOINT).decode())
+        try:
+            life_s = float(db.global_vars.get("tidb_gc_life_time", 600))
+        except (TypeError, ValueError):
+            life_s = 600.0
+        # resume: keep the ORIGINAL backup_ts — the finished files were
+        # written at that snapshot, and mixing snapshots would make the
+        # restored database internally inconsistent. A checkpoint older
+        # than the GC life is DISCARDED (fresh run at a fresh ts): MVCC GC
+        # may have pruned the versions that snapshot needs, and a resumed
+        # scan would silently read post-GC state against pre-GC files.
+        fresh_enough = _time.time() - ck.get("created", 0.0) < life_s
+        if ck.get("db") == db_name and fresh_enough:
+            done = {
+                n: m for n, m in ck.get("tables", {}).items()
+                if store_out.exists(m["file"])
+            }
+            if done:
+                backup_ts = ck["backup_ts"]
+    if backup_ts is None:
+        backup_ts = db.store.current_ts()
+        done = {}
     meta: dict = {"backup_ts": backup_ts, "db": db_name, "tables": {}}
     # go through the store's own snapshot factory (not memstore.Snapshot
     # directly) so backups compose with wrapped stores — fault-injected,
@@ -36,6 +76,9 @@ def backup_database(db, db_name: str, dest: str, tables: list[str] | None = None
     snap = db.store.get_snapshot(backup_ts)
     for name in names:
         t = db.catalog.table(db_name, name)
+        if t.name in done:
+            meta["tables"][t.name] = done[t.name]
+            continue
         count = 0
         fname = f"{db_name}.{t.name}.rows"
         with store_out.create(fname) as w:
@@ -46,6 +89,22 @@ def backup_database(db, db_name: str, dest: str, tables: list[str] | None = None
                     w.write(v)
                     count += 1
         meta["tables"][t.name] = {"schema": t.to_pb(), "rows": count, "file": fname}
+        done[t.name] = meta["tables"][t.name]
+        import time as _time
+
+        store_out.write_file(
+            _CHECKPOINT,
+            json.dumps(
+                {
+                    "backup_ts": backup_ts,
+                    "db": db_name,
+                    "tables": done,
+                    # wall clock of the checkpoint: resumes past the GC life
+                    # discard it (the snapshot may no longer be readable)
+                    "created": _time.time(),
+                }
+            ).encode(),
+        )
     store_out.write_file("backupmeta.json", json.dumps(meta).encode())
     return meta
 

@@ -73,9 +73,14 @@ class LocalStorage(ExternalStorage):
             return f.read()
 
     def list_files(self) -> list[str]:
+        if not os.path.isdir(self.root):
+            return []  # fresh destination: nothing written yet, not an error
         return sorted(
             f for f in os.listdir(self.root) if os.path.isfile(os.path.join(self.root, f))
         )
+
+    def exists(self, name: str) -> bool:
+        return os.path.isfile(os.path.join(self.root, name))
 
     def create(self, name: str):
         os.makedirs(self.root, exist_ok=True)

@@ -327,3 +327,39 @@ METRICS_HISTORY_POINTS = REGISTRY.gauge(
     "tidb_tpu_metrics_history_points",
     "Samples currently retained by the in-process metrics history recorder",
 )
+
+# elastic data placement (kv/placement.py: the PD-analog placement driver —
+# epoch-versioned movable ownership, region migration, the balancer sweep)
+PLACEMENT_EPOCH = REGISTRY.gauge(
+    "tidb_tpu_placement_epoch",
+    "Current placement epoch per table binding (monotone; never regresses)",
+    ("table",),
+)
+PLACEMENT_REFRESH = REGISTRY.counter(
+    "tidb_tpu_placement_refresh_total",
+    "Placement map re-resolves (the boRegionMiss re-route signal)",
+    ("outcome",),
+)
+PLACEMENT_REROUTE = REGISTRY.counter(
+    "tidb_tpu_placement_reroute_total",
+    "Data verbs re-routed to a new owner after a placement epoch change",
+    ("verb",),
+)
+REGION_MIGRATE = REGISTRY.counter(
+    "tidb_tpu_region_migrate_total",
+    "Region (table) migrations between stores",
+    ("outcome",),
+)
+REGION_MIGRATE_SECONDS = REGISTRY.histogram(
+    "tidb_tpu_region_migrate_seconds",
+    "Wall clock of one region migration (copy + catch-up + fenced cutover)",
+)
+BALANCER_MOVES = REGISTRY.counter(
+    "tidb_tpu_balancer_move_total",
+    "Region moves initiated by the load balancer sweep",
+    ("reason",),
+)
+META_CATCHUP = REGISTRY.counter(
+    "tidb_tpu_meta_catchup_total",
+    "Returning-replica anti-entropy replays (meta + election + placement)",
+)
