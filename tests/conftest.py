@@ -23,9 +23,20 @@ jax.config.update("jax_enable_x64", True)
 
 import sys
 
-import pytest
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# runtime lock-order detection for the WHOLE tier-1 suite (defaulted on,
+# TIDB_TPU_LOCKCHECK=0 opts out): every threading.Lock/RLock created after
+# this point is order-checked, so an acquisition-order inversion raises a
+# typed LockOrderError the moment the second edge appears instead of some
+# future 2-core CI host hanging forever (the PR 1 _MESH_EXEC_LOCK failure
+# mode). Must run BEFORE any tidb_tpu import creates its locks.
+os.environ.setdefault("TIDB_TPU_LOCKCHECK", "1")
+from tidb_tpu.utils import lockcheck as _lockcheck
+
+_lockcheck.install()
+
+import pytest
 
 
 def pytest_configure(config):

@@ -1,0 +1,226 @@
+"""utils/lockcheck: the runtime would-deadlock detector. Drives a REAL
+two-lock inversion to the typed cycle error — deterministically, without
+needing the losing thread interleaving — plus wrapper-semantics coverage
+(RLock re-entry, Condition/Event/Queue protocol, release bookkeeping)."""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.utils import lockcheck
+
+
+@pytest.fixture
+def checked():
+    """Ensure instrumentation is active for the test (tier-1 conftest
+    installs it process-wide already; standalone runs force it), and
+    isolate this test's order graph from suite history."""
+    was = lockcheck.installed()
+    lockcheck.install(force=True)
+    lockcheck.reset()
+    yield
+    lockcheck.reset()
+    if not was:
+        lockcheck.uninstall()
+
+
+def test_two_lock_inversion_raises_typed_cycle(checked):
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+    # the REVERSED order: a classic ABBA inversion. No second thread, no
+    # timing luck — the second edge itself is the error.
+    with pytest.raises(lockcheck.LockOrderError) as ei:
+        with b:
+            with a:
+                pass
+    assert len(ei.value.cycle) >= 2
+    assert "lock-order cycle" in str(ei.value)
+    # the failed acquire must NOT leave the inner lock held
+    assert a.acquire(blocking=False)
+    a.release()
+
+
+def test_inversion_across_threads(checked):
+    """The PR 1 shape: thread one nests A->B, thread two nests B->A. The
+    detector fires in whichever thread closes the cycle second — even
+    though the threads never actually contend."""
+    a = threading.Lock()
+    b = threading.Lock()
+    errs: list = []
+
+    def t1():
+        with a:
+            time.sleep(0.01)
+            with b:
+                pass
+
+    def t2():
+        time.sleep(0.05)  # strictly after t1 released everything
+        try:
+            with b:
+                with a:
+                    pass
+        except lockcheck.LockOrderError as e:
+            errs.append(e)
+
+    th1 = threading.Thread(target=t1, name="lc-t1")
+    th2 = threading.Thread(target=t2, name="lc-t2")
+    th1.start(), th2.start()
+    th1.join(5), th2.join(5)
+    assert len(errs) == 1 and isinstance(errs[0], lockcheck.LockOrderError)
+
+
+def test_three_lock_cycle(checked):
+    a, b, c = threading.Lock(), threading.Lock(), threading.Lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with pytest.raises(lockcheck.LockOrderError) as ei:
+        with c:
+            with a:
+                pass
+    assert len(ei.value.cycle) >= 3
+
+
+def test_consistent_order_is_fine(checked):
+    a = threading.Lock()
+    b = threading.Lock()
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    # sequential (non-nested) use in any order is also fine
+    with b:
+        pass
+    with a:
+        pass
+
+
+def test_rlock_reentry_is_not_a_cycle(checked):
+    r = threading.RLock()
+    with r:
+        with r:
+            with r:
+                pass
+    # still released all the way down
+    assert r.acquire(blocking=False)
+    r.release()
+
+
+def test_condition_event_queue_protocol(checked):
+    # Condition round trip (wait releases, notify wakes, re-acquire restores)
+    cond = threading.Condition()
+    hits = []
+
+    def waiter():
+        with cond:
+            cond.wait(2.0)
+            hits.append(1)
+
+    t = threading.Thread(target=waiter, name="lc-cond")
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        cond.notify_all()
+    t.join(5)
+    assert hits == [1] and not t.is_alive()
+    # Event and Queue are built on checked locks once installed
+    ev = threading.Event()
+    ev.set()
+    assert ev.wait(0.1)
+    q = queue.Queue()
+    q.put("x")
+    assert q.get(timeout=1) == "x"
+
+
+def test_condition_wait_on_reentrant_rlock_keeps_tracking(checked):
+    """Condition.wait on a re-entrantly held RLock releases ALL recursion
+    levels and must restore the same number of held-list entries — a
+    restore of one would leave the thread holding the lock with an empty
+    held record, silently blinding the detector to every ordering edge
+    through that lock afterwards."""
+    r = threading.RLock()
+    cond = threading.Condition(r)
+    x = threading.Lock()
+    with r:
+        with r:
+            with cond:
+                cond.wait(0.05)  # times out; full release + restore cycle
+        # depth is back to 1 here: tracking must still see r held, so this
+        # nested acquire records the r -> x ordering edge
+        with x:
+            pass
+    assert (id(r), id(x)) in lockcheck._edges, (
+        "held-list desynchronized across Condition.wait: r->x edge missing"
+    )
+
+
+def test_nonblocking_and_timeout_acquires(checked):
+    a = threading.Lock()
+    assert a.acquire(blocking=False)
+    # a failed try-acquire must not be recorded as held
+    assert not a.acquire(blocking=False)
+    a.release()
+    assert a.acquire(True, 0.1)
+    a.release()
+
+
+def test_id_reuse_does_not_alias_dead_edges(checked):
+    """The DDLWorker false-positive shape: a dead lock pair's edges must
+    not survive onto fresh locks that recycle their memory (CPython id()
+    reuse). Alternating nest order across GENERATIONS of fresh pairs is
+    not an inversion — before the purge-on-construction fix, the recycled
+    ids inherited the previous generation's edge and raised a phantom
+    cycle."""
+    import gc
+
+    for i in range(50):
+        a = threading.Lock()
+        b = threading.Lock()
+        if i % 2:
+            with a:
+                with b:
+                    pass
+        else:
+            with b:
+                with a:
+                    pass
+        del a, b
+        gc.collect()
+
+
+def test_reset_clears_history(checked):
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+    lockcheck.reset()
+    # reversed order is fine again: the edge evidence is gone
+    with b:
+        with a:
+            pass
+
+
+def test_env_knob_gates_install(monkeypatch):
+    monkeypatch.setenv(lockcheck.ENV_KNOB, "0")
+    was = lockcheck.installed()
+    if was:
+        lockcheck.uninstall()
+    try:
+        assert lockcheck.install() is False  # knob off, no force
+        assert not lockcheck.installed()
+        assert lockcheck.install(force=True) is True
+        lockcheck.uninstall()
+        assert not lockcheck.installed()
+    finally:
+        if was:
+            lockcheck.install(force=True)

@@ -1,0 +1,73 @@
+"""The tier-1 CI gate for graftcheck: scan the FULL shipped tree and fail
+on any non-baselined finding — a new replay-unclassified verb, a stripped
+assert, an uncached jit, a lock-order cycle, an anonymous thread, an
+unbounded metric, or dead code now fails CI like any other regression
+(ref: TiDB's build/linter + nogo wired into every build)."""
+
+import json
+import os
+import time
+
+from tidb_tpu.tools.check import build_tree, load_baseline, load_rules, scan
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(ROOT, "graftcheck_baseline.json")
+
+
+def test_full_tree_scan_is_clean_within_budget():
+    t0 = time.perf_counter()
+    tree = build_tree(ROOT)
+    baseline = load_baseline(BASELINE) if os.path.isfile(BASELINE) else []
+    report = scan(tree, baseline=baseline)
+    elapsed = time.perf_counter() - t0
+    msgs = "\n".join(f.render() for f in report.findings)
+    assert not report.findings, f"graftcheck found NEW violations:\n{msgs}"
+    # the committed baseline stays near-empty: fix or suppress, don't accrete
+    assert len(report.baselined) <= 10, (
+        f"baseline has grown to {len(report.baselined)} grandfathered findings "
+        "— fix some before adding more"
+    )
+    # the whole point of a repo-native checker is that CI can afford it
+    assert elapsed < 30.0, f"graftcheck scan took {elapsed:.1f}s (budget 30s)"
+
+
+def test_every_rule_ran_and_documents_itself():
+    rules = load_rules()
+    expected = {
+        "replay-registry",
+        "lock-order",
+        "shared-mutation",
+        "opt-assert",
+        "jit-cache",
+        "traced-impure",
+        "thread-name",
+        "metric-labels",
+        "dead-code",
+    }
+    assert expected <= set(rules)
+    for r in rules.values():
+        # each catalog entry carries the incident story and a fix
+        assert len(r.explain) > 100, f"rule {r.id} lacks a real explanation"
+        assert "Fix:" in r.explain, f"rule {r.id} explanation lacks a fix recipe"
+
+
+def test_baseline_file_is_committed_and_parseable():
+    assert os.path.isfile(BASELINE), "graftcheck_baseline.json must be committed"
+    with open(BASELINE) as f:
+        data = json.load(f)
+    assert isinstance(data.get("findings"), list)
+    assert len(data["findings"]) <= 10
+
+
+def test_tier1_runs_with_lockcheck_installed():
+    """The acceptance invariant: tier-1 executes the whole suite under the
+    runtime lock-order detector (conftest installs it unless explicitly
+    opted out), so every green run doubles as a deadlock-freedom proof
+    over the lock orders the suite exercised."""
+    from tidb_tpu.utils import lockcheck
+
+    if os.environ.get(lockcheck.ENV_KNOB) != "1":
+        import pytest
+
+        pytest.skip("lockcheck explicitly disabled for this run")
+    assert lockcheck.installed()

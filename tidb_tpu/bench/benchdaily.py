@@ -300,6 +300,38 @@ def bench_trace_sampled_overhead() -> float:
     )
 
 
+@register("graftcheck_runtime_overhead_ms")
+def bench_lockcheck_overhead() -> float:
+    """Warm COUNT(*) latency (ms, lower is better) with the runtime
+    lock-order detector (utils/lockcheck, TIDB_TPU_LOCKCHECK=1) ACTIVE —
+    the always-on cost of running tier-1 as a standing deadlock-freedom
+    proof. When this lane starts from an uninstrumented process (the
+    standalone benchdaily run) it first measures the plain path and
+    HARD-FAILS if instrumentation costs more than 5% (+0.15 ms timer
+    grace) on the warm fixed-overhead path — the same enforced-budget rule
+    the tracing lanes follow (an unbudgeted checker quietly becomes the
+    regression it exists to catch). Under tier-1 the process is already
+    instrumented, so the lane just records the instrumented latency for
+    the --check trend gate."""
+    from tidb_tpu.utils import lockcheck
+
+    pre_installed = lockcheck.installed()
+    plain = None if pre_installed else _warm_count_best("gco_plain")
+    lockcheck.install(force=True)
+    try:
+        # a FRESH db/session so its locks are created post-instrumentation
+        inst = _warm_count_best("gco_inst")
+    finally:
+        if not pre_installed:
+            lockcheck.uninstall()
+    if plain is not None and inst > plain * 1.05 + 0.15:
+        raise RuntimeError(
+            f"lockcheck overhead breached the 5% budget: plain {plain:.3f}ms "
+            f"-> instrumented {inst:.3f}ms"
+        )
+    return inst
+
+
 @register("qps_point_select")
 def bench_qps_point_select() -> float:
     """Concurrent point-select throughput (ops/s, higher is better): N

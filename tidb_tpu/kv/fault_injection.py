@@ -39,7 +39,8 @@ class InjectionConfig:
         self._errs: dict[str, tuple[Exception, Optional[int]]] = {}
 
     def _set(self, name: str, err: Optional[Exception], n_times: Optional[int]) -> None:
-        assert name in self._HOOKS, f"unknown injection hook {name!r}"
+        if name not in self._HOOKS:
+            raise KeyError(f"unknown injection hook {name!r}")
         with self._mu:
             if err is None:
                 self._errs.pop(name, None)

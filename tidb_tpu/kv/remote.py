@@ -333,7 +333,9 @@ class StoreServer:
                         pass
                     return
                 self._conns.add(conn)
-            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True, name="store-conn"
+            ).start()
 
     def _serve(self, conn: socket.socket) -> None:
         try:
@@ -783,7 +785,8 @@ class _RemoteCopClient:
         from tidb_tpu.copr.client import CopResponse, CopResult, run_task_resilient
         from tidb_tpu.utils.chunk import decode_chunk
 
-        assert req.tp == RequestType.DAG
+        if req.tp != RequestType.DAG:
+            raise ValueError(f"remote cop client handles DAG requests only, got {req.tp}")
         read_ts = req.start_ts or self.store.current_ts()
         tasks = list(self.store.pd.regions_in_ranges(req.ranges))
         if req.desc:
@@ -903,18 +906,46 @@ class _RemoteCopClient:
         return CopResponse(it, cancel)
 
 
-# verbs that must NOT be transparently replayed after they may have reached
-# the server. Everything else is replay-safe: reads are pure; percolator
-# prewrite/rollback/pessimistic_rollback/acquire_lock are idempotent under the
-# same start_ts (memstore re-prewrite rewrites the same lock); raw_put/delete
-# write the same value; owner verbs re-assert the same lease. ``commit`` is
-# the 2PC safety case (see UndeterminedError); ``raw_cas`` replayed after a
-# successful-but-unacked swap would misreport failure; the ingest verbs mint
-# a fresh commit_ts per call, so a replay would double the rows;
-# ``mpp_dispatch`` mints a fresh task_id per call — replaying a lost reply
-# would double-execute the gather and orphan the first task (retry belongs
-# at the gather layer, which can cancel).
-_NON_REPLAYABLE = frozenset({"commit", "raw_cas", "ingest", "ingest_columnar", "mpp_dispatch"})
+# The wire-verb replay registry. EVERY verb must appear in exactly one of
+# these two sets — graftcheck's replay-registry rule cross-checks them
+# against the server dispatcher and every client header, and the replay
+# gate in RemoteStore._call is fail-closed (``cmd in REPLAYABLE``), so a
+# new verb CANNOT silently default to replay-on-reconnect (the PR 1
+# mpp_dispatch bug class: replaying a lost reply double-executed a gather).
+#
+# REPLAYABLE — safe to re-send after the server may have executed it:
+# reads are pure; percolator prewrite/rollback/pessimistic_rollback/
+# acquire_lock are idempotent under the same start_ts (re-prewrite rewrites
+# the same lock); raw_put/raw_delete write the same value; owner/election/
+# placement proposes re-assert the same record under the same fencing
+# token; fence/unfence/purge/drop_stable are absorbing; migrate_region
+# re-installs the same (key, commit_ts) versions; mpp_conn retains the
+# final frame server-side precisely so a lost reply can be re-asked;
+# mpp_cancel is the idempotent ack.
+REPLAYABLE = frozenset(
+    {
+        "ping", "sys_snapshot", "current_ts", "tso",
+        "raw_get", "raw_put", "raw_delete", "raw_scan",
+        "run_gc", "snap_get", "snap_batch_get", "snap_scan",
+        "prewrite", "rollback", "pessimistic_rollback", "acquire_lock",
+        "check_txn_status", "resolve_lock", "detector_cleanup",
+        "drop_stable", "purge_table",
+        "owner_campaign", "owner_of", "owner_resign", "owner_term",
+        "election_propose", "election_read",
+        "placement_propose", "placement_read",
+        "fence_table", "unfence_table", "migrate_export", "migrate_region",
+        "regions_in_ranges", "cop",
+        "mpp_ndev", "mpp_conn", "mpp_cancel",
+    }
+)
+# NON_REPLAYABLE — a replay after an unacked send could double-apply:
+# ``commit`` is the 2PC safety case (UndeterminedError); ``raw_cas``
+# replayed after a successful-but-unacked swap would misreport failure;
+# the ingest verbs mint a fresh commit_ts per call, so a replay doubles
+# the rows; ``mpp_dispatch`` mints a fresh task_id per call — replaying a
+# lost reply would double-execute the gather and orphan the first task
+# (retry belongs at the gather layer, which can cancel).
+NON_REPLAYABLE = frozenset({"commit", "raw_cas", "ingest", "ingest_columnar", "mpp_dispatch"})
 
 
 class RemoteStore:
@@ -998,7 +1029,9 @@ class RemoteStore:
             UndeterminedError.
         """
         cmd = header["cmd"]
-        replayable = cmd not in _NON_REPLAYABLE
+        # fail-closed: replay is an earned property — an undeclared verb is
+        # treated as non-replayable (and fails the graftcheck registry scan)
+        replayable = cmd in REPLAYABLE
         bo: Optional[Backoffer] = None
         while True:
             maybe_sent = False

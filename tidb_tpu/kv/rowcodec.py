@@ -88,7 +88,8 @@ def encode_row(schema: RowSchema, values: Sequence) -> bytes:
 
 def decode_row(schema: RowSchema, buf: bytes) -> list:
     """Single-row decode (write path read-modify, point gets)."""
-    assert buf[0] == ROW_VERSION
+    if buf[0] != ROW_VERSION:
+        raise ValueError(f"bad row version {buf[0]:#x} (corrupt or foreign encoding)")
     vals: list = [None] * schema.n
     bitmap = buf[1 : 1 + schema.bitmap_len]
     off = schema.fixed_base

@@ -224,7 +224,8 @@ class _ShardedCopClient:
     def send(self, req: Request):
         from tidb_tpu.copr.client import CopResponse
 
-        assert req.tp == RequestType.DAG
+        if req.tp != RequestType.DAG:
+            raise ValueError(f"sharded cop client handles DAG requests only, got {req.tp}")
         segments = self.store.group_ranges(req.ranges, consecutive=True)
         bo = Backoffer(budget_ms=2000)
         subs: list = []  # live sub-responses, for early-exit cancellation
@@ -889,7 +890,7 @@ class ShardedStore:
     def ingest(self, keys: Sequence[bytes], values: Sequence[bytes]) -> int:
         # NOT re-routed on ConnectionError: ingest mints a fresh commit_ts
         # per call, so a replay could double rows (same rule as the wire
-        # layer's _NON_REPLAYABLE); a typed RegionError still re-routes —
+        # layer's NON_REPLAYABLE); a typed RegionError still re-routes —
         # the fenced store refused before ingesting anything
         def once():
             by: dict[int, tuple[list, list]] = {}

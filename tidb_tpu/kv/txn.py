@@ -47,10 +47,6 @@ class MemBuffer:
     def contains(self, key: bytes) -> bool:
         return key in self._buf
 
-    def is_deleted(self, key: bytes) -> bool:
-        ent = self._buf.get(key)
-        return ent is not None and ent[0] == OP_DEL
-
     def _record(self, key: bytes) -> None:
         if self._stages:
             st = self._stages[-1]
@@ -207,7 +203,8 @@ class Txn:
 
     # -- 2PC ---------------------------------------------------------------
     def commit(self) -> int:
-        assert not self._done, "txn already finished"
+        if self._done:
+            raise RuntimeError("txn already finished")
         self._done = True
         muts = self.membuf.mutations()
         if not muts:

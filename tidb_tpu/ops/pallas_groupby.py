@@ -87,7 +87,8 @@ def grouped_sums(seg, pairs, B: int, n_pad: int, interpret: bool = False):
     """
     import jax.numpy as jnp
 
-    assert n_pad % _BLK == 0, "n_pad must be a multiple of the row block"
+    if n_pad % _BLK != 0:
+        raise ValueError(f"n_pad must be a multiple of the row block ({_BLK}), got {n_pad}")
     L = len(pairs)
     B_pad = max(_pad_to(B, 8), 8)
     n_cols = 3 * L

@@ -60,6 +60,12 @@ import threading as _threading
 _MESH_EXEC_LOCK = _threading.Lock()
 # (store, table, slots, region versions, ndev) → padded device input lanes
 _MPP_DEV_CACHE: dict = {}
+# serializes MUTATIONS of the two module caches above/below: lookups stay
+# lock-free (GIL-atomic dict reads; a miss just rebuilds), but the eviction
+# sweeps iterate while sizing, and concurrent gathers from different
+# sessions insert outside _MESH_EXEC_LOCK — iteration-during-insert raises
+# RuntimeError. Never held across a compile or an upload.
+_MPP_CACHE_MU = _threading.Lock()
 
 # per-shard straggler observation channel: the fragment program's shard
 # probes (mpp.build_dist_pipeline shard_probe) report back through this ONE
@@ -1444,7 +1450,10 @@ class MPPGatherExec:
             if ckey is not None:
                 if pool is None:
                     pool = {"n": n, "live": jnp.asarray(arrays[-1]), "cols": {}}
-                    _MPP_DEV_CACHE[ckey] = pool
+                    with _MPP_CACHE_MU:
+                        # a racing gather may have installed the pool first:
+                        # adopt the winner so both share one resident copy
+                        pool = _MPP_DEV_CACHE.setdefault(ckey, pool)
                 lanes = []
                 for i, s in enumerate(want):
                     ent = pool["cols"].get(s)
@@ -1457,10 +1466,11 @@ class MPPGatherExec:
                 dev = (lanes + [pool["live"]], pool["n"], [pool["cols"][s][2] for s in want])
             else:
                 dev = ([jnp.asarray(a) for a in arrays], n, bounds)
+            with _MPP_CACHE_MU:
                 if key is not None:
                     _MPP_DEV_CACHE[key] = dev
-            while len(_MPP_DEV_CACHE) > 32:
-                _MPP_DEV_CACHE.pop(next(iter(_MPP_DEV_CACHE)))
+                while len(_MPP_DEV_CACHE) > 32:
+                    _MPP_DEV_CACHE.pop(next(iter(_MPP_DEV_CACHE)))
             return dev
 
         # traced under TRACE (or a propagated remote trace context): the two
@@ -1771,9 +1781,10 @@ class MPPGatherExec:
                 )
                 # the sink is baked into the compiled program's closures: a
                 # cache hit must attribute warn counts via the ORIGINAL sink
-                _MPP_FN_CACHE[fn_key] = (fn, warn_sink)
-                while len(_MPP_FN_CACHE) > 64:
-                    _MPP_FN_CACHE.pop(next(iter(_MPP_FN_CACHE)))
+                with _MPP_CACHE_MU:
+                    _MPP_FN_CACHE[fn_key] = (fn, warn_sink)
+                    while len(_MPP_FN_CACHE) > 64:
+                        _MPP_FN_CACHE.pop(next(iter(_MPP_FN_CACHE)))
             else:
                 _met.MPP_PROGRAM_CACHE.inc(result="hit")
                 fn, warn_sink = cached

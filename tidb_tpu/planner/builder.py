@@ -2126,11 +2126,6 @@ def _source_outcol(e: Expression, schema) -> Optional[OutCol]:
     return None
 
 
-def _slot_of(e: Expression, schema) -> int:
-    oc = _source_outcol(e, schema)
-    return oc.slot if oc else -1
-
-
 def _as_equi_pair(cond: Expression, nleft: int):
     if isinstance(cond, ScalarFunc) and cond.sig == "eq":
         a, b = cond.args

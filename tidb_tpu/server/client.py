@@ -56,7 +56,8 @@ class Client:
 
     def _handshake(self, user: str, password: str, db: str, tls: bool, auth_plugin: str) -> None:
         greeting = self.io.read()
-        assert greeting[0] == 10, "unexpected protocol version"
+        if greeting[0] != 10:
+            raise ConnectionError(f"unexpected protocol version {greeting[0]}")
         # salt = 8 bytes after ver+thread_id, then 12 more past the caps block
         off = 1 + greeting.index(b"\x00", 1) + 4
         salt1 = greeting[off : off + 8]
@@ -171,7 +172,8 @@ class Client:
 
     def _expect_eof(self) -> None:
         pkt = self.io.read()
-        assert pkt[0] == 0xFE, "expected EOF packet"
+        if pkt[0] != 0xFE:
+            raise ConnectionError(f"expected EOF packet, got {pkt[0]:#x}")
 
     # -- binary prepared protocol (COM_STMT_*; what real drivers use for
     # parameterized queries — PyMySQL/Connector-J prepare by default) -------
@@ -251,7 +253,8 @@ class Client:
     def execute_cursor(self, stmt_id: int, params: list = ()):
         """Binary execute in CURSOR mode: the server parks the result; rows
         arrive via fetch(). Returns the column names."""
-        assert not params, "cursor demo client: parameterless statements"
+        if params:
+            raise ValueError("cursor demo client: parameterless statements only")
         body = struct.pack("<IBI", stmt_id, p.CURSOR_TYPE_READ_ONLY, 1)
         self.io.reset_seq()
         self.io.write(bytes([p.COM_STMT_EXECUTE]) + body)
@@ -270,7 +273,8 @@ class Client:
             self._cursor_types.append(tc)
         eof = self.io.read()
         status = struct.unpack_from("<H", eof, 3)[0]
-        assert status & p.SERVER_STATUS_CURSOR_EXISTS, "server did not open a cursor"
+        if not status & p.SERVER_STATUS_CURSOR_EXISTS:
+            raise ConnectionError("server did not open a cursor")
         self.columns = cols
         return cols
 

@@ -408,9 +408,13 @@ class Server:
         self.port = s.getsockname()[1]
         self._lsock = s
         self.server_id = self._alloc_server_id()
-        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="mysql-accept"
+        )
         self._accept_thread.start()
-        self._kill_thread = threading.Thread(target=self._kill_poll_loop, daemon=True)
+        self._kill_thread = threading.Thread(
+            target=self._kill_poll_loop, daemon=True, name="mysql-kill-poll"
+        )
         self._kill_thread.start()
         return self.port
 
@@ -433,7 +437,9 @@ class Server:
                         break
                 conn = ClientConn(self, sock, cid)
                 self._conns[cid] = conn
-            threading.Thread(target=conn.run, daemon=True).start()
+            threading.Thread(
+                target=conn.run, daemon=True, name=f"mysql-conn-{cid}"
+            ).start()
 
     # -- cross-node KILL (ref: tests/globalkilltest; util/globalconn) --------
     def _kill_poll_loop(self) -> None:

@@ -188,7 +188,9 @@ class StatusServer:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> int:
-        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="status-http"
+        )
         self._thread.start()
         return self.port
 

@@ -108,7 +108,8 @@ def _create_table_sql(t, db: str = "") -> str:
 def dump_database(db, db_name: str, dest: str, fmt: str = "sql") -> dict:
     """Export one database. fmt: "sql" (INSERTs) or "csv". Returns
     {table: row_count}."""
-    assert fmt in ("sql", "csv")
+    if fmt not in ("sql", "csv"):
+        raise ValueError(f"unsupported dump format {fmt!r} (want 'sql' or 'csv')")
     os.makedirs(dest, exist_ok=True)
     with open(os.path.join(dest, f"{db_name}-schema-create.sql"), "w") as f:
         f.write(f"CREATE DATABASE IF NOT EXISTS `{db_name}`;\n")

@@ -31,7 +31,8 @@ class BackoffConfig:
     __slots__ = ("name", "base_ms", "cap_ms", "jitter")
 
     def __init__(self, name: str, base_ms: float, cap_ms: float, jitter: str = "equal"):
-        assert jitter in ("equal", "full", "none")
+        if jitter not in ("equal", "full", "none"):
+            raise ValueError(f"unknown jitter mode {jitter!r}")
         self.name = name
         self.base_ms = base_ms
         self.cap_ms = cap_ms

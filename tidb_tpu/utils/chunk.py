@@ -144,7 +144,10 @@ class Column:
     dictionary: Dictionary | None = None
 
     def __post_init__(self):
-        assert self.data.shape == self.validity.shape, "data/validity length mismatch"
+        if self.data.shape != self.validity.shape:
+            raise ValueError(
+                f"data/validity length mismatch: {self.data.shape} vs {self.validity.shape}"
+            )
 
     def __len__(self) -> int:
         return len(self.data)
@@ -240,7 +243,8 @@ class Column:
         cur = len(self)
         if cur == n:
             return self
-        assert n > cur
+        if n < cur:
+            raise ValueError(f"pad_to({n}) would truncate a {cur}-row column")
         data = np.zeros(n, dtype=self.data.dtype)
         data[:cur] = self.data
         validity = np.zeros(n, dtype=bool)
@@ -249,11 +253,13 @@ class Column:
 
     @staticmethod
     def concat(cols: Sequence["Column"]) -> "Column":
-        assert cols
+        if not cols:
+            raise ValueError("Column.concat of an empty sequence")
         first = cols[0]
         # dictionaries must be shared (same object) to concat raw codes
         for c in cols[1:]:
-            assert c.dictionary is first.dictionary, "concat across dictionaries requires re-encode"
+            if c.dictionary is not first.dictionary:
+                raise ValueError("concat across dictionaries requires re-encode")
         return Column(
             np.concatenate([c.data for c in cols]),
             np.concatenate([c.validity for c in cols]),
@@ -295,7 +301,8 @@ class Chunk:
 
     @staticmethod
     def concat(chunks: Sequence["Chunk"]) -> "Chunk":
-        assert chunks
+        if not chunks:
+            raise ValueError("Chunk.concat of an empty sequence")
         ncols = chunks[0].num_cols
         return Chunk([Column.concat([ch.columns[i] for ch in chunks]) for i in range(ncols)])
 
@@ -349,7 +356,8 @@ def encode_chunk(chunk: Chunk) -> bytes:
 
 
 def decode_chunk(buf: bytes) -> Chunk:
-    assert buf[:4] == _MAGIC, "bad chunk magic"
+    if buf[:4] != _MAGIC:
+        raise ValueError("bad chunk magic (corrupt or truncated frame)")
     off = 4
     ncols, nrows = struct.unpack_from("<ii", buf, off)
     off += 8

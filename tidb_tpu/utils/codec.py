@@ -21,8 +21,6 @@ from __future__ import annotations
 
 import struct
 
-import numpy as np
-
 SIGN_MASK = 0x8000000000000000
 
 NIL_FLAG = 0x00
@@ -47,10 +45,6 @@ def decode_int_raw(b: bytes, off: int = 0) -> int:
     if u >= SIGN_MASK:
         u -= 1 << 64
     return u
-
-
-def encode_uint_raw(v: int) -> bytes:
-    return struct.pack(">Q", v & 0xFFFFFFFFFFFFFFFF)
 
 
 def decode_uint_raw(b: bytes, off: int = 0) -> int:
@@ -152,7 +146,3 @@ def decode_key_one(b: bytes, off: int = 0):
     raise ValueError(f"unknown datum flag {flag:#x}")
 
 
-def encode_key_vec_int64(vals: np.ndarray) -> np.ndarray:
-    """Vectorized sign-flip for building many int keys at once (uint64 view,
-    big-endian comparable)."""
-    return (vals.astype(np.uint64) ^ np.uint64(SIGN_MASK))

@@ -47,13 +47,6 @@ def weight_bytes(b: bytes, collation: str = "ci") -> bytes:
     return weight_str(b.decode("utf-8", "surrogateescape")).encode("utf-8", "surrogateescape")
 
 
-def weight_key(v: "bytes | str", collation: str = "ci") -> bytes:
-    """Sort/group key for one value."""
-    if isinstance(v, str):
-        v = v.encode("utf-8", "surrogateescape")
-    return weight_bytes(v, collation)
-
-
 def equal(a: bytes, b: bytes, collation: str = "ci") -> bool:
     return weight_bytes(a, collation) == weight_bytes(b, collation)
 
