@@ -1,0 +1,40 @@
+"""graftfuzz shrunk repro: an MPP agg-over-join fragment cast every group
+key lane to int64 (gather.py's fragment input builder), truncating DOUBLE
+group keys — ``GROUP BY c1_2`` returned 3.0 for the group whose key is
+3.25, and two float keys sharing an integer part would have merged.
+
+Found by campaign seed=42 case 199 (mesh case; differential oracle),
+shrunk to one row per side. Fixed in parallel/gather.py (float key lanes
+keep their dtype; they always take the generic dtype-preserving sort path).
+Replayed by tests/test_fuzz_corpus.py; runnable standalone.
+"""
+
+from tidb_tpu.tools.fuzz.runner import run_repro
+
+SPEC = {
+    "setup": [
+        "CREATE TABLE t0 (c0_0 BIGINT)",
+        "CREATE TABLE t1 (c1_0 BIGINT, c1_2 DOUBLE)",
+        "INSERT INTO t0 VALUES (3), (4)",
+        "INSERT INTO t1 VALUES (3, 3.25), (4, 3.75)",
+    ],
+    "dml": [],
+    "merge": False,
+    "mpp": True,
+    "region_split_keys": 16,
+    "oracle": "differential",
+    "phase": "cold",
+    "query": "SELECT c1_2, COUNT(*) FROM t0 LEFT JOIN t1 ON t0.c0_0 = t1.c1_0 GROUP BY c1_2",
+    "ordered": False,
+    "ci_lax": [],
+    "ci_free": [],
+}
+
+
+def test_repro():
+    run_repro(SPEC)
+
+
+if __name__ == "__main__":
+    test_repro()
+    print("no divergence — the bug this repro pinned is fixed")

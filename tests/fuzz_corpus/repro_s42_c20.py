@@ -1,0 +1,45 @@
+"""graftfuzz shrunk repro: an index range scan on a general_ci column
+under-selected — ``KEY (c0_1)`` + ``WHERE c0_1 = 'A'`` built a byte range
+that misses the ci-equal member ``'a'``. Both engines shared the index
+path, so only the metamorphic TLP oracle (Q = Qp ∪ Q¬p ∪ Qp-null) caught
+it: the ``p`` partition lost the row while ``NOT p`` correctly excluded it.
+
+Found by campaign seed=42 (TLP oracle, partition pred ``c0_1 = 'A'``).
+Fixed in planner/ranger.py (ci columns stop the usable index prefix).
+Replayed by tests/test_fuzz_corpus.py; runnable standalone.
+"""
+
+from tidb_tpu.tools.fuzz.runner import run_repro
+
+_Q = "SELECT c0_0 FROM t0"
+
+SPEC = {
+    "setup": [
+        "CREATE TABLE t0 (c0_0 VARCHAR(8), c0_1 VARCHAR(8) COLLATE utf8mb4_general_ci, KEY (c0_1))",
+        "INSERT INTO t0 VALUES ('', 'a')",
+    ],
+    "dml": [],
+    "merge": False,
+    "mpp": False,
+    "region_split_keys": 1 << 62,
+    "oracle": "tlp",
+    "phase": "cold",
+    "query": _Q,
+    "ordered": False,
+    "tlp_pred": "c0_1 = 'A'",
+    "tlp_engine": "host",
+    "tlp_parts": [
+        _Q + " WHERE (c0_1 = 'A')",
+        _Q + " WHERE (NOT (c0_1 = 'A'))",
+        _Q + " WHERE ((c0_1 = 'A') IS NULL)",
+    ],
+}
+
+
+def test_repro():
+    run_repro(SPEC)
+
+
+if __name__ == "__main__":
+    test_repro()
+    print("no divergence — the bug this repro pinned is fixed")

@@ -1,0 +1,33 @@
+"""graftfuzz shrunk repro: scalar aggregate + implicit first_row over an
+EMPTY table crashed the host engine (IndexError in _segment_reduce's
+first_row path — ``data[first_idx]`` with zero rows but one output group).
+
+Found by campaign seed=42 (differential oracle: device ok, host raised).
+Fixed in copr/host_engine.py (first_row over zero rows → NULL).
+Replayed by tests/test_fuzz_corpus.py; runnable standalone.
+"""
+
+from tidb_tpu.tools.fuzz.runner import run_repro
+
+SPEC = {
+    "setup": ["CREATE TABLE t0 (c0_0 BIGINT, c0_1 DOUBLE, c0_2 BIGINT)"],
+    "dml": [],
+    "merge": False,
+    "mpp": False,
+    "region_split_keys": 1 << 62,
+    "oracle": "differential",
+    "phase": "cold",
+    "query": "SELECT c0_0, AVG(c0_1), COUNT(c0_2) FROM t0",
+    "ordered": False,
+    "ci_lax": [],
+    "ci_free": [],
+}
+
+
+def test_repro():
+    run_repro(SPEC)
+
+
+if __name__ == "__main__":
+    test_repro()
+    print("no divergence — the bug this repro pinned is fixed")

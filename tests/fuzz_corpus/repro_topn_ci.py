@@ -1,0 +1,39 @@
+"""graftfuzz shrunk repro: a device-pushed TopN ordered a general_ci column
+by sorted-dictionary BYTE rank ('A' < 'B' < 'a'), not general_ci weight
+order ('a' ≡ 'A' < 'B') — ``ORDER BY a LIMIT 2`` selected the wrong
+candidate SET ({'A','B'} instead of {'a','A'}), not just a different tie
+order.
+
+Found probing the campaign vocabulary (differential oracle). Fixed in
+planner/optimizer.py (_demote_ci_order: ci order keys and ci MIN/MAX args
+stay host-side, whose sort/agg paths rank by weight).
+Replayed by tests/test_fuzz_corpus.py; runnable standalone.
+"""
+
+from tidb_tpu.tools.fuzz.runner import run_repro
+
+SPEC = {
+    "setup": [
+        "CREATE TABLE t0 (c0_0 VARCHAR(8) COLLATE utf8mb4_general_ci, c0_1 BIGINT)",
+        "INSERT INTO t0 VALUES ('B', 1), ('a', 2), ('zz', 3), ('A', 4)",
+    ],
+    "dml": [],
+    "merge": False,
+    "mpp": False,
+    "region_split_keys": 1 << 62,
+    "oracle": "differential",
+    "phase": "cold",
+    "query": "SELECT c0_0, c0_1 FROM t0 ORDER BY c0_0 ASC LIMIT 2",
+    "ordered": True,
+    "ci_lax": [],
+    "ci_free": [],
+}
+
+
+def test_repro():
+    run_repro(SPEC)
+
+
+if __name__ == "__main__":
+    test_repro()
+    print("no divergence — the bug this repro pinned is fixed")

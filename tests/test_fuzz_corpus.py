@@ -1,0 +1,79 @@
+"""Fuzz-corpus replay: every shrunk repro under ``tests/fuzz_corpus/``
+re-runs through both engines on every tier-1 run, so a fuzz-found bug that
+regresses fails CI with its original one-screen scenario (see
+STATIC_ANALYSIS.md § graftfuzz for the corpus/triage policy).
+
+Also pins, as direct unit tests, the fuzz-found bugs whose oracle form
+cannot re-trigger on the fixed tree (the host string MIN/MAX misorder:
+any device MIN/MAX query force-sorts the shared dictionary and partially
+'heals' the bin case, and ci MIN/MAX is now demoted off the device — so a
+differential replay compares host against host)."""
+
+import glob
+import importlib.util
+import os
+
+import pytest
+
+import tidb_tpu
+
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "fuzz_corpus")
+
+
+def _corpus_files():
+    return sorted(glob.glob(os.path.join(CORPUS_DIR, "repro_*.py")))
+
+
+def test_corpus_not_silently_empty():
+    """The corpus may only ship empty when STATIC_ANALYSIS.md records a
+    clean >=10k-case campaign (ISSUE 14 policy); this tree ships repros."""
+    assert _corpus_files(), "fuzz corpus is empty — see STATIC_ANALYSIS.md triage policy"
+
+
+@pytest.mark.parametrize("path", _corpus_files(), ids=lambda p: os.path.basename(p)[:-3])
+def test_replay_corpus(path):
+    spec = importlib.util.spec_from_file_location(os.path.basename(path)[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    from tidb_tpu.tools.fuzz.runner import run_repro
+
+    run_repro(mod.SPEC)
+
+
+# -- direct regressions for fuzz-found bugs the oracles can't re-pin ---------
+
+
+def test_host_string_minmax_unsorted_dict():
+    """MIN/MAX over a bin string column whose dictionary is NOT rank-sorted
+    must rank by value, not by insertion-order code (graftfuzz found the
+    host engine returning the first/last-encoded value; the whole suite
+    missed it because any prior device query force-sorts the dictionary)."""
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE u (a VARCHAR(8), v BIGINT)")
+    db.execute("INSERT INTO u VALUES ('B', 1), ('a', 2), ('zz', 3), ('A', 4)")
+    s = db.session()
+    s.execute("SET tidb_isolation_read_engines = 'host'")
+    assert s.query("SELECT MIN(a), MAX(a) FROM u") == [("A", "zz")]
+    # grouped + multi-region partial merge rides the same ranked reduce
+    assert s.query("SELECT v > 2, MIN(a) FROM u GROUP BY v > 2 ORDER BY v > 2") == [
+        (0, "B"),
+        (1, "A"),
+    ]
+
+
+def test_host_string_minmax_ci_weight_order():
+    """general_ci MIN/MAX ranks by weight class ('a' ≡ 'A' < 'B' < 'zz'),
+    never by byte order, on BOTH engines (the device demotes ci MIN/MAX to
+    the host path — optimizer._demote_ci_order)."""
+    db = tidb_tpu.open()
+    db.execute("CREATE TABLE t (a VARCHAR(8) COLLATE utf8mb4_general_ci, v BIGINT)")
+    db.execute("INSERT INTO t VALUES ('B', 1), ('a', 2), ('zz', 3), ('A', 4)")
+    s = db.session()
+    for eng in ("host", "tpu"):
+        s.execute(f"SET tidb_isolation_read_engines = '{eng}'")
+        # the min class is {'a','A'}; the byte-min member is the canonical pick
+        assert s.query("SELECT MIN(a), MAX(a) FROM t") == [("A", "zz")], eng
+        assert s.query("SELECT v > 2, MIN(a) FROM t GROUP BY v > 2 ORDER BY v > 2") == [
+            (0, "a"),
+            (1, "A"),
+        ], eng

@@ -1,0 +1,85 @@
+"""Seeded-mutation proof for the graftfuzz harness (graftcheck style: break
+the real engine, assert the tool catches it).
+
+A subprocess monkeypatches ``tpu_engine.execute_dag`` with an off-by-one
+corruption on the first int64 output lane — a parity bug in a device code
+path — then runs a small campaign. The harness must (1) FIND the
+divergence, (2) SHRINK it inside fixed bounds (≤3 columns, ≤8 rows — the
+ISSUE 14 acceptance bounds), and (3) emit a standalone repro that
+REPRODUCES: fails while the bug is in place, passes on the healthy tree.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+_BUG_PATCH = textwrap.dedent(
+    """
+    import numpy as np
+    from tidb_tpu.copr import tpu_engine
+
+    _orig = tpu_engine.execute_dag
+
+    def _corrupted(store, dag, region, ranges, read_ts, warn=None):
+        ch = _orig(store, dag, region, ranges, read_ts, warn=warn)
+        for c in ch.columns:
+            if c.data.dtype == np.int64 and len(c.data):
+                c.data = c.data + 1  # the injected parity bug
+                break
+        return ch
+
+    tpu_engine.execute_dag = _corrupted
+    """
+)
+
+
+def _run(py_body: str, timeout: int = 420):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-c", py_body], capture_output=True, text=True,
+        timeout=timeout, env=env,
+    )
+
+
+def test_injected_parity_bug_found_shrunk_and_reproduced(tmp_path):
+    out_dir = str(tmp_path / "fuzz_out")
+    driver = _BUG_PATCH + textwrap.dedent(
+        f"""
+        import sys
+        from tidb_tpu.tools.fuzz.__main__ import main
+        sys.exit(main(["--seed", "5", "--cases", "4", "--query-pool", "6",
+                       "--out", {out_dir!r}, "--quiet"]))
+        """
+    )
+    res = _run(driver)
+    assert res.returncode == 1, f"campaign under injected bug must find it:\n{res.stderr[-2000:]}"
+
+    with open(os.path.join(out_dir, "findings.json"), encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc["findings"], "no findings emitted"
+    finding = doc["findings"][0]
+    # the shrinker must land inside the fixed bounds
+    assert finding["shrunk"]["columns"] <= 3, finding
+    assert finding["shrunk"]["rows"] <= 8, finding
+    # the emitted repro reproduced in-process under the bug
+    assert finding["repro_verified"] is True, finding
+
+    repro = os.path.join(out_dir, finding["repro"])
+    assert os.path.isfile(repro)
+
+    # WITH the bug: the repro fails (AssertionError → nonzero exit)
+    rerun_bug = _BUG_PATCH + textwrap.dedent(
+        f"""
+        import runpy
+        runpy.run_path({repro!r}, run_name="__main__")
+        """
+    )
+    res_bug = _run(rerun_bug)
+    assert res_bug.returncode != 0, "repro must FAIL while the bug is in place"
+    assert "AssertionError" in res_bug.stderr
+
+    # WITHOUT the bug: the repro passes on the healthy tree
+    res_ok = _run(f"import runpy; runpy.run_path({repro!r}, run_name='__main__')")
+    assert res_ok.returncode == 0, f"repro must pass on the fixed tree:\n{res_ok.stderr[-2000:]}"

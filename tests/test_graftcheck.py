@@ -430,3 +430,154 @@ def test_guards_survive_python_O():
     )
     assert p.returncode == 0, p.stdout + p.stderr
     assert "OPTIMIZED-GUARDS-OK" in p.stdout
+
+
+# -- failpoint-registry rule (PR 14) -----------------------------------------
+
+_FP_REGISTRY = (
+    "FAILPOINTS = frozenset({\n"
+    "    'colcache_merge',\n"
+    "    'remote_send',\n"
+    "})\n"
+)
+# every failpoint call in these fixtures is assembled by implicit string
+# concatenation ("failpoint.in" "ject(...)"), so the registry rule's corpus
+# regex can never match THIS file's own raw lines when the real-tree scan
+# reads tests/ as reference corpus — the fixtures stay decoupled from
+# whatever the real FAILPOINTS registry happens to contain
+_FP_INJECTS = (
+    "from tidb_tpu.utils import failpoint\n"
+    "def merge():\n"
+    "    failpoint.in" "ject('colcache_merge', 1)\n"
+    "def send():\n"
+    "    failpoint.in" "ject('remote_send', 'cop')\n"
+)
+
+
+_ARM_OK = "failpoint.en" "able('remote_send', boom)\n"
+_ARM_TYPO = "failpoint.en" "able('remote_sned', boom)\n"
+
+
+def test_failpoint_registry_clean_tree():
+    tree = Tree(
+        {"tidb_tpu/kv/fault_injection.py": _FP_REGISTRY, "tidb_tpu/copr/x.py": _FP_INJECTS},
+        corpus={"tests/test_x.py": _ARM_OK},
+    )
+    assert not scan(tree, rules=["failpoint-registry"]).findings
+
+
+def test_failpoint_registry_flags_typod_test_reference():
+    # the acceptance case: a chaos test arming a name that does not exist —
+    # the fault never fires and the test passes vacuously
+    tree = Tree(
+        {"tidb_tpu/kv/fault_injection.py": _FP_REGISTRY, "tidb_tpu/copr/x.py": _FP_INJECTS},
+        corpus={"tests/test_x.py": _ARM_TYPO},
+    )
+    r = scan(tree, rules=["failpoint-registry"])
+    assert len(r.findings) == 1
+    assert r.findings[0].symbol == "remote_sned"
+    assert r.findings[0].path == "tests/test_x.py"
+
+
+def test_failpoint_registry_flags_unregistered_inject_and_stale_entry():
+    inj = _FP_INJECTS + "def extra():\n    failpoint.in" "ject('new_point')\n"
+    tree = Tree({"tidb_tpu/kv/fault_injection.py": _FP_REGISTRY, "tidb_tpu/copr/x.py": inj})
+    r = scan(tree, rules=["failpoint-registry"])
+    assert [f.symbol for f in r.findings] == ["new_point"]
+    # registry entry whose inject site was deleted → stale finding
+    gone = _FP_INJECTS.replace("    failpoint.in" "ject('remote_send', 'cop')\n", "    pass\n")
+    tree2 = Tree({"tidb_tpu/kv/fault_injection.py": _FP_REGISTRY, "tidb_tpu/copr/x.py": gone})
+    r2 = scan(tree2, rules=["failpoint-registry"])
+    assert [f.symbol for f in r2.findings] == ["remote_send"]
+    assert r2.findings[0].path == "tidb_tpu/kv/fault_injection.py"
+
+
+def test_failpoint_registry_alias_and_suppression():
+    aliased = (
+        "from tidb_tpu.utils import failpoint as _fp\n"
+        "def probe(i):\n"
+        "    _fp.in" "ject('mystery', i)  # graftcheck: off=failpoint-registry\n"
+    )
+    files = {
+        "tidb_tpu/kv/fault_injection.py": _FP_REGISTRY,
+        "tidb_tpu/copr/x.py": _FP_INJECTS,  # keeps the registry non-stale
+        "tidb_tpu/parallel/x.py": aliased,
+    }
+    r = scan(Tree(dict(files)), rules=["failpoint-registry"])
+    assert not r.findings and r.suppressed == 1
+    # without the suppression the aliased call is still recognized
+    files["tidb_tpu/parallel/x.py"] = aliased.replace(
+        "  # graftcheck: off=failpoint-registry", ""
+    )
+    assert [f.symbol for f in scan(Tree(files), rules=["failpoint-registry"]).findings] == ["mystery"]
+
+
+def test_failpoint_registry_real_tree_is_consistent():
+    """The shipped registry matches the shipped inject sites exactly and
+    every test reference resolves (the live invariant, not a fixture)."""
+    tree = build_tree(ROOT)
+    assert not scan(tree, rules=["failpoint-registry"]).findings
+
+
+# -- except-swallow rule (PR 14) ---------------------------------------------
+
+
+def test_except_swallow_flags_pass_and_bare():
+    bad = (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "def h():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except:\n"
+        "        return 1\n"
+    )
+    r = _scan_src("tidb_tpu/kv/x.py", bad, ["except-swallow"])
+    assert len(r.findings) == 2
+    assert {f.line for f in r.findings} == {4, 9}
+
+
+def test_except_swallow_allows_narrowed_and_handled():
+    ok = (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except ValueError:\n"
+        "        pass\n"
+        "def h(self):\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception as e:\n"
+        "        self.errors += 1\n"
+        "        self.last = e\n"
+    )
+    assert not _scan_src("tidb_tpu/kv/x.py", ok, ["except-swallow"]).findings
+
+
+def test_except_swallow_flags_continue_and_tuple_broad():
+    bad = (
+        "def f(xs):\n"
+        "    for x in xs:\n"
+        "        try:\n"
+        "            g(x)\n"
+        "        except (ValueError, Exception):\n"
+        "            continue\n"
+    )
+    r = _scan_src("tidb_tpu/kv/x.py", bad, ["except-swallow"])
+    assert len(r.findings) == 1 and r.findings[0].line == 5
+
+
+def test_except_swallow_suppression_names_the_reason():
+    ok = (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    # advisory probe; sweep retries next tick\n"
+        "    except Exception:  # graftcheck: off=except-swallow\n"
+        "        pass\n"
+    )
+    r = _scan_src("tidb_tpu/kv/x.py", ok, ["except-swallow"])
+    assert not r.findings and r.suppressed == 1

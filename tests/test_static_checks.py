@@ -43,6 +43,8 @@ def test_every_rule_ran_and_documents_itself():
         "thread-name",
         "metric-labels",
         "dead-code",
+        "failpoint-registry",
+        "except-swallow",
     }
     assert expected <= set(rules)
     for r in rules.values():

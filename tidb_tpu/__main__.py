@@ -86,8 +86,8 @@ def main(argv=None) -> int:
     if status is not None:
         try:
             status.close()
-        except Exception:
-            pass
+        except OSError:
+            pass  # shutdown: the status socket may already be torn down
     recorder().stop()
     return 0
 

@@ -779,6 +779,26 @@ def bench_balancer_converge() -> float:
     return elapsed
 
 
+@register("fuzz_cases_per_s")
+def bench_fuzz_throughput() -> float:
+    """graftfuzz campaign throughput (cases/s, higher is better): a fixed-
+    seed 60-case campaign with the tier-1 smoke lane's narrow query pools.
+    Guards the harness's cost model — the oracle set is only CI-viable while
+    the kernel-compile amortization holds (pooled DBs, bounded per-profile
+    query vocabulary), so a regression here means the smoke lane's 90 s
+    budget is rotting. Hard-fails on any divergence: a bench box finding a
+    parity bug must not record it as a throughput number."""
+    from tidb_tpu.tools.fuzz.harness import run_campaign
+
+    res = run_campaign(seed=1234, cases=60, pool_size=6, do_shrink=False)
+    if res.findings or res.errors:
+        raise RuntimeError(
+            f"fuzz campaign not clean: {len(res.findings)} finding(s), "
+            f"{res.errors} harness error(s)\n" + res.findings_json()
+        )
+    return res.checked / max(res.elapsed_s, 1e-9)
+
+
 def run_all(names=None) -> list[dict]:
     out = []
     for name, fn in _BENCHES.items():
@@ -788,6 +808,10 @@ def run_all(names=None) -> list[dict]:
         rec = {"name": name, "date": datetime.date.today().isoformat()}
         if name.endswith("_ms"):
             rec["ms"] = round(v, 1)
+        elif name.endswith("_per_s"):
+            # small-magnitude throughput lane (e.g. fuzz cases/s): keep a
+            # decimal so the ±25% gate is not quantized away at values < 10
+            rec["ops_per_sec"] = round(v, 1)
         elif name.endswith("_s"):
             # seconds-scale latency lane: recorded in ms so check_regression
             # applies its lower-is-better rule unchanged
@@ -827,6 +851,14 @@ def main(argv=None):
     ap.add_argument("--only", nargs="*", default=None)
     ap.add_argument("--check", default=None, help="baseline JSON; exit 2 on regression")
     ap.add_argument("--tolerance", type=float, default=0.25)
+    ap.add_argument(
+        "--fuzz-minutes", type=float, default=None,
+        help="ALSO run a graftfuzz long campaign for N wall-clock minutes "
+        "(nightly lane; full-width query pools, repros under --fuzz-out); "
+        "exit 3 on any divergence",
+    )
+    ap.add_argument("--fuzz-seed", type=int, default=42)
+    ap.add_argument("--fuzz-out", default="fuzz_nightly")
     args = ap.parse_args(argv)
     records = run_all(args.only)
     with open(args.out, "w") as f:
@@ -844,6 +876,25 @@ def main(argv=None):
                 print(f"REGRESSION {line}")
             raise SystemExit(2)
         print("regression guard: ok")
+    if args.fuzz_minutes:
+        # nightly long campaign: wall-clock bounded, full-width query pools
+        # (the tier-1 smoke lane already covers the narrow ones), shrunk
+        # repros + findings.json land under --fuzz-out for triage
+        from tidb_tpu.tools.fuzz.harness import run_campaign
+
+        res = run_campaign(
+            seed=args.fuzz_seed,
+            minutes=args.fuzz_minutes,
+            out_dir=args.fuzz_out,
+            progress=lambda m: print(f"graftfuzz: {m}"),
+        )
+        print(
+            f"graftfuzz nightly: {res.checked} cases, {len(res.findings)} finding(s), "
+            f"{res.errors} harness error(s), {res.checked / max(res.elapsed_s, 1e-9):.1f} cases/s"
+        )
+        if res.findings or res.errors:
+            print(f"divergences/harness errors! shrunk repros in {args.fuzz_out}/ — fix or triage per STATIC_ANALYSIS.md")
+            raise SystemExit(3)
 
 
 if __name__ == "__main__":

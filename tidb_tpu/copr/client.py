@@ -19,6 +19,7 @@ import contextlib
 import os
 import threading
 import time
+from concurrent import futures
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Callable, Iterator, Optional
@@ -132,7 +133,7 @@ def windowed_fanout(pool, run: Callable, items: list, window: int):
             except BaseException as e:
                 try:
                     results[i].set_exception(e)
-                except Exception:
+                except futures.InvalidStateError:
                     pass  # consumer already cancelled this slot
 
     handles = [pool.submit(worker) for _ in range(min(window, n))]

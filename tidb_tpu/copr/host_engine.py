@@ -235,6 +235,42 @@ def minmax_sentinel(op: str, dtype):
     return info.max if op == "min" else info.min
 
 
+def _string_minmax(op: str, data, valid, seg, ngroups: int, dic, ci: bool):
+    """MIN/MAX over a dictionary-coded string lane. Codes are insertion-order
+    identities, not an order: reducing them raw returns whichever value was
+    dictionary-encoded first/last, which is wrong whenever the dictionary is
+    unsorted and ALWAYS wrong for general_ci (weight order ≠ byte order).
+    Rank the codes under the column's collation, reduce ranks, map back.
+    Within a ci weight class the byte order breaks ties, so the returned
+    member is deterministic. Found by graftfuzz (the whole-suite blind spot:
+    any prior device query force-sorts the dictionary and 'heals' the bin
+    case, so engine-parity tests never saw it)."""
+    vals = dic.values_array()
+    if ci:
+        from tidb_tpu.utils.collate import weight_bytes
+
+        order = sorted(range(len(vals)), key=lambda c: (weight_bytes(vals[c]), vals[c]))
+    else:
+        order = sorted(range(len(vals)), key=lambda c: vals[c])
+    rank_of = np.zeros(max(len(vals), 1), dtype=np.int64)
+    for r, c in enumerate(order):
+        rank_of[c] = r
+    safe = np.where(valid, data, 0).astype(np.int64)
+    ranks = rank_of[np.clip(safe, 0, len(rank_of) - 1)]
+    res, cnt = _segment_reduce(op, ranks, valid, seg, ngroups)
+    back = np.asarray(order if order else [0], dtype=np.int64)
+    codes = back[np.clip(np.where(cnt > 0, res, 0), 0, len(back) - 1)]
+    return codes.astype(data.dtype), cnt
+
+
+def string_minmax_needs_rank(ftype, dic) -> bool:
+    """True when raw-code reduction would misorder: ci collation (weight
+    order), or a dictionary whose codes are not rank-compacted yet."""
+    return ftype.kind == TypeKind.STRING and dic is not None and (
+        ftype.collation == "ci" or not dic.sorted
+    )
+
+
 def _segment_reduce(op: str, data: np.ndarray, valid: np.ndarray, seg: np.ndarray, ngroups: int):
     """→ (result, valid_count) per group."""
     w = valid.astype(np.int64)
@@ -255,6 +291,12 @@ def _segment_reduce(op: str, data: np.ndarray, valid: np.ndarray, seg: np.ndarra
         (np.minimum if op == "min" else np.maximum).at(out, seg, d)
         return out, cnt
     if op == "first_row":
+        if len(data) == 0:
+            # scalar agg over zero rows still emits its one group (MySQL:
+            # SELECT a, COUNT(*) FROM empty → (NULL, 0)); there is no row to
+            # take, so first_row is NULL — found by graftfuzz (repro
+            # tests/fuzz_corpus/repro_s42_c28.py), previously IndexError
+            return np.zeros(ngroups, dtype=data.dtype), np.zeros(ngroups, dtype=np.int64)
         first_idx = np.zeros(ngroups, dtype=np.int64)
         seen = np.zeros(ngroups, dtype=bool)
         # rows are already grouped contiguously: boundary rows are the firsts
@@ -335,7 +377,12 @@ def _aggregate(chunk: Chunk, ex: dagpb.ExecutorPB, warn=None) -> Chunk:
                 dtype = np.float64 if sum_ft.kind == TypeKind.FLOAT else np.int64
                 out_cols.append(Column(res.astype(dtype), cnt > 0, sum_ft))
             elif kind in ("min", "max", "first_row"):
-                res, cnt = _segment_reduce(kind, data, valid, seg_a, ngroups)
+                if kind != "first_row" and string_minmax_needs_rank(aft, adic):
+                    res, cnt = _string_minmax(
+                        kind, data, valid, seg_a, ngroups, adic, aft.collation == "ci"
+                    )
+                else:
+                    res, cnt = _segment_reduce(kind, data, valid, seg_a, ngroups)
                 sentinel_ok = cnt > 0 if kind != "first_row" else (cnt > 0)
                 out_cols.append(Column(res.astype(data.dtype), sentinel_ok, aft, adic))
             elif kind == "sumsq":

@@ -275,8 +275,10 @@ class DistTaskManager:
                     f"exec_id = '{self._esc(exec_id)}', lease = {now_ms + lease_ms} "
                     f"WHERE id = {sid} AND state = '{SubtaskState.PENDING}'"
                 )
-            except Exception:
-                continue  # write conflict: another node won the claim
+            # write conflict: another node won the claim — the protocol, not
+            # a failure (optimistic claim via conditional UPDATE)
+            except Exception:  # graftcheck: off=except-swallow
+                continue
             if getattr(res, "affected", 0) != 1:
                 continue
             task = self.get_task(tid)
@@ -310,8 +312,9 @@ class DistTaskManager:
                         f"{int(time.time() * 1000) + self.lease_ms} WHERE id = {st.id} "
                         f"AND state = '{SubtaskState.RUNNING}' AND exec_id = '{self._esc(st.exec_id)}'"
                     )
-                except Exception:
-                    pass  # store briefly unreachable; the next beat retries
+                # store briefly unreachable; the next beat retries the lease
+                except Exception:  # graftcheck: off=except-swallow
+                    pass
 
         hb = threading.Thread(target=heartbeat, daemon=True, name=f"disttask-hb-{st.id}")
         hb.start()
@@ -351,7 +354,9 @@ class DistTaskManager:
                         f"AND lease = {st.lease}"
                     )
                     n += getattr(res, "affected", 0)
-                except Exception:
+                # reclaim is best-effort: a missed subtask is retried by the
+                # next expiry sweep (lease still expired)
+                except Exception:  # graftcheck: off=except-swallow
                     pass
         return n
 

@@ -753,15 +753,29 @@ def merge_partials(chunk: Chunk, aggs: list[AggDesc], ngroup: int) -> Chunk:
                 np.logical_or.at(anyv, seg, valid)
                 state_cols.append(Column(out.astype(data.dtype), anyv, c.ftype))
             elif pk in ("min", "max"):
-                from tidb_tpu.copr.host_engine import minmax_sentinel
+                from tidb_tpu.copr.host_engine import (
+                    _string_minmax,
+                    minmax_sentinel,
+                    string_minmax_needs_rank,
+                )
 
-                sentinel = minmax_sentinel(pk, data.dtype)
-                d = np.where(valid, data, sentinel).astype(data.dtype)
-                out = np.full(ngroups, sentinel, dtype=data.dtype)
-                (np.minimum if pk == "min" else np.maximum).at(out, seg, d)
-                anyv = np.zeros(ngroups, dtype=bool)
-                np.logical_or.at(anyv, seg, valid)
-                state_cols.append(Column(out, anyv, c.ftype, c.dictionary))
+                if string_minmax_needs_rank(c.ftype, c.dictionary):
+                    # partial states carry dictionary CODES; merging them raw
+                    # has the same misordering as the cop-side reduce (ci
+                    # weight order / unsorted dictionary — see host_engine)
+                    out, cntv = _string_minmax(
+                        pk, data, valid, seg, ngroups, c.dictionary,
+                        c.ftype.collation == "ci",
+                    )
+                    state_cols.append(Column(out, cntv > 0, c.ftype, c.dictionary))
+                else:
+                    sentinel = minmax_sentinel(pk, data.dtype)
+                    d = np.where(valid, data, sentinel).astype(data.dtype)
+                    out = np.full(ngroups, sentinel, dtype=data.dtype)
+                    (np.minimum if pk == "min" else np.maximum).at(out, seg, d)
+                    anyv = np.zeros(ngroups, dtype=bool)
+                    np.logical_or.at(anyv, seg, valid)
+                    state_cols.append(Column(out, anyv, c.ftype, c.dictionary))
             elif pk == "first_row":
                 first_idx = np.nonzero(boundary)[0] if n else np.empty(0, np.int64)
                 # first VALID row per group preferred

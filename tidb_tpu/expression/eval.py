@@ -1343,8 +1343,8 @@ def _json_unquote(xp, args, ctx):
         if t.startswith('"') and t.endswith('"'):
             try:
                 t = _json.loads(t)
-            except Exception:
-                pass
+            except ValueError:
+                pass  # not valid JSON text: unquote is a no-op, keep as-is
         out.append(t.encode() if isinstance(t, str) else t)
     return _encode_strs(ctx, out)
 

@@ -61,14 +61,24 @@ class ExtensionRegistry:
             try:
                 e.on_stmt_event(ev)
             except Exception:
-                pass  # extensions never break queries
+                _hook_error(e, "stmt")  # extensions never break queries
 
     def notify_conn(self, ev: ConnEvent) -> None:
         for e in self._exts:
             try:
                 e.on_connection_event(ev)
             except Exception:
-                pass
+                _hook_error(e, "conn")
+
+
+def _hook_error(ext: "Extension", hook: str) -> None:
+    """A broken extension must not break queries, but its failures must be
+    visible AND attributable: count per (extension, hook) so /metrics names
+    the misbehaving plugin instead of it failing silently forever. (Label
+    cardinality is the registered-extension set — bounded per process.)"""
+    from tidb_tpu.utils import metrics as _m
+
+    _m.EXT_HOOK_ERRORS.inc(ext=getattr(ext, "name", type(ext).__name__), hook=hook)
 
 
 class AuditLogger(Extension):

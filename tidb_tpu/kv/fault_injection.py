@@ -26,6 +26,34 @@ import threading
 import time
 from typing import Callable, Optional, Sequence
 
+# -- failpoint registry ------------------------------------------------------
+#
+# The authoritative set of failpoint names the package defines (every
+# ``failpoint.inject("<name>", ...)`` call site). Failpoints are armed by
+# bare string name, so a typo'd name in a chaos test silently never fires
+# and the test passes vacuously; graftcheck's ``failpoint-registry`` rule
+# cross-checks every reference in package code AND tests/ against this set,
+# and flags stale entries whose inject site was removed. Adding a new
+# inject site means adding its name here in the same change.
+
+FAILPOINTS = frozenset(
+    {
+        "colcache_merge",  # copr/colcache.py: mid-merge crash atomicity
+        "cop_task_engine",  # copr/client.py: per-task engine fault/degrade
+        "ddl/afterStateSwitch",  # catalog/ddl.py: crash between DDL states
+        "ddl/beforeBackfillBatch",  # catalog/ddl.py: crash mid-backfill
+        "disttask_local_worker_start",  # disttask/framework.py: slow worker
+        "import_subtask_before_ingest",  # tools/importer.py: subtask restart
+        "mpp_run_fragment",  # parallel/gather.py: fragment dispatch fault
+        "mpp_shard_slow",  # parallel/gather.py: per-shard straggler delay
+        "placement_cutover",  # kv/placement.py: hold the migration fence
+        "placement_migrate_batch",  # kv/placement.py: slow copy batches
+        "remote_send",  # kv/remote.py: wire frame drop/delay on send
+        "remote_recv",  # kv/remote.py: wire frame drop/delay on receive
+        "table_reader_begin",  # executor/executors.py: park a reader mid-stmt
+    }
+)
+
 
 class InjectionConfig:
     """Configurable error hooks. Each hook is ``(exception, remaining)``:

@@ -23,6 +23,9 @@ class GCWorker:
         self.safe_point = 0
         self.runs = 0
         self.last_pruned = 0
+        # background-loop failure visibility (the loop itself never dies)
+        self.sweep_errors = 0
+        self.last_error = ""
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -61,8 +64,11 @@ class GCWorker:
             while not self._stop.wait(self.interval_s):
                 try:
                     self.run_once()
-                except Exception:
-                    pass  # GC must never take the server down
+                except Exception as e:
+                    # GC must never take the server down, but a failing sweep
+                    # must be visible (sys_snapshot ships the registry)
+                    self.sweep_errors += 1
+                    self.last_error = f"{type(e).__name__}: {e}"
 
         self._thread = threading.Thread(target=loop, name="gc-worker", daemon=True)
         self._thread.start()

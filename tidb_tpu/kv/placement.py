@@ -474,8 +474,10 @@ def _shard_weights(db, store):
                     except ValueError:
                         continue
                     cop_execs[tid] = cop_execs.get(tid, 0) + int(st.get("exec_count", 0))
-    except Exception:
-        pass  # load probes are advisory; the balancer still sees row weights
+    # load probes are advisory: the balancer still sees row weights, and a
+    # dead store's missing report must never abort the sweep
+    except Exception:  # graftcheck: off=except-swallow
+        pass
     weights = [0.0] * len(store.stores)
     tables = []
     for db_name in db.catalog.databases():
@@ -514,7 +516,9 @@ def balancer_sweep(db, max_moves: int = 1) -> dict:
         for o in db.health.sweep(sections=()):
             if 0 <= o.get("shard", -1) < len(live):
                 live[o["shard"]] = bool(o["ok"])
-    except Exception:
+    # health is advisory too: with no sweep every shard stays eligible,
+    # which only risks a move the next tick would undo
+    except Exception:  # graftcheck: off=except-swallow
         pass
     moves: list[dict] = []
     for _ in range(max_moves):

@@ -276,9 +276,10 @@ class StoreServer:
         while not self._stop.wait(interval):
             try:
                 cache_for(self.store).merge_pending(should_stop=self._stop.is_set)
-            except Exception:
-                pass  # a failed sweep retries next tick; queries still merge
-                # on the query-path threshold
+            # a failed sweep retries next tick; queries still merge on the
+            # query-path threshold, so nothing is lost — only deferred
+            except Exception:  # graftcheck: off=except-swallow
+                pass
 
     def shutdown(self) -> None:
         if getattr(self, "_rec_started", False) and not self._stop.is_set():

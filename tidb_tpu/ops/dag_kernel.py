@@ -137,8 +137,10 @@ def _ensure_x64():
         try:
             jax.config.update("jax_compilation_cache_dir", cc)
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        except Exception:
-            pass  # older jax without the persistent cache: cold compiles only
+        # older jax without the persistent-cache config knobs: cold compiles
+        # only — strictly a performance feature, never a correctness one
+        except Exception:  # graftcheck: off=except-swallow
+            pass
         _ensure_x64._cc_done = True
 
 

@@ -1592,7 +1592,14 @@ class MPPGatherExec:
             for g in agg.group_by:
                 d, v, _ = eval_expr(g, batch, jnp)
                 n = pairs[0][0].shape[0]
-                d = jnp.broadcast_to(d, (n,)).astype(jnp.int64)
+                # int-backed keys (ints, dict codes, dates, decimals) widen
+                # to one uniform int64 sort lane; FLOAT keys must keep their
+                # dtype — an int64 cast truncates the VALUE (3.25 → 3) and
+                # can merge distinct groups. Float lanes carry bounds=None,
+                # so they always take the generic dtype-preserving sort path
+                # (found by graftfuzz, repro tests/fuzz_corpus/repro_s42_c199.py)
+                d = jnp.broadcast_to(d, (n,))
+                d = d.astype(jnp.float64) if jnp.issubdtype(d.dtype, jnp.floating) else d.astype(jnp.int64)
                 v = jnp.broadcast_to(v if v is not None else True, (n,))
                 out.append(jnp.where(v, d, 0))
                 out.append(v.astype(jnp.int64))
@@ -1810,7 +1817,9 @@ class MPPGatherExec:
                     # program's sink (with the next program's t0)
                     try:
                         jax.effects_barrier()
-                    except Exception:
+                    # the attempt already failed; the barrier is best-effort
+                    # draining of straggler probes on the way to the retry
+                    except Exception:  # graftcheck: off=except-swallow
                         pass  # the attempt's own error is the one to surface
                     _SHARD_OBS["sink"] = None
                 # grow-and-retry attempts overwrite: the SUCCESSFUL run wins

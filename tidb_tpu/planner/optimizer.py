@@ -652,6 +652,27 @@ def _build_index_access(scan: LogicalScan, acc, conds: list[Expression]):
 # ---------------------------------------------------------------------------
 
 
+def _ci_order_keys(exprs) -> bool:
+    """Any general_ci string among ``exprs`` used as an ORDER key (TopN) or
+    MIN/MAX argument? Device order semantics come from sorted-dictionary
+    byte ranks, but ci orders by weight class ('a' ≡ 'A' < 'B'), so a
+    device TopN could select the wrong candidate SET, not just a different
+    tie order — found by graftfuzz; such keys stay host-side (the host
+    sort/agg paths rank by weight)."""
+    return any(
+        e is not None and e.ftype.kind == TypeKind.STRING and e.ftype.collation == "ci"
+        for e in exprs
+    )
+
+
+def _demote_ci_order(st: StoreType, engines: list[str], exprs) -> Optional[StoreType]:
+    """TPU → HOST when ``exprs`` are ci-order-sensitive; None when no engine
+    can serve them (push must be skipped, the root executor handles it)."""
+    if st != StoreType.TPU or not _ci_order_keys(exprs):
+        return st
+    return StoreType.HOST if "host" in engines else None
+
+
 def _pick_engine(engines: list[str], exprs: list[Expression]) -> StoreType:
     for name in engines:
         if name == "tpu" and all(can_push_down(e, "tpu") for e in exprs):
@@ -853,7 +874,10 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None, vars=None) -> P
         if can_push:
             exprs: list[Expression] = list(group_r) + [a.arg for a in aggs_r if a.arg is not None]
             st = _pick_engine(engines, list(reader.pushed_conditions) + exprs)
-            if all(can_push_down(e, st.value) for e in exprs) and all(
+            st = _demote_ci_order(
+                st, engines, [a.arg for a in aggs_r if a.name in ("min", "max")]
+            )
+            if st is not None and all(can_push_down(e, st.value) for e in exprs) and all(
                 can_push_down(c, st.value) for c in reader.pushed_conditions
             ):
                 reader.store_type = st
@@ -899,7 +923,8 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None, vars=None) -> P
                 and reader.pushed_limit is None
             ):
                 st = _pick_engine(engines, list(reader.pushed_conditions) + [e for e, _ in by])
-                if all(can_push_down(e, st.value) for e, _ in by) and all(
+                st = _demote_ci_order(st, engines, [e for e, _ in by])
+                if st is not None and all(can_push_down(e, st.value) for e, _ in by) and all(
                     can_push_down(c, st.value) for c in reader.pushed_conditions
                 ):
                     reader.store_type = st
@@ -1142,7 +1167,10 @@ def _physical_rollup(plan: LogicalAggregation, engines, stats, vars) -> Physical
             a.arg for a in plan.aggs if a.arg is not None
         ]
         st = _pick_engine(engines, list(child.pushed_conditions) + exprs)
-        if all(can_push_down(e, st.value) for e in exprs) and all(
+        st = _demote_ci_order(
+            st, engines, [a.arg for a in plan.aggs if a.name in ("min", "max")]
+        )
+        if st is not None and all(can_push_down(e, st.value) for e in exprs) and all(
             can_push_down(c, st.value) for c in child.pushed_conditions
         ):
             child.store_type = st

@@ -260,6 +260,14 @@ def detach_index_conditions(
         if pos is None:
             break
         ftype = table.columns[off].ftype
+        if ftype.kind == TypeKind.STRING and ftype.collation == "ci":
+            # index keys are byte-encoded raw values, but general_ci equality
+            # holds across byte-distinct members of a weight class ('a' ≡
+            # 'A'): a byte range can only under-select. Stop the usable
+            # prefix here — comparisons on this column stay residual filters
+            # (which evaluate collation-aware). Found by graftfuzz's TLP
+            # oracle on BOTH engines (repro tests/fuzz_corpus/repro_s42_c20.py)
+            break
         bound, used = _extract_col_conds(conds, pos, ftype)
         if bound.empty:
             return IndexAccess(index, [], used_all + used, [c for c in conds], eq_len, False, 0)

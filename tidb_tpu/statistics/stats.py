@@ -104,8 +104,10 @@ class StatsHandle:
     def _load_bg(self, table_id: int) -> None:
         try:
             self.load_sync(table_id)
-        except Exception:
-            pass  # missing/corrupt persisted stats: stay on pseudo stats
+        # missing/corrupt persisted stats: stay on pseudo stats — the
+        # planner's documented degraded mode, re-probed on the next miss
+        except Exception:  # graftcheck: off=except-swallow
+            pass
         finally:
             with self._mu:
                 self._loading.discard(table_id)
@@ -128,7 +130,9 @@ class StatsHandle:
                 if cs.is_string:
                     try:
                         cs.dictionary = self._dict_resolver(table_id, cs.offset)
-                    except Exception:
+                    # no dictionary (column never decoded on this node):
+                    # string estimates fall back to containment heuristics
+                    except Exception:  # graftcheck: off=except-swallow
                         pass
         with self._mu:
             if table_id in self._tables:

@@ -167,6 +167,7 @@ def load_rules() -> dict[str, Rule]:
         rules_hygiene,
         rules_locks,
         rules_pyopt,
+        rules_robust,
         rules_wire,
     )
 

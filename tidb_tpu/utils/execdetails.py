@@ -347,8 +347,8 @@ def plan_digest(plan) -> str:
         d = hashlib.sha256(text.encode()).hexdigest()[:16]
         try:
             plan._plan_digest = d
-        except Exception:
-            pass
+        except AttributeError:
+            pass  # __slots__ plan nodes can't memoize; recompute next time
     return d
 
 

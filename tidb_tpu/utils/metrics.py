@@ -211,6 +211,11 @@ QUERY_DURATION = REGISTRY.histogram(
     "tidb_tpu_server_handle_query_duration_seconds", "Statement latency"
 )
 COP_TASKS = REGISTRY.counter("tidb_tpu_copr_task_total", "Coprocessor tasks", ("engine",))
+# extension hook failures (hooks may not break queries, but a misbehaving
+# plugin must be visible — see extension.ExtensionRegistry._hook_error)
+EXT_HOOK_ERRORS = REGISTRY.counter(
+    "tidb_tpu_extension_hook_error_total", "Extension callback failures", ("ext", "hook")
+)
 # session plan reuse (statement fast lane + value-agnostic prepared plans)
 PLAN_CACHE = REGISTRY.counter(
     "tidb_tpu_session_plan_cache_total",
