@@ -1,0 +1,41 @@
+"""graftfuzz-style regression for the DEVICE ci MIN/MAX path (the PR 14
+follow-up: the planner used to demote ci MIN/MAX args to the host engine —
+``optimizer._demote_ci_order`` — because device code reduction ranked by
+dictionary byte order, not general_ci weight order).
+
+Now the binder rank-compacts ci dictionaries under (weight_bytes, bytes)
+(``Dictionary.compact(ci=True)`` via ``ensure_sorted_dict(..., ci=True)``),
+so device code MIN/MAX picks the same member the host's ``_string_minmax``
+ranking picks. The values below make byte order and weight order disagree
+('B' < 'a' in bytes, 'a' < 'B' under ci) so a regression to raw byte-rank
+reduction diverges immediately. Replayed by tests/test_fuzz_corpus.py;
+runnable standalone.
+"""
+
+from tidb_tpu.tools.fuzz.runner import run_repro
+
+SPEC = {
+    "setup": [
+        "CREATE TABLE c0 (g BIGINT, s VARCHAR(8) COLLATE utf8mb4_general_ci)",
+        "INSERT INTO c0 VALUES (0, 'B'), (0, 'a'), (1, 'c'), (1, 'A'), (1, NULL), (2, NULL)",
+    ],
+    "dml": [],
+    "merge": False,
+    "mpp": False,
+    "region_split_keys": 1 << 62,
+    "oracle": "differential",
+    "phase": "cold",
+    "query": "SELECT g, MIN(s), MAX(s) FROM c0 GROUP BY g",
+    "ordered": False,
+    "ci_lax": [],
+    "ci_free": [],
+}
+
+
+def test_repro():
+    run_repro(SPEC)
+
+
+if __name__ == "__main__":
+    test_repro()
+    print("no divergence — device ci MIN/MAX matches the host weight ranking")

@@ -226,9 +226,12 @@ def test_mpp_gather_exec_info_line():
     text = "\n".join(r[0] for r in rows)
     if "PhysMPPGather" not in text:
         pytest.skip("planner did not choose MPP on this host")
-    m = re.search(r"mpp_task: \{fragments: (\d+), ndev: (\d+), wall: ([\d.]+)ms, rows: (\d+)", text)
+    m = re.search(
+        r"mpp_task: \{fragments: (\d+), stages: (\d+), ndev: (\d+), wall: ([\d.]+)ms, rows: (\d+)",
+        text,
+    )
     assert m, text
-    assert int(m.group(1)) >= 2 and int(m.group(2)) >= 1
+    assert int(m.group(1)) >= 2 and int(m.group(2)) >= 1 and int(m.group(3)) >= 1
     # and the always-on statement aggregate saw it too
     s.query(q)
     assert s.mpp_details and s.mpp_details[0].ndev >= 1

@@ -6,8 +6,9 @@ STATIC_ANALYSIS.md § graftfuzz for the corpus/triage policy).
 Also pins, as direct unit tests, the fuzz-found bugs whose oracle form
 cannot re-trigger on the fixed tree (the host string MIN/MAX misorder:
 any device MIN/MAX query force-sorts the shared dictionary and partially
-'heals' the bin case, and ci MIN/MAX is now demoted off the device — so a
-differential replay compares host against host)."""
+'heals' the bin case). ci MIN/MAX runs DEVICE-side now — the binder
+compacts ci dictionaries under the weight order — so its differential
+repro (repro_ci_minmax_device) is a real device-vs-host check."""
 
 import glob
 import importlib.util
@@ -63,8 +64,9 @@ def test_host_string_minmax_unsorted_dict():
 
 def test_host_string_minmax_ci_weight_order():
     """general_ci MIN/MAX ranks by weight class ('a' ≡ 'A' < 'B' < 'zz'),
-    never by byte order, on BOTH engines (the device demotes ci MIN/MAX to
-    the host path — optimizer._demote_ci_order)."""
+    never by byte order, on BOTH engines — the device runs it natively now
+    over a ci-weight-compacted dictionary (Dictionary.compact(ci=True));
+    the planner no longer demotes (PR 14 follow-up closed)."""
     db = tidb_tpu.open()
     db.execute("CREATE TABLE t (a VARCHAR(8) COLLATE utf8mb4_general_ci, v BIGINT)")
     db.execute("INSERT INTO t VALUES ('B', 1), ('a', 2), ('zz', 3), ('A', 4)")

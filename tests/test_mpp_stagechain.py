@@ -1,0 +1,209 @@
+"""Staged fragment pipelines (ISSUE 15): multi-stage queries execute as ONE
+composed shard_map program — the subplan aggregate's output slots stay
+device-resident and the consumer join re-partitions them with an on-device
+``all_to_all`` on the new key, instead of the old D2H gather → host re-slice
+→ H2D re-upload. Parity-tested against the host path at forced mesh widths
+1 and 4 (NULL keys included), with the ZERO-intermediate-host-bytes counter
+asserted, the EXPLAIN ANALYZE ``mpp_task`` stage count checked, and a
+dead-store chaos case on the hybrid shards × devices path."""
+
+import numpy as np
+import pytest
+
+import tidb_tpu
+from tidb_tpu.executor.load import bulk_load
+from tidb_tpu.parallel import mesh as mesh_mod
+from tidb_tpu.utils import metrics
+
+
+@pytest.fixture(scope="module")
+def db():
+    d = tidb_tpu.open(region_split_keys=1 << 62)
+    rng = np.random.default_rng(15)
+    n_l, n_p, n_o = 4000, 200, 3000
+    d.execute("CREATE TABLE li (l_partkey BIGINT, l_qty BIGINT, l_price BIGINT)")
+    d.execute("CREATE TABLE part (p_partkey BIGINT PRIMARY KEY, p_brand BIGINT)")
+    d.execute("CREATE TABLE fact (fk BIGINT, v BIGINT)")
+    d.execute("CREATE TABLE dim (dk BIGINT PRIMARY KEY, g BIGINT)")
+    d.execute("CREATE TABLE outer_t (ok BIGINT, w BIGINT)")
+    bulk_load(d, "li", [rng.integers(0, n_p + 10, n_l), rng.integers(1, 50, n_l),
+                        rng.integers(100, 9000, n_l)])
+    bulk_load(d, "part", [np.arange(n_p), rng.integers(0, 9, n_p)])
+    bulk_load(d, "fact", [rng.integers(0, n_p, n_l), rng.integers(0, 100, n_l)])
+    bulk_load(d, "dim", [np.arange(n_p), rng.integers(0, 30, n_p)])
+    bulk_load(d, "outer_t", [rng.integers(0, 30, n_o), rng.integers(0, 50, n_o)])
+    # adversarial rows: NULL join keys, NULL agg args, NULL group keys
+    d.execute("INSERT INTO li VALUES (NULL, 10, 500), (3, NULL, NULL)")
+    d.execute("INSERT INTO fact VALUES (NULL, 7), (5, NULL)")
+    d.execute("INSERT INTO outer_t VALUES (NULL, 9)")
+    for t in ("li", "part", "fact", "dim", "outer_t"):
+        d.execute(f"ANALYZE TABLE {t}")
+    return d
+
+
+def _staged_vs_host(db, sql, ndevs=(1, 4), expect_stages=2):
+    """The parity oracle: run ``sql`` staged at each forced mesh width and
+    compare against the host path; the staged runs must move ZERO
+    intermediate bytes through the host and report the stage count."""
+    host_s = db.session()
+    host_s.execute("SET tidb_allow_mpp = 0")
+    want = host_s.query(sql)
+    for nd in ndevs:
+        mesh_mod.FORCE_NDEV = nd
+        try:
+            s = db.session()
+            before = metrics.MPP_HOST_INTERMEDIATE.total()
+            got = s.query(sql)
+            moved = metrics.MPP_HOST_INTERMEDIATE.total() - before
+            det = s.mpp_details[-1] if s.mpp_details else None
+            assert det is not None, f"no MPP gather formed at ndev={nd} for: {sql}"
+            assert det.stages == expect_stages, (det.stages, expect_stages, sql)
+            assert det.ndev == nd
+            assert moved == 0, f"{moved} intermediate bytes crossed the host at ndev={nd}"
+            if expect_stages > 1:
+                # the inter-stage repartition actually moved lanes on-mesh
+                assert len(det.stage_bytes) == expect_stages - 1
+            assert sorted(map(repr, got)) == sorted(map(repr, want)), (nd, got[:5], want[:5])
+        finally:
+            mesh_mod.FORCE_NDEV = None
+
+
+def test_staged_subplan_parity_q17_shape(db):
+    """The decorrelated correlated-aggregate (Q17) subplan runs as a device
+    stage: stage 1 = per-key AVG over li, repartitioned on the join key into
+    stage 2 = the probe join + final agg."""
+    _staged_vs_host(
+        db,
+        "SELECT SUM(l_price) FROM li, part WHERE p_partkey = l_partkey "
+        "AND p_brand = 3 AND l_qty < (SELECT 0.2 * AVG(l_qty) FROM li WHERE l_partkey = p_partkey)",
+    )
+
+
+def test_agg_over_join_restaged_parity(db):
+    """An agg-over-JOIN derived table re-keyed into a second join: the walk
+    lifts the inner agg into its own gather, and _subplan_side RE-ABSORBS it
+    as a device stage of the consumer — one composed program."""
+    _staged_vs_host(
+        db,
+        "SELECT SUM(w * c) FROM outer_t JOIN "
+        "(SELECT g, SUM(v + g) c FROM fact JOIN dim ON fk = dk GROUP BY g) sub "
+        "ON ok = sub.g",
+    )
+
+
+def test_staged_min_max_and_count_lanes(db):
+    """Stage finalize covers every agg kind (count/sum/avg/min/max) with the
+    host finalize semantics — sentinels for extremes, validity counts. (Agg
+    args read the BUILD side so the inner gather keeps its direct form; an
+    all-probe-side agg takes the pre-agg-pushdown form instead, which runs
+    as its own gather + host merge — a still-open re-absorption case.)"""
+    _staged_vs_host(
+        db,
+        "SELECT SUM(w + mx) FROM outer_t JOIN "
+        "(SELECT g, MIN(v + g) mn, MAX(v - g) mx, COUNT(*) c FROM fact JOIN dim ON fk = dk GROUP BY g) sub "
+        "ON ok = sub.g WHERE w > 2",
+    )
+
+
+def test_stage_chain_null_keys(db):
+    """NULL probe keys, NULL stage join keys, and NULL agg args flow through
+    the staged path with host NULL semantics (NULL keys match nothing; NULL
+    args drop out of the aggregate, not the group)."""
+    _staged_vs_host(
+        db,
+        "SELECT SUM(l_price) FROM li, part WHERE p_partkey = l_partkey "
+        "AND l_qty < (SELECT 2 + AVG(l_qty) FROM li WHERE l_partkey = p_partkey)",
+    )
+
+
+def test_explain_analyze_reports_stage_count(db):
+    """Acceptance: EXPLAIN ANALYZE's mpp_task line reports the stage count
+    of the composed program."""
+    import re
+
+    s = db.session()
+    sql = (
+        "SELECT SUM(l_price) FROM li, part WHERE p_partkey = l_partkey "
+        "AND p_brand = 3 AND l_qty < (SELECT 0.2 * AVG(l_qty) FROM li WHERE l_partkey = p_partkey)"
+    )
+    text = "\n".join(r[0] for r in s.execute("EXPLAIN ANALYZE " + sql).rows)
+    m = re.search(r"mpp_task: \{fragments: \d+, stages: (\d+),", text)
+    assert m, text
+    assert int(m.group(1)) == 2, text
+    assert "stage_bytes: [" in text, text
+
+
+def test_program_cache_spans_stage_chain(db):
+    """The composed staged program rides the fragment-program cache: a
+    repeat execution of the same staged shape compiles NOTHING."""
+    s = db.session()
+    sql = (
+        "SELECT SUM(w * c) FROM outer_t JOIN "
+        "(SELECT g, COUNT(*) c, SUM(v + g) sv FROM fact JOIN dim ON fk = dk GROUP BY g) sub "
+        "ON ok = sub.g"
+    )
+    s.query(sql)  # pays any compile
+    miss0 = metrics.MPP_PROGRAM_CACHE.get(result="miss")
+    s.query(sql)
+    assert metrics.MPP_PROGRAM_CACHE.get(result="miss") == miss0
+    det = s.mpp_details[-1]
+    assert det.stages == 2 and det.compiles == 0
+
+
+@pytest.mark.chaos
+def test_hybrid_mesh_store_death_mid_query():
+    """SIGKILL-one-store chaos on the hybrid shards × devices path: a
+    cross-shard gather runs on the coordinator mesh with per-owner reads;
+    killing the build table's owner mid-loop must surface a clean typed
+    error (no replica owns its data) or keep answering — never hang — and
+    the fleet keeps serving after the store returns."""
+    from tidb_tpu.kv.memstore import MemStore
+    from tidb_tpu.kv.sharded import ShardedStore
+    from tidb_tpu.session.session import DB
+
+    class _DeadStore:
+        """Every verb raises — the in-process analog of a SIGKILLed shard."""
+
+        nonce = "dead"
+
+        def __getattr__(self, name):
+            def _down(*a, **k):
+                raise ConnectionError("chaos: store down")
+
+            return _down
+
+    fleet = ShardedStore([MemStore(region_split_keys=100_000) for _ in range(2)])
+    db = DB(store=fleet)
+    s = db.session()
+    s.execute("CREATE TABLE ho (k BIGINT PRIMARY KEY, d BIGINT)")
+    s.execute("CREATE TABLE hl (k BIGINT, p BIGINT)")
+    s.execute("INSERT INTO ho VALUES " + ",".join(f"({i},{i % 5})" for i in range(200)))
+    s.execute("INSERT INTO hl VALUES " + ",".join(f"({i % 200},{100 + i})" for i in range(1000)))
+    s.execute("ANALYZE TABLE ho")
+    s.execute("ANALYZE TABLE hl")
+    tid_o = db.catalog.table("test", "ho").id
+    tid_l = db.catalog.table("test", "hl").id
+    assert fleet.shard_of_table(tid_o) != fleet.shard_of_table(tid_l), "tables must straddle"
+    s.execute("SET tidb_enforce_mpp = 1")
+    q = "SELECT d, SUM(p) FROM hl, ho WHERE hl.k = ho.k GROUP BY d ORDER BY d"
+    h0 = metrics.MPP_HYBRID.total()
+    want = s.query(q)
+    assert metrics.MPP_HYBRID.total() > h0, "straddling gather must take the hybrid path"
+    assert len(want) == 5
+    # SIGKILL the build-side owner: the hybrid read path must fail TYPED
+    victim = fleet.shard_of_table(tid_o)
+    alive = fleet.stores[victim]
+    fleet.stores[victim] = _DeadStore()
+    try:
+        s2 = db.session()
+        s2.execute("SET tidb_enforce_mpp = 1")
+        with pytest.raises(Exception) as ei:
+            s2.query(q)
+        # a clean verdict, never a hang or a silent wrong answer
+        assert ei.value is not None
+    finally:
+        fleet.stores[victim] = alive
+    # the returning store serves the same hybrid gather again
+    s3 = db.session()
+    s3.execute("SET tidb_enforce_mpp = 1")
+    assert s3.query(q) == want

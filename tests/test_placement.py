@@ -297,6 +297,47 @@ def test_balancer_spreads_induced_skew():
         assert s.query(f"SELECT COUNT(*), SUM(v) FROM {t}") == [(800, 319600)]
 
 
+def test_balancer_embedded_hot_table_signal_converges():
+    """Embedded-fleet skew convergence on the HOT signal alone (ISSUE 15
+    satellite): three equal-row tables on one shard, but one is hammered
+    with cop queries — the per-store cop-digest rings (attached by
+    ShardedStore to in-process members, recorded by the embedded cop
+    client, shipped via sys_snapshot's statements section) must give
+    run_balancer the same hot boost a wire fleet gets, and the HOT table
+    must be the first to move."""
+    from tidb_tpu.kv.placement import _shard_weights
+
+    fleet = _fleet()
+    db, s = _mkdb(fleet)
+    hot_shard = None
+    tids = {}
+    for t in ("hz0", "hz1", "hz2"):
+        s.execute(f"CREATE TABLE {t} (id BIGINT PRIMARY KEY, v BIGINT)")
+        s.execute(f"INSERT INTO {t} VALUES " + ",".join(f"({i},{i})" for i in range(300)))
+        tids[t] = db.catalog.table("test", t).id
+        if hot_shard is None:
+            hot_shard = fleet.shard_of_table(tids[t])
+        else:
+            fleet.migrate_table(tids[t], hot_shard)
+        s.execute(f"ANALYZE TABLE {t}")
+    # hammer ONE table: its per-store cop ring accumulates the digest counts
+    for _ in range(30):
+        s.query("SELECT SUM(v) FROM hz1")
+    db.health.sweep()
+    weights, tables = _shard_weights(db, fleet)
+    by_name = {name: w for w, _tid, _si, name in tables}
+    assert by_name["test.hz1"] > by_name["test.hz0"] + 1000, by_name
+    for _ in range(6):
+        if db.run_balancer().get("balanced"):
+            break
+    # convergence: the induced skew spread, and the HOT table moved off the
+    # overloaded shard (the balancer picks the heaviest movable table first)
+    shards = {t: fleet.shard_of_table(tid) for t, tid in tids.items()}
+    assert len(set(shards.values())) >= 2, shards
+    assert shards["hz1"] != hot_shard, shards
+    assert s.query("SELECT COUNT(*) FROM hz1") == [(300,)]
+
+
 def test_ttl_fence_self_heals_after_aborted_migration():
     """A migration driver that dies between fencing and cutover leaves a
     TTL fence that expires on its own — the table returns to its old owner

@@ -108,8 +108,8 @@ def test_tables_split_across_stores(cluster):
 
 def test_cross_store_join_q3_parity(cluster):
     """Q3-shaped join whose two tables live on DIFFERENT store processes:
-    cop scans fan per owner; the join happens SQL-side (an MPP gather would
-    span owners, so the session falls back — exercised explicitly below)."""
+    per-owner reads cross the wire and the gather runs on the coordinator's
+    mesh (the hybrid shards × devices path — exercised explicitly below)."""
     db, _ = cluster
     s = db.session()
     got = s.execute(
@@ -128,8 +128,9 @@ def test_cross_store_join_q3_parity(cluster):
 
 def test_single_owner_mpp_agg(cluster):
     """A single-table gather has ONE owner → dispatched as a remote MPP task
-    to that store process; a cross-owner join gather is REFUSED by the
-    single-owner placement rule and the session re-plans without MPP."""
+    to that store process; a cross-owner join gather is refused by the
+    single-owner placement rule and runs on the HYBRID shards × devices path
+    instead (coordinator mesh + per-owner wire reads — never a dispatch)."""
     from tidb_tpu.kv.sharded import ShardedStore
 
     db, _ = cluster
@@ -156,11 +157,15 @@ def test_single_owner_mpp_agg(cluster):
         assert got == sorted(cnt.items())
         assert len(dispatched) == 1, "single-owner agg must ship as ONE remote MPP task"
         dispatched.clear()
+        from tidb_tpu.utils import metrics as _m
+
+        h0 = _m.MPP_HYBRID.get()
         join = s.execute(
             "SELECT o_odate, SUM(l_price) FROM lineitem2, orders "
             "WHERE l_orderkey = o_orderkey GROUP BY o_odate ORDER BY o_odate"
         ).rows
-        assert len(join) == 5 and not dispatched, "cross-owner gather must fall back"
+        assert len(join) == 5 and not dispatched, "cross-owner gather must not dispatch"
+        assert _m.MPP_HYBRID.get() > h0, "cross-owner gather must ride the hybrid path"
     finally:
         ShardedStore.mpp_dispatch = orig
         s.execute("SET tidb_enforce_mpp = 0")

@@ -779,6 +779,182 @@ def bench_balancer_converge() -> float:
     return elapsed
 
 
+def _scaling_curve(name: str, build, query: str, rows: int, expect_staged: bool = False):
+    """Shared harness of the scaling-curve lanes: run ``query`` at every
+    mesh width ndev ∈ {1, 2, 4, 8} available (forced shard counts on the
+    virtual CPU mesh; real devices when present) and HARD-GATE the curve —
+    the tentpole claim "one query, every chip" is only true if rows/s/chip
+    survives scale-out. Gates (RuntimeError, never assert — python -O):
+
+    - real accelerators: rows/s/chip at every width ≥ 0.5 × the 1-chip
+      figure (flat-curve tolerance);
+    - virtual CPU mesh (all widths share the same cores, so per-chip
+      flatness is unmeasurable): TOTAL rows/s at the widest mesh ≥ 0.1 ×
+      the 1-device figure — catches superlinear padding/exchange
+      pathologies CI can see;
+    - ``expect_staged``: the query must run the staged pipeline (stage
+      count ≥ 2) and move ZERO intermediate bytes through the host
+      (tidb_tpu_mpp_intermediate_host_bytes_total must not grow).
+
+    Returns rows/s/chip at the widest mesh (the --check trend metric)."""
+    import time as _t
+
+    import jax
+
+    from tidb_tpu.parallel import mesh as _mesh
+    from tidb_tpu.utils import metrics as _m
+
+    db, session_setup = build()
+    ndevs = [d for d in (1, 2, 4, 8) if d <= len(jax.devices())]
+    curve: dict[int, float] = {}
+    host_bytes0 = _m.MPP_HOST_INTERMEDIATE.total()
+    try:
+        for nd in ndevs:
+            _mesh.FORCE_NDEV = nd
+            s = db.session()
+            session_setup(s)
+            s.query(query)  # warm: compile + device lanes for THIS width
+            best = float("inf")
+            for _ in range(3):
+                t0 = _t.perf_counter()
+                s.query(query)
+                best = min(best, _t.perf_counter() - t0)
+            curve[nd] = rows / best
+            if expect_staged:
+                det = s.mpp_details[-1] if s.mpp_details else None
+                if det is None or det.stages < 2:
+                    raise RuntimeError(
+                        f"{name}: staged pipeline did not engage at ndev={nd} "
+                        f"(stages={det.stages if det else None})"
+                    )
+    finally:
+        _mesh.FORCE_NDEV = None
+    if expect_staged:
+        moved = _m.MPP_HOST_INTERMEDIATE.total() - host_bytes0
+        if moved:
+            raise RuntimeError(
+                f"{name}: staged pipeline moved {moved} intermediate bytes "
+                "through the host (must be zero)"
+            )
+    widest = ndevs[-1]
+    if len(ndevs) >= 2:
+        if jax.default_backend() == "cpu":
+            if curve[widest] < 0.1 * curve[1]:
+                raise RuntimeError(
+                    f"{name}: total throughput collapsed going wide: "
+                    + ", ".join(f"ndev={d}: {curve[d]:,.0f} rows/s" for d in ndevs)
+                )
+        else:
+            for d in ndevs[1:]:
+                if curve[d] / d < 0.5 * curve[1]:
+                    raise RuntimeError(
+                        f"{name}: rows/s/chip degraded at ndev={d}: "
+                        f"{curve[d] / d:,.0f} vs {curve[1]:,.0f} at 1 chip"
+                    )
+    return curve[widest] / widest
+
+
+@register("scaling_q1_rows_per_s_per_chip")
+def bench_scaling_q1() -> float:
+    """Q1-shaped single-table MPP agg at ndev ∈ {1, 2, 4, 8}: rows/s/chip
+    at the widest mesh, curve-gated (see _scaling_curve)."""
+    import numpy as np
+
+    import tidb_tpu
+    from tidb_tpu.executor.load import bulk_load
+
+    def build():
+        db = tidb_tpu.open(region_split_keys=1 << 62)
+        db.execute("CREATE TABLE sc1 (id BIGINT PRIMARY KEY, g BIGINT, v BIGINT)")
+        n = 400_000
+        rng = np.random.default_rng(41)
+        bulk_load(db, "sc1", [np.arange(n, dtype=np.int64), rng.integers(0, 6, n),
+                              rng.integers(0, 1000, n)])
+        db.execute("ANALYZE TABLE sc1")
+
+        def setup(s):
+            s.execute("SET tidb_enforce_mpp = 1")
+
+        return db, setup
+
+    return _scaling_curve(
+        "scaling_q1", build, "SELECT g, COUNT(*), SUM(v) FROM sc1 GROUP BY g", 400_000
+    )
+
+
+@register("scaling_q3_rows_per_s_per_chip")
+def bench_scaling_q3() -> float:
+    """Q3-shaped MPP join+agg at ndev ∈ {1, 2, 4, 8}: rows/s/chip at the
+    widest mesh, curve-gated."""
+    import numpy as np
+
+    import tidb_tpu
+    from tidb_tpu.executor.load import bulk_load
+
+    def build():
+        db = tidb_tpu.open(region_split_keys=1 << 62)
+        db.execute("CREATE TABLE sc3o (o_orderkey BIGINT PRIMARY KEY, o_odate BIGINT)")
+        db.execute("CREATE TABLE sc3l (l_orderkey BIGINT, l_price BIGINT)")
+        rng = np.random.default_rng(43)
+        n_o, n_l = 10_000, 100_000
+        bulk_load(db, "sc3o", [np.arange(n_o, dtype=np.int64), 8000 + rng.integers(0, 30, n_o)])
+        bulk_load(db, "sc3l", [rng.integers(0, n_o, n_l), rng.integers(100, 10_000, n_l)])
+        db.execute("ANALYZE TABLE sc3o")
+        db.execute("ANALYZE TABLE sc3l")
+
+        def setup(s):
+            s.execute("SET tidb_enforce_mpp = 1")
+
+        return db, setup
+
+    return _scaling_curve(
+        "scaling_q3",
+        build,
+        "SELECT o_odate, SUM(l_price) FROM sc3l, sc3o WHERE l_orderkey = o_orderkey "
+        "GROUP BY o_odate ORDER BY o_odate",
+        100_000,
+    )
+
+
+@register("scaling_q17_rows_per_s_per_chip")
+def bench_scaling_q17() -> float:
+    """Q17-shaped STAGED two-stage pipeline at ndev ∈ {1, 2, 4, 8}:
+    rows/s/chip at the widest mesh. Beyond the curve gate this lane proves
+    the staged path end-to-end: stage count ≥ 2 at every width and ZERO
+    intermediate bytes through the host (the subplan aggregate stays
+    device-resident; its repartition rides all_to_all on ICI)."""
+    import numpy as np
+
+    import tidb_tpu
+    from tidb_tpu.executor.load import bulk_load
+
+    def build():
+        db = tidb_tpu.open(region_split_keys=1 << 62)
+        db.execute("CREATE TABLE sc17l (l_partkey BIGINT, l_qty BIGINT, l_price BIGINT)")
+        db.execute("CREATE TABLE sc17p (p_partkey BIGINT PRIMARY KEY, p_brand BIGINT)")
+        rng = np.random.default_rng(47)
+        n_l, n_p = 100_000, 4_000
+        bulk_load(db, "sc17l", [rng.integers(0, n_p, n_l), rng.integers(1, 50, n_l),
+                                rng.integers(100, 10_000, n_l)])
+        bulk_load(db, "sc17p", [np.arange(n_p, dtype=np.int64), rng.integers(0, 9, n_p)])
+        db.execute("ANALYZE TABLE sc17l")
+        db.execute("ANALYZE TABLE sc17p")
+
+        def setup(s):
+            pass
+
+        return db, setup
+
+    return _scaling_curve(
+        "scaling_q17",
+        build,
+        "SELECT SUM(l_price) FROM sc17l, sc17p WHERE p_partkey = l_partkey "
+        "AND p_brand = 3 AND l_qty < (SELECT 0.2 * AVG(l_qty) FROM sc17l WHERE l_partkey = p_partkey)",
+        100_000,
+        expect_staged=True,
+    )
+
+
 @register("fuzz_cases_per_s")
 def bench_fuzz_throughput() -> float:
     """graftfuzz campaign throughput (cases/s, higher is better): a fixed-
@@ -860,6 +1036,18 @@ def main(argv=None):
     ap.add_argument("--fuzz-seed", type=int, default=42)
     ap.add_argument("--fuzz-out", default="fuzz_nightly")
     args = ap.parse_args(argv)
+    # scaling-curve lanes need a multi-device mesh: standalone CPU runs get
+    # the virtual 8-device host platform (must be set BEFORE the first lane
+    # initializes jax; inert when a real accelerator platform is preset —
+    # there the lanes use however many real chips exist)
+    import os as _os
+
+    if _os.environ.get("JAX_PLATFORMS", "cpu").startswith("cpu"):
+        flags = _os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            _os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     records = run_all(args.only)
     with open(args.out, "w") as f:
         json.dump(records, f, indent=1)

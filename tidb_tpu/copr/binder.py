@@ -325,7 +325,11 @@ class Binder:
 
     def _force_sorted(self, col_pb: dict):
         slot = self.scan_cols[col_pb["idx"]].column_id
-        self.cache.ensure_sorted_dict(self.table_id, slot)
+        # ci columns rank-compact under the general_ci WEIGHT order (byte
+        # tiebreak) — the only order they ever reduce/compare under; every
+        # other collation compacts under byte order. ft pb layout:
+        # [kind, length, scale, nullable, collation, json]
+        self.cache.ensure_sorted_dict(self.table_id, slot, ci=col_pb["ft"][4] == "ci")
 
     def bind_expr(self, pb: dict, allow_string_ref: bool = False) -> dict:
         tp = pb["tp"]

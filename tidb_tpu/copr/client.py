@@ -452,6 +452,23 @@ class CopClient:
                 )
             # processing = task wall minus its own backoff sleeps
             det.proc_ms = max((time.perf_counter() - t0) * 1000.0 - det.backoff_ms, 0.0)
+            ring = getattr(self.store, "cop_ring", None)
+            if ring is not None:
+                # per-store cop-digest ring (embedded fleet members only —
+                # attached by ShardedStore): the same per-TABLE digest the
+                # wire servers record, so the balancer's hot boost sees
+                # embedded and wire fleets identically
+                from tidb_tpu import config as _config
+
+                tid = dag.executors[0].table_id if dag.executors else 0
+                ring.record(
+                    f"cop table={tid} region={task.region.region_id}",
+                    det.proc_ms / 1000.0,
+                    len(chunk),
+                    user="store",
+                    slow_threshold_s=_config.current().store_slow_cop_ms / 1000.0,
+                    digest_val=f"cop:{tid}|cop table={tid}",
+                )
             return CopResult(chunk, task.task_id, task.region.region_id, det)
 
         if concurrency == 1 or len(tasks) == 1:

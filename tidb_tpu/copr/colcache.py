@@ -204,6 +204,22 @@ class ColumnCache:
                         total += getattr(data, "nbytes", 0) + getattr(valid, "nbytes", 0)
         return total
 
+    def table_resident_bytes(self, table_id: int) -> int:
+        """Cached bytes for ONE table (partition physical ids resolve to
+        their logical table) — the per-table residency signal the MPP
+        exchange-type cost model consults (a build side whose columns are
+        already resident broadcasts cheaper than the row count says)."""
+        total = 0
+        with self._mu:
+            want = self._resolve(table_id)
+            for coll in (self._entries, self._merged):
+                for (_rid, tid), e in coll.items():
+                    if self._alias.get(tid, tid) != want:
+                        continue
+                    for data, valid in getattr(e, "cols", {}).values():
+                        total += getattr(data, "nbytes", 0) + getattr(valid, "nbytes", 0)
+        return total
+
     # -- dictionaries ------------------------------------------------------
     def set_table_alias(self, physical_id: int, logical_id: int) -> None:
         """Partition physical ids share the logical table's dictionaries, so
@@ -218,15 +234,19 @@ class ColumnCache:
         with self._mu:
             return self._dicts.setdefault((self._resolve(table_id), slot), Dictionary())
 
-    def ensure_sorted_dict(self, table_id: int, slot: int) -> Dictionary:
-        """Rank-compact a dictionary so codes become order-preserving;
-        remaps codes in all cached regions of this column."""
+    def ensure_sorted_dict(self, table_id: int, slot: int, ci: bool = False) -> Dictionary:
+        """Rank-compact a dictionary so codes become order-preserving —
+        under byte order, or under the general_ci WEIGHT order with ``ci``
+        (the device ci MIN/MAX legalization: a ci column's only correct
+        order IS the weight order, and ci comparisons never push down, so no
+        byte-order consumer exists for it); remaps codes in all cached
+        regions of this column."""
         with self._mu:
             logical = self._resolve(table_id)
             dic = self._dicts.setdefault((logical, slot), Dictionary())
-            if dic.sorted:
+            if dic.ci_sorted if ci else dic.sorted:
                 return dic
-            remap = dic.compact()
+            remap = dic.compact(ci=ci)
             for (rid, tid), entry in self._entries.items():
                 if self._resolve(tid) == logical and slot in entry.cols:
                     data, valid = entry.cols[slot]
@@ -896,3 +916,12 @@ def cache_for(store: MemStore) -> ColumnCache:
             c = ColumnCache(store)
             _CACHES[store] = c
         return c
+
+
+def peek_resident_bytes(store, table_id: int) -> int:
+    """Cached bytes for one table WITHOUT creating a cache — the planner's
+    residency probe (planning a query must never allocate columnar state
+    for a store that has served none)."""
+    with _CACHES_MU:
+        c = _CACHES.get(store)
+    return c.table_resident_bytes(table_id) if c is not None else 0

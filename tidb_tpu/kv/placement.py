@@ -457,8 +457,9 @@ def _shard_weights(db, store):
     """Per-shard placement weight plus the movable tables behind it:
     → (weights list, [(weight, table_id, shard, name)]). Weight per table =
     stats row count (the durable skew signal) plus a hot boost from each
-    store's cop statement ring when the fleet ships one (wire fleets do;
-    embedded stores share one process registry, so only rows count there).
+    store's cop statement ring — wire servers record into their own
+    StmtSummary, embedded members into the per-store ``cop_ring`` the fleet
+    attaches at construction, so both fleet kinds ship the same signal.
     Partitioned tables are immovable for now — their physical views would
     each need their own binding."""
     cop_execs: dict[int, int] = {}

@@ -200,6 +200,12 @@ def sys_report(store=None, server=None, hist=None, sections=None) -> dict:
         from tidb_tpu.copr.colcache import cache_for
 
         rep["device_cache_bytes"] = cache_for(store).resident_bytes()
+        ring = getattr(store, "cop_ring", None)
+        if ring is not None and _want("statements"):
+            # embedded fleet member: its per-store cop-digest ring ships in
+            # the same section a store server's StmtSummary would, so the
+            # balancer's hot-table boost works in-process too
+            rep["statements"] = [st.to_pb() for st in ring.stats()[-64:]]
     if server is not None:
         rep["addr"] = f"{server.host}:{server.port}"
         with server._conns_mu:

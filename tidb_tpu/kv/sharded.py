@@ -153,6 +153,47 @@ class _ShardedSnapshot:
 
         return self._store._routed("snap_scan", run)
 
+    def scan_record_rows(self, kr: KeyRange):
+        """Record scan feeding the coordinator's columnar cache — the hybrid
+        shards × devices MPP path reads every owner from the SQL layer. A
+        region's range lives on exactly one owner, so this routes (no
+        fan-out); in-process members answer natively, wire members fall back
+        to a visible-pairs scan packed into BulkRows (their stable rows ride
+        the scan, and :meth:`ShardedStore.stable_parts` reports none for
+        them, so nothing double-counts)."""
+
+        def run():
+            si = self._store.shard_of_key(kr.start)
+            snap = self._store.stores[si].get_snapshot(self.read_ts)
+            native = getattr(snap, "scan_record_rows", None)
+            if native is not None:
+                return native(kr)
+            import numpy as np
+
+            from tidb_tpu.kv import tablecodec
+            from tidb_tpu.kv.memstore import BulkRows
+
+            handles, chunks, starts, ends = [], [], [], []
+            off = 0
+            for k, v in snap.scan(kr):
+                if not tablecodec.is_record_key(k):
+                    continue
+                handles.append(tablecodec.decode_record_key(k)[1])
+                chunks.append(v)
+                starts.append(off)
+                off += len(v)
+                ends.append(off)
+            n = len(handles)
+            return BulkRows(
+                np.asarray(handles, dtype=np.int64),
+                np.asarray(starts, dtype=np.int64),
+                np.asarray(ends, dtype=np.int64),
+                b"".join(chunks),
+                put_ts=np.full(n, self.read_ts, dtype=np.int64),
+            )
+
+        return self._store._routed("snap_scan_rows", run)
+
 
 class _ShardedCopClient:
     """Cop fan-out per range OWNER: consecutive same-owner ranges form one
@@ -266,6 +307,20 @@ class ShardedStore:
         # explicit table_id → shard index; unlisted tables hash by id
         self.placement = dict(placement or {})
         self.nonce = "sharded(" + ",".join(s.nonce for s in self.stores) + ")"
+        # per-store cop-digest rings for IN-PROCESS members: wire members
+        # record cop tasks into their server's StmtSummary, but embedded
+        # MemStores share one process registry, so the balancer's hot-table
+        # boost had no per-store signal. Each member gets its own ring; the
+        # embedded cop client records into it and sys_report ships it in the
+        # "statements" section exactly like a store server would.
+        from tidb_tpu.utils.stmtsummary import StmtSummary as _SS
+
+        for st in self.stores:
+            if not hasattr(st, "host") and getattr(st, "cop_ring", None) is None:
+                try:
+                    st.cop_ring = _SS(capacity=128, slow_capacity=64)
+                except AttributeError:  # slotted/duck store: ring stays off
+                    pass
         # single authority (the PD TSO role) with store-down failover: the
         # authority index advances to the next live shard when the current
         # one is unreachable, and meta reads follow it (every shard carries a
@@ -999,17 +1054,58 @@ class ShardedStore:
             t.join()
         return out
 
+    # -- columnar-cache verbs for the hybrid shards × devices path ----------
+    def stable_parts(self, table_id: int, kr, read_ts: int) -> list:
+        """Stable-block slices from the range's owner (the coordinator's
+        columnar cache merges them like an embedded store's). Wire members
+        keep their blocks server-side and report none — their rows arrive
+        via the scan fallback instead."""
+
+        def run():
+            st = self.store_for_key(kr.start)
+            fn = getattr(st, "stable_parts", None)
+            return fn(table_id, kr, read_ts) if fn is not None else []
+
+        return self._routed("stable_parts", run)
+
+    def col_changes_since(self, region_id: int, table_id: int, after_ts: int):
+        # coordinator-side region ids are minted (shard/epoch-namespaced), so
+        # member change logs cannot be consulted by id — "span" tells the
+        # cache to MERGE (full routed re-scan) and never delta-read: always
+        # correct, merely conservative after writes
+        return ("span", (0, 2**63 - 1))
+
+    def col_changes_prune(self, region_id: int, table_id: int, upto_ts: int) -> None:
+        return None  # nothing itemized coordinator-side, nothing to prune
+
     # -- MPP: single-owner placement ----------------------------------------
     def mpp_ndev(self) -> int:
-        return self.stores[0].mpp_ndev()
+        fn = getattr(self.stores[0], "mpp_ndev", None)
+        if fn is None:
+            # embedded fleet: the coordinator process owns the (one) mesh
+            from tidb_tpu.parallel import make_mesh
+
+            return int(make_mesh().devices.size)
+        return fn()
 
     def _mpp_owner(self, spec: dict) -> int:
-        def tid_of(r: dict) -> int:
-            # subplan readers nest their table reader under "sub"
-            return r["sub"]["reader"]["tid"] if "sub" in r else r["tid"]
+        def tids_of(r: dict) -> list[int]:
+            # subplan readers nest their table reader under "sub"; a staged
+            # chain subplan reads EVERY chain table — all must co-locate,
+            # or the serving store would see empty regions for the rest
+            if "sub" in r:
+                sp = r["sub"]
+                if sp.get("chain"):
+                    return [crp["tid"] for crp in sp["chain"]["readers"]]
+                return [sp["reader"]["tid"]]
+            return [r["tid"]]
 
         def owners() -> set[int]:
-            return {self.shard_of_table(tid_of(r)) for r in spec.get("readers", [])}
+            return {
+                self.shard_of_table(tid)
+                for r in spec.get("readers", [])
+                for tid in tids_of(r)
+            }
 
         got = owners()
         if len(got) != 1 and self.placement_refresh():
@@ -1017,17 +1113,27 @@ class ShardedStore:
             # migration — re-resolve once before giving up on MPP
             got = owners()
         if len(got) != 1:
-            from tidb_tpu.parallel.probe import MPPRetryExhausted
+            from tidb_tpu.parallel.probe import MPPStraddleError
 
-            raise MPPRetryExhausted(
+            raise MPPStraddleError(
                 f"MPP gather reads tables on {len(got)} store shards; "
-                "single-owner placement required (falls back to cop + host join)"
+                "single-owner placement unavailable (hybrid mesh or host join)"
             )
         return got.pop()
 
     def mpp_dispatch(self, spec: dict, read_ts: int, **kw) -> str:
         owner = self._mpp_owner(spec)
-        return f"{owner}:{self.stores[owner].mpp_dispatch(spec, read_ts, **kw)}"
+        fn = getattr(self.stores[owner], "mpp_dispatch", None)
+        if fn is None:
+            # embedded members run no task manager — the coordinator's own
+            # mesh serves the gather (same hybrid path a straddle takes)
+            from tidb_tpu.parallel.probe import MPPStraddleError
+
+            raise MPPStraddleError(
+                "embedded fleet members dispatch no MPP tasks; "
+                "coordinator mesh serves the gather"
+            )
+        return f"{owner}:{fn(spec, read_ts, **kw)}"
 
     def mpp_conn(self, task_id: str, check_killed=None, warn=None, **kw):
         owner, _, tid = task_id.partition(":")

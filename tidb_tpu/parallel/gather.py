@@ -144,6 +144,15 @@ class SubplanReader:
     # output positions holding ALL the agg group keys (the uniqueness proof:
     # join keys covering them make the build side unique); None = unprovable
     group_pos: Optional[frozenset] = None
+    # stage-chain extensions: ``chain`` = (readers, joins, filters) when the
+    # agg's input is itself a join chain (agg-over-join build sides — the
+    # derived-table shapes that used to refuse MPP outright); ``staged`` =
+    # the planner proved the whole subplan runs as a DEVICE stage inside the
+    # consumer's fragment program (see mpp.DistStageSpec) — its output slots
+    # stay HBM-resident and the consumer join's all_to_all re-partitions
+    # them on the new key, no host round-trip
+    chain: Optional[tuple] = None
+    staged: bool = False
 
     # duck-typed touch points shared with plain reader build sides
     pushed_agg = None
@@ -165,6 +174,23 @@ class SubplanReader:
                 [a.to_pb() for a in rd.pushed_agg.aggs],
                 rd.pushed_agg_mode,
             )
+        chain_fp = None
+        if self.chain is not None:
+            readers, joins, filters = self.chain
+            chain_fp = (
+                [
+                    (r.table.id, tuple(r.scan_slots), [c.to_pb() for c in r.pushed_conditions])
+                    for r in readers
+                ],
+                # other/str_keys are compiled into the stage's pair-filter
+                # closures — omitting them would collide two staged programs
+                # that differ only in a semi/anti pair condition
+                [
+                    (j.eq, j.exchange, j.unique, j.kind, [c.to_pb() for c in j.other], j.str_keys)
+                    for j in joins
+                ],
+                [(pos, [c.to_pb() for c in cl]) for pos, cl in filters],
+            )
         return repr(
             (
                 tuple(rd.scan_slots),
@@ -175,6 +201,8 @@ class SubplanReader:
                 bool(self.agg.partial_input),
                 [c.to_pb() for c in self.having],
                 [e.to_pb() for e in self.proj] if self.proj is not None else None,
+                chain_fp,
+                self.staged,
             )
         )
 
@@ -330,7 +358,15 @@ def _agg_mpp_ok(agg: PhysFinalAgg) -> bool:
 FORCE_EXCHANGE: str | None = None  # test hook: "hash" | "broadcast"
 
 
-def _choose_exchange(l_rows: int | None, r_rows: int | None, ndev: int, bcast_thr: int = 100_000) -> str:
+def _choose_exchange(
+    l_rows: int | None,
+    r_rows: int | None,
+    ndev: int,
+    bcast_thr: int = 100_000,
+    l_resident: bool = False,
+    r_resident: bool = False,
+    hbm_frac: float = 0.0,
+) -> str:
     """Stats-driven exchange choice (ref: fragment.go:235 exchange-type cost):
     broadcast replicates the build side to every shard (moves r*(ndev-1)
     rows); hash shuffles both sides (moves ~(l+r)*(ndev-1)/ndev rows) and
@@ -338,17 +374,27 @@ def _choose_exchange(l_rows: int | None, r_rows: int | None, ndev: int, bcast_th
     replicating the build side is cheaper than routing the probe side.
     Without stats on a side, fall back to an absolute build-side cap rather
     than guessing a probe size (a large analyzed build side must not be
-    replicated just because the probe is un-analyzed)."""
+    replicated just because the probe is un-analyzed).
+
+    Residency terms (the placement-aware refinement): ``l_resident`` — the
+    probe side's columns are already DEVICE-resident, so hash-routing them
+    allocates fresh routed buffers and forfeits the residency, while
+    broadcast probes them in place (broadcast earns a 2× allowance);
+    ``hbm_frac`` — fleet HBM pressure from the health reports; replicating a
+    build side ndev× under pressure evicts hot columns, so the broadcast
+    cap shrinks 8× past 85% occupancy."""
     if FORCE_EXCHANGE is not None:
         return FORCE_EXCHANGE
     if bcast_thr <= 0:
         return "hash"  # the TiDB idiom: threshold 0 disables broadcast
+    thr = bcast_thr // 8 if hbm_frac > 0.85 else bcast_thr
     if r_rows is None or l_rows is None:
         small = r_rows if r_rows is not None else 0
-        return "broadcast" if small <= bcast_thr else "hash"
-    if r_rows > bcast_thr:
-        return "hash"  # build side exceeds the user's replication cap
-    if r_rows * max(ndev - 1, 1) <= max(l_rows, 1):
+        return "broadcast" if small <= thr else "hash"
+    if r_rows > thr:
+        return "hash"  # build side exceeds the (pressure-scaled) cap
+    bonus = 2 if l_resident and not r_resident else 1
+    if r_rows * max(ndev - 1, 1) <= max(l_rows, 1) * bonus:
         return "broadcast"
     return "hash"
 
@@ -368,11 +414,70 @@ def _chain_cond_ok(c: Expression) -> bool:
     return no_str(c)
 
 
-def _subplan_side(r: PhysicalPlan) -> Optional[SubplanReader]:
+def _stage_agg_of(sub: SubplanReader):
+    """The COMPLETE (group_by, aggs) a device stage would compute over the
+    subplan's RAW scan lanes, or None. Three normal forms: a chain subplan's
+    final agg (positions over the accumulated chain schema); a plain
+    reader's final agg; a pushed-partial reader's ORIGINAL agg re-rooted
+    (the planner pushed the partial below the exchange — the pushed
+    LogicalAggregation holds the pre-pushdown shape over scan positions)."""
+    rd = sub.reader
+    if sub.chain is not None:
+        return (sub.agg.group_by, sub.agg.aggs) if not sub.agg.partial_input else None
+    if sub.agg.partial_input:
+        if rd.pushed_agg is None:
+            return None
+        return rd.pushed_agg.group_by, rd.pushed_agg.aggs
+    if rd.pushed_agg is not None:
+        return None
+    return sub.agg.group_by, sub.agg.aggs
+
+
+def _stage_eligible(sub: SubplanReader) -> bool:
+    """Device admission for running the WHOLE subplan as a fragment stage:
+    every agg, group key, HAVING residue, and projection must evaluate on
+    the engine over int/float lanes. Scalar aggregates stay host-side (a
+    one-row-even-when-empty contract the padded stage cannot honor)."""
+    got = _stage_agg_of(sub)
+    if got is None:
+        return False
+    gb, aggs = got
+    if not gb:
+        return False
+    for a in aggs:
+        if a.name not in ("count", "sum", "avg", "min", "max") or a.distinct:
+            return False
+        if a.arg is not None:
+            if not can_push_down(a.arg, "tpu"):
+                return False
+            if a.arg.ftype.kind == TypeKind.STRING and a.name != "count":
+                return False  # codes are identities, not values/an order
+    for g in gb:
+        if not can_push_down(g, "tpu"):
+            return False
+        if g.ftype.kind == TypeKind.STRING and not isinstance(g, ColumnRef):
+            return False
+    if not all(_chain_cond_ok(c) for c in sub.having):
+        return False
+    if sub.proj is not None and not all(_chain_cond_ok(e) for e in sub.proj):
+        return False
+    readers = sub.chain[0] if sub.chain is not None else [sub.reader]
+    for r in readers:
+        if not all(can_push_down(c, "tpu") for c in r.pushed_conditions):
+            return False
+    return True
+
+
+def _subplan_side(
+    r: PhysicalPlan, stats=None, get_ndev=None, bcast_thr: int = 100_000
+) -> Optional[SubplanReader]:
     """Admit an aggregate subplan as a join build side — canonical form
-    [PhysProjection] → [PhysSelection] → PhysFinalAgg → PhysTableReader
-    (the decorrelated correlated-aggregate shapes). Returns the wrapper or
-    None when the subtree doesn't normalize."""
+    [PhysProjection] → [PhysSelection] → PhysFinalAgg → (PhysTableReader |
+    join chain). The reader form covers the decorrelated correlated-
+    aggregate shapes; the chain form covers derived-table agg-over-join
+    build sides, admitted ONLY when the whole subplan is stage-eligible
+    (it executes as a device stage — there is no host materialization
+    contract for a chain). Returns the wrapper or None."""
     top = r
     proj = None
     if isinstance(r, PhysProjection):
@@ -380,19 +485,55 @@ def _subplan_side(r: PhysicalPlan) -> Optional[SubplanReader]:
     having: list = []
     if isinstance(r, PhysSelection):
         having, r = list(r.conditions), r.children[0]
-    if not (isinstance(r, PhysFinalAgg) and not getattr(r, "rollup", False)):
-        return None
-    agg = r
+    chain = None
+    if (
+        isinstance(r, PhysMPPGather)
+        and r.agg is not None
+        and r.topn is None
+        and r.joins
+        and not any(isinstance(x, SubplanReader) for x in r.readers)
+        and not any(x.pushed_agg is not None for x in r.readers)
+        and not any(j.kind == "right" for j in r.joins)
+    ):
+        # a bottom-up-rewritten derived table: the walk already lifted the
+        # agg-over-join into ITS OWN gather — re-absorb it as a device stage
+        # of the consumer, so both fragments compose into ONE program with
+        # an on-device repartition instead of two programs and a host hop
+        # (right joins pad the accumulated layout mid-chain: not stageable)
+        agg = PhysFinalAgg(
+            group_by=r.agg.group_by,
+            aggs=r.agg.aggs,
+            partial_input=False,
+            schema=list(r.schema),
+            children=[],
+        )
+        chain = (list(r.readers), list(r.joins), list(r.filters))
+        rd = r.readers[0]
+    else:
+        if not (isinstance(r, PhysFinalAgg) and not getattr(r, "rollup", False)):
+            return None
+        agg = r
+        rd = agg.children[0] if agg.children else None
+        if not (
+            isinstance(rd, PhysTableReader)
+            and rd.pushed_topn is None
+            and rd.pushed_limit is None
+            and rd.pushed_window is None
+        ):
+            if rd is None or get_ndev is None or agg.partial_input:
+                return None
+            flat = _flatten_join_chain(rd, stats, get_ndev, bcast_thr)
+            if (
+                flat is None
+                or not flat[1]
+                or any(isinstance(x, SubplanReader) for x in flat[0])
+                or any(j.kind == "right" for j in flat[1])
+            ):
+                return None  # no nested stages; right joins pad the layout
+            chain = (flat[0], flat[1], flat[2])
+            rd = flat[0][0]
     if any(a.name == "group_concat" for a in agg.aggs):
         return None  # string-valued output lanes have no device identity
-    rd = agg.children[0] if agg.children else None
-    if not (
-        isinstance(rd, PhysTableReader)
-        and rd.pushed_topn is None
-        and rd.pushed_limit is None
-        and rd.pushed_window is None
-    ):
-        return None
     schema = top.schema
     if any(oc.ftype.kind == TypeKind.STRING for oc in schema):
         return None  # derived lanes carry no dictionary
@@ -409,7 +550,7 @@ def _subplan_side(r: PhysicalPlan) -> Optional[SubplanReader]:
             if gset <= covered
             else None  # a dropped group key: uniqueness unprovable
         )
-    return SubplanReader(
+    sub = SubplanReader(
         plan=top,
         reader=rd,
         agg=agg,
@@ -417,10 +558,15 @@ def _subplan_side(r: PhysicalPlan) -> Optional[SubplanReader]:
         proj=list(proj.exprs) if proj is not None else None,
         schema=list(schema),
         group_pos=gpos,
+        chain=chain,
     )
+    sub.staged = _stage_eligible(sub)
+    if chain is not None and not sub.staged:
+        return None  # chain subplans have no host-materialization fallback
+    return sub
 
 
-def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev, bcast_thr: int = 100_000):
+def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev, bcast_thr: int = 100_000, res=None):
     """Left-deep chain of equi-joins over MPP-eligible readers →
     (readers, joins, filters, probe_row_estimate) or None. eq_conds left
     positions index the child-0 schema, which for a left-deep chain IS the
@@ -428,9 +574,11 @@ def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev, bcast_thr: int = 100_0
     [(position, [conditions])] — Selections interposed in the chain (and
     inner-join other_conds) become post-join fragment filters at the join
     count where they appeared. ``get_ndev`` is lazy: mesh construction (JAX
-    backend init) only happens once a candidate matched."""
+    backend init) only happens once a candidate matched. ``res``: optional
+    (table_id → device-resident?, hbm_frac) residency context feeding the
+    exchange-type cost model."""
     if isinstance(p, PhysSelection):
-        base = _flatten_join_chain(p.children[0], stats, get_ndev, bcast_thr)
+        base = _flatten_join_chain(p.children[0], stats, get_ndev, bcast_thr, res)
         if base is None or not all(_chain_cond_ok(c) for c in p.conditions):
             return None
         readers, joins, filters, rows = base
@@ -467,17 +615,26 @@ def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev, bcast_thr: int = 100_0
                 return None
             if not all(_chain_cond_ok(c) for c in other):
                 return None
-        base = _flatten_join_chain(p.children[0], stats, get_ndev, bcast_thr)
+        base = _flatten_join_chain(p.children[0], stats, get_ndev, bcast_thr, res)
         if base is None:
             return None
         r = p.children[1]
         eq_conds = list(p.eq_conds)
+        nleft_node = len(p.children[0].schema)
+        # aggregate subplans admit BEFORE projection peeling: the projection
+        # is part of the subplan's OUTPUT contract — peeling it would leave
+        # the accumulated layout in agg-output order while the builder
+        # resolved later references (outer agg args, post-join filters)
+        # against the projection's order
+        sub = _subplan_side(r, stats, get_ndev, bcast_thr)
+        if sub is not None:
+            r = sub
+        r_pre_peel = r
         # column-only projections over the build reader (subquery rewrites
         # emit them) just remap the right key positions — and the right-side
         # refs of any other_conds, which the builder resolved against the
         # [left ++ projection-output] joined layout
-        nleft_node = len(p.children[0].schema)
-        while isinstance(r, PhysProjection) and all(isinstance(e, ColumnRef) for e in r.exprs):
+        while sub is None and isinstance(r, PhysProjection) and all(isinstance(e, ColumnRef) for e in r.exprs):
             eq_conds = [(lp, r.exprs[rp].index) for lp, rp in eq_conds]
             if other:
                 if p.kind not in ("semi", "anti"):
@@ -496,9 +653,10 @@ def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev, bcast_thr: int = 100_0
                 }
                 other = [_remap_expr(c, mapping) for c in other]
             r = r.children[0]
-        sub = None
-        if not (isinstance(r, PhysTableReader) and _reader_mpp_ok(r)):
-            sub = _subplan_side(r)
+        if sub is None and not (isinstance(r, PhysTableReader) and _reader_mpp_ok(r)):
+            if r is r_pre_peel:
+                return None  # nothing peeled: the pre-peel probe already said no
+            sub = _subplan_side(r, stats, get_ndev, bcast_thr)
             if sub is None:
                 return None
             r = sub
@@ -546,7 +704,20 @@ def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev, bcast_thr: int = 100_0
                 from tidb_tpu.statistics.selectivity import estimate_selectivity
 
                 r_rows = max(r_rows * estimate_selectivity(r.pushed_conditions, r.schema, st), 1.0)
-        exchange = _choose_exchange(probe_rows, r_rows, get_ndev(), bcast_thr)
+        res_fn, hbm_frac = res if res is not None else (None, 0.0)
+        # the probe-residency allowance only applies to the FIRST fold: later
+        # joins probe an accumulated intermediate (freshly routed buffers),
+        # whose base table's residency protects nothing
+        l_res = bool(res_fn(readers[0].table.id)) if res_fn is not None and not joins else False
+        exchange = _choose_exchange(
+            probe_rows,
+            r_rows,
+            get_ndev(),
+            bcast_thr,
+            l_resident=l_res,
+            r_resident=bool(res_fn(r.table.id)) if res_fn is not None else False,
+            hbm_frac=hbm_frac,
+        )
         if other and p.kind == "inner":
             # inner-join other_conds filter joined rows AFTER the fold — the
             # builder resolved them over [left ++ right] = the accumulated
@@ -600,6 +771,25 @@ def _flatten_join_chain(p: PhysicalPlan, stats, get_ndev, bcast_thr: int = 100_0
     return None
 
 
+def _lane_layout(readers: list, joins: list):
+    """Accumulated lane layout over a reader chain: reader k contributes
+    2*ncols_k+1 lanes (data/valid interleaved + live). Returns (n_lanes per
+    reader, lane_of: accumulated-schema pos → data lane index). Semi/anti
+    build readers exist in the INPUT but fold no lanes into the accumulated
+    layout, so the offset does not move past them. Shared by the outer plan
+    and the join chains inside device stages."""
+    n_lanes = [2 * len(r.schema) + 1 for r in readers]
+    lane_of = []
+    off = 0
+    for ri, r in enumerate(readers):
+        in_plan = ri == 0 or joins[ri - 1].kind in ("inner", "left", "right")
+        if in_plan:
+            for i in range(len(r.schema)):
+                lane_of.append(off + 2 * i)
+            off += 2 * len(r.schema) + 1
+    return n_lanes, lane_of
+
+
 def _plan_schema_len(readers: list, joins: list) -> int:
     """Length of the accumulated PLAN schema: semi/anti joins contribute no
     build columns."""
@@ -627,13 +817,45 @@ def _plan_col_source(readers: list, joins: list, pos: int):
     return None
 
 
-def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> PhysicalPlan:
+def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None, health=None) -> PhysicalPlan:
     """Rewrite eligible FinalAgg/TopN/Limit-over-join subtrees into
     PhysMPPGather (ref: the planner preferring mpp task type under
-    tidb_allow_mpp)."""
+    tidb_allow_mpp). ``health``: the DB's StoreHealthRegistry, feeding the
+    exchange-type cost model real residency/HBM signals (placement-aware
+    fragment scheduling) — None degrades to the pure row-count model."""
     if not sysvar_int(vars, "tidb_allow_mpp", 1):
         return plan
     enforce = sysvar_int(vars, "tidb_enforce_mpp", 0)
+
+    # residency context for _choose_exchange: per-table device/columnar
+    # residency from the locally readable cache (embedded stores and the
+    # hybrid sharded coordinator — remote dispatch cannot see server
+    # residency and degrades to row counts), plus fleet HBM pressure from
+    # the last health sweep. Peeks only: planning must never build a cache.
+    def _res_fn(tid: int) -> bool:
+        if store is None:
+            return False
+        try:
+            from tidb_tpu.copr.colcache import peek_resident_bytes
+
+            return peek_resident_bytes(store, tid) > 0
+        except Exception:  # graftcheck: off=except-swallow
+            return False  # residency is advisory; planning must not fail
+
+    hbm_frac = 0.0
+    if health is not None:
+        try:
+            from tidb_tpu.copr.tpu_engine import _hbm_budget
+
+            budget = float(_hbm_budget())
+            for ent in health.reports().values():
+                rep = ent.get("report") or {}
+                b = float(rep.get("device_cache_bytes") or 0)
+                if budget > 0:
+                    hbm_frac = max(hbm_frac, b / budget)
+        except Exception:  # graftcheck: off=except-swallow
+            hbm_frac = 0.0  # pressure is advisory too
+    res = (_res_fn, hbm_frac)
 
     # lazy: mesh construction triggers JAX backend init (seconds of cold
     # start) — only pay it when a query actually matches an MPP shape. A
@@ -807,7 +1029,7 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> P
                     by = remapped
                     host_parent, slot = below, 0
                     below = below.children[0]
-                flat = _flatten_join_chain(below, stats, get_ndev, bcast_thr) if below is not None else None
+                flat = _flatten_join_chain(below, stats, get_ndev, bcast_thr, res) if below is not None else None
                 if (
                     flat is not None
                     and flat[1]  # single-reader TopN is the coprocessor's job
@@ -834,7 +1056,7 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> P
                 while isinstance(below, PhysProjection):
                     host_parent, slot = below, 0
                     below = below.children[0]
-                flat = _flatten_join_chain(below, stats, get_ndev, bcast_thr)
+                flat = _flatten_join_chain(below, stats, get_ndev, bcast_thr, res)
                 if flat is not None and flat[1] and total <= 65536:
                     readers, joins, filters, _ = flat
                     gather = PhysMPPGather(
@@ -882,7 +1104,7 @@ def try_mpp_rewrite(plan: PhysicalPlan, vars: dict, stats=None, store=None) -> P
                 below = below.children[0]
             if mpp_agg is not p and not _agg_mpp_ok(mpp_agg):
                 mpp_agg, below = p, child  # substituted args not device-legal
-            flat = _flatten_join_chain(below, stats, get_ndev, bcast_thr)
+            flat = _flatten_join_chain(below, stats, get_ndev, bcast_thr, res)
             if flat is not None and flat[1]:
                 readers, joins, filters, _ = flat
                 if (
@@ -950,6 +1172,84 @@ def _scan_schema(reader: PhysTableReader) -> Schema:
     return out
 
 
+def _make_join_specs(joins, nrows, bounds_acc, bounds_by_reader, lane_of, ndev: int):
+    """MPPJoin chain → DistJoinSpec list with power-of-two bucketed caps and
+    JOINT per-key value bounds (both sides must pack identically). Shared by
+    the outer plan chain and the join chains inside device stages. left_keys
+    of later joins need no rebase: after join ji the accumulated lane layout
+    = probe lanes + build lanes, and ``lane_of`` is computed over the full
+    reader list. Key-validity lanes enforce NULL-key semantics (inner-join
+    keys must be non-NULL to match)."""
+    from tidb_tpu.parallel.mpp import DistJoinSpec
+
+    shard = lambda n: max(_pow2(2 * ((max(n, 1) + ndev - 1) // ndev)), 64)
+    probe_cap = shard(nrows[0])
+    specs = []
+    for ji, join in enumerate(joins):
+        build_cap = shard(nrows[ji + 1])
+        lane_eq_l = [lane_of[lp] for lp, _ in join.eq]
+        # build reader's local lanes
+        lane_eq_r = [2 * rp for _, rp in join.eq]
+        kb = []
+        for lp, rp in join.eq:
+            lb = bounds_acc[lp] if lp < len(bounds_acc) else None
+            rb = bounds_by_reader[ji + 1][rp]
+            kb.append(
+                (min(lb[0], rb[0]), max(lb[1], rb[1])) if lb is not None and rb is not None else None
+            )
+        specs.append(
+            DistJoinSpec(
+                left_keys=lane_eq_l,
+                right_keys=lane_eq_r,
+                kind=join.kind,
+                exchange=join.exchange,
+                left_row_cap=probe_cap,
+                right_row_cap=build_cap,
+                unique=join.unique,
+                out_cap=max(_pow2(probe_cap), 1024),
+                key_bounds=tuple(kb),
+            )
+        )
+        if join.kind == "right":
+            # the fragment appends one static build-sized segment of
+            # (possibly) unmatched build rows to the accumulated layout
+            base = specs[-1].out_cap if not join.unique else probe_cap
+            probe_cap = base + build_cap
+        elif not join.unique and join.kind in ("inner", "left"):
+            probe_cap = specs[-1].out_cap
+    for spec in specs:
+        spec.left_key_valid = tuple(k + 1 for k in spec.left_keys)
+        spec.right_key_valid = tuple(k + 1 for k in spec.right_keys)
+    return specs
+
+
+def _stage_parts_of(sub: SubplanReader):
+    """(readers, joins, filters, group_by, aggs) of the device stage a
+    staged SubplanReader executes: its RAW input readers, the join chain
+    inside the stage, interposed filters, and the COMPLETE agg over the
+    accumulated stage schema. A pushed-partial single reader is re-rooted to
+    its pre-pushdown shape (raw scan lanes; the pushed LogicalAggregation
+    holds the original group/agg expressions over scan positions)."""
+    if sub.chain is not None:
+        readers, joins, filters = sub.chain
+        return list(readers), list(joins), list(filters), sub.agg.group_by, sub.agg.aggs
+    rd = sub.reader
+    if sub.agg.partial_input:
+        gb, aggs = rd.pushed_agg.group_by, rd.pushed_agg.aggs
+        bare = PhysTableReader(
+            db=rd.db,
+            table=rd.table,
+            store_type=rd.store_type,
+            pushed_conditions=list(rd.pushed_conditions),
+            scan_slots=list(rd.scan_slots),
+            ranges=rd.ranges,
+            schema=_scan_schema(rd),
+            partitions=rd.partitions,
+        )
+        return [bare], [], [], gb, aggs
+    return [rd], [], [], sub.agg.group_by, sub.agg.aggs
+
+
 # ---------------------------------------------------------------------------
 # coordinator / executor
 # ---------------------------------------------------------------------------
@@ -985,6 +1285,12 @@ class MPPGatherExec:
             # executor (its reader rides the normal cop/device path) — the
             # chunk is in the same physical representation the host engine
             # joins against, so fragment-side comparisons agree bit-exactly
+            if reader.chain is not None:
+                # chain subplans are admitted staged-only; the stage path
+                # materializes raw reader lanes and never lands here
+                from tidb_tpu.parallel.probe import MPPRetryExhausted
+
+                raise MPPRetryExhausted("chain subplan build side has no host materialization")
             if self.session._txn_dirty():
                 # the union-scan overlay cannot reach through the agg
                 from tidb_tpu.parallel.probe import MPPRetryExhausted
@@ -992,7 +1298,16 @@ class MPPGatherExec:
                 raise MPPRetryExhausted("mpp subplan build side cannot observe txn-local mutations")
             from tidb_tpu.executor.executors import build_executor
 
-            return build_executor(reader.plan, self.session).execute()
+            chunk = build_executor(reader.plan, self.session).execute()
+            # an intermediate fragment result crossed the host boundary —
+            # the quantity the staged pipeline (SubplanReader.staged) keeps
+            # at zero; bench lanes and stage-chain tests assert on it
+            from tidb_tpu.utils import metrics as _m
+
+            _m.MPP_HOST_INTERMEDIATE.inc(
+                sum(c.data.nbytes + c.validity.nbytes for c in chunk.columns)
+            )
+            return chunk
         if reader.pushed_agg is not None:
             return TableReaderExec(reader, self.session).execute()
         if self.session._txn_dirty():
@@ -1077,28 +1392,11 @@ class MPPGatherExec:
 
     # -- lane layout ---------------------------------------------------------
     def _lane_maps(self):
-        """Accumulated lane layout: reader k contributes 2*ncols_k+1 lanes
-        (data/valid interleaved + live). Returns (n_lanes per reader,
-        lane_of: schema pos → data lane index in the accumulated layout)."""
-        p = self.plan
-        # lane count follows the reader's OUTPUT schema (pre-aggregated
-        # readers emit partial lanes + keys, not raw scan columns). The
-        # PLAN schema skips semi/anti build readers — their lanes exist in
-        # the INPUT but contribute no output columns, matching the step
-        # function's accumulated layout.
-        n_lanes = [2 * len(r.schema) + 1 for r in p.readers]
-        lane_of = []
-        off = 0
-        for ri, r in enumerate(p.readers):
-            in_plan = ri == 0 or p.joins[ri - 1].kind in ("inner", "left", "right")
-            if in_plan:
-                for i in range(len(r.schema)):
-                    lane_of.append(off + 2 * i)
-                # the ACCUMULATED layout only grows for joins that append
-                # build lanes — semi/anti readers exist in the INPUT but
-                # contribute nothing to acc, so the offset must not move
-                off += 2 * len(r.schema) + 1
-        return n_lanes, lane_of
+        """Accumulated lane layout over the plan's readers (see
+        :func:`_lane_layout`). Lane count follows each reader's OUTPUT
+        schema — pre-aggregated readers emit partial lanes + keys, staged
+        subplan readers their finalize lanes — not raw scan columns."""
+        return _lane_layout(self.plan.readers, self.plan.joins)
 
     def _col_source(self, pos: int):
         """(table_id, slot) for accumulated PLAN-schema position ``pos``."""
@@ -1115,8 +1413,30 @@ class MPPGatherExec:
         gather to the storage server instead (DispatchMPPTask analog) —
         BEFORE any jax import: the SQL-layer process must never initialize
         a device backend it does not own."""
-        if hasattr(self.session.store, "mpp_dispatch"):
-            return self._execute_remote()
+        store = self.session.store
+        if hasattr(store, "mpp_dispatch"):
+            from tidb_tpu.parallel.probe import MPPStraddleError
+
+            try:
+                return self._execute_remote()
+            except MPPStraddleError:
+                # hybrid shards × devices: the gather's tables live on
+                # DIFFERENT store shards, so no single owner can serve it.
+                # A fleet client can read every shard (the sharded cop/
+                # columnar route crosses the wire per owner — today's wire
+                # path), so the staged fragment program runs on the
+                # coordinator's own mesh instead of degrading to the host
+                # join. Single-store remote sessions never straddle, so the
+                # never-initialize-a-foreign-backend rule still holds there.
+                if not (
+                    hasattr(store, "stores")
+                    and sysvar_int(self.session.vars, "tidb_mpp_hybrid", 1)
+                ):
+                    raise
+                from tidb_tpu.utils import metrics as _m
+
+                _m.MPP_HYBRID.inc()
+                self._hybrid = True
         import jax
 
         from tidb_tpu.parallel import make_mesh
@@ -1138,6 +1458,12 @@ class MPPGatherExec:
         self._compiles = 0
         while True:
             devices = GLOBAL_PROBER.alive(jax.devices())
+            from tidb_tpu.parallel import mesh as _mesh_mod
+
+            if _mesh_mod.FORCE_NDEV is not None:
+                # scaling runs pin the mesh width (benchdaily scaling lanes,
+                # ndev-parity tests) — same path, fewer shards
+                devices = devices[: _mesh_mod.FORCE_NDEV]
             if not devices:
                 raise MPPRetryExhausted("no alive devices for MPP")
             mesh = make_mesh(devices=devices)
@@ -1165,8 +1491,11 @@ class MPPGatherExec:
                         wall_ms=(_t.perf_counter() - t0) * 1000.0,
                         rows=len(out),
                         retries=bo.attempts(),
+                        store="hybrid" if getattr(self, "_hybrid", False) else "",
                         shards=shards,
                         compiles=getattr(self, "_compiles", 0),
+                        stages=getattr(self, "_n_stages", 1),
+                        stage_bytes=getattr(self, "_stage_bytes", []),
                     ),
                 )
                 return out
@@ -1301,6 +1630,8 @@ class MPPGatherExec:
                 # (the mesh lives there) — ships home in the exec sidecar
                 shards=[list(sh) for sh in (e.get("shards") or [])],
                 compiles=int(e.get("compiles", 0)),
+                stages=int(e.get("stages", 1)),
+                stage_bytes=[int(b) for b in (e.get("stage_bytes") or [])],
             ),
         )
         return chunk
@@ -1317,6 +1648,10 @@ class MPPGatherExec:
 
         p = self.plan
         ndev = mesh.devices.size
+        self._stage_bytes = []  # per-device-stage exchanged bytes (psum)
+        self._n_stages = 1 + sum(
+            1 for r in p.readers if isinstance(r, SubplanReader) and r.staged
+        )
         # pinned read ts (stale read / server-side dispatched task): caching
         # stays legal per reader as long as no region committed PAST the pin —
         # checked against region.max_commit_ts in dev_side
@@ -1332,6 +1667,18 @@ class MPPGatherExec:
                 # string join keys compare as dictionary codes: both columns
                 # must share ONE dictionary (idempotent after the first query)
                 _cache.unify_dictionaries(ta, sa, tb, sb)
+        # staged subplan build sides: (readers, joins, filters, group_by,
+        # aggs) of the device STAGE, aligned with p.readers (None = plain /
+        # host-materialized). Stage join chains unify their own string keys.
+        stage_parts = [
+            _stage_parts_of(r) if isinstance(r, SubplanReader) and r.staged else None
+            for r in p.readers
+        ]
+        for parts in stage_parts:
+            if parts is not None:
+                for join in parts[1]:
+                    for (ta, sa), (tb, sb) in join.str_keys:
+                        _cache.unify_dictionaries(ta, sa, tb, sb)
         conds = [self._bind_conditions(r) for r in p.readers]
         agg = p.agg
 
@@ -1474,12 +1821,47 @@ class MPPGatherExec:
             return dev
 
         # traced under TRACE (or a propagated remote trace context): the two
-        # dominant phases of a gather get their own spans
+        # dominant phases of a gather get their own spans. A STAGED reader
+        # materializes its stage readers' RAW lanes (per-column pooled like
+        # any plain scan) — the subplan's aggregate never touches the host.
         with self.session.span("mpp-inputs"):
-            sides = [dev_side(r) for r in p.readers]
-        all_lanes = [a for arrays, _, _ in sides for a in arrays]
-        nrows = [n for _, n, _ in sides]
-        bounds_by_reader = [bs for _, _, bs in sides]
+            sides = [
+                [dev_side(sr) for sr in stage_parts[ri][0]]
+                if stage_parts[ri] is not None
+                else dev_side(r)
+                for ri, r in enumerate(p.readers)
+            ]
+        stats = self.session._db.stats
+
+        def _stage_cap(sub, probe_n: int) -> int:
+            """Per-shard group-slot capacity of a stage (compile-key
+            component; overflow is detected and retried bigger)."""
+            est = sub.rows_estimate(stats)
+            if est:
+                return max(_pow2(min(int(2 * est), 1 << 16)), 64)
+            return max(_pow2(min(probe_n + 1, 1 << 16)), 256)
+
+        stage_caps = [
+            _stage_cap(p.readers[ri], sides[ri][0][1]) if stage_parts[ri] is not None else 0
+            for ri in range(len(p.readers))
+        ]
+        all_lanes = []
+        nrows = []
+        bounds_by_reader = []
+        for ri, side in enumerate(sides):
+            if stage_parts[ri] is not None:
+                for arrays, _, _ in side:
+                    all_lanes.extend(arrays)
+                # build-row proxy for the consumer join's caps: the stage
+                # emits ≤ group_cap live slots per shard
+                nrows.append(ndev * stage_caps[ri])
+                # finalize lanes carry no static value bounds
+                bounds_by_reader.append([None] * len(p.readers[ri].schema))
+            else:
+                arrays, n, bs = side
+                all_lanes.extend(arrays)
+                nrows.append(n)
+                bounds_by_reader.append(bs)
         # accumulated PLAN-schema position → column bounds (packed sorts);
         # semi/anti build readers contribute no plan columns
         all_bounds = list(bounds_by_reader[0])
@@ -1488,6 +1870,14 @@ class MPPGatherExec:
                 all_bounds.extend(bounds_by_reader[ji + 1])
         ncols = [len(r.schema) for r in p.readers]
         n_lanes, lane_of = self._lane_maps()
+        # INPUT lane counts differ from the fold-time layout for staged
+        # readers: their input block is the stage readers' lanes
+        in_lanes = [
+            sum(2 * len(sr.schema) + 1 for sr in stage_parts[ri][0])
+            if stage_parts[ri] is not None
+            else n_lanes[ri]
+            for ri in range(len(p.readers))
+        ]
 
         from tidb_tpu.ops.dag_kernel import _DeviceWarnSink
 
@@ -1514,17 +1904,21 @@ class MPPGatherExec:
         # agg input mapping over the accumulated lane layout
         total_cols = _plan_schema_len(p.readers, p.joins)
 
-        def lanes_filter(cond_list):
+        def lanes_filter(cond_list, _lane_of=None, _total=None):
             """Post-join chain filter over the ACCUMULATED lane layout:
             plan positions resolve through lane_of; lanes of not-yet-folded
             readers are absent, which is fine — a condition placed at chain
-            position k only references columns available after k joins."""
+            position k only references columns available after k joins.
+            ``_lane_of``/``_total`` override the outer layout for filters
+            INSIDE a device stage's own chain."""
+            lmap = lane_of if _lane_of is None else _lane_of
+            ncol = total_cols if _total is None else _total
 
             def fn(acc):
                 nav = len(acc)
                 pairs = [
-                    (acc[lane_of[i]], acc[lane_of[i] + 1]) if lane_of[i] + 1 < nav else None
-                    for i in range(total_cols)
+                    (acc[lmap[i]], acc[lmap[i] + 1]) if lmap[i] + 1 < nav else None
+                    for i in range(ncol)
                 ]
                 n = acc[0].shape[0]
                 batch = EvalBatch(pairs, [None] * len(pairs), n, warn=warn_sink)
@@ -1541,19 +1935,23 @@ class MPPGatherExec:
 
         chain_filters = [(pos, lanes_filter(cl)) for pos, cl in p.filters]
 
-        def build_pair_filter(join, ji):
+        def build_pair_filter(join, ji, _readers=None, _joins=None, _lane_of=None):
             """Semi/anti ``other`` conditions over candidate (probe, build)
             pairs: refs below the accumulated plan width hit probe lanes,
             the rest hit the build reader's local lanes (the builder's
-            [left ++ right] joined layout)."""
-            nleft = _plan_schema_len(p.readers[: ji + 1], p.joins[:ji])
-            nb = len(p.readers[ji + 1].schema)
+            [left ++ right] joined layout). ``_readers``/``_joins``/
+            ``_lane_of`` override the outer plan for joins INSIDE a stage."""
+            rds = p.readers if _readers is None else _readers
+            jns = p.joins if _joins is None else _joins
+            lmap = lane_of if _lane_of is None else _lane_of
+            nleft = _plan_schema_len(rds[: ji + 1], jns[:ji])
+            nb = len(rds[ji + 1].schema)
             cond_list = list(join.other)
 
             def fn(out_l, out_r):
                 nav = len(out_l)
                 pairs = [
-                    (out_l[lane_of[i]], out_l[lane_of[i] + 1]) if lane_of[i] + 1 < nav else None
+                    (out_l[lmap[i]], out_l[lmap[i] + 1]) if lmap[i] + 1 < nav else None
                     for i in range(nleft)
                 ]
                 pairs += [(out_r[2 * j], out_r[2 * j + 1]) for j in range(nb)]
@@ -1637,50 +2035,174 @@ class MPPGatherExec:
         # expansion capacity from the probe row count with 2× headroom —
         # power-of-two bucketed so the caps (compile-key components) land on
         # the same grid for nearby sizes and for grow-and-retry attempts
-        shard = lambda n: max(_pow2(2 * ((max(n, 1) + ndev - 1) // ndev)), 64)
-        probe_cap = shard(nrows[0])
-        join_specs = []
-        for ji, join in enumerate(p.joins):
-            build_cap = shard(nrows[ji + 1])
-            lane_eq_l = [lane_of[lp] for lp, _ in join.eq]
-            # build reader's local lanes
-            lane_eq_r = [2 * rp for _, rp in join.eq]
-            # JOINT per-key bounds (both sides must pack identically)
-            kb = []
-            for lp, rp in join.eq:
-                lb = all_bounds[lp] if lp < len(all_bounds) else None
-                rb = bounds_by_reader[ji + 1][rp]
-                kb.append(
-                    (min(lb[0], rb[0]), max(lb[1], rb[1])) if lb is not None and rb is not None else None
-                )
-            join_specs.append(
-                DistJoinSpec(
-                    left_keys=lane_eq_l,
-                    right_keys=lane_eq_r,
-                    kind=join.kind,
-                    exchange=join.exchange,
-                    left_row_cap=probe_cap,
-                    right_row_cap=build_cap,
-                    unique=join.unique,
-                    out_cap=max(_pow2(probe_cap), 1024),
-                    key_bounds=tuple(kb),
-                )
-            )
-            if join.kind == "right":
-                # the fragment appends one static build-sized segment of
-                # (possibly) unmatched build rows to the accumulated layout
-                base = join_specs[-1].out_cap if not join.unique else probe_cap
-                probe_cap = base + build_cap
-            elif not join.unique and join.kind in ("inner", "left"):
-                probe_cap = join_specs[-1].out_cap
+        join_specs = _make_join_specs(
+            p.joins, nrows, all_bounds, bounds_by_reader, lane_of, ndev
+        )
 
-        # rebase left_keys of later joins: after join ji the accumulated lane
-        # layout = probe lanes + build lanes — lane_of already accounts for
-        # this because it is computed over the full reader list
-        # key-NULL masking: inner-join keys must be non-NULL to match
-        for ji, spec in enumerate(join_specs):
-            spec.left_key_valid = tuple(k + 1 for k in spec.left_keys)
-            spec.right_key_valid = tuple(k + 1 for k in spec.right_keys)
+        # device-stage runtimes: each staged build side carries its own
+        # selections, internal join specs, agg-input mapper, and finalize
+        # closure (HAVING + projection over the merged group slots). The
+        # pure-data DistStageSpec rides the compile key; callables live in
+        # the StageRuntime wrapper.
+        from tidb_tpu.parallel.mpp import DistStageSpec, StageRuntime
+
+        def _stage_agg_inputs(s_gb, s_aggs, s_lane_of, s_total):
+            def fn(joined):
+                pairs = [
+                    (joined[s_lane_of[i]], joined[s_lane_of[i] + 1]) for i in range(s_total)
+                ]
+                n = pairs[0][0].shape[0]
+                batch = EvalBatch(pairs, [None] * len(pairs), n, warn=warn_sink)
+                out = []
+                for g in s_gb:
+                    d, v, _ = eval_expr(g, batch, jnp)
+                    # same key-lane dtype discipline as the final agg: int-
+                    # backed keys widen to int64, FLOAT keys keep their dtype
+                    # (an int64 cast would merge distinct groups)
+                    d = jnp.broadcast_to(d, (n,))
+                    d = d.astype(jnp.float64) if jnp.issubdtype(d.dtype, jnp.floating) else d.astype(jnp.int64)
+                    v = jnp.broadcast_to(v if v is not None else True, (n,))
+                    out.append(jnp.where(v, d, 0))
+                    out.append(v.astype(jnp.int64))
+                for a in s_aggs:
+                    if a.arg is None:
+                        continue
+                    d, v, _ = eval_expr(a.arg, batch, jnp)
+                    d = jnp.broadcast_to(d, (n,))
+                    v = jnp.broadcast_to(v if v is not None else True, (n,))
+                    if a.name in ("min", "max"):
+                        # extremes reduce with sentinels, not zeros
+                        if jnp.issubdtype(d.dtype, jnp.floating):
+                            sent = jnp.inf if a.name == "min" else -jnp.inf
+                        else:
+                            sent = (
+                                jnp.iinfo(jnp.int64).max if a.name == "min" else jnp.iinfo(jnp.int64).min
+                            )
+                        out.append(jnp.where(v, d, sent))
+                    else:
+                        out.append(jnp.where(v, d, 0))
+                    out.append(v.astype(jnp.int64))
+                return out
+
+            return fn
+
+        def _stage_finalize(sub, s_gb, s_aggs):
+            """Merged group slots → the subplan's OUTPUT lanes, with the
+            host finalize semantics (finalize_agg) reproduced in jnp —
+            notably decimal AVG's scale+4 rounded division — then the
+            HAVING residue and the projection evaluated device-side."""
+            having, proj, n_gk = sub.having, sub.proj, len(s_gb)
+
+            def fn(mkeys, msums, bcnt):
+                n = bcnt.shape[0]
+                slot_live = bcnt > 0
+                pairs = []
+                vi = 0
+                for a in s_aggs:
+                    if a.arg is None:  # COUNT(*)
+                        pairs.append((bcnt.astype(jnp.int64), slot_live))
+                        continue
+                    vdata, vcnt = msums[2 * vi], msums[2 * vi + 1]
+                    vi += 1
+                    if a.name == "count":
+                        pairs.append((vcnt.astype(jnp.int64), slot_live))
+                    elif a.name == "avg":
+                        denom = jnp.maximum(vcnt, 1)
+                        if a.arg.ftype.kind == TypeKind.DECIMAL:
+                            # sum lane carries arg scale; result scale+4 with
+                            # round-half-away (host finalize_agg parity)
+                            num = vdata.astype(jnp.int64) * 10000
+                            q = jnp.sign(num) * ((jnp.abs(num) + denom // 2) // denom)
+                            pairs.append((q, vcnt > 0))
+                        else:
+                            pairs.append((vdata.astype(jnp.float64) / denom, vcnt > 0))
+                    else:  # sum / min / max
+                        pairs.append((vdata, vcnt > 0))
+                for gi in range(n_gk):
+                    pairs.append((mkeys[2 * gi], mkeys[2 * gi + 1].astype(bool)))
+                live = slot_live
+                batch = EvalBatch(pairs, [None] * len(pairs), n, warn=warn_sink)
+                for c in having:
+                    d, v, _ = eval_expr(c, batch, jnp)
+                    keep = jnp.broadcast_to(d != 0, live.shape)
+                    if v is not None:
+                        keep = keep & jnp.broadcast_to(v, live.shape)
+                    live = live & keep
+                outs = []
+                if proj is not None:
+                    for src in proj:
+                        d, v, _ = eval_expr(src, batch, jnp)
+                        d = jnp.broadcast_to(d, (n,))
+                        vb = jnp.broadcast_to(v if v is not None else True, (n,)).astype(bool)
+                        outs += [jnp.where(vb, d, 0), vb]
+                else:
+                    for d, vb in pairs:
+                        d = jnp.broadcast_to(d, (n,))
+                        vb = jnp.broadcast_to(vb, (n,)).astype(bool)
+                        outs += [jnp.where(vb, d, 0), vb]
+                return outs, live
+
+            return fn
+
+        stage_runtimes: list = [None] * len(p.readers)
+        for ri in range(len(p.readers)):
+            if stage_parts[ri] is None:
+                continue
+            sub = p.readers[ri]
+            s_readers, s_joins, s_filters, s_gb, s_aggs = stage_parts[ri]
+            blocks = sides[ri]
+            s_conds = [self._bind_conditions(sr) for sr in s_readers]
+            s_ncols = [len(sr.schema) for sr in s_readers]
+            s_selec = [side_selection(s_conds[i], s_ncols[i]) for i in range(len(s_readers))]
+            s_nrows = [n for _, n, _ in blocks]
+            s_bounds = [bs for _, _, bs in blocks]
+            s_nlanes, s_lane_of = _lane_layout(s_readers, s_joins)
+            s_acc_bounds = list(s_bounds[0])
+            for ji, join in enumerate(s_joins):
+                if join.kind in ("inner", "left", "right"):
+                    s_acc_bounds.extend(s_bounds[ji + 1])
+            s_specs = _make_join_specs(s_joins, s_nrows, s_acc_bounds, s_bounds, s_lane_of, ndev)
+            s_total = _plan_schema_len(s_readers, s_joins)
+            s_kb = []
+            for g in s_gb:
+                s_kb.append(
+                    s_acc_bounds[g.index]
+                    if isinstance(g, ColumnRef) and g.index < len(s_acc_bounds)
+                    else None
+                )
+                s_kb.append((0, 1))
+            val_kinds = []
+            for a in s_aggs:
+                if a.arg is not None:
+                    val_kinds.append(a.name if a.name in ("min", "max") else "sum")
+                    val_kinds.append("sum")  # the validity/count lane
+            nk = 2 * len(s_gb)
+            stage_runtimes[ri] = StageRuntime(
+                DistStageSpec(
+                    n_lanes=list(s_nlanes),
+                    joins=s_specs,
+                    n_keys=nk,
+                    sums=list(range(nk, nk + len(val_kinds))),
+                    group_cap=stage_caps[ri],
+                    key_bounds=tuple(s_kb),
+                    val_kinds=tuple(val_kinds),
+                    out_width=len(sub.schema),
+                ),
+                s_selec,
+                _stage_agg_inputs(s_gb, s_aggs, s_lane_of, s_total),
+                _stage_finalize(sub, s_gb, s_aggs),
+                pair_filters=[
+                    build_pair_filter(j, ji, _readers=s_readers, _joins=s_joins, _lane_of=s_lane_of)
+                    if j.other
+                    else None
+                    for ji, j in enumerate(s_joins)
+                ],
+                chain_filters=[
+                    (pos, lanes_filter(cl, _lane_of=s_lane_of, _total=s_total))
+                    for pos, cl in s_filters
+                ],
+            )
+        has_stages = any(s is not None for s in stage_runtimes)
 
         group_cap = 0
         if agg is not None:
@@ -1759,12 +2281,20 @@ class MPPGatherExec:
                 repr(spec),
                 repr(topn_spec),
                 tuple(n_lanes),
+                tuple(in_lanes),
                 tuple(repr([c.to_pb() for c in cl]) for cl in conds),
                 repr([g.to_pb() for g in agg.group_by]) if agg is not None else "",
                 repr([a.to_pb() for a in agg.aggs]) if agg is not None else "",
                 tuple(ncols),
                 repr([(pos, [c.to_pb() for c in cl]) for pos, cl in p.filters]),
                 repr([[c.to_pb() for c in j.other] for j in p.joins]),
+                # staged build sides: the stage spec (caps/bounds/joins) plus
+                # the subplan's value fingerprint (conds/agg/having/proj)
+                tuple(repr(s.spec) if s is not None else "" for s in stage_runtimes),
+                tuple(
+                    r.fingerprint() if isinstance(r, SubplanReader) and r.staged else ""
+                    for r in p.readers
+                ),
                 PROBES_ENABLED,
             )
             from tidb_tpu.utils import metrics as _met
@@ -1777,7 +2307,7 @@ class MPPGatherExec:
                     mesh,
                     join_specs,
                     spec,
-                    n_lanes=n_lanes,
+                    n_lanes=in_lanes,
                     selections=selections,
                     agg_inputs=agg_inputs if agg is not None else None,
                     topn=topn_spec,
@@ -1785,6 +2315,7 @@ class MPPGatherExec:
                     shard_probe=_shard_probe if PROBES_ENABLED else None,
                     pair_filters=pair_filters,
                     chain_filters=chain_filters,
+                    stages=stage_runtimes if has_stages else None,
                 )
                 # the sink is baked into the compiled program's closures: a
                 # cache hit must attribute warn counts via the ORIGINAL sink
@@ -1825,6 +2356,10 @@ class MPPGatherExec:
                 # grow-and-retry attempts overwrite: the SUCCESSFUL run wins
                 self._shard_obs = sorted(shard_obs)
             wtotal = int(arrs.pop())  # the warn-count slot (always present)
+            if has_stages:
+                # per-stage exchanged bytes (staged-reader order) — feeds
+                # EXPLAIN ANALYZE's mpp_task line and the multichip dryrun
+                self._stage_bytes = [int(x) for x in np.asarray(arrs.pop())]
             dropped = int(arrs[-2])
             overflow = int(arrs[-1])
             if dropped == 0 and overflow == 0:
@@ -1843,15 +2378,25 @@ class MPPGatherExec:
                 break
             # grow-on-overflow, like coprocessor paging (skewed owners can
             # exceed either side's 2× headroom; the counters are shared, so
-            # grow everything that can overflow)
+            # grow everything that can overflow — stage caps included)
             if dropped:
                 for s in join_specs:
                     s.left_row_cap *= 4
                     s.right_row_cap *= 4
+                for st in stage_runtimes:
+                    if st is not None:
+                        for s in st.spec.joins:
+                            s.left_row_cap *= 4
+                            s.right_row_cap *= 4
             if overflow:
                 group_cap *= 4
                 for s in join_specs:
                     s.out_cap *= 4
+                for st in stage_runtimes:
+                    if st is not None:
+                        st.spec.group_cap *= 4
+                        for s in st.spec.joins:
+                            s.out_cap *= 4
         if agg is not None:
             return self._merge(arrs[:-2], agg)
         return self._rows_chunk(arrs[:-2])

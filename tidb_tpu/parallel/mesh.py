@@ -8,6 +8,12 @@ from typing import Optional, Sequence
 
 _MESH_CACHE: dict = {}
 
+# forced mesh width for scaling runs: the benchdaily scaling-curve lanes and
+# the stage-chain ndev-parity tests pin the SAME process to 1/2/4/8 devices
+# of the virtual CPU mesh (None = use every available device). Applies only
+# when the caller passes no explicit n_devices/devices.
+FORCE_NDEV: Optional[int] = None
+
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "dp", devices: Optional[Sequence] = None):
     """1-D mesh over available devices. SQL fragments parallelize along one
@@ -21,6 +27,8 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "dp", devices: Option
     from jax.sharding import Mesh
 
     devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is None and devices is None:
+        n_devices = FORCE_NDEV
     if n_devices is not None:
         if n_devices > len(devs):
             raise RuntimeError(

@@ -458,6 +458,297 @@ def _local_match_counts(jax, jnp, lkey, lkeys, lvalid, rkey, rkeys, rvalid, dead
 
 
 @dataclass
+class DistStageSpec:
+    """One device-resident pipeline STAGE producing a build side for the
+    next fragment (ref: fragment trees whose exchange receivers feed further
+    exchange senders, fragment.go stacked fragments). The staged subplan
+    (scan → [join chain] → grouped agg → finalize/having/proj) runs inside
+    the SAME shard_map program as its consumer; its group slots stay in HBM
+    and the downstream join re-partitions them with ``all_to_all`` on the
+    NEW key — the inter-stage repartition that used to be a D2H gather →
+    host re-slice → H2D re-upload.
+
+    Pure data (callables ride the StageRuntime wrapper so this spec can be
+    part of a compiled-program cache key): ``n_lanes`` per stage-reader
+    input lane counts; ``joins`` the left-deep chain INSIDE the stage;
+    ``n_keys``/``sums``/``group_cap``/``key_bounds``/``val_kinds`` the
+    stage's agg spec (same contract as DistAggSpec); ``out_width`` the
+    number of output (data, valid) lane pairs the finalize emits."""
+
+    n_lanes: Sequence[int]
+    joins: Sequence[DistJoinSpec]
+    n_keys: int
+    sums: Sequence[int]
+    group_cap: int = 256
+    key_bounds: tuple = ()
+    val_kinds: tuple = ()
+    out_width: int = 0
+
+
+class StageRuntime:
+    """DistStageSpec + the traced callables that close over bound
+    expressions: per-stage-reader selections, the agg-input mapper, and the
+    finalize (agg outputs → build lanes + live mask, incl. HAVING/proj).
+    Kept OUT of the dataclass so ``repr(spec)`` stays a stable cache key."""
+
+    __slots__ = ("spec", "selections", "agg_inputs", "finalize", "pair_filters", "chain_filters")
+
+    def __init__(self, spec, selections, agg_inputs, finalize, pair_filters=None, chain_filters=()):
+        self.spec = spec
+        self.selections = selections
+        self.agg_inputs = agg_inputs
+        self.finalize = finalize
+        self.pair_filters = pair_filters
+        self.chain_filters = chain_filters  # [(chain position, mask fn)]
+
+
+def _fold_join(jax, jnp, join, ndev, acc, mask, rcols, rvalid, pf):
+    """Fold ONE build side into the accumulated probe layout — the per-join
+    body of the fragment pipeline, shared by the outer chain and the join
+    chains INSIDE device stages. Returns (acc, mask, dropped, overflow,
+    xbytes) deltas accumulated into the caller's counters."""
+    dropped = jnp.int64(0)
+    overflow = jnp.int64(0)
+    xbytes = jnp.int64(0)
+    kb = tuple(join.key_bounds) if join.key_bounds else None
+
+    def join_lane(comps, _kb=kb):
+        p = _pack_keys(jnp, comps, _kb) if _kb else None
+        if p is None:
+            return _combine_keys(jnp, comps), None
+        return p
+
+    kind = join.kind
+    lkeys = [acc[i] for i in join.left_keys]
+    rkeys = [rcols[i] for i in join.right_keys]
+    # probe rows with NULL keys: inner/semi joins drop them up front;
+    # left joins must keep them (NULL-extended), anti joins must keep
+    # them (a NULL key matches nothing)
+    lkv = jnp.ones(mask.shape[0], dtype=bool)
+    for vl in join.left_key_valid:
+        lkv = lkv & acc[vl].astype(bool)
+    if kind in ("inner", "semi"):
+        mask = mask & lkv
+    lkey, ncodes = join_lane(lkeys)
+    rkey, _ = join_lane(rkeys)
+    if join.exchange == "hash":
+        # NULL-key survivors route to shard 0 (they match nothing)
+        lowner = jnp.where(lkv, jnp.abs(lkey).astype(jnp.int64) % ndev, 0)
+        rowner = jnp.abs(rkey).astype(jnp.int64) % ndev
+        lcap = join.left_row_cap or join.row_cap
+        rcap = join.right_row_cap or join.row_cap
+        xbytes = xbytes + mask.sum() * (8 * len(acc)) + rvalid.sum() * (8 * len(rcols))
+        acc, mask, d1 = _route_rows(jax, jnp, acc, mask, lowner, ndev, lcap)
+        rcols, rvalid, d2 = _route_rows(jax, jnp, rcols, rvalid, rowner, ndev, rcap)
+        dropped = dropped + d1 + d2
+        lkeys = [acc[i] for i in join.left_keys]
+        rkeys = [rcols[i] for i in join.right_keys]
+        lkv = jnp.ones(mask.shape[0], dtype=bool)
+        for vl in join.left_key_valid:
+            lkv = lkv & acc[vl].astype(bool)
+        lkey, ncodes = join_lane(lkeys)
+        rkey, _ = join_lane(rkeys)
+    else:  # broadcast: replicate the build side on every shard
+        xbytes = xbytes + rvalid.sum() * (8 * len(rcols) * max(ndev - 1, 0))
+        rcols = [jax.lax.all_gather(c, "dp").reshape(-1) for c in rcols]
+        rvalid = jax.lax.all_gather(rvalid, "dp").reshape(-1)
+        rkeys = [rcols[i] for i in join.right_keys]
+        rkey, _ = join_lane(rkeys)
+    rlive = rvalid  # post-selection build rows (right joins preserve
+    # these even with NULL keys — key validity only gates MATCHING)
+    for vl in join.right_key_valid:
+        rvalid = rvalid & rcols[vl].astype(bool)
+    # dead-row sentinels above every live key code (packed lanes stay
+    # in their narrow dtype; mixed-hash lanes use the int64 bigs)
+    dead_b = None if ncodes is None else ncodes + 1
+    dead_p = None if ncodes is None else ncodes
+    if (
+        ncodes is None
+        and len(lkeys) > 1
+        and not join.unique
+        and (kind == "left" or (kind in ("semi", "anti") and pf is None))
+    ):
+        # count-based existence / left-outer match counts must be
+        # EXACT and no static bounds packed the key — rank-compress
+        # the composite key over both sides instead (collision-free)
+        lkey, rkey, span = _exact_pair_lanes(jnp, lkeys, rkeys)
+        dead_b, dead_p = span + 1, span
+    probe_live = mask & lkv  # rows eligible to match
+    if kind == "right":
+        # build-side outer (ref: mpp.go:397 right-out join build):
+        # matched pairs emit like inner; build rows NO probe row
+        # matched emit once with the probe lanes NULL-extended. With
+        # hash exchange each build row lives on exactly one shard, so
+        # the unmatched flag is local; with broadcast the flag must
+        # AND across shards (psum of per-shard match counts) and only
+        # shard 0 emits the survivors.
+        if join.unique:
+            gathered, match = _local_unique_join(
+                jax, jnp, lkey, lkeys, probe_live, rkey, rkeys, rcols, rvalid, dead_b, dead_p
+            )
+            macc = acc + gathered
+            mmask = match
+        else:
+            out_l, out_r, mmask, of = _local_expand_join(
+                jax, jnp, lkey, lkeys, probe_live, rkey, rkeys,
+                rcols, rvalid, acc, join.out_cap, dead_b, dead_p,
+                left_outer=False, lmatch=probe_live
+            )
+            overflow = overflow + of
+            macc = out_l + out_r
+        # per-build-row probe-match counts (roles swapped; exact —
+        # the planner admits single-key right joins only)
+        cnt_b = _local_match_counts(
+            jax, jnp, rkey, rkeys, rvalid, lkey, lkeys, probe_live, dead_b, dead_p
+        )
+        if join.exchange == "broadcast":
+            cnt_b = jax.lax.psum(cnt_b, "dp")
+            emit = jax.lax.axis_index("dp") == 0
+            unmatched = rlive & (cnt_b == 0) & emit
+        else:
+            unmatched = rlive & (cnt_b == 0)
+        n_probe_lanes = len(acc)
+        rn = rlive.shape[0]
+        acc = [
+            jnp.concatenate([a, jnp.zeros(rn, a.dtype)])
+            for a in macc[:n_probe_lanes]
+        ] + [
+            jnp.concatenate([a, rc])
+            for a, rc in zip(macc[n_probe_lanes:], rcols)
+        ]
+        mask = jnp.concatenate([mmask, unmatched])
+    elif kind in ("semi", "anti") and pf is not None:
+        # existence gated on non-equality pair conditions: expand,
+        # verify, filter, reduce (unique build sides ride the same
+        # path — the expansion then has ≤1 candidate per probe row)
+        cnt_pass, of = _local_filtered_exists(
+            jax, jnp, lkey, lkeys, probe_live, rkey, rkeys, rcols, rvalid,
+            acc, join.out_cap, pf, dead_b, dead_p,
+        )
+        overflow = overflow + of
+        mask = mask & (cnt_pass > 0) if kind == "semi" else mask & (cnt_pass == 0)
+    elif kind in ("semi", "anti") and not join.unique:
+        cnt = _local_match_counts(
+            jax, jnp, lkey, lkeys, probe_live, rkey, rkeys, rvalid, dead_b, dead_p
+        )
+        mask = mask & (cnt > 0) if kind == "semi" else mask & (cnt == 0)
+    elif join.unique:
+        gathered, match = _local_unique_join(
+            jax, jnp, lkey, lkeys, probe_live, rkey, rkeys, rcols, rvalid, dead_b, dead_p
+        )
+        if kind == "inner":
+            mask = match
+            acc = acc + gathered
+        elif kind == "left":
+            # NULL-extend the build lanes for matchless probe rows
+            acc = acc + [jnp.where(match, g, 0) for g in gathered]
+        elif kind == "semi":
+            mask = match
+        else:  # anti
+            mask = mask & ~match
+    else:
+        out_l, out_r, newmask, of = _local_expand_join(
+            jax, jnp, lkey, lkeys, probe_live if kind == "inner" else mask, rkey, rkeys,
+            rcols, rvalid, acc, join.out_cap, dead_b, dead_p,
+            left_outer=(kind == "left"), lmatch=probe_live
+        )
+        overflow = overflow + of
+        mask = newmask
+        acc = out_l + out_r
+    return acc, mask, dropped, overflow, xbytes
+
+
+def _exchange_group_slots(jax, jnp, ndev, cap, pkeys, psums, pcnt, route_keys=None):
+    """Hash-exchange per-shard group SLOTS to their key owners — the
+    fragment-boundary ``all_to_all`` between a partial agg and its merge
+    (shared by the final agg tail and inter-stage repartitions). Routes by
+    ``route_keys`` (default: every key lane); returns (rxkeys, rxsums,
+    rxcnt, slot_overflow)."""
+    h = _combine_keys(jnp, route_keys if route_keys is not None else pkeys)
+    owner = jnp.where(pcnt > 0, jnp.abs(h) % ndev, ndev - 1)
+    order = jnp.argsort(owner, stable=True)
+    so = owner[order]
+    rank = jnp.arange(cap) - jnp.searchsorted(so, so, side="left")
+    # one dest owning more than ``cap`` group slots overflows the bucket
+    of_slots = ((pcnt[order] > 0) & (rank >= cap)).sum()
+
+    def bucketize(x):
+        buf = jnp.zeros((ndev * cap,), dtype=x.dtype)
+        return buf.at[so * cap + rank].set(x[order])
+
+    def exchange(buf):
+        return jax.lax.all_to_all(
+            buf.reshape(ndev, cap), "dp", split_axis=0, concat_axis=0, tiled=False
+        ).reshape(ndev * cap)
+
+    rxkeys = [exchange(bucketize(k)) for k in pkeys]
+    rxsums = [exchange(bucketize(s)) for s in psums]
+    rxcnt = exchange(bucketize(pcnt))
+    return rxkeys, rxsums, rxcnt, of_slots
+
+
+def _run_stage(jax, jnp, stage: StageRuntime, block, ndev):
+    """Execute one DEVICE stage over its readers' input lane block: fold the
+    stage's join chain, run the two-phase grouped agg (partial →
+    group-owner all_to_all → merge), finalize to build lanes. The returned
+    lanes are per-shard ``group_cap`` slots, DEVICE-RESIDENT — the consumer
+    join's exchange re-partitions them on the new key without any host
+    round-trip. Returns (out_lanes, out_valid, dropped, overflow, xbytes)."""
+    spec = stage.spec
+
+    def _chain(pos, acc, mask):
+        for fpos, fn in stage.chain_filters:
+            if fpos == pos:
+                mask = mask & fn(acc)
+        return mask
+
+    soffs = [sum(spec.n_lanes[:i]) for i in range(len(spec.n_lanes) + 1)]
+    acc = list(block[soffs[0] : soffs[1]])
+    mask = jnp.ones(acc[0].shape[0], dtype=bool)
+    if stage.selections[0] is not None:
+        mask = stage.selections[0](*acc)
+    mask = _chain(0, acc, mask)
+    dropped = jnp.int64(0)
+    overflow = jnp.int64(0)
+    xbytes = jnp.int64(0)
+    for ji, join in enumerate(spec.joins):
+        rcols = list(block[soffs[ji + 1] : soffs[ji + 2]])
+        rvalid = jnp.ones(rcols[0].shape[0], dtype=bool)
+        if stage.selections[ji + 1] is not None:
+            rvalid = stage.selections[ji + 1](*rcols)
+        pf = stage.pair_filters[ji] if stage.pair_filters is not None else None
+        acc, mask, d, of, xb = _fold_join(jax, jnp, join, ndev, acc, mask, rcols, rvalid, pf)
+        dropped, overflow, xbytes = dropped + d, overflow + of, xbytes + xb
+        mask = _chain(ji + 1, acc, mask)
+    acols = stage.agg_inputs(acc)
+    keys = list(acols[: spec.n_keys])
+    vals = [acols[i] for i in spec.sums]
+    pkeys, psums, pcnt, of1 = _segment_partial(
+        jnp, keys, vals, mask, spec.group_cap, spec.key_bounds, spec.val_kinds
+    )
+    # the inter-stage repartition: live group slots cross the mesh ONCE,
+    # 8 B per lane per slot (keys + sums + count) — all on ICI
+    xbytes = xbytes + (pcnt > 0).sum() * jnp.int64(8 * (len(pkeys) + len(psums) + 1))
+    rxkeys, rxsums, rxcnt, of_slots = _exchange_group_slots(
+        jax, jnp, ndev, spec.group_cap, pkeys, psums, pcnt
+    )
+    mkeys, msums_cnt, _, of3 = _segment_partial(
+        jnp,
+        rxkeys,
+        rxsums + [rxcnt],
+        rxcnt > 0,
+        spec.group_cap,
+        spec.key_bounds,
+        tuple(spec.val_kinds) + ("sum",),
+    )
+    out_lanes, out_valid = stage.finalize(mkeys, list(msums_cnt[:-1]), msums_cnt[-1])
+    # trailing live lane keeps the block layout identical to a plain
+    # reader's (2*ncols data/valid pairs + live), so the accumulated lane
+    # offsets downstream stay uniform
+    return out_lanes + [out_valid], out_valid, dropped, overflow + of1 + of_slots + of3, xbytes
+
+
+@dataclass
 class DistTopNSpec:
     """Per-shard TopN/Limit/row-gather tail over the joined lane layout.
 
@@ -486,6 +777,7 @@ def build_dist_pipeline(
     shard_probe: Callable | None = None,
     pair_filters: Sequence[Callable | None] | None = None,
     chain_filters: Sequence[tuple] = (),
+    stages: "Sequence[StageRuntime | None] | None" = None,
 ):
     """The generalized MPP pipeline in ONE jitted shard_map (ref: §3.3 —
     fragments: scan→sel→[exchange→join]*→(partial agg→hash exchange→merge |
@@ -507,7 +799,13 @@ def build_dist_pipeline(
     depend on the shard-LOCAL tail reduction (before the final replicating
     collectives, which would synchronize every shard to the same finish
     time), so the invocation time attributes per-shard compute: the
-    straggler probe behind the ``mpp_task: {..., slowest: shard k}`` line."""
+    straggler probe behind the ``mpp_task: {..., slowest: shard k}`` line.
+
+    ``stages``: per-reader StageRuntime or None — reader k with a stage runs
+    its input block through :func:`_run_stage` and the STAGE OUTPUT slots
+    (device-resident) become the join's build side; with stages present the
+    program emits one extra replicated output, the per-stage exchanged-byte
+    vector (ordered by reader index), before the warn count."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -538,157 +836,26 @@ def build_dist_pipeline(
         # per-shard exchanged-byte estimate (8 B per lane per routed row);
         # DCE'd when no shard_probe consumes it
         xbytes = jnp.int64(0)
+        # per-stage exchanged bytes (reader order), replicated output when
+        # any stage exists — the dryrun/EXPLAIN per-stage breakdown
+        stage_xb: list = []
         for ji, join in enumerate(joins):
-            rcols = list(cols[offs[ji + 1] : offs[ji + 2]])
-            rvalid = jnp.ones(rcols[0].shape[0], dtype=bool)
-            if selections[ji + 1] is not None:
-                rvalid = selections[ji + 1](*rcols)
-            kb = tuple(join.key_bounds) if join.key_bounds else None
-
-            def join_lane(comps, _kb=kb):
-                p = _pack_keys(jnp, comps, _kb) if _kb else None
-                if p is None:
-                    return _combine_keys(jnp, comps), None
-                return p
-
-            kind = join.kind
-            lkeys = [acc[i] for i in join.left_keys]
-            rkeys = [rcols[i] for i in join.right_keys]
-            # probe rows with NULL keys: inner/semi joins drop them up front;
-            # left joins must keep them (NULL-extended), anti joins must keep
-            # them (a NULL key matches nothing)
-            lkv = jnp.ones(mask.shape[0], dtype=bool)
-            for vl in join.left_key_valid:
-                lkv = lkv & acc[vl].astype(bool)
-            if kind in ("inner", "semi"):
-                mask = mask & lkv
-            lkey, ncodes = join_lane(lkeys)
-            rkey, _ = join_lane(rkeys)
-            if join.exchange == "hash":
-                # NULL-key survivors route to shard 0 (they match nothing)
-                lowner = jnp.where(lkv, jnp.abs(lkey).astype(jnp.int64) % ndev, 0)
-                rowner = jnp.abs(rkey).astype(jnp.int64) % ndev
-                lcap = join.left_row_cap or join.row_cap
-                rcap = join.right_row_cap or join.row_cap
-                xbytes = xbytes + mask.sum() * (8 * len(acc)) + rvalid.sum() * (8 * len(rcols))
-                acc, mask, d1 = _route_rows(jax, jnp, acc, mask, lowner, ndev, lcap)
-                rcols, rvalid, d2 = _route_rows(jax, jnp, rcols, rvalid, rowner, ndev, rcap)
-                dropped = dropped + d1 + d2
-                lkeys = [acc[i] for i in join.left_keys]
-                rkeys = [rcols[i] for i in join.right_keys]
-                lkv = jnp.ones(mask.shape[0], dtype=bool)
-                for vl in join.left_key_valid:
-                    lkv = lkv & acc[vl].astype(bool)
-                lkey, ncodes = join_lane(lkeys)
-                rkey, _ = join_lane(rkeys)
-            else:  # broadcast: replicate the build side on every shard
-                xbytes = xbytes + rvalid.sum() * (8 * len(rcols) * max(ndev - 1, 0))
-                rcols = [jax.lax.all_gather(c, "dp").reshape(-1) for c in rcols]
-                rvalid = jax.lax.all_gather(rvalid, "dp").reshape(-1)
-                rkeys = [rcols[i] for i in join.right_keys]
-                rkey, _ = join_lane(rkeys)
-            rlive = rvalid  # post-selection build rows (right joins preserve
-            # these even with NULL keys — key validity only gates MATCHING)
-            for vl in join.right_key_valid:
-                rvalid = rvalid & rcols[vl].astype(bool)
-            # dead-row sentinels above every live key code (packed lanes stay
-            # in their narrow dtype; mixed-hash lanes use the int64 bigs)
-            dead_b = None if ncodes is None else ncodes + 1
-            dead_p = None if ncodes is None else ncodes
-            pf = pair_filters[ji] if pair_filters is not None else None
-            if (
-                ncodes is None
-                and len(lkeys) > 1
-                and not join.unique
-                and (kind == "left" or (kind in ("semi", "anti") and pf is None))
-            ):
-                # count-based existence / left-outer match counts must be
-                # EXACT and no static bounds packed the key — rank-compress
-                # the composite key over both sides instead (collision-free)
-                lkey, rkey, span = _exact_pair_lanes(jnp, lkeys, rkeys)
-                dead_b, dead_p = span + 1, span
-            probe_live = mask & lkv  # rows eligible to match
-            if kind == "right":
-                # build-side outer (ref: mpp.go:397 right-out join build):
-                # matched pairs emit like inner; build rows NO probe row
-                # matched emit once with the probe lanes NULL-extended. With
-                # hash exchange each build row lives on exactly one shard, so
-                # the unmatched flag is local; with broadcast the flag must
-                # AND across shards (psum of per-shard match counts) and only
-                # shard 0 emits the survivors.
-                if join.unique:
-                    gathered, match = _local_unique_join(
-                        jax, jnp, lkey, lkeys, probe_live, rkey, rkeys, rcols, rvalid, dead_b, dead_p
-                    )
-                    macc = acc + gathered
-                    mmask = match
-                else:
-                    out_l, out_r, mmask, of = _local_expand_join(
-                        jax, jnp, lkey, lkeys, probe_live, rkey, rkeys,
-                        rcols, rvalid, acc, join.out_cap, dead_b, dead_p,
-                        left_outer=False, lmatch=probe_live
-                    )
-                    overflow = overflow + of
-                    macc = out_l + out_r
-                # per-build-row probe-match counts (roles swapped; exact —
-                # the planner admits single-key right joins only)
-                cnt_b = _local_match_counts(
-                    jax, jnp, rkey, rkeys, rvalid, lkey, lkeys, probe_live, dead_b, dead_p
-                )
-                if join.exchange == "broadcast":
-                    cnt_b = jax.lax.psum(cnt_b, "dp")
-                    emit = jax.lax.axis_index("dp") == 0
-                    unmatched = rlive & (cnt_b == 0) & emit
-                else:
-                    unmatched = rlive & (cnt_b == 0)
-                n_probe_lanes = len(acc)
-                rn = rlive.shape[0]
-                acc = [
-                    jnp.concatenate([a, jnp.zeros(rn, a.dtype)])
-                    for a in macc[:n_probe_lanes]
-                ] + [
-                    jnp.concatenate([a, rc])
-                    for a, rc in zip(macc[n_probe_lanes:], rcols)
-                ]
-                mask = jnp.concatenate([mmask, unmatched])
-            elif kind in ("semi", "anti") and pf is not None:
-                # existence gated on non-equality pair conditions: expand,
-                # verify, filter, reduce (unique build sides ride the same
-                # path — the expansion then has ≤1 candidate per probe row)
-                cnt_pass, of = _local_filtered_exists(
-                    jax, jnp, lkey, lkeys, probe_live, rkey, rkeys, rcols, rvalid,
-                    acc, join.out_cap, pf, dead_b, dead_p,
-                )
-                overflow = overflow + of
-                mask = mask & (cnt_pass > 0) if kind == "semi" else mask & (cnt_pass == 0)
-            elif kind in ("semi", "anti") and not join.unique:
-                cnt = _local_match_counts(
-                    jax, jnp, lkey, lkeys, probe_live, rkey, rkeys, rvalid, dead_b, dead_p
-                )
-                mask = mask & (cnt > 0) if kind == "semi" else mask & (cnt == 0)
-            elif join.unique:
-                gathered, match = _local_unique_join(
-                    jax, jnp, lkey, lkeys, probe_live, rkey, rkeys, rcols, rvalid, dead_b, dead_p
-                )
-                if kind == "inner":
-                    mask = match
-                    acc = acc + gathered
-                elif kind == "left":
-                    # NULL-extend the build lanes for matchless probe rows
-                    acc = acc + [jnp.where(match, g, 0) for g in gathered]
-                elif kind == "semi":
-                    mask = match
-                else:  # anti
-                    mask = mask & ~match
+            block = list(cols[offs[ji + 1] : offs[ji + 2]])
+            stage = stages[ji + 1] if stages is not None else None
+            if stage is not None:
+                rcols, rvalid, d_s, of_s, xb_s = _run_stage(jax, jnp, stage, block, ndev)
+                dropped = dropped + d_s
+                overflow = overflow + of_s
+                xbytes = xbytes + xb_s
+                stage_xb.append(xb_s)
             else:
-                out_l, out_r, newmask, of = _local_expand_join(
-                    jax, jnp, lkey, lkeys, probe_live if kind == "inner" else mask, rkey, rkeys,
-                    rcols, rvalid, acc, join.out_cap, dead_b, dead_p,
-                    left_outer=(kind == "left"), lmatch=probe_live
-                )
-                overflow = overflow + of
-                mask = newmask
-                acc = out_l + out_r
+                rcols = block
+                rvalid = jnp.ones(rcols[0].shape[0], dtype=bool)
+                if selections[ji + 1] is not None:
+                    rvalid = selections[ji + 1](*rcols)
+            pf = pair_filters[ji] if pair_filters is not None else None
+            acc, mask, d, of, xb = _fold_join(jax, jnp, join, ndev, acc, mask, rcols, rvalid, pf)
+            dropped, overflow, xbytes = dropped + d, overflow + of, xbytes + xb
             mask = _apply_chain(ji + 1, acc, mask)
         outs, local_rows = (
             _agg_tail(acc, mask, dropped, overflow)
@@ -700,6 +867,10 @@ def build_dist_pipeline(
             # tail reduction, so the probe fires after this shard's compute
             # but BEFORE the synchronizing gathers equalize finish times
             jax.debug.callback(shard_probe, jax.lax.axis_index("dp"), local_rows, xbytes)
+        if stage_xb:
+            # per-stage exchange bytes, summed across shards — rides home as
+            # one replicated vector (staged-reader order)
+            outs = (*outs, jax.lax.psum(jnp.stack(stage_xb), "dp"))
         if warn_sink is not None:
             # device warnings born inside the fragment (division by 0 in a
             # selection/agg argument) ride ONE replicated count output —
@@ -753,27 +924,11 @@ def build_dist_pipeline(
         keys = list(acols[: G + D])
         vals = [acols[i] for i in agg.sums]
         pkeys, psums, pcnt, of1 = _segment_partial(jnp, keys, vals, mask, cap, agg.key_bounds, agg.val_kinds)
-        h = _combine_keys(jnp, pkeys[:G])  # route by GROUP keys only: every
-        # (g, *) slot lands on g's owner shard, where x dedups globally
-        owner = jnp.where(pcnt > 0, jnp.abs(h) % ndev, ndev - 1)
-        order = jnp.argsort(owner, stable=True)
-        so = owner[order]
-        rank = jnp.arange(cap) - jnp.searchsorted(so, so, side="left")
-        # one dest owning more than ``cap`` group slots overflows the bucket
-        of_slots = ((pcnt[order] > 0) & (rank >= cap)).sum()
-
-        def bucketize(x):
-            buf = jnp.zeros((ndev * cap,), dtype=x.dtype)
-            return buf.at[so * cap + rank].set(x[order])
-
-        def exchange(buf):
-            return jax.lax.all_to_all(
-                buf.reshape(ndev, cap), "dp", split_axis=0, concat_axis=0, tiled=False
-            ).reshape(ndev * cap)
-
-        rxkeys = [exchange(bucketize(k)) for k in pkeys]
-        rxsums = [exchange(bucketize(s)) for s in psums]
-        rxcnt = exchange(bucketize(pcnt))
+        # route by GROUP keys only: every (g, *) slot lands on g's owner
+        # shard, where x dedups globally
+        rxkeys, rxsums, rxcnt, of_slots = _exchange_group_slots(
+            jax, jnp, ndev, cap, pkeys, psums, pcnt, route_keys=pkeys[:G]
+        )
         mkeys, msums_cnt, _, of3 = _segment_partial(jnp, rxkeys, rxsums + [rxcnt], rxcnt > 0, cap, agg.key_bounds, tuple(agg.val_kinds) + ("sum",))
         if D:
             # stage 3: per-g reduction over the deduped (g, x) slots — the
@@ -819,7 +974,11 @@ def build_dist_pipeline(
             n_rep = agg.n_keys + len(agg.sums) + 1
     else:
         n_rep = 2 * len(topn.out_lanes) + 1
-    extra = (P(),) if warn_sink is not None else ()
+    extra = ()
+    if stages is not None and any(s is not None for s in stages):
+        extra += (P(None),)  # per-stage exchange-bytes vector
+    if warn_sink is not None:
+        extra += (P(),)
     from tidb_tpu.parallel import shard_map_compat
 
     fn = shard_map_compat(

@@ -87,30 +87,8 @@ def gather_to_pb(plan, group_cap: Optional[int] = None, schema_ver: int = -1) ->
 
     from tidb_tpu.parallel.gather import SubplanReader
 
-    readers = []
-    for r in plan.readers:
-        if isinstance(r, SubplanReader):
-            readers.append(
-                {
-                    "sub": {
-                        "reader": _reader_pb(r.reader),
-                        "agg": {
-                            "group": [g.to_pb() for g in r.agg.group_by],
-                            "aggs": [a.to_pb() for a in r.agg.aggs],
-                            "partial": bool(r.agg.partial_input),
-                            "schema": [_oc_pb(oc) for oc in r.agg.schema],
-                        },
-                        "having": [c.to_pb() for c in r.having],
-                        "proj": [e.to_pb() for e in r.proj] if r.proj is not None else None,
-                        "schema": [_oc_pb(oc) for oc in r.schema],
-                        "gpos": sorted(r.group_pos) if r.group_pos is not None else None,
-                    }
-                }
-            )
-        else:
-            readers.append(_reader_pb(r))
-    joins = [
-        {
+    def _join_pb(j) -> dict:
+        return {
             "eq": [list(e) for e in j.eq],
             "exchange": j.exchange,
             "unique": bool(j.unique),
@@ -118,8 +96,37 @@ def gather_to_pb(plan, group_cap: Optional[int] = None, schema_ver: int = -1) ->
             "str_keys": [[list(a), list(b)] for a, b in j.str_keys],
             "other": [c.to_pb() for c in j.other],
         }
-        for j in plan.joins
-    ]
+
+    readers = []
+    for r in plan.readers:
+        if isinstance(r, SubplanReader):
+            sub_pb = {
+                "reader": _reader_pb(r.reader),
+                "agg": {
+                    "group": [g.to_pb() for g in r.agg.group_by],
+                    "aggs": [a.to_pb() for a in r.agg.aggs],
+                    "partial": bool(r.agg.partial_input),
+                    "schema": [_oc_pb(oc) for oc in r.agg.schema],
+                },
+                "having": [c.to_pb() for c in r.having],
+                "proj": [e.to_pb() for e in r.proj] if r.proj is not None else None,
+                "schema": [_oc_pb(oc) for oc in r.schema],
+                "gpos": sorted(r.group_pos) if r.group_pos is not None else None,
+                # the stage-chain descriptor: remote dispatch must run the
+                # STAGED program too (zero host intermediates on the server)
+                "staged": bool(r.staged),
+            }
+            if r.chain is not None:
+                c_readers, c_joins, c_filters = r.chain
+                sub_pb["chain"] = {
+                    "readers": [_reader_pb(cr) for cr in c_readers],
+                    "joins": [_join_pb(j) for j in c_joins],
+                    "filters": [[pos, [c.to_pb() for c in cl]] for pos, cl in c_filters],
+                }
+            readers.append({"sub": sub_pb})
+        else:
+            readers.append(_reader_pb(r))
+    joins = [_join_pb(j) for j in plan.joins]
     agg_pb = None
     if plan.agg is not None:
         agg_pb = {
@@ -148,6 +155,16 @@ def gather_from_pb(pb: dict, table_by_id):
     catalog; a stale id raises KeyError for the caller to reload+retry."""
     from tidb_tpu.parallel.gather import MPPJoin, PhysMPPGather, SubplanReader
     from tidb_tpu.planner.plans import PhysProjection, PhysSelection
+
+    def _join_from_pb(jp) -> "MPPJoin":
+        return MPPJoin(
+            eq=[tuple(e) for e in jp["eq"]],
+            exchange=jp["exchange"],
+            unique=jp["unique"],
+            kind=jp["kind"],
+            str_keys=[(tuple(a), tuple(b)) for a, b in jp["str_keys"]],
+            other=[expr_from_pb(c) for c in jp.get("other", ())],
+        )
 
     def _reader_from_pb(rp):
         db_name, table = table_by_id(rp["tid"])
@@ -196,30 +213,30 @@ def gather_from_pb(pb: dict, table_by_id):
                 node = PhysSelection(conditions=list(having), children=[node])
             if proj is not None:
                 node = PhysProjection(exprs=list(proj), schema=list(schema), children=[node])
+            chain = None
+            if sp.get("chain") is not None:
+                cp = sp["chain"]
+                chain = (
+                    [_reader_from_pb(crp) for crp in cp["readers"]],
+                    [_join_from_pb(jp) for jp in cp["joins"]],
+                    [(pos, [expr_from_pb(c) for c in cl]) for pos, cl in cp["filters"]],
+                )
             readers.append(
                 SubplanReader(
                     plan=node,
-                    reader=rd,
+                    reader=rd if chain is None else chain[0][0],
                     agg=agg,
                     having=having,
                     proj=proj,
                     schema=schema,
                     group_pos=frozenset(sp["gpos"]) if sp["gpos"] is not None else None,
+                    chain=chain,
+                    staged=bool(sp.get("staged", False)),
                 )
             )
         else:
             readers.append(_reader_from_pb(rp))
-    joins = [
-        MPPJoin(
-            eq=[tuple(e) for e in jp["eq"]],
-            exchange=jp["exchange"],
-            unique=jp["unique"],
-            kind=jp["kind"],
-            str_keys=[(tuple(a), tuple(b)) for a, b in jp["str_keys"]],
-            other=[expr_from_pb(c) for c in jp.get("other", ())],
-        )
-        for jp in pb["joins"]
-    ]
+    joins = [_join_from_pb(jp) for jp in pb["joins"]]
     agg = None
     if pb["agg"] is not None:
         agg = PhysFinalAgg(
@@ -354,6 +371,8 @@ class MPPTaskManager:
                     # travels as JSON) — the dispatching client renders it
                     "shards": det.shards if det is not None else [],
                     "compiles": det.compiles if det is not None else 0,
+                    "stages": det.stages if det is not None else 1,
+                    "stage_bytes": det.stage_bytes if det is not None else [],
                 }
             except Exception as e:  # travels the wire as (kind, message)
                 task["kind"] = type(e).__name__

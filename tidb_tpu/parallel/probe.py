@@ -89,6 +89,15 @@ class MPPRetryExhausted(Exception):
     executor_with_retry giving up → error surfaced / fallback)."""
 
 
+class MPPStraddleError(MPPRetryExhausted):
+    """A gather's readers live on MULTIPLE store shards, so single-owner
+    dispatch cannot place it. Subclasses MPPRetryExhausted (any handler that
+    re-plans without MPP still works), but the gather executor catches it
+    FIRST and runs the hybrid shards × devices path: reader materialization
+    crosses the wire per owner (today's cop/columnar route), the staged
+    fragment program runs on the coordinator's own mesh."""
+
+
 class MPPTaskLostError(Exception):
     """The storage server no longer knows a dispatched task (it restarted
     between dispatch and conn, or the task was reclaimed). Retriable at the

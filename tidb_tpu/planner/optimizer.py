@@ -653,12 +653,14 @@ def _build_index_access(scan: LogicalScan, acc, conds: list[Expression]):
 
 
 def _ci_order_keys(exprs) -> bool:
-    """Any general_ci string among ``exprs`` used as an ORDER key (TopN) or
-    MIN/MAX argument? Device order semantics come from sorted-dictionary
-    byte ranks, but ci orders by weight class ('a' ≡ 'A' < 'B'), so a
-    device TopN could select the wrong candidate SET, not just a different
-    tie order — found by graftfuzz; such keys stay host-side (the host
-    sort/agg paths rank by weight)."""
+    """Any general_ci string among ``exprs`` used as an ORDER key (TopN)?
+    Device order semantics come from sorted-dictionary byte ranks, but ci
+    orders by weight class ('a' ≡ 'A' < 'B'), so a device TopN could select
+    the wrong candidate SET, not just a different tie order — found by
+    graftfuzz; such keys stay host-side (the host sort paths rank by
+    weight). MIN/MAX arguments no longer demote: the binder compacts ci
+    dictionaries under the weight order itself (Dictionary.compact(ci=True)),
+    making code reduction collation-correct."""
     return any(
         e is not None and e.ftype.kind == TypeKind.STRING and e.ftype.collation == "ci"
         for e in exprs
@@ -874,9 +876,10 @@ def _physical(plan: LogicalPlan, engines: list[str], stats=None, vars=None) -> P
         if can_push:
             exprs: list[Expression] = list(group_r) + [a.arg for a in aggs_r if a.arg is not None]
             st = _pick_engine(engines, list(reader.pushed_conditions) + exprs)
-            st = _demote_ci_order(
-                st, engines, [a.arg for a in aggs_r if a.name in ("min", "max")]
-            )
+            # ci MIN/MAX args no longer demote: the binder rank-compacts the
+            # dictionary under the general_ci weight order (byte tiebreak),
+            # so device code reduction picks the same member the host's
+            # _string_minmax ranking would — found by graftfuzz, closed here
             if st is not None and all(can_push_down(e, st.value) for e in exprs) and all(
                 can_push_down(c, st.value) for c in reader.pushed_conditions
             ):
@@ -1167,9 +1170,8 @@ def _physical_rollup(plan: LogicalAggregation, engines, stats, vars) -> Physical
             a.arg for a in plan.aggs if a.arg is not None
         ]
         st = _pick_engine(engines, list(child.pushed_conditions) + exprs)
-        st = _demote_ci_order(
-            st, engines, [a.arg for a in plan.aggs if a.name in ("min", "max")]
-        )
+        # ci MIN/MAX: device-legal via ci-weight dictionary compaction (see
+        # the plain agg-pushdown site above) — only ORDER keys still demote
         if st is not None and all(can_push_down(e, st.value) for e in exprs) and all(
             can_push_down(c, st.value) for c in child.pushed_conditions
         ):

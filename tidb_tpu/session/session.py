@@ -42,6 +42,10 @@ DEFAULT_SYSVARS = {
     # MPP gating (ref: tidb_vars.go:399 tidb_allow_mpp, :415 tidb_enforce_mpp)
     "tidb_allow_mpp": 1,
     "tidb_enforce_mpp": 0,
+    # hybrid shards × devices: a gather whose tables straddle store shards
+    # runs the staged program on the coordinator's mesh with per-owner wire
+    # reads (0 restores the old re-plan-without-MPP fallback)
+    "tidb_mpp_hybrid": 1,
     # slow query log threshold in ms (ref: tidb_slow_log_threshold)
     "tidb_slow_log_threshold": 300,
     # always-on sampled tracing (Dapper-style): the fraction of statements
@@ -1542,7 +1546,9 @@ class Session:
         plan = optimize(logical, engines, stats=self._db.stats, vars=self.vars)
         from tidb_tpu.parallel.gather import try_mpp_rewrite
 
-        plan = try_mpp_rewrite(plan, self.vars, stats=self._db.stats, store=self.store)
+        plan = try_mpp_rewrite(
+            plan, self.vars, stats=self._db.stats, store=self.store, health=self._db.health
+        )
         if key is not None and not builder.uncacheable:
             self._plan_cache[key] = plan
             cap_n = sysvar_int(self.vars, "tidb_prepared_plan_cache_size", 100)

@@ -26,8 +26,12 @@ def analyze_table(session, db_name: str, t: TableInfo) -> TableStats:
     for c in t.columns:
         if c.ftype.kind == TypeKind.STRING:
             # order-preserving codes: histograms over codes estimate string
-            # ranges correctly (ref: string stats use bytes ordering)
-            cache.ensure_sorted_dict(t.id, c.offset)
+            # ranges correctly (ref: string stats use bytes ordering). A ci
+            # column's canonical order is the general_ci WEIGHT order — the
+            # same order the device MIN/MAX compaction uses; requesting byte
+            # order here would ping-pong full-cache remaps (and epoch bumps)
+            # against every ci MIN/MAX query
+            cache.ensure_sorted_dict(t.id, c.offset, ci=c.ftype.collation == "ci")
     reader = PhysTableReader(
         db=db_name,
         table=t,

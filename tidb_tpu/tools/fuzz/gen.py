@@ -174,6 +174,10 @@ class CaseSpec:
     dml: list = field(default_factory=list)  # rendered SQL statements
     merge: bool = False  # run the delta merge after DML and re-check
     mpp: bool = False
+    # forced mesh width for mpp cases (0 = every available device): the
+    # differential/TLP oracles must cover the staged repartition path at
+    # real multi-shard widths, not just whatever the box exposes
+    ndev: int = 0
     tlp_pred: str = ""  # TLP partition predicate (applies to queries[0])
     region_split_keys: int = 1 << 62
     # campaign DB-pool identity: cases of one profile share a live DB (and
@@ -461,6 +465,31 @@ def _q_corr_agg(rng: random.Random, a: TableSpec, b: TableSpec) -> Optional[Quer
     return Query(a.name, sel, where=[pred], agg=True)
 
 
+def _q_two_stage(rng: random.Random, a: TableSpec, b: TableSpec) -> Optional[Query]:
+    """Two-stage fragment shape: a derived AGGREGATE over b re-keyed into a
+    join with a — the staged-pipeline vocabulary (the device stage's output
+    slots repartition on the join key inside ONE composed program; see
+    parallel/mpp.DistStageSpec)."""
+    k = _join_key(rng, a, b)
+    ia = [c.name for c in a.columns if c.kind in ("int", "float", "dec")]
+    ib = [c.name for c in b.columns if c.kind in ("int", "float", "dec")]
+    if k is None or not ia or not ib:
+        return None
+    ka, kb = k
+    fn = rng.choice(["SUM", "COUNT", "AVG", "MIN", "MAX"])
+    arg = "*" if fn == "COUNT" else rng.choice(ib)
+    sub = f"SELECT {kb} AS sk, {fn}({arg}) AS sv FROM {b.name}"
+    sub_where = _wheres(rng, [b], p_each=0.3)
+    if sub_where:
+        sub += " WHERE " + " AND ".join(f"({c})" for c in sub_where)
+    sub += f" GROUP BY {kb}"
+    join = f"JOIN ({sub}) ds ON {a.name}.{ka} = ds.sk"
+    sel = ["COUNT(*)", f"SUM({rng.choice(ia)})"]
+    if rng.random() < 0.5:
+        sel.append("SUM(sv)")
+    return Query(a.name, sel, join=join, where=_wheres(rng, [a], p_each=0.3), agg=True)
+
+
 def gen_query(rng: random.Random, profile: Profile) -> Query:
     tables = profile.tables
     a = tables[0]
@@ -468,7 +497,7 @@ def gen_query(rng: random.Random, profile: Profile) -> Query:
     if profile.mpp:
         # gather-path vocabulary: join-shaped plans that try_mpp_rewrite lifts
         for _ in range(4):
-            q = rng.choice([_q_left_join, _q_semi, _q_corr_agg])(rng, a, b)
+            q = rng.choice([_q_left_join, _q_semi, _q_corr_agg, _q_two_stage])(rng, a, b)
             if q is not None:
                 return q
         return _q_agg(rng, a)
@@ -513,6 +542,10 @@ def _fill_query_pool(rng: random.Random, profile: Profile, size: int) -> None:
         add(_q_semi(rng, t0, t1))
         add(_q_left_join(rng, t0, t1))
         add(_q_corr_agg(rng, t0, t1))
+        if profile.mpp:
+            # one pinned two-stage shape per mesh profile: the smoke lane
+            # must exercise the staged repartition path every campaign
+            add(_q_two_stage(rng, t0, t1))
     guard = 0
     while len(profile.queries) < size and guard < size * 20:
         add(gen_query(rng, profile))
@@ -585,6 +618,9 @@ def gen_case(seed: int, index: int, n_queries: int = 2, pool_size: int = 12) -> 
         dml=dml,
         merge=rng.random() < 0.7,
         mpp=mpp,
+        # mesh cases force multi-shard widths so the oracles cover the
+        # repartition/staged paths at ndev > 1, whatever the box default
+        ndev=rng.choice((2, 4, 8)) if mpp else 0,
         tlp_pred=tlp_pred,
         region_split_keys=16 if mpp else 1 << 62,
         profile_key=(seed, pid, mpp),

@@ -157,10 +157,34 @@ def _tlp_round(dev, host, q: Query, pred: str, phase: str, engines=("tpu", "host
     return None
 
 
+def _forced_ndev(mpp: bool, ndev: int):
+    """Pin the MPP mesh width for one case/repro (0 = every device) — the
+    campaign's width and its repro replays MUST agree, so both run through
+    this one clamp."""
+    from contextlib import contextmanager
+
+    @contextmanager
+    def ctx():
+        from tidb_tpu.parallel import mesh as _mesh
+
+        old = _mesh.FORCE_NDEV
+        if mpp and ndev:
+            import jax
+
+            _mesh.FORCE_NDEV = min(int(ndev), len(jax.devices()))
+        try:
+            yield
+        finally:
+            _mesh.FORCE_NDEV = old
+
+    return ctx()
+
+
 def check_case(spec: CaseSpec, pool: Optional[DBPool] = None) -> Optional[Divergence]:
     """Run every phase; the FIRST divergence wins (the shrinker re-drives
-    this same function on reduced specs, always without a pool)."""
-    with _delta_config():
+    this same function on reduced specs, always without a pool). Mesh cases
+    with a forced ``ndev`` pin the MPP mesh width for the whole case."""
+    with _delta_config(), _forced_ndev(spec.mpp, getattr(spec, "ndev", 0)):
         if pool is not None and spec.profile_key:
             db, dev, host, writer = pool.sessions_for(spec)
         else:
@@ -232,6 +256,7 @@ def spec_to_repro(spec: CaseSpec, div: Divergence) -> dict:
         "dml": list(spec.dml) if div.phase != "cold" else [],
         "merge": bool(spec.merge and div.phase == "merged"),
         "mpp": bool(spec.mpp),
+        "ndev": int(getattr(spec, "ndev", 0)),
         "region_split_keys": int(spec.region_split_keys),
         "oracle": div.oracle if div.oracle != "freshness" else "differential",
         "phase": div.phase,
@@ -256,7 +281,7 @@ def run_repro(spec: dict) -> None:
     file is an ordinary failing-until-fixed pytest)."""
     from tidb_tpu.tools.fuzz.oracles import canon_rows
 
-    with _delta_config():
+    with _delta_config(), _forced_ndev(bool(spec.get("mpp")), int(spec.get("ndev", 0))):
         import tidb_tpu
 
         db = tidb_tpu.open(region_split_keys=spec.get("region_split_keys", 1 << 62))

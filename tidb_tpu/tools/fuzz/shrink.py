@@ -151,13 +151,20 @@ def shrink(spec: CaseSpec, div: Divergence):
     else:  # isolation changed the outcome (phase interplay): keep the original
         prober.last = div
 
-    # scenario: no DML at all → cold repro; else no merge; plain mesh/regions
+    # scenario: no DML at all → cold repro; else no merge; default mesh
+    # width; plain mesh/regions
     for cand in (
         replace(spec, dml=[], merge=False),
         replace(spec, merge=False),
-        replace(spec, mpp=False, region_split_keys=1 << 62),
+        replace(spec, ndev=0),
+        replace(spec, mpp=False, ndev=0, region_split_keys=1 << 62),
     ):
-        if (cand.dml != spec.dml or cand.merge != spec.merge or cand.mpp != spec.mpp) and prober.fails(cand):
+        if (
+            cand.dml != spec.dml
+            or cand.merge != spec.merge
+            or cand.mpp != spec.mpp
+            or cand.ndev != spec.ndev
+        ) and prober.fails(cand):
             spec = cand
 
     if spec.dml:

@@ -48,7 +48,7 @@ class Dictionary:
     string comparisons; appends after compaction clear ``sorted`` again.
     """
 
-    __slots__ = ("_values", "_index", "sorted", "_mu")
+    __slots__ = ("_values", "_index", "sorted", "ci_sorted", "_mu")
 
     def __init__(self, values: Sequence[bytes] = ()):  # noqa: D107
         import threading
@@ -56,6 +56,10 @@ class Dictionary:
         self._values: list[bytes] = list(values)
         self._index: dict[bytes, int] = {v: i for i, v in enumerate(self._values)}
         self.sorted = self._values == sorted(self._values) if self._values else True
+        # codes order-preserving under the general_ci WEIGHT order (set by
+        # compact(ci=True) — the device ci MIN/MAX legalization); any append
+        # may land out of weight order, so it clears like ``sorted``
+        self.ci_sorted = not self._values
         # encode() appends; concurrent cop/partition worker threads share
         # table-level dictionaries, so the mutation is locked
         self._mu = threading.Lock()
@@ -77,7 +81,11 @@ class Dictionary:
                 self._index[value] = code
                 if self.sorted and code > 0 and self._values[code - 1] > value:
                     self.sorted = False
-                # a single element dict stays sorted
+                # a single element dict stays sorted; ci weight order is not
+                # checked here (weight_bytes costs) — any multi-value append
+                # conservatively drops the ci-order proof
+                if code > 0:
+                    self.ci_sorted = False
         return code
 
     def try_encode(self, value: "bytes | str") -> int:
@@ -97,15 +105,28 @@ class Dictionary:
     def values_array(self) -> list[bytes]:
         return list(self._values)
 
-    def compact(self) -> np.ndarray:
-        """Sort values; return the old-code→new-code remap array."""
-        order = sorted(range(len(self._values)), key=lambda i: self._values[i])
+    def compact(self, ci: bool = False) -> np.ndarray:
+        """Sort values; return the old-code→new-code remap array. ``ci``
+        sorts by (general_ci weight, bytes) instead of raw bytes — codes
+        become order-preserving under the COLLATION's order, which legalizes
+        device-side MIN/MAX on ci columns (the host _string_minmax recipe,
+        applied once to the dictionary instead of per reduction)."""
+        if ci:
+            from tidb_tpu.utils.collate import weight_bytes
+
+            order = sorted(
+                range(len(self._values)),
+                key=lambda i: (weight_bytes(self._values[i]), self._values[i]),
+            )
+        else:
+            order = sorted(range(len(self._values)), key=lambda i: self._values[i])
         remap = np.empty(len(order), dtype=np.int32)
         for new, old in enumerate(order):
             remap[old] = new
         self._values = [self._values[i] for i in order]
         self._index = {v: i for i, v in enumerate(self._values)}
-        self.sorted = True
+        self.sorted = self._values == sorted(self._values)
+        self.ci_sorted = ci or len(self._values) <= 1
         return remap
 
     # rank lookup for range predicates on sorted dictionaries

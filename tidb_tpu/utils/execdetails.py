@@ -248,10 +248,11 @@ class MPPExecDetails:
     rows, exchange_bytes]`` row per mesh shard, so EXPLAIN ANALYZE can name
     WHICH device inside the collective was slow."""
 
-    __slots__ = ("n_fragments", "ndev", "wall_ms", "rows", "retries", "store", "shards", "compiles")
+    __slots__ = ("n_fragments", "ndev", "wall_ms", "rows", "retries", "store", "shards", "compiles",
+                 "stages", "stage_bytes")
 
     def __init__(self, n_fragments=0, ndev=0, wall_ms=0.0, rows=0, retries=0, store="", shards=None,
-                 compiles=0):
+                 compiles=0, stages=1, stage_bytes=None):
         self.n_fragments = n_fragments
         self.ndev = ndev
         self.wall_ms = wall_ms
@@ -262,6 +263,11 @@ class MPPExecDetails:
         # fragment programs BUILT for this gather (0 = every attempt rode the
         # program cache) — the MPP analog of the cop sidecar's jit flag
         self.compiles = compiles
+        # staged fragment pipeline: how many on-mesh stages ONE program ran
+        # (1 + device-staged subplan build sides), and each device stage's
+        # inter-stage exchanged bytes (all on ICI — zero host bytes)
+        self.stages = stages
+        self.stage_bytes = stage_bytes or []
 
     def shard_summary(self) -> "tuple | None":
         """(max_ms, min_ms, p95_ms, slowest_shard_id) or None."""
@@ -275,10 +281,15 @@ class MPPExecDetails:
     def render(self) -> str:
         parts = [
             f"fragments: {self.n_fragments}",
+            f"stages: {self.stages}",
             f"ndev: {self.ndev}",
             f"wall: {self.wall_ms:.1f}ms",
             f"rows: {self.rows}",
         ]
+        if self.stage_bytes:
+            parts.append(
+                "stage_bytes: [" + ", ".join(str(int(b)) for b in self.stage_bytes) + "]"
+            )
         ss = self.shard_summary()
         if ss is not None:
             mx, mn, p95, slowest = ss

@@ -307,6 +307,21 @@ MPP_PROGRAM_CACHE = REGISTRY.counter(
     "MPP fragment-program cache lookups by outcome",
     ("result",),
 )
+# cross-store × cross-chip hybrid gathers: a straddling gather (tables on
+# multiple store shards) ran on the coordinator's mesh with per-owner wire
+# reads instead of degrading to the host join
+MPP_HYBRID = REGISTRY.counter(
+    "tidb_tpu_mpp_hybrid_total",
+    "MPP gathers executed on the hybrid shards-x-devices path",
+)
+# bytes of INTERMEDIATE fragment results that crossed the host boundary
+# (a subplan build side materialized through the Volcano executor and
+# re-uploaded) — the staged on-mesh pipeline exists to keep this at ZERO;
+# the scaling bench lane and the stage-chain tests assert on it
+MPP_HOST_INTERMEDIATE = REGISTRY.counter(
+    "tidb_tpu_mpp_intermediate_host_bytes_total",
+    "Bytes of intermediate MPP fragment results moved through the host",
+)
 # instance-level serving architecture (planner/instcache + the point-get
 # batcher in copr/client): cross-session cache outcomes, and how many
 # concurrent point reads each batched store dispatch coalesced (count =
