@@ -1,0 +1,437 @@
+"""SQL-native fleet diagnostics: the structured event log (utils/eventlog),
+the ``log_search`` wire verb + ``cluster_log``/``tidb_log`` memtables, the
+rule-driven ``inspection_result`` engine, and the ``tools.diag`` bundle.
+
+The chaos section closes the postmortem loop end to end: a 3-process wire
+fleet loses a store to SIGKILL and the incident is diagnosed THROUGH SQL
+alone — ``inspection_result`` names the dead instance, ``cluster_log``
+shows the recovery/backoff event trail, and queries keep answering."""
+
+import dataclasses
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tidb_tpu import config as _config
+from tidb_tpu.kv.memstore import MemStore
+from tidb_tpu.kv.remote import RemoteStore, StoreServer
+from tidb_tpu.kv.sharded import ShardedStore
+from tidb_tpu.session.session import DB
+from tidb_tpu.utils import eventlog as _ev
+from tidb_tpu.utils.eventlog import EventLog
+from tidb_tpu.utils.inspection import InspectionContext, inspect, rules_catalog
+
+
+@pytest.fixture
+def fresh_log():
+    """Isolated event-log singleton: reset before AND after so neighboring
+    tests' rings never leak in."""
+    _ev.reset()
+    yield
+    _ev.reset()
+
+
+# -- the recorder itself ------------------------------------------------------
+
+
+def test_ring_bounds_per_level():
+    lg = EventLog(debug_cap=4, info_cap=8, warn_cap=4, error_cap=4)
+    for i in range(20):
+        lg.emit(_ev.INFO, "c", "e", n=i)
+        lg.emit(_ev.DEBUG, "c", "d", n=i)
+    assert len(lg.rings[_ev.INFO]) == 8
+    assert len(lg.rings[_ev.DEBUG]) == 4
+    # the ring keeps the NEWEST events
+    assert [e[4]["n"] for e in lg.search(component="c", min_level=_ev.INFO)] == list(
+        range(12, 20)
+    )
+
+
+def test_search_filters_and_limit():
+    lg = EventLog(16, 64, 16, 16)
+    for i in range(10):
+        lg.emit(_ev.INFO, "placement", "cutover", table=i)
+        lg.emit(_ev.WARN, "mpp", "redispatch", attempt=i)
+    assert len(lg.search(component="mpp")) == 10
+    assert len(lg.search(min_level=_ev.WARN)) == 10
+    assert len(lg.search(limit=3)) == 3
+    # regex matches component.event plus stringified fields
+    assert len(lg.search(pattern=r"table=7")) == 1
+    got = lg.search(component="placement", limit=4)
+    assert [e[4]["table"] for e in got] == [6, 7, 8, 9], "newest-tail, oldest-first"
+
+
+def test_for_trace_pivot():
+    lg = EventLog(16, 16, 16, 16)
+    lg.emit(_ev.INFO, "mpp", "straddle_hybrid", trace_id="tr1")
+    lg.emit(_ev.ERROR, "backoff", "exhausted", trace_id="tr1")
+    lg.emit(_ev.WARN, "copr", "degrade", trace_id="tr2")
+    evs = lg.for_trace("tr1")
+    assert [e[3] for e in evs] == ["straddle_hybrid", "exhausted"]
+    assert lg.for_trace("") == []
+
+
+def test_level_gating_from_config(fresh_log):
+    old = _config.current()
+    _config.set_current(dataclasses.replace(old, eventlog_level="warn"))
+    try:
+        assert _ev.on(_ev.INFO) is None
+        assert _ev.on(_ev.DEBUG) is None
+        assert _ev.on(_ev.WARN) is not None
+        assert _ev.on(_ev.ERROR) is not None
+        _ev.set_level("debug")
+        assert _ev.on(_ev.DEBUG) is not None
+    finally:
+        _config.set_current(old)
+
+
+def test_off_path_constructs_nothing(fresh_log):
+    """The tracer=None discipline: with the floor at off, the gate returns
+    None and a correctly-written call site allocates NOTHING — no fields
+    dict, no tuple, no string."""
+    import tracemalloc
+
+    old = _config.current()
+    _config.set_current(dataclasses.replace(old, eventlog_level="off"))
+    try:
+        assert _ev.on(_ev.ERROR) is None  # warm: singleton built
+        tracemalloc.start()
+        before = tracemalloc.get_traced_memory()[0]
+        for i in range(2000):
+            lg = _ev.on(_ev.WARN)
+            if lg is not None:
+                lg.emit(_ev.WARN, "placement", "cutover", table=i, epoch=i)
+        after = tracemalloc.get_traced_memory()[0]
+        tracemalloc.stop()
+        assert after - before < 512, f"off path allocated {after - before} bytes"
+        assert len(_ev.get()) == 0
+    finally:
+        _config.set_current(old)
+
+
+# -- wire search + memtables --------------------------------------------------
+
+
+def test_wire_log_search_filters_serverside(fresh_log):
+    srv = StoreServer(MemStore(region_split_keys=1000))
+    srv.start()
+    try:
+        lg = _ev.get()
+        for i in range(40):
+            lg.emit(_ev.INFO, "placement", "balancer_move", table=i)
+        lg.emit(_ev.ERROR, "backoff", "exhausted", config="regionMiss")
+        st = RemoteStore("127.0.0.1", srv.port, retry_budget_ms=250, backoff_seed=0)
+        # the verb caps shipped rows at limit (newest kept)
+        rows = st.log_search(limit=5)
+        assert len(rows) == 5
+        # level/component/pattern filter on the SERVER side
+        assert [r[2] for r in st.log_search(min_level=_ev.ERROR)] == ["backoff"]
+        assert len(st.log_search(component="placement", limit=None)) == 40
+        assert len(st.log_search(pattern=r"table=3\b", limit=None)) == 1
+        # replay safety: the verb is a pure read, retried transparently
+        from tidb_tpu.kv.remote import REPLAYABLE
+
+        assert "log_search" in REPLAYABLE
+    finally:
+        srv.shutdown()
+
+
+def test_tidb_log_memtable_and_pushdown(fresh_log):
+    db = DB()
+    s = db.session()
+    lg = _ev.get()
+    lg.emit(_ev.INFO, "placement", "migrate_begin", table=9, src=0, dst=1)
+    lg.emit(_ev.WARN, "mpp", "redispatch", trace_id="tr9", attempt=1)
+    lg.emit(_ev.ERROR, "backoff", "exhausted", config="regionMiss")
+    rows = s.query(
+        "SELECT LEVEL, COMPONENT, EVENT FROM information_schema.tidb_log "
+        "WHERE LEVEL = 'warn'"
+    )
+    assert rows == [("warn", "mpp", "redispatch")]
+    # TS bounds + level floor compose; FIELDS ships sorted JSON
+    rows = s.query(
+        "SELECT EVENT, FIELDS FROM information_schema.tidb_log "
+        "WHERE TS > 0 AND LEVEL = 'error'"
+    )
+    assert rows[0][0] == "exhausted" and json.loads(rows[0][1]) == {
+        "config": "regionMiss"
+    }
+    # trace_id column round-trips for the /traces pivot
+    rows = s.query(
+        "SELECT TRACE_ID FROM information_schema.tidb_log WHERE COMPONENT = 'mpp'"
+    )
+    assert rows == [("tr9",)]
+
+
+def test_cluster_log_partial_results_on_dead_store(fresh_log):
+    old = _config.current()
+    _config.set_current(dataclasses.replace(old, store_slow_cop_ms=0.0))
+    srv = StoreServer(MemStore(region_split_keys=1000))
+    srv.start()
+    dead_srv = StoreServer(MemStore(region_split_keys=1000))
+    dead_srv.start()
+    try:
+        live = RemoteStore("127.0.0.1", srv.port, retry_budget_ms=150, backoff_seed=0)
+        dead = RemoteStore(
+            "127.0.0.1", dead_srv.port, retry_budget_ms=150, backoff_seed=0
+        )
+        live_addr = f"127.0.0.1:{srv.port}"
+        dead_addr = f"127.0.0.1:{dead_srv.port}"
+        db = DB(store=ShardedStore([live, dead]))
+        dead_srv.shutdown()
+        _ev.get().emit(_ev.WARN, "chaos", "store_down", store=dead_addr)
+        s = db.session()
+        rows = s.query(
+            "SELECT INSTANCE, COMPONENT, EVENT FROM information_schema.cluster_log"
+        )
+        # partial results: the coordinator's own events answer, the dead
+        # store degrades to a warning — never a failed query
+        assert any(r[1] == "chaos" for r in rows), rows
+        assert any(dead_addr in w[2] for w in s.warnings), s.warnings
+        # INSTANCE pushdown restricts the sweep: probing only the live
+        # store reaches no dead endpoint, so no warning is raised
+        s2 = db.session()
+        s2.query(
+            "SELECT INSTANCE, EVENT FROM information_schema.cluster_log "
+            f"WHERE INSTANCE = '{live_addr}'"
+        )
+        assert not any(dead_addr in w[2] for w in s2.warnings), s2.warnings
+    finally:
+        srv.shutdown()
+        dead_srv.shutdown()
+        _config.set_current(old)
+
+
+# -- inspection rules ---------------------------------------------------------
+
+
+def _by_rule(rows):
+    out = {}
+    for r in rows:
+        out.setdefault(r[0], []).append(r)
+    return out
+
+
+def test_every_rule_reaches_warning_and_critical(fresh_log):
+    warn_ctx = InspectionContext(
+        health={"tikv:a": {"ok": True}},
+        stale={"tikv:a": True},
+        staleness_s={"tikv:a": 90.0},
+        weights=[30.0, 10.0],
+        skew_ratio=2.0,
+        plan_cache={"hit": 40, "miss": 60},
+        cache_bytes={"tikv:a": 85},
+        hbm_budget=100,
+        mpp_shards={
+            "count": 20,
+            "sum": 1.0,
+            "buckets": [[0.01, 10], [0.05, 19], ["+Inf", 20]],
+        },
+        backoff_rate=10.0,
+        delta_rows=3000.0,
+        delta_merge_rows=2048,
+    )
+    by = _by_rule(inspect(ctx=warn_ctx))
+    for rule in (
+        "store-liveness", "store-skew", "plan-cache", "hbm-pressure",
+        "mpp-straggler", "backoff-storm", "delta-backlog",
+    ):
+        assert any(r[2] == "warning" for r in by[rule]), (rule, by[rule])
+
+    crit_ctx = InspectionContext(
+        health={"tikv:b": {"ok": False, "error": "connection refused"}},
+        stale={"tikv:b": True},
+        weights=[100.0, 10.0],
+        skew_ratio=2.0,
+        plan_cache={"hit": 1, "miss": 99},
+        cache_bytes={"tikv:b": 96},
+        hbm_budget=100,
+        mpp_shards={
+            "count": 20,
+            "sum": 5.0,
+            "buckets": [[0.01, 10], [1.0, 19], ["+Inf", 20]],
+        },
+        backoff_rate=100.0,
+        delta_rows=10_000.0,
+        delta_merge_rows=2048,
+    )
+    by = _by_rule(inspect(ctx=crit_ctx))
+    for rule in (
+        "store-liveness", "store-skew", "plan-cache", "hbm-pressure",
+        "mpp-straggler", "backoff-storm", "delta-backlog",
+    ):
+        assert any(r[2] == "critical" for r in by[rule]), (rule, by[rule])
+    # the dead instance is NAMED in the critical row
+    assert ("store-liveness", "tikv:b") in {(r[0], r[1]) for r in by["store-liveness"]}
+    # criticals echo into the event log (component=inspection, ERROR)
+    echoed = _ev.get().search(component="inspection", min_level=_ev.ERROR, limit=None)
+    assert {e[3] for e in echoed} >= {
+        "store-liveness", "store-skew", "plan-cache", "hbm-pressure",
+        "mpp-straggler", "backoff-storm", "delta-backlog",
+    }
+
+
+def test_inspection_tables_and_catalog(fresh_log):
+    db = DB()
+    s = db.session()
+    db.health.sweep(sections=())
+    rules = dict((r[0], r[1]) for r in s.query(
+        "SELECT NAME, TYPE FROM information_schema.inspection_rules"
+    ))
+    assert set(rules) == {n for n, _t, _c in rules_catalog()}
+    rows = s.query(
+        "SELECT RULE, ITEM, STATUS FROM information_schema.inspection_result"
+    )
+    assert {r[0] for r in rows} == set(rules)
+    assert all(r[2] in ("ok", "warning", "critical") for r in rows)
+
+
+# -- diag bundle --------------------------------------------------------------
+
+
+def test_diag_bundle_byte_determinism(fresh_log, tmp_path):
+    from tidb_tpu.tools.diag import write_bundle
+
+    db = DB()
+    db.session().query("SELECT 1")
+    db.health.sweep()
+    _ev.get().emit(_ev.WARN, "mpp", "redispatch", trace_id="t1", attempt=1)
+    # sweep=True is the CLI path: the refresh sweep's own duration histogram
+    # must not leak into sys_reports, or run N never hashes equal to run N+1
+    p1 = write_bundle(db, str(tmp_path / "a"))
+    p2 = write_bundle(db, str(tmp_path / "b"))
+    names = [os.path.basename(p) for p in p1]
+    assert {"logs.json", "inspection.json", "sys_reports.json", "config.json",
+            "versions.json", "slow_queries.json", "metrics_history.json"} == set(names)
+    for a, b in zip(p1, p2):
+        ha = hashlib.sha256(open(a, "rb").read()).hexdigest()
+        hb = hashlib.sha256(open(b, "rb").read()).hexdigest()
+        assert ha == hb, f"bundle file {os.path.basename(a)} not byte-stable"
+    # the bundle's log dump carries the event
+    logs = json.loads(open(os.path.join(str(tmp_path / "a"), "logs.json")).read())
+    assert any(e["component"] == "mpp" and e["trace_id"] == "t1" for e in logs)
+
+
+# -- chaos: postmortem through SQL alone --------------------------------------
+
+_SERVER_SCRIPT = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from tidb_tpu.kv.memstore import MemStore
+from tidb_tpu.kv.remote import StoreServer
+
+srv = StoreServer(MemStore(region_split_keys=100_000))
+print(f"PORT {{srv.start()}}", flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+def _spawn():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return subprocess.Popen(
+        [sys.executable, "-c", _SERVER_SCRIPT.format(repo=repo)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def _port(proc):
+    got: list = []
+
+    def reader():
+        for line in proc.stdout:
+            if line.startswith("PORT "):
+                got.append(int(line.split()[1]))
+                return
+
+    t = threading.Thread(target=reader, daemon=True, name="diag-port-reader")
+    t.start()
+    t.join(timeout=120)
+    if not got:
+        proc.kill()
+        raise RuntimeError("store server did not report a port within 120s")
+    return got[0]
+
+
+@pytest.mark.chaos
+def test_chaos_sigkill_diagnosed_through_sql(fresh_log):
+    """Kill one store of a 3-process fleet and close the postmortem loop
+    WITHOUT leaving SQL: inspection_result names the dead instance,
+    cluster_log shows the failover/backoff event trail, and queries on
+    surviving shards keep answering throughout."""
+    procs = [_spawn(), _spawn(), _spawn()]
+    try:
+        ports = [_port(p) for p in procs]
+        stores = [
+            RemoteStore("127.0.0.1", p, retry_budget_ms=250, backoff_seed=0)
+            for p in ports
+        ]
+        db = DB(store=ShardedStore(stores))
+        s = db.session()
+        # three tables, consecutive ids → one per shard
+        for i, name in enumerate(("da", "db_", "dc")):
+            s.execute(f"CREATE TABLE {name} (id BIGINT PRIMARY KEY, v BIGINT)")
+            s.execute(
+                f"INSERT INTO {name} VALUES "
+                + ",".join(f"({j},{j})" for j in range(100 + i))
+            )
+        shard_of = {
+            name: db.store.shard_of_table(db.catalog.table("test", name).id)
+            for name in ("da", "db_", "dc")
+        }
+        db.health.sweep()
+
+        victim = shard_of["da"]
+        dead_addr = f"127.0.0.1:{ports[victim]}"
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait(timeout=10)
+        time.sleep(0.2)
+
+        # queries on surviving shards keep answering mid-incident
+        survivor = next(n for n, sh in shard_of.items() if sh != victim)
+        expect = 100 + ("da", "db_", "dc").index(survivor)
+        assert s.query(f"SELECT COUNT(*) FROM {survivor}") == [(expect,)]
+
+        # a query against the dead shard fails typed + fast, and leaves a
+        # backoff trail in the event log
+        t0 = time.time()
+        with pytest.raises(Exception):
+            s.query("SELECT COUNT(*) FROM da")
+        assert time.time() - t0 < 30
+
+        # the postmortem, through SQL alone:
+        db.health.sweep()
+        rows = s.query(
+            "SELECT RULE, ITEM, STATUS FROM information_schema.inspection_result "
+            "WHERE STATUS = 'critical'"
+        )
+        assert ("store-liveness", dead_addr, "critical") in rows, rows
+        # the critical finding itself is now an event, and the incident's
+        # backoff trail is searchable — both via cluster_log
+        log_rows = s.query(
+            "SELECT COMPONENT, EVENT FROM information_schema.cluster_log "
+            "WHERE LEVEL = 'error'"
+        )
+        comps = {r[0] for r in log_rows}
+        assert "inspection" in comps, log_rows
+        assert "backoff" in comps, log_rows
+        # survivors still answer after the sweep — the fleet serves while
+        # being diagnosed
+        assert s.query(f"SELECT COUNT(*) FROM {survivor}") == [(expect,)]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)

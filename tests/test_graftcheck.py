@@ -52,6 +52,29 @@ def test_thread_name_rule():
     assert not _scan_src("tidb_tpu/kv/x.py", ok, ["thread-name"]).findings
 
 
+def test_eventlog_discipline_rule():
+    bad = "def f(x):\n    print('migrated', x)\n    return x\n"
+    r = _scan_src("tidb_tpu/kv/x.py", bad, ["eventlog-discipline"])
+    assert len(r.findings) == 1 and r.findings[0].rule == "eventlog-discipline"
+    # the structured-event shape is clean
+    ok = (
+        "from tidb_tpu.utils import eventlog as _ev\n"
+        "def f(x):\n"
+        "    lg = _ev.on(_ev.INFO)\n"
+        "    if lg is not None:\n"
+        "        lg.emit(_ev.INFO, 'placement', 'migrated', table=x)\n"
+        "    return x\n"
+    )
+    assert not _scan_src("tidb_tpu/kv/x.py", ok, ["eventlog-discipline"]).findings
+    # CLI surfaces whose contract IS stdout are exempt
+    for path in ("tidb_tpu/tools/x.py", "tidb_tpu/bench/x.py", "tidb_tpu/kv/__main__.py"):
+        assert not _scan_src(path, bad, ["eventlog-discipline"]).findings
+    # an explicit suppression silences the line
+    sup = bad.replace("print('migrated', x)", "print('migrated', x)  # graftcheck: off=eventlog-discipline")
+    r2 = _scan_src("tidb_tpu/kv/x.py", sup, ["eventlog-discipline"])
+    assert not r2.findings and r2.suppressed == 1
+
+
 def test_metric_labels_rule():
     bad = (
         "from tidb_tpu.utils.metrics import REGISTRY\n"

@@ -675,3 +675,27 @@ def test_chaos_kill_store_during_migration(wire_cluster):
     if kind == "ok" and payload["moved"]:
         sf = observer.session()
         assert sf.query("SELECT COUNT(*), COUNT(DISTINCT id) FROM kt") == [(400, 400)]
+
+    # the recovery event CHAIN must be visible post-hoc in the structured
+    # event log, not just the outcome: every chaos failpoint firing, the
+    # migration's begin record, and (when the cutover landed) the
+    # fence→cutover sequence in timestamp order — the postmortem an
+    # operator reconstructs from cluster_log after the incident
+    from tidb_tpu.utils import eventlog as _ev
+
+    chaos_evs = _ev.get().search(
+        component="chaos", pattern="placement_migrate_batch", limit=None
+    )
+    assert chaos_evs, "chaos failpoint firings must land in the event log"
+    pl = [
+        e
+        for e in _ev.get().search(component="placement", limit=None)
+        if e[4].get("table") == tid
+    ]
+    assert any(e[3] == "migrate_begin" for e in pl), pl
+    if kind == "ok" and payload["moved"]:
+        names = [e[3] for e in pl]
+        assert "fence" in names and "cutover" in names, names
+        t_begin = next(e[0] for e in pl if e[3] == "migrate_begin")
+        t_cut = next(e[0] for e in pl if e[3] == "cutover")
+        assert t_begin <= t_cut, (t_begin, t_cut)

@@ -332,6 +332,37 @@ def bench_lockcheck_overhead() -> float:
     return inst
 
 
+@register("log_overhead_ms")
+def bench_log_overhead() -> float:
+    """Warm multi-region COUNT(*) with the structured event log at its
+    default ``info`` floor (ms, lower is better), HARD-FAILED against the
+    same query with the log OFF when the gap breaches 5% (+0.15 ms timer
+    grace) — the enforced-budget rule the tracing and lockcheck lanes
+    follow. The ``on(level)`` gate is two loads + a compare and the hot
+    query path emits nothing at info, so this lane should sit within noise
+    of ``trace_off_overhead_ms``; any drift means an instrumented seam
+    started allocating on the fast path."""
+    from tidb_tpu.utils import eventlog as _ev
+
+    prev = _ev.min_level()
+    _ev.set_level(_ev.OFF)
+    try:
+        off = _warm_count_best("lgo_off", region_split_keys=2000)
+    finally:
+        _ev.set_level(prev)
+    _ev.set_level("info")
+    try:
+        on = _warm_count_best("lgo_on", region_split_keys=2000)
+    finally:
+        _ev.set_level(prev)
+    if on > off * 1.05 + 0.15:
+        raise RuntimeError(
+            f"event-log overhead breached the 5% budget: off {off:.3f}ms "
+            f"-> info {on:.3f}ms"
+        )
+    return on
+
+
 @register("qps_point_select")
 def bench_qps_point_select() -> float:
     """Concurrent point-select throughput (ops/s, higher is better): N
@@ -568,6 +599,42 @@ def bench_cluster_snapshot() -> float:
             best = min(best, (_t.perf_counter() - t0) * 1000)
             if not all(o["ok"] for o in outs):  # never inside an assert (-O)
                 raise RuntimeError(f"sweep lost a live store: {outs}")
+        return best
+    finally:
+        for srv in servers:
+            srv.shutdown()
+
+
+@register("inspection_sweep_ms")
+def bench_inspection_sweep() -> float:
+    """One full diagnosis pass over a 3-store wire fleet (ms, lower is
+    better): a fresh ``sys_snapshot`` health sweep plus every inspection
+    rule evaluated against it — the cost of one ``SELECT * FROM
+    information_schema.inspection_result`` an operator runs mid-incident.
+    Guarded next to ``cluster_snapshot_ms`` so the rule engine never grows
+    a tax that makes diagnosis itself the slow query."""
+    import time as _t
+
+    from tidb_tpu.kv.memstore import MemStore
+    from tidb_tpu.kv.remote import RemoteStore, StoreServer
+    from tidb_tpu.kv.sharded import ShardedStore
+    from tidb_tpu.session.session import DB
+    from tidb_tpu.utils.inspection import inspect
+
+    servers = [StoreServer(MemStore(region_split_keys=100_000)) for _ in range(3)]
+    try:
+        stores = [RemoteStore("127.0.0.1", srv.start()) for srv in servers]
+        db = DB(store=ShardedStore(stores))
+        db.health.sweep()  # warm: sockets dialed, report path imported
+        inspect(db, echo=False)
+        best = float("inf")
+        for _ in range(10):
+            t0 = _t.perf_counter()
+            db.health.sweep()
+            rows = inspect(db, echo=False)
+            best = min(best, (_t.perf_counter() - t0) * 1000)
+            if not rows:  # never inside an assert (-O)
+                raise RuntimeError("inspection returned no rows on a live fleet")
         return best
     finally:
         for srv in servers:

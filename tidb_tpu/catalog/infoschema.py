@@ -13,8 +13,15 @@ _S = lambda n=64: string_type(n)  # noqa: E731
 _I = bigint_type
 
 
-def memtable_rows(db, session, name: str) -> Optional[tuple[list, list, list]]:
-    """→ (column names, ftypes, rows) for information_schema.<name>."""
+def memtable_rows(db, session, name: str, hints=()) -> Optional[tuple[list, list, list]]:
+    """→ (column names, ftypes, rows) for information_schema.<name>.
+
+    ``hints`` are simple WHERE conjuncts the builder extracted —
+    ``(column_lower, op, literal)`` triples with op ∈ eq/ne/lt/le/gt/ge.
+    They are a pure OPTIMIZATION: the full WHERE still applies as a
+    LogicalSelection above the memtable source, so a consumer may honor any
+    subset (the log sweeps use them to push time/level/instance filters into
+    the wire fan-out so rings never ship whole)."""
     fn = {
         "schemata": _schemata,
         "tables": _tables,
@@ -47,9 +54,17 @@ def memtable_rows(db, session, name: str) -> Optional[tuple[list, list, list]]:
         "character_sets": _character_sets,
         "collations": _collations,
         "tidb_top_sql": _top_sql,
+        # the diagnostics plane (utils/eventlog + utils/inspection): the
+        # structured event log, its fleet-wide sweep, and the rule engine
+        "tidb_log": _tidb_log,
+        "cluster_log": _cluster_log,
+        "inspection_result": _inspection_result,
+        "inspection_rules": _inspection_rules,
     }.get(name)
     if fn is None:
         return None
+    if name in ("tidb_log", "cluster_log"):
+        return fn(db, session, hints)
     return fn(db, session)
 
 
@@ -188,9 +203,19 @@ def _top_sql(db, session):
     from tidb_tpu.types.field_type import double_type
     from tidb_tpu.utils.topsql import collector
 
-    cols = ["SQL_DIGEST", "PLAN_DIGEST", "QUERY_SAMPLE_TEXT", "CPU_TIME_SEC", "SAMPLES", "TRACE_ID"]
-    fts = [_S(80), _S(80), _S(256), double_type(), _I(), _S(80)]
-    return cols, fts, collector().top_sql()
+    from tidb_tpu.utils import eventlog as _evlog
+
+    cols = ["SQL_DIGEST", "PLAN_DIGEST", "QUERY_SAMPLE_TEXT", "CPU_TIME_SEC",
+            "SAMPLES", "TRACE_ID", "EVENTS"]
+    fts = [_S(80), _S(80), _S(256), double_type(), _I(), _S(80), _I()]
+    # EVENTS resolves at read time: the count of event-log rows carrying the
+    # row's sampled trace_id — the Top-SQL ↔ cluster_log pivot
+    lg = _evlog.get()
+    rows = [
+        (d, p, s, c, n, t, len(lg.for_trace(t)) if t else 0)
+        for d, p, s, c, n, t in collector().top_sql()
+    ]
+    return cols, fts, rows
 
 
 def _slow_query_shape():
@@ -198,16 +223,19 @@ def _slow_query_shape():
 
     cols = ["TIME", "QUERY", "QUERY_TIME", "RESULT_ROWS", "USER", "DIGEST",
             "PLAN_DIGEST", "COP_TASKS", "COP_PROC_MAX", "BACKOFF_TIME",
-            "RESPLITS", "MAX_TASK_STORE", "COP_SUMMARY", "TRACE_ID", "MEM_MAX"]
+            "RESPLITS", "MAX_TASK_STORE", "COP_SUMMARY", "TRACE_ID", "MEM_MAX",
+            "EVENTS", "FIRST_ERROR"]
     fts = [double_type(), _S(512), double_type(), _I(), _S(), _S(80), _S(80),
-           _I(), double_type(), double_type(), _I(), _S(64), _S(256), _S(80), _I()]
+           _I(), double_type(), double_type(), _I(), _S(64), _S(256), _S(80), _I(),
+           _I(), _S(64)]
     return cols, fts
 
 
 def _slow_entry_row(e):
     return (e.time, e.sql, e.latency_s, e.rows, e.user, e.digest, e.plan_digest,
             e.cop_tasks, e.cop_proc_max_ms / 1000.0, e.backoff_ms / 1000.0,
-            e.resplits, e.max_task_store, e.cop_summary, e.trace_id, e.mem_max)
+            e.resplits, e.max_task_store, e.cop_summary, e.trace_id, e.mem_max,
+            e.events, e.first_error)
 
 
 def _slow_query(db, session):
@@ -566,6 +594,156 @@ def _cluster_metrics_history(db, session):
         for r in o["report"].get("history", ()):
             rows.append((o["instance"],) + tuple(r))
     return cols, fts, rows
+
+
+# -- the diagnostics plane ----------------------------------------------------
+# tidb_log / cluster_log (ref: infoschema's cluster_log served by the
+# diagnostics log-search RPC): the structured event rings as SQL, with WHERE
+# time/level/component/instance predicates pushed into the search so rings
+# never materialize (or ship) whole; inspection_result is the rule engine.
+
+
+def _hint_eq(hints, col):
+    """The literal of a ``col = value`` conjunct, if the builder saw one."""
+    for c, op, v in hints:
+        if c == col and op == "eq":
+            return v
+    return None
+
+
+def _hint_bounds(hints, col):
+    """(lo, hi) bounds from ``col >/>=/</<= literal`` conjuncts (half-open
+    vs closed is ignored — hints are superset filters, the real WHERE
+    re-applies above)."""
+    lo = hi = None
+    for c, op, v in hints:
+        if c != col or not isinstance(v, (int, float)):
+            continue
+        if op in ("gt", "ge"):
+            lo = v if lo is None else max(lo, v)
+        elif op in ("lt", "le"):
+            hi = v if hi is None else min(hi, v)
+        elif op == "eq":
+            lo = v if lo is None else max(lo, v)
+            hi = v if hi is None else min(hi, v)
+    return lo, hi
+
+
+def _log_search_args(hints):
+    """Translate builder hints into eventlog.search kwargs. LEVEL equality
+    becomes min_level — a SUPERSET (higher severities ride along; the real
+    WHERE trims them), which keeps the pushdown monotone-safe."""
+    from tidb_tpu.utils import eventlog as _evlog
+
+    since, until = _hint_bounds(hints, "ts")
+    min_level = _evlog.DEBUG
+    lv = _hint_eq(hints, "level")
+    if isinstance(lv, str):
+        min_level = min(_evlog.level_from_name(lv), _evlog.ERROR)
+    comp = _hint_eq(hints, "component")
+    return {
+        "since": since,
+        "until": until,
+        "min_level": min_level,
+        "component": comp if isinstance(comp, str) else None,
+    }
+
+
+def _log_shape():
+    from tidb_tpu.types.field_type import double_type
+
+    cols = ["TS", "LEVEL", "COMPONENT", "EVENT", "FIELDS", "TRACE_ID"]
+    fts = [double_type(), _S(16), _S(32), _S(64), _S(512), _S(80)]
+    return cols, fts
+
+
+def _log_row(ev):
+    import json as _json
+
+    from tidb_tpu.utils import eventlog as _evlog
+
+    ts, level, component, event, fields, trace_id = ev
+    return (ts, _evlog.level_name(int(level)), component, event,
+            _json.dumps(fields, sort_keys=True, default=str), trace_id or "")
+
+
+def _tidb_log(db, session, hints=()):
+    """THIS process's event ring (the local flight recorder)."""
+    from tidb_tpu.utils import eventlog as _evlog
+
+    cols, fts = _log_shape()
+    rows = [_log_row(e) for e in _evlog.get().search(limit=None, **_log_search_args(hints))]
+    return cols, fts, rows
+
+
+def _cluster_log(db, session, hints=()):
+    """Every instance's event ring in one table: local rows plus the
+    ``log_search`` wire fan-out, INSTANCE-tagged, with the WHERE hints
+    applied server-side (a dead store degrades to a session warning plus
+    partial rows, like every cluster_* memtable)."""
+    from tidb_tpu.utils import eventlog as _evlog
+
+    cols, fts = _log_shape()
+    cols = ["INSTANCE"] + cols
+    fts = [_S()] + fts
+    args = _log_search_args(hints)
+    me = _local_instance(db)
+    inst = _hint_eq(hints, "instance")
+    want_local = inst is None or inst == me
+    rows = []
+    if want_local:
+        rows = [(me,) + _log_row(e) for e in _evlog.get().search(limit=None, **args)]
+    if inst is not None and inst == me:
+        return cols, fts, rows
+    store = db.store
+    sweep = getattr(store, "log_search_all", None)
+    if sweep is not None:
+        outs = sweep(instances={inst} if inst is not None else None, **args)
+    elif hasattr(store, "log_search"):
+        # a single remote store (no fleet): probe it directly, same outcome
+        # shape, dead-store tolerant
+        from tidb_tpu.kv.sharded import ShardedStore
+
+        addr = ShardedStore.instance_name(store)
+        if inst is not None and inst != addr:
+            outs = []
+        else:
+            try:
+                outs = [{"instance": addr, "shard": 0, "ok": True,
+                         "rows": store.log_search(**args)}]
+            except (ConnectionError, OSError) as e:
+                outs = [{"instance": addr, "shard": 0, "ok": False, "error": str(e)}]
+    else:
+        outs = []
+    for o in outs:
+        if not o["ok"]:
+            session.append_warning(
+                "Warning", 1105,
+                f"cluster_log: instance {o['instance']} unreachable: {o['error']}",
+            )
+            continue
+        rows.extend((o["instance"],) + _log_row(e) for e in o.get("rows", ()))
+    return cols, fts, rows
+
+
+def _inspection_result(db, session):
+    """The rule engine's verdicts (ref: inspection_result): one row per
+    (rule, item) evaluated over DB.health + metrics history + the event
+    log — status ok|warning|critical with value/reference/detail."""
+    from tidb_tpu.utils.inspection import inspect
+
+    cols = ["RULE", "ITEM", "STATUS", "VALUE", "REFERENCE", "DETAIL"]
+    fts = [_S(32), _S(64), _S(16), _S(64), _S(64), _S(256)]
+    return cols, fts, inspect(db)
+
+
+def _inspection_rules(db, session):
+    """The rule catalog (ref: inspection_rules): what inspect() evaluates."""
+    from tidb_tpu.utils.inspection import rules_catalog
+
+    cols = ["NAME", "TYPE", "COMMENT"]
+    fts = [_S(32), _S(16), _S(256)]
+    return cols, fts, rules_catalog()
 
 
 def _character_sets(db, session):

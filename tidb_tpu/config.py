@@ -75,6 +75,16 @@ class Config:
     # StmtSummary ring (served fleet-wide via the sys_snapshot verb /
     # information_schema.cluster_slow_query)
     store_slow_cop_ms: float = 300.0
+    # [observability] structured event log (utils/eventlog.py): the minimum
+    # level retained ("debug"|"info"|"warn"|"error"|"off") and per-level ring
+    # capacities. Levels below the floor construct nothing (the tracer=None
+    # zero-cost discipline); rings are bounded deques, so retention is by
+    # count, not time — searchable via information_schema.tidb_log /
+    # cluster_log and the log_search wire verb.
+    eventlog_level: str = "info"
+    eventlog_capacity: int = 2048
+    eventlog_debug_capacity: int = 512
+    eventlog_error_capacity: int = 1024
     # [perf] instance-level serving: capacity (entries) of EACH cross-session
     # cache (statement ASTs / plan templates, planner/instcache.py), and the
     # optional point-get batcher collection window in microseconds — 0 keeps
@@ -151,6 +161,14 @@ class Config:
         )
         cfg.trace_clamp_qps = float(obs.get("trace-clamp-qps", cfg.trace_clamp_qps))
         cfg.store_slow_cop_ms = float(obs.get("store-slow-cop-ms", cfg.store_slow_cop_ms))
+        cfg.eventlog_level = str(obs.get("eventlog-level", cfg.eventlog_level))
+        cfg.eventlog_capacity = int(obs.get("eventlog-capacity", cfg.eventlog_capacity))
+        cfg.eventlog_debug_capacity = int(
+            obs.get("eventlog-debug-capacity", cfg.eventlog_debug_capacity)
+        )
+        cfg.eventlog_error_capacity = int(
+            obs.get("eventlog-error-capacity", cfg.eventlog_error_capacity)
+        )
         perf = raw.get("perf", {})
         cfg.instance_plan_cache_size = int(
             perf.get("instance-plan-cache-size", cfg.instance_plan_cache_size)

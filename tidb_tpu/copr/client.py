@@ -304,6 +304,7 @@ def run_task_resilient(
     degrade_on: tuple,
     never_degrade: tuple = (),
     detail=None,
+    trace_id=None,
 ) -> Chunk:
     """One cop task under the request's Backoffer — the single region-error /
     degrade policy shared by the embedded and remote cop clients.
@@ -354,9 +355,21 @@ def run_task_resilient(
         # instead of dying with the device
         if warn is not None:
             warn(1, 1105, f"TPU cop task on region {region.region_id} degraded to host: {e}")
+        from tidb_tpu.utils import eventlog as _ev
         from tidb_tpu.utils import metrics as _m
 
         _m.COP_DEGRADED.inc(reason=degrade_reason)
+        lg = _ev.on(_ev.WARN)
+        if lg is not None:
+            lg.emit(
+                _ev.WARN,
+                "copr",
+                "degrade",
+                trace_id=trace_id,
+                region=region.region_id,
+                reason=degrade_reason,
+                cause=f"{type(e).__name__}: {e}",
+            )
         if detail is not None:
             detail.degraded = f"{degrade_reason}:{type(e).__name__}"
         return attempt(StoreType.HOST, region, ranges)
@@ -449,6 +462,7 @@ class CopClient:
                     # data/txn verdicts and kills: degrading engines would not help
                     never_degrade=(KVError, QueryKilledError, QueryOOMError),
                     detail=det,
+                    trace_id=tracer.trace_id if tracer is not None else None,
                 )
             # processing = task wall minus its own backoff sleeps
             det.proc_ms = max((time.perf_counter() - t0) * 1000.0 - det.backoff_ms, 0.0)

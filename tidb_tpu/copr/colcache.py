@@ -32,6 +32,7 @@ from tidb_tpu.kv.kv import KeyLockedError
 from tidb_tpu.kv.memstore import MemStore, Region
 from tidb_tpu.kv.rowcodec import RowSchema, decode_fixed_bulk, decode_strings_bulk
 from tidb_tpu.types import FieldType, TypeKind
+from tidb_tpu.utils import eventlog as _ev
 from tidb_tpu.utils import execdetails as _ed
 from tidb_tpu.utils import failpoint
 from tidb_tpu.utils import metrics as _metrics
@@ -646,7 +647,15 @@ class ColumnCache:
                     self.store.col_changes_prune(region.region_id, table_id, entry.built_ts)
         self._ensure_slots(entry, table_id, schema, slots)
         if old is not None:
-            _metrics.DEVICE_MERGE_SECONDS.observe(_time.perf_counter() - t0)
+            wall = _time.perf_counter() - t0
+            _metrics.DEVICE_MERGE_SECONDS.observe(wall)
+            lg = _ev.on(_ev.DEBUG)
+            if lg is not None:
+                lg.emit(
+                    _ev.DEBUG, "colcache", "merge",
+                    region=region.region_id, table=table_id,
+                    rows=entry.n, wall_ms=round(wall * 1000.0, 3),
+                )
             det = _ed.current_cop()
             if det is not None:
                 det.merges += 1
@@ -718,6 +727,10 @@ class ColumnCache:
             read_ts = self.store.current_ts()
             self._merge((rid, tid), region, tid, None, (), read_ts, old)
             merged += 1
+        if merged:
+            lg = _ev.on(_ev.INFO)
+            if lg is not None:
+                lg.emit(_ev.INFO, "colcache", "compactor_round", merged=merged)
         return merged
 
     @property

@@ -49,7 +49,19 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from tidb_tpu.utils import eventlog as _ev
 from tidb_tpu.utils.backoff import Backoffer, BackoffExhausted, boStoreDown
+
+# campaign outcomes worth waking a reader: a grant and a fencing are state
+# transitions; renewals/losses are steady-state churn and stay at debug
+_OUTCOME_LEVEL = {"won": _ev.INFO, "fenced": _ev.WARN}
+
+
+def _campaign_event(outcome: str, key: str, node_id: str, term: int) -> None:
+    lvl = _OUTCOME_LEVEL.get(outcome, _ev.DEBUG)
+    lg = _ev.on(lvl)
+    if lg is not None:
+        lg.emit(lvl, "election", outcome, key=key, node=node_id, term=term)
 
 
 @dataclass
@@ -366,25 +378,41 @@ class QuorumElection:
             # renewal under the fencing token: any term movement = deposed
             if wterm != term or wowner != node_id or wdeadline <= now:
                 _m.ELECTION_CAMPAIGN.inc(key=key, outcome="fenced")
+                _campaign_event("fenced", key, node_id, wterm)
                 return False
             ok = self._propose_majority(key, node_id, term, now + lease)
             _m.ELECTION_CAMPAIGN.inc(key=key, outcome="renewed" if ok else "fenced")
+            _campaign_event("renewed" if ok else "fenced", key, node_id, term)
             return ok
         if wowner == node_id and wterm > 0 and wdeadline > now:
             # still ours and still live: refresh at the same term
             ok = self._propose_majority(key, node_id, wterm, now + lease)
             _m.ELECTION_CAMPAIGN.inc(key=key, outcome="renewed" if ok else "lost")
+            _campaign_event("renewed" if ok else "lost", key, node_id, wterm)
             return ok
         if wowner is not None and wowner != node_id and wdeadline > now:
             _m.ELECTION_CAMPAIGN.inc(key=key, outcome="lost")
+            _campaign_event("lost", key, node_id, wterm)
             return False  # live lease elsewhere: back off until it expires
         # vacant / expired / our own expired lease: the fencing token bumps.
         # (An expired lease we used to hold gets a NEW term too — same-term
         # re-grant past expiry is the split-brain window, see module doc.)
         ok = self._propose_majority(key, node_id, wterm + 1, now + lease)
         _m.ELECTION_CAMPAIGN.inc(key=key, outcome="won" if ok else "lost")
+        _campaign_event("won" if ok else "lost", key, node_id, wterm + 1 if ok else wterm)
         if ok and wowner is not None and wowner != node_id:
             _m.ELECTION_FAILOVER.inc(key=key)
+            lg = _ev.on(_ev.WARN)
+            if lg is not None:
+                lg.emit(
+                    _ev.WARN,
+                    "election",
+                    "failover",
+                    key=key,
+                    node=node_id,
+                    prev_owner=wowner,
+                    term=wterm + 1,
+                )
         return ok
 
     def owner(self, key: str) -> Optional[str]:

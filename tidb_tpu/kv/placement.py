@@ -49,6 +49,7 @@ import threading
 import time
 from typing import Optional
 
+from tidb_tpu.utils import eventlog as _ev
 from tidb_tpu.utils import failpoint
 
 
@@ -350,6 +351,12 @@ def migrate_table(store, table_id: int, dst: int, *, batch_keys: Optional[int] =
             return {"moved": False, "src": src, "dst": dst, "reason": "already placed there"}
     s_src, s_dst = store.stores[src], store.stores[dst]
     cache.note_moving(table_id, src, dst, epoch + 1)
+    lg = _ev.on(_ev.INFO)
+    if lg is not None:
+        lg.emit(
+            _ev.INFO, "placement", "migrate_begin",
+            table=table_id, src=src, dst=dst, epoch=epoch + 1,
+        )
     t0 = time.perf_counter()
     blackout_ms = 0.0
     rows = 0
@@ -370,6 +377,9 @@ def migrate_table(store, table_id: int, dst: int, *, batch_keys: Optional[int] =
         # with at least half the TTL remaining (re-copying the same window
         # is idempotent and picks up anything that slipped).
         cache.note_phase(table_id, "cutover")
+        lg = _ev.on(_ev.INFO)
+        if lg is not None:
+            lg.emit(_ev.INFO, "placement", "fence", table=table_id, src=src, ttl_s=ttl)
         tb0 = time.perf_counter()
         try:
             for _attempt in range(4):
@@ -397,10 +407,22 @@ def migrate_table(store, table_id: int, dst: int, *, batch_keys: Optional[int] =
                     # the winner owns the table's state now (it may already
                     # have fenced+purged our src) — abort WITHOUT touching
                     # fences or the epoch; our TTL fence expires on its own
+                    lg = _ev.on(_ev.WARN)
+                    if lg is not None:
+                        lg.emit(
+                            _ev.WARN, "placement", "lost_race",
+                            table=table_id, epoch=e2, winner_shard=o2,
+                        )
                     raise PlacementLostRace(
                         f"placement epoch bump for table {table_id} lost the race "
                         f"(now epoch {e2} → shard {o2})"
                     )
+            lg = _ev.on(_ev.INFO)
+            if lg is not None:
+                lg.emit(
+                    _ev.INFO, "placement", "cutover",
+                    table=table_id, src=src, dst=dst, epoch=epoch + 1,
+                )
         except ConnectionError:
             # below quorum / dead peer mid-cutover: try to re-assert the OLD
             # owner at a higher epoch (a clean cancel); if even that cannot
@@ -434,6 +456,9 @@ def migrate_table(store, table_id: int, dst: int, *, batch_keys: Optional[int] =
                 s_src, s_dst, table_id, last_ts, None, batch, include_locks=True
             )
             s_src.purge_table(table_id)
+            lg = _ev.on(_ev.INFO)
+            if lg is not None:
+                lg.emit(_ev.INFO, "placement", "purge", table=table_id, src=src)
         except ConnectionError:
             pass  # src died right after cutover: nothing routes there anyway
     except BaseException:
@@ -547,4 +572,10 @@ def balancer_sweep(db, max_moves: int = 1) -> dict:
         out["table"] = name
         moves.append(out)
         _m.BALANCER_MOVES.inc(reason="skew")
+        lg = _ev.on(_ev.INFO)
+        if lg is not None:
+            lg.emit(
+                _ev.INFO, "placement", "balancer_move",
+                table=name, src=hot, dst=cold, reason="skew",
+            )
     return {"moves": moves, "balanced": not moves or len(moves) < max_moves}

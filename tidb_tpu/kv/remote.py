@@ -39,6 +39,7 @@ from tidb_tpu.kv.kv import (
     WriteConflictError,
 )
 from tidb_tpu.kv.memstore import OP_DEL, OP_PUT, Lock, MemStore, Mutation, Region
+from tidb_tpu.utils import eventlog as _ev
 from tidb_tpu.utils import execdetails as _ed
 from tidb_tpu.utils import failpoint
 from tidb_tpu.utils import tracing as _tracing
@@ -340,6 +341,9 @@ class StoreServer:
                         pass
                     return
                 self._conns.add(conn)
+            lg = _ev.on(_ev.DEBUG)
+            if lg is not None:
+                lg.emit(_ev.DEBUG, "store", "conn_open", port=self.port)
             threading.Thread(
                 target=self._serve, args=(conn,), daemon=True, name="store-conn"
             ).start()
@@ -383,6 +387,9 @@ class StoreServer:
         finally:
             with self._conns_mu:
                 self._conns.discard(conn)
+            lg = _ev.on(_ev.DEBUG)
+            if lg is not None:
+                lg.emit(_ev.DEBUG, "store", "conn_close", port=self.port)
             try:
                 conn.close()
             except OSError:
@@ -404,6 +411,23 @@ class StoreServer:
                     sections=h.get("sections"),
                 )
             }, []
+        if cmd == "log_search":
+            # fleet log search (replay-safe: a pure read of the process's
+            # event rings) — ALL filtering happens server-side so a ring
+            # never ships whole: time range, min level, component, regex,
+            # and the row cap travel in the header
+            from tidb_tpu.utils import eventlog as _evlog
+
+            lim = h.get("limit", 256)
+            rows = _evlog.get().search(
+                since=h.get("since"),
+                until=h.get("until"),
+                min_level=int(h.get("min_level", _evlog.DEBUG)),
+                component=h.get("component"),
+                pattern=h.get("pattern"),
+                limit=int(lim) if lim is not None else None,
+            )
+            return {"rows": [list(r) for r in rows]}, []
         if cmd == "current_ts":
             return {"ts": st.current_ts()}, []
         if cmd == "tso":
@@ -893,6 +917,7 @@ class _RemoteCopClient:
                     degrade_on=(RuntimeError,),
                     never_degrade=(QueryKilledError, QueryOOMError),
                     detail=det,
+                    trace_id=tracer.trace_id if tracer is not None else None,
                 )
             # proc_ms arrived from the server's sidecar; what remains of the
             # client-observed wall is wire + (de)serialization time
@@ -931,7 +956,7 @@ class _RemoteCopClient:
 # mpp_cancel is the idempotent ack.
 REPLAYABLE = frozenset(
     {
-        "ping", "sys_snapshot", "current_ts", "tso",
+        "ping", "sys_snapshot", "log_search", "current_ts", "tso",
         "raw_get", "raw_put", "raw_delete", "raw_scan",
         "run_gc", "snap_get", "snap_batch_get", "snap_scan",
         "prewrite", "rollback", "pessimistic_rollback", "acquire_lock",
@@ -1150,6 +1175,32 @@ class RemoteStore:
             }
         )
         return h["report"]
+
+    def log_search(
+        self,
+        since=None,
+        until=None,
+        min_level: int = 0,
+        component=None,
+        pattern=None,
+        limit: int = 256,
+    ) -> list:
+        """Search the SERVER process's structured event log — filters ship
+        in the header and apply store-side, so at most ``limit`` rows cross
+        the wire. Replay-safe (a pure read). → [[ts, level, component,
+        event, fields, trace_id], ...] oldest-first."""
+        h, _ = self._call(
+            {
+                "cmd": "log_search",
+                "since": since,
+                "until": until,
+                "min_level": min_level,
+                "component": component,
+                "pattern": pattern,
+                "limit": limit,
+            }
+        )
+        return h["rows"]
 
     def run_gc(self, safe_point=None, life_ms: int = 600_000):
         """MVCC GC runs where the data lives — proxied to the server.

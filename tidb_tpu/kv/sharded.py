@@ -1054,6 +1054,65 @@ class ShardedStore:
             t.join()
         return out
 
+    def log_search_all(
+        self,
+        since=None,
+        until=None,
+        min_level: int = 0,
+        component=None,
+        pattern=None,
+        limit: int = 256,
+        instances=None,
+    ) -> list[dict]:
+        """Fan the ``log_search`` verb out to every WIRE shard with the same
+        concurrent dead-store-tolerant sweep as :meth:`sys_snapshot_all` —
+        filters (time/level/component/regex/limit) apply server-side, and a
+        dead store contributes a per-store failure outcome, never a failed
+        sweep. In-process shards report zero rows with ``"local": True``:
+        their events land in THIS process's ring, which the caller already
+        reads directly (fanning out would duplicate every row per shard).
+        ``instances`` (a set of instance names) restricts the sweep — the
+        cluster_log INSTANCE-predicate pushdown.
+        → [{"instance", "shard", "ok", "rows" | "error"}] in shard order."""
+
+        def probe(si: int, st) -> dict:
+            addr = self.instance_name(st)
+            fn = getattr(st, "log_search", None)
+            if fn is None:
+                return {"instance": addr, "shard": si, "ok": True, "rows": [], "local": True}
+            try:
+                rows = fn(
+                    since=since, until=until, min_level=min_level,
+                    component=component, pattern=pattern, limit=limit,
+                )
+                return {"instance": addr, "shard": si, "ok": True, "rows": rows}
+            except (ConnectionError, OSError) as e:
+                return {"instance": addr, "shard": si, "ok": False, "error": str(e)}
+
+        targets = [
+            (si, st)
+            for si, st in enumerate(self.stores)
+            if instances is None or self.instance_name(st) in instances
+        ]
+        if len(targets) <= 1:
+            return [probe(si, st) for si, st in targets]
+        out: list = [None] * len(targets)
+
+        def run(oi: int, si: int, st) -> None:
+            out[oi] = probe(si, st)
+
+        threads = [
+            threading.Thread(
+                target=run, args=(oi, si, st), daemon=True, name=f"logsearch-{si}"
+            )
+            for oi, (si, st) in enumerate(targets)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out
+
     # -- columnar-cache verbs for the hybrid shards × devices path ----------
     def stable_parts(self, table_id: int, kr, read_ts: int) -> list:
         """Stable-block slices from the range's owner (the coordinator's

@@ -1433,9 +1433,18 @@ class MPPGatherExec:
                     and sysvar_int(self.session.vars, "tidb_mpp_hybrid", 1)
                 ):
                     raise
+                from tidb_tpu.utils import eventlog as _ev
                 from tidb_tpu.utils import metrics as _m
 
                 _m.MPP_HYBRID.inc()
+                lg = _ev.on(_ev.INFO)
+                if lg is not None:
+                    lg.emit(
+                        _ev.INFO,
+                        "mpp",
+                        "straddle_hybrid",
+                        trace_id=getattr(self.session.tracer, "trace_id", None),
+                    )
                 self._hybrid = True
         import jax
 
@@ -1613,9 +1622,21 @@ class MPPGatherExec:
                             f"{be.attempts} attempts: {exc}"
                         ) from exc
                     redispatches += 1
+                    from tidb_tpu.utils import eventlog as _ev
                     from tidb_tpu.utils import metrics as _m
 
                     _m.PLACEMENT_REROUTE.inc(verb="mpp_dispatch")
+                    lg = _ev.on(_ev.WARN)
+                    if lg is not None:
+                        lg.emit(
+                            _ev.WARN,
+                            "mpp",
+                            "redispatch",
+                            trace_id=tr.trace_id if tr is not None else None,
+                            attempt=redispatches,
+                            moved=moved,
+                            cause=str(exc),
+                        )
         e = exec_pb[0] if exec_pb else {}
         sess.record_mpp_detail(
             self.plan,

@@ -396,6 +396,11 @@ class MPPTaskManager:
             # restarted between dispatch and conn — or reclaimed the task —
             # tells the gather to RE-DISPATCH rather than fail the query
             # (the client-go mpp_probe lost-task recovery idiom)
+            from tidb_tpu.utils import eventlog as _ev
+
+            lg = _ev.on(_ev.WARN)
+            if lg is not None:
+                lg.emit(_ev.WARN, "mpp", "task_lost", task=task_id)
             return True, None, "MPPTaskLost", f"unknown mpp task {task_id}", (), None, None
         if not task["ev"].wait(wait_s):
             return False, None, None, None, (), None, None

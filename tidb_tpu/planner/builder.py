@@ -281,7 +281,16 @@ class Builder:
         if sel.from_ is None:
             plan: LogicalPlan = LogicalDual()
         else:
-            plan = self._build_from(sel.from_)
+            # the WHERE travels down to memtable sources as pushdown HINTS
+            # (simple col-vs-literal conjuncts only): the log memtables use
+            # them to filter their wire sweep server-side. Saved/restored —
+            # derived tables re-enter here with their own WHERE.
+            prev_w = getattr(self, "_mt_where", None)
+            self._mt_where = sel.where
+            try:
+                plan = self._build_from(sel.from_)
+            finally:
+                self._mt_where = prev_w
 
         if sel.where is not None:
             residual: list[ast.Node] = []
@@ -958,7 +967,10 @@ class Builder:
         if isinstance(node, ast.TableRef):
             db = node.db or self.db
             if db.lower() == "information_schema" and self.memtable_provider is not None:
-                mem = self.memtable_provider(node.name.lower())
+                mem = self.memtable_provider(
+                    node.name.lower(),
+                    _memtable_hints(getattr(self, "_mt_where", None)),
+                )
                 if mem is None:
                     raise PlanError(f"Unknown table 'information_schema.{node.name}'")
                 names, ftypes, rows = mem
@@ -2038,6 +2050,27 @@ def _split_ast_conj(node: ast.Node) -> list:
     if isinstance(node, ast.BinaryOp) and node.op == "and":
         return _split_ast_conj(node.left) + _split_ast_conj(node.right)
     return [node]
+
+
+def _memtable_hints(where) -> list:
+    """Extract ``(column_lower, op, literal)`` triples from the simple
+    col-vs-literal conjuncts of a WHERE — the memtable pushdown hints.
+    Strictly advisory: the full WHERE still evaluates as a LogicalSelection
+    above the source, so dropping a conjunct here never changes results —
+    only how many rows a cluster sweep ships."""
+    if where is None:
+        return []
+    flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq", "ne": "ne"}
+    out = []
+    for cj in _split_ast_conj(where):
+        if not isinstance(cj, ast.BinaryOp) or cj.op not in flip:
+            continue
+        le, ri = cj.left, cj.right
+        if isinstance(le, ast.ColumnName) and isinstance(ri, ast.Literal):
+            out.append((le.name.lower(), cj.op, ri.value))
+        elif isinstance(ri, ast.ColumnName) and isinstance(le, ast.Literal):
+            out.append((ri.name.lower(), flip[cj.op], le.value))
+    return out
 
 
 def _and_join_ast(conds: list):

@@ -498,6 +498,11 @@ class Server:
             from tidb_tpu.utils.metrics import SERVER_CONNS
 
             SERVER_CONNS.inc(1 if event == "connected" else -1)
+        from tidb_tpu.utils import eventlog as _ev
+
+        lg = _ev.on(_ev.INFO)
+        if lg is not None:
+            lg.emit(_ev.INFO, "server", event, conn=conn.conn_id, user=conn.user)
         exts = getattr(self.db, "extensions", None)
         if exts is not None and exts.have:
             import time as _t

@@ -122,8 +122,54 @@ class StatusServer:
                              "resplits": e.resplits,
                              "max_task_store": e.max_task_store,
                              "cop_summary": e.cop_summary,
-                             "trace_id": e.trace_id}
+                             "trace_id": e.trace_id,
+                             "events": e.events,
+                             "first_error": e.first_error}
                             for e in outer.db.stmt_summary.slow_queries()
+                        ]
+                    ).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/logs"):
+                    # the structured event log (utils/eventlog): ?since=<ts>
+                    # / ?seconds=<lookback> bound the window, ?level=<name>
+                    # sets the floor, ?component= and ?pattern= filter, and
+                    # ?limit= caps rows (newest kept) — the HTTP face of
+                    # information_schema.tidb_log; trace_id pivots to /traces
+                    import time as _time
+                    from urllib.parse import parse_qs, urlparse
+
+                    from tidb_tpu.utils import eventlog as _evlog
+
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        since = q.get("since", [None])[0]
+                        since = float(since) if since else None
+                        secs = q.get("seconds", [None])[0]
+                        if secs:
+                            since = _time.time() - float(secs)
+                        until = q.get("until", [None])[0]
+                        until = float(until) if until else None
+                        limit = int(q.get("limit", ["256"])[0])
+                    except ValueError:
+                        self.send_response(400)
+                        self.end_headers()
+                        return
+                    rows = _evlog.get().search(
+                        since=since,
+                        until=until,
+                        min_level=_evlog.level_from_name(
+                            q.get("level", ["debug"])[0]
+                        ),
+                        component=q.get("component", [None])[0],
+                        pattern=q.get("pattern", [None])[0],
+                        limit=limit,
+                    )
+                    body = json.dumps(
+                        [
+                            {"ts": ts, "level": _evlog.level_name(lv),
+                             "component": comp, "event": ev,
+                             "fields": fields, "trace_id": tid}
+                            for ts, lv, comp, ev, fields, tid in rows
                         ]
                     ).encode()
                     ctype = "application/json"

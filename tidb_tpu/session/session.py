@@ -22,6 +22,7 @@ from tidb_tpu.parser import ast, parse
 from tidb_tpu.planner.builder import Builder
 from tidb_tpu.planner.optimizer import optimize
 from tidb_tpu.planner.plans import PlanError, explain_plan
+from tidb_tpu.utils import eventlog as _ev
 from tidb_tpu.utils import sysvar_int
 from tidb_tpu.utils.chunk import Chunk
 
@@ -1352,10 +1353,19 @@ class Session:
                 with self.span("execute"):
                     ex = build_executor(plan, self)
                     chunk = ex.execute()
-            except MPPRetryExhausted:
+            except MPPRetryExhausted as mpp_err:
                 # MPP gave up (device failures) → re-plan without MPP and run
                 # on the surviving engines (ref: mpp retry exhaustion falling
                 # back rather than failing the statement)
+                lg = _ev.on(_ev.WARN)
+                if lg is not None:
+                    lg.emit(
+                        _ev.WARN,
+                        "mpp",
+                        "host_join_fallback",
+                        trace_id=getattr(self.tracer, "trace_id", None),
+                        reason=str(mpp_err),
+                    )
                 prev = self.vars.get("tidb_allow_mpp", 1)
                 self.vars["tidb_allow_mpp"] = 0
                 # on the cached-plan prepared lane `stmt` still carries its
@@ -1574,10 +1584,10 @@ class Session:
     def _subquery_runner(self, sel) -> list[tuple]:
         return self._run_select_ast(sel)
 
-    def _memtable_provider(self, name: str):
+    def _memtable_provider(self, name: str, hints=()):
         from tidb_tpu.catalog.infoschema import memtable_rows
 
-        return memtable_rows(self._db, self, name)
+        return memtable_rows(self._db, self, name, hints)
 
     def _cte_runner(self, sel):
         """Plan+run one CTE part; returns (rows, schema) for the fixpoint
@@ -2286,6 +2296,16 @@ class DB:
                     # but only until it expires unrefreshed
                     if time.monotonic() > deadline:
                         fenced.set()
+                        lg = _ev.on(_ev.ERROR)
+                        if lg is not None:
+                            lg.emit(
+                                _ev.ERROR,
+                                "owner",
+                                "self_fence",
+                                key=key,
+                                node=self.node_id,
+                                reason="lease expired, election keyspace unreachable",
+                            )
                         return
                     continue
                 if ok:
@@ -2293,6 +2313,16 @@ class DB:
                 else:
                     # the term moved on (another node won) — self-fence NOW
                     fenced.set()
+                    lg = _ev.on(_ev.WARN)
+                    if lg is not None:
+                        lg.emit(
+                            _ev.WARN,
+                            "owner",
+                            "deposed",
+                            key=key,
+                            node=self.node_id,
+                            term=term,
+                        )
                     return
 
         ka = threading.Thread(target=keepalive, daemon=True, name=f"owner-ka-{key}")

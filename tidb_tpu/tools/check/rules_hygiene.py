@@ -24,6 +24,7 @@ from tidb_tpu.tools.check.core import Finding, Tree, call_name, rule
 
 THREAD_RULE = "thread-name"
 METRIC_RULE = "metric-labels"
+EVENTLOG_RULE = "eventlog-discipline"
 
 
 @rule(
@@ -59,6 +60,50 @@ def check_threads(tree: Tree) -> list:
                                 symbol="Thread",
                             )
                         )
+    return out
+
+
+# CLI surfaces whose job IS stdout: the ecosystem tools, the bench
+# runners, and module entry points
+_PRINT_EXEMPT_PREFIXES = ("tidb_tpu/tools/", "tidb_tpu/bench/")
+
+
+@rule(
+    EVENTLOG_RULE,
+    "package code must not print() — record an event or raise",
+    """
+Bare print() in library code is an observability leak: the line scrolls
+off a terminal nobody is watching, never reaches information_schema
+.tidb_log / cluster_log, carries no level, component, or trace_id, and is
+invisible to the log_search wire verb and the tools.diag bundle. The repo
+has a structured event log (utils/eventlog) precisely so load-bearing
+state transitions survive for post-hoc diagnosis — a print is a signal
+that dies at birth. Fix: emit an event (eventlog.on(level) gate + emit)
+or raise a typed error. CLI surfaces whose contract IS stdout — tools/,
+bench/, and __main__.py entry points — are exempt.
+""",
+)
+def check_eventlog_discipline(tree: Tree) -> list:
+    out: list[Finding] = []
+    for sf in tree.targets():
+        if sf.path.startswith(_PRINT_EXEMPT_PREFIXES) or sf.path.endswith("__main__.py"):
+            continue
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Call)
+                and call_name(node.func) == "print"
+            ):
+                out.append(
+                    Finding(
+                        EVENTLOG_RULE,
+                        sf.path,
+                        node.lineno,
+                        "bare print() in package code — emit a structured "
+                        "event (utils/eventlog) so the signal reaches "
+                        "cluster_log and the diag bundle",
+                        symbol="print",
+                    )
+                )
     return out
 
 

@@ -23,6 +23,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from tidb_tpu.utils import eventlog as _ev
+
 
 class BackoffConfig:
     """One retriable condition: exponential growth from ``base_ms`` capped at
@@ -181,13 +183,38 @@ class Backoffer:
             else:
                 sleep_ms = raw
             if self._slept_ms + sleep_ms > self.budget_ms:
-                raise BackoffExhausted(
+                exhausted = BackoffExhausted(
                     config, sum(self._attempts.values()), self._slept_ms, err
                 )
+                lg = _ev.on(_ev.ERROR)
+                if lg is not None:
+                    lg.emit(
+                        _ev.ERROR,
+                        "backoff",
+                        "exhausted",
+                        config=config.name,
+                        attempts=exhausted.attempts,
+                        slept_ms=round(exhausted.slept_ms, 2),
+                        last=str(err) if err is not None else None,
+                    )
+                raise exhausted
             self._attempts[config.name] = n + 1
             self._slept_ms += sleep_ms
         from tidb_tpu.utils import metrics as _metrics
 
+        # regionMiss sleeps are the re-route signal (stale placement → refresh
+        # → retry) and log at info; everything else is debug-only churn
+        lvl = _ev.INFO if config.name == "regionMiss" else _ev.DEBUG
+        lg = _ev.on(lvl)
+        if lg is not None:
+            lg.emit(
+                lvl,
+                "backoff",
+                "region_miss" if config.name == "regionMiss" else "sleep",
+                config=config.name,
+                attempt=n + 1,
+                sleep_ms=round(sleep_ms, 2),
+            )
         _metrics.BACKOFF_TOTAL.inc(config=config.name)
         self._sleep(sleep_ms / 1000.0)
         return sleep_ms

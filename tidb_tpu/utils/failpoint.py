@@ -34,6 +34,14 @@ def inject(name: str, *args):
         action = _active.get(name)
     if action is None:
         return None
+    # every enabled firing is an event BEFORE the action runs (callables may
+    # raise to simulate crashes — the chaos.* record must precede the damage
+    # so recovery chains in cluster_log show cause, then effect)
+    from tidb_tpu.utils import eventlog as _ev
+
+    lg = _ev.on(_ev.WARN)
+    if lg is not None:
+        lg.emit(_ev.WARN, "chaos", name, failpoint=name)
     if callable(action):
         return action(*args)
     return action

@@ -121,6 +121,11 @@ class SlowEntry:
     trace_id: str = ""
     # the statement's memory-tracker peak (bytes) — slow_query.MEM_MAX
     mem_max: int = 0
+    # event-log cross-links, captured at record time when the statement was
+    # trace-sampled: how many events carried its trace_id, and the first
+    # ERROR-level one (component.event) — the "what went wrong first" pivot
+    events: int = 0
+    first_error: str = ""
 
     def __iter__(self):
         # legacy 5-tuple shape for pre-structured consumers
@@ -198,6 +203,17 @@ class StmtSummary:
                     e.resplits = cop.resplits
                     e.max_task_store = cop.max_task_store
                     e.cop_summary = cop.render()
+                if trace_id:
+                    # slow statements are rare — a ring scan here is fine,
+                    # and the cross-link makes the entry self-diagnosing
+                    from tidb_tpu.utils import eventlog as _evlog
+
+                    evs = _evlog.get().for_trace(trace_id)
+                    e.events = len(evs)
+                    for ev in evs:
+                        if ev[1] >= _evlog.ERROR:
+                            e.first_error = f"{ev[2]}.{ev[3]}"
+                            break
                 self._slow.append(e)
 
     def stats(self) -> list[StmtStats]:
