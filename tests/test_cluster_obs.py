@@ -507,6 +507,21 @@ def test_partial_fleet_introspection(fleet):
     seen = {r[0] for r in rows}
     assert addrs[0] in seen and addrs[1] in seen and addrs[2] not in seen
 
+    # the keyspace heatmap sweep degrades identically: survivors' traffic
+    # rings still surface, the dead store contributes no rows, and a
+    # warning names the unreachable instance (ISSUE 20 satellite)
+    rows = s.query(
+        "SELECT INSTANCE, READ_KEYS FROM information_schema.keyspace_heatmap"
+    )
+    seen = {r[0] for r in rows}
+    assert addrs[0] in seen and addrs[1] in seen, (
+        f"survivors' heatmap rows must remain: {rows}"
+    )
+    assert addrs[2] not in seen, "the dead store cannot contribute traffic"
+    assert any(addrs[2] in w[2] for w in s.warnings), (
+        f"the heatmap sweep must warn about the dead instance: {s.warnings}"
+    )
+
     # the health registry marks the dead store stale, survivors fresh
     assert db.health.is_stale(addrs[2])
     assert not db.health.is_stale(addrs[0])

@@ -85,6 +85,58 @@ def test_metric_labels_rule():
     assert len(r.findings) == 1
     ok = bad.replace("tuple(dims)", "('kind', 'outcome')")
     assert not _scan_src("tidb_tpu/utils/x.py", ok, ["metric-labels"]).findings
+    # the group-labeled RU accounting counters (workload attribution) keep
+    # the literal-tuple contract: group names are a bounded, user-declared
+    # domain, and the declarations in utils/metrics.py must stay literal
+    grp = (
+        "from tidb_tpu.utils.metrics import REGISTRY\n"
+        "RU = REGISTRY.counter('ru_total', 'help', ('group',))\n"
+        "STMTS = REGISTRY.counter('stmt_total', 'help', ('group',))\n"
+    )
+    assert not _scan_src("tidb_tpu/utils/x.py", grp, ["metric-labels"]).findings
+
+
+def test_sys_sections_rule():
+    """An undeclared _want() section literal in sys_report is a finding
+    (the PR 9 sections= discipline: heavy report parts must be selectable
+    request-side), as is a declared-but-ungated stale section name."""
+    ok = (
+        "SYS_SECTIONS = frozenset({'metrics', 'slow'})\n"
+        "def sys_report(sections=None):\n"
+        "    want = None if sections is None else set(sections)\n"
+        "    def _want(k):\n"
+        "        return want is None or k in want\n"
+        "    rep = {}\n"
+        "    if _want('metrics'):\n"
+        "        rep['metrics'] = 1\n"
+        "    if _want('slow'):\n"
+        "        rep['slow'] = []\n"
+        "    return rep\n"
+    )
+    assert not _scan_src("tidb_tpu/kv/remote.py", ok, ["sys-sections"]).findings
+    # a new heavy section gated but NOT declared escapes the contract
+    bad = ok.replace(
+        "    return rep\n",
+        "    if _want('heatmap'):\n        rep['heatmap'] = []\n    return rep\n",
+    )
+    r = _scan_src("tidb_tpu/kv/remote.py", bad, ["sys-sections"])
+    assert len(r.findings) == 1 and r.findings[0].symbol == "heatmap"
+    # declared-but-ungated is a stale declaration
+    stale = ok.replace("{'metrics', 'slow'}", "{'metrics', 'slow', 'traces'}")
+    r2 = _scan_src("tidb_tpu/kv/remote.py", stale, ["sys-sections"])
+    assert len(r2.findings) == 1 and r2.findings[0].symbol == "traces"
+    # no declaration at all is one finding, not a crash
+    nodecl = ok.replace("SYS_SECTIONS = frozenset({'metrics', 'slow'})\n", "")
+    r3 = _scan_src("tidb_tpu/kv/remote.py", nodecl, ["sys-sections"])
+    assert len(r3.findings) == 1 and r3.findings[0].symbol == "declarations"
+    # files other than kv/remote.py are out of scope
+    assert not _scan_src("tidb_tpu/kv/other.py", bad, ["sys-sections"]).findings
+
+
+def test_sys_sections_real_tree_is_clean():
+    """The real kv/remote.py declares every section its gates select."""
+    tree = build_tree(ROOT)
+    assert not scan(tree, rules=["sys-sections"]).findings
 
 
 def test_jit_cache_rule_flags_uncached_and_allows_builders():

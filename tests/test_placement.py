@@ -298,13 +298,12 @@ def test_balancer_spreads_induced_skew():
 
 
 def test_balancer_embedded_hot_table_signal_converges():
-    """Embedded-fleet skew convergence on the HOT signal alone (ISSUE 15
-    satellite): three equal-row tables on one shard, but one is hammered
-    with cop queries — the per-store cop-digest rings (attached by
-    ShardedStore to in-process members, recorded by the embedded cop
-    client, shipped via sys_snapshot's statements section) must give
-    run_balancer the same hot boost a wire fleet gets, and the HOT table
-    must be the first to move."""
+    """Embedded-fleet skew convergence on the HOT signal alone: three
+    equal-row tables on one shard, but one is hammered with cop queries —
+    the per-store keyspace traffic rings (kv/memstore TrafficStats, fed by
+    the cop-serve seam so even device-cache hits count, shipped via
+    sys_snapshot's heatmap section) must give run_balancer the measured
+    hot boost, and the HOT table must be the first to move."""
     from tidb_tpu.kv.placement import _shard_weights
 
     fleet = _fleet()

@@ -363,6 +363,41 @@ def bench_log_overhead() -> float:
     return on
 
 
+@register("metering_overhead_ms")
+def bench_metering_overhead() -> float:
+    """Warm multi-region COUNT(*) with workload attribution ON (ms, lower is
+    better) — per-statement ResourceUsage assembly, the RU fold into the
+    session's resource group, AND the store-side keyspace traffic rings —
+    HARD-FAILED against the same query with both switched off
+    (``METERING_ENABLED = False``, ``keyviz-interval-s = 0``) when the gap
+    breaches 5% (+0.15 ms timer grace). Same enforced-budget rule as the
+    tracing/lockcheck/eventlog lanes: always-on accounting that taxes every
+    statement is exactly the regression it exists to attribute."""
+    import dataclasses
+
+    from tidb_tpu import config as _config
+    from tidb_tpu.resourcegroup import groups as _rg
+
+    prev_cfg = _config.current()
+    prev_on = _rg.METERING_ENABLED
+    _rg.METERING_ENABLED = False
+    # fresh stores built inside _warm_count_best read config.current() at
+    # TrafficStats construction, so interval 0 yields disabled rings
+    _config.set_current(dataclasses.replace(prev_cfg, keyviz_interval_s=0.0))
+    try:
+        off = _warm_count_best("mto_off", region_split_keys=2000)
+    finally:
+        _rg.METERING_ENABLED = prev_on
+        _config.set_current(prev_cfg)
+    on = _warm_count_best("mto_on", region_split_keys=2000)
+    if on > off * 1.05 + 0.15:
+        raise RuntimeError(
+            f"metering overhead breached the 5% budget: off {off:.3f}ms "
+            f"-> metered {on:.3f}ms"
+        )
+    return on
+
+
 @register("qps_point_select")
 def bench_qps_point_select() -> float:
     """Concurrent point-select throughput (ops/s, higher is better): N
@@ -635,6 +670,49 @@ def bench_inspection_sweep() -> float:
             best = min(best, (_t.perf_counter() - t0) * 1000)
             if not rows:  # never inside an assert (-O)
                 raise RuntimeError("inspection returned no rows on a live fleet")
+        return best
+    finally:
+        for srv in servers:
+            srv.shutdown()
+
+
+@register("keyviz_sweep_ms")
+def bench_keyviz_sweep() -> float:
+    """Heatmap-only ``sys_snapshot`` sweep wall (ms, lower is better) over a
+    3-store wire fleet with live traffic rings: the substrate of one
+    ``information_schema.keyspace_heatmap`` query, ``GET /keyviz``, or the
+    balancer's hot-weight read — ring serialization per store plus three
+    RPCs, with the heavy metrics/statements/slow sections deselected.
+    Guarded next to ``cluster_snapshot_ms`` so the traffic substrate stays
+    cheap enough to poll at dashboard cadence."""
+    import time as _t
+
+    import numpy as np
+
+    from tidb_tpu.executor.load import bulk_load
+    from tidb_tpu.kv.memstore import MemStore
+    from tidb_tpu.kv.remote import RemoteStore, StoreServer
+    from tidb_tpu.kv.sharded import ShardedStore
+    from tidb_tpu.session.session import DB
+
+    servers = [StoreServer(MemStore(region_split_keys=100_000)) for _ in range(3)]
+    try:
+        stores = [RemoteStore("127.0.0.1", srv.start()) for srv in servers]
+        db = DB(store=ShardedStore(stores))
+        db.execute("CREATE TABLE kvz (id BIGINT PRIMARY KEY, v BIGINT)")
+        n = 5_000
+        bulk_load(db, "kvz", [np.arange(n, dtype=np.int64), np.arange(n, dtype=np.int64)])
+        s = db.session()
+        for _ in range(5):  # populate the rings on the owning stores
+            s.query("SELECT SUM(v) FROM kvz")
+        db.health.sweep(sections=("heatmap",))  # warm: sockets + report path
+        best = float("inf")
+        for _ in range(10):
+            t0 = _t.perf_counter()
+            outs = db.health.sweep(sections=("heatmap",))
+            best = min(best, (_t.perf_counter() - t0) * 1000)
+            if not all(o["ok"] for o in outs):  # never inside an assert (-O)
+                raise RuntimeError(f"heatmap sweep lost a live store: {outs}")
         return best
     finally:
         for srv in servers:

@@ -46,7 +46,13 @@ def memtable_rows(db, session, name: str, hints=()) -> Optional[tuple[list, list
         "metrics_history": _metrics_history,
         "cluster_metrics_history": _cluster_metrics_history,
         "resource_groups": _resource_groups,
+        "resource_group_usage": _resource_group_usage,
         "runaway_watches": _runaway_watches,
+        # workload attribution: per-(region, table) traffic rings sampled in
+        # the stores, swept fleet-wide (the Key Visualizer substrate; also
+        # GET /keyviz and the balancer's hot-shard boost)
+        "keyspace_heatmap": _keyspace_heatmap,
+        "cluster_keyspace_heatmap": _cluster_keyspace_heatmap,
         "views": _views,
         "key_column_usage": _key_column_usage,
         "table_constraints": _table_constraints,
@@ -177,9 +183,9 @@ def _statements_summary_shape():
 
     cols = ["DIGEST", "DIGEST_TEXT", "EXEC_COUNT", "SUM_LATENCY", "MAX_LATENCY",
             "AVG_LATENCY", "SUM_ROWS", "QUERY_SAMPLE_TEXT", "PLAN_DIGEST",
-            "SUM_COP_TASKS", "SUM_BACKOFF", "MAX_MEM"]
+            "SUM_COP_TASKS", "SUM_BACKOFF", "MAX_MEM", "SUM_RU", "RESOURCE_GROUP"]
     fts = [_S(80), _S(256), _I(), double_type(), double_type(), double_type(),
-           _I(), _S(256), _S(80), _I(), double_type(), _I()]
+           _I(), _S(256), _S(80), _I(), double_type(), _I(), double_type(), _S(32)]
     return cols, fts
 
 
@@ -187,7 +193,8 @@ def _stmt_stats_row(st):
     d, _, norm = st.digest.partition("|")
     return (d, norm, st.exec_count, st.sum_latency, st.max_latency,
             st.avg_latency, st.sum_rows, st.sample, st.plan_digest,
-            st.sum_cop_tasks, st.sum_backoff, st.max_mem)
+            st.sum_cop_tasks, st.sum_backoff, st.max_mem, st.sum_ru,
+            st.resource_group)
 
 
 def _statements_summary(db, session):
@@ -206,14 +213,15 @@ def _top_sql(db, session):
     from tidb_tpu.utils import eventlog as _evlog
 
     cols = ["SQL_DIGEST", "PLAN_DIGEST", "QUERY_SAMPLE_TEXT", "CPU_TIME_SEC",
-            "SAMPLES", "TRACE_ID", "EVENTS"]
-    fts = [_S(80), _S(80), _S(256), double_type(), _I(), _S(80), _I()]
+            "SAMPLES", "TRACE_ID", "EVENTS", "RU"]
+    fts = [_S(80), _S(80), _S(256), double_type(), _I(), _S(80), _I(),
+           double_type()]
     # EVENTS resolves at read time: the count of event-log rows carrying the
     # row's sampled trace_id — the Top-SQL ↔ cluster_log pivot
     lg = _evlog.get()
     rows = [
-        (d, p, s, c, n, t, len(lg.for_trace(t)) if t else 0)
-        for d, p, s, c, n, t in collector().top_sql()
+        (d, p, s, c, n, t, len(lg.for_trace(t)) if t else 0, ru)
+        for d, p, s, c, n, t, ru in collector().top_sql()
     ]
     return cols, fts, rows
 
@@ -224,10 +232,10 @@ def _slow_query_shape():
     cols = ["TIME", "QUERY", "QUERY_TIME", "RESULT_ROWS", "USER", "DIGEST",
             "PLAN_DIGEST", "COP_TASKS", "COP_PROC_MAX", "BACKOFF_TIME",
             "RESPLITS", "MAX_TASK_STORE", "COP_SUMMARY", "TRACE_ID", "MEM_MAX",
-            "EVENTS", "FIRST_ERROR"]
+            "EVENTS", "FIRST_ERROR", "RU", "RESOURCE_GROUP"]
     fts = [double_type(), _S(512), double_type(), _I(), _S(), _S(80), _S(80),
            _I(), double_type(), double_type(), _I(), _S(64), _S(256), _S(80), _I(),
-           _I(), _S(64)]
+           _I(), _S(64), double_type(), _S(32)]
     return cols, fts
 
 
@@ -235,7 +243,7 @@ def _slow_entry_row(e):
     return (e.time, e.sql, e.latency_s, e.rows, e.user, e.digest, e.plan_digest,
             e.cop_tasks, e.cop_proc_max_ms / 1000.0, e.backoff_ms / 1000.0,
             e.resplits, e.max_task_store, e.cop_summary, e.trace_id, e.mem_max,
-            e.events, e.first_error)
+            e.events, e.first_error, e.ru, e.resource_group)
 
 
 def _slow_query(db, session):
@@ -284,6 +292,87 @@ def _runaway_watches(db, session):
     cols = ["TIME", "RESOURCE_GROUP_NAME", "ACTION", "SAMPLE_SQL"]
     fts = [double_type(), _S(), _S(16), _S(256)]
     rows = [(r.time, r.group, r.action, r.sql) for r in db.resource_groups.runaway_log]
+    return cols, fts, rows
+
+
+def _resource_group_usage(db, session):
+    """Per-group cumulative metered usage (workload attribution: which
+    tenant is spending the fleet's RUs, and on what — reads vs writes vs
+    compute vs transfer). Counters accumulate from statement finalization
+    (session.execute → ResourceGroupManager.charge); metering only, no
+    admission control."""
+    from tidb_tpu.types.field_type import double_type
+
+    _D = double_type
+    cols = ["RESOURCE_GROUP", "STATEMENTS", "RU", "RRU", "WRU", "WALL_MS",
+            "CPU_MS", "DEVICE_MS", "HOST_MS", "H2D_BYTES", "D2H_BYTES",
+            "KEYS_SCANNED", "BYTES_SCANNED", "KEYS_WRITTEN", "BYTES_WRITTEN",
+            "COP_RPCS", "BACKOFF_MS", "MPP_EXCHANGE_BYTES", "ROWS_RETURNED"]
+    fts = [_S(32), _I(), _D(), _D(), _D(), _D(), _D(), _D(), _D(), _I(), _I(),
+           _I(), _I(), _I(), _I(), _I(), _D(), _I(), _I()]
+    rows = []
+    for g in db.resource_groups.list():
+        u = g.usage
+        rows.append((g.name, u.statements, u.ru, u.rru, u.wru, u.wall_ms,
+                     u.cpu_ms, u.device_ms, u.host_ms, u.h2d_bytes,
+                     u.d2h_bytes, u.keys_scanned, u.bytes_scanned,
+                     u.keys_written, u.bytes_written, u.cop_rpcs,
+                     u.backoff_ms, u.mpp_exchange_bytes, u.rows_returned))
+    return cols, fts, rows
+
+
+def _table_names(db) -> dict:
+    names = {}
+    for dname, t in _iter_tables(db):
+        names[t.id] = f"{dname}.{t.name}"
+        for v in t.partition_views():
+            names.setdefault(v.id, f"{dname}.{t.name}")
+    return names
+
+
+def _keyspace_heatmap(db, session):
+    """Window totals of the stores' per-(region, table) traffic rings — the
+    "which region is hot, and whose table is it" view (ref: the dashboard
+    Key Visualizer; per-bucket detail is cluster_keyspace_heatmap, raw JSON
+    is GET /keyviz). A dead store degrades to a warning + partial rows."""
+    cols = ["INSTANCE", "REGION_ID", "TABLE_ID", "TABLE_NAME", "READ_KEYS",
+            "READ_BYTES", "WRITE_KEYS", "WRITE_BYTES"]
+    fts = [_S(), _I(), _I(), _S(128), _I(), _I(), _I(), _I()]
+    names = _table_names(db)
+    rows = []
+    for o in _cluster_sweep(db, session, sections=("heatmap",)):
+        if not o["ok"]:
+            continue
+        for ent in o["report"].get("heatmap", ()):
+            rk = rb = wk = wb = 0
+            for _, brk, brb, bwk, bwb in ent["buckets"]:
+                rk += brk
+                rb += brb
+                wk += bwk
+                wb += bwb
+            rows.append((o["instance"], ent["region_id"], ent["table_id"],
+                         names.get(ent["table_id"], ""), rk, rb, wk, wb))
+    return cols, fts, rows
+
+
+def _cluster_keyspace_heatmap(db, session):
+    """The same rings at full bucket resolution: one row per retained
+    (instance, region, table, bucket) — traffic-over-time as SQL."""
+    from tidb_tpu.types.field_type import double_type
+
+    cols = ["INSTANCE", "BUCKET_TS", "REGION_ID", "TABLE_ID", "TABLE_NAME",
+            "READ_KEYS", "READ_BYTES", "WRITE_KEYS", "WRITE_BYTES"]
+    fts = [_S(), double_type(), _I(), _I(), _S(128), _I(), _I(), _I(), _I()]
+    names = _table_names(db)
+    rows = []
+    for o in _cluster_sweep(db, session, sections=("heatmap",)):
+        if not o["ok"]:
+            continue
+        for ent in o["report"].get("heatmap", ()):
+            tn = names.get(ent["table_id"], "")
+            for ts, rk, rb, wk, wb in ent["buckets"]:
+                rows.append((o["instance"], ts, ent["region_id"],
+                             ent["table_id"], tn, rk, rb, wk, wb))
     return cols, fts, rows
 
 

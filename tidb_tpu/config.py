@@ -70,6 +70,15 @@ class Config:
     # signal exceeds this, the effective sample rate scales down
     # proportionally (tracing.clamp_rate). 0 = no clamp.
     trace_clamp_qps: float = 0.0
+    # [observability] keyspace traffic heatmap (the Key Visualizer analog):
+    # every store keeps bounded per-(region, table) traffic rings — read and
+    # write keys+bytes bucketed by keyviz-interval-s, retained for
+    # keyviz-retention-s — sampled at the snapshot/scan/cop/commit seams and
+    # served fleet-wide via the sys_snapshot "heatmap" section /
+    # information_schema.keyspace_heatmap / GET /keyviz. interval <= 0
+    # disables the rings entirely (note_* become no-ops).
+    keyviz_interval_s: float = 5.0
+    keyviz_retention_s: float = 600.0
     # [observability] store-side cop slow log: a cop task whose store-side
     # processing wall crosses this lands in the STORE process's own
     # StmtSummary ring (served fleet-wide via the sys_snapshot verb /
@@ -160,6 +169,8 @@ class Config:
             obs.get("metrics-history-retention", cfg.metrics_history_retention_s)
         )
         cfg.trace_clamp_qps = float(obs.get("trace-clamp-qps", cfg.trace_clamp_qps))
+        cfg.keyviz_interval_s = float(obs.get("keyviz-interval-s", cfg.keyviz_interval_s))
+        cfg.keyviz_retention_s = float(obs.get("keyviz-retention-s", cfg.keyviz_retention_s))
         cfg.store_slow_cop_ms = float(obs.get("store-slow-cop-ms", cfg.store_slow_cop_ms))
         cfg.eventlog_level = str(obs.get("eventlog-level", cfg.eventlog_level))
         cfg.eventlog_capacity = int(obs.get("eventlog-capacity", cfg.eventlog_capacity))

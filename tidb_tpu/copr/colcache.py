@@ -378,14 +378,26 @@ class ColumnCache:
         delta capacity; anything else folds through :meth:`_merge` (which
         still re-uploads only dirty device blocks)."""
         key = (region.region_id, table_id)
+        base_delta = None
         for _attempt in range(4):
             base_delta = self._get_split_once(key, region, table_id, schema, slots, read_ts)
             if base_delta is not None:
-                return base_delta
-        # repeated install races (merges landing back to back): plain merge
-        with self._mu:
-            old = self._entries.get(key)
-        return self._merge(key, region, table_id, schema, slots, read_ts, old), None
+                break
+        if base_delta is None:
+            # repeated install races (merges landing back to back): plain merge
+            with self._mu:
+                old = self._entries.get(key)
+            base_delta = self._merge(key, region, table_id, schema, slots, read_ts, old), None
+        # cop-serve traffic seam: every serve counts — device-cache hits
+        # never reach the store's MVCC read seams, but a hammered-cached
+        # region is exactly what the keyspace heatmap (and the balancer's
+        # hot boost) must surface
+        note = getattr(self.store, "note_region_read", None)
+        if note is not None:
+            n = base_delta[0].n + (base_delta[1].n if base_delta[1] is not None else 0)
+            if n:
+                note(region.region_id, table_id, n, n * 8 * max(1, len(slots)))
+        return base_delta
 
     def _get_split_once(self, key, region, table_id, schema, slots, read_ts):
         """One get_split attempt; None = a concurrent merge replaced the

@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import bisect
 import threading
+import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Iterator, Optional, Sequence
 
@@ -38,6 +40,7 @@ from tidb_tpu.kv.kv import (
 )
 from tidb_tpu.kv.detector import DeadlockDetector
 from tidb_tpu.kv import tablecodec
+from tidb_tpu.utils import execdetails as _ed
 
 OP_PUT = "P"
 OP_DEL = "D"
@@ -89,6 +92,84 @@ class _ChangeLog:
         self.items.clear()
         self.lost = True
         self.lost_max_ts = max(self.lost_max_ts, ts)
+
+
+# heatmap bound: past this many live (region, table) pairs, NEW pairs are
+# dropped (existing rings keep accumulating) — the retention math stays exact
+# and a pathological keyspace cannot balloon the store's memory
+_TRAFFIC_RINGS_CAP = 4096
+
+
+class TrafficStats:
+    """Per-(region, table) keyspace traffic rings — the Key Visualizer
+    substrate (ref: the Dashboard heatmap fed by per-region read/write
+    statistics). Read and write keys+bytes are bucketed by the
+    ``[observability] keyviz-interval-s`` knob with bounded retention
+    (``keyviz-retention-s``), sampled at the snapshot/scan/cop/commit seams
+    and shipped fleet-wide via the ``sys_snapshot`` "heatmap" section.
+
+    Lockless on purpose (the eventlog discipline): notes ride the hottest
+    read path of the store, so they rely on GIL-atomic dict/deque ops
+    instead of a mutex — a lock here costs more than the accounting,
+    especially under the tier-1 lock-order detector. Counter bumps are
+    plain read-modify-writes, so a racing pair can drop a count into a
+    just-rolled bucket or lose one — the heatmap is advisory traffic
+    telemetry, not billing; ``enabled`` is the first check on every note
+    so a disabled recorder (interval <= 0) costs one attribute read."""
+
+    __slots__ = ("interval_s", "retention_s", "enabled", "_rings")
+
+    def __init__(self, interval_s: float | None = None, retention_s: float | None = None):
+        from tidb_tpu import config as _config
+
+        cfg = _config.current()
+        self.interval_s = cfg.keyviz_interval_s if interval_s is None else interval_s
+        self.retention_s = cfg.keyviz_retention_s if retention_s is None else retention_s
+        self.enabled = self.interval_s > 0
+        # (region_id, table_id) → deque of mutable rows
+        # [bucket_ts, read_keys, read_bytes, write_keys, write_bytes]
+        self._rings: dict[tuple[int, int], deque] = {}
+
+    def _note(self, region_id: int, table_id: int, ki: int, bi: int, keys: int, nbytes: int) -> None:
+        now = time.time()
+        bts = now - (now % self.interval_s)
+        ring = self._rings.get((region_id, table_id))
+        if ring is None:
+            if len(self._rings) >= _TRAFFIC_RINGS_CAP:
+                return
+            depth = max(1, int(self.retention_s / self.interval_s))
+            # setdefault: a racing creator's ring wins, ours is discarded
+            ring = self._rings.setdefault((region_id, table_id), deque(maxlen=depth))
+        row = ring[-1] if ring else None
+        if row is None or row[0] != bts:
+            row = [bts, 0, 0, 0, 0]
+            ring.append(row)
+        row[ki] += keys
+        row[bi] += nbytes
+
+    def note_read(self, region_id: int, table_id: int, keys: int, nbytes: int) -> None:
+        if self.enabled and keys > 0:
+            self._note(region_id, table_id, 1, 2, int(keys), int(nbytes))
+
+    def note_write(self, region_id: int, table_id: int, keys: int, nbytes: int) -> None:
+        if self.enabled and keys > 0:
+            self._note(region_id, table_id, 3, 4, int(keys), int(nbytes))
+
+    def drop_table(self, table_id: int) -> None:
+        """Migration purge / DDL drop forgets the table's rings — post-
+        cutover traffic belongs to the new owner's store."""
+        for k in [k for k in self._rings if k[1] == table_id]:
+            self._rings.pop(k, None)
+
+    def snapshot(self, since: float = 0.0) -> list[dict]:
+        """JSON-able ring dump (buckets at or after ``since``): the
+        sys_snapshot "heatmap" section / GET /keyviz payload."""
+        out: list[dict] = []
+        for (rid, tid), ring in list(self._rings.items()):
+            buckets = [list(r) for r in list(ring) if r[0] >= since]
+            if buckets:
+                out.append({"region_id": rid, "table_id": tid, "buckets": buckets})
+        return out
 
 
 @dataclass(frozen=True)
@@ -272,7 +353,9 @@ class Snapshot:
 
     def get(self, key: bytes) -> Optional[bytes]:
         with self._store._mu:
-            return self._get_locked(key)
+            v = self._get_locked(key)
+        self._store._note_read_traffic(key, 1, len(v) if v is not None else 0)
+        return v
 
     def get_many(self, keys) -> list:
         """Vectorized multi-key read: ONE lock acquisition for the whole
@@ -281,12 +364,21 @@ class Snapshot:
         list — one session's locked key must never fail the other sessions'
         reads coalesced into the same batch."""
         out: list = []
+        first = None
+        nb = 0
         with self._store._mu:
             for k in keys:
+                if first is None:
+                    first = k
                 try:
-                    out.append(self._get_locked(k))
+                    v = self._get_locked(k)
+                    if v is not None:
+                        nb += len(v)
+                    out.append(v)
                 except KeyLockedError as e:
                     out.append(e)
+        if first is not None:
+            self._store._note_read_traffic(first, len(out), nb)
         return out
 
     def scan(self, kr: KeyRange, limit: int = 2**63, reverse: bool = False) -> list[tuple[bytes, bytes]]:
@@ -351,6 +443,8 @@ class Snapshot:
             if cur_key is not None and cur_val is not None and len(out) < limit:
                 b, i = cur_val if isinstance(cur_val, tuple) else (None, None)
                 out.append((cur_key, encode_row(b.schema, b.row_values(i)) if b is not None else cur_val))
+        if out:
+            store._note_read_traffic(out[0][0], len(out), sum(len(v) for _, v in out))
         return out
 
     def scan_record_rows(self, kr: KeyRange) -> BulkRows:
@@ -391,6 +485,8 @@ class Snapshot:
                 starts.append(off)
                 off += len(w.value)
                 ends.append(off)
+        if handles or tombs:
+            self._store._note_read_traffic(kr.start, len(handles) + len(tombs), off)
         return BulkRows(
             np.asarray(handles, dtype=np.int64),
             np.asarray(starts, dtype=np.int64),
@@ -459,6 +555,13 @@ class MemStore:
         # the cutover signal stale routing clients re-resolve on. TTL
         # fences self-heal when a migration driver dies mid-move.
         self._fences: dict[int, float | None] = {}
+        # keyspace traffic heatmap rings (Key Visualizer substrate) — fed by
+        # the read/write seams below, served via sys_snapshot "heatmap"
+        self.traffic = TrafficStats()
+        # one-entry (table-prefix, region-range) resolution memo for the
+        # lockless read seam: (key9, start, end, region_id, table_id) —
+        # invalidated on region splits and table purges
+        self._traffic_memo: tuple | None = None
 
     # -- owner election (ref: pkg/owner/manager.go:49) ----------------------
     def owner_campaign(
@@ -702,6 +805,56 @@ class MemStore:
                 if rr.start < hi and rr.end > lo:
                     self._recount_region(r)
                     r.data_version += 1
+        self.traffic.drop_table(table_id)
+        self._traffic_memo = None
+
+    # -- workload attribution (read seam) ------------------------------------
+    def _note_read_traffic(self, key: bytes, keys: int, nbytes: int) -> None:
+        """Attribute a read at ``key``'s region/table into the traffic rings
+        AND the active cop-task sidecar (the keys/bytes-scanned RU inputs).
+        Rides the hottest read path of the store, so it is lockless end to
+        end: a one-entry (table-prefix, region-range) memo resolves the
+        repeat-key / scan-locality case with a slice compare and two bytes
+        compares, and memo misses walk ``_regions`` WITHOUT the store mutex
+        (GIL-snapshot iteration — re-acquiring ``_mu`` here doubled the
+        per-get cost under the tier-1 lock-order detector, and a racing
+        split at worst misattributes a few advisory counts)."""
+        det = _ed.current_cop()
+        if det is not None:
+            det.keys_scanned += keys
+            det.bytes_scanned += nbytes
+        tr = self.traffic
+        if not tr.enabled or keys <= 0:
+            return
+        memo = self._traffic_memo
+        if (
+            memo is not None
+            and memo[1] <= key
+            and key[:9] == memo[0]
+            and (memo[2] == b"" or key < memo[2])
+        ):
+            tr._note(memo[3], memo[4], 1, 2, keys, nbytes)
+            return
+        tid = tablecodec.table_id_of(key)
+        if tid < 0:
+            return
+        rid = -1
+        for r in self._regions:
+            if r.start <= key and (r.end == b"" or key < r.end):
+                rid = r.region_id
+                self._traffic_memo = (key[:9], r.start, r.end, rid, tid)
+                break
+        tr._note(rid, tid, 1, 2, keys, nbytes)
+
+    def note_region_read(self, region_id: int, table_id: int, keys: int, nbytes: int) -> None:
+        """Logical read traffic with region/table already resolved — the
+        cop-serve seam (copr/colcache.get_split). Device-cache hits never
+        touch the MVCC seams above, yet a hammered-but-cached region IS hot:
+        the heatmap (and the balancer reading it) must see every serve, not
+        just the physical builds."""
+        tr = self.traffic
+        if tr.enabled:
+            tr.note_read(region_id, table_id, keys, nbytes)
 
     # -- columnar change log (write→delta notification seam) ----------------
     def _note_change(self, region_id: int, key: bytes, op: str, ts: int) -> None:
@@ -848,6 +1001,7 @@ class MemStore:
                     self._next_region_id += 1
                     r.end = split_key
                     self._regions.insert(i + 1, new)
+                    self._traffic_memo = None
                     self._recount_region(r)
                     self._recount_region(new)
                     return
@@ -909,7 +1063,11 @@ class MemStore:
             # pessimistic (lock-only) locks carry no data → readers pass
             raise KeyLockedError(key, lock)
 
-    def prewrite(self, mutations: Sequence[Mutation], primary: bytes, start_ts: int) -> None:
+    def prewrite(self, mutations: Sequence[Mutation], primary: bytes, start_ts: int) -> dict:
+        """Stage locks; returns write-side accounting (``keys``/``bytes``
+        staged) — the counts ride the response headers so the txn layer can
+        attribute write RUs without a second pass over the mutations."""
+        nbytes = 0
         with self._mu:
             for m in mutations:
                 self._check_fence_key(m.key)
@@ -925,10 +1083,9 @@ class MemStore:
                     raise WriteConflictError(m.key, writes[-1].commit_ts, start_ts)
                 if start_ts in self._rollbacks.get(m.key, ()):
                     raise TxnAbortedError(f"txn {start_ts} already rolled back at {m.key!r}")
-            import time
-
             now_ms = time.time() * 1000
             for m in mutations:
+                nbytes += len(m.key) + len(m.value)
                 self._locks[m.key] = Lock(
                     primary=primary,
                     start_ts=start_ts,
@@ -937,6 +1094,7 @@ class MemStore:
                     ttl_ms=self.lock_ttl_ms,
                     created_ms=now_ms,
                 )
+        return {"keys": len(mutations), "bytes": nbytes}
 
     def acquire_pessimistic_lock(
         self,
@@ -1008,7 +1166,15 @@ class MemStore:
                     del self._locks[k]
         self.detector.clean_up(start_ts)
 
-    def commit(self, keys: Sequence[bytes], start_ts: int, commit_ts: int) -> None:
+    def commit(self, keys: Sequence[bytes], start_ts: int, commit_ts: int) -> dict:
+        """Move staged values into the write column. Returns write-side
+        accounting of keys NEWLY committed by THIS call — the idempotent
+        re-commit path contributes nothing, so a boRegionMiss re-routed
+        commit never double-counts in RU metering or the traffic rings."""
+        committed = 0
+        committed_bytes = 0
+        # (region_id, table_id) → [keys, bytes] for the heatmap write seam
+        wtraf: dict[tuple[int, int], list[int]] = {}
         with self._mu:
             touched: set[int] = set()
             for k in keys:
@@ -1039,10 +1205,22 @@ class MemStore:
                     region.key_count += 1
                 touched.add(id(region))
                 self._note_change(region.region_id, k, op, commit_ts)
+                nb = len(k) + len(lock.value)
+                committed += 1
+                committed_bytes += nb
+                if self.traffic.enabled:
+                    tid = tablecodec.table_id_of(k)
+                    if tid >= 0:
+                        acc = wtraf.setdefault((region.region_id, tid), [0, 0])
+                        acc[0] += 1
+                        acc[1] += nb
             for r in self._regions:
                 if id(r) in touched:
                     r.data_version += 1
                     self._maybe_auto_split(r)
+        for (rid, tid), (nk, nb) in wtraf.items():
+            self.traffic.note_write(rid, tid, nk, nb)
+        return {"keys": committed, "bytes": committed_bytes}
 
     def ingest(self, keys: Sequence[bytes], values: Sequence[bytes]) -> int:
         """Bulk ingest of pre-encoded committed rows at one fresh commit ts —
@@ -1094,8 +1272,25 @@ class MemStore:
                 if tablecodec.is_record_key(k):
                     tid, h = tablecodec.decode_record_key(k)
                     by_table.setdefault(tid, []).append(h)
+            per_key_bytes = (
+                sum(len(k) + len(v) for k, v in zip(keys, values)) / max(1, len(keys))
+                if self.traffic.enabled
+                else 0.0
+            )
             for tid, hs in by_table.items():
-                self._note_bulk(tid, np.sort(np.asarray(hs, dtype=np.int64)), touched, commit_ts)
+                arr = np.sort(np.asarray(hs, dtype=np.int64))
+                self._note_bulk(tid, arr, touched, commit_ts)
+                if self.traffic.enabled:
+                    for r in touched:
+                        hlo, hhi = tablecodec.range_to_handles(r.range(), tid)
+                        if hlo >= hhi:
+                            continue
+                        blo = int(np.searchsorted(arr, hlo, side="left"))
+                        bhi = int(np.searchsorted(arr, hhi, side="left"))
+                        if bhi > blo:
+                            self.traffic.note_write(
+                                r.region_id, tid, bhi - blo, int((bhi - blo) * per_key_bytes)
+                            )
             for r in touched:
                 self._maybe_auto_split(r)
             return commit_ts
@@ -1160,6 +1355,19 @@ class MemStore:
                 r.max_commit_ts = max(r.max_commit_ts, commit_ts)
                 r.data_version += 1
             self._note_bulk(table_id, handles, touched, commit_ts)
+            if self.traffic.enabled:
+                ncols = max(1, len(cols))
+                for r in touched:
+                    hlo, hhi = tablecodec.range_to_handles(r.range(), table_id)
+                    if hlo >= hhi:
+                        continue
+                    blo = int(np.searchsorted(handles, hlo, side="left"))
+                    bhi = int(np.searchsorted(handles, hhi, side="left"))
+                    if bhi > blo:
+                        # decoded columns: ~8 data bytes per cell
+                        self.traffic.note_write(
+                            r.region_id, table_id, bhi - blo, (bhi - blo) * 8 * ncols
+                        )
             for r in touched:
                 self._maybe_auto_split(r)
             return commit_ts
@@ -1210,6 +1418,11 @@ class MemStore:
                 hi = int(np.searchsorted(block.handles, hhi, side="left"))
                 if lo < hi:
                     out.append((block, lo, hi))
+        if out:
+            nk = sum(hi - lo for _, lo, hi in out)
+            # decoded columns: ~8 data bytes per cell
+            nb = sum((hi - lo) * 8 * max(1, len(b.cols)) for b, lo, hi in out)
+            self._note_read_traffic(kr.start, nk, nb)
         return out
 
     def stable_row_count(self, table_id: int) -> int:

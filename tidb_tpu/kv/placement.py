@@ -481,25 +481,22 @@ def migrate_table(store, table_id: int, dst: int, *, batch_keys: Optional[int] =
 def _shard_weights(db, store):
     """Per-shard placement weight plus the movable tables behind it:
     → (weights list, [(weight, table_id, shard, name)]). Weight per table =
-    stats row count (the durable skew signal) plus a hot boost from each
-    store's cop statement ring — wire servers record into their own
-    StmtSummary, embedded members into the per-store ``cop_ring`` the fleet
-    attaches at construction, so both fleet kinds ship the same signal.
-    Partitioned tables are immovable for now — their physical views would
-    each need their own binding."""
-    cop_execs: dict[int, int] = {}
+    stats row count (the durable skew signal) plus a hot boost from the
+    stores' MEASURED per-(region, table) traffic rings (kv/memstore
+    TrafficStats, swept as the ``heatmap`` sys_snapshot section) — keys
+    touched over the retained window, reads and writes alike. This replaced
+    the old cop-digest exec-count heuristic: the heatmap weighs actual keys
+    moved, counts write traffic the cop ring never saw, and decays as the
+    rings roll. Partitioned tables are immovable for now — their physical
+    views would each need their own binding."""
+    traffic: dict[int, int] = {}
     try:
-        for o in db.health.sweep(sections=("statements",)):
+        for o in db.health.sweep(sections=("heatmap",)):
             if not o["ok"]:
                 continue
-            for st in o["report"].get("statements", ()):
-                digest = st.get("digest", "") if isinstance(st, dict) else ""
-                if digest.startswith("cop:"):
-                    try:
-                        tid = int(digest.split(":", 1)[1].split("|", 1)[0])
-                    except ValueError:
-                        continue
-                    cop_execs[tid] = cop_execs.get(tid, 0) + int(st.get("exec_count", 0))
+            for ent in o["report"].get("heatmap", ()):
+                n = sum(b[1] + b[3] for b in ent["buckets"])  # read+write keys
+                traffic[ent["table_id"]] = traffic.get(ent["table_id"], 0) + n
     # load probes are advisory: the balancer still sees row weights, and a
     # dead store's missing report must never abort the sweep
     except Exception:  # graftcheck: off=except-swallow
@@ -511,7 +508,7 @@ def _shard_weights(db, store):
             t = db.catalog.table(db_name, tname)
             st = db.stats.get(t.id)
             w = float(max(st.row_count if st is not None else 0, 1))
-            w += 100.0 * cop_execs.get(t.id, 0)
+            w += float(traffic.get(t.id, 0))
             si = store.shard_of_table(t.id)
             weights[si] += w
             if t.partition is None:

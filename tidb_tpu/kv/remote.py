@@ -153,6 +153,13 @@ def _cursor_from_pb(pb):
     return ("stable", int(pb[1]), int(pb[2]))
 
 
+# every section name sys_report's request side may select — the graftcheck
+# sys-sections rule asserts each _want("...") literal below is declared here,
+# so a new heavy section cannot silently ship to load probes that asked for
+# nothing (the sections=() discipline)
+SYS_SECTIONS = frozenset({"metrics", "statements", "slow", "heatmap"})
+
+
 def sys_report(store=None, server=None, hist=None, sections=None) -> dict:
     """One process's introspection report — what the replay-safe
     ``sys_snapshot`` verb ships fleet-wide (ref: the gRPC coprocessor
@@ -207,6 +214,10 @@ def sys_report(store=None, server=None, hist=None, sections=None) -> dict:
             # the same section a store server's StmtSummary would, so the
             # balancer's hot-table boost works in-process too
             rep["statements"] = [st.to_pb() for st in ring.stats()[-64:]]
+        if _want("heatmap"):
+            # keyspace traffic rings (Key Visualizer substrate) — heavy like
+            # statements/slow, so only shipped when asked for
+            rep["heatmap"] = store.traffic.snapshot()
     if server is not None:
         rep["addr"] = f"{server.host}:{server.port}"
         with server._conns_mu:
@@ -500,11 +511,12 @@ class StoreServer:
                 val = buf[off : off + vlen]
                 off += vlen
                 muts.append(Mutation(OP_PUT if op == 0 else OP_DEL, key, val))
-            st.prewrite(muts, _ub(h["primary"]), h["start_ts"])
-            return {"ok": 1}, []
+            counts = st.prewrite(muts, _ub(h["primary"]), h["start_ts"])
+            # write-side accounting rides the reply headers (RU metering)
+            return {"ok": 1, **(counts or {})}, []
         if cmd == "commit":
-            st.commit([_ub(k) for k in h["keys"]], h["start_ts"], h["commit_ts"])
-            return {"ok": 1}, []
+            counts = st.commit([_ub(k) for k in h["keys"]], h["start_ts"], h["commit_ts"])
+            return {"ok": 1, **(counts or {})}, []
         if cmd == "rollback":
             st.rollback([_ub(k) for k in h["keys"]], h["start_ts"])
             return {"ok": 1}, []
@@ -1447,16 +1459,18 @@ class RemoteStore:
         h, _ = self._call({"cmd": "check_txn_status", "primary": _b(primary), "start_ts": start_ts})
         return h["status"], h["commit_ts"]
 
-    def prewrite(self, mutations: Sequence[Mutation], primary: bytes, start_ts: int) -> None:
+    def prewrite(self, mutations: Sequence[Mutation], primary: bytes, start_ts: int) -> dict:
         buf = bytearray()
         for m in mutations:
             buf += bytes([0 if m.op == OP_PUT else 1])
             buf += struct.pack("<I", len(m.key)) + m.key
             buf += struct.pack("<Q", len(m.value)) + m.value
-        self._call({"cmd": "prewrite", "primary": _b(primary), "start_ts": start_ts}, [bytes(buf)])
+        h, _ = self._call({"cmd": "prewrite", "primary": _b(primary), "start_ts": start_ts}, [bytes(buf)])
+        return {"keys": int(h.get("keys", 0)), "bytes": int(h.get("bytes", 0))}
 
-    def commit(self, keys: Sequence[bytes], start_ts: int, commit_ts: int) -> None:
-        self._call({"cmd": "commit", "keys": [_b(k) for k in keys], "start_ts": start_ts, "commit_ts": commit_ts})
+    def commit(self, keys: Sequence[bytes], start_ts: int, commit_ts: int) -> dict:
+        h, _ = self._call({"cmd": "commit", "keys": [_b(k) for k in keys], "start_ts": start_ts, "commit_ts": commit_ts})
+        return {"keys": int(h.get("keys", 0)), "bytes": int(h.get("bytes", 0))}
 
     def rollback(self, keys: Sequence[bytes], start_ts: int) -> None:
         self._call({"cmd": "rollback", "keys": [_b(k) for k in keys], "start_ts": start_ts})

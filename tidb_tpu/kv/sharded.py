@@ -790,7 +790,7 @@ class ShardedStore:
                 by.setdefault(si, []).append(k)
         return by.items()
 
-    def prewrite(self, mutations: Sequence[Mutation], primary: bytes, start_ts: int) -> None:
+    def prewrite(self, mutations: Sequence[Mutation], primary: bytes, start_ts: int) -> dict:
         # placement-routed: the grouping recomputes per attempt, so a
         # region that moved between two attempts re-routes (prewrite is
         # idempotent under one start_ts — re-sending to the new owner is
@@ -805,8 +805,15 @@ class ShardedStore:
                 lambda si, muts: self.stores[si].prewrite(muts, primary, start_ts),
                 lambda muts: all(not self.is_table_key(m.key) for m in muts),
             )
+            # write accounting computed from the UNIQUE mutation list, not the
+            # per-store replies: meta keys fan to every replica and would
+            # otherwise count once per shard
+            return {
+                "keys": len(mutations),
+                "bytes": sum(len(m.key) + len(m.value) for m in mutations),
+            }
 
-        self._routed("prewrite", once)
+        return self._routed("prewrite", once)
 
     def commit(self, keys: Sequence[bytes], start_ts: int, commit_ts: int) -> None:
         # placement-routed on the TYPED refusal only: a fenced ex-owner
@@ -1126,6 +1133,21 @@ class ShardedStore:
             return fn(table_id, kr, read_ts) if fn is not None else []
 
         return self._routed("stable_parts", run)
+
+    def note_region_read(self, region_id: int, table_id: int, keys: int, nbytes: int) -> None:
+        """Cop-serve traffic (copr/colcache.get_split) lands on the range
+        owner's rings — the store that answers for the table is the one
+        whose heatmap should show it hot. Embedded members take the note
+        directly; wire members note server-side when their cop verbs run,
+        so nothing ships here. Advisory: a mid-move owner flip just
+        attributes the serve to whichever store owns the table NOW."""
+        try:
+            st = self.stores[self.shard_of_table(table_id)]
+        except Exception:  # graftcheck: off=except-swallow
+            return
+        fn = getattr(st, "note_region_read", None)
+        if fn is not None:
+            fn(region_id, table_id, keys, nbytes)
 
     def col_changes_since(self, region_id: int, table_id: int, after_ts: int):
         # coordinator-side region ids are minted (shard/epoch-namespaced), so

@@ -42,6 +42,14 @@ def is_record_key(key: bytes) -> bool:
     return len(key) == _RECORD_KEY_LEN and key[:1] == TABLE_PREFIX and key[9:11] == RECORD_SEP
 
 
+def table_id_of(key: bytes) -> int:
+    """table_id of ANY table-space key (record, index, or bare prefix);
+    -1 for keys outside the ``t`` keyspace (meta, election, placement)."""
+    if key[:1] != TABLE_PREFIX or len(key) < 9:
+        return -1
+    return codec.decode_int_raw(key, 1)
+
+
 def record_range(table_id: int) -> KeyRange:
     """Full-table scan range: [t{id}_r, t{id}_s)."""
     p = record_prefix(table_id)

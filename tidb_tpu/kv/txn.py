@@ -114,6 +114,11 @@ class Txn:
         self._locked_keys: set[bytes] = set()
         self._pess_primary: Optional[bytes] = None
         self._primary: Optional[bytes] = None  # recorded at commit for resolve_undetermined
+        # write-side accounting set by commit() (WRU metering inputs): unique
+        # keys/bytes this txn wrote, from the prewrite response headers when
+        # the store reports them, else computed client-side
+        self.write_keys = 0
+        self.write_bytes = 0
 
     # -- pessimistic locking ------------------------------------------------
     def lock_keys(self, keys, wait_timeout_ms: int = 3000) -> None:
@@ -221,11 +226,17 @@ class Txn:
             primary = self._pess_primary  # keep lock primary stable across upgrade
         self._primary = primary
         try:
-            self.store.prewrite(muts, primary, self.start_ts)
+            counts = self.store.prewrite(muts, primary, self.start_ts)
         except KeyLockedError as e:
             self.store.resolve_lock(e.key, e.lock)
             # single retry after resolution; else surface the conflict
-            self.store.prewrite(muts, primary, self.start_ts)
+            counts = self.store.prewrite(muts, primary, self.start_ts)
+        if isinstance(counts, dict) and "keys" in counts:
+            self.write_keys = int(counts["keys"])
+            self.write_bytes = int(counts.get("bytes", 0))
+        else:  # store (or a wrapper) predates the accounting headers
+            self.write_keys = len(muts)
+            self.write_bytes = sum(len(m.key) + len(m.value) for m in muts)
         self.commit_ts = self.store.tso.ts()
         # commit primary first — the txn is durably decided once this returns.
         # An UndeterminedError here (commit sent, reply lost) propagates with

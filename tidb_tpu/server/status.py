@@ -65,7 +65,7 @@ class StatusServer:
                         if o["ok"]:
                             ent["report"] = {
                                 k: v for k, v in o["report"].items()
-                                if k not in ("metrics", "history", "statements", "slow", "traces")
+                                if k not in ("metrics", "history", "statements", "slow", "traces", "heatmap")
                             }
                         else:
                             ent["error"] = o["error"]
@@ -124,7 +124,9 @@ class StatusServer:
                              "cop_summary": e.cop_summary,
                              "trace_id": e.trace_id,
                              "events": e.events,
-                             "first_error": e.first_error}
+                             "first_error": e.first_error,
+                             "ru": e.ru,
+                             "resource_group": e.resource_group}
                             for e in outer.db.stmt_summary.slow_queries()
                         ]
                     ).encode()
@@ -180,10 +182,43 @@ class StatusServer:
                     body = json.dumps(
                         [
                             {"sql_digest": d, "plan_digest": p, "sample": s,
-                             "cpu_time_sec": c, "samples": n, "trace_id": t}
-                            for d, p, s, c, n, t in collector().top_sql()
+                             "cpu_time_sec": c, "samples": n, "trace_id": t,
+                             "ru": ru}
+                            for d, p, s, c, n, t, ru in collector().top_sql()
                         ]
                     ).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/keyviz"):
+                    # the keyspace traffic heatmap, raw (the Key Visualizer
+                    # substrate; information_schema.keyspace_heatmap is the
+                    # SQL face of the same sweep): one live heatmap-only
+                    # sys_snapshot sweep, ?seconds=<s> trims each ring to
+                    # the trailing window; dead stores degrade to error
+                    # entries, never a failed response
+                    import time as _time
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    secs = q.get("seconds", [None])[0]
+                    try:
+                        since = _time.time() - float(secs) if secs else 0.0
+                    except ValueError:
+                        self.send_response(400)
+                        self.end_headers()
+                        return
+                    outs = outer.db.health.sweep(sections=("heatmap",))
+                    ents = []
+                    for o in outs:
+                        ent = {"instance": o["instance"], "ok": o["ok"]}
+                        if o["ok"]:
+                            ent["heatmap"] = [
+                                {**e, "buckets": [b for b in e["buckets"] if b[0] >= since]}
+                                for e in o["report"].get("heatmap", ())
+                            ]
+                        else:
+                            ent["error"] = o["error"]
+                        ents.append(ent)
+                    body = json.dumps({"instances": ents}).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/traces"):
                     # the always-on sampled-trace reservoir (utils/tracing

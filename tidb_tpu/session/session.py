@@ -258,6 +258,18 @@ class Session:
         self._last_mem_peak = 0
         self._killed = False
         self._deadline: Optional[float] = None
+        # per-statement write-side accounting (WRU inputs): accumulated from
+        # Txn.write_keys/write_bytes at _finish_txn, reset per statement —
+        # an explicit COMMIT statement carries the whole txn's writes
+        self._stmt_write_keys = 0
+        self._stmt_write_bytes = 0
+        # DRYRUN runaway observation: (deadline, group_name) armed by _select
+        # for groups whose QUERY_LIMIT action is DRYRUN — check_killed records
+        # the breach WITHOUT killing (observational only; KILL keeps its
+        # enforcing deadline in self._deadline)
+        self._runaway_obs: Optional[tuple] = None
+        self._runaway_fired = False  # this statement already logged a runaway
+        self._cur_sql = ""  # current statement text (runaway record sample)
         # session-scoped plan bindings (override globals; ref: bindinfo scope)
         self.bindings: dict[str, tuple[str, str]] = {}
         # user variables (@x) and prepared statements (session-scoped)
@@ -351,6 +363,8 @@ class Session:
             t, self._txn = self._txn, None
             if commit:
                 t.commit()
+                self._stmt_write_keys += getattr(t, "write_keys", 0)
+                self._stmt_write_bytes += getattr(t, "write_bytes", 0)
                 # stats deltas flush at commit, not per statement (ref:
                 # stats delta dumping) — rolled-back mods never count
                 for tid, n in self._pending_mods.items():
@@ -374,6 +388,12 @@ class Session:
             raise QueryKilledError("Query execution was interrupted")
         if self._deadline is not None and time.monotonic() > self._deadline:
             raise QueryKilledError("Query execution was interrupted, maximum statement execution time exceeded")
+        if self._runaway_obs is not None and time.monotonic() > self._runaway_obs[0]:
+            # DRYRUN runaway: record + WARN event, never kill (observational)
+            _, gname = self._runaway_obs
+            self._runaway_obs = None
+            self._runaway_fired = True
+            self._db.resource_groups.record_runaway(gname, "DRYRUN", self._cur_sql[:256])
 
     # -- tracing (ref: util/tracing StartRegionEx call sites) ----------------
     def span(self, name: str):
@@ -470,6 +490,33 @@ class Session:
         self.mpp_details.append(detail)
         if self.runtime_stats is not None:
             self.runtime_stats.record_mpp(plan, detail)
+
+    def _assemble_usage(self, dt_s: float, cpu_ms: float, rows: int):
+        """Fold the statement's exec-details sidecars and write accounting
+        into one ResourceUsage record (the RU metering input). Reads only
+        per-statement state — call after the statement finishes, before the
+        next one resets the sidecars."""
+        from tidb_tpu.resourcegroup.groups import ResourceUsage
+
+        u = ResourceUsage(wall_ms=dt_s * 1000.0, cpu_ms=cpu_ms, rows_returned=rows)
+        cs = self.exec_summary
+        if cs is not None and cs.num:
+            u.cop_rpcs = cs.num
+            u.device_ms = cs.device_ms
+            u.host_ms = cs.host_ms
+            u.h2d_bytes = cs.h2d_bytes
+            u.d2h_bytes = cs.d2h_bytes
+            u.backoff_ms = cs.backoff_ms
+            u.keys_scanned = cs.keys_scanned
+            u.bytes_scanned = cs.bytes_scanned
+        for m in self.mpp_details:
+            for s in m.shards:
+                if len(s) > 3:
+                    u.mpp_exchange_bytes += int(s[3])
+            u.mpp_exchange_bytes += sum(int(b) for b in m.stage_bytes)
+        u.keys_written = self._stmt_write_keys
+        u.bytes_written = self._stmt_write_bytes
+        return u.finalize()
 
     def _audit_stmt(self, sql: str, event: str, duration_s: float, error: str = "") -> None:
         if not self._db.extensions.have:
@@ -637,6 +684,11 @@ class Session:
         self.mpp_details = []
         self._last_plan = None
         self._last_mem_peak = 0
+        self._stmt_write_keys = 0
+        self._stmt_write_bytes = 0
+        self._runaway_fired = False
+        self._cur_sql = exec_sql
+        t0_cpu = _time.thread_time()
         if not isinstance(stmt, ast.Show):  # SHOW WARNINGS must see them
             self._prev_warnings = self.warnings
             self.warnings = []
@@ -664,6 +716,22 @@ class Session:
 
                 # memoized on the plan object — cached plans pay this once
                 pd = _plan_digest(self._last_plan)
+            # workload attribution: fold the statement's sidecars + write
+            # accounting into a measured ResourceUsage → RUs (metering only;
+            # ref: the resource-control RU model + RunawayChecker at
+            # adapter.go:553)
+            from tidb_tpu.resourcegroup import groups as _rg
+
+            gname = str(self.vars.get("tidb_resource_group", "default"))
+            g = self._db.resource_groups.get(gname)
+            usage = None
+            ru = 0.0
+            if _rg.METERING_ENABLED:
+                usage = self._assemble_usage(
+                    dt, (_time.thread_time() - t0_cpu) * 1000.0,
+                    len(res.rows) or res.affected,
+                )
+                ru = usage.ru
             self._db.stmt_summary.record(
                 exec_sql, dt, len(res.rows) or res.affected, f"{self.user}@{self.host}",
                 float(self.vars.get("tidb_slow_log_threshold", 300)) / 1000.0,
@@ -674,13 +742,16 @@ class Session:
                 # the structured SlowEntry
                 trace_id=(self._sampled_tracer.trace_id if self._sampled_tracer is not None else ""),
                 mem_max=self._last_mem_peak,
+                ru=ru,
+                resource_group=(g.name if g is not None else gname),
             )
-            # resource-group accounting + runaway detection (ref:
-            # RunawayChecker at adapter.go:553; RU model per request)
-            g = self._db.resource_groups.get(str(self.vars.get("tidb_resource_group", "default")))
+            if topsql is not None and ru:
+                topsql.note_ru(sql_digest().split("|")[0], ru)
             if g is not None:
-                g.consume(0.125 + (len(res.rows) or res.affected))
-                if g.exec_elapsed_s and dt > g.exec_elapsed_s:
+                if usage is not None:
+                    g.consume(ru)
+                    self._db.resource_groups.charge(g.name, usage)
+                if g.exec_elapsed_s and dt > g.exec_elapsed_s and not self._runaway_fired:
                     self._db.resource_groups.record_runaway(g.name, g.action, exec_sql[:256])
             self._audit_stmt(exec_sql, "ok", dt)
             return res
@@ -688,7 +759,11 @@ class Session:
             _m.STMT_TOTAL.inc(type=f"{stype}:error")
             self._audit_stmt(exec_sql, "error", _time.perf_counter() - t0, str(exc))
             g = self._db.resource_groups.get(str(self.vars.get("tidb_resource_group", "default")))
-            if g is not None and g.exec_elapsed_s and (_time.perf_counter() - t0) >= g.exec_elapsed_s:
+            if (
+                g is not None and g.exec_elapsed_s
+                and (_time.perf_counter() - t0) >= g.exec_elapsed_s
+                and not self._runaway_fired
+            ):
                 self._db.resource_groups.record_runaway(g.name, g.action, exec_sql[:256])
             if not self._explicit and self._txn is not None:
                 # autocommit statement failed → roll back its staged writes
@@ -1342,6 +1417,12 @@ class Session:
         if g is not None and g.exec_elapsed_s and g.action == "KILL":
             limits.append(g.exec_elapsed_s)
         self._deadline = (time.monotonic() + min(limits)) if limits else None
+        # DRYRUN arms an OBSERVATIONAL deadline on the same check_killed()
+        # seam: past it the statement is recorded as a runaway (+ WARN
+        # event) but keeps running — metering, not enforcement
+        self._runaway_obs = None
+        if g is not None and g.exec_elapsed_s and g.action == "DRYRUN":
+            self._runaway_obs = (time.monotonic() + g.exec_elapsed_s, g.name)
         try:
             with self.span("plan"):
                 plan = self._plan_select(stmt, cache_key=cache_key, capture=is_outer)
@@ -1383,6 +1464,7 @@ class Session:
         finally:
             self._read_ts_override = None
             self._deadline = None
+            self._runaway_obs = None
             if self.mem_tracker is not None:
                 # max over every _select of the statement (subqueries/CTEs
                 # run their own tracker before the outer one finishes)
@@ -1855,6 +1937,13 @@ class Session:
             finally:
                 coll, self.runtime_stats = self.runtime_stats, None
             text = explain_plan(plan, stats=coll)
+            from tidb_tpu.resourcegroup import groups as _rg
+
+            if _rg.METERING_ENABLED:
+                # the RU the run just metered, as a trailing plan row (the
+                # wall/cpu terms belong to execute(); this shows the
+                # statement-shape charge: scans, cop RPCs, exchanges)
+                text += f"\nru: {self._assemble_usage(0.0, 0.0, 0).ru:.2f}"
         else:
             text = explain_plan(plan)
         return Result(columns=["plan"], rows=[(line,) for line in text.split("\n")])

@@ -20,6 +20,7 @@ import ast
 from tidb_tpu.tools.check.core import Finding, Tree, rule
 
 RULE = "replay-registry"
+SECTIONS_RULE = "sys-sections"
 
 _DECLS = ("REPLAYABLE", "NON_REPLAYABLE")
 
@@ -205,6 +206,92 @@ def check(tree: Tree) -> list:
                 "`replayable = cmd in REPLAYABLE` (a not-in-NON_REPLAYABLE test "
                 "silently replays every undeclared verb)",
                 symbol="gate",
+            )
+        )
+    return out
+
+
+@rule(
+    SECTIONS_RULE,
+    "every sys_report section a _want() gate selects must be declared",
+    """
+kv/remote.py must declare a module-level SYS_SECTIONS frozenset naming
+every report section the request side may select, and every literal
+`_want("...")` gate inside sys_report must name a declared section (and
+every declared section must have a gate — a stale declaration misleads the
+next section author). PR 9 established the sections= discipline: heavy
+report parts (statements rings, slow logs, traffic heatmaps) ship ONLY
+when a sweep asks for them, so a load probe with sections=() stays cheap.
+A _want literal missing from the declaration is exactly how a new heavy
+section silently escapes that contract — consumers can't discover it, the
+/cluster slim filter doesn't know to strip it, and nobody reviewed its
+wire weight. Fix: add the section name to SYS_SECTIONS next to the gate.
+""",
+)
+def check_sections(tree: Tree) -> list:
+    sf = tree.get("kv/remote.py")
+    if sf is None:
+        return []
+    out: list[Finding] = []
+    declared = None
+    decl_ln = 1
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and t.id == "SYS_SECTIONS":
+                declared = _literal_set(node.value)
+                decl_ln = node.lineno
+    if declared is None:
+        out.append(
+            Finding(
+                SECTIONS_RULE,
+                sf.path,
+                1,
+                "kv/remote.py must declare a module-level SYS_SECTIONS "
+                "frozenset of literal sys_report section names",
+                symbol="declarations",
+            )
+        )
+        return out
+    report_fn = None
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "sys_report":
+            report_fn = node
+            break
+    gated: dict[str, int] = {}
+    if report_fn is not None:
+        for node in ast.walk(report_fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "_want"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                gated.setdefault(node.args[0].value, node.lineno)
+    for name, ln in sorted(gated.items()):
+        if name not in declared:
+            out.append(
+                Finding(
+                    SECTIONS_RULE,
+                    sf.path,
+                    ln,
+                    f"sys_report gates section {name!r} with _want() but "
+                    "SYS_SECTIONS does not declare it — the section escapes "
+                    "the sections= selection contract",
+                    symbol=name,
+                )
+            )
+    for name in sorted(declared - set(gated)):
+        out.append(
+            Finding(
+                SECTIONS_RULE,
+                sf.path,
+                decl_ln,
+                f"SYS_SECTIONS declares {name!r} but no _want({name!r}) gate "
+                "exists in sys_report — stale declaration",
+                symbol=name,
             )
         )
     return out

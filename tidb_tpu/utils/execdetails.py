@@ -54,7 +54,7 @@ class CopExecDetails:
         "region_id", "store", "queue_ms", "wire_ms", "proc_ms", "device_ms",
         "host_ms", "compile_ms", "h2d_bytes", "d2h_bytes", "dev_cache_hits",
         "dev_cache_misses", "engine", "degraded", "retries", "backoff_ms",
-        "resplits", "delta_rows", "merges",
+        "resplits", "delta_rows", "merges", "keys_scanned", "bytes_scanned",
     )
 
     def __init__(self, region_id: int = -1, store: str = ""):
@@ -77,6 +77,8 @@ class CopExecDetails:
         self.resplits = 0  # region re-splits (epoch changes)
         self.delta_rows = 0  # columnar delta-overlay rows this scan read through
         self.merges = 0  # delta→base merges this task triggered (query-path)
+        self.keys_scanned = 0  # store-side MVCC keys this task read (RU input)
+        self.bytes_scanned = 0  # store-side bytes those keys carried
 
     def to_pb(self) -> dict:
         """Compact wire form (zeros omitted — the sidecar rides every cop
@@ -110,6 +112,10 @@ class CopExecDetails:
             out["dlr"] = self.delta_rows
         if self.merges:
             out["mg"] = self.merges
+        if self.keys_scanned:
+            out["sk"] = self.keys_scanned
+        if self.bytes_scanned:
+            out["sb"] = self.bytes_scanned
         return out
 
     def merge_pb(self, pb: dict) -> None:
@@ -132,6 +138,8 @@ class CopExecDetails:
         self.resplits += int(pb.get("rs", 0))
         self.delta_rows += int(pb.get("dlr", 0))
         self.merges += int(pb.get("mg", 0))
+        self.keys_scanned += int(pb.get("sk", 0))
+        self.bytes_scanned += int(pb.get("sb", 0))
 
 
 class CopTasksSummary:
@@ -142,7 +150,7 @@ class CopTasksSummary:
         "procs", "queue_ms", "wire_ms", "device_ms", "host_ms", "compile_ms",
         "h2d_bytes", "d2h_bytes", "dev_cache_hits", "dev_cache_misses",
         "engines", "degraded", "retries", "backoff_ms", "resplits",
-        "delta_rows", "merges",
+        "delta_rows", "merges", "keys_scanned", "bytes_scanned",
         "max_proc_ms", "max_task_store", "max_task_region",
     )
 
@@ -164,6 +172,8 @@ class CopTasksSummary:
         self.resplits = 0
         self.delta_rows = 0
         self.merges = 0
+        self.keys_scanned = 0
+        self.bytes_scanned = 0
         self.max_proc_ms = 0.0
         self.max_task_store = ""
         self.max_task_region = -1
@@ -192,6 +202,8 @@ class CopTasksSummary:
         self.resplits += d.resplits
         self.delta_rows += d.delta_rows
         self.merges += d.merges
+        self.keys_scanned += d.keys_scanned
+        self.bytes_scanned += d.bytes_scanned
         if d.proc_ms >= self.max_proc_ms:
             self.max_proc_ms = d.proc_ms
             self.max_task_store = d.store or "local"
@@ -230,6 +242,8 @@ class CopTasksSummary:
             parts.append(f"h2d: {self.h2d_bytes}B, d2h: {self.d2h_bytes}B")
         if self.dev_cache_hits or self.dev_cache_misses:
             parts.append(f"dev_cache: {self.dev_cache_hits}/{self.dev_cache_hits + self.dev_cache_misses}")
+        if self.keys_scanned:
+            parts.append(f"scan: {self.keys_scanned} keys/{self.bytes_scanned}B")
         if self.delta_rows:
             parts.append(f"delta_rows: {self.delta_rows}")  # scan paid the delta path
         if self.merges:

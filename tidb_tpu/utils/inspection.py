@@ -60,6 +60,9 @@ class InspectionContext:
     # committed rows pending in delta overlays / the compactor threshold
     delta_rows: float = 0.0
     delta_merge_rows: int = 2048
+    # (instance, region_id, table_id) → keys touched over the retained
+    # traffic window (reads + writes), from cached heatmap report sections
+    region_traffic: dict = field(default_factory=dict)
 
     @classmethod
     def from_db(cls, db) -> "InspectionContext":
@@ -93,6 +96,10 @@ class InspectionContext:
                 rep = ent.get("report") or {}
                 if "device_cache_bytes" in rep:
                     ctx.cache_bytes[inst] = rep["device_cache_bytes"]
+                for hent in rep.get("heatmap", ()):
+                    n = sum(b[1] + b[3] for b in hent["buckets"])
+                    k = (inst, hent["region_id"], hent["table_id"])
+                    ctx.region_traffic[k] = ctx.region_traffic.get(k, 0) + n
         if not ctx.cache_bytes:
             # no fleet cache — read this process's own device cache
             store = getattr(db, "store", None)
@@ -300,6 +307,37 @@ def _mpp_straggler(ctx: InspectionContext):
         status = WARNING
     return [("mpp", status, f"{ratio:.1f}", "p95/median <= 4",
              f"p95={p95:g}s median={p50:g}s over {snap['count']} shards")]
+
+
+@rule(
+    "hot-region", "balance",
+    "Single-region traffic skew from the stores' keyspace heatmap rings — "
+    "one region taking a sustained multiple of the others' traffic wants a "
+    "split or a balancer move",
+)
+def _hot_region(ctx: InspectionContext):
+    tr = ctx.region_traffic
+    if len(tr) < 2:
+        return [("regions", OK, "n/a", "hottest/mean-of-rest <= 4",
+                 "under 2 regions with traffic")]
+    (hk, hot) = max(tr.items(), key=lambda kv: kv[1])
+    rest = [v for k, v in tr.items() if k != hk]
+    mean_rest = sum(rest) / len(rest)
+    if hot <= 0 or mean_rest <= 0:
+        return [("regions", OK, "n/a", "hottest/mean-of-rest <= 4",
+                 "no traffic in the retained window")]
+    ratio = hot / mean_rest
+    status = OK
+    if ratio > 16:
+        status = CRITICAL
+    elif ratio > 4:
+        status = WARNING
+    inst, rid, tid = hk
+    return [(
+        f"region-{rid}", status, f"{ratio:.1f}", "hottest/mean-of-rest <= 4",
+        f"{hot} keys on {inst} table {tid} vs mean {mean_rest:.0f} "
+        f"over {len(rest)} other regions",
+    )]
 
 
 @rule(

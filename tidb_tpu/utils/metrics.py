@@ -383,3 +383,17 @@ META_CATCHUP = REGISTRY.counter(
     "tidb_tpu_meta_catchup_total",
     "Returning-replica anti-entropy replays (meta + election + placement)",
 )
+# workload attribution (resourcegroup/groups.py): per-group request units
+# and statement counts — the metering substrate admission control (ROADMAP
+# item 3) will act on. Labeled by resource group so metricshist keeps a
+# per-tenant consumption history.
+RU_CONSUMED = REGISTRY.counter(
+    "tidb_tpu_resource_group_ru_total",
+    "Request units consumed per resource group (RRU + WRU, metering only)",
+    ("group",),
+)
+RU_STATEMENTS = REGISTRY.counter(
+    "tidb_tpu_resource_group_statement_total",
+    "Statements attributed per resource group",
+    ("group",),
+)

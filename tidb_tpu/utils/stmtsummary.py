@@ -74,6 +74,11 @@ class StmtStats:
     # peak per-statement memory (utils/memory.Tracker root max_consumed) —
     # the statements_summary MAX_MEM column (OOM forensics without a repro)
     max_mem: int = 0
+    # workload attribution: request units this digest consumed and the
+    # resource group its sessions ran under (statements_summary SUM_RU /
+    # RESOURCE_GROUP; metering only)
+    sum_ru: float = 0.0
+    resource_group: str = ""
 
     @property
     def avg_latency(self) -> float:
@@ -126,6 +131,10 @@ class SlowEntry:
     # ERROR-level one (component.event) — the "what went wrong first" pivot
     events: int = 0
     first_error: str = ""
+    # workload attribution: the statement's metered request units and its
+    # session's resource group (slow_query RU / RESOURCE_GROUP)
+    ru: float = 0.0
+    resource_group: str = ""
 
     def __iter__(self):
         # legacy 5-tuple shape for pre-structured consumers
@@ -166,6 +175,8 @@ class StmtSummary:
         cop=None,
         trace_id: str = "",
         mem_max: int = 0,
+        ru: float = 0.0,
+        resource_group: str = "",
     ) -> None:
         # the session computes one digest per statement and threads it here
         # (plus Top-SQL/bindings) instead of re-normalizing per consumer;
@@ -184,6 +195,9 @@ class StmtSummary:
             st.sum_rows += rows
             st.last_seen = time.time()
             st.max_mem = max(st.max_mem, int(mem_max))
+            st.sum_ru += ru
+            if resource_group:
+                st.resource_group = resource_group
             if plan_digest:
                 st.plan_digest = plan_digest
             if cop is not None and cop.num:
@@ -195,6 +209,7 @@ class StmtSummary:
                     time.time(), sql[:512], latency_s, rows, user,
                     digest=d.partition("|")[0], plan_digest=plan_digest,
                     trace_id=trace_id, mem_max=int(mem_max),
+                    ru=ru, resource_group=resource_group,
                 )
                 if cop is not None and cop.num:
                     e.cop_tasks = cop.num

@@ -39,6 +39,10 @@ class TopSQLCollector:
         # digest → the last trace-sampled statement's reservoir trace id:
         # the Top-SQL ↔ trace-reservoir pivot (GET /traces?id=...)
         self._trace_of: dict[str, str] = {}
+        # digest → cumulative metered request units (workload attribution:
+        # hot-by-CPU vs hot-by-RU can rank differently — scan-heavy
+        # statements burn RUs in the store, not in this process's frames)
+        self._ru_of: dict[str, float] = defaultdict(float)
         # collapsed python stacks: "mod.fn;mod.fn;..." → samples
         self._stacks: dict[int, dict[str, int]] = {}
         self._stop = threading.Event()
@@ -53,6 +57,11 @@ class TopSQLCollector:
             self._attached.setdefault(tid, []).append(
                 (sql_digest, plan_digest, sample_sql[:256], trace_id)
             )
+
+    def note_ru(self, sql_digest: str, ru: float) -> None:
+        """Accumulate a finished statement's metered RUs on its digest."""
+        with self._mu:
+            self._ru_of[sql_digest] += ru
 
     def detach(self) -> None:
         tid = threading.get_ident()
@@ -129,13 +138,16 @@ class TopSQLCollector:
                             self._samples_of.pop(dg, None)
                             self._plan_of.pop(dg, None)
                             self._trace_of.pop(dg, None)
+                            self._ru_of.pop(dg, None)
 
     # -- reports ------------------------------------------------------------
     def top_sql(self, last_s: int = 60, limit: int = 30) -> list[tuple]:
         """[(digest, plan_digest, sample_sql, cpu_seconds, samples,
-        trace_id)] over the trailing ``last_s`` seconds, hottest first.
+        trace_id, ru)] over the trailing ``last_s`` seconds, hottest first.
         ``trace_id`` cross-links to the trace reservoir when a sampled
-        statement contributed samples."""
+        statement contributed samples; ``ru`` is the digest's cumulative
+        metered request units (lifetime — RUs land once per statement, not
+        per sample, so they don't window)."""
         cutoff = int(time.time()) - last_s
         agg: dict[str, int] = defaultdict(int)
         with self._mu:
@@ -151,6 +163,7 @@ class TopSQLCollector:
                     round(n * self.interval_s, 4),
                     n,
                     self._trace_of.get(dg, ""),
+                    round(self._ru_of.get(dg, 0.0), 3),
                 )
                 for dg, n in agg.items()
             ]
